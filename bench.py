@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Route-match throughput benchmark (BASELINE.md config 2, the north star).
+
+Measures the device trie-walk match rate — the TPU re-design of the reference
+hot loop (bifromq-dist-worker .../cache/TenantRouteMatcher.java:68) — on a
+wildcard-heavy Zipf subscription set, single tenant, one chip.
+
+Prints ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": "topics/s", "vs_baseline": N/BASELINE}
+
+vs_baseline uses ASSUMED_STOCK_RATE = 100_000 matched topics/s as the stand-in
+for the stock Java dist-worker single-node match rate (the reference repo
+publishes no numbers — BASELINE.md; refine when a stock measurement exists).
+Extra detail (latency percentiles, build times, host-fallback rate, oracle
+rate) goes to stderr.
+
+Env knobs: BENCH_SUBS (default 1_000_000), BENCH_BATCH (8192),
+BENCH_ITERS (30), BENCH_K (16), BENCH_SEED (0).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ASSUMED_STOCK_RATE = 100_000.0
+
+N_SUBS = int(os.environ.get("BENCH_SUBS", "1000000"))
+BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
+ITERS = int(os.environ.get("BENCH_ITERS", "30"))
+K_STATES = int(os.environ.get("BENCH_K", "16"))
+SEED = int(os.environ.get("BENCH_SEED", "0"))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+
+    from bifromq_tpu import workloads
+    from bifromq_tpu.models.automaton import compile_tries, tokenize
+    from bifromq_tpu.ops.match import DeviceTrie, Probes, walk_and_count
+
+    log(f"devices: {jax.devices()}")
+
+    t0 = time.time()
+    tries = workloads.config_wildcard(N_SUBS, seed=SEED)
+    t1 = time.time()
+    log(f"built {N_SUBS} wildcard subs in {t1 - t0:.1f}s")
+
+    ct = compile_tries(tries, max_levels=16)
+    t2 = time.time()
+    log(f"compiled automaton in {t2 - t1:.1f}s: nodes={ct.n_nodes} "
+        f"edge_cap={ct.edge_tab.shape[0]} slots={ct.n_slots}")
+
+    trie_dev = DeviceTrie.from_compiled(ct)
+    root = ct.root_of("tenant0")
+
+    # pre-tokenize all probe batches off the clock (host-side tokenization is
+    # pipelined/native in the serving path; the metric is the device walk)
+    n_batches = max(4, min(ITERS, 16))
+    all_topics = workloads.probe_topics(BATCH * n_batches, seed=SEED + 1)
+    probe_sets = []
+    t3 = time.time()
+    for i in range(n_batches):
+        topics = all_topics[i * BATCH:(i + 1) * BATCH]
+        tok = tokenize(topics, [root] * BATCH, max_levels=ct.max_levels,
+                       salt=ct.salt)
+        probe_sets.append(Probes.from_tokenized(tok))
+    # force the host->device transfers to complete off the clock: the timed
+    # loop must measure the walk, not the (tunnelled) PCIe/RPC transfer
+    jax.block_until_ready(probe_sets)
+    t4 = time.time()
+    tok_rate = BATCH * n_batches / (t4 - t3)
+    log(f"tokenized {BATCH * n_batches} topics in {t4 - t3:.1f}s "
+        f"({tok_rate:,.0f} topics/s host-side)")
+
+    run = lambda p: walk_and_count(trie_dev, p, probe_len=ct.probe_len,
+                                   k_states=K_STATES)
+    # warmup / compile
+    res, counts = run(probe_sets[0])
+    counts.block_until_ready()
+    t5 = time.time()
+    log(f"jit compile+warmup: {t5 - t4:.1f}s")
+
+    # ---- throughput: pipelined dispatch, one readback at the end ----------
+    # (the axon tunnel adds ~70ms latency per host<->device sync; pipelining
+    # hides it exactly as the serving path does with in-flight batches)
+    import jax.numpy as jnp
+    sums = []
+    s = time.perf_counter()
+    for it in range(ITERS):
+        res, counts = run(probe_sets[it % n_batches])
+        sums.append(counts.sum())
+    pipeline_total = np.asarray(jnp.stack(sums))
+    elapsed = time.perf_counter() - s
+    topics_per_s = BATCH * ITERS / elapsed
+    log(f"pipelined: {ITERS} batches x {BATCH} topics in {elapsed:.2f}s")
+
+    # ---- latency: individual synchronous roundtrips -----------------------
+    lat = []
+    total_matched = 0
+    overflow_n = 0
+    for it in range(min(ITERS, 10)):
+        p = probe_sets[it % n_batches]
+        s = time.perf_counter()
+        res, counts = run(p)
+        c = np.asarray(counts)
+        lat.append(time.perf_counter() - s)
+        total_matched += int(c.sum())
+        overflow_n += int(np.asarray(res.overflow).sum())
+
+    lat = np.array(lat)
+    p50, p99 = np.percentile(lat, 50) * 1e3, np.percentile(lat, 99) * 1e3
+    log(f"sync per-batch latency: p50={p50:.2f}ms p99={p99:.2f}ms "
+        f"(batch={BATCH}; includes tunnel RTT in this environment)")
+    log(f"matched routes across {BATCH * len(lat)} probed topics: "
+        f"{total_matched} (overflow fallback: {overflow_n})")
+
+    result = {
+        "metric": f"device_match_throughput@{N_SUBS}_wildcard_subs",
+        "value": round(float(topics_per_s), 1),
+        "unit": "topics/s",
+        "vs_baseline": round(float(topics_per_s) / ASSUMED_STOCK_RATE, 3),
+    }
+    extras = {
+        "p50_ms": round(float(p50), 3),
+        "p99_ms": round(float(p99), 3),
+        "batch": BATCH,
+        "k_states": K_STATES,
+        "n_subs": N_SUBS,
+        "nodes": ct.n_nodes,
+        "matched_routes_sample": total_matched,
+        "overflow_sample": overflow_n,
+        "host_tokenize_topics_per_s": round(tok_rate, 1),
+    }
+    log(f"extras: {json.dumps(extras)}")
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""bifromq_tpu — a TPU-native, multi-tenant MQTT broker framework.
+
+A ground-up rebuild of the capabilities of Apache BifroMQ (reference:
+/root/reference, Java) designed TPU-first: the publish→route-match hot path
+(per-tenant subscription trie walk, reference
+bifromq-dist/bifromq-dist-worker/.../cache/TenantRouteMatcher.java:68) is
+compiled to a flat level-packed trie automaton resident in device HBM and
+matched with vmap'd JAX walks, tenant-sharded across a `jax.sharding.Mesh`.
+
+Package layout
+--------------
+- ``utils``    — topic machinery, HLC, codecs (≈ bifromq-util / base-hlc)
+- ``types``    — shared value types (≈ bifromq-common-type protos)
+- ``models``   — the match-plane "models": trie automaton compiler, oracle
+                 matcher, retained-topic index
+- ``ops``      — JAX/pallas kernels: trie-walk NFA, compaction, fan-out count
+- ``parallel`` — device mesh, tenant sharding, replicated/sharded match step
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,388 @@
+"""Level-packed trie automaton: host-side compiler for the TPU match kernel.
+
+This is the TPU-native re-design of the reference hot path: where BifroMQ
+walks a per-tenant subscription trie per PUBLISH with a sort-merge join over
+a RocksDB iterator (bifromq-dist-worker .../cache/TenantRouteMatcher.java:68
+joined with .../trie/TopicFilterIterator.java:38), we compile the whole
+multi-tenant route table into flat int32 tables resident in device HBM and
+match batches of topics with a fixed-shape NFA walk (ops/match.py).
+
+Table layout (all int32, device-friendly):
+
+- ``node_tab [N, 8]``: packed per-node record, one gather per active state:
+    col 0  plus_child   ('+' child node id, -1 if none)
+    col 1  hash_child   ('#' child node id, -1 if none)
+    col 2  route_start  (first matching slot attached to this node)
+    col 3  route_count  (number of matching slots at this node)
+    col 4  subtree_end  (DFS pre-order: subtree of n is [n, subtree_end[n)))
+    col 5  child_count  (number of literal children)
+    col 6  child_start  (into child_list, for '+'-expansion in retained mode)
+    col 7  subtree_route_count (total matchings in subtree, for '#'-range count)
+- ``edge_tab [NB, P, 4]``: two-choice bucketed hash table of literal edges,
+  entries ``(node, h1, h2, child)``. Every key lives in one of its two
+  candidate buckets (greedy + bounded cuckoo eviction at build time), so a
+  device lookup is exactly TWO contiguous bucket-row gathers — on TPU, gather
+  cost is per-index, not per-byte, so one 128-byte bucket row costs the same
+  as one 4-byte element.
+- ``child_list [E]``: literal child node ids in CSR order (DFS order).
+
+Level strings are hashed to 64 bits (two int32 lanes) with BLAKE2b + salt; the
+builder detects the (astronomically unlikely) same-parent collision and
+recompiles with a new salt, so device matches are exact, not probabilistic.
+
+Matching slots are host-side Python objects (NormalMatching ≈ reference
+dist-worker-schema cache/NormalMatching.java, GroupMatching ≈
+cache/GroupMatching.java): the device returns accepting node ids; the host
+expands node → slots → routes for delivery.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..types import RouteMatcherType
+from ..utils import topic as topic_util
+from .oracle import Route, SubscriptionTrie, _TrieNode
+
+# node_tab column indices
+NODE_PLUS = 0
+NODE_HASH = 1
+NODE_RSTART = 2
+NODE_RCOUNT = 3
+NODE_SUB_END = 4
+NODE_CCOUNT = 5
+NODE_CSTART = 6
+NODE_SUB_RCOUNT = 7
+NODE_COLS = 8
+
+_EMPTY = -1
+
+
+@dataclass(frozen=True)
+class GroupMatching:
+    """One matched shared-subscription group (≈ GroupMatching.java:34)."""
+    mqtt_topic_filter: str
+    ordered: bool
+    members: Tuple[Route, ...]
+
+
+Matching = Union[Route, GroupMatching]
+
+
+class HashCollisionError(RuntimeError):
+    pass
+
+
+def level_hash(level: str, salt: int) -> Tuple[int, int]:
+    """Stable 64-bit hash of a topic level, as two int32s."""
+    d = hashlib.blake2b(level.encode("utf-8"), digest_size=8,
+                        salt=salt.to_bytes(8, "little")).digest()
+    h1 = int.from_bytes(d[:4], "little", signed=True)
+    h2 = int.from_bytes(d[4:], "little", signed=True)
+    return h1, h2
+
+
+def _mix_u32(node: np.ndarray, h1: np.ndarray, h2: np.ndarray) -> np.ndarray:
+    """Bucket-choice mixer #1; MUST stay in sync with ops.match._mix_u32."""
+    with np.errstate(over="ignore"):
+        x = node.astype(np.uint32) * np.uint32(0x9E3779B1)
+        x ^= h1.astype(np.uint32) * np.uint32(0x85EBCA6B)
+        x ^= x >> np.uint32(15)
+        x *= np.uint32(0xC2B2AE35)
+        x ^= h2.astype(np.uint32) * np.uint32(0x27D4EB2F)
+        x ^= x >> np.uint32(13)
+    return x
+
+
+def _mix2_u32(node: np.ndarray, h1: np.ndarray, h2: np.ndarray) -> np.ndarray:
+    """Bucket-choice mixer #2; MUST stay in sync with ops.match._mix2_u32."""
+    with np.errstate(over="ignore"):
+        x = node.astype(np.uint32) * np.uint32(0x7FEB352D)
+        x ^= h2.astype(np.uint32) * np.uint32(0x846CA68B)
+        x ^= x >> np.uint32(16)
+        x *= np.uint32(0x9E3779B1)
+        x ^= h1.astype(np.uint32) * np.uint32(0xC2B2AE35)
+        x ^= x >> np.uint32(14)
+    return x
+
+
+@dataclass
+class CompiledTrie:
+    """Immutable compiled automaton (host numpy; see .device() in ops.match)."""
+    node_tab: np.ndarray          # [N, 8] int32
+    edge_tab: np.ndarray          # [T, 4] int32
+    child_list: np.ndarray        # [max(E,1)] int32
+    matchings: List[Matching]     # slot -> matching
+    tenant_root: Dict[str, int]
+    salt: int
+    probe_len: int
+    max_levels: int
+
+    @property
+    def n_nodes(self) -> int:
+        return self.node_tab.shape[0]
+
+    @property
+    def n_slots(self) -> int:
+        return len(self.matchings)
+
+    def root_of(self, tenant_id: str) -> int:
+        return self.tenant_root.get(tenant_id, _EMPTY)
+
+
+def _node_matchings(node: _TrieNode) -> List[Matching]:
+    out: List[Matching] = list(node.routes.values())
+    for members in node.groups.values():
+        if not members:
+            continue
+        first = next(iter(members.values()))
+        out.append(GroupMatching(
+            mqtt_topic_filter=first.matcher.mqtt_topic_filter,
+            ordered=first.matcher.type == RouteMatcherType.ORDERED_SHARE,
+            members=tuple(members.values()),
+        ))
+    return out
+
+
+def compile_tries(tries: Dict[str, SubscriptionTrie], *, max_levels: int = 16,
+                  probe_len: int = 8, salt: int = 0, min_edge_cap: int = 8,
+                  _max_salt_retries: int = 4) -> CompiledTrie:
+    """Compile per-tenant subscription tries into one packed automaton.
+
+    DFS pre-order numbering per tenant (tenants concatenated) gives contiguous
+    subtrees. Wildcard children ('+'/'#') become dedicated pointer columns;
+    literal children become hash-table edges.
+    """
+    for attempt in range(_max_salt_retries):
+        try:
+            return _compile_once(tries, max_levels=max_levels,
+                                 probe_len=probe_len, salt=salt + attempt,
+                                 min_edge_cap=min_edge_cap)
+        except HashCollisionError:
+            continue
+    raise HashCollisionError("level-hash collisions persisted across salts")
+
+
+def _compile_once(tries: Dict[str, SubscriptionTrie], *, max_levels: int,
+                  probe_len: int, salt: int, min_edge_cap: int) -> CompiledTrie:
+    # --- pass 1: DFS, assign pre-order ids, collect rows -------------------
+    tenant_root: Dict[str, int] = {}
+    matchings: List[Matching] = []
+    # per-node scratch rows; grown in DFS order so index == node id
+    plus_child: List[int] = []
+    hash_child: List[int] = []
+    route_start: List[int] = []
+    route_count: List[int] = []
+    subtree_end: List[int] = []
+    child_start: List[int] = []
+    child_count: List[int] = []
+    sub_rcount: List[int] = []
+    # (nid, literal child ids); child_list CSR is emitted after the DFS so each
+    # node's children stay contiguous despite pre-order subtree allocation
+    pending_children: List[Tuple[int, List[int]]] = []
+    edges: List[Tuple[int, int, int, int]] = []  # (parent, h1, h2, child)
+
+    def alloc(node: _TrieNode) -> int:
+        nid = len(plus_child)
+        ms = _node_matchings(node)
+        plus_child.append(_EMPTY)
+        hash_child.append(_EMPTY)
+        route_start.append(len(matchings))
+        route_count.append(len(ms))
+        subtree_end.append(_EMPTY)
+        child_start.append(_EMPTY)
+        child_count.append(0)
+        sub_rcount.append(0)
+        matchings.extend(ms)
+        return nid
+
+    def dfs(node: _TrieNode, nid: int) -> int:
+        """Returns total matchings in subtree of nid."""
+        total = route_count[nid]
+        literals: List[Tuple[str, _TrieNode]] = []
+        plus_node = None
+        hash_node = None
+        for level, child in node.children.items():
+            if level == topic_util.SINGLE_WILDCARD:
+                plus_node = child
+            elif level == topic_util.MULTI_WILDCARD:
+                hash_node = child
+            else:
+                literals.append((level, child))
+        # DFS order: literals (sorted for determinism), then '+', then '#'.
+        literals.sort(key=lambda kv: kv[0])
+        seen: Dict[Tuple[int, int], str] = {}
+        lit_ids: List[int] = []
+        for level, child in literals:
+            h1, h2 = level_hash(level, salt)
+            prev = seen.get((h1, h2))
+            if prev is not None and prev != level:
+                raise HashCollisionError(f"collision {prev!r} vs {level!r}")
+            seen[(h1, h2)] = level
+            cid = alloc(child)
+            edges.append((nid, h1, h2, cid))
+            lit_ids.append(cid)
+            total += dfs(child, cid)
+        if lit_ids:
+            pending_children.append((nid, lit_ids))
+        child_count[nid] = len(literals)
+        if plus_node is not None:
+            pid = alloc(plus_node)
+            plus_child[nid] = pid
+            total += dfs(plus_node, pid)
+        if hash_node is not None:
+            hid = alloc(hash_node)
+            hash_child[nid] = hid
+            total += dfs(hash_node, hid)
+        subtree_end[nid] = len(plus_child)
+        sub_rcount[nid] = total
+        return total
+
+    for tenant_id, trie in tries.items():
+        root = trie._root
+        rid = alloc(root)
+        tenant_root[tenant_id] = rid
+        dfs(root, rid)
+
+    child_list: List[int] = []
+    for nid, lit_ids in pending_children:
+        child_start[nid] = len(child_list)
+        child_list.extend(lit_ids)
+
+    n = len(plus_child)
+    node_tab = np.full((max(n, 1), NODE_COLS), _EMPTY, dtype=np.int32)
+    if n:
+        node_tab[:n, NODE_PLUS] = plus_child
+        node_tab[:n, NODE_HASH] = hash_child
+        node_tab[:n, NODE_RSTART] = route_start
+        node_tab[:n, NODE_RCOUNT] = route_count
+        node_tab[:n, NODE_SUB_END] = subtree_end
+        node_tab[:n, NODE_CCOUNT] = child_count
+        node_tab[:n, NODE_CSTART] = child_start
+        node_tab[:n, NODE_SUB_RCOUNT] = sub_rcount
+
+    # --- pass 2: build the open-addressing edge table ----------------------
+    edge_tab = _build_edge_table(edges, probe_len, min_cap=min_edge_cap)
+
+    cl = np.asarray(child_list, dtype=np.int32) if child_list else np.full(
+        1, _EMPTY, dtype=np.int32)
+    return CompiledTrie(
+        node_tab=node_tab,
+        edge_tab=edge_tab,
+        child_list=cl,
+        matchings=matchings,
+        tenant_root=tenant_root,
+        salt=salt,
+        probe_len=probe_len,
+        max_levels=max_levels,
+    )
+
+
+def _build_edge_table(edges: List[Tuple[int, int, int, int]],
+                      probe_len: int, min_cap: int = 2) -> np.ndarray:
+    """Two-choice bucketed hash insert → [n_buckets, probe_len, 4].
+
+    Each key can live in bucket mix1(key) or mix2(key); insertion is greedy
+    two-choice with a bounded cuckoo-eviction rescue. The device lookup
+    fetches both candidate buckets with two contiguous row gathers
+    (ops.match._edge_lookup). Grows n_buckets (power of two) until everything
+    places.
+
+    ``min_cap`` (power of two) lets multi-shard builds force a common bucket
+    count so the mixing mask is identical across shards (parallel/sharded.py).
+    """
+    n_edges = len(edges)
+    nb = max(min_cap, 2)
+    while nb * probe_len < 2 * max(n_edges, 1):
+        nb *= 2
+    if not n_edges:
+        return np.full((nb, probe_len, 4), _EMPTY, dtype=np.int32)
+    earr = np.asarray(edges, dtype=np.int32)
+    rng = np.random.default_rng(0xB1F)
+    while True:
+        tab = np.full((nb, probe_len, 4), _EMPTY, dtype=np.int32)
+        fill = np.zeros(nb, dtype=np.int32)
+        mask = np.uint32(nb - 1)
+        b1 = (_mix_u32(earr[:, 0], earr[:, 1], earr[:, 2]) & mask).astype(np.int64)
+        b2 = (_mix2_u32(earr[:, 0], earr[:, 1], earr[:, 2]) & mask).astype(np.int64)
+        ok = True
+        for i in range(n_edges):
+            entry = earr[i]
+            c1, c2 = int(b1[i]), int(b2[i])
+            placed = False
+            for _ in range(200):  # bounded cuckoo random walk
+                tgt = c1 if fill[c1] <= fill[c2] else c2
+                if fill[tgt] < probe_len:
+                    tab[tgt, fill[tgt]] = entry
+                    fill[tgt] += 1
+                    placed = True
+                    break
+                # evict a random resident of the fuller choice and retry it
+                victim_slot = int(rng.integers(probe_len))
+                victim = tab[tgt, victim_slot].copy()
+                tab[tgt, victim_slot] = entry
+                entry = victim
+                vb1 = int(_mix_u32(entry[0:1], entry[1:2], entry[2:3])[0] & mask)
+                vb2 = int(_mix2_u32(entry[0:1], entry[1:2], entry[2:3])[0] & mask)
+                # prefer the evictee's *other* bucket next round
+                c1, c2 = (vb2, vb1) if vb1 == tgt else (vb1, vb2)
+            if not placed:
+                ok = False
+                break
+        if ok:
+            return tab
+        nb *= 2
+
+
+# --------------------------- probe tokenization ----------------------------
+
+@dataclass
+class TokenizedTopics:
+    """Fixed-shape device probe batch. Padding rows have length == -1."""
+    tok_h1: np.ndarray    # [B, max_levels + 1] int32
+    tok_h2: np.ndarray    # [B, max_levels + 1] int32
+    lengths: np.ndarray   # [B] int32 (level count; -1 for padding rows)
+    roots: np.ndarray     # [B] int32 (tenant root node id, -1 unknown tenant)
+    sys_mask: np.ndarray  # [B] bool (first level starts with '$')
+
+    @property
+    def batch(self) -> int:
+        return self.tok_h1.shape[0]
+
+
+def tokenize(topics: Sequence[Sequence[str]], roots: Sequence[int],
+             *, max_levels: int, salt: int,
+             batch: Optional[int] = None) -> TokenizedTopics:
+    """Hash topic levels into a padded probe batch.
+
+    ``topics`` are pre-parsed level lists (utils.topic.parse); ``roots`` the
+    per-topic tenant root ids (CompiledTrie.root_of). Topics longer than
+    ``max_levels`` cannot match any stored filter of ≤ max_levels exactly;
+    they are marked as padding here and must take the host fallback.
+    """
+    n = len(topics)
+    b = batch or n
+    assert b >= n
+    width = max_levels + 1
+    tok_h1 = np.zeros((b, width), dtype=np.int32)
+    tok_h2 = np.zeros((b, width), dtype=np.int32)
+    lengths = np.full(b, _EMPTY, dtype=np.int32)
+    rootv = np.full(b, _EMPTY, dtype=np.int32)
+    sys_mask = np.zeros(b, dtype=bool)
+    for i, (levels, root) in enumerate(zip(topics, roots)):
+        if len(levels) > max_levels:
+            continue  # leave as padding; caller falls back to oracle
+        lengths[i] = len(levels)
+        rootv[i] = root
+        if levels and levels[0].startswith(topic_util.SYS_PREFIX):
+            sys_mask[i] = True
+        for j, level in enumerate(levels):
+            h1, h2 = level_hash(level, salt)
+            tok_h1[i, j] = h1
+            tok_h2[i, j] = h2
+    return TokenizedTopics(tok_h1=tok_h1, tok_h2=tok_h2, lengths=lengths,
+                           roots=rootv, sys_mask=sys_mask)

@@ -1,0 +1,157 @@
+"""TpuMatcher: the full match plane — compile, walk on device, expand on host.
+
+This is the component that stands in for the reference's
+``SubscriptionCache`` → ``TenantRouteCache`` → ``TenantRouteMatcher`` pipeline
+(bifromq-dist-worker .../cache/SubscriptionCache.java:59,
+TenantRouteCache.java:65, TenantRouteMatcher.java:68): authoritative
+subscription state lives in host-side per-tenant tries (fed by route
+mutations); a compiled automaton snapshot serves batched match queries on
+device; topics that exceed the fixed-shape walk (active-state overflow,
+over-deep topics) fall back to the host oracle, mirroring the bounded-probe
+fallback contract of the reference matcher.
+
+Mutation → visibility: callers mutate via add_route/remove_route and the
+automaton is recompiled lazily (dirty flag) — the double-buffered
+"refresh after mutation" behavior of TenantRouteCache.java:100-160. Real
+deployments recompile off the serving thread; see dist/ (later stage) for the
+serving integration.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import topic as topic_util
+from .automaton import (
+    NODE_RCOUNT, NODE_RSTART, CompiledTrie, GroupMatching, Matching,
+    compile_tries, tokenize,
+)
+from .oracle import (
+    PERSISTENT_SUB_BROKER_ID, UNCAPPED_FANOUT, MatchedRoutes, Route,
+    SubscriptionTrie,
+)
+
+
+class TpuMatcher:
+    def __init__(self, *, max_levels: int = 16, k_states: int = 32,
+                 probe_len: int = 8, device=None) -> None:
+        self.max_levels = max_levels
+        self.k_states = k_states
+        self.probe_len = probe_len
+        self.device = device
+        self.tries: Dict[str, SubscriptionTrie] = {}
+        self._compiled: Optional[CompiledTrie] = None
+        self._device_trie = None
+        self._dirty = True
+
+    # ---------------- mutation side (≈ batchAddRoute/batchRemoveRoute) -----
+
+    def add_route(self, tenant_id: str, route: Route) -> bool:
+        added = self.tries.setdefault(tenant_id, SubscriptionTrie()).add(route)
+        self._dirty = True
+        return added
+
+    def remove_route(self, tenant_id: str, matcher, receiver_url,
+                     incarnation: int = 0) -> bool:
+        trie = self.tries.get(tenant_id)
+        if trie is None:
+            return False
+        removed = trie.remove(matcher, receiver_url, incarnation)
+        if removed:
+            if len(trie) == 0:
+                del self.tries[tenant_id]
+            self._dirty = True
+        return removed
+
+    # ---------------- compilation ------------------------------------------
+
+    def refresh(self) -> CompiledTrie:
+        """Recompile + upload if mutations happened since the last refresh."""
+        if self._dirty or self._compiled is None:
+            self._compiled = compile_tries(
+                self.tries, max_levels=self.max_levels,
+                probe_len=self.probe_len)
+            from ..ops.match import DeviceTrie  # deferred: keeps jax optional
+            self._device_trie = DeviceTrie.from_compiled(
+                self._compiled, device=self.device)
+            self._dirty = False
+        return self._compiled
+
+    @property
+    def compiled(self) -> CompiledTrie:
+        return self.refresh()
+
+    @property
+    def device_trie(self):
+        self.refresh()
+        return self._device_trie
+
+    # ---------------- query side (≈ SubscriptionCache.get) -----------------
+
+    def match_batch(self, queries: Sequence[Tuple[str, Sequence[str]]],
+                    *, max_persistent_fanout: int = UNCAPPED_FANOUT,
+                    max_group_fanout: int = UNCAPPED_FANOUT,
+                    batch: Optional[int] = None) -> List[MatchedRoutes]:
+        """Match (tenant_id, topic_levels) pairs; returns per-query routes."""
+        from ..ops.match import Probes, walk
+
+        if not queries:
+            return []
+        ct = self.refresh()
+        roots = [ct.root_of(t) for t, _ in queries]
+        tok = tokenize([levels for _, levels in queries], roots,
+                       max_levels=ct.max_levels, salt=ct.salt, batch=batch)
+        probes = Probes.from_tokenized(tok, device=self.device)
+        res = walk(self._device_trie, probes, probe_len=ct.probe_len,
+                   k_states=self.k_states)
+        hash_acc = np.asarray(res.hash_acc)
+        final_acc = np.asarray(res.final_acc)
+        overflow = np.asarray(res.overflow)
+        out: List[MatchedRoutes] = []
+        for qi, (tenant_id, levels) in enumerate(queries):
+            if roots[qi] < 0:  # tenant has no routes at all
+                out.append(MatchedRoutes())
+                continue
+            needs_fallback = overflow[qi] or tok.lengths[qi] < 0
+            if needs_fallback:
+                out.append(self.tries[tenant_id].match(
+                    list(levels), max_persistent_fanout=max_persistent_fanout,
+                    max_group_fanout=max_group_fanout))
+                continue
+            nodes = np.concatenate([hash_acc[qi].ravel(), final_acc[qi]])
+            out.append(self._expand(ct, nodes[nodes >= 0],
+                                    max_persistent_fanout, max_group_fanout))
+        return out
+
+    def match(self, tenant_id: str, topic: str, **kwargs) -> MatchedRoutes:
+        return self.match_batch([(tenant_id, topic_util.parse(topic))],
+                                **kwargs)[0]
+
+    @staticmethod
+    def _expand(ct: CompiledTrie, nodes: np.ndarray,
+                max_persistent_fanout: int,
+                max_group_fanout: int) -> MatchedRoutes:
+        """Accepting nodes → routes, applying MatchedRoutes.java cap rules."""
+        out = MatchedRoutes()
+        node_tab = ct.node_tab
+        for n in nodes:
+            start = int(node_tab[n, NODE_RSTART])
+            count = int(node_tab[n, NODE_RCOUNT])
+            for slot in range(start, start + count):
+                m: Matching = ct.matchings[slot]
+                if isinstance(m, GroupMatching):
+                    if (m.mqtt_topic_filter not in out.groups
+                            and len(out.groups) >= max_group_fanout):
+                        out.max_group_fanout_exceeded = True
+                        continue
+                    out.groups[m.mqtt_topic_filter] = list(m.members)
+                else:
+                    if m.broker_id == PERSISTENT_SUB_BROKER_ID:
+                        if out.persistent_fanout >= max_persistent_fanout:
+                            out.max_persistent_fanout_exceeded = True
+                            continue
+                        out.persistent_fanout += 1
+                    out.normal.append(m)
+        return out

@@ -1,0 +1,230 @@
+"""Fixed-shape NFA trie walk over the compiled automaton (the hot kernel).
+
+This replaces the reference's per-PUBLISH iterator join
+(bifromq-dist-worker .../cache/TenantRouteMatcher.java:68 +
+.../trie/TopicFilterIterator.java:38) with a batched, fully static walk:
+
+- B topics × K active NFA states advance one topic level per step
+  (``lax.fori_loop`` over max_levels+1 static iterations — XLA-friendly, no
+  data-dependent control flow).
+- Literal-edge lookup = ``probe_len`` linear probes of the open-addressing
+  edge table: one [B,K,4] row gather per probe.
+- '+' / '#' transitions = one packed node-record gather per step.
+- Successor compaction = mask + cumsum + scatter-drop (no sort).
+- Topics whose active set would exceed K set an overflow flag and are
+  re-matched on the host oracle — the same bounded-work-then-fallback contract
+  the reference's 20-probe seek heuristic embodies
+  (TenantRouteMatcher.java:129-136).
+
+Outputs are accepting *node ids*; route expansion to delivery targets happens
+host-side (models.automaton matchings), while fan-out counting stays on device
+for benchmarks (route_count gather + sum).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.automaton import (
+    NODE_HASH, NODE_PLUS, NODE_RCOUNT, CompiledTrie, TokenizedTopics,
+)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class DeviceTrie:
+    """Compiled automaton tables resident on device."""
+    node_tab: jax.Array   # [N, 8] int32
+    edge_tab: jax.Array   # [T, 4] int32
+    child_list: jax.Array  # [E] int32
+
+    def tree_flatten(self):
+        return (self.node_tab, self.edge_tab, self.child_list), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @staticmethod
+    def from_compiled(ct: CompiledTrie, device=None) -> "DeviceTrie":
+        put = functools.partial(jax.device_put, device=device)
+        return DeviceTrie(
+            node_tab=put(ct.node_tab),
+            edge_tab=put(ct.edge_tab),
+            child_list=put(ct.child_list),
+        )
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class Probes:
+    """Device-side tokenized topic batch (see automaton.TokenizedTopics)."""
+    tok_h1: jax.Array    # [B, L+1] int32
+    tok_h2: jax.Array    # [B, L+1] int32
+    lengths: jax.Array   # [B] int32
+    roots: jax.Array     # [B] int32
+    sys_mask: jax.Array  # [B] bool
+
+    def tree_flatten(self):
+        return (self.tok_h1, self.tok_h2, self.lengths, self.roots,
+                self.sys_mask), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @staticmethod
+    def from_tokenized(t: TokenizedTopics, device=None) -> "Probes":
+        put = functools.partial(jax.device_put, device=device)
+        return Probes(put(t.tok_h1), put(t.tok_h2), put(t.lengths),
+                      put(t.roots), put(t.sys_mask))
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class WalkResult:
+    """Accepting node ids, -1-padded; fixed shape for a [B] probe batch."""
+    hash_acc: jax.Array   # [B, L+1, K] '#'-child accepts per consumed-level count
+    final_acc: jax.Array  # [B, K] nodes active after consuming all levels
+    overflow: jax.Array   # [B] bool — active-set overflow; host must re-match
+
+    def tree_flatten(self):
+        return (self.hash_acc, self.final_acc, self.overflow), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def _mix_u32(node: jax.Array, h1: jax.Array, h2: jax.Array) -> jax.Array:
+    """MUST stay in sync with models.automaton._mix_u32."""
+    x = node.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+    x = x ^ (h1.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B))
+    x = x ^ (x >> jnp.uint32(15))
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (h2.astype(jnp.uint32) * jnp.uint32(0x27D4EB2F))
+    x = x ^ (x >> jnp.uint32(13))
+    return x
+
+
+def _mix2_u32(node: jax.Array, h1: jax.Array, h2: jax.Array) -> jax.Array:
+    """MUST stay in sync with models.automaton._mix2_u32."""
+    x = node.astype(jnp.uint32) * jnp.uint32(0x7FEB352D)
+    x = x ^ (h2.astype(jnp.uint32) * jnp.uint32(0x846CA68B))
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x9E3779B1)
+    x = x ^ (h1.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35))
+    x = x ^ (x >> jnp.uint32(14))
+    return x
+
+
+def _edge_lookup(edge_tab: jax.Array, probe_len: int, node: jax.Array,
+                 h1: jax.Array, h2: jax.Array) -> jax.Array:
+    """Exact literal-child lookup; node/h1/h2 are [B,K]; returns child or -1.
+
+    The edge table is two-choice bucketed ([NB, P, 4],
+    automaton._build_edge_table): a key lives in one of its two candidate
+    buckets, so the lookup is exactly two contiguous bucket-row gathers —
+    TPU gather cost is per-index, not per-byte, so fetching a whole 128-byte
+    bucket costs the same as one element.
+    """
+    nb = edge_tab.shape[0]
+    mask = jnp.uint32(nb - 1)
+    flat = edge_tab.reshape(nb, probe_len * 4)
+    b1 = (_mix_u32(node, h1, h2) & mask).astype(jnp.int32)
+    b2 = (_mix2_u32(node, h1, h2) & mask).astype(jnp.int32)
+    shape = node.shape + (probe_len, 4)
+    rows = jnp.concatenate([flat[b1].reshape(shape),
+                            flat[b2].reshape(shape)], axis=-2)  # [B,K,2P,4]
+    hit = ((rows[..., 0] == node[..., None])
+           & (rows[..., 1] == h1[..., None])
+           & (rows[..., 2] == h2[..., None]))
+    return jnp.max(jnp.where(hit, rows[..., 3], -1), axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("probe_len", "k_states"))
+def walk(trie: DeviceTrie, probes: Probes, *, probe_len: int,
+         k_states: int = 32) -> WalkResult:
+    """Run the NFA walk for a batch of topics. See module docstring."""
+    b, width = probes.tok_h1.shape
+    max_levels = width - 1
+    k = k_states
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+
+    act0 = jnp.full((b, k), -1, dtype=jnp.int32)
+    act0 = act0.at[:, 0].set(jnp.where(probes.lengths >= 0, probes.roots, -1))
+    hash_acc0 = jnp.full((b, max_levels + 1, k), -1, dtype=jnp.int32)
+    final_acc0 = jnp.full((b, k), -1, dtype=jnp.int32)
+    overflow0 = jnp.zeros((b,), dtype=bool)
+
+    def body(i, carry):
+        act, hash_acc, final_acc, overflow = carry
+        in_range = (i <= probes.lengths)[:, None]           # [B,1]
+        valid = (act >= 0) & in_range                       # [B,K]
+        # [MQTT-4.7.2-1]: block the root's wildcard children for '$'-topics
+        allow_wc = jnp.logical_not(probes.sys_mask & (i == 0))[:, None]
+        node_rec = trie.node_tab[act.clip(0)]               # [B,K,8]
+
+        # 1. '#'-child accepts: match regardless of remaining levels
+        hc = jnp.where(valid & allow_wc, node_rec[..., NODE_HASH], -1)
+        hash_acc = jax.lax.dynamic_update_slice_in_dim(
+            hash_acc, hc[:, None, :], i, axis=1)
+
+        # 2. final accepts once the whole topic is consumed
+        is_final = (i == probes.lengths)[:, None]
+        final_acc = jnp.where(is_final, jnp.where(valid, act, -1), final_acc)
+
+        # 3. successors for topics that still have levels left
+        stepping = (i < probes.lengths)[:, None]
+        h1 = jax.lax.dynamic_index_in_dim(probes.tok_h1, i, axis=1)  # [B,1]
+        h2 = jax.lax.dynamic_index_in_dim(probes.tok_h2, i, axis=1)
+        h1 = jnp.broadcast_to(h1, (b, k))
+        h2 = jnp.broadcast_to(h2, (b, k))
+        exact = _edge_lookup(trie.edge_tab, probe_len, act.clip(0), h1, h2)
+        exact = jnp.where(stepping & valid, exact, -1)
+        plus = jnp.where(stepping & valid & allow_wc,
+                         node_rec[..., NODE_PLUS], -1)
+        cand = jnp.concatenate([exact, plus], axis=1)       # [B,2K]
+        cvalid = cand >= 0
+        pos = jnp.cumsum(cvalid, axis=1) - 1                # [B,2K]
+        total = pos[:, -1] + 1
+        overflow = overflow | (total > k)
+        pos = jnp.where(cvalid & (pos < k), pos, 2 * k)     # 2K => dropped
+        new_act = jnp.full((b, k), -1, dtype=jnp.int32)
+        new_act = new_act.at[rows, pos].set(cand, mode="drop")
+        return new_act, hash_acc, final_acc, overflow
+
+    # dynamic trip count: stop at the longest topic actually in the batch
+    # (lowered to a while loop; the padded tail of short batches costs nothing)
+    upper = jnp.clip(jnp.max(probes.lengths, initial=-1) + 1, 0, max_levels + 1)
+    act, hash_acc, final_acc, overflow = jax.lax.fori_loop(
+        0, upper, body, (act0, hash_acc0, final_acc0, overflow0))
+    return WalkResult(hash_acc=hash_acc, final_acc=final_acc,
+                      overflow=overflow)
+
+
+@jax.jit
+def count_routes(trie: DeviceTrie, result: WalkResult) -> jax.Array:
+    """Per-topic matched-slot count (normal routes + group matchings). [B]"""
+    def node_count(nodes):  # [...,] -> [...]
+        cnt = trie.node_tab[nodes.clip(0), NODE_RCOUNT]
+        return jnp.where(nodes >= 0, cnt, 0)
+
+    b = result.final_acc.shape[0]
+    hash_cnt = node_count(result.hash_acc).reshape(b, -1).sum(axis=1)
+    final_cnt = node_count(result.final_acc).sum(axis=1)
+    return hash_cnt + final_cnt
+
+
+@functools.partial(jax.jit, static_argnames=("probe_len", "k_states"))
+def walk_and_count(trie: DeviceTrie, probes: Probes, *, probe_len: int,
+                   k_states: int = 32) -> Tuple[WalkResult, jax.Array]:
+    """Fused walk + per-topic fan-out count (bench entry point)."""
+    res = walk(trie, probes, probe_len=probe_len, k_states=k_states)
+    return res, count_routes(trie, res)

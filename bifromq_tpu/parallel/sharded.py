@@ -1,0 +1,234 @@
+"""Tenant-sharded, replica-parallel match plane over a jax.sharding.Mesh.
+
+This is the TPU-native analog of the reference's two scale-out axes for the
+route table (SURVEY.md §2.8):
+
+- KV **range partitioning** across dist-worker stores → here: tenants are
+  hashed onto ``n_shards`` automaton shards; each mesh column holds one
+  shard's tables in its HBM (sharded over the ``shard`` mesh axis).
+- **Raft replication** for read scaling (replica-spread queries,
+  BatchDistServerCall.replicaSelect:245) → here: every shard's tables are
+  replicated over the ``replica`` mesh axis and probe batches are split
+  across replicas.
+
+The per-device program is the same fixed-shape walk as single-chip
+(ops.match.walk); cross-device communication is a single ``psum`` for global
+fan-out stats — probes are routed host-side to their tenant's shard, so the
+match itself needs no collective, exactly like the reference where a topic's
+query goes to the one range replica that owns the tenant's key span.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.automaton import CompiledTrie, compile_tries, tokenize
+from ..models.oracle import UNCAPPED_FANOUT, MatchedRoutes, SubscriptionTrie
+from ..models.matcher import TpuMatcher
+from ..ops.match import DeviceTrie, Probes, count_routes, walk
+
+REPLICA_AXIS = "replica"
+SHARD_AXIS = "shard"
+
+
+def tenant_shard(tenant_id: str, n_shards: int) -> int:
+    """Stable tenant → shard assignment (≈ range ownership by tenant prefix)."""
+    d = hashlib.blake2b(tenant_id.encode("utf-8"), digest_size=4).digest()
+    return int.from_bytes(d, "little") % n_shards
+
+
+@dataclass
+class ShardedTables:
+    """Per-shard compiled automata padded/stacked for mesh placement."""
+    node_tab: np.ndarray    # [S, N, 8]
+    edge_tab: np.ndarray    # [S, T, 4]
+    child_list: np.ndarray  # [S, E]
+    compiled: List[CompiledTrie]   # per-shard (for salt, matchings, roots)
+    n_shards: int
+    probe_len: int
+    max_levels: int
+
+    def shard_of(self, tenant_id: str) -> int:
+        return tenant_shard(tenant_id, self.n_shards)
+
+    def root_of(self, tenant_id: str) -> int:
+        return self.compiled[self.shard_of(tenant_id)].root_of(tenant_id)
+
+
+def build_sharded(tries: Dict[str, SubscriptionTrie], n_shards: int, *,
+                  max_levels: int = 16, probe_len: int = 8) -> ShardedTables:
+    """Compile each tenant shard with a common edge-table capacity.
+
+    All shards share one edge-table size (power of two) so the device-side
+    mixing mask is identical; node/child arrays are -1-padded to the max.
+    """
+    by_shard: List[Dict[str, SubscriptionTrie]] = [dict() for _ in range(n_shards)]
+    for tenant_id, trie in tries.items():
+        by_shard[tenant_shard(tenant_id, n_shards)][tenant_id] = trie
+
+    compiled = [compile_tries(s, max_levels=max_levels, probe_len=probe_len)
+                for s in by_shard]
+    # common bucket count: the mixing mask must be identical across shards
+    cap = max(ct.edge_tab.shape[0] for ct in compiled)
+    # re-sync: growing one shard to `cap` can itself grow (eviction spill);
+    # iterate until all bucket counts agree.
+    while True:
+        compiled = [
+            ct if ct.edge_tab.shape[0] == cap else compile_tries(
+                by_shard[i], max_levels=max_levels, probe_len=probe_len,
+                min_edge_cap=cap)
+            for i, ct in enumerate(compiled)
+        ]
+        new_cap = max(ct.edge_tab.shape[0] for ct in compiled)
+        if new_cap == cap:
+            break
+        cap = new_cap
+
+    n_max = max(ct.node_tab.shape[0] for ct in compiled)
+    e_max = max(ct.child_list.shape[0] for ct in compiled)
+    node_tab = np.full((n_shards, n_max, 8), -1, dtype=np.int32)
+    edge_tab = np.full((n_shards, cap, probe_len, 4), -1, dtype=np.int32)
+    child_list = np.full((n_shards, e_max), -1, dtype=np.int32)
+    for s, ct in enumerate(compiled):
+        node_tab[s, :ct.node_tab.shape[0]] = ct.node_tab
+        edge_tab[s] = ct.edge_tab
+        child_list[s, :ct.child_list.shape[0]] = ct.child_list
+    return ShardedTables(node_tab=node_tab, edge_tab=edge_tab,
+                         child_list=child_list, compiled=compiled,
+                         n_shards=n_shards, probe_len=probe_len,
+                         max_levels=max_levels)
+
+
+def make_mesh(n_replicas: int, n_shards: int,
+              devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    assert len(devices) >= n_replicas * n_shards, (
+        f"need {n_replicas * n_shards} devices, have {len(devices)}")
+    grid = np.array(devices[:n_replicas * n_shards]).reshape(
+        n_replicas, n_shards)
+    return Mesh(grid, (REPLICA_AXIS, SHARD_AXIS))
+
+
+def make_match_step(mesh: Mesh, *, probe_len: int, k_states: int = 32):
+    """Build the jitted multi-device match step.
+
+    Inputs:  tables sharded [S, ...] over SHARD_AXIS (replicated over
+             REPLICA_AXIS); probes [R, S, B, ...] split over both axes.
+    Outputs: walk results [R, S, B, ...] with the same layout, per-topic
+             route counts, and a globally psum'd total matched-route count.
+    """
+    def local_step(node_tab, edge_tab, child_list, tok_h1, tok_h2, lengths,
+                   roots, sys_mask):
+        trie = DeviceTrie(node_tab[0], edge_tab[0], child_list[0])
+        probes = Probes(tok_h1[0, 0], tok_h2[0, 0], lengths[0, 0],
+                        roots[0, 0], sys_mask[0, 0])
+        res = walk(trie, probes, probe_len=probe_len, k_states=k_states)
+        counts = count_routes(trie, res)
+        total = jax.lax.psum(counts.sum(), (REPLICA_AXIS, SHARD_AXIS))
+        expand = lambda x: x[None, None]
+        return (expand(res.hash_acc), expand(res.final_acc),
+                expand(res.overflow), expand(counts), total)
+
+    table_spec = P(SHARD_AXIS)
+    probe_spec = P(REPLICA_AXIS, SHARD_AXIS)
+    sharded = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(table_spec, table_spec, table_spec,
+                  probe_spec, probe_spec, probe_spec, probe_spec, probe_spec),
+        out_specs=(probe_spec, probe_spec, probe_spec, probe_spec, P()),
+        # the walk's loop carries start as replicated constants and become
+        # device-varying after the first level; skip the vma consistency check
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+class MeshMatcher:
+    """Serving wrapper: routes queries to shards, pads replica batches, and
+    expands device results host-side (with oracle fallback), mirroring
+    TpuMatcher but across a full device mesh."""
+
+    def __init__(self, tries: Dict[str, SubscriptionTrie], mesh: Mesh, *,
+                 max_levels: int = 16, probe_len: int = 8,
+                 k_states: int = 32) -> None:
+        self.mesh = mesh
+        self.n_replicas = mesh.shape[REPLICA_AXIS]
+        self.tables = build_sharded(tries, mesh.shape[SHARD_AXIS],
+                                    max_levels=max_levels,
+                                    probe_len=probe_len)
+        self.tries = tries
+        self.k_states = k_states
+        self._step = make_match_step(mesh, probe_len=probe_len,
+                                     k_states=k_states)
+        table_sharding = NamedSharding(mesh, P(SHARD_AXIS))
+        self.dev_node_tab = jax.device_put(self.tables.node_tab, table_sharding)
+        self.dev_edge_tab = jax.device_put(self.tables.edge_tab, table_sharding)
+        self.dev_child_list = jax.device_put(self.tables.child_list,
+                                             table_sharding)
+
+    def match_batch(self, queries: Sequence[Tuple[str, Sequence[str]]],
+                    *, per_device_batch: Optional[int] = None
+                    ) -> List[MatchedRoutes]:
+        """Match (tenant, topic_levels) pairs across the mesh."""
+        r, s = self.n_replicas, self.tables.n_shards
+        # route each query to its shard, then round-robin across replicas
+        slots: List[List[int]] = [[] for _ in range(r * s)]
+        for qi, (tenant_id, _) in enumerate(queries):
+            sh = self.tables.shard_of(tenant_id)
+            rep = min(range(r), key=lambda j: len(slots[j * s + sh]))
+            slots[rep * s + sh].append(qi)
+        b = per_device_batch or max(1, max(len(x) for x in slots))
+        assert all(len(x) <= b for x in slots)
+
+        width = self.tables.max_levels + 1
+        tok_h1 = np.zeros((r, s, b, width), dtype=np.int32)
+        tok_h2 = np.zeros((r, s, b, width), dtype=np.int32)
+        lengths = np.full((r, s, b), -1, dtype=np.int32)
+        roots = np.full((r, s, b), -1, dtype=np.int32)
+        sys_mask = np.zeros((r, s, b), dtype=bool)
+        for rep in range(r):
+            for sh in range(s):
+                idxs = slots[rep * s + sh]
+                if not idxs:
+                    continue
+                ct = self.tables.compiled[sh]
+                topics = [queries[qi][1] for qi in idxs]
+                qroots = [ct.root_of(queries[qi][0]) for qi in idxs]
+                tk = tokenize(topics, qroots, max_levels=ct.max_levels,
+                              salt=ct.salt, batch=b)
+                tok_h1[rep, sh] = tk.tok_h1
+                tok_h2[rep, sh] = tk.tok_h2
+                lengths[rep, sh] = tk.lengths
+                roots[rep, sh] = tk.roots
+                sys_mask[rep, sh] = tk.sys_mask
+
+        hash_acc, final_acc, overflow, counts, _total = self._step(
+            self.dev_node_tab, self.dev_edge_tab, self.dev_child_list,
+            tok_h1, tok_h2, lengths, roots, sys_mask)
+        hash_acc = np.asarray(hash_acc)
+        final_acc = np.asarray(final_acc)
+        overflow = np.asarray(overflow)
+
+        out: List[MatchedRoutes] = [MatchedRoutes() for _ in queries]
+        uncapped = UNCAPPED_FANOUT
+        for rep in range(r):
+            for sh in range(s):
+                ct = self.tables.compiled[sh]
+                for bi, qi in enumerate(slots[rep * s + sh]):
+                    tenant_id, levels = queries[qi]
+                    if ct.root_of(tenant_id) < 0:
+                        continue
+                    if overflow[rep, sh, bi] or len(levels) > ct.max_levels:
+                        out[qi] = self.tries[tenant_id].match(list(levels))
+                        continue
+                    nodes = np.concatenate([hash_acc[rep, sh, bi].ravel(),
+                                            final_acc[rep, sh, bi]])
+                    out[qi] = TpuMatcher._expand(ct, nodes[nodes >= 0],
+                                                 uncapped, uncapped)
+        return out

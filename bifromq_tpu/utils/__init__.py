@@ -1,0 +1,1 @@
+"""bifromq_tpu.utils."""

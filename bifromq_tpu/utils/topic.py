@@ -1,0 +1,209 @@
+"""MQTT topic machinery: parse, validate, escape, shared-subscription syntax.
+
+Behavioral parity with the reference implementation
+``bifromq-util/src/main/java/org/apache/bifromq/util/TopicUtil.java`` and
+``TopicConst.java`` (constants), including:
+
+- level parsing semantics ("/" -> ["", ""], "/a" -> ["", "a"], "a/" -> ["a", ""])
+- NUL-escaped level encoding used by KV codecs (escape/unescape)
+- topic validation [MQTT-4.7.3-1], [MQTT-4.7.3-2], [MQTT-4.7.1-1]
+- topic-filter validation incl. '#'-last / '+'-alone placement rules
+- shared subscriptions: "$share/<group>/<filter>" (unordered) and
+  "$oshare/<group>/<filter>" (ordered) [MQTT-4.8.2-1], [MQTT-4.8.2-2]
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+# Constants (reference: bifromq-util .../util/TopicConst.java)
+NUL = "\u0000"
+DELIMITER = "/"
+SINGLE_WILDCARD = "+"
+MULTI_WILDCARD = "#"
+SYS_PREFIX = "$"
+UNORDERED_SHARE = "$share"
+ORDERED_SHARE = "$oshare"
+
+_PREFIX_UNORDERED_SHARE = UNORDERED_SHARE + DELIMITER
+_PREFIX_ORDERED_SHARE = ORDERED_SHARE + DELIMITER
+
+
+def parse(topic: str, escaped: bool = False) -> List[str]:
+    """Split a topic/topic-filter into levels.
+
+    Mirrors TopicUtil.parse (TopicUtil.java:205): every separator produces a
+    new (possibly empty) level; "/" -> ["", ""].
+    """
+    sep = NUL if escaped else DELIMITER
+    return topic.split(sep)
+
+
+def fast_join(levels: List[str], delimiter: str = DELIMITER) -> str:
+    """Inverse of :func:`parse` (TopicUtil.fastJoin)."""
+    return delimiter.join(levels)
+
+
+def escape(topic_filter: str) -> str:
+    """Replace '/' with NUL for order-preserving KV encoding (TopicUtil.escape)."""
+    assert NUL not in topic_filter
+    return topic_filter.replace(DELIMITER, NUL)
+
+
+def unescape(topic_filter: str) -> str:
+    return topic_filter.replace(NUL, DELIMITER)
+
+
+def is_valid_topic(topic: str, max_level_length: int = 40, max_levels: int = 16,
+                   max_length: int = 255) -> bool:
+    """Validate a PUBLISH topic name (TopicUtil.isValidTopic, TopicUtil.java:48).
+
+    No wildcards, no NUL, bounded total length / level count / level length.
+    A topic beginning with a share prefix is invalid.
+    """
+    assert max_length <= 65535 and max_level_length <= max_length
+    if not topic or len(topic) > max_length:
+        return False  # [MQTT-4.7.3-1]
+    if topic.startswith(_PREFIX_ORDERED_SHARE) or topic.startswith(_PREFIX_UNORDERED_SHARE):
+        return False
+    level_len = 0
+    level = 1
+    for ch in topic:
+        if ch == DELIMITER:
+            level += 1
+            if level > max_levels:
+                return False
+            if level_len > max_level_length:
+                return False
+            level_len = 0
+        else:
+            if ch == NUL or ch == SINGLE_WILDCARD or ch == MULTI_WILDCARD:
+                return False  # [MQTT-4.7.3-2], [MQTT-4.7.1-1]
+            level_len += 1
+    return level_len <= max_level_length
+
+
+def is_valid_topic_filter(topic_filter: str, max_level_length: int = 40,
+                          max_levels: int = 16, max_length: int = 255) -> bool:
+    """Validate a SUBSCRIBE topic filter (TopicUtil.isValidTopicFilter:94).
+
+    Handles share-prefix validation ([MQTT-4.8.2-1/2]) then the wildcard
+    placement rules: '#' only as the final character of the final level,
+    '+' only as a whole level.
+    """
+    if topic_filter.startswith(_PREFIX_UNORDERED_SHARE):
+        max_length += len(_PREFIX_UNORDERED_SHARE)
+    if topic_filter.startswith(_PREFIX_ORDERED_SHARE):
+        max_length += len(_PREFIX_ORDERED_SHARE)
+    assert max_length <= 65535 and max_level_length <= max_length
+    if not topic_filter or len(topic_filter) > max_length:
+        return False  # [MQTT-4.7.3-1]
+    i = 0
+    level_len = 0
+    if topic_filter.startswith(_PREFIX_ORDERED_SHARE) or topic_filter.startswith(
+            _PREFIX_UNORDERED_SHARE):
+        # validate the share name level
+        i = topic_filter.index(DELIMITER) + 1
+        while i < len(topic_filter):
+            ch = topic_filter[i]
+            if ch == DELIMITER:
+                break
+            if ch in (MULTI_WILDCARD, SINGLE_WILDCARD, NUL):
+                return False  # [MQTT-4.8.2-2]
+            level_len += 1
+            i += 1
+        if level_len == 0:
+            return False  # [MQTT-4.8.2-1]
+        if i == len(topic_filter):
+            return False  # [MQTT-4.8.2-2]: no '/' after group, or empty filter
+        level_len = 0
+        i += 1  # skip the separator; i is now the real filter start
+    start_idx = i
+    level = 1
+    n = len(topic_filter)
+    while i < n:
+        ch = topic_filter[i]
+        if ch == DELIMITER:
+            level += 1
+            if level > max_levels:
+                return False
+            if level_len > max_level_length:
+                return False
+            level_len = 0
+        else:
+            if ch == NUL:
+                return False  # [MQTT-4.7.3-2]
+            if ch == MULTI_WILDCARD:
+                if i != n - 1:
+                    return False
+                if i != start_idx and topic_filter[i - 1] != DELIMITER:
+                    return False
+            if ch == SINGLE_WILDCARD:
+                if i == start_idx:
+                    if i != n - 1 and topic_filter[i + 1] != DELIMITER:
+                        return False
+                elif i == n - 1:
+                    if topic_filter[i - 1] != DELIMITER:
+                        return False
+                else:
+                    if topic_filter[i - 1] != DELIMITER or topic_filter[i + 1] != DELIMITER:
+                        return False
+            level_len += 1
+        i += 1
+    if level > max_levels:
+        return False
+    return level_len <= max_level_length
+
+
+def is_wildcard_topic_filter(topic_filter: str) -> bool:
+    return SINGLE_WILDCARD in topic_filter or is_multi_wildcard_topic_filter(topic_filter)
+
+
+def is_multi_wildcard_topic_filter(topic_filter: str) -> bool:
+    return topic_filter.endswith(MULTI_WILDCARD)
+
+
+def is_shared_subscription(topic_filter: str) -> bool:
+    return is_ordered_shared(topic_filter) or is_unordered_shared(topic_filter)
+
+
+def is_normal_topic_filter(topic_filter: str) -> bool:
+    return not is_shared_subscription(topic_filter)
+
+
+def is_unordered_shared(topic_filter: str) -> bool:
+    return topic_filter.startswith(_PREFIX_UNORDERED_SHARE)
+
+
+def is_ordered_shared(topic_filter: str) -> bool:
+    return topic_filter.startswith(_PREFIX_ORDERED_SHARE)
+
+
+def matches(topic_levels: List[str], filter_levels: List[str]) -> bool:
+    """Single-filter MQTT match semantics, used as the parity oracle.
+
+    Implements [MQTT-4.7.1-*]: '+' matches exactly one level, '#' matches any
+    number (including zero) of trailing levels, and [MQTT-4.7.2-1]: wildcards
+    do not match a first level beginning with '$' (reference:
+    bifromq-dist-coproc-proto .../trie/TopicTrieNode.java:151 wildcardMatchable).
+    """
+    sys_first = bool(topic_levels) and topic_levels[0].startswith(SYS_PREFIX)
+    ti, fi = 0, 0
+    nt, nf = len(topic_levels), len(filter_levels)
+    while fi < nf:
+        fl = filter_levels[fi]
+        if fl == MULTI_WILDCARD:
+            # '#' must be last; matches remaining levels including none
+            if ti == 0 and sys_first:
+                return False
+            return fi == nf - 1
+        if ti >= nt:
+            return False
+        if fl == SINGLE_WILDCARD:
+            if ti == 0 and sys_first:
+                return False
+        elif fl != topic_levels[ti]:
+            return False
+        ti += 1
+        fi += 1
+    return ti == nt

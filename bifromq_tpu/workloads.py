@@ -1,0 +1,140 @@
+"""Workload generators for the BASELINE.md measurement configs.
+
+The five configs (BASELINE.json `configs`):
+1. 1 tenant, 10K exact-topic subscriptions
+2. 1 tenant, 1M wildcard subscriptions, Zipf-skewed topic tree
+3. 1K tenants × 10K subs each, $share fan-out
+4. retained: 5M retained topics, wildcard SUBSCRIBE probes
+5. 10K tenants, 10M total subs, tenant-sharded across the mesh
+
+Generation is deterministic per seed. Filters are built directly as
+RouteMatcher tuples (bypassing string validation) for speed at the 10M scale.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Sequence, Tuple
+
+from .models.oracle import Route, SubscriptionTrie
+from .types import RouteMatcher, RouteMatcherType
+from .utils import topic as topic_util
+
+
+def _zipf_levels(n_levels: int) -> Tuple[List[str], List[float]]:
+    names = [f"l{i}" for i in range(n_levels)]
+    weights = [1.0 / (i + 1) for i in range(n_levels)]
+    return names, weights
+
+
+def _mk_matcher(levels: Sequence[str], share_group: str = "",
+                ordered: bool = False) -> RouteMatcher:
+    if share_group:
+        prefix = topic_util.ORDERED_SHARE if ordered else topic_util.UNORDERED_SHARE
+        tf = f"{prefix}/{share_group}/" + "/".join(levels)
+        return RouteMatcher(
+            type=(RouteMatcherType.ORDERED_SHARE if ordered
+                  else RouteMatcherType.UNORDERED_SHARE),
+            filter_levels=tuple(levels), mqtt_topic_filter=tf,
+            group=share_group)
+    return RouteMatcher(type=RouteMatcherType.NORMAL,
+                        filter_levels=tuple(levels),
+                        mqtt_topic_filter="/".join(levels))
+
+
+def gen_filter_levels(rng: random.Random, names: List[str],
+                      weights: List[float], *, max_depth: int = 6,
+                      p_plus: float = 0.15, p_hash: float = 0.1) -> List[str]:
+    depth = rng.randint(1, max_depth)
+    levels = rng.choices(names, weights=weights, k=depth)
+    for j in range(depth):
+        if rng.random() < p_plus:
+            levels[j] = topic_util.SINGLE_WILDCARD
+    if rng.random() < p_hash:
+        levels.append(topic_util.MULTI_WILDCARD)
+    return levels
+
+
+def gen_topic_levels(rng: random.Random, names: List[str],
+                     weights: List[float], *, max_depth: int = 6) -> List[str]:
+    depth = rng.randint(1, max_depth)
+    return rng.choices(names, weights=weights, k=depth)
+
+
+def config_exact(n_subs: int = 10_000, *, seed: int = 0,
+                 persistent_ratio: float = 0.0) -> Dict[str, SubscriptionTrie]:
+    """Config 1: one tenant, exact-topic subscriptions."""
+    rng = random.Random(seed)
+    names, weights = _zipf_levels(max(64, n_subs // 100))
+    trie = SubscriptionTrie()
+    for i in range(n_subs):
+        levels = gen_topic_levels(rng, names, weights)
+        broker = 1 if rng.random() < persistent_ratio else 0
+        trie.add(Route(matcher=_mk_matcher(levels), broker_id=broker,
+                       receiver_id=f"r{i}", deliverer_key=f"d{i % 64}"))
+    return {"tenant0": trie}
+
+
+def config_wildcard(n_subs: int = 1_000_000, *, seed: int = 0,
+                    n_level_names: int = 1000, max_depth: int = 6,
+                    persistent_ratio: float = 0.1
+                    ) -> Dict[str, SubscriptionTrie]:
+    """Config 2: one tenant, wildcard-heavy Zipf subscriptions."""
+    rng = random.Random(seed)
+    names, weights = _zipf_levels(n_level_names)
+    trie = SubscriptionTrie()
+    for i in range(n_subs):
+        levels = gen_filter_levels(rng, names, weights, max_depth=max_depth)
+        broker = 1 if rng.random() < persistent_ratio else 0
+        trie.add(Route(matcher=_mk_matcher(levels), broker_id=broker,
+                       receiver_id=f"r{i}", deliverer_key=f"d{i % 64}"))
+    return {"tenant0": trie}
+
+
+def config_shared(n_tenants: int = 1000, subs_per_tenant: int = 10_000, *,
+                  seed: int = 0, n_groups: int = 16
+                  ) -> Dict[str, SubscriptionTrie]:
+    """Config 3: many tenants, $share shared-subscription fan-out."""
+    rng = random.Random(seed)
+    names, weights = _zipf_levels(500)
+    out: Dict[str, SubscriptionTrie] = {}
+    for t in range(n_tenants):
+        trie = SubscriptionTrie()
+        for i in range(subs_per_tenant):
+            levels = gen_filter_levels(rng, names, weights, p_plus=0.05,
+                                       p_hash=0.05)
+            group = f"g{rng.randrange(n_groups)}"
+            ordered = rng.random() < 0.3
+            trie.add(Route(matcher=_mk_matcher(levels, group, ordered),
+                           broker_id=0, receiver_id=f"t{t}m{i}",
+                           deliverer_key=f"d{i % 64}"))
+        out[f"tenant{t}"] = trie
+    return out
+
+
+def config_multi_tenant(n_tenants: int = 10_000, total_subs: int = 10_000_000,
+                        *, seed: int = 0) -> Dict[str, SubscriptionTrie]:
+    """Config 5: tenant-sharded: Zipf tenant sizes summing to total_subs."""
+    rng = random.Random(seed)
+    names, weights = _zipf_levels(1000)
+    tenant_weights = [1.0 / (i + 1) for i in range(n_tenants)]
+    wsum = sum(tenant_weights)
+    out: Dict[str, SubscriptionTrie] = {}
+    for t in range(n_tenants):
+        n = max(1, int(total_subs * tenant_weights[t] / wsum))
+        trie = SubscriptionTrie()
+        for i in range(n):
+            levels = gen_filter_levels(rng, names, weights)
+            trie.add(Route(matcher=_mk_matcher(levels), broker_id=0,
+                           receiver_id=f"t{t}r{i}", deliverer_key=f"d{i % 64}"))
+        out[f"tenant{t}"] = trie
+    return out
+
+
+def probe_topics(n: int, *, seed: int = 1, n_level_names: int = 1000,
+                 max_depth: int = 6) -> List[List[str]]:
+    """Concrete PUBLISH topics drawn from the same Zipf tree."""
+    rng = random.Random(seed)
+    names, weights = _zipf_levels(n_level_names)
+    return [gen_topic_levels(rng, names, weights, max_depth=max_depth)
+            for _ in range(n)]

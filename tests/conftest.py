@@ -1,0 +1,23 @@
+"""Test harness config.
+
+Tests run on a virtual 8-device CPU mesh (mirrors the reference's in-process
+multi-node test clusters, SURVEY.md §4: KVRangeStoreTestCluster et al. — real
+components over fake transports). Real-TPU runs happen via bench.py and the
+driver's graft entry, not the unit suite.
+
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+# force-override: the session env pins JAX_PLATFORMS=axon (real TPU tunnel)
+# and a sitecustomize registers the axon PJRT plugin at interpreter start, so
+# the env var alone is not enough — set the config knob too.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

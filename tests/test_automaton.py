@@ -1,0 +1,254 @@
+"""Automaton compiler + device-walk parity tests.
+
+The oracle trie (tests/test_oracle.py proves it against brute force) is the
+ground truth; here the compiled automaton + JAX walk must reproduce its match
+sets exactly, including wildcards, '$'-topics, shared groups, multi-tenant
+isolation and the overflow fallback path.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from bifromq_tpu.models import automaton as am
+from bifromq_tpu.models.matcher import TpuMatcher
+from bifromq_tpu.models.oracle import Route, SubscriptionTrie
+from bifromq_tpu.types import RouteMatcher
+from bifromq_tpu.utils import topic as t
+
+
+def mk_route(tf: str, receiver: str = "r0", broker: int = 0, inc: int = 0) -> Route:
+    return Route(matcher=RouteMatcher.from_topic_filter(tf), broker_id=broker,
+                 receiver_id=receiver, deliverer_key="d0", incarnation=inc)
+
+
+def route_key(r: Route):
+    return (r.matcher.mqtt_topic_filter, r.receiver_url)
+
+
+def result_keys(m):
+    normal = sorted(route_key(r) for r in m.normal)
+    groups = {k: sorted(route_key(r) for r in v) for k, v in m.groups.items()}
+    return normal, groups
+
+
+class TestCompile:
+    def test_empty(self):
+        ct = am.compile_tries({})
+        assert ct.n_nodes == 1  # padded sentinel
+        assert ct.root_of("t") == -1
+
+    def test_single_filter_structure(self):
+        trie = SubscriptionTrie()
+        trie.add(mk_route("a/b"))
+        ct = am.compile_tries({"t": trie})
+        root = ct.root_of("t")
+        assert root == 0
+        # root -> a -> b, pre-order: 0,1,2
+        assert ct.n_nodes == 3
+        assert ct.node_tab[root, am.NODE_CCOUNT] == 1
+        assert ct.node_tab[2, am.NODE_RCOUNT] == 1
+        assert ct.n_slots == 1
+
+    def test_subtree_contiguity_and_counts(self):
+        trie = SubscriptionTrie()
+        for tf in ["a/b", "a/c", "a/+", "a/#", "d"]:
+            trie.add(mk_route(tf, receiver=tf))
+        ct = am.compile_tries({"t": trie})
+        nt = ct.node_tab
+        # every node's subtree_end > node id; root subtree covers everything
+        root = ct.root_of("t")
+        assert nt[root, am.NODE_SUB_END] == ct.n_nodes
+        assert nt[root, am.NODE_SUB_RCOUNT] == 5
+        # slots within a subtree are contiguous from route_start
+        for n in range(ct.n_nodes):
+            end = nt[n, am.NODE_SUB_END]
+            assert n < end <= ct.n_nodes
+
+    def test_child_list_contiguous(self):
+        trie = SubscriptionTrie()
+        for tf in ["a/x/1", "b/y/2", "c/z/3"]:
+            trie.add(mk_route(tf))
+        ct = am.compile_tries({"t": trie})
+        root = ct.root_of("t")
+        start = ct.node_tab[root, am.NODE_CSTART]
+        count = ct.node_tab[root, am.NODE_CCOUNT]
+        assert count == 3
+        kids = ct.child_list[start:start + count]
+        # all three children are depth-1 nodes whose parent is root
+        for kid in kids:
+            assert 0 < kid < ct.n_nodes
+
+    def test_edge_table_exact(self):
+        rng = random.Random(7)
+        trie = SubscriptionTrie()
+        levels = [f"lvl{i}" for i in range(200)]
+        for lv in levels:
+            trie.add(mk_route(lv, receiver=lv))
+        ct = am.compile_tries({"t": trie})
+        root = ct.root_of("t")
+        # every literal level must be findable in one of its two buckets
+        tab = ct.edge_tab
+        nb = tab.shape[0]
+        for lv in levels:
+            h1, h2 = am.level_hash(lv, ct.salt)
+            args = (np.int32(root), np.int32(h1), np.int32(h2))
+            found = False
+            for b in (int(am._mix_u32(*args) & np.uint32(nb - 1)),
+                      int(am._mix2_u32(*args) & np.uint32(nb - 1))):
+                for row in tab[b]:
+                    if row[0] == root and row[1] == h1 and row[2] == h2:
+                        found = True
+            assert found, lv
+
+
+class TestWalkParity:
+    def check(self, filters, topics, tenants=("tenantA",), k_states=32,
+              broker_mix=False):
+        matcher = TpuMatcher(k_states=k_states)
+        oracles = {}
+        rng = random.Random(1)
+        for tenant in tenants:
+            oracle = SubscriptionTrie()
+            for i, tf in enumerate(filters):
+                broker = rng.choice([0, 1]) if broker_mix else 0
+                r = mk_route(tf, receiver=f"{tenant}-r{i}", broker=broker)
+                oracle.add(r)
+                matcher.add_route(tenant, r)
+            oracles[tenant] = oracle
+        queries = [(tenant, t.parse(topic)) for tenant in tenants
+                   for topic in topics]
+        got = matcher.match_batch(queries)
+        for (tenant, levels), res in zip(queries, got):
+            expect = oracles[tenant].match(list(levels))
+            assert result_keys(res) == result_keys(expect), (tenant, levels)
+
+    def test_basic(self):
+        self.check(
+            ["a/b", "a/+", "a/#", "#", "+/+", "b/+", "a", "+"],
+            ["a/b", "a/c", "a", "b", "x/y/z", "a/b/c", ""],
+        )
+
+    def test_sys_topics(self):
+        self.check(
+            ["#", "+/health", "$SYS/#", "$SYS/+", "$SYS/health"],
+            ["$SYS/health", "$SYS/other", "sys/health", "$SYS"],
+        )
+
+    def test_empty_levels(self):
+        self.check(
+            ["/", "//", "+/+", "/#", "/+", "a//b", "a/+/b"],
+            ["/", "//", "a//b", "", "/a"],
+        )
+
+    def test_shared_groups(self):
+        self.check(
+            ["$share/g1/a/+", "$share/g2/a/+", "$oshare/og/a/b", "a/b",
+             "$share/g1/#"],
+            ["a/b", "a/c", "x"],
+        )
+
+    def test_multi_tenant_isolation(self):
+        matcher = TpuMatcher()
+        matcher.add_route("t1", mk_route("a/b", receiver="t1r"))
+        matcher.add_route("t2", mk_route("a/+", receiver="t2r"))
+        res = matcher.match_batch([("t1", ["a", "b"]), ("t2", ["a", "b"]),
+                                   ("t3", ["a", "b"])])
+        assert [x.receiver_id for x in res[0].normal] == ["t1r"]
+        assert [x.receiver_id for x in res[1].normal] == ["t2r"]
+        assert res[2].all_routes() == []
+
+    def test_deep_and_mixed(self):
+        self.check(
+            ["a/b/c/d/e/f", "a/b/c/d/e/+", "a/+/c/+/e/#", "a/#", "+/b/#"],
+            ["a/b/c/d/e/f", "a/b/c/d/e", "a/x/c/y/e/anything/deeper"],
+        )
+
+    def test_overflow_falls_back_to_oracle(self):
+        # k_states=2 forces overflow with many '+' branches; results must
+        # still be exact via the host fallback.
+        filters = [f"{a}/{b}" for a in ["+", "a", "b"] for b in ["+", "x", "y"]]
+        self.check(filters, ["a/x", "b/y"], k_states=2)
+
+    def test_too_long_topic_falls_back(self):
+        matcher = TpuMatcher(max_levels=4)
+        matcher.add_route("t", mk_route("a/#", receiver="r"))
+        levels = ["a"] + ["x"] * 10  # 11 levels > max_levels
+        res = matcher.match_batch([("t", levels)])
+        assert [x.receiver_id for x in res[0].normal] == ["r"]
+
+    def test_mutation_refresh(self):
+        matcher = TpuMatcher()
+        r = mk_route("a/+", receiver="r1")
+        matcher.add_route("t", r)
+        assert [x.receiver_id for x in matcher.match("t", "a/b").normal] == ["r1"]
+        matcher.add_route("t", mk_route("a/b", receiver="r2"))
+        got = sorted(x.receiver_id for x in matcher.match("t", "a/b").normal)
+        assert got == ["r1", "r2"]
+        matcher.remove_route("t", r.matcher, r.receiver_url)
+        assert [x.receiver_id for x in matcher.match("t", "a/b").normal] == ["r2"]
+
+    def test_caps_via_device_path(self):
+        matcher = TpuMatcher()
+        for i in range(5):
+            matcher.add_route("t", mk_route("a", receiver=f"p{i}", broker=1))
+        for i in range(3):
+            matcher.add_route("t", mk_route(f"$share/g{i}/a", receiver="m"))
+        res = matcher.match_batch([("t", ["a"])], max_persistent_fanout=2,
+                                  max_group_fanout=1)[0]
+        assert len([r for r in res.normal if r.broker_id == 1]) == 2
+        assert res.max_persistent_fanout_exceeded
+        assert len(res.groups) == 1
+        assert res.max_group_fanout_exceeded
+
+
+class TestPropertyRandom:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_random_parity(self, seed):
+        rng = random.Random(seed)
+        alphabet = ["a", "b", "c", "d", "", "x1", "$s"]
+
+        def rand_filter():
+            n = rng.randint(1, 6)
+            levels = []
+            for i in range(n):
+                roll = rng.random()
+                if roll < 0.2:
+                    levels.append("+")
+                elif roll < 0.3 and i == n - 1:
+                    levels.append("#")
+                else:
+                    levels.append(rng.choice(alphabet))
+            tf = "/".join(levels)
+            if rng.random() < 0.2:
+                tf = f"$share/g{rng.randint(0, 2)}/{tf}"
+            return tf
+
+        def rand_topic():
+            n = rng.randint(1, 6)
+            return [rng.choice(alphabet + ["$SYS"])] + [
+                rng.choice(alphabet) for _ in range(n - 1)]
+
+        matcher = TpuMatcher(k_states=8)
+        oracle = SubscriptionTrie()
+        for i in range(250):
+            tf = rand_filter()
+            if not t.is_valid_topic_filter(tf):
+                continue
+            r = mk_route(tf, receiver=f"r{i}", broker=rng.choice([0, 1]))
+            oracle.add(r)
+            matcher.add_route("t", r)
+
+        topics = [rand_topic() for _ in range(300)]
+        got = matcher.match_batch([("t", lv) for lv in topics])
+        for levels, res in zip(topics, got):
+            expect = oracle.match(levels)
+            assert result_keys(res) == result_keys(expect), levels
+
+
+class TestEmptyBatch:
+    def test_match_batch_empty(self):
+        matcher = TpuMatcher()
+        matcher.add_route("t", mk_route("a/b"))
+        assert matcher.match_batch([]) == []

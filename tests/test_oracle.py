@@ -1,0 +1,208 @@
+"""Oracle subscription-trie tests: trie NFA match vs brute-force per-filter
+matching, caps, incarnation guards, shared groups.
+
+Mirrors the spirit of the reference coproc match tests
+(bifromq-dist/bifromq-dist-worker/src/test/.../worker/MatchTest and
+trie/TopicFilterIteratorTest property style).
+"""
+
+import random
+import string
+
+from bifromq_tpu.models.oracle import MatchedRoutes, Route, SubscriptionTrie
+from bifromq_tpu.types import RouteMatcher
+from bifromq_tpu.utils import topic as t
+
+
+def mk_route(tf: str, receiver: str = "r0", broker: int = 0, inc: int = 0) -> Route:
+    return Route(matcher=RouteMatcher.from_topic_filter(tf), broker_id=broker,
+                 receiver_id=receiver, deliverer_key="d0", incarnation=inc)
+
+
+def brute_force(routes, topic_levels):
+    out = []
+    for r in routes:
+        if t.matches(topic_levels, list(r.matcher.filter_levels)):
+            out.append(r)
+    return out
+
+
+def route_key(r: Route):
+    return (r.matcher.mqtt_topic_filter, r.receiver_url)
+
+
+class TestBasics:
+    def test_add_match_remove(self):
+        trie = SubscriptionTrie()
+        r = mk_route("a/b")
+        assert trie.add(r)
+        assert len(trie) == 1
+        m = trie.match(["a", "b"])
+        assert [x.receiver_id for x in m.normal] == ["r0"]
+        assert trie.match(["a", "c"]).all_routes() == []
+        assert trie.remove(r.matcher, r.receiver_url)
+        assert len(trie) == 0
+        assert trie.match(["a", "b"]).all_routes() == []
+
+    def test_wildcards(self):
+        trie = SubscriptionTrie()
+        for tf in ["#", "+/+", "a/#", "a/+", "a/b", "b/+"]:
+            trie.add(mk_route(tf, receiver=tf))
+        m = trie.match(["a", "b"])
+        got = sorted(x.receiver_id for x in m.normal)
+        assert got == ["#", "+/+", "a/#", "a/+", "a/b"]
+
+    def test_sys_topic_no_root_wildcard(self):
+        trie = SubscriptionTrie()
+        for tf in ["#", "+/health", "$SYS/#", "$SYS/+"]:
+            trie.add(mk_route(tf, receiver=tf))
+        m = trie.match(["$SYS", "health"])
+        got = sorted(x.receiver_id for x in m.normal)
+        assert got == ["$SYS/#", "$SYS/+"]
+
+    def test_hash_matches_parent(self):
+        trie = SubscriptionTrie()
+        trie.add(mk_route("sport/#"))
+        assert len(trie.match(["sport"]).normal) == 1
+        assert len(trie.match(["sport", "x", "y"]).normal) == 1
+
+    def test_incarnation_guard(self):
+        trie = SubscriptionTrie()
+        trie.add(mk_route("a", inc=5))
+        trie.add(mk_route("a", inc=3))  # stale upsert keeps newer
+        m = trie.match(["a"])
+        assert m.normal[0].incarnation == 5
+        # stale remove is a no-op
+        assert not trie.remove(mk_route("a").matcher, mk_route("a").receiver_url, incarnation=3)
+        assert len(trie) == 1
+        assert trie.remove(mk_route("a").matcher, mk_route("a").receiver_url, incarnation=5)
+
+    def test_prune_empty_branches(self):
+        trie = SubscriptionTrie()
+        r = mk_route("a/b/c/d")
+        trie.add(r)
+        trie.remove(r.matcher, r.receiver_url)
+        assert trie._root.is_empty()
+
+
+class TestShared:
+    def test_group_membership(self):
+        trie = SubscriptionTrie()
+        trie.add(mk_route("$share/g/a/+", receiver="m1"))
+        trie.add(mk_route("$share/g/a/+", receiver="m2"))
+        trie.add(mk_route("$oshare/og/a/b", receiver="m3"))
+        m = trie.match(["a", "b"])
+        assert set(m.groups) == {"$share/g/a/+", "$oshare/og/a/b"}
+        assert sorted(x.receiver_id for x in m.groups["$share/g/a/+"]) == ["m1", "m2"]
+        assert m.normal == []
+
+    def test_same_filter_distinct_groups(self):
+        trie = SubscriptionTrie()
+        trie.add(mk_route("$share/g1/a", receiver="m1"))
+        trie.add(mk_route("$share/g2/a", receiver="m2"))
+        trie.add(mk_route("a", receiver="n"))
+        m = trie.match(["a"])
+        assert set(m.groups) == {"$share/g1/a", "$share/g2/a"}
+        assert [x.receiver_id for x in m.normal] == ["n"]
+
+    def test_group_remove(self):
+        trie = SubscriptionTrie()
+        r1, r2 = mk_route("$share/g/a", receiver="m1"), mk_route("$share/g/a", receiver="m2")
+        trie.add(r1)
+        trie.add(r2)
+        assert trie.remove(r1.matcher, r1.receiver_url)
+        m = trie.match(["a"])
+        assert [x.receiver_id for x in m.groups["$share/g/a"]] == ["m2"]
+        assert trie.remove(r2.matcher, r2.receiver_url)
+        assert trie.match(["a"]).groups == {}
+
+
+class TestCaps:
+    def test_persistent_fanout_cap_only_counts_broker1(self):
+        trie = SubscriptionTrie()
+        for i in range(5):
+            trie.add(mk_route("a", receiver=f"p{i}", broker=1))
+        for i in range(5):
+            trie.add(mk_route("a", receiver=f"t{i}", broker=0))
+        m = trie.match(["a"], max_persistent_fanout=3)
+        persistent = [r for r in m.normal if r.broker_id == 1]
+        transient = [r for r in m.normal if r.broker_id == 0]
+        assert len(persistent) == 3
+        assert len(transient) == 5
+        assert m.max_persistent_fanout_exceeded
+
+    def test_group_fanout_caps_distinct_groups(self):
+        trie = SubscriptionTrie()
+        for i in range(5):
+            trie.add(mk_route(f"$share/g{i}/a", receiver="m"))
+        m = trie.match(["a"], max_group_fanout=2)
+        assert len(m.groups) == 2
+        assert m.max_group_fanout_exceeded
+
+
+class TestPropertyRandom:
+    def test_random_vs_brute_force(self):
+        rng = random.Random(42)
+        alphabet = ["a", "b", "c", "", "x1"]
+
+        def rand_filter():
+            n = rng.randint(1, 5)
+            levels = []
+            for i in range(n):
+                roll = rng.random()
+                if roll < 0.15:
+                    levels.append("+")
+                elif roll < 0.25 and i == n - 1:
+                    levels.append("#")
+                else:
+                    levels.append(rng.choice(alphabet))
+            return "/".join(levels)
+
+        def rand_topic():
+            n = rng.randint(1, 5)
+            first = rng.choice(alphabet + ["$SYS"])
+            return [first] + [rng.choice(alphabet) for _ in range(n - 1)]
+
+        trie = SubscriptionTrie()
+        routes = []
+        for i in range(300):
+            tf = rand_filter()
+            if not t.is_valid_topic_filter(tf):
+                continue
+            r = mk_route(tf, receiver=f"r{i}")
+            trie.add(r)
+            routes.append(r)
+
+        for _ in range(500):
+            topic_levels = rand_topic()
+            expect = sorted(route_key(r) for r in brute_force(routes, topic_levels))
+            got = sorted(route_key(r) for r in trie.match(topic_levels).all_routes())
+            assert got == expect, f"mismatch for topic {topic_levels}"
+
+
+class TestReviewRegressions:
+    def test_share_and_oshare_same_group_name_stay_distinct(self):
+        trie = SubscriptionTrie()
+        trie.add(mk_route("$share/g/a", receiver="u1"))
+        trie.add(mk_route("$oshare/g/a", receiver="o1"))
+        m = trie.match(["a"])
+        assert set(m.groups) == {"$share/g/a", "$oshare/g/a"}
+        assert [x.receiver_id for x in m.groups["$share/g/a"]] == ["u1"]
+        assert [x.receiver_id for x in m.groups["$oshare/g/a"]] == ["o1"]
+        # removal only touches the matching share type
+        r = mk_route("$share/g/a", receiver="u1")
+        assert trie.remove(r.matcher, r.receiver_url)
+        m = trie.match(["a"])
+        assert set(m.groups) == {"$oshare/g/a"}
+
+    def test_literal_wildcard_topic_level_not_double_collected(self):
+        trie = SubscriptionTrie()
+        trie.add(mk_route("a/+", receiver="rr"))
+        # invalid-as-topic input, but the oracle must stay consistent with
+        # the device walk: one match, not two
+        m = trie.match(["a", "+"])
+        assert [x.receiver_id for x in m.normal] == ["rr"]
+        # "+" still matches a literal "#" level (it matches ANY single level);
+        # the point is no double-collection via the exact-child path
+        m2 = trie.match(["a", "#"])
+        assert [x.receiver_id for x in m2.normal] == ["rr"]

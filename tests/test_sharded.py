@@ -1,0 +1,113 @@
+"""Mesh-sharded match plane tests on the virtual 8-device CPU mesh.
+
+Plays the role of the reference's in-process cluster harnesses
+(KVRangeStoreTestCluster, SURVEY.md §4): real components, fake devices.
+"""
+
+import random
+
+import jax
+import pytest
+
+from bifromq_tpu.models.oracle import Route, SubscriptionTrie
+from bifromq_tpu.parallel import sharded as sh
+from bifromq_tpu.types import RouteMatcher
+from bifromq_tpu.utils import topic as t
+
+
+def mk_route(tf: str, receiver: str = "r0", broker: int = 0) -> Route:
+    return Route(matcher=RouteMatcher.from_topic_filter(tf), broker_id=broker,
+                 receiver_id=receiver, deliverer_key="d0")
+
+
+def route_key(r):
+    return (r.matcher.mqtt_topic_filter, r.receiver_url)
+
+
+def result_keys(m):
+    return (sorted(route_key(r) for r in m.normal),
+            {k: sorted(route_key(r) for r in v) for k, v in m.groups.items()})
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest must force 8 CPU devices"
+    return sh.make_mesh(2, 4)
+
+
+def build_tries(n_tenants=12, n_filters=40, seed=3):
+    rng = random.Random(seed)
+    alphabet = ["a", "b", "c", "d", "x1"]
+    tries = {}
+    for ti in range(n_tenants):
+        trie = SubscriptionTrie()
+        for fi in range(n_filters):
+            n = rng.randint(1, 5)
+            levels = []
+            for i in range(n):
+                roll = rng.random()
+                if roll < 0.2:
+                    levels.append("+")
+                elif roll < 0.3 and i == n - 1:
+                    levels.append("#")
+                else:
+                    levels.append(rng.choice(alphabet))
+            tf = "/".join(levels)
+            if not t.is_valid_topic_filter(tf):
+                continue
+            trie.add(mk_route(tf, receiver=f"t{ti}-r{fi}"))
+        tries[f"tenant{ti}"] = trie
+    return tries
+
+
+class TestShardAssignment:
+    def test_stable_and_in_range(self):
+        for n in (1, 4, 8):
+            for tid in ("a", "b", "tenant42"):
+                s1 = sh.tenant_shard(tid, n)
+                assert 0 <= s1 < n
+                assert s1 == sh.tenant_shard(tid, n)
+
+
+class TestBuildSharded:
+    def test_common_edge_cap_and_padding(self):
+        tries = build_tries()
+        tables = sh.build_sharded(tries, 4)
+        assert tables.node_tab.shape[0] == 4
+        caps = {ct.edge_tab.shape[0] for ct in tables.compiled}
+        assert caps == {tables.edge_tab.shape[1]}
+        # every tenant is routable
+        for tid in tries:
+            assert tables.root_of(tid) >= 0
+
+
+class TestMeshMatcher:
+    def test_parity_across_mesh(self, mesh8):
+        rng = random.Random(9)
+        tries = build_tries()
+        matcher = sh.MeshMatcher(tries, mesh8)
+        alphabet = ["a", "b", "c", "d", "x1", "$SYS"]
+        queries = []
+        for _ in range(200):
+            tid = f"tenant{rng.randrange(12)}"
+            n = rng.randint(1, 5)
+            levels = [rng.choice(alphabet)] + [
+                rng.choice(alphabet[:5]) for _ in range(n - 1)]
+            queries.append((tid, levels))
+        got = matcher.match_batch(queries)
+        for (tid, levels), res in zip(queries, got):
+            expect = tries[tid].match(list(levels))
+            assert result_keys(res) == result_keys(expect), (tid, levels)
+
+    def test_unknown_tenant_empty(self, mesh8):
+        matcher = sh.MeshMatcher(build_tries(), mesh8)
+        res = matcher.match_batch([("nobody", ["a", "b"])])
+        assert res[0].all_routes() == []
+
+    def test_single_device_mesh(self):
+        mesh = sh.make_mesh(1, 1)
+        tries = build_tries(n_tenants=3)
+        matcher = sh.MeshMatcher(tries, mesh)
+        res = matcher.match_batch([("tenant0", ["a", "b"])])
+        expect = tries["tenant0"].match(["a", "b"])
+        assert result_keys(res[0]) == result_keys(expect)

@@ -1,0 +1,157 @@
+"""Topic machinery parity tests.
+
+Case sets mirror the reference's TopicUtilTest
+(bifromq-util/src/test/java/org/apache/bifromq/util/TopicUtilTest.java) and
+MQTT spec normative statements [MQTT-4.7.*], [MQTT-4.8.2-*].
+"""
+
+import pytest
+
+from bifromq_tpu.types import RouteMatcher, RouteMatcherType
+from bifromq_tpu.utils import topic as t
+
+
+class TestParse:
+    @pytest.mark.parametrize("s,expect", [
+        ("/", ["", ""]),
+        ("/a", ["", "a"]),
+        ("a/", ["a", ""]),
+        ("a/b", ["a", "b"]),
+        ("a//b", ["a", "", "b"]),
+        ("a", ["a"]),
+        ("", [""]),
+    ])
+    def test_parse(self, s, expect):
+        assert t.parse(s) == expect
+
+    def test_escape_roundtrip(self):
+        for s in ["a/b/c", "/", "sport/+/player1", "#"]:
+            assert t.unescape(t.escape(s)) == s
+            assert t.parse(t.escape(s), escaped=True) == t.parse(s)
+
+    def test_fast_join(self):
+        assert t.fast_join(["a", "", "b"]) == "a//b"
+
+
+class TestValidateTopic:
+    @pytest.mark.parametrize("topic,ok", [
+        ("a/b/c", True),
+        ("/", True),
+        ("a", True),
+        ("$SYS/health", True),
+        ("", False),
+        ("a/+/b", False),
+        ("a/#", False),
+        ("#", False),
+        ("a/b#", False),
+        ("$share/g/t", False),
+        ("$oshare/g/t", False),
+        ("\u0000", False),
+    ])
+    def test_cases(self, topic, ok):
+        assert t.is_valid_topic(topic) is ok
+
+    def test_limits(self):
+        assert t.is_valid_topic("a/" * 7 + "a", max_levels=8)
+        assert not t.is_valid_topic("a/" * 8 + "a", max_levels=8)
+        assert not t.is_valid_topic("abcdef", max_level_length=5)
+        assert t.is_valid_topic("abcde", max_level_length=5)
+        assert not t.is_valid_topic("a" * 300, max_length=255)
+
+
+class TestValidateTopicFilter:
+    @pytest.mark.parametrize("tf,ok", [
+        ("a/b", True),
+        ("#", True),
+        ("+", True),
+        ("a/#", True),
+        ("a/+/b", True),
+        ("+/+", True),
+        ("/#", True),
+        ("/", True),
+        ("sport/#/more", False),     # '#' not last
+        ("sport/ten#", False),       # '#' not alone in level
+        ("sport+", False),           # '+' not alone in level
+        ("+sport", False),
+        ("a/+b", False),
+        ("$share/g/a/b", True),
+        ("$share/g/#", True),
+        ("$oshare/g/+/b", True),
+        ("$share//a", False),        # empty group [MQTT-4.8.2-1]
+        ("$share/g", False),         # no filter after group [MQTT-4.8.2-2]
+        ("$share/g+/a", False),      # wildcard in group name
+        ("$share/g#/a", False),
+        ("$share/", False),
+        ("", False),
+    ])
+    def test_cases(self, tf, ok):
+        assert t.is_valid_topic_filter(tf) is ok
+
+    def test_share_prefix_length_budget(self):
+        # The literal "$share/" prefix (7 chars) extends max_length; the group
+        # name itself still counts (TopicUtil.isValidTopicFilter:95-97).
+        tf = "$share/gg/" + "a" * 20  # 30 chars total
+        assert t.is_valid_topic_filter(tf, max_level_length=20, max_length=23)
+        assert not t.is_valid_topic_filter(tf, max_level_length=20, max_length=22)
+
+    def test_classifiers(self):
+        assert t.is_shared_subscription("$share/g/a")
+        assert t.is_ordered_shared("$oshare/g/a")
+        assert not t.is_ordered_shared("$share/g/a")
+        assert t.is_normal_topic_filter("a/b")
+        assert t.is_wildcard_topic_filter("a/+")
+        assert t.is_wildcard_topic_filter("a/#")
+        assert t.is_multi_wildcard_topic_filter("#")
+        assert not t.is_wildcard_topic_filter("a/b")
+
+
+class TestMatches:
+    @pytest.mark.parametrize("topic,tf,ok", [
+        ("sport/tennis/player1", "sport/tennis/player1", True),
+        ("sport/tennis/player1", "sport/tennis/player2", False),
+        ("sport/tennis/player1", "sport/tennis/+", True),
+        ("sport/tennis/player1", "sport/+/player1", True),
+        ("sport/tennis/player1", "+/+/+", True),
+        ("sport/tennis/player1", "#", True),
+        ("sport/tennis/player1", "sport/#", True),
+        ("sport/tennis/player1", "sport/tennis/player1/#", True),  # '#' matches zero levels
+        ("sport", "sport/#", True),
+        ("sport", "sport/+", False),
+        ("sport/", "sport/+", True),     # '+' matches empty level
+        ("/finance", "+/+", True),
+        ("/finance", "/+", True),
+        ("/finance", "+", False),
+        ("sport/tennis", "sport/tennis/#/ranking", False),
+        # [MQTT-4.7.2-1]: no wildcard match on '$'-first level
+        ("$SYS/health", "#", False),
+        ("$SYS/health", "+/health", False),
+        ("$SYS/health", "$SYS/health", True),
+        ("$SYS/health", "$SYS/+", True),
+        ("$SYS/health", "$SYS/#", True),
+        ("$SYS/a/b", "$SYS/+/+", True),
+    ])
+    def test_cases(self, topic, tf, ok):
+        assert t.matches(t.parse(topic), t.parse(tf)) is ok
+
+
+class TestRouteMatcher:
+    def test_normal(self):
+        m = RouteMatcher.from_topic_filter("a/+/b")
+        assert m.type == RouteMatcherType.NORMAL
+        assert m.filter_levels == ("a", "+", "b")
+        assert m.group is None
+        assert not m.is_shared
+
+    def test_unordered_share(self):
+        m = RouteMatcher.from_topic_filter("$share/grp/a/#")
+        assert m.type == RouteMatcherType.UNORDERED_SHARE
+        assert m.group == "grp"
+        assert m.filter_levels == ("a", "#")
+        assert m.mqtt_topic_filter == "$share/grp/a/#"
+        assert m.is_shared
+
+    def test_ordered_share(self):
+        m = RouteMatcher.from_topic_filter("$oshare/grp/+")
+        assert m.type == RouteMatcherType.ORDERED_SHARE
+        assert m.group == "grp"
+        assert m.filter_levels == ("+",)
