@@ -1,0 +1,1 @@
+"""bifromq_tpu.dist — the distribution plane (≈ bifromq-dist + bifromq-deliverer)."""

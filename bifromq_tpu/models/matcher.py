@@ -100,6 +100,12 @@ class TpuMatcher:
         if not queries:
             return []
         ct = self.refresh()
+        if batch is None:
+            # pad to power-of-two buckets: every distinct batch shape costs an
+            # XLA compile, so live traffic must reuse a small set of shapes
+            batch = 16
+            while batch < len(queries):
+                batch *= 2
         roots = [ct.root_of(t) for t, _ in queries]
         tok = tokenize([levels for _, levels in queries], roots,
                        max_levels=ct.max_levels, salt=ct.salt, batch=batch)

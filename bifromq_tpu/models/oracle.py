@@ -112,17 +112,26 @@ class SubscriptionTrie:
         Incarnation guard: an insert with a stale incarnation (< existing) is
         ignored, matching the reference's guard on normal-route upsert.
         """
-        node = self._root
-        for level in route.matcher.filter_levels:
-            node = node.children.setdefault(level, _TrieNode())
         url = route.receiver_url
-        if route.matcher.type == RouteMatcherType.NORMAL:
-            existing = node.routes.get(url)
+        # probe without creating first: a stale-incarnation insert must not
+        # materialize (and leak) empty trie nodes along a new path
+        probe = self._root
+        for level in route.matcher.filter_levels:
+            probe = probe.children.get(level)
+            if probe is None:
+                break
+        if (probe is not None
+                and route.matcher.type == RouteMatcherType.NORMAL):
+            existing = probe.routes.get(url)
             if existing is not None:
                 if existing.incarnation > route.incarnation:
                     return False
-                node.routes[url] = route
+                probe.routes[url] = route
                 return False
+        node = self._root
+        for level in route.matcher.filter_levels:
+            node = node.children.setdefault(level, _TrieNode())
+        if route.matcher.type == RouteMatcherType.NORMAL:
             node.routes[url] = route
             self._count += 1
             return True

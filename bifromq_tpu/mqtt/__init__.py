@@ -1,0 +1,1 @@
+"""bifromq_tpu.mqtt — MQTT protocol frontend (codec, sessions, broker, client)."""

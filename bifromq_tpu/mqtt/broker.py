@@ -1,0 +1,306 @@
+"""Asyncio MQTT broker frontend (≈ bifromq-mqtt MQTTBroker + handler pipeline).
+
+Connection lifecycle mirrors the reference Netty pipeline
+(MQTTBroker.java:177-240 → MQTTPreludeHandler.java:58 → MQTT{3,5}ConnectHandler
+→ session handler swap): wait for CONNECT with a timeout, authenticate via the
+plugin, resolve tenant settings, register the session (kicking any previous
+owner), then dispatch packets into the session until close. Keep-alive
+enforcement closes connections silent for 1.5× the negotiated interval.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+from typing import Optional
+
+from ..dist.service import DistService
+from ..plugin.auth import (AllowAllAuthProvider, AuthData, IAuthProvider,
+                           MQTTAction)
+from ..plugin.events import (CollectingEventCollector, Event, EventType,
+                             IEventCollector)
+from ..plugin.settings import (DefaultSettingProvider, ISettingProvider,
+                               Setting, TenantSettings)
+from ..plugin.subbroker import SubBrokerRegistry
+from ..types import ClientInfo
+from . import packets as pk
+from .codec import StreamDecoder, encode
+from .protocol import (CONNACK_ACCEPTED, CONNACK_REFUSED_IDENTIFIER_REJECTED,
+                       CONNACK_REFUSED_NOT_AUTHORIZED, PROTOCOL_MQTT5,
+                       MalformedPacket, PropertyId, ReasonCode)
+from .session import (LocalSessionRegistry, Session, SessionRegistry,
+                      TransientSubBroker)
+
+log = logging.getLogger("bifromq_tpu.mqtt")
+
+CONNECT_TIMEOUT = 10.0  # ≈ MQTTPreludeHandler timeout
+
+
+class Connection:
+    """One client transport; owns the write side and the decode loop."""
+
+    def __init__(self, broker: "MQTTBroker", reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter) -> None:
+        self.broker = broker
+        self.reader = reader
+        self.writer = writer
+        self.decoder = StreamDecoder()
+        self.session: Optional[Session] = None
+        self.protocol_level = 4
+        self._closed = False
+
+    # ------------- write side ---------------------------------------------
+
+    async def send(self, packet) -> None:
+        if self._closed:
+            return
+        try:
+            self.writer.write(encode(packet, self.protocol_level))
+            await self.writer.drain()
+        except (ConnectionError, RuntimeError):
+            self._closed = True
+
+    async def protocol_error(self, msg: str,
+                             reason: int = ReasonCode.PROTOCOL_ERROR) -> None:
+        log.debug("protocol error: %s", msg)
+        await self.disconnect_with(reason)
+
+    async def disconnect_with(self, reason: int) -> None:
+        if self.protocol_level >= PROTOCOL_MQTT5:
+            await self.send(pk.Disconnect(reason_code=reason))
+        if self.session is not None:
+            await self.session.close(fire_will=True)
+        else:
+            await self.close_transport()
+
+    async def close_transport(self) -> None:
+        if not self._closed:
+            self._closed = True
+            try:
+                self.writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # ------------- read loop ----------------------------------------------
+
+    async def run(self) -> None:
+        try:
+            await self._prelude()
+            if self.session is None:
+                return
+            while not self._closed and not self.session.closed:
+                timeout = None
+                if self.session.keep_alive:
+                    timeout = self.session.keep_alive * 1.5
+                try:
+                    data = await asyncio.wait_for(self.reader.read(65536),
+                                                  timeout=timeout)
+                except asyncio.TimeoutError:
+                    self.broker.events.report(Event(
+                        EventType.CLIENT_DISCONNECTED,
+                        self.session.client_info.tenant_id,
+                        {"reason": "keepalive_timeout"}))
+                    await self.session.close(fire_will=True)
+                    return
+                if not data:
+                    await self.session.close(fire_will=True)
+                    return
+                for packet in self.decoder.feed(data):
+                    if isinstance(packet, pk.Connect):
+                        await self.protocol_error("duplicate CONNECT")
+                        return
+                    await self.session.handle(packet)
+                    if self.session.closed:
+                        # e.g. DISCONNECT followed by more packets in the
+                        # same TCP chunk: drop the remainder
+                        return
+        except MalformedPacket as e:
+            if self.session is not None:
+                await self.protocol_error(str(e), e.reason)
+            else:
+                await self.close_transport()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            if self.session is not None:
+                await self.session.close(fire_will=True)
+        except Exception:  # noqa: BLE001
+            log.exception("connection crashed")
+            if self.session is not None:
+                await self.session.close(fire_will=True)
+            await self.close_transport()
+        finally:
+            await self.close_transport()
+
+    async def _prelude(self) -> None:
+        """Wait for the first packet; it must be CONNECT (prelude handler)."""
+        buf_packets = []
+        try:
+            while not buf_packets:
+                data = await asyncio.wait_for(self.reader.read(65536),
+                                              timeout=CONNECT_TIMEOUT)
+                if not data:
+                    await self.close_transport()
+                    return
+                buf_packets = self.decoder.feed(data)
+        except (asyncio.TimeoutError, MalformedPacket):
+            await self.close_transport()
+            return
+        first = buf_packets[0]
+        if not isinstance(first, pk.Connect):
+            await self.close_transport()
+            return
+        self.protocol_level = first.protocol_level
+        await self._on_connect(first)
+        if self.session is not None:
+            for packet in buf_packets[1:]:
+                await self.session.handle(packet)
+
+    async def _on_connect(self, c: pk.Connect) -> None:
+        broker = self.broker
+        v5 = c.protocol_level >= PROTOCOL_MQTT5
+        peer = self.writer.get_extra_info("peername")
+        auth_result = await broker.auth.auth(AuthData(
+            client_id=c.client_id, protocol_level=c.protocol_level,
+            username=c.username, password=c.password,
+            remote_addr=str(peer)))
+        if not auth_result.ok:
+            rc = (ReasonCode.NOT_AUTHORIZED if v5
+                  else CONNACK_REFUSED_NOT_AUTHORIZED)
+            await self.send(pk.Connack(reason_code=rc))
+            broker.events.report(Event(EventType.CONNECT_REJECTED, "",
+                                       {"reason": auth_result.reason}))
+            await self.close_transport()
+            return
+
+        tenant_id = auth_result.tenant_id
+        settings = TenantSettings.resolve(broker.settings, tenant_id)
+        enabled = {3: Setting.MQTT3Enabled, 4: Setting.MQTT4Enabled,
+                   5: Setting.MQTT5Enabled}[c.protocol_level]
+        if not settings[enabled]:
+            rc = (ReasonCode.UNSUPPORTED_PROTOCOL_VERSION if v5 else 1)
+            await self.send(pk.Connack(reason_code=rc))
+            await self.close_transport()
+            return
+
+        client_id = c.client_id
+        assigned = None
+        if not client_id:
+            if not c.clean_start and not v5:
+                await self.send(pk.Connack(
+                    reason_code=CONNACK_REFUSED_IDENTIFIER_REJECTED))
+                await self.close_transport()
+                return
+            client_id = assigned = uuid.uuid4().hex
+
+        client_info = ClientInfo(
+            tenant_id=tenant_id, type="MQTT",
+            metadata=tuple(sorted({
+                "clientId": client_id,
+                "userId": auth_result.user_id,
+                "ver": str(c.protocol_level),
+                **auth_result.attrs,
+            }.items())))
+
+        keep_alive = c.keep_alive
+        min_ka = settings[Setting.MinKeepAliveSeconds]
+        server_keep_alive = None
+        if keep_alive and keep_alive < min_ka:
+            keep_alive = min_ka
+            server_keep_alive = min_ka
+
+        session = Session(
+            conn=self, client_id=client_id, client_info=ClientInfo(
+                tenant_id=tenant_id, type="MQTT",
+                metadata=client_info.metadata + (("sessionId", ""),)),
+            protocol_level=c.protocol_level, clean_start=c.clean_start,
+            keep_alive=keep_alive, will=c.will, settings=settings,
+            dist=broker.dist, auth=broker.auth, events=broker.events,
+            local_registry=broker.local_sessions,
+            session_registry=broker.session_registry,
+            connect_props=c.properties,
+            retain_service=broker.retain_service)
+        # bake the session id into publisher identity (no_local support)
+        session.client_info = ClientInfo(
+            tenant_id=tenant_id, type="MQTT",
+            metadata=client_info.metadata + (
+                ("sessionId", session.session_id),))
+        self.session = session
+        await session.start()
+
+        props = None
+        if v5:
+            props = {
+                PropertyId.TOPIC_ALIAS_MAXIMUM:
+                    settings[Setting.MaxTopicAlias],
+                PropertyId.SHARED_SUBSCRIPTION_AVAILABLE:
+                    1 if settings[Setting.SharedSubscriptionEnabled] else 0,
+                PropertyId.WILDCARD_SUBSCRIPTION_AVAILABLE:
+                    1 if settings[Setting.WildcardSubscriptionEnabled] else 0,
+                PropertyId.RETAIN_AVAILABLE:
+                    1 if settings[Setting.RetainEnabled] else 0,
+                PropertyId.MAXIMUM_QOS: settings[Setting.MaximumQoS],
+                PropertyId.RECEIVE_MAXIMUM:
+                    settings[Setting.ReceivingMaximum],
+            }
+            if assigned:
+                props[PropertyId.ASSIGNED_CLIENT_IDENTIFIER] = assigned
+            if server_keep_alive is not None:
+                props[PropertyId.SERVER_KEEP_ALIVE] = server_keep_alive
+        await self.send(pk.Connack(session_present=False,
+                                   reason_code=CONNACK_ACCEPTED,
+                                   properties=props))
+        broker.events.report(Event(EventType.CLIENT_CONNECTED, tenant_id,
+                                   {"client_id": client_id}))
+
+
+class MQTTBroker:
+    """The broker process: listeners + shared services (≈ StandaloneStarter
+    wiring for the mqtt-server role, SURVEY.md §3.1)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 1883, *,
+                 auth: Optional[IAuthProvider] = None,
+                 settings: Optional[ISettingProvider] = None,
+                 events: Optional[IEventCollector] = None,
+                 dist: Optional[DistService] = None,
+                 retain_service=None) -> None:
+        self.host = host
+        self.port = port
+        self.auth = auth or AllowAllAuthProvider()
+        self.settings = settings or DefaultSettingProvider()
+        self.events = events or CollectingEventCollector()
+        self.local_sessions = LocalSessionRegistry()
+        self.session_registry = SessionRegistry(self.events)
+        self.sub_brokers = SubBrokerRegistry()
+        self.sub_brokers.register(TransientSubBroker(self.local_sessions))
+        self.dist = dist or DistService(self.sub_brokers, self.events,
+                                        self.settings)
+        self.retain_service = retain_service
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_client, self.host, self.port)
+        addr = self._server.sockets[0].getsockname()
+        self.port = addr[1]
+        log.info("mqtt broker listening on %s:%s", *addr[:2])
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        # close lingering sessions: wait_closed() (py3.12+) blocks until every
+        # client handler returns, so orphaned connections must be torn down
+        for sid in list(self.local_sessions._by_id):
+            session = self.local_sessions.get(sid)
+            if session is not None:
+                session._will_suppressed = True
+                await session.close(fire_will=False)
+        if self._server is not None:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 5)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _on_client(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+        conn = Connection(self, reader, writer)
+        await conn.run()

@@ -1,0 +1,229 @@
+"""Asyncio MQTT client (3.1.1 / 5.0) — the framework's own test/load client.
+
+Plays the role Paho/HiveMQ clients play in the reference's protocol
+integration tests (bifromq-mqtt .../integration/{v3,v5}); also the load
+generator for broker benchmarks. Inbound QoS1/2 publishes are acked
+automatically and surfaced on ``messages`` (an asyncio.Queue).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from . import packets as pk
+from .codec import StreamDecoder, encode
+from .protocol import PROTOCOL_MQTT5, MalformedPacket, PropertyId
+
+
+class MQTTClientError(Exception):
+    pass
+
+
+class MQTTClient:
+    def __init__(self, host: str = "127.0.0.1", port: int = 1883, *,
+                 client_id: str = "", protocol_level: int = 4,
+                 clean_start: bool = True, keep_alive: int = 0,
+                 username: Optional[str] = None,
+                 password: Optional[bytes] = None,
+                 will: Optional[pk.Will] = None,
+                 properties: Optional[dict] = None) -> None:
+        self.host = host
+        self.port = port
+        self.client_id = client_id
+        self.protocol_level = protocol_level
+        self.clean_start = clean_start
+        self.keep_alive = keep_alive
+        self.username = username
+        self.password = password
+        self.will = will
+        self.properties = properties
+        self.messages: "asyncio.Queue[pk.Publish]" = asyncio.Queue()
+        self.connack: Optional[pk.Connack] = None
+        self.disconnect_packet: Optional[pk.Disconnect] = None
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._decoder = StreamDecoder(protocol_level=protocol_level)
+        self._read_task: Optional[asyncio.Task] = None
+        self._pending: Dict[Tuple[str, int], asyncio.Future] = {}
+        self._next_pid = 1
+        self.closed = asyncio.Event()
+
+    # ---------------- lifecycle -------------------------------------------
+
+    async def connect(self, timeout: float = 5.0) -> pk.Connack:
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port)
+        await self._send(pk.Connect(
+            client_id=self.client_id, protocol_level=self.protocol_level,
+            clean_start=self.clean_start, keep_alive=self.keep_alive,
+            username=self.username, password=self.password, will=self.will,
+            properties=self.properties))
+        fut = self._expect("connack", 0)
+        self._read_task = asyncio.get_running_loop().create_task(
+            self._read_loop())
+        self.connack = await asyncio.wait_for(fut, timeout)
+        if self.connack.reason_code != 0:
+            raise MQTTClientError(
+                f"CONNECT refused: {self.connack.reason_code}")
+        if (self.protocol_level >= PROTOCOL_MQTT5 and self.connack.properties
+                and PropertyId.ASSIGNED_CLIENT_IDENTIFIER
+                in self.connack.properties):
+            self.client_id = self.connack.properties[
+                PropertyId.ASSIGNED_CLIENT_IDENTIFIER]
+        return self.connack
+
+    async def disconnect(self, reason_code: int = 0,
+                         properties: Optional[dict] = None) -> None:
+        if self._writer is not None:
+            try:
+                await self._send(pk.Disconnect(reason_code=reason_code,
+                                               properties=properties))
+            except Exception:  # noqa: BLE001
+                pass
+        await self._teardown()
+
+    async def _teardown(self) -> None:
+        if self._read_task is not None:
+            self._read_task.cancel()
+            self._read_task = None
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._writer = None
+        self.closed.set()
+
+    # ---------------- operations ------------------------------------------
+
+    async def subscribe(self, filters: Union[str, Sequence], qos: int = 0,
+                        timeout: float = 5.0, *,
+                        no_local: bool = False,
+                        retain_as_published: bool = False,
+                        retain_handling: int = 0,
+                        properties: Optional[dict] = None) -> pk.SubAck:
+        if isinstance(filters, str):
+            subs = [pk.SubscriptionRequest(
+                filters, qos=qos, no_local=no_local,
+                retain_as_published=retain_as_published,
+                retain_handling=retain_handling)]
+        else:
+            subs = [s if isinstance(s, pk.SubscriptionRequest)
+                    else pk.SubscriptionRequest(s, qos=qos) for s in filters]
+        pid = self._alloc_pid()
+        fut = self._expect("suback", pid)
+        await self._send(pk.Subscribe(packet_id=pid, subscriptions=subs,
+                                      properties=properties))
+        return await asyncio.wait_for(fut, timeout)
+
+    async def unsubscribe(self, filters: Union[str, Sequence[str]],
+                          timeout: float = 5.0) -> pk.UnsubAck:
+        tfs = [filters] if isinstance(filters, str) else list(filters)
+        pid = self._alloc_pid()
+        fut = self._expect("unsuback", pid)
+        await self._send(pk.Unsubscribe(packet_id=pid, topic_filters=tfs))
+        return await asyncio.wait_for(fut, timeout)
+
+    async def publish(self, topic: str, payload: bytes = b"", qos: int = 0,
+                      retain: bool = False, timeout: float = 5.0,
+                      properties: Optional[dict] = None) -> Optional[int]:
+        """Returns the terminal reason code for QoS>0, None for QoS0."""
+        if qos == 0:
+            await self._send(pk.Publish(topic=topic, payload=payload, qos=0,
+                                        retain=retain,
+                                        properties=properties))
+            return None
+        pid = self._alloc_pid()
+        if qos == 1:
+            fut = self._expect("puback", pid)
+            await self._send(pk.Publish(topic=topic, payload=payload, qos=1,
+                                        retain=retain, packet_id=pid,
+                                        properties=properties))
+            ack: pk.PubAck = await asyncio.wait_for(fut, timeout)
+            return ack.reason_code
+        fut = self._expect("pubrec", pid)
+        await self._send(pk.Publish(topic=topic, payload=payload, qos=2,
+                                    retain=retain, packet_id=pid,
+                                    properties=properties))
+        rec: pk.PubRec = await asyncio.wait_for(fut, timeout)
+        fut2 = self._expect("pubcomp", pid)
+        await self._send(pk.PubRel(packet_id=pid))
+        await asyncio.wait_for(fut2, timeout)
+        return rec.reason_code
+
+    async def ping(self, timeout: float = 5.0) -> None:
+        fut = self._expect("pingresp", 0)
+        await self._send(pk.PingReq())
+        await asyncio.wait_for(fut, timeout)
+
+    async def recv(self, timeout: float = 5.0) -> pk.Publish:
+        return await asyncio.wait_for(self.messages.get(), timeout)
+
+    # ---------------- internals -------------------------------------------
+
+    async def _send(self, packet) -> None:
+        if self._writer is None:
+            raise MQTTClientError("not connected")
+        self._writer.write(encode(packet, self.protocol_level))
+        await self._writer.drain()
+
+    def _alloc_pid(self) -> int:
+        pid = self._next_pid
+        self._next_pid = pid % 65535 + 1
+        return pid
+
+    def _expect(self, kind: str, pid: int) -> asyncio.Future:
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[(kind, pid)] = fut
+        return fut
+
+    def _resolve(self, kind: str, pid: int, value) -> None:
+        fut = self._pending.pop((kind, pid), None)
+        if fut is not None and not fut.done():
+            fut.set_result(value)
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                data = await self._reader.read(65536)
+                if not data:
+                    break
+                for p in self._decoder.feed(data):
+                    await self._on_packet(p)
+        except (asyncio.CancelledError, ConnectionError, MalformedPacket):
+            pass
+        finally:
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(MQTTClientError("connection closed"))
+            self._pending.clear()
+            self.closed.set()
+
+    async def _on_packet(self, p) -> None:
+        if isinstance(p, pk.Connack):
+            self._decoder.protocol_level = self.protocol_level
+            self._resolve("connack", 0, p)
+        elif isinstance(p, pk.Publish):
+            if p.qos == 1:
+                await self._send(pk.PubAck(packet_id=p.packet_id))
+            elif p.qos == 2:
+                await self._send(pk.PubRec(packet_id=p.packet_id))
+            await self.messages.put(p)
+        elif isinstance(p, pk.PubAck):
+            self._resolve("puback", p.packet_id, p)
+        elif isinstance(p, pk.PubRec):
+            self._resolve("pubrec", p.packet_id, p)
+        elif isinstance(p, pk.PubRel):
+            await self._send(pk.PubComp(packet_id=p.packet_id))
+        elif isinstance(p, pk.PubComp):
+            self._resolve("pubcomp", p.packet_id, p)
+        elif isinstance(p, pk.SubAck):
+            self._resolve("suback", p.packet_id, p)
+        elif isinstance(p, pk.UnsubAck):
+            self._resolve("unsuback", p.packet_id, p)
+        elif isinstance(p, pk.PingResp):
+            self._resolve("pingresp", 0, p)
+        elif isinstance(p, pk.Disconnect):
+            self.disconnect_packet = p
+            await self._teardown()

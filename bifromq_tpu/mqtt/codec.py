@@ -1,0 +1,395 @@
+"""MQTT wire codec: packet dataclasses ↔ bytes, plus a streaming decoder.
+
+Replaces the reference's Netty MqttEncoder/MqttDecoder pipeline stages
+(bifromq-mqtt .../MQTTBroker.java:177-240). The streaming decoder is
+incremental: feed arbitrary byte chunks, get complete packets out — the shape
+an asyncio transport needs.
+
+Version handling: encode/decode take the negotiated ``protocol_level``
+(3/4 = MQTT 3.x, 5 = MQTT 5); CONNECT self-describes its level.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator, List, Optional, Tuple
+
+from . import packets as pk
+from .protocol import (
+    PROTOCOL_MQTT5, MalformedPacket, PacketType, ReasonCode,
+    decode_binary, decode_properties, decode_string, decode_varint,
+    encode_binary, encode_properties, encode_string, encode_varint,
+)
+
+_MAX_PACKET_ID = 65535
+
+
+def _read_u16(body: bytes, pos: int) -> int:
+    if pos + 2 > len(body):
+        raise MalformedPacket("truncated packet")
+    return struct.unpack_from(">H", body, pos)[0]
+
+
+def _fixed_header(ptype: int, flags: int, body: bytes) -> bytes:
+    return bytes([(ptype << 4) | flags]) + encode_varint(len(body)) + body
+
+
+def _packet_id_bytes(packet_id: Optional[int]) -> bytes:
+    if packet_id is None or not 1 <= packet_id <= _MAX_PACKET_ID:
+        raise MalformedPacket(f"bad packet id {packet_id}")
+    return struct.pack(">H", packet_id)
+
+
+# ------------------------------- encode ------------------------------------
+
+def encode(packet, protocol_level: int) -> bytes:
+    v5 = protocol_level >= PROTOCOL_MQTT5
+    if isinstance(packet, pk.Connect):
+        return _encode_connect(packet)
+    if isinstance(packet, pk.Connack):
+        body = bytes([1 if packet.session_present else 0, packet.reason_code])
+        if v5:
+            body += encode_properties(packet.properties)
+        return _fixed_header(PacketType.CONNACK, 0, body)
+    if isinstance(packet, pk.Publish):
+        flags = (0x08 if packet.dup else 0) | (packet.qos << 1) | (
+            0x01 if packet.retain else 0)
+        body = encode_string(packet.topic)
+        if packet.qos > 0:
+            body += _packet_id_bytes(packet.packet_id)
+        if v5:
+            body += encode_properties(packet.properties)
+        body += packet.payload
+        return _fixed_header(PacketType.PUBLISH, flags, body)
+    if isinstance(packet, (pk.PubAck, pk.PubRec, pk.PubRel, pk.PubComp)):
+        ptype = {pk.PubAck: PacketType.PUBACK, pk.PubRec: PacketType.PUBREC,
+                 pk.PubRel: PacketType.PUBREL, pk.PubComp: PacketType.PUBCOMP}[
+                     type(packet)]
+        flags = 0x02 if ptype == PacketType.PUBREL else 0
+        body = _packet_id_bytes(packet.packet_id)
+        if v5 and (packet.reason_code or packet.properties):
+            body += bytes([packet.reason_code])
+            body += encode_properties(packet.properties)
+        return _fixed_header(ptype, flags, body)
+    if isinstance(packet, pk.Subscribe):
+        body = _packet_id_bytes(packet.packet_id)
+        if v5:
+            body += encode_properties(packet.properties)
+        for s in packet.subscriptions:
+            body += encode_string(s.topic_filter)
+            opts = s.qos & 0x03
+            if v5:
+                opts |= (0x04 if s.no_local else 0)
+                opts |= (0x08 if s.retain_as_published else 0)
+                opts |= (s.retain_handling & 0x03) << 4
+            body += bytes([opts])
+        return _fixed_header(PacketType.SUBSCRIBE, 0x02, body)
+    if isinstance(packet, pk.SubAck):
+        body = _packet_id_bytes(packet.packet_id)
+        if v5:
+            body += encode_properties(packet.properties)
+        body += bytes(packet.reason_codes)
+        return _fixed_header(PacketType.SUBACK, 0, body)
+    if isinstance(packet, pk.Unsubscribe):
+        body = _packet_id_bytes(packet.packet_id)
+        if v5:
+            body += encode_properties(packet.properties)
+        for tf in packet.topic_filters:
+            body += encode_string(tf)
+        return _fixed_header(PacketType.UNSUBSCRIBE, 0x02, body)
+    if isinstance(packet, pk.UnsubAck):
+        body = _packet_id_bytes(packet.packet_id)
+        if v5:
+            body += encode_properties(packet.properties)
+            body += bytes(packet.reason_codes)
+        return _fixed_header(PacketType.UNSUBACK, 0, body)
+    if isinstance(packet, pk.PingReq):
+        return _fixed_header(PacketType.PINGREQ, 0, b"")
+    if isinstance(packet, pk.PingResp):
+        return _fixed_header(PacketType.PINGRESP, 0, b"")
+    if isinstance(packet, pk.Disconnect):
+        if v5 and (packet.reason_code or packet.properties):
+            body = bytes([packet.reason_code]) + encode_properties(
+                packet.properties)
+        else:
+            body = b""
+        return _fixed_header(PacketType.DISCONNECT, 0, body)
+    if isinstance(packet, pk.Auth):
+        body = b""
+        if packet.reason_code or packet.properties:
+            body = bytes([packet.reason_code]) + encode_properties(
+                packet.properties)
+        return _fixed_header(PacketType.AUTH, 0, body)
+    raise MalformedPacket(f"cannot encode {type(packet)}")
+
+
+def _encode_connect(c: pk.Connect) -> bytes:
+    v5 = c.protocol_level >= PROTOCOL_MQTT5
+    name = "MQIsdp" if c.protocol_level == 3 else "MQTT"
+    flags = 0
+    if c.clean_start:
+        flags |= 0x02
+    if c.will is not None:
+        flags |= 0x04 | (c.will.qos << 3) | (0x20 if c.will.retain else 0)
+    if c.password is not None:
+        flags |= 0x40
+    if c.username is not None:
+        flags |= 0x80
+    body = encode_string(name) + bytes([c.protocol_level, flags]) + struct.pack(
+        ">H", c.keep_alive)
+    if v5:
+        body += encode_properties(c.properties)
+    body += encode_string(c.client_id)
+    if c.will is not None:
+        if v5:
+            body += encode_properties(c.will.properties)
+        body += encode_string(c.will.topic)
+        body += encode_binary(c.will.payload)
+    if c.username is not None:
+        body += encode_string(c.username)
+    if c.password is not None:
+        body += encode_binary(c.password)
+    return _fixed_header(PacketType.CONNECT, 0, body)
+
+
+# ------------------------------- decode ------------------------------------
+
+def decode_packet(ptype: int, flags: int, body: bytes, protocol_level: int):
+    """Decode one complete packet body (fixed header already consumed)."""
+    v5 = protocol_level >= PROTOCOL_MQTT5
+    if ptype == PacketType.CONNECT:
+        return _decode_connect(body)
+    if ptype == PacketType.CONNACK:
+        if len(body) < 2:
+            raise MalformedPacket("short CONNACK")
+        session_present = bool(body[0] & 0x01)
+        rc = body[1]
+        props = None
+        if v5 and len(body) > 2:
+            props, _ = decode_properties(body, 2)
+        return pk.Connack(session_present=session_present, reason_code=rc,
+                          properties=props)
+    if ptype == PacketType.PUBLISH:
+        qos = (flags >> 1) & 0x03
+        if qos == 3:
+            raise MalformedPacket("invalid QoS 3")
+        topic, pos = decode_string(body, 0)
+        packet_id = None
+        if qos > 0:
+            packet_id = _read_u16(body, pos)
+            pos += 2
+            if packet_id == 0:
+                raise MalformedPacket("packet id 0")
+        props = None
+        if v5:
+            props, pos = decode_properties(body, pos)
+        return pk.Publish(topic=topic, payload=body[pos:], qos=qos,
+                          retain=bool(flags & 0x01), dup=bool(flags & 0x08),
+                          packet_id=packet_id, properties=props)
+    if ptype in (PacketType.PUBACK, PacketType.PUBREC, PacketType.PUBREL,
+                 PacketType.PUBCOMP):
+        if ptype == PacketType.PUBREL and flags != 0x02:
+            raise MalformedPacket("bad PUBREL flags")
+        packet_id = _read_u16(body, 0)
+        rc = 0
+        props = None
+        if v5 and len(body) > 2:
+            rc = body[2]
+            if len(body) > 3:
+                props, _ = decode_properties(body, 3)
+        cls = {PacketType.PUBACK: pk.PubAck, PacketType.PUBREC: pk.PubRec,
+               PacketType.PUBREL: pk.PubRel, PacketType.PUBCOMP: pk.PubComp}[
+                   PacketType(ptype)]
+        return cls(packet_id=packet_id, reason_code=rc, properties=props)
+    if ptype == PacketType.SUBSCRIBE:
+        if flags != 0x02:
+            raise MalformedPacket("bad SUBSCRIBE flags")
+        packet_id = _read_u16(body, 0)
+        pos = 2
+        props = None
+        if v5:
+            props, pos = decode_properties(body, pos)
+        subs: List[pk.SubscriptionRequest] = []
+        while pos < len(body):
+            tf, pos = decode_string(body, pos)
+            if pos >= len(body):
+                raise MalformedPacket("missing sub options")
+            opts = body[pos]
+            pos += 1
+            qos = opts & 0x03
+            if qos == 3:
+                raise MalformedPacket("invalid sub QoS")
+            if not v5 and opts & 0xFC:
+                raise MalformedPacket("reserved sub option bits set")
+            subs.append(pk.SubscriptionRequest(
+                topic_filter=tf, qos=qos,
+                no_local=bool(opts & 0x04),
+                retain_as_published=bool(opts & 0x08),
+                retain_handling=(opts >> 4) & 0x03))
+        if not subs:
+            raise MalformedPacket("empty SUBSCRIBE",
+                                  ReasonCode.PROTOCOL_ERROR)
+        return pk.Subscribe(packet_id=packet_id, subscriptions=subs,
+                            properties=props)
+    if ptype == PacketType.SUBACK:
+        packet_id = _read_u16(body, 0)
+        pos = 2
+        props = None
+        if v5:
+            props, pos = decode_properties(body, pos)
+        return pk.SubAck(packet_id=packet_id, reason_codes=list(body[pos:]),
+                         properties=props)
+    if ptype == PacketType.UNSUBSCRIBE:
+        if flags != 0x02:
+            raise MalformedPacket("bad UNSUBSCRIBE flags")
+        packet_id = _read_u16(body, 0)
+        pos = 2
+        props = None
+        if v5:
+            props, pos = decode_properties(body, pos)
+        tfs: List[str] = []
+        while pos < len(body):
+            tf, pos = decode_string(body, pos)
+            tfs.append(tf)
+        if not tfs:
+            raise MalformedPacket("empty UNSUBSCRIBE",
+                                  ReasonCode.PROTOCOL_ERROR)
+        return pk.Unsubscribe(packet_id=packet_id, topic_filters=tfs,
+                              properties=props)
+    if ptype == PacketType.UNSUBACK:
+        packet_id = _read_u16(body, 0)
+        pos = 2
+        props = None
+        rcs: List[int] = []
+        if v5:
+            props, pos = decode_properties(body, pos)
+            rcs = list(body[pos:])
+        return pk.UnsubAck(packet_id=packet_id, reason_codes=rcs,
+                           properties=props)
+    if ptype == PacketType.PINGREQ:
+        return pk.PingReq()
+    if ptype == PacketType.PINGRESP:
+        return pk.PingResp()
+    if ptype == PacketType.DISCONNECT:
+        rc = 0
+        props = None
+        if v5 and body:
+            rc = body[0]
+            if len(body) > 1:
+                props, _ = decode_properties(body, 1)
+        return pk.Disconnect(reason_code=rc, properties=props)
+    if ptype == PacketType.AUTH:
+        if not v5:
+            raise MalformedPacket("AUTH requires MQTT 5")
+        rc = 0
+        props = None
+        if body:
+            rc = body[0]
+            if len(body) > 1:
+                props, _ = decode_properties(body, 1)
+        return pk.Auth(reason_code=rc, properties=props)
+    raise MalformedPacket(f"unknown packet type {ptype}")
+
+
+def _decode_connect(body: bytes) -> pk.Connect:
+    name, pos = decode_string(body, 0)
+    if pos + 2 > len(body):
+        raise MalformedPacket("short CONNECT")
+    level = body[pos]
+    pos += 1
+    if (name, level) not in (("MQIsdp", 3), ("MQTT", 4), ("MQTT", 5)):
+        raise MalformedPacket(f"unsupported protocol {name!r} v{level}",
+                              ReasonCode.UNSUPPORTED_PROTOCOL_VERSION)
+    flags = body[pos]
+    pos += 1
+    if flags & 0x01:
+        raise MalformedPacket("reserved connect flag set")
+    clean_start = bool(flags & 0x02)
+    has_will = bool(flags & 0x04)
+    will_qos = (flags >> 3) & 0x03
+    will_retain = bool(flags & 0x20)
+    has_password = bool(flags & 0x40)
+    has_username = bool(flags & 0x80)
+    if not has_will and (will_qos or will_retain):
+        raise MalformedPacket("will flags without will")
+    if will_qos == 3:
+        raise MalformedPacket("invalid will QoS")
+    keep_alive = _read_u16(body, pos)
+    pos += 2
+    props = None
+    if level >= PROTOCOL_MQTT5:
+        props, pos = decode_properties(body, pos)
+    client_id, pos = decode_string(body, pos)
+    will = None
+    if has_will:
+        will_props = None
+        if level >= PROTOCOL_MQTT5:
+            will_props, pos = decode_properties(body, pos)
+        wt, pos = decode_string(body, pos)
+        wp, pos = decode_binary(body, pos)
+        will = pk.Will(topic=wt, payload=wp, qos=will_qos, retain=will_retain,
+                       properties=will_props)
+    username = None
+    if has_username:
+        username, pos = decode_string(body, pos)
+    password = None
+    if has_password:
+        password, pos = decode_binary(body, pos)
+    return pk.Connect(client_id=client_id, protocol_level=level,
+                      protocol_name=name, clean_start=clean_start,
+                      keep_alive=keep_alive, username=username,
+                      password=password, will=will, properties=props)
+
+
+class StreamDecoder:
+    """Incremental decoder: feed() bytes, iterate complete packets.
+
+    ``protocol_level`` starts at 4 and should be updated by the session once
+    CONNECT negotiates the version (the decoder peeks CONNECT's own level
+    automatically). ``max_packet_size`` guards memory (ConditionalRejectHandler
+    analog in the reference pipeline).
+    """
+
+    def __init__(self, protocol_level: int = 4,
+                 max_packet_size: int = 1 << 20) -> None:
+        self.protocol_level = protocol_level
+        self.max_packet_size = max_packet_size
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List:
+        self._buf += data
+        out = []
+        while True:
+            pkt, consumed = self._try_decode()
+            if pkt is None:
+                break
+            del self._buf[:consumed]
+            out.append(pkt)
+        return out
+
+    def _try_decode(self) -> Tuple[Optional[object], int]:
+        buf = self._buf
+        if len(buf) < 2:
+            return None, 0
+        ptype = buf[0] >> 4
+        flags = buf[0] & 0x0F
+        # remaining length varint
+        try:
+            length, pos = decode_varint(bytes(buf[:5]), 1)
+        except MalformedPacket:
+            if len(buf) >= 5:
+                raise
+            return None, 0
+        if length > self.max_packet_size:
+            raise MalformedPacket("packet too large",
+                                  ReasonCode.PACKET_TOO_LARGE)
+        if len(buf) < pos + length:
+            return None, 0
+        body = bytes(buf[pos:pos + length])
+        level = self.protocol_level
+        if ptype == PacketType.CONNECT:
+            pkt = _decode_connect(body)
+            self.protocol_level = pkt.protocol_level
+        else:
+            pkt = decode_packet(ptype, flags, body, level)
+        return pkt, pos + length

@@ -1,0 +1,147 @@
+"""MQTT control-packet dataclasses (3.1 / 3.1.1 / 5.0).
+
+One dataclass per control packet; version differences are carried in optional
+fields (``properties`` / ``reason_code`` are None for MQTT 3). Mirrors the
+shape of io.netty.handler.codec.mqtt message classes the reference consumes
+in its handlers (bifromq-mqtt .../handler/MQTTConnectHandler.java etc.).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from .protocol import Properties
+
+
+@dataclass
+class Will:
+    topic: str
+    payload: bytes
+    qos: int = 0
+    retain: bool = False
+    properties: Optional[Properties] = None
+
+
+@dataclass
+class Connect:
+    client_id: str
+    protocol_level: int          # 3, 4 (=3.1.1), 5
+    protocol_name: str = "MQTT"
+    clean_start: bool = True
+    keep_alive: int = 0
+    username: Optional[str] = None
+    password: Optional[bytes] = None
+    will: Optional[Will] = None
+    properties: Optional[Properties] = None
+
+
+@dataclass
+class Connack:
+    session_present: bool = False
+    # MQTT3 return code or MQTT5 reason code, per protocol_level
+    reason_code: int = 0
+    properties: Optional[Properties] = None
+
+
+@dataclass
+class Publish:
+    topic: str
+    payload: bytes = b""
+    qos: int = 0
+    retain: bool = False
+    dup: bool = False
+    packet_id: Optional[int] = None   # required for qos > 0
+    properties: Optional[Properties] = None
+
+
+@dataclass
+class PubAck:
+    packet_id: int
+    reason_code: int = 0
+    properties: Optional[Properties] = None
+
+
+@dataclass
+class PubRec:
+    packet_id: int
+    reason_code: int = 0
+    properties: Optional[Properties] = None
+
+
+@dataclass
+class PubRel:
+    packet_id: int
+    reason_code: int = 0
+    properties: Optional[Properties] = None
+
+
+@dataclass
+class PubComp:
+    packet_id: int
+    reason_code: int = 0
+    properties: Optional[Properties] = None
+
+
+@dataclass
+class SubscriptionRequest:
+    topic_filter: str
+    qos: int = 0
+    no_local: bool = False           # MQTT5
+    retain_as_published: bool = False  # MQTT5
+    retain_handling: int = 0         # MQTT5
+
+
+@dataclass
+class Subscribe:
+    packet_id: int
+    subscriptions: List[SubscriptionRequest] = field(default_factory=list)
+    properties: Optional[Properties] = None
+
+
+@dataclass
+class SubAck:
+    packet_id: int
+    reason_codes: List[int] = field(default_factory=list)
+    properties: Optional[Properties] = None
+
+
+@dataclass
+class Unsubscribe:
+    packet_id: int
+    topic_filters: List[str] = field(default_factory=list)
+    properties: Optional[Properties] = None
+
+
+@dataclass
+class UnsubAck:
+    packet_id: int
+    reason_codes: List[int] = field(default_factory=list)  # MQTT5 only
+    properties: Optional[Properties] = None
+
+
+@dataclass
+class PingReq:
+    pass
+
+
+@dataclass
+class PingResp:
+    pass
+
+
+@dataclass
+class Disconnect:
+    reason_code: int = 0              # MQTT5
+    properties: Optional[Properties] = None
+
+
+@dataclass
+class Auth:
+    reason_code: int = 0              # MQTT5 only
+    properties: Optional[Properties] = None
+
+
+Packet = (Connect, Connack, Publish, PubAck, PubRec, PubRel, PubComp,
+          Subscribe, SubAck, Unsubscribe, UnsubAck, PingReq, PingResp,
+          Disconnect, Auth)

@@ -1,0 +1,304 @@
+"""MQTT wire-protocol constants and property codec (3.1, 3.1.1, 5.0).
+
+Counterpart of the Netty MQTT codec the reference uses
+(io.netty.handler.codec.mqtt, wired in bifromq-mqtt .../MQTTBroker.java:177
+pipeline) — here a dependency-free binary codec shared by server and client.
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+from typing import Dict, List, Optional, Tuple, Union
+
+
+class PacketType(enum.IntEnum):
+    CONNECT = 1
+    CONNACK = 2
+    PUBLISH = 3
+    PUBACK = 4
+    PUBREC = 5
+    PUBREL = 6
+    PUBCOMP = 7
+    SUBSCRIBE = 8
+    SUBACK = 9
+    UNSUBSCRIBE = 10
+    UNSUBACK = 11
+    PINGREQ = 12
+    PINGRESP = 13
+    DISCONNECT = 14
+    AUTH = 15  # MQTT 5 only
+
+
+# protocol levels from CONNECT variable header
+PROTOCOL_MQTT31 = 3
+PROTOCOL_MQTT311 = 4
+PROTOCOL_MQTT5 = 5
+
+
+class ReasonCode(enum.IntEnum):
+    """MQTT 5 reason codes (subset used by the broker)."""
+    SUCCESS = 0x00
+    GRANTED_QOS1 = 0x01
+    GRANTED_QOS2 = 0x02
+    DISCONNECT_WITH_WILL = 0x04
+    NO_MATCHING_SUBSCRIBERS = 0x10
+    NO_SUBSCRIPTION_EXISTED = 0x11
+    CONTINUE_AUTHENTICATION = 0x18
+    REAUTHENTICATE = 0x19
+    UNSPECIFIED_ERROR = 0x80
+    MALFORMED_PACKET = 0x81
+    PROTOCOL_ERROR = 0x82
+    IMPLEMENTATION_SPECIFIC_ERROR = 0x83
+    UNSUPPORTED_PROTOCOL_VERSION = 0x84
+    CLIENT_IDENTIFIER_NOT_VALID = 0x85
+    BAD_USER_NAME_OR_PASSWORD = 0x86
+    NOT_AUTHORIZED = 0x87
+    SERVER_UNAVAILABLE = 0x88
+    SERVER_BUSY = 0x89
+    BANNED = 0x8A
+    SERVER_SHUTTING_DOWN = 0x8B
+    BAD_AUTHENTICATION_METHOD = 0x8C
+    KEEP_ALIVE_TIMEOUT = 0x8D
+    SESSION_TAKEN_OVER = 0x8E
+    TOPIC_FILTER_INVALID = 0x8F
+    TOPIC_NAME_INVALID = 0x90
+    PACKET_IDENTIFIER_IN_USE = 0x91
+    PACKET_IDENTIFIER_NOT_FOUND = 0x92
+    RECEIVE_MAXIMUM_EXCEEDED = 0x93
+    TOPIC_ALIAS_INVALID = 0x94
+    PACKET_TOO_LARGE = 0x95
+    MESSAGE_RATE_TOO_HIGH = 0x96
+    QUOTA_EXCEEDED = 0x97
+    ADMINISTRATIVE_ACTION = 0x98
+    PAYLOAD_FORMAT_INVALID = 0x99
+    RETAIN_NOT_SUPPORTED = 0x9A
+    QOS_NOT_SUPPORTED = 0x9B
+    USE_ANOTHER_SERVER = 0x9C
+    SERVER_MOVED = 0x9D
+    SHARED_SUBSCRIPTIONS_NOT_SUPPORTED = 0x9E
+    CONNECTION_RATE_EXCEEDED = 0x9F
+    MAXIMUM_CONNECT_TIME = 0xA0
+    SUBSCRIPTION_IDENTIFIERS_NOT_SUPPORTED = 0xA1
+    WILDCARD_SUBSCRIPTIONS_NOT_SUPPORTED = 0xA2
+
+
+# MQTT 3 CONNACK return codes
+CONNACK_ACCEPTED = 0
+CONNACK_REFUSED_PROTOCOL_VERSION = 1
+CONNACK_REFUSED_IDENTIFIER_REJECTED = 2
+CONNACK_REFUSED_SERVER_UNAVAILABLE = 3
+CONNACK_REFUSED_BAD_USER_PASSWORD = 4
+CONNACK_REFUSED_NOT_AUTHORIZED = 5
+
+
+class PropertyId(enum.IntEnum):
+    PAYLOAD_FORMAT_INDICATOR = 0x01
+    MESSAGE_EXPIRY_INTERVAL = 0x02
+    CONTENT_TYPE = 0x03
+    RESPONSE_TOPIC = 0x08
+    CORRELATION_DATA = 0x09
+    SUBSCRIPTION_IDENTIFIER = 0x0B
+    SESSION_EXPIRY_INTERVAL = 0x11
+    ASSIGNED_CLIENT_IDENTIFIER = 0x12
+    SERVER_KEEP_ALIVE = 0x13
+    AUTHENTICATION_METHOD = 0x15
+    AUTHENTICATION_DATA = 0x16
+    REQUEST_PROBLEM_INFORMATION = 0x17
+    WILL_DELAY_INTERVAL = 0x18
+    REQUEST_RESPONSE_INFORMATION = 0x19
+    RESPONSE_INFORMATION = 0x1A
+    SERVER_REFERENCE = 0x1C
+    REASON_STRING = 0x1F
+    RECEIVE_MAXIMUM = 0x21
+    TOPIC_ALIAS_MAXIMUM = 0x22
+    TOPIC_ALIAS = 0x23
+    MAXIMUM_QOS = 0x24
+    RETAIN_AVAILABLE = 0x25
+    USER_PROPERTY = 0x26
+    MAXIMUM_PACKET_SIZE = 0x27
+    WILDCARD_SUBSCRIPTION_AVAILABLE = 0x28
+    SUBSCRIPTION_IDENTIFIER_AVAILABLE = 0x29
+    SHARED_SUBSCRIPTION_AVAILABLE = 0x2A
+
+
+# property id -> wire type
+_P_BYTE, _P_U16, _P_U32, _P_VARINT, _P_BIN, _P_STR, _P_PAIR = range(7)
+_PROP_TYPES: Dict[int, int] = {
+    PropertyId.PAYLOAD_FORMAT_INDICATOR: _P_BYTE,
+    PropertyId.MESSAGE_EXPIRY_INTERVAL: _P_U32,
+    PropertyId.CONTENT_TYPE: _P_STR,
+    PropertyId.RESPONSE_TOPIC: _P_STR,
+    PropertyId.CORRELATION_DATA: _P_BIN,
+    PropertyId.SUBSCRIPTION_IDENTIFIER: _P_VARINT,
+    PropertyId.SESSION_EXPIRY_INTERVAL: _P_U32,
+    PropertyId.ASSIGNED_CLIENT_IDENTIFIER: _P_STR,
+    PropertyId.SERVER_KEEP_ALIVE: _P_U16,
+    PropertyId.AUTHENTICATION_METHOD: _P_STR,
+    PropertyId.AUTHENTICATION_DATA: _P_BIN,
+    PropertyId.REQUEST_PROBLEM_INFORMATION: _P_BYTE,
+    PropertyId.WILL_DELAY_INTERVAL: _P_U32,
+    PropertyId.REQUEST_RESPONSE_INFORMATION: _P_BYTE,
+    PropertyId.RESPONSE_INFORMATION: _P_STR,
+    PropertyId.SERVER_REFERENCE: _P_STR,
+    PropertyId.REASON_STRING: _P_STR,
+    PropertyId.RECEIVE_MAXIMUM: _P_U16,
+    PropertyId.TOPIC_ALIAS_MAXIMUM: _P_U16,
+    PropertyId.TOPIC_ALIAS: _P_U16,
+    PropertyId.MAXIMUM_QOS: _P_BYTE,
+    PropertyId.RETAIN_AVAILABLE: _P_BYTE,
+    PropertyId.USER_PROPERTY: _P_PAIR,
+    PropertyId.MAXIMUM_PACKET_SIZE: _P_U32,
+    PropertyId.WILDCARD_SUBSCRIPTION_AVAILABLE: _P_BYTE,
+    PropertyId.SUBSCRIPTION_IDENTIFIER_AVAILABLE: _P_BYTE,
+    PropertyId.SHARED_SUBSCRIPTION_AVAILABLE: _P_BYTE,
+}
+
+# Properties stored as {PropertyId: value}; USER_PROPERTY and
+# SUBSCRIPTION_IDENTIFIER may repeat -> stored as list.
+Properties = Dict[int, Union[int, str, bytes, List]]
+_REPEATABLE = {PropertyId.USER_PROPERTY, PropertyId.SUBSCRIPTION_IDENTIFIER}
+
+
+class MalformedPacket(Exception):
+    def __init__(self, msg: str, reason: ReasonCode = ReasonCode.MALFORMED_PACKET):
+        super().__init__(msg)
+        self.reason = reason
+
+
+# ---------------------------- primitives -----------------------------------
+
+def encode_varint(value: int) -> bytes:
+    if value < 0 or value > 268_435_455:
+        raise MalformedPacket(f"varint out of range: {value}")
+    out = bytearray()
+    while True:
+        b = value % 128
+        value //= 128
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def decode_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    """Returns (value, new_pos); raises on >4 bytes or truncation."""
+    mult, value = 1, 0
+    for i in range(4):
+        if pos >= len(buf):
+            raise MalformedPacket("truncated varint")
+        b = buf[pos]
+        pos += 1
+        value += (b & 0x7F) * mult
+        if not b & 0x80:
+            return value, pos
+        mult *= 128
+    raise MalformedPacket("varint too long")
+
+
+def encode_string(s: str) -> bytes:
+    raw = s.encode("utf-8")
+    if len(raw) > 65535:
+        raise MalformedPacket("string too long")
+    return struct.pack(">H", len(raw)) + raw
+
+
+def decode_string(buf: bytes, pos: int) -> Tuple[str, int]:
+    raw, pos = decode_binary(buf, pos)
+    try:
+        s = raw.decode("utf-8")
+    except UnicodeDecodeError as e:
+        raise MalformedPacket("invalid utf-8") from e
+    if "\u0000" in s:
+        raise MalformedPacket("NUL in utf-8 string")
+    return s, pos
+
+
+def encode_binary(b: bytes) -> bytes:
+    if len(b) > 65535:
+        raise MalformedPacket("binary too long")
+    return struct.pack(">H", len(b)) + b
+
+
+def decode_binary(buf: bytes, pos: int) -> Tuple[bytes, int]:
+    if pos + 2 > len(buf):
+        raise MalformedPacket("truncated length")
+    n = struct.unpack_from(">H", buf, pos)[0]
+    pos += 2
+    if pos + n > len(buf):
+        raise MalformedPacket("truncated field")
+    return buf[pos:pos + n], pos + n
+
+
+# ---------------------------- properties -----------------------------------
+
+def encode_properties(props: Optional[Properties]) -> bytes:
+    if not props:
+        return encode_varint(0)
+    body = bytearray()
+    for pid, value in props.items():
+        ptype = _PROP_TYPES.get(pid)
+        if ptype is None:
+            raise MalformedPacket(f"unknown property {pid}")
+        values = value if pid in _REPEATABLE and isinstance(value, list) else [value]
+        for v in values:
+            body += encode_varint(pid)
+            if ptype == _P_BYTE:
+                body.append(v & 0xFF)
+            elif ptype == _P_U16:
+                body += struct.pack(">H", v)
+            elif ptype == _P_U32:
+                body += struct.pack(">I", v)
+            elif ptype == _P_VARINT:
+                body += encode_varint(v)
+            elif ptype == _P_BIN:
+                body += encode_binary(v)
+            elif ptype == _P_STR:
+                body += encode_string(v)
+            elif ptype == _P_PAIR:
+                body += encode_string(v[0]) + encode_string(v[1])
+    return encode_varint(len(body)) + bytes(body)
+
+
+def decode_properties(buf: bytes, pos: int) -> Tuple[Properties, int]:
+    length, pos = decode_varint(buf, pos)
+    end = pos + length
+    if end > len(buf):
+        raise MalformedPacket("truncated properties")
+    props: Properties = {}
+    while pos < end:
+        pid, pos = decode_varint(buf, pos)
+        ptype = _PROP_TYPES.get(pid)
+        if ptype is None:
+            raise MalformedPacket(f"unknown property id {pid}")
+        if ptype == _P_BYTE:
+            if pos >= end:
+                raise MalformedPacket("truncated property")
+            v, pos = buf[pos], pos + 1
+        elif ptype == _P_U16:
+            if pos + 2 > end:
+                raise MalformedPacket("truncated property")
+            v, pos = struct.unpack_from(">H", buf, pos)[0], pos + 2
+        elif ptype == _P_U32:
+            if pos + 4 > end:
+                raise MalformedPacket("truncated property")
+            v, pos = struct.unpack_from(">I", buf, pos)[0], pos + 4
+        elif ptype == _P_VARINT:
+            v, pos = decode_varint(buf, pos)
+        elif ptype == _P_BIN:
+            v, pos = decode_binary(buf, pos)
+        elif ptype == _P_STR:
+            v, pos = decode_string(buf, pos)
+        else:  # _P_PAIR
+            k, pos = decode_string(buf, pos)
+            val, pos = decode_string(buf, pos)
+            v = (k, val)
+        if pid in _REPEATABLE:
+            props.setdefault(pid, []).append(v)
+        else:
+            if pid in props:
+                raise MalformedPacket(f"duplicate property {pid}",
+                                      ReasonCode.PROTOCOL_ERROR)
+            props[pid] = v
+    return props, pos

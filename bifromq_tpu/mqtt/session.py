@@ -1,0 +1,552 @@
+"""MQTT session logic: transient sessions, registries, local delivery.
+
+Re-expression of the reference session stack (bifromq-mqtt
+.../handler/MQTTSessionHandler.java 1868 LoC + MQTTTransientSessionHandler,
+protocol variance from IMQTTProtocolHelper v3/v5): one asyncio ``Session``
+class parameterized by protocol level, since the version differences —
+reason codes, properties, topic aliases — live in the codec layer here.
+
+Delivery path: the dist plane fans out to ``TransientSubBroker`` (sub-broker
+id 0, ≈ mqtt-broker-client + LocalDistService.dist:97) which resolves
+receiver ids in the ``LocalSessionRegistry`` and pushes into sessions.
+SessionRegistry kicks the previous owner on re-register
+(≈ session-dict SessionRegistry.java:72-86).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..dist.service import DistService
+from ..plugin.auth import IAuthProvider, MQTTAction
+from ..plugin.events import Event, EventType, IEventCollector
+from ..plugin.settings import Setting, TenantSettings
+from ..plugin.subbroker import (DeliveryPack, DeliveryResult, ISubBroker,
+                                TRANSIENT_SUB_BROKER_ID)
+from ..types import ClientInfo, MatchInfo, Message, QoS, RouteMatcher
+from ..utils import topic as topic_util
+from ..utils.hlc import HLC
+from . import packets as pk
+from .protocol import (PROTOCOL_MQTT5, PropertyId, ReasonCode,
+                       CONNACK_ACCEPTED)
+
+
+@dataclass
+class Subscription:
+    matcher: RouteMatcher
+    qos: int
+    no_local: bool = False
+    retain_as_published: bool = False
+    retain_handling: int = 0
+    sub_id: Optional[int] = None
+
+
+class LocalSessionRegistry:
+    """receiver_id (session id) → live session (≈ LocalSessionRegistry)."""
+
+    def __init__(self) -> None:
+        self._by_id: Dict[str, "Session"] = {}
+
+    def register(self, session: "Session") -> None:
+        self._by_id[session.session_id] = session
+
+    def unregister(self, session: "Session") -> None:
+        self._by_id.pop(session.session_id, None)
+
+    def get(self, session_id: str) -> Optional["Session"]:
+        return self._by_id.get(session_id)
+
+    def __len__(self) -> int:
+        return len(self._by_id)
+
+
+class SessionRegistry:
+    """(tenant, client_id) → session, kicking the previous owner on conflict
+    (≈ session-dict server SessionRegistry.java:72-86)."""
+
+    def __init__(self, events: IEventCollector) -> None:
+        self._owners: Dict[Tuple[str, str], "Session"] = {}
+        self._events = events
+
+    async def register(self, session: "Session") -> None:
+        key = (session.client_info.tenant_id, session.client_id)
+        prev = self._owners.get(key)
+        self._owners[key] = session
+        if prev is not None and prev is not session:
+            self._events.report(Event(
+                EventType.SESSION_KICKED, session.client_info.tenant_id,
+                {"client_id": session.client_id}))
+            await prev.kick()
+
+    def unregister(self, session: "Session") -> None:
+        key = (session.client_info.tenant_id, session.client_id)
+        if self._owners.get(key) is session:
+            del self._owners[key]
+
+    def get(self, tenant_id: str, client_id: str) -> Optional["Session"]:
+        return self._owners.get((tenant_id, client_id))
+
+
+class TransientSubBroker(ISubBroker):
+    """Sub-broker id 0: delivery into local transient sessions."""
+
+    id = TRANSIENT_SUB_BROKER_ID
+
+    def __init__(self, registry: LocalSessionRegistry) -> None:
+        self.registry = registry
+
+    async def deliver(self, tenant_id: str, deliverer_key: str,
+                      packs: Sequence[DeliveryPack]
+                      ) -> Dict[MatchInfo, DeliveryResult]:
+        out: Dict[MatchInfo, DeliveryResult] = {}
+        for pack in packs:
+            for mi in pack.match_infos:
+                session = self.registry.get(mi.receiver_id)
+                if session is None or session.closed:
+                    out[mi] = DeliveryResult.NO_RECEIVER
+                    continue
+                ok = await session.deliver(pack.message_pack, mi)
+                out[mi] = DeliveryResult.OK if ok else DeliveryResult.NO_SUB
+        return out
+
+    async def check_subscriptions(self, tenant_id: str,
+                                  match_infos: Sequence[MatchInfo]
+                                  ) -> List[bool]:
+        out = []
+        for mi in match_infos:
+            s = self.registry.get(mi.receiver_id)
+            out.append(bool(
+                s is not None and not s.closed
+                and mi.matcher.mqtt_topic_filter in s.subscriptions))
+        return out
+
+
+class _PacketIdAllocator:
+    def __init__(self) -> None:
+        self._next = 1
+        self._in_use: Set[int] = set()
+
+    def alloc(self) -> Optional[int]:
+        for _ in range(65535):
+            pid = self._next
+            self._next = pid % 65535 + 1
+            if pid not in self._in_use:
+                self._in_use.add(pid)
+                return pid
+        return None
+
+    def release(self, pid: int) -> None:
+        self._in_use.discard(pid)
+
+
+@dataclass
+class _OutboundQoS:
+    packet_id: int
+    publish: pk.Publish
+    phase: int  # 1 = awaiting PUBACK/PUBREC, 2 = awaiting PUBCOMP
+
+
+class Session:
+    """One connected MQTT session (transient)."""
+
+    def __init__(self, *, conn, client_id: str, client_info: ClientInfo,
+                 protocol_level: int, clean_start: bool, keep_alive: int,
+                 will: Optional[pk.Will], settings: TenantSettings,
+                 dist: DistService, auth: IAuthProvider,
+                 events: IEventCollector,
+                 local_registry: LocalSessionRegistry,
+                 session_registry: SessionRegistry,
+                 connect_props: Optional[dict] = None,
+                 retain_service=None) -> None:
+        self.conn = conn
+        self.client_id = client_id
+        self.client_info = client_info
+        self.protocol_level = protocol_level
+        self.clean_start = clean_start
+        self.keep_alive = keep_alive
+        self.will = will
+        self.settings = settings
+        self.dist = dist
+        self.auth = auth
+        self.events = events
+        self.local_registry = local_registry
+        self.session_registry = session_registry
+        self.retain_service = retain_service
+        self.connect_props = connect_props or {}
+
+        self.session_id = uuid.uuid4().hex
+        self.subscriptions: Dict[str, Subscription] = {}
+        self.closed = False
+        self._will_suppressed = False
+        self._pid_alloc = _PacketIdAllocator()
+        self._outbound: Dict[int, _OutboundQoS] = {}
+        self._inbound_qos2: Set[int] = set()
+        self._recv_topic_alias: Dict[int, str] = {}
+        self.last_active = time.monotonic()
+        # client's receive maximum (v5) — simple in-flight cap
+        self._client_recv_max = int(
+            self.connect_props.get(PropertyId.RECEIVE_MAXIMUM, 65535)
+            if protocol_level >= PROTOCOL_MQTT5 else 65535)
+
+    # ---------------- lifecycle -------------------------------------------
+
+    async def start(self) -> None:
+        self.local_registry.register(self)
+        await self.session_registry.register(self)
+
+    async def kick(self) -> None:
+        """Another session took over this (tenant, client_id)."""
+        self._will_suppressed = True
+        if self.protocol_level >= PROTOCOL_MQTT5:
+            await self.conn.send(pk.Disconnect(
+                reason_code=ReasonCode.SESSION_TAKEN_OVER))
+        await self.close(fire_will=False)
+
+    async def close(self, fire_will: bool) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self.session_registry.unregister(self)
+        self.local_registry.unregister(self)
+        for tf, sub in list(self.subscriptions.items()):
+            self._unroute(sub)
+        self.subscriptions.clear()
+        if fire_will and self.will is not None and not self._will_suppressed:
+            await self._fire_will()
+        await self.conn.close_transport()
+        self.events.report(Event(EventType.CLIENT_DISCONNECTED,
+                                 self.client_info.tenant_id,
+                                 {"client_id": self.client_id}))
+
+    async def _fire_will(self) -> None:
+        will = self.will
+        msg = Message(message_id=0, pub_qos=QoS(will.qos),
+                      payload=will.payload, timestamp=HLC.INST.get(),
+                      is_retain=will.retain)
+        await self.dist.pub(self.client_info, will.topic, msg)
+        if will.retain and self.retain_service is not None:
+            await self.retain_service.retain(self.client_info, will.topic, msg)
+        self.events.report(Event(EventType.WILL_DISTED,
+                                 self.client_info.tenant_id,
+                                 {"topic": will.topic}))
+
+    # ---------------- inbound packet handling ------------------------------
+
+    async def handle(self, packet) -> None:
+        self.last_active = time.monotonic()
+        if isinstance(packet, pk.Publish):
+            await self._on_publish(packet)
+        elif isinstance(packet, pk.PubAck):
+            self._on_puback(packet.packet_id)
+        elif isinstance(packet, pk.PubRec):
+            await self._on_pubrec(packet.packet_id)
+        elif isinstance(packet, pk.PubRel):
+            await self._on_pubrel(packet.packet_id)
+        elif isinstance(packet, pk.PubComp):
+            self._on_pubcomp(packet.packet_id)
+        elif isinstance(packet, pk.Subscribe):
+            await self._on_subscribe(packet)
+        elif isinstance(packet, pk.Unsubscribe):
+            await self._on_unsubscribe(packet)
+        elif isinstance(packet, pk.PingReq):
+            await self.conn.send(pk.PingResp())
+        elif isinstance(packet, pk.Disconnect):
+            if (self.protocol_level >= PROTOCOL_MQTT5
+                    and packet.reason_code ==
+                    ReasonCode.DISCONNECT_WITH_WILL):
+                await self.close(fire_will=True)
+            else:
+                self._will_suppressed = True
+                await self.close(fire_will=False)
+        elif isinstance(packet, pk.Auth):
+            # re-auth flow is delegated to the auth provider in later rounds
+            await self.conn.protocol_error("unexpected AUTH")
+        else:
+            await self.conn.protocol_error(f"unexpected {type(packet).__name__}")
+
+    # -------- PUBLISH ingress (≈ MQTTSessionHandler.handleQoS{0,1,2}Pub) ---
+
+    async def _on_publish(self, p: pk.Publish) -> None:
+        topic = await self._resolve_topic_alias(p)
+        if topic is None:
+            return  # error already sent by _resolve_topic_alias
+        ts = self.settings
+        if not topic_util.is_valid_topic(
+                topic, ts[Setting.MaxTopicLevelLength],
+                ts[Setting.MaxTopicLevels], ts[Setting.MaxTopicLength]):
+            await self.conn.protocol_error(
+                "invalid topic", ReasonCode.TOPIC_NAME_INVALID)
+            return
+        if p.qos > ts[Setting.MaximumQoS]:
+            await self.conn.protocol_error(
+                "QoS not supported", ReasonCode.QOS_NOT_SUPPORTED)
+            return
+        if len(p.payload) > ts[Setting.MaxUserPayloadBytes]:
+            await self.conn.protocol_error(
+                "payload too large", ReasonCode.PACKET_TOO_LARGE)
+            return
+        allowed = await self.auth.check_permission(
+            self.client_info, MQTTAction.PUB, topic)
+        if not allowed:
+            self.events.report(Event(EventType.PUB_ACTION_DISALLOWED,
+                                     self.client_info.tenant_id,
+                                     {"topic": topic}))
+            if p.qos == 1:
+                await self.conn.send(pk.PubAck(
+                    packet_id=p.packet_id,
+                    reason_code=ReasonCode.NOT_AUTHORIZED))
+            elif p.qos == 2:
+                await self.conn.send(pk.PubRec(
+                    packet_id=p.packet_id,
+                    reason_code=ReasonCode.NOT_AUTHORIZED))
+            elif self.protocol_level >= PROTOCOL_MQTT5:
+                await self.conn.disconnect_with(ReasonCode.NOT_AUTHORIZED)
+            return
+        if p.qos == 2:
+            if p.packet_id in self._inbound_qos2:
+                # duplicate delivery of an unreleased QoS2 publish
+                await self.conn.send(pk.PubRec(packet_id=p.packet_id))
+                return
+            self._inbound_qos2.add(p.packet_id)
+
+        msg = Message(message_id=p.packet_id or 0, pub_qos=QoS(p.qos),
+                      payload=p.payload, timestamp=HLC.INST.get(),
+                      is_retain=p.retain)
+        self.events.report(Event(EventType.PUB_RECEIVED,
+                                 self.client_info.tenant_id,
+                                 {"topic": topic, "qos": p.qos}))
+        if p.retain and self.retain_service is not None:
+            if ts[Setting.RetainEnabled]:
+                await self.retain_service.retain(self.client_info, topic, msg)
+        result = await self.dist.pub(self.client_info, topic, msg)
+        if p.qos == 1:
+            rc = (ReasonCode.SUCCESS if result.fanout > 0
+                  else ReasonCode.NO_MATCHING_SUBSCRIBERS)
+            await self.conn.send(pk.PubAck(
+                packet_id=p.packet_id,
+                reason_code=(rc if self.protocol_level >= PROTOCOL_MQTT5
+                             else 0)))
+        elif p.qos == 2:
+            rc = (ReasonCode.SUCCESS if result.fanout > 0
+                  else ReasonCode.NO_MATCHING_SUBSCRIBERS)
+            await self.conn.send(pk.PubRec(
+                packet_id=p.packet_id,
+                reason_code=(rc if self.protocol_level >= PROTOCOL_MQTT5
+                             else 0)))
+
+    async def _resolve_topic_alias(self, p: pk.Publish) -> Optional[str]:
+        """MQTT5 inbound topic alias (≈ v5/ReceiverTopicAliasManager).
+
+        Returns the effective topic, or None after sending the error.
+        """
+        alias = (p.properties or {}).get(PropertyId.TOPIC_ALIAS) \
+            if self.protocol_level >= PROTOCOL_MQTT5 else None
+        if alias is None:
+            if not p.topic:
+                await self.conn.protocol_error(
+                    "empty topic", ReasonCode.TOPIC_NAME_INVALID)
+                return None
+            return p.topic
+        max_alias = self.settings[Setting.MaxTopicAlias]
+        if alias == 0 or alias > max_alias:
+            await self.conn.disconnect_with(ReasonCode.TOPIC_ALIAS_INVALID)
+            return None
+        if p.topic:
+            self._recv_topic_alias[alias] = p.topic
+            return p.topic
+        topic = self._recv_topic_alias.get(alias)
+        if topic is None:
+            await self.conn.disconnect_with(ReasonCode.PROTOCOL_ERROR)
+        return topic
+
+    async def _on_pubrel(self, packet_id: int) -> None:
+        self._inbound_qos2.discard(packet_id)
+        await self.conn.send(pk.PubComp(packet_id=packet_id))
+
+    # -------- SUBSCRIBE/UNSUBSCRIBE (≈ MQTTSessionHandler.doSubscribe) -----
+
+    async def _on_subscribe(self, s: pk.Subscribe) -> None:
+        ts = self.settings
+        v5 = self.protocol_level >= PROTOCOL_MQTT5
+        if len(s.subscriptions) > ts[Setting.MaxTopicFiltersPerSub]:
+            await self.conn.protocol_error(
+                "too many filters", ReasonCode.QUOTA_EXCEEDED)
+            return
+        sub_id = None
+        if v5 and s.properties:
+            sids = s.properties.get(PropertyId.SUBSCRIPTION_IDENTIFIER)
+            if sids:
+                if not ts[Setting.SubscriptionIdentifierEnabled]:
+                    await self.conn.protocol_error(
+                        "sub id disabled",
+                        ReasonCode.SUBSCRIPTION_IDENTIFIERS_NOT_SUPPORTED)
+                    return
+                sub_id = sids[0]
+        codes: List[int] = []
+        for req in s.subscriptions:
+            codes.append(await self._subscribe_one(req, sub_id))
+        await self.conn.send(pk.SubAck(packet_id=s.packet_id,
+                                       reason_codes=codes))
+        self.events.report(Event(EventType.SUB_ACKED,
+                                 self.client_info.tenant_id,
+                                 {"filters": [r.topic_filter
+                                              for r in s.subscriptions]}))
+
+    async def _subscribe_one(self, req: pk.SubscriptionRequest,
+                             sub_id: Optional[int]) -> int:
+        ts = self.settings
+        v5 = self.protocol_level >= PROTOCOL_MQTT5
+        tf = req.topic_filter
+        if not topic_util.is_valid_topic_filter(
+                tf, ts[Setting.MaxTopicLevelLength],
+                ts[Setting.MaxTopicLevels], ts[Setting.MaxTopicLength]):
+            return (ReasonCode.TOPIC_FILTER_INVALID if v5 else 0x80)
+        if (topic_util.is_wildcard_topic_filter(tf)
+                and not ts[Setting.WildcardSubscriptionEnabled]):
+            return (ReasonCode.WILDCARD_SUBSCRIPTIONS_NOT_SUPPORTED
+                    if v5 else 0x80)
+        if topic_util.is_shared_subscription(tf):
+            if not ts[Setting.SharedSubscriptionEnabled]:
+                return (ReasonCode.SHARED_SUBSCRIPTIONS_NOT_SUPPORTED
+                        if v5 else 0x80)
+            if v5 and req.no_local:
+                # [MQTT-3.8.3-4] shared subscription must not set no-local
+                return ReasonCode.PROTOCOL_ERROR
+        if len(self.subscriptions) >= ts[Setting.MaxTopicFiltersPerInbox] \
+                and tf not in self.subscriptions:
+            return ReasonCode.QUOTA_EXCEEDED if v5 else 0x80
+        allowed = await self.auth.check_permission(
+            self.client_info, MQTTAction.SUB, tf)
+        if not allowed:
+            self.events.report(Event(EventType.SUB_ACTION_DISALLOWED,
+                                     self.client_info.tenant_id,
+                                     {"filter": tf}))
+            return ReasonCode.NOT_AUTHORIZED if v5 else 0x80
+        granted = min(req.qos, ts[Setting.MaximumQoS])
+        matcher = RouteMatcher.from_topic_filter(tf)
+        old = self.subscriptions.get(tf)
+        sub = Subscription(matcher=matcher, qos=granted,
+                           no_local=req.no_local,
+                           retain_as_published=req.retain_as_published,
+                           retain_handling=req.retain_handling,
+                           sub_id=sub_id)
+        self.subscriptions[tf] = sub
+        self.dist.match(self.client_info.tenant_id, matcher,
+                        TRANSIENT_SUB_BROKER_ID, self.session_id,
+                        self._deliverer_key())
+        # retained delivery (≈ retainClient.match on SUBSCRIBE)
+        if (self.retain_service is not None and ts[Setting.RetainEnabled]
+                and not topic_util.is_shared_subscription(tf)
+                and (req.retain_handling == 0
+                     or (req.retain_handling == 1 and old is None))):
+            await self._deliver_retained(sub)
+        return granted
+
+    async def _deliver_retained(self, sub: Subscription) -> None:
+        limit = self.settings[Setting.RetainMessageMatchLimit]
+        matches = await self.retain_service.match(
+            self.client_info.tenant_id, list(sub.matcher.filter_levels),
+            limit)
+        for topic, msg in matches:
+            await self._send_publish(topic, msg, sub, retained=True)
+
+    async def _on_unsubscribe(self, u: pk.Unsubscribe) -> None:
+        v5 = self.protocol_level >= PROTOCOL_MQTT5
+        codes: List[int] = []
+        for tf in u.topic_filters:
+            sub = self.subscriptions.pop(tf, None)
+            if sub is None:
+                codes.append(ReasonCode.NO_SUBSCRIPTION_EXISTED if v5 else 0)
+                continue
+            self._unroute(sub)
+            codes.append(ReasonCode.SUCCESS)
+        await self.conn.send(pk.UnsubAck(packet_id=u.packet_id,
+                                         reason_codes=codes))
+        self.events.report(Event(EventType.UNSUB_ACKED,
+                                 self.client_info.tenant_id,
+                                 {"filters": u.topic_filters}))
+
+    def _unroute(self, sub: Subscription) -> None:
+        self.dist.unmatch(self.client_info.tenant_id, sub.matcher,
+                          TRANSIENT_SUB_BROKER_ID, self.session_id,
+                          self._deliverer_key())
+
+    def _deliverer_key(self) -> str:
+        # one deliverer group per session bucket (≈ DeliverersPerMqttServer)
+        return f"d{hash(self.session_id) % 16}"
+
+    # ---------------- outbound delivery ------------------------------------
+
+    async def deliver(self, pack, match_info: MatchInfo) -> bool:
+        """Called by TransientSubBroker; returns False if sub is gone."""
+        sub = self.subscriptions.get(match_info.matcher.mqtt_topic_filter)
+        if sub is None or self.closed:
+            return False
+        for pub_pack in pack.packs:
+            for msg in pub_pack.messages:
+                if sub.no_local and (pub_pack.publisher.meta().get("sessionId")
+                                     == self.session_id):
+                    continue
+                await self._send_publish(pack.topic, msg, sub)
+        return True
+
+    async def _send_publish(self, topic: str, msg: Message,
+                            sub: Subscription, retained: bool = False) -> None:
+        qos = min(int(msg.pub_qos), sub.qos)
+        retain_flag = (retained if not sub.retain_as_published
+                       else (msg.is_retain or retained))
+        props = None
+        if self.protocol_level >= PROTOCOL_MQTT5:
+            props = {}
+            if sub.sub_id is not None:
+                props[PropertyId.SUBSCRIPTION_IDENTIFIER] = [sub.sub_id]
+            if msg.user_properties:
+                props[PropertyId.USER_PROPERTY] = list(msg.user_properties)
+            if not props:
+                props = None
+        if qos == 0:
+            await self.conn.send(pk.Publish(topic=topic, payload=msg.payload,
+                                            qos=0, retain=retain_flag,
+                                            properties=props))
+            return
+        if len(self._outbound) >= self._client_recv_max:
+            # receive-maximum exhausted: transient semantics = drop QoS>0
+            dropped = (EventType.QOS1_DROPPED if qos == 1
+                       else EventType.QOS2_DROPPED)
+            self.events.report(Event(dropped, self.client_info.tenant_id,
+                                     {"topic": topic, "reason": "recv_max"}))
+            return
+        pid = self._pid_alloc.alloc()
+        if pid is None:
+            return
+        publish = pk.Publish(topic=topic, payload=msg.payload, qos=qos,
+                             retain=retain_flag, packet_id=pid,
+                             properties=props)
+        self._outbound[pid] = _OutboundQoS(packet_id=pid, publish=publish,
+                                           phase=1)
+        await self.conn.send(publish)
+        self.events.report(Event(EventType.DELIVERED,
+                                 self.client_info.tenant_id,
+                                 {"topic": topic, "qos": qos}))
+
+    def _on_puback(self, pid: int) -> None:
+        st = self._outbound.pop(pid, None)
+        if st is not None:
+            self._pid_alloc.release(pid)
+
+    async def _on_pubrec(self, pid: int) -> None:
+        st = self._outbound.get(pid)
+        if st is None or st.publish.qos != 2:
+            await self.conn.send(pk.PubRel(packet_id=pid))
+            return
+        st.phase = 2
+        await self.conn.send(pk.PubRel(packet_id=pid))
+
+    def _on_pubcomp(self, pid: int) -> None:
+        st = self._outbound.pop(pid, None)
+        if st is not None:
+            self._pid_alloc.release(pid)

@@ -176,6 +176,8 @@ class MeshMatcher:
                     *, per_device_batch: Optional[int] = None
                     ) -> List[MatchedRoutes]:
         """Match (tenant, topic_levels) pairs across the mesh."""
+        if not queries:
+            return []
         r, s = self.n_replicas, self.tables.n_shards
         # route each query to its shard, then round-robin across replicas
         slots: List[List[int]] = [[] for _ in range(r * s)]
@@ -183,7 +185,14 @@ class MeshMatcher:
             sh = self.tables.shard_of(tenant_id)
             rep = min(range(r), key=lambda j: len(slots[j * s + sh]))
             slots[rep * s + sh].append(qi)
-        b = per_device_batch or max(1, max(len(x) for x in slots))
+        if per_device_batch is None:
+            # power-of-two bucket: keep the set of compiled shapes small
+            need = max(1, max(len(x) for x in slots))
+            b = 16
+            while b < need:
+                b *= 2
+        else:
+            b = per_device_batch
         assert all(len(x) <= b for x in slots)
 
         width = self.tables.max_levels + 1

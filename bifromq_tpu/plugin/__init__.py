@@ -1,0 +1,29 @@
+"""Extension points (≈ reference bifromq-plugin, pf4j-based).
+
+The six reference extension points (SURVEY.md §2.7 bifromq-plugin) become
+plain Python interfaces with safe-call wrappers (the ``*-helper`` modules'
+metered/exception-isolated role):
+
+- IAuthProvider        (plugin-auth-provider .../IAuthProvider.java:47)
+- ISettingProvider     (plugin-setting-provider .../Setting.java:31-77)
+- IResourceThrottler   (plugin-resource-throttler)
+- IEventCollector      (plugin-event-collector, 94 event types)
+- ISubBroker           (plugin-sub-broker .../ISubBroker.java:28)
+- IClientBalancer      (server redirection)
+"""
+
+from .auth import (AuthResult, IAuthProvider, AllowAllAuthProvider,
+                   MQTTAction)
+from .events import Event, EventType, IEventCollector, CollectingEventCollector
+from .settings import ISettingProvider, Setting, DefaultSettingProvider, TenantSettings
+from .subbroker import (DeliveryPack, DeliveryResult, ISubBroker,
+                        SubBrokerRegistry)
+from .throttler import IResourceThrottler, AllowAllResourceThrottler, TenantResourceType
+
+__all__ = [
+    "AuthResult", "IAuthProvider", "AllowAllAuthProvider", "MQTTAction",
+    "Event", "EventType", "IEventCollector", "CollectingEventCollector",
+    "ISettingProvider", "Setting", "DefaultSettingProvider", "TenantSettings",
+    "DeliveryPack", "DeliveryResult", "ISubBroker", "SubBrokerRegistry",
+    "IResourceThrottler", "AllowAllResourceThrottler", "TenantResourceType",
+]

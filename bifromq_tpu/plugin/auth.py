@@ -1,0 +1,81 @@
+"""Auth extension point (≈ plugin-auth-provider IAuthProvider.java:47).
+
+The reference exposes async ``auth(MQTT3AuthData|MQTT5AuthData)`` and
+``checkPermission(ClientInfo, MQTTAction)``; here a single provider interface
+covers both protocol generations (the broker passes the negotiated level).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..types import ClientInfo
+
+
+class MQTTAction(enum.Enum):
+    PUB = "pub"
+    SUB = "sub"
+    UNSUB = "unsub"
+    CONN = "conn"
+
+
+@dataclass(frozen=True)
+class AuthData:
+    """Connection credentials presented at CONNECT."""
+    client_id: str
+    protocol_level: int
+    username: Optional[str] = None
+    password: Optional[bytes] = None
+    cert: Optional[bytes] = None
+    remote_addr: str = ""
+
+
+@dataclass(frozen=True)
+class AuthResult:
+    ok: bool
+    tenant_id: str = ""
+    user_id: str = ""
+    reason: str = ""
+    # extra attrs copied into ClientInfo metadata
+    attrs: Dict[str, str] = field(default_factory=dict)
+
+    @staticmethod
+    def success(tenant_id: str, user_id: str, **attrs: str) -> "AuthResult":
+        return AuthResult(ok=True, tenant_id=tenant_id, user_id=user_id,
+                          attrs=dict(attrs))
+
+    @staticmethod
+    def reject(reason: str) -> "AuthResult":
+        return AuthResult(ok=False, reason=reason)
+
+
+class IAuthProvider:
+    """Override ``auth`` and ``check_permission``; both may be async-free."""
+
+    async def auth(self, data: AuthData) -> AuthResult:
+        raise NotImplementedError
+
+    async def check_permission(self, client: ClientInfo, action: MQTTAction,
+                               topic: str) -> bool:
+        raise NotImplementedError
+
+
+class AllowAllAuthProvider(IAuthProvider):
+    """Default open provider: tenant = username prefix before '/', or the
+    dev tenant. Mirrors the reference's DevOnlyAuthProvider used in tests."""
+
+    def __init__(self, default_tenant: str = "DevOnly") -> None:
+        self.default_tenant = default_tenant
+
+    async def auth(self, data: AuthData) -> AuthResult:
+        tenant = self.default_tenant
+        user = data.username or data.client_id
+        if data.username and "/" in data.username:
+            tenant, user = data.username.split("/", 1)
+        return AuthResult.success(tenant, user)
+
+    async def check_permission(self, client: ClientInfo, action: MQTTAction,
+                               topic: str) -> bool:
+        return True

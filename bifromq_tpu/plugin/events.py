@@ -1,0 +1,72 @@
+"""Event collector extension point (≈ plugin-event-collector).
+
+The reference streams 94 pooled event types through IEventCollector — the
+operational firehose. Here events are lightweight dataclasses; the EventType
+enum covers the families the broker currently emits and grows with it.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+
+class EventType(enum.Enum):
+    # connect family (reference eventcollector/mqttbroker/clientconnected/...)
+    CLIENT_CONNECTED = "client_connected"
+    CONNECT_REJECTED = "connect_rejected"
+    SESSION_KICKED = "session_kicked"
+    CLIENT_DISCONNECTED = "client_disconnected"
+    # pub/deliver family
+    PUB_RECEIVED = "pub_received"
+    PUB_ACTION_DISALLOWED = "pub_action_disallowed"
+    DELIVERED = "delivered"
+    DELIVER_ERROR = "deliver_error"
+    QOS0_DROPPED = "qos0_dropped"
+    QOS1_DROPPED = "qos1_dropped"
+    QOS2_DROPPED = "qos2_dropped"
+    # sub family
+    SUB_ACKED = "sub_acked"
+    SUB_ACTION_DISALLOWED = "sub_action_disallowed"
+    UNSUB_ACKED = "unsub_acked"
+    # dist family
+    DIST_ERROR = "dist_error"
+    PERSISTENT_FANOUT_THROTTLED = "persistent_fanout_throttled"
+    GROUP_FANOUT_THROTTLED = "group_fanout_throttled"
+    # lwt / retain
+    WILL_DISTED = "will_disted"
+    RETAIN_MSG_CLEARED = "retain_msg_cleared"
+    MSG_RETAINED = "msg_retained"
+    RETAIN_ERROR = "retain_error"
+    # inbox family
+    OVERFLOWED = "overflowed"
+    MSG_FETCHED = "msg_fetched"
+
+
+@dataclass
+class Event:
+    type: EventType
+    tenant_id: str = ""
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+
+class IEventCollector:
+    def report(self, event: Event) -> None:
+        raise NotImplementedError
+
+
+class CollectingEventCollector(IEventCollector):
+    """Default: keeps a bounded in-memory tail (tests assert against it)."""
+
+    def __init__(self, capacity: int = 10_000) -> None:
+        self.events: List[Event] = []
+        self.capacity = capacity
+
+    def report(self, event: Event) -> None:
+        self.events.append(event)
+        if len(self.events) > self.capacity:
+            del self.events[:len(self.events) - self.capacity]
+
+    def of(self, etype: EventType) -> List[Event]:
+        return [e for e in self.events if e.type == etype]

@@ -1,0 +1,4 @@
+"""bifromq_tpu.scheduler — adaptive batching (analog of base-scheduler)."""
+from .batcher import BatchCallScheduler, Batcher
+
+__all__ = ["BatchCallScheduler", "Batcher"]

