@@ -9,7 +9,7 @@ match batches of topics with a fixed-shape NFA walk (ops/match.py).
 
 Table layout (all int32, device-friendly):
 
-- ``node_tab [N, 8]``: packed per-node record, one gather per active state:
+- ``node_tab [N, 12]``: packed per-node record, one gather per active state:
     col 0  plus_child   ('+' child node id, -1 if none)
     col 1  hash_child   ('#' child node id, -1 if none)
     col 2  route_start  (first matching slot attached to this node)
@@ -18,6 +18,14 @@ Table layout (all int32, device-friendly):
     col 5  child_count  (number of literal children)
     col 6  child_start  (into child_list, for '+'-expansion in retained mode)
     col 7  subtree_route_count (total matchings in subtree, for '#'-range count)
+    col 8  sys_child_count ('$'-prefixed literal children; they sort FIRST)
+    col 9  sys_slot_count  (matchings inside those children's subtrees)
+    cols 10-11 reserved
+
+  '$'-prefixed children sorting first makes both their child_list entries and
+  their subtree slots contiguous prefixes, so the retained-mode walk can
+  apply the [MQTT-4.7.2-1] rule at a tenant root by skipping a prefix —
+  no per-node flags or data-dependent branches.
 - ``edge_tab [NB, P, 4]``: two-choice bucketed hash table of literal edges,
   entries ``(node, h1, h2, child)``. Every key lives in one of its two
   candidate buckets (greedy + bounded cuckoo eviction at build time), so a
@@ -57,7 +65,9 @@ NODE_SUB_END = 4
 NODE_CCOUNT = 5
 NODE_CSTART = 6
 NODE_SUB_RCOUNT = 7
-NODE_COLS = 8
+NODE_SYS_CCOUNT = 8
+NODE_SYS_SLOTS = 9
+NODE_COLS = 12
 
 _EMPTY = -1
 
@@ -113,7 +123,7 @@ def _mix2_u32(node: np.ndarray, h1: np.ndarray, h2: np.ndarray) -> np.ndarray:
 @dataclass
 class CompiledTrie:
     """Immutable compiled automaton (host numpy; see .device() in ops.match)."""
-    node_tab: np.ndarray          # [N, 8] int32
+    node_tab: np.ndarray          # [N, NODE_COLS] int32
     edge_tab: np.ndarray          # [T, 4] int32
     child_list: np.ndarray        # [max(E,1)] int32
     matchings: List[Matching]     # slot -> matching
@@ -181,6 +191,8 @@ def _compile_once(tries: Dict[str, SubscriptionTrie], *, max_levels: int,
     child_start: List[int] = []
     child_count: List[int] = []
     sub_rcount: List[int] = []
+    sys_ccount: List[int] = []
+    sys_slots: List[int] = []
     # (nid, literal child ids); child_list CSR is emitted after the DFS so each
     # node's children stay contiguous despite pre-order subtree allocation
     pending_children: List[Tuple[int, List[int]]] = []
@@ -197,6 +209,8 @@ def _compile_once(tries: Dict[str, SubscriptionTrie], *, max_levels: int,
         child_start.append(_EMPTY)
         child_count.append(0)
         sub_rcount.append(0)
+        sys_ccount.append(0)
+        sys_slots.append(0)
         matchings.extend(ms)
         return nid
 
@@ -213,8 +227,10 @@ def _compile_once(tries: Dict[str, SubscriptionTrie], *, max_levels: int,
                 hash_node = child
             else:
                 literals.append((level, child))
-        # DFS order: literals (sorted for determinism), then '+', then '#'.
-        literals.sort(key=lambda kv: kv[0])
+        # DFS order: literals ('$'-prefixed FIRST, then sorted), '+', '#' —
+        # sys-first keeps sys children contiguous for the root-wildcard rule.
+        literals.sort(key=lambda kv: (0 if kv[0].startswith(
+            topic_util.SYS_PREFIX) else 1, kv[0]))
         seen: Dict[Tuple[int, int], str] = {}
         lit_ids: List[int] = []
         for level, child in literals:
@@ -226,7 +242,11 @@ def _compile_once(tries: Dict[str, SubscriptionTrie], *, max_levels: int,
             cid = alloc(child)
             edges.append((nid, h1, h2, cid))
             lit_ids.append(cid)
-            total += dfs(child, cid)
+            child_total = dfs(child, cid)
+            total += child_total
+            if level.startswith(topic_util.SYS_PREFIX):
+                sys_ccount[nid] += 1
+                sys_slots[nid] += child_total
         if lit_ids:
             pending_children.append((nid, lit_ids))
         child_count[nid] = len(literals)
@@ -264,6 +284,8 @@ def _compile_once(tries: Dict[str, SubscriptionTrie], *, max_levels: int,
         node_tab[:n, NODE_CCOUNT] = child_count
         node_tab[:n, NODE_CSTART] = child_start
         node_tab[:n, NODE_SUB_RCOUNT] = sub_rcount
+        node_tab[:n, NODE_SYS_CCOUNT] = sys_ccount
+        node_tab[:n, NODE_SYS_SLOTS] = sys_slots
 
     # --- pass 2: build the open-addressing edge table ----------------------
     edge_tab = _build_edge_table(edges, probe_len, min_cap=min_edge_cap)
@@ -386,3 +408,56 @@ def tokenize(topics: Sequence[Sequence[str]], roots: Sequence[int],
             tok_h2[i, j] = h2
     return TokenizedTopics(tok_h1=tok_h1, tok_h2=tok_h2, lengths=lengths,
                            roots=rootv, sys_mask=sys_mask)
+
+
+# ------------------------ filter-probe tokenization -------------------------
+# (retained-message lookup: wildcard FILTERS probe a trie of concrete topics)
+
+KIND_LIT = 0
+KIND_PLUS = 1
+KIND_HASH = 2
+
+
+@dataclass
+class TokenizedFilters:
+    """Fixed-shape filter probe batch; padding rows have length == -1."""
+    tok_h1: np.ndarray    # [B, max_levels + 1] int32
+    tok_h2: np.ndarray    # [B, max_levels + 1] int32
+    tok_kind: np.ndarray  # [B, max_levels + 1] int32 (KIND_*)
+    lengths: np.ndarray   # [B] int32
+    roots: np.ndarray     # [B] int32
+
+    @property
+    def batch(self) -> int:
+        return self.tok_h1.shape[0]
+
+
+def tokenize_filters(filters: Sequence[Sequence[str]], roots: Sequence[int],
+                     *, max_levels: int, salt: int,
+                     batch: Optional[int] = None) -> TokenizedFilters:
+    """Hash filter levels ('+'/'#' become kind codes) into a probe batch."""
+    n = len(filters)
+    b = batch or n
+    assert b >= n
+    width = max_levels + 1
+    tok_h1 = np.zeros((b, width), dtype=np.int32)
+    tok_h2 = np.zeros((b, width), dtype=np.int32)
+    tok_kind = np.zeros((b, width), dtype=np.int32)
+    lengths = np.full(b, _EMPTY, dtype=np.int32)
+    rootv = np.full(b, _EMPTY, dtype=np.int32)
+    for i, (levels, root) in enumerate(zip(filters, roots)):
+        if len(levels) > max_levels:
+            continue  # padding; caller falls back to the host matcher
+        lengths[i] = len(levels)
+        rootv[i] = root
+        for j, level in enumerate(levels):
+            if level == topic_util.SINGLE_WILDCARD:
+                tok_kind[i, j] = KIND_PLUS
+            elif level == topic_util.MULTI_WILDCARD:
+                tok_kind[i, j] = KIND_HASH
+            else:
+                h1, h2 = level_hash(level, salt)
+                tok_h1[i, j] = h1
+                tok_h2[i, j] = h2
+    return TokenizedFilters(tok_h1=tok_h1, tok_h2=tok_h2, tok_kind=tok_kind,
+                            lengths=lengths, roots=rootv)

@@ -1,0 +1,178 @@
+"""Retained-topic index: host authority + compiled trie for filter probes.
+
+Host-side counterpart of ops.retained (the reference's RetainTopicIndex,
+bifromq-retain .../store/index/RetainTopicIndex.java:35, rebuilt from KV on
+reset — here rebuilt/compiled from the authoritative per-tenant topic maps).
+The oracle-grade fallback ``match_filter_host`` mirrors RetainMatcher.java:36
+semantics plus the [MQTT-4.7.2-1] root-'$' rule.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..types import RouteMatcher, RouteMatcherType
+from ..utils import topic as topic_util
+from .automaton import (CompiledTrie, compile_tries, tokenize_filters)
+from .oracle import Route, SubscriptionTrie, _TrieNode
+
+
+def _topic_route(topic_levels: Sequence[str], topic_str: str) -> Route:
+    """A retained topic stored as a wildcard-free 'route'; receiver == topic."""
+    return Route(
+        matcher=RouteMatcher(type=RouteMatcherType.NORMAL,
+                             filter_levels=tuple(topic_levels),
+                             mqtt_topic_filter=topic_str),
+        broker_id=0, receiver_id=topic_str, deliverer_key="")
+
+
+def match_filter_host(trie: SubscriptionTrie,
+                      filter_levels: Sequence[str]) -> List[str]:
+    """Exact filter-over-topic-trie match (host fallback & test oracle)."""
+    out: List[str] = []
+
+    def collect_subtree(node: _TrieNode, skip_sys: bool) -> None:
+        for r in node.routes.values():
+            out.append(r.receiver_id)
+        for level, child in node.children.items():
+            if skip_sys and level.startswith(topic_util.SYS_PREFIX):
+                continue
+            collect_subtree(child, False)
+
+    active: List[_TrieNode] = [trie._root]
+    n = len(filter_levels)
+    for i, lvl in enumerate(filter_levels):
+        at_root = i == 0
+        if lvl == topic_util.MULTI_WILDCARD:
+            for node in active:
+                collect_subtree(node, skip_sys=at_root)
+            return out
+        nxt: List[_TrieNode] = []
+        if lvl == topic_util.SINGLE_WILDCARD:
+            for node in active:
+                for level, child in node.children.items():
+                    if at_root and level.startswith(topic_util.SYS_PREFIX):
+                        continue
+                    nxt.append(child)
+        else:
+            for node in active:
+                child = node.children.get(lvl)
+                if child is not None:
+                    nxt.append(child)
+        active = nxt
+        if not active:
+            return out
+    for node in active:
+        for r in node.routes.values():
+            out.append(r.receiver_id)
+    return out
+
+
+class RetainedIndex:
+    """Per-tenant retained-topic tries + compiled automaton for device probes.
+
+    Mirrors TpuMatcher's mutate-dirty-recompile contract; query side takes
+    wildcard FILTERS (ops.retained walk) instead of topics.
+    """
+
+    def __init__(self, *, max_levels: int = 16, k_states: int = 32,
+                 probe_len: int = 8, device=None) -> None:
+        self.max_levels = max_levels
+        self.k_states = k_states
+        self.probe_len = probe_len
+        self.device = device
+        self.tries: Dict[str, SubscriptionTrie] = {}
+        self._compiled: Optional[CompiledTrie] = None
+        self._device_trie = None
+        self._dirty = True
+
+    def add_topic(self, tenant_id: str, topic_levels: Sequence[str],
+                  topic_str: str) -> bool:
+        trie = self.tries.setdefault(tenant_id, SubscriptionTrie())
+        added = trie.add(_topic_route(topic_levels, topic_str))
+        if added:  # payload replacement leaves the index unchanged
+            self._dirty = True
+        return added
+
+    def remove_topic(self, tenant_id: str, topic_levels: Sequence[str],
+                     topic_str: str) -> bool:
+        trie = self.tries.get(tenant_id)
+        if trie is None:
+            return False
+        r = _topic_route(topic_levels, topic_str)
+        removed = trie.remove(r.matcher, r.receiver_url)
+        if removed:
+            if len(trie) == 0:
+                del self.tries[tenant_id]
+            self._dirty = True
+        return removed
+
+    def topic_count(self, tenant_id: str) -> int:
+        trie = self.tries.get(tenant_id)
+        return len(trie) if trie is not None else 0
+
+    def refresh(self) -> CompiledTrie:
+        if self._dirty or self._compiled is None:
+            self._compiled = compile_tries(self.tries,
+                                           max_levels=self.max_levels,
+                                           probe_len=self.probe_len)
+            from ..ops.match import DeviceTrie
+            self._device_trie = DeviceTrie.from_compiled(self._compiled,
+                                                         device=self.device)
+            self._dirty = False
+        return self._compiled
+
+    def match_batch(self, queries: Sequence[Tuple[str, Sequence[str]]],
+                    *, batch: Optional[int] = None,
+                    limit: Optional[int] = None) -> List[List[str]]:
+        """(tenant, filter_levels) pairs → matched retained topic strings.
+
+        ``limit`` bounds expansion per query (scan-bounded like the
+        reference's RetainMessageMatchLimit): expired entries filtered by the
+        caller may reduce the final result below the limit.
+        """
+        from ..ops.retained import FilterProbes, retained_walk
+
+        if not queries:
+            return []
+        ct = self.refresh()
+        if batch is None:
+            batch = 16
+            while batch < len(queries):
+                batch *= 2
+        roots = [ct.root_of(t) for t, _ in queries]
+        tok = tokenize_filters([f for _, f in queries], roots,
+                               max_levels=ct.max_levels, salt=ct.salt,
+                               batch=batch)
+        probes = FilterProbes.from_tokenized(tok, device=self.device)
+        ranges, overflow = retained_walk(self._device_trie, probes,
+                                         probe_len=ct.probe_len,
+                                         k_states=self.k_states)
+        ranges = np.asarray(ranges)
+        overflow = np.asarray(overflow)
+        out: List[List[str]] = []
+        for qi, (tenant_id, levels) in enumerate(queries):
+            if roots[qi] < 0:
+                out.append([])
+                continue
+            cap = limit if limit is not None else 2 ** 31 - 1
+            if overflow[qi] or tok.lengths[qi] < 0:
+                out.append(match_filter_host(self.tries[tenant_id],
+                                             list(levels))[:cap])
+                continue
+            topics: List[str] = []
+            for start, count in ranges[qi]:
+                for slot in range(start, start + max(0, count)):
+                    if len(topics) >= cap:
+                        break
+                    topics.append(ct.matchings[slot].receiver_id)
+                if len(topics) >= cap:
+                    break
+            out.append(topics)
+        return out
+
+    def match(self, tenant_id: str, filter_levels: Sequence[str],
+              limit: Optional[int] = None) -> List[str]:
+        return self.match_batch([(tenant_id, filter_levels)], limit=limit)[0]

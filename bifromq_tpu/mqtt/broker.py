@@ -274,6 +274,9 @@ class MQTTBroker:
         self.sub_brokers.register(TransientSubBroker(self.local_sessions))
         self.dist = dist or DistService(self.sub_brokers, self.events,
                                         self.settings)
+        if retain_service is None:
+            from ..retain.service import RetainService
+            retain_service = RetainService(self.events)
         self.retain_service = retain_service
         self._server: Optional[asyncio.AbstractServer] = None
 

@@ -40,7 +40,7 @@ from ..models.automaton import (
 @dataclass
 class DeviceTrie:
     """Compiled automaton tables resident on device."""
-    node_tab: jax.Array   # [N, 8] int32
+    node_tab: jax.Array   # [N, NODE_COLS] int32
     edge_tab: jax.Array   # [T, 4] int32
     child_list: jax.Array  # [E] int32
 
@@ -169,7 +169,7 @@ def walk(trie: DeviceTrie, probes: Probes, *, probe_len: int,
         valid = (act >= 0) & in_range                       # [B,K]
         # [MQTT-4.7.2-1]: block the root's wildcard children for '$'-topics
         allow_wc = jnp.logical_not(probes.sys_mask & (i == 0))[:, None]
-        node_rec = trie.node_tab[act.clip(0)]               # [B,K,8]
+        node_rec = trie.node_tab[act.clip(0)]               # [B,K,NODE_COLS]
 
         # 1. '#'-child accepts: match regardless of remaining levels
         hc = jnp.where(valid & allow_wc, node_rec[..., NODE_HASH], -1)

@@ -1,0 +1,156 @@
+"""Retained-message lookup kernel: wildcard filters probe a topic trie.
+
+The roles-swapped twin of ops.match (BASELINE.json: "retain-store's
+retained-message wildcard lookup reuses the same compiled-trie kernel"):
+the automaton stores *concrete retained topics*; probes are SUBSCRIBE
+*filters* that may contain '+'/'#'. Reference behavior:
+bifromq-retain .../store/RetainStoreCoProc.batchMatch with
+RetainTopicIndex.java:35 + RetainMatcher.java:36 semantics.
+
+Per probe level:
+- literal  → the same two-bucket edge lookup as ops.match
+- '+'      → expand to ALL literal children of every active node (a CSR
+             range read + cumsum-partitioned compaction; overflow → host)
+- '#'      → terminal: every active node's whole DFS subtree matches; with
+             pre-order numbering a subtree's matching slots are ONE
+             contiguous range, so the device emits (start, count) pairs —
+             no per-descendant work at all.
+
+[MQTT-4.7.2-1]: a root-level '+'/'#' must not reach '$'-prefixed first
+levels. The compiler sorts sys children first (automaton.py), so the walk
+just skips a prefix of the child range / slot range when i == 0.
+
+Output is slot *ranges* (not node ids): [B, K, 2] (start, count), since '#'
+can accept whole subtrees. The host expands slots → retained messages.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.automaton import (
+    KIND_HASH, KIND_LIT, KIND_PLUS, NODE_CCOUNT, NODE_CSTART, NODE_RCOUNT,
+    NODE_RSTART, NODE_SUB_RCOUNT, NODE_SYS_CCOUNT, NODE_SYS_SLOTS,
+    TokenizedFilters,
+)
+from .match import DeviceTrie, _edge_lookup
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class FilterProbes:
+    tok_h1: jax.Array
+    tok_h2: jax.Array
+    tok_kind: jax.Array
+    lengths: jax.Array
+    roots: jax.Array
+
+    def tree_flatten(self):
+        return (self.tok_h1, self.tok_h2, self.tok_kind, self.lengths,
+                self.roots), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @staticmethod
+    def from_tokenized(t: TokenizedFilters, device=None) -> "FilterProbes":
+        put = functools.partial(jax.device_put, device=device)
+        return FilterProbes(put(t.tok_h1), put(t.tok_h2), put(t.tok_kind),
+                            put(t.lengths), put(t.roots))
+
+
+@functools.partial(jax.jit, static_argnames=("probe_len", "k_states"))
+def retained_walk(trie: DeviceTrie, probes: FilterProbes, *, probe_len: int,
+                  k_states: int = 32) -> Tuple[jax.Array, jax.Array]:
+    """Returns (ranges [B, K, 2] int32 (slot_start, slot_count), overflow [B]).
+
+    Ranges with count <= 0 are empty. Padding probes produce no ranges.
+    """
+    b, width = probes.tok_h1.shape
+    max_levels = width - 1
+    k = k_states
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+
+    act0 = jnp.full((b, k), -1, dtype=jnp.int32)
+    act0 = act0.at[:, 0].set(jnp.where(probes.lengths >= 0, probes.roots, -1))
+    ranges0 = jnp.zeros((b, k, 2), dtype=jnp.int32)
+    overflow0 = jnp.zeros((b,), dtype=bool)
+
+    def body(i, carry):
+        act, ranges, overflow = carry
+        valid = act >= 0                                     # [B,K]
+        stepping = (i < probes.lengths)[:, None]
+        node_rec = trie.node_tab[act.clip(0)]                # [B,K,12]
+        kind = jax.lax.dynamic_index_in_dim(probes.tok_kind, i, axis=1)  # [B,1]
+        at_root = i == 0  # active set == {root} only before the first step
+
+        # ---- '#': emit subtree slot ranges and stop this probe -------------
+        is_hash = stepping & (kind == KIND_HASH)
+        sys_skip = jnp.where(at_root, node_rec[..., NODE_RCOUNT]
+                             + node_rec[..., NODE_SYS_SLOTS], 0)
+        h_start = node_rec[..., NODE_RSTART] + sys_skip
+        h_count = node_rec[..., NODE_SUB_RCOUNT] - sys_skip
+        hash_ranges = jnp.stack([h_start, jnp.where(valid, h_count, 0)],
+                                axis=-1)
+        ranges = jnp.where((is_hash & valid)[..., None], hash_ranges, ranges)
+
+        # ---- final level consumed: emit own-slot ranges ---------------------
+        is_final = (i == probes.lengths)[:, None]
+        own = jnp.stack([node_rec[..., NODE_RSTART],
+                         jnp.where(valid, node_rec[..., NODE_RCOUNT], 0)],
+                        axis=-1)
+        ranges = jnp.where((is_final & valid)[..., None], own, ranges)
+
+        # ---- successors -----------------------------------------------------
+        live = stepping & (kind != KIND_HASH) & valid
+        # literal
+        h1 = jnp.broadcast_to(
+            jax.lax.dynamic_index_in_dim(probes.tok_h1, i, axis=1), (b, k))
+        h2 = jnp.broadcast_to(
+            jax.lax.dynamic_index_in_dim(probes.tok_h2, i, axis=1), (b, k))
+        exact = _edge_lookup(trie.edge_tab, probe_len, act.clip(0), h1, h2)
+        exact = jnp.where(live & (kind == KIND_LIT), exact, -1)
+
+        # '+': expand all children of all active nodes via cumsum partition
+        sys_cskip = jnp.where(at_root, node_rec[..., NODE_SYS_CCOUNT], 0)
+        c_start = node_rec[..., NODE_CSTART] + sys_cskip
+        c_count = jnp.where(live & (kind == KIND_PLUS),
+                            node_rec[..., NODE_CCOUNT] - sys_cskip, 0)
+        offsets = jnp.cumsum(c_count, axis=1)                # [B,K] inclusive
+        total = offsets[:, -1]
+        overflow = overflow | (total > k)
+        slot_ids = jnp.arange(k, dtype=jnp.int32)[None, :]   # [1,K]
+        # source state j for output slot s: first j with offsets[j] > s
+        src = jnp.sum(offsets[:, None, :] <= slot_ids[..., None],
+                      axis=-1)                               # [B,K]
+        src_c = src.clip(0, k - 1)
+        base = jnp.take_along_axis(offsets, src_c, axis=1) \
+            - jnp.take_along_axis(c_count, src_c, axis=1)
+        within = slot_ids - base
+        list_idx = (jnp.take_along_axis(c_start, src_c, axis=1) + within)
+        plus_kids = trie.child_list[
+            list_idx.clip(0, trie.child_list.shape[0] - 1)]
+        plus_kids = jnp.where(slot_ids < total[:, None], plus_kids, -1)
+
+        is_plus_row = kind == KIND_PLUS                      # [B,1]
+        cand = jnp.where(is_plus_row, plus_kids, exact)      # [B,K]
+        # compact (exact path produces at most one successor per state but
+        # holes remain; reuse the scatter-drop compaction)
+        cvalid = cand >= 0
+        pos = jnp.cumsum(cvalid, axis=1) - 1
+        pos = jnp.where(cvalid & (pos < k), pos, 2 * k)
+        new_act = jnp.full((b, k), -1, dtype=jnp.int32)
+        new_act = new_act.at[rows, pos].set(cand, mode="drop")
+        return new_act, ranges, overflow
+
+    upper = jnp.clip(jnp.max(probes.lengths, initial=-1) + 1, 0,
+                     max_levels + 1)
+    act, ranges, overflow = jax.lax.fori_loop(
+        0, upper, body, (act0, ranges0, overflow0))
+    return ranges, overflow

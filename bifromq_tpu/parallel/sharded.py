@@ -28,7 +28,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.automaton import CompiledTrie, compile_tries, tokenize
+from ..models.automaton import NODE_COLS, CompiledTrie, compile_tries, tokenize
 from ..models.oracle import UNCAPPED_FANOUT, MatchedRoutes, SubscriptionTrie
 from ..models.matcher import TpuMatcher
 from ..ops.match import DeviceTrie, Probes, count_routes, walk
@@ -46,7 +46,7 @@ def tenant_shard(tenant_id: str, n_shards: int) -> int:
 @dataclass
 class ShardedTables:
     """Per-shard compiled automata padded/stacked for mesh placement."""
-    node_tab: np.ndarray    # [S, N, 8]
+    node_tab: np.ndarray    # [S, N, NODE_COLS]
     edge_tab: np.ndarray    # [S, T, 4]
     child_list: np.ndarray  # [S, E]
     compiled: List[CompiledTrie]   # per-shard (for salt, matchings, roots)
@@ -92,7 +92,7 @@ def build_sharded(tries: Dict[str, SubscriptionTrie], n_shards: int, *,
 
     n_max = max(ct.node_tab.shape[0] for ct in compiled)
     e_max = max(ct.child_list.shape[0] for ct in compiled)
-    node_tab = np.full((n_shards, n_max, 8), -1, dtype=np.int32)
+    node_tab = np.full((n_shards, n_max, NODE_COLS), -1, dtype=np.int32)
     edge_tab = np.full((n_shards, cap, probe_len, 4), -1, dtype=np.int32)
     child_list = np.full((n_shards, e_max), -1, dtype=np.int32)
     for s, ct in enumerate(compiled):

@@ -1,0 +1,1 @@
+"""bifromq_tpu.retain — retained-message service (analog of bifromq-retain)."""

@@ -1,0 +1,136 @@
+"""Retained-message service (≈ bifromq-retain store + server + client).
+
+Reference: RetainStoreCoProc (retain/store/RetainStoreCoProc.java:76) —
+RW batchRetain (empty payload deletes, per [MQTT-3.3.1-6/7/10/11]), RO
+batchMatch against the in-memory RetainTopicIndex; expiry GC driven by a
+tenant GC runner (store/gc/RetainStoreGCProcessor). Here:
+
+- authoritative state: per-tenant ``topic → RetainedMsg`` maps
+- wildcard lookup: models.retained.RetainedIndex (device probes + fallback)
+- expiry: lazy on match + an explicit ``gc()`` sweep (the delay-runner
+  scheduling lands with the inbox milestone's DelayTaskRunner)
+- per-tenant topic quota via IResourceThrottler (TOTAL_RETAIN_TOPICS)
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..models.retained import RetainedIndex
+from ..plugin.events import Event, EventType, IEventCollector
+from ..plugin.throttler import (AllowAllResourceThrottler, IResourceThrottler,
+                                TenantResourceType)
+from ..types import ClientInfo, Message
+from ..utils import topic as topic_util
+
+_NEVER = 0xFFFFFFFF
+
+
+@dataclass
+class RetainedMsg:
+    topic: str
+    message: Message
+    publisher: ClientInfo
+    expire_at: Optional[float]  # epoch seconds; None = never
+
+
+class RetainService:
+    def __init__(self, events: IEventCollector, *,
+                 throttler: Optional[IResourceThrottler] = None,
+                 index: Optional[RetainedIndex] = None,
+                 clock=time.time) -> None:
+        self.events = events
+        self.throttler = throttler or AllowAllResourceThrottler()
+        self.index = index or RetainedIndex()
+        self.clock = clock
+        self.tenants: Dict[str, Dict[str, RetainedMsg]] = {}
+
+    # ---------------- mutations (≈ batchRetain) ----------------------------
+
+    async def retain(self, publisher: ClientInfo, topic: str,
+                     message: Message) -> bool:
+        tenant_id = publisher.tenant_id
+        levels = topic_util.parse(topic)
+        store = self.tenants.setdefault(tenant_id, {})
+        if not message.payload:
+            # empty payload clears the retained message [MQTT-3.3.1-10/11]
+            if store.pop(topic, None) is not None:
+                self.index.remove_topic(tenant_id, levels, topic)
+                if not store:
+                    del self.tenants[tenant_id]
+                self.events.report(Event(EventType.RETAIN_MSG_CLEARED,
+                                         tenant_id, {"topic": topic}))
+            return True
+        if topic not in store and not self.throttler.has_resource(
+                tenant_id, TenantResourceType.TOTAL_RETAIN_TOPICS):
+            self.events.report(Event(EventType.RETAIN_ERROR, tenant_id,
+                                     {"topic": topic, "reason": "quota"}))
+            return False
+        expire_at = None
+        if message.expiry_seconds != _NEVER:
+            expire_at = self.clock() + message.expiry_seconds
+        store[topic] = RetainedMsg(topic=topic, message=message,
+                                   publisher=publisher, expire_at=expire_at)
+        self.index.add_topic(tenant_id, levels, topic)
+        self.events.report(Event(EventType.MSG_RETAINED, tenant_id,
+                                 {"topic": topic}))
+        return True
+
+    # ---------------- queries (≈ batchMatch) -------------------------------
+
+    async def match(self, tenant_id: str, filter_levels: Sequence[str],
+                    limit: int) -> List[Tuple[str, Message]]:
+        res = await self.match_batch([(tenant_id, filter_levels)], limit)
+        return res[0]
+
+    async def match_batch(self, queries: Sequence[Tuple[str, Sequence[str]]],
+                          limit: int) -> List[List[Tuple[str, Message]]]:
+        raw = self.index.match_batch(queries, limit=limit)
+        now = self.clock()
+        out: List[List[Tuple[str, Message]]] = []
+        for (tenant_id, _), topics in zip(queries, raw):
+            store = self.tenants.get(tenant_id, {})
+            hits: List[Tuple[str, Message]] = []
+            for topic in topics:
+                rm = store.get(topic)
+                if rm is None:
+                    continue
+                if rm.expire_at is not None and rm.expire_at <= now:
+                    self._expire(tenant_id, rm)
+                    continue
+                if len(hits) < limit:
+                    hits.append((topic, rm.message))
+            out.append(hits)
+        return out
+
+    # ---------------- expiry GC (≈ RetainStoreGCProcessor) -----------------
+
+    def gc(self, tenant_id: Optional[str] = None) -> int:
+        now = self.clock()
+        removed = 0
+        tenants = ([tenant_id] if tenant_id is not None
+                   else list(self.tenants))
+        for t in tenants:
+            store = self.tenants.get(t)
+            if store is None:
+                continue
+            for rm in [x for x in store.values()
+                       if x.expire_at is not None and x.expire_at <= now]:
+                self._expire(t, rm)
+                removed += 1
+        return removed
+
+    def _expire(self, tenant_id: str, rm: RetainedMsg) -> None:
+        store = self.tenants.get(tenant_id)
+        if store is None:
+            return
+        if store.pop(rm.topic, None) is not None:
+            self.index.remove_topic(tenant_id, topic_util.parse(rm.topic),
+                                    rm.topic)
+            if not store:
+                del self.tenants[tenant_id]
+
+    def topic_count(self, tenant_id: str) -> int:
+        return len(self.tenants.get(tenant_id, {}))

@@ -341,3 +341,67 @@ class TestReviewRegressions:
         c = await mk_client(broker, client_id="et")
         await c.publish("", b"x")  # qos0, empty topic
         await asyncio.wait_for(c.closed.wait(), 5)  # broker drops the conn
+
+
+class TestRetainedMessages:
+    async def test_retained_delivered_on_subscribe(self, broker):
+        p = await mk_client(broker, client_id="rp")
+        await p.publish("state/light", b"on", qos=1, retain=True)
+        # subscriber arrives later and still gets it, flagged retained
+        s = await mk_client(broker, client_id="rs")
+        await s.subscribe("state/+")
+        msg = await s.recv()
+        assert msg.topic == "state/light" and msg.payload == b"on"
+        assert msg.retain
+        await p.disconnect(); await s.disconnect()
+
+    async def test_live_delivery_not_flagged_retained(self, broker):
+        s = await mk_client(broker, client_id="lv")
+        await s.subscribe("state/t")
+        p = await mk_client(broker, client_id="lp")
+        await p.publish("state/t", b"x", qos=1, retain=True)
+        msg = await s.recv()
+        assert not msg.retain  # normal delivery; retain-as-published off
+        await p.disconnect(); await s.disconnect()
+
+    async def test_empty_payload_clears_retained(self, broker):
+        p = await mk_client(broker, client_id="cp")
+        await p.publish("clear/t", b"v", qos=1, retain=True)
+        await p.publish("clear/t", b"", qos=1, retain=True)
+        s = await mk_client(broker, client_id="cs")
+        await s.subscribe("clear/t")
+        with pytest.raises(asyncio.TimeoutError):
+            await s.recv(timeout=0.3)
+        await p.disconnect(); await s.disconnect()
+
+    async def test_retain_handling_2_skips_delivery(self, broker):
+        p = await mk_client(broker, client_id="rh2p")
+        await p.publish("rh/t", b"v", qos=1, retain=True)
+        s = await mk_client(broker, client_id="rh2s", protocol_level=5)
+        await s.subscribe("rh/t", retain_handling=2)
+        with pytest.raises(asyncio.TimeoutError):
+            await s.recv(timeout=0.3)
+        await p.disconnect(); await s.disconnect()
+
+    async def test_retain_handling_1_only_new_sub(self, broker):
+        p = await mk_client(broker, client_id="rh1p")
+        await p.publish("rh1/t", b"v", qos=1, retain=True)
+        s = await mk_client(broker, client_id="rh1s", protocol_level=5)
+        await s.subscribe("rh1/t", retain_handling=1)
+        assert (await s.recv()).payload == b"v"  # first sub: delivered
+        await s.subscribe("rh1/t", retain_handling=1)  # resub: not delivered
+        with pytest.raises(asyncio.TimeoutError):
+            await s.recv(timeout=0.3)
+        await p.disconnect(); await s.disconnect()
+
+    async def test_retained_will(self, broker):
+        dying = await mk_client(broker, client_id="rw",
+                                will=pk.Will(topic="rwill/t", payload=b"gone",
+                                             retain=True))
+        dying._writer.close()
+        await asyncio.sleep(0.3)
+        s = await mk_client(broker, client_id="rwatch")
+        await s.subscribe("rwill/t")
+        msg = await s.recv()
+        assert msg.payload == b"gone" and msg.retain
+        await s.disconnect()
