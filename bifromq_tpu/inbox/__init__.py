@@ -1,0 +1,1 @@
+"""bifromq_tpu.inbox — persistent sessions (analog of bifromq-inbox)."""

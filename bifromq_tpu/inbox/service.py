@@ -1,0 +1,242 @@
+"""Inbox service: persistent-session broker side (≈ inbox-server + -client).
+
+- ``InboxService`` wraps the store with the broker-facing API and runs the
+  expiry machinery (≈ store/delay/DelayTaskRunner.java:45 scheduling
+  ExpireInboxTask / SendLWTTask at session-expiry deadlines).
+- ``InboxSubBroker`` implements the delivery SPI id=1
+  (≈ inbox-client IInboxClient.java:55): dist fan-out lands here, messages
+  are appended to the inbox queues, and any online fetcher is signaled
+  (≈ FetcherSignaler).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..dist.service import DistService
+from ..kv.engine import IKVEngine, InMemKVEngine
+from ..plugin.events import Event, EventType, IEventCollector
+from ..plugin.settings import ISettingProvider, Setting
+from ..plugin.subbroker import (PERSISTENT_SUB_BROKER_ID, DeliveryPack,
+                                DeliveryResult, ISubBroker)
+from ..types import ClientInfo, MatchInfo, Message, QoS, RouteMatcher, TopicFilterOption
+from ..utils.hlc import HLC
+from .store import LWT, Fetched, InboxMetadata, InboxStore
+
+
+class DelayTaskRunner:
+    """Deadline-keyed task scheduling (≈ DelayTaskRunner.java:45):
+    one pending task per key; rescheduling replaces."""
+
+    def __init__(self, clock=time.time) -> None:
+        self.clock = clock
+        self._tasks: Dict[object, asyncio.TimerHandle] = {}
+
+    def schedule(self, key, deadline: float,
+                 fn: Callable[[], None]) -> None:
+        self.cancel(key)
+        loop = asyncio.get_running_loop()
+        delay = max(0.0, deadline - self.clock())
+
+        def fire():
+            self._tasks.pop(key, None)
+            fn()
+
+        self._tasks[key] = loop.call_later(delay, fire)
+
+    def cancel(self, key) -> None:
+        h = self._tasks.pop(key, None)
+        if h is not None:
+            h.cancel()
+
+    def close(self) -> None:
+        for h in self._tasks.values():
+            h.cancel()
+        self._tasks.clear()
+
+
+class InboxService:
+    def __init__(self, dist: DistService, events: IEventCollector,
+                 settings: ISettingProvider, *,
+                 engine: Optional[IKVEngine] = None,
+                 clock=time.time) -> None:
+        self.dist = dist
+        self.events = events
+        self.settings = settings
+        self.clock = clock
+        engine = engine or InMemKVEngine()
+        self.store = InboxStore(engine.create_space("inbox_data"), events,
+                                clock=clock)
+        self.delay = DelayTaskRunner(clock=clock)
+        # online fetch signalers: (tenant, inbox) -> callback (≈ FetcherSignaler)
+        self._signals: Dict[Tuple[str, str], Callable[[], None]] = {}
+
+    def _setting(self, s: Setting, tenant_id: str):
+        v = self.settings.provide(s, tenant_id)
+        return s.default if v is None else v
+
+    # ---------------- lifecycle -------------------------------------------
+
+    def attach(self, tenant_id: str, inbox_id: str, *, clean_start: bool,
+               expiry_seconds: int,
+               client_meta: Tuple[Tuple[str, str], ...] = (),
+               lwt: Optional[LWT] = None) -> Tuple[InboxMetadata, bool]:
+        meta, present = self.store.attach(
+            tenant_id, inbox_id, clean_start=clean_start,
+            expiry_seconds=expiry_seconds, client_meta=client_meta, lwt=lwt)
+        self.delay.cancel((tenant_id, inbox_id))
+        if not present:
+            # a fresh inbox has no routes yet; a reattached one keeps them
+            pass
+        return meta, present
+
+    def detach(self, tenant_id: str, inbox_id: str, *,
+               fire_lwt_on_expiry: bool = True) -> None:
+        meta = self.store.detach(tenant_id, inbox_id,
+                                 keep_lwt=fire_lwt_on_expiry)
+        if meta is None:
+            return
+        self._signals.pop((tenant_id, inbox_id), None)
+        deadline = meta.expire_at()
+        if deadline == float("inf"):
+            return
+        self.delay.schedule(
+            (tenant_id, inbox_id), deadline,
+            lambda: asyncio.get_running_loop().create_task(
+                self._expire(tenant_id, inbox_id)))
+
+    async def _expire(self, tenant_id: str, inbox_id: str) -> None:
+        """ExpireInboxTask + SendLWTTask: fire LWT, drop routes, delete."""
+        meta = self.store.get(tenant_id, inbox_id)
+        if meta is None or meta.detached_at is None:
+            return  # reattached meanwhile
+        if meta.expire_at() > self.clock():
+            return
+        if meta.lwt is not None:
+            publisher = ClientInfo(tenant_id=tenant_id,
+                                   metadata=meta.client_meta)
+            await self.dist.pub(publisher, meta.lwt.topic, meta.lwt.message)
+            self.events.report(Event(EventType.WILL_DISTED, tenant_id,
+                                     {"topic": meta.lwt.topic,
+                                      "inbox": inbox_id}))
+        self._drop_routes(tenant_id, inbox_id, meta)
+        self.store.delete(tenant_id, inbox_id)
+
+    def delete(self, tenant_id: str, inbox_id: str) -> None:
+        meta = self.store.get(tenant_id, inbox_id)
+        if meta is not None:
+            self._drop_routes(tenant_id, inbox_id, meta)
+        self.delay.cancel((tenant_id, inbox_id))
+        self.store.delete(tenant_id, inbox_id)
+
+    def _drop_routes(self, tenant_id: str, inbox_id: str,
+                     meta: InboxMetadata) -> None:
+        for tf in list(meta.filters):
+            self.dist.unmatch(tenant_id,
+                              RouteMatcher.from_topic_filter(tf),
+                              PERSISTENT_SUB_BROKER_ID, inbox_id,
+                              self._deliverer_key(inbox_id))
+    # ---------------- subscriptions ----------------------------------------
+
+    @staticmethod
+    def _deliverer_key(inbox_id: str) -> str:
+        return f"i{hash(inbox_id) % 16}"
+
+    def sub(self, tenant_id: str, inbox_id: str, topic_filter: str,
+            opt: TopicFilterOption) -> str:
+        res = self.store.sub(
+            tenant_id, inbox_id, topic_filter, opt,
+            max_filters=self._setting(Setting.MaxTopicFiltersPerInbox,
+                                      tenant_id))
+        if res in ("ok", "exists"):
+            self.dist.match(tenant_id,
+                            RouteMatcher.from_topic_filter(topic_filter),
+                            PERSISTENT_SUB_BROKER_ID, inbox_id,
+                            self._deliverer_key(inbox_id))
+        return res
+
+    def unsub(self, tenant_id: str, inbox_id: str, topic_filter: str) -> bool:
+        removed = self.store.unsub(tenant_id, inbox_id, topic_filter)
+        if removed:
+            self.dist.unmatch(tenant_id,
+                              RouteMatcher.from_topic_filter(topic_filter),
+                              PERSISTENT_SUB_BROKER_ID, inbox_id,
+                              self._deliverer_key(inbox_id))
+        return removed
+
+    # ---------------- fetch signaling --------------------------------------
+
+    def register_fetcher(self, tenant_id: str, inbox_id: str,
+                         signal: Callable[[], None]) -> None:
+        self._signals[(tenant_id, inbox_id)] = signal
+
+    def unregister_fetcher(self, tenant_id: str, inbox_id: str) -> None:
+        self._signals.pop((tenant_id, inbox_id), None)
+
+    def _signal(self, tenant_id: str, inbox_id: str) -> None:
+        cb = self._signals.get((tenant_id, inbox_id))
+        if cb is not None:
+            cb()
+
+    # ---------------- gc ----------------------------------------------------
+
+    async def gc(self) -> int:
+        """Sweep expired inboxes (≈ InboxStoreGCProcessor); returns count."""
+        expired = self.store.expired_inboxes()
+        for tenant_id, inbox_id, _meta in expired:
+            await self._expire(tenant_id, inbox_id)
+        return len(expired)
+
+    def close(self) -> None:
+        self.delay.close()
+
+
+class InboxSubBroker(ISubBroker):
+    """Delivery SPI id=1: append to inbox queues + wake fetchers."""
+
+    id = PERSISTENT_SUB_BROKER_ID
+
+    def __init__(self, service: InboxService) -> None:
+        self.service = service
+
+    async def deliver(self, tenant_id: str, deliverer_key: str,
+                      packs: Sequence[DeliveryPack]
+                      ) -> Dict[MatchInfo, DeliveryResult]:
+        svc = self.service
+        out: Dict[MatchInfo, DeliveryResult] = {}
+        inbox_size = svc._setting(Setting.SessionInboxSize, tenant_id)
+        drop_oldest = svc._setting(Setting.QoS0DropOldest, tenant_id)
+        touched = set()
+        for pack in packs:
+            topic = pack.message_pack.topic
+            for mi in pack.match_infos:
+                result = DeliveryResult.OK
+                for pub_pack in pack.message_pack.packs:
+                    pub_client = pub_pack.publisher.meta().get("clientId")
+                    for msg in pub_pack.messages:
+                        r = svc.store.insert(
+                            tenant_id, mi.receiver_id, topic, msg,
+                            mi.matcher.mqtt_topic_filter,
+                            inbox_size=inbox_size, drop_oldest=drop_oldest,
+                            publisher_client_id=pub_client)
+                        if r is None:
+                            result = DeliveryResult.NO_SUB
+                        elif r.ok:
+                            touched.add((tenant_id, mi.receiver_id))
+                out[mi] = result
+        for tenant, inbox in touched:
+            svc._signal(tenant, inbox)
+        return out
+
+    async def check_subscriptions(self, tenant_id: str,
+                                  match_infos: Sequence[MatchInfo]
+                                  ) -> List[bool]:
+        out = []
+        for mi in match_infos:
+            meta = self.service.store.get(tenant_id, mi.receiver_id)
+            out.append(bool(meta is not None
+                            and meta.expire_at() > self.service.clock()
+                            and mi.matcher.mqtt_topic_filter in meta.filters))
+        return out

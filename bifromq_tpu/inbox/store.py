@@ -1,0 +1,360 @@
+"""Inbox store: KV-backed persistent-session state machine (≈ inbox-store).
+
+Reference: InboxStoreCoProc (bifromq-inbox .../store/InboxStoreCoProc.java:166)
+RW ops batchAttach/batchDetach/batchDelete/batchSub/batchUnsub/batchInsert/
+batchCommit and RO ops batchExist/batchFetch — re-expressed as a synchronous
+state machine over an IKVSpace (raft-replicated ranges plug in underneath
+via the same writes; see kv/).
+
+Layout per (tenant, inbox, incarnation) — kv/schema.py inbox keys:
+  metadata record ‖ qos0 queue (seq-keyed) ‖ send-buffer queue (seq-keyed)
+
+QoS0 messages go to the qos0 queue (delivered best-effort, committed on
+send); QoS1/2 go to the send-buffer (committed on client ack). Capacity per
+queue comes from tenant settings (SessionInboxSize), dropping oldest or
+newest per QoS0DropOldest — reference semantics.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..kv.engine import IKVSpace
+from ..kv import schema
+from ..plugin.events import Event, EventType, IEventCollector
+from ..types import Message, QoS, TopicFilterOption
+from ..utils import topic as topic_util
+
+_NEVER = float("inf")
+
+
+@dataclass
+class LWT:
+    topic: str
+    message: Message
+    delay_seconds: int = 0
+
+
+@dataclass
+class InboxMetadata:
+    inbox_id: str
+    incarnation: int
+    expiry_seconds: int
+    client_meta: Tuple[Tuple[str, str], ...] = ()
+    # topic filter -> options
+    filters: Dict[str, TopicFilterOption] = field(default_factory=dict)
+    lwt: Optional[LWT] = None
+    detached_at: Optional[float] = None   # epoch; None while attached
+    qos0_next_seq: int = 0
+    qos0_start_seq: int = 0
+    buffer_next_seq: int = 0
+    buffer_start_seq: int = 0
+
+    def expire_at(self) -> float:
+        if self.detached_at is None:
+            return _NEVER
+        return self.detached_at + self.expiry_seconds
+
+
+def _enc_meta(m: InboxMetadata) -> bytes:
+    out = struct.pack(">QIQQQQ", m.incarnation, m.expiry_seconds,
+                      m.qos0_next_seq, m.qos0_start_seq,
+                      m.buffer_next_seq, m.buffer_start_seq)
+    out += struct.pack(">d", -1.0 if m.detached_at is None else m.detached_at)
+    out += struct.pack(">H", len(m.client_meta))
+    for k, v in m.client_meta:
+        out += schema._len16(k.encode()) + schema._len16(v.encode())
+    out += struct.pack(">H", len(m.filters))
+    for tf, opt in m.filters.items():
+        out += schema._len16(tf.encode())
+        out += struct.pack(">B??Bqq", int(opt.qos), opt.retain_as_published,
+                           opt.no_local, opt.retain_handling,
+                           -1 if opt.sub_id is None else opt.sub_id,
+                           opt.incarnation)
+    if m.lwt is None:
+        out += b"\x00"
+    else:
+        out += b"\x01" + schema._len16(m.lwt.topic.encode()) \
+            + struct.pack(">I", m.lwt.delay_seconds) \
+            + schema._len16(schema.encode_message(m.lwt.message))
+    return out
+
+
+def _dec_meta(inbox_id: str, buf: bytes) -> InboxMetadata:
+    (incarnation, expiry, q0n, q0s, bn, bs) = struct.unpack_from(">QIQQQQ",
+                                                                buf, 0)
+    pos = struct.calcsize(">QIQQQQ")
+    detached = struct.unpack_from(">d", buf, pos)[0]
+    pos += 8
+    n_meta = struct.unpack_from(">H", buf, pos)[0]
+    pos += 2
+    client_meta = []
+    for _ in range(n_meta):
+        k, pos = schema._read_len16(buf, pos)
+        v, pos = schema._read_len16(buf, pos)
+        client_meta.append((k.decode(), v.decode()))
+    n_filters = struct.unpack_from(">H", buf, pos)[0]
+    pos += 2
+    filters: Dict[str, TopicFilterOption] = {}
+    for _ in range(n_filters):
+        tf, pos = schema._read_len16(buf, pos)
+        qos, rap, nl, rh, sub_id, inc = struct.unpack_from(">B??Bqq", buf, pos)
+        pos += struct.calcsize(">B??Bqq")
+        filters[tf.decode()] = TopicFilterOption(
+            qos=QoS(qos), retain_as_published=rap, no_local=nl,
+            retain_handling=rh, sub_id=None if sub_id < 0 else sub_id,
+            incarnation=inc)
+    lwt = None
+    if buf[pos] == 1:
+        pos += 1
+        topic_b, pos = schema._read_len16(buf, pos)
+        delay = struct.unpack_from(">I", buf, pos)[0]
+        pos += 4
+        msg_b, pos = schema._read_len16(buf, pos)
+        lwt = LWT(topic=topic_b.decode(), delay_seconds=delay,
+                  message=schema.decode_message(msg_b))
+    return InboxMetadata(
+        inbox_id=inbox_id, incarnation=incarnation, expiry_seconds=expiry,
+        client_meta=tuple(client_meta), filters=filters, lwt=lwt,
+        detached_at=None if detached < 0 else detached,
+        qos0_next_seq=q0n, qos0_start_seq=q0s,
+        buffer_next_seq=bn, buffer_start_seq=bs)
+
+
+@dataclass
+class Fetched:
+    qos0: List[Tuple[int, str, Message]]     # (seq, topic, message)
+    buffer: List[Tuple[int, str, Message]]
+
+
+@dataclass
+class InsertResult:
+    ok: bool
+    dropped_qos0: int = 0
+    dropped_buffer: int = 0
+
+
+class InboxStore:
+    """Single-writer state machine over a KV space."""
+
+    def __init__(self, space: IKVSpace, events: IEventCollector, *,
+                 clock=time.time) -> None:
+        self.space = space
+        self.events = events
+        self.clock = clock
+
+    # ---------------- metadata helpers -------------------------------------
+
+    def _load(self, tenant_id: str,
+              inbox_id: str) -> Optional[InboxMetadata]:
+        """Latest (only) incarnation of this inbox, or None."""
+        value = self.space.get(schema.inbox_meta_key(tenant_id, inbox_id))
+        return None if value is None else _dec_meta(inbox_id, value)
+
+    def _store(self, tenant_id: str, m: InboxMetadata) -> None:
+        self.space.writer().put(
+            schema.inbox_meta_key(tenant_id, m.inbox_id),
+            _enc_meta(m)).done()
+
+    # ---------------- lifecycle (≈ batchAttach/batchDetach/batchDelete) ----
+
+    def attach(self, tenant_id: str, inbox_id: str, *, clean_start: bool,
+               expiry_seconds: int,
+               client_meta: Tuple[Tuple[str, str], ...] = (),
+               lwt: Optional[LWT] = None) -> Tuple[InboxMetadata, bool]:
+        """Returns (metadata, session_present)."""
+        existing = self._load(tenant_id, inbox_id)
+        now = self.clock()
+        if existing is not None and not clean_start \
+                and existing.expire_at() > now:
+            meta = replace(existing, detached_at=None, lwt=lwt,
+                           expiry_seconds=expiry_seconds,
+                           client_meta=client_meta)
+            self._store(tenant_id, meta)
+            return meta, True
+        if existing is not None:
+            self.delete(tenant_id, inbox_id)
+        meta = InboxMetadata(inbox_id=inbox_id,
+                             incarnation=int(now * 1000),
+                             expiry_seconds=expiry_seconds,
+                             client_meta=client_meta, lwt=lwt)
+        self._store(tenant_id, meta)
+        return meta, False
+
+    def detach(self, tenant_id: str, inbox_id: str,
+               *, keep_lwt: bool = True) -> Optional[InboxMetadata]:
+        meta = self._load(tenant_id, inbox_id)
+        if meta is None:
+            return None
+        meta = replace(meta, detached_at=self.clock(),
+                       lwt=meta.lwt if keep_lwt else None)
+        self._store(tenant_id, meta)
+        return meta
+
+    def delete(self, tenant_id: str, inbox_id: str) -> bool:
+        prefix = schema.inbox_prefix(tenant_id, inbox_id)
+        existed = self._load(tenant_id, inbox_id) is not None
+        self.space.writer().delete_range(
+            prefix, schema.prefix_end(prefix)).done()
+        return existed
+
+    def exists(self, tenant_id: str, inbox_id: str) -> bool:
+        meta = self._load(tenant_id, inbox_id)
+        return meta is not None and meta.expire_at() > self.clock()
+
+    def get(self, tenant_id: str, inbox_id: str) -> Optional[InboxMetadata]:
+        return self._load(tenant_id, inbox_id)
+
+    # ---------------- subscriptions (≈ batchSub/batchUnsub) ----------------
+
+    def sub(self, tenant_id: str, inbox_id: str, topic_filter: str,
+            opt: TopicFilterOption, max_filters: int) -> str:
+        """Returns 'ok' | 'exists' | 'exceeds_limit' | 'no_inbox'."""
+        meta = self._load(tenant_id, inbox_id)
+        if meta is None:
+            return "no_inbox"
+        existed = topic_filter in meta.filters
+        if not existed and len(meta.filters) >= max_filters:
+            return "exceeds_limit"
+        meta.filters[topic_filter] = opt
+        self._store(tenant_id, meta)
+        return "exists" if existed else "ok"
+
+    def unsub(self, tenant_id: str, inbox_id: str,
+              topic_filter: str) -> bool:
+        meta = self._load(tenant_id, inbox_id)
+        if meta is None or topic_filter not in meta.filters:
+            return False
+        del meta.filters[topic_filter]
+        self._store(tenant_id, meta)
+        return True
+
+    # ---------------- insert (≈ batchInsert) -------------------------------
+
+    def insert(self, tenant_id: str, inbox_id: str, topic: str,
+               message: Message, matched_filter: str, *,
+               inbox_size: int, drop_oldest: bool,
+               publisher_client_id: Optional[str] = None
+               ) -> Optional[InsertResult]:
+        """Returns None if the subscription no longer exists (NO_SUB)."""
+        meta = self._load(tenant_id, inbox_id)
+        if meta is None or meta.expire_at() <= self.clock():
+            return None
+        opt = meta.filters.get(matched_filter)
+        if opt is None:
+            return None
+        if opt.no_local and publisher_client_id == inbox_id:
+            return InsertResult(ok=True)  # [MQTT-3.8.3-3] skip own publishes
+        qos = min(int(message.pub_qos), int(opt.qos))
+        record = schema._len16(topic.encode()) + schema.encode_message(
+            replace(message, pub_qos=QoS(qos)))
+        w = self.space.writer()
+        dropped0 = droppedb = 0
+        if qos == 0:
+            depth = meta.qos0_next_seq - meta.qos0_start_seq
+            if depth >= inbox_size:
+                if drop_oldest:
+                    w.delete(schema.inbox_qos0_key(
+                        tenant_id, inbox_id, meta.qos0_start_seq))
+                    meta.qos0_start_seq += 1
+                    dropped0 = 1
+                else:
+                    self.events.report(Event(EventType.OVERFLOWED, tenant_id,
+                                             {"inbox": inbox_id, "qos": 0}))
+                    return InsertResult(ok=False, dropped_qos0=1)
+            w.put(schema.inbox_qos0_key(tenant_id, inbox_id,
+                                        meta.qos0_next_seq), record)
+            meta.qos0_next_seq += 1
+        else:
+            depth = meta.buffer_next_seq - meta.buffer_start_seq
+            if depth >= inbox_size:
+                self.events.report(Event(EventType.OVERFLOWED, tenant_id,
+                                         {"inbox": inbox_id, "qos": qos}))
+                return InsertResult(ok=False, dropped_buffer=1)
+            w.put(schema.inbox_buffer_key(tenant_id, inbox_id,
+                                          meta.buffer_next_seq), record)
+            meta.buffer_next_seq += 1
+        w.put(schema.inbox_meta_key(tenant_id, inbox_id), _enc_meta(meta))
+        w.done()
+        return InsertResult(ok=True, dropped_qos0=dropped0,
+                            dropped_buffer=droppedb)
+
+    # ---------------- fetch/commit (≈ batchFetch/batchCommit) --------------
+
+    def fetch(self, tenant_id: str, inbox_id: str, *, max_fetch: int = 100,
+              qos0_after: Optional[int] = None,
+              buffer_after: Optional[int] = None,
+              max_buffer: Optional[int] = None) -> Optional[Fetched]:
+        meta = self._load(tenant_id, inbox_id)
+        if meta is None:
+            return None
+
+        def scan(key_fn, after, start_seq, cap) -> List[Tuple[int, str, Message]]:
+            if cap <= 0:
+                return []
+            from_seq = start_seq if after is None else max(after + 1,
+                                                           start_seq)
+            out = []
+            start = key_fn(tenant_id, inbox_id, from_seq)
+            end = key_fn(tenant_id, inbox_id, 2 ** 63 - 1)
+            for key, value in self.space.iterate(start, end):
+                if len(out) >= cap:
+                    break
+                seq = schema.seq_of(key)
+                topic_b, pos = schema._read_len16(value, 0)
+                out.append((seq, topic_b.decode(),
+                            schema.decode_message(value[pos:])))
+            return out
+
+        return Fetched(
+            qos0=scan(schema.inbox_qos0_key, qos0_after, meta.qos0_start_seq,
+                      max_fetch),
+            buffer=scan(schema.inbox_buffer_key, buffer_after,
+                        meta.buffer_start_seq,
+                        max_fetch if max_buffer is None else max_buffer))
+
+    def commit(self, tenant_id: str, inbox_id: str, *,
+               qos0_up_to: Optional[int] = None,
+               buffer_up_to: Optional[int] = None) -> bool:
+        meta = self._load(tenant_id, inbox_id)
+        if meta is None:
+            return False
+        w = self.space.writer()
+        if qos0_up_to is not None and qos0_up_to >= meta.qos0_start_seq:
+            w.delete_range(
+                schema.inbox_qos0_key(tenant_id, inbox_id,
+                                      meta.qos0_start_seq),
+                schema.inbox_qos0_key(tenant_id, inbox_id, qos0_up_to + 1))
+            meta.qos0_start_seq = qos0_up_to + 1
+        if buffer_up_to is not None and buffer_up_to >= meta.buffer_start_seq:
+            w.delete_range(
+                schema.inbox_buffer_key(tenant_id, inbox_id,
+                                        meta.buffer_start_seq),
+                schema.inbox_buffer_key(tenant_id, inbox_id,
+                                        buffer_up_to + 1))
+            meta.buffer_start_seq = buffer_up_to + 1
+        w.put(schema.inbox_meta_key(tenant_id, inbox_id), _enc_meta(meta))
+        w.done()
+        return True
+
+    # ---------------- gc (≈ ExpireInboxTask / gc scan) ---------------------
+
+    def expired_inboxes(self, now: Optional[float] = None
+                        ) -> List[Tuple[str, str, InboxMetadata]]:
+        """Scan all inboxes whose expiry deadline passed (gc support)."""
+        now = self.clock() if now is None else now
+        out = []
+        for key, value in self.space.iterate(schema.TAG_INBOX,
+                                             schema.prefix_end(
+                                                 schema.TAG_INBOX)):
+            tenant_b, pos = schema._read_len16(key, 1)
+            inbox_b, pos = schema._read_len16(key, pos)
+            if len(key) != pos + 1 or key[-1] != 0:
+                continue  # not a metadata record
+            meta = _dec_meta(inbox_b.decode(), value)
+            if meta.expire_at() <= now:
+                out.append((tenant_b.decode(), meta.inbox_id, meta))
+        return out

@@ -1,0 +1,1 @@
+"""bifromq_tpu.kv — storage engine (analog of base-kv local engines + schemas)."""

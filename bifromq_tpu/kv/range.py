@@ -1,0 +1,179 @@
+"""Replicated KV range: raft-driven state machine over an IKVSpace.
+
+A deliberately lean re-expression of the reference's range replica
+(base-kv-store-server .../store/range/KVRangeFSM.java:164 — raft WAL + data
+space + apply loop + coproc), minus split/merge (SURVEY.md §7 defers the
+dual-range merge handshake to a later round):
+
+- mutations serialize into raft entries; the apply loop executes them on the
+  local space in commit order on every replica
+- reads go through ``read_index`` for linearizability
+  (≈ KVRangeQueryLinearizer.java:37)
+- the coproc SPI mirrors IKVRangeCoProc: ``query(input, reader)`` /
+  ``mutate(input, reader, writer)`` / ``reset(boundary)``
+- raft snapshots serialize the whole space (RocksDB-checkpoint analog)
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Awaitable, Callable, List, Optional, Tuple
+
+from ..raft.node import LogEntry, RaftNode
+from .engine import IKVSpace, KVWriteBatch
+
+
+class IKVRangeCoProc:
+    """Domain-logic plug point (≈ base-kv-store-coproc-api IKVRangeCoProc)."""
+
+    def query(self, input_data: bytes, reader: IKVSpace) -> bytes:
+        raise NotImplementedError
+
+    def mutate(self, input_data: bytes, reader: IKVSpace,
+               writer: KVWriteBatch) -> bytes:
+        """Stage writes into ``writer``; return the output payload."""
+        raise NotImplementedError
+
+    def reset(self, reader: IKVSpace) -> None:
+        """Rebuild derived state after a snapshot restore
+        (≈ DistWorkerCoProc.reset:283 rebuilding Fact/caches)."""
+
+
+# wire ops inside raft entries
+_OP_PUT = 0
+_OP_DEL = 1
+_OP_DEL_RANGE = 2
+_OP_COPROC = 3
+
+
+def _enc_kv_ops(ops: List[Tuple[str, bytes, Optional[bytes]]]) -> bytes:
+    out = bytearray([0])  # kind 0 = raw kv batch
+    out += struct.pack(">I", len(ops))
+    for op, a, b in ops:
+        code = {"put": _OP_PUT, "del": _OP_DEL, "del_range": _OP_DEL_RANGE}[op]
+        out.append(code)
+        out += struct.pack(">I", len(a)) + a
+        b = b or b""
+        out += struct.pack(">I", len(b)) + b
+    return bytes(out)
+
+
+def _enc_coproc(payload: bytes) -> bytes:
+    return bytes([1]) + payload
+
+
+class ReplicatedKVRange:
+    """One raft-replicated range bound to a local space + coproc."""
+
+    def __init__(self, range_id: str, node_id: str, voters: List[str],
+                 transport, space: IKVSpace,
+                 coproc: Optional[IKVRangeCoProc] = None) -> None:
+        self.range_id = range_id
+        self.space = space
+        self.coproc = coproc
+        self._mutation_results: dict = {}
+        self.raft = RaftNode(
+            node_id, voters, transport,
+            apply_cb=self._apply,
+            snapshot_cb=self._snapshot,
+            restore_cb=self._restore)
+
+    # ---------------- raft callbacks ---------------------------------------
+
+    def _apply(self, entry: LogEntry) -> None:
+        data = entry.data
+        if not data:
+            return
+        kind = data[0]
+        if kind == 0:
+            self._apply_kv_batch(data)
+        else:
+            writer = self.space.writer()
+            out = (self.coproc.mutate(data[1:], self.space, writer)
+                   if self.coproc is not None else b"")
+            writer.done()
+            self._mutation_results[entry.index] = out
+
+    def _apply_kv_batch(self, data: bytes) -> None:
+        n = struct.unpack_from(">I", data, 1)[0]
+        pos = 5
+        w = self.space.writer()
+        for _ in range(n):
+            code = data[pos]
+            pos += 1
+            alen = struct.unpack_from(">I", data, pos)[0]
+            pos += 4
+            a = data[pos:pos + alen]
+            pos += alen
+            blen = struct.unpack_from(">I", data, pos)[0]
+            pos += 4
+            b = data[pos:pos + blen]
+            pos += blen
+            if code == _OP_PUT:
+                w.put(a, b)
+            elif code == _OP_DEL:
+                w.delete(a)
+            else:
+                w.delete_range(a, b)
+        w.done()
+
+    def _snapshot(self) -> bytes:
+        out = bytearray()
+        for k, v in self.space.iterate():
+            out += struct.pack(">I", len(k)) + k
+            out += struct.pack(">I", len(v)) + v
+        return bytes(out)
+
+    def _restore(self, data: bytes) -> None:
+        w = self.space.writer()
+        w.delete_range(b"", b"\xff" * 32)
+        pos = 0
+        while pos < len(data):
+            klen = struct.unpack_from(">I", data, pos)[0]
+            pos += 4
+            k = data[pos:pos + klen]
+            pos += klen
+            vlen = struct.unpack_from(">I", data, pos)[0]
+            pos += 4
+            v = data[pos:pos + vlen]
+            pos += vlen
+            w.put(k, v)
+        w.done()
+        if self.coproc is not None:
+            self.coproc.reset(self.space)
+
+    # ---------------- public API -------------------------------------------
+
+    async def put(self, key: bytes, value: bytes) -> None:
+        await self.raft.propose(_enc_kv_ops([("put", key, value)]))
+
+    async def delete(self, key: bytes) -> None:
+        await self.raft.propose(_enc_kv_ops([("del", key, None)]))
+
+    async def write_batch(self, ops) -> None:
+        await self.raft.propose(_enc_kv_ops(ops))
+
+    async def mutate_coproc(self, payload: bytes) -> bytes:
+        """RW coproc call through consensus (≈ KVRangeRWRequest execute)."""
+        index = await self.raft.propose(_enc_coproc(payload))
+        return self._mutation_results.pop(index, b"")
+
+    async def get(self, key: bytes, *, linearized: bool = True
+                  ) -> Optional[bytes]:
+        if linearized:
+            await self.raft.read_index()
+        return self.space.get(key)
+
+    async def query_coproc(self, payload: bytes, *,
+                           linearized: bool = True) -> bytes:
+        """RO coproc call (≈ KVRangeRORequest via KVRangeQueryRunner)."""
+        if linearized:
+            await self.raft.read_index()
+        if self.coproc is None:
+            return b""
+        return self.coproc.query(payload, self.space)
+
+    @property
+    def is_leader(self) -> bool:
+        from ..raft.node import Role
+        return self.raft.role == Role.LEADER

@@ -208,7 +208,21 @@ class Connection:
             keep_alive = min_ka
             server_keep_alive = min_ka
 
-        session = Session(
+        # persistent vs transient (≈ setupTransient/PersistentSessionHandler,
+        # MQTTConnectHandler.java:166-200): v5 uses the session-expiry
+        # property; v3/v4 use cleanSession=false; ForceTransient overrides.
+        session_expiry = 0
+        if v5:
+            session_expiry = int((c.properties or {}).get(
+                PropertyId.SESSION_EXPIRY_INTERVAL, 0))
+        elif not c.clean_start:
+            session_expiry = settings[Setting.MaxSessionExpirySeconds]
+        session_expiry = min(session_expiry,
+                             settings[Setting.MaxSessionExpirySeconds])
+        persistent = session_expiry > 0 and not settings[
+            Setting.ForceTransient]
+
+        common = dict(
             conn=self, client_id=client_id, client_info=ClientInfo(
                 tenant_id=tenant_id, type="MQTT",
                 metadata=client_info.metadata + (("sessionId", ""),)),
@@ -219,6 +233,16 @@ class Connection:
             session_registry=broker.session_registry,
             connect_props=c.properties,
             retain_service=broker.retain_service)
+        if persistent:
+            from .persistent import PersistentSession
+            session = PersistentSession(inbox=broker.inbox,
+                                        expiry_seconds=session_expiry,
+                                        **common)
+        else:
+            # clean-start semantics: a transient connect discards any
+            # existing persistent state for this client id (inbox + routes)
+            broker.inbox.delete(tenant_id, client_id)
+            session = Session(**common)
         # bake the session id into publisher identity (no_local support)
         session.client_info = ClientInfo(
             tenant_id=tenant_id, type="MQTT",
@@ -246,7 +270,9 @@ class Connection:
                 props[PropertyId.ASSIGNED_CLIENT_IDENTIFIER] = assigned
             if server_keep_alive is not None:
                 props[PropertyId.SERVER_KEEP_ALIVE] = server_keep_alive
-        await self.send(pk.Connack(session_present=False,
+        session_present = bool(getattr(session, "session_present", False)
+                               and not c.clean_start)
+        await self.send(pk.Connack(session_present=session_present,
                                    reason_code=CONNACK_ACCEPTED,
                                    properties=props))
         broker.events.report(Event(EventType.CLIENT_CONNECTED, tenant_id,
@@ -278,6 +304,9 @@ class MQTTBroker:
             from ..retain.service import RetainService
             retain_service = RetainService(self.events)
         self.retain_service = retain_service
+        from ..inbox.service import InboxService, InboxSubBroker
+        self.inbox = InboxService(self.dist, self.events, self.settings)
+        self.sub_brokers.register(InboxSubBroker(self.inbox))
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> None:

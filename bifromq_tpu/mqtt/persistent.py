@@ -1,0 +1,244 @@
+"""Persistent MQTT session handler (≈ MQTTPersistentSessionHandler).
+
+Reference behavior (bifromq-mqtt .../MQTTPersistentSessionHandler.java):
+subscriptions and undelivered messages live in the inbox store (sub-broker
+id 1); while the session is online an inbox fetch loop (reference
+inboxReader.fetch, :387) drains the qos0 + send-buffer queues into the
+connection; PUBACK/PUBCOMP commit the send-buffer (consume():518, commit
+scheduler); on disconnect the inbox detaches and expires on its own clock.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional, Set
+
+from ..inbox.service import InboxService
+from ..inbox.store import LWT
+from ..plugin.events import Event, EventType
+from ..types import Message, QoS, TopicFilterOption
+from ..utils.hlc import HLC
+from . import packets as pk
+from .protocol import PROTOCOL_MQTT5, ReasonCode
+from .session import BLOCKED, Session, Subscription
+
+
+class PersistentSession(Session):
+    def __init__(self, *, inbox: InboxService, expiry_seconds: int,
+                 **kwargs) -> None:
+        super().__init__(**kwargs)
+        self.inbox = inbox
+        self.expiry_seconds = expiry_seconds
+        self.inbox_id = self.client_id
+        self.session_present = False
+        self._fetch_wake = asyncio.Event()
+        self._fetch_task: Optional[asyncio.Task] = None
+        self._qos0_cursor: Optional[int] = None
+        self._buf_cursor: Optional[int] = None
+        # outbound packet id -> send-buffer seq (for commit on ack)
+        self._pid_to_seq: Dict[int, int] = {}
+        self._acked_seqs: Set[int] = set()
+        self._committed_seq = -1
+
+    # ---------------- lifecycle -------------------------------------------
+
+    async def start(self) -> None:
+        tenant = self.client_info.tenant_id
+        lwt = None
+        if self.will is not None:
+            lwt = LWT(topic=self.will.topic,
+                      message=Message(message_id=0,
+                                      pub_qos=QoS(self.will.qos),
+                                      payload=self.will.payload,
+                                      timestamp=HLC.INST.get(),
+                                      is_retain=self.will.retain))
+        meta, present = self.inbox.attach(
+            tenant, self.inbox_id, clean_start=self.clean_start,
+            expiry_seconds=self.expiry_seconds,
+            client_meta=self.client_info.metadata, lwt=lwt)
+        self.session_present = present
+        if present:
+            # restore subscription state (routes already exist in dist)
+            for tf, opt in meta.filters.items():
+                from ..types import RouteMatcher
+                self.subscriptions[tf] = Subscription(
+                    matcher=RouteMatcher.from_topic_filter(tf),
+                    qos=int(opt.qos), no_local=opt.no_local,
+                    retain_as_published=opt.retain_as_published,
+                    retain_handling=opt.retain_handling, sub_id=opt.sub_id)
+        self._committed_seq = meta.buffer_start_seq - 1
+        self.local_registry.register(self)
+        await self.session_registry.register(self)
+        self.inbox.register_fetcher(tenant, self.inbox_id,
+                                    self._fetch_wake.set)
+        self._fetch_task = asyncio.get_running_loop().create_task(
+            self._fetch_loop())
+        self._fetch_wake.set()  # drain messages accumulated while offline
+
+    async def close(self, fire_will: bool) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        tenant = self.client_info.tenant_id
+        if self._fetch_task is not None:
+            self._fetch_task.cancel()
+        self.inbox.unregister_fetcher(tenant, self.inbox_id)
+        self.session_registry.unregister(self)
+        self.local_registry.unregister(self)
+        if self._kicked_replaced:
+            # the new owner took over the inbox; nothing to detach
+            pass
+        elif fire_will and self.will is not None \
+                and not self._will_suppressed:
+            # abnormal close: fire the will now, then let the inbox expire
+            await self._fire_will()
+            self.inbox.detach(tenant, self.inbox_id,
+                              fire_lwt_on_expiry=False)
+        elif self.expiry_seconds <= 0:
+            # session expiry 0: state dies with the connection (v5 semantics)
+            self.inbox.delete(tenant, self.inbox_id)
+        else:
+            self.inbox.detach(tenant, self.inbox_id,
+                              fire_lwt_on_expiry=False)
+        await self.conn.close_transport()
+        self.events.report(Event(EventType.CLIENT_DISCONNECTED, tenant,
+                                 {"client_id": self.client_id}))
+
+    _kicked_replaced = False
+
+    async def kick(self) -> None:
+        self._kicked_replaced = True
+        self._will_suppressed = True
+        if self.protocol_level >= PROTOCOL_MQTT5:
+            await self.conn.send(pk.Disconnect(
+                reason_code=ReasonCode.SESSION_TAKEN_OVER))
+        await self.close(fire_will=False)
+
+    # ---------------- subscriptions ----------------------------------------
+
+    async def _subscribe_one(self, req: pk.SubscriptionRequest,
+                             sub_id: Optional[int]) -> int:
+        code = await super()._subscribe_one(req, sub_id)
+        if code >= 0x80:
+            return code
+        sub = self.subscriptions[req.topic_filter]
+        res = self.inbox.sub(
+            self.client_info.tenant_id, self.inbox_id, req.topic_filter,
+            TopicFilterOption(qos=QoS(sub.qos), no_local=sub.no_local,
+                              retain_as_published=sub.retain_as_published,
+                              retain_handling=sub.retain_handling,
+                              sub_id=sub.sub_id))
+        if res == "exceeds_limit":
+            del self.subscriptions[req.topic_filter]
+            return (ReasonCode.QUOTA_EXCEEDED
+                    if self.protocol_level >= PROTOCOL_MQTT5 else 0x80)
+        return code
+
+    def _route(self, sub: Subscription) -> None:
+        pass  # inbox.sub (in _subscribe_one) registers the inbox route
+
+    def _unroute(self, sub: Subscription) -> None:
+        # persistent routes belong to the inbox; remove via the inbox so
+        # store metadata and dist stay consistent
+        self.inbox.unsub(self.client_info.tenant_id, self.inbox_id,
+                         sub.matcher.mqtt_topic_filter)
+
+    # ---------------- inbox fetch loop (≈ inboxReader.fetch) ---------------
+
+    _drop_on_recv_max = False  # pause the fetch loop, never drop QoS>0
+
+    async def _fetch_loop(self) -> None:
+        tenant = self.client_info.tenant_id
+        try:
+            while not self.closed:
+                await self._fetch_wake.wait()
+                self._fetch_wake.clear()
+                while not self.closed:
+                    budget = self._client_recv_max - len(self._pid_to_seq)
+                    fetched = self.inbox.store.fetch(
+                        tenant, self.inbox_id, max_fetch=100,
+                        qos0_after=self._qos0_cursor,
+                        buffer_after=self._buf_cursor,
+                        max_buffer=max(0, budget))
+                    if fetched is None:
+                        return
+                    if not fetched.qos0 and not fetched.buffer:
+                        break  # drained (or window full): wait for a wake
+                    for seq, topic, msg in fetched.qos0:
+                        self._qos0_cursor = seq
+                        await self._push(topic, msg)
+                    if fetched.qos0:
+                        # qos0 committed on send (reference: commit after push)
+                        self.inbox.store.commit(tenant, self.inbox_id,
+                                                qos0_up_to=self._qos0_cursor)
+                    blocked = False
+                    for seq, topic, msg in fetched.buffer:
+                        if not await self._push(topic, msg, buffer_seq=seq):
+                            blocked = True
+                            break  # retry this seq after acks free the window
+                        self._buf_cursor = seq
+                    if blocked:
+                        break  # _commit_acked wakes us
+        except asyncio.CancelledError:
+            pass
+
+    async def _push(self, topic: str, msg: Message,
+                    buffer_seq: Optional[int] = None) -> bool:
+        """Send one inbox message via the shared send path (properties,
+        retain-as-published, receive-maximum all handled there). Returns
+        False when the send window is exhausted (caller must not advance)."""
+        sub = self._matching_sub(topic)
+        if sub is None:
+            # subscription changed since enqueue; honor the stored QoS
+            sub = Subscription(matcher=None, qos=int(msg.pub_qos))
+        result = await self._send_publish(topic, msg, sub,
+                                          retained=msg.is_retained)
+        if result is BLOCKED:
+            return False
+        if buffer_seq is not None:
+            if isinstance(result, int):
+                self._pid_to_seq[result] = buffer_seq
+            else:
+                # sub got downgraded to qos0: nothing will ack; commit now
+                self._commit_seq_direct(buffer_seq)
+        return True
+
+    def _matching_sub(self, topic: str) -> Optional[Subscription]:
+        from ..utils import topic as topic_util
+        levels = topic_util.parse(topic)
+        for tf, sub in self.subscriptions.items():
+            if topic_util.matches(levels, list(sub.matcher.filter_levels)):
+                return sub
+        return None
+
+    # ---------------- ack handling → commit --------------------------------
+
+    def _commit_seq_direct(self, seq: int) -> None:
+        self._acked_seqs.add(seq)
+        self._advance_commit()
+
+    def _commit_acked(self, pid: int) -> None:
+        seq = self._pid_to_seq.pop(pid, None)
+        if seq is None:
+            return
+        self._acked_seqs.add(seq)
+        self._advance_commit()
+        self._fetch_wake.set()  # freed in-flight budget
+
+    def _advance_commit(self) -> None:
+        up_to = self._committed_seq
+        while up_to + 1 in self._acked_seqs:
+            up_to += 1
+            self._acked_seqs.discard(up_to)
+        if up_to != self._committed_seq:
+            self._committed_seq = up_to
+            self.inbox.store.commit(self.client_info.tenant_id,
+                                    self.inbox_id, buffer_up_to=up_to)
+
+    def _on_puback(self, pid: int) -> None:
+        super()._on_puback(pid)
+        self._commit_acked(pid)
+
+    def _on_pubcomp(self, pid: int) -> None:
+        super()._on_pubcomp(pid)
+        self._commit_acked(pid)

@@ -150,6 +150,12 @@ class _OutboundQoS:
     phase: int  # 1 = awaiting PUBACK/PUBREC, 2 = awaiting PUBCOMP
 
 
+# _send_publish result: the send was gated by receive-maximum / packet-id
+# exhaustion. Transient sessions drop (and report); persistent sessions
+# stop fetching and retry after acks free the window.
+BLOCKED = object()
+
+
 class Session:
     """One connected MQTT session (transient)."""
 
@@ -435,9 +441,7 @@ class Session:
                            retain_handling=req.retain_handling,
                            sub_id=sub_id)
         self.subscriptions[tf] = sub
-        self.dist.match(self.client_info.tenant_id, matcher,
-                        TRANSIENT_SUB_BROKER_ID, self.session_id,
-                        self._deliverer_key())
+        self._route(sub)
         # retained delivery (≈ retainClient.match on SUBSCRIBE)
         if (self.retain_service is not None and ts[Setting.RetainEnabled]
                 and not topic_util.is_shared_subscription(tf)
@@ -470,6 +474,13 @@ class Session:
                                  self.client_info.tenant_id,
                                  {"filters": u.topic_filters}))
 
+    def _route(self, sub: Subscription) -> None:
+        """Register the dist route for a new subscription; persistent
+        sessions override (their routes target the inbox sub-broker)."""
+        self.dist.match(self.client_info.tenant_id, sub.matcher,
+                        TRANSIENT_SUB_BROKER_ID, self.session_id,
+                        self._deliverer_key())
+
     def _unroute(self, sub: Subscription) -> None:
         self.dist.unmatch(self.client_info.tenant_id, sub.matcher,
                           TRANSIENT_SUB_BROKER_ID, self.session_id,
@@ -494,8 +505,14 @@ class Session:
                 await self._send_publish(pack.topic, msg, sub)
         return True
 
+    # transient semantics: a full receive window DROPS QoS>0 messages;
+    # persistent sessions override this to pause their fetch loop instead
+    _drop_on_recv_max = True
+
     async def _send_publish(self, topic: str, msg: Message,
-                            sub: Subscription, retained: bool = False) -> None:
+                            sub: Subscription, retained: bool = False):
+        """Returns None (sent as qos0), the packet id (sent qos>0), or
+        ``BLOCKED`` (receive-maximum / packet-id window exhausted)."""
         qos = min(int(msg.pub_qos), sub.qos)
         retain_flag = (retained if not sub.retain_as_published
                        else (msg.is_retain or retained))
@@ -512,17 +529,19 @@ class Session:
             await self.conn.send(pk.Publish(topic=topic, payload=msg.payload,
                                             qos=0, retain=retain_flag,
                                             properties=props))
-            return
-        if len(self._outbound) >= self._client_recv_max:
-            # receive-maximum exhausted: transient semantics = drop QoS>0
-            dropped = (EventType.QOS1_DROPPED if qos == 1
-                       else EventType.QOS2_DROPPED)
-            self.events.report(Event(dropped, self.client_info.tenant_id,
-                                     {"topic": topic, "reason": "recv_max"}))
-            return
-        pid = self._pid_alloc.alloc()
+            return None
+        pid = None
+        if len(self._outbound) < self._client_recv_max:
+            pid = self._pid_alloc.alloc()
         if pid is None:
-            return
+            if self._drop_on_recv_max:
+                dropped = (EventType.QOS1_DROPPED if qos == 1
+                           else EventType.QOS2_DROPPED)
+                self.events.report(Event(dropped,
+                                         self.client_info.tenant_id,
+                                         {"topic": topic,
+                                          "reason": "recv_max"}))
+            return BLOCKED
         publish = pk.Publish(topic=topic, payload=msg.payload, qos=qos,
                              retain=retain_flag, packet_id=pid,
                              properties=props)
@@ -532,6 +551,7 @@ class Session:
         self.events.report(Event(EventType.DELIVERED,
                                  self.client_info.tenant_id,
                                  {"topic": topic, "qos": qos}))
+        return pid
 
     def _on_puback(self, pid: int) -> None:
         st = self._outbound.pop(pid, None)

@@ -1,0 +1,1 @@
+"""bifromq_tpu.raft — raft consensus (analog of base-kv-raft)."""

@@ -1,0 +1,617 @@
+"""Raft consensus core (≈ reference base-kv-raft).
+
+Re-expression of the reference's from-scratch raft
+(base-kv/base-kv-raft .../raft/RaftNode.java:52 with state classes
+RaftNodeStateLeader/Follower/Candidate, PeerLogReplicator, read-index reads,
+snapshot install, leader transfer). Deliberately tick-driven like the
+reference (RaftNode.tick():99): a host loop calls ``tick()`` at a fixed
+cadence and tests drive time manually — no wall-clock coupling.
+
+Round-1 scope: leader election (randomized timeouts), log replication with
+per-peer next/match index, majority commit, linearizable read-index,
+snapshot install for lagging peers with log compaction, leader transfer
+(TimeoutNow), single-server config change (joint consensus is a later
+round, per SURVEY.md §7 hard-parts).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import random
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Dict, List, Optional, Set, Tuple
+
+
+class Role(enum.Enum):
+    FOLLOWER = "follower"
+    CANDIDATE = "candidate"
+    LEADER = "leader"
+
+
+@dataclass
+class LogEntry:
+    term: int
+    index: int
+    data: bytes
+    # config-change entries carry the new voter set instead of user data
+    config: Optional[Tuple[str, ...]] = None
+
+
+@dataclass
+class Snapshot:
+    last_index: int
+    last_term: int
+    data: bytes
+    voters: Tuple[str, ...]
+
+
+# ------------------------------ messages ------------------------------------
+
+@dataclass
+class RequestVote:
+    term: int
+    candidate: str
+    last_log_index: int
+    last_log_term: int
+
+
+@dataclass
+class VoteReply:
+    term: int
+    granted: bool
+
+
+@dataclass
+class PreVote:
+    """Pre-vote probe (reference has pre-vote, RaftNode.java):
+    asks peers whether a real election at ``term`` could win, WITHOUT
+    disturbing terms — prevents partitioned stragglers from inflating their
+    term and deposing a healthy leader on heal."""
+    term: int   # the term the candidate would campaign at
+    candidate: str
+    last_log_index: int
+    last_log_term: int
+
+
+@dataclass
+class PreVoteReply:
+    term: int
+    granted: bool
+
+
+@dataclass
+class AppendEntries:
+    term: int
+    leader: str
+    prev_index: int
+    prev_term: int
+    entries: List[LogEntry]
+    leader_commit: int
+    read_ctx: Optional[int] = None   # read-index heartbeat correlation
+
+
+@dataclass
+class AppendReply:
+    term: int
+    success: bool
+    match_index: int
+    read_ctx: Optional[int] = None
+
+
+@dataclass
+class InstallSnapshot:
+    term: int
+    leader: str
+    snapshot: Snapshot
+
+
+@dataclass
+class SnapshotReply:
+    term: int
+    match_index: int
+
+
+@dataclass
+class TimeoutNow:
+    term: int
+
+
+RaftMessage = (RequestVote, VoteReply, AppendEntries, AppendReply,
+               InstallSnapshot, SnapshotReply, TimeoutNow)
+
+
+class ITransport:
+    """Fire-and-forget message passing; replies are messages too."""
+
+    def send(self, to: str, sender: str, msg) -> None:
+        raise NotImplementedError
+
+
+class RaftNode:
+    """One raft participant hosting an opaque FSM via ``apply_cb``.
+
+    ``apply_cb(entry)`` is invoked exactly once per committed entry in index
+    order. ``snapshot_cb()`` must return FSM state bytes;
+    ``restore_cb(bytes)`` installs it.
+    """
+
+    ELECTION_TICKS = (10, 20)   # randomized range
+    HEARTBEAT_TICKS = 2
+    MAX_ENTRIES_PER_APPEND = 64
+    SNAPSHOT_THRESHOLD = 256    # compact when log grows beyond this
+
+    def __init__(self, node_id: str, voters: List[str],
+                 transport: ITransport, *,
+                 apply_cb: Callable[[LogEntry], None],
+                 snapshot_cb: Callable[[], bytes] = lambda: b"",
+                 restore_cb: Callable[[bytes], None] = lambda b: None,
+                 rng: Optional[random.Random] = None) -> None:
+        self.id = node_id
+        self.voters: Set[str] = set(voters)
+        self.transport = transport
+        self.apply_cb = apply_cb
+        self.snapshot_cb = snapshot_cb
+        self.restore_cb = restore_cb
+        self.rng = rng or random.Random(hash(node_id) & 0xFFFF)
+
+        self.role = Role.FOLLOWER
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self.leader_id: Optional[str] = None
+        # log[0] is a sentinel for (snap_index, snap_term)
+        self.snap = Snapshot(last_index=0, last_term=0, data=b"",
+                             voters=tuple(voters))
+        self.log: List[LogEntry] = []
+        self.commit_index = 0
+        self.last_applied = 0
+
+        self._votes: Set[str] = set()
+        self._next_index: Dict[str, int] = {}
+        self._match_index: Dict[str, int] = {}
+        self._election_elapsed = 0
+        self._heartbeat_elapsed = 0
+        self._election_deadline = self._rand_election()
+        self._propose_waiters: Dict[int, asyncio.Future] = {}
+        self._read_waiters: Dict[int, Tuple[asyncio.Future, Set[str], int]] = {}
+        self._read_ctx_seq = 0
+        self._transfer_target: Optional[str] = None
+        self.stopped = False
+
+    # ---------------- log helpers ------------------------------------------
+
+    def _rand_election(self) -> int:
+        return self.rng.randint(*self.ELECTION_TICKS)
+
+    @property
+    def last_index(self) -> int:
+        return self.log[-1].index if self.log else self.snap.last_index
+
+    @property
+    def last_term(self) -> int:
+        return self.log[-1].term if self.log else self.snap.last_term
+
+    def _entry(self, index: int) -> Optional[LogEntry]:
+        if index <= self.snap.last_index or index > self.last_index:
+            return None
+        return self.log[index - self.snap.last_index - 1]
+
+    def _term_at(self, index: int) -> Optional[int]:
+        if index == self.snap.last_index:
+            return self.snap.last_term
+        e = self._entry(index)
+        return e.term if e else None
+
+    def _entries_from(self, index: int) -> List[LogEntry]:
+        if index <= self.snap.last_index:
+            return []
+        return self.log[index - self.snap.last_index - 1:]
+
+    # ---------------- public API -------------------------------------------
+
+    def tick(self) -> None:
+        """Advance logical time by one tick (≈ RaftNode.tick():99)."""
+        if self.stopped:
+            return
+        if self.role == Role.LEADER:
+            self._heartbeat_elapsed += 1
+            if self._heartbeat_elapsed >= self.HEARTBEAT_TICKS:
+                self._heartbeat_elapsed = 0
+                self._broadcast_append()
+        else:
+            self._election_elapsed += 1
+            if self._election_elapsed >= self._election_deadline:
+                self._start_prevote()
+
+    def propose(self, data: bytes) -> "asyncio.Future[int]":
+        """Append a command; resolves with its index once committed.
+
+        Rejected immediately when not leader (caller retries via the
+        leader hint), matching the reference's leader-only propose.
+        """
+        fut = asyncio.get_running_loop().create_future()
+        if self.role != Role.LEADER:
+            fut.set_exception(NotLeaderError(self.leader_id))
+            return fut
+        entry = LogEntry(term=self.term, index=self.last_index + 1, data=data)
+        self.log.append(entry)
+        self._propose_waiters[entry.index] = fut
+        self._match_index[self.id] = self.last_index
+        self._broadcast_append()
+        self._maybe_commit()
+        return fut
+
+    def read_index(self) -> "asyncio.Future[int]":
+        """Linearizable read barrier (≈ RaftNode.readIndex():141): resolves
+        with a commit index safe to serve reads at, after a heartbeat round
+        confirms leadership."""
+        fut = asyncio.get_running_loop().create_future()
+        if self.role != Role.LEADER:
+            fut.set_exception(NotLeaderError(self.leader_id))
+            return fut
+        if len(self.voters) == 1:
+            fut.set_result(self.commit_index)
+            return fut
+        self._read_ctx_seq += 1
+        ctx = self._read_ctx_seq
+        self._read_waiters[ctx] = (fut, {self.id}, self.commit_index)
+        self._broadcast_append(read_ctx=ctx)
+        return fut
+
+    def change_config(self, new_voters: List[str]) -> "asyncio.Future[int]":
+        """Single-server membership change (add or remove one voter)."""
+        fut = asyncio.get_running_loop().create_future()
+        if self.role != Role.LEADER:
+            fut.set_exception(NotLeaderError(self.leader_id))
+            return fut
+        diff = self.voters.symmetric_difference(new_voters)
+        if len(diff) > 1:
+            fut.set_exception(ValueError("one voter change at a time"))
+            return fut
+        entry = LogEntry(term=self.term, index=self.last_index + 1, data=b"",
+                         config=tuple(sorted(new_voters)))
+        self.log.append(entry)
+        # config applies immediately on append (raft single-server change)
+        self._apply_config(entry.config)
+        self._propose_waiters[entry.index] = fut
+        self._match_index[self.id] = self.last_index
+        self._broadcast_append()
+        self._maybe_commit()
+        return fut
+
+    def transfer_leadership(self, target: str) -> None:
+        """(≈ RaftNode.transferLeadership():171)"""
+        if self.role != Role.LEADER or target not in self.voters:
+            return
+        self._transfer_target = target
+        if self._match_index.get(target, 0) == self.last_index:
+            self.transport.send(target, self.id, TimeoutNow(term=self.term))
+        # else: replication catch-up will trigger it from _on_append_reply
+
+    def stop(self) -> None:
+        self.stopped = True
+
+    # ---------------- message handling -------------------------------------
+
+    def receive(self, sender: str, msg) -> None:
+        if self.stopped:
+            return
+        # pre-vote traffic must not disturb terms
+        if isinstance(msg, PreVote):
+            self._on_pre_vote(sender, msg)
+            return
+        if isinstance(msg, PreVoteReply):
+            self._on_pre_vote_reply(sender, msg)
+            return
+        term = getattr(msg, "term", None)
+        if term is not None and term > self.term:
+            self._become_follower(term, None)
+        if isinstance(msg, RequestVote):
+            self._on_request_vote(sender, msg)
+        elif isinstance(msg, VoteReply):
+            self._on_vote_reply(sender, msg)
+        elif isinstance(msg, AppendEntries):
+            self._on_append(sender, msg)
+        elif isinstance(msg, AppendReply):
+            self._on_append_reply(sender, msg)
+        elif isinstance(msg, InstallSnapshot):
+            self._on_install_snapshot(sender, msg)
+        elif isinstance(msg, SnapshotReply):
+            self._on_snapshot_reply(sender, msg)
+        elif isinstance(msg, TimeoutNow):
+            if msg.term == self.term and self.id in self.voters:
+                self._start_election()
+
+    # ---------------- elections --------------------------------------------
+
+    def _become_follower(self, term: int, leader: Optional[str]) -> None:
+        if term > self.term:
+            self.term = term
+            self.voted_for = None
+        prev_role = self.role
+        self.role = Role.FOLLOWER
+        self.leader_id = leader
+        self._election_elapsed = 0
+        self._election_deadline = self._rand_election()
+        if prev_role == Role.LEADER:
+            self._fail_waiters()
+
+    def _start_prevote(self) -> None:
+        """Probe electability before burning a term (pre-vote)."""
+        if self.id not in self.voters:
+            return
+        self._election_elapsed = 0
+        self._election_deadline = self._rand_election()
+        self._prevotes = {self.id}
+        if len(self._prevotes & self.voters) * 2 > len(self.voters):
+            self._start_election()
+            return
+        for peer in self.voters - {self.id}:
+            self.transport.send(peer, self.id, PreVote(
+                term=self.term + 1, candidate=self.id,
+                last_log_index=self.last_index, last_log_term=self.last_term))
+
+    def _on_pre_vote(self, sender: str, msg: PreVote) -> None:
+        up_to_date = (msg.last_log_term, msg.last_log_index) >= (
+            self.last_term, self.last_index)
+        # leader stickiness: only grant if we haven't heard from a live
+        # leader recently (or never knew one)
+        no_recent_leader = (self.leader_id is None
+                            or self._election_elapsed
+                            >= self.ELECTION_TICKS[0])
+        granted = (msg.term >= self.term and up_to_date and no_recent_leader
+                   and self.role != Role.LEADER)
+        self.transport.send(sender, self.id,
+                            PreVoteReply(term=self.term, granted=granted))
+
+    def _on_pre_vote_reply(self, sender: str, msg: PreVoteReply) -> None:
+        if self.role == Role.LEADER or not hasattr(self, "_prevotes"):
+            return
+        if msg.granted:
+            self._prevotes.add(sender)
+            if len(self._prevotes & self.voters) * 2 > len(self.voters):
+                self._prevotes = set()
+                self._start_election()
+
+    def _start_election(self) -> None:
+        if self.id not in self.voters:
+            return
+        self.role = Role.CANDIDATE
+        self.term += 1
+        self.voted_for = self.id
+        self.leader_id = None
+        self._votes = {self.id}
+        self._election_elapsed = 0
+        self._election_deadline = self._rand_election()
+        for peer in self.voters - {self.id}:
+            self.transport.send(peer, self.id, RequestVote(
+                term=self.term, candidate=self.id,
+                last_log_index=self.last_index, last_log_term=self.last_term))
+        self._check_majority_votes()
+
+    def _on_request_vote(self, sender: str, msg: RequestVote) -> None:
+        granted = False
+        if msg.term >= self.term:
+            up_to_date = (msg.last_log_term, msg.last_log_index) >= (
+                self.last_term, self.last_index)
+            if up_to_date and self.voted_for in (None, msg.candidate):
+                granted = True
+                self.voted_for = msg.candidate
+                self._election_elapsed = 0
+        self.transport.send(sender, self.id,
+                            VoteReply(term=self.term, granted=granted))
+
+    def _on_vote_reply(self, sender: str, msg: VoteReply) -> None:
+        if self.role != Role.CANDIDATE or msg.term != self.term:
+            return
+        if msg.granted:
+            self._votes.add(sender)
+            self._check_majority_votes()
+
+    def _check_majority_votes(self) -> None:
+        if len(self._votes & self.voters) * 2 > len(self.voters):
+            self._become_leader()
+
+    def _become_leader(self) -> None:
+        self.role = Role.LEADER
+        self.leader_id = self.id
+        self._transfer_target = None
+        self._heartbeat_elapsed = 0
+        self._next_index = {p: self.last_index + 1 for p in self.voters}
+        self._match_index = {p: 0 for p in self.voters}
+        self._match_index[self.id] = self.last_index
+        # no-op entry to commit prior-term entries promptly
+        self.log.append(LogEntry(term=self.term, index=self.last_index + 1,
+                                 data=b""))
+        self._match_index[self.id] = self.last_index
+        self._broadcast_append()
+
+    # ---------------- replication ------------------------------------------
+
+    def _broadcast_append(self, read_ctx: Optional[int] = None) -> None:
+        for peer in self.voters - {self.id}:
+            self._send_append(peer, read_ctx=read_ctx)
+
+    def _send_append(self, peer: str,
+                     read_ctx: Optional[int] = None) -> None:
+        nxt = self._next_index.get(peer, self.last_index + 1)
+        if nxt <= self.snap.last_index:
+            # ship the materialized snapshot: its data was captured at
+            # compaction time and is consistent with its last_index label
+            self.transport.send(peer, self.id, InstallSnapshot(
+                term=self.term, leader=self.id, snapshot=self.snap))
+            return
+        prev_index = nxt - 1
+        prev_term = self._term_at(prev_index)
+        if prev_term is None:
+            prev_index = self.snap.last_index
+            prev_term = self.snap.last_term
+        entries = self._entries_from(nxt)[:self.MAX_ENTRIES_PER_APPEND]
+        self.transport.send(peer, self.id, AppendEntries(
+            term=self.term, leader=self.id, prev_index=prev_index,
+            prev_term=prev_term, entries=list(entries),
+            leader_commit=self.commit_index, read_ctx=read_ctx))
+
+    def _on_append(self, sender: str, msg: AppendEntries) -> None:
+        if msg.term < self.term:
+            self.transport.send(sender, self.id, AppendReply(
+                term=self.term, success=False, match_index=0,
+                read_ctx=msg.read_ctx))
+            return
+        self._become_follower(msg.term, msg.leader)
+        local_prev_term = self._term_at(msg.prev_index)
+        if local_prev_term is None or local_prev_term != msg.prev_term:
+            self.transport.send(sender, self.id, AppendReply(
+                term=self.term, success=False,
+                match_index=self.snap.last_index, read_ctx=msg.read_ctx))
+            return
+        for e in msg.entries:
+            existing = self._term_at(e.index)
+            if existing is None or existing != e.term:
+                # truncate conflicting suffix, then append
+                self.log = self.log[:max(0, e.index - self.snap.last_index - 1)]
+                self.log.append(e)
+                if e.config is not None:
+                    self._apply_config(e.config)
+        match = msg.prev_index + len(msg.entries)
+        if msg.leader_commit > self.commit_index:
+            self.commit_index = min(msg.leader_commit, self.last_index)
+            self._apply_committed()
+        self.transport.send(sender, self.id, AppendReply(
+            term=self.term, success=True, match_index=match,
+            read_ctx=msg.read_ctx))
+
+    def _on_append_reply(self, sender: str, msg: AppendReply) -> None:
+        if self.role != Role.LEADER or msg.term != self.term:
+            return
+        if msg.success:
+            self._match_index[sender] = max(
+                self._match_index.get(sender, 0), msg.match_index)
+            self._next_index[sender] = self._match_index[sender] + 1
+            self._maybe_commit()
+            if msg.read_ctx is not None:
+                self._ack_read(sender, msg.read_ctx)
+            if (self._transfer_target == sender
+                    and self._match_index[sender] == self.last_index):
+                self.transport.send(sender, self.id,
+                                    TimeoutNow(term=self.term))
+            elif self._match_index[sender] < self.last_index:
+                self._send_append(sender)
+        else:
+            # back off; fast-rewind to the follower's snapshot boundary hint
+            hint = msg.match_index + 1
+            self._next_index[sender] = min(
+                hint, max(1, self._next_index.get(sender, 1) - 1))
+            self._send_append(sender)
+
+    def _maybe_commit(self) -> None:
+        if self.role != Role.LEADER:
+            return
+        for idx in range(self.last_index, self.commit_index, -1):
+            t = self._term_at(idx)
+            if t != self.term:
+                continue  # only commit current-term entries by counting
+            votes = sum(1 for p in self.voters
+                        if self._match_index.get(p, 0) >= idx)
+            if votes * 2 > len(self.voters):
+                self.commit_index = idx
+                self._apply_committed()
+                break
+
+    def _apply_committed(self) -> None:
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            e = self._entry(self.last_applied)
+            if e is not None and e.config is None and e.data:
+                self.apply_cb(e)
+            fut = self._propose_waiters.pop(self.last_applied, None)
+            if fut is not None and not fut.done():
+                fut.set_result(self.last_applied)
+        self._maybe_compact()
+
+    # ---------------- read index -------------------------------------------
+
+    def _ack_read(self, sender: str, ctx: int) -> None:
+        st = self._read_waiters.get(ctx)
+        if st is None:
+            return
+        fut, acks, commit_at = st
+        acks.add(sender)
+        if len(acks & self.voters) * 2 > len(self.voters):
+            del self._read_waiters[ctx]
+            if not fut.done():
+                fut.set_result(commit_at)
+
+    # ---------------- snapshots --------------------------------------------
+
+    def _maybe_compact(self) -> None:
+        if len(self.log) <= self.SNAPSHOT_THRESHOLD:
+            return
+        # the snapshot MUST be cut exactly at last_applied: snapshot_cb()
+        # serializes FSM state as applied through last_applied, and labeling
+        # it lower would make followers re-apply covered entries
+        cut = self.last_applied
+        if cut <= self.snap.last_index:
+            return
+        term = self._term_at(cut)
+        if term is None:
+            return
+        # slice with the OLD snapshot offset before replacing it
+        new_log = self._entries_from(cut + 1)
+        self.snap = Snapshot(last_index=cut, last_term=term,
+                             data=self.snapshot_cb(),
+                             voters=tuple(sorted(self.voters)))
+        self.log = new_log
+
+    def _on_install_snapshot(self, sender: str, msg: InstallSnapshot) -> None:
+        if msg.term < self.term:
+            return
+        self._become_follower(msg.term, msg.leader)
+        if msg.snapshot.last_index <= self.commit_index:
+            self.transport.send(sender, self.id, SnapshotReply(
+                term=self.term, match_index=self.commit_index))
+            return
+        self.snap = msg.snapshot
+        self.log = []
+        self.commit_index = msg.snapshot.last_index
+        self.last_applied = msg.snapshot.last_index
+        self.voters = set(msg.snapshot.voters)
+        self.restore_cb(msg.snapshot.data)
+        self.transport.send(sender, self.id, SnapshotReply(
+            term=self.term, match_index=msg.snapshot.last_index))
+
+    def _on_snapshot_reply(self, sender: str, msg: SnapshotReply) -> None:
+        if self.role != Role.LEADER or msg.term != self.term:
+            return
+        self._match_index[sender] = max(self._match_index.get(sender, 0),
+                                        msg.match_index)
+        self._next_index[sender] = self._match_index[sender] + 1
+        self._send_append(sender)
+
+    # ---------------- config -----------------------------------------------
+
+    def _apply_config(self, voters: Tuple[str, ...]) -> None:
+        self.voters = set(voters)
+        if self.role == Role.LEADER:
+            for p in self.voters:
+                self._next_index.setdefault(p, self.last_index + 1)
+                self._match_index.setdefault(p, 0)
+            if self.id not in self.voters:
+                # removed leader steps down after the change commits
+                pass
+
+    def _fail_waiters(self) -> None:
+        for fut in self._propose_waiters.values():
+            if not fut.done():
+                fut.set_exception(NotLeaderError(self.leader_id))
+        self._propose_waiters.clear()
+        for fut, _, _ in self._read_waiters.values():
+            if not fut.done():
+                fut.set_exception(NotLeaderError(self.leader_id))
+        self._read_waiters.clear()
+
+
+class NotLeaderError(Exception):
+    def __init__(self, leader_hint: Optional[str]) -> None:
+        super().__init__(f"not leader (hint: {leader_hint})")
+        self.leader_hint = leader_hint

@@ -1,0 +1,345 @@
+"""Persistent-session integration tests: offline delivery, session resume,
+expiry, inbox queue semantics. Mirrors the reference's persistent-session
+integration scenarios (bifromq-mqtt .../integration and inbox-store tests).
+"""
+
+import asyncio
+
+import pytest
+
+from bifromq_tpu.inbox.store import InboxStore
+from bifromq_tpu.kv.engine import InMemKVEngine
+from bifromq_tpu.mqtt.broker import MQTTBroker
+from bifromq_tpu.mqtt.client import MQTTClient
+from bifromq_tpu.mqtt.protocol import PropertyId
+from bifromq_tpu.plugin.events import CollectingEventCollector, EventType
+from bifromq_tpu.plugin.settings import DefaultSettingProvider, Setting
+from bifromq_tpu.types import Message, QoS, TopicFilterOption
+
+pytestmark = pytest.mark.asyncio
+
+
+@pytest.fixture
+async def broker():
+    b = MQTTBroker(port=0)
+    await b.start()
+    yield b
+    b.inbox.close()
+    await b.stop()
+
+
+async def connect_persistent(broker, client_id, *, v5=False, expiry=300,
+                             clean=False, **kw):
+    if v5:
+        c = MQTTClient(port=broker.port, client_id=client_id,
+                       protocol_level=5, clean_start=clean,
+                       properties={PropertyId.SESSION_EXPIRY_INTERVAL: expiry},
+                       **kw)
+    else:
+        c = MQTTClient(port=broker.port, client_id=client_id,
+                       clean_start=clean, **kw)
+    await c.connect()
+    return c
+
+
+class TestOfflineDelivery:
+    async def test_qos1_offline_then_resume(self, broker):
+        c = await connect_persistent(broker, "dev1")
+        assert not c.connack.session_present
+        await c.subscribe("alerts/#", qos=1)
+        await c.disconnect()
+
+        p = MQTTClient(port=broker.port, client_id="pub")
+        await p.connect()
+        for i in range(3):
+            assert await p.publish("alerts/fire", f"a{i}".encode(), qos=1) == 0
+        await p.disconnect()
+
+        c2 = await connect_persistent(broker, "dev1")
+        assert c2.connack.session_present
+        got = [await c2.recv() for _ in range(3)]
+        assert [m.payload for m in got] == [b"a0", b"a1", b"a2"]
+        assert all(m.qos == 1 for m in got)
+        await c2.disconnect()
+
+    async def test_qos0_offline_queued(self, broker):
+        c = await connect_persistent(broker, "dev0")
+        await c.subscribe("news/#", qos=0)
+        await c.disconnect()
+        p = MQTTClient(port=broker.port, client_id="pub0")
+        await p.connect()
+        await p.publish("news/today", b"hello", qos=1)
+        await p.disconnect()
+        c2 = await connect_persistent(broker, "dev0")
+        msg = await c2.recv()
+        assert msg.payload == b"hello" and msg.qos == 0
+        await c2.disconnect()
+
+    async def test_online_delivery_via_inbox(self, broker):
+        c = await connect_persistent(broker, "live1")
+        await c.subscribe("t/x", qos=1)
+        p = MQTTClient(port=broker.port, client_id="pubx")
+        await p.connect()
+        await p.publish("t/x", b"now", qos=1)
+        msg = await c.recv()
+        assert msg.payload == b"now"
+        await c.disconnect()
+        await p.disconnect()
+
+    async def test_acked_not_redelivered(self, broker):
+        c = await connect_persistent(broker, "ack1")
+        await c.subscribe("q/t", qos=1)
+        p = MQTTClient(port=broker.port, client_id="puba")
+        await p.connect()
+        await p.publish("q/t", b"m1", qos=1)
+        msg = await c.recv()      # client auto-acks qos1
+        assert msg.payload == b"m1"
+        await asyncio.sleep(0.2)  # let the commit land
+        await c.disconnect()
+        c2 = await connect_persistent(broker, "ack1")
+        assert c2.connack.session_present
+        with pytest.raises(asyncio.TimeoutError):
+            await c2.recv(timeout=0.4)
+        await c2.disconnect()
+        await p.disconnect()
+
+    async def test_clean_start_wipes_session(self, broker):
+        c = await connect_persistent(broker, "wipe1")
+        await c.subscribe("w/#", qos=1)
+        await c.disconnect()
+        p = MQTTClient(port=broker.port, client_id="pubw")
+        await p.connect()
+        await p.publish("w/x", b"lost", qos=1)
+        await p.disconnect()
+        # clean start discards state
+        c2 = await connect_persistent(broker, "wipe1", clean=True)
+        assert not c2.connack.session_present
+        with pytest.raises(asyncio.TimeoutError):
+            await c2.recv(timeout=0.4)
+        await c2.disconnect()
+
+    async def test_unsubscribe_stops_offline_queue(self, broker):
+        c = await connect_persistent(broker, "u1")
+        await c.subscribe("u/t", qos=1)
+        await c.unsubscribe("u/t")
+        await c.disconnect()
+        p = MQTTClient(port=broker.port, client_id="pubu")
+        await p.connect()
+        await p.publish("u/t", b"x", qos=1)
+        await p.disconnect()
+        c2 = await connect_persistent(broker, "u1")
+        with pytest.raises(asyncio.TimeoutError):
+            await c2.recv(timeout=0.4)
+        await c2.disconnect()
+
+    async def test_v5_expiry_session(self, broker):
+        c = await connect_persistent(broker, "exp1", v5=True, expiry=300)
+        await c.subscribe("e/t", qos=1)
+        await c.disconnect()
+        p = MQTTClient(port=broker.port, client_id="pube")
+        await p.connect()
+        await p.publish("e/t", b"kept", qos=1)
+        await p.disconnect()
+        c2 = await connect_persistent(broker, "exp1", v5=True, expiry=300)
+        assert c2.connack.session_present
+        assert (await c2.recv()).payload == b"kept"
+        await c2.disconnect()
+
+    async def test_v5_zero_expiry_is_transient_state(self, broker):
+        c = await connect_persistent(broker, "z1", v5=True, expiry=0)
+        await c.subscribe("z/t", qos=1)
+        await c.disconnect()
+        c2 = await connect_persistent(broker, "z1", v5=True, expiry=0)
+        assert not c2.connack.session_present
+        await c2.disconnect()
+
+    async def test_kick_takes_over_inbox(self, broker):
+        c1 = await connect_persistent(broker, "ko1")
+        await c1.subscribe("k/t", qos=1)
+        c2 = await connect_persistent(broker, "ko1")
+        await asyncio.wait_for(c1.closed.wait(), 5)
+        assert c2.connack.session_present  # took over, state intact
+        p = MQTTClient(port=broker.port, client_id="pubk")
+        await p.connect()
+        await p.publish("k/t", b"after-kick", qos=1)
+        assert (await c2.recv()).payload == b"after-kick"
+        await c2.disconnect()
+        await p.disconnect()
+
+
+class TestSessionExpiryGC:
+    async def test_expired_session_cleaned(self):
+        now = [1000.0]
+        b = MQTTBroker(port=0)
+        b.inbox.clock = lambda: now[0]
+        b.inbox.store.clock = lambda: now[0]
+        b.inbox.delay.clock = lambda: now[0]
+        await b.start()
+        try:
+            c = await connect_persistent(b, "gc1", v5=True, expiry=10)
+            await c.subscribe("g/t", qos=1)
+            await c.disconnect()
+            await asyncio.sleep(0.1)
+            assert b.inbox.store.exists("DevOnly", "gc1")
+            now[0] = 1020.0
+            n = await b.inbox.gc()
+            assert n == 1
+            assert not b.inbox.store.exists("DevOnly", "gc1")
+            # routes dropped too: publish matches nothing
+            assert len(b.dist.matcher.tries.get("DevOnly", ())) == 0
+        finally:
+            b.inbox.close()
+            await b.stop()
+
+
+class TestInboxStoreUnit:
+    def setup_method(self):
+        self.now = [100.0]
+        engine = InMemKVEngine()
+        self.store = InboxStore(engine.create_space("t"),
+                                CollectingEventCollector(),
+                                clock=lambda: self.now[0])
+
+    def mk_msg(self, payload=b"x", qos=1):
+        return Message(message_id=0, pub_qos=QoS(qos), payload=payload,
+                       timestamp=0)
+
+    def test_attach_detach_expire(self):
+        meta, present = self.store.attach("T", "i1", clean_start=False,
+                                          expiry_seconds=60)
+        assert not present
+        meta2, present2 = self.store.attach("T", "i1", clean_start=False,
+                                           expiry_seconds=60)
+        assert present2 and meta2.incarnation == meta.incarnation
+        self.store.detach("T", "i1")
+        self.now[0] += 100
+        assert not self.store.exists("T", "i1")
+        _, present3 = self.store.attach("T", "i1", clean_start=False,
+                                       expiry_seconds=60)
+        assert not present3  # expired: fresh incarnation
+
+    def test_queue_roundtrip_and_commit(self):
+        self.store.attach("T", "i1", clean_start=True, expiry_seconds=60)
+        self.store.sub("T", "i1", "a/#",
+                       TopicFilterOption(qos=QoS.AT_LEAST_ONCE), 10)
+        for i in range(5):
+            r = self.store.insert("T", "i1", "a/b", self.mk_msg(f"m{i}".encode()),
+                                  "a/#", inbox_size=100, drop_oldest=False)
+            assert r.ok
+        f = self.store.fetch("T", "i1")
+        assert [m[2].payload for m in f.buffer] == [b"m0", b"m1", b"m2",
+                                                    b"m3", b"m4"]
+        self.store.commit("T", "i1", buffer_up_to=2)
+        f2 = self.store.fetch("T", "i1")
+        assert [m[2].payload for m in f2.buffer] == [b"m3", b"m4"]
+
+    def test_qos0_drop_oldest(self):
+        self.store.attach("T", "i1", clean_start=True, expiry_seconds=60)
+        self.store.sub("T", "i1", "a",
+                       TopicFilterOption(qos=QoS.AT_MOST_ONCE), 10)
+        for i in range(5):
+            self.store.insert("T", "i1", "a", self.mk_msg(f"m{i}".encode(), 0),
+                              "a", inbox_size=3, drop_oldest=True)
+        f = self.store.fetch("T", "i1")
+        assert [m[2].payload for m in f.qos0] == [b"m2", b"m3", b"m4"]
+
+    def test_buffer_full_drops_new(self):
+        self.store.attach("T", "i1", clean_start=True, expiry_seconds=60)
+        self.store.sub("T", "i1", "a",
+                       TopicFilterOption(qos=QoS.AT_LEAST_ONCE), 10)
+        for i in range(4):
+            r = self.store.insert("T", "i1", "a", self.mk_msg(qos=1),
+                                  "a", inbox_size=2, drop_oldest=False)
+        f = self.store.fetch("T", "i1")
+        assert len(f.buffer) == 2
+
+    def test_insert_no_sub_returns_none(self):
+        self.store.attach("T", "i1", clean_start=True, expiry_seconds=60)
+        assert self.store.insert("T", "i1", "a", self.mk_msg(), "nope",
+                                 inbox_size=10, drop_oldest=False) is None
+
+    def test_qos_downgrade_on_insert(self):
+        self.store.attach("T", "i1", clean_start=True, expiry_seconds=60)
+        self.store.sub("T", "i1", "a",
+                       TopicFilterOption(qos=QoS.AT_MOST_ONCE), 10)
+        self.store.insert("T", "i1", "a", self.mk_msg(qos=2), "a",
+                          inbox_size=10, drop_oldest=False)
+        f = self.store.fetch("T", "i1")
+        assert len(f.qos0) == 1 and not f.buffer  # downgraded to sub qos 0
+
+
+class TestReviewRegressions:
+    async def test_transient_connect_wipes_persistent_state(self, broker):
+        c = await connect_persistent(broker, "mix1")
+        await c.subscribe("m/#", qos=1)
+        await c.disconnect()
+        # transient reconnect (clean session) must discard inbox + routes
+        t = MQTTClient(port=broker.port, client_id="mix1", clean_start=True)
+        await t.connect()
+        assert not broker.inbox.store.exists("DevOnly", "mix1")
+        assert len(broker.dist.matcher.tries.get("DevOnly", ())) == 0
+        await t.disconnect()
+        # later persistent connect starts fresh
+        c2 = await connect_persistent(broker, "mix1")
+        assert not c2.connack.session_present
+        await c2.disconnect()
+
+    async def test_receive_maximum_respected(self, broker):
+        from bifromq_tpu.mqtt.protocol import PropertyId as P
+        c = MQTTClient(port=broker.port, client_id="rm1", protocol_level=5,
+                       clean_start=False,
+                       properties={P.SESSION_EXPIRY_INTERVAL: 300,
+                                   P.RECEIVE_MAXIMUM: 3})
+        await c.connect()
+        await c.subscribe("rm/t", qos=1)
+        await c.disconnect()
+        p = MQTTClient(port=broker.port, client_id="rmp")
+        await p.connect()
+        for i in range(10):
+            await p.publish("rm/t", f"{i}".encode(), qos=1)
+        await p.disconnect()
+        # suppress the client's auto-ack so in-flight stays at the window cap
+        c2 = MQTTClient(port=broker.port, client_id="rm1", protocol_level=5,
+                        clean_start=False,
+                        properties={P.SESSION_EXPIRY_INTERVAL: 300,
+                                    P.RECEIVE_MAXIMUM: 3})
+        orig = c2._on_packet
+
+        async def no_ack(pkt):
+            from bifromq_tpu.mqtt import packets as pkx
+            if isinstance(pkt, pkx.Publish):
+                await c2.messages.put(pkt)  # receive without acking
+                return
+            await orig(pkt)
+
+        c2._on_packet = no_ack
+        await c2.connect()
+        got = []
+        while True:
+            try:
+                got.append(await c2.recv(timeout=0.5))
+            except asyncio.TimeoutError:
+                break
+        assert len(got) == 3  # exactly receive-maximum in flight, no more
+        await c2.disconnect()
+
+    async def test_raft_snapshot_no_double_apply(self):
+        # follower restored from snapshot must not re-apply covered entries
+        import sys
+        sys.path.insert(0, "tests")
+        from test_raft import Cluster
+        from bifromq_tpu.raft.node import RaftNode
+        c = Cluster(3)
+        leader = c.elect()
+        straggler = next(nid for nid in c.ids if nid != leader.id)
+        c.transport.partition({straggler}, set(c.ids) - {straggler})
+        n = RaftNode.SNAPSHOT_THRESHOLD + 40
+        for i in range(n):
+            fut = c.leader().propose(f"v{i}".encode())
+            c.run_until(lambda: fut.done())
+            await fut
+        c.transport.heal()
+        c.run_until(lambda: c.nodes[straggler].commit_index
+                    >= c.leader().commit_index, max_ticks=3000)
+        datas = [d for _, d in c.applied[straggler]]
+        assert len(datas) == len(set(datas)), "double-applied entries"
