@@ -99,7 +99,9 @@ def main():
     pipeline_total = np.asarray(jnp.stack(sums))
     elapsed = time.perf_counter() - s
     topics_per_s = BATCH * ITERS / elapsed
-    log(f"pipelined: {ITERS} batches x {BATCH} topics in {elapsed:.2f}s")
+    routes_per_s = float(pipeline_total.sum()) / elapsed
+    log(f"pipelined: {ITERS} batches x {BATCH} topics in {elapsed:.2f}s "
+        f"({routes_per_s:,.0f} matched routes/s)")
 
     # ---- latency: individual synchronous roundtrips -----------------------
     lat = []
@@ -137,6 +139,7 @@ def main():
         "matched_routes_sample": total_matched,
         "overflow_sample": overflow_n,
         "host_tokenize_topics_per_s": round(tok_rate, 1),
+        "matched_routes_per_s": round(routes_per_s, 1),
     }
     log(f"extras: {json.dumps(extras)}")
     print(json.dumps(result), flush=True)

@@ -1,0 +1,4 @@
+"""bifromq_tpu.cluster — gossip membership (analog of base-cluster)."""
+from .membership import AgentHost
+
+__all__ = ["AgentHost"]

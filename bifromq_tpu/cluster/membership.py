@@ -1,0 +1,289 @@
+"""Cluster membership: SWIM-style gossip over UDP (≈ base-cluster).
+
+Reference shape (SURVEY.md §2.2): shared-port UDP gossip transport,
+infection-style dissemination (Gossiper.java:46), SWIM direct + indirect
+probing (fd/FailureDetector.java:54 probe():190), CRDT-backed member list
+with auto-join/heal/drop (HostMemberList, AutoSeeder/AutoHealer/AutoDropper),
+and logical *agents* (service groups) riding membership (agent/Agent.java,
+IAgentHost.host():65).
+
+Here: one asyncio datagram endpoint per host carries pings/acks with
+piggybacked membership + agent state. Member records are (incarnation,
+status) LWW registers — a refuting node bumps its own incarnation, the
+standard SWIM suspicion-refutation rule. Agents are per-node registrations
+disseminated the same way; ``agent_members(agent_id)`` is the service
+discovery primitive the RPC layer builds on.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+ALIVE = "alive"
+SUSPECT = "suspect"
+DEAD = "dead"
+
+
+@dataclass
+class MemberState:
+    node_id: str
+    addr: Tuple[str, int]
+    incarnation: int = 0
+    status: str = ALIVE
+    # agent_id -> metadata dict (services this node exposes)
+    agents: Dict[str, dict] = field(default_factory=dict)
+    status_at: float = field(default_factory=time.time)
+
+    def record(self) -> dict:
+        return {"id": self.node_id, "addr": list(self.addr),
+                "inc": self.incarnation, "st": self.status,
+                "agents": self.agents}
+
+
+class AgentHost(asyncio.DatagramProtocol):
+    """One cluster participant (≈ IAgentHost)."""
+
+    PROBE_INTERVAL = 0.15
+    PROBE_TIMEOUT = 0.12
+    INDIRECT_K = 2
+    SUSPECT_TIMEOUT = 0.8
+    DEAD_REAP = 5.0
+    GOSSIP_FANOUT = 3
+
+    def __init__(self, node_id: str, host: str = "127.0.0.1",
+                 port: int = 0, *, seeds: Optional[List[Tuple[str, int]]] = None,
+                 rng: Optional[random.Random] = None) -> None:
+        self.node_id = node_id
+        self.host = host
+        self.port = port
+        self.seeds = seeds or []
+        self.rng = rng or random.Random()
+        self.members: Dict[str, MemberState] = {}
+        self.transport: Optional[asyncio.DatagramTransport] = None
+        self._probe_task: Optional[asyncio.Task] = None
+        self._acks: Dict[int, asyncio.Future] = {}
+        self._seq = 0
+        self._listeners: List[Callable[[], None]] = []
+        self.stopped = False
+
+    # ---------------- lifecycle -------------------------------------------
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self.transport, _ = await loop.create_datagram_endpoint(
+            lambda: self, local_addr=(self.host, self.port))
+        self.port = self.transport.get_extra_info("sockname")[1]
+        self.members[self.node_id] = MemberState(
+            node_id=self.node_id, addr=(self.host, self.port))
+        for seed in self.seeds:
+            self._send(tuple(seed), {"t": "join"})
+        self._probe_task = loop.create_task(self._probe_loop())
+
+    async def stop(self) -> None:
+        self.stopped = True
+        if self._probe_task is not None:
+            self._probe_task.cancel()
+        if self.transport is not None:
+            self.transport.close()
+
+    # ---------------- agents (service groups) ------------------------------
+
+    def host_agent(self, agent_id: str, metadata: Optional[dict] = None) -> None:
+        """Announce a logical service on this node (≈ agentHost.host(id))."""
+        me = self.members[self.node_id]
+        me.agents[agent_id] = metadata or {}
+        me.incarnation += 1
+        self._notify()
+
+    def stop_agent(self, agent_id: str) -> None:
+        me = self.members[self.node_id]
+        if agent_id in me.agents:
+            del me.agents[agent_id]
+            me.incarnation += 1
+            self._notify()
+
+    def agent_members(self, agent_id: str) -> Dict[str, dict]:
+        """node_id -> metadata for every ALIVE node hosting the agent."""
+        return {m.node_id: m.agents[agent_id]
+                for m in self.members.values()
+                if m.status == ALIVE and agent_id in m.agents}
+
+    def alive_members(self) -> Set[str]:
+        return {m.node_id for m in self.members.values()
+                if m.status == ALIVE}
+
+    def on_change(self, cb: Callable[[], None]) -> None:
+        self._listeners.append(cb)
+
+    def _notify(self) -> None:
+        for cb in self._listeners:
+            cb()
+
+    # ---------------- wire ------------------------------------------------
+
+    def _send(self, addr: Tuple[str, int], msg: dict) -> None:
+        if self.transport is None or self.stopped:
+            return
+        msg["from"] = self.node_id
+        msg["gossip"] = self._gossip_sample()
+        try:
+            self.transport.sendto(json.dumps(msg).encode(), addr)
+        except OSError:
+            pass
+
+    def _gossip_sample(self) -> List[dict]:
+        members = list(self.members.values())
+        self.rng.shuffle(members)
+        return [m.record() for m in members[:8]]
+
+    def datagram_received(self, data: bytes, addr: Tuple[str, int]) -> None:
+        if self.stopped:
+            return
+        try:
+            msg = json.loads(data.decode())
+        except (ValueError, UnicodeDecodeError):
+            return
+        for rec in msg.get("gossip", []):
+            self._merge(rec)
+        t = msg.get("t")
+        if t == "join":
+            self._send(addr, {"t": "welcome"})
+        elif t == "ping":
+            self._send(addr, {"t": "ack", "seq": msg.get("seq")})
+        elif t == "ping-req":
+            # indirect probe on behalf of the requester (SWIM)
+            target = msg.get("target")
+            ts = self.members.get(target)
+            if ts is not None:
+                self._send(ts.addr, {"t": "ping", "seq": -1})
+            self._send(addr, {"t": "ack", "seq": msg.get("seq")})
+        elif t == "ack":
+            fut = self._acks.pop(msg.get("seq"), None)
+            if fut is not None and not fut.done():
+                fut.set_result(True)
+
+    def _merge(self, rec: dict) -> None:
+        nid = rec.get("id")
+        if not nid:
+            return
+        inc, st = rec.get("inc", 0), rec.get("st", ALIVE)
+        cur = self.members.get(nid)
+        if nid == self.node_id:
+            # refute rumors about myself (SWIM refutation)
+            me = self.members[self.node_id]
+            if st != ALIVE and inc >= me.incarnation:
+                me.incarnation = inc + 1
+                self._notify()
+            return
+        changed = False
+        if cur is None:
+            self.members[nid] = MemberState(
+                node_id=nid, addr=tuple(rec.get("addr", ("", 0))),
+                incarnation=inc, status=st, agents=rec.get("agents", {}))
+            changed = True
+        else:
+            # precedence: higher incarnation wins; at equal incarnation a
+            # worse status (suspect/dead) overrides alive
+            rank = {ALIVE: 0, SUSPECT: 1, DEAD: 2}
+            if (inc > cur.incarnation
+                    or (inc == cur.incarnation
+                        and rank[st] > rank[cur.status])):
+                cur.incarnation = inc
+                if cur.status != st:
+                    cur.status = st
+                    cur.status_at = time.time()
+                cur.agents = rec.get("agents", cur.agents)
+                changed = True
+        if changed:
+            self._notify()
+
+    # ---------------- SWIM probe loop --------------------------------------
+
+    async def _probe_loop(self) -> None:
+        try:
+            while not self.stopped:
+                await asyncio.sleep(self.PROBE_INTERVAL)
+                self._advance_suspicions()
+                target = self._pick_probe_target()
+                if target is None:
+                    continue
+                ok = await self._probe(target)
+                if not ok:
+                    ok = await self._indirect_probe(target)
+                if not ok:
+                    self._suspect(target)
+        except asyncio.CancelledError:
+            pass
+
+    def _pick_probe_target(self) -> Optional[MemberState]:
+        candidates = [m for m in self.members.values()
+                      if m.node_id != self.node_id and m.status != DEAD]
+        return self.rng.choice(candidates) if candidates else None
+
+    async def _probe(self, target: MemberState) -> bool:
+        self._seq += 1
+        seq = self._seq
+        fut = asyncio.get_running_loop().create_future()
+        self._acks[seq] = fut
+        self._send(target.addr, {"t": "ping", "seq": seq})
+        try:
+            await asyncio.wait_for(fut, self.PROBE_TIMEOUT)
+            return True
+        except asyncio.TimeoutError:
+            self._acks.pop(seq, None)
+            return False
+
+    async def _indirect_probe(self, target: MemberState) -> bool:
+        helpers = [m for m in self.members.values()
+                   if m.status == ALIVE
+                   and m.node_id not in (self.node_id, target.node_id)]
+        self.rng.shuffle(helpers)
+        ok = False
+        for helper in helpers[:self.INDIRECT_K]:
+            self._seq += 1
+            seq = self._seq
+            fut = asyncio.get_running_loop().create_future()
+            self._acks[seq] = fut
+            self._send(helper.addr, {"t": "ping-req", "seq": seq,
+                                     "target": target.node_id})
+            try:
+                await asyncio.wait_for(fut, self.PROBE_TIMEOUT)
+                ok = True
+            except asyncio.TimeoutError:
+                self._acks.pop(seq, None)
+        # a direct re-probe after helpers relayed a ping settles it
+        if ok:
+            return await self._probe(target)
+        return False
+
+    def _suspect(self, target: MemberState) -> None:
+        if target.status == ALIVE:
+            target.status = SUSPECT
+            target.status_at = time.time()
+            self._notify()
+            self._broadcast_state(target)
+
+    def _advance_suspicions(self) -> None:
+        now = time.time()
+        for m in list(self.members.values()):
+            if m.node_id == self.node_id:
+                continue
+            if m.status == SUSPECT and now - m.status_at > self.SUSPECT_TIMEOUT:
+                m.status = DEAD   # ≈ AutoDropper eviction
+                m.status_at = now
+                self._notify()
+                self._broadcast_state(m)
+            elif m.status == DEAD and now - m.status_at > self.DEAD_REAP:
+                del self.members[m.node_id]
+
+    def _broadcast_state(self, member: MemberState) -> None:
+        peers = [m for m in self.members.values()
+                 if m.status == ALIVE and m.node_id != self.node_id]
+        self.rng.shuffle(peers)
+        for peer in peers[:self.GOSSIP_FANOUT]:
+            self._send(peer.addr, {"t": "state"})

@@ -180,6 +180,40 @@ class InboxService:
         if cb is not None:
             cb()
 
+    # ---------------- recovery (checkpoint/resume) --------------------------
+
+    def recover(self) -> int:
+        """Rebuild dist routes + expiry timers from persisted inbox state.
+
+        The broker calls this on start when the inbox engine is durable —
+        the resume half of the reference's checkpoint/resume contract
+        (coproc ``reset`` rebuilding derived state, SURVEY.md §5).
+        """
+        n = 0
+        now = self.clock()
+        for tenant_id, inbox_id, meta in self.store.all_inboxes():
+            if meta.detached_at is None:
+                # attached at crash time: the connection is gone, so detach
+                # now — starts the expiry clock and preserves the LWT
+                meta = self.store.detach(tenant_id, inbox_id) or meta
+            if meta.expire_at() <= now:
+                # expired while down: clean up right away on the loop
+                asyncio.get_running_loop().create_task(
+                    self._expire(tenant_id, inbox_id))
+                continue
+            for tf in meta.filters:
+                self.dist.match(tenant_id,
+                                RouteMatcher.from_topic_filter(tf),
+                                PERSISTENT_SUB_BROKER_ID, inbox_id,
+                                self._deliverer_key(inbox_id))
+            self.delay.schedule(
+                (tenant_id, inbox_id), meta.expire_at(),
+                lambda t=tenant_id, i=inbox_id:
+                    asyncio.get_running_loop().create_task(
+                        self._expire(t, i)))
+            n += 1
+        return n
+
     # ---------------- gc ----------------------------------------------------
 
     async def gc(self) -> int:

@@ -342,10 +342,8 @@ class InboxStore:
 
     # ---------------- gc (≈ ExpireInboxTask / gc scan) ---------------------
 
-    def expired_inboxes(self, now: Optional[float] = None
-                        ) -> List[Tuple[str, str, InboxMetadata]]:
-        """Scan all inboxes whose expiry deadline passed (gc support)."""
-        now = self.clock() if now is None else now
+    def all_inboxes(self) -> List[Tuple[str, str, InboxMetadata]]:
+        """Scan every inbox's metadata (recovery + gc support)."""
         out = []
         for key, value in self.space.iterate(schema.TAG_INBOX,
                                              schema.prefix_end(
@@ -355,6 +353,12 @@ class InboxStore:
             if len(key) != pos + 1 or key[-1] != 0:
                 continue  # not a metadata record
             meta = _dec_meta(inbox_b.decode(), value)
-            if meta.expire_at() <= now:
-                out.append((tenant_b.decode(), meta.inbox_id, meta))
+            out.append((tenant_b.decode(), meta.inbox_id, meta))
         return out
+
+    def expired_inboxes(self, now: Optional[float] = None
+                        ) -> List[Tuple[str, str, InboxMetadata]]:
+        """Scan all inboxes whose expiry deadline passed (gc support)."""
+        now = self.clock() if now is None else now
+        return [(t, i, m) for t, i, m in self.all_inboxes()
+                if m.expire_at() <= now]

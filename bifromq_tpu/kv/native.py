@@ -1,0 +1,210 @@
+"""Native (C++) KV engine binding — the durable engine behind the SPI.
+
+Fills the role RocksDB fills in the reference (data + WAL engines of
+base-kv; SURVEY.md §2.9 "our equivalent: C++ behind the same KVSpace SPI"):
+ordered memtable + append-only WAL with fsync + full-dump checkpoints, with
+crash recovery on open (checkpoint load + WAL replay).
+
+The shared library builds on first use with the baked-in g++ (no pybind11 —
+plain C ABI + ctypes) and is cached next to the source.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, Iterator, Optional, Tuple
+
+from .engine import (IKVEngine, IKVSpace, IKVSpaceCheckpoint, KVWriteBatch)
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native", "kvengine.cpp")
+_SO = os.path.join(os.path.dirname(_SRC), "libkvengine.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build() -> str:
+    if not (os.path.exists(_SO)
+            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC,
+             "-o", _SO],
+            check=True, capture_output=True)
+    return _SO
+
+
+def load_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        lib = ctypes.CDLL(_build())
+        lib.kv_open.restype = ctypes.c_void_p
+        lib.kv_open.argtypes = [ctypes.c_char_p]
+        lib.kv_close.argtypes = [ctypes.c_void_p]
+        lib.kv_space.restype = ctypes.c_void_p
+        lib.kv_space.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.kv_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+        lib.kv_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        lib.kv_del_range.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int, ctypes.c_char_p,
+                                     ctypes.c_int]
+        lib.kv_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+                               ctypes.POINTER(ctypes.c_char_p),
+                               ctypes.POINTER(ctypes.c_int)]
+        lib.kv_free.argtypes = [ctypes.c_char_p]
+        lib.kv_count.restype = ctypes.c_uint64
+        lib.kv_count.argtypes = [ctypes.c_void_p]
+        lib.kv_flush.argtypes = [ctypes.c_void_p]
+        lib.kv_checkpoint.argtypes = [ctypes.c_void_p]
+        lib.kv_wal_bytes.restype = ctypes.c_uint64
+        lib.kv_wal_bytes.argtypes = [ctypes.c_void_p]
+        lib.kv_iter.restype = ctypes.c_void_p
+        lib.kv_iter.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                                ctypes.c_int]
+        lib.kv_iter_valid.argtypes = [ctypes.c_void_p]
+        lib.kv_iter_key.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_char_p),
+                                    ctypes.POINTER(ctypes.c_int)]
+        lib.kv_iter_value.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_char_p),
+                                      ctypes.POINTER(ctypes.c_int)]
+        lib.kv_iter_next.argtypes = [ctypes.c_void_p]
+        lib.kv_iter_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class NativeKVSpace(IKVSpace):
+    def __init__(self, engine: "NativeKVEngine", name: str,
+                 handle: int) -> None:
+        self.name = name
+        self._engine = engine
+        self._h = handle
+        self._lib = engine._lib
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        out = ctypes.c_char_p()
+        outlen = ctypes.c_int()
+        if not self._lib.kv_get(self._h, key, len(key),
+                                ctypes.byref(out), ctypes.byref(outlen)):
+            return None
+        # ctypes c_char_p.value stops at NUL; use string_at for binary safety
+        raw = ctypes.string_at(out, outlen.value)
+        self._lib.kv_free(out)
+        return raw
+
+    def iterate(self, start: Optional[bytes] = None,
+                end: Optional[bytes] = None,
+                reverse: bool = False) -> Iterator[Tuple[bytes, bytes]]:
+        it = self._lib.kv_iter(
+            self._h, start or b"", len(start) if start is not None else -1,
+            end or b"", len(end) if end is not None else -1, int(reverse))
+        try:
+            k = ctypes.c_char_p()
+            klen = ctypes.c_int()
+            v = ctypes.c_char_p()
+            vlen = ctypes.c_int()
+            while self._lib.kv_iter_valid(it):
+                self._lib.kv_iter_key(it, ctypes.byref(k),
+                                      ctypes.byref(klen))
+                self._lib.kv_iter_value(it, ctypes.byref(v),
+                                        ctypes.byref(vlen))
+                yield (ctypes.string_at(k, klen.value),
+                       ctypes.string_at(v, vlen.value))
+                self._lib.kv_iter_next(it)
+        finally:
+            self._lib.kv_iter_close(it)
+
+    def size(self, start: Optional[bytes] = None,
+             end: Optional[bytes] = None) -> int:
+        return sum(len(k) + len(v) for k, v in self.iterate(start, end))
+
+    def checkpoint(self) -> IKVSpaceCheckpoint:
+        # durability checkpoint + an in-memory read snapshot for callers
+        self._lib.kv_checkpoint(self._h)
+        snap = dict(self.iterate())
+        return _NativeCheckpoint(snap)
+
+    def flush(self) -> None:
+        self._lib.kv_flush(self._h)
+
+    @property
+    def wal_bytes(self) -> int:
+        return self._lib.kv_wal_bytes(self._h)
+
+    def destroy(self) -> None:
+        self._apply([("del_range", b"", b"\xff" * 32)])
+
+    def get_metadata(self, key: bytes) -> Optional[bytes]:
+        return self.get(b"\xfeMETA" + key)
+
+    def put_metadata(self, key: bytes, value: bytes) -> None:
+        self._lib.kv_put(self._h, b"\xfeMETA" + key, len(key) + 5,
+                         value, len(value))
+
+    def _apply(self, ops) -> None:
+        for op, a, b in ops:
+            if op == "put":
+                self._lib.kv_put(self._h, a, len(a), b, len(b))
+            elif op == "del":
+                self._lib.kv_del(self._h, a, len(a))
+            else:
+                self._lib.kv_del_range(self._h, a, len(a), b, len(b))
+
+    def __len__(self) -> int:
+        return int(self._lib.kv_count(self._h))
+
+
+class _NativeCheckpoint(IKVSpaceCheckpoint):
+    def __init__(self, snap: Dict[bytes, bytes]) -> None:
+        self._snap = snap
+        self._keys = sorted(snap)
+
+    def iterate(self, start: Optional[bytes] = None,
+                end: Optional[bytes] = None):
+        import bisect
+        lo = 0 if start is None else bisect.bisect_left(self._keys, start)
+        hi = (len(self._keys) if end is None
+              else bisect.bisect_left(self._keys, end))
+        for k in self._keys[lo:hi]:
+            yield k, self._snap[k]
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._snap.get(key)
+
+
+class NativeKVEngine(IKVEngine):
+    """Durable engine rooted at ``dir``; spaces persist across restarts."""
+
+    def __init__(self, dir: str) -> None:
+        self.dir = dir
+        self._lib = load_lib()
+        self._eng = self._lib.kv_open(dir.encode())
+        self._spaces: Dict[str, NativeKVSpace] = {}
+
+    def create_space(self, name: str) -> IKVSpace:
+        sp = self._spaces.get(name)
+        if sp is None:
+            h = self._lib.kv_space(self._eng, name.encode())
+            sp = NativeKVSpace(self, name, h)
+            self._spaces[name] = sp
+        return sp
+
+    def get_space(self, name: str) -> Optional[IKVSpace]:
+        return self._spaces.get(name)
+
+    def spaces(self) -> Dict[str, IKVSpace]:
+        return dict(self._spaces)
+
+    def close(self) -> None:
+        if self._eng is not None:
+            self._lib.kv_close(self._eng)
+            self._eng = None
+            self._spaces.clear()

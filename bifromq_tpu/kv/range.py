@@ -71,7 +71,10 @@ class ReplicatedKVRange:
         self.range_id = range_id
         self.space = space
         self.coproc = coproc
+        # results kept only for indices this node proposed (followers apply
+        # the same entries but have no caller waiting — don't accumulate)
         self._mutation_results: dict = {}
+        self._pending_results: set = set()
         self.raft = RaftNode(
             node_id, voters, transport,
             apply_cb=self._apply,
@@ -92,7 +95,8 @@ class ReplicatedKVRange:
             out = (self.coproc.mutate(data[1:], self.space, writer)
                    if self.coproc is not None else b"")
             writer.done()
-            self._mutation_results[entry.index] = out
+            if entry.index in self._pending_results:
+                self._mutation_results[entry.index] = out
 
     def _apply_kv_batch(self, data: bytes) -> None:
         n = struct.unpack_from(">I", data, 1)[0]
@@ -155,7 +159,16 @@ class ReplicatedKVRange:
 
     async def mutate_coproc(self, payload: bytes) -> bytes:
         """RW coproc call through consensus (≈ KVRangeRWRequest execute)."""
-        index = await self.raft.propose(_enc_coproc(payload))
+        fut = self.raft.propose(_enc_coproc(payload))
+        guess = None
+        if not fut.done():  # propose appended synchronously when leader
+            guess = self.raft.last_index
+            self._pending_results.add(guess)
+        try:
+            index = await fut
+        finally:
+            if guess is not None:
+                self._pending_results.discard(guess)
         return self._mutation_results.pop(index, b"")
 
     async def get(self, key: bytes, *, linearized: bool = True

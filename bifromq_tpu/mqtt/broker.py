@@ -221,6 +221,13 @@ class Connection:
                              settings[Setting.MaxSessionExpirySeconds])
         persistent = session_expiry > 0 and not settings[
             Setting.ForceTransient]
+        if (not persistent and v5 and not c.clean_start
+                and not settings[Setting.ForceTransient]
+                and broker.inbox.store.exists(tenant_id, client_id)):
+            # [MQTT-3.1.2-5]: Clean Start 0 resumes existing session state
+            # even with session-expiry 0 — the session then ends at
+            # network disconnect (expiry 0 deletes on close)
+            persistent = True
 
         common = dict(
             conn=self, client_id=client_id, client_info=ClientInfo(
@@ -288,7 +295,7 @@ class MQTTBroker:
                  settings: Optional[ISettingProvider] = None,
                  events: Optional[IEventCollector] = None,
                  dist: Optional[DistService] = None,
-                 retain_service=None) -> None:
+                 retain_service=None, inbox_engine=None) -> None:
         self.host = host
         self.port = port
         self.auth = auth or AllowAllAuthProvider()
@@ -305,11 +312,16 @@ class MQTTBroker:
             retain_service = RetainService(self.events)
         self.retain_service = retain_service
         from ..inbox.service import InboxService, InboxSubBroker
-        self.inbox = InboxService(self.dist, self.events, self.settings)
+        self.inbox = InboxService(self.dist, self.events, self.settings,
+                                  engine=inbox_engine)
         self.sub_brokers.register(InboxSubBroker(self.inbox))
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> None:
+        recovered = self.inbox.recover()
+        if recovered:
+            log.info("recovered %d persistent sessions from storage",
+                     recovered)
         self._server = await asyncio.start_server(
             self._on_client, self.host, self.port)
         addr = self._server.sockets[0].getsockname()

@@ -238,7 +238,11 @@ class PersistentSession(Session):
     def _on_puback(self, pid: int) -> None:
         super()._on_puback(pid)
         self._commit_acked(pid)
+        # any ack (inbox or direct retained delivery) frees send-window
+        # budget — always wake the fetch loop
+        self._fetch_wake.set()
 
     def _on_pubcomp(self, pid: int) -> None:
         super()._on_pubcomp(pid)
         self._commit_acked(pid)
+        self._fetch_wake.set()

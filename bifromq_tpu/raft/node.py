@@ -175,6 +175,7 @@ class RaftNode:
         self._propose_waiters: Dict[int, asyncio.Future] = {}
         self._read_waiters: Dict[int, Tuple[asyncio.Future, Set[str], int]] = {}
         self._read_ctx_seq = 0
+        self._term_start_index = 0  # index of this term's no-op (leader)
         self._transfer_target: Optional[str] = None
         self.stopped = False
 
@@ -249,7 +250,8 @@ class RaftNode:
         if self.role != Role.LEADER:
             fut.set_exception(NotLeaderError(self.leader_id))
             return fut
-        if len(self.voters) == 1:
+        if len(self.voters) == 1 and self.commit_index >= \
+                self._term_start_index:
             fut.set_result(self.commit_index)
             return fut
         self._read_ctx_seq += 1
@@ -420,11 +422,15 @@ class RaftNode:
         self._next_index = {p: self.last_index + 1 for p in self.voters}
         self._match_index = {p: 0 for p in self.voters}
         self._match_index[self.id] = self.last_index
-        # no-op entry to commit prior-term entries promptly
+        # no-op entry to commit prior-term entries promptly; read-index is
+        # gated on it committing (raft §8: a new leader may not serve
+        # linearizable reads until it has committed an entry in its term)
         self.log.append(LogEntry(term=self.term, index=self.last_index + 1,
                                  data=b""))
+        self._term_start_index = self.last_index
         self._match_index[self.id] = self.last_index
         self._broadcast_append()
+        self._maybe_commit()  # single-voter groups commit immediately
 
     # ---------------- replication ------------------------------------------
 
@@ -527,7 +533,25 @@ class RaftNode:
             fut = self._propose_waiters.pop(self.last_applied, None)
             if fut is not None and not fut.done():
                 fut.set_result(self.last_applied)
+            if (e is not None and e.config is not None
+                    and self.role == Role.LEADER
+                    and self.id not in self.voters):
+                # a leader removed by a committed config change steps down
+                self._become_follower(self.term, None)
+        if (self.role == Role.LEADER
+                and self.commit_index >= self._term_start_index):
+            self._flush_confirmed_reads()
         self._maybe_compact()
+
+    def _flush_confirmed_reads(self) -> None:
+        """Resolve read waiters whose quorum arrived before the term-start
+        no-op committed (read-index gating)."""
+        for ctx in list(self._read_waiters):
+            fut, acks, _ = self._read_waiters[ctx]
+            if len(acks & self.voters) * 2 > len(self.voters):
+                del self._read_waiters[ctx]
+                if not fut.done():
+                    fut.set_result(self.commit_index)
 
     # ---------------- read index -------------------------------------------
 
@@ -535,12 +559,16 @@ class RaftNode:
         st = self._read_waiters.get(ctx)
         if st is None:
             return
-        fut, acks, commit_at = st
+        fut, acks, _ = st
         acks.add(sender)
-        if len(acks & self.voters) * 2 > len(self.voters):
+        quorum = len(acks & self.voters) * 2 > len(self.voters)
+        if quorum and self.commit_index >= self._term_start_index:
+            # leadership confirmed AND this term has a committed entry:
+            # the current commit index is a safe linearization point
             del self._read_waiters[ctx]
             if not fut.done():
-                fut.set_result(commit_at)
+                fut.set_result(self.commit_index)
+        # else: keep waiting; _apply_committed re-checks once the no-op lands
 
     # ---------------- snapshots --------------------------------------------
 

@@ -1,0 +1,264 @@
+// Native KV engine: durable ordered keyspaces behind the IKVEngine SPI.
+//
+// Plays the role RocksDB (C++ via rocksdbjni) plays in the reference
+// (base-kv-local-engine-rocksdb: column-family-per-space, WAL, checkpoints
+// for snapshots — SURVEY.md §2.9). Design: per-space ordered memtable
+// (std::map) + append-only WAL with group fsync; checkpoint writes a full
+// sorted dump and truncates the WAL; recovery = load checkpoint + replay WAL.
+//
+// C ABI for ctypes (no pybind11 in the image). All functions are
+// thread-safe via a per-engine mutex; Python holds the GIL around calls
+// anyway, so contention is nil in practice.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+using Bytes = std::string;
+
+struct Space;
+
+struct Engine {
+    std::string dir;
+    std::mutex mu;
+    std::map<std::string, std::unique_ptr<Space>> spaces;
+};
+
+enum WalOp : uint8_t { WAL_PUT = 0, WAL_DEL = 1, WAL_DEL_RANGE = 2 };
+
+struct Space {
+    Engine* eng;
+    std::string name;
+    std::map<Bytes, Bytes> data;
+    FILE* wal = nullptr;
+    std::string wal_path;
+    std::string ckpt_path;
+    uint64_t wal_bytes = 0;
+
+    ~Space() {
+        if (wal) fclose(wal);
+    }
+};
+
+static void write_u32(FILE* f, uint32_t v) { fwrite(&v, 4, 1, f); }
+
+static bool read_u32(FILE* f, uint32_t* v) { return fread(v, 4, 1, f) == 1; }
+
+static void wal_append(Space* sp, uint8_t op, const Bytes& a, const Bytes& b) {
+    fputc(op, sp->wal);
+    write_u32(sp->wal, (uint32_t)a.size());
+    fwrite(a.data(), 1, a.size(), sp->wal);
+    write_u32(sp->wal, (uint32_t)b.size());
+    fwrite(b.data(), 1, b.size(), sp->wal);
+    sp->wal_bytes += 9 + a.size() + b.size();
+}
+
+static void apply_op(Space* sp, uint8_t op, const Bytes& a, const Bytes& b) {
+    if (op == WAL_PUT) {
+        sp->data[a] = b;
+    } else if (op == WAL_DEL) {
+        sp->data.erase(a);
+    } else {  // WAL_DEL_RANGE: [a, b)
+        auto lo = sp->data.lower_bound(a);
+        auto hi = sp->data.lower_bound(b);
+        sp->data.erase(lo, hi);
+    }
+}
+
+static void load_checkpoint(Space* sp) {
+    FILE* f = fopen(sp->ckpt_path.c_str(), "rb");
+    if (!f) return;
+    uint32_t klen, vlen;
+    while (read_u32(f, &klen)) {
+        Bytes k(klen, '\0');
+        if (fread(&k[0], 1, klen, f) != klen) break;
+        if (!read_u32(f, &vlen)) break;
+        Bytes v(vlen, '\0');
+        if (vlen && fread(&v[0], 1, vlen, f) != vlen) break;
+        sp->data.emplace(std::move(k), std::move(v));
+    }
+    fclose(f);
+}
+
+static void replay_wal(Space* sp) {
+    FILE* f = fopen(sp->wal_path.c_str(), "rb");
+    if (!f) return;
+    for (;;) {
+        int op = fgetc(f);
+        if (op == EOF) break;
+        uint32_t alen, blen;
+        if (!read_u32(f, &alen)) break;
+        Bytes a(alen, '\0');
+        if (alen && fread(&a[0], 1, alen, f) != alen) break;
+        if (!read_u32(f, &blen)) break;
+        Bytes b(blen, '\0');
+        if (blen && fread(&b[0], 1, blen, f) != blen) break;
+        apply_op(sp, (uint8_t)op, a, b);
+    }
+    fclose(f);
+}
+
+struct Iter {
+    std::vector<std::pair<Bytes, Bytes>> items;  // snapshot of the range
+    size_t pos = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kv_open(const char* dir) {
+    auto* e = new Engine();
+    e->dir = dir;
+    mkdir(dir, 0755);
+    return e;
+}
+
+void kv_close(void* eng) { delete static_cast<Engine*>(eng); }
+
+void* kv_space(void* engp, const char* name) {
+    auto* e = static_cast<Engine*>(engp);
+    std::lock_guard<std::mutex> lock(e->mu);
+    auto it = e->spaces.find(name);
+    if (it != e->spaces.end()) return it->second.get();
+    auto sp = std::make_unique<Space>();
+    sp->eng = e;
+    sp->name = name;
+    sp->wal_path = e->dir + "/" + name + ".wal";
+    sp->ckpt_path = e->dir + "/" + name + ".ckpt";
+    load_checkpoint(sp.get());
+    replay_wal(sp.get());
+    sp->wal = fopen(sp->wal_path.c_str(), "ab");
+    Space* raw = sp.get();
+    e->spaces[name] = std::move(sp);
+    return raw;
+}
+
+int kv_put(void* spp, const char* k, int klen, const char* v, int vlen) {
+    auto* sp = static_cast<Space*>(spp);
+    std::lock_guard<std::mutex> lock(sp->eng->mu);
+    Bytes key(k, klen), val(v, vlen);
+    wal_append(sp, WAL_PUT, key, val);
+    apply_op(sp, WAL_PUT, key, val);
+    return 0;
+}
+
+int kv_del(void* spp, const char* k, int klen) {
+    auto* sp = static_cast<Space*>(spp);
+    std::lock_guard<std::mutex> lock(sp->eng->mu);
+    Bytes key(k, klen);
+    wal_append(sp, WAL_DEL, key, "");
+    apply_op(sp, WAL_DEL, key, "");
+    return 0;
+}
+
+int kv_del_range(void* spp, const char* s, int slen, const char* e2,
+                 int elen) {
+    auto* sp = static_cast<Space*>(spp);
+    std::lock_guard<std::mutex> lock(sp->eng->mu);
+    Bytes a(s, slen), b(e2, elen);
+    wal_append(sp, WAL_DEL_RANGE, a, b);
+    apply_op(sp, WAL_DEL_RANGE, a, b);
+    return 0;
+}
+
+// returns 1 if found; caller frees with kv_free
+int kv_get(void* spp, const char* k, int klen, char** out, int* outlen) {
+    auto* sp = static_cast<Space*>(spp);
+    std::lock_guard<std::mutex> lock(sp->eng->mu);
+    auto it = sp->data.find(Bytes(k, klen));
+    if (it == sp->data.end()) return 0;
+    *outlen = (int)it->second.size();
+    *out = (char*)malloc(it->second.size() + 1);
+    memcpy(*out, it->second.data(), it->second.size());
+    return 1;
+}
+
+void kv_free(char* p) { free(p); }
+
+uint64_t kv_count(void* spp) {
+    auto* sp = static_cast<Space*>(spp);
+    std::lock_guard<std::mutex> lock(sp->eng->mu);
+    return sp->data.size();
+}
+
+int kv_flush(void* spp) {
+    auto* sp = static_cast<Space*>(spp);
+    std::lock_guard<std::mutex> lock(sp->eng->mu);
+    fflush(sp->wal);
+    return fsync(fileno(sp->wal));
+}
+
+// full-dump checkpoint then truncate the WAL (RocksDB-checkpoint analog)
+int kv_checkpoint(void* spp) {
+    auto* sp = static_cast<Space*>(spp);
+    std::lock_guard<std::mutex> lock(sp->eng->mu);
+    std::string tmp = sp->ckpt_path + ".tmp";
+    FILE* f = fopen(tmp.c_str(), "wb");
+    if (!f) return -1;
+    for (auto& kv : sp->data) {
+        write_u32(f, (uint32_t)kv.first.size());
+        fwrite(kv.first.data(), 1, kv.first.size(), f);
+        write_u32(f, (uint32_t)kv.second.size());
+        fwrite(kv.second.data(), 1, kv.second.size(), f);
+    }
+    fflush(f);
+    fsync(fileno(f));
+    fclose(f);
+    if (rename(tmp.c_str(), sp->ckpt_path.c_str()) != 0) return -1;
+    fclose(sp->wal);
+    sp->wal = fopen(sp->wal_path.c_str(), "wb");  // truncate
+    sp->wal_bytes = 0;
+    return 0;
+}
+
+uint64_t kv_wal_bytes(void* spp) {
+    return static_cast<Space*>(spp)->wal_bytes;
+}
+
+void* kv_iter(void* spp, const char* s, int slen, const char* e2, int elen,
+              int reverse) {
+    auto* sp = static_cast<Space*>(spp);
+    std::lock_guard<std::mutex> lock(sp->eng->mu);
+    auto* it = new Iter();
+    auto lo = slen >= 0 ? sp->data.lower_bound(Bytes(s, slen))
+                        : sp->data.begin();
+    auto hi = elen >= 0 ? sp->data.lower_bound(Bytes(e2, elen))
+                        : sp->data.end();
+    for (auto p = lo; p != hi; ++p) it->items.emplace_back(p->first, p->second);
+    if (reverse) std::reverse(it->items.begin(), it->items.end());
+    return it;
+}
+
+int kv_iter_valid(void* itp) {
+    auto* it = static_cast<Iter*>(itp);
+    return it->pos < it->items.size();
+}
+
+void kv_iter_key(void* itp, const char** k, int* klen) {
+    auto* it = static_cast<Iter*>(itp);
+    *k = it->items[it->pos].first.data();
+    *klen = (int)it->items[it->pos].first.size();
+}
+
+void kv_iter_value(void* itp, const char** v, int* vlen) {
+    auto* it = static_cast<Iter*>(itp);
+    *v = it->items[it->pos].second.data();
+    *vlen = (int)it->items[it->pos].second.size();
+}
+
+void kv_iter_next(void* itp) { static_cast<Iter*>(itp)->pos++; }
+
+void kv_iter_close(void* itp) { delete static_cast<Iter*>(itp); }
+
+}  // extern "C"

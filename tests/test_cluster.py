@@ -1,0 +1,98 @@
+"""Gossip membership tests over real loopback UDP (≈ base-cluster
+AgentTestCluster pattern: real hosts, real sockets, localhost)."""
+
+import asyncio
+
+import pytest
+
+from bifromq_tpu.cluster.membership import ALIVE, DEAD, AgentHost
+
+pytestmark = pytest.mark.asyncio
+
+
+async def start_cluster(n):
+    hosts = []
+    seed = AgentHost("h0")
+    await seed.start()
+    hosts.append(seed)
+    for i in range(1, n):
+        h = AgentHost(f"h{i}", seeds=[("127.0.0.1", seed.port)])
+        await h.start()
+        hosts.append(h)
+    return hosts
+
+
+async def stop_all(hosts):
+    for h in hosts:
+        await h.stop()
+
+
+async def wait_for(cond, timeout=8.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if cond():
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError("condition not reached")
+
+
+class TestMembership:
+    async def test_join_converges(self):
+        hosts = await start_cluster(4)
+        try:
+            await wait_for(lambda: all(
+                len(h.alive_members()) == 4 for h in hosts))
+        finally:
+            await stop_all(hosts)
+
+    async def test_agent_discovery(self):
+        hosts = await start_cluster(3)
+        try:
+            hosts[1].host_agent("dist-worker", {"grpc_port": 7001})
+            hosts[2].host_agent("dist-worker", {"grpc_port": 7002})
+            hosts[2].host_agent("inbox-store", {})
+            await wait_for(lambda: set(
+                hosts[0].agent_members("dist-worker")) == {"h1", "h2"})
+            assert hosts[0].agent_members("dist-worker")["h1"] == {
+                "grpc_port": 7001}
+            await wait_for(lambda: set(
+                hosts[0].agent_members("inbox-store")) == {"h2"})
+        finally:
+            await stop_all(hosts)
+
+    async def test_agent_stop_propagates(self):
+        hosts = await start_cluster(3)
+        try:
+            hosts[1].host_agent("svc", {})
+            await wait_for(lambda: "h1" in hosts[0].agent_members("svc"))
+            hosts[1].stop_agent("svc")
+            await wait_for(lambda: "h1" not in hosts[0].agent_members("svc"))
+        finally:
+            await stop_all(hosts)
+
+    async def test_failure_detection(self):
+        hosts = await start_cluster(4)
+        try:
+            await wait_for(lambda: all(
+                len(h.alive_members()) == 4 for h in hosts))
+            await hosts[3].stop()  # silent death
+            await wait_for(lambda: all(
+                "h3" not in h.alive_members() for h in hosts[:3]),
+                timeout=15.0)
+            # dead node's agents disappear from discovery
+            assert all(h.members.get("h3") is None
+                       or h.members["h3"].status != ALIVE
+                       for h in hosts[:3])
+        finally:
+            await stop_all(hosts[:3])
+
+    async def test_late_joiner_sees_agents(self):
+        hosts = await start_cluster(2)
+        try:
+            hosts[1].host_agent("svc", {"x": 1})
+            late = AgentHost("late", seeds=[("127.0.0.1", hosts[0].port)])
+            await late.start()
+            hosts.append(late)
+            await wait_for(lambda: "h1" in late.agent_members("svc"))
+        finally:
+            await stop_all(hosts)

@@ -343,3 +343,47 @@ class TestReviewRegressions:
                     >= c.leader().commit_index, max_ticks=3000)
         datas = [d for _, d in c.applied[straggler]]
         assert len(datas) == len(set(datas)), "double-applied entries"
+
+    async def test_v5_clean_start0_expiry0_resumes_then_ends(self, broker):
+        # [MQTT-3.1.2-5]: existing state must resume even with expiry=0,
+        # then the session ends at network disconnect
+        c = await connect_persistent(broker, "r0", v5=True, expiry=3600)
+        await c.subscribe("r0/t", qos=1)
+        await c.disconnect()
+        p = MQTTClient(port=broker.port, client_id="r0p")
+        await p.connect()
+        await p.publish("r0/t", b"queued", qos=1)
+        await p.disconnect()
+        c2 = await connect_persistent(broker, "r0", v5=True, expiry=0)
+        assert c2.connack.session_present
+        assert (await c2.recv()).payload == b"queued"
+        await asyncio.sleep(0.2)
+        await c2.disconnect()
+        # give the broker a beat to process the DISCONNECT
+        await asyncio.sleep(0.3)
+        # expiry 0: state died with the connection
+        assert not broker.inbox.store.exists("DevOnly", "r0")
+
+    async def test_recover_detaches_crashed_sessions(self):
+        from bifromq_tpu.kv.engine import InMemKVEngine
+        engine = InMemKVEngine()
+        b1 = MQTTBroker(port=0, inbox_engine=engine)
+        await b1.start()
+        c = await connect_persistent(b1, "crash1", v5=True, expiry=5)
+        await c.subscribe("cr/t", qos=1)
+        # crash: kill the broker without the client disconnecting
+        c._read_task.cancel()
+        b1.inbox.close()
+        await b1.stop()
+        meta = b1.inbox.store.get("DevOnly", "crash1")
+        # stop() closes sessions, so detach happened; force attached state
+        # to emulate a hard crash snapshot
+        from dataclasses import replace
+        b1.inbox.store._store("DevOnly", replace(meta, detached_at=None))
+        # restart over the same engine
+        b2 = MQTTBroker(port=0, inbox_engine=engine)
+        await b2.start()
+        meta2 = b2.inbox.store.get("DevOnly", "crash1")
+        assert meta2.detached_at is not None  # recovery started the clock
+        b2.inbox.close()
+        await b2.stop()

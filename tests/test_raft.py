@@ -267,3 +267,20 @@ class TestLeaderTransfer:
         c.run_until(lambda: c.nodes[target].role == Role.LEADER)
         assert c.nodes[target].term > old_term
         assert leader.role != Role.LEADER
+
+
+class TestReadIndexGating:
+    async def test_read_index_waits_for_term_start_commit(self):
+        # a fresh leader must not serve reads below prior-term commits
+        c = Cluster(3)
+        first = c.elect()
+        fut = first.propose(b"X")
+        c.run_until(lambda: fut.done())
+        idx = await fut
+        c.transport.kill(first.id)
+        c.run_until(lambda: c.leader() is not None
+                    and c.leader().id != first.id)
+        new_leader = c.leader()
+        rfut = new_leader.read_index()
+        c.run_until(lambda: rfut.done())
+        assert await rfut >= idx  # covers the prior-term committed write
