@@ -1,0 +1,4 @@
+"""bifromq_tpu.apiserver — HTTP management API (analog of bifromq-apiserver)."""
+from .server import APIServer
+
+__all__ = ["APIServer"]

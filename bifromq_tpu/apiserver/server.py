@@ -1,0 +1,258 @@
+"""HTTP management API (≈ bifromq-apiserver).
+
+Reference endpoints (apiserver/http/handler/*: PubHandler.java:62 et al.):
+pub / sub / unsub / kill / expire-sessions / retain ops + cluster
+introspection. Here a dependency-free asyncio HTTP/1.1 server exposing:
+
+  PUT  /pub?tenant_id=&topic=&qos=&retain=     body = payload
+  PUT  /sub?tenant_id=&client_id=&topic_filter=&qos=
+  DELETE /unsub?tenant_id=&client_id=&topic_filter=
+  DELETE /kill?tenant_id=&client_id=
+  DELETE /session?tenant_id=&client_id=         (expire/delete inbox)
+  PUT  /retain?tenant_id=&topic=                body = payload ('' clears)
+  GET  /cluster                                  (gossip membership, if any)
+  GET  /sessions?tenant_id=
+  GET  /routes?tenant_id=
+  GET  /retained?tenant_id=
+  GET  /metrics
+
+Headers (tenant_id etc.) are also accepted in the reference style
+(`x-tenant-id`, `x-client-id`...); query params win.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+from ..mqtt.broker import MQTTBroker
+from ..types import ClientInfo, Message, QoS
+from ..utils import topic as topic_util
+from ..utils.hlc import HLC
+
+log = logging.getLogger("bifromq_tpu.api")
+
+
+class APIServer:
+    def __init__(self, broker: MQTTBroker, host: str = "127.0.0.1",
+                 port: int = 0, *, cluster=None, metrics=None) -> None:
+        self.broker = broker
+        self.host = host
+        self.port = port
+        self.cluster = cluster
+        self.metrics = metrics
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._on_client, self.host,
+                                                  self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # ---------------- http plumbing ----------------------------------------
+
+    async def _on_client(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                request = await self._read_request(reader)
+                if request is None:
+                    break
+                method, path, headers, body = request
+                status, payload = await self._route(method, path, headers,
+                                                    body)
+                data = json.dumps(payload).encode() + b"\n"
+                reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                          429: "Too Many Requests",
+                          500: "Internal Server Error"}.get(status, "Status")
+                writer.write(
+                    f"HTTP/1.1 {status} {reason}\r\n"
+                    f"content-type: application/json\r\n"
+                    f"content-length: {len(data)}\r\n\r\n".encode() + data)
+                await writer.drain()
+                if headers.get("connection", "").lower() == "close":
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError, ValueError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _read_request(self, reader: asyncio.StreamReader):
+        line = await reader.readline()
+        if not line:
+            return None
+        try:
+            method, path, _ = line.decode().split(" ", 2)
+        except ValueError:
+            return None
+        headers: Dict[str, str] = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = h.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        body = b""
+        n = int(headers.get("content-length", "0") or 0)
+        if n:
+            body = await reader.readexactly(n)
+        return method.upper(), path, headers, body
+
+    # ---------------- routing ----------------------------------------------
+
+    async def _route(self, method: str, path: str, headers: Dict[str, str],
+                     body: bytes) -> Tuple[int, object]:
+        url = urlsplit(path)
+        q = {k: v[0] for k, v in parse_qs(url.query).items()}
+
+        def arg(name: str, default: Optional[str] = None) -> Optional[str]:
+            return q.get(name, headers.get(f"x-{name.replace('_', '-')}",
+                                           default))
+
+        route = (method, url.path)
+        try:
+            if route == ("PUT", "/pub"):
+                return await self._pub(arg, body)
+            if route == ("PUT", "/sub"):
+                return await self._sub(arg)
+            if route == ("DELETE", "/unsub"):
+                return await self._unsub(arg)
+            if route == ("DELETE", "/kill"):
+                return await self._kill(arg)
+            if route == ("DELETE", "/session"):
+                return self._expire_session(arg)
+            if route == ("PUT", "/retain"):
+                return await self._retain(arg, body)
+            if route == ("GET", "/cluster"):
+                return self._cluster_info()
+            if route == ("GET", "/sessions"):
+                return self._sessions(arg)
+            if route == ("GET", "/routes"):
+                return self._routes(arg)
+            if route == ("GET", "/retained"):
+                return self._retained(arg)
+            if route == ("GET", "/metrics"):
+                return 200, (self.metrics.snapshot()
+                             if self.metrics is not None else {})
+            return 404, {"error": f"no route {method} {url.path}"}
+        except KeyError as e:
+            return 400, {"error": f"missing parameter {e}"}
+        except ValueError as e:
+            return 400, {"error": f"bad parameter: {e}"}
+        except Exception as e:  # noqa: BLE001 — a handler bug must surface
+            log.exception("api handler failed: %s %s", method, url.path)
+            return 500, {"error": repr(e)}
+
+    # ---------------- handlers ---------------------------------------------
+
+    async def _pub(self, arg, body: bytes) -> Tuple[int, object]:
+        tenant = arg("tenant_id") or "DevOnly"
+        topic = arg("topic")
+        if not topic or not topic_util.is_valid_topic(topic):
+            return 400, {"error": "invalid topic"}
+        qos = int(arg("qos", "0"))
+        msg = Message(message_id=0, pub_qos=QoS(qos), payload=body,
+                      timestamp=HLC.INST.get(),
+                      is_retain=arg("retain", "false") == "true")
+        publisher = ClientInfo(tenant_id=tenant, type="API")
+        if msg.is_retain and self.broker.retain_service is not None:
+            await self.broker.retain_service.retain(publisher, topic, msg)
+        result = await self.broker.dist.pub(publisher, topic, msg)
+        return 200, {"fanout": result.fanout}
+
+    async def _sub(self, arg) -> Tuple[int, object]:
+        tenant = arg("tenant_id") or "DevOnly"
+        client_id = arg("client_id")
+        tf = arg("topic_filter")
+        if not client_id or not tf:
+            return 400, {"error": "client_id and topic_filter required"}
+        if not topic_util.is_valid_topic_filter(tf):
+            return 400, {"error": "invalid topic filter"}
+        qos = int(arg("qos", "0"))
+        from ..types import TopicFilterOption
+        res = self.broker.inbox.sub(tenant, client_id, tf,
+                                    TopicFilterOption(qos=QoS(qos)))
+        if res == "no_inbox":
+            return 404, {"error": "no such persistent session"}
+        return 200, {"result": res}
+
+    async def _unsub(self, arg) -> Tuple[int, object]:
+        tenant = arg("tenant_id") or "DevOnly"
+        client_id = arg("client_id")
+        tf = arg("topic_filter")
+        if not client_id or not tf:
+            return 400, {"error": "client_id and topic_filter required"}
+        removed = self.broker.inbox.unsub(tenant, client_id, tf)
+        return (200 if removed else 404), {"removed": removed}
+
+    async def _kill(self, arg) -> Tuple[int, object]:
+        tenant = arg("tenant_id") or "DevOnly"
+        client_id = arg("client_id")
+        session = self.broker.session_registry.get(tenant, client_id or "")
+        if session is None:
+            return 404, {"error": "not connected"}
+        await session.kick()
+        return 200, {"killed": client_id}
+
+    def _expire_session(self, arg) -> Tuple[int, object]:
+        tenant = arg("tenant_id") or "DevOnly"
+        client_id = arg("client_id")
+        existed = self.broker.inbox.store.exists(tenant, client_id or "")
+        self.broker.inbox.delete(tenant, client_id or "")
+        return (200 if existed else 404), {"deleted": existed}
+
+    async def _retain(self, arg, body: bytes) -> Tuple[int, object]:
+        tenant = arg("tenant_id") or "DevOnly"
+        topic = arg("topic")
+        if not topic or not topic_util.is_valid_topic(topic):
+            return 400, {"error": "invalid topic"}
+        msg = Message(message_id=0, pub_qos=QoS.AT_MOST_ONCE, payload=body,
+                      timestamp=HLC.INST.get(), is_retain=True)
+        ok = await self.broker.retain_service.retain(
+            ClientInfo(tenant_id=tenant, type="API"), topic, msg)
+        return (200 if ok else 429), {"retained": ok and bool(body)}
+
+    def _cluster_info(self) -> Tuple[int, object]:
+        if self.cluster is None:
+            return 200, {"mode": "standalone"}
+        return 200, {
+            "mode": "cluster",
+            "members": {m.node_id: {"status": m.status,
+                                    "agents": sorted(m.agents)}
+                        for m in self.cluster.members.values()},
+        }
+
+    def _sessions(self, arg) -> Tuple[int, object]:
+        tenant = arg("tenant_id") or "DevOnly"
+        online = self.broker.session_registry.client_ids(tenant)
+        persistent = [i for t, i, m in self.broker.inbox.store.all_inboxes()
+                      if t == tenant]
+        return 200, {"online": sorted(online),
+                     "persistent": sorted(persistent)}
+
+    def _routes(self, arg) -> Tuple[int, object]:
+        tenant = arg("tenant_id") or "DevOnly"
+        trie = self.broker.dist.matcher.tries.get(tenant)
+        routes = []
+        if trie is not None:
+            for r in trie.routes():
+                routes.append({"filter": r.matcher.mqtt_topic_filter,
+                               "broker": r.broker_id,
+                               "receiver": r.receiver_id})
+        return 200, {"count": len(routes), "routes": routes[:1000]}
+
+    def _retained(self, arg) -> Tuple[int, object]:
+        tenant = arg("tenant_id") or "DevOnly"
+        svc = self.broker.retain_service
+        topics = sorted(svc.tenants.get(tenant, {})) if svc else []
+        return 200, {"count": len(topics), "topics": topics[:1000]}

@@ -295,9 +295,11 @@ class MQTTBroker:
                  settings: Optional[ISettingProvider] = None,
                  events: Optional[IEventCollector] = None,
                  dist: Optional[DistService] = None,
-                 retain_service=None, inbox_engine=None) -> None:
+                 retain_service=None, inbox_engine=None,
+                 ssl_context=None) -> None:
         self.host = host
         self.port = port
+        self.ssl_context = ssl_context  # TLS listener (≈ 8883/netty-tcnative)
         self.auth = auth or AllowAllAuthProvider()
         self.settings = settings or DefaultSettingProvider()
         self.events = events or CollectingEventCollector()
@@ -323,7 +325,7 @@ class MQTTBroker:
             log.info("recovered %d persistent sessions from storage",
                      recovered)
         self._server = await asyncio.start_server(
-            self._on_client, self.host, self.port)
+            self._on_client, self.host, self.port, ssl=self.ssl_context)
         addr = self._server.sockets[0].getsockname()
         self.port = addr[1]
         log.info("mqtt broker listening on %s:%s", *addr[:2])

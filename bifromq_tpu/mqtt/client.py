@@ -27,9 +27,11 @@ class MQTTClient:
                  username: Optional[str] = None,
                  password: Optional[bytes] = None,
                  will: Optional[pk.Will] = None,
-                 properties: Optional[dict] = None) -> None:
+                 properties: Optional[dict] = None,
+                 ssl_context=None) -> None:
         self.host = host
         self.port = port
+        self.ssl_context = ssl_context
         self.client_id = client_id
         self.protocol_level = protocol_level
         self.clean_start = clean_start
@@ -53,7 +55,7 @@ class MQTTClient:
 
     async def connect(self, timeout: float = 5.0) -> pk.Connack:
         self._reader, self._writer = await asyncio.open_connection(
-            self.host, self.port)
+            self.host, self.port, ssl=self.ssl_context)
         await self._send(pk.Connect(
             client_id=self.client_id, protocol_level=self.protocol_level,
             clean_start=self.clean_start, keep_alive=self.keep_alive,

@@ -90,6 +90,10 @@ class SessionRegistry:
     def get(self, tenant_id: str, client_id: str) -> Optional["Session"]:
         return self._owners.get((tenant_id, client_id))
 
+    def client_ids(self, tenant_id: str) -> List[str]:
+        """Connected client ids for a tenant (introspection)."""
+        return [cid for (t, cid) in self._owners if t == tenant_id]
+
 
 class TransientSubBroker(ISubBroker):
     """Sub-broker id 0: delivery into local transient sessions."""
@@ -529,6 +533,9 @@ class Session:
             await self.conn.send(pk.Publish(topic=topic, payload=msg.payload,
                                             qos=0, retain=retain_flag,
                                             properties=props))
+            self.events.report(Event(EventType.DELIVERED,
+                                     self.client_info.tenant_id,
+                                     {"topic": topic, "qos": 0}))
             return None
         pid = None
         if len(self._outbound) < self._client_recv_max:

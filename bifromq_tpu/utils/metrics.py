@@ -1,0 +1,107 @@
+"""Per-tenant metrics (≈ bifromq-metrics ITenantMeter/TenantMeter).
+
+The reference meters every tenant-visible flow through micrometer
+(TenantMetric enum: MqttQoS0IngressBytes, MqttPersistentFanOutBytes, …).
+Here: a dependency-free registry of per-(tenant, metric) counters and
+gauges with a JSON-able snapshot (served by the API server's /metrics).
+An event-collector adapter turns the plugin event stream into meters, so
+services need no direct metrics coupling.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from collections import defaultdict
+from typing import Callable, Dict, Tuple
+
+from ..plugin.events import Event, EventType, IEventCollector
+
+
+class TenantMetric(enum.Enum):
+    CONNECTIONS = "connections"
+    CONNECT_COUNT = "connect_count"
+    DISCONNECT_COUNT = "disconnect_count"
+    SESSION_KICKED = "session_kicked"
+    PUB_RECEIVED = "pub_received"
+    DELIVERED = "delivered"
+    DELIVER_ERRORS = "deliver_errors"
+    QOS_DROPPED = "qos_dropped"
+    SUB_COUNT = "sub_count"
+    UNSUB_COUNT = "unsub_count"
+    FANOUT_THROTTLED = "fanout_throttled"
+    RETAINED = "retained"
+    RETAIN_CLEARED = "retain_cleared"
+    WILL_DISTED = "will_disted"
+    INBOX_OVERFLOW = "inbox_overflow"
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._counters: Dict[Tuple[str, str], int] = defaultdict(int)
+        self._gauges: Dict[Tuple[str, str], Callable[[], float]] = {}
+        self._lock = threading.Lock()
+        self.started_at = time.time()
+
+    def inc(self, tenant_id: str, metric: TenantMetric, n: int = 1) -> None:
+        with self._lock:
+            self._counters[(tenant_id, metric.value)] += n
+
+    def gauge(self, tenant_id: str, name: str,
+              fn: Callable[[], float]) -> None:
+        with self._lock:
+            self._gauges[(tenant_id, name)] = fn
+
+    def get(self, tenant_id: str, metric: TenantMetric) -> int:
+        return self._counters.get((tenant_id, metric.value), 0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            per_tenant: Dict[str, Dict[str, float]] = defaultdict(dict)
+            for (tenant, name), v in self._counters.items():
+                per_tenant[tenant][name] = v
+            for (tenant, name), fn in self._gauges.items():
+                try:
+                    per_tenant[tenant][name] = fn()
+                except Exception:  # noqa: BLE001
+                    pass
+            return {"uptime_s": round(time.time() - self.started_at, 1),
+                    "tenants": dict(per_tenant)}
+
+
+_EVENT_TO_METRIC = {
+    EventType.CLIENT_CONNECTED: TenantMetric.CONNECT_COUNT,
+    EventType.CLIENT_DISCONNECTED: TenantMetric.DISCONNECT_COUNT,
+    EventType.SESSION_KICKED: TenantMetric.SESSION_KICKED,
+    EventType.PUB_RECEIVED: TenantMetric.PUB_RECEIVED,
+    EventType.DELIVERED: TenantMetric.DELIVERED,
+    EventType.DELIVER_ERROR: TenantMetric.DELIVER_ERRORS,
+    EventType.QOS0_DROPPED: TenantMetric.QOS_DROPPED,
+    EventType.QOS1_DROPPED: TenantMetric.QOS_DROPPED,
+    EventType.QOS2_DROPPED: TenantMetric.QOS_DROPPED,
+    EventType.SUB_ACKED: TenantMetric.SUB_COUNT,
+    EventType.UNSUB_ACKED: TenantMetric.UNSUB_COUNT,
+    EventType.PERSISTENT_FANOUT_THROTTLED: TenantMetric.FANOUT_THROTTLED,
+    EventType.GROUP_FANOUT_THROTTLED: TenantMetric.FANOUT_THROTTLED,
+    EventType.MSG_RETAINED: TenantMetric.RETAINED,
+    EventType.RETAIN_MSG_CLEARED: TenantMetric.RETAIN_CLEARED,
+    EventType.WILL_DISTED: TenantMetric.WILL_DISTED,
+    EventType.OVERFLOWED: TenantMetric.INBOX_OVERFLOW,
+}
+
+
+class MeteringEventCollector(IEventCollector):
+    """Event-collector decorator: meters events, then forwards downstream."""
+
+    def __init__(self, registry: MetricsRegistry,
+                 downstream: IEventCollector = None) -> None:
+        self.registry = registry
+        self.downstream = downstream
+
+    def report(self, event: Event) -> None:
+        metric = _EVENT_TO_METRIC.get(event.type)
+        if metric is not None:
+            self.registry.inc(event.tenant_id or "-", metric)
+        if self.downstream is not None:
+            self.downstream.report(event)

@@ -1,0 +1,167 @@
+"""API server + metrics integration tests (≈ bifromq-apiserver handler
+tests): a real broker + real HTTP over loopback."""
+
+import asyncio
+import json
+
+import pytest
+
+from bifromq_tpu.apiserver import APIServer
+from bifromq_tpu.mqtt.broker import MQTTBroker
+from bifromq_tpu.mqtt.client import MQTTClient
+from bifromq_tpu.plugin.events import CollectingEventCollector
+from bifromq_tpu.utils.metrics import (MeteringEventCollector, MetricsRegistry,
+                                       TenantMetric)
+
+pytestmark = pytest.mark.asyncio
+
+
+async def http(port, method, path, body=b""):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(
+        f"{method} {path} HTTP/1.1\r\nhost: x\r\n"
+        f"content-length: {len(body)}\r\nconnection: close\r\n\r\n".encode()
+        + body)
+    await writer.drain()
+    raw = await reader.read(65536)
+    writer.close()
+    head, _, payload = raw.partition(b"\r\n\r\n")
+    status = int(head.split(b" ")[1])
+    return status, json.loads(payload)
+
+
+@pytest.fixture
+async def stack():
+    registry = MetricsRegistry()
+    events = MeteringEventCollector(registry, CollectingEventCollector())
+    broker = MQTTBroker(port=0, events=events)
+    await broker.start()
+    api = APIServer(broker, port=0, metrics=registry)
+    await api.start()
+    yield broker, api, registry
+    await api.stop()
+    broker.inbox.close()
+    await broker.stop()
+
+
+class TestAPI:
+    async def test_pub_reaches_subscriber(self, stack):
+        broker, api, _ = stack
+        sub = MQTTClient(port=broker.port, client_id="s1")
+        await sub.connect()
+        await sub.subscribe("api/t")
+        status, out = await http(api.port, "PUT",
+                                 "/pub?tenant_id=DevOnly&topic=api/t&qos=1",
+                                 b"hello-from-http")
+        assert status == 200 and out["fanout"] == 1
+        msg = await sub.recv()
+        assert msg.payload == b"hello-from-http"
+        await sub.disconnect()
+
+    async def test_pub_invalid_topic(self, stack):
+        _, api, _ = stack
+        status, out = await http(api.port, "PUT", "/pub?topic=bad/%2B/x")
+        # '+' decoded into the topic -> invalid
+        assert status == 400
+
+    async def test_kill(self, stack):
+        broker, api, _ = stack
+        c = MQTTClient(port=broker.port, client_id="victim")
+        await c.connect()
+        status, out = await http(api.port, "DELETE",
+                                 "/kill?tenant_id=DevOnly&client_id=victim")
+        assert status == 200
+        await asyncio.wait_for(c.closed.wait(), 5)
+        status, _ = await http(api.port, "DELETE",
+                               "/kill?tenant_id=DevOnly&client_id=victim")
+        assert status == 404
+
+    async def test_sub_unsub_on_behalf(self, stack):
+        broker, api, _ = stack
+        # persistent session exists offline
+        c = MQTTClient(port=broker.port, client_id="dev9", clean_start=False)
+        await c.connect()
+        await c.disconnect()
+        status, out = await http(
+            api.port, "PUT",
+            "/sub?tenant_id=DevOnly&client_id=dev9&topic_filter=a/%23&qos=1")
+        assert status == 200 and out["result"] == "ok"
+        # publish lands in the inbox even though the client is offline
+        await http(api.port, "PUT", "/pub?topic=a/b&qos=1", b"queued")
+        f = broker.inbox.store.fetch("DevOnly", "dev9")
+        assert len(f.buffer) == 1
+        status, out = await http(
+            api.port, "DELETE",
+            "/unsub?tenant_id=DevOnly&client_id=dev9&topic_filter=a/%23")
+        assert status == 200 and out["removed"]
+
+    async def test_session_expire_and_listing(self, stack):
+        broker, api, _ = stack
+        c = MQTTClient(port=broker.port, client_id="listme",
+                       clean_start=False)
+        await c.connect()
+        status, out = await http(api.port, "GET",
+                                 "/sessions?tenant_id=DevOnly")
+        assert "listme" in out["online"] and "listme" in out["persistent"]
+        await c.disconnect()
+        status, out = await http(
+            api.port, "DELETE",
+            "/session?tenant_id=DevOnly&client_id=listme")
+        assert status == 200 and out["deleted"]
+
+    async def test_retain_and_listing(self, stack):
+        broker, api, _ = stack
+        status, out = await http(api.port, "PUT",
+                                 "/retain?tenant_id=DevOnly&topic=r/t",
+                                 b"val")
+        assert status == 200 and out["retained"]
+        status, out = await http(api.port, "GET",
+                                 "/retained?tenant_id=DevOnly")
+        assert out["topics"] == ["r/t"]
+        # empty body clears
+        await http(api.port, "PUT", "/retain?tenant_id=DevOnly&topic=r/t")
+        status, out = await http(api.port, "GET",
+                                 "/retained?tenant_id=DevOnly")
+        assert out["count"] == 0
+
+    async def test_routes_listing(self, stack):
+        broker, api, _ = stack
+        c = MQTTClient(port=broker.port, client_id="router")
+        await c.connect()
+        await c.subscribe("x/+")
+        status, out = await http(api.port, "GET", "/routes?tenant_id=DevOnly")
+        assert out["count"] == 1 and out["routes"][0]["filter"] == "x/+"
+        await c.disconnect()
+
+    async def test_metrics_endpoint(self, stack):
+        broker, api, registry = stack
+        c = MQTTClient(port=broker.port, client_id="m1")
+        await c.connect()
+        await c.subscribe("mt/t")
+        await c.publish("mt/t", b"x", qos=1)
+        await c.recv()
+        await c.disconnect()
+        status, out = await http(api.port, "GET", "/metrics")
+        t = out["tenants"]["DevOnly"]
+        assert t["connect_count"] >= 1
+        assert t["pub_received"] >= 1
+        assert t["delivered"] >= 1
+        assert registry.get("DevOnly", TenantMetric.PUB_RECEIVED) >= 1
+
+    async def test_unknown_route(self, stack):
+        _, api, _ = stack
+        status, _ = await http(api.port, "GET", "/nope")
+        assert status == 404
+
+    async def test_cluster_standalone(self, stack):
+        _, api, _ = stack
+        status, out = await http(api.port, "GET", "/cluster")
+        assert out["mode"] == "standalone"
+
+    async def test_bad_qos_param_returns_400(self, stack):
+        _, api, _ = stack
+        status, out = await http(api.port, "PUT",
+                                 "/pub?topic=t&qos=abc", b"x")
+        assert status == 400
+        status, out = await http(api.port, "PUT", "/pub?topic=t&qos=7", b"x")
+        assert status == 400

@@ -1,0 +1,64 @@
+"""TLS listener test (≈ the reference's 8883/SSL listener)."""
+
+import asyncio
+import ssl
+import subprocess
+
+import pytest
+
+from bifromq_tpu.mqtt.broker import MQTTBroker
+from bifromq_tpu.mqtt.client import MQTTClient
+
+pytestmark = pytest.mark.asyncio
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("certs")
+    key, crt = str(d / "k.pem"), str(d / "c.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", crt, "-days", "1",
+         "-subj", "/CN=localhost"], check=True, capture_output=True)
+    return key, crt
+
+
+class TestTLS:
+    async def test_pubsub_over_tls(self, certs):
+        key, crt = certs
+        server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        server_ctx.load_cert_chain(crt, key)
+        b = MQTTBroker(port=0, ssl_context=server_ctx)
+        await b.start()
+        try:
+            client_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            client_ctx.check_hostname = False
+            client_ctx.verify_mode = ssl.CERT_NONE
+            sub = MQTTClient(port=b.port, client_id="tls-sub",
+                             ssl_context=client_ctx)
+            await sub.connect()
+            await sub.subscribe("secure/t", qos=1)
+            p = MQTTClient(port=b.port, client_id="tls-pub",
+                           ssl_context=client_ctx)
+            await p.connect()
+            assert await p.publish("secure/t", b"encrypted", qos=1) == 0
+            assert (await sub.recv()).payload == b"encrypted"
+            await sub.disconnect()
+            await p.disconnect()
+        finally:
+            b.inbox.close()
+            await b.stop()
+
+    async def test_plaintext_rejected_on_tls_listener(self, certs):
+        key, crt = certs
+        server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        server_ctx.load_cert_chain(crt, key)
+        b = MQTTBroker(port=0, ssl_context=server_ctx)
+        await b.start()
+        try:
+            c = MQTTClient(port=b.port, client_id="plain")
+            with pytest.raises(Exception):
+                await asyncio.wait_for(c.connect(), 3)
+        finally:
+            b.inbox.close()
+            await b.stop()
