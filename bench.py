@@ -14,7 +14,7 @@ publishes no numbers — BASELINE.md; refine when a stock measurement exists).
 Extra detail (latency percentiles, build times, host-fallback rate, oracle
 rate) goes to stderr.
 
-Env knobs: BENCH_SUBS (default 1_000_000), BENCH_BATCH (8192),
+Env knobs: BENCH_SUBS (default 1_000_000), BENCH_BATCH (32768),
 BENCH_ITERS (30), BENCH_K (16), BENCH_SEED (0).
 """
 
@@ -28,7 +28,7 @@ import numpy as np
 ASSUMED_STOCK_RATE = 100_000.0
 
 N_SUBS = int(os.environ.get("BENCH_SUBS", "1000000"))
-BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
+BATCH = int(os.environ.get("BENCH_BATCH", "32768"))
 ITERS = int(os.environ.get("BENCH_ITERS", "30"))
 K_STATES = int(os.environ.get("BENCH_K", "16"))
 SEED = int(os.environ.get("BENCH_SEED", "0"))
