@@ -133,11 +133,12 @@ class InboxService:
 
     def _drop_routes(self, tenant_id: str, inbox_id: str,
                      meta: InboxMetadata) -> None:
-        for tf in list(meta.filters):
+        for tf, opt in list(meta.filters.items()):
             self.dist.unmatch(tenant_id,
                               RouteMatcher.from_topic_filter(tf),
                               PERSISTENT_SUB_BROKER_ID, inbox_id,
-                              self._deliverer_key(inbox_id))
+                              self._deliverer_key(inbox_id),
+                              incarnation=opt.incarnation)
     # ---------------- subscriptions ----------------------------------------
 
     @staticmethod
@@ -146,25 +147,29 @@ class InboxService:
 
     def sub(self, tenant_id: str, inbox_id: str, topic_filter: str,
             opt: TopicFilterOption) -> str:
-        res = self.store.sub(
+        res, stored = self.store.sub(
             tenant_id, inbox_id, topic_filter, opt,
             max_filters=self._setting(Setting.MaxTopicFiltersPerInbox,
                                       tenant_id))
         if res in ("ok", "exists"):
+            # register with the *stored* option's incarnation (bumped on
+            # re-subscribe) so the route table and metadata stay in lockstep
             self.dist.match(tenant_id,
                             RouteMatcher.from_topic_filter(topic_filter),
                             PERSISTENT_SUB_BROKER_ID, inbox_id,
-                            self._deliverer_key(inbox_id))
+                            self._deliverer_key(inbox_id),
+                            incarnation=stored.incarnation)
         return res
 
     def unsub(self, tenant_id: str, inbox_id: str, topic_filter: str) -> bool:
         removed = self.store.unsub(tenant_id, inbox_id, topic_filter)
-        if removed:
-            self.dist.unmatch(tenant_id,
-                              RouteMatcher.from_topic_filter(topic_filter),
-                              PERSISTENT_SUB_BROKER_ID, inbox_id,
-                              self._deliverer_key(inbox_id))
-        return removed
+        if removed is not None:
+            self.dist.unmatch(
+                tenant_id, RouteMatcher.from_topic_filter(topic_filter),
+                PERSISTENT_SUB_BROKER_ID, inbox_id,
+                self._deliverer_key(inbox_id),
+                incarnation=removed.incarnation)
+        return removed is not None
 
     # ---------------- fetch signaling --------------------------------------
 
@@ -201,11 +206,15 @@ class InboxService:
                 asyncio.get_running_loop().create_task(
                     self._expire(tenant_id, inbox_id))
                 continue
-            for tf in meta.filters:
+            # thread the stored per-subscription incarnation through so the
+            # rebuilt route can't resurrect over a newer one (incarnation
+            # guard parity, dist-worker batchAddRoute)
+            for tf, opt in meta.filters.items():
                 self.dist.match(tenant_id,
                                 RouteMatcher.from_topic_filter(tf),
                                 PERSISTENT_SUB_BROKER_ID, inbox_id,
-                                self._deliverer_key(inbox_id))
+                                self._deliverer_key(inbox_id),
+                                incarnation=opt.incarnation)
             self.delay.schedule(
                 (tenant_id, inbox_id), meta.expire_at(),
                 lambda t=tenant_id, i=inbox_id:

@@ -211,26 +211,37 @@ class InboxStore:
     # ---------------- subscriptions (≈ batchSub/batchUnsub) ----------------
 
     def sub(self, tenant_id: str, inbox_id: str, topic_filter: str,
-            opt: TopicFilterOption, max_filters: int) -> str:
-        """Returns 'ok' | 'exists' | 'exceeds_limit' | 'no_inbox'."""
+            opt: TopicFilterOption, max_filters: int
+            ) -> Tuple[str, Optional[TopicFilterOption]]:
+        """Returns (status, effective_option): status is 'ok' | 'exists' |
+        'exceeds_limit' | 'no_inbox'; effective_option is the stored option
+        (incarnation-bumped on re-subscribe) or None when not stored."""
         meta = self._load(tenant_id, inbox_id)
         if meta is None:
-            return "no_inbox"
+            return "no_inbox", None
         existed = topic_filter in meta.filters
         if not existed and len(meta.filters) >= max_filters:
-            return "exceeds_limit"
+            return "exceeds_limit", None
+        # bump the per-subscription incarnation on re-subscribe so the new
+        # route supersedes any stale one still in flight (incarnation guard,
+        # ref inbox-store batchSub / dist-worker batchAddRoute)
+        if existed:
+            opt = replace(opt,
+                          incarnation=meta.filters[topic_filter].incarnation + 1)
         meta.filters[topic_filter] = opt
         self._store(tenant_id, meta)
-        return "exists" if existed else "ok"
+        return ("exists" if existed else "ok"), opt
 
     def unsub(self, tenant_id: str, inbox_id: str,
-              topic_filter: str) -> bool:
+              topic_filter: str) -> Optional[TopicFilterOption]:
+        """Remove a subscription; returns the removed option (the caller
+        needs its incarnation for the route unmatch), or None."""
         meta = self._load(tenant_id, inbox_id)
         if meta is None or topic_filter not in meta.filters:
-            return False
-        del meta.filters[topic_filter]
+            return None
+        opt = meta.filters.pop(topic_filter)
         self._store(tenant_id, meta)
-        return True
+        return opt
 
     # ---------------- insert (≈ batchInsert) -------------------------------
 

@@ -62,6 +62,8 @@ def load_lib():
         lib.kv_count.argtypes = [ctypes.c_void_p]
         lib.kv_flush.argtypes = [ctypes.c_void_p]
         lib.kv_checkpoint.argtypes = [ctypes.c_void_p]
+        lib.kv_set_sync.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.kv_commit.argtypes = [ctypes.c_void_p]
         lib.kv_wal_bytes.restype = ctypes.c_uint64
         lib.kv_wal_bytes.argtypes = [ctypes.c_void_p]
         lib.kv_iter.restype = ctypes.c_void_p
@@ -135,6 +137,12 @@ class NativeKVSpace(IKVSpace):
     def flush(self) -> None:
         self._lib.kv_flush(self._h)
 
+    def set_sync(self, fsync_on_commit: bool) -> None:
+        """Toggle fsync-on-commit (the WALable SPI's sync contract); the
+        default flushes each batch commit to the OS page cache, which
+        survives a process crash but not power loss."""
+        self._lib.kv_set_sync(self._h, int(fsync_on_commit))
+
     @property
     def wal_bytes(self) -> int:
         return self._lib.kv_wal_bytes(self._h)
@@ -148,6 +156,7 @@ class NativeKVSpace(IKVSpace):
     def put_metadata(self, key: bytes, value: bytes) -> None:
         self._lib.kv_put(self._h, b"\xfeMETA" + key, len(key) + 5,
                          value, len(value))
+        self._lib.kv_commit(self._h)
 
     def _apply(self, ops) -> None:
         for op, a, b in ops:
@@ -157,6 +166,9 @@ class NativeKVSpace(IKVSpace):
                 self._lib.kv_del(self._h, a, len(a))
             else:
                 self._lib.kv_del_range(self._h, a, len(a), b, len(b))
+        # group-commit barrier: the batch is acknowledged once it reaches the
+        # kernel (or the platter, with set_sync(True))
+        self._lib.kv_commit(self._h)
 
     def __len__(self) -> int:
         return int(self._lib.kv_count(self._h))

@@ -387,9 +387,15 @@ class StreamDecoder:
             return None, 0
         body = bytes(buf[pos:pos + length])
         level = self.protocol_level
-        if ptype == PacketType.CONNECT:
-            pkt = _decode_connect(body)
-            self.protocol_level = pkt.protocol_level
-        else:
-            pkt = decode_packet(ptype, flags, body, level)
+        # translate any stray short-read error from a truncated/hostile body
+        # into MalformedPacket so sessions answer with a protocol-level
+        # disconnect instead of the generic connection-crashed path
+        try:
+            if ptype == PacketType.CONNECT:
+                pkt = _decode_connect(body)
+                self.protocol_level = pkt.protocol_level
+            else:
+                pkt = decode_packet(ptype, flags, body, level)
+        except (IndexError, struct.error) as e:
+            raise MalformedPacket(f"truncated packet body: {e}") from e
         return pkt, pos + length

@@ -44,6 +44,10 @@ struct Space {
     std::string wal_path;
     std::string ckpt_path;
     uint64_t wal_bytes = 0;
+    // 0 = flush to OS page cache per batch commit (survives process crash);
+    // 1 = fsync per batch commit (survives power loss) — the WALable SPI's
+    // sync-on-commit contract.
+    int sync_mode = 0;
 
     ~Space() {
         if (wal) fclose(wal);
@@ -61,6 +65,15 @@ static void wal_append(Space* sp, uint8_t op, const Bytes& a, const Bytes& b) {
     write_u32(sp->wal, (uint32_t)b.size());
     fwrite(b.data(), 1, b.size(), sp->wal);
     sp->wal_bytes += 9 + a.size() + b.size();
+}
+
+// Batch-commit barrier: acknowledged writes must not sit in a userspace
+// stdio buffer, so the Python write batch calls this once at done() — flush
+// to the kernel (survives process crash); sync_mode additionally fsyncs
+// (survives power loss). Group commit, not per-record syscalls.
+static void wal_commit(Space* sp) {
+    fflush(sp->wal);
+    if (sp->sync_mode) fsync(fileno(sp->wal));
 }
 
 static void apply_op(Space* sp, uint8_t op, const Bytes& a, const Bytes& b) {
@@ -216,10 +229,30 @@ int kv_checkpoint(void* spp) {
     fsync(fileno(f));
     fclose(f);
     if (rename(tmp.c_str(), sp->ckpt_path.c_str()) != 0) return -1;
+    // truncate the WAL by swapping in a fresh handle; on failure keep the old
+    // handle — replaying a pre-checkpoint WAL over the checkpoint is a no-op
+    // (ops re-apply in order to the same final state), so an un-truncated WAL
+    // is safe, a nullptr handle is not.
+    FILE* nw = fopen(sp->wal_path.c_str(), "wb");
+    if (!nw) return -1;
     fclose(sp->wal);
-    sp->wal = fopen(sp->wal_path.c_str(), "wb");  // truncate
+    sp->wal = nw;
     sp->wal_bytes = 0;
     return 0;
+}
+
+// sync_mode: 0 = flush-per-commit (default), 1 = fsync-per-commit
+void kv_set_sync(void* spp, int sync_mode) {
+    auto* sp = static_cast<Space*>(spp);
+    std::lock_guard<std::mutex> lock(sp->eng->mu);
+    sp->sync_mode = sync_mode;
+}
+
+// group-commit barrier for a write batch (see wal_commit)
+void kv_commit(void* spp) {
+    auto* sp = static_cast<Space*>(spp);
+    std::lock_guard<std::mutex> lock(sp->eng->mu);
+    wal_commit(sp);
 }
 
 uint64_t kv_wal_bytes(void* spp) {

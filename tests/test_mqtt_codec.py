@@ -249,3 +249,23 @@ class TestTruncatedBodies:
         with pytest.raises(MalformedPacket):
             decode_packet(PacketType.CONNECT, 0,
                           b"\x00\x04MQTT\x04\x02", 4)  # missing keepalive
+
+    def test_every_connect_prefix_raises_malformed(self):
+        # a hostile frame: complete per remaining-length, body cut anywhere —
+        # must surface MalformedPacket, never IndexError/struct.error
+        from bifromq_tpu.mqtt.codec import _decode_connect
+        full = codec.encode(pk.Connect(
+            client_id="cid", protocol_level=5, username="u", password=b"p",
+            will=pk.Will(topic="w", payload=b"x", qos=1)), 5)
+        # strip fixed header (type byte + varint) to get the body
+        _, pos = codec.decode_varint(full, 1)
+        body = full[pos:]
+        for cut in range(len(body)):
+            try:
+                _decode_connect(body[:cut])
+            except MalformedPacket:
+                pass
+            except Exception as e:
+                import struct as _s
+                assert not isinstance(e, (IndexError, _s.error)), (
+                    f"raw {type(e).__name__} at cut={cut}")

@@ -123,3 +123,31 @@ class TestNativeEngine:
         f = store.fetch("T", "i1")
         assert [m[2].payload for m in f.buffer] == [b"m"]
         eng.close()
+
+    def test_survives_hard_process_kill(self, dir_):
+        # acknowledged writes must not sit in a userspace stdio buffer:
+        # a child process writes (no flush/close) then os._exit()s — the
+        # record must still be there on recovery (RocksDB WAL parity)
+        import subprocess
+        import sys
+        code = (
+            "from bifromq_tpu.kv.native import NativeKVEngine\n"
+            "import os\n"
+            f"eng = NativeKVEngine({dir_!r})\n"
+            "sp = eng.create_space('s')\n"
+            "sp.writer().put(b'acked', b'payload').done()\n"
+            "os._exit(0)\n")
+        subprocess.run([sys.executable, "-c", code], check=True,
+                       cwd=os.path.dirname(os.path.dirname(__file__)))
+        eng = NativeKVEngine(dir_)
+        assert eng.create_space("s").get(b"acked") == b"payload"
+        eng.close()
+
+    def test_sync_mode_toggle(self, dir_):
+        eng = NativeKVEngine(dir_)
+        sp = eng.create_space("s")
+        sp.set_sync(True)
+        sp.writer().put(b"k", b"v").done()
+        assert sp.get(b"k") == b"v"
+        sp.set_sync(False)
+        eng.close()
