@@ -10,16 +10,29 @@ device; topics that exceed the fixed-shape walk (active-state overflow,
 over-deep topics) fall back to the host oracle, mirroring the bounded-probe
 fallback contract of the reference matcher.
 
-Mutation → visibility: callers mutate via add_route/remove_route and the
-automaton is recompiled lazily (dirty flag) — the double-buffered
-"refresh after mutation" behavior of TenantRouteCache.java:100-160. Real
-deployments recompile off the serving thread; see dist/ (later stage) for the
-serving integration.
+Mutation → visibility (the TenantRouteCache.java:100-160 refresh-on-mutation
+contract, re-designed for an immutable compiled automaton):
+
+- Every mutation applies to the authoritative tries instantly (exact
+  incarnation guards) and lands in a small **delta overlay** — per-tenant
+  delta tries for adds plus a tombstone set for removes/supersedes — so it
+  is visible to the *next* match call without recompiling anything.
+- Serving walks the **base** compiled automaton (double-buffered device
+  tables) and corrects the expansion with the overlay: tombstoned base
+  matchings are suppressed, delta-trie matches are merged in, then fan-out
+  caps apply to the merged set.
+- A background **compaction** folds the overlay into a new base: the
+  mutation log replays onto a shadow copy of the tries (so the compile
+  reads a frozen snapshot while serving keeps mutating), the shadow
+  compiles off-thread, and the serving thread swaps in the new tables and
+  rebuilds the (now tiny) overlay from the log suffix. Staleness of the
+  base is bounded by compile time; correctness never depends on it.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+import threading
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -33,25 +46,53 @@ from .oracle import (
     SubscriptionTrie,
 )
 
+# tombstone key: (full mqtt topic filter incl. any share prefix, receiver_url)
+_TombKey = Tuple[str, Tuple[int, str, str]]
+
 
 class TpuMatcher:
     def __init__(self, *, max_levels: int = 16, k_states: int = 32,
-                 probe_len: int = 8, device=None) -> None:
+                 probe_len: int = 8, device=None,
+                 auto_compact: bool = True,
+                 compact_threshold: int = 2048) -> None:
         self.max_levels = max_levels
         self.k_states = k_states
         self.probe_len = probe_len
         self.device = device
+        self.auto_compact = auto_compact
+        self.compact_threshold = compact_threshold
+        # authoritative state (exact guards; host fallback matches)
         self.tries: Dict[str, SubscriptionTrie] = {}
-        self._compiled: Optional[CompiledTrie] = None
+        # serving snapshot (double-buffered: swapped atomically, old tables
+        # stay alive for in-flight dispatches)
+        self._base_ct: Optional[CompiledTrie] = None
         self._device_trie = None
-        self._dirty = True
+        # overlay since the base snapshot
+        self._delta: Dict[str, SubscriptionTrie] = {}
+        self._tomb: Dict[str, Set[_TombKey]] = {}
+        self._overlay_n = 0
+        # mutation log since the shadow copy last synced; shadow is the
+        # frozen snapshot source for off-thread compiles
+        self._log: List[Tuple] = []
+        self._shadow: Dict[str, SubscriptionTrie] = {}
+        self._swap_lock = threading.Lock()
+        self._pending_swap = None   # set by the compact thread
+        self._compact_done = False
+        self._compact_thread: Optional[threading.Thread] = None
+        self.compile_count = 0      # full compiles (observability/tests)
 
     # ---------------- mutation side (≈ batchAddRoute/batchRemoveRoute) -----
 
     def add_route(self, tenant_id: str, route: Route) -> bool:
-        added = self.tries.setdefault(tenant_id, SubscriptionTrie()).add(route)
-        self._dirty = True
-        return added
+        trie = self.tries.setdefault(tenant_id, SubscriptionTrie())
+        created, effective = trie.add_effective(route)
+        if not effective:  # stale-incarnation upsert: nothing changed
+            return False
+        op = ("add", tenant_id, route)
+        self._log.append(op)
+        self._overlay_record(op)
+        self._maybe_compact()
+        return created
 
     def remove_route(self, tenant_id: str, matcher, receiver_url,
                      incarnation: int = 0) -> bool:
@@ -59,25 +100,134 @@ class TpuMatcher:
         if trie is None:
             return False
         removed = trie.remove(matcher, receiver_url, incarnation)
-        if removed:
-            if len(trie) == 0:
-                del self.tries[tenant_id]
-            self._dirty = True
-        return removed
+        if not removed:
+            return False
+        if len(trie) == 0:
+            del self.tries[tenant_id]
+        op = ("rm", tenant_id, matcher, receiver_url, incarnation)
+        self._log.append(op)
+        self._overlay_record(op)
+        self._maybe_compact()
+        return True
 
-    # ---------------- compilation ------------------------------------------
+    def _overlay_record(self, op: Tuple) -> None:
+        """Fold one log op into the serving overlay (delta tries + tombstones).
+
+        The single definition of the overlay semantics: an add supersedes any
+        base copy (tombstone) and supplies the live version via the delta
+        trie; a remove tombstones the base copy and retracts any delta copy.
+        """
+        if op[0] == "add":
+            _, tenant, route = op
+            self._delta.setdefault(tenant, SubscriptionTrie()).add(route)
+            self._tomb.setdefault(tenant, set()).add(
+                (route.matcher.mqtt_topic_filter, route.receiver_url))
+        else:
+            _, tenant, matcher, url, inc = op
+            d = self._delta.get(tenant)
+            if d is not None:
+                d.remove(matcher, url, inc)
+            self._tomb.setdefault(tenant, set()).add(
+                (matcher.mqtt_topic_filter, url))
+        self._overlay_n += 1
+
+    # ---------------- compilation / compaction -----------------------------
+
+    @property
+    def overlay_size(self) -> int:
+        return self._overlay_n
+
+    def _replay_log_into_shadow(self) -> None:
+        for op in self._log:
+            if op[0] == "add":
+                _, tenant, route = op
+                self._shadow.setdefault(tenant, SubscriptionTrie()).add(route)
+            else:
+                _, tenant, matcher, url, inc = op
+                trie = self._shadow.get(tenant)
+                if trie is not None:
+                    trie.remove(matcher, url, inc)
+                    if len(trie) == 0:
+                        del self._shadow[tenant]
+        self._log.clear()
+
+    def _compile_shadow(self) -> Tuple[CompiledTrie, object]:
+        self.compile_count += 1
+        ct = compile_tries(self._shadow, max_levels=self.max_levels,
+                           probe_len=self.probe_len)
+        from ..ops.match import DeviceTrie  # deferred: keeps jax optional
+        dev = DeviceTrie.from_compiled(ct, device=self.device)
+        return ct, dev
 
     def refresh(self) -> CompiledTrie:
-        """Recompile + upload if mutations happened since the last refresh."""
-        if self._dirty or self._compiled is None:
-            self._compiled = compile_tries(
-                self.tries, max_levels=self.max_levels,
-                probe_len=self.probe_len)
-            from ..ops.match import DeviceTrie  # deferred: keeps jax optional
-            self._device_trie = DeviceTrie.from_compiled(
-                self._compiled, device=self.device)
-            self._dirty = False
-        return self._compiled
+        """Blocking compaction: fold every pending mutation into a fresh base.
+
+        Kept for cold start, tests, and explicit quiesce; live mutations use
+        the background path (``_maybe_compact``) instead.
+        """
+        self.drain()
+        if self._log or self._base_ct is None:
+            self._replay_log_into_shadow()
+            ct, dev = self._compile_shadow()
+            self._install_base(ct, dev)
+        return self._base_ct
+
+    def _install_base(self, ct: CompiledTrie, dev) -> None:
+        self._base_ct = ct
+        self._device_trie = dev
+        # overlay = mutations not in this base = the log suffix
+        self._delta = {}
+        self._tomb = {}
+        self._overlay_n = 0
+        for op in self._log:
+            self._overlay_record(op)
+
+    def _maybe_compact(self) -> None:
+        if (not self.auto_compact
+                or self._overlay_n < self.compact_threshold
+                or self._base_ct is None
+                or self._compact_thread is not None):
+            self._apply_pending_swap()
+            return
+        # snapshot: fold the log into the shadow NOW (serving thread, cheap —
+        # O(log)); the compile thread then reads only the frozen shadow
+        self._replay_log_into_shadow()
+
+        def work():
+            try:
+                result = self._compile_shadow()
+            except Exception:  # noqa: BLE001 — must not wedge compaction
+                import logging
+                logging.getLogger(__name__).exception(
+                    "background compaction failed; will retry")
+                result = None
+            with self._swap_lock:
+                self._pending_swap = result
+                self._compact_done = True
+
+        self._compact_done = False
+        t = threading.Thread(target=work, name="tpu-matcher-compact",
+                             daemon=True)
+        self._compact_thread = t
+        t.start()
+
+    def _apply_pending_swap(self) -> None:
+        with self._swap_lock:
+            pending, self._pending_swap = self._pending_swap, None
+            done = self._compact_done
+        if pending is not None:
+            self._install_base(*pending)
+        if done:
+            # thread finished (successfully or not): allow the next compact
+            self._compact_thread = None
+            self._compact_done = False
+
+    def drain(self) -> None:
+        """Wait for any in-flight compaction and apply its result."""
+        t = self._compact_thread
+        if t is not None:
+            t.join()
+        self._apply_pending_swap()
 
     @property
     def compiled(self) -> CompiledTrie:
@@ -94,12 +244,19 @@ class TpuMatcher:
                     *, max_persistent_fanout: int = UNCAPPED_FANOUT,
                     max_group_fanout: int = UNCAPPED_FANOUT,
                     batch: Optional[int] = None) -> List[MatchedRoutes]:
-        """Match (tenant_id, topic_levels) pairs; returns per-query routes."""
+        """Match (tenant_id, topic_levels) pairs; returns per-query routes.
+
+        Exact at every instant: base walk ⊕ overlay ⊖ tombstones equals a
+        match against the authoritative tries.
+        """
         from ..ops.match import Probes, walk
 
         if not queries:
             return []
-        ct = self.refresh()
+        self._apply_pending_swap()
+        if self._base_ct is None:
+            self.refresh()
+        ct = self._base_ct
         if batch is None:
             # pad to power-of-two buckets: every distinct batch shape costs an
             # XLA compile, so live traffic must reuse a small set of shapes
@@ -117,18 +274,37 @@ class TpuMatcher:
         overflow = np.asarray(res.overflow)
         out: List[MatchedRoutes] = []
         for qi, (tenant_id, levels) in enumerate(queries):
-            if roots[qi] < 0:  # tenant has no routes at all
-                out.append(MatchedRoutes())
+            tomb = self._tomb.get(tenant_id)
+            delta = self._delta.get(tenant_id)
+            if roots[qi] < 0:
+                # tenant absent from the base snapshot: all its routes (if
+                # any) are newer than the base — serve from authoritative
+                if tenant_id in self.tries:
+                    out.append(self.tries[tenant_id].match(
+                        list(levels),
+                        max_persistent_fanout=max_persistent_fanout,
+                        max_group_fanout=max_group_fanout))
+                else:
+                    out.append(MatchedRoutes())
                 continue
             needs_fallback = overflow[qi] or tok.lengths[qi] < 0
             if needs_fallback:
-                out.append(self.tries[tenant_id].match(
+                trie = self.tries.get(tenant_id)
+                out.append(trie.match(
                     list(levels), max_persistent_fanout=max_persistent_fanout,
-                    max_group_fanout=max_group_fanout))
+                    max_group_fanout=max_group_fanout)
+                    if trie is not None else MatchedRoutes())
                 continue
             nodes = np.concatenate([hash_acc[qi].ravel(), final_acc[qi]])
-            out.append(self._expand(ct, nodes[nodes >= 0],
-                                    max_persistent_fanout, max_group_fanout))
+            nodes = nodes[nodes >= 0]
+            if not tomb and delta is None:
+                # fast path: no overlay for this tenant
+                out.append(self._expand(ct, nodes, max_persistent_fanout,
+                                        max_group_fanout))
+                continue
+            out.append(self._expand_with_overlay(
+                ct, nodes, tomb or (), delta, list(levels),
+                max_persistent_fanout, max_group_fanout))
         return out
 
     def match(self, tenant_id: str, topic: str, **kwargs) -> MatchedRoutes:
@@ -160,4 +336,48 @@ class TpuMatcher:
                             continue
                         out.persistent_fanout += 1
                     out.normal.append(m)
+        return out
+
+    def _expand_with_overlay(self, ct: CompiledTrie, nodes: np.ndarray,
+                             tomb, delta: Optional[SubscriptionTrie],
+                             levels: List[str],
+                             max_persistent_fanout: int,
+                             max_group_fanout: int) -> MatchedRoutes:
+        """Base expansion ⊖ tombstones ⊕ delta matches, then caps."""
+        normal: List[Route] = []
+        groups: Dict[str, List[Route]] = {}
+        node_tab = ct.node_tab
+        for n in nodes:
+            start = int(node_tab[n, NODE_RSTART])
+            count = int(node_tab[n, NODE_RCOUNT])
+            for slot in range(start, start + count):
+                m: Matching = ct.matchings[slot]
+                if isinstance(m, GroupMatching):
+                    members = [r for r in m.members
+                               if (m.mqtt_topic_filter, r.receiver_url)
+                               not in tomb]
+                    if members:
+                        groups[m.mqtt_topic_filter] = members
+                else:
+                    if (m.matcher.mqtt_topic_filter, m.receiver_url) not in tomb:
+                        normal.append(m)
+        if delta is not None:
+            dm = delta.match(levels)
+            normal.extend(dm.normal)
+            for f, members in dm.groups.items():
+                groups.setdefault(f, []).extend(members)
+        # caps over the merged set (MatchedRoutes.java:38 rules)
+        out = MatchedRoutes()
+        for r in normal:
+            if r.broker_id == PERSISTENT_SUB_BROKER_ID:
+                if out.persistent_fanout >= max_persistent_fanout:
+                    out.max_persistent_fanout_exceeded = True
+                    continue
+                out.persistent_fanout += 1
+            out.normal.append(r)
+        for f, members in groups.items():
+            if len(out.groups) >= max_group_fanout:
+                out.max_group_fanout_exceeded = True
+                continue
+            out.groups[f] = members
         return out

@@ -112,6 +112,16 @@ class SubscriptionTrie:
         Incarnation guard: an insert with a stale incarnation (< existing) is
         ignored, matching the reference's guard on normal-route upsert.
         """
+        return self.add_effective(route)[0]
+
+    def add_effective(self, route: Route) -> Tuple[bool, bool]:
+        """Insert or refresh a route; returns (created, effective).
+
+        ``created``: a new entry was created. ``effective``: the stored state
+        changed at all (a refresh of an existing entry with an equal-or-newer
+        incarnation is effective but not created; a stale-incarnation insert
+        is neither). Overlay maintenance (TpuMatcher) keys off ``effective``.
+        """
         url = route.receiver_url
         # probe without creating first: a stale-incarnation insert must not
         # materialize (and leak) empty trie nodes along a new path
@@ -125,23 +135,23 @@ class SubscriptionTrie:
             existing = probe.routes.get(url)
             if existing is not None:
                 if existing.incarnation > route.incarnation:
-                    return False
+                    return False, False
                 probe.routes[url] = route
-                return False
+                return False, True
         node = self._root
         for level in route.matcher.filter_levels:
             node = node.children.setdefault(level, _TrieNode())
         if route.matcher.type == RouteMatcherType.NORMAL:
             node.routes[url] = route
             self._count += 1
-            return True
+            return True, True
         gkey = (int(route.matcher.type), route.matcher.group or "")
         group = node.groups.setdefault(gkey, {})
         created = url not in group
         group[url] = route
         if created:
             self._count += 1
-        return created
+        return created, True
 
     def remove(self, matcher: RouteMatcher, receiver_url: Tuple[int, str, str],
                incarnation: int = 0) -> bool:

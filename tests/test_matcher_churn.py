@@ -1,0 +1,167 @@
+"""Incremental-refresh churn tests: mutations stay visible without full
+recompiles, serving stays exact during background compaction, and after
+quiesce the compiled base matches the authoritative tries exactly
+(TenantRouteCache.java:100-160 refresh-on-mutation contract)."""
+
+import random
+
+import pytest
+
+from bifromq_tpu.models.matcher import TpuMatcher
+from bifromq_tpu.models.oracle import Route, SubscriptionTrie
+from bifromq_tpu.types import RouteMatcher
+from bifromq_tpu.utils import topic as topic_util
+
+
+def mk_route(tf: str, receiver: str, inc: int = 0, broker: int = 0) -> Route:
+    return Route(matcher=RouteMatcher.from_topic_filter(tf), broker_id=broker,
+                 receiver_id=receiver, deliverer_key="d0", incarnation=inc)
+
+
+def assert_same(matched, oracle_matched, ctx=""):
+    got = sorted((r.matcher.mqtt_topic_filter, r.receiver_url)
+                 for r in matched.normal)
+    want = sorted((r.matcher.mqtt_topic_filter, r.receiver_url)
+                  for r in oracle_matched.normal)
+    assert got == want, f"normal mismatch {ctx}: {got} != {want}"
+    got_g = {f: sorted(r.receiver_url for r in ms)
+             for f, ms in matched.groups.items()}
+    want_g = {f: sorted(r.receiver_url for r in ms)
+              for f, ms in oracle_matched.groups.items()}
+    assert got_g == want_g, f"group mismatch {ctx}"
+
+
+FILTERS = ["a/b", "a/+", "a/#", "+/b", "x/y/z", "a/b/c", "#",
+           "$share/g1/a/b", "$share/g1/a/+", "$oshare/g2/a/b"]
+TOPICS = [["a", "b"], ["a", "c"], ["a", "b", "c"], ["x", "y", "z"], ["q"]]
+
+
+class TestChurn:
+    def test_mutations_visible_without_recompile(self):
+        m = TpuMatcher(max_levels=8, k_states=16, auto_compact=False)
+        for i in range(50):
+            m.add_route("T", mk_route(FILTERS[i % len(FILTERS)], f"r{i}"))
+        m.refresh()
+        base_compiles = m.compile_count
+        # every mutation must be visible on the very next match, with no
+        # further full compiles; `live` is an independent ground truth
+        # (a plain dict of surviving (filter, receiver) pairs)
+        rng = random.Random(7)
+        live = set()
+        for i in range(50):
+            live.add((FILTERS[i % len(FILTERS)], f"r{i}"))
+        for step in range(300):
+            tf = rng.choice(FILTERS)
+            rid = f"r{rng.randrange(60)}"
+            if rng.random() < 0.5:
+                m.add_route("T", mk_route(tf, rid, inc=step))
+                live.add((tf, rid))
+            else:
+                m.remove_route("T", RouteMatcher.from_topic_filter(tf),
+                               (0, rid, "d0"), incarnation=step)
+                live.discard((tf, rid))
+            if step % 25 == 0:
+                topic = rng.choice(TOPICS)
+                got = m.match_batch([("T", topic)])[0]
+                want = m.tries["T"].match(list(topic)) if "T" in m.tries \
+                    else SubscriptionTrie().match(list(topic))
+                assert_same(got, want, f"step {step}")
+                # cross-check normal matches against the independent set
+                want_normal = sorted(
+                    (tf2, (0, rid2, "d0")) for tf2, rid2 in live
+                    if not tf2.startswith("$share")
+                    and not tf2.startswith("$oshare")
+                    and topic_util.matches(
+                        list(topic),
+                        RouteMatcher.from_topic_filter(tf2).filter_levels))
+                got_normal = sorted((r.matcher.mqtt_topic_filter,
+                                     r.receiver_url) for r in got.normal)
+                assert got_normal == want_normal, f"step {step}"
+        assert m.compile_count == base_compiles, "serving path recompiled"
+
+    def test_background_compaction_keeps_serving_exact(self):
+        m = TpuMatcher(max_levels=8, k_states=16, auto_compact=True,
+                       compact_threshold=64)
+        for i in range(200):
+            m.add_route("T", mk_route(f"s/{i}/+", f"r{i}"))
+        m.refresh()
+        rng = random.Random(11)
+        for step in range(400):
+            i = rng.randrange(300)
+            if rng.random() < 0.6:
+                m.add_route("T", mk_route(f"s/{i}/+", f"r{i}", inc=step))
+            else:
+                m.remove_route("T",
+                               RouteMatcher.from_topic_filter(f"s/{i}/+"),
+                               (0, f"r{i}", "d0"), incarnation=step)
+            if step % 17 == 0:
+                i = rng.randrange(300)
+                topic = ["s", str(i), "leaf"]
+                got = m.match_batch([("T", topic)])[0]
+                want = m.tries["T"].match(topic)
+                assert_same(got, want, f"step {step}")
+        # compaction must actually have happened in the background
+        m.drain()
+        assert m.compile_count >= 2
+
+    def test_post_quiesce_parity_and_empty_overlay(self):
+        m = TpuMatcher(max_levels=8, k_states=16, auto_compact=True,
+                       compact_threshold=32)
+        rng = random.Random(3)
+        for step in range(150):
+            tf = rng.choice(FILTERS)
+            m.add_route("T", mk_route(tf, f"r{rng.randrange(40)}", inc=step))
+        m.refresh()
+        assert m.overlay_size == 0
+        for topic in TOPICS:
+            got = m.match_batch([("T", topic)])[0]
+            want = m.tries["T"].match(list(topic))
+            assert_same(got, want, f"post-quiesce {topic}")
+
+    def test_new_tenant_after_base_compile(self):
+        m = TpuMatcher(max_levels=8, auto_compact=False)
+        m.add_route("T1", mk_route("a/b", "r1"))
+        m.refresh()
+        # T2 appears only after the base snapshot
+        m.add_route("T2", mk_route("a/+", "r2"))
+        got = m.match_batch([("T2", ["a", "b"])])[0]
+        assert [r.receiver_id for r in got.normal] == ["r2"]
+        # and an unknown tenant still matches nothing
+        assert m.match_batch([("zz", ["a", "b"])])[0].all_routes() == []
+
+    def test_remove_all_routes_of_base_tenant(self):
+        m = TpuMatcher(max_levels=8, auto_compact=False)
+        m.add_route("T", mk_route("a/b", "r1"))
+        m.add_route("T", mk_route("a/+", "r2"))
+        m.refresh()
+        m.remove_route("T", RouteMatcher.from_topic_filter("a/b"),
+                       (0, "r1", "d0"))
+        m.remove_route("T", RouteMatcher.from_topic_filter("a/+"),
+                       (0, "r2", "d0"))
+        assert m.match_batch([("T", ["a", "b"])])[0].all_routes() == []
+
+    def test_shared_group_member_churn(self):
+        m = TpuMatcher(max_levels=8, auto_compact=False)
+        m.add_route("T", mk_route("$share/g/a/b", "r1"))
+        m.add_route("T", mk_route("$share/g/a/b", "r2"))
+        m.refresh()
+        # add a member post-base; remove one pre-base member
+        m.add_route("T", mk_route("$share/g/a/b", "r3"))
+        m.remove_route("T", RouteMatcher.from_topic_filter("$share/g/a/b"),
+                       (0, "r1", "d0"))
+        got = m.match_batch([("T", ["a", "b"])])[0]
+        assert sorted(r.receiver_id
+                      for r in got.groups["$share/g/a/b"]) == ["r2", "r3"]
+
+    def test_incarnation_guard_skips_overlay(self):
+        m = TpuMatcher(max_levels=8, auto_compact=False)
+        m.add_route("T", mk_route("a/b", "r1", inc=5))
+        m.refresh()
+        # stale re-add must not resurrect through the overlay
+        assert not m.add_route("T", mk_route("a/b", "r1", inc=3))
+        assert m.overlay_size == 0
+        # stale remove is a no-op
+        assert not m.remove_route("T", RouteMatcher.from_topic_filter("a/b"),
+                                  (0, "r1", "d0"), incarnation=3)
+        got = m.match_batch([("T", ["a", "b"])])[0]
+        assert [r.incarnation for r in got.normal] == [5]
