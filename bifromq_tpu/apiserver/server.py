@@ -130,7 +130,7 @@ class APIServer:
             if route == ("DELETE", "/kill"):
                 return await self._kill(arg)
             if route == ("DELETE", "/session"):
-                return self._expire_session(arg)
+                return await self._expire_session(arg)
             if route == ("PUT", "/retain"):
                 return await self._retain(arg, body)
             if route == ("GET", "/cluster"):
@@ -180,7 +180,7 @@ class APIServer:
             return 400, {"error": "invalid topic filter"}
         qos = int(arg("qos", "0"))
         from ..types import TopicFilterOption
-        res = self.broker.inbox.sub(tenant, client_id, tf,
+        res = await self.broker.inbox.sub(tenant, client_id, tf,
                                     TopicFilterOption(qos=QoS(qos)))
         if res == "no_inbox":
             return 404, {"error": "no such persistent session"}
@@ -192,7 +192,7 @@ class APIServer:
         tf = arg("topic_filter")
         if not client_id or not tf:
             return 400, {"error": "client_id and topic_filter required"}
-        removed = self.broker.inbox.unsub(tenant, client_id, tf)
+        removed = await self.broker.inbox.unsub(tenant, client_id, tf)
         return (200 if removed else 404), {"removed": removed}
 
     async def _kill(self, arg) -> Tuple[int, object]:
@@ -204,11 +204,11 @@ class APIServer:
         await session.kick()
         return 200, {"killed": client_id}
 
-    def _expire_session(self, arg) -> Tuple[int, object]:
+    async def _expire_session(self, arg) -> Tuple[int, object]:
         tenant = arg("tenant_id") or "DevOnly"
         client_id = arg("client_id")
         existed = self.broker.inbox.store.exists(tenant, client_id or "")
-        self.broker.inbox.delete(tenant, client_id or "")
+        await self.broker.inbox.delete(tenant, client_id or "")
         return (200 if existed else 404), {"deleted": existed}
 
     async def _retain(self, arg, body: bytes) -> Tuple[int, object]:

@@ -1,15 +1,16 @@
 """Distribution service: route table + TPU match + fan-out delivery.
 
-Single-process re-expression of the reference's dist stack
-(bifromq-dist-server DistService → dist-worker DistWorkerCoProc →
-bifromq-deliverer MessageDeliverer), with the route-match hot loop on the
-TPU matcher (models.matcher.TpuMatcher):
+Re-expression of the reference's dist stack (bifromq-dist-server
+DistService → dist-worker DistWorkerCoProc → bifromq-deliverer
+MessageDeliverer). There is ONE route table and it lives on the replicated
+KV range hosted by ``DistWorker`` (≈ DistWorkerCoProc.java:105 — "the route
+table *is* the KV"):
 
-- ``match``/``unmatch`` mutate the authoritative route trie
-  (≈ DistWorkerCoProc.batchAddRoute:304 / batchRemoveRoute:415, including
-  incarnation guards) and refresh the compiled automaton.
+- ``match``/``unmatch`` are RW coproc calls through consensus
+  (≈ batchAddRoute:304 / batchRemoveRoute:415, incl. incarnation guards).
 - ``pub`` funnels through a per-tenant adaptive Batcher (≈ PubCallScheduler →
-  BatchDistServerCall) that emits device match batches.
+  BatchDistServerCall) that emits device match batches served from the
+  worker replica's derived TpuMatcher.
 - Fan-out: shared-group member election (ordered share = rendezvous hash on
   topic, unordered = random — ≈ DeliverExecutorGroup's cached ordered pick),
   then delivery batched per (tenant, sub-broker, deliverer key)
@@ -54,34 +55,51 @@ class DistService:
     def __init__(self, sub_brokers: SubBrokerRegistry,
                  event_collector: IEventCollector,
                  setting_provider: ISettingProvider, *,
+                 worker=None,
                  matcher: Optional[TpuMatcher] = None,
                  max_burst_latency: float = 0.005,
                  rng_seed: Optional[int] = None) -> None:
         self.sub_brokers = sub_brokers
         self.events = event_collector
         self.settings = setting_provider
-        self.matcher = matcher or TpuMatcher()
+        if worker is None:
+            from .worker import DistWorker, DistWorkerCoProc
+            worker = DistWorker(coproc=DistWorkerCoProc(matcher))
+        self.worker = worker
         self._rng = random.Random(rng_seed)
         self._pub_scheduler: BatchCallScheduler[PubCall, PubResult] = \
             BatchCallScheduler(lambda tenant: self._make_pub_batch(tenant),
                                max_burst_latency=max_burst_latency)
 
+    @property
+    def matcher(self) -> TpuMatcher:
+        """This replica's derived matcher (introspection/metrics only —
+        mutations MUST go through match/unmatch so they ride consensus)."""
+        return self.worker.matcher
+
+    async def start(self) -> None:
+        await self.worker.start()
+
+    async def stop(self) -> None:
+        await self.worker.stop()
+
     # ---------------- route mutations (≈ batchAddRoute/batchRemoveRoute) ---
 
-    def match(self, tenant_id: str, matcher: RouteMatcher, broker_id: int,
-              receiver_id: str, deliverer_key: str,
-              incarnation: int = 0) -> bool:
+    async def match(self, tenant_id: str, matcher: RouteMatcher,
+                    broker_id: int, receiver_id: str, deliverer_key: str,
+                    incarnation: int = 0) -> bool:
         route = Route(matcher=matcher, broker_id=broker_id,
                       receiver_id=receiver_id, deliverer_key=deliverer_key,
                       incarnation=incarnation)
-        return self.matcher.add_route(tenant_id, route)
+        return await self.worker.add_route(tenant_id, route) in ("ok",
+                                                                 "exists")
 
-    def unmatch(self, tenant_id: str, matcher: RouteMatcher, broker_id: int,
-                receiver_id: str, deliverer_key: str,
-                incarnation: int = 0) -> bool:
-        return self.matcher.remove_route(
+    async def unmatch(self, tenant_id: str, matcher: RouteMatcher,
+                      broker_id: int, receiver_id: str, deliverer_key: str,
+                      incarnation: int = 0) -> bool:
+        return await self.worker.remove_route(
             tenant_id, matcher, (broker_id, receiver_id, deliverer_key),
-            incarnation)
+            incarnation) == "ok"
 
     # ---------------- publish path -----------------------------------------
 
@@ -96,7 +114,7 @@ class DistService:
                 Setting.MaxPersistentFanout, tenant_id)
             mgf = self.settings.provide(Setting.MaxGroupFanout, tenant_id)
             queries = [(tenant_id, topic_util.parse(c.topic)) for c in calls]
-            matched = self.matcher.match_batch(
+            matched = await self.worker.match_batch(
                 queries,
                 max_persistent_fanout=(
                     mpf if mpf is not None
@@ -157,7 +175,7 @@ class DistService:
                 elif outcome in (DeliveryResult.NO_SUB,
                                  DeliveryResult.NO_RECEIVER):
                     # dead route cleanup (≈ BatchDeliveryCall NO_SUB handling)
-                    self.matcher.remove_route(
+                    await self.worker.remove_route(
                         tenant_id, route.matcher, route.receiver_url,
                         route.incarnation)
         return fanout

@@ -166,3 +166,155 @@ class DistWorkerCoProc(IKVRangeCoProc):
             tenant_id = _tenant_of_key(key)
             self.matcher.add_route(tenant_id,
                                    schema.decode_route(tenant_id, key, value))
+
+
+class DistWorker:
+    """Hosts the dist route-table range replica and serves the broker's dist
+    plane from it (≈ dist-worker role: DistWorker.java:48 hosting
+    DistWorkerCoProc on a BaseKVStoreServer range).
+
+    There is ONE route table and it lives on the replicated KV: mutations go
+    through consensus (``ReplicatedKVRange.mutate_coproc`` → coproc
+    incarnation-guarded apply on every replica), matches are served from this
+    replica's derived TpuMatcher (the reference's replica-spread reads —
+    BatchDistServerCall.replicaSelect:245 picks any query-ready replica).
+
+    Defaults give a single-voter in-process deployment (the standalone
+    broker); multi-voter clusters share a transport and tick externally or
+    via each worker's tick loop.
+    """
+
+    def __init__(self, *, node_id: str = "local",
+                 voters: Optional[List[str]] = None,
+                 transport=None, space: Optional[IKVSpace] = None,
+                 coproc: Optional[DistWorkerCoProc] = None,
+                 tick_interval: float = 0.01) -> None:
+        from ..kv.engine import InMemKVEngine
+        from ..raft.transport import InMemTransport
+
+        self.transport = transport if transport is not None else InMemTransport()
+        self.space = (space if space is not None
+                      else InMemKVEngine().create_space("dist_routes"))
+        self.coproc = coproc or DistWorkerCoProc()
+        from ..kv.range import ReplicatedKVRange
+        self.range = ReplicatedKVRange("dist", node_id,
+                                       voters or [node_id],
+                                       self.transport, self.space,
+                                       coproc=self.coproc)
+        if hasattr(self.transport, "register"):
+            self.transport.register(self.range.raft)
+        self.tick_interval = tick_interval
+        self._tick_task = None
+
+    @property
+    def matcher(self) -> TpuMatcher:
+        return self.coproc.matcher
+
+    async def start(self) -> None:
+        """Recover derived state from the (possibly durable) route keyspace,
+        drive the initial election, and start the tick loop."""
+        import asyncio
+
+        self.coproc.reset(self.space)
+        from ..raft.node import Role
+        if len(self.range.raft.voters) == 1:
+            # standalone: elect deterministically without waiting wall-clock
+            for _ in range(10_000):
+                if self.range.raft.role == Role.LEADER:
+                    break
+                self.range.raft.tick()
+                self._pump()
+        self._tick_task = asyncio.create_task(self._tick_loop())
+
+    async def stop(self) -> None:
+        if self._tick_task is not None:
+            self._tick_task.cancel()
+            try:
+                await self._tick_task
+            except BaseException:  # noqa: BLE001 — cancellation
+                pass
+            self._tick_task = None
+        self.range.raft.stop()
+
+    def _pump(self) -> None:
+        pump = getattr(self.transport, "pump", None)
+        if pump is not None:
+            pump()
+
+    async def _tick_loop(self) -> None:
+        import asyncio
+
+        while True:
+            self.range.raft.tick()
+            self._pump()
+            await asyncio.sleep(self.tick_interval)
+
+    # ---------------- dist plane API ---------------------------------------
+
+    async def _mutate(self, payload: bytes, *, timeout: float = 5.0) -> bytes:
+        """Propose with a bounded wait for leadership.
+
+        Covers the window before the initial election completes. A follower
+        replica keeps failing with NotLeaderError after the timeout — leader
+        forwarding arrives with the RPC fabric (multi-process deployment);
+        until then multi-voter workers must mutate via the leader."""
+        import asyncio
+        import time as _time
+
+        from ..raft.node import NotLeaderError
+
+        deadline = _time.monotonic() + timeout
+        while True:
+            try:
+                return await self.range.mutate_coproc(payload)
+            except NotLeaderError:
+                if (_time.monotonic() >= deadline
+                        or self.range.raft.leader_id not in (
+                            None, self.range.raft.id)):
+                    raise
+                await asyncio.sleep(self.tick_interval)
+
+    async def add_route(self, tenant_id: str, route: Route) -> str:
+        out = await self._mutate(encode_add_route(tenant_id, route))
+        return out.decode()
+
+    async def remove_route(self, tenant_id: str, matcher: RouteMatcher,
+                           receiver_url: Tuple[int, str, str],
+                           incarnation: int = 0) -> str:
+        out = await self._mutate(
+            encode_remove_route(tenant_id, matcher, receiver_url,
+                                incarnation))
+        return out.decode()
+
+    async def purge_broker_routes(self, broker_id: int) -> int:
+        """Remove every route targeting ``broker_id`` receivers.
+
+        Crash-recovery sweep: transient-session routes written through to a
+        durable route keyspace must not resurrect after an unclean restart
+        (their sessions are gone). The reference reaps these via the
+        dist GC + checkSubscriptions purge (DistWorkerCoProc.gc:554)."""
+        doomed = []
+        for key, value in self.space.iterate(
+                schema.TAG_DIST, schema.prefix_end(schema.TAG_DIST)):
+            tenant_id = _tenant_of_key(key)
+            route = schema.decode_route(tenant_id, key, value)
+            if route.broker_id == broker_id:
+                doomed.append((tenant_id, route))
+        for tenant_id, route in doomed:
+            await self._mutate(encode_remove_route(
+                tenant_id, route.matcher, route.receiver_url,
+                route.incarnation))
+        return len(doomed)
+
+    async def match_batch(self, queries, *, max_persistent_fanout,
+                          max_group_fanout, linearized: bool = False):
+        """Serve matches from this replica's derived matcher.
+
+        ``linearized=True`` adds a read-index barrier (leader only); the pub
+        hot path uses the default local read, matching the reference's
+        non-linearized coproc query for dist."""
+        if linearized:
+            await self.range.raft.read_index()
+        return self.coproc.matcher.match_batch(
+            queries, max_persistent_fanout=max_persistent_fanout,
+            max_group_fanout=max_group_fanout)

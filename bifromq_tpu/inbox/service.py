@@ -72,6 +72,13 @@ class InboxService:
         self.delay = DelayTaskRunner(clock=clock)
         # online fetch signalers: (tenant, inbox) -> callback (≈ FetcherSignaler)
         self._signals: Dict[Tuple[str, str], Callable[[], None]] = {}
+        # per-inbox locks: store mutation + dist consensus write must be
+        # atomic vs concurrent sub/unsub/delete/expire (the awaited dist call
+        # is a suspension point; reference serializes via AsyncRunner)
+        self._locks: Dict[Tuple[str, str], asyncio.Lock] = {}
+
+    def _lock(self, tenant_id: str, inbox_id: str) -> asyncio.Lock:
+        return self._locks.setdefault((tenant_id, inbox_id), asyncio.Lock())
 
     def _setting(self, s: Setting, tenant_id: str):
         v = self.settings.provide(s, tenant_id)
@@ -109,32 +116,43 @@ class InboxService:
 
     async def _expire(self, tenant_id: str, inbox_id: str) -> None:
         """ExpireInboxTask + SendLWTTask: fire LWT, drop routes, delete."""
-        meta = self.store.get(tenant_id, inbox_id)
-        if meta is None or meta.detached_at is None:
-            return  # reattached meanwhile
-        if meta.expire_at() > self.clock():
-            return
-        if meta.lwt is not None:
-            publisher = ClientInfo(tenant_id=tenant_id,
-                                   metadata=meta.client_meta)
-            await self.dist.pub(publisher, meta.lwt.topic, meta.lwt.message)
-            self.events.report(Event(EventType.WILL_DISTED, tenant_id,
-                                     {"topic": meta.lwt.topic,
-                                      "inbox": inbox_id}))
-        self._drop_routes(tenant_id, inbox_id, meta)
-        self.store.delete(tenant_id, inbox_id)
+        async with self._lock(tenant_id, inbox_id):
+            meta = self.store.get(tenant_id, inbox_id)
+            if meta is None or meta.detached_at is None:
+                return  # reattached meanwhile
+            if meta.expire_at() > self.clock():
+                return
+            if meta.lwt is not None:
+                publisher = ClientInfo(tenant_id=tenant_id,
+                                       metadata=meta.client_meta)
+                await self.dist.pub(publisher, meta.lwt.topic,
+                                    meta.lwt.message)
+                self.events.report(Event(EventType.WILL_DISTED, tenant_id,
+                                         {"topic": meta.lwt.topic,
+                                          "inbox": inbox_id}))
+            # re-read: the inbox may have been reattached/resubscribed while
+            # the LWT pub suspended
+            meta = self.store.get(tenant_id, inbox_id)
+            if meta is None or meta.detached_at is None \
+                    or meta.expire_at() > self.clock():
+                return
+            await self._drop_routes(tenant_id, inbox_id, meta)
+            self.store.delete(tenant_id, inbox_id)
+            self._locks.pop((tenant_id, inbox_id), None)
 
-    def delete(self, tenant_id: str, inbox_id: str) -> None:
-        meta = self.store.get(tenant_id, inbox_id)
-        if meta is not None:
-            self._drop_routes(tenant_id, inbox_id, meta)
-        self.delay.cancel((tenant_id, inbox_id))
-        self.store.delete(tenant_id, inbox_id)
+    async def delete(self, tenant_id: str, inbox_id: str) -> None:
+        async with self._lock(tenant_id, inbox_id):
+            meta = self.store.get(tenant_id, inbox_id)
+            if meta is not None:
+                await self._drop_routes(tenant_id, inbox_id, meta)
+            self.delay.cancel((tenant_id, inbox_id))
+            self.store.delete(tenant_id, inbox_id)
+        self._locks.pop((tenant_id, inbox_id), None)
 
-    def _drop_routes(self, tenant_id: str, inbox_id: str,
-                     meta: InboxMetadata) -> None:
+    async def _drop_routes(self, tenant_id: str, inbox_id: str,
+                           meta: InboxMetadata) -> None:
         for tf, opt in list(meta.filters.items()):
-            self.dist.unmatch(tenant_id,
+            await self.dist.unmatch(tenant_id,
                               RouteMatcher.from_topic_filter(tf),
                               PERSISTENT_SUB_BROKER_ID, inbox_id,
                               self._deliverer_key(inbox_id),
@@ -145,31 +163,34 @@ class InboxService:
     def _deliverer_key(inbox_id: str) -> str:
         return f"i{hash(inbox_id) % 16}"
 
-    def sub(self, tenant_id: str, inbox_id: str, topic_filter: str,
-            opt: TopicFilterOption) -> str:
-        res, stored = self.store.sub(
-            tenant_id, inbox_id, topic_filter, opt,
-            max_filters=self._setting(Setting.MaxTopicFiltersPerInbox,
-                                      tenant_id))
-        if res in ("ok", "exists"):
-            # register with the *stored* option's incarnation (bumped on
-            # re-subscribe) so the route table and metadata stay in lockstep
-            self.dist.match(tenant_id,
-                            RouteMatcher.from_topic_filter(topic_filter),
-                            PERSISTENT_SUB_BROKER_ID, inbox_id,
-                            self._deliverer_key(inbox_id),
-                            incarnation=stored.incarnation)
-        return res
+    async def sub(self, tenant_id: str, inbox_id: str, topic_filter: str,
+                  opt: TopicFilterOption) -> str:
+        async with self._lock(tenant_id, inbox_id):
+            res, stored = self.store.sub(
+                tenant_id, inbox_id, topic_filter, opt,
+                max_filters=self._setting(Setting.MaxTopicFiltersPerInbox,
+                                          tenant_id))
+            if res in ("ok", "exists"):
+                # register with the *stored* option's incarnation (bumped on
+                # re-subscribe) so route table and metadata stay in lockstep
+                await self.dist.match(
+                    tenant_id, RouteMatcher.from_topic_filter(topic_filter),
+                    PERSISTENT_SUB_BROKER_ID, inbox_id,
+                    self._deliverer_key(inbox_id),
+                    incarnation=stored.incarnation)
+            return res
 
-    def unsub(self, tenant_id: str, inbox_id: str, topic_filter: str) -> bool:
-        removed = self.store.unsub(tenant_id, inbox_id, topic_filter)
-        if removed is not None:
-            self.dist.unmatch(
-                tenant_id, RouteMatcher.from_topic_filter(topic_filter),
-                PERSISTENT_SUB_BROKER_ID, inbox_id,
-                self._deliverer_key(inbox_id),
-                incarnation=removed.incarnation)
-        return removed is not None
+    async def unsub(self, tenant_id: str, inbox_id: str,
+                    topic_filter: str) -> bool:
+        async with self._lock(tenant_id, inbox_id):
+            removed = self.store.unsub(tenant_id, inbox_id, topic_filter)
+            if removed is not None:
+                await self.dist.unmatch(
+                    tenant_id, RouteMatcher.from_topic_filter(topic_filter),
+                    PERSISTENT_SUB_BROKER_ID, inbox_id,
+                    self._deliverer_key(inbox_id),
+                    incarnation=removed.incarnation)
+            return removed is not None
 
     # ---------------- fetch signaling --------------------------------------
 
@@ -187,7 +208,7 @@ class InboxService:
 
     # ---------------- recovery (checkpoint/resume) --------------------------
 
-    def recover(self) -> int:
+    async def recover(self) -> int:
         """Rebuild dist routes + expiry timers from persisted inbox state.
 
         The broker calls this on start when the inbox engine is durable —
@@ -210,7 +231,7 @@ class InboxService:
             # rebuilt route can't resurrect over a newer one (incarnation
             # guard parity, dist-worker batchAddRoute)
             for tf, opt in meta.filters.items():
-                self.dist.match(tenant_id,
+                await self.dist.match(tenant_id,
                                 RouteMatcher.from_topic_filter(tf),
                                 PERSISTENT_SUB_BROKER_ID, inbox_id,
                                 self._deliverer_key(inbox_id),

@@ -159,16 +159,15 @@ class ReplicatedKVRange:
 
     async def mutate_coproc(self, payload: bytes) -> bytes:
         """RW coproc call through consensus (≈ KVRangeRWRequest execute)."""
-        fut = self.raft.propose(_enc_coproc(payload))
-        guess = None
-        if not fut.done():  # propose appended synchronously when leader
-            guess = self.raft.last_index
-            self._pending_results.add(guess)
+        # register interest BEFORE proposing: a single-voter leader commits
+        # and applies synchronously inside propose(), so registering after
+        # would miss the result
+        guess = self.raft.last_index + 1
+        self._pending_results.add(guess)
         try:
-            index = await fut
+            index = await self.raft.propose(_enc_coproc(payload))
         finally:
-            if guess is not None:
-                self._pending_results.discard(guess)
+            self._pending_results.discard(guess)
         return self._mutation_results.pop(index, b"")
 
     async def get(self, key: bytes, *, linearized: bool = True

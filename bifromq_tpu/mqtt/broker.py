@@ -248,7 +248,7 @@ class Connection:
         else:
             # clean-start semantics: a transient connect discards any
             # existing persistent state for this client id (inbox + routes)
-            broker.inbox.delete(tenant_id, client_id)
+            await broker.inbox.delete(tenant_id, client_id)
             session = Session(**common)
         # bake the session id into publisher identity (no_local support)
         session.client_info = ClientInfo(
@@ -307,8 +307,16 @@ class MQTTBroker:
         self.session_registry = SessionRegistry(self.events)
         self.sub_brokers = SubBrokerRegistry()
         self.sub_brokers.register(TransientSubBroker(self.local_sessions))
-        self.dist = dist or DistService(self.sub_brokers, self.events,
-                                        self.settings)
+        if dist is None:
+            # ONE route table, on the replicated KV (DistWorkerCoProc.java:105)
+            # — durable when an engine is provided, so routes survive restart
+            # through the dist keyspace itself (coproc reset-from-KV)
+            from ..dist.worker import DistWorker
+            route_space = (inbox_engine.create_space("dist_routes")
+                           if inbox_engine is not None else None)
+            dist = DistService(self.sub_brokers, self.events, self.settings,
+                               worker=DistWorker(space=route_space))
+        self.dist = dist
         if retain_service is None:
             from ..retain.service import RetainService
             retain_service = RetainService(self.events)
@@ -320,7 +328,16 @@ class MQTTBroker:
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> None:
-        recovered = self.inbox.recover()
+        await self.dist.start()
+        # unclean-shutdown sweep: transient-session routes in a durable route
+        # keyspace point at sessions that no longer exist — purge before
+        # serving (the reference's dist GC role, DistWorkerCoProc.gc:554)
+        from ..plugin.subbroker import TRANSIENT_SUB_BROKER_ID
+        purged = await self.dist.worker.purge_broker_routes(
+            TRANSIENT_SUB_BROKER_ID)
+        if purged:
+            log.info("purged %d stale transient routes", purged)
+        recovered = await self.inbox.recover()
         if recovered:
             log.info("recovered %d persistent sessions from storage",
                      recovered)
@@ -345,6 +362,7 @@ class MQTTBroker:
                 await asyncio.wait_for(self._server.wait_closed(), 5)
             except asyncio.TimeoutError:
                 pass
+        await self.dist.stop()
 
     async def _on_client(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter) -> None:

@@ -96,7 +96,7 @@ class PersistentSession(Session):
                               fire_lwt_on_expiry=False)
         elif self.expiry_seconds <= 0:
             # session expiry 0: state dies with the connection (v5 semantics)
-            self.inbox.delete(tenant, self.inbox_id)
+            await self.inbox.delete(tenant, self.inbox_id)
         else:
             self.inbox.detach(tenant, self.inbox_id,
                               fire_lwt_on_expiry=False)
@@ -122,7 +122,7 @@ class PersistentSession(Session):
         if code >= 0x80:
             return code
         sub = self.subscriptions[req.topic_filter]
-        res = self.inbox.sub(
+        res = await self.inbox.sub(
             self.client_info.tenant_id, self.inbox_id, req.topic_filter,
             TopicFilterOption(qos=QoS(sub.qos), no_local=sub.no_local,
                               retain_as_published=sub.retain_as_published,
@@ -134,14 +134,14 @@ class PersistentSession(Session):
                     if self.protocol_level >= PROTOCOL_MQTT5 else 0x80)
         return code
 
-    def _route(self, sub: Subscription) -> None:
+    async def _route(self, sub: Subscription) -> None:
         pass  # inbox.sub (in _subscribe_one) registers the inbox route
 
-    def _unroute(self, sub: Subscription) -> None:
+    async def _unroute(self, sub: Subscription) -> None:
         # persistent routes belong to the inbox; remove via the inbox so
         # store metadata and dist stay consistent
-        self.inbox.unsub(self.client_info.tenant_id, self.inbox_id,
-                         sub.matcher.mqtt_topic_filter)
+        await self.inbox.unsub(self.client_info.tenant_id, self.inbox_id,
+                               sub.matcher.mqtt_topic_filter)
 
     # ---------------- inbox fetch loop (≈ inboxReader.fetch) ---------------
 

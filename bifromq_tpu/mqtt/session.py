@@ -223,7 +223,7 @@ class Session:
         self.session_registry.unregister(self)
         self.local_registry.unregister(self)
         for tf, sub in list(self.subscriptions.items()):
-            self._unroute(sub)
+            await self._unroute(sub)
         self.subscriptions.clear()
         if fire_will and self.will is not None and not self._will_suppressed:
             await self._fire_will()
@@ -445,7 +445,7 @@ class Session:
                            retain_handling=req.retain_handling,
                            sub_id=sub_id)
         self.subscriptions[tf] = sub
-        self._route(sub)
+        await self._route(sub)
         # retained delivery (≈ retainClient.match on SUBSCRIBE)
         if (self.retain_service is not None and ts[Setting.RetainEnabled]
                 and not topic_util.is_shared_subscription(tf)
@@ -470,7 +470,7 @@ class Session:
             if sub is None:
                 codes.append(ReasonCode.NO_SUBSCRIPTION_EXISTED if v5 else 0)
                 continue
-            self._unroute(sub)
+            await self._unroute(sub)
             codes.append(ReasonCode.SUCCESS)
         await self.conn.send(pk.UnsubAck(packet_id=u.packet_id,
                                          reason_codes=codes))
@@ -478,17 +478,18 @@ class Session:
                                  self.client_info.tenant_id,
                                  {"filters": u.topic_filters}))
 
-    def _route(self, sub: Subscription) -> None:
-        """Register the dist route for a new subscription; persistent
-        sessions override (their routes target the inbox sub-broker)."""
-        self.dist.match(self.client_info.tenant_id, sub.matcher,
-                        TRANSIENT_SUB_BROKER_ID, self.session_id,
-                        self._deliverer_key())
+    async def _route(self, sub: Subscription) -> None:
+        """Register the dist route for a new subscription (a consensus write
+        on the route table); persistent sessions override (their routes
+        target the inbox sub-broker)."""
+        await self.dist.match(self.client_info.tenant_id, sub.matcher,
+                              TRANSIENT_SUB_BROKER_ID, self.session_id,
+                              self._deliverer_key())
 
-    def _unroute(self, sub: Subscription) -> None:
-        self.dist.unmatch(self.client_info.tenant_id, sub.matcher,
-                          TRANSIENT_SUB_BROKER_ID, self.session_id,
-                          self._deliverer_key())
+    async def _unroute(self, sub: Subscription) -> None:
+        await self.dist.unmatch(self.client_info.tenant_id, sub.matcher,
+                                TRANSIENT_SUB_BROKER_ID, self.session_id,
+                                self._deliverer_key())
 
     def _deliverer_key(self) -> str:
         # one deliverer group per session bucket (≈ DeliverersPerMqttServer)
