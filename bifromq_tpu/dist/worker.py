@@ -188,6 +188,7 @@ class DistWorker:
                  voters: Optional[List[str]] = None,
                  transport=None, space: Optional[IKVSpace] = None,
                  coproc: Optional[DistWorkerCoProc] = None,
+                 raft_store=None,
                  tick_interval: float = 0.01) -> None:
         from ..kv.engine import InMemKVEngine
         from ..raft.transport import InMemTransport
@@ -200,7 +201,8 @@ class DistWorker:
         self.range = ReplicatedKVRange("dist", node_id,
                                        voters or [node_id],
                                        self.transport, self.space,
-                                       coproc=self.coproc)
+                                       coproc=self.coproc,
+                                       raft_store=raft_store)
         if hasattr(self.transport, "register"):
             self.transport.register(self.range.raft)
         self.tick_interval = tick_interval

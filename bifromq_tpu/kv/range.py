@@ -62,12 +62,26 @@ def _enc_coproc(payload: bytes) -> bytes:
     return bytes([1]) + payload
 
 
+_META_APPLIED = b"raft_applied"
+
+
 class ReplicatedKVRange:
-    """One raft-replicated range bound to a local space + coproc."""
+    """One raft-replicated range bound to a local space + coproc.
+
+    With ``raft_store`` (an IRaftStateStore, e.g. over the durable native
+    engine) the replica survives restart without violating raft safety: hard
+    state/log/snapshot reload from the store, and the data space carries an
+    applied-index watermark so entries already folded into durable FSM state
+    are not re-applied. The watermark is written after the apply batch (not
+    atomically with it), so a crash between the two re-applies ONE entry —
+    all range ops (kv put/del/del_range, coproc route upserts with
+    incarnation guards) are idempotent under re-apply.
+    """
 
     def __init__(self, range_id: str, node_id: str, voters: List[str],
                  transport, space: IKVSpace,
-                 coproc: Optional[IKVRangeCoProc] = None) -> None:
+                 coproc: Optional[IKVRangeCoProc] = None,
+                 raft_store=None) -> None:
         self.range_id = range_id
         self.space = space
         self.coproc = coproc
@@ -75,11 +89,25 @@ class ReplicatedKVRange:
         # the same entries but have no caller waiting — don't accumulate)
         self._mutation_results: dict = {}
         self._pending_results: set = set()
+        applied = 0
+        if raft_store is not None:
+            raw = space.get_metadata(_META_APPLIED)
+            applied = struct.unpack(">Q", raw)[0] if raw else 0
+            snap = raft_store.load_snapshot()
+            if snap is not None and snap.last_index > applied:
+                # the FSM fell behind its own snapshot (e.g. fresh space on
+                # an old store): reinstall before serving
+                self._restore(snap.data)
+                applied = snap.last_index
+                space.put_metadata(_META_APPLIED,
+                                   struct.pack(">Q", applied))
         self.raft = RaftNode(
             node_id, voters, transport,
             apply_cb=self._apply,
             snapshot_cb=self._snapshot,
-            restore_cb=self._restore)
+            restore_cb=self._restore,
+            store=raft_store,
+            initial_applied=applied)
 
     # ---------------- raft callbacks ---------------------------------------
 
@@ -97,6 +125,9 @@ class ReplicatedKVRange:
             writer.done()
             if entry.index in self._pending_results:
                 self._mutation_results[entry.index] = out
+        if self.raft is not None and self.raft.store is not None:
+            self.space.put_metadata(_META_APPLIED,
+                                    struct.pack(">Q", entry.index))
 
     def _apply_kv_batch(self, data: bytes) -> None:
         n = struct.unpack_from(">I", data, 1)[0]

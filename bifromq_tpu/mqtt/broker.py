@@ -312,10 +312,18 @@ class MQTTBroker:
             # — durable when an engine is provided, so routes survive restart
             # through the dist keyspace itself (coproc reset-from-KV)
             from ..dist.worker import DistWorker
-            route_space = (inbox_engine.create_space("dist_routes")
-                           if inbox_engine is not None else None)
+            route_space = None
+            raft_store = None
+            if inbox_engine is not None:
+                route_space = inbox_engine.create_space("dist_routes")
+                # raft hard state/log on its own space of the same durable
+                # engine (≈ the reference's separate WALable engine)
+                from ..raft.store import KVRaftStateStore
+                raft_store = KVRaftStateStore(
+                    inbox_engine.create_space("dist_raft"))
             dist = DistService(self.sub_brokers, self.events, self.settings,
-                               worker=DistWorker(space=route_space))
+                               worker=DistWorker(space=route_space,
+                                                 raft_store=raft_store))
         self.dist = dist
         if retain_service is None:
             from ..retain.service import RetainService

@@ -7,11 +7,12 @@ snapshot install, leader transfer). Deliberately tick-driven like the
 reference (RaftNode.tick():99): a host loop calls ``tick()`` at a fixed
 cadence and tests drive time manually — no wall-clock coupling.
 
-Round-1 scope: leader election (randomized timeouts), log replication with
-per-peer next/match index, majority commit, linearizable read-index,
+Scope: leader election (randomized timeouts + pre-vote), log replication
+with per-peer next/match index, majority commit, linearizable read-index,
 snapshot install for lagging peers with log compaction, leader transfer
-(TimeoutNow), single-server config change (joint consensus is a later
-round, per SURVEY.md §7 hard-parts).
+(TimeoutNow), single-server config change AND two-phase joint consensus
+(C_old,new — ≈ RaftConfigChanger), durable hard state/log/snapshot via
+IRaftStateStore (raft/store.py) so a restarted node cannot double-vote.
 """
 
 from __future__ import annotations
@@ -34,8 +35,11 @@ class LogEntry:
     term: int
     index: int
     data: bytes
-    # config-change entries carry the new voter set instead of user data
+    # config-change entries carry the new voter set instead of user data;
+    # joint-consensus entries additionally carry the outgoing set
+    # (C_old,new — ≈ RaftConfigChanger's two-phase change)
     config: Optional[Tuple[str, ...]] = None
+    config_old: Optional[Tuple[str, ...]] = None
 
 
 @dataclass
@@ -44,6 +48,7 @@ class Snapshot:
     last_term: int
     data: bytes
     voters: Tuple[str, ...]
+    voters_old: Optional[Tuple[str, ...]] = None
 
 
 # ------------------------------ messages ------------------------------------
@@ -146,13 +151,17 @@ class RaftNode:
                  apply_cb: Callable[[LogEntry], None],
                  snapshot_cb: Callable[[], bytes] = lambda: b"",
                  restore_cb: Callable[[bytes], None] = lambda b: None,
+                 store=None, initial_applied: int = 0,
                  rng: Optional[random.Random] = None) -> None:
         self.id = node_id
         self.voters: Set[str] = set(voters)
+        # outgoing voter set while a joint config (C_old,new) is in flight
+        self.voters_old: Optional[Set[str]] = None
         self.transport = transport
         self.apply_cb = apply_cb
         self.snapshot_cb = snapshot_cb
         self.restore_cb = restore_cb
+        self.store = store  # IRaftStateStore; None = volatile (tests only)
         self.rng = rng or random.Random(hash(node_id) & 0xFFFF)
 
         self.role = Role.FOLLOWER
@@ -166,6 +175,9 @@ class RaftNode:
         self.commit_index = 0
         self.last_applied = 0
 
+        if store is not None:
+            self._load_from_store(initial_applied)
+
         self._votes: Set[str] = set()
         self._next_index: Dict[str, int] = {}
         self._match_index: Dict[str, int] = {}
@@ -173,16 +185,58 @@ class RaftNode:
         self._heartbeat_elapsed = 0
         self._election_deadline = self._rand_election()
         self._propose_waiters: Dict[int, asyncio.Future] = {}
+        self._config_final_fut: Optional[asyncio.Future] = None
         self._read_waiters: Dict[int, Tuple[asyncio.Future, Set[str], int]] = {}
         self._read_ctx_seq = 0
         self._term_start_index = 0  # index of this term's no-op (leader)
         self._transfer_target: Optional[str] = None
         self.stopped = False
 
+    # ---------------- persistence ------------------------------------------
+
+    def _load_from_store(self, initial_applied: int) -> None:
+        """Reload term/vote/log/snapshot persisted by a previous incarnation
+        (the IRaftStateStore contract that makes restart double-vote-free)."""
+        self.term, self.voted_for = self.store.load_hard_state()
+        snap = self.store.load_snapshot()
+        if snap is not None:
+            self.snap = snap
+            self.voters = set(snap.voters)
+            self.voters_old = (set(snap.voters_old)
+                               if snap.voters_old is not None else None)
+        self.log = self.store.load_entries()
+        # drop any persisted prefix the snapshot already covers
+        self.log = [e for e in self.log if e.index > self.snap.last_index]
+        self._recompute_config()
+        # the FSM owner tells us how far its durable state already applied;
+        # committed-ness of those entries is implied (they were applied)
+        self.last_applied = max(self.snap.last_index, initial_applied)
+        self.commit_index = self.last_applied
+
+    def _persist_hard(self) -> None:
+        if self.store is not None:
+            self.store.save_hard_state(self.term, self.voted_for)
+
+    def _persist_append(self, entries: List[LogEntry]) -> None:
+        if self.store is not None and entries:
+            self.store.append(entries)
+
     # ---------------- log helpers ------------------------------------------
 
     def _rand_election(self) -> int:
         return self.rng.randint(*self.ELECTION_TICKS)
+
+    def _all_voters(self) -> Set[str]:
+        return (self.voters | self.voters_old if self.voters_old is not None
+                else self.voters)
+
+    def _quorum(self, acks: Set[str]) -> bool:
+        """Majority — in BOTH configs while a joint change is in flight."""
+        ok = len(acks & self.voters) * 2 > len(self.voters)
+        if self.voters_old is not None:
+            ok = ok and (len(acks & self.voters_old) * 2
+                         > len(self.voters_old))
+        return ok
 
     @property
     def last_index(self) -> int:
@@ -236,6 +290,7 @@ class RaftNode:
             return fut
         entry = LogEntry(term=self.term, index=self.last_index + 1, data=data)
         self.log.append(entry)
+        self._persist_append([entry])
         self._propose_waiters[entry.index] = fut
         self._match_index[self.id] = self.last_index
         self._broadcast_append()
@@ -250,8 +305,8 @@ class RaftNode:
         if self.role != Role.LEADER:
             fut.set_exception(NotLeaderError(self.leader_id))
             return fut
-        if len(self.voters) == 1 and self.commit_index >= \
-                self._term_start_index:
+        if (len(self.voters) == 1 and self.voters_old is None
+                and self.commit_index >= self._term_start_index):
             fut.set_result(self.commit_index)
             return fut
         self._read_ctx_seq += 1
@@ -261,21 +316,37 @@ class RaftNode:
         return fut
 
     def change_config(self, new_voters: List[str]) -> "asyncio.Future[int]":
-        """Single-server membership change (add or remove one voter)."""
+        """Cluster membership change (≈ RaftNode.changeClusterConfig():206).
+
+        A one-voter delta commits as a single config entry (raft
+        single-server change). Anything larger runs two-phase joint
+        consensus (≈ RaftConfigChanger): first a C_old,new entry requiring
+        majorities in BOTH sets, then — once that commits — the final C_new
+        entry. The returned future resolves when the FINAL config commits.
+        """
         fut = asyncio.get_running_loop().create_future()
         if self.role != Role.LEADER:
             fut.set_exception(NotLeaderError(self.leader_id))
             return fut
-        diff = self.voters.symmetric_difference(new_voters)
-        if len(diff) > 1:
-            fut.set_exception(ValueError("one voter change at a time"))
+        if self.voters_old is not None:
+            fut.set_exception(RuntimeError("config change in progress"))
             return fut
-        entry = LogEntry(term=self.term, index=self.last_index + 1, data=b"",
-                         config=tuple(sorted(new_voters)))
+        target = tuple(sorted(new_voters))
+        diff = self.voters.symmetric_difference(new_voters)
+        if len(diff) <= 1:
+            entry = LogEntry(term=self.term, index=self.last_index + 1,
+                             data=b"", config=target)
+            self._propose_waiters[entry.index] = fut
+        else:
+            entry = LogEntry(term=self.term, index=self.last_index + 1,
+                             data=b"", config=target,
+                             config_old=tuple(sorted(self.voters)))
+            # resolved when the final (C_new-only) entry commits
+            self._config_final_fut = fut
         self.log.append(entry)
-        # config applies immediately on append (raft single-server change)
-        self._apply_config(entry.config)
-        self._propose_waiters[entry.index] = fut
+        self._persist_append([entry])
+        # a config entry takes effect as soon as it is appended
+        self._set_config(entry.config, entry.config_old)
         self._match_index[self.id] = self.last_index
         self._broadcast_append()
         self._maybe_commit()
@@ -330,6 +401,7 @@ class RaftNode:
         if term > self.term:
             self.term = term
             self.voted_for = None
+            self._persist_hard()
         prev_role = self.role
         self.role = Role.FOLLOWER
         self.leader_id = leader
@@ -340,15 +412,15 @@ class RaftNode:
 
     def _start_prevote(self) -> None:
         """Probe electability before burning a term (pre-vote)."""
-        if self.id not in self.voters:
+        if self.id not in self._all_voters():
             return
         self._election_elapsed = 0
         self._election_deadline = self._rand_election()
         self._prevotes = {self.id}
-        if len(self._prevotes & self.voters) * 2 > len(self.voters):
+        if self._quorum(self._prevotes):
             self._start_election()
             return
-        for peer in self.voters - {self.id}:
+        for peer in self._all_voters() - {self.id}:
             self.transport.send(peer, self.id, PreVote(
                 term=self.term + 1, candidate=self.id,
                 last_log_index=self.last_index, last_log_term=self.last_term))
@@ -371,21 +443,22 @@ class RaftNode:
             return
         if msg.granted:
             self._prevotes.add(sender)
-            if len(self._prevotes & self.voters) * 2 > len(self.voters):
+            if self._quorum(self._prevotes):
                 self._prevotes = set()
                 self._start_election()
 
     def _start_election(self) -> None:
-        if self.id not in self.voters:
+        if self.id not in self._all_voters():
             return
         self.role = Role.CANDIDATE
         self.term += 1
         self.voted_for = self.id
+        self._persist_hard()
         self.leader_id = None
         self._votes = {self.id}
         self._election_elapsed = 0
         self._election_deadline = self._rand_election()
-        for peer in self.voters - {self.id}:
+        for peer in self._all_voters() - {self.id}:
             self.transport.send(peer, self.id, RequestVote(
                 term=self.term, candidate=self.id,
                 last_log_index=self.last_index, last_log_term=self.last_term))
@@ -399,6 +472,7 @@ class RaftNode:
             if up_to_date and self.voted_for in (None, msg.candidate):
                 granted = True
                 self.voted_for = msg.candidate
+                self._persist_hard()  # persist BEFORE promising the vote
                 self._election_elapsed = 0
         self.transport.send(sender, self.id,
                             VoteReply(term=self.term, granted=granted))
@@ -411,7 +485,7 @@ class RaftNode:
             self._check_majority_votes()
 
     def _check_majority_votes(self) -> None:
-        if len(self._votes & self.voters) * 2 > len(self.voters):
+        if self._quorum(self._votes):
             self._become_leader()
 
     def _become_leader(self) -> None:
@@ -419,23 +493,29 @@ class RaftNode:
         self.leader_id = self.id
         self._transfer_target = None
         self._heartbeat_elapsed = 0
-        self._next_index = {p: self.last_index + 1 for p in self.voters}
-        self._match_index = {p: 0 for p in self.voters}
+        peers = self._all_voters()
+        self._next_index = {p: self.last_index + 1 for p in peers}
+        self._match_index = {p: 0 for p in peers}
         self._match_index[self.id] = self.last_index
         # no-op entry to commit prior-term entries promptly; read-index is
         # gated on it committing (raft §8: a new leader may not serve
         # linearizable reads until it has committed an entry in its term)
-        self.log.append(LogEntry(term=self.term, index=self.last_index + 1,
-                                 data=b""))
+        noop = LogEntry(term=self.term, index=self.last_index + 1, data=b"")
+        self.log.append(noop)
+        self._persist_append([noop])
         self._term_start_index = self.last_index
         self._match_index[self.id] = self.last_index
+        # NOTE: if a joint config is in flight (voters_old set), the final
+        # C_new entry is appended only AFTER this term's no-op commits under
+        # the JOINT quorum (see _apply_committed) — appending it here would
+        # let an uncommitted joint config decide commits, splitting brains
         self._broadcast_append()
         self._maybe_commit()  # single-voter groups commit immediately
 
     # ---------------- replication ------------------------------------------
 
     def _broadcast_append(self, read_ctx: Optional[int] = None) -> None:
-        for peer in self.voters - {self.id}:
+        for peer in self._all_voters() - {self.id}:
             self._send_append(peer, read_ctx=read_ctx)
 
     def _send_append(self, peer: str,
@@ -471,14 +551,20 @@ class RaftNode:
                 term=self.term, success=False,
                 match_index=self.snap.last_index, read_ctx=msg.read_ctx))
             return
+        appended: List[LogEntry] = []
         for e in msg.entries:
             existing = self._term_at(e.index)
             if existing is None or existing != e.term:
                 # truncate conflicting suffix, then append
                 self.log = self.log[:max(0, e.index - self.snap.last_index - 1)]
                 self.log.append(e)
-                if e.config is not None:
-                    self._apply_config(e.config)
+                appended.append(e)
+        if appended:
+            self._persist_append(appended)
+            # a truncation may have dropped an uncommitted config entry;
+            # recompute the voter sets from snapshot + surviving log so no
+            # phantom config lingers
+            self._recompute_config()
         match = msg.prev_index + len(msg.entries)
         if msg.leader_commit > self.commit_index:
             self.commit_index = min(msg.leader_commit, self.last_index)
@@ -517,9 +603,9 @@ class RaftNode:
             t = self._term_at(idx)
             if t != self.term:
                 continue  # only commit current-term entries by counting
-            votes = sum(1 for p in self.voters
-                        if self._match_index.get(p, 0) >= idx)
-            if votes * 2 > len(self.voters):
+            acks = {p for p in self._all_voters()
+                    if self._match_index.get(p, 0) >= idx}
+            if self._quorum(acks):
                 self.commit_index = idx
                 self._apply_committed()
                 break
@@ -533,13 +619,25 @@ class RaftNode:
             fut = self._propose_waiters.pop(self.last_applied, None)
             if fut is not None and not fut.done():
                 fut.set_result(self.last_applied)
-            if (e is not None and e.config is not None
-                    and self.role == Role.LEADER
-                    and self.id not in self.voters):
-                # a leader removed by a committed config change steps down
-                self._become_follower(self.term, None)
+            if e is not None and e.config is not None \
+                    and e.config_old is None:
+                if self._config_final_fut is not None \
+                        and not self._config_final_fut.done():
+                    self._config_final_fut.set_result(self.last_applied)
+                    self._config_final_fut = None
+                if (self.role == Role.LEADER
+                        and self.id not in self.voters):
+                    # a leader removed by the committed final config
+                    # steps down
+                    self._become_follower(self.term, None)
         if (self.role == Role.LEADER
                 and self.commit_index >= self._term_start_index):
+            if self.voters_old is not None:
+                # the joint entry is committed under BOTH quorums (it
+                # precedes this term's committed no-op): safe to leave
+                # the joint config now — exactly once, since this flips
+                # voters_old to None
+                self._append_final_config()
             self._flush_confirmed_reads()
         self._maybe_compact()
 
@@ -548,7 +646,7 @@ class RaftNode:
         no-op committed (read-index gating)."""
         for ctx in list(self._read_waiters):
             fut, acks, _ = self._read_waiters[ctx]
-            if len(acks & self.voters) * 2 > len(self.voters):
+            if self._quorum(acks):
                 del self._read_waiters[ctx]
                 if not fut.done():
                     fut.set_result(self.commit_index)
@@ -561,8 +659,7 @@ class RaftNode:
             return
         fut, acks, _ = st
         acks.add(sender)
-        quorum = len(acks & self.voters) * 2 > len(self.voters)
-        if quorum and self.commit_index >= self._term_start_index:
+        if self._quorum(acks) and self.commit_index >= self._term_start_index:
             # leadership confirmed AND this term has a committed entry:
             # the current commit index is a safe linearization point
             del self._read_waiters[ctx]
@@ -588,8 +685,14 @@ class RaftNode:
         new_log = self._entries_from(cut + 1)
         self.snap = Snapshot(last_index=cut, last_term=term,
                              data=self.snapshot_cb(),
-                             voters=tuple(sorted(self.voters)))
+                             voters=tuple(sorted(self.voters)),
+                             voters_old=(tuple(sorted(self.voters_old))
+                                         if self.voters_old is not None
+                                         else None))
         self.log = new_log
+        if self.store is not None:
+            self.store.save_snapshot(self.snap)
+            self.store.truncate_prefix(cut)
 
     def _on_install_snapshot(self, sender: str, msg: InstallSnapshot) -> None:
         if msg.term < self.term:
@@ -604,6 +707,11 @@ class RaftNode:
         self.commit_index = msg.snapshot.last_index
         self.last_applied = msg.snapshot.last_index
         self.voters = set(msg.snapshot.voters)
+        self.voters_old = (set(msg.snapshot.voters_old)
+                           if msg.snapshot.voters_old is not None else None)
+        if self.store is not None:
+            self.store.save_snapshot(msg.snapshot)
+            self.store.truncate_prefix(1 << 60)
         self.restore_cb(msg.snapshot.data)
         self.transport.send(sender, self.id, SnapshotReply(
             term=self.term, match_index=msg.snapshot.last_index))
@@ -618,21 +726,49 @@ class RaftNode:
 
     # ---------------- config -----------------------------------------------
 
-    def _apply_config(self, voters: Tuple[str, ...]) -> None:
+    def _recompute_config(self) -> None:
+        """Derive the effective voter sets from snapshot + log (the last
+        config entry wins) — used after load and after conflict truncation."""
+        voters: Tuple[str, ...] = tuple(self.snap.voters)
+        old = self.snap.voters_old
+        for e in self.log:
+            if e.config is not None:
+                voters, old = e.config, e.config_old
+        self._set_config(voters, old)
+
+    def _set_config(self, voters: Tuple[str, ...],
+                    voters_old: Optional[Tuple[str, ...]] = None) -> None:
         self.voters = set(voters)
+        self.voters_old = set(voters_old) if voters_old is not None else None
         if self.role == Role.LEADER:
-            for p in self.voters:
+            for p in self._all_voters():
                 self._next_index.setdefault(p, self.last_index + 1)
                 self._match_index.setdefault(p, 0)
-            if self.id not in self.voters:
-                # removed leader steps down after the change commits
-                pass
+
+    def _append_final_config(self) -> None:
+        """Phase 2 of joint consensus: leave the joint config."""
+        entry = LogEntry(term=self.term, index=self.last_index + 1, data=b"",
+                         config=tuple(sorted(self.voters)))
+        self.log.append(entry)
+        self._persist_append([entry])
+        self._set_config(entry.config, None)
+        if self._config_final_fut is not None:
+            self._propose_waiters[entry.index] = self._config_final_fut
+            self._config_final_fut = None
+        self._match_index[self.id] = self.last_index
+        self._broadcast_append()
+        self._maybe_commit()  # a sole surviving voter commits immediately
 
     def _fail_waiters(self) -> None:
         for fut in self._propose_waiters.values():
             if not fut.done():
                 fut.set_exception(NotLeaderError(self.leader_id))
         self._propose_waiters.clear()
+        if self._config_final_fut is not None:
+            if not self._config_final_fut.done():
+                self._config_final_fut.set_exception(
+                    NotLeaderError(self.leader_id))
+            self._config_final_fut = None
         for fut, _, _ in self._read_waiters.values():
             if not fut.done():
                 fut.set_exception(NotLeaderError(self.leader_id))

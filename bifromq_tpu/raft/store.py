@@ -1,0 +1,205 @@
+"""Durable raft state (≈ base-kv-raft IRaftStateStore + WAL engine).
+
+Persists the three things raft safety depends on across restarts
+(RaftNode.java:52 contract via IRaftStateStore; the reference backs it with
+a WALable RocksDB engine, KVRangeWALStorageEngine.java):
+
+- hard state: (current term, voted_for) — lost state here lets a node vote
+  twice in one term, electing two leaders;
+- the log suffix since the last snapshot;
+- the snapshot (FSM state + last included index/term + voter sets).
+
+``KVRaftStateStore`` lays this out in an IKVSpace, so the durable native
+engine (WAL + checkpoint, native/kvengine.cpp) provides crash safety;
+``InMemoryStateStore`` is the test double — shipped in main source the way
+the reference ships raft/InMemoryStateStore.java for reuse by other modules.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Sequence, Tuple
+
+from ..kv.engine import IKVSpace
+
+
+def _frame(b: bytes) -> bytes:
+    return struct.pack(">I", len(b)) + b
+
+
+def _read_frame(buf: bytes, pos: int) -> Tuple[bytes, int]:
+    n = struct.unpack_from(">I", buf, pos)[0]
+    pos += 4
+    return buf[pos:pos + n], pos + n
+
+
+def _enc_strs(strs: Optional[Sequence[str]]) -> bytes:
+    if strs is None:
+        return struct.pack(">i", -1)
+    out = bytearray(struct.pack(">i", len(strs)))
+    for s in strs:
+        out += _frame(s.encode())
+    return bytes(out)
+
+
+def _dec_strs(buf: bytes, pos: int) -> Tuple[Optional[Tuple[str, ...]], int]:
+    n = struct.unpack_from(">i", buf, pos)[0]
+    pos += 4
+    if n < 0:
+        return None, pos
+    out = []
+    for _ in range(n):
+        s, pos = _read_frame(buf, pos)
+        out.append(s.decode())
+    return tuple(out), pos
+
+
+def encode_entry(entry) -> bytes:
+    out = bytearray(struct.pack(">QQ", entry.term, entry.index))
+    out += _frame(entry.data)
+    out += _enc_strs(entry.config)
+    out += _enc_strs(getattr(entry, "config_old", None))
+    return bytes(out)
+
+
+def decode_entry(buf: bytes):
+    from .node import LogEntry
+    term, index = struct.unpack_from(">QQ", buf, 0)
+    data, pos = _read_frame(buf, 16)
+    config, pos = _dec_strs(buf, pos)
+    config_old, pos = _dec_strs(buf, pos)
+    return LogEntry(term=term, index=index, data=data, config=config,
+                    config_old=config_old)
+
+
+def encode_snapshot(snap) -> bytes:
+    out = bytearray(struct.pack(">QQ", snap.last_index, snap.last_term))
+    out += _frame(snap.data)
+    out += _enc_strs(snap.voters)
+    out += _enc_strs(getattr(snap, "voters_old", None))
+    return bytes(out)
+
+
+def decode_snapshot(buf: bytes):
+    from .node import Snapshot
+    last_index, last_term = struct.unpack_from(">QQ", buf, 0)
+    data, pos = _read_frame(buf, 16)
+    voters, pos = _dec_strs(buf, pos)
+    voters_old, pos = _dec_strs(buf, pos)
+    return Snapshot(last_index=last_index, last_term=last_term, data=data,
+                    voters=voters or (), voters_old=voters_old)
+
+
+class IRaftStateStore:
+    """Persistence SPI; every mutator must be durable before returning."""
+
+    def save_hard_state(self, term: int, voted_for: Optional[str]) -> None:
+        raise NotImplementedError
+
+    def load_hard_state(self) -> Tuple[int, Optional[str]]:
+        raise NotImplementedError
+
+    def append(self, entries: Sequence) -> None:
+        """Append entries; any existing entries at >= entries[0].index are
+        logically truncated first (conflict overwrite)."""
+        raise NotImplementedError
+
+    def truncate_prefix(self, up_to_index: int) -> None:
+        """Discard entries with index <= up_to_index (post-compaction)."""
+        raise NotImplementedError
+
+    def save_snapshot(self, snap) -> None:
+        raise NotImplementedError
+
+    def load_snapshot(self):
+        raise NotImplementedError
+
+    def load_entries(self) -> List:
+        raise NotImplementedError
+
+
+class InMemoryStateStore(IRaftStateStore):
+    def __init__(self) -> None:
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self.entries: List = []
+        self.snap = None
+
+    def save_hard_state(self, term, voted_for):
+        self.term, self.voted_for = term, voted_for
+
+    def load_hard_state(self):
+        return self.term, self.voted_for
+
+    def append(self, entries):
+        if entries:
+            first = entries[0].index
+            self.entries = [e for e in self.entries if e.index < first]
+            self.entries.extend(entries)
+
+    def truncate_prefix(self, up_to_index):
+        self.entries = [e for e in self.entries if e.index > up_to_index]
+
+    def save_snapshot(self, snap):
+        self.snap = snap
+
+    def load_snapshot(self):
+        return self.snap
+
+    def load_entries(self):
+        return list(self.entries)
+
+
+_KEY_HARD = b"hs"
+_KEY_SNAP = b"sn"
+_PFX_ENTRY = b"e:"
+
+
+def _entry_key(index: int) -> bytes:
+    return _PFX_ENTRY + struct.pack(">Q", index)
+
+
+class KVRaftStateStore(IRaftStateStore):
+    """Raft state in an IKVSpace (durable when the space is engine-backed)."""
+
+    def __init__(self, space: IKVSpace) -> None:
+        self.space = space
+
+    def save_hard_state(self, term, voted_for):
+        v = struct.pack(">Q", term) + (
+            voted_for.encode() if voted_for else b"")
+        self.space.writer().put(_KEY_HARD, v).done()
+
+    def load_hard_state(self):
+        v = self.space.get(_KEY_HARD)
+        if v is None:
+            return 0, None
+        term = struct.unpack_from(">Q", v, 0)[0]
+        vf = v[8:].decode() or None
+        return term, vf
+
+    def append(self, entries):
+        if not entries:
+            return
+        w = self.space.writer()
+        # conflict truncate: drop any stale suffix at/after the first index
+        w.delete_range(_entry_key(entries[0].index),
+                       _PFX_ENTRY + b"\xff" * 9)
+        for e in entries:
+            w.put(_entry_key(e.index), encode_entry(e))
+        w.done()
+
+    def truncate_prefix(self, up_to_index):
+        self.space.writer().delete_range(
+            _entry_key(0), _entry_key(up_to_index + 1)).done()
+
+    def save_snapshot(self, snap):
+        self.space.writer().put(_KEY_SNAP, encode_snapshot(snap)).done()
+
+    def load_snapshot(self):
+        v = self.space.get(_KEY_SNAP)
+        return decode_snapshot(v) if v is not None else None
+
+    def load_entries(self):
+        return [decode_entry(v) for _, v in self.space.iterate(
+            _PFX_ENTRY, _PFX_ENTRY + b"\xff" * 9)]
