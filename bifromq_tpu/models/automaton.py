@@ -378,14 +378,28 @@ class TokenizedTopics:
 
 def tokenize(topics: Sequence[Sequence[str]], roots: Sequence[int],
              *, max_levels: int, salt: int,
-             batch: Optional[int] = None) -> TokenizedTopics:
+             batch: Optional[int] = None,
+             native: bool = True) -> TokenizedTopics:
     """Hash topic levels into a padded probe batch.
 
-    ``topics`` are pre-parsed level lists (utils.topic.parse); ``roots`` the
-    per-topic tenant root ids (CompiledTrie.root_of). Topics longer than
-    ``max_levels`` cannot match any stored filter of ≤ max_levels exactly;
-    they are marked as padding here and must take the host fallback.
+    ``topics`` are pre-parsed level lists (utils.topic.parse) or raw topic
+    strings; ``roots`` the per-topic tenant root ids (CompiledTrie.root_of).
+    Topics longer than ``max_levels`` cannot match any stored filter of
+    ≤ max_levels exactly; they are marked as padding here and must take the
+    host fallback.
+
+    Uses the native (C++) tokenizer when available — the Python loop below
+    is the semantics reference and fallback.
     """
+    if native:
+        try:
+            from .native_tok import tokenize_topics_native
+            h1, h2, _, lengths, rootv, sysm = tokenize_topics_native(
+                topics, roots, max_levels=max_levels, salt=salt, batch=batch)
+            return TokenizedTopics(tok_h1=h1, tok_h2=h2, lengths=lengths,
+                                   roots=rootv, sys_mask=sysm)
+        except Exception:  # noqa: BLE001 — e.g. no compiler in env
+            pass
     n = len(topics)
     b = batch or n
     assert b >= n
@@ -396,6 +410,8 @@ def tokenize(topics: Sequence[Sequence[str]], roots: Sequence[int],
     rootv = np.full(b, _EMPTY, dtype=np.int32)
     sys_mask = np.zeros(b, dtype=bool)
     for i, (levels, root) in enumerate(zip(topics, roots)):
+        if isinstance(levels, str):  # raw topic string (native-path parity)
+            levels = levels.split(topic_util.DELIMITER)
         if len(levels) > max_levels:
             continue  # leave as padding; caller falls back to oracle
         lengths[i] = len(levels)

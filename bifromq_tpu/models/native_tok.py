@@ -1,0 +1,103 @@
+"""ctypes binding for the native topic tokenizer (native/tokenizer.cpp).
+
+Hashes PUBLISH-topic levels into probe arrays ~20-40x faster than the Python
+loop — the host-side ceiling flagged in round-1 perf notes. Bit-exact with
+``automaton.level_hash`` (same BLAKE2b-8 + salt), enforced by parity tests.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native", "tokenizer.cpp")
+_SO = os.path.join(os.path.dirname(_SRC), "libtokenizer.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def load_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is False:  # cached failure: don't re-spawn g++ per call
+            raise RuntimeError("native tokenizer unavailable")
+        if _lib is not None:
+            return _lib
+        try:
+            if not (os.path.exists(_SO)
+                    and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+                subprocess.run(
+                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC,
+                     "-o", _SO],
+                    check=True, capture_output=True)
+        except Exception:
+            _lib = False
+            raise
+        lib = ctypes.CDLL(_SO)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.tok_topics.argtypes = [
+            u8p, i32p, ctypes.c_int, i32p, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_int, i32p, i32p, i32p, i32p, i32p, u8p, ctypes.c_int]
+        _lib = lib
+        return lib
+
+
+def _pack(topics: Sequence) -> tuple:
+    """Join level lists (or accept raw strings) into (bytes, offsets)."""
+    enc: List[bytes] = []
+    for t in topics:
+        if isinstance(t, str):
+            enc.append(t.encode("utf-8"))
+        else:
+            enc.append("/".join(t).encode("utf-8"))
+    offsets = np.zeros(len(enc) + 1, dtype=np.int32)
+    np.cumsum([len(b) for b in enc], out=offsets[1:])
+    return b"".join(enc), offsets
+
+
+def tokenize_topics_native(topics: Sequence, roots: Sequence[int], *,
+                           max_levels: int, salt: int,
+                           batch: Optional[int] = None,
+                           filter_mode: bool = False):
+    """Native-equivalent of automaton.tokenize / tokenize_filters.
+
+    Returns (tok_h1, tok_h2, tok_kind, lengths, roots, sys_mask) numpy
+    arrays; tok_kind is None unless ``filter_mode``.
+    """
+    lib = load_lib()
+    n = len(topics)
+    b = batch or n
+    assert b >= n
+    width = max_levels + 1
+    data, offsets = _pack(topics)
+    data_arr = np.frombuffer(data, dtype=np.uint8) if data else \
+        np.zeros(1, dtype=np.uint8)
+    roots_arr = np.asarray(list(roots), dtype=np.int32)
+    tok_h1 = np.zeros((b, width), dtype=np.int32)
+    tok_h2 = np.zeros((b, width), dtype=np.int32)
+    tok_kind = np.zeros((b, width), dtype=np.int32) if filter_mode else None
+    lengths = np.full(b, -1, dtype=np.int32)
+    root_out = np.full(b, -1, dtype=np.int32)
+    sys_mask = np.zeros(b, dtype=np.uint8)
+
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+
+    def p32(a):
+        return a.ctypes.data_as(i32p)
+
+    lib.tok_topics(
+        data_arr.ctypes.data_as(u8p), p32(offsets), n, p32(roots_arr),
+        max_levels, ctypes.c_uint64(salt & 0xFFFFFFFFFFFFFFFF),
+        int(filter_mode), p32(tok_h1), p32(tok_h2),
+        p32(tok_kind) if tok_kind is not None else i32p(),
+        p32(lengths), p32(root_out), sys_mask.ctypes.data_as(u8p), width)
+    return tok_h1, tok_h2, tok_kind, lengths, root_out, sys_mask.astype(bool)

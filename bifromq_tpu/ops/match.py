@@ -155,7 +155,6 @@ def walk(trie: DeviceTrie, probes: Probes, *, probe_len: int,
     b, width = probes.tok_h1.shape
     max_levels = width - 1
     k = k_states
-    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
 
     act0 = jnp.full((b, k), -1, dtype=jnp.int32)
     act0 = act0.at[:, 0].set(jnp.where(probes.lengths >= 0, probes.roots, -1))
@@ -191,13 +190,12 @@ def walk(trie: DeviceTrie, probes: Probes, *, probe_len: int,
         plus = jnp.where(stepping & valid & allow_wc,
                          node_rec[..., NODE_PLUS], -1)
         cand = jnp.concatenate([exact, plus], axis=1)       # [B,2K]
-        cvalid = cand >= 0
-        pos = jnp.cumsum(cvalid, axis=1) - 1                # [B,2K]
-        total = pos[:, -1] + 1
-        overflow = overflow | (total > k)
-        pos = jnp.where(cvalid & (pos < k), pos, 2 * k)     # 2K => dropped
-        new_act = jnp.full((b, k), -1, dtype=jnp.int32)
-        new_act = new_act.at[rows, pos].set(cand, mode="drop")
+        overflow = overflow | ((cand >= 0).sum(axis=1) > k)
+        # successor compaction by per-row SORT, not scatter: a bitonic sort
+        # of 2K lanes vectorizes on TPU where the scatter serializes (the
+        # active set is a set — order is immaterial); descending puts the
+        # valid nodes first
+        new_act = -jnp.sort(-cand, axis=1)[:, :k]
         return new_act, hash_acc, final_acc, overflow
 
     # dynamic trip count: stop at the longest topic actually in the batch
@@ -228,3 +226,52 @@ def walk_and_count(trie: DeviceTrie, probes: Probes, *, probe_len: int,
     """Fused walk + per-topic fan-out count (bench entry point)."""
     res = walk(trie, probes, probe_len=probe_len, k_states=k_states)
     return res, count_routes(trie, res)
+
+
+@functools.partial(jax.jit, static_argnames=("probe_len", "k_states"))
+def walk_count_only(trie: DeviceTrie, probes: Probes, *, probe_len: int,
+                    k_states: int = 32) -> Tuple[jax.Array, jax.Array]:
+    """Walk that accumulates per-topic matched-slot counts in the loop body
+    and never materializes the accept tensors — the cheapest full-match
+    measurement (and the shape a pure fan-out-counting service would use).
+    Returns ([B] counts, [B] overflow)."""
+    from ..models.automaton import NODE_RCOUNT
+
+    b, width = probes.tok_h1.shape
+    k = k_states
+
+    act0 = jnp.full((b, k), -1, dtype=jnp.int32)
+    act0 = act0.at[:, 0].set(jnp.where(probes.lengths >= 0, probes.roots, -1))
+    cnt0 = jnp.zeros((b,), dtype=jnp.int32)
+    overflow0 = jnp.zeros((b,), dtype=bool)
+
+    def body(i, carry):
+        act, cnt, overflow = carry
+        in_range = (i <= probes.lengths)[:, None]
+        valid = (act >= 0) & in_range
+        allow_wc = jnp.logical_not(probes.sys_mask & (i == 0))[:, None]
+        node_rec = trie.node_tab[act.clip(0)]
+        hc = jnp.where(valid & allow_wc, node_rec[..., NODE_HASH], -1)
+        hc_cnt = jnp.where(hc >= 0, trie.node_tab[hc.clip(0), NODE_RCOUNT], 0)
+        cnt = cnt + hc_cnt.sum(axis=1, dtype=jnp.int32)
+        is_final = (i == probes.lengths)[:, None]
+        fin_cnt = jnp.where(is_final & valid, node_rec[..., NODE_RCOUNT], 0)
+        cnt = cnt + fin_cnt.sum(axis=1, dtype=jnp.int32)
+        stepping = (i < probes.lengths)[:, None]
+        h1 = jnp.broadcast_to(
+            jax.lax.dynamic_index_in_dim(probes.tok_h1, i, axis=1), (b, k))
+        h2 = jnp.broadcast_to(
+            jax.lax.dynamic_index_in_dim(probes.tok_h2, i, axis=1), (b, k))
+        exact = _edge_lookup(trie.edge_tab, probe_len, act.clip(0), h1, h2)
+        exact = jnp.where(stepping & valid, exact, -1)
+        plus = jnp.where(stepping & valid & allow_wc,
+                         node_rec[..., NODE_PLUS], -1)
+        cand = jnp.concatenate([exact, plus], axis=1)
+        overflow = overflow | ((cand >= 0).sum(axis=1) > k)
+        new_act = -jnp.sort(-cand, axis=1)[:, :k]
+        return new_act, cnt, overflow
+
+    upper = jnp.clip(jnp.max(probes.lengths, initial=-1) + 1, 0, width)
+    _, cnt, overflow = jax.lax.fori_loop(0, upper, body,
+                                         (act0, cnt0, overflow0))
+    return cnt, overflow

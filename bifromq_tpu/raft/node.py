@@ -186,6 +186,9 @@ class RaftNode:
         self._election_deadline = self._rand_election()
         self._propose_waiters: Dict[int, asyncio.Future] = {}
         self._config_final_fut: Optional[asyncio.Future] = None
+        # index of the in-flight joint (C_old,new) entry; phase 2 must not
+        # start until commit_index covers it
+        self._joint_index: Optional[int] = None
         self._read_waiters: Dict[int, Tuple[asyncio.Future, Set[str], int]] = {}
         self._read_ctx_seq = 0
         self._term_start_index = 0  # index of this term's no-op (leader)
@@ -347,6 +350,8 @@ class RaftNode:
         self._persist_append([entry])
         # a config entry takes effect as soon as it is appended
         self._set_config(entry.config, entry.config_old)
+        if entry.config_old is not None:
+            self._joint_index = entry.index
         self._match_index[self.id] = self.last_index
         self._broadcast_append()
         self._maybe_commit()
@@ -632,11 +637,11 @@ class RaftNode:
                     self._become_follower(self.term, None)
         if (self.role == Role.LEADER
                 and self.commit_index >= self._term_start_index):
-            if self.voters_old is not None:
-                # the joint entry is committed under BOTH quorums (it
-                # precedes this term's committed no-op): safe to leave
-                # the joint config now — exactly once, since this flips
-                # voters_old to None
+            if (self.voters_old is not None
+                    and self.commit_index >= (self._joint_index or 0)):
+                # the joint entry itself is committed under BOTH quorums:
+                # safe to leave the joint config now — exactly once, since
+                # this flips voters_old to None
                 self._append_final_config()
             self._flush_confirmed_reads()
         self._maybe_compact()
@@ -709,6 +714,10 @@ class RaftNode:
         self.voters = set(msg.snapshot.voters)
         self.voters_old = (set(msg.snapshot.voters_old)
                            if msg.snapshot.voters_old is not None else None)
+        # a snapshot only covers applied entries, so any joint config in it
+        # is already committed
+        self._joint_index = (msg.snapshot.last_index
+                             if self.voters_old is not None else None)
         if self.store is not None:
             self.store.save_snapshot(msg.snapshot)
             self.store.truncate_prefix(1 << 60)
@@ -731,10 +740,13 @@ class RaftNode:
         config entry wins) — used after load and after conflict truncation."""
         voters: Tuple[str, ...] = tuple(self.snap.voters)
         old = self.snap.voters_old
+        ji = self.snap.last_index if old is not None else None
         for e in self.log:
             if e.config is not None:
                 voters, old = e.config, e.config_old
+                ji = e.index if e.config_old is not None else None
         self._set_config(voters, old)
+        self._joint_index = ji
 
     def _set_config(self, voters: Tuple[str, ...],
                     voters_old: Optional[Tuple[str, ...]] = None) -> None:
@@ -752,6 +764,7 @@ class RaftNode:
         self.log.append(entry)
         self._persist_append([entry])
         self._set_config(entry.config, None)
+        self._joint_index = None
         if self._config_final_fut is not None:
             self._propose_waiters[entry.index] = self._config_final_fut
             self._config_final_fut = None

@@ -22,9 +22,14 @@ from .utils import topic as topic_util
 
 
 def _zipf_levels(n_levels: int) -> Tuple[List[str], List[float]]:
+    """Returns (names, CUMULATIVE weights) — cumulative so random.choices
+    skips its per-call accumulate pass (it dominates 10M-scale generation)."""
     names = [f"l{i}" for i in range(n_levels)]
-    weights = [1.0 / (i + 1) for i in range(n_levels)]
-    return names, weights
+    acc, cum = 0.0, []
+    for i in range(n_levels):
+        acc += 1.0 / (i + 1)
+        cum.append(acc)
+    return names, cum
 
 
 def _mk_matcher(levels: Sequence[str], share_group: str = "",
@@ -46,7 +51,7 @@ def gen_filter_levels(rng: random.Random, names: List[str],
                       weights: List[float], *, max_depth: int = 6,
                       p_plus: float = 0.15, p_hash: float = 0.1) -> List[str]:
     depth = rng.randint(1, max_depth)
-    levels = rng.choices(names, weights=weights, k=depth)
+    levels = rng.choices(names, cum_weights=weights, k=depth)
     for j in range(depth):
         if rng.random() < p_plus:
             levels[j] = topic_util.SINGLE_WILDCARD
@@ -58,7 +63,7 @@ def gen_filter_levels(rng: random.Random, names: List[str],
 def gen_topic_levels(rng: random.Random, names: List[str],
                      weights: List[float], *, max_depth: int = 6) -> List[str]:
     depth = rng.randint(1, max_depth)
-    return rng.choices(names, weights=weights, k=depth)
+    return rng.choices(names, cum_weights=weights, k=depth)
 
 
 def config_exact(n_subs: int = 10_000, *, seed: int = 0,
@@ -129,6 +134,40 @@ def config_multi_tenant(n_tenants: int = 10_000, total_subs: int = 10_000_000,
                            receiver_id=f"t{t}r{i}", deliverer_key=f"d{i % 64}"))
         out[f"tenant{t}"] = trie
     return out
+
+
+def config_retained(n_topics: int = 5_000_000, *, seed: int = 0,
+                    n_level_names: int = 1000, max_depth: int = 6
+                    ) -> Dict[str, List[List[str]]]:
+    """Config 4: retained-message store — concrete topics per tenant.
+
+    The retained path stores *topics* (not filters) and probes with wildcard
+    FILTERS (roles-swapped walk, models/retained.py); returns unique topic
+    level-lists for one tenant.
+    """
+    rng = random.Random(seed)
+    names, weights = _zipf_levels(n_level_names)
+    seen = set()
+    topics: List[List[str]] = []
+    for i in range(n_topics):
+        levels = gen_topic_levels(rng, names, weights, max_depth=max_depth)
+        if tuple(levels) in seen:
+            # disambiguate with a device-id tail (realistic retained-topic
+            # shape: per-device leaves under shared prefixes); may exceed
+            # max_depth by one level
+            levels = levels + [f"d{i}"]
+        seen.add(tuple(levels))
+        topics.append(levels)
+    return {"tenant0": topics}
+
+
+def probe_filters(n: int, *, seed: int = 2, n_level_names: int = 1000,
+                  max_depth: int = 6) -> List[List[str]]:
+    """Wildcard SUBSCRIBE filters probing the retained store (config 4)."""
+    rng = random.Random(seed)
+    names, weights = _zipf_levels(n_level_names)
+    return [gen_filter_levels(rng, names, weights, max_depth=max_depth)
+            for _ in range(n)]
 
 
 def probe_topics(n: int, *, seed: int = 1, n_level_names: int = 1000,

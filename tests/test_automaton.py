@@ -252,3 +252,41 @@ class TestEmptyBatch:
         matcher = TpuMatcher()
         matcher.add_route("t", mk_route("a/b"))
         assert matcher.match_batch([]) == []
+
+
+class TestWalkCountOnly:
+    @pytest.mark.parametrize("seed", [0, 3, 9])
+    def test_count_parity_vs_oracle(self, seed):
+        import random
+        from bifromq_tpu.models.automaton import compile_tries, tokenize
+        from bifromq_tpu.models.oracle import SubscriptionTrie
+        from bifromq_tpu.ops.match import (DeviceTrie, Probes,
+                                           walk_count_only)
+        from bifromq_tpu import workloads
+
+        rng = random.Random(seed)
+        names, weights = workloads._zipf_levels(30)
+        trie = SubscriptionTrie()
+        from tests.test_automaton import mk_route
+        for i in range(300):
+            levels = workloads.gen_filter_levels(rng, names, weights,
+                                                 max_depth=4)
+            trie.add(mk_route("/".join(levels), receiver=f"r{i}"))
+        tries = {"T": trie}
+        ct = compile_tries(tries, max_levels=8)
+        dev = DeviceTrie.from_compiled(ct)
+        topics = [workloads.gen_topic_levels(rng, names, weights, max_depth=4)
+                  for _ in range(64)]
+        tok = tokenize(topics, [ct.root_of("T")] * 64,
+                       max_levels=8, salt=ct.salt)
+        cnt, overflow = walk_count_only(dev, Probes.from_tokenized(tok),
+                                        probe_len=ct.probe_len, k_states=16)
+        import numpy as np
+        cnt, overflow = np.asarray(cnt), np.asarray(overflow)
+        for qi, levels in enumerate(topics):
+            if overflow[qi]:
+                continue
+            want = trie.match(levels)
+            # matched-slot count = normal routes + distinct group matchings
+            assert cnt[qi] == len(want.normal) + len(want.groups), (
+                qi, levels)

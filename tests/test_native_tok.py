@@ -1,0 +1,81 @@
+"""Native (C++) tokenizer parity: bit-exact with the Python reference
+(automaton.level_hash BLAKE2b-8 + salt) across unicode, empty levels,
+$SYS topics, deep topics, >128-byte levels, and filter wildcards."""
+
+import random
+
+import numpy as np
+import pytest
+
+from bifromq_tpu.models.automaton import tokenize, tokenize_filters
+from bifromq_tpu.models.native_tok import load_lib, tokenize_topics_native
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _require_native():
+    try:
+        load_lib()
+    except Exception:
+        pytest.skip("native tokenizer unavailable (no compiler)")
+
+
+CORPUS = [
+    ["a", "b", "c"], [""], ["", ""], ["$SYS", "health"],
+    ["héllo", "wörld", "日本語"], ["x" * 200, "y" * 500],  # multi-block
+    ["a"] * 17,  # too deep -> padding row
+    ["lvl%d" % i for i in range(16)], ["single"],
+    ["", "leading"], ["trailing", ""],
+]
+
+
+class TestParity:
+    @pytest.mark.parametrize("salt", [0, 1, 3, 987654321])
+    def test_topic_parity(self, salt):
+        rng = random.Random(salt)
+        topics = list(CORPUS)
+        for _ in range(300):
+            topics.append(["n%d" % rng.randrange(64)
+                           for _ in range(rng.randrange(1, 9))])
+        roots = list(range(len(topics)))
+        py = tokenize(topics, roots, max_levels=16, salt=salt, native=False)
+        nat = tokenize(topics, roots, max_levels=16, salt=salt, native=True)
+        np.testing.assert_array_equal(py.tok_h1, nat.tok_h1)
+        np.testing.assert_array_equal(py.tok_h2, nat.tok_h2)
+        np.testing.assert_array_equal(py.lengths, nat.lengths)
+        np.testing.assert_array_equal(py.roots, nat.roots)
+        np.testing.assert_array_equal(py.sys_mask, nat.sys_mask)
+
+    def test_filter_parity(self):
+        filters = [["a", "+", "c"], ["#"], ["+"], ["a", "b"],
+                   ["$share", "g", "t", "+"], ["+", "#"]]
+        roots = list(range(len(filters)))
+        py = tokenize_filters(filters, roots, max_levels=8, salt=7)
+        h1, h2, kind, lengths, rootv, _ = tokenize_topics_native(
+            filters, roots, max_levels=8, salt=7, filter_mode=True)
+        np.testing.assert_array_equal(py.tok_h1, h1)
+        np.testing.assert_array_equal(py.tok_h2, h2)
+        np.testing.assert_array_equal(py.tok_kind, kind)
+        np.testing.assert_array_equal(py.lengths, lengths)
+
+    def test_string_inputs_match_level_lists(self):
+        topics = [["a", "b"], ["c"], ["", "x"]]
+        strs = ["a/b", "c", "/x"]
+        roots = [0, 1, 2]
+        a = tokenize(topics, roots, max_levels=8, salt=0, native=True)
+        b = tokenize(strs, roots, max_levels=8, salt=0, native=True)
+        np.testing.assert_array_equal(a.tok_h1, b.tok_h1)
+        np.testing.assert_array_equal(a.lengths, b.lengths)
+        # Python fallback accepts strings too
+        c = tokenize(strs, roots, max_levels=8, salt=0, native=False)
+        np.testing.assert_array_equal(a.tok_h1, c.tok_h1)
+        np.testing.assert_array_equal(a.lengths, c.lengths)
+
+    def test_padding_rows_batch(self):
+        topics = [["a"]]
+        py = tokenize(topics, [5], max_levels=4, salt=0, batch=8,
+                      native=False)
+        nat = tokenize(topics, [5], max_levels=4, salt=0, batch=8,
+                       native=True)
+        np.testing.assert_array_equal(py.lengths, nat.lengths)
+        np.testing.assert_array_equal(py.roots, nat.roots)
+        np.testing.assert_array_equal(py.tok_h1, nat.tok_h1)
