@@ -237,16 +237,24 @@ def bench_config4():
 
 
 def bench_config5():
+    import random
+
     from bifromq_tpu import workloads
     tries = workloads.config_multi_tenant(MT_TENANTS, MT_SUBS, seed=SEED)
     topics = workloads.probe_topics(BATCH * 4, seed=SEED + 1)
     tenants = sorted(tries)
+    # Zipf tenant traffic: heavier tenants see proportionally more queries
+    rng = random.Random(SEED + 3)
+    cum = []
+    acc = 0.0
+    for i in range(len(tenants)):
+        acc += 1.0 / (i + 1)
+        cum.append(acc)
+    tenant_seq = rng.choices(tenants, cum_weights=cum, k=BATCH * 4)
 
     def probe(i, batch):
         ts = topics[i * batch:(i + 1) * batch]
-        # Zipf tenant traffic: heavier tenants see more queries
-        return [(t, tenants[(j * j + i) % len(tenants)])
-                for j, t in enumerate(ts)]
+        return [(t, tenant_seq[i * batch + j]) for j, t in enumerate(ts)]
     return _measure_match(
         tries, probe, name=f"c5_multitenant_{MT_TENANTS}x{MT_SUBS}")
 

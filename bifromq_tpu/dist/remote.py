@@ -1,0 +1,231 @@
+"""Remote dist-worker: the dist plane split across OS processes.
+
+Server side (``DistWorkerRPCService``) exposes a ``DistWorker`` over the
+RPC fabric; client side (``RemoteDistWorker``) implements the same
+dist-plane API ``DistService`` consumes, so an mqtt-frontend process can
+serve routes from a dist-worker process — the reference's
+dist-server → dist-worker RPC hop (BatchDistServerCall → KVRange query,
+SURVEY.md §3.3 process boundaries).
+
+Route mutations ride an ``order_key`` = tenant id pipeline so a tenant's
+add/remove stream applies in order (≈ orderKey-pinned match/unmatch calls,
+BatchMatchCall routing by route key).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import List, Optional, Sequence, Tuple
+
+from ..models.oracle import MatchedRoutes, Route
+from ..rpc.fabric import (RPCClient, RPCServer, ServiceRegistry, _len16,
+                          _read16)
+from ..types import RouteMatcher
+from . import worker as dw
+
+SERVICE = "dist-worker"
+
+
+def _enc_route(r: Route) -> bytes:
+    return (_len16(r.matcher.mqtt_topic_filter.encode())
+            + struct.pack(">I", r.broker_id)
+            + _len16(r.receiver_id.encode())
+            + _len16(r.deliverer_key.encode())
+            + struct.pack(">q", r.incarnation))
+
+
+def _dec_route(buf: bytes, pos: int) -> Tuple[Route, int]:
+    tf, pos = _read16(buf, pos)
+    broker = struct.unpack_from(">I", buf, pos)[0]
+    pos += 4
+    recv, pos = _read16(buf, pos)
+    dk, pos = _read16(buf, pos)
+    inc = struct.unpack_from(">q", buf, pos)[0]
+    pos += 8
+    return Route(matcher=RouteMatcher.from_topic_filter(tf.decode()),
+                 broker_id=broker, receiver_id=recv.decode(),
+                 deliverer_key=dk.decode(), incarnation=inc), pos
+
+
+def encode_matched(m: MatchedRoutes) -> bytes:
+    flags = ((1 if m.max_persistent_fanout_exceeded else 0)
+             | (2 if m.max_group_fanout_exceeded else 0))
+    out = bytearray([flags])
+    out += struct.pack(">I", len(m.normal))
+    for r in m.normal:
+        out += _enc_route(r)
+    out += struct.pack(">H", len(m.groups))
+    for tf, members in m.groups.items():
+        out += _len16(tf.encode())
+        out += struct.pack(">I", len(members))
+        for r in members:
+            out += _enc_route(r)
+    return bytes(out)
+
+
+def decode_matched(buf: bytes, pos: int = 0) -> Tuple[MatchedRoutes, int]:
+    m = MatchedRoutes()
+    flags = buf[pos]
+    pos += 1
+    m.max_persistent_fanout_exceeded = bool(flags & 1)
+    m.max_group_fanout_exceeded = bool(flags & 2)
+    n = struct.unpack_from(">I", buf, pos)[0]
+    pos += 4
+    for _ in range(n):
+        r, pos = _dec_route(buf, pos)
+        m.normal.append(r)
+    ng = struct.unpack_from(">H", buf, pos)[0]
+    pos += 2
+    for _ in range(ng):
+        tf, pos = _read16(buf, pos)
+        nm = struct.unpack_from(">I", buf, pos)[0]
+        pos += 4
+        members = []
+        for _ in range(nm):
+            r, pos = _dec_route(buf, pos)
+            members.append(r)
+        m.groups[tf.decode()] = members
+    return m, pos
+
+
+class DistWorkerRPCService:
+    """Server-side adapter: DistWorker methods behind the RPC fabric."""
+
+    def __init__(self, worker: dw.DistWorker) -> None:
+        self.worker = worker
+
+    def register(self, server: RPCServer) -> None:
+        server.register(SERVICE, {
+            "add_route": self._add_route,
+            "remove_route": self._remove_route,
+            "match_batch": self._match_batch,
+            "purge_broker": self._purge_broker,
+        })
+
+    async def _add_route(self, payload: bytes, okey: str) -> bytes:
+        tenant_b, pos = _read16(payload, 0)
+        route, pos = _dec_route(payload, pos)
+        return (await self.worker.add_route(tenant_b.decode(),
+                                            route)).encode()
+
+    async def _remove_route(self, payload: bytes, okey: str) -> bytes:
+        tenant_b, pos = _read16(payload, 0)
+        route, pos = _dec_route(payload, pos)
+        return (await self.worker.remove_route(
+            tenant_b.decode(), route.matcher, route.receiver_url,
+            route.incarnation)).encode()
+
+    async def _match_batch(self, payload: bytes, okey: str) -> bytes:
+        mpf, mgf, lin, n = struct.unpack_from(">IIBI", payload, 0)
+        pos = 13
+        queries = []
+        for _ in range(n):
+            tenant_b, pos = _read16(payload, pos)
+            topic_b, pos = _read16(payload, pos)
+            queries.append((tenant_b.decode(),
+                            topic_b.decode().split("/")))
+        results = await self.worker.match_batch(
+            queries, max_persistent_fanout=mpf, max_group_fanout=mgf,
+            linearized=bool(lin))
+        out = bytearray(struct.pack(">I", len(results)))
+        for m in results:
+            out += encode_matched(m)
+        return bytes(out)
+
+    async def _purge_broker(self, payload: bytes, okey: str) -> bytes:
+        (broker_id,) = struct.unpack_from(">I", payload, 0)
+        n = await self.worker.purge_broker_routes(broker_id)
+        return struct.pack(">I", n)
+
+
+class RemoteDistWorker:
+    """Client-side dist plane: same API surface DistService consumes from a
+    local DistWorker, but served by a dist-worker process over RPC."""
+
+    def __init__(self, registry: ServiceRegistry, *,
+                 service: str = SERVICE) -> None:
+        self.registry = registry
+        self.service = service
+
+    # DistService lifecycle hooks
+    async def start(self) -> None:
+        pass
+
+    async def stop(self) -> None:
+        await self.registry.close()
+
+    @property
+    def matcher(self):
+        raise RuntimeError("remote dist worker has no local matcher; "
+                           "introspect on the worker process")
+
+    def _client(self, key: str) -> RPCClient:
+        c = self.registry.client(self.service, key)
+        if c is None:
+            raise RuntimeError(f"no endpoints for service {self.service}")
+        return c
+
+    async def add_route(self, tenant_id: str, route: Route) -> str:
+        payload = _len16(tenant_id.encode()) + _enc_route(route)
+        out = await self._client(tenant_id).call(
+            self.service, "add_route", payload, order_key=tenant_id)
+        return out.decode()
+
+    async def remove_route(self, tenant_id: str, matcher: RouteMatcher,
+                           receiver_url: Tuple[int, str, str],
+                           incarnation: int = 0) -> str:
+        route = Route(matcher=matcher, broker_id=receiver_url[0],
+                      receiver_id=receiver_url[1],
+                      deliverer_key=receiver_url[2], incarnation=incarnation)
+        payload = _len16(tenant_id.encode()) + _enc_route(route)
+        out = await self._client(tenant_id).call(
+            self.service, "remove_route", payload, order_key=tenant_id)
+        return out.decode()
+
+    async def match_batch(self, queries: Sequence[Tuple[str, Sequence[str]]],
+                          *, max_persistent_fanout: int,
+                          max_group_fanout: int,
+                          linearized: bool = False) -> List[MatchedRoutes]:
+        if not queries:
+            return []
+        # shard the batch by the SAME rendezvous key mutations use (tenant),
+        # so each sub-batch lands on the worker that holds those routes;
+        # sub-calls run concurrently and results stitch back by index
+        by_ep: dict = {}
+        for qi, (tenant_id, levels) in enumerate(queries):
+            ep = self.registry.pick(self.service, tenant_id)
+            if ep is None:
+                raise RuntimeError(f"no endpoints for {self.service}")
+            by_ep.setdefault(ep, []).append(qi)
+
+        async def call_one(ep: str, idxs: List[int]) -> List[MatchedRoutes]:
+            payload = bytearray(struct.pack(
+                ">IIBI", max_persistent_fanout & 0xFFFFFFFF,
+                max_group_fanout & 0xFFFFFFFF, int(linearized), len(idxs)))
+            for qi in idxs:
+                tenant_id, levels = queries[qi]
+                payload += _len16(tenant_id.encode())
+                payload += _len16("/".join(levels).encode())
+            out = await self.registry.client_for(ep).call(
+                self.service, "match_batch", bytes(payload))
+            (n,) = struct.unpack_from(">I", out, 0)
+            pos = 4
+            results = []
+            for _ in range(n):
+                m, pos = decode_matched(out, pos)
+                results.append(m)
+            return results
+
+        parts = await asyncio.gather(
+            *(call_one(ep, idxs) for ep, idxs in by_ep.items()))
+        stitched: List[Optional[MatchedRoutes]] = [None] * len(queries)
+        for (ep, idxs), res in zip(by_ep.items(), parts):
+            for qi, m in zip(idxs, res):
+                stitched[qi] = m
+        return stitched
+
+    async def purge_broker_routes(self, broker_id: int) -> int:
+        out = await self._client(str(broker_id)).call(
+            self.service, "purge_broker", struct.pack(">I", broker_id))
+        return struct.unpack(">I", out)[0]

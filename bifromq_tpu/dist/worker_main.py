@@ -1,0 +1,67 @@
+"""Standalone dist-worker process: ``python -m bifromq_tpu.dist.worker_main``.
+
+Hosts the route-table range + TPU matcher behind the RPC fabric — the
+dist-worker role of the reference's multi-process deployment
+(DistWorker.java:48 on a BaseKVStoreServer, reached via gRPC). The
+mqtt-frontend process connects with ``dist.remote.RemoteDistWorker``.
+
+Prints ``READY <port>`` on stdout once serving.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+
+if os.environ.get("JAX_PLATFORMS"):
+    # the env var alone does not beat a sitecustomize-registered platform
+    # plugin; the config knob does (must run before first jax device use)
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+async def serve(args) -> None:
+    from ..kv.native import NativeKVEngine
+    from ..raft.store import KVRaftStateStore
+    from ..rpc.fabric import RPCServer
+    from .remote import DistWorkerRPCService
+    from .worker import DistWorker
+
+    space = None
+    raft_store = None
+    if args.data_dir:
+        engine = NativeKVEngine(args.data_dir)
+        space = engine.create_space("dist_routes")
+        raft_store = KVRaftStateStore(engine.create_space("dist_raft"))
+    worker = DistWorker(node_id=args.node_id, space=space,
+                        raft_store=raft_store)
+    await worker.start()
+    server = RPCServer(host=args.host, port=args.port)
+    DistWorkerRPCService(worker).register(server)
+    await server.start()
+    print(f"READY {server.port}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await server.stop()
+        await worker.stop()
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--node-id", default="worker0")
+    p.add_argument("--data-dir", default="")
+    args = p.parse_args(argv)
+    try:
+        asyncio.run(serve(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -148,6 +148,30 @@ def _edge_lookup(edge_tab: jax.Array, probe_len: int, node: jax.Array,
     return jnp.max(jnp.where(hit, rows[..., 3], -1), axis=-1)
 
 
+def _advance(trie: DeviceTrie, probes: Probes, probe_len: int, b: int,
+             k: int, i, act, valid, allow_wc, node_rec):
+    """One NFA step: literal + '+' successors, sort-compacted to K slots.
+
+    Shared by walk() and walk_count_only() so the successor semantics have
+    exactly one definition. Returns (new_act [B,K], overflowed [B])."""
+    stepping = (i < probes.lengths)[:, None]
+    h1 = jnp.broadcast_to(
+        jax.lax.dynamic_index_in_dim(probes.tok_h1, i, axis=1), (b, k))
+    h2 = jnp.broadcast_to(
+        jax.lax.dynamic_index_in_dim(probes.tok_h2, i, axis=1), (b, k))
+    exact = _edge_lookup(trie.edge_tab, probe_len, act.clip(0), h1, h2)
+    exact = jnp.where(stepping & valid, exact, -1)
+    plus = jnp.where(stepping & valid & allow_wc,
+                     node_rec[..., NODE_PLUS], -1)
+    cand = jnp.concatenate([exact, plus], axis=1)        # [B,2K]
+    overflowed = (cand >= 0).sum(axis=1) > k
+    # successor compaction by per-row SORT, not scatter: a bitonic sort of
+    # 2K lanes vectorizes on TPU where the scatter serializes (the active
+    # set is a set — order is immaterial); descending puts valid nodes first
+    new_act = -jnp.sort(-cand, axis=1)[:, :k]
+    return new_act, overflowed
+
+
 @functools.partial(jax.jit, static_argnames=("probe_len", "k_states"))
 def walk(trie: DeviceTrie, probes: Probes, *, probe_len: int,
          k_states: int = 32) -> WalkResult:
@@ -180,23 +204,9 @@ def walk(trie: DeviceTrie, probes: Probes, *, probe_len: int,
         final_acc = jnp.where(is_final, jnp.where(valid, act, -1), final_acc)
 
         # 3. successors for topics that still have levels left
-        stepping = (i < probes.lengths)[:, None]
-        h1 = jax.lax.dynamic_index_in_dim(probes.tok_h1, i, axis=1)  # [B,1]
-        h2 = jax.lax.dynamic_index_in_dim(probes.tok_h2, i, axis=1)
-        h1 = jnp.broadcast_to(h1, (b, k))
-        h2 = jnp.broadcast_to(h2, (b, k))
-        exact = _edge_lookup(trie.edge_tab, probe_len, act.clip(0), h1, h2)
-        exact = jnp.where(stepping & valid, exact, -1)
-        plus = jnp.where(stepping & valid & allow_wc,
-                         node_rec[..., NODE_PLUS], -1)
-        cand = jnp.concatenate([exact, plus], axis=1)       # [B,2K]
-        overflow = overflow | ((cand >= 0).sum(axis=1) > k)
-        # successor compaction by per-row SORT, not scatter: a bitonic sort
-        # of 2K lanes vectorizes on TPU where the scatter serializes (the
-        # active set is a set — order is immaterial); descending puts the
-        # valid nodes first
-        new_act = -jnp.sort(-cand, axis=1)[:, :k]
-        return new_act, hash_acc, final_acc, overflow
+        new_act, overflowed = _advance(trie, probes, probe_len, b, k, i,
+                                       act, valid, allow_wc, node_rec)
+        return new_act, hash_acc, final_acc, overflow | overflowed
 
     # dynamic trip count: stop at the longest topic actually in the batch
     # (lowered to a while loop; the padded tail of short batches costs nothing)
@@ -257,19 +267,9 @@ def walk_count_only(trie: DeviceTrie, probes: Probes, *, probe_len: int,
         is_final = (i == probes.lengths)[:, None]
         fin_cnt = jnp.where(is_final & valid, node_rec[..., NODE_RCOUNT], 0)
         cnt = cnt + fin_cnt.sum(axis=1, dtype=jnp.int32)
-        stepping = (i < probes.lengths)[:, None]
-        h1 = jnp.broadcast_to(
-            jax.lax.dynamic_index_in_dim(probes.tok_h1, i, axis=1), (b, k))
-        h2 = jnp.broadcast_to(
-            jax.lax.dynamic_index_in_dim(probes.tok_h2, i, axis=1), (b, k))
-        exact = _edge_lookup(trie.edge_tab, probe_len, act.clip(0), h1, h2)
-        exact = jnp.where(stepping & valid, exact, -1)
-        plus = jnp.where(stepping & valid & allow_wc,
-                         node_rec[..., NODE_PLUS], -1)
-        cand = jnp.concatenate([exact, plus], axis=1)
-        overflow = overflow | ((cand >= 0).sum(axis=1) > k)
-        new_act = -jnp.sort(-cand, axis=1)[:, :k]
-        return new_act, cnt, overflow
+        new_act, overflowed = _advance(trie, probes, probe_len, b, k, i,
+                                       act, valid, allow_wc, node_rec)
+        return new_act, cnt, overflow | overflowed
 
     upper = jnp.clip(jnp.max(probes.lengths, initial=-1) + 1, 0, width)
     _, cnt, overflow = jax.lax.fori_loop(0, upper, body,

@@ -1,0 +1,2 @@
+from .fabric import (RPCClient, RPCError, RPCServer,  # noqa: F401
+                     ServiceRegistry)

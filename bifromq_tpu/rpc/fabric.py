@@ -1,0 +1,328 @@
+"""RPC fabric: multiplexed length-prefixed RPC over asyncio TCP.
+
+Re-expression of base-rpc (SURVEY.md §2.4) without gRPC (not in the image):
+
+- ``RPCServer`` binds one port and hosts many named services
+  (≈ RPCServer.java: one server, many BluePrints). A service is a map of
+  method name → async handler(payload: bytes, headers) -> bytes.
+- ``RPCClient`` multiplexes concurrent calls over one connection with
+  correlation ids; calls carrying an ``order_key`` execute in FIFO order
+  per key on the server (≈ orderKey-pinned ManagedRequestPipeline /
+  ResponsePipeline semantics: one ordered stream per key).
+- ``ServiceRegistry`` is the traffic-governor analog: servers announce
+  ``(service, address)`` into a gossip agent's metadata
+  (≈ RPCServiceAnnouncer publishing ServerEndpoint into the traffic
+  governor ORMap CRDT, RPCServiceTrafficService.java:30); clients pick a
+  server by rendezvous hash over a tenant key (≈ HRWRouter tenant-aware
+  load balancing).
+
+Wire format (all big-endian):
+  frame   := u32 length ‖ body
+  request := 0x01 ‖ u64 id ‖ len16 service ‖ len16 method ‖ len16 order_key
+             ‖ payload
+  reply   := 0x02 ‖ u64 id ‖ u8 status ‖ payload      (status 0 = OK)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import struct
+from typing import Awaitable, Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+_REQ = 0x01
+_REP = 0x02
+
+Handler = Callable[[bytes, str], Awaitable[bytes]]
+
+
+class RPCError(Exception):
+    pass
+
+
+def _len16(b: bytes) -> bytes:
+    return struct.pack(">H", len(b)) + b
+
+
+def _read16(buf: bytes, pos: int) -> Tuple[bytes, int]:
+    n = struct.unpack_from(">H", buf, pos)[0]
+    pos += 2
+    return buf[pos:pos + n], pos + n
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> bytes:
+    hdr = await reader.readexactly(4)
+    (n,) = struct.unpack(">I", hdr)
+    return await reader.readexactly(n)
+
+
+def _write_frame(writer: asyncio.StreamWriter, body: bytes) -> None:
+    writer.write(struct.pack(">I", len(body)) + body)
+
+
+class _OrderedRunner:
+    """Per-order-key FIFO execution (≈ base-util AsyncRunner: a serialized
+    async task queue; the reference pins one response pipeline per key)."""
+
+    def __init__(self) -> None:
+        self._queues: Dict[str, asyncio.Queue] = {}
+        self._tasks: Dict[str, asyncio.Task] = {}
+
+    def submit(self, key: str, coro_fn) -> None:
+        q = self._queues.get(key)
+        if q is None:
+            q = self._queues[key] = asyncio.Queue()
+            self._tasks[key] = asyncio.create_task(self._drain(key, q))
+        q.put_nowait(coro_fn)
+
+    async def _drain(self, key: str, q: asyncio.Queue) -> None:
+        while True:
+            try:
+                coro_fn = await asyncio.wait_for(q.get(), timeout=30)
+            except asyncio.TimeoutError:
+                # idle: retire the queue (bounded state per key)
+                if q.empty():
+                    self._queues.pop(key, None)
+                    self._tasks.pop(key, None)
+                    return
+                continue
+            try:
+                await coro_fn()
+            except Exception:  # noqa: BLE001
+                log.exception("ordered task failed (key=%s)", key)
+
+    def close(self) -> None:
+        for t in self._tasks.values():
+            t.cancel()
+        self._queues.clear()
+        self._tasks.clear()
+
+
+class RPCServer:
+    """One listener hosting many services."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.host = host
+        self.port = port
+        self._services: Dict[str, Dict[str, Handler]] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conn_tasks: set = set()
+
+    def register(self, service: str, methods: Dict[str, Handler]) -> None:
+        self._services.setdefault(service, {}).update(methods)
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._on_conn, self.host,
+                                                  self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        for t in list(self._conn_tasks):
+            t.cancel()
+
+    async def _on_conn(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        self._conn_tasks.add(task)
+        runner = _OrderedRunner()
+        send_lock = asyncio.Lock()
+        try:
+            while True:
+                body = await _read_frame(reader)
+                # hostile/truncated frames (port scanners, bad peers) drop
+                # the connection without an unhandled-traceback path
+                if not body or body[0] != _REQ:
+                    if not body:
+                        break
+                    continue
+                try:
+                    (rid,) = struct.unpack_from(">Q", body, 1)
+                    service_b, pos = _read16(body, 9)
+                    method_b, pos = _read16(body, pos)
+                    okey_b, pos = _read16(body, pos)
+                except (struct.error, IndexError):
+                    break
+                payload = body[pos:]
+                handler = self._services.get(service_b.decode(), {}).get(
+                    method_b.decode())
+
+                async def run(rid=rid, handler=handler, payload=payload,
+                              okey=okey_b.decode()):
+                    if handler is None:
+                        status, out = 1, b"no such method"
+                    else:
+                        try:
+                            out = await handler(payload, okey)
+                            status = 0
+                        except Exception as e:  # noqa: BLE001
+                            status, out = 1, repr(e).encode()
+                    async with send_lock:
+                        _write_frame(writer, bytes([_REP])
+                                     + struct.pack(">Q", rid)
+                                     + bytes([status]) + out)
+                        await writer.drain()
+
+                if okey_b:
+                    runner.submit(okey_b.decode(), run)
+                else:
+                    asyncio.ensure_future(run())
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError):
+            pass
+        finally:
+            runner.close()
+            writer.close()
+            self._conn_tasks.discard(task)
+
+
+class RPCClient:
+    """Multiplexed client for one server address; reconnects lazily."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self.host = host
+        self.port = port
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._reader_task: Optional[asyncio.Task] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._next_id = 0
+        self._conn_lock = asyncio.Lock()
+
+    @classmethod
+    def from_address(cls, address: str) -> "RPCClient":
+        host, port = address.rsplit(":", 1)
+        return cls(host, int(port))
+
+    async def _ensure_conn(self) -> asyncio.StreamWriter:
+        async with self._conn_lock:
+            if self._writer is not None and not self._writer.is_closing():
+                return self._writer
+            reader, writer = await asyncio.open_connection(self.host,
+                                                           self.port)
+            self._writer = writer
+            self._reader_task = asyncio.create_task(self._read_loop(reader))
+            return writer
+
+    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                body = await _read_frame(reader)
+                if body[0] != _REP:
+                    continue
+                (rid,) = struct.unpack_from(">Q", body, 1)
+                status = body[9]
+                payload = body[10:]
+                fut = self._pending.pop(rid, None)
+                if fut is not None and not fut.done():
+                    if status == 0:
+                        fut.set_result(payload)
+                    else:
+                        fut.set_exception(RPCError(payload.decode()))
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError):
+            pass
+        finally:
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(RPCError("connection lost"))
+            self._pending.clear()
+            if self._writer is not None:
+                self._writer.close()
+                self._writer = None
+
+    async def call(self, service: str, method: str, payload: bytes, *,
+                   order_key: str = "", timeout: float = 30.0) -> bytes:
+        writer = await self._ensure_conn()
+        self._next_id += 1
+        rid = self._next_id
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        body = (bytes([_REQ]) + struct.pack(">Q", rid)
+                + _len16(service.encode()) + _len16(method.encode())
+                + _len16(order_key.encode()) + payload)
+        _write_frame(writer, body)
+        await writer.drain()
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            # a timed-out call must not leak its correlation entry
+            self._pending.pop(rid, None)
+
+    async def close(self) -> None:
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
+class ServiceRegistry:
+    """Service discovery over the gossip agent fabric (traffic governor
+    analog): each server announces into agent ``rpc:<service>`` with its
+    address in the agent metadata; clients rendezvous-hash a tenant key
+    over the live endpoints (HRWRouter)."""
+
+    def __init__(self, agent_host=None) -> None:
+        self.agent_host = agent_host
+        self._static: Dict[str, List[str]] = {}
+        self._clients: Dict[str, RPCClient] = {}
+
+    # -- server side --------------------------------------------------------
+
+    def announce(self, service: str, address: str) -> None:
+        if self.agent_host is not None:
+            self.agent_host.host_agent(f"rpc:{service}",
+                                       {"address": address})
+        self._static.setdefault(service, []).append(address)
+
+    # -- client side --------------------------------------------------------
+
+    def endpoints(self, service: str) -> List[str]:
+        out = []
+        if self.agent_host is not None:
+            for _node, meta in self.agent_host.agent_members(
+                    f"rpc:{service}").items():
+                addr = (meta or {}).get("address")
+                if addr:
+                    out.append(addr)
+        for addr in self._static.get(service, []):
+            if addr not in out:
+                out.append(addr)
+        return sorted(out)
+
+    def pick(self, service: str, key: str) -> Optional[str]:
+        """Rendezvous hash (≈ base-util RendezvousHash / HRWRouter)."""
+        eps = self.endpoints(service)
+        if not eps:
+            return None
+
+        def score(ep: str) -> int:
+            h = hashlib.blake2b(f"{ep}|{key}".encode(),
+                                digest_size=8).digest()
+            return int.from_bytes(h, "big")
+        return max(eps, key=score)
+
+    def client(self, service: str, key: str) -> Optional[RPCClient]:
+        addr = self.pick(service, key)
+        if addr is None:
+            return None
+        return self.client_for(addr)
+
+    def client_for(self, addr: str) -> RPCClient:
+        c = self._clients.get(addr)
+        if c is None:
+            c = self._clients[addr] = RPCClient.from_address(addr)
+        return c
+
+    async def close(self) -> None:
+        for c in self._clients.values():
+            await c.close()
+        self._clients.clear()

@@ -1,0 +1,153 @@
+"""RPC fabric tests: unary calls, multiplexing, orderKey FIFO pipelines,
+error propagation, discovery + rendezvous routing (≈ base-rpc semantics)."""
+
+import asyncio
+
+import pytest
+
+from bifromq_tpu.rpc.fabric import (RPCClient, RPCError, RPCServer,
+                                    ServiceRegistry)
+
+pytestmark = pytest.mark.asyncio
+
+
+async def _echo(payload: bytes, okey: str) -> bytes:
+    return b"echo:" + payload
+
+
+class TestRPC:
+    async def test_unary_roundtrip(self):
+        server = RPCServer()
+        server.register("svc", {"echo": _echo})
+        await server.start()
+        client = RPCClient("127.0.0.1", server.port)
+        try:
+            out = await client.call("svc", "echo", b"hi")
+            assert out == b"echo:hi"
+            out = await client.call("svc", "echo", b"\x00\xffbin")
+            assert out == b"echo:\x00\xffbin"
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_concurrent_multiplexing(self):
+        async def slow(payload, okey):
+            await asyncio.sleep(float(payload))
+            return payload
+
+        server = RPCServer()
+        server.register("svc", {"slow": slow})
+        await server.start()
+        client = RPCClient("127.0.0.1", server.port)
+        try:
+            # slower first: replies must come back out of order, matched by id
+            a = asyncio.create_task(client.call("svc", "slow", b"0.2"))
+            b = asyncio.create_task(client.call("svc", "slow", b"0.01"))
+            done, _ = await asyncio.wait({a, b},
+                                         return_when=asyncio.FIRST_COMPLETED)
+            assert b in done and a not in done
+            assert await a == b"0.2" and await b == b"0.01"
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_order_key_fifo(self):
+        seen = []
+
+        async def record(payload, okey):
+            # later calls would overtake without the ordered runner
+            await asyncio.sleep(0.05 if payload == b"first" else 0)
+            seen.append(payload)
+            return b""
+
+        server = RPCServer()
+        server.register("svc", {"rec": record})
+        await server.start()
+        client = RPCClient("127.0.0.1", server.port)
+        try:
+            await asyncio.gather(
+                client.call("svc", "rec", b"first", order_key="k"),
+                client.call("svc", "rec", b"second", order_key="k"),
+                client.call("svc", "rec", b"third", order_key="k"))
+            assert seen == [b"first", b"second", b"third"]
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_error_propagation(self):
+        async def boom(payload, okey):
+            raise ValueError("bad input")
+
+        server = RPCServer()
+        server.register("svc", {"boom": boom})
+        await server.start()
+        client = RPCClient("127.0.0.1", server.port)
+        try:
+            with pytest.raises(RPCError, match="bad input"):
+                await client.call("svc", "boom", b"")
+            with pytest.raises(RPCError, match="no such method"):
+                await client.call("svc", "missing", b"")
+            # the connection survives handler errors
+            server.register("svc", {"echo": _echo})
+            assert await client.call("svc", "echo", b"ok") == b"echo:ok"
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_reconnect_after_server_restart(self):
+        server = RPCServer()
+        server.register("svc", {"echo": _echo})
+        await server.start()
+        port = server.port
+        client = RPCClient("127.0.0.1", port)
+        assert await client.call("svc", "echo", b"1") == b"echo:1"
+        await server.stop()
+        await asyncio.sleep(0.05)
+        server2 = RPCServer(port=port)
+        server2.register("svc", {"echo": _echo})
+        await server2.start()
+        try:
+            # first call after the drop may fail; the client reconnects
+            for _ in range(3):
+                try:
+                    out = await client.call("svc", "echo", b"2")
+                    break
+                except RPCError:
+                    await asyncio.sleep(0.05)
+            assert out == b"echo:2"
+        finally:
+            await client.close()
+            await server2.stop()
+
+
+class TestRegistry:
+    async def test_static_endpoints_and_rendezvous(self):
+        reg = ServiceRegistry()
+        reg.announce("dist", "127.0.0.1:1000")
+        reg.announce("dist", "127.0.0.1:1001")
+        assert reg.endpoints("dist") == ["127.0.0.1:1000", "127.0.0.1:1001"]
+        # stable pick per key; spread across keys
+        picks = {reg.pick("dist", f"tenant{i}") for i in range(50)}
+        assert picks == {"127.0.0.1:1000", "127.0.0.1:1001"}
+        assert all(reg.pick("dist", "t") == reg.pick("dist", "t")
+                   for _ in range(5))
+        assert reg.pick("absent", "t") is None
+
+    async def test_gossip_backed_discovery(self):
+        from bifromq_tpu.cluster.membership import AgentHost
+        a = AgentHost("n1", port=0)
+        await a.start()
+        b = AgentHost("n2", port=0, seeds=[("127.0.0.1", a.port)])
+        await b.start()
+        try:
+            rega = ServiceRegistry(agent_host=a)
+            regb = ServiceRegistry(agent_host=b)
+            rega.announce("dist", "127.0.0.1:9999")
+            for _ in range(200):
+                if regb.endpoints("dist"):
+                    break
+                await asyncio.sleep(0.02)
+            assert regb.endpoints("dist") == ["127.0.0.1:9999"]
+        finally:
+            await a.stop()
+            await b.stop()

@@ -1,0 +1,107 @@
+"""Two-process deployment: mqtt-frontend in this process, dist-worker in a
+separate OS process over the RPC fabric — pub on process A matches and
+delivers via routes held by process B (the reference's dist-server →
+dist-worker gRPC hop, SURVEY.md §3.3)."""
+
+import asyncio
+import os
+import subprocess
+import sys
+
+import pytest
+
+from bifromq_tpu.dist.remote import SERVICE, RemoteDistWorker
+from bifromq_tpu.dist.service import DistService
+from bifromq_tpu.mqtt.broker import MQTTBroker
+from bifromq_tpu.mqtt.client import MQTTClient
+from bifromq_tpu.rpc.fabric import ServiceRegistry
+
+pytestmark = pytest.mark.asyncio
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def worker_proc():
+    env = dict(os.environ)
+    # the worker process needs no jax device — keep it on CPU and quick
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "bifromq_tpu.dist.worker_main", "--port", "0"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True)
+    line = proc.stdout.readline().strip()
+    assert line.startswith("READY "), line
+    port = int(line.split()[1])
+    yield port
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+class TestTwoProcess:
+    async def test_pub_on_a_delivers_via_b(self, worker_proc):
+        reg = ServiceRegistry()
+        reg.announce(SERVICE, f"127.0.0.1:{worker_proc}")
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        # swap the dist plane for the remote worker (frontend role only)
+        broker.dist = DistService(broker.sub_brokers, broker.events,
+                                  broker.settings,
+                                  worker=RemoteDistWorker(reg))
+        broker.inbox.dist = broker.dist
+        await broker.start()
+        try:
+            sub = MQTTClient("127.0.0.1", broker.port, client_id="s1")
+            await sub.connect()
+            await sub.subscribe("two/+/proc", qos=1)
+            p = MQTTClient("127.0.0.1", broker.port, client_id="p1")
+            await p.connect()
+            await p.publish("two/x/proc", b"crossed", qos=1)
+            msg = await asyncio.wait_for(sub.messages.get(), 10)
+            assert msg.payload == b"crossed"
+            # unsubscribe removes the route over the same pipeline
+            await sub.unsubscribe("two/+/proc")
+            await p.publish("two/x/proc", b"gone", qos=0)
+            await asyncio.sleep(0.3)
+            assert sub.messages.empty()
+            await sub.disconnect()
+            await p.disconnect()
+        finally:
+            await broker.stop()
+
+    async def test_shared_group_and_match_results_cross_process(
+            self, worker_proc):
+        reg = ServiceRegistry()
+        reg.announce(SERVICE, f"127.0.0.1:{worker_proc}")
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        broker.dist = DistService(broker.sub_brokers, broker.events,
+                                  broker.settings,
+                                  worker=RemoteDistWorker(reg))
+        broker.inbox.dist = broker.dist
+        await broker.start()
+        try:
+            s1 = MQTTClient("127.0.0.1", broker.port, client_id="m1")
+            s2 = MQTTClient("127.0.0.1", broker.port, client_id="m2")
+            await s1.connect()
+            await s2.connect()
+            await s1.subscribe("$share/g/sg/t", qos=0)
+            await s2.subscribe("$share/g/sg/t", qos=0)
+            p = MQTTClient("127.0.0.1", broker.port, client_id="p2")
+            await p.connect()
+            for i in range(6):
+                await p.publish("sg/t", b"m%d" % i)
+            # first remote match jit-compiles on the worker (~seconds on a
+            # cold CPU backend): poll rather than a fixed sleep
+            for _ in range(200):
+                total = s1.messages.qsize() + s2.messages.qsize()
+                if total >= 6:
+                    break
+                await asyncio.sleep(0.1)
+            # exactly one member receives each message
+            total = s1.messages.qsize() + s2.messages.qsize()
+            assert total == 6
+            await s1.disconnect()
+            await s2.disconnect()
+            await p.disconnect()
+        finally:
+            await broker.stop()
