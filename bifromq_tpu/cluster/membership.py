@@ -19,10 +19,13 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import random
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set, Tuple
+
+log = logging.getLogger(__name__)
 
 ALIVE = "alive"
 SUSPECT = "suspect"
@@ -69,6 +72,7 @@ class AgentHost(asyncio.DatagramProtocol):
         self._acks: Dict[int, asyncio.Future] = {}
         self._seq = 0
         self._listeners: List[Callable[[], None]] = []
+        self._payload_handlers: Dict[str, Callable[[str, dict], None]] = {}
         self.stopped = False
 
     # ---------------- lifecycle -------------------------------------------
@@ -90,6 +94,22 @@ class AgentHost(asyncio.DatagramProtocol):
             self._probe_task.cancel()
         if self.transport is not None:
             self.transport.close()
+
+    # ---------------- payload channel (cluster messenger) -------------------
+
+    def register_payload_handler(self, channel: str,
+                                 cb: Callable[[str, dict], None]) -> None:
+        """Subscribe to application payloads on ``channel`` (≈ Messenger)."""
+        self._payload_handlers[channel] = cb
+
+    def send_payload(self, node_id: str, channel: str, data: dict) -> bool:
+        """Fire-and-forget payload to a member by node id."""
+        m = self.members.get(node_id)
+        if m is None:
+            return False
+        self._send(tuple(m.addr), {"t": "payload", "ch": channel,
+                                   "data": data})
+        return True
 
     # ---------------- agents (service groups) ------------------------------
 
@@ -166,6 +186,15 @@ class AgentHost(asyncio.DatagramProtocol):
             fut = self._acks.pop(msg.get("seq"), None)
             if fut is not None and not fut.done():
                 fut.set_result(True)
+        elif t == "payload":
+            # application payload channel (CRDT anti-entropy rides the
+            # membership transport, ≈ the reference's cluster Messenger)
+            cb = self._payload_handlers.get(msg.get("ch"))
+            if cb is not None:
+                try:
+                    cb(msg.get("from"), msg.get("data"))
+                except Exception:  # noqa: BLE001
+                    log.exception("payload handler failed")
 
     def _merge(self, rec: dict) -> None:
         nid = rec.get("id")

@@ -1,0 +1,2 @@
+from .core import AWORSet, DotContext, MVReg, ORMap  # noqa: F401
+from .store import AntiEntropy, CRDTStore, InMemMessenger  # noqa: F401

@@ -1,0 +1,217 @@
+"""Causal CRDT lattices: dot context, AWORSet, MVReg, ORMap.
+
+Re-expression of base-crdt's causal CRDT core (base-crdt-store
+.../basecrdt/core/api + internal: AWORSet, ORMap, MVReg with dot-store
+lattices, SURVEY.md §2.3). State is (dot store, causal context); merge is
+the standard causal join:
+
+    keep (dot → value) entries present in BOTH states, plus entries present
+    in ONE state whose dot the other's context has NOT seen (fresh), drop
+    the rest (observed-removed); then join the contexts.
+
+All mutators are DELTA mutators: they return a small state containing just
+the new/retracted dots, suitable for delta anti-entropy (store.py).
+Serialization is plain JSON-able dicts so deltas ride the gossip messenger.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+Dot = Tuple[str, int]
+
+
+class DotContext:
+    """Compact causal context: version vector + dot cloud (≈ the reference's
+    causal context with compaction)."""
+
+    def __init__(self) -> None:
+        self.vv: Dict[str, int] = {}
+        self.cloud: Set[Dot] = set()
+
+    def contains(self, dot: Dot) -> bool:
+        rid, n = dot
+        return n <= self.vv.get(rid, 0) or dot in self.cloud
+
+    def add(self, dot: Dot) -> None:
+        self.cloud.add(dot)
+        self.compact()
+
+    def next_dot(self, replica_id: str) -> Dot:
+        n = self.vv.get(replica_id, 0) + 1
+        self.vv[replica_id] = n
+        return (replica_id, n)
+
+    def compact(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for dot in list(self.cloud):
+                rid, n = dot
+                if n == self.vv.get(rid, 0) + 1:
+                    self.vv[rid] = n
+                    self.cloud.discard(dot)
+                    changed = True
+                elif n <= self.vv.get(rid, 0):
+                    self.cloud.discard(dot)
+                    changed = True
+
+    def join(self, other: "DotContext") -> None:
+        for rid, n in other.vv.items():
+            self.vv[rid] = max(self.vv.get(rid, 0), n)
+        self.cloud |= other.cloud
+        self.compact()
+
+    def to_dict(self) -> dict:
+        return {"vv": dict(self.vv), "cloud": sorted(self.cloud)}
+
+    @staticmethod
+    def from_dict(d: dict) -> "DotContext":
+        ctx = DotContext()
+        ctx.vv = {k: int(v) for k, v in d.get("vv", {}).items()}
+        ctx.cloud = {(r, int(n)) for r, n in d.get("cloud", [])}
+        return ctx
+
+
+class _DotStoreCRDT:
+    """Shared join logic for dot-keyed stores (AWORSet / MVReg)."""
+
+    def __init__(self) -> None:
+        self.ctx = DotContext()
+        self.store: Dict[Dot, Any] = {}
+
+    def join(self, other: "_DotStoreCRDT") -> bool:
+        """Causal join; returns True if local state changed."""
+        changed = False
+        for dot, val in list(self.store.items()):
+            if dot not in other.store and other.ctx.contains(dot):
+                del self.store[dot]  # observed-removed elsewhere
+                changed = True
+        for dot, val in other.store.items():
+            if dot not in self.store and not self.ctx.contains(dot):
+                self.store[dot] = val  # fresh
+                changed = True
+        before = (dict(self.ctx.vv), set(self.ctx.cloud))
+        self.ctx.join(other.ctx)
+        if (self.ctx.vv, self.ctx.cloud) != before:
+            changed = True
+        return changed
+
+    def to_dict(self) -> dict:
+        return {"ctx": self.ctx.to_dict(),
+                "store": [[list(dot), val] for dot, val in
+                          sorted(self.store.items())]}
+
+    @classmethod
+    def from_dict(cls, d: dict):
+        o = cls()
+        o.ctx = DotContext.from_dict(d.get("ctx", {}))
+        o.store = {(r, int(n)): val for (r, n), val in d.get("store", [])}
+        return o
+
+
+class AWORSet(_DotStoreCRDT):
+    """Add-wins observed-remove set (≈ AWORSet.java)."""
+
+    def add(self, replica_id: str, element) -> "AWORSet":
+        """Add (re-tagging any same-element dots); returns the delta."""
+        retired = [dot for dot, v in self.store.items() if v == element]
+        dot = self.ctx.next_dot(replica_id)
+        for d in retired:
+            del self.store[d]
+        self.store[dot] = element
+        delta = AWORSet()
+        delta.store[dot] = element
+        delta.ctx.add(dot)
+        for d in retired:
+            delta.ctx.add(d)
+        delta.ctx.compact()
+        return delta
+
+    def remove(self, element) -> "AWORSet":
+        """Observed-remove: retract every dot carrying the element."""
+        retired = [dot for dot, v in self.store.items() if v == element]
+        delta = AWORSet()
+        for d in retired:
+            del self.store[d]
+            delta.ctx.add(d)
+        delta.ctx.compact()
+        return delta
+
+    def elements(self) -> List:
+        seen = []
+        for _, v in sorted(self.store.items()):
+            if v not in seen:
+                seen.append(v)
+        return seen
+
+    def __contains__(self, element) -> bool:
+        return any(v == element for v in self.store.values())
+
+
+class MVReg(_DotStoreCRDT):
+    """Multi-value register (≈ MVReg.java): concurrent writes all survive
+    until causally overwritten."""
+
+    def write(self, replica_id: str, value) -> "MVReg":
+        retired = list(self.store)
+        dot = self.ctx.next_dot(replica_id)
+        self.store.clear()
+        self.store[dot] = value
+        delta = MVReg()
+        delta.store[dot] = value
+        delta.ctx.add(dot)
+        for d in retired:
+            delta.ctx.add(d)
+        delta.ctx.compact()
+        return delta
+
+    def values(self) -> List:
+        return [v for _, v in sorted(self.store.items())]
+
+
+class ORMap:
+    """Observed-remove map of key → embedded causal CRDT
+    (≈ ORMap.java: values are themselves CRDTs sharing the map context).
+
+    Implemented as key-partitioned sub-CRDTs; a key removal retracts every
+    dot of its sub-CRDT. Deltas are per-key sub-deltas.
+    """
+
+    def __init__(self, value_type=AWORSet) -> None:
+        self.value_type = value_type
+        self.entries: Dict[str, Any] = {}
+
+    def get(self, key: str):
+        e = self.entries.get(key)
+        if e is None:
+            e = self.entries[key] = self.value_type()
+        return e
+
+    def keys(self) -> List[str]:
+        return sorted(k for k, v in self.entries.items() if v.store)
+
+    def remove_key(self, key: str) -> Optional[dict]:
+        """Retract the whole sub-CRDT; returns the delta dict or None."""
+        e = self.entries.get(key)
+        if e is None or not e.store:
+            return None
+        delta = self.value_type()
+        for dot in list(e.store):
+            del e.store[dot]
+            delta.ctx.add(dot)
+        delta.ctx.compact()
+        return {key: delta.to_dict()}
+
+    def join(self, deltas: Dict[str, dict]) -> bool:
+        changed = False
+        for key, sub in deltas.items():
+            if self.get(key).join(self.value_type.from_dict(sub)):
+                changed = True
+        return changed
+
+    def to_dict(self) -> Dict[str, dict]:
+        return {k: v.to_dict() for k, v in self.entries.items()}
+
+    def delta_for(self, key: str) -> Dict[str, dict]:
+        return {key: self.get(key).to_dict()}

@@ -1,0 +1,272 @@
+"""CRDT store + delta anti-entropy over a messenger.
+
+Re-expression of base-crdt-store's replication plane (CRDTStore.java:54
+hosting replicas; AntiEntropy.java:44 running delta-sync rounds with
+neighbors over the cluster messenger):
+
+- ``CRDTStore.host(uri)`` binds a named ORMap replica.
+- Every local mutation appends its delta to a bounded delta log; an
+  ``AntiEntropy`` round sends each neighbor the log suffix it has not
+  acked yet (delta sync), falling back to FULL state when the neighbor is
+  too far behind the truncated log — the reference's delta/state dual.
+- Transport is pluggable: ``InMemMessenger`` for in-process clusters
+  (partition-able, the reference's test-cluster trick) and
+  ``AgentMessenger`` riding the gossip host's UDP socket.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .core import AWORSet, MVReg, ORMap
+
+log = logging.getLogger(__name__)
+
+MAX_DELTA_LOG = 256
+
+
+class IMessenger:
+    """Fire-and-forget peer messaging + neighbor discovery."""
+
+    def send(self, to: str, payload: dict) -> None:
+        raise NotImplementedError
+
+    def neighbors(self) -> List[str]:
+        raise NotImplementedError
+
+    def on_receive(self, cb: Callable[[str, dict], None]) -> None:
+        raise NotImplementedError
+
+
+class InMemMessenger(IMessenger):
+    """In-process fabric with partitions (≈ CRDTStoreTestCluster)."""
+
+    def __init__(self) -> None:
+        self.nodes: Dict[str, Callable[[str, dict], None]] = {}
+        self.blocked: set = set()
+        self._me: Optional[str] = None
+
+    def bind(self, node_id: str) -> "InMemMessenger":
+        m = InMemMessenger()
+        m.nodes = self.nodes
+        m.blocked = self.blocked
+        m._me = node_id
+        m._root = self if getattr(self, "_root", None) is None else self._root
+        return m
+
+    def partition(self, *groups) -> None:
+        self.blocked.clear()
+        gl = [set(g) for g in groups]
+        everyone = set(self.nodes)
+        for g in gl:
+            for a in g:
+                for b in everyone - g:
+                    self.blocked.add(frozenset((a, b)))
+
+    def heal(self) -> None:
+        self.blocked.clear()
+
+    def send(self, to: str, payload: dict) -> None:
+        if frozenset((self._me, to)) in self.blocked:
+            return
+        cb = self.nodes.get(to)
+        if cb is not None:
+            cb(self._me, json.loads(json.dumps(payload)))
+
+    def neighbors(self) -> List[str]:
+        return sorted(n for n in self.nodes if n != self._me)
+
+    def on_receive(self, cb: Callable[[str, dict], None]) -> None:
+        self.nodes[self._me] = cb
+
+
+class AgentMessenger(IMessenger):
+    """CRDT messenger riding the gossip host's UDP socket (the reference's
+    anti-entropy-over-cluster-messenger layering, AntiEntropy.java:44 over
+    base-cluster Messenger): peers = alive gossip members."""
+
+    CHANNEL = "crdt"
+
+    def __init__(self, agent_host) -> None:
+        self.agent_host = agent_host
+        self._cb: Optional[Callable[[str, dict], None]] = None
+        agent_host.register_payload_handler(
+            self.CHANNEL, lambda sender, data: self._cb
+            and self._cb(sender, data))
+
+    def send(self, to: str, payload: dict) -> None:
+        self.agent_host.send_payload(to, self.CHANNEL, payload)
+
+    def neighbors(self) -> List[str]:
+        return sorted(n for n in self.agent_host.alive_members()
+                      if n != self.agent_host.node_id)
+
+    def on_receive(self, cb: Callable[[str, dict], None]) -> None:
+        self._cb = cb
+
+
+class _Replica:
+    """One hosted ORMap replica with a delta log."""
+
+    def __init__(self, uri: str, replica_id: str) -> None:
+        self.uri = uri
+        self.replica_id = replica_id
+        self.ormap = ORMap()
+        # delta log: seq -> per-key delta dict (bounded; older rounds fall
+        # back to full-state sync)
+        self.delta_log: List[Tuple[int, Dict[str, dict]]] = []
+        self.next_seq = 1
+        self.first_seq = 1
+        self._watchers: List[Callable[[], None]] = []
+
+    def record_delta(self, delta: Dict[str, dict]) -> None:
+        self.delta_log.append((self.next_seq, delta))
+        self.next_seq += 1
+        if len(self.delta_log) > MAX_DELTA_LOG:
+            dropped = len(self.delta_log) - MAX_DELTA_LOG
+            self.delta_log = self.delta_log[dropped:]
+            self.first_seq = self.delta_log[0][0]
+
+    def watch(self, cb: Callable[[], None]) -> None:
+        self._watchers.append(cb)
+
+    def notify(self) -> None:
+        for cb in self._watchers:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001
+                log.exception("crdt watcher failed")
+
+
+class CRDTStore:
+    """Hosts replicas; applies local mutations; answers sync messages."""
+
+    def __init__(self, replica_id: str, messenger: IMessenger) -> None:
+        self.replica_id = replica_id
+        self.messenger = messenger
+        self.replicas: Dict[str, _Replica] = {}
+        messenger.on_receive(self._on_message)
+
+    def host(self, uri: str) -> _Replica:
+        r = self.replicas.get(uri)
+        if r is None:
+            r = self.replicas[uri] = _Replica(uri, self.replica_id)
+        return r
+
+    # ---------------- local mutations (delta mutators) ---------------------
+
+    def set_add(self, uri: str, key: str, element) -> None:
+        r = self.host(uri)
+        delta = r.ormap.get(key).add(self.replica_id, element)
+        r.record_delta({key: delta.to_dict()})
+        r.notify()
+
+    def set_remove(self, uri: str, key: str, element) -> None:
+        r = self.host(uri)
+        delta = r.ormap.get(key).remove(element)
+        r.record_delta({key: delta.to_dict()})
+        r.notify()
+
+    def remove_key(self, uri: str, key: str) -> None:
+        r = self.host(uri)
+        delta = r.ormap.remove_key(key)
+        if delta is not None:
+            r.record_delta(delta)
+            r.notify()
+
+    def elements(self, uri: str, key: str) -> List:
+        return self.host(uri).ormap.get(key).elements()
+
+    def keys(self, uri: str) -> List[str]:
+        return self.host(uri).ormap.keys()
+
+    # ---------------- sync protocol ----------------------------------------
+    # {t: "delta", uri, from_seq, to_seq, deltas: [...]}   + implicit ack req
+    # {t: "full", uri, state}
+    # {t: "ack",  uri, seq}
+
+    def _on_message(self, sender: str, msg: dict) -> None:
+        t = msg.get("t")
+        uri = msg.get("uri")
+        if t == "delta":
+            r = self.host(uri)
+            changed = False
+            for delta in msg["deltas"]:
+                if r.ormap.join(delta):
+                    changed = True
+            self.messenger.send(sender, {"t": "ack", "uri": uri,
+                                         "seq": msg["to_seq"]})
+            if changed:
+                r.notify()
+        elif t == "full":
+            r = self.host(uri)
+            if r.ormap.join(msg["state"]):
+                r.notify()
+            self.messenger.send(sender, {"t": "ack", "uri": uri,
+                                         "seq": msg["seq"]})
+        elif t == "ack":
+            ae = getattr(self, "_anti_entropy", None)
+            if ae is not None:
+                ae.on_ack(sender, uri, int(msg["seq"]))
+
+
+class AntiEntropy:
+    """Periodic delta-sync rounds with every neighbor (AntiEntropy.java:44).
+
+    Tracks the highest seq each neighbor acked per uri; a round ships the
+    unacked delta-log suffix, or full state if the suffix fell off the
+    bounded log (or the neighbor is brand new)."""
+
+    def __init__(self, store: CRDTStore, *, interval: float = 0.05) -> None:
+        self.store = store
+        self.interval = interval
+        self.acked: Dict[Tuple[str, str], int] = {}   # (peer, uri) -> seq
+        self._task: Optional[asyncio.Task] = None
+        store._anti_entropy = self
+
+    def on_ack(self, peer: str, uri: str, seq: int) -> None:
+        key = (peer, uri)
+        self.acked[key] = max(self.acked.get(key, 0), seq)
+
+    def run_round(self) -> None:
+        for uri, r in self.store.replicas.items():
+            for peer in self.store.messenger.neighbors():
+                # -1 = never acked: forces one initial full-state exchange,
+                # after which ack(next_seq-1) silences the pair until the
+                # next local mutation
+                acked = self.acked.get((peer, uri), -1)
+                if acked >= r.next_seq - 1:
+                    continue  # fully caught up
+                if acked + 1 < r.first_seq:
+                    # suffix unavailable (or nothing logged): full state
+                    self.store.messenger.send(peer, {
+                        "t": "full", "uri": uri,
+                        "state": r.ormap.to_dict(),
+                        "seq": r.next_seq - 1})
+                else:
+                    deltas = [d for s, d in r.delta_log if s > acked]
+                    if not deltas:
+                        continue
+                    self.store.messenger.send(peer, {
+                        "t": "delta", "uri": uri,
+                        "from_seq": acked + 1, "to_seq": r.next_seq - 1,
+                        "deltas": deltas})
+
+    async def start(self) -> None:
+        async def loop():
+            while True:
+                self.run_round()
+                await asyncio.sleep(self.interval)
+        self._task = asyncio.create_task(loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except BaseException:  # noqa: BLE001
+                pass
+            self._task = None

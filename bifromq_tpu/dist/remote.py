@@ -135,7 +135,9 @@ class DistWorkerRPCService:
 
     async def _purge_broker(self, payload: bytes, okey: str) -> bytes:
         (broker_id,) = struct.unpack_from(">I", payload, 0)
-        n = await self.worker.purge_broker_routes(broker_id)
+        prefix, _ = _read16(payload, 4)
+        n = await self.worker.purge_broker_routes(
+            broker_id, deliverer_prefix=prefix.decode())
         return struct.pack(">I", n)
 
 
@@ -225,7 +227,15 @@ class RemoteDistWorker:
                 stitched[qi] = m
         return stitched
 
-    async def purge_broker_routes(self, broker_id: int) -> int:
-        out = await self._client(str(broker_id)).call(
-            self.service, "purge_broker", struct.pack(">I", broker_id))
-        return struct.unpack(">I", out)[0]
+    async def purge_broker_routes(self, broker_id: int,
+                                  deliverer_prefix: str = "") -> int:
+        """Sweep on EVERY worker: routes are tenant-sharded, so the purge
+        must reach the whole fleet, scoped by the caller's prefix."""
+        payload = (struct.pack(">I", broker_id)
+                   + _len16(deliverer_prefix.encode()))
+        total = 0
+        for ep in self.registry.endpoints(self.service):
+            out = await self.registry.client_for(ep).call(
+                self.service, "purge_broker", payload)
+            total += struct.unpack(">I", out)[0]
+        return total

@@ -288,19 +288,24 @@ class DistWorker:
                                 incarnation))
         return out.decode()
 
-    async def purge_broker_routes(self, broker_id: int) -> int:
-        """Remove every route targeting ``broker_id`` receivers.
+    async def purge_broker_routes(self, broker_id: int,
+                                  deliverer_prefix: str = "") -> int:
+        """Remove every route targeting ``broker_id`` receivers whose
+        deliverer key starts with ``deliverer_prefix``.
 
         Crash-recovery sweep: transient-session routes written through to a
         durable route keyspace must not resurrect after an unclean restart
-        (their sessions are gone). The reference reaps these via the
-        dist GC + checkSubscriptions purge (DistWorkerCoProc.gc:554)."""
+        (their sessions are gone). The prefix scopes the sweep to ONE
+        frontend instance's routes so co-tenant frontends sharing a worker
+        are untouched. The reference reaps these via the dist GC +
+        checkSubscriptions purge (DistWorkerCoProc.gc:554)."""
         doomed = []
         for key, value in self.space.iterate(
                 schema.TAG_DIST, schema.prefix_end(schema.TAG_DIST)):
             tenant_id = _tenant_of_key(key)
             route = schema.decode_route(tenant_id, key, value)
-            if route.broker_id == broker_id:
+            if route.broker_id == broker_id and \
+                    route.deliverer_key.startswith(deliverer_prefix):
                 doomed.append((tenant_id, route))
         for tenant_id, route in doomed:
             await self._mutate(encode_remove_route(

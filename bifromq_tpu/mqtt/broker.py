@@ -300,6 +300,18 @@ class MQTTBroker:
         self.host = host
         self.port = port
         self.ssl_context = ssl_context  # TLS listener (≈ 8883/netty-tcnative)
+        # stable broker-instance id: scopes this broker's transient routes in
+        # the shared route table (deliverer-key prefix), so a startup sweep
+        # can purge ITS stale routes without touching other frontends'
+        self.server_id = uuid.uuid4().hex[:8]
+        if inbox_engine is not None:
+            meta_space = inbox_engine.create_space("broker_meta")
+            sid = meta_space.get_metadata(b"server_id")
+            if sid is None:
+                meta_space.put_metadata(b"server_id",
+                                        self.server_id.encode())
+            else:
+                self.server_id = sid.decode()
         self.auth = auth or AllowAllAuthProvider()
         self.settings = settings or DefaultSettingProvider()
         self.events = events or CollectingEventCollector()
@@ -342,7 +354,7 @@ class MQTTBroker:
         # serving (the reference's dist GC role, DistWorkerCoProc.gc:554)
         from ..plugin.subbroker import TRANSIENT_SUB_BROKER_ID
         purged = await self.dist.worker.purge_broker_routes(
-            TRANSIENT_SUB_BROKER_ID)
+            TRANSIENT_SUB_BROKER_ID, deliverer_prefix=self.server_id + "|")
         if purged:
             log.info("purged %d stale transient routes", purged)
         recovered = await self.inbox.recover()

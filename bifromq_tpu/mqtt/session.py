@@ -492,8 +492,10 @@ class Session:
                                 self._deliverer_key())
 
     def _deliverer_key(self) -> str:
-        # one deliverer group per session bucket (≈ DeliverersPerMqttServer)
-        return f"d{hash(self.session_id) % 16}"
+        # one deliverer group per session bucket (≈ DeliverersPerMqttServer),
+        # prefixed by the broker-instance id so crash sweeps are scoped
+        sid = getattr(getattr(self.conn, "broker", None), "server_id", "")
+        return f"{sid}|d{hash(self.session_id) % 16}"
 
     # ---------------- outbound delivery ------------------------------------
 

@@ -207,20 +207,28 @@ class RPCClient:
                 return self._writer
             reader, writer = await asyncio.open_connection(self.host,
                                                            self.port)
+            # per-connection pending map: a dead connection's cleanup must
+            # only fail ITS calls, never a successor connection's
             self._writer = writer
-            self._reader_task = asyncio.create_task(self._read_loop(reader))
+            self._pending = {}
+            self._reader_task = asyncio.create_task(
+                self._read_loop(reader, writer, self._pending))
             return writer
 
-    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+    async def _read_loop(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter,
+                         pending: Dict[int, asyncio.Future]) -> None:
         try:
             while True:
                 body = await _read_frame(reader)
-                if body[0] != _REP:
+                if not body or body[0] != _REP:
+                    if not body:
+                        break
                     continue
                 (rid,) = struct.unpack_from(">Q", body, 1)
                 status = body[9]
                 payload = body[10:]
-                fut = self._pending.pop(rid, None)
+                fut = pending.pop(rid, None)
                 if fut is not None and not fut.done():
                     if status == 0:
                         fut.set_result(payload)
@@ -230,21 +238,22 @@ class RPCClient:
                 asyncio.CancelledError):
             pass
         finally:
-            for fut in self._pending.values():
+            for fut in pending.values():
                 if not fut.done():
                     fut.set_exception(RPCError("connection lost"))
-            self._pending.clear()
-            if self._writer is not None:
-                self._writer.close()
+            pending.clear()
+            writer.close()
+            if self._writer is writer:
                 self._writer = None
 
     async def call(self, service: str, method: str, payload: bytes, *,
                    order_key: str = "", timeout: float = 30.0) -> bytes:
         writer = await self._ensure_conn()
+        pending = self._pending
         self._next_id += 1
         rid = self._next_id
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._pending[rid] = fut
+        pending[rid] = fut
         body = (bytes([_REQ]) + struct.pack(">Q", rid)
                 + _len16(service.encode()) + _len16(method.encode())
                 + _len16(order_key.encode()) + payload)
@@ -254,7 +263,7 @@ class RPCClient:
             return await asyncio.wait_for(fut, timeout)
         finally:
             # a timed-out call must not leak its correlation entry
-            self._pending.pop(rid, None)
+            pending.pop(rid, None)
 
     async def close(self) -> None:
         if self._reader_task is not None:
@@ -265,33 +274,54 @@ class RPCClient:
 
 
 class ServiceRegistry:
-    """Service discovery over the gossip agent fabric (traffic governor
-    analog): each server announces into agent ``rpc:<service>`` with its
-    address in the agent metadata; clients rendezvous-hash a tenant key
-    over the live endpoints (HRWRouter)."""
+    """Service discovery (traffic governor analog, three backends):
 
-    def __init__(self, agent_host=None) -> None:
+    - **CRDT** (the reference way, RPCServiceTrafficService.java:30): each
+      server announces ``(service → address)`` into a replicated ORMap
+      ("traffic" uri) on a CRDTStore; anti-entropy spreads it.
+    - **gossip agents**: announce into agent ``rpc:<service>`` metadata.
+    - **static**: explicit addresses (tests / config files).
+
+    Clients rendezvous-hash a tenant key over the union of live endpoints
+    (HRWRouter semantics)."""
+
+    TRAFFIC_URI = "traffic"
+
+    def __init__(self, agent_host=None, crdt_store=None) -> None:
         self.agent_host = agent_host
+        self.crdt_store = crdt_store
         self._static: Dict[str, List[str]] = {}
         self._clients: Dict[str, RPCClient] = {}
 
     # -- server side --------------------------------------------------------
 
     def announce(self, service: str, address: str) -> None:
+        if self.crdt_store is not None:
+            self.crdt_store.set_add(self.TRAFFIC_URI, service, address)
         if self.agent_host is not None:
             self.agent_host.host_agent(f"rpc:{service}",
                                        {"address": address})
         self._static.setdefault(service, []).append(address)
 
+    def withdraw(self, service: str, address: str) -> None:
+        if self.crdt_store is not None:
+            self.crdt_store.set_remove(self.TRAFFIC_URI, service, address)
+        if self.agent_host is not None:
+            self.agent_host.stop_agent(f"rpc:{service}")
+        if address in self._static.get(service, []):
+            self._static[service].remove(address)
+
     # -- client side --------------------------------------------------------
 
     def endpoints(self, service: str) -> List[str]:
         out = []
+        if self.crdt_store is not None:
+            out.extend(self.crdt_store.elements(self.TRAFFIC_URI, service))
         if self.agent_host is not None:
             for _node, meta in self.agent_host.agent_members(
                     f"rpc:{service}").items():
                 addr = (meta or {}).get("address")
-                if addr:
+                if addr and addr not in out:
                     out.append(addr)
         for addr in self._static.get(service, []):
             if addr not in out:

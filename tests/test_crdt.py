@@ -1,0 +1,226 @@
+"""base-crdt tests: lattice semantics (add-wins, observed-remove, MVReg
+concurrency), delta anti-entropy convergence across 3 in-process hosts with
+partitions, and full-state fallback after delta-log truncation
+(≈ CRDTStoreTestCluster / AntiEntropy convergence tests)."""
+
+import asyncio
+
+import pytest
+
+from bifromq_tpu.crdt.core import AWORSet, DotContext, MVReg, ORMap
+from bifromq_tpu.crdt.store import (AntiEntropy, CRDTStore, InMemMessenger,
+                                    MAX_DELTA_LOG)
+
+pytestmark = pytest.mark.asyncio
+
+
+class TestLattices:
+    def test_awor_set_add_remove(self):
+        s = AWORSet()
+        s.add("r1", "a")
+        s.add("r1", "b")
+        assert s.elements() == ["a", "b"]
+        s.remove("a")
+        assert s.elements() == ["b"]
+        assert "a" not in s
+
+    def test_add_wins_on_concurrent_add_remove(self):
+        a, b = AWORSet(), AWORSet()
+        d = a.add("r1", "x")
+        b.join(AWORSet.from_dict(d.to_dict()))
+        assert "x" in b
+        # concurrent: a removes x, b re-adds x
+        da = a.remove("x")
+        db = b.add("r2", "x")
+        a.join(AWORSet.from_dict(db.to_dict()))
+        b.join(AWORSet.from_dict(da.to_dict()))
+        assert "x" in a and "x" in b  # add wins
+        assert a.to_dict() == b.to_dict()
+
+    def test_observed_remove_only_removes_seen(self):
+        a, b = AWORSet(), AWORSet()
+        a.add("r1", "x")
+        # b never saw r1's add; b's remove of "x" is a no-op on join
+        db = b.remove("x")
+        a.join(AWORSet.from_dict(db.to_dict()))
+        assert "x" in a
+
+    def test_mvreg_concurrent_writes_both_survive(self):
+        a, b = MVReg(), MVReg()
+        da = a.write("r1", "va")
+        db = b.write("r2", "vb")
+        a.join(MVReg.from_dict(db.to_dict()))
+        b.join(MVReg.from_dict(da.to_dict()))
+        assert sorted(a.values()) == ["va", "vb"]
+        assert sorted(b.values()) == ["va", "vb"]
+        # a causal overwrite collapses both
+        d = a.write("r1", "final")
+        b.join(MVReg.from_dict(d.to_dict()))
+        assert b.values() == ["final"]
+
+    def test_ormap_key_remove(self):
+        m = ORMap()
+        m.get("svc").add("r1", "ep1")
+        m.get("svc").add("r1", "ep2")
+        m.get("other").add("r1", "x")
+        assert m.keys() == ["other", "svc"]
+        delta = m.remove_key("svc")
+        assert delta is not None
+        assert m.keys() == ["other"]
+
+    def test_dot_context_compaction(self):
+        ctx = DotContext()
+        ctx.add(("r1", 2))
+        assert ctx.cloud == {("r1", 2)}
+        ctx.add(("r1", 1))
+        assert ctx.cloud == set() and ctx.vv == {"r1": 2}
+        assert ctx.contains(("r1", 1)) and ctx.contains(("r1", 2))
+        assert not ctx.contains(("r1", 3))
+
+
+def mk_cluster(n=3, interval=0.01):
+    root = InMemMessenger()
+    stores, aes = {}, {}
+    for i in range(n):
+        nid = f"h{i}"
+        m = root.bind(nid)
+        st = CRDTStore(nid, m)
+        stores[nid] = st
+        aes[nid] = AntiEntropy(st, interval=interval)
+    return root, stores, aes
+
+
+async def settle(stores, uri, key, want, timeout=5.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if all(sorted(st.elements(uri, key)) == sorted(want)
+               for st in stores.values()):
+            return
+        await asyncio.sleep(0.02)
+    got = {n: st.elements(uri, key) for n, st in stores.items()}
+    raise AssertionError(f"no convergence: want {want}, got {got}")
+
+
+class TestAntiEntropy:
+    async def test_three_host_convergence(self):
+        root, stores, aes = mk_cluster(3)
+        for ae in aes.values():
+            await ae.start()
+        try:
+            stores["h0"].set_add("svc", "dist", "ep0")
+            stores["h1"].set_add("svc", "dist", "ep1")
+            stores["h2"].set_add("svc", "dist", "ep2")
+            await settle(stores, "svc", "dist", ["ep0", "ep1", "ep2"])
+            stores["h1"].set_remove("svc", "dist", "ep0")
+            await settle(stores, "svc", "dist", ["ep1", "ep2"])
+        finally:
+            for ae in aes.values():
+                await ae.stop()
+
+    async def test_convergence_after_partition(self):
+        root, stores, aes = mk_cluster(3)
+        for ae in aes.values():
+            await ae.start()
+        try:
+            stores["h0"].set_add("svc", "k", "base")
+            await settle(stores, "svc", "k", ["base"])
+            root.partition({"h0"}, {"h1", "h2"})
+            stores["h0"].set_add("svc", "k", "minority")
+            stores["h1"].set_add("svc", "k", "majority")
+            stores["h2"].set_remove("svc", "k", "base")
+            await asyncio.sleep(0.2)
+            # divided views
+            assert "minority" not in stores["h1"].elements("svc", "k")
+            root.heal()
+            await settle(stores, "svc", "k", ["minority", "majority"])
+        finally:
+            for ae in aes.values():
+                await ae.stop()
+
+    async def test_full_state_fallback_after_log_truncation(self):
+        root, stores, aes = mk_cluster(2)
+        # h1 partitioned away while h0 makes MANY updates (log overflows)
+        root.partition({"h0"}, {"h1"})
+        for ae in aes.values():
+            await ae.start()
+        try:
+            for i in range(MAX_DELTA_LOG + 50):
+                stores["h0"].set_add("svc", "k", f"e{i}")
+            root.heal()
+            want = [f"e{i}" for i in range(MAX_DELTA_LOG + 50)]
+            await settle(stores, "svc", "k", want, timeout=10)
+        finally:
+            for ae in aes.values():
+                await ae.stop()
+
+    async def test_late_joiner_gets_full_state(self):
+        root, stores, aes = mk_cluster(2)
+        for ae in aes.values():
+            await ae.start()
+        try:
+            stores["h0"].set_add("svc", "k", "early")
+            await settle(stores, "svc", "k", ["early"])
+            # a third host appears later
+            m = root.bind("h2")
+            st2 = CRDTStore("h2", m)
+            ae2 = AntiEntropy(st2, interval=0.01)
+            await ae2.start()
+            stores["h2"] = st2
+            aes["h2"] = ae2
+            await settle(stores, "svc", "k", ["early"])
+        finally:
+            for ae in aes.values():
+                await ae.stop()
+
+    async def test_watch_notifies_on_remote_change(self):
+        root, stores, aes = mk_cluster(2)
+        fired = []
+        stores["h1"].host("svc").watch(lambda: fired.append(1))
+        for ae in aes.values():
+            await ae.start()
+        try:
+            stores["h0"].set_add("svc", "k", "v")
+            await settle(stores, "svc", "k", ["v"])
+            assert fired
+        finally:
+            for ae in aes.values():
+                await ae.stop()
+
+
+class TestCRDTOverGossip:
+    async def test_anti_entropy_over_real_udp_gossip(self):
+        from bifromq_tpu.cluster.membership import AgentHost
+        from bifromq_tpu.crdt.store import AgentMessenger
+        from bifromq_tpu.rpc.fabric import ServiceRegistry
+
+        a = AgentHost("g1", port=0)
+        await a.start()
+        b = AgentHost("g2", port=0, seeds=[("127.0.0.1", a.port)])
+        await b.start()
+        sa = CRDTStore("g1", AgentMessenger(a))
+        sb = CRDTStore("g2", AgentMessenger(b))
+        aea, aeb = AntiEntropy(sa, interval=0.02), AntiEntropy(sb,
+                                                               interval=0.02)
+        await aea.start()
+        await aeb.start()
+        try:
+            rega = ServiceRegistry(crdt_store=sa)
+            regb = ServiceRegistry(crdt_store=sb)
+            rega.announce("dist-worker", "127.0.0.1:7001")
+            deadline = asyncio.get_running_loop().time() + 5
+            while asyncio.get_running_loop().time() < deadline:
+                if regb.endpoints("dist-worker") == ["127.0.0.1:7001"]:
+                    break
+                await asyncio.sleep(0.05)
+            assert regb.endpoints("dist-worker") == ["127.0.0.1:7001"]
+            rega.withdraw("dist-worker", "127.0.0.1:7001")
+            while asyncio.get_running_loop().time() < deadline:
+                if not regb.endpoints("dist-worker"):
+                    break
+                await asyncio.sleep(0.05)
+            assert regb.endpoints("dist-worker") == []
+        finally:
+            await aea.stop()
+            await aeb.stop()
+            await a.stop()
+            await b.stop()

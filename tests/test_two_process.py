@@ -105,3 +105,41 @@ class TestTwoProcess:
             await p.disconnect()
         finally:
             await broker.stop()
+
+    async def test_purge_scoped_to_one_frontend(self, worker_proc):
+        # two frontends share one worker; A's startup sweep must not delete
+        # B's live transient routes
+        reg = ServiceRegistry()
+        reg.announce(SERVICE, f"127.0.0.1:{worker_proc}")
+
+        def mk_front():
+            b = MQTTBroker(host="127.0.0.1", port=0)
+            b.dist = DistService(b.sub_brokers, b.events, b.settings,
+                                 worker=RemoteDistWorker(reg))
+            b.inbox.dist = b.dist
+            return b
+
+        fa, fb = mk_front(), mk_front()
+        await fa.start()
+        await fb.start()
+        try:
+            cb = MQTTClient("127.0.0.1", fb.port, client_id="cb")
+            await cb.connect()
+            await cb.subscribe("scope/+", qos=0)
+            # frontend A sweeps its own (empty) route set
+            purged = await fa.dist.worker.purge_broker_routes(
+                0, deliverer_prefix=fa.server_id + "|")
+            assert purged == 0
+            # B's subscription still matches
+            res = await fb.dist.worker.match_batch(
+                [("DevOnly", ["scope", "x"])], max_persistent_fanout=10,
+                max_group_fanout=10)
+            assert len(res[0].normal) == 1
+            # B's own sweep with its prefix removes its route
+            purged = await fb.dist.worker.purge_broker_routes(
+                0, deliverer_prefix=fb.server_id + "|")
+            assert purged == 1
+            await cb.disconnect()
+        finally:
+            await fa.stop()
+            await fb.stop()
