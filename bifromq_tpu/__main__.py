@@ -1,0 +1,13 @@
+"""``python -m bifromq_tpu --config conf.yml`` — standalone broker CLI."""
+
+import os
+
+if os.environ.get("JAX_PLATFORMS"):
+    # config-level override beats a sitecustomize-registered platform plugin
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+from .starter import main
+
+main()

@@ -49,6 +49,7 @@ class Connection:
         self.session: Optional[Session] = None
         self.protocol_level = 4
         self._closed = False
+        self._pending_packets: list = []
 
     # ------------- write side ---------------------------------------------
 
@@ -150,19 +151,103 @@ class Connection:
             await self.close_transport()
             return
         self.protocol_level = first.protocol_level
+        # packets pipelined behind CONNECT are visible to the enhanced-auth
+        # exchange (_next_packet) and flushed to the session afterwards
+        self._pending_packets = buf_packets[1:]
         await self._on_connect(first)
         if self.session is not None:
-            for packet in buf_packets[1:]:
-                await self.session.handle(packet)
+            while self._pending_packets:
+                await self.session.handle(self._pending_packets.pop(0))
+                if self.session.closed:
+                    return
+
+    async def _next_packet(self, timeout: float = 10.0):
+        """Read the next single packet during a pre-CONNACK exchange."""
+        if self._pending_packets:
+            return self._pending_packets.pop(0)
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            remain = deadline - asyncio.get_event_loop().time()
+            if remain <= 0:
+                return None
+            try:
+                data = await asyncio.wait_for(self.reader.read(65536),
+                                              remain)
+            except asyncio.TimeoutError:
+                return None
+            if not data:
+                return None
+            pkts = self.decoder.feed(data)
+            if pkts:
+                self._pending_packets = pkts[1:]
+                return pkts[0]
+
+    async def _extended_auth_exchange(self, c: pk.Connect, method: str):
+        """MQTT5 enhanced auth: run the provider's AUTH challenge loop
+        before CONNACK; returns an AuthResult or None (closed)."""
+        from ..plugin.auth import AuthResult, ExtAuthData
+
+        broker = self.broker
+        peer = str(self.writer.get_extra_info("peername"))
+        step = ExtAuthData(
+            client_id=c.client_id, method=method,
+            data=(c.properties or {}).get(PropertyId.AUTHENTICATION_DATA,
+                                          b""),
+            remote_addr=peer)
+        for _ in range(8):  # bounded exchange rounds
+            res = await broker.auth.extended_auth(step)
+            if res.kind == "fail":
+                # method-unsupported vs credential failure carry distinct
+                # MQTT5 reason codes ([MQTT-4.12])
+                rc = (ReasonCode.BAD_AUTHENTICATION_METHOD if res.bad_method
+                      else ReasonCode.NOT_AUTHORIZED)
+                await self.send(pk.Connack(reason_code=rc))
+                broker.events.report(Event(EventType.CONNECT_REJECTED, "",
+                                           {"reason": res.reason}))
+                await self.close_transport()
+                return None
+            if res.kind == "success":
+                self.auth_method = method
+                # CONNACK must echo the method (+ any final server proof)
+                self.auth_success_data = res.data
+                return AuthResult.success(res.tenant_id, res.user_id)
+            props = {PropertyId.AUTHENTICATION_METHOD: method}
+            if res.data:
+                props[PropertyId.AUTHENTICATION_DATA] = res.data
+            await self.send(pk.Auth(
+                reason_code=ReasonCode.CONTINUE_AUTHENTICATION,
+                properties=props))
+            reply = await self._next_packet()
+            if not isinstance(reply, pk.Auth) or (reply.properties or {}).get(
+                    PropertyId.AUTHENTICATION_METHOD) != method:
+                await self.close_transport()
+                return None
+            step = ExtAuthData(
+                client_id=c.client_id, method=method,
+                data=(reply.properties or {}).get(
+                    PropertyId.AUTHENTICATION_DATA, b""),
+                remote_addr=peer)
+        await self.close_transport()
+        return None
 
     async def _on_connect(self, c: pk.Connect) -> None:
         broker = self.broker
         v5 = c.protocol_level >= PROTOCOL_MQTT5
         peer = self.writer.get_extra_info("peername")
-        auth_result = await broker.auth.auth(AuthData(
-            client_id=c.client_id, protocol_level=c.protocol_level,
-            username=c.username, password=c.password,
-            remote_addr=str(peer)))
+        auth_method = None
+        if v5 and c.properties:
+            auth_method = c.properties.get(PropertyId.AUTHENTICATION_METHOD)
+        if auth_method is not None:
+            # MQTT5 enhanced auth: AUTH-packet exchange before CONNACK
+            # (≈ MQTT5ConnectHandler + ReAuthenticator SPI flow)
+            auth_result = await self._extended_auth_exchange(c, auth_method)
+            if auth_result is None:
+                return  # exchange failed; connection already closed
+        else:
+            auth_result = await broker.auth.auth(AuthData(
+                client_id=c.client_id, protocol_level=c.protocol_level,
+                username=c.username, password=c.password,
+                remote_addr=str(peer)))
         if not auth_result.ok:
             rc = (ReasonCode.NOT_AUTHORIZED if v5
                   else CONNACK_REFUSED_NOT_AUTHORIZED)
@@ -173,6 +258,17 @@ class Connection:
             return
 
         tenant_id = auth_result.tenant_id
+        # TotalConnections quota (≈ MQTTConnectHandler.java:134-146)
+        from ..plugin.throttler import TenantResourceType
+        if not broker.throttler.has_resource(
+                tenant_id, TenantResourceType.TOTAL_CONNECTIONS):
+            rc = ReasonCode.QUOTA_EXCEEDED if v5 else 3
+            await self.send(pk.Connack(reason_code=rc))
+            broker.events.report(Event(
+                EventType.OUT_OF_TENANT_RESOURCE, tenant_id,
+                {"resource": "total_connections"}))
+            await self.close_transport()
+            return
         settings = TenantSettings.resolve(broker.settings, tenant_id)
         enabled = {3: Setting.MQTT3Enabled, 4: Setting.MQTT4Enabled,
                    5: Setting.MQTT5Enabled}[c.protocol_level]
@@ -239,7 +335,9 @@ class Connection:
             local_registry=broker.local_sessions,
             session_registry=broker.session_registry,
             connect_props=c.properties,
-            retain_service=broker.retain_service)
+            retain_service=broker.retain_service,
+            throttler=broker.throttler,
+            auth_method=getattr(self, "auth_method", None))
         if persistent:
             from .persistent import PersistentSession
             session = PersistentSession(inbox=broker.inbox,
@@ -277,6 +375,12 @@ class Connection:
                 props[PropertyId.ASSIGNED_CLIENT_IDENTIFIER] = assigned
             if server_keep_alive is not None:
                 props[PropertyId.SERVER_KEEP_ALIVE] = server_keep_alive
+            if getattr(self, "auth_method", None) is not None:
+                # [MQTT-4.12]: CONNACK echoes the method (+ final proof)
+                props[PropertyId.AUTHENTICATION_METHOD] = self.auth_method
+                if getattr(self, "auth_success_data", b""):
+                    props[PropertyId.AUTHENTICATION_DATA] = \
+                        self.auth_success_data
         session_present = bool(getattr(session, "session_present", False)
                                and not c.clean_start)
         await self.send(pk.Connack(session_present=session_present,
@@ -296,10 +400,18 @@ class MQTTBroker:
                  events: Optional[IEventCollector] = None,
                  dist: Optional[DistService] = None,
                  retain_service=None, inbox_engine=None,
-                 ssl_context=None) -> None:
+                 ssl_context=None, throttler=None,
+                 tls_port: Optional[int] = None, tls_ssl_context=None,
+                 ws_port: Optional[int] = None,
+                 ws_path: str = "/mqtt", ws_ssl_context=None) -> None:
         self.host = host
         self.port = port
         self.ssl_context = ssl_context  # TLS listener (≈ 8883/netty-tcnative)
+        self.tls_port = tls_port        # additional TLS listener (8883)
+        self.tls_ssl_context = tls_ssl_context
+        self.ws_port = ws_port          # WS listener (≈ MqttOverWSHandler)
+        self.ws_path = ws_path
+        self.ws_ssl_context = ws_ssl_context
         # stable broker-instance id: scopes this broker's transient routes in
         # the shared route table (deliverer-key prefix), so a startup sweep
         # can purge ITS stale routes without touching other frontends'
@@ -313,6 +425,8 @@ class MQTTBroker:
             else:
                 self.server_id = sid.decode()
         self.auth = auth or AllowAllAuthProvider()
+        from ..plugin.throttler import AllowAllResourceThrottler
+        self.throttler = throttler or AllowAllResourceThrottler()
         self.settings = settings or DefaultSettingProvider()
         self.events = events or CollectingEventCollector()
         self.local_sessions = LocalSessionRegistry()
@@ -346,6 +460,8 @@ class MQTTBroker:
                                   engine=inbox_engine)
         self.sub_brokers.register(InboxSubBroker(self.inbox))
         self._server: Optional[asyncio.AbstractServer] = None
+        self._tls_server: Optional[asyncio.AbstractServer] = None
+        self._ws_server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> None:
         await self.dist.start()
@@ -366,10 +482,27 @@ class MQTTBroker:
         addr = self._server.sockets[0].getsockname()
         self.port = addr[1]
         log.info("mqtt broker listening on %s:%s", *addr[:2])
+        if self.tls_port is not None:
+            self._tls_server = await asyncio.start_server(
+                self._on_client, self.host, self.tls_port,
+                ssl=self.tls_ssl_context)
+            self.tls_port = self._tls_server.sockets[0].getsockname()[1]
+            log.info("mqtts listening on %s:%s", self.host, self.tls_port)
+        if self.ws_port is not None:
+            self._ws_server = await asyncio.start_server(
+                self._on_ws_client, self.host, self.ws_port,
+                ssl=self.ws_ssl_context)
+            self.ws_port = self._ws_server.sockets[0].getsockname()[1]
+            log.info("mqtt-over-ws listening on %s:%s%s", self.host,
+                     self.ws_port, self.ws_path)
 
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
+        if self._tls_server is not None:
+            self._tls_server.close()
+        if self._ws_server is not None:
+            self._ws_server.close()
         # close lingering sessions: wait_closed() (py3.12+) blocks until every
         # client handler returns, so orphaned connections must be torn down
         for sid in list(self.local_sessions._by_id):
@@ -387,4 +520,14 @@ class MQTTBroker:
     async def _on_client(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter) -> None:
         conn = Connection(self, reader, writer)
+        await conn.run()
+
+    async def _on_ws_client(self, reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter) -> None:
+        from . import ws
+        if not await ws.server_handshake(reader, writer, self.ws_path):
+            writer.close()
+            return
+        stream = ws.server_stream(reader, writer)
+        conn = Connection(self, stream, stream)
         await conn.run()

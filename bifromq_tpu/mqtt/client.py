@@ -28,10 +28,14 @@ class MQTTClient:
                  password: Optional[bytes] = None,
                  will: Optional[pk.Will] = None,
                  properties: Optional[dict] = None,
-                 ssl_context=None) -> None:
+                 ssl_context=None, ws_path: Optional[str] = None,
+                 auth_handler=None) -> None:
         self.host = host
         self.port = port
         self.ssl_context = ssl_context
+        self.ws_path = ws_path  # MQTT-over-WebSocket when set
+        # enhanced-auth responder: fn(server_data: bytes) -> bytes (MQTT5)
+        self.auth_handler = auth_handler
         self.client_id = client_id
         self.protocol_level = protocol_level
         self.clean_start = clean_start
@@ -54,8 +58,14 @@ class MQTTClient:
     # ---------------- lifecycle -------------------------------------------
 
     async def connect(self, timeout: float = 5.0) -> pk.Connack:
-        self._reader, self._writer = await asyncio.open_connection(
-            self.host, self.port, ssl=self.ssl_context)
+        if self.ws_path is not None:
+            from .ws import connect_ws
+            stream = await connect_ws(self.host, self.port, self.ws_path,
+                                      ssl_context=self.ssl_context)
+            self._reader = self._writer = stream
+        else:
+            self._reader, self._writer = await asyncio.open_connection(
+                self.host, self.port, ssl=self.ssl_context)
         await self._send(pk.Connect(
             client_id=self.client_id, protocol_level=self.protocol_level,
             clean_start=self.clean_start, keep_alive=self.keep_alive,
@@ -185,6 +195,17 @@ class MQTTClient:
         if fut is not None and not fut.done():
             fut.set_result(value)
 
+    async def reauthenticate(self, method: str, data: bytes = b"",
+                             timeout: float = 5.0) -> "pk.Auth":
+        """MQTT5 re-auth: send AUTH 0x19 and run the exchange until the
+        server answers AUTH SUCCESS (returned) or disconnects."""
+        from .protocol import PropertyId as PID
+        fut = self._expect("auth", 0)
+        await self._send(pk.Auth(reason_code=0x19, properties={
+            PID.AUTHENTICATION_METHOD: method,
+            PID.AUTHENTICATION_DATA: data}))
+        return await asyncio.wait_for(fut, timeout)
+
     async def _read_loop(self) -> None:
         try:
             while True:
@@ -226,6 +247,29 @@ class MQTTClient:
             self._resolve("unsuback", p.packet_id, p)
         elif isinstance(p, pk.PingResp):
             self._resolve("pingresp", 0, p)
+        elif isinstance(p, pk.Auth):
+            from .protocol import PropertyId as PID
+            props = p.properties or {}
+            if p.reason_code == 0x18 and self.auth_handler is None:
+                # mid-exchange CONTINUE with nobody to answer it: surface the
+                # error instead of resolving reauthenticate() prematurely
+                fut = self._pending.pop(("auth", 0), None)
+                if fut is not None and not fut.done():
+                    fut.set_exception(MQTTClientError(
+                        "server requested auth continuation but no "
+                        "auth_handler is set"))
+            elif (p.reason_code == 0x18  # CONTINUE_AUTHENTICATION
+                    and self.auth_handler is not None):
+                out = self.auth_handler(props.get(
+                    PID.AUTHENTICATION_DATA, b""))
+                await self._send(pk.Auth(
+                    reason_code=0x18,
+                    properties={
+                        PID.AUTHENTICATION_METHOD:
+                            props.get(PID.AUTHENTICATION_METHOD, ""),
+                        PID.AUTHENTICATION_DATA: out}))
+            else:
+                self._resolve("auth", 0, p)
         elif isinstance(p, pk.Disconnect):
             self.disconnect_packet = p
             await self._teardown()

@@ -134,6 +134,11 @@ class PersistentSession(Session):
                     if self.protocol_level >= PROTOCOL_MQTT5 else 0x80)
         return code
 
+    @property
+    def _NORMAL_SUB_RESOURCE(self):
+        from ..plugin.throttler import TenantResourceType
+        return TenantResourceType.TOTAL_PERSISTENT_SUBSCRIPTIONS
+
     async def _route(self, sub: Subscription) -> None:
         pass  # inbox.sub (in _subscribe_one) registers the inbox route
 

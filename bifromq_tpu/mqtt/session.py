@@ -171,7 +171,8 @@ class Session:
                  local_registry: LocalSessionRegistry,
                  session_registry: SessionRegistry,
                  connect_props: Optional[dict] = None,
-                 retain_service=None) -> None:
+                 retain_service=None, throttler=None,
+                 auth_method: Optional[str] = None) -> None:
         self.conn = conn
         self.client_id = client_id
         self.client_info = client_info
@@ -186,6 +187,10 @@ class Session:
         self.local_registry = local_registry
         self.session_registry = session_registry
         self.retain_service = retain_service
+        from ..plugin.throttler import AllowAllResourceThrottler
+        self.throttler = throttler or AllowAllResourceThrottler()
+        self.auth_method = auth_method  # enhanced-auth method (MQTT5)
+        self._reauth_pending = False
         self.connect_props = connect_props or {}
 
         self.session_id = uuid.uuid4().hex
@@ -273,10 +278,64 @@ class Session:
                 self._will_suppressed = True
                 await self.close(fire_will=False)
         elif isinstance(packet, pk.Auth):
-            # re-auth flow is delegated to the auth provider in later rounds
-            await self.conn.protocol_error("unexpected AUTH")
+            await self._on_auth(packet)
         else:
             await self.conn.protocol_error(f"unexpected {type(packet).__name__}")
+
+    def _sub_resource(self, tf: str):
+        from ..plugin.throttler import TenantResourceType
+        if topic_util.is_shared_subscription(tf):
+            return TenantResourceType.TOTAL_SHARED_SUBSCRIPTIONS
+        return self._NORMAL_SUB_RESOURCE
+
+    # persistent sessions override with TOTAL_PERSISTENT_SUBSCRIPTIONS
+    @property
+    def _NORMAL_SUB_RESOURCE(self):
+        from ..plugin.throttler import TenantResourceType
+        return TenantResourceType.TOTAL_TRANSIENT_SUBSCRIPTIONS
+
+    # -------- MQTT5 enhanced re-auth (≈ ReAuthenticator.java) --------------
+
+    async def _on_auth(self, a: pk.Auth) -> None:
+        from ..plugin.auth import ExtAuthData
+
+        if self.protocol_level < PROTOCOL_MQTT5 or self.auth_method is None:
+            await self.conn.protocol_error("unexpected AUTH")
+            return
+        props = a.properties or {}
+        method = props.get(PropertyId.AUTHENTICATION_METHOD)
+        if method != self.auth_method:
+            # [MQTT-4.12.0-5] method must not change mid-connection
+            await self.conn.protocol_error(
+                "auth method changed", ReasonCode.BAD_AUTHENTICATION_METHOD)
+            return
+        if a.reason_code == ReasonCode.REAUTHENTICATE:
+            self._reauth_pending = True
+        elif not self._reauth_pending:
+            await self.conn.protocol_error("unexpected AUTH")
+            return
+        res = await self.auth.extended_auth(ExtAuthData(
+            client_id=self.client_id, method=method,
+            data=props.get(PropertyId.AUTHENTICATION_DATA, b""),
+            is_reauth=True))
+        if res.kind == "fail":
+            self.events.report(Event(EventType.CONNECT_REJECTED,
+                                     self.client_info.tenant_id,
+                                     {"reason": f"re-auth: {res.reason}"}))
+            await self.conn.protocol_error("re-authentication failed",
+                                           ReasonCode.NOT_AUTHORIZED)
+            return
+        out_props = {PropertyId.AUTHENTICATION_METHOD: method}
+        if res.data:
+            out_props[PropertyId.AUTHENTICATION_DATA] = res.data
+        if res.kind == "continue":
+            await self.conn.send(pk.Auth(
+                reason_code=ReasonCode.CONTINUE_AUTHENTICATION,
+                properties=out_props))
+            return
+        self._reauth_pending = False
+        await self.conn.send(pk.Auth(reason_code=ReasonCode.SUCCESS,
+                                     properties=out_props))
 
     # -------- PUBLISH ingress (≈ MQTTSessionHandler.handleQoS{0,1,2}Pub) ---
 
@@ -298,6 +357,23 @@ class Session:
         if len(p.payload) > ts[Setting.MaxUserPayloadBytes]:
             await self.conn.protocol_error(
                 "payload too large", ReasonCode.PACKET_TOO_LARGE)
+            return
+        from ..plugin.throttler import TenantResourceType
+        if not self.throttler.has_resource(
+                self.client_info.tenant_id,
+                TenantResourceType.TOTAL_INGRESS_BYTES_PER_SECOND):
+            self.events.report(Event(EventType.OUT_OF_TENANT_RESOURCE,
+                                     self.client_info.tenant_id,
+                                     {"topic": topic,
+                                      "resource": "ingress_bytes"}))
+            if p.qos == 1:
+                await self.conn.send(pk.PubAck(
+                    packet_id=p.packet_id,
+                    reason_code=ReasonCode.QUOTA_EXCEEDED))
+            elif p.qos == 2:
+                await self.conn.send(pk.PubRec(
+                    packet_id=p.packet_id,
+                    reason_code=ReasonCode.QUOTA_EXCEEDED))
             return
         allowed = await self.auth.check_permission(
             self.client_info, MQTTAction.PUB, topic)
@@ -428,6 +504,12 @@ class Session:
                 return ReasonCode.PROTOCOL_ERROR
         if len(self.subscriptions) >= ts[Setting.MaxTopicFiltersPerInbox] \
                 and tf not in self.subscriptions:
+            return ReasonCode.QUOTA_EXCEEDED if v5 else 0x80
+        if not self.throttler.has_resource(self.client_info.tenant_id,
+                                           self._sub_resource(tf)):
+            self.events.report(Event(EventType.OUT_OF_TENANT_RESOURCE,
+                                     self.client_info.tenant_id,
+                                     {"filter": tf, "resource": "sub"}))
             return ReasonCode.QUOTA_EXCEEDED if v5 else 0x80
         allowed = await self.auth.check_permission(
             self.client_info, MQTTAction.SUB, tf)

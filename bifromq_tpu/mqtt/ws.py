@@ -1,0 +1,228 @@
+"""MQTT over WebSocket (RFC 6455): handshake + frame-codec stream shims.
+
+Fills the reference's WS/WSS listener role (bifromq-mqtt
+.../handler/ws/MqttOverWSHandler.java + MQTTBroker.java ws listeners):
+an HTTP upgrade with the ``mqtt`` subprotocol, then MQTT packets ride
+binary WS frames. The stream classes duck-type the small surface
+``Connection`` uses (read/write/drain/close/get_extra_info), so the whole
+MQTT session stack runs unchanged over WS.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import os
+import struct
+from typing import Optional, Tuple
+
+_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+OP_CONT = 0x0
+OP_TEXT = 0x1
+OP_BINARY = 0x2
+OP_CLOSE = 0x8
+OP_PING = 0x9
+OP_PONG = 0xA
+
+
+def _accept_key(key: str) -> str:
+    return base64.b64encode(
+        hashlib.sha1((key + _GUID).encode()).digest()).decode()
+
+
+async def _read_http_head(reader: asyncio.StreamReader) -> Tuple[str, dict]:
+    head = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), 10)
+    lines = head.decode("latin1").split("\r\n")
+    headers = {}
+    for line in lines[1:]:
+        if ":" in line:
+            k, v = line.split(":", 1)
+            headers[k.strip().lower()] = v.strip()
+    return lines[0], headers
+
+
+async def server_handshake(reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter,
+                           path: str = "/mqtt") -> bool:
+    """Answer the HTTP upgrade; returns False (connection refused) on a bad
+    request. Negotiates the ``mqtt`` subprotocol when offered."""
+    try:
+        request, headers = await _read_http_head(reader)
+    except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+            asyncio.LimitOverrunError):
+        return False
+    parts = request.split()
+    if (len(parts) < 2 or parts[0] != "GET"
+            or parts[1].split("?")[0] != path
+            or headers.get("upgrade", "").lower() != "websocket"
+            or "sec-websocket-key" not in headers):
+        writer.write(b"HTTP/1.1 400 Bad Request\r\n\r\n")
+        await writer.drain()
+        return False
+    resp = ["HTTP/1.1 101 Switching Protocols",
+            "Upgrade: websocket",
+            "Connection: Upgrade",
+            f"Sec-WebSocket-Accept: {_accept_key(headers['sec-websocket-key'])}"]
+    offered = [p.strip() for p in
+               headers.get("sec-websocket-protocol", "").split(",") if p]
+    if "mqtt" in offered:
+        resp.append("Sec-WebSocket-Protocol: mqtt")
+    writer.write(("\r\n".join(resp) + "\r\n\r\n").encode())
+    await writer.drain()
+    return True
+
+
+async def client_handshake(reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter, host: str,
+                           path: str = "/mqtt") -> None:
+    key = base64.b64encode(os.urandom(16)).decode()
+    req = (f"GET {path} HTTP/1.1\r\nHost: {host}\r\n"
+           "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+           f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n"
+           "Sec-WebSocket-Protocol: mqtt\r\n\r\n")
+    writer.write(req.encode())
+    await writer.drain()
+    status, headers = await _read_http_head(reader)
+    if " 101 " not in status + " ":
+        raise ConnectionError(f"ws upgrade refused: {status}")
+    if headers.get("sec-websocket-accept") != _accept_key(key):
+        raise ConnectionError("bad Sec-WebSocket-Accept")
+
+
+def _encode_frame(opcode: int, payload: bytes, mask: bool) -> bytes:
+    out = bytearray([0x80 | opcode])
+    n = len(payload)
+    mbit = 0x80 if mask else 0
+    if n < 126:
+        out.append(mbit | n)
+    elif n < 65536:
+        out.append(mbit | 126)
+        out += struct.pack(">H", n)
+    else:
+        out.append(mbit | 127)
+        out += struct.pack(">Q", n)
+    if mask:
+        mk = os.urandom(4)
+        out += mk
+        out += bytes(b ^ mk[i % 4] for i, b in enumerate(payload))
+    else:
+        out += payload
+    return bytes(out)
+
+
+class _WSStream:
+    """Bidirectional WS data stream over (reader, writer).
+
+    ``read()`` returns the next data payload (handling ping/pong/close and
+    fragmentation); ``write()`` queues a single binary frame.
+    ``max_payload`` bounds a frame AND an assembled fragment sequence — the
+    MQTT decoder's own packet cap sits behind this, so an attacker cannot
+    buffer unbounded data at the WS layer.
+    """
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter, *, mask_out: bool,
+                 max_payload: int = 1 << 20) -> None:
+        self._reader = reader
+        self._writer = writer
+        self._mask_out = mask_out
+        self._max_payload = max_payload
+        self._closed = False
+        self._frag = bytearray()
+
+    # ---- reader duck-type -------------------------------------------------
+
+    async def read(self, _n: int = -1) -> bytes:
+        """Next complete data payload; b'' on close (matches StreamReader
+        EOF convention used by the connection loop)."""
+        while True:
+            if self._closed:
+                return b""
+            try:
+                hdr = await self._reader.readexactly(2)
+            except (asyncio.IncompleteReadError, ConnectionError):
+                return b""
+            fin = bool(hdr[0] & 0x80)
+            opcode = hdr[0] & 0x0F
+            masked = bool(hdr[1] & 0x80)
+            n = hdr[1] & 0x7F
+            try:
+                if n == 126:
+                    n = struct.unpack(">H",
+                                      await self._reader.readexactly(2))[0]
+                elif n == 127:
+                    n = struct.unpack(">Q",
+                                      await self._reader.readexactly(8))[0]
+                if n + len(self._frag) > self._max_payload:
+                    self.close()  # oversized frame: refuse to buffer it
+                    return b""
+                mk = await self._reader.readexactly(4) if masked else None
+                payload = await self._reader.readexactly(n) if n else b""
+            except (asyncio.IncompleteReadError, ConnectionError):
+                return b""
+            if mk:
+                payload = bytes(b ^ mk[i % 4]
+                                for i, b in enumerate(payload))
+            if opcode == OP_PING:
+                self.write_frame(OP_PONG, payload)
+                continue
+            if opcode == OP_PONG:
+                continue
+            if opcode == OP_CLOSE:
+                self.write_frame(OP_CLOSE, payload)
+                self._closed = True
+                return b""
+            if opcode in (OP_BINARY, OP_TEXT, OP_CONT):
+                self._frag += payload
+                if fin:
+                    out = bytes(self._frag)
+                    self._frag.clear()
+                    if out:
+                        return out
+                continue
+
+    # ---- writer duck-type -------------------------------------------------
+
+    def write_frame(self, opcode: int, payload: bytes) -> None:
+        if not self._writer.is_closing():
+            self._writer.write(_encode_frame(opcode, payload,
+                                             self._mask_out))
+
+    def write(self, data: bytes) -> None:
+        self.write_frame(OP_BINARY, data)
+
+    async def drain(self) -> None:
+        await self._writer.drain()
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            try:
+                self.write_frame(OP_CLOSE, b"")
+            except Exception:  # noqa: BLE001
+                pass
+        self._writer.close()
+
+    def is_closing(self) -> bool:
+        return self._writer.is_closing()
+
+    def get_extra_info(self, name: str):
+        return self._writer.get_extra_info(name)
+
+
+def server_stream(reader, writer) -> "_WSStream":
+    return _WSStream(reader, writer, mask_out=False)
+
+
+def client_stream(reader, writer) -> "_WSStream":
+    return _WSStream(reader, writer, mask_out=True)
+
+
+async def connect_ws(host: str, port: int, path: str = "/mqtt",
+                     ssl_context=None) -> _WSStream:
+    reader, writer = await asyncio.open_connection(host, port,
+                                                   ssl=ssl_context)
+    await client_handshake(reader, writer, host, path)
+    return client_stream(reader, writer)

@@ -51,11 +51,56 @@ class AuthResult:
         return AuthResult(ok=False, reason=reason)
 
 
+@dataclass(frozen=True)
+class ExtAuthData:
+    """One step of an MQTT5 enhanced-auth exchange (AUTH packets)."""
+    client_id: str
+    method: str
+    data: bytes
+    is_reauth: bool = False
+    remote_addr: str = ""
+
+
+@dataclass(frozen=True)
+class ExtAuthResult:
+    """CONTINUE sends an AUTH challenge back; SUCCESS completes the
+    exchange (tenant/user as in AuthResult); FAIL rejects."""
+    kind: str            # "continue" | "success" | "fail"
+    data: bytes = b""    # server-to-client auth data for continue/success
+    tenant_id: str = ""
+    user_id: str = ""
+    reason: str = ""
+    # fail flavor: True = method unsupported (CONNACK 0x8C), False =
+    # credentials rejected (CONNACK 0x87) — distinct MQTT5 reason codes
+    bad_method: bool = False
+
+    @staticmethod
+    def cont(data: bytes = b"") -> "ExtAuthResult":
+        return ExtAuthResult(kind="continue", data=data)
+
+    @staticmethod
+    def success(tenant_id: str, user_id: str,
+                data: bytes = b"") -> "ExtAuthResult":
+        return ExtAuthResult(kind="success", tenant_id=tenant_id,
+                             user_id=user_id, data=data)
+
+    @staticmethod
+    def fail(reason: str, *, bad_method: bool = False) -> "ExtAuthResult":
+        return ExtAuthResult(kind="fail", reason=reason,
+                             bad_method=bad_method)
+
+
 class IAuthProvider:
     """Override ``auth`` and ``check_permission``; both may be async-free."""
 
     async def auth(self, data: AuthData) -> AuthResult:
         raise NotImplementedError
+
+    async def extended_auth(self, data: ExtAuthData) -> ExtAuthResult:
+        """MQTT5 enhanced auth step (≈ MQTT5 enhanced-auth SPI backing
+        ReAuthenticator.java). Default: method unsupported."""
+        return ExtAuthResult.fail(f"auth method {data.method!r} unsupported",
+                                  bad_method=True)
 
     async def check_permission(self, client: ClientInfo, action: MQTTAction,
                                topic: str) -> bool:

@@ -39,6 +39,8 @@ class EventType(enum.Enum):
     RETAIN_MSG_CLEARED = "retain_msg_cleared"
     MSG_RETAINED = "msg_retained"
     RETAIN_ERROR = "retain_error"
+    # resource throttling (≈ OutOfTenantResource event family)
+    OUT_OF_TENANT_RESOURCE = "out_of_tenant_resource"
     # inbox family
     OVERFLOWED = "overflowed"
     MSG_FETCHED = "msg_fetched"

@@ -1,0 +1,141 @@
+"""Standalone boot assembly (≈ build-bifromq-starter StandaloneStarter).
+
+``python -m bifromq_tpu --config conf.yml`` parses the YAML config tree,
+consolidates defaults (≈ StandaloneConfigConsolidator), assembles the
+enabled services (mqtt listeners incl. TLS/WS, API server, durable engine,
+cluster membership), and runs until SIGINT — the role of
+StandaloneStarter.java:87 + ServiceBootstrapper.java:39.
+
+Config shape (all keys optional):
+
+    mqtt:
+      host: 0.0.0.0
+      tcp: {port: 1883}
+      tls: {port: 8883, cert: server.pem, key: server.key}
+      ws:  {port: 8080, path: /mqtt}
+    api: {port: 9090}
+    data_dir: /var/lib/bifromq-tpu       # durable engine when set
+    cluster:
+      node_id: node1
+      port: 7946
+      seeds: ["10.0.0.1:7946"]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import ssl as ssl_mod
+from typing import Optional
+
+log = logging.getLogger("bifromq_tpu.starter")
+
+
+def load_config(path: Optional[str]) -> dict:
+    if not path:
+        return {}
+    import yaml
+    with open(path) as f:
+        return yaml.safe_load(f) or {}
+
+
+def _tls_context(cfg: dict):
+    ctx = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cfg["cert"], cfg.get("key"))
+    return ctx
+
+
+class Standalone:
+    """Assembled standalone broker node."""
+
+    def __init__(self, config: dict) -> None:
+        self.config = config
+        self.broker = None
+        self.api = None
+        self.agent_host = None
+
+    async def start(self) -> None:
+        from .mqtt.broker import MQTTBroker
+
+        cfg = self.config
+        mqtt_cfg = cfg.get("mqtt", {})
+        host = mqtt_cfg.get("host", "127.0.0.1")
+        engine = None
+        if cfg.get("data_dir"):
+            from .kv.native import NativeKVEngine
+            engine = NativeKVEngine(cfg["data_dir"])
+
+        cluster_cfg = cfg.get("cluster")
+        if cluster_cfg:
+            from .cluster.membership import AgentHost
+            seeds = []
+            for s in cluster_cfg.get("seeds", []):
+                h, p = str(s).rsplit(":", 1)
+                seeds.append((h, int(p)))
+            self.agent_host = AgentHost(
+                cluster_cfg.get("node_id", "node"),
+                host=host, port=int(cluster_cfg.get("port", 0)),
+                seeds=seeds)
+            await self.agent_host.start()
+
+        tcp = mqtt_cfg.get("tcp", {"port": 1883})
+        tls = mqtt_cfg.get("tls")
+        ws = mqtt_cfg.get("ws")
+        self.broker = MQTTBroker(
+            host=host, port=int(tcp.get("port", 1883)),
+            inbox_engine=engine,
+            tls_port=(int(tls.get("port", 8883)) if tls else None),
+            tls_ssl_context=(_tls_context(tls) if tls else None),
+            ws_port=(int(ws["port"]) if ws else None),
+            ws_path=(ws.get("path", "/mqtt") if ws else "/mqtt"))
+        await self.broker.start()
+
+        api_cfg = cfg.get("api")
+        if api_cfg:
+            from .apiserver.server import APIServer
+            from .utils.metrics import MetricsRegistry
+            self.api = APIServer(self.broker,
+                                 metrics=MetricsRegistry(),
+                                 host=host,
+                                 port=int(api_cfg.get("port", 9090)))
+            await self.api.start()
+        log.info("standalone up: mqtt=%s:%s%s%s", host, self.broker.port,
+                 f" ws={self.broker.ws_port}" if ws else "",
+                 f" api={self.api.port}" if self.api else "")
+
+    async def stop(self) -> None:
+        if self.api is not None:
+            await self.api.stop()
+        if self.broker is not None:
+            await self.broker.stop()
+        if self.agent_host is not None:
+            await self.agent_host.stop()
+
+
+async def run(config: dict) -> None:
+    node = Standalone(config)
+    await node.start()
+    stop_ev = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop_ev.set)
+        except NotImplementedError:
+            pass
+    try:
+        await stop_ev.wait()
+    finally:
+        await node.stop()
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="bifromq_tpu",
+                                description="TPU-native MQTT broker")
+    p.add_argument("--config", "-c", default=None, help="YAML config path")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    asyncio.run(run(load_config(args.config)))
