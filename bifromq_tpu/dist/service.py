@@ -56,15 +56,14 @@ class DistService:
                  event_collector: IEventCollector,
                  setting_provider: ISettingProvider, *,
                  worker=None,
-                 matcher: Optional[TpuMatcher] = None,
                  max_burst_latency: float = 0.005,
                  rng_seed: Optional[int] = None) -> None:
         self.sub_brokers = sub_brokers
         self.events = event_collector
         self.settings = setting_provider
         if worker is None:
-            from .worker import DistWorker, DistWorkerCoProc
-            worker = DistWorker(coproc=DistWorkerCoProc(matcher))
+            from .worker import DistWorker
+            worker = DistWorker()
         self.worker = worker
         self._rng = random.Random(rng_seed)
         self._pub_scheduler: BatchCallScheduler[PubCall, PubResult] = \

@@ -94,6 +94,12 @@ class DistWorkerCoProc(IKVRangeCoProc):
 
     def __init__(self, matcher: Optional[TpuMatcher] = None) -> None:
         self.matcher = matcher or TpuMatcher()
+        # (start, end) enforced at APPLY time by the hosting store: a split
+        # committed between a client's range resolution and this entry's
+        # apply moves the key out of this range — the mutation must bounce
+        # (b"retry") so the caller re-resolves, never landing a key outside
+        # the boundary (≈ KVRangeFSM boundary check on command apply)
+        self.boundary = None
 
     # ---------------- RW (≈ batchAddRoute / batchRemoveRoute) --------------
 
@@ -101,6 +107,10 @@ class DistWorkerCoProc(IKVRangeCoProc):
                writer: KVWriteBatch) -> bytes:
         op = input_data[0]
         key, pos = _read_frame(input_data, 1)
+        if self.boundary is not None:
+            start, end = self.boundary
+            if key < start or (end is not None and key >= end):
+                return b"retry"
         value, pos = _read_frame(input_data, pos)
         tenant_id = _tenant_of_key(key)  # single source of truth: the key
         route = schema.decode_route(tenant_id, key, value)
@@ -169,66 +179,94 @@ class DistWorkerCoProc(IKVRangeCoProc):
 
 
 class DistWorker:
-    """Hosts the dist route-table range replica and serves the broker's dist
-    plane from it (≈ dist-worker role: DistWorker.java:48 hosting
-    DistWorkerCoProc on a BaseKVStoreServer range).
+    """Hosts the dist route table on a multi-range replicated KV store and
+    serves the broker's dist plane from it (≈ dist-worker role:
+    DistWorker.java:48 hosting DistWorkerCoProc ranges on a
+    BaseKVStoreServer, with split-driven elasticity).
 
-    There is ONE route table and it lives on the replicated KV: mutations go
-    through consensus (``ReplicatedKVRange.mutate_coproc`` → coproc
-    incarnation-guarded apply on every replica), matches are served from this
-    replica's derived TpuMatcher (the reference's replica-spread reads —
-    BatchDistServerCall.replicaSelect:245 picks any query-ready replica).
+    There is ONE route table and it lives on the replicated KV: mutations
+    go through consensus on the range covering the route key (the route
+    keyspace is order-preserving, so ranges split by key boundary —
+    ``KVRangeStore``); matches union this replica's derived TpuMatchers
+    across every range intersecting the tenant's keyspace (the reference's
+    per-tenant boundary intersect in batchDist:515).
 
-    Defaults give a single-voter in-process deployment (the standalone
-    broker); multi-voter clusters share a transport and tick externally or
-    via each worker's tick loop.
+    Defaults give a single-voter, single-range in-process deployment (the
+    standalone broker); a ``KVStoreBalanceController`` may split ranges as
+    they grow.
     """
 
     def __init__(self, *, node_id: str = "local",
                  voters: Optional[List[str]] = None,
-                 transport=None, space: Optional[IKVSpace] = None,
-                 coproc: Optional[DistWorkerCoProc] = None,
-                 raft_store=None,
-                 tick_interval: float = 0.01) -> None:
+                 transport=None, engine=None,
+                 raft_store_factory=None,
+                 tick_interval: float = 0.01,
+                 split_threshold: Optional[int] = None) -> None:
         from ..kv.engine import InMemKVEngine
+        from ..kv.store import KVRangeStore
         from ..raft.transport import InMemTransport
 
-        self.transport = transport if transport is not None else InMemTransport()
-        self.space = (space if space is not None
-                      else InMemKVEngine().create_space("dist_routes"))
-        self.coproc = coproc or DistWorkerCoProc()
-        from ..kv.range import ReplicatedKVRange
-        self.range = ReplicatedKVRange("dist", node_id,
-                                       voters or [node_id],
-                                       self.transport, self.space,
-                                       coproc=self.coproc,
-                                       raft_store=raft_store)
-        if hasattr(self.transport, "register"):
-            self.transport.register(self.range.raft)
+        self.transport = (transport if transport is not None
+                          else InMemTransport())
+        self.engine = engine if engine is not None else InMemKVEngine()
+        self.store = KVRangeStore(
+            node_id, self.transport, self.engine,
+            coproc_factory=lambda rid: DistWorkerCoProc(),
+            member_nodes=voters or [node_id],
+            raft_store_factory=raft_store_factory)
         self.tick_interval = tick_interval
         self._tick_task = None
+        self.balance_controller = None
+        if split_threshold is not None:
+            from ..kv.balance import (KVStoreBalanceController,
+                                      RangeSplitBalancer)
+            self.balance_controller = KVStoreBalanceController(
+                self.store, [RangeSplitBalancer(max_keys=split_threshold)])
 
     @property
     def matcher(self) -> TpuMatcher:
-        return self.coproc.matcher
+        """Single-range introspection convenience; multi-range workers are
+        inspected via ``store.describe()`` / per-range coprocs."""
+        if len(self.store.ranges) != 1:
+            raise RuntimeError("multiple ranges; use store.coprocs")
+        return next(iter(self.store.coprocs.values())).matcher
+
+    @property
+    def space(self):
+        """Legacy single-range space accessor (tests/introspection)."""
+        if len(self.store.ranges) != 1:
+            raise RuntimeError("multiple ranges; use store.ranges")
+        return next(iter(self.store.ranges.values())).space
+
+    def _iter_all_routes(self):
+        for rid, r in self.store.ranges.items():
+            for key, value in r.space.iterate(
+                    schema.TAG_DIST, schema.prefix_end(schema.TAG_DIST)):
+                tenant_id = _tenant_of_key(key)
+                yield tenant_id, schema.decode_route(tenant_id, key, value)
 
     async def start(self) -> None:
-        """Recover derived state from the (possibly durable) route keyspace,
-        drive the initial election, and start the tick loop."""
+        """Open/recover the range set, drive initial elections, start the
+        tick loop (+ the balance controller when configured)."""
         import asyncio
 
-        self.coproc.reset(self.space)
+        self.store.open()
         from ..raft.node import Role
-        if len(self.range.raft.voters) == 1:
-            # standalone: elect deterministically without waiting wall-clock
+        if self.store.member_nodes == [self.store.node_id]:
+            # standalone: elect every range deterministically
             for _ in range(10_000):
-                if self.range.raft.role == Role.LEADER:
+                if all(r.raft.role == Role.LEADER
+                       for r in self.store.ranges.values()):
                     break
-                self.range.raft.tick()
+                self.store.tick()
                 self._pump()
         self._tick_task = asyncio.create_task(self._tick_loop())
+        if self.balance_controller is not None:
+            await self.balance_controller.start()
 
     async def stop(self) -> None:
+        if self.balance_controller is not None:
+            await self.balance_controller.stop()
         if self._tick_task is not None:
             self._tick_task.cancel()
             try:
@@ -236,7 +274,7 @@ class DistWorker:
             except BaseException:  # noqa: BLE001 — cancellation
                 pass
             self._tick_task = None
-        self.range.raft.stop()
+        self.store.stop()
 
     def _pump(self) -> None:
         pump = getattr(self.transport, "pump", None)
@@ -247,19 +285,18 @@ class DistWorker:
         import asyncio
 
         while True:
-            self.range.raft.tick()
+            self.store.tick()
             self._pump()
             await asyncio.sleep(self.tick_interval)
 
     # ---------------- dist plane API ---------------------------------------
 
-    async def _mutate(self, payload: bytes, *, timeout: float = 5.0) -> bytes:
-        """Propose with a bounded wait for leadership.
-
-        Covers the window before the initial election completes. A follower
-        replica keeps failing with NotLeaderError after the timeout — leader
-        forwarding arrives with the RPC fabric (multi-process deployment);
-        until then multi-voter workers must mutate via the leader."""
+    async def _mutate(self, key: bytes, payload: bytes, *,
+                      timeout: float = 5.0) -> bytes:
+        """Propose on the range covering ``key``, with a bounded wait for
+        leadership (covers the initial-election window; follower replicas
+        in multi-voter groups still raise after the timeout — leader
+        forwarding rides the RPC fabric)."""
         import asyncio
         import time as _time
 
@@ -267,31 +304,42 @@ class DistWorker:
 
         deadline = _time.monotonic() + timeout
         while True:
+            # re-resolve each attempt: a concurrent split may move the key
+            rng = self.store.range_for_key(key)
             try:
-                return await self.range.mutate_coproc(payload)
+                out = await rng.mutate_coproc(payload)
             except NotLeaderError:
                 if (_time.monotonic() >= deadline
-                        or self.range.raft.leader_id not in (
-                            None, self.range.raft.id)):
+                        or rng.raft.leader_id not in (None, rng.raft.id)):
                     raise
                 await asyncio.sleep(self.tick_interval)
+                continue
+            if out != b"retry":
+                return out
+            # a split moved the key out of this range between resolution
+            # and apply: route again against the updated router
+            if _time.monotonic() >= deadline:
+                raise TimeoutError("range resolution kept racing splits")
+            await asyncio.sleep(0)
 
     async def add_route(self, tenant_id: str, route: Route) -> str:
-        out = await self._mutate(encode_add_route(tenant_id, route))
+        key = schema.route_key(tenant_id, route.matcher, route.receiver_url)
+        out = await self._mutate(key, encode_add_route(tenant_id, route))
         return out.decode()
 
     async def remove_route(self, tenant_id: str, matcher: RouteMatcher,
                            receiver_url: Tuple[int, str, str],
                            incarnation: int = 0) -> str:
+        key = schema.route_key(tenant_id, matcher, receiver_url)
         out = await self._mutate(
-            encode_remove_route(tenant_id, matcher, receiver_url,
-                                incarnation))
+            key, encode_remove_route(tenant_id, matcher, receiver_url,
+                                     incarnation))
         return out.decode()
 
     async def purge_broker_routes(self, broker_id: int,
                                   deliverer_prefix: str = "") -> int:
         """Remove every route targeting ``broker_id`` receivers whose
-        deliverer key starts with ``deliverer_prefix``.
+        deliverer key starts with ``deliverer_prefix`` — across all ranges.
 
         Crash-recovery sweep: transient-session routes written through to a
         durable route keyspace must not resurrect after an unclean restart
@@ -299,29 +347,78 @@ class DistWorker:
         frontend instance's routes so co-tenant frontends sharing a worker
         are untouched. The reference reaps these via the dist GC +
         checkSubscriptions purge (DistWorkerCoProc.gc:554)."""
-        doomed = []
-        for key, value in self.space.iterate(
-                schema.TAG_DIST, schema.prefix_end(schema.TAG_DIST)):
-            tenant_id = _tenant_of_key(key)
-            route = schema.decode_route(tenant_id, key, value)
-            if route.broker_id == broker_id and \
-                    route.deliverer_key.startswith(deliverer_prefix):
-                doomed.append((tenant_id, route))
+        doomed = [(t, r) for t, r in self._iter_all_routes()
+                  if r.broker_id == broker_id
+                  and r.deliverer_key.startswith(deliverer_prefix)]
         for tenant_id, route in doomed:
-            await self._mutate(encode_remove_route(
+            key = schema.route_key(tenant_id, route.matcher,
+                                   route.receiver_url)
+            await self._mutate(key, encode_remove_route(
                 tenant_id, route.matcher, route.receiver_url,
                 route.incarnation))
         return len(doomed)
 
     async def match_batch(self, queries, *, max_persistent_fanout,
                           max_group_fanout, linearized: bool = False):
-        """Serve matches from this replica's derived matcher.
+        """Serve matches from this replica's derived matchers, unioning
+        across every range whose boundary intersects the query tenant's
+        keyspace (per-tenant boundary intersect ≈ batchDist:515).
 
-        ``linearized=True`` adds a read-index barrier (leader only); the pub
-        hot path uses the default local read, matching the reference's
-        non-linearized coproc query for dist."""
+        ``linearized=True`` adds a read-index barrier per touched range
+        (leader only); the pub hot path uses the default local read."""
+        from ..models.oracle import MatchedRoutes
+
+        from ..models.oracle import PERSISTENT_SUB_BROKER_ID
+
+        # resolve the range set per tenant once; each range walks ONLY the
+        # queries whose tenant keyspace intersects it
+        tenant_ranges = {}
+        for tenant_id, _levels in queries:
+            if tenant_id not in tenant_ranges:
+                pfx = schema.tenant_route_prefix(tenant_id)
+                tenant_ranges[tenant_id] = self.store.router.intersecting(
+                    pfx, schema.prefix_end(pfx))
+        range_queries = {}      # rid -> [query index]
+        for qi, (tenant_id, _levels) in enumerate(queries):
+            for rid in tenant_ranges[tenant_id]:
+                range_queries.setdefault(rid, []).append(qi)
         if linearized:
-            await self.range.raft.read_index()
-        return self.coproc.matcher.match_batch(
-            queries, max_persistent_fanout=max_persistent_fanout,
-            max_group_fanout=max_group_fanout)
+            for rid in range_queries:
+                await self.store.ranges[rid].raft.read_index()
+        per_query = {}          # (rid, qi) -> MatchedRoutes
+        for rid, idxs in range_queries.items():
+            sub = [queries[qi] for qi in idxs]
+            res = self.store.coprocs[rid].matcher.match_batch(
+                sub, max_persistent_fanout=max_persistent_fanout,
+                max_group_fanout=max_group_fanout)
+            for qi, m in zip(idxs, res):
+                per_query[(rid, qi)] = m
+        results = []
+        for qi, (tenant_id, _levels) in enumerate(queries):
+            rids = tenant_ranges[tenant_id]
+            if len(rids) == 1:
+                results.append(per_query[(rids[0], qi)])
+                continue
+            # union across ranges, then RE-APPLY the per-tenant caps — each
+            # range enforced them locally, the tenant limit is global
+            normal, groups = [], {}
+            for rid in rids:
+                m = per_query[(rid, qi)]
+                normal.extend(m.normal)
+                for f, members in m.groups.items():
+                    groups.setdefault(f, []).extend(members)
+            merged = MatchedRoutes()
+            for r in normal:
+                if r.broker_id == PERSISTENT_SUB_BROKER_ID:
+                    if merged.persistent_fanout >= max_persistent_fanout:
+                        merged.max_persistent_fanout_exceeded = True
+                        continue
+                    merged.persistent_fanout += 1
+                merged.normal.append(r)
+            for f, members in groups.items():
+                if len(merged.groups) >= max_group_fanout:
+                    merged.max_group_fanout_exceeded = True
+                    continue
+                merged.groups[f] = members
+            results.append(merged)
+        return results

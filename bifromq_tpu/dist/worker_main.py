@@ -30,14 +30,15 @@ async def serve(args) -> None:
     from .remote import DistWorkerRPCService
     from .worker import DistWorker
 
-    space = None
-    raft_store = None
+    engine = None
+    raft_store_factory = None
     if args.data_dir:
         engine = NativeKVEngine(args.data_dir)
-        space = engine.create_space("dist_routes")
-        raft_store = KVRaftStateStore(engine.create_space("dist_raft"))
-    worker = DistWorker(node_id=args.node_id, space=space,
-                        raft_store=raft_store)
+
+        def raft_store_factory(rid, _eng=engine):
+            return KVRaftStateStore(_eng.create_space(f"raft_{rid}"))
+    worker = DistWorker(node_id=args.node_id, engine=engine,
+                        raft_store_factory=raft_store_factory)
     await worker.start()
     server = RPCServer(host=args.host, port=args.port)
     DistWorkerRPCService(worker).register(server)

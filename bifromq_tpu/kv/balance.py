@@ -1,0 +1,102 @@
+"""Balance controller + balancers (≈ base-kv-store-balance-controller).
+
+``KVStoreBalanceController`` periodically evaluates pluggable balancers
+against its own store and executes the commands they emit — the
+decentralized placement loop of KVStoreBalanceController.java:85
+(balance():303). First balancer: ``RangeSplitBalancer``
+(≈ balance/impl/RangeSplitBalancer.java fed by split hinters): splits any
+leader range whose keyspace outgrew ``max_keys`` at its median key, which
+keeps the per-range compiled automatons bounded — the TPU analog of
+keeping per-range scan cost flat (FanoutSplitHinter's goal).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import List, Optional
+
+from .store import KVRangeStore
+
+log = logging.getLogger(__name__)
+
+
+class SplitCommand:
+    def __init__(self, range_id: str, split_key: bytes) -> None:
+        self.range_id = range_id
+        self.split_key = split_key
+
+    def __repr__(self) -> str:
+        return f"Split({self.range_id} @ {self.split_key!r})"
+
+
+class RangeSplitBalancer:
+    """Emit a split for any local leader range with more than ``max_keys``
+    keys, at the median key (a size hinter; fan-out hinters can feed the
+    same command stream)."""
+
+    def __init__(self, max_keys: int = 100_000) -> None:
+        self.max_keys = max_keys
+
+    def balance(self, store: KVRangeStore) -> List[SplitCommand]:
+        out: List[SplitCommand] = []
+        for rid, r in store.ranges.items():
+            if not r.is_leader:
+                continue
+            n = len(r.space)
+            if n <= self.max_keys:
+                continue
+            start, end = store.boundaries[rid]
+            mid = self._median_key(r.space, start, end, n)
+            if mid is not None and mid > start:
+                out.append(SplitCommand(rid, mid))
+        return out
+
+    @staticmethod
+    def _median_key(space, start: bytes, end, n: int) -> Optional[bytes]:
+        target = n // 2
+        for i, (k, _v) in enumerate(space.iterate(start, end)):
+            if i >= target:
+                return k
+        return None
+
+
+class KVStoreBalanceController:
+    """Runs the balancer set on an interval against one store."""
+
+    def __init__(self, store: KVRangeStore, balancers=None, *,
+                 interval: float = 1.0) -> None:
+        self.store = store
+        self.balancers = balancers or [RangeSplitBalancer()]
+        self.interval = interval
+        self._task = None
+
+    async def run_once(self) -> int:
+        executed = 0
+        for b in self.balancers:
+            for cmd in b.balance(self.store):
+                try:
+                    if isinstance(cmd, SplitCommand):
+                        sib = await self.store.split(cmd.range_id,
+                                                     cmd.split_key)
+                        log.info("split %s -> %s", cmd.range_id, sib)
+                        executed += 1
+                except Exception:  # noqa: BLE001 — keep balancing others
+                    log.exception("balance command failed: %r", cmd)
+        return executed
+
+    async def start(self) -> None:
+        async def loop():
+            while True:
+                await asyncio.sleep(self.interval)
+                await self.run_once()
+        self._task = asyncio.create_task(loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except BaseException:  # noqa: BLE001
+                pass
+            self._task = None

@@ -111,6 +111,10 @@ class ReplicatedKVRange:
 
     # ---------------- raft callbacks ---------------------------------------
 
+    # set by a hosting KVRangeStore: fn(split_key) runs the deterministic
+    # split state transfer at this entry's apply position on every replica
+    on_split = None
+
     def _apply(self, entry: LogEntry) -> None:
         data = entry.data
         if not data:
@@ -118,6 +122,9 @@ class ReplicatedKVRange:
         kind = data[0]
         if kind == 0:
             self._apply_kv_batch(data)
+        elif kind == 2:  # split marker (≈ KVRangeFSM WALSplit command)
+            if self.on_split is not None:
+                self.on_split(data[1:])
         else:
             writer = self.space.writer()
             out = (self.coproc.mutate(data[1:], self.space, writer)
@@ -187,6 +194,11 @@ class ReplicatedKVRange:
 
     async def write_batch(self, ops) -> None:
         await self.raft.propose(_enc_kv_ops(ops))
+
+    async def propose_split(self, split_key: bytes) -> None:
+        """Replicate a split marker; the hosting store's ``on_split`` hook
+        executes the state transfer when it applies."""
+        await self.raft.propose(bytes([2]) + split_key)
 
     async def mutate_coproc(self, payload: bytes) -> bytes:
         """RW coproc call through consensus (≈ KVRangeRWRequest execute)."""

@@ -1,0 +1,270 @@
+"""Multi-range KV store + boundary router + split (≈ base-kv elasticity).
+
+``KVRangeStore`` hosts many ``ReplicatedKVRange`` replicas on one node
+(≈ base-kv-store-server KVRangeStore.java:101 hosting KVRangeFSMs) and
+executes the **split** half of the reference's split/merge state machine
+(KVRangeFSM.java:164; merge stays future work per SURVEY §7 hard-parts):
+
+- every range owns a key *boundary* ``[start, end)`` (None end = +inf) and
+  its own raft group (per-range member ids ``node:range``);
+- a split is a raft entry on the parent range; applying it is
+  deterministic on every replica: keys ≥ split_key move to a freshly
+  created sibling range (new space, new raft group seeded with identical
+  FSM state — a snapshot at index 0), boundaries shrink/attach, and the
+  coprocs reset to rebuild derived state;
+- ``KVRangeRouter`` is the client-side boundary map
+  (≈ base-kv-store-client's NavigableMap<Boundary, KVRangeSetting>
+  ``latestEffectiveRouter``): find_by_key / intersecting.
+
+Range metadata (id → boundary) persists in a store-meta space so a durable
+store reloads its range set on restart.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .engine import IKVEngine, IKVSpace
+from .range import IKVRangeCoProc, ReplicatedKVRange
+
+Boundary = Tuple[bytes, Optional[bytes]]   # [start, end); end None = +inf
+
+
+def _intersects(b: Boundary, start: bytes, end: Optional[bytes]) -> bool:
+    bs, be = b
+    if be is not None and be <= start:
+        return False
+    if end is not None and bs >= end:
+        return False
+    return True
+
+
+class KVRangeRouter:
+    """Boundary-sorted range lookup (client-side router analog)."""
+
+    def __init__(self) -> None:
+        self._ranges: List[Tuple[Boundary, str]] = []  # sorted by start
+
+    def update(self, range_id: str, boundary: Boundary) -> None:
+        self._ranges = [(b, r) for b, r in self._ranges if r != range_id]
+        self._ranges.append((boundary, range_id))
+        self._ranges.sort(key=lambda x: x[0][0])
+
+    def remove(self, range_id: str) -> None:
+        self._ranges = [(b, r) for b, r in self._ranges if r != range_id]
+
+    def find_by_key(self, key: bytes) -> Optional[str]:
+        for (start, end), rid in self._ranges:
+            if key >= start and (end is None or key < end):
+                return rid
+        return None
+
+    def intersecting(self, start: bytes,
+                     end: Optional[bytes]) -> List[str]:
+        return [rid for b, rid in self._ranges if _intersects(b, start, end)]
+
+    def ranges(self) -> List[Tuple[Boundary, str]]:
+        return list(self._ranges)
+
+
+_META_RANGES = b"ranges"
+
+
+class KVRangeStore:
+    """Hosts this node's range replicas over one engine + one transport."""
+
+    def __init__(self, node_id: str, transport, engine: IKVEngine,
+                 coproc_factory: Callable[[str], IKVRangeCoProc], *,
+                 member_nodes: Optional[List[str]] = None,
+                 raft_store_factory=None) -> None:
+        self.node_id = node_id
+        self.transport = transport
+        self.engine = engine
+        self.coproc_factory = coproc_factory
+        self.member_nodes = member_nodes or [node_id]
+        self.raft_store_factory = raft_store_factory
+        self.ranges: Dict[str, ReplicatedKVRange] = {}
+        self.coprocs: Dict[str, IKVRangeCoProc] = {}
+        self.boundaries: Dict[str, Boundary] = {}
+        self.router = KVRangeRouter()
+        self._meta = engine.create_space("store_meta")
+        self._split_seq = 0
+
+    # ---------------- lifecycle -------------------------------------------
+
+    def open(self) -> None:
+        """Load existing ranges from the meta space, or bootstrap genesis
+        (≈ KVRangeStore.start loading IKVSpaces + RangeBootstrapBalancer)."""
+        raw = self._meta.get_metadata(_META_RANGES)
+        if raw:
+            for rec in json.loads(raw.decode()):
+                self._open_range(
+                    rec["id"],
+                    (bytes.fromhex(rec["start"]),
+                     bytes.fromhex(rec["end"]) if rec["end"] else None))
+        else:
+            genesis = self._open_range("r0", (b"", None))
+            # one-time migration from the pre-multi-range layout: routes
+            # persisted in a flat "dist_routes" space move into genesis
+            legacy = self.engine.create_space("dist_routes")
+            moved = 0
+            w = genesis.space.writer()
+            for k, v in legacy.iterate():
+                w.put(k, v)
+                moved += 1
+            w.done()
+            if moved:
+                legacy.writer().delete_range(b"", b"\xff" * 48).done()
+                self.coprocs["r0"].reset(genesis.space)
+            self._persist_meta()
+
+    def _persist_meta(self) -> None:
+        recs = [{"id": rid, "start": b[0].hex(),
+                 "end": b[1].hex() if b[1] is not None else None}
+                for rid, b in self.boundaries.items()]
+        self._meta.put_metadata(_META_RANGES,
+                                json.dumps(sorted(recs,
+                                                  key=lambda r: r["id"])
+                                           ).encode())
+
+    def _open_range(self, range_id: str, boundary: Boundary
+                    ) -> ReplicatedKVRange:
+        space = self.engine.create_space(f"range_{range_id}")
+        coproc = self.coproc_factory(range_id)
+        raft_store = (self.raft_store_factory(range_id)
+                      if self.raft_store_factory else None)
+        member_id = f"{self.node_id}:{range_id}"
+        voters = [f"{n}:{range_id}" for n in self.member_nodes]
+        r = ReplicatedKVRange(range_id, member_id, voters, self.transport,
+                              space, coproc=coproc, raft_store=raft_store)
+        r.on_split = lambda split_key, rid=range_id: self._apply_split(
+            rid, split_key)
+        if hasattr(self.transport, "register"):
+            self.transport.register(r.raft)
+        self.ranges[range_id] = r
+        self.coprocs[range_id] = coproc
+        self.boundaries[range_id] = boundary
+        self.router.update(range_id, boundary)
+        if hasattr(coproc, "boundary"):
+            coproc.boundary = boundary
+        coproc.reset(space)
+        return r
+
+    def tick(self) -> None:
+        for r in self.ranges.values():
+            r.raft.tick()
+
+    def stop(self) -> None:
+        for r in self.ranges.values():
+            r.raft.stop()
+
+    # ---------------- routing ---------------------------------------------
+
+    def range_for_key(self, key: bytes) -> ReplicatedKVRange:
+        rid = self.router.find_by_key(key)
+        if rid is None:
+            raise KeyError(f"no range covers key {key!r}")
+        return self.ranges[rid]
+
+    # ---------------- split (≈ KVRangeFSM split command) -------------------
+
+    async def split(self, range_id: str, split_key: bytes) -> str:
+        """Propose a split of ``range_id`` at ``split_key``; resolves with
+        the new sibling's id after the split applies on this replica."""
+        import asyncio
+        import time as _time
+
+        from ..raft.node import NotLeaderError
+
+        r = self.ranges[range_id]
+        start, end = self.boundaries[range_id]
+        if not (split_key > start and (end is None or split_key < end)):
+            raise ValueError("split key outside boundary")
+        deadline = _time.monotonic() + 5.0
+        while True:
+            try:
+                await r.propose_split(split_key)
+                break
+            except NotLeaderError:
+                # freshly created groups elect asynchronously; wait bounded
+                if (_time.monotonic() >= deadline
+                        or r.raft.leader_id not in (None, r.raft.id)):
+                    raise
+                await asyncio.sleep(0.01)
+        # the apply hook (this replica) created the sibling synchronously
+        return self._sibling_id(range_id, split_key)
+
+    def _sibling_id(self, parent: str, split_key: bytes) -> str:
+        # hash the WHOLE key: route keys share long tenant prefixes, so a
+        # key-prefix id would collide across different split points (and the
+        # replay guard would silently swallow real splits)
+        import hashlib
+        digest = hashlib.blake2b(split_key, digest_size=6).hexdigest()
+        return f"{parent}.{digest}"
+
+    def _apply_split(self, range_id: str, split_key: bytes) -> None:
+        """Runs inside the raft apply of the split entry — on EVERY replica,
+        at the same log position, so the state transfer is deterministic."""
+        parent = self.ranges[range_id]
+        start, end = self.boundaries[range_id]
+        sibling_id = self._sibling_id(range_id, split_key)
+        if sibling_id in self.ranges:
+            return  # replayed entry (restart); already split
+        sib_space = self.engine.create_space(f"range_{sibling_id}")
+        # move [split_key, end) into the sibling space
+        w = sib_space.writer()
+        moved = 0
+        for k, v in parent.space.iterate(split_key, end):
+            w.put(k, v)
+            moved += 1
+        w.done()
+        parent.space.writer().delete_range(
+            split_key, end if end is not None else b"\xff" * 48).done()
+        # shrink parent, open sibling
+        self.boundaries[range_id] = (start, split_key)
+        self.router.update(range_id, (start, split_key))
+        if hasattr(self.coprocs[range_id], "boundary"):
+            self.coprocs[range_id].boundary = (start, split_key)
+        coproc = self.coproc_factory(sibling_id)
+        raft_store = (self.raft_store_factory(sibling_id)
+                      if self.raft_store_factory else None)
+        member_id = f"{self.node_id}:{sibling_id}"
+        voters = [f"{n}:{sibling_id}" for n in self.member_nodes]
+        sib = ReplicatedKVRange(sibling_id, member_id, voters,
+                                self.transport, sib_space, coproc=coproc,
+                                raft_store=raft_store)
+        sib.on_split = lambda sk, rid=sibling_id: self._apply_split(rid, sk)
+        if hasattr(self.transport, "register"):
+            self.transport.register(sib.raft)
+        self.ranges[sibling_id] = sib
+        self.coprocs[sibling_id] = coproc
+        self.boundaries[sibling_id] = (split_key, end)
+        self.router.update(sibling_id, (split_key, end))
+        if hasattr(coproc, "boundary"):
+            coproc.boundary = (split_key, end)
+        if self.member_nodes == [self.node_id]:
+            # sole-voter store: elect the new group synchronously so the
+            # sibling serves immediately after the split applies
+            from ..raft.node import Role
+            for _ in range(200):
+                if sib.raft.role == Role.LEADER:
+                    break
+                sib.raft.tick()
+        # derived state rebuilds from the moved keyspaces
+        self.coprocs[range_id].reset(parent.space)
+        coproc.reset(sib_space)
+        self._persist_meta()
+
+    # ---------------- introspection ---------------------------------------
+
+    def describe(self) -> List[dict]:
+        out = []
+        for rid, r in sorted(self.ranges.items()):
+            s, e = self.boundaries[rid]
+            out.append({"id": rid, "start": s.hex(),
+                        "end": e.hex() if e is not None else None,
+                        "keys": len(r.space),
+                        "leader": r.is_leader})
+        return out

@@ -438,18 +438,21 @@ class MQTTBroker:
             # — durable when an engine is provided, so routes survive restart
             # through the dist keyspace itself (coproc reset-from-KV)
             from ..dist.worker import DistWorker
-            route_space = None
-            raft_store = None
+            engine = None
+            raft_store_factory = None
             if inbox_engine is not None:
-                route_space = inbox_engine.create_space("dist_routes")
-                # raft hard state/log on its own space of the same durable
-                # engine (≈ the reference's separate WALable engine)
+                engine = inbox_engine
+                # raft hard state/log on per-range spaces of the same
+                # durable engine (≈ the reference's separate WALable engine)
                 from ..raft.store import KVRaftStateStore
-                raft_store = KVRaftStateStore(
-                    inbox_engine.create_space("dist_raft"))
+
+                def raft_store_factory(rid, _eng=inbox_engine):
+                    return KVRaftStateStore(
+                        _eng.create_space(f"raft_{rid}"))
             dist = DistService(self.sub_brokers, self.events, self.settings,
-                               worker=DistWorker(space=route_space,
-                                                 raft_store=raft_store))
+                               worker=DistWorker(
+                                   engine=engine,
+                                   raft_store_factory=raft_store_factory))
         self.dist = dist
         if retain_service is None:
             from ..retain.service import RetainService

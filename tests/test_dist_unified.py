@@ -47,14 +47,13 @@ class TestDistWorker:
 
     async def test_routes_survive_worker_restart_via_reset(self):
         engine = InMemKVEngine()
-        space = engine.create_space("dist_routes")
-        w = DistWorker(space=space)
+        w = DistWorker(engine=engine)
         await w.start()
         await w.add_route("T", mk_route("x/#", "r7"))
         await w.add_route("T", mk_route("$share/g/x/y", "g1"))
         await w.stop()
-        # simulated process restart: fresh worker over the same space
-        w2 = DistWorker(space=space)
+        # simulated process restart: fresh worker over the same engine
+        w2 = DistWorker(engine=engine)
         await w2.start()
         try:
             res = await w2.match_batch(

@@ -1,0 +1,197 @@
+"""Range split + balancer tests: split by key boundary with no lost or
+duplicated routes, split under concurrent mutation/match load, balancer
+auto-split, and multi-range restart recovery (≈ KVRangeFSM split +
+RangeSplitBalancer + KVStoreBalanceController)."""
+
+import asyncio
+import random
+
+import pytest
+
+from bifromq_tpu.dist.worker import DistWorker
+from bifromq_tpu.kv.balance import KVStoreBalanceController, RangeSplitBalancer
+from bifromq_tpu.kv.engine import InMemKVEngine
+from bifromq_tpu.models.oracle import Route
+from bifromq_tpu.types import RouteMatcher
+
+pytestmark = pytest.mark.asyncio
+
+
+def mk_route(tf, receiver="r0", broker=0, inc=0):
+    return Route(matcher=RouteMatcher.from_topic_filter(tf), broker_id=broker,
+                 receiver_id=receiver, deliverer_key="d0", incarnation=inc)
+
+
+async def all_matches(w, tenant, topic_levels):
+    res = await w.match_batch([(tenant, topic_levels)],
+                              max_persistent_fanout=1 << 30,
+                              max_group_fanout=1 << 30)
+    return sorted((r.matcher.mqtt_topic_filter, r.receiver_id)
+                  for r in res[0].all_routes())
+
+
+class TestSplit:
+    async def test_split_preserves_all_routes(self):
+        w = DistWorker()
+        await w.start()
+        try:
+            for i in range(200):
+                await w.add_route("T", mk_route(f"s/{i:03d}/+", f"r{i}"))
+            before = await all_matches(w, "T", ["s", "042", "leaf"])
+            assert len(before) == 1
+            # split at the median key of the only range
+            rid = next(iter(w.store.ranges))
+            keys = [k for k, _ in w.store.ranges[rid].space.iterate()]
+            mid = keys[len(keys) // 2]
+            sib = await w.store.split(rid, mid)
+            assert len(w.store.ranges) == 2
+            # no routes lost or duplicated across the boundary
+            total = sum(len(r.space) for r in w.store.ranges.values())
+            assert total == 200
+            for i in (0, 42, 101, 199):
+                got = await all_matches(w, "T", ["s", f"{i:03d}", "leaf"])
+                assert got == [(f"s/{i:03d}/+", f"r{i}")], (i, got)
+            # wildcard spanning the split boundary unions both ranges
+            got = await all_matches(w, "T", ["s", "042", "x"])
+            assert got == [("s/042/+", "r42")]
+            # mutations keep routing to the right range post-split
+            assert await w.add_route("T", mk_route("s/000/+", "rX")) == "ok"
+            assert await w.remove_route(
+                "T", RouteMatcher.from_topic_filter("s/199/+"),
+                (0, "r199", "d0")) == "ok"
+            assert (await all_matches(w, "T", ["s", "199", "x"])) == []
+        finally:
+            await w.stop()
+
+    async def test_split_under_load(self):
+        w = DistWorker()
+        await w.start()
+        rng = random.Random(5)
+        live = {}
+        try:
+            for i in range(300):
+                await w.add_route("T", mk_route(f"l/{i:04d}/#", f"r{i}"))
+                live[f"l/{i:04d}/#"] = f"r{i}"
+
+            async def churn(n):
+                for j in range(n):
+                    i = rng.randrange(600)
+                    tf = f"l/{i:04d}/#"
+                    if rng.random() < 0.6:
+                        await w.add_route("T", mk_route(tf, f"r{i}", inc=j))
+                        live[tf] = f"r{i}"
+                    elif tf in live:
+                        await w.remove_route(
+                            "T", RouteMatcher.from_topic_filter(tf),
+                            (0, live[tf], "d0"), incarnation=j)
+                        live.pop(tf, None)
+                    if j % 20 == 0:
+                        await asyncio.sleep(0)
+
+            async def do_splits():
+                for _ in range(2):
+                    await asyncio.sleep(0.01)
+                    rid = max(w.store.ranges,
+                              key=lambda r: len(w.store.ranges[r].space))
+                    keys = [k for k, _ in
+                            w.store.ranges[rid].space.iterate()]
+                    if len(keys) > 10:
+                        await w.store.split(rid, keys[len(keys) // 2])
+
+            await asyncio.gather(churn(200), do_splits())
+            assert len(w.store.ranges) >= 2
+            # exact parity with the independently tracked live set
+            for i in range(0, 600, 37):
+                tf = f"l/{i:04d}/#"
+                got = await all_matches(w, "T", ["l", f"{i:04d}", "z"])
+                want = [(tf, live[tf])] if tf in live else []
+                assert got == want, (tf, got, want)
+            total = sum(len(r.space) for r in w.store.ranges.values())
+            assert total == len(live)
+        finally:
+            await w.stop()
+
+    async def test_balancer_auto_splits(self):
+        w = DistWorker(split_threshold=64)
+        await w.start()
+        try:
+            for i in range(200):
+                await w.add_route("T", mk_route(f"b/{i:03d}", f"r{i}"))
+            # let the controller run (interval 1s default — run manually)
+            n = await w.balance_controller.run_once()
+            assert n >= 1
+            while await w.balance_controller.run_once():
+                pass
+            assert len(w.store.ranges) >= 3
+            assert all(len(r.space) <= 110
+                       for r in w.store.ranges.values())
+            for i in (0, 99, 150, 199):
+                got = await all_matches(w, "T", ["b", f"{i:03d}"])
+                assert got == [(f"b/{i:03d}", f"r{i}")]
+        finally:
+            await w.stop()
+
+    async def test_multi_range_restart_recovery(self):
+        engine = InMemKVEngine()
+        w = DistWorker(engine=engine)
+        await w.start()
+        for i in range(100):
+            await w.add_route("T", mk_route(f"p/{i:03d}/+", f"r{i}"))
+        rid = next(iter(w.store.ranges))
+        keys = [k for k, _ in w.store.ranges[rid].space.iterate()]
+        await w.store.split(rid, keys[50])
+        assert len(w.store.ranges) == 2
+        await w.stop()
+        # restart over the same engine: both ranges reload from meta
+        w2 = DistWorker(engine=engine)
+        await w2.start()
+        try:
+            assert len(w2.store.ranges) == 2
+            for i in (0, 49, 50, 99):
+                got = await all_matches(w2, "T", ["p", f"{i:03d}", "x"])
+                assert got == [(f"p/{i:03d}/+", f"r{i}")]
+        finally:
+            await w2.stop()
+
+
+class TestLegacyMigration:
+    async def test_old_flat_layout_migrates_into_genesis(self):
+        from bifromq_tpu.kv import schema
+
+        engine = InMemKVEngine()
+        # simulate a pre-multi-range deployment: routes in "dist_routes"
+        legacy = engine.create_space("dist_routes")
+        r = mk_route("m/old/+", "rOld")
+        key = schema.route_key("T", r.matcher, r.receiver_url)
+        legacy.writer().put(key, schema.route_value(0)).done()
+        w = DistWorker(engine=engine)
+        await w.start()
+        try:
+            got = await all_matches(w, "T", ["m", "old", "x"])
+            assert got == [("m/old/+", "rOld")]
+            assert len(legacy) == 0  # moved, not copied
+        finally:
+            await w.stop()
+
+
+class TestBoundaryBounce:
+    async def test_apply_time_boundary_check_bounces_stale_mutations(self):
+        # a mutation applied to a range whose boundary no longer covers the
+        # key must return b"retry" without writing (split race guard)
+        from bifromq_tpu.dist.worker import DistWorkerCoProc, encode_add_route
+        from bifromq_tpu.kv.engine import InMemKVEngine
+        from bifromq_tpu.kv import schema
+
+        cp = DistWorkerCoProc()
+        space = InMemKVEngine().create_space("s")
+        r = mk_route("z/1", "r1")
+        key = schema.route_key("T", r.matcher, r.receiver_url)
+        cp.boundary = (b"", key)  # boundary excludes the key ([start, key))
+        out = cp.mutate(encode_add_route("T", r), space, space.writer())
+        assert out == b"retry"
+        assert len(space) == 0
+        cp.boundary = (b"", None)
+        w = space.writer()
+        out = cp.mutate(encode_add_route("T", r), space, w)
+        w.done()
+        assert out == b"ok" and len(space) == 1
