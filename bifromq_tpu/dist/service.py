@@ -78,9 +78,55 @@ class DistService:
 
     async def start(self) -> None:
         await self.worker.start()
+        from ..utils.sysprops import SysProp, get
+        interval = get(SysProp.DIST_GC_INTERVAL_SECONDS)
+        if interval and interval > 0:
+            import asyncio
+
+            async def loop():
+                while True:
+                    await asyncio.sleep(interval)
+                    try:
+                        await self.gc_sweep()
+                    except Exception:  # noqa: BLE001
+                        import logging
+                        logging.getLogger(__name__).exception("dist gc")
+            self._gc_task = asyncio.create_task(loop())
 
     async def stop(self) -> None:
+        task = getattr(self, "_gc_task", None)
+        if task is not None:
+            task.cancel()
+            self._gc_task = None
         await self.worker.stop()
+
+    async def gc_sweep(self) -> int:
+        """Periodic dead-route sweep (≈ DistWorkerCoProc.gc:554 +
+        SubscriptionCleaner): every stored route is checked against its
+        sub-broker's checkSubscriptions; routes whose receiver no longer
+        holds the subscription are removed through consensus."""
+        if not hasattr(self.worker, "_iter_all_routes"):
+            # remote worker: the sweep must run in the worker process (it
+            # owns the keyspace); the frontend has nothing to scan
+            return 0
+        removed = 0
+        for tenant_id, route in list(self.worker._iter_all_routes()):
+            if not self.sub_brokers.has(route.broker_id):
+                continue
+            broker = self.sub_brokers.get(route.broker_id)
+            mi = MatchInfo(matcher=route.matcher,
+                           receiver_id=route.receiver_id,
+                           incarnation=route.incarnation)
+            try:
+                alive = await broker.check_subscriptions(tenant_id, [mi])
+            except Exception:  # noqa: BLE001
+                continue
+            if not alive[0]:
+                await self.worker.remove_route(
+                    tenant_id, route.matcher, route.receiver_url,
+                    route.incarnation)
+                removed += 1
+        return removed
 
     # ---------------- route mutations (≈ batchAddRoute/batchRemoveRoute) ---
 

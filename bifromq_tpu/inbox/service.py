@@ -93,6 +93,8 @@ class InboxService:
         meta, present = self.store.attach(
             tenant_id, inbox_id, clean_start=clean_start,
             expiry_seconds=expiry_seconds, client_meta=client_meta, lwt=lwt)
+        self.events.report(Event(EventType.INBOX_ATTACHED, tenant_id,
+                                 {"inbox": inbox_id, "present": present}))
         self.delay.cancel((tenant_id, inbox_id))
         if not present:
             # a fresh inbox has no routes yet; a reattached one keeps them
@@ -105,6 +107,8 @@ class InboxService:
                                  keep_lwt=fire_lwt_on_expiry)
         if meta is None:
             return
+        self.events.report(Event(EventType.INBOX_DETACHED, tenant_id,
+                                 {"inbox": inbox_id}))
         self._signals.pop((tenant_id, inbox_id), None)
         deadline = meta.expire_at()
         if deadline == float("inf"):
@@ -125,11 +129,18 @@ class InboxService:
             if meta.lwt is not None:
                 publisher = ClientInfo(tenant_id=tenant_id,
                                        metadata=meta.client_meta)
-                await self.dist.pub(publisher, meta.lwt.topic,
-                                    meta.lwt.message)
-                self.events.report(Event(EventType.WILL_DISTED, tenant_id,
-                                         {"topic": meta.lwt.topic,
-                                          "inbox": inbox_id}))
+                try:
+                    await self.dist.pub(publisher, meta.lwt.topic,
+                                        meta.lwt.message)
+                    self.events.report(Event(EventType.WILL_DISTED,
+                                             tenant_id,
+                                             {"topic": meta.lwt.topic,
+                                              "inbox": inbox_id}))
+                except Exception as e:  # noqa: BLE001 — expiry continues
+                    self.events.report(Event(EventType.WILL_DIST_ERROR,
+                                             tenant_id,
+                                             {"topic": meta.lwt.topic,
+                                              "error": repr(e)}))
             # re-read: the inbox may have been reattached/resubscribed while
             # the LWT pub suspended
             meta = self.store.get(tenant_id, inbox_id)
@@ -138,6 +149,8 @@ class InboxService:
                 return
             await self._drop_routes(tenant_id, inbox_id, meta)
             self.store.delete(tenant_id, inbox_id)
+            self.events.report(Event(EventType.INBOX_EXPIRED, tenant_id,
+                                     {"inbox": inbox_id}))
             self._locks.pop((tenant_id, inbox_id), None)
 
     async def delete(self, tenant_id: str, inbox_id: str) -> None:
@@ -146,7 +159,10 @@ class InboxService:
             if meta is not None:
                 await self._drop_routes(tenant_id, inbox_id, meta)
             self.delay.cancel((tenant_id, inbox_id))
-            self.store.delete(tenant_id, inbox_id)
+            existed = self.store.delete(tenant_id, inbox_id)
+            if meta is not None or existed:
+                self.events.report(Event(EventType.INBOX_DELETED, tenant_id,
+                                         {"inbox": inbox_id}))
         self._locks.pop((tenant_id, inbox_id), None)
 
     async def _drop_routes(self, tenant_id: str, inbox_id: str,

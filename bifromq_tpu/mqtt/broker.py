@@ -65,6 +65,10 @@ class Connection:
     async def protocol_error(self, msg: str,
                              reason: int = ReasonCode.PROTOCOL_ERROR) -> None:
         log.debug("protocol error: %s", msg)
+        tenant = (self.session.client_info.tenant_id
+                  if self.session is not None else "")
+        self.broker.events.report(Event(EventType.PROTOCOL_VIOLATION,
+                                        tenant, {"detail": msg}))
         await self.disconnect_with(reason)
 
     async def disconnect_with(self, reason: int) -> None:
@@ -269,6 +273,28 @@ class Connection:
                 {"resource": "total_connections"}))
             await self.close_transport()
             return
+        redirect = broker.balancer.need_redirect(ClientInfo(
+            tenant_id=tenant_id, type="MQTT",
+            metadata=(("clientId", c.client_id),)))
+        if redirect is not None:
+            # server redirection (≈ IClientBalancer → MQTT5 Server Reference)
+            broker.events.report(Event(
+                EventType.REDIRECTED, tenant_id,
+                {"server_reference": redirect.server_reference}))
+            from ..plugin.balancer import RedirectType
+            if v5:
+                rc = (ReasonCode.SERVER_MOVED
+                      if redirect.type == RedirectType.MOVE
+                      else ReasonCode.USE_ANOTHER_SERVER)
+                props = ({PropertyId.SERVER_REFERENCE:
+                          redirect.server_reference}
+                         if redirect.server_reference else None)
+                await self.send(pk.Connack(reason_code=rc,
+                                           properties=props))
+            else:
+                await self.send(pk.Connack(reason_code=3))
+            await self.close_transport()
+            return
         settings = TenantSettings.resolve(broker.settings, tenant_id)
         enabled = {3: Setting.MQTT3Enabled, 4: Setting.MQTT4Enabled,
                    5: Setting.MQTT5Enabled}[c.protocol_level]
@@ -401,6 +427,7 @@ class MQTTBroker:
                  dist: Optional[DistService] = None,
                  retain_service=None, inbox_engine=None,
                  ssl_context=None, throttler=None,
+                 balancer=None, session_dict=None, mem_usage=None,
                  tls_port: Optional[int] = None, tls_ssl_context=None,
                  ws_port: Optional[int] = None,
                  ws_path: str = "/mqtt", ws_ssl_context=None) -> None:
@@ -427,6 +454,19 @@ class MQTTBroker:
         self.auth = auth or AllowAllAuthProvider()
         from ..plugin.throttler import AllowAllResourceThrottler
         self.throttler = throttler or AllowAllResourceThrottler()
+        from ..plugin.balancer import NoRedirectBalancer
+        self.balancer = balancer or NoRedirectBalancer()
+        # cross-node session dict client (cluster-wide kick); None = local
+        self.session_dict = session_dict
+        from ..utils.env import MemUsage
+        from ..utils.sysprops import SysProp, get
+        self.mem_usage = mem_usage or MemUsage(
+            high_watermark=get(SysProp.INGRESS_SLOWDOWN_MEM_USAGE))
+        # token bucket for connection-rate limiting
+        # (≈ ConnectionRateLimitHandler)
+        self._conn_rate_limit = get(SysProp.MAX_CONN_PER_SECOND)
+        self._conn_tokens = float(self._conn_rate_limit)
+        self._conn_refill_at = 0.0
         self.settings = settings or DefaultSettingProvider()
         self.events = events or CollectingEventCollector()
         self.local_sessions = LocalSessionRegistry()
@@ -520,13 +560,44 @@ class MQTTBroker:
                 pass
         await self.dist.stop()
 
+    def _admit_connection(self) -> Optional[EventType]:
+        """Frontend admission stage (≈ ConnectionRateLimitHandler +
+        ConditionalRejectHandler): token-bucket connection rate + process
+        memory pressure. Returns the rejection event type, or None."""
+        import time as _time
+        now = _time.monotonic()
+        if self._conn_refill_at:
+            self._conn_tokens = min(
+                float(self._conn_rate_limit),
+                self._conn_tokens
+                + (now - self._conn_refill_at) * self._conn_rate_limit)
+        self._conn_refill_at = now
+        if self._conn_tokens < 1.0:
+            return EventType.CONNECTION_RATE_EXCEEDED
+        if self.mem_usage.under_pressure():
+            return EventType.SERVER_BUSY
+        self._conn_tokens -= 1.0
+        return None
+
+    def _reject(self, writer, reason: EventType) -> None:
+        self.events.report(Event(reason, "", {}))
+        writer.close()
+
     async def _on_client(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter) -> None:
+        rejected = self._admit_connection()
+        if rejected is not None:
+            self._reject(writer, rejected)
+            return
         conn = Connection(self, reader, writer)
         await conn.run()
 
     async def _on_ws_client(self, reader: asyncio.StreamReader,
                             writer: asyncio.StreamWriter) -> None:
+        rejected = self._admit_connection()
+        if rejected is not None:
+            self._reject(writer, rejected)
+            return
         from . import ws
         if not await ws.server_handshake(reader, writer, self.ws_path):
             writer.close()

@@ -69,6 +69,7 @@ class PersistentSession(Session):
         self._committed_seq = meta.buffer_start_seq - 1
         self.local_registry.register(self)
         await self.session_registry.register(self)
+        await self._global_kick()
         self.inbox.register_fetcher(tenant, self.inbox_id,
                                     self._fetch_wake.set)
         self._fetch_task = asyncio.get_running_loop().create_task(

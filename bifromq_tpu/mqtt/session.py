@@ -212,6 +212,16 @@ class Session:
     async def start(self) -> None:
         self.local_registry.register(self)
         await self.session_registry.register(self)
+        await self._global_kick()
+
+    async def _global_kick(self) -> None:
+        """Cluster-wide single-owner kick via the session-dict service
+        (≈ cross-node SessionRegistry semantics)."""
+        sd = getattr(getattr(self.conn, "broker", None), "session_dict",
+                     None)
+        if sd is not None:
+            await sd.kick_everywhere(self.client_info.tenant_id,
+                                     self.client_id)
 
     async def kick(self) -> None:
         """Another session took over this (tenant, client_id)."""
@@ -268,6 +278,8 @@ class Session:
         elif isinstance(packet, pk.Unsubscribe):
             await self._on_unsubscribe(packet)
         elif isinstance(packet, pk.PingReq):
+            self.events.report(Event(EventType.PING_REQ,
+                                     self.client_info.tenant_id, {}))
             await self.conn.send(pk.PingResp())
         elif isinstance(packet, pk.Disconnect):
             if (self.protocol_level >= PROTOCOL_MQTT5
@@ -347,6 +359,9 @@ class Session:
         if not topic_util.is_valid_topic(
                 topic, ts[Setting.MaxTopicLevelLength],
                 ts[Setting.MaxTopicLevels], ts[Setting.MaxTopicLength]):
+            self.events.report(Event(EventType.MALFORMED_TOPIC,
+                                     self.client_info.tenant_id,
+                                     {"topic": topic}))
             await self.conn.protocol_error(
                 "invalid topic", ReasonCode.TOPIC_NAME_INVALID)
             return
@@ -490,13 +505,22 @@ class Session:
         if not topic_util.is_valid_topic_filter(
                 tf, ts[Setting.MaxTopicLevelLength],
                 ts[Setting.MaxTopicLevels], ts[Setting.MaxTopicLength]):
+            self.events.report(Event(EventType.MALFORMED_TOPIC_FILTER,
+                                     self.client_info.tenant_id,
+                                     {"filter": tf}))
             return (ReasonCode.TOPIC_FILTER_INVALID if v5 else 0x80)
         if (topic_util.is_wildcard_topic_filter(tf)
                 and not ts[Setting.WildcardSubscriptionEnabled]):
+            self.events.report(Event(EventType.WILDCARD_SUB_UNSUPPORTED,
+                                     self.client_info.tenant_id,
+                                     {"filter": tf}))
             return (ReasonCode.WILDCARD_SUBSCRIPTIONS_NOT_SUPPORTED
                     if v5 else 0x80)
         if topic_util.is_shared_subscription(tf):
             if not ts[Setting.SharedSubscriptionEnabled]:
+                self.events.report(Event(
+                    EventType.SHARED_SUB_UNSUPPORTED,
+                    self.client_info.tenant_id, {"filter": tf}))
                 return (ReasonCode.SHARED_SUBSCRIPTIONS_NOT_SUPPORTED
                         if v5 else 0x80)
             if v5 and req.no_local:

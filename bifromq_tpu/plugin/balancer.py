@@ -1,0 +1,36 @@
+"""Client balancer extension point (≈ bifromq-plugin IClientBalancer).
+
+``need_redirect`` runs at CONNECT: returning a ``ServerRedirection`` makes
+the broker answer USE_ANOTHER_SERVER / SERVER_MOVED with a Server
+Reference property (MQTT5) instead of accepting the session — the
+reference's server-redirection hook for tenant-aware load shedding.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Optional
+
+from ..types import ClientInfo
+
+
+class RedirectType(enum.Enum):
+    MOVE = "move"                   # permanent (SERVER_MOVED 0x9D)
+    TEMPORARY = "temporary"         # USE_ANOTHER_SERVER 0x9C
+
+
+@dataclass(frozen=True)
+class ServerRedirection:
+    type: RedirectType
+    server_reference: str = ""      # "host:port" hint; may be empty
+
+
+class IClientBalancer:
+    def need_redirect(self, client: ClientInfo
+                      ) -> Optional[ServerRedirection]:
+        return None
+
+
+class NoRedirectBalancer(IClientBalancer):
+    pass

@@ -44,6 +44,26 @@ class EventType(enum.Enum):
     # inbox family
     OVERFLOWED = "overflowed"
     MSG_FETCHED = "msg_fetched"
+    # connect/frontend detail family (every member below is REPORTED by a
+    # live code path — no decorative enum entries)
+    PROTOCOL_VIOLATION = "protocol_violation"
+    MALFORMED_TOPIC = "malformed_topic"
+    MALFORMED_TOPIC_FILTER = "malformed_topic_filter"
+    CONNECTION_RATE_EXCEEDED = "connection_rate_exceeded"
+    SERVER_BUSY = "server_busy"
+    REDIRECTED = "redirected"
+    # ping family
+    PING_REQ = "ping_req"
+    # sub detail family
+    SHARED_SUB_UNSUPPORTED = "shared_sub_unsupported"
+    WILDCARD_SUB_UNSUPPORTED = "wildcard_sub_unsupported"
+    # lwt detail
+    WILL_DIST_ERROR = "will_dist_error"
+    # inbox detail family
+    INBOX_ATTACHED = "inbox_attached"
+    INBOX_DETACHED = "inbox_detached"
+    INBOX_EXPIRED = "inbox_expired"
+    INBOX_DELETED = "inbox_deleted"
 
 
 @dataclass

@@ -1,0 +1,1 @@
+from .service import SessionDictClient, SessionDictRPCService  # noqa: F401

@@ -1,0 +1,133 @@
+"""Cross-node session dictionary (≈ bifromq-session-dict).
+
+The in-broker ``SessionRegistry`` kicks same-(tenant, client) owners
+locally; this service extends the contract cluster-wide over the RPC
+fabric (SessionDictService.proto kill/exist/get semantics):
+
+- ``SessionDictRPCService`` exposes a broker's live registry (exist /
+  kill / client list) as the ``session-dict`` fabric service.
+- ``SessionDictClient`` fans a kick out to every peer broker when a
+  client id connects here (the reference's register-stream kick,
+  SessionRegistry.java:72-86 across nodes), and answers online checks
+  (≈ OnlineCheckScheduler/BatchSessionExistCall).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import List, Tuple
+
+from ..rpc.fabric import RPCServer, ServiceRegistry, _len16, _read16
+
+log = logging.getLogger(__name__)
+
+SERVICE = "session-dict"
+
+
+class SessionDictRPCService:
+    def __init__(self, broker) -> None:
+        self.broker = broker
+
+    def register(self, server: RPCServer) -> None:
+        server.register(SERVICE, {
+            "kill": self._kill,
+            "exist": self._exist,
+            "clients": self._clients,
+        })
+
+    async def _kill(self, payload: bytes, okey: str) -> bytes:
+        tenant_b, pos = _read16(payload, 0)
+        client_b, pos = _read16(payload, pos)
+        session = self.broker.session_registry.get(tenant_b.decode(),
+                                                   client_b.decode())
+        if session is None:
+            return b"\x00"
+        await session.kick()
+        return b"\x01"
+
+    async def _exist(self, payload: bytes, okey: str) -> bytes:
+        tenant_b, pos = _read16(payload, 0)
+        (n,) = struct.unpack_from(">H", payload, pos)
+        pos += 2
+        out = bytearray()
+        for _ in range(n):
+            client_b, pos = _read16(payload, pos)
+            s = self.broker.session_registry.get(tenant_b.decode(),
+                                                 client_b.decode())
+            out.append(1 if s is not None and not s.closed else 0)
+        return bytes(out)
+
+    async def _clients(self, payload: bytes, okey: str) -> bytes:
+        tenant_b, _ = _read16(payload, 0)
+        ids = self.broker.session_registry.client_ids(tenant_b.decode())
+        out = bytearray(struct.pack(">H", len(ids)))
+        for cid in ids:
+            out += _len16(cid.encode())
+        return bytes(out)
+
+
+class SessionDictClient:
+    """Broker-side client: cluster-wide kick + online checks.
+
+    ``self_address`` is REQUIRED (this broker's own session-dict RPC
+    address): without it the broker would kick the session it just
+    registered via its own service.
+    """
+
+    PEER_TIMEOUT = 2.0   # a sick peer must not stall CONNECT
+
+    def __init__(self, registry: ServiceRegistry, *,
+                 self_address: str) -> None:
+        if not self_address:
+            raise ValueError("self_address is required")
+        self.registry = registry
+        self.self_address = self_address
+
+    async def _call_peer(self, ep: str, method: str,
+                         payload: bytes, order_key: str = "") -> bytes:
+        return await self.registry.client_for(ep).call(
+            SERVICE, method, payload, order_key=order_key,
+            timeout=self.PEER_TIMEOUT)
+
+    async def kick_everywhere(self, tenant_id: str, client_id: str) -> int:
+        """Kick (tenant, client) on every peer broker concurrently;
+        returns the kick count. Called when a client id registers here, so
+        the cluster holds ONE live session per (tenant, client)."""
+        payload = _len16(tenant_id.encode()) + _len16(client_id.encode())
+        peers = [ep for ep in self.registry.endpoints(SERVICE)
+                 if ep != self.self_address]
+        if not peers:
+            return 0
+        outs = await asyncio.gather(
+            *(self._call_peer(ep, "kill", payload,
+                              order_key=f"{tenant_id}/{client_id}")
+              for ep in peers),
+            return_exceptions=True)
+        kicked = 0
+        for ep, out in zip(peers, outs):
+            if isinstance(out, BaseException):
+                log.debug("session-dict kick to %s failed: %r", ep, out)
+            else:
+                kicked += out[0]
+        return kicked
+
+    async def exist(self, tenant_id: str,
+                    client_ids: List[str]) -> List[bool]:
+        """Cluster-wide online check (any broker hosting it counts)."""
+        alive = [False] * len(client_ids)
+        payload = bytearray(_len16(tenant_id.encode()))
+        payload += struct.pack(">H", len(client_ids))
+        for cid in client_ids:
+            payload += _len16(cid.encode())
+        peers = self.registry.endpoints(SERVICE)
+        outs = await asyncio.gather(
+            *(self._call_peer(ep, "exist", bytes(payload)) for ep in peers),
+            return_exceptions=True)
+        for out in outs:
+            if isinstance(out, BaseException):
+                continue
+            for i, b in enumerate(out[:len(alive)]):
+                alive[i] = alive[i] or bool(b)
+        return alive

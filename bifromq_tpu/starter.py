@@ -55,6 +55,7 @@ class Standalone:
         self.broker = None
         self.api = None
         self.agent_host = None
+        self.rpc_server = None
 
     async def start(self) -> None:
         from .mqtt.broker import MQTTBroker
@@ -92,6 +93,22 @@ class Standalone:
             ws_path=(ws.get("path", "/mqtt") if ws else "/mqtt"))
         await self.broker.start()
 
+        if self.agent_host is not None:
+            # clustered: expose the session-dict service on the RPC fabric
+            # and discover peers over gossip, so (tenant, client) stays
+            # single-owner cluster-wide
+            from .rpc.fabric import RPCServer, ServiceRegistry
+            from .sessiondict import (SessionDictClient,
+                                      SessionDictRPCService)
+            from .sessiondict.service import SERVICE as _SD
+            self.rpc_server = RPCServer(host=host)
+            SessionDictRPCService(self.broker).register(self.rpc_server)
+            await self.rpc_server.start()
+            registry = ServiceRegistry(agent_host=self.agent_host)
+            registry.announce(_SD, self.rpc_server.address)
+            self.broker.session_dict = SessionDictClient(
+                registry, self_address=self.rpc_server.address)
+
         api_cfg = cfg.get("api")
         if api_cfg:
             from .apiserver.server import APIServer
@@ -108,6 +125,8 @@ class Standalone:
     async def stop(self) -> None:
         if self.api is not None:
             await self.api.stop()
+        if self.rpc_server is not None:
+            await self.rpc_server.stop()
         if self.broker is not None:
             await self.broker.stop()
         if self.agent_host is not None:

@@ -1,0 +1,114 @@
+"""Async building blocks (≈ base-util AsyncRunner / AsyncRetry /
+RendezvousHash).
+
+- ``AsyncRunner``: a serialized async task queue — submitted coroutines run
+  strictly FIFO, one at a time (the reference's AsyncRunner backs every
+  single-writer component; the RPC fabric's per-orderKey pipelines use the
+  same discipline).
+- ``async_retry``: bounded exponential-backoff retry for awaitables
+  (≈ AsyncRetry.exec).
+- ``RendezvousHash``: highest-random-weight node selection — stable per
+  key, ~1/n keys move on membership change (≈ RendezvousHash.java; used
+  for deliverer pick and server routing).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+from typing import Awaitable, Callable, Iterable, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class AsyncRunner:
+    """Serialized async task queue; ``submit`` returns a future resolving
+    with the coroutine's result once its turn completes."""
+
+    def __init__(self) -> None:
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+        self._closed = False
+
+    def _ensure_loop(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.create_task(self._drain())
+
+    def submit(self, coro_fn: Callable[[], Awaitable[T]]) -> "asyncio.Future[T]":
+        if self._closed:
+            raise RuntimeError("runner closed")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._queue.put_nowait((coro_fn, fut))
+        self._ensure_loop()
+        return fut
+
+    async def _drain(self) -> None:
+        while not self._queue.empty():
+            coro_fn, fut = self._queue.get_nowait()
+            try:
+                result = await coro_fn()
+                if not fut.done():
+                    fut.set_result(result)
+            except Exception as e:  # noqa: BLE001
+                if not fut.done():
+                    fut.set_exception(e)
+
+    async def await_done(self) -> None:
+        """Drain barrier: resolves once everything submitted so far ran."""
+        if self._task is not None and not self._task.done():
+            await self._task
+
+    def close(self) -> None:
+        self._closed = True
+
+
+async def async_retry(fn: Callable[[], Awaitable[T]], *,
+                      retries: int = 3, base_delay: float = 0.05,
+                      max_delay: float = 2.0,
+                      retry_on=(Exception,)) -> T:
+    """Run ``fn`` with bounded exponential backoff (≈ AsyncRetry.exec)."""
+    delay = base_delay
+    for attempt in range(retries + 1):
+        try:
+            return await fn()
+        except retry_on:
+            if attempt == retries:
+                raise
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, max_delay)
+    raise AssertionError("unreachable")
+
+
+class RendezvousHash:
+    """Highest-random-weight selection over a node set."""
+
+    def __init__(self, nodes: Iterable[str] = ()) -> None:
+        self._nodes: List[str] = sorted(set(nodes))
+
+    def add(self, node: str) -> None:
+        if node not in self._nodes:
+            self._nodes.append(node)
+            self._nodes.sort()
+
+    def remove(self, node: str) -> None:
+        if node in self._nodes:
+            self._nodes.remove(node)
+
+    @property
+    def nodes(self) -> List[str]:
+        return list(self._nodes)
+
+    @staticmethod
+    def _score(node: str, key: str) -> int:
+        h = hashlib.blake2b(f"{node}|{key}".encode(),
+                            digest_size=8).digest()
+        return int.from_bytes(h, "big")
+
+    def pick(self, key: str) -> Optional[str]:
+        if not self._nodes:
+            return None
+        return max(self._nodes, key=lambda n: self._score(n, key))
+
+    def ranked(self, key: str, n: int = 2) -> List[str]:
+        """Top-n nodes for a key (replica placement)."""
+        return sorted(self._nodes, key=lambda x: -self._score(x, key))[:n]

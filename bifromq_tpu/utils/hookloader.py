@@ -1,0 +1,51 @@
+"""Hook discovery (≈ base-hookloader BaseHookLoader.java).
+
+The reference loads SPI factory classes named in system properties from
+the classpath; here hooks are dotted ``module:attr`` (or ``module.attr``)
+paths named in environment variables / config values, resolved with
+importlib and cached per interface. Used to plug custom auth providers,
+setting providers, throttlers, balancers etc. into the starter without
+code changes.
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+from typing import Any, Dict, Optional, Type
+
+log = logging.getLogger(__name__)
+
+_cache: Dict[str, Any] = {}
+
+
+def load_hook(path: str, expected_type: Optional[Type] = None,
+              *init_args, **init_kwargs) -> Any:
+    """Instantiate the hook class at ``module:attr`` (cached per path).
+
+    Raises TypeError when the instance doesn't satisfy ``expected_type``.
+    """
+    if path in _cache:
+        return _cache[path]
+    mod_name, _, attr = path.replace(":", ".").rpartition(".")
+    if not mod_name:
+        raise ValueError(f"hook path {path!r} needs module.attr form")
+    cls = getattr(importlib.import_module(mod_name), attr)
+    obj = cls(*init_args, **init_kwargs)
+    if expected_type is not None and not isinstance(obj, expected_type):
+        raise TypeError(f"{path} is {type(obj).__name__}, expected "
+                        f"{expected_type.__name__}")
+    _cache[path] = obj
+    return obj
+
+
+def load_optional(path: Optional[str], expected_type: Optional[Type] = None,
+                  default: Any = None) -> Any:
+    """Best-effort variant: falls back to ``default`` (logged) on failure."""
+    if not path:
+        return default
+    try:
+        return load_hook(path, expected_type)
+    except Exception:  # noqa: BLE001
+        log.exception("failed to load hook %s; using default", path)
+        return default

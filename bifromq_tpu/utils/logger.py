@@ -1,0 +1,35 @@
+"""Structured context logging (≈ base-logger MDCLogger.java).
+
+``MDCLogger`` wraps a stdlib logger and injects mapped diagnostic context
+tags (store id, range id, tenant…) into every record — the reference tags
+slf4j MDC so multi-range/multi-tenant logs stay attributable. Context
+composes: ``with_context(rangeId=...)`` derives a child logger carrying
+the union of tags; tags render as a stable ``k=v`` prefix.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict
+
+
+class MDCLogger(logging.LoggerAdapter):
+    def __init__(self, logger: logging.Logger,
+                 **tags: Any) -> None:
+        super().__init__(logger, dict(tags))
+
+    def with_context(self, **tags: Any) -> "MDCLogger":
+        merged = dict(self.extra)
+        merged.update(tags)
+        return MDCLogger(self.logger, **merged)
+
+    def process(self, msg, kwargs):
+        if self.extra:
+            prefix = " ".join(f"{k}={v}" for k, v in
+                              sorted(self.extra.items()))
+            msg = f"[{prefix}] {msg}"
+        return msg, kwargs
+
+
+def mdc_logger(name: str, **tags: Any) -> MDCLogger:
+    return MDCLogger(logging.getLogger(name), **tags)

@@ -1,0 +1,78 @@
+"""Typed system-property flags (≈ bifromq-sysprops BifroMQSysProp.java).
+
+Each prop has a typed parser + default; values resolve from environment
+variables (``BIFROMQ_<NAME>``) the way the reference resolves JVM
+``-D`` properties, with the same resolve-once-then-cache semantics and a
+test hook to override. The prop set mirrors the reference's most
+load-bearing entries (DistMatchParallelism, DeliverersPerMqttServer, …)
+plus TPU-specific knobs (match batch bucket, walk width).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+from typing import Any, Callable, Dict, Optional
+
+
+def _bool(v: str) -> bool:
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+class SysProp(enum.Enum):
+    """(env suffix, parser, default)"""
+
+    # dist plane (≈ DistMatchParallelism, DistTopicMatchExpirySeconds,
+    # DistMaxCachedRoutesPerTenant ...)
+    DIST_MATCH_PARALLELISM = ("DIST_MATCH_PARALLELISM", int, 4)
+    DIST_FANOUT_PARALLELISM = ("DIST_FANOUT_PARALLELISM", int, 8)
+    DIST_WORKER_SPLIT_THRESHOLD = ("DIST_WORKER_SPLIT_THRESHOLD", int, 0)
+    DIST_GC_INTERVAL_SECONDS = ("DIST_GC_INTERVAL_SECONDS", float, 600.0)
+    # mqtt plane (≈ DeliverersPerMqttServer, IngressSlowDownDirectMemoryUsage)
+    DELIVERERS_PER_MQTT_SERVER = ("DELIVERERS_PER_MQTT_SERVER", int, 16)
+    CONNECT_TIMEOUT_SECONDS = ("CONNECT_TIMEOUT_SECONDS", float, 10.0)
+    MAX_CONN_PER_SECOND = ("MAX_CONN_PER_SECOND", int, 2000)
+    INGRESS_SLOWDOWN_MEM_USAGE = ("INGRESS_SLOWDOWN_MEM_USAGE", float, 0.9)
+    # TPU match plane
+    MATCH_BATCH_BUCKET = ("MATCH_BATCH_BUCKET", int, 8192)
+    MATCH_WALK_WIDTH = ("MATCH_WALK_WIDTH", int, 16)
+    MATCH_MAX_LEVELS = ("MATCH_MAX_LEVELS", int, 16)
+    MATCHER_COMPACT_THRESHOLD = ("MATCHER_COMPACT_THRESHOLD", int, 2048)
+    # raft / kv
+    RAFT_TICK_INTERVAL_SECONDS = ("RAFT_TICK_INTERVAL_SECONDS", float, 0.01)
+    KV_SYNC_ON_COMMIT = ("KV_SYNC_ON_COMMIT", _bool, False)
+
+    def __init__(self, env_suffix: str, parser: Callable[[str], Any],
+                 default: Any) -> None:
+        self.env_suffix = env_suffix
+        self.parser = parser
+        self.default = default
+
+
+_cache: Dict[SysProp, Any] = {}
+_overrides: Dict[SysProp, Any] = {}
+
+
+def get(prop: SysProp) -> Any:
+    """Resolve a prop: override > env var (parsed) > default; cached."""
+    if prop in _overrides:
+        return _overrides[prop]
+    if prop not in _cache:
+        raw = os.environ.get(f"BIFROMQ_{prop.env_suffix}")
+        if raw is None:
+            _cache[prop] = prop.default
+        else:
+            try:
+                _cache[prop] = prop.parser(raw)
+            except (ValueError, TypeError):
+                _cache[prop] = prop.default
+    return _cache[prop]
+
+
+def override(prop: SysProp, value: Optional[Any]) -> None:
+    """Test hook: force a value (None clears)."""
+    if value is None:
+        _overrides.pop(prop, None)
+    else:
+        _overrides[prop] = value
+    _cache.pop(prop, None)

@@ -1,0 +1,268 @@
+"""Base infrastructure components: sysprops, env/mem-pressure, hookloader,
+MDC logger, AsyncRunner/retry/rendezvous, dist GC sweep, cross-node
+session dict, client balancer redirect, connection admission."""
+
+import asyncio
+import logging
+import os
+
+import pytest
+
+from bifromq_tpu.mqtt.broker import MQTTBroker
+from bifromq_tpu.mqtt.client import MQTTClient, MQTTClientError
+from bifromq_tpu.utils import sysprops
+from bifromq_tpu.utils.async_util import (AsyncRunner, RendezvousHash,
+                                          async_retry)
+from bifromq_tpu.utils.env import EnvProvider, MemUsage
+from bifromq_tpu.utils.hookloader import load_hook, load_optional
+from bifromq_tpu.utils.logger import mdc_logger
+
+pytestmark = pytest.mark.asyncio
+
+
+class TestSysProps:
+    def test_default_env_override_precedence(self):
+        p = sysprops.SysProp.DIST_MATCH_PARALLELISM
+        sysprops.override(p, None)
+        assert sysprops.get(p) == 4
+        os.environ["BIFROMQ_DIST_MATCH_PARALLELISM"] = "9"
+        sysprops._cache.pop(p, None)
+        try:
+            assert sysprops.get(p) == 9
+            sysprops.override(p, 2)
+            assert sysprops.get(p) == 2
+        finally:
+            del os.environ["BIFROMQ_DIST_MATCH_PARALLELISM"]
+            sysprops.override(p, None)
+            sysprops._cache.pop(p, None)
+
+    def test_bad_value_falls_back_to_default(self):
+        p = sysprops.SysProp.MATCH_WALK_WIDTH
+        os.environ["BIFROMQ_MATCH_WALK_WIDTH"] = "not-a-number"
+        sysprops._cache.pop(p, None)
+        try:
+            assert sysprops.get(p) == 16
+        finally:
+            del os.environ["BIFROMQ_MATCH_WALK_WIDTH"]
+            sysprops._cache.pop(p, None)
+
+
+class TestEnv:
+    def test_mem_usage_probe(self):
+        m = MemUsage(budget_bytes=1 << 40, sample_interval=0)
+        assert 0 <= m.usage() < 0.01
+        assert not m.under_pressure()
+        tiny = MemUsage(budget_bytes=1, sample_interval=0)
+        assert tiny.under_pressure()
+
+    def test_env_provider_named_executor(self):
+        env = EnvProvider()
+        pool = env.executor("test-pool", max_workers=1)
+        assert pool is env.executor("test-pool")
+        name = pool.submit(lambda: __import__("threading")
+                           .current_thread().name).result()
+        assert name.startswith("test-pool")
+        env.shutdown()
+
+
+class TestHookLoader:
+    def test_load_and_cache(self):
+        h1 = load_hook("bifromq_tpu.plugin.auth:AllowAllAuthProvider")
+        h2 = load_hook("bifromq_tpu.plugin.auth:AllowAllAuthProvider")
+        assert h1 is h2
+
+    def test_type_check_and_optional_fallback(self):
+        from bifromq_tpu.plugin.throttler import IResourceThrottler
+        with pytest.raises(TypeError):
+            load_hook("bifromq_tpu.plugin.auth:AuthData", IResourceThrottler)
+        sentinel = object()
+        assert load_optional("no.such.module:X", default=sentinel) is sentinel
+        assert load_optional(None, default=sentinel) is sentinel
+
+
+class TestMDCLogger:
+    def test_context_tags_prefix(self, caplog):
+        log = mdc_logger("t.mdc", storeId="s1").with_context(rangeId="r7")
+        with caplog.at_level(logging.INFO, logger="t.mdc"):
+            log.info("applied %d", 3)
+        assert "[rangeId=r7 storeId=s1] applied 3" in caplog.text
+
+
+class TestAsyncUtil:
+    async def test_async_runner_fifo(self):
+        runner = AsyncRunner()
+        seen = []
+
+        async def job(i, delay):
+            await asyncio.sleep(delay)
+            seen.append(i)
+            return i
+
+        futs = [runner.submit(lambda i=i, d=0.02 - i * 0.005: job(i, d))
+                for i in range(4)]
+        results = await asyncio.gather(*futs)
+        assert results == [0, 1, 2, 3]
+        assert seen == [0, 1, 2, 3]  # strict FIFO despite inverse delays
+
+    async def test_async_retry_backoff(self):
+        attempts = []
+
+        async def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise ValueError("try again")
+            return "done"
+
+        out = await async_retry(flaky, retries=4, base_delay=0.001)
+        assert out == "done" and len(attempts) == 3
+        with pytest.raises(ValueError):
+            await async_retry(flaky_always, retries=1, base_delay=0.001)
+
+    async def test_rendezvous_stability(self):
+        rh = RendezvousHash(["a", "b", "c"])
+        before = {f"k{i}": rh.pick(f"k{i}") for i in range(100)}
+        rh.remove("b")
+        moved = sum(1 for k, v in before.items()
+                    if v != "b" and rh.pick(k) != v)
+        assert moved == 0  # only keys on the removed node move
+        assert len(rh.ranked("k1", 2)) == 2
+
+
+async def flaky_always():
+    raise ValueError("always")
+
+
+class TestDistGC:
+    async def test_gc_sweep_removes_dead_routes(self):
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        await broker.start()
+        try:
+            c = MQTTClient("127.0.0.1", broker.port, client_id="gc1")
+            await c.connect()
+            await c.subscribe("gc/+", qos=0)
+            assert len(list(broker.dist.worker.space.iterate())) == 1
+            # simulate a dead receiver: session vanishes without unroute
+            broker.local_sessions._by_id.clear()
+            removed = await broker.dist.gc_sweep()
+            assert removed == 1
+            assert len(list(broker.dist.worker.space.iterate())) == 0
+            await c.disconnect()
+        finally:
+            await broker.stop()
+
+
+class TestSessionDict:
+    async def test_cluster_wide_kick_and_exist(self):
+        from bifromq_tpu.rpc.fabric import RPCServer, ServiceRegistry
+        from bifromq_tpu.sessiondict import (SessionDictClient,
+                                             SessionDictRPCService)
+        from bifromq_tpu.sessiondict.service import SERVICE
+
+        reg = ServiceRegistry()
+        brokers, servers = [], []
+        for _ in range(2):
+            b = MQTTBroker(host="127.0.0.1", port=0)
+            await b.start()
+            srv = RPCServer()
+            SessionDictRPCService(b).register(srv)
+            await srv.start()
+            reg.announce(SERVICE, srv.address)
+            b.session_dict = SessionDictClient(reg,
+                                              self_address=srv.address)
+            brokers.append(b)
+            servers.append(srv)
+        try:
+            c1 = MQTTClient("127.0.0.1", brokers[0].port, client_id="dup",
+                            protocol_level=5)
+            await c1.connect()
+            sd = brokers[1].session_dict
+            assert await sd.exist("DevOnly", ["dup", "ghost"]) == [True,
+                                                                   False]
+            # same client id connects to broker B: A's session is kicked
+            c2 = MQTTClient("127.0.0.1", brokers[1].port, client_id="dup",
+                            protocol_level=5)
+            await c2.connect()
+            await asyncio.wait_for(c1.closed.wait(), 5)
+            assert brokers[0].session_registry.get("DevOnly", "dup") is None
+            assert brokers[1].session_registry.get("DevOnly",
+                                                   "dup") is not None
+            await c2.disconnect()
+        finally:
+            for b in brokers:
+                await b.stop()
+            for s in servers:
+                await s.stop()
+
+
+class TestClientBalancer:
+    async def test_redirect_on_connect(self):
+        from bifromq_tpu.plugin.balancer import (IClientBalancer,
+                                                 RedirectType,
+                                                 ServerRedirection)
+
+        class MoveAll(IClientBalancer):
+            def need_redirect(self, client):
+                return ServerRedirection(RedirectType.TEMPORARY,
+                                         "other:1883")
+
+        broker = MQTTBroker(host="127.0.0.1", port=0, balancer=MoveAll())
+        await broker.start()
+        try:
+            c = MQTTClient("127.0.0.1", broker.port, client_id="r",
+                           protocol_level=5)
+            with pytest.raises(MQTTClientError, match="156"):
+                await c.connect()
+            from bifromq_tpu.mqtt.protocol import PropertyId
+            assert c.connack.properties[
+                PropertyId.SERVER_REFERENCE] == "other:1883"
+        finally:
+            await broker.stop()
+
+
+class TestAdmission:
+    async def test_mem_pressure_rejects_connections(self):
+        broker = MQTTBroker(host="127.0.0.1", port=0,
+                            mem_usage=MemUsage(budget_bytes=1,
+                                               sample_interval=0))
+        await broker.start()
+        try:
+            c = MQTTClient("127.0.0.1", broker.port, client_id="x")
+            with pytest.raises(Exception):
+                await c.connect(timeout=2)
+        finally:
+            await broker.stop()
+
+
+class TestClusteredStarter:
+    async def test_two_standalone_nodes_cluster_wide_kick(self):
+        from bifromq_tpu.starter import Standalone
+
+        n1 = Standalone({"mqtt": {"host": "127.0.0.1", "tcp": {"port": 0}},
+                         "cluster": {"node_id": "sn1", "port": 0}})
+        await n1.start()
+        n2 = Standalone({
+            "mqtt": {"host": "127.0.0.1", "tcp": {"port": 0}},
+            "cluster": {"node_id": "sn2", "port": 0,
+                        "seeds": [f"127.0.0.1:{n1.agent_host.port}"]}})
+        await n2.start()
+        try:
+            # wait for gossip to spread the session-dict endpoints
+            for _ in range(200):
+                if (n1.broker.session_dict.registry.endpoints(
+                        "session-dict")
+                        and len(n2.broker.session_dict.registry.endpoints(
+                            "session-dict")) >= 2):
+                    break
+                await asyncio.sleep(0.02)
+            c1 = MQTTClient("127.0.0.1", n1.broker.port, client_id="one",
+                            protocol_level=5)
+            await c1.connect()
+            c2 = MQTTClient("127.0.0.1", n2.broker.port, client_id="one",
+                            protocol_level=5)
+            await c2.connect()
+            await asyncio.wait_for(c1.closed.wait(), 5)
+            assert n1.broker.session_registry.get("DevOnly", "one") is None
+            await c2.disconnect()
+        finally:
+            await n2.stop()
+            await n1.stop()
