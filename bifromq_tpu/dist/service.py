@@ -109,23 +109,27 @@ class DistService:
             # remote worker: the sweep must run in the worker process (it
             # owns the keyspace); the frontend has nothing to scan
             return 0
+        # batch checks per (broker, tenant) — the ISubBroker SPI is batched
+        # exactly for this (≈ SubscriptionCleaner batching)
+        groups: Dict[Tuple[int, str], List[Route]] = {}
+        for tenant_id, route in self.worker._iter_all_routes():
+            if self.sub_brokers.has(route.broker_id):
+                groups.setdefault((route.broker_id, tenant_id),
+                                  []).append(route)
         removed = 0
-        for tenant_id, route in list(self.worker._iter_all_routes()):
-            if not self.sub_brokers.has(route.broker_id):
-                continue
-            broker = self.sub_brokers.get(route.broker_id)
-            mi = MatchInfo(matcher=route.matcher,
-                           receiver_id=route.receiver_id,
-                           incarnation=route.incarnation)
+        for (broker_id, tenant_id), routes in groups.items():
+            broker = self.sub_brokers.get(broker_id)
+            mis = [MatchInfo(matcher=r.matcher, receiver_id=r.receiver_id,
+                             incarnation=r.incarnation) for r in routes]
             try:
-                alive = await broker.check_subscriptions(tenant_id, [mi])
+                alive = await broker.check_subscriptions(tenant_id, mis)
             except Exception:  # noqa: BLE001
                 continue
-            if not alive[0]:
-                await self.worker.remove_route(
-                    tenant_id, route.matcher, route.receiver_url,
-                    route.incarnation)
-                removed += 1
+            for r, ok in zip(routes, alive):
+                if not ok:
+                    await self.worker.remove_route(
+                        tenant_id, r.matcher, r.receiver_url, r.incarnation)
+                    removed += 1
         return removed
 
     # ---------------- route mutations (≈ batchAddRoute/batchRemoveRoute) ---

@@ -112,6 +112,27 @@ class InstallSnapshot:
 
 
 @dataclass
+class SnapshotChunk:
+    """One chunk of a snapshot dump session (≈ KVRangeDumpSession
+    streaming snapshot KVs to a lagging replica). ``meta`` rides the first
+    chunk; ``last`` marks the final one."""
+    term: int
+    leader: str
+    session_id: int
+    seq: int
+    data: bytes
+    last: bool
+    meta: Optional[Snapshot] = None   # snapshot WITHOUT data (first chunk)
+
+
+@dataclass
+class SnapshotChunkAck:
+    term: int
+    session_id: int
+    seq: int
+
+
+@dataclass
 class SnapshotReply:
     term: int
     match_index: int
@@ -145,6 +166,10 @@ class RaftNode:
     HEARTBEAT_TICKS = 2
     MAX_ENTRIES_PER_APPEND = 64
     SNAPSHOT_THRESHOLD = 256    # compact when log grows beyond this
+    SNAPSHOT_CHUNK_BYTES = 64 * 1024
+    # bandwidth governor (≈ SnapshotBandwidthGovernor): bytes of snapshot
+    # chunks a leader may ship per tick, across all dump sessions
+    SNAPSHOT_BYTES_PER_TICK = 256 * 1024
 
     def __init__(self, node_id: str, voters: List[str],
                  transport: ITransport, *,
@@ -193,6 +218,12 @@ class RaftNode:
         self._read_ctx_seq = 0
         self._term_start_index = 0  # index of this term's no-op (leader)
         self._transfer_target: Optional[str] = None
+        # leader-side dump sessions: peer -> {id, snap, offset, inflight}
+        self._dump_sessions: Dict[str, dict] = {}
+        self._dump_session_seq = 0
+        self._dump_budget = 0       # governor tokens (bytes), refilled per tick
+        # follower-side restore session: {id, leader, meta, chunks: {seq: b}}
+        self._restore_session: Optional[dict] = None
         self.stopped = False
 
     # ---------------- persistence ------------------------------------------
@@ -276,6 +307,10 @@ class RaftNode:
             if self._heartbeat_elapsed >= self.HEARTBEAT_TICKS:
                 self._heartbeat_elapsed = 0
                 self._broadcast_append()
+            self._dump_budget = min(self.SNAPSHOT_BYTES_PER_TICK * 4,
+                                    self._dump_budget
+                                    + self.SNAPSHOT_BYTES_PER_TICK)
+            self._pump_dump_sessions(tick=True)
         else:
             self._election_elapsed += 1
             if self._election_elapsed >= self._election_deadline:
@@ -394,6 +429,10 @@ class RaftNode:
             self._on_append_reply(sender, msg)
         elif isinstance(msg, InstallSnapshot):
             self._on_install_snapshot(sender, msg)
+        elif isinstance(msg, SnapshotChunk):
+            self._on_snapshot_chunk(sender, msg)
+        elif isinstance(msg, SnapshotChunkAck):
+            self._on_snapshot_chunk_ack(sender, msg)
         elif isinstance(msg, SnapshotReply):
             self._on_snapshot_reply(sender, msg)
         elif isinstance(msg, TimeoutNow):
@@ -414,6 +453,7 @@ class RaftNode:
         self._election_deadline = self._rand_election()
         if prev_role == Role.LEADER:
             self._fail_waiters()
+            self._dump_sessions.clear()
 
     def _start_prevote(self) -> None:
         """Probe electability before burning a term (pre-vote)."""
@@ -527,10 +567,10 @@ class RaftNode:
                      read_ctx: Optional[int] = None) -> None:
         nxt = self._next_index.get(peer, self.last_index + 1)
         if nxt <= self.snap.last_index:
-            # ship the materialized snapshot: its data was captured at
-            # compaction time and is consistent with its last_index label
-            self.transport.send(peer, self.id, InstallSnapshot(
-                term=self.term, leader=self.id, snapshot=self.snap))
+            # ship the materialized snapshot via a chunked dump session
+            # (its data was captured at compaction time and is consistent
+            # with its last_index label)
+            self._start_dump_session(peer)
             return
         prev_index = nxt - 1
         prev_term = self._term_at(prev_index)
@@ -699,31 +739,131 @@ class RaftNode:
             self.store.save_snapshot(self.snap)
             self.store.truncate_prefix(cut)
 
-    def _on_install_snapshot(self, sender: str, msg: InstallSnapshot) -> None:
+    # ----- chunked dump sessions (≈ KVRangeDumpSession / KVRangeRestorer) --
+
+    def _start_dump_session(self, peer: str) -> None:
+        sess = self._dump_sessions.get(peer)
+        if sess is not None and sess["snap"] is self.snap:
+            return  # already streaming this snapshot
+        self._dump_session_seq += 1
+        self._dump_sessions[peer] = {
+            "id": self._dump_session_seq,
+            "snap": self.snap,
+            "offset": 0,
+            "awaiting_ack": None,   # seq in flight, stop-and-wait
+            "next_seq": 0,
+        }
+
+    DUMP_ACK_TIMEOUT_TICKS = 20
+
+    def _pump_dump_sessions(self, tick: bool = False) -> None:
+        """Ship chunks within the governor's byte budget; a chunk unacked
+        for DUMP_ACK_TIMEOUT_TICKS restarts the session (chunks can be lost
+        while the peer is still partitioned). ``age`` counts TICKS only —
+        ack-triggered pumps must not age other peers' sessions."""
+        for peer, sess in list(self._dump_sessions.items()):
+            if sess["awaiting_ack"] is not None:
+                if tick:
+                    sess["age"] = sess.get("age", 0) + 1
+                if sess.get("age", 0) >= self.DUMP_ACK_TIMEOUT_TICKS:
+                    self._dump_session_seq += 1
+                    sess.update(id=self._dump_session_seq, offset=0,
+                                awaiting_ack=None, next_seq=0, age=0)
+                else:
+                    continue
+            if self._dump_budget < self.SNAPSHOT_CHUNK_BYTES \
+                    and sess["offset"] > 0:
+                continue  # out of budget this tick
+            snap: Snapshot = sess["snap"]
+            data = snap.data
+            off = sess["offset"]
+            chunk = data[off:off + self.SNAPSHOT_CHUNK_BYTES]
+            last = off + len(chunk) >= len(data)
+            meta = None
+            if sess["next_seq"] == 0:
+                meta = Snapshot(last_index=snap.last_index,
+                                last_term=snap.last_term, data=b"",
+                                voters=snap.voters,
+                                voters_old=snap.voters_old)
+            self.transport.send(peer, self.id, SnapshotChunk(
+                term=self.term, leader=self.id, session_id=sess["id"],
+                seq=sess["next_seq"], data=chunk, last=last, meta=meta))
+            self._dump_budget -= len(chunk)
+            sess["awaiting_ack"] = sess["next_seq"]
+            sess["age"] = 0
+            sess["next_seq"] += 1
+            sess["offset"] = off + len(chunk)
+
+    def _on_snapshot_chunk_ack(self, sender: str,
+                               msg: SnapshotChunkAck) -> None:
+        if self.role != Role.LEADER or msg.term != self.term:
+            return
+        sess = self._dump_sessions.get(sender)
+        if sess is None or sess["id"] != msg.session_id:
+            return
+        if sess["awaiting_ack"] == msg.seq:
+            sess["awaiting_ack"] = None
+            if sess["offset"] >= len(sess["snap"].data):
+                del self._dump_sessions[sender]  # done; reply advances peer
+            else:
+                self._pump_dump_sessions()
+
+    def _on_snapshot_chunk(self, sender: str, msg: SnapshotChunk) -> None:
         if msg.term < self.term:
             return
         self._become_follower(msg.term, msg.leader)
-        if msg.snapshot.last_index <= self.commit_index:
+        rs = self._restore_session
+        if msg.seq == 0:
+            rs = self._restore_session = {
+                "id": msg.session_id, "leader": msg.leader,
+                "meta": msg.meta, "chunks": [],
+            }
+        if rs is None or rs["id"] != msg.session_id \
+                or msg.seq != len(rs["chunks"]):
+            # stale/out-of-order session: drop (leader restarts a session)
+            self._restore_session = None
+            return
+        rs["chunks"].append(msg.data)
+        self.transport.send(sender, self.id, SnapshotChunkAck(
+            term=self.term, session_id=msg.session_id, seq=msg.seq))
+        if msg.last:
+            meta: Snapshot = rs["meta"]
+            self._restore_session = None
+            snap = Snapshot(last_index=meta.last_index,
+                            last_term=meta.last_term,
+                            data=b"".join(rs["chunks"]),
+                            voters=meta.voters,
+                            voters_old=meta.voters_old)
+            self._install_snapshot_obj(sender, snap)
+
+    def _install_snapshot_obj(self, sender: str, snapshot: Snapshot) -> None:
+        if snapshot.last_index <= self.commit_index:
             self.transport.send(sender, self.id, SnapshotReply(
                 term=self.term, match_index=self.commit_index))
             return
-        self.snap = msg.snapshot
+        self.snap = snapshot
         self.log = []
-        self.commit_index = msg.snapshot.last_index
-        self.last_applied = msg.snapshot.last_index
-        self.voters = set(msg.snapshot.voters)
-        self.voters_old = (set(msg.snapshot.voters_old)
-                           if msg.snapshot.voters_old is not None else None)
-        # a snapshot only covers applied entries, so any joint config in it
-        # is already committed
-        self._joint_index = (msg.snapshot.last_index
+        self.commit_index = snapshot.last_index
+        self.last_applied = snapshot.last_index
+        self.voters = set(snapshot.voters)
+        self.voters_old = (set(snapshot.voters_old)
+                           if snapshot.voters_old is not None else None)
+        self._joint_index = (snapshot.last_index
                              if self.voters_old is not None else None)
         if self.store is not None:
-            self.store.save_snapshot(msg.snapshot)
+            self.store.save_snapshot(snapshot)
             self.store.truncate_prefix(1 << 60)
-        self.restore_cb(msg.snapshot.data)
+        self.restore_cb(snapshot.data)
         self.transport.send(sender, self.id, SnapshotReply(
-            term=self.term, match_index=msg.snapshot.last_index))
+            term=self.term, match_index=snapshot.last_index))
+
+    def _on_install_snapshot(self, sender: str, msg: InstallSnapshot) -> None:
+        """Legacy single-message install (in-proc tests); live transfers
+        go through the chunked dump session path."""
+        if msg.term < self.term:
+            return
+        self._become_follower(msg.term, msg.leader)
+        self._install_snapshot_obj(sender, msg.snapshot)
 
     def _on_snapshot_reply(self, sender: str, msg: SnapshotReply) -> None:
         if self.role != Role.LEADER or msg.term != self.term:

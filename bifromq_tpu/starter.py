@@ -127,6 +127,9 @@ class Standalone:
             await self.api.stop()
         if self.rpc_server is not None:
             await self.rpc_server.stop()
+        if (self.broker is not None
+                and getattr(self.broker, "session_dict", None) is not None):
+            await self.broker.session_dict.registry.close()
         if self.broker is not None:
             await self.broker.stop()
         if self.agent_host is not None:

@@ -25,8 +25,9 @@ def load_hook(path: str, expected_type: Optional[Type] = None,
 
     Raises TypeError when the instance doesn't satisfy ``expected_type``.
     """
-    if path in _cache:
-        return _cache[path]
+    key = (path, init_args, tuple(sorted(init_kwargs.items())))
+    if key in _cache:
+        return _cache[key]
     mod_name, _, attr = path.replace(":", ".").rpartition(".")
     if not mod_name:
         raise ValueError(f"hook path {path!r} needs module.attr form")
@@ -35,7 +36,7 @@ def load_hook(path: str, expected_type: Optional[Type] = None,
     if expected_type is not None and not isinstance(obj, expected_type):
         raise TypeError(f"{path} is {type(obj).__name__}, expected "
                         f"{expected_type.__name__}")
-    _cache[path] = obj
+    _cache[key] = obj
     return obj
 
 
