@@ -61,6 +61,38 @@ class RangeSplitBalancer:
         return None
 
 
+class MergeCommand:
+    def __init__(self, left_id: str, right_id: str) -> None:
+        self.left_id = left_id
+        self.right_id = right_id
+
+    def __repr__(self) -> str:
+        return f"Merge({self.left_id} <- {self.right_id})"
+
+
+class RangeMergeBalancer:
+    """Merge adjacent under-filled leader ranges (the shrink half of
+    elasticity): when two neighbors together hold fewer than ``min_keys``
+    keys, fold the right one into the left (≈ the reference's merge
+    balancing driven from range load facts)."""
+
+    def __init__(self, min_keys: int = 1000) -> None:
+        self.min_keys = min_keys
+
+    def balance(self, store: KVRangeStore) -> List["MergeCommand"]:
+        ordered = store.router.ranges()  # boundary-sorted
+        for ((_s1, e1), left), ((s2, _e2), right) in zip(ordered,
+                                                         ordered[1:]):
+            if e1 != s2:
+                continue
+            lr, rr = store.ranges[left], store.ranges[right]
+            if not (lr.is_leader and rr.is_leader):
+                continue
+            if len(lr.space) + len(rr.space) < self.min_keys:
+                return [MergeCommand(left, right)]  # one merge per round
+        return []
+
+
 class KVStoreBalanceController:
     """Runs the balancer set on an interval against one store."""
 
@@ -80,6 +112,11 @@ class KVStoreBalanceController:
                         sib = await self.store.split(cmd.range_id,
                                                      cmd.split_key)
                         log.info("split %s -> %s", cmd.range_id, sib)
+                        executed += 1
+                    elif isinstance(cmd, MergeCommand):
+                        await self.store.merge(cmd.left_id, cmd.right_id)
+                        log.info("merged %s <- %s", cmd.left_id,
+                                 cmd.right_id)
                         executed += 1
                 except Exception:  # noqa: BLE001 — keep balancing others
                     log.exception("balance command failed: %r", cmd)

@@ -2,8 +2,9 @@
 
 A deliberately lean re-expression of the reference's range replica
 (base-kv-store-server .../store/range/KVRangeFSM.java:164 — raft WAL + data
-space + apply loop + coproc), minus split/merge (SURVEY.md §7 defers the
-dual-range merge handshake to a later round):
+space + apply loop + coproc). Split and the two-phase merge handshake live
+in the hosting store (kv/store.py) behind the on_split/on_seal/on_merge
+apply hooks:
 
 - mutations serialize into raft entries; the apply loop executes them on the
   local space in commit order on every replica
@@ -114,6 +115,15 @@ class ReplicatedKVRange:
     # set by a hosting KVRangeStore: fn(split_key) runs the deterministic
     # split state transfer at this entry's apply position on every replica
     on_split = None
+    # merge hooks (≈ KVRangeFSM's dual-range merge state machine):
+    # on_seal(sealed: bool) toggles this range's write seal; on_merge(
+    # payload) folds a sealed sibling into this range — both run at apply
+    # position on every replica
+    on_seal = None
+    on_merge = None
+    # derived deterministically from the log (seal/unseal apply positions);
+    # blocks EVERY mutation kind, including raw kv batches
+    sealed = False
 
     def _apply(self, entry: LogEntry) -> None:
         data = entry.data
@@ -121,15 +131,26 @@ class ReplicatedKVRange:
             return
         kind = data[0]
         if kind == 0:
-            self._apply_kv_batch(data)
+            if not self.sealed:  # sealed: content is frozen for the merge
+                self._apply_kv_batch(data)
         elif kind == 2:  # split marker (≈ KVRangeFSM WALSplit command)
             if self.on_split is not None:
                 self.on_split(data[1:])
+        elif kind == 3:  # seal/unseal marker (merge ph.1, ≈ WALPrepareMerge)
+            self.sealed = bool(data[1]) if len(data) > 1 else True
+            if self.on_seal is not None:
+                self.on_seal(self.sealed)
+        elif kind == 4:  # merge-commit payload (phase 2, ≈ WALMerge)
+            if self.on_merge is not None:
+                self.on_merge(data[1:])
         else:
-            writer = self.space.writer()
-            out = (self.coproc.mutate(data[1:], self.space, writer)
-                   if self.coproc is not None else b"")
-            writer.done()
+            if self.sealed:
+                out = b"retry"
+            else:
+                writer = self.space.writer()
+                out = (self.coproc.mutate(data[1:], self.space, writer)
+                       if self.coproc is not None else b"")
+                writer.done()
             if entry.index in self._pending_results:
                 self._mutation_results[entry.index] = out
         if self.raft is not None and self.raft.store is not None:
@@ -199,6 +220,18 @@ class ReplicatedKVRange:
         """Replicate a split marker; the hosting store's ``on_split`` hook
         executes the state transfer when it applies."""
         await self.raft.propose(bytes([2]) + split_key)
+
+    async def propose_seal(self, sealed: bool = True) -> None:
+        """Merge phase 1: once this marker applies, no later mutation of
+        ANY kind can change the space — every replica's content is frozen
+        at the same log position (the precondition for a deterministic
+        merge). ``sealed=False`` rolls the seal back (aborted merge)."""
+        await self.raft.propose(bytes([3, int(sealed)]))
+
+    async def propose_merge(self, payload: bytes) -> None:
+        """Merge phase 2 (proposed on the SURVIVING range): payload carries
+        the sealed sibling's id, boundary, and data."""
+        await self.raft.propose(bytes([4]) + payload)
 
     async def mutate_coproc(self, payload: bytes) -> bytes:
         """RW coproc call through consensus (≈ KVRangeRWRequest execute)."""

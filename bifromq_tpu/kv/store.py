@@ -2,8 +2,8 @@
 
 ``KVRangeStore`` hosts many ``ReplicatedKVRange`` replicas on one node
 (≈ base-kv-store-server KVRangeStore.java:101 hosting KVRangeFSMs) and
-executes the **split** half of the reference's split/merge state machine
-(KVRangeFSM.java:164; merge stays future work per SURVEY §7 hard-parts):
+executes BOTH halves of the reference's split/merge state machine
+(KVRangeFSM.java:164 — the SURVEY §7 hard part):
 
 - every range owns a key *boundary* ``[start, end)`` (None end = +inf) and
   its own raft group (per-range member ids ``node:range``);
@@ -12,6 +12,10 @@ executes the **split** half of the reference's split/merge state machine
   created sibling range (new space, new raft group seeded with identical
   FSM state — a snapshot at index 0), boundaries shrink/attach, and the
   coprocs reset to rebuild derived state;
+- a merge is the two-phase seal → merge-commit handshake (see
+  ``KVRangeStore.merge``): the mergee freezes at a log position, its
+  sealed content ships inside the survivor's merge entry, and every
+  replica retires its local mergee deterministically;
 - ``KVRangeRouter`` is the client-side boundary map
   (≈ base-kv-store-client's NavigableMap<Boundary, KVRangeSetting>
   ``latestEffectiveRouter``): find_by_key / intersecting.
@@ -141,6 +145,10 @@ class KVRangeStore:
                               space, coproc=coproc, raft_store=raft_store)
         r.on_split = lambda split_key, rid=range_id: self._apply_split(
             rid, split_key)
+        r.on_seal = lambda sealed, rid=range_id: self._apply_seal(
+            rid, sealed)
+        r.on_merge = lambda payload, rid=range_id: self._apply_merge(
+            rid, payload)
         if hasattr(self.transport, "register"):
             self.transport.register(r.raft)
         self.ranges[range_id] = r
@@ -149,6 +157,11 @@ class KVRangeStore:
         self.router.update(range_id, boundary)
         if hasattr(coproc, "boundary"):
             coproc.boundary = boundary
+        if space.get_metadata(b"sealed") == b"\x01":
+            # a crash between seal and merge-commit must not forget the
+            # seal on this replica while others still enforce it
+            r.sealed = True
+            self._apply_seal(range_id, True)
         coproc.reset(space)
         return r
 
@@ -236,6 +249,10 @@ class KVRangeStore:
                                 self.transport, sib_space, coproc=coproc,
                                 raft_store=raft_store)
         sib.on_split = lambda sk, rid=sibling_id: self._apply_split(rid, sk)
+        sib.on_seal = lambda sealed, rid=sibling_id: self._apply_seal(
+            rid, sealed)
+        sib.on_merge = lambda payload, rid=sibling_id: self._apply_merge(
+            rid, payload)
         if hasattr(self.transport, "register"):
             self.transport.register(sib.raft)
         self.ranges[sibling_id] = sib
@@ -256,6 +273,151 @@ class KVRangeStore:
         self.coprocs[range_id].reset(parent.space)
         coproc.reset(sib_space)
         self._persist_meta()
+
+    # ---------------- merge (≈ KVRangeFSM dual-range merge handshake) ------
+
+    async def merge(self, left_id: str, right_id: str) -> None:
+        """Merge the adjacent range ``right_id`` into ``left_id``.
+
+        Two-phase, mirroring the reference's PrepareMerge/Merge handshake
+        (KVRangeFSM.java:164 — the hard part SURVEY §7 names):
+
+        1. a SEAL entry commits on the mergee: from its apply position no
+           mutation can change the space, so every replica that applied it
+           holds identical content;
+        2. the sealed content ships inside a MERGE entry on the survivor:
+           applying it is deterministic on every replica regardless of the
+           local mergee replica's progress — write the data, extend the
+           boundary, retire the local mergee replica.
+
+        Between seal and merge-apply, mutations on the mergee's keys bounce
+        (``b"retry"``) and re-resolve; once the router flips they land on
+        the survivor (brief unavailability, as in the reference).
+        """
+        import asyncio
+        import time as _time
+
+        from ..raft.node import NotLeaderError
+
+        ls, le = self.boundaries[left_id]
+        rs, re_ = self.boundaries[right_id]
+        if le != rs:
+            raise ValueError("ranges not adjacent")
+        right = self.ranges[right_id]
+
+        async def propose_with_leader_wait(coro_fn, raft, timeout=5.0):
+            deadline = _time.monotonic() + timeout
+            while True:
+                try:
+                    return await coro_fn()
+                except NotLeaderError:
+                    if (_time.monotonic() >= deadline
+                            or raft.leader_id not in (None, raft.id)):
+                        raise
+                    await asyncio.sleep(0.01)
+
+        await propose_with_leader_wait(right.propose_seal, right.raft)
+        # the seal applied locally (propose resolves at apply): the local
+        # mergee content is now the canonical sealed state
+        payload = bytearray()
+        payload += struct.pack(">H", len(right_id.encode()))
+        payload += right_id.encode()
+        payload += struct.pack(">H", len(re_ or b"\xff"))
+        payload += b"\x01" if re_ is not None else b"\x00"
+        payload += re_ if re_ is not None else b""
+        body = bytearray()
+        for k, v in right.space.iterate():
+            body += struct.pack(">I", len(k)) + k
+            body += struct.pack(">I", len(v)) + v
+        payload += struct.pack(">Q", len(body)) + body
+        left = self.ranges[left_id]
+        try:
+            await propose_with_leader_wait(
+                lambda: left.propose_merge(bytes(payload)), left.raft)
+        except BaseException:
+            # phase 2 failed: roll the seal back so the mergee's keyspan
+            # does not stay write-unavailable
+            try:
+                await propose_with_leader_wait(
+                    lambda: right.propose_seal(False), right.raft)
+            except BaseException:  # noqa: BLE001 — surface the original
+                pass
+            raise
+
+    def _apply_seal(self, range_id: str, sealed: bool) -> None:
+        coproc = self.coprocs.get(range_id)
+        rng = self.ranges.get(range_id)
+        if rng is not None:
+            # durable so a restarted replica re-enforces the seal (the
+            # applied-index watermark may already cover the seal entry)
+            rng.space.put_metadata(b"sealed",
+                                   b"\x01" if sealed else b"\x00")
+        if coproc is not None and hasattr(coproc, "boundary"):
+            start, end = self.boundaries[range_id]
+            # sealed = empty boundary: every mutation bounces for
+            # re-resolution; unsealed restores the real boundary
+            coproc.boundary = (start, start) if sealed else (start, end)
+
+    def _apply_merge(self, left_id: str, payload: bytes) -> None:
+        (n,) = struct.unpack_from(">H", payload, 0)
+        pos = 2
+        right_id = payload[pos:pos + n].decode()
+        pos += n
+        (_elen,) = struct.unpack_from(">H", payload, pos)
+        pos += 2
+        has_end = payload[pos] == 1
+        pos += 1
+        new_end = None
+        if has_end:
+            new_end = payload[pos:pos + _elen]
+            pos += _elen
+        (blen,) = struct.unpack_from(">Q", payload, pos)
+        pos += 8
+        body = payload[pos:pos + blen]
+        left = self.ranges[left_id]
+        # fold the sealed content into the survivor
+        w = left.space.writer()
+        bpos = 0
+        while bpos < len(body):
+            (klen,) = struct.unpack_from(">I", body, bpos)
+            bpos += 4
+            k = body[bpos:bpos + klen]
+            bpos += klen
+            (vlen,) = struct.unpack_from(">I", body, bpos)
+            bpos += 4
+            w.put(k, body[bpos:bpos + vlen])
+            bpos += vlen
+        w.done()
+        start, _ = self.boundaries[left_id]
+        self.boundaries[left_id] = (start, new_end)
+        self.router.update(left_id, (start, new_end))
+        if hasattr(self.coprocs[left_id], "boundary"):
+            self.coprocs[left_id].boundary = (start, new_end)
+        self.coprocs[left_id].reset(left.space)
+        # retire the local mergee replica (it may lag; its data is already
+        # canonical inside this entry)
+        self._retire_range(right_id)
+        self._persist_meta()
+
+    def _retire_range(self, range_id: str) -> None:
+        r = self.ranges.pop(range_id, None)
+        if r is None:
+            return
+        r.raft.stop()
+        self.coprocs.pop(range_id, None)
+        self.boundaries.pop(range_id, None)
+        self.router.remove(range_id)
+        # destroy ALL traces: data + metadata (applied watermark, seal) and
+        # the per-range raft store — a later split reusing the same
+        # deterministic sibling id must start from genuinely empty state
+        r.space.destroy()
+        if self.raft_store_factory is not None:
+            try:
+                self.raft_store_factory(range_id).clear()
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                import logging
+                logging.getLogger(__name__).exception(
+                    "failed to clear raft store for %s", range_id)
 
     # ---------------- introspection ---------------------------------------
 

@@ -117,6 +117,11 @@ class IRaftStateStore:
     def load_entries(self) -> List:
         raise NotImplementedError
 
+    def clear(self) -> None:
+        """Destroy ALL persisted raft state (a retired range's store must
+        not leak into a future range reusing the same id)."""
+        raise NotImplementedError
+
 
 class InMemoryStateStore(IRaftStateStore):
     def __init__(self) -> None:
@@ -148,6 +153,9 @@ class InMemoryStateStore(IRaftStateStore):
 
     def load_entries(self):
         return list(self.entries)
+
+    def clear(self):
+        self.term, self.voted_for, self.entries, self.snap = 0, None, [], None
 
 
 _KEY_HARD = b"hs"
@@ -203,3 +211,6 @@ class KVRaftStateStore(IRaftStateStore):
     def load_entries(self):
         return [decode_entry(v) for _, v in self.space.iterate(
             _PFX_ENTRY, _PFX_ENTRY + b"\xff" * 9)]
+
+    def clear(self):
+        self.space.writer().delete_range(b"", b"\xff" * 32).done()

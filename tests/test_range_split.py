@@ -195,3 +195,143 @@ class TestBoundaryBounce:
         out = cp.mutate(encode_add_route("T", r), space, w)
         w.done()
         assert out == b"ok" and len(space) == 1
+
+
+class TestMerge:
+    async def test_merge_preserves_all_routes(self):
+        w = DistWorker()
+        await w.start()
+        try:
+            for i in range(100):
+                await w.add_route("T", mk_route(f"g/{i:03d}/+", f"r{i}"))
+            rid = next(iter(w.store.ranges))
+            keys = [k for k, _ in w.store.ranges[rid].space.iterate()]
+            sib = await w.store.split(rid, keys[50])
+            assert len(w.store.ranges) == 2
+            # merge back: left <- right (boundary-sorted adjacency)
+            ordered = w.store.router.ranges()
+            left, right = ordered[0][1], ordered[1][1]
+            await w.store.merge(left, right)
+            assert len(w.store.ranges) == 1
+            assert w.store.boundaries[left] == (b"", None)
+            assert len(w.store.ranges[left].space) == 100
+            for i in (0, 49, 50, 99):
+                got = await all_matches(w, "T", ["g", f"{i:03d}", "x"])
+                assert got == [(f"g/{i:03d}/+", f"r{i}")]
+            # mutations work across the healed boundary
+            assert await w.add_route("T", mk_route("g/050/+", "rX")) == "ok"
+            assert await w.remove_route(
+                "T", RouteMatcher.from_topic_filter("g/000/+"),
+                (0, "r0", "d0")) == "ok"
+        finally:
+            await w.stop()
+
+    async def test_merge_then_split_again(self):
+        w = DistWorker()
+        await w.start()
+        try:
+            for i in range(60):
+                await w.add_route("T", mk_route(f"h/{i:02d}", f"r{i}"))
+            rid = next(iter(w.store.ranges))
+            keys = [k for k, _ in w.store.ranges[rid].space.iterate()]
+            await w.store.split(rid, keys[30])
+            ordered = w.store.router.ranges()
+            await w.store.merge(ordered[0][1], ordered[1][1])
+            # split at a different key after the merge
+            left = w.store.router.ranges()[0][1]
+            keys = [k for k, _ in w.store.ranges[left].space.iterate()]
+            await w.store.split(left, keys[15])
+            assert len(w.store.ranges) == 2
+            total = sum(len(r.space) for r in w.store.ranges.values())
+            assert total == 60
+            for i in (0, 15, 30, 59):
+                got = await all_matches(w, "T", ["h", f"{i:02d}"])
+                assert got == [(f"h/{i:02d}", f"r{i}")]
+        finally:
+            await w.stop()
+
+    async def test_merge_balancer_folds_underfilled_ranges(self):
+        from bifromq_tpu.kv.balance import (KVStoreBalanceController,
+                                            RangeMergeBalancer)
+        w = DistWorker()
+        await w.start()
+        try:
+            for i in range(40):
+                await w.add_route("T", mk_route(f"m/{i:02d}", f"r{i}"))
+            rid = next(iter(w.store.ranges))
+            keys = [k for k, _ in w.store.ranges[rid].space.iterate()]
+            await w.store.split(rid, keys[20])
+            assert len(w.store.ranges) == 2
+            ctrl = KVStoreBalanceController(
+                w.store, [RangeMergeBalancer(min_keys=1000)])
+            n = await ctrl.run_once()
+            assert n == 1
+            assert len(w.store.ranges) == 1
+            for i in (0, 20, 39):
+                got = await all_matches(w, "T", ["m", f"{i:02d}"])
+                assert got == [(f"m/{i:02d}", f"r{i}")]
+        finally:
+            await w.stop()
+
+    async def test_merge_restart_recovery(self):
+        engine = InMemKVEngine()
+        w = DistWorker(engine=engine)
+        await w.start()
+        for i in range(50):
+            await w.add_route("T", mk_route(f"q/{i:02d}", f"r{i}"))
+        rid = next(iter(w.store.ranges))
+        keys = [k for k, _ in w.store.ranges[rid].space.iterate()]
+        await w.store.split(rid, keys[25])
+        ordered = w.store.router.ranges()
+        await w.store.merge(ordered[0][1], ordered[1][1])
+        await w.stop()
+        w2 = DistWorker(engine=engine)
+        await w2.start()
+        try:
+            assert len(w2.store.ranges) == 1
+            for i in (0, 25, 49):
+                got = await all_matches(w2, "T", ["q", f"{i:02d}"])
+                assert got == [(f"q/{i:02d}", f"r{i}")]
+        finally:
+            await w2.stop()
+
+    async def test_resplit_same_key_after_merge_durable(self):
+        # split at K, merge back, split at K again: the deterministic
+        # sibling id must NOT resurrect the retired range's raft state
+        from bifromq_tpu.raft.store import KVRaftStateStore
+
+        engine = InMemKVEngine()
+        stores = {}
+
+        def rsf(rid):
+            st = stores.get(rid)
+            if st is None:
+                st = stores[rid] = KVRaftStateStore(
+                    engine.create_space(f"raft_{rid}"))
+            return st
+
+        w = DistWorker(engine=engine, raft_store_factory=rsf)
+        await w.start()
+        try:
+            for i in range(30):
+                await w.add_route("T", mk_route(f"z/{i:02d}", f"r{i}"))
+            rid = next(iter(w.store.ranges))
+            keys = [k for k, _ in w.store.ranges[rid].space.iterate()]
+            K = keys[15]
+            sib1 = await w.store.split(rid, K)
+            ordered = w.store.router.ranges()
+            await w.store.merge(ordered[0][1], ordered[1][1])
+            sib2 = await w.store.split(rid, K)
+            assert sib2 == sib1  # deterministic id reused
+            # the resurrected id serves fresh state: all routes intact and
+            # mutable through the new group
+            for i in (0, 15, 29):
+                got = await all_matches(w, "T", ["z", f"{i:02d}"])
+                assert got == [(f"z/{i:02d}", f"r{i}")]
+            assert await w.add_route("T", mk_route("z/16", "rNew",
+                                                   inc=5)) in ("ok",
+                                                               "exists")
+            got = await all_matches(w, "T", ["z", "16"])
+            assert ("z/16", "rNew") in got
+        finally:
+            await w.stop()
