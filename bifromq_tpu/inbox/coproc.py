@@ -1,0 +1,363 @@
+"""Inbox store as a replicated KV coprocessor (≈ inbox-store
+InboxStoreCoProc.java:166 hosted on base-kv).
+
+Every inbox mutation (attach/detach/sub/unsub/insert/commit/delete)
+serializes into a coproc op and replicates through the range's raft; the
+op carries the PROPOSER's wall-clock timestamp so replicas apply
+identical state transitions (the reference stamps ops with HLC the same
+way). Reads (fetch/get/exists) are served from this replica's local
+store — the replica-spread read pattern.
+
+``ReplicatedInboxStore`` is the async facade the service uses: same
+method names as ``InboxStore``, mutations awaited through consensus,
+reads delegated locally.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from typing import List, Optional, Tuple
+
+from ..kv import schema
+from ..kv.engine import IKVSpace, KVWriteBatch
+from ..kv.range import IKVRangeCoProc, ReplicatedKVRange
+from ..plugin.events import IEventCollector
+from ..types import Message, QoS, TopicFilterOption
+from .store import LWT, InboxStore, InsertResult
+
+_OP_ATTACH = 0
+_OP_DETACH = 1
+_OP_SUB = 2
+_OP_UNSUB = 3
+_OP_INSERT = 4
+_OP_COMMIT = 5
+_OP_DELETE = 6
+
+_len16 = schema._len16
+_read16 = schema._read_len16
+
+
+def _enc_str(s: str) -> bytes:
+    return _len16(s.encode())
+
+
+def _enc_opt(opt: TopicFilterOption) -> bytes:
+    return struct.pack(">B??Bqq", int(opt.qos), opt.retain_as_published,
+                       opt.no_local, opt.retain_handling,
+                       -1 if opt.sub_id is None else opt.sub_id,
+                       opt.incarnation)
+
+
+def _dec_opt(buf: bytes, pos: int) -> Tuple[TopicFilterOption, int]:
+    qos, rap, nl, rh, sub_id, inc = struct.unpack_from(">B??Bqq", buf, pos)
+    pos += struct.calcsize(">B??Bqq")
+    return TopicFilterOption(qos=QoS(qos), retain_as_published=rap,
+                             no_local=nl, retain_handling=rh,
+                             sub_id=None if sub_id < 0 else sub_id,
+                             incarnation=inc), pos
+
+
+def _enc_lwt(lwt: Optional[LWT]) -> bytes:
+    if lwt is None:
+        return b"\x00"
+    return (b"\x01" + _enc_str(lwt.topic)
+            + struct.pack(">I", lwt.delay_seconds)
+            + _len16(schema.encode_message(lwt.message)))
+
+
+def _dec_lwt(buf: bytes, pos: int) -> Tuple[Optional[LWT], int]:
+    if buf[pos] == 0:
+        return None, pos + 1
+    pos += 1
+    topic_b, pos = _read16(buf, pos)
+    (delay,) = struct.unpack_from(">I", buf, pos)
+    pos += 4
+    msg_b, pos = _read16(buf, pos)
+    return LWT(topic=topic_b.decode(), delay_seconds=delay,
+               message=schema.decode_message(msg_b)), pos
+
+
+class _MutedEvents(IEventCollector):
+    """Apply-side stores must NOT report events: apply runs on every
+    replica (and replays after restart), which would multiply each event
+    by the replica count. The proposer-side facade reports instead."""
+
+    def report(self, event) -> None:
+        pass
+
+
+class InboxStoreCoProc(IKVRangeCoProc):
+    """Applies inbox ops deterministically on every range replica."""
+
+    def __init__(self, events: IEventCollector) -> None:
+        # retained for observability wiring; apply-side store is muted
+        self.events = events
+        self.store: Optional[InboxStore] = None
+        self._now = 0.0
+
+    def _ensure_store(self, space: IKVSpace) -> InboxStore:
+        if self.store is None:
+            # the op's embedded timestamp IS the clock during apply
+            self.store = InboxStore(space, _MutedEvents(),
+                                    clock=lambda: self._now)
+        return self.store
+
+    def reset(self, reader: IKVSpace) -> None:
+        self.store = InboxStore(reader, _MutedEvents(),
+                                clock=lambda: self._now)
+
+    def query(self, input_data: bytes, reader: IKVSpace) -> bytes:
+        return b""  # reads go through the local store facade
+
+    def mutate(self, input_data: bytes, reader: IKVSpace,
+               writer: KVWriteBatch) -> bytes:
+        store = self._ensure_store(reader)
+        op = input_data[0]
+        (self._now,) = struct.unpack_from(">d", input_data, 1)
+        pos = 9
+        tenant_b, pos = _read16(input_data, pos)
+        inbox_b, pos = _read16(input_data, pos)
+        tenant, inbox = tenant_b.decode(), inbox_b.decode()
+        buf = input_data
+        if op == _OP_ATTACH:
+            clean_start = buf[pos] == 1
+            pos += 1
+            (expiry,) = struct.unpack_from(">I", buf, pos)
+            pos += 4
+            (n_meta,) = struct.unpack_from(">H", buf, pos)
+            pos += 2
+            client_meta = []
+            for _ in range(n_meta):
+                k, pos = _read16(buf, pos)
+                v, pos = _read16(buf, pos)
+                client_meta.append((k.decode(), v.decode()))
+            lwt, pos = _dec_lwt(buf, pos)
+            _meta, present = store.attach(
+                tenant, inbox, clean_start=clean_start,
+                expiry_seconds=expiry, client_meta=tuple(client_meta),
+                lwt=lwt)
+            return b"\x01" if present else b"\x00"
+        if op == _OP_DETACH:
+            keep_lwt = buf[pos] == 1
+            meta = store.detach(tenant, inbox, keep_lwt=keep_lwt)
+            return b"\x01" if meta is not None else b"\x00"
+        if op == _OP_SUB:
+            tf_b, pos = _read16(buf, pos)
+            opt, pos = _dec_opt(buf, pos)
+            (max_filters,) = struct.unpack_from(">I", buf, pos)
+            status, stored = store.sub(tenant, inbox, tf_b.decode(), opt,
+                                       max_filters=max_filters)
+            inc = stored.incarnation if stored is not None else -1
+            return _enc_str(status) + struct.pack(">q", inc)
+        if op == _OP_UNSUB:
+            tf_b, pos = _read16(buf, pos)
+            removed = store.unsub(tenant, inbox, tf_b.decode())
+            if removed is None:
+                return b"\x00"
+            return b"\x01" + struct.pack(">q", removed.incarnation)
+        if op == _OP_INSERT:
+            # batched (≈ batchInsert): one consensus round per delivery
+            # pack, not per message
+            (inbox_size,) = struct.unpack_from(">I", buf, pos)
+            pos += 4
+            drop_oldest = buf[pos] == 1
+            pos += 1
+            pub_b, pos = _read16(buf, pos)
+            nonce = buf[pos:pos + 8]
+            pos += 8
+            (n,) = struct.unpack_from(">H", buf, pos)
+            pos += 2
+            out = bytearray()
+            for i in range(n):
+                topic_b, pos = _read16(buf, pos)
+                tf_b, pos = _read16(buf, pos)
+                msg_b, pos = _read16(buf, pos)
+                res = store.insert(
+                    tenant, inbox, topic_b.decode(),
+                    schema.decode_message(msg_b), tf_b.decode(),
+                    inbox_size=inbox_size, drop_oldest=drop_oldest,
+                    publisher_client_id=pub_b.decode() or None,
+                    op_id=nonce + struct.pack(">H", i))
+                if res is None:
+                    out += b"\x00"
+                else:
+                    out += b"\x01" + struct.pack(
+                        ">?II", res.ok, res.dropped_qos0,
+                        res.dropped_buffer)
+            return bytes(out)
+        if op == _OP_COMMIT:
+            q0, bf = struct.unpack_from(">qq", buf, pos)
+            ok = store.commit(tenant, inbox,
+                              qos0_up_to=None if q0 < 0 else q0,
+                              buffer_up_to=None if bf < 0 else bf)
+            return b"\x01" if ok else b"\x00"
+        if op == _OP_DELETE:
+            existed = store.delete(tenant, inbox)
+            return b"\x01" if existed else b"\x00"
+        return b""
+
+
+def _envelope(op: int, now: float, tenant: str, inbox: str) -> bytearray:
+    out = bytearray([op])
+    out += struct.pack(">d", now)
+    out += _enc_str(tenant)
+    out += _enc_str(inbox)
+    return out
+
+
+class ReplicatedInboxStore:
+    """Async InboxStore facade over a replicated range.
+
+    Mutations replicate through consensus (proposer-stamped timestamps);
+    reads serve from this replica's local store.
+    """
+
+    def __init__(self, rng: ReplicatedKVRange, coproc: InboxStoreCoProc,
+                 *, clock=time.time) -> None:
+        self.range = rng
+        self.coproc = coproc
+        self.clock = clock
+        coproc._ensure_store(rng.space)
+
+    # ---------------- reads (local replica) -------------------------------
+
+    @property
+    def _local(self) -> InboxStore:
+        return self.coproc.store
+
+    def get(self, tenant, inbox):
+        return self._local.get(tenant, inbox)
+
+    def exists(self, tenant, inbox):
+        self.coproc._now = self.clock()
+        return self._local.exists(tenant, inbox)
+
+    def fetch(self, tenant, inbox, **kw):
+        return self._local.fetch(tenant, inbox, **kw)
+
+    def all_inboxes(self):
+        return self._local.all_inboxes()
+
+    def _store(self, tenant, meta):
+        """Direct local write (crash-simulation in tests only)."""
+        self._local._store(tenant, meta)
+
+    def expired_inboxes(self, now=None):
+        return self._local.expired_inboxes(now=self.clock()
+                                           if now is None else now)
+
+    # ---------------- mutations (through consensus) ------------------------
+
+    async def _mutate(self, payload: bytes, timeout: float = 5.0) -> bytes:
+        import asyncio
+        import time as _time
+
+        from ..raft.node import NotLeaderError
+
+        deadline = _time.monotonic() + timeout
+        while True:
+            try:
+                return await self.range.mutate_coproc(bytes(payload))
+            except NotLeaderError:
+                # cover the initial-election window; a steady-state
+                # follower still raises (leader forwarding rides the RPC
+                # fabric in multi-process deployments)
+                if (_time.monotonic() >= deadline
+                        or self.range.raft.leader_id not in (
+                            None, self.range.raft.id)):
+                    raise
+                await asyncio.sleep(0.01)
+
+    async def attach(self, tenant, inbox, *, clean_start, expiry_seconds,
+                     client_meta=(), lwt=None):
+        out = _envelope(_OP_ATTACH, self.clock(), tenant, inbox)
+        out += b"\x01" if clean_start else b"\x00"
+        out += struct.pack(">I", expiry_seconds)
+        out += struct.pack(">H", len(client_meta))
+        for k, v in client_meta:
+            out += _enc_str(k) + _enc_str(v)
+        out += _enc_lwt(lwt)
+        res = await self._mutate(out)
+        present = res == b"\x01"
+        return self._local.get(tenant, inbox), present
+
+    async def detach(self, tenant, inbox, *, keep_lwt=True):
+        out = _envelope(_OP_DETACH, self.clock(), tenant, inbox)
+        out += b"\x01" if keep_lwt else b"\x00"
+        res = await self._mutate(out)
+        return self._local.get(tenant, inbox) if res == b"\x01" else None
+
+    async def sub(self, tenant, inbox, topic_filter, opt, *, max_filters):
+        out = _envelope(_OP_SUB, self.clock(), tenant, inbox)
+        out += _enc_str(topic_filter)
+        out += _enc_opt(opt)
+        out += struct.pack(">I", max_filters)
+        res = await self._mutate(out)
+        status_b, pos = _read16(res, 0)
+        (inc,) = struct.unpack_from(">q", res, pos)
+        stored = None
+        if inc >= 0:
+            from dataclasses import replace
+            stored = replace(opt, incarnation=inc)
+        return status_b.decode(), stored
+
+    async def unsub(self, tenant, inbox, topic_filter):
+        out = _envelope(_OP_UNSUB, self.clock(), tenant, inbox)
+        out += _enc_str(topic_filter)
+        res = await self._mutate(out)
+        if res[0] == 0:
+            return None
+        (inc,) = struct.unpack_from(">q", res, 1)
+        return TopicFilterOption(incarnation=inc)
+
+    async def insert_batch(self, tenant, inbox, records, *, inbox_size,
+                           drop_oldest, publisher_client_id=None
+                           ) -> List[Optional[InsertResult]]:
+        """records: [(topic, message, matched_filter)] — ONE consensus
+        round for the whole delivery pack (≈ batchInsert)."""
+        import os as _os
+
+        out = _envelope(_OP_INSERT, self.clock(), tenant, inbox)
+        out += struct.pack(">I", inbox_size)
+        out += b"\x01" if drop_oldest else b"\x00"
+        out += _enc_str(publisher_client_id or "")
+        out += _os.urandom(8)  # op nonce: re-apply dedup key
+        out += struct.pack(">H", len(records))
+        for topic, message, matched_filter in records:
+            out += _enc_str(topic)
+            out += _enc_str(matched_filter)
+            out += _len16(schema.encode_message(message))
+        res = await self._mutate(out)
+        results: List[Optional[InsertResult]] = []
+        pos = 0
+        for _ in records:
+            if res[pos] == 0:
+                results.append(None)
+                pos += 1
+            else:
+                ok, d0, db = struct.unpack_from(">?II", res, pos + 1)
+                results.append(InsertResult(ok=ok, dropped_qos0=d0,
+                                            dropped_buffer=db))
+                pos += 1 + struct.calcsize(">?II")
+        return results
+
+    async def insert(self, tenant, inbox, topic, message, matched_filter,
+                     *, inbox_size, drop_oldest,
+                     publisher_client_id=None) -> Optional[InsertResult]:
+        return (await self.insert_batch(
+            tenant, inbox, [(topic, message, matched_filter)],
+            inbox_size=inbox_size, drop_oldest=drop_oldest,
+            publisher_client_id=publisher_client_id))[0]
+
+    async def commit(self, tenant, inbox, *, qos0_up_to=None,
+                     buffer_up_to=None) -> bool:
+        out = _envelope(_OP_COMMIT, self.clock(), tenant, inbox)
+        out += struct.pack(">qq",
+                           -1 if qos0_up_to is None else qos0_up_to,
+                           -1 if buffer_up_to is None else buffer_up_to)
+        return (await self._mutate(out)) == b"\x01"
+
+    async def delete(self, tenant, inbox) -> bool:
+        out = _envelope(_OP_DELETE, self.clock(), tenant, inbox)
+        return (await self._mutate(out)) == b"\x01"

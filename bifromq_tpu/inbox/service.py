@@ -58,17 +58,40 @@ class DelayTaskRunner:
 
 
 class InboxService:
+    """Broker-facing inbox API over a REPLICATED inbox range: mutations
+    ride consensus (inbox/coproc.py — ≈ inbox-store hosted on base-kv),
+    reads serve from this replica's local store."""
+
     def __init__(self, dist: DistService, events: IEventCollector,
                  settings: ISettingProvider, *,
                  engine: Optional[IKVEngine] = None,
+                 node_id: str = "local", voters=None, transport=None,
+                 raft_store=None, tick_interval: float = 0.01,
                  clock=time.time) -> None:
+        from ..kv.range import ReplicatedKVRange
+        from ..raft.transport import InMemTransport
+        from .coproc import InboxStoreCoProc, ReplicatedInboxStore
+
         self.dist = dist
         self.events = events
         self.settings = settings
         self.clock = clock
+        self.tick_interval = tick_interval
         engine = engine or InMemKVEngine()
-        self.store = InboxStore(engine.create_space("inbox_data"), events,
-                                clock=clock)
+        self._coproc = InboxStoreCoProc(events)
+        self._transport = (transport if transport is not None
+                           else InMemTransport())
+        member_id = f"{node_id}:inbox"
+        self.range = ReplicatedKVRange(
+            "inbox", member_id,
+            [f"{n}:inbox" for n in (voters or [node_id])],
+            self._transport, engine.create_space("inbox_data"),
+            coproc=self._coproc, raft_store=raft_store)
+        if hasattr(self._transport, "register"):
+            self._transport.register(self.range.raft)
+        self.store = ReplicatedInboxStore(self.range, self._coproc,
+                                          clock=clock)
+        self._tick_task = None
         self.delay = DelayTaskRunner(clock=clock)
         # online fetch signalers: (tenant, inbox) -> callback (≈ FetcherSignaler)
         self._signals: Dict[Tuple[str, str], Callable[[], None]] = {}
@@ -80,17 +103,44 @@ class InboxService:
     def _lock(self, tenant_id: str, inbox_id: str) -> asyncio.Lock:
         return self._locks.setdefault((tenant_id, inbox_id), asyncio.Lock())
 
+    async def start(self) -> None:
+        import asyncio
+
+        from ..raft.node import Role
+        if len(self.range.raft.voters) == 1:
+            for _ in range(10_000):
+                if self.range.raft.role == Role.LEADER:
+                    break
+                self.range.raft.tick()
+                pump = getattr(self._transport, "pump", None)
+                if pump is not None:
+                    pump()
+        async def loop():
+            while True:
+                self.range.raft.tick()
+                pump = getattr(self._transport, "pump", None)
+                if pump is not None:
+                    pump()
+                await asyncio.sleep(self.tick_interval)
+        self._tick_task = asyncio.create_task(loop())
+
+    async def stop(self) -> None:
+        if self._tick_task is not None:
+            self._tick_task.cancel()
+            self._tick_task = None
+        self.range.raft.stop()
+
     def _setting(self, s: Setting, tenant_id: str):
         v = self.settings.provide(s, tenant_id)
         return s.default if v is None else v
 
     # ---------------- lifecycle -------------------------------------------
 
-    def attach(self, tenant_id: str, inbox_id: str, *, clean_start: bool,
-               expiry_seconds: int,
-               client_meta: Tuple[Tuple[str, str], ...] = (),
-               lwt: Optional[LWT] = None) -> Tuple[InboxMetadata, bool]:
-        meta, present = self.store.attach(
+    async def attach(self, tenant_id: str, inbox_id: str, *,
+                     clean_start: bool, expiry_seconds: int,
+                     client_meta: Tuple[Tuple[str, str], ...] = (),
+                     lwt: Optional[LWT] = None) -> Tuple[InboxMetadata, bool]:
+        meta, present = await self.store.attach(
             tenant_id, inbox_id, clean_start=clean_start,
             expiry_seconds=expiry_seconds, client_meta=client_meta, lwt=lwt)
         self.events.report(Event(EventType.INBOX_ATTACHED, tenant_id,
@@ -101,10 +151,10 @@ class InboxService:
             pass
         return meta, present
 
-    def detach(self, tenant_id: str, inbox_id: str, *,
-               fire_lwt_on_expiry: bool = True) -> None:
-        meta = self.store.detach(tenant_id, inbox_id,
-                                 keep_lwt=fire_lwt_on_expiry)
+    async def detach(self, tenant_id: str, inbox_id: str, *,
+                     fire_lwt_on_expiry: bool = True) -> None:
+        meta = await self.store.detach(tenant_id, inbox_id,
+                                       keep_lwt=fire_lwt_on_expiry)
         if meta is None:
             return
         self.events.report(Event(EventType.INBOX_DETACHED, tenant_id,
@@ -148,7 +198,7 @@ class InboxService:
                     or meta.expire_at() > self.clock():
                 return
             await self._drop_routes(tenant_id, inbox_id, meta)
-            self.store.delete(tenant_id, inbox_id)
+            await self.store.delete(tenant_id, inbox_id)
             self.events.report(Event(EventType.INBOX_EXPIRED, tenant_id,
                                      {"inbox": inbox_id}))
             self._locks.pop((tenant_id, inbox_id), None)
@@ -159,7 +209,7 @@ class InboxService:
             if meta is not None:
                 await self._drop_routes(tenant_id, inbox_id, meta)
             self.delay.cancel((tenant_id, inbox_id))
-            existed = self.store.delete(tenant_id, inbox_id)
+            existed = await self.store.delete(tenant_id, inbox_id)
             if meta is not None or existed:
                 self.events.report(Event(EventType.INBOX_DELETED, tenant_id,
                                          {"inbox": inbox_id}))
@@ -182,7 +232,7 @@ class InboxService:
     async def sub(self, tenant_id: str, inbox_id: str, topic_filter: str,
                   opt: TopicFilterOption) -> str:
         async with self._lock(tenant_id, inbox_id):
-            res, stored = self.store.sub(
+            res, stored = await self.store.sub(
                 tenant_id, inbox_id, topic_filter, opt,
                 max_filters=self._setting(Setting.MaxTopicFiltersPerInbox,
                                           tenant_id))
@@ -199,7 +249,8 @@ class InboxService:
     async def unsub(self, tenant_id: str, inbox_id: str,
                     topic_filter: str) -> bool:
         async with self._lock(tenant_id, inbox_id):
-            removed = self.store.unsub(tenant_id, inbox_id, topic_filter)
+            removed = await self.store.unsub(tenant_id, inbox_id,
+                                             topic_filter)
             if removed is not None:
                 await self.dist.unmatch(
                     tenant_id, RouteMatcher.from_topic_filter(topic_filter),
@@ -237,7 +288,7 @@ class InboxService:
             if meta.detached_at is None:
                 # attached at crash time: the connection is gone, so detach
                 # now — starts the expiry clock and preserves the LWT
-                meta = self.store.detach(tenant_id, inbox_id) or meta
+                meta = await self.store.detach(tenant_id, inbox_id) or meta
             if meta.expire_at() <= now:
                 # expired while down: clean up right away on the loop
                 asyncio.get_running_loop().create_task(
@@ -289,22 +340,31 @@ class InboxSubBroker(ISubBroker):
         inbox_size = svc._setting(Setting.SessionInboxSize, tenant_id)
         drop_oldest = svc._setting(Setting.QoS0DropOldest, tenant_id)
         touched = set()
+        # one consensus round per (inbox, publisher) — ≈ batchInsert
         for pack in packs:
             topic = pack.message_pack.topic
             for mi in pack.match_infos:
                 result = DeliveryResult.OK
                 for pub_pack in pack.message_pack.packs:
                     pub_client = pub_pack.publisher.meta().get("clientId")
-                    for msg in pub_pack.messages:
-                        r = svc.store.insert(
-                            tenant_id, mi.receiver_id, topic, msg,
-                            mi.matcher.mqtt_topic_filter,
-                            inbox_size=inbox_size, drop_oldest=drop_oldest,
-                            publisher_client_id=pub_client)
+                    records = [(topic, msg, mi.matcher.mqtt_topic_filter)
+                               for msg in pub_pack.messages]
+                    results = await svc.store.insert_batch(
+                        tenant_id, mi.receiver_id, records,
+                        inbox_size=inbox_size, drop_oldest=drop_oldest,
+                        publisher_client_id=pub_client)
+                    for r in results:
                         if r is None:
                             result = DeliveryResult.NO_SUB
                         elif r.ok:
                             touched.add((tenant_id, mi.receiver_id))
+                        if r is not None and (r.dropped_qos0
+                                              or r.dropped_buffer
+                                              or not r.ok):
+                            # proposer-side event (apply side is muted)
+                            svc.events.report(Event(
+                                EventType.OVERFLOWED, tenant_id,
+                                {"inbox": mi.receiver_id}))
                 out[mi] = result
         for tenant, inbox in touched:
             svc._signal(tenant, inbox)

@@ -248,9 +248,14 @@ class InboxStore:
     def insert(self, tenant_id: str, inbox_id: str, topic: str,
                message: Message, matched_filter: str, *,
                inbox_size: int, drop_oldest: bool,
-               publisher_client_id: Optional[str] = None
-               ) -> Optional[InsertResult]:
-        """Returns None if the subscription no longer exists (NO_SUB)."""
+               publisher_client_id: Optional[str] = None,
+               op_id: Optional[bytes] = None) -> Optional[InsertResult]:
+        """Returns None if the subscription no longer exists (NO_SUB).
+
+        ``op_id`` (replicated-coproc apply only): written atomically with
+        the insert batch; re-applying the same op (the one-entry crash
+        window, kv/range.py) is detected and skipped — appends are NOT
+        naturally idempotent."""
         meta = self._load(tenant_id, inbox_id)
         if meta is None or meta.expire_at() <= self.clock():
             return None
@@ -262,7 +267,12 @@ class InboxStore:
         qos = min(int(message.pub_qos), int(opt.qos))
         record = schema._len16(topic.encode()) + schema.encode_message(
             replace(message, pub_qos=QoS(qos)))
+        if op_id is not None and self.space.get(
+                schema.inbox_op_key(tenant_id, inbox_id)) == op_id:
+            return InsertResult(ok=True)  # re-applied op (crash window)
         w = self.space.writer()
+        if op_id is not None:
+            w.put(schema.inbox_op_key(tenant_id, inbox_id), op_id)
         dropped0 = droppedb = 0
         if qos == 0:
             depth = meta.qos0_next_seq - meta.qos0_start_seq

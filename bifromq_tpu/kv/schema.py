@@ -171,10 +171,15 @@ def inbox_prefix(tenant_id: str, inbox_id: str = None) -> bytes:
 _INBOX_META = b"\x00"
 _INBOX_QOS0 = b"\x01"
 _INBOX_BUF = b"\x02"
+_INBOX_OP = b"\x03"   # last-applied op id (replicated-apply dedup)
 
 
 def inbox_meta_key(tenant_id: str, inbox_id: str) -> bytes:
     return inbox_prefix(tenant_id, inbox_id) + _INBOX_META
+
+
+def inbox_op_key(tenant_id: str, inbox_id: str) -> bytes:
+    return inbox_prefix(tenant_id, inbox_id) + _INBOX_OP
 
 
 def inbox_qos0_key(tenant_id: str, inbox_id: str, seq: int) -> bytes:

@@ -516,6 +516,7 @@ class MQTTBroker:
             TRANSIENT_SUB_BROKER_ID, deliverer_prefix=self.server_id + "|")
         if purged:
             log.info("purged %d stale transient routes", purged)
+        await self.inbox.start()
         recovered = await self.inbox.recover()
         if recovered:
             log.info("recovered %d persistent sessions from storage",
@@ -558,6 +559,7 @@ class MQTTBroker:
                 await asyncio.wait_for(self._server.wait_closed(), 5)
             except asyncio.TimeoutError:
                 pass
+        await self.inbox.stop()
         await self.dist.stop()
 
     def _admit_connection(self) -> Optional[EventType]:

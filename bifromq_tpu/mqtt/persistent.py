@@ -38,6 +38,7 @@ class PersistentSession(Session):
         # outbound packet id -> send-buffer seq (for commit on ack)
         self._pid_to_seq: Dict[int, int] = {}
         self._acked_seqs: Set[int] = set()
+        self._commit_tasks: Set[asyncio.Task] = set()
         self._committed_seq = -1
 
     # ---------------- lifecycle -------------------------------------------
@@ -52,7 +53,7 @@ class PersistentSession(Session):
                                       payload=self.will.payload,
                                       timestamp=HLC.INST.get(),
                                       is_retain=self.will.retain))
-        meta, present = self.inbox.attach(
+        meta, present = await self.inbox.attach(
             tenant, self.inbox_id, clean_start=self.clean_start,
             expiry_seconds=self.expiry_seconds,
             client_meta=self.client_info.metadata, lwt=lwt)
@@ -93,14 +94,14 @@ class PersistentSession(Session):
                 and not self._will_suppressed:
             # abnormal close: fire the will now, then let the inbox expire
             await self._fire_will()
-            self.inbox.detach(tenant, self.inbox_id,
-                              fire_lwt_on_expiry=False)
+            await self.inbox.detach(tenant, self.inbox_id,
+                                    fire_lwt_on_expiry=False)
         elif self.expiry_seconds <= 0:
             # session expiry 0: state dies with the connection (v5 semantics)
             await self.inbox.delete(tenant, self.inbox_id)
         else:
-            self.inbox.detach(tenant, self.inbox_id,
-                              fire_lwt_on_expiry=False)
+            await self.inbox.detach(tenant, self.inbox_id,
+                                    fire_lwt_on_expiry=False)
         await self.conn.close_transport()
         self.events.report(Event(EventType.CLIENT_DISCONNECTED, tenant,
                                  {"client_id": self.client_id}))
@@ -175,7 +176,7 @@ class PersistentSession(Session):
                         await self._push(topic, msg)
                     if fetched.qos0:
                         # qos0 committed on send (reference: commit after push)
-                        self.inbox.store.commit(tenant, self.inbox_id,
+                        await self.inbox.store.commit(tenant, self.inbox_id,
                                                 qos0_up_to=self._qos0_cursor)
                     blocked = False
                     for seq, topic, msg in fetched.buffer:
@@ -238,8 +239,23 @@ class PersistentSession(Session):
             self._acked_seqs.discard(up_to)
         if up_to != self._committed_seq:
             self._committed_seq = up_to
-            self.inbox.store.commit(self.client_info.tenant_id,
-                                    self.inbox_id, buffer_up_to=up_to)
+            # fire-and-forget: commits are monotonic and idempotent (a
+            # smaller up_to applying late is a no-op), so ack handling
+            # stays synchronous while the trim rides consensus; hold a
+            # strong reference and surface failures (GC'd or silently
+            # failed tasks would un-trim acked messages)
+            task = asyncio.ensure_future(self.inbox.store.commit(
+                self.client_info.tenant_id, self.inbox_id,
+                buffer_up_to=up_to))
+            self._commit_tasks.add(task)
+
+            def _done(t):
+                self._commit_tasks.discard(t)
+                if not t.cancelled() and t.exception() is not None:
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "inbox commit failed: %r", t.exception())
+            task.add_done_callback(_done)
 
     def _on_puback(self, pid: int) -> None:
         super()._on_puback(pid)
