@@ -254,5 +254,5 @@ class APIServer:
     def _retained(self, arg) -> Tuple[int, object]:
         tenant = arg("tenant_id") or "DevOnly"
         svc = self.broker.retain_service
-        topics = sorted(svc.tenants.get(tenant, {})) if svc else []
+        topics = svc.topics(tenant) if svc else []
         return 200, {"count": len(topics), "topics": topics[:1000]}

@@ -202,6 +202,11 @@ def retain_key(tenant_id: str, topic: str) -> bytes:
     return TAG_RETAIN + _len16(tenant_id.encode()) + topic.encode()
 
 
+def split_retain_key(key: bytes) -> tuple:
+    tenant_b, pos = _read_len16(key, 1)
+    return tenant_b.decode(), key[pos:].decode()
+
+
 def retain_prefix(tenant_id: str) -> bytes:
     return TAG_RETAIN + _len16(tenant_id.encode())
 

@@ -496,7 +496,9 @@ class MQTTBroker:
         self.dist = dist
         if retain_service is None:
             from ..retain.service import RetainService
-            retain_service = RetainService(self.events)
+            # share the durable engine so retained messages survive restart
+            retain_service = RetainService(self.events,
+                                           engine=inbox_engine)
         self.retain_service = retain_service
         from ..inbox.service import InboxService, InboxSubBroker
         self.inbox = InboxService(self.dist, self.events, self.settings,
@@ -517,6 +519,8 @@ class MQTTBroker:
         if purged:
             log.info("purged %d stale transient routes", purged)
         await self.inbox.start()
+        if hasattr(self.retain_service, "start"):
+            await self.retain_service.start()
         recovered = await self.inbox.recover()
         if recovered:
             log.info("recovered %d persistent sessions from storage",
@@ -560,6 +564,8 @@ class MQTTBroker:
             except asyncio.TimeoutError:
                 pass
         await self.inbox.stop()
+        if hasattr(self.retain_service, "stop"):
+            await self.retain_service.stop()
         await self.dist.stop()
 
     def _admit_connection(self) -> Optional[EventType]:

@@ -1,0 +1,106 @@
+"""Retain store as a replicated KV coprocessor (≈ retain-store
+RetainStoreCoProc.java:76 on base-kv): batchRetain-style SET/DEL ops ride
+consensus into the retain keyspace; the wildcard RetainedIndex + message
+map are derived state rebuilt from KV on reset (≈ RetainTopicIndex rebuilt
+on reset, store/index/RetainTopicIndex.java:35)."""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Optional, Tuple
+
+from ..kv import schema
+from ..kv.engine import IKVSpace, KVWriteBatch
+from ..kv.range import IKVRangeCoProc
+from ..models.retained import RetainedIndex
+from ..types import ClientInfo
+from ..utils import topic as topic_util
+
+OP_SET = 0
+OP_DEL = 1
+
+_len16 = schema._len16
+_read16 = schema._read_len16
+
+
+def enc_retained(msg_bytes: bytes, publisher: ClientInfo,
+                 expire_at: Optional[float]) -> bytes:
+    out = bytearray(struct.pack(">d", -1.0 if expire_at is None
+                                else expire_at))
+    out += _len16(publisher.tenant_id.encode())
+    out += _len16(publisher.type.encode())
+    out += struct.pack(">H", len(publisher.metadata))
+    for k, v in publisher.metadata:
+        out += _len16(k.encode()) + _len16(v.encode())
+    out += msg_bytes
+    return bytes(out)
+
+
+def dec_retained(buf: bytes):
+    (exp,) = struct.unpack_from(">d", buf, 0)
+    pos = 8
+    tenant_b, pos = _read16(buf, pos)
+    type_b, pos = _read16(buf, pos)
+    (n,) = struct.unpack_from(">H", buf, pos)
+    pos += 2
+    meta = []
+    for _ in range(n):
+        k, pos = _read16(buf, pos)
+        v, pos = _read16(buf, pos)
+        meta.append((k.decode(), v.decode()))
+    msg = schema.decode_message(buf[pos:])
+    publisher = ClientInfo(tenant_id=tenant_b.decode(),
+                           type=type_b.decode(), metadata=tuple(meta))
+    return (None if exp < 0 else exp), publisher, msg
+
+
+class RetainCoProc(IKVRangeCoProc):
+    """Applies retain SET/DEL deterministically; derived index per replica."""
+
+    def __init__(self, index: Optional[RetainedIndex] = None) -> None:
+        self.index = index or RetainedIndex()
+        # tenant -> topic -> value bytes (decoded lazily by the service)
+        self.values: Dict[str, Dict[str, bytes]] = {}
+
+    def reset(self, reader: IKVSpace) -> None:
+        self.index = RetainedIndex(max_levels=self.index.max_levels,
+                                   k_states=self.index.k_states)
+        self.values = {}
+        for key, value in reader.iterate(
+                schema.TAG_RETAIN, schema.prefix_end(schema.TAG_RETAIN)):
+            tenant, topic = schema.split_retain_key(key)
+            self.values.setdefault(tenant, {})[topic] = value
+            self.index.add_topic(tenant, topic_util.parse(topic), topic)
+
+    def query(self, input_data: bytes, reader: IKVSpace) -> bytes:
+        return b""  # queries go through the local index/service
+
+    def mutate(self, input_data: bytes, reader: IKVSpace,
+               writer: KVWriteBatch) -> bytes:
+        op = input_data[0]
+        tenant_b, pos = _read16(input_data, 1)
+        topic_b, pos = _read16(input_data, pos)
+        tenant, topic = tenant_b.decode(), topic_b.decode()
+        key = schema.retain_key(tenant, topic)
+        store = self.values.setdefault(tenant, {})
+        if op == OP_DEL:
+            existed = store.pop(topic, None) is not None
+            if existed:
+                writer.delete(key)
+                self.index.remove_topic(tenant, topic_util.parse(topic),
+                                        topic)
+            if not store:
+                self.values.pop(tenant, None)
+            return b"\x01" if existed else b"\x00"
+        value = input_data[pos:]
+        created = topic not in store
+        store[topic] = value
+        writer.put(key, value)
+        if created:
+            self.index.add_topic(tenant, topic_util.parse(topic), topic)
+        return b"\x01" if created else b"\x00"
+
+
+def enc_op(op: int, tenant: str, topic: str, value: bytes = b"") -> bytes:
+    return (bytes([op]) + _len16(tenant.encode()) + _len16(topic.encode())
+            + value)

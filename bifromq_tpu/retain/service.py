@@ -37,33 +37,125 @@ class RetainedMsg:
 
 
 class RetainService:
+    """Retained messages over a REPLICATED retain range: SET/DEL ride
+    consensus (retain/coproc.py), wildcard matches serve from this
+    replica's derived index; durable when an engine is provided."""
+
     def __init__(self, events: IEventCollector, *,
                  throttler: Optional[IResourceThrottler] = None,
                  index: Optional[RetainedIndex] = None,
-                 clock=time.time) -> None:
+                 engine=None, node_id: str = "local", voters=None,
+                 transport=None, raft_store=None,
+                 tick_interval: float = 0.01, clock=time.time) -> None:
+        from ..kv.engine import InMemKVEngine
+        from ..kv.range import ReplicatedKVRange
+        from ..raft.transport import InMemTransport
+        from .coproc import RetainCoProc
+
         self.events = events
         self.throttler = throttler or AllowAllResourceThrottler()
-        self.index = index or RetainedIndex()
         self.clock = clock
-        self.tenants: Dict[str, Dict[str, RetainedMsg]] = {}
+        self.tick_interval = tick_interval
+        engine = engine or InMemKVEngine()
+        self.coproc = RetainCoProc(index)
+        self._transport = (transport if transport is not None
+                           else InMemTransport())
+        self.range = ReplicatedKVRange(
+            "retain", f"{node_id}:retain",
+            [f"{n}:retain" for n in (voters or [node_id])],
+            self._transport, engine.create_space("retain_data"),
+            coproc=self.coproc, raft_store=raft_store)
+        if hasattr(self._transport, "register"):
+            self._transport.register(self.range.raft)
+        self.coproc.reset(self.range.space)
+        self._tick_task = None
+
+    @property
+    def index(self) -> RetainedIndex:
+        return self.coproc.index
+
+    async def start(self) -> None:
+        import asyncio
+
+        from ..raft.node import Role
+        if len(self.range.raft.voters) == 1:
+            for _ in range(10_000):
+                if self.range.raft.role == Role.LEADER:
+                    break
+                self.range.raft.tick()
+                pump = getattr(self._transport, "pump", None)
+                if pump is not None:
+                    pump()
+
+        async def loop():
+            while True:
+                self.range.raft.tick()
+                pump = getattr(self._transport, "pump", None)
+                if pump is not None:
+                    pump()
+                await asyncio.sleep(self.tick_interval)
+        self._tick_task = asyncio.create_task(loop())
+
+    async def stop(self) -> None:
+        if self._tick_task is not None:
+            self._tick_task.cancel()
+            self._tick_task = None
+        self.range.raft.stop()
+
+    def _decode(self, tenant_id: str, topic: str) -> Optional[RetainedMsg]:
+        from .coproc import dec_retained
+        raw = self.coproc.values.get(tenant_id, {}).get(topic)
+        if raw is None:
+            return None
+        expire_at, publisher, msg = dec_retained(raw)
+        return RetainedMsg(topic=topic, message=msg, publisher=publisher,
+                           expire_at=expire_at)
 
     # ---------------- mutations (≈ batchRetain) ----------------------------
 
+    async def _mutate(self, payload: bytes, timeout: float = 5.0) -> bytes:
+        import asyncio
+        import time as _time
+
+        from ..raft.node import NotLeaderError
+        from ..raft.node import Role
+        deadline = _time.monotonic() + timeout
+        while True:
+            try:
+                return await self.range.mutate_coproc(payload)
+            except NotLeaderError:
+                if _time.monotonic() >= deadline or self.range.raft.stopped:
+                    raise
+                if len(self.range.raft.voters) == 1:
+                    # standalone range used without start(): self-elect
+                    for _ in range(200):
+                        if self.range.raft.role == Role.LEADER:
+                            break
+                        self.range.raft.tick()
+                    continue
+                if self.range.raft.leader_id not in (None,
+                                                     self.range.raft.id):
+                    raise
+                await asyncio.sleep(0.01)
+
     async def retain(self, publisher: ClientInfo, topic: str,
                      message: Message) -> bool:
+        from ..kv import schema as _schema
+        from .coproc import OP_DEL, OP_SET, enc_op, enc_retained
+
         tenant_id = publisher.tenant_id
-        levels = topic_util.parse(topic)
-        store = self.tenants.setdefault(tenant_id, {})
+        existing = self.coproc.values.get(tenant_id, {})
         if not message.payload:
             # empty payload clears the retained message [MQTT-3.3.1-10/11]
-            if store.pop(topic, None) is not None:
-                self.index.remove_topic(tenant_id, levels, topic)
-                if not store:
-                    del self.tenants[tenant_id]
+            out = await self._mutate(enc_op(OP_DEL, tenant_id, topic))
+            if out == b"\x01":
                 self.events.report(Event(EventType.RETAIN_MSG_CLEARED,
                                          tenant_id, {"topic": topic}))
             return True
-        if topic not in store and not self.throttler.has_resource(
+        # quota is advisory under concurrency (check-then-propose): like
+        # the reference's IResourceThrottler, has_resource is an
+        # eventually-consistent gate, not a transactional reservation
+        if topic not in existing and not self.throttler.has_resource(
                 tenant_id, TenantResourceType.TOTAL_RETAIN_TOPICS):
             self.events.report(Event(EventType.RETAIN_ERROR, tenant_id,
                                      {"topic": topic, "reason": "quota"}))
@@ -71,9 +163,9 @@ class RetainService:
         expire_at = None
         if message.expiry_seconds != _NEVER:
             expire_at = self.clock() + message.expiry_seconds
-        store[topic] = RetainedMsg(topic=topic, message=message,
-                                   publisher=publisher, expire_at=expire_at)
-        self.index.add_topic(tenant_id, levels, topic)
+        value = enc_retained(_schema.encode_message(message), publisher,
+                             expire_at)
+        await self._mutate(enc_op(OP_SET, tenant_id, topic, value))
         self.events.report(Event(EventType.MSG_RETAINED, tenant_id,
                                  {"topic": topic}))
         return True
@@ -91,14 +183,19 @@ class RetainService:
         now = self.clock()
         out: List[List[Tuple[str, Message]]] = []
         for (tenant_id, _), topics in zip(queries, raw):
-            store = self.tenants.get(tenant_id, {})
             hits: List[Tuple[str, Message]] = []
             for topic in topics:
-                rm = store.get(topic)
+                rm = self._decode(tenant_id, topic)
                 if rm is None:
                     continue
                 if rm.expire_at is not None and rm.expire_at <= now:
-                    self._expire(tenant_id, rm)
+                    # best-effort consensus cleanup: a follower replica
+                    # cannot propose — it still FILTERS the expired hit
+                    # (the leader's gc sweep removes it for real)
+                    try:
+                        await self._expire(tenant_id, rm)
+                    except Exception:  # noqa: BLE001
+                        pass
                     continue
                 if len(hits) < limit:
                     hits.append((topic, rm.message))
@@ -107,30 +204,27 @@ class RetainService:
 
     # ---------------- expiry GC (≈ RetainStoreGCProcessor) -----------------
 
-    def gc(self, tenant_id: Optional[str] = None) -> int:
+    async def gc(self, tenant_id: Optional[str] = None) -> int:
         now = self.clock()
         removed = 0
         tenants = ([tenant_id] if tenant_id is not None
-                   else list(self.tenants))
+                   else list(self.coproc.values))
         for t in tenants:
-            store = self.tenants.get(t)
-            if store is None:
-                continue
-            for rm in [x for x in store.values()
-                       if x.expire_at is not None and x.expire_at <= now]:
-                self._expire(t, rm)
-                removed += 1
+            for topic in list(self.coproc.values.get(t, {})):
+                rm = self._decode(t, topic)
+                if rm is not None and rm.expire_at is not None \
+                        and rm.expire_at <= now:
+                    await self._expire(t, rm)
+                    removed += 1
         return removed
 
-    def _expire(self, tenant_id: str, rm: RetainedMsg) -> None:
-        store = self.tenants.get(tenant_id)
-        if store is None:
-            return
-        if store.pop(rm.topic, None) is not None:
-            self.index.remove_topic(tenant_id, topic_util.parse(rm.topic),
-                                    rm.topic)
-            if not store:
-                del self.tenants[tenant_id]
+    async def _expire(self, tenant_id: str, rm: RetainedMsg) -> None:
+        from .coproc import OP_DEL, enc_op
+        await self._mutate(enc_op(OP_DEL, tenant_id, rm.topic))
 
     def topic_count(self, tenant_id: str) -> int:
-        return len(self.tenants.get(tenant_id, {}))
+        return len(self.coproc.values.get(tenant_id, {}))
+
+    def topics(self, tenant_id: str) -> List[str]:
+        """Retained topic listing (introspection/API)."""
+        return sorted(self.coproc.values.get(tenant_id, {}))

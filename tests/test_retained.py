@@ -153,7 +153,7 @@ class TestRetainService:
         for i in range(5):
             await svc.retain(PUB, f"g/{i}", mk_msg(expiry=5))
         now[0] = 100.0
-        assert svc.gc() == 5
+        assert await svc.gc() == 5
         assert svc.topic_count("T") == 0
 
     async def test_quota(self):
@@ -169,3 +169,19 @@ class TestRetainService:
         assert not await svc.retain(PUB, "two", mk_msg())
         assert await svc.retain(PUB, "one", mk_msg(b"update"))  # replace ok
         assert ev.of(EventType.RETAIN_ERROR)
+
+
+class TestRetainReplicatedDurability:
+    async def test_retained_messages_survive_restart(self):
+        from bifromq_tpu.kv.engine import InMemKVEngine
+        engine = InMemKVEngine()
+        svc = RetainService(CollectingEventCollector(), engine=engine)
+        await svc.retain(PUB, "keep/a", mk_msg(b"v1"))
+        await svc.retain(PUB, "keep/b", mk_msg(b"v2"))
+        await svc.retain(PUB, "keep/a", mk_msg(b""))  # clear one
+        await svc.stop()
+        # restart over the same engine: derived index rebuilds from KV
+        svc2 = RetainService(CollectingEventCollector(), engine=engine)
+        hits = await svc2.match("T", ["keep", "+"], limit=10)
+        assert [(t, m.payload) for t, m in hits] == [("keep/b", b"v2")]
+        assert svc2.topic_count("T") == 1
