@@ -1,0 +1,172 @@
+"""Store messenger: raft traffic between KV stores over the RPC fabric.
+
+Re-expression of the reference's AgentHostStoreMessenger
+(base-kv/base-kv-store-server .../server/AgentHostStoreMessenger.java:41):
+every store process hosts one messenger; raft messages (and snapshot dump
+chunks) addressed to ``node:range`` member ids are batched per destination
+store and shipped as one RPC frame; the receiving messenger fans them out
+to its local raft nodes. Messages to members on THIS store short-circuit
+in-process (the reference's local agent delivery).
+
+Raft tolerates message loss by design, so delivery is fire-and-forget: an
+unreachable peer's batch is dropped and heartbeat retransmission repairs
+the gap once the peer returns — no queue grows without bound
+(``MAX_BACKLOG`` per peer).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
+
+from ..raft.node import ITransport, RaftNode
+from ..raft.wire import decode_msg, encode_msg
+from ..rpc.fabric import RPCServer, ServiceRegistry, _len16, _read16
+
+log = logging.getLogger(__name__)
+
+SERVICE_PREFIX = "basekv-store"
+
+
+def node_of(member_id: str) -> str:
+    """``node:range`` member id → hosting store/node name."""
+    return member_id.split(":", 1)[0]
+
+
+class StoreMessenger(ITransport):
+    """One per store process; shared by every hosted raft group."""
+
+    MAX_BACKLOG = 4096          # queued messages per destination store
+    CALL_TIMEOUT = 10.0         # snapshot chunks can be sizeable
+
+    def __init__(self, node_id: str, registry: ServiceRegistry, *,
+                 cluster: str = "dist") -> None:
+        self.node_id = node_id
+        self.registry = registry
+        self.cluster = cluster
+        self.service = f"{SERVICE_PREFIX}:{cluster}"
+        self._local: Dict[str, RaftNode] = {}
+        self._outbox: Dict[str, Deque[Tuple[str, str, bytes]]] = {}
+        self._wakes: Dict[str, asyncio.Event] = {}
+        self._senders: Dict[str, asyncio.Task] = {}
+        self._running = False
+        self.dropped = 0
+        self.sent_batches = 0
+
+    # ---------------- ITransport -------------------------------------------
+
+    def register(self, node: RaftNode) -> None:
+        self._local[node.id] = node
+
+    def unregister(self, member_id: str) -> None:
+        self._local.pop(member_id, None)
+
+    def send(self, to: str, sender: str, msg) -> None:
+        dest = node_of(to)
+        if dest == self.node_id or to in self._local:
+            # in-proc bypass — schedule (not inline) so a reply can't
+            # re-enter the sending node mid-update
+            try:
+                asyncio.get_running_loop().call_soon(
+                    self._deliver_local, to, sender, msg)
+            except RuntimeError:    # no loop (sync test tick): inline
+                self._deliver_local(to, sender, msg)
+            return
+        q = self._outbox.setdefault(dest, deque(maxlen=self.MAX_BACKLOG))
+        if len(q) == q.maxlen:
+            self.dropped += 1
+        q.append((to, sender, encode_msg(msg)))
+        if self._running:
+            self._ensure_sender(dest).set()
+
+    def _deliver_local(self, to: str, sender: str, msg) -> None:
+        node = self._local.get(to)
+        if node is not None:
+            node.receive(sender, msg)
+
+    # ---------------- server side ------------------------------------------
+
+    def attach(self, server: RPCServer) -> None:
+        server.register(self.service, {"raft_batch": self._on_batch})
+
+    async def _on_batch(self, payload: bytes, _okey: str) -> bytes:
+        (n,) = struct.unpack_from(">I", payload, 0)
+        pos = 4
+        for _ in range(n):
+            to_b, pos = _read16(payload, pos)
+            sender_b, pos = _read16(payload, pos)
+            (mlen,) = struct.unpack_from(">I", payload, pos)
+            pos += 4
+            raw = payload[pos:pos + mlen]
+            pos += mlen
+            node = self._local.get(to_b.decode())
+            if node is not None:        # unknown member: retired range; drop
+                node.receive(sender_b.decode(), decode_msg(raw))
+        return b""
+
+    # ---------------- flush loop -------------------------------------------
+
+    async def start(self) -> None:
+        self._running = True
+        for dest, q in self._outbox.items():
+            if q:
+                self._ensure_sender(dest).set()
+
+    async def stop(self) -> None:
+        self._running = False
+        for t in self._senders.values():
+            t.cancel()
+        self._senders.clear()
+        self._wakes.clear()
+
+    def address_of(self, dest_node: str) -> Optional[str]:
+        eps = self.registry.endpoints(f"{self.service}:{dest_node}")
+        return eps[0] if eps else None
+
+    def _ensure_sender(self, dest: str) -> asyncio.Event:
+        ev = self._wakes.get(dest)
+        if ev is None:
+            ev = self._wakes[dest] = asyncio.Event()
+            # one sender per destination: a blackholed peer (slow TCP
+            # connect) must not stall heartbeats to healthy peers
+            self._senders[dest] = asyncio.create_task(
+                self._sender_loop(dest, ev))
+        return ev
+
+    async def _sender_loop(self, dest: str, wake: asyncio.Event) -> None:
+        while True:
+            await wake.wait()
+            wake.clear()
+            q = self._outbox.get(dest)
+            if not q:
+                continue
+            batch = list(q)
+            q.clear()
+            # wait_for bounds the WHOLE ship — including connection
+            # establishment, which RPCClient.call does before its own
+            # timeout applies
+            try:
+                await asyncio.wait_for(self._ship(dest, batch),
+                                       self.CALL_TIMEOUT)
+            except asyncio.TimeoutError:
+                self.dropped += len(batch)
+
+    async def _ship(self, dest: str, batch) -> None:
+        addr = self.address_of(dest)
+        if addr is None:
+            self.dropped += len(batch)
+            return
+        body = bytearray(struct.pack(">I", len(batch)))
+        for to, sender, raw in batch:
+            body += _len16(to.encode()) + _len16(sender.encode())
+            body += struct.pack(">I", len(raw)) + raw
+        try:
+            await self.registry.client_for(addr).call(
+                self.service, "raft_batch", bytes(body),
+                timeout=self.CALL_TIMEOUT)
+            self.sent_batches += 1
+        except Exception:  # noqa: BLE001 — unreachable peer: drop, raft heals
+            self.dropped += len(batch)

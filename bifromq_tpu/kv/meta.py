@@ -1,0 +1,370 @@
+"""Cluster-wide KV landscape + remote store access.
+
+Three pieces, mirroring the reference's base-kv deployment plane:
+
+- ``MetaService`` ≈ base-kv-meta-service (BaseKVMetaService.java:32 /
+  IBaseKVClusterMetadataManager): every store publishes a
+  ``KVRangeStoreDescriptor`` (store id, RPC address, hosted ranges with
+  boundaries + leader flags) into a replicated CRDT map; clients observe
+  the union and route by boundary. A static in-proc map backs tests and
+  single-process deployments, exactly like ServiceRegistry's static tier.
+- ``BaseKVStoreServer`` ≈ base-kv-store-server's RPC facade
+  (KVRangeStoreService: query/mutate per range over gRPC): hosts a
+  ``KVRangeStore`` behind the RPC fabric, attaches the raft
+  ``StoreMessenger``, ticks raft, and re-publishes its descriptor when
+  ranges/leadership change.
+- ``ClusterKVClient`` ≈ base-kv-store-client (BaseKVStoreClient.java's
+  ``latestEffectiveRouter``): boundary-routes a key to the leader replica's
+  store, follows ``not_leader`` hints, refreshes the landscape on topology
+  change, and retries sealed-range bounces (``b"retry"``).
+
+Status bytes on the query/mutate wire:
+  0 ok ‖ result   1 not_leader ‖ len16 leader-store hint
+  2 no_range      3 retry (seal/boundary bounce)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import struct
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..rpc.fabric import RPCServer, ServiceRegistry, _len16, _read16
+from ..raft.node import NotLeaderError
+from .messenger import StoreMessenger, node_of
+from .store import KVRangeStore
+
+log = logging.getLogger(__name__)
+
+_OK, _NOT_LEADER, _NO_RANGE, _RETRY = 0, 1, 2, 3
+
+
+class MetaService:
+    """Replicated store-descriptor map (landscape)."""
+
+    URI = "landscape"
+
+    def __init__(self, crdt_store=None) -> None:
+        self.crdt = crdt_store
+        self._static: Dict[str, dict] = {}   # "cluster/store" -> descriptor
+
+    def announce(self, cluster: str, descriptor: dict) -> None:
+        key = f"{cluster}/{descriptor['store_id']}"
+        if self.crdt is not None:
+            # latest-wins register semantics over the AWORSet: retire every
+            # superseded element, then add the new one
+            for el in self.crdt.elements(self.URI, key):
+                self.crdt.set_remove(self.URI, key, el)
+            self.crdt.set_add(self.URI, key, json.dumps(descriptor,
+                                                        sort_keys=True))
+        self._static[key] = descriptor
+
+    def withdraw(self, cluster: str, store_id: str) -> None:
+        key = f"{cluster}/{store_id}"
+        if self.crdt is not None:
+            self.crdt.remove_key(self.URI, key)
+        self._static.pop(key, None)
+
+    def landscape(self, cluster: str) -> Dict[str, dict]:
+        """store_id → freshest descriptor (max epoch wins across tiers)."""
+        out: Dict[str, dict] = {}
+
+        def fold(desc: dict) -> None:
+            sid = desc["store_id"]
+            if sid not in out or desc["epoch"] > out[sid]["epoch"]:
+                out[sid] = desc
+
+        prefix = f"{cluster}/"
+        if self.crdt is not None:
+            for key in self.crdt.keys(self.URI):
+                if key.startswith(prefix):
+                    for el in self.crdt.elements(self.URI, key):
+                        try:
+                            fold(json.loads(el))
+                        except (ValueError, KeyError):
+                            continue
+        for key, desc in self._static.items():
+            if key.startswith(prefix):
+                fold(desc)
+        return out
+
+
+def _store_descriptor(store: KVRangeStore, address: str,
+                      epoch: int) -> dict:
+    ranges = []
+    for rid, r in sorted(store.ranges.items()):
+        s, e = store.boundaries[rid]
+        leader = r.raft.leader_id
+        ranges.append({
+            "id": rid, "start": s.hex(),
+            "end": e.hex() if e is not None else None,
+            "is_leader": r.is_leader,
+            "leader_store": node_of(leader) if leader else None,
+        })
+    return {"store_id": store.node_id, "address": address, "epoch": epoch,
+            "ranges": ranges}
+
+
+class BaseKVStoreServer:
+    """RPC facade for one KVRangeStore process."""
+
+    ANNOUNCE_INTERVAL = 0.1
+
+    def __init__(self, store: KVRangeStore, messenger: StoreMessenger,
+                 server: RPCServer, registry: ServiceRegistry,
+                 meta: MetaService, *, cluster: str = "dist",
+                 tick_interval: float = 0.02) -> None:
+        self.store = store
+        self.messenger = messenger
+        self.server = server
+        self.registry = registry
+        self.meta = meta
+        self.cluster = cluster
+        self.tick_interval = tick_interval
+        self.service = f"basekv:{cluster}"
+        self._epoch = 0
+        self._last_published = None
+        self._tasks: List[asyncio.Task] = []
+        server.register(self.service, {
+            "query": self._on_query,
+            "mutate": self._on_mutate,
+            "describe": self._on_describe,
+        })
+        messenger.attach(server)
+
+    async def start(self) -> None:
+        if self.server._server is None:
+            await self.server.start()
+        addr = self.server.address
+        # per-node raft ingress + the shared query/mutate service
+        self.registry.announce(f"{self.messenger.service}:"
+                               f"{self.store.node_id}", addr)
+        self.registry.announce(self.service, addr)
+        await self.messenger.start()
+        self._publish(force=True)
+
+        async def tick_loop() -> None:
+            while True:
+                try:
+                    self.store.tick()
+                    self._publish()
+                except Exception:  # noqa: BLE001 — a tick error must not
+                    log.exception("store tick failed")  # zombie the store
+                await asyncio.sleep(self.tick_interval)
+        self._tasks.append(asyncio.create_task(tick_loop()))
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        self._tasks.clear()
+        await self.messenger.stop()
+        addr = self.server.address
+        self.registry.withdraw(f"{self.messenger.service}:"
+                               f"{self.store.node_id}", addr)
+        self.registry.withdraw(self.service, addr)
+        self.meta.withdraw(self.cluster, self.store.node_id)
+        self.store.stop()
+        await self.server.stop()
+
+    def _publish(self, force: bool = False) -> None:
+        desc = _store_descriptor(self.store, self.server.address,
+                                 self._epoch)
+        fingerprint = json.dumps(desc["ranges"], sort_keys=True)
+        if not force and fingerprint == self._last_published:
+            return
+        # restart-monotonic: a rebooted store's fresh descriptors must
+        # outrank its pre-crash ones in the landscape's max-epoch fold
+        self._epoch = max(self._epoch + 1, time.time_ns() // 1_000_000)
+        desc["epoch"] = self._epoch
+        self._last_published = fingerprint
+        self.meta.announce(self.cluster, desc)
+
+    # ---------------- handlers ---------------------------------------------
+
+    def _range(self, range_id: str):
+        return self.store.ranges.get(range_id)
+
+    @staticmethod
+    def _leader_hint(r) -> bytes:
+        leader = r.raft.leader_id
+        hint = node_of(leader) if leader else ""
+        return bytes([_NOT_LEADER]) + _len16(hint.encode())
+
+    async def _on_query(self, payload: bytes, _okey: str) -> bytes:
+        rid_b, pos = _read16(payload, 0)
+        linearized = bool(payload[pos])
+        r = self._range(rid_b.decode())
+        if r is None:
+            return bytes([_NO_RANGE])
+        try:
+            out = await r.query_coproc(payload[pos + 1:],
+                                       linearized=linearized)
+        except NotLeaderError:
+            return self._leader_hint(r)
+        return bytes([_OK]) + out
+
+    async def _on_mutate(self, payload: bytes, _okey: str) -> bytes:
+        rid_b, pos = _read16(payload, 0)
+        r = self._range(rid_b.decode())
+        if r is None:
+            return bytes([_NO_RANGE])
+        try:
+            out = await r.mutate_coproc(payload[pos:])
+        except NotLeaderError:
+            return self._leader_hint(r)
+        if out == b"retry":         # sealed for a merge: re-resolve
+            return bytes([_RETRY])
+        return bytes([_OK]) + out
+
+    async def _on_describe(self, _payload: bytes, _okey: str) -> bytes:
+        return json.dumps(_store_descriptor(
+            self.store, self.server.address, self._epoch)).encode()
+
+
+class ClusterKVClient:
+    """Boundary router + leader-following query/mutate pipelines."""
+
+    MAX_ATTEMPTS = 8
+    CALL_TIMEOUT = 10.0
+
+    def __init__(self, meta: MetaService, registry: ServiceRegistry, *,
+                 cluster: str = "dist",
+                 seeds: Optional[List[str]] = None) -> None:
+        self.meta = meta
+        self.registry = registry
+        self.cluster = cluster
+        self.seeds = list(seeds or [])   # store addresses to poll when the
+        self.service = f"basekv:{cluster}"  # landscape isn't CRDT-replicated
+        # range_id -> (start, end, leader_store, {store_id: address})
+        self._routes: List[Tuple[bytes, Optional[bytes], str,
+                                 Optional[str], Dict[str, str]]] = []
+        self.refresh()
+
+    def refresh(self) -> None:
+        landscape = self.meta.landscape(self.cluster)
+        by_range: Dict[str, dict] = {}
+        for sid, desc in landscape.items():
+            for rd in desc["ranges"]:
+                rec = by_range.setdefault(rd["id"], {
+                    "start": bytes.fromhex(rd["start"]),
+                    "end": (bytes.fromhex(rd["end"])
+                            if rd["end"] is not None else None),
+                    "leader": None, "leader_epoch": -1, "stores": {}})
+                rec["stores"][sid] = desc["address"]
+                # freshest claim wins: a dead store's stale is_leader flag
+                # must not shadow the survivor's newer election result
+                if rd["is_leader"] and desc["epoch"] > rec["leader_epoch"]:
+                    rec["leader"] = sid
+                    rec["leader_epoch"] = desc["epoch"]
+                elif rec["leader"] is None and rd["leader_store"]:
+                    rec["leader"] = rd["leader_store"]
+        self._routes = sorted(
+            ((rec["start"], rec["end"], rid, rec["leader"], rec["stores"])
+             for rid, rec in by_range.items()),
+            key=lambda t: t[0])
+
+    def find(self, key: bytes):
+        for start, end, rid, leader, stores in self._routes:
+            if key >= start and (end is None or key < end):
+                return rid, leader, stores
+        return None
+
+    def ranges(self) -> List[Tuple[bytes, Optional[bytes], str]]:
+        return [(s, e, rid) for s, e, rid, _l, _st in self._routes]
+
+    async def refresh_remote(self) -> None:
+        """Fold fresh descriptors polled from seed stores into the local
+        landscape (cross-process deployments without a shared CRDT); a seed
+        that fails the poll is evicted so its stale descriptor can't keep
+        routing traffic at a dead address."""
+        for addr in self.seeds:
+            try:
+                desc = await asyncio.wait_for(self.describe(addr),
+                                              self.CALL_TIMEOUT)
+                self.meta.announce(self.cluster, desc)
+            except Exception:  # noqa: BLE001 — dead seed: evict + skip
+                for sid, desc in self.meta.landscape(self.cluster).items():
+                    if desc["address"] == addr:
+                        self.meta.withdraw(self.cluster, sid)
+        self.refresh()
+
+    async def _refresh(self) -> None:
+        if self.seeds:
+            await self.refresh_remote()
+        else:
+            self.refresh()
+
+    async def _call(self, method: str, key: bytes, payload: bytes,
+                    *, order_key: str = "") -> bytes:
+        last_err: Optional[Exception] = None
+        prefer: Optional[str] = None
+        for attempt in range(self.MAX_ATTEMPTS):
+            route = self.find(key)
+            if route is None:
+                await asyncio.sleep(0.05)
+                await self._refresh()
+                continue
+            rid, leader, stores = route
+            target = prefer or leader
+            addr = stores.get(target) if target else None
+            if addr is None:            # no known leader: probe any replica
+                addr = next(iter(stores.values()), None)
+            if addr is None:
+                await asyncio.sleep(0.05)
+                await self._refresh()
+                continue
+            body = _len16(rid.encode()) + payload
+            try:
+                # wait_for bounds connection establishment too (a
+                # blackholed store must not stall the call for the OS
+                # SYN-retry window)
+                out = await asyncio.wait_for(
+                    self.registry.client_for(addr).call(
+                        self.service, method, body, order_key=order_key),
+                    self.CALL_TIMEOUT)
+            except Exception as e:  # noqa: BLE001 — dead store: re-route
+                last_err = e
+                prefer = None
+                await asyncio.sleep(0.05 * (attempt + 1))
+                await self._refresh()
+                continue
+            status = out[0]
+            if status == _OK:
+                return out[1:]
+            if status == _NOT_LEADER:
+                hint_b, _ = _read16(out, 1)
+                prefer = hint_b.decode() or None
+                if prefer == target:    # stale self-hint: re-elect soon
+                    prefer = None
+                await asyncio.sleep(0.02 * (attempt + 1))
+                await self._refresh()
+                continue
+            # no_range (post-split/merge topology) or sealed retry
+            prefer = None
+            await asyncio.sleep(0.02 * (attempt + 1))
+            await self._refresh()
+        raise RuntimeError(
+            f"kv {method} failed after {self.MAX_ATTEMPTS} attempts"
+            + (f": {last_err!r}" if last_err else ""))
+
+    async def query(self, key: bytes, payload: bytes, *,
+                    linearized: bool = True) -> bytes:
+        return await self._call(
+            "query", key, bytes([int(linearized)]) + payload)
+
+    async def mutate(self, key: bytes, payload: bytes, *,
+                     order_key: str = "") -> bytes:
+        """Mutations MUST be idempotent: a reply lost to a connection drop
+        re-proposes an already-committed op (the same at-least-once
+        contract range.py's crash re-apply already imposes — route upserts
+        carry incarnation guards, inbox inserts op-nonce dedup)."""
+        return await self._call("mutate", key, payload,
+                                order_key=order_key)
+
+    async def describe(self, address: str) -> dict:
+        out = await self.registry.client_for(address).call(
+            self.service, "describe", b"")
+        return json.loads(out.decode())

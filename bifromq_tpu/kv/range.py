@@ -32,7 +32,12 @@ class IKVRangeCoProc:
 
     def mutate(self, input_data: bytes, reader: IKVSpace,
                writer: KVWriteBatch) -> bytes:
-        """Stage writes into ``writer``; return the output payload."""
+        """Stage writes into ``writer``; return the output payload.
+
+        ``b"retry"`` is RESERVED: it signals a boundary/seal bounce and
+        makes the caller re-resolve the range and re-propose — coprocs
+        return it for keys outside their boundary, never as user data.
+        """
         raise NotImplementedError
 
     def reset(self, reader: IKVSpace) -> None:

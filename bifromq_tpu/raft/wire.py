@@ -1,0 +1,225 @@
+"""Binary wire codec for raft messages.
+
+The reference serializes raft traffic as protobuf
+(base-kv/base-kv-raft .../raft/proto/raft.proto: AppendEntries,
+RequestVote, InstallSnapshot...) and tunnels it between stores over the
+cluster messenger (AgentHostStoreMessenger.java:41). protoc-generated
+Python is slow and the schema here is small and stable, so this is a
+hand-rolled fixed-width big-endian codec: one tag byte then the fields in
+declaration order. Every message dataclass in raft/node.py round-trips.
+
+Framing of optionals:
+  opt-int  := 0x00 | 0x01 ‖ u64
+  opt-strs := u16 count (0xFFFF = absent) ‖ count × (len16 str)
+  opt-snap := 0x00 | 0x01 ‖ snapshot
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Tuple
+
+from .node import (AppendEntries, AppendReply, InstallSnapshot, LogEntry,
+                   PreVote, PreVoteReply, RequestVote, Snapshot,
+                   SnapshotChunk, SnapshotChunkAck, SnapshotReply,
+                   TimeoutNow, VoteReply)
+
+_TAGS = [RequestVote, VoteReply, PreVote, PreVoteReply, AppendEntries,
+         AppendReply, InstallSnapshot, SnapshotReply, TimeoutNow,
+         SnapshotChunk, SnapshotChunkAck]
+_TAG_OF = {cls: i for i, cls in enumerate(_TAGS)}
+
+_ABSENT = 0xFFFF
+
+
+def _s(txt: str) -> bytes:
+    b = txt.encode()
+    return struct.pack(">H", len(b)) + b
+
+
+def _rs(buf: bytes, pos: int) -> Tuple[str, int]:
+    (n,) = struct.unpack_from(">H", buf, pos)
+    pos += 2
+    return buf[pos:pos + n].decode(), pos + n
+
+
+def _b32(b: bytes) -> bytes:
+    return struct.pack(">I", len(b)) + b
+
+
+def _rb32(buf: bytes, pos: int) -> Tuple[bytes, int]:
+    (n,) = struct.unpack_from(">I", buf, pos)
+    pos += 4
+    return buf[pos:pos + n], pos + n
+
+
+def _opt_int(v: Optional[int]) -> bytes:
+    return b"\x00" if v is None else b"\x01" + struct.pack(">Q", v)
+
+
+def _r_opt_int(buf: bytes, pos: int) -> Tuple[Optional[int], int]:
+    if buf[pos] == 0:
+        return None, pos + 1
+    return struct.unpack_from(">Q", buf, pos + 1)[0], pos + 9
+
+
+def _strs(items: Optional[Tuple[str, ...]]) -> bytes:
+    if items is None:
+        return struct.pack(">H", _ABSENT)
+    out = struct.pack(">H", len(items))
+    for s in items:
+        out += _s(s)
+    return out
+
+
+def _r_strs(buf: bytes, pos: int) -> Tuple[Optional[Tuple[str, ...]], int]:
+    (n,) = struct.unpack_from(">H", buf, pos)
+    pos += 2
+    if n == _ABSENT:
+        return None, pos
+    out = []
+    for _ in range(n):
+        s, pos = _rs(buf, pos)
+        out.append(s)
+    return tuple(out), pos
+
+
+def _entry(e: LogEntry) -> bytes:
+    return (struct.pack(">QQ", e.term, e.index) + _b32(e.data)
+            + _strs(e.config) + _strs(e.config_old))
+
+
+def _r_entry(buf: bytes, pos: int) -> Tuple[LogEntry, int]:
+    term, index = struct.unpack_from(">QQ", buf, pos)
+    pos += 16
+    data, pos = _rb32(buf, pos)
+    config, pos = _r_strs(buf, pos)
+    config_old, pos = _r_strs(buf, pos)
+    return LogEntry(term=term, index=index, data=data, config=config,
+                    config_old=config_old), pos
+
+
+def _snap(s: Snapshot) -> bytes:
+    return (struct.pack(">QQ", s.last_index, s.last_term) + _b32(s.data)
+            + _strs(s.voters) + _strs(s.voters_old))
+
+
+def _r_snap(buf: bytes, pos: int) -> Tuple[Snapshot, int]:
+    li, lt = struct.unpack_from(">QQ", buf, pos)
+    pos += 16
+    data, pos = _rb32(buf, pos)
+    voters, pos = _r_strs(buf, pos)
+    voters_old, pos = _r_strs(buf, pos)
+    return Snapshot(last_index=li, last_term=lt, data=data,
+                    voters=voters or (), voters_old=voters_old), pos
+
+
+def encode_msg(msg) -> bytes:
+    tag = _TAG_OF[type(msg)]
+    out = bytearray([tag])
+    if isinstance(msg, (RequestVote, PreVote)):
+        out += struct.pack(">Q", msg.term) + _s(msg.candidate)
+        out += struct.pack(">QQ", msg.last_log_index, msg.last_log_term)
+    elif isinstance(msg, (VoteReply, PreVoteReply)):
+        out += struct.pack(">QB", msg.term, int(msg.granted))
+    elif isinstance(msg, AppendEntries):
+        out += struct.pack(">Q", msg.term) + _s(msg.leader)
+        out += struct.pack(">QQ", msg.prev_index, msg.prev_term)
+        out += struct.pack(">I", len(msg.entries))
+        for e in msg.entries:
+            out += _entry(e)
+        out += struct.pack(">Q", msg.leader_commit)
+        out += _opt_int(msg.read_ctx)
+    elif isinstance(msg, AppendReply):
+        out += struct.pack(">QBQ", msg.term, int(msg.success),
+                           msg.match_index)
+        out += _opt_int(msg.read_ctx)
+    elif isinstance(msg, InstallSnapshot):
+        out += struct.pack(">Q", msg.term) + _s(msg.leader)
+        out += _snap(msg.snapshot)
+    elif isinstance(msg, SnapshotReply):
+        out += struct.pack(">QQ", msg.term, msg.match_index)
+    elif isinstance(msg, TimeoutNow):
+        out += struct.pack(">Q", msg.term)
+    elif isinstance(msg, SnapshotChunk):
+        out += struct.pack(">Q", msg.term) + _s(msg.leader)
+        out += struct.pack(">QQ", msg.session_id, msg.seq)
+        out += _b32(msg.data) + bytes([int(msg.last)])
+        if msg.meta is None:
+            out += b"\x00"
+        else:
+            out += b"\x01" + _snap(msg.meta)
+    elif isinstance(msg, SnapshotChunkAck):
+        out += struct.pack(">QQQ", msg.term, msg.session_id, msg.seq)
+    else:  # pragma: no cover - _TAG_OF lookup already failed
+        raise TypeError(f"unknown raft message {type(msg)}")
+    return bytes(out)
+
+
+def decode_msg(buf: bytes):
+    cls = _TAGS[buf[0]]
+    pos = 1
+    if cls in (RequestVote, PreVote):
+        (term,) = struct.unpack_from(">Q", buf, pos)
+        pos += 8
+        cand, pos = _rs(buf, pos)
+        lli, llt = struct.unpack_from(">QQ", buf, pos)
+        return cls(term=term, candidate=cand, last_log_index=lli,
+                   last_log_term=llt)
+    if cls in (VoteReply, PreVoteReply):
+        term, granted = struct.unpack_from(">QB", buf, pos)
+        return cls(term=term, granted=bool(granted))
+    if cls is AppendEntries:
+        (term,) = struct.unpack_from(">Q", buf, pos)
+        pos += 8
+        leader, pos = _rs(buf, pos)
+        prev_i, prev_t = struct.unpack_from(">QQ", buf, pos)
+        pos += 16
+        (n,) = struct.unpack_from(">I", buf, pos)
+        pos += 4
+        entries: List[LogEntry] = []
+        for _ in range(n):
+            e, pos = _r_entry(buf, pos)
+            entries.append(e)
+        (commit,) = struct.unpack_from(">Q", buf, pos)
+        pos += 8
+        read_ctx, pos = _r_opt_int(buf, pos)
+        return AppendEntries(term=term, leader=leader, prev_index=prev_i,
+                             prev_term=prev_t, entries=entries,
+                             leader_commit=commit, read_ctx=read_ctx)
+    if cls is AppendReply:
+        term, success, match = struct.unpack_from(">QBQ", buf, pos)
+        pos += 17
+        read_ctx, pos = _r_opt_int(buf, pos)
+        return AppendReply(term=term, success=bool(success),
+                           match_index=match, read_ctx=read_ctx)
+    if cls is InstallSnapshot:
+        (term,) = struct.unpack_from(">Q", buf, pos)
+        pos += 8
+        leader, pos = _rs(buf, pos)
+        snap, pos = _r_snap(buf, pos)
+        return InstallSnapshot(term=term, leader=leader, snapshot=snap)
+    if cls is SnapshotReply:
+        term, match = struct.unpack_from(">QQ", buf, pos)
+        return SnapshotReply(term=term, match_index=match)
+    if cls is TimeoutNow:
+        (term,) = struct.unpack_from(">Q", buf, pos)
+        return TimeoutNow(term=term)
+    if cls is SnapshotChunk:
+        (term,) = struct.unpack_from(">Q", buf, pos)
+        pos += 8
+        leader, pos = _rs(buf, pos)
+        sid, seq = struct.unpack_from(">QQ", buf, pos)
+        pos += 16
+        data, pos = _rb32(buf, pos)
+        last = bool(buf[pos])
+        pos += 1
+        meta = None
+        if buf[pos] == 1:
+            meta, _ = _r_snap(buf, pos + 1)
+        return SnapshotChunk(term=term, leader=leader, session_id=sid,
+                             seq=seq, data=data, last=last, meta=meta)
+    if cls is SnapshotChunkAck:
+        term, sid, seq = struct.unpack_from(">QQQ", buf, pos)
+        return SnapshotChunkAck(term=term, session_id=sid, seq=seq)
+    raise TypeError(f"unknown tag {buf[0]}")  # pragma: no cover

@@ -1,0 +1,110 @@
+"""Three-OS-process raft cluster: replication, leader crash, catch-up.
+
+The VERDICT-r2 gap this closes: raft previously rode only InMemTransport,
+so replication could not cross a process boundary. Here three
+``bifromq_tpu.kv.store_main`` processes replicate one range over real TCP
+(StoreMessenger ≈ AgentHostStoreMessenger); the driver routes via the
+landscape (ClusterKVClient), SIGKILLs the leader, watches the survivors
+elect and keep serving, then restarts the dead node empty and waits for
+the snapshot dump session to catch it up.
+"""
+
+import asyncio
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from bifromq_tpu.kv.meta import ClusterKVClient, MetaService
+from bifromq_tpu.rpc.fabric import ServiceRegistry, _len16
+
+pytestmark = pytest.mark.asyncio
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NODES = ["p1", "p2", "p3"]
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _spawn(node, port, peers):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "bifromq_tpu.kv.store_main",
+         "--node", node, "--port", str(port), "--peers", peers,
+         "--tick-interval", "0.01"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+    line = proc.stdout.readline().strip()
+    assert line.startswith("READY "), line
+    return proc
+
+
+class TestThreeProcess:
+    async def test_crash_failover_and_catchup(self):
+        ports = _free_ports(3)
+        peers = ",".join(f"{n}=127.0.0.1:{p}" for n, p in zip(NODES, ports))
+        addrs = {n: f"127.0.0.1:{p}" for n, p in zip(NODES, ports)}
+        procs = {n: _spawn(n, p, peers) for n, p in zip(NODES, ports)}
+        registry = ServiceRegistry()
+        client = ClusterKVClient(MetaService(), registry,
+                                 seeds=list(addrs.values()))
+        try:
+            # -- replicate through the landscape-routed leader --------------
+            assert await client.mutate(b"k", b"k=v1") == b"ok:k"
+            assert await client.query(b"k", b"k") == b"v1"
+
+            # -- SIGKILL the leader; survivors elect and serve --------------
+            await client.refresh_remote()
+            _rid, leader, _stores = client.find(b"k")
+            assert leader in procs
+            procs[leader].kill()
+            procs[leader].wait(timeout=10)
+            client.seeds = [a for n, a in addrs.items() if n != leader]
+            assert await client.mutate(b"k", b"k=v2") == b"ok:k"
+            assert await client.query(b"k", b"k") == b"v2"
+            # enough churn that the dead node must catch up via snapshot
+            for i in range(300):
+                await client.mutate(b"bulk", f"bulk{i}=x".encode())
+
+            # -- restart the dead node empty; snapshot catches it up --------
+            procs[leader] = _spawn(leader, int(addrs[leader].split(":")[1]),
+                                   peers)
+            client.seeds = list(addrs.values())
+            reborn = registry.client_for(addrs[leader])
+            payload = _len16(b"r0") + b"\x00" + b"k"  # non-linearized local
+            deadline = asyncio.get_running_loop().time() + 15
+            got = b""
+            while asyncio.get_running_loop().time() < deadline:
+                try:
+                    out = await reborn.call("basekv:dist", "query", payload)
+                    if out[0] == 0 and out[1:] == b"v2":
+                        got = out[1:]
+                        break
+                except Exception:
+                    pass
+                await asyncio.sleep(0.1)
+            assert got == b"v2"
+        finally:
+            for p in procs.values():
+                p.kill()
+            for p in procs.values():
+                try:
+                    p.wait(timeout=5)
+                except Exception:
+                    pass
+            await registry.close()

@@ -1,0 +1,154 @@
+"""Raft over the wire: multi-store replication across real TCP sockets.
+
+≈ the reference's store-messenger deployment (AgentHostStoreMessenger
+tunneling raft between KVRangeStores) + meta-service landscape routing
+(BaseKVMetaService): three stores on loopback RPC servers replicate one
+range; a client routes by boundary via the landscape, follows leader
+hints, survives a leader kill, and a wiped replica catches up via the
+snapshot dump session.
+"""
+
+import asyncio
+
+import pytest
+
+from bifromq_tpu.kv.engine import InMemKVEngine
+from bifromq_tpu.kv.messenger import StoreMessenger
+from bifromq_tpu.kv.meta import BaseKVStoreServer, ClusterKVClient, MetaService
+from bifromq_tpu.kv.store import KVRangeStore
+from bifromq_tpu.raft import wire
+from bifromq_tpu.raft.node import (AppendEntries, AppendReply,
+                                   InstallSnapshot, LogEntry, PreVote,
+                                   PreVoteReply, RequestVote, Snapshot,
+                                   SnapshotChunk, SnapshotChunkAck,
+                                   SnapshotReply, TimeoutNow, VoteReply)
+from bifromq_tpu.rpc.fabric import ServiceRegistry
+
+pytestmark = pytest.mark.asyncio
+
+NODES = ["s1", "s2", "s3"]
+
+
+class TestWireCodec:
+    def test_roundtrip_all_messages(self):
+        entries = [
+            LogEntry(term=2, index=5, data=b"\x00payload"),
+            LogEntry(term=3, index=6, data=b"", config=("a:r0", "b:r0")),
+            LogEntry(term=3, index=7, data=b"", config=("a:r0",),
+                     config_old=("a:r0", "b:r0")),
+        ]
+        snap = Snapshot(last_index=9, last_term=3, data=b"snapdata",
+                        voters=("a:r0", "b:r0"), voters_old=None)
+        snap_joint = Snapshot(last_index=9, last_term=3, data=b"",
+                              voters=("a:r0",), voters_old=("a:r0", "b:r0"))
+        msgs = [
+            RequestVote(term=4, candidate="a:r0", last_log_index=7,
+                        last_log_term=3),
+            VoteReply(term=4, granted=True),
+            PreVote(term=5, candidate="b:r0", last_log_index=0,
+                    last_log_term=0),
+            PreVoteReply(term=5, granted=False),
+            AppendEntries(term=4, leader="a:r0", prev_index=4, prev_term=2,
+                          entries=entries, leader_commit=5, read_ctx=None),
+            AppendEntries(term=4, leader="a:r0", prev_index=4, prev_term=2,
+                          entries=[], leader_commit=5, read_ctx=17),
+            AppendReply(term=4, success=True, match_index=7, read_ctx=17),
+            AppendReply(term=4, success=False, match_index=0, read_ctx=None),
+            InstallSnapshot(term=4, leader="a:r0", snapshot=snap),
+            InstallSnapshot(term=4, leader="a:r0", snapshot=snap_joint),
+            SnapshotReply(term=4, match_index=9),
+            TimeoutNow(term=4),
+            SnapshotChunk(term=4, leader="a:r0", session_id=11, seq=0,
+                          data=b"chunk0", last=False, meta=snap),
+            SnapshotChunk(term=4, leader="a:r0", session_id=11, seq=1,
+                          data=b"chunk1", last=True, meta=None),
+            SnapshotChunkAck(term=4, session_id=11, seq=1),
+        ]
+        for m in msgs:
+            assert wire.decode_msg(wire.encode_msg(m)) == m, m
+
+
+def _mk_store(node, registry, meta, engine=None):
+    from bifromq_tpu.kv.store_main import _coproc_factory
+    engine = engine or InMemKVEngine()
+    messenger = StoreMessenger(node, registry)
+    store = KVRangeStore(node, messenger, engine,
+                         _coproc_factory("echo"), member_nodes=NODES)
+    store.open()
+    from bifromq_tpu.rpc.fabric import RPCServer
+    server = BaseKVStoreServer(store, messenger, RPCServer(port=0),
+                               registry, meta, tick_interval=0.01)
+    return server, engine
+
+
+async def _wait_leader(servers, range_id="r0", timeout=5.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        for srv in servers:
+            r = srv.store.ranges.get(range_id)
+            if r is not None and r.is_leader:
+                return srv
+        await asyncio.sleep(0.02)
+    raise AssertionError("no leader elected")
+
+
+class TestWireCluster:
+    async def test_replicate_failover_catchup(self):
+        registry = ServiceRegistry()
+        meta = MetaService()
+        servers = {}
+        for n in NODES:
+            servers[n], _ = _mk_store(n, registry, meta)
+        for srv in servers.values():
+            await srv.start()
+        try:
+            leader_srv = await _wait_leader(list(servers.values()))
+            client = ClusterKVClient(meta, registry)
+
+            # -- replicated mutate routed by boundary -----------------------
+            assert await client.mutate(b"alpha", b"alpha=1") == b"ok:alpha"
+            assert await client.query(b"alpha", b"alpha") == b"1"
+            # the entry reached a majority; followers apply on commit
+            # broadcast — give the heartbeat a beat to advance commit
+            await asyncio.sleep(0.2)
+            applied = sum(
+                1 for srv in servers.values()
+                if srv.store.ranges["r0"].space.get(b"alpha") == b"1")
+            assert applied >= 2, applied
+
+            # -- leader kill: survivors elect and keep serving --------------
+            dead = leader_srv.store.node_id
+            await leader_srv.stop()
+            registry.withdraw(f"basekv-store:dist:{dead}",
+                              leader_srv.server.address)
+            registry.withdraw("basekv:dist", leader_srv.server.address)
+            survivors = [s for n, s in servers.items() if n != dead]
+            await _wait_leader(survivors)
+            assert await client.mutate(b"beta", b"beta=2") == b"ok:beta"
+            assert await client.query(b"beta", b"beta") == b"2"
+
+            # -- wiped replica rejoins and catches up via snapshot ----------
+            new_leader = await _wait_leader(survivors)
+            # push the leader log past the compaction threshold so the
+            # rejoining empty replica must take the dump-session path
+            for i in range(new_leader.store.ranges["r0"]
+                           .raft.SNAPSHOT_THRESHOLD + 10):
+                await client.mutate(b"bulk", f"bulk{i}=x".encode())
+            reborn, _ = _mk_store(dead, registry, meta)
+            servers[dead] = reborn
+            await reborn.start()
+            deadline = asyncio.get_running_loop().time() + 10
+            while asyncio.get_running_loop().time() < deadline:
+                if (reborn.store.ranges["r0"].space.get(b"alpha") == b"1"
+                        and reborn.store.ranges["r0"].space.get(b"beta")
+                        == b"2"):
+                    break
+                await asyncio.sleep(0.05)
+            assert reborn.store.ranges["r0"].space.get(b"alpha") == b"1"
+            assert reborn.store.ranges["r0"].space.get(b"beta") == b"2"
+        finally:
+            for srv in servers.values():
+                try:
+                    await srv.stop()
+                except Exception:
+                    pass
