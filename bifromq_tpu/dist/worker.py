@@ -29,6 +29,7 @@ from ..utils import topic as topic_util
 _OP_ADD = 0
 _OP_REMOVE = 1
 _OP_MATCH = 2
+_OP_BATCH = 3
 
 
 def _frame(b: bytes) -> bytes:
@@ -59,6 +60,27 @@ def encode_remove_route(tenant_id: str, matcher: RouteMatcher,
     key = schema.route_key(tenant_id, matcher, receiver_url)
     return (bytes([_OP_REMOVE]) + _frame(key)
             + _frame(schema.route_value(incarnation)))
+
+
+def encode_batch(sub_ops: Sequence[bytes]) -> bytes:
+    """Many add/remove ops as ONE raft entry (≈ BatchMatchCall folding an
+    orderKey-pinned call window into a single KVRangeRWRequest,
+    bifromq-dist-server .../scheduler/BatchMatchCall.java)."""
+    out = bytearray([_OP_BATCH])
+    out += struct.pack(">I", len(sub_ops))
+    for op in sub_ops:
+        out += _frame(op)
+    return bytes(out)
+
+
+def decode_batch_reply(buf: bytes) -> List[bytes]:
+    n = struct.unpack_from(">I", buf, 0)[0]
+    pos = 4
+    out = []
+    for _ in range(n):
+        s, pos = _read_frame(buf, pos)
+        out.append(s)
+    return out
 
 
 def encode_match_query(tenant_id: str, topics: Sequence[str]) -> bytes:
@@ -105,6 +127,24 @@ class DistWorkerCoProc(IKVRangeCoProc):
 
     def mutate(self, input_data: bytes, reader: IKVSpace,
                writer: KVWriteBatch) -> bytes:
+        if input_data[0] == _OP_BATCH:
+            # one raft entry, many route ops; per-op status so a boundary
+            # bounce on one key doesn't poison its batch-mates. The overlay
+            # makes earlier batch-mates' staged writes visible to later
+            # incarnation-guard reads (KVWriteBatch only lands at done()).
+            n = struct.unpack_from(">I", input_data, 1)[0]
+            pos = 5
+            statuses = bytearray(struct.pack(">I", n))
+            overlay: dict = {}
+            for _ in range(n):
+                sub, pos = _read_frame(input_data, pos)
+                st = self._mutate_one(sub, reader, writer, overlay)
+                statuses += _frame(st)
+            return bytes(statuses)
+        return self._mutate_one(input_data, reader, writer, {})
+
+    def _mutate_one(self, input_data: bytes, reader: IKVSpace,
+                    writer: KVWriteBatch, overlay: dict) -> bytes:
         op = input_data[0]
         key, pos = _read_frame(input_data, 1)
         if self.boundary is not None:
@@ -115,23 +155,29 @@ class DistWorkerCoProc(IKVRangeCoProc):
         tenant_id = _tenant_of_key(key)  # single source of truth: the key
         route = schema.decode_route(tenant_id, key, value)
         incarnation = route.incarnation
+
+        def current(k: bytes):
+            return overlay[k] if k in overlay else reader.get(k)
+
         if op == _OP_ADD:
-            existing = reader.get(key)
+            existing = current(key)
             if existing is not None:
                 prev_inc = struct.unpack(">q", existing)[0]
                 if prev_inc > incarnation:
                     return b"stale"  # incarnation guard
             writer.put(key, value)
+            overlay[key] = value
             self.matcher.add_route(tenant_id, route)
             return b"ok" if existing is None else b"exists"
         if op == _OP_REMOVE:
-            existing = reader.get(key)
+            existing = current(key)
             if existing is None:
                 return b"missing"
             prev_inc = struct.unpack(">q", existing)[0]
             if prev_inc > incarnation:
                 return b"stale"
             writer.delete(key)
+            overlay[key] = None
             self.matcher.remove_route(tenant_id, route.matcher,
                                       route.receiver_url, incarnation)
             return b"ok"
@@ -216,6 +262,12 @@ class DistWorker:
             raft_store_factory=raft_store_factory)
         self.tick_interval = tick_interval
         self._tick_task = None
+        # mutations coalesce per range into ONE raft entry per flush
+        # (≈ BatchMatchCall): consensus cost amortizes across the batch
+        from ..scheduler.batcher import BatchCallScheduler
+        self._mutation_scheduler = BatchCallScheduler(
+            lambda rid: (lambda calls: self._propose_batch(rid, calls)),
+            max_burst_latency=0.005)
         self.balance_controller = None
         if split_threshold is not None:
             from ..kv.balance import (KVStoreBalanceController,
@@ -305,9 +357,12 @@ class DistWorker:
         deadline = _time.monotonic() + timeout
         while True:
             # re-resolve each attempt: a concurrent split may move the key
-            rng = self.store.range_for_key(key)
+            rid = self.store.router.find_by_key(key)
+            if rid is None:
+                raise KeyError(f"no range covers key {key!r}")
+            rng = self.store.ranges[rid]
             try:
-                out = await rng.mutate_coproc(payload)
+                out = await self._mutation_scheduler.submit(rid, payload)
             except NotLeaderError:
                 if (_time.monotonic() >= deadline
                         or rng.raft.leader_id not in (None, rng.raft.id)):
@@ -321,6 +376,18 @@ class DistWorker:
             if _time.monotonic() >= deadline:
                 raise TimeoutError("range resolution kept racing splits")
             await asyncio.sleep(0)
+
+    async def _propose_batch(self, rid: str, calls) -> List[bytes]:
+        """One raft entry for a window of route ops on range ``rid``."""
+        rng = self.store.ranges.get(rid)
+        if rng is None:     # range retired (merge) between submit and flush
+            return [b"retry"] * len(calls)
+        if len(calls) == 1:
+            return [await rng.mutate_coproc(calls[0])]
+        out = await rng.mutate_coproc(encode_batch(calls))
+        if out == b"retry":     # sealed range bounces the whole batch
+            return [b"retry"] * len(calls)
+        return decode_batch_reply(out)
 
     async def add_route(self, tenant_id: str, route: Route) -> str:
         key = schema.route_key(tenant_id, route.matcher, route.receiver_url)

@@ -103,6 +103,7 @@ def _store_descriptor(store: KVRangeStore, address: str,
             "end": e.hex() if e is not None else None,
             "is_leader": r.is_leader,
             "leader_store": node_of(leader) if leader else None,
+            "voters": sorted(node_of(v) for v in r.raft.voters),
         })
     return {"store_id": store.node_id, "address": address, "epoch": epoch,
             "ranges": ranges}
@@ -127,11 +128,15 @@ class BaseKVStoreServer:
         self.service = f"basekv:{cluster}"
         self._epoch = 0
         self._last_published = None
+        self._zombie_rounds: Dict[str, int] = {}
         self._tasks: List[asyncio.Task] = []
         server.register(self.service, {
             "query": self._on_query,
             "mutate": self._on_mutate,
+            "mutate_fwd": self._on_mutate_fwd,
             "describe": self._on_describe,
+            "ensure_range": self._on_ensure_range,
+            "recover": self._on_recover,
         })
         messenger.attach(server)
 
@@ -150,6 +155,7 @@ class BaseKVStoreServer:
             while True:
                 try:
                     self.store.tick()
+                    self._check_zombies()
                     self._publish()
                 except Exception:  # noqa: BLE001 — a tick error must not
                     log.exception("store tick failed")  # zombie the store
@@ -168,6 +174,42 @@ class BaseKVStoreServer:
         self.meta.withdraw(self.cluster, self.store.node_id)
         self.store.stop()
         await self.server.stop()
+
+    ZOMBIE_ROUNDS = 50
+
+    def _check_zombies(self) -> None:
+        """Zombie-quit (≈ the reference's quit of a replica outside the
+        latest config): retire a local replica only when BOTH its own raft
+        sees itself excluded AND the landscape's current leader for the
+        range persistently publishes a voter set without this store — an
+        appended-but-uncommitted config (leader crashed mid-change) never
+        destroys state, because the next leader elected under the old
+        config re-includes us in its descriptor."""
+        landscape = None
+        for rid, r in list(self.store.ranges.items()):
+            if not r.raft.is_zombie:
+                self._zombie_rounds.pop(rid, None)
+                continue
+            if landscape is None:
+                landscape = self.meta.landscape(self.cluster)
+            excluded = False
+            for sid, desc in landscape.items():
+                if sid == self.store.node_id:
+                    continue
+                for rd in desc["ranges"]:
+                    if (rd["id"] == rid and rd["is_leader"]
+                            and self.store.node_id
+                            not in rd.get("voters", [])):
+                        excluded = True
+            if not excluded:
+                self._zombie_rounds.pop(rid, None)
+                continue
+            n = self._zombie_rounds.get(rid, 0) + 1
+            self._zombie_rounds[rid] = n
+            if n >= self.ZOMBIE_ROUNDS:
+                self._zombie_rounds.pop(rid, None)
+                log.info("zombie-quit: retiring excluded replica %s", rid)
+                self.store.retire_replica(rid)
 
     def _publish(self, force: bool = False) -> None:
         desc = _store_descriptor(self.store, self.server.address,
@@ -206,7 +248,15 @@ class BaseKVStoreServer:
             return self._leader_hint(r)
         return bytes([_OK]) + out
 
-    async def _on_mutate(self, payload: bytes, _okey: str) -> bytes:
+    async def _on_mutate(self, payload: bytes, okey: str) -> bytes:
+        return await self._mutate_impl(payload, okey, may_forward=True)
+
+    async def _on_mutate_fwd(self, payload: bytes, okey: str) -> bytes:
+        # forwarded hop: never re-forward (loop guard)
+        return await self._mutate_impl(payload, okey, may_forward=False)
+
+    async def _mutate_impl(self, payload: bytes, okey: str,
+                           may_forward: bool) -> bytes:
         rid_b, pos = _read16(payload, 0)
         r = self._range(rid_b.decode())
         if r is None:
@@ -214,14 +264,57 @@ class BaseKVStoreServer:
         try:
             out = await r.mutate_coproc(payload[pos:])
         except NotLeaderError:
-            return self._leader_hint(r)
+            # follower-received proposal: forward to the leader instead of
+            # bouncing to the caller (the reference's store client follows
+            # leaders; here the store proxies one hop so callers don't
+            # need retry logic at all)
+            fwd = await self._forward_to_leader(r, payload, okey) \
+                if may_forward else None
+            return fwd if fwd is not None else self._leader_hint(r)
         if out == b"retry":         # sealed for a merge: re-resolve
             return bytes([_RETRY])
         return bytes([_OK]) + out
 
+    async def _forward_to_leader(self, r, payload: bytes,
+                                 okey: str) -> Optional[bytes]:
+        leader = r.raft.leader_id
+        if leader is None:
+            return None
+        leader_node = node_of(leader)
+        if leader_node == self.store.node_id:
+            return None
+        addr = self.messenger.address_of(leader_node)
+        if addr is None:
+            return None
+        try:
+            return await asyncio.wait_for(
+                self.registry.client_for(addr).call(
+                    self.service, "mutate_fwd", payload, order_key=okey),
+                ClusterKVClient.CALL_TIMEOUT)
+        except Exception:  # noqa: BLE001 — dead leader: caller re-routes
+            return None
+
     async def _on_describe(self, _payload: bytes, _okey: str) -> bytes:
         return json.dumps(_store_descriptor(
             self.store, self.server.address, self._epoch)).encode()
+
+    async def _on_ensure_range(self, payload: bytes, _okey: str) -> bytes:
+        """Open a replica shell (placement target half, kv/placement.py)."""
+        rid_b, pos = _read16(payload, 0)
+        spec = json.loads(payload[pos:].decode())
+        boundary = (bytes.fromhex(spec["start"]),
+                    bytes.fromhex(spec["end"])
+                    if spec["end"] is not None else None)
+        self.store.ensure_range(rid_b.decode(), boundary, spec["voters"])
+        return b"ok"
+
+    async def _on_recover(self, payload: bytes, _okey: str) -> bytes:
+        """Operator quorum-loss recovery RPC
+        (≈ BaseKVStoreService.proto:33 RecoverRequest)."""
+        rid_b, pos = _read16(payload, 0)
+        live = json.loads(payload[pos:].decode()) if payload[pos:] else None
+        self.store.recover(rid_b.decode(), live)
+        return b"ok"
 
 
 class ClusterKVClient:

@@ -1,0 +1,266 @@
+"""Replica placement balancers + cluster placement controller.
+
+≈ base-kv-store-balance-controller's placement balancer set
+(impl/ReplicaCntBalancer.java:51, RangeLeaderBalancer.java,
+RedundantEpochRemovalBalancer / UnreachableReplicaRemovalBalancer,
+RangeBootstrapBalancer) re-expressed over this repo's landscape
+(kv/meta.py) instead of CRDT store descriptors.
+
+Decentralized like the reference: every store runs the controller against
+its own view, but a balancer only emits commands for ranges whose LEADER
+replica is local — one decision-maker per range at any moment. Commands:
+
+- ``EnsureReplicaCommand``: open a replica shell on a target store (RPC),
+  then grow the range's voter config to include it; raft catch-up (append
+  backfill or snapshot dump session) does the data motion.
+- ``ConfigChangeCommand``: shrink/grow the voter set via joint consensus;
+  replicas excluded by the committed config zombie-quit on their own store
+  (kv/store.py tick).
+- ``TransferLeaderCommand``: move leadership to spread leaders per store.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+from typing import Callable, Dict, List, Optional, Set
+
+from .store import KVRangeStore
+
+log = logging.getLogger(__name__)
+
+
+def _node_of(member_id: str) -> str:
+    return member_id.split(":", 1)[0]
+
+
+def _voter_nodes(raft) -> Set[str]:
+    return {_node_of(v) for v in raft.voters}
+
+
+class EnsureReplicaCommand:
+    def __init__(self, store_id: str, range_id: str, boundary,
+                 voter_nodes: List[str]) -> None:
+        self.store_id = store_id
+        self.range_id = range_id
+        self.boundary = boundary
+        self.voter_nodes = voter_nodes
+
+    def __repr__(self) -> str:
+        return f"EnsureReplica({self.range_id} on {self.store_id})"
+
+
+class ConfigChangeCommand:
+    def __init__(self, range_id: str, voter_nodes: List[str]) -> None:
+        self.range_id = range_id
+        self.voter_nodes = voter_nodes
+
+    def __repr__(self) -> str:
+        return f"ConfigChange({self.range_id} -> {self.voter_nodes})"
+
+
+class TransferLeaderCommand:
+    def __init__(self, range_id: str, target_node: str) -> None:
+        self.range_id = range_id
+        self.target_node = target_node
+
+    def __repr__(self) -> str:
+        return f"TransferLeader({self.range_id} -> {self.target_node})"
+
+
+class ReplicaCntBalancer:
+    """Keep every local-leader range at ``target`` voters
+    (≈ ReplicaCntBalancer.java:51): under-replicated ranges grow onto
+    rendezvous-picked live stores (EnsureReplica + ConfigChange); over-
+    replicated ranges shed a non-leader voter, preferring dead stores."""
+
+    def __init__(self, target: int = 3) -> None:
+        self.target = target
+
+    def balance(self, store: KVRangeStore, alive: Set[str]) -> List:
+        out: List = []
+        for rid, r in store.ranges.items():
+            if not r.is_leader or r.raft.voters_old is not None:
+                continue    # no stacking on an in-flight change
+            nodes = _voter_nodes(r.raft)
+            if len(nodes) < self.target:
+                candidates = sorted(alive - nodes)
+                if not candidates:
+                    continue
+
+                def score(n: str, rid=rid) -> int:
+                    h = hashlib.blake2b(f"{n}|{rid}".encode(),
+                                        digest_size=8).digest()
+                    return int.from_bytes(h, "big")
+                new_node = max(candidates, key=score)
+                new_nodes = sorted(nodes | {new_node})
+                out.append(EnsureReplicaCommand(
+                    new_node, rid, store.boundaries[rid], new_nodes))
+                out.append(ConfigChangeCommand(rid, new_nodes))
+            elif len(nodes) > self.target:
+                dead = sorted(nodes - alive - {store.node_id})
+                live_followers = sorted(nodes & alive - {store.node_id})
+                victim = (dead or live_followers or [None])[0]
+                if victim is not None:
+                    out.append(ConfigChangeCommand(
+                        rid, sorted(nodes - {victim})))
+        return out
+
+
+class UnreachableReplicaRemovalBalancer:
+    """Drop voters whose store has been out of the live set for
+    ``miss_rounds`` consecutive balance rounds
+    (≈ UnreachableReplicaRemovalBalancer): only when the surviving set
+    still forms a quorum of the current config — a majority loss is
+    recover()'s job, not an automatic one."""
+
+    def __init__(self, miss_rounds: int = 3) -> None:
+        self.miss_rounds = miss_rounds
+        self._misses: Dict[str, int] = {}   # "rid/node" -> rounds missing
+
+    def balance(self, store: KVRangeStore, alive: Set[str]) -> List:
+        out: List = []
+        seen = set()
+        for rid, r in store.ranges.items():
+            if not r.is_leader or r.raft.voters_old is not None:
+                continue
+            nodes = _voter_nodes(r.raft)
+            live = nodes & alive | {store.node_id}
+            if len(live) * 2 <= len(nodes):
+                continue    # majority gone: recover territory
+            for node in sorted(nodes - alive - {store.node_id}):
+                key = f"{rid}/{node}"
+                seen.add(key)
+                n = self._misses.get(key, 0) + 1
+                self._misses[key] = n
+                if n >= self.miss_rounds:
+                    out.append(ConfigChangeCommand(
+                        rid, sorted(nodes - {node})))
+                    break   # one removal per range per round
+        for key in list(self._misses):
+            if key not in seen:
+                del self._misses[key]
+        return out
+
+
+class RangeLeaderBalancer:
+    """Spread range leadership across stores
+    (≈ RangeLeaderBalancer.java): when this store leads ≥2 more ranges
+    than the least-loaded voter store in the landscape, hand one over."""
+
+    def balance(self, store: KVRangeStore, alive: Set[str],
+                leader_counts: Dict[str, int]) -> List:
+        my_leads = [rid for rid, r in store.ranges.items()
+                    if r.is_leader and r.raft.voters_old is None]
+        mine = len(my_leads)
+        for rid in sorted(my_leads):
+            r = store.ranges[rid]
+            followers = sorted((_voter_nodes(r.raft) & alive)
+                               - {store.node_id})
+            if not followers:
+                continue
+            target = min(followers,
+                         key=lambda n: (leader_counts.get(n, 0), n))
+            if mine - leader_counts.get(target, 0) >= 2:
+                return [TransferLeaderCommand(rid, target)]
+        return []
+
+
+class ClusterPlacementController:
+    """Executes placement commands for one store (run by its
+    BaseKVStoreServer): ensure-replica travels over the RPC fabric; config
+    changes and leader transfers act on the local leader raft."""
+
+    def __init__(self, server, balancers=None, *,
+                 interval: float = 0.5,
+                 alive_fn: Optional[Callable[[], Set[str]]] = None) -> None:
+        self.server = server            # BaseKVStoreServer
+        self.store: KVRangeStore = server.store
+        self.balancers = balancers if balancers is not None else [
+            ReplicaCntBalancer(), UnreachableReplicaRemovalBalancer(),
+            RangeLeaderBalancer()]
+        self.interval = interval
+        # default liveness = landscape membership (gossip deployments pass
+        # AgentHost.alive_members)
+        self.alive_fn = alive_fn or (lambda: set(
+            self.server.meta.landscape(self.server.cluster)))
+        self._task = None
+
+    def _leader_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for sid, desc in self.server.meta.landscape(
+                self.server.cluster).items():
+            counts[sid] = sum(1 for rd in desc["ranges"]
+                              if rd["is_leader"])
+        return counts
+
+    async def run_once(self) -> int:
+        alive = set(self.alive_fn())
+        executed = 0
+        for b in self.balancers:
+            if isinstance(b, RangeLeaderBalancer):
+                cmds = b.balance(self.store, alive, self._leader_counts())
+            else:
+                cmds = b.balance(self.store, alive)
+            failed_ranges: Set[str] = set()
+            for cmd in cmds:
+                if cmd.range_id in failed_ranges:
+                    continue    # its paired predecessor failed: a config
+                    # change must not commit a voter whose ensure failed
+                try:
+                    await self._execute(cmd)
+                    executed += 1
+                except Exception:  # noqa: BLE001 — keep balancing others
+                    failed_ranges.add(cmd.range_id)
+                    log.exception("placement command failed: %r", cmd)
+        return executed
+
+    async def _execute(self, cmd) -> None:
+        import asyncio
+        import json
+
+        from ..rpc.fabric import _len16
+
+        if isinstance(cmd, EnsureReplicaCommand):
+            addr = self.server.messenger.address_of(cmd.store_id)
+            if addr is None:
+                raise RuntimeError(f"no address for {cmd.store_id}")
+            s, e = cmd.boundary
+            payload = _len16(cmd.range_id.encode()) + json.dumps({
+                "start": s.hex(),
+                "end": e.hex() if e is not None else None,
+                "voters": cmd.voter_nodes}).encode()
+            await asyncio.wait_for(
+                self.server.registry.client_for(addr).call(
+                    self.server.service, "ensure_range", payload),
+                10.0)
+        elif isinstance(cmd, ConfigChangeCommand):
+            r = self.store.ranges[cmd.range_id]
+            voters = [f"{n}:{cmd.range_id}" for n in cmd.voter_nodes]
+            await asyncio.wait_for(
+                asyncio.shield(r.raft.change_config(voters)), 10.0)
+        elif isinstance(cmd, TransferLeaderCommand):
+            r = self.store.ranges[cmd.range_id]
+            r.raft.transfer_leadership(
+                f"{cmd.target_node}:{cmd.range_id}")
+
+    async def start(self) -> None:
+        import asyncio
+
+        async def loop():
+            while True:
+                await asyncio.sleep(self.interval)
+                try:
+                    await self.run_once()
+                except Exception:  # noqa: BLE001
+                    log.exception("placement round failed")
+        self._task = asyncio.create_task(loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except BaseException:  # noqa: BLE001 — cancellation
+                pass
+            self._task = None

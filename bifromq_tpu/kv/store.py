@@ -98,16 +98,21 @@ class KVRangeStore:
 
     # ---------------- lifecycle -------------------------------------------
 
-    def open(self) -> None:
+    def open(self, *, bootstrap: bool = True) -> None:
         """Load existing ranges from the meta space, or bootstrap genesis
-        (≈ KVRangeStore.start loading IKVSpaces + RangeBootstrapBalancer)."""
+        (≈ KVRangeStore.start loading IKVSpaces + RangeBootstrapBalancer).
+        ``bootstrap=False`` joins an existing cluster empty: replicas
+        arrive via ensure_range placement, never a competing genesis."""
         raw = self._meta.get_metadata(_META_RANGES)
         if raw:
             for rec in json.loads(raw.decode()):
                 self._open_range(
                     rec["id"],
                     (bytes.fromhex(rec["start"]),
-                     bytes.fromhex(rec["end"]) if rec["end"] else None))
+                     bytes.fromhex(rec["end"]) if rec["end"] else None),
+                    voters=rec.get("voters"))
+        elif not bootstrap:
+            return
         else:
             genesis = self._open_range("r0", (b"", None))
             # one-time migration from the pre-multi-range layout: routes
@@ -126,21 +131,24 @@ class KVRangeStore:
 
     def _persist_meta(self) -> None:
         recs = [{"id": rid, "start": b[0].hex(),
-                 "end": b[1].hex() if b[1] is not None else None}
+                 "end": b[1].hex() if b[1] is not None else None,
+                 "voters": sorted(self.ranges[rid].raft.voters)}
                 for rid, b in self.boundaries.items()]
         self._meta.put_metadata(_META_RANGES,
                                 json.dumps(sorted(recs,
                                                   key=lambda r: r["id"])
                                            ).encode())
 
-    def _open_range(self, range_id: str, boundary: Boundary
+    def _open_range(self, range_id: str, boundary: Boundary, *,
+                    voters: Optional[List[str]] = None
                     ) -> ReplicatedKVRange:
         space = self.engine.create_space(f"range_{range_id}")
         coproc = self.coproc_factory(range_id)
         raft_store = (self.raft_store_factory(range_id)
                       if self.raft_store_factory else None)
         member_id = f"{self.node_id}:{range_id}"
-        voters = [f"{n}:{range_id}" for n in self.member_nodes]
+        if voters is None:
+            voters = [f"{n}:{range_id}" for n in self.member_nodes]
         r = ReplicatedKVRange(range_id, member_id, voters, self.transport,
                               space, coproc=coproc, raft_store=raft_store)
         r.on_split = lambda split_key, rid=range_id: self._apply_split(
@@ -168,6 +176,14 @@ class KVRangeStore:
     def tick(self) -> None:
         for r in self.ranges.values():
             r.raft.tick()
+
+    def retire_replica(self, range_id: str) -> None:
+        """Zombie-quit execution (the DECISION lives in BaseKVStoreServer,
+        which corroborates the local exclusion against the landscape's
+        current leader — an appended-but-never-committed config entry must
+        not destroy replica state)."""
+        self._retire_range(range_id)
+        self._persist_meta()
 
     def stop(self) -> None:
         for r in self.ranges.values():
@@ -244,7 +260,12 @@ class KVRangeStore:
         raft_store = (self.raft_store_factory(sibling_id)
                       if self.raft_store_factory else None)
         member_id = f"{self.node_id}:{sibling_id}"
-        voters = [f"{n}:{sibling_id}" for n in self.member_nodes]
+        # the sibling inherits the PARENT's replica placement (its current
+        # voter-node set), not the store's static template — dynamically
+        # placed ranges keep their placement through splits
+        parent_nodes = sorted({v.split(":", 1)[0]
+                               for v in parent.raft.voters})
+        voters = [f"{n}:{sibling_id}" for n in parent_nodes]
         sib = ReplicatedKVRange(sibling_id, member_id, voters,
                                 self.transport, sib_space, coproc=coproc,
                                 raft_store=raft_store)
@@ -261,8 +282,8 @@ class KVRangeStore:
         self.router.update(sibling_id, (split_key, end))
         if hasattr(coproc, "boundary"):
             coproc.boundary = (split_key, end)
-        if self.member_nodes == [self.node_id]:
-            # sole-voter store: elect the new group synchronously so the
+        if parent_nodes == [self.node_id]:
+            # sole-voter range: elect the new group synchronously so the
             # sibling serves immediately after the split applies
             from ..raft.node import Role
             for _ in range(200):
@@ -418,6 +439,32 @@ class KVRangeStore:
                 import logging
                 logging.getLogger(__name__).exception(
                     "failed to clear raft store for %s", range_id)
+
+    # ---------------- placement / recovery ---------------------------------
+
+    def ensure_range(self, range_id: str, boundary: Boundary,
+                     voter_nodes: List[str]) -> ReplicatedKVRange:
+        """Open a replica shell for ``range_id`` on this store (the target
+        half of replica placement: a balancer adds this store to the
+        range's config, then the leader catches the shell up via appends or
+        a snapshot dump session)."""
+        r = self.ranges.get(range_id)
+        if r is not None:
+            return r
+        voters = [f"{n}:{range_id}" for n in sorted(voter_nodes)]
+        r = self._open_range(range_id, boundary, voters=voters)
+        self._persist_meta()
+        return r
+
+    def recover(self, range_id: str,
+                live_nodes: Optional[List[str]] = None) -> None:
+        """Quorum-loss recovery: force this range's config down to the
+        known-live nodes (default: just this store). See RaftNode.recover
+        for the safety caveat."""
+        nodes = live_nodes or [self.node_id]
+        self.ranges[range_id].raft.recover(
+            [f"{n}:{range_id}" for n in nodes])
+        self._persist_meta()
 
     # ---------------- introspection ---------------------------------------
 

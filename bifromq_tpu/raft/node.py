@@ -381,6 +381,7 @@ class RaftNode:
                              config_old=tuple(sorted(self.voters)))
             # resolved when the final (C_new-only) entry commits
             self._config_final_fut = fut
+        before = self._all_voters()
         self.log.append(entry)
         self._persist_append([entry])
         # a config entry takes effect as soon as it is appended
@@ -389,8 +390,50 @@ class RaftNode:
             self._joint_index = entry.index
         self._match_index[self.id] = self.last_index
         self._broadcast_append()
+        # ship the config entry to members it removes too: appending it is
+        # how they learn they're out (→ zombie-quit at their store); in the
+        # joint path removed peers are still in _all_voters() and the
+        # broadcast above already reached them
+        for peer in before - self._all_voters() - {self.id}:
+            self._send_append(peer)
         self._maybe_commit()
         return fut
+
+    def recover(self, live_voters: Optional[List[str]] = None) -> None:
+        """Quorum-loss recovery (≈ KVRangeFSM.recover:512 serving the
+        RecoverRequest RPC, BaseKVStoreService.proto:33): force-adopt a
+        voter config containing only known-reachable members so a range
+        that lost its majority can elect and serve again.
+
+        UNSAFE by design if the 'lost' replicas are actually alive across a
+        partition (two sides could fork history) — operator/controller
+        invoked only, exactly like the reference's recover API.
+        """
+        new = set(live_voters) if live_voters else {self.id}
+        if self.id not in new:
+            raise ValueError("recover() must include this member")
+        # an in-flight change is superseded — its caller must not observe
+        # success when the recover entry later commits
+        if self._config_final_fut is not None:
+            if not self._config_final_fut.done():
+                self._config_final_fut.set_exception(
+                    RuntimeError("config change superseded by recover()"))
+            self._config_final_fut = None
+        entry = LogEntry(term=self.term, index=self.last_index + 1,
+                         data=b"", config=tuple(sorted(new)))
+        self.log.append(entry)
+        self._persist_append([entry])
+        self._set_config(entry.config, None)
+        self._joint_index = None
+        # campaign immediately: with the forced config this member can win
+        self._start_election()
+
+    @property
+    def is_zombie(self) -> bool:
+        """True once a config that excludes this member took effect — the
+        hosting store retires such replicas (≈ the reference's zombie-quit:
+        a replica outside the latest config destroys itself)."""
+        return self.id not in self._all_voters()
 
     def transfer_leadership(self, target: str) -> None:
         """(≈ RaftNode.transferLeadership():171)"""
@@ -899,6 +942,7 @@ class RaftNode:
 
     def _append_final_config(self) -> None:
         """Phase 2 of joint consensus: leave the joint config."""
+        removed = self._all_voters() - self.voters
         entry = LogEntry(term=self.term, index=self.last_index + 1, data=b"",
                          config=tuple(sorted(self.voters)))
         self.log.append(entry)
@@ -910,6 +954,8 @@ class RaftNode:
             self._config_final_fut = None
         self._match_index[self.id] = self.last_index
         self._broadcast_append()
+        for peer in removed - {self.id}:   # outgoing members learn they're
+            self._send_append(peer)        # out (zombie-quit trigger)
         self._maybe_commit()  # a sole surviving voter commits immediately
 
     def _fail_waiters(self) -> None:

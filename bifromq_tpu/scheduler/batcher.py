@@ -63,13 +63,19 @@ class Batcher(Generic[CallT, ResultT]):
         self._tasks: set = set()
         self.batches_emitted = 0
         self.calls_submitted = 0
+        self.last_activity = time.monotonic()
 
     def submit(self, call: CallT) -> "asyncio.Future[ResultT]":
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._queue.append((call, fut))
         self.calls_submitted += 1
+        self.last_activity = time.monotonic()
         self._trigger()
         return fut
+
+    @property
+    def idle(self) -> bool:
+        return not self._queue and self._inflight == 0
 
     @property
     def batch_cap(self) -> int:
@@ -131,6 +137,7 @@ class BatchCallScheduler(Generic[CallT, ResultT]):
         self._budget = max_burst_latency
         self._max_batch = max_batch_size
         self._batchers: Dict[Hashable, Batcher] = {}
+        self.calls_seen = 0
 
     def batcher(self, key: Hashable) -> Batcher:
         b = self._batchers.get(key)
@@ -141,5 +148,18 @@ class BatchCallScheduler(Generic[CallT, ResultT]):
             self._batchers[key] = b
         return b
 
+    IDLE_REAP_SECS = 30.0
+
     def submit(self, key: Hashable, call: CallT) -> "asyncio.Future[ResultT]":
-        return self.batcher(key).submit(call)
+        fut = self.batcher(key).submit(call)
+        # opportunistic reaping (the reference expires batchers after
+        # inactivity): retired keys — e.g. merged-away ranges — must not
+        # pin their Batcher state forever
+        if len(self._batchers) > 8 and (self.calls_seen % 256) == 0:
+            now = time.monotonic()
+            for k in [k for k, b in self._batchers.items()
+                      if k != key and b.idle
+                      and now - b.last_activity > self.IDLE_REAP_SECS]:
+                del self._batchers[k]
+        self.calls_seen += 1
+        return fut

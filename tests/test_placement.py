@@ -1,0 +1,227 @@
+"""Quorum-loss recovery, zombie-quit, and replica placement balancers.
+
+VERDICT-r2 item 4: recover()/zombie-quit
+(≈ BaseKVStoreService.proto:33-34, KVRangeFSM.recover:512) and the
+replica placement balancer set (≈ impl/ReplicaCntBalancer.java:51,
+RangeLeaderBalancer, UnreachableReplicaRemovalBalancer).
+"""
+
+import asyncio
+
+import pytest
+
+from bifromq_tpu.kv.engine import InMemKVEngine
+from bifromq_tpu.kv.messenger import StoreMessenger
+from bifromq_tpu.kv.meta import BaseKVStoreServer, ClusterKVClient, MetaService
+from bifromq_tpu.kv.placement import (ClusterPlacementController,
+                                      RangeLeaderBalancer,
+                                      ReplicaCntBalancer,
+                                      UnreachableReplicaRemovalBalancer)
+from bifromq_tpu.kv.store import KVRangeStore
+from bifromq_tpu.kv.store_main import _coproc_factory
+from bifromq_tpu.raft.node import RaftNode, Role
+from bifromq_tpu.raft.transport import InMemTransport
+from bifromq_tpu.rpc.fabric import RPCServer, ServiceRegistry
+
+pytestmark = pytest.mark.asyncio
+
+
+class TestRecover:
+    async def test_majority_loss_then_recover(self):
+        """A 3-voter group loses 2 voters; recover() on the survivor forces
+        a single-voter config and service resumes."""
+        t = InMemTransport()
+        nodes = {}
+        for n in ("a", "b", "c"):
+            nodes[n] = RaftNode(n, ["a", "b", "c"], t,
+                                apply_cb=lambda e: None)
+            t.register(nodes[n])
+        for _ in range(400):
+            t.pump()
+            for nd in nodes.values():
+                nd.tick()
+            if any(nd.role == Role.LEADER for nd in nodes.values()):
+                break
+        leader = next(nd for nd in nodes.values()
+                      if nd.role == Role.LEADER)
+        fut = leader.propose(b"x")
+        for _ in range(100):
+            t.pump()
+            if fut.done():
+                break
+        await fut
+        survivor = next(nd for nd in nodes.values() if nd is not leader)
+        doomed = [nd for nd in nodes.values() if nd is not survivor]
+        for nd in doomed:
+            t.kill(nd.id)
+        # survivor cannot elect under the old 3-voter config
+        for _ in range(100):
+            survivor.tick()
+            t.pump()
+        assert survivor.role != Role.LEADER
+        survivor.recover()
+        for _ in range(50):
+            survivor.tick()
+            t.pump()
+            if survivor.role == Role.LEADER:
+                break
+        assert survivor.role == Role.LEADER
+        fut = survivor.propose(b"y")
+        for _ in range(100):
+            t.pump()
+            if fut.done():
+                break
+        assert await fut > 0
+
+
+def _mk_store(node, registry, meta, *, member_nodes, bootstrap=True):
+    engine = InMemKVEngine()
+    messenger = StoreMessenger(node, registry)
+    store = KVRangeStore(node, messenger, engine, _coproc_factory("echo"),
+                         member_nodes=member_nodes)
+    store.open(bootstrap=bootstrap)
+    server = BaseKVStoreServer(store, messenger, RPCServer(port=0),
+                               registry, meta, tick_interval=0.01)
+    return server
+
+
+async def _wait(cond, timeout=8.0, interval=0.02):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+class TestPlacement:
+    async def test_replica_cnt_grows_then_unreachable_pruned(self):
+        """s1 bootstraps a 1-voter range; ReplicaCntBalancer grows it to 3
+        across joining stores (ensure_range + config change + raft
+        catch-up); killing one store makes
+        UnreachableReplicaRemovalBalancer prune it back out."""
+        registry = ServiceRegistry()
+        meta = MetaService()
+        alive = {"s1", "s2", "s3"}
+        s1 = _mk_store("s1", registry, meta, member_nodes=["s1"])
+        s2 = _mk_store("s2", registry, meta, member_nodes=["s2"],
+                       bootstrap=False)
+        s3 = _mk_store("s3", registry, meta, member_nodes=["s3"],
+                       bootstrap=False)
+        servers = {"s1": s1, "s2": s2, "s3": s3}
+        for srv in servers.values():
+            await srv.start()
+        ctrl = ClusterPlacementController(
+            s1, [ReplicaCntBalancer(target=3),
+                 UnreachableReplicaRemovalBalancer(miss_rounds=2)],
+            interval=0.1, alive_fn=lambda: set(alive))
+        await ctrl.start()
+        try:
+            client = ClusterKVClient(meta, registry)
+            assert await client.mutate(b"k", b"k=1") == b"ok:k"
+            # -- growth to 3 voters, replicas land on s2 and s3 ------------
+            ok = await _wait(lambda: len(
+                s1.store.ranges["r0"].raft.voters) == 3)
+            assert ok, s1.store.ranges["r0"].raft.voters
+            ok = await _wait(lambda: ("r0" in s2.store.ranges
+                                      and "r0" in s3.store.ranges))
+            assert ok
+            # replicated data reached the new replicas (raft catch-up)
+            ok = await _wait(lambda: all(
+                srv.store.ranges["r0"].space.get(b"k") == b"1"
+                for srv in (s2, s3)))
+            assert ok
+            # -- kill s3: unreachable-removal prunes it --------------------
+            await s3.stop()
+            alive.discard("s3")
+            ok = await _wait(lambda: len(
+                s1.store.ranges["r0"].raft.voters) == 2)
+            assert ok, s1.store.ranges["r0"].raft.voters
+            assert await client.mutate(b"k", b"k=2") == b"ok:k"
+        finally:
+            await ctrl.stop()
+            for srv in servers.values():
+                try:
+                    await srv.stop()
+                except Exception:
+                    pass
+
+    async def test_zombie_quit_on_config_exclusion(self):
+        """A replica excluded by a committed config change retires itself
+        (zombie-quit): its store destroys the local range state."""
+        registry = ServiceRegistry()
+        meta = MetaService()
+        members = ["z1", "z2", "z3"]
+        servers = {n: _mk_store(n, registry, meta, member_nodes=members)
+                   for n in members}
+        for srv in servers.values():
+            await srv.start()
+        try:
+            ok = await _wait(lambda: any(
+                srv.store.ranges["r0"].is_leader
+                for srv in servers.values()))
+            assert ok
+            leader_srv = next(srv for srv in servers.values()
+                              if srv.store.ranges["r0"].is_leader)
+            victim = next(n for n in members
+                          if n != leader_srv.store.node_id)
+            keep = [n for n in members if n != victim]
+            await leader_srv.store.ranges["r0"].raft.change_config(
+                [f"{n}:r0" for n in keep])
+            # the excluded replica self-retires after ZOMBIE_TICKS
+            ok = await _wait(
+                lambda: "r0" not in servers[victim].store.ranges)
+            assert ok, servers[victim].store.ranges.keys()
+        finally:
+            for srv in servers.values():
+                try:
+                    await srv.stop()
+                except Exception:
+                    pass
+
+    async def test_leader_balancer_spreads_leadership(self):
+        """A store leading every range hands one off to its least-loaded
+        voter peer (RangeLeaderBalancer)."""
+        registry = ServiceRegistry()
+        meta = MetaService()
+        members = ["l1", "l2", "l3"]
+        servers = {n: _mk_store(n, registry, meta, member_nodes=members)
+                   for n in members}
+        for srv in servers.values():
+            await srv.start()
+        try:
+            ok = await _wait(lambda: any(
+                srv.store.ranges["r0"].is_leader
+                for srv in servers.values()))
+            assert ok
+            leader_srv = next(srv for srv in servers.values()
+                              if srv.store.ranges["r0"].is_leader)
+            # split twice so one store leads 3 ranges (splits elect the
+            # proposer's replica first in practice via catch-up priority)
+            client = ClusterKVClient(meta, registry)
+            for i in range(40):
+                await client.mutate(b"m%03d" % i, b"m%03d=x" % i)
+            await leader_srv.store.split("r0", b"m020")
+            ctrl = ClusterPlacementController(
+                leader_srv, [RangeLeaderBalancer()], interval=0.1,
+                alive_fn=lambda: set(members))
+            # wait until this store leads both ranges OR give the balancer
+            # a chance once it does
+            await _wait(lambda: sum(
+                1 for r in leader_srv.store.ranges.values()
+                if r.is_leader) >= 2, timeout=5.0)
+            my_leads = sum(1 for r in leader_srv.store.ranges.values()
+                           if r.is_leader)
+            if my_leads >= 2:
+                await ctrl.start()
+                ok = await _wait(lambda: sum(
+                    1 for r in leader_srv.store.ranges.values()
+                    if r.is_leader) < my_leads, timeout=8.0)
+                await ctrl.stop()
+                assert ok
+        finally:
+            for srv in servers.values():
+                try:
+                    await srv.stop()
+                except Exception:
+                    pass

@@ -152,3 +152,34 @@ class TestWireCluster:
                     await srv.stop()
                 except Exception:
                     pass
+
+    async def test_follower_forwards_mutation_to_leader(self):
+        """A mutation sent to a FOLLOWER store succeeds without caller
+        retries: the store proxies one hop to the leader (VERDICT item 5's
+        leader forwarding)."""
+        from bifromq_tpu.rpc.fabric import _len16
+
+        registry = ServiceRegistry()
+        meta = MetaService()
+        servers = {}
+        for n in NODES:
+            servers[n], _ = _mk_store(n, registry, meta)
+        for srv in servers.values():
+            await srv.start()
+        try:
+            leader_srv = await _wait_leader(list(servers.values()))
+            follower = next(s for s in servers.values()
+                            if s is not leader_srv)
+            payload = _len16(b"r0") + b"fwd=1"
+            out = await registry.client_for(follower.server.address).call(
+                "basekv:dist", "mutate", payload)
+            assert out[0] == 0 and out[1:] == b"ok:fwd", out
+            # committed through the leader: visible via linearized query
+            client = ClusterKVClient(meta, registry)
+            assert await client.query(b"fwd", b"fwd") == b"1"
+        finally:
+            for srv in servers.values():
+                try:
+                    await srv.stop()
+                except Exception:
+                    pass
