@@ -213,10 +213,7 @@ class DistWorkerCoProc(IKVRangeCoProc):
 
     def reset(self, reader: IKVSpace) -> None:
         """Rebuild the matcher (derived state) from the route keyspace."""
-        self.matcher = TpuMatcher(max_levels=self.matcher.max_levels,
-                                  k_states=self.matcher.k_states,
-                                  probe_len=self.matcher.probe_len,
-                                  device=self.matcher.device)
+        self.matcher = self.matcher.clone_empty()
         for key, value in reader.iterate(schema.TAG_DIST,
                                          schema.prefix_end(schema.TAG_DIST)):
             tenant_id = _tenant_of_key(key)
@@ -247,7 +244,8 @@ class DistWorker:
                  transport=None, engine=None,
                  raft_store_factory=None,
                  tick_interval: float = 0.01,
-                 split_threshold: Optional[int] = None) -> None:
+                 split_threshold: Optional[int] = None,
+                 matcher_factory=None) -> None:
         from ..kv.engine import InMemKVEngine
         from ..kv.store import KVRangeStore
         from ..raft.transport import InMemTransport
@@ -255,9 +253,14 @@ class DistWorker:
         self.transport = (transport if transport is not None
                           else InMemTransport())
         self.engine = engine if engine is not None else InMemKVEngine()
+        # matcher_factory=lambda: MeshMatcher(mesh=...) backs every range's
+        # derived matcher with the multi-device mesh plane instead of the
+        # single-chip TpuMatcher (SURVEY §2.8 scale-out)
+        self.matcher_factory = matcher_factory
         self.store = KVRangeStore(
             node_id, self.transport, self.engine,
-            coproc_factory=lambda rid: DistWorkerCoProc(),
+            coproc_factory=lambda rid: DistWorkerCoProc(
+                matcher_factory() if matcher_factory else None),
             member_nodes=voters or [node_id],
             raft_store_factory=raft_store_factory)
         self.tick_interval = tick_interval

@@ -81,6 +81,14 @@ class TpuMatcher:
         self._compact_thread: Optional[threading.Thread] = None
         self.compile_count = 0      # full compiles (observability/tests)
 
+    def clone_empty(self) -> "TpuMatcher":
+        """A fresh matcher with the same configuration — the reset-from-KV
+        rebuild target (subclasses override to preserve their plumbing)."""
+        return TpuMatcher(max_levels=self.max_levels, k_states=self.k_states,
+                          probe_len=self.probe_len, device=self.device,
+                          auto_compact=self.auto_compact,
+                          compact_threshold=self.compact_threshold)
+
     # ---------------- mutation side (≈ batchAddRoute/batchRemoveRoute) -----
 
     def add_route(self, tenant_id: str, route: Route) -> bool:

@@ -1,0 +1,140 @@
+"""MeshMatcher as a live serving plane (VERDICT-r2 item 2).
+
+The mesh matcher inherits TpuMatcher's delta-overlay/tombstone/compaction
+machinery, so mutations are visible on the next match without recompiles,
+and it drops into the real dist plane: a DistWorker whose per-range
+coprocs are MeshMatcher-backed serves MQTT pub/sub end-to-end on the
+8-device CPU mesh.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from bifromq_tpu.models.matcher import TpuMatcher
+from bifromq_tpu.models.oracle import Route, SubscriptionTrie
+from bifromq_tpu.parallel.sharded import MeshMatcher, make_mesh
+from bifromq_tpu.types import RouteMatcher
+
+pytestmark = pytest.mark.asyncio
+
+
+def mk_route(tf: str, receiver: str, inc: int = 0, broker: int = 0) -> Route:
+    return Route(matcher=RouteMatcher.from_topic_filter(tf), broker_id=broker,
+                 receiver_id=receiver, deliverer_key="d0", incarnation=inc)
+
+
+FILTERS = ["a/b", "a/+", "a/#", "+/b", "x/y/z", "a/b/c", "#",
+           "$share/g1/a/b", "$share/g1/a/+", "$oshare/g2/a/b"]
+TOPICS = [["a", "b"], ["a", "c"], ["a", "b", "c"], ["x", "y", "z"], ["q"]]
+TENANTS = [f"ten{i}" for i in range(5)]
+
+
+def _mesh():
+    import jax
+    return make_mesh(2, 4, jax.devices()[:8])
+
+
+def assert_same(matched, oracle_matched, ctx=""):
+    got = sorted((r.matcher.mqtt_topic_filter, r.receiver_url)
+                 for r in matched.normal)
+    want = sorted((r.matcher.mqtt_topic_filter, r.receiver_url)
+                  for r in oracle_matched.normal)
+    assert got == want, f"normal mismatch {ctx}: {got} != {want}"
+    got_g = {f: sorted(r.receiver_url for r in ms)
+             for f, ms in matched.groups.items()}
+    want_g = {f: sorted(r.receiver_url for r in ms)
+              for f, ms in oracle_matched.groups.items()}
+    assert got_g == want_g, f"group mismatch {ctx}"
+
+
+class TestMeshChurn:
+    def test_mesh_mutations_visible_and_exact(self):
+        """Fuzzed add/remove churn across tenants: the mesh matcher equals
+        the oracle at every step, without full recompiles between steps."""
+        m = MeshMatcher(mesh=_mesh(), max_levels=8, k_states=16,
+                        auto_compact=False)
+        oracle = {}
+        rng = random.Random(11)
+        for i in range(60):
+            t = rng.choice(TENANTS)
+            r = mk_route(FILTERS[i % len(FILTERS)], f"r{i}")
+            m.add_route(t, r)
+            oracle.setdefault(t, SubscriptionTrie()).add(r)
+        m.refresh()
+        base_compiles = m.compile_count
+        for step in range(150):
+            t = rng.choice(TENANTS)
+            tf = rng.choice(FILTERS)
+            rid = f"r{rng.randrange(70)}"
+            if rng.random() < 0.5:
+                r = mk_route(tf, rid, inc=step)
+                m.add_route(t, r)
+                oracle.setdefault(t, SubscriptionTrie()).add(r)
+            else:
+                matcher = RouteMatcher.from_topic_filter(tf)
+                m.remove_route(t, matcher, (0, rid, "d0"), incarnation=step)
+                if t in oracle:
+                    oracle[t].remove(matcher, (0, rid, "d0"), step)
+            if step % 10 == 0:
+                queries = [(t2, topic) for t2 in TENANTS
+                           for topic in TOPICS]
+                res = m.match_batch(queries)
+                for (t2, topic), got in zip(queries, res):
+                    want = (oracle[t2].match(list(topic))
+                            if t2 in oracle else None)
+                    if want is None:
+                        assert not got.all_routes()
+                    else:
+                        assert_same(got, want, f"step {step} {t2}/{topic}")
+        assert m.compile_count == base_compiles, "serving must not recompile"
+
+    def test_mesh_background_compaction_swaps(self):
+        m = MeshMatcher(mesh=_mesh(), max_levels=8, k_states=16,
+                        auto_compact=True, compact_threshold=32)
+        for i in range(200):
+            m.add_route("T", mk_route(f"s/{i}/+", f"r{i}"))
+            if i % 20 == 0:
+                m.match_batch([("T", ["s", str(i), "leaf"])])
+        m.drain()
+        m.match_batch([("T", ["s", "5", "x"])])
+        assert m.compile_count >= 2          # background compactions ran
+        assert m.overlay_size < 200          # overlay folded into the base
+
+
+class TestMeshBrokerIntegration:
+    async def test_pubsub_through_mesh_backed_worker(self):
+        """Full-stack: MQTT subscribe/publish where the broker's dist plane
+        runs on a MeshMatcher-backed DistWorker over the 8-CPU mesh."""
+        from bifromq_tpu.dist.worker import DistWorker
+        from bifromq_tpu.mqtt.broker import MQTTBroker
+        from bifromq_tpu.mqtt.client import MQTTClient
+        from bifromq_tpu.dist.service import DistService
+
+        mesh = _mesh()
+        worker = DistWorker(matcher_factory=lambda: MeshMatcher(
+            mesh=mesh, max_levels=8, k_states=16))
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        broker.dist = DistService(broker.sub_brokers, broker.events,
+                                  broker.settings, worker=worker)
+        broker.inbox.dist = broker.dist
+        await broker.start()
+        try:
+            sub = MQTTClient("127.0.0.1", broker.port, client_id="ms")
+            await sub.connect()
+            await sub.subscribe("mesh/+/live", qos=1)
+            pub = MQTTClient("127.0.0.1", broker.port, client_id="mp")
+            await pub.connect()
+            await pub.publish("mesh/a/live", b"via-mesh", qos=1)
+            msg = await asyncio.wait_for(sub.messages.get(), 10)
+            assert msg.topic == "mesh/a/live" and msg.payload == b"via-mesh"
+            # unsubscribe tombstones the route in the mesh overlay
+            await sub.unsubscribe("mesh/+/live")
+            await pub.publish("mesh/a/live", b"gone", qos=1)
+            await asyncio.sleep(0.3)
+            assert sub.messages.empty()
+            await sub.disconnect()
+            await pub.disconnect()
+        finally:
+            await broker.stop()
