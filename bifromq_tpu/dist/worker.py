@@ -115,7 +115,12 @@ class DistWorkerCoProc(IKVRangeCoProc):
     """Route-table coproc; one instance per range replica."""
 
     def __init__(self, matcher: Optional[TpuMatcher] = None) -> None:
+        from ..kv.load import KVLoadRecorder
         self.matcher = matcher or TpuMatcher()
+        # per-range load profile (≈ KVLoadRecorder + FanoutSplitHinter
+        # food): mutates record the route key, matches record the tenant
+        # prefix weighted by fan-out (see DistWorker.match_batch)
+        self.load_recorder = KVLoadRecorder()
         # (start, end) enforced at APPLY time by the hosting store: a split
         # committed between a client's range resolution and this entry's
         # apply moves the key out of this range — the mutation must bounce
@@ -152,6 +157,7 @@ class DistWorkerCoProc(IKVRangeCoProc):
             if key < start or (end is not None and key >= end):
                 return b"retry"
         value, pos = _read_frame(input_data, pos)
+        self.load_recorder.record(key)
         tenant_id = _tenant_of_key(key)  # single source of truth: the key
         route = schema.decode_route(tenant_id, key, value)
         incarnation = route.incarnation
@@ -245,6 +251,7 @@ class DistWorker:
                  raft_store_factory=None,
                  tick_interval: float = 0.01,
                  split_threshold: Optional[int] = None,
+                 load_split_threshold: Optional[float] = None,
                  matcher_factory=None) -> None:
         from ..kv.engine import InMemKVEngine
         from ..kv.store import KVRangeStore
@@ -262,7 +269,8 @@ class DistWorker:
             coproc_factory=lambda rid: DistWorkerCoProc(
                 matcher_factory() if matcher_factory else None),
             member_nodes=voters or [node_id],
-            raft_store_factory=raft_store_factory)
+            raft_store_factory=raft_store_factory,
+            legacy_space="dist_routes")
         self.tick_interval = tick_interval
         self._tick_task = None
         # mutations coalesce per range into ONE raft entry per flush
@@ -272,11 +280,18 @@ class DistWorker:
             lambda rid: (lambda calls: self._propose_batch(rid, calls)),
             max_burst_latency=0.005)
         self.balance_controller = None
+        balancers = []
         if split_threshold is not None:
-            from ..kv.balance import (KVStoreBalanceController,
-                                      RangeSplitBalancer)
+            from ..kv.balance import RangeSplitBalancer
+            balancers.append(RangeSplitBalancer(max_keys=split_threshold))
+        if load_split_threshold is not None:
+            from ..kv.load import LoadSplitBalancer
+            balancers.append(LoadSplitBalancer(
+                max_load_per_second=load_split_threshold))
+        if balancers:
+            from ..kv.balance import KVStoreBalanceController
             self.balance_controller = KVStoreBalanceController(
-                self.store, [RangeSplitBalancer(max_keys=split_threshold)])
+                self.store, balancers)
 
     @property
     def matcher(self) -> TpuMatcher:
@@ -458,11 +473,19 @@ class DistWorker:
         per_query = {}          # (rid, qi) -> MatchedRoutes
         for rid, idxs in range_queries.items():
             sub = [queries[qi] for qi in idxs]
-            res = self.store.coprocs[rid].matcher.match_batch(
+            coproc = self.store.coprocs[rid]
+            res = coproc.matcher.match_batch(
                 sub, max_persistent_fanout=max_persistent_fanout,
                 max_group_fanout=max_group_fanout)
+            rec = getattr(coproc, "load_recorder", None)
             for qi, m in zip(idxs, res):
                 per_query[(rid, qi)] = m
+                if rec is not None:
+                    # fan-out-weighted query load on the tenant's keyspan
+                    # (≈ FanoutSplitHinter weighing by matched routes)
+                    rec.record(
+                        schema.tenant_route_prefix(queries[qi][0]),
+                        cost=1 + len(m.normal) + len(m.groups))
         results = []
         for qi, (tenant_id, _levels) in enumerate(queries):
             rids = tenant_ranges[tenant_id]

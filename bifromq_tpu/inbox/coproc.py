@@ -91,10 +91,16 @@ class InboxStoreCoProc(IKVRangeCoProc):
     """Applies inbox ops deterministically on every range replica."""
 
     def __init__(self, events: IEventCollector) -> None:
+        from ..kv.load import KVLoadRecorder
+
         # retained for observability wiring; apply-side store is muted
         self.events = events
         self.store: Optional[InboxStore] = None
         self._now = 0.0
+        # multi-range hosting: boundary bounce + load profile + split-key
+        # alignment (one inbox's records must never straddle ranges)
+        self.boundary = None
+        self.load_recorder = KVLoadRecorder()
 
     def _ensure_store(self, space: IKVSpace) -> InboxStore:
         if self.store is None:
@@ -110,6 +116,16 @@ class InboxStoreCoProc(IKVRangeCoProc):
     def query(self, input_data: bytes, reader: IKVSpace) -> bytes:
         return b""  # reads go through the local store facade
 
+    def align_split_key(self, candidate: bytes) -> Optional[bytes]:
+        """Snap a split-key hint onto the owning inbox's prefix start so a
+        split never separates one inbox's metadata from its queues."""
+        try:
+            _tenant_b, pos = schema._read_len16(candidate, 1)
+            _inbox_b, pos = schema._read_len16(candidate, pos)
+        except Exception:  # noqa: BLE001 — malformed/short key: no hint
+            return None
+        return candidate[:pos]
+
     def mutate(self, input_data: bytes, reader: IKVSpace,
                writer: KVWriteBatch) -> bytes:
         store = self._ensure_store(reader)
@@ -119,6 +135,12 @@ class InboxStoreCoProc(IKVRangeCoProc):
         tenant_b, pos = _read16(input_data, pos)
         inbox_b, pos = _read16(input_data, pos)
         tenant, inbox = tenant_b.decode(), inbox_b.decode()
+        group_key = schema.inbox_prefix(tenant, inbox)
+        if self.boundary is not None:
+            start, end = self.boundary
+            if group_key < start or (end is not None and group_key >= end):
+                return b"retry"    # split moved the inbox: re-resolve
+        self.load_recorder.record(group_key)
         buf = input_data
         if op == _OP_ATTACH:
             clean_start = buf[pos] == 1
@@ -250,24 +272,14 @@ class ReplicatedInboxStore:
     # ---------------- mutations (through consensus) ------------------------
 
     async def _mutate(self, payload: bytes, timeout: float = 5.0) -> bytes:
-        import asyncio
-        import time as _time
+        # covers the initial-election window; a steady-state follower
+        # still raises (leader forwarding rides the RPC fabric in
+        # multi-process deployments)
+        from ..kv.range import propose_with_leader_wait
 
-        from ..raft.node import NotLeaderError
-
-        deadline = _time.monotonic() + timeout
-        while True:
-            try:
-                return await self.range.mutate_coproc(bytes(payload))
-            except NotLeaderError:
-                # cover the initial-election window; a steady-state
-                # follower still raises (leader forwarding rides the RPC
-                # fabric in multi-process deployments)
-                if (_time.monotonic() >= deadline
-                        or self.range.raft.leader_id not in (
-                            None, self.range.raft.id)):
-                    raise
-                await asyncio.sleep(0.01)
+        return await propose_with_leader_wait(
+            self.range, lambda: self.range.mutate_coproc(bytes(payload)),
+            timeout=timeout)
 
     async def attach(self, tenant, inbox, *, clean_start, expiry_seconds,
                      client_meta=(), lwt=None):
@@ -280,13 +292,13 @@ class ReplicatedInboxStore:
         out += _enc_lwt(lwt)
         res = await self._mutate(out)
         present = res == b"\x01"
-        return self._local.get(tenant, inbox), present
+        return self.get(tenant, inbox), present
 
     async def detach(self, tenant, inbox, *, keep_lwt=True):
         out = _envelope(_OP_DETACH, self.clock(), tenant, inbox)
         out += b"\x01" if keep_lwt else b"\x00"
         res = await self._mutate(out)
-        return self._local.get(tenant, inbox) if res == b"\x01" else None
+        return self.get(tenant, inbox) if res == b"\x01" else None
 
     async def sub(self, tenant, inbox, topic_filter, opt, *, max_filters):
         out = _envelope(_OP_SUB, self.clock(), tenant, inbox)
@@ -361,3 +373,89 @@ class ReplicatedInboxStore:
     async def delete(self, tenant, inbox) -> bool:
         out = _envelope(_OP_DELETE, self.clock(), tenant, inbox)
         return (await self._mutate(out)) == b"\x01"
+
+
+class ShardedInboxStore(ReplicatedInboxStore):
+    """Inbox keyspace across a multi-range ``KVRangeStore`` — the same
+    split/merge elasticity as the route table (≈ inbox-store hosted on
+    base-kv with per-range InboxStoreCoProcs, VERDICT-r2 item 6's
+    'inbox and retain are single-range' gap).
+
+    Ops route by the owning inbox's prefix key; a split landing between
+    resolution and apply bounces ``b"retry"`` and re-resolves, exactly
+    like the dist worker's mutation path.
+    """
+
+    def __init__(self, kvstore, *, clock=time.time) -> None:
+        self.kvstore = kvstore          # KVRangeStore
+        self.clock = clock
+
+    # ---------------- routing ----------------------------------------------
+
+    def _coproc_for(self, tenant: str, inbox: str) -> InboxStoreCoProc:
+        key = schema.inbox_prefix(tenant, inbox)
+        rid = self.kvstore.router.find_by_key(key)
+        if rid is None:
+            raise KeyError(f"no range covers inbox {tenant}/{inbox}")
+        return self.kvstore.coprocs[rid]
+
+    def _store_for(self, tenant: str, inbox: str) -> InboxStore:
+        c = self._coproc_for(tenant, inbox)
+        c._now = self.clock()
+        return c.store
+
+    # ---------------- reads (local replicas, unioned) ----------------------
+
+    def get(self, tenant, inbox):
+        return self._store_for(tenant, inbox).get(tenant, inbox)
+
+    def exists(self, tenant, inbox):
+        return self._store_for(tenant, inbox).exists(tenant, inbox)
+
+    def fetch(self, tenant, inbox, **kw):
+        return self._store_for(tenant, inbox).fetch(tenant, inbox, **kw)
+
+    def all_inboxes(self):
+        out = []
+        for c in self.kvstore.coprocs.values():
+            if c.store is not None:
+                out.extend(c.store.all_inboxes())
+        return out
+
+    def _store(self, tenant, meta):
+        self._store_for(tenant, meta.inbox_id)._store(tenant, meta)
+
+    def expired_inboxes(self, now=None):
+        now = self.clock() if now is None else now
+        out = []
+        for c in self.kvstore.coprocs.values():
+            if c.store is not None:
+                out.extend(c.store.expired_inboxes(now=now))
+        return out
+
+    # ---------------- mutations (routed through consensus) ------------------
+
+    async def _mutate(self, payload: bytes, timeout: float = 5.0) -> bytes:
+        import asyncio
+        import time as _time
+
+        from ..kv.range import propose_with_leader_wait
+
+        buf = bytes(payload)
+        tenant_b, pos = _read16(buf, 9)
+        inbox_b, pos = _read16(buf, pos)
+        key = schema.inbox_prefix(tenant_b.decode(), inbox_b.decode())
+        deadline = _time.monotonic() + timeout
+        while True:
+            rid = self.kvstore.router.find_by_key(key)
+            if rid is None:
+                raise KeyError(f"no range covers key {key!r}")
+            rng = self.kvstore.ranges[rid]
+            out = await propose_with_leader_wait(
+                rng, lambda rng=rng: rng.mutate_coproc(buf),
+                timeout=max(0.01, deadline - _time.monotonic()))
+            if out != b"retry":
+                return out
+            if _time.monotonic() >= deadline:
+                raise TimeoutError("inbox op kept racing splits")
+            await asyncio.sleep(0)    # split raced: re-resolve the range

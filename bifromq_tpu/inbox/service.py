@@ -66,11 +66,12 @@ class InboxService:
                  settings: ISettingProvider, *,
                  engine: Optional[IKVEngine] = None,
                  node_id: str = "local", voters=None, transport=None,
-                 raft_store=None, tick_interval: float = 0.01,
+                 raft_store_factory=None, tick_interval: float = 0.01,
+                 split_threshold: Optional[int] = None,
                  clock=time.time) -> None:
-        from ..kv.range import ReplicatedKVRange
+        from ..kv.store import KVRangeStore
         from ..raft.transport import InMemTransport
-        from .coproc import InboxStoreCoProc, ReplicatedInboxStore
+        from .coproc import InboxStoreCoProc, ShardedInboxStore
 
         self.dist = dist
         self.events = events
@@ -78,19 +79,26 @@ class InboxService:
         self.clock = clock
         self.tick_interval = tick_interval
         engine = engine or InMemKVEngine()
-        self._coproc = InboxStoreCoProc(events)
         self._transport = (transport if transport is not None
                            else InMemTransport())
-        member_id = f"{node_id}:inbox"
-        self.range = ReplicatedKVRange(
-            "inbox", member_id,
-            [f"{n}:inbox" for n in (voters or [node_id])],
-            self._transport, engine.create_space("inbox_data"),
-            coproc=self._coproc, raft_store=raft_store)
-        if hasattr(self._transport, "register"):
-            self._transport.register(self.range.raft)
-        self.store = ReplicatedInboxStore(self.range, self._coproc,
-                                          clock=clock)
+        # the inbox keyspace on a MULTI-RANGE store (split/merge elastic
+        # like the route table; "inbox_" prefix namespaces its spaces on a
+        # shared durable engine)
+        self.kvstore = KVRangeStore(
+            node_id, self._transport, engine,
+            coproc_factory=lambda rid: InboxStoreCoProc(events),
+            member_nodes=voters or [node_id],
+            raft_store_factory=raft_store_factory,
+            space_prefix="inbox_", legacy_space="inbox_data")
+        self.kvstore.open()
+        self.balance_controller = None
+        if split_threshold is not None:
+            from ..kv.balance import (KVStoreBalanceController,
+                                      RangeSplitBalancer)
+            self.balance_controller = KVStoreBalanceController(
+                self.kvstore,
+                [RangeSplitBalancer(max_keys=split_threshold)])
+        self.store = ShardedInboxStore(self.kvstore, clock=clock)
         self._tick_task = None
         self.delay = DelayTaskRunner(clock=clock)
         # online fetch signalers: (tenant, inbox) -> callback (≈ FetcherSignaler)
@@ -107,28 +115,33 @@ class InboxService:
         import asyncio
 
         from ..raft.node import Role
-        if len(self.range.raft.voters) == 1:
+        if self.kvstore.member_nodes == [self.kvstore.node_id]:
             for _ in range(10_000):
-                if self.range.raft.role == Role.LEADER:
+                if all(r.raft.role == Role.LEADER
+                       for r in self.kvstore.ranges.values()):
                     break
-                self.range.raft.tick()
+                self.kvstore.tick()
                 pump = getattr(self._transport, "pump", None)
                 if pump is not None:
                     pump()
         async def loop():
             while True:
-                self.range.raft.tick()
+                self.kvstore.tick()
                 pump = getattr(self._transport, "pump", None)
                 if pump is not None:
                     pump()
                 await asyncio.sleep(self.tick_interval)
         self._tick_task = asyncio.create_task(loop())
+        if self.balance_controller is not None:
+            await self.balance_controller.start()
 
     async def stop(self) -> None:
+        if self.balance_controller is not None:
+            await self.balance_controller.stop()
         if self._tick_task is not None:
             self._tick_task.cancel()
             self._tick_task = None
-        self.range.raft.stop()
+        self.kvstore.stop()
 
     def _setting(self, s: Setting, tenant_id: str):
         v = self.settings.provide(s, tenant_id)

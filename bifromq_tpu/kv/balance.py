@@ -48,7 +48,13 @@ class RangeSplitBalancer:
                 continue
             start, end = store.boundaries[rid]
             mid = self._median_key(r.space, start, end, n)
-            if mid is not None and mid > start:
+            # coprocs with multi-key record groups (e.g. one inbox's
+            # meta + queues) snap the split onto a group boundary
+            align = getattr(store.coprocs.get(rid), "align_split_key", None)
+            if mid is not None and align is not None:
+                mid = align(mid)
+            if mid is not None and mid > start \
+                    and (end is None or mid < end):
                 out.append(SplitCommand(rid, mid))
         return out
 

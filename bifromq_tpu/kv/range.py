@@ -45,6 +45,41 @@ class IKVRangeCoProc:
         (≈ DistWorkerCoProc.reset:283 rebuilding Fact/caches)."""
 
 
+async def propose_with_leader_wait(rng, fn, *, timeout: float = 5.0,
+                                   tick_single_voter: bool = False):
+    """Run a consensus proposal with a bounded wait for leadership.
+
+    The ONE retry idiom for every proposal path (dist mutations, inbox,
+    retain, split/merge): a NotLeaderError during the initial-election
+    window waits and retries; a steady-state follower (a DIFFERENT known
+    leader) re-raises so callers redirect. ``tick_single_voter`` drives a
+    sole-voter group's election synchronously (standalone ranges used
+    without a tick loop).
+    """
+    import asyncio
+    import time as _time
+
+    from ..raft.node import NotLeaderError, Role
+
+    deadline = _time.monotonic() + timeout
+    while True:
+        try:
+            return await fn()
+        except NotLeaderError:
+            raft = rng.raft
+            if _time.monotonic() >= deadline or raft.stopped:
+                raise
+            if tick_single_voter and len(raft.voters) == 1:
+                for _ in range(200):
+                    if raft.role == Role.LEADER:
+                        break
+                    raft.tick()
+                continue
+            if raft.leader_id not in (None, raft.id):
+                raise
+            await asyncio.sleep(0.01)
+
+
 # wire ops inside raft entries
 _OP_PUT = 0
 _OP_DEL = 1

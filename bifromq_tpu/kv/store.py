@@ -82,18 +82,27 @@ class KVRangeStore:
     def __init__(self, node_id: str, transport, engine: IKVEngine,
                  coproc_factory: Callable[[str], IKVRangeCoProc], *,
                  member_nodes: Optional[List[str]] = None,
-                 raft_store_factory=None) -> None:
+                 raft_store_factory=None,
+                 space_prefix: str = "",
+                 legacy_space: Optional[str] = None) -> None:
         self.node_id = node_id
         self.transport = transport
         self.engine = engine
         self.coproc_factory = coproc_factory
         self.member_nodes = member_nodes or [node_id]
         self.raft_store_factory = raft_store_factory
+        # namespaces this store's engine spaces so several KVRangeStores
+        # (dist routes, inbox, retain) can share one durable engine
+        self.space_prefix = space_prefix
+        # this store's OWN pre-multi-range flat space, migrated into
+        # genesis on first open (each store names only its own — a shared
+        # engine must never let one store's bootstrap steal another's)
+        self.legacy_space = legacy_space
         self.ranges: Dict[str, ReplicatedKVRange] = {}
         self.coprocs: Dict[str, IKVRangeCoProc] = {}
         self.boundaries: Dict[str, Boundary] = {}
         self.router = KVRangeRouter()
-        self._meta = engine.create_space("store_meta")
+        self._meta = engine.create_space(f"{space_prefix}store_meta")
         self._split_seq = 0
 
     # ---------------- lifecycle -------------------------------------------
@@ -115,18 +124,21 @@ class KVRangeStore:
             return
         else:
             genesis = self._open_range("r0", (b"", None))
-            # one-time migration from the pre-multi-range layout: routes
-            # persisted in a flat "dist_routes" space move into genesis
-            legacy = self.engine.create_space("dist_routes")
-            moved = 0
-            w = genesis.space.writer()
-            for k, v in legacy.iterate():
-                w.put(k, v)
-                moved += 1
-            w.done()
-            if moved:
-                legacy.writer().delete_range(b"", b"\xff" * 48).done()
-                self.coprocs["r0"].reset(genesis.space)
+            # one-time migration from the pre-multi-range layout: this
+            # store's keyspace persisted in a flat legacy space moves
+            # into genesis
+            if self.legacy_space:
+                legacy = self.engine.create_space(self.legacy_space)
+                moved = 0
+                w = genesis.space.writer()
+                for k, v in legacy.iterate():
+                    w.put(k, v)
+                    moved += 1
+                w.done()
+                if moved:
+                    legacy.writer().delete_range(b"",
+                                                 b"\xff" * 48).done()
+                    self.coprocs["r0"].reset(genesis.space)
             self._persist_meta()
 
     def _persist_meta(self) -> None:
@@ -142,7 +154,8 @@ class KVRangeStore:
     def _open_range(self, range_id: str, boundary: Boundary, *,
                     voters: Optional[List[str]] = None
                     ) -> ReplicatedKVRange:
-        space = self.engine.create_space(f"range_{range_id}")
+        space = self.engine.create_space(
+            f"{self.space_prefix}range_{range_id}")
         coproc = self.coproc_factory(range_id)
         raft_store = (self.raft_store_factory(range_id)
                       if self.raft_store_factory else None)
@@ -202,26 +215,14 @@ class KVRangeStore:
     async def split(self, range_id: str, split_key: bytes) -> str:
         """Propose a split of ``range_id`` at ``split_key``; resolves with
         the new sibling's id after the split applies on this replica."""
-        import asyncio
-        import time as _time
-
-        from ..raft.node import NotLeaderError
+        from .range import propose_with_leader_wait
 
         r = self.ranges[range_id]
         start, end = self.boundaries[range_id]
         if not (split_key > start and (end is None or split_key < end)):
             raise ValueError("split key outside boundary")
-        deadline = _time.monotonic() + 5.0
-        while True:
-            try:
-                await r.propose_split(split_key)
-                break
-            except NotLeaderError:
-                # freshly created groups elect asynchronously; wait bounded
-                if (_time.monotonic() >= deadline
-                        or r.raft.leader_id not in (None, r.raft.id)):
-                    raise
-                await asyncio.sleep(0.01)
+        await propose_with_leader_wait(r,
+                                       lambda: r.propose_split(split_key))
         # the apply hook (this replica) created the sibling synchronously
         return self._sibling_id(range_id, split_key)
 
@@ -241,7 +242,8 @@ class KVRangeStore:
         sibling_id = self._sibling_id(range_id, split_key)
         if sibling_id in self.ranges:
             return  # replayed entry (restart); already split
-        sib_space = self.engine.create_space(f"range_{sibling_id}")
+        sib_space = self.engine.create_space(
+            f"{self.space_prefix}range_{sibling_id}")
         # move [split_key, end) into the sibling space
         w = sib_space.writer()
         moved = 0
@@ -315,10 +317,7 @@ class KVRangeStore:
         (``b"retry"``) and re-resolve; once the router flips they land on
         the survivor (brief unavailability, as in the reference).
         """
-        import asyncio
-        import time as _time
-
-        from ..raft.node import NotLeaderError
+        from .range import propose_with_leader_wait
 
         ls, le = self.boundaries[left_id]
         rs, re_ = self.boundaries[right_id]
@@ -326,18 +325,7 @@ class KVRangeStore:
             raise ValueError("ranges not adjacent")
         right = self.ranges[right_id]
 
-        async def propose_with_leader_wait(coro_fn, raft, timeout=5.0):
-            deadline = _time.monotonic() + timeout
-            while True:
-                try:
-                    return await coro_fn()
-                except NotLeaderError:
-                    if (_time.monotonic() >= deadline
-                            or raft.leader_id not in (None, raft.id)):
-                        raise
-                    await asyncio.sleep(0.01)
-
-        await propose_with_leader_wait(right.propose_seal, right.raft)
+        await propose_with_leader_wait(right, right.propose_seal)
         # the seal applied locally (propose resolves at apply): the local
         # mergee content is now the canonical sealed state
         payload = bytearray()
@@ -354,13 +342,13 @@ class KVRangeStore:
         left = self.ranges[left_id]
         try:
             await propose_with_leader_wait(
-                lambda: left.propose_merge(bytes(payload)), left.raft)
+                left, lambda: left.propose_merge(bytes(payload)))
         except BaseException:
             # phase 2 failed: roll the seal back so the mergee's keyspan
             # does not stay write-unavailable
             try:
                 await propose_with_leader_wait(
-                    lambda: right.propose_seal(False), right.raft)
+                    right, lambda: right.propose_seal(False))
             except BaseException:  # noqa: BLE001 — surface the original
                 pass
             raise

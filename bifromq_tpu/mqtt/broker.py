@@ -473,6 +473,13 @@ class MQTTBroker:
         self.session_registry = SessionRegistry(self.events)
         self.sub_brokers = SubBrokerRegistry()
         self.sub_brokers.register(TransientSubBroker(self.local_sessions))
+        # one shared route per (server, filter, bucket) for transient subs
+        # (≈ LocalTopicRouter.java:36); dist is attached below
+        from .localrouter import LocalTopicRouter
+        self.local_router = LocalTopicRouter(self.server_id,
+                                             self.local_sessions,
+                                             dist_getter=lambda: self.dist)
+        self.sub_brokers.register(self.local_router)
         if dist is None:
             # ONE route table, on the replicated KV (DistWorkerCoProc.java:105)
             # — durable when an engine is provided, so routes survive restart
@@ -514,8 +521,12 @@ class MQTTBroker:
         # keyspace point at sessions that no longer exist — purge before
         # serving (the reference's dist GC role, DistWorkerCoProc.gc:554)
         from ..plugin.subbroker import TRANSIENT_SUB_BROKER_ID
+        from .localrouter import LOCAL_ROUTER_SUB_BROKER_ID
         purged = await self.dist.worker.purge_broker_routes(
             TRANSIENT_SUB_BROKER_ID, deliverer_prefix=self.server_id + "|")
+        purged += await self.dist.worker.purge_broker_routes(
+            LOCAL_ROUTER_SUB_BROKER_ID,
+            deliverer_prefix=self.server_id + "|")
         if purged:
             log.info("purged %d stale transient routes", purged)
         await self.inbox.start()

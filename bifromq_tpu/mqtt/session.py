@@ -587,15 +587,37 @@ class Session:
     async def _route(self, sub: Subscription) -> None:
         """Register the dist route for a new subscription (a consensus write
         on the route table); persistent sessions override (their routes
-        target the inbox sub-broker)."""
+        target the inbox sub-broker).
+
+        Non-shared transient subs ride the LocalTopicRouter: one SHARED
+        route per (server, filter, bucket) with local re-fan-out
+        (≈ LocalTopicRouter.java:36) — shared subs keep per-session routes
+        because group election must see individual receivers."""
+        tf = sub.matcher.mqtt_topic_filter
+        router = self._local_router()
+        if router is not None and not topic_util.is_shared_subscription(tf):
+            if await router.add_local_sub(self.client_info.tenant_id, tf,
+                                          self.session_id):
+                return
         await self.dist.match(self.client_info.tenant_id, sub.matcher,
                               TRANSIENT_SUB_BROKER_ID, self.session_id,
                               self._deliverer_key())
 
     async def _unroute(self, sub: Subscription) -> None:
+        tf = sub.matcher.mqtt_topic_filter
+        router = self._local_router()
+        if router is not None and await router.remove_local_sub(
+                self.client_info.tenant_id, tf, self.session_id):
+            return
         await self.dist.unmatch(self.client_info.tenant_id, sub.matcher,
                                 TRANSIENT_SUB_BROKER_ID, self.session_id,
                                 self._deliverer_key())
+
+    def _local_router(self):
+        broker = getattr(self.conn, "broker", None)
+        router = getattr(broker, "local_router", None)
+        return router if (router is not None
+                          and router.dist is not None) else None
 
     def _deliverer_key(self) -> str:
         # one deliverer group per session bucket (≈ DeliverersPerMqttServer),

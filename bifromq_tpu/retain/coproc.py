@@ -58,9 +58,14 @@ class RetainCoProc(IKVRangeCoProc):
     """Applies retain SET/DEL deterministically; derived index per replica."""
 
     def __init__(self, index: Optional[RetainedIndex] = None) -> None:
+        from ..kv.load import KVLoadRecorder
+
         self.index = index or RetainedIndex()
         # tenant -> topic -> value bytes (decoded lazily by the service)
         self.values: Dict[str, Dict[str, bytes]] = {}
+        # multi-range hosting (boundary bounce + load profile)
+        self.boundary = None
+        self.load_recorder = KVLoadRecorder()
 
     def reset(self, reader: IKVSpace) -> None:
         self.index = RetainedIndex(max_levels=self.index.max_levels,
@@ -82,6 +87,11 @@ class RetainCoProc(IKVRangeCoProc):
         topic_b, pos = _read16(input_data, pos)
         tenant, topic = tenant_b.decode(), topic_b.decode()
         key = schema.retain_key(tenant, topic)
+        if self.boundary is not None:
+            start, end = self.boundary
+            if key < start or (end is not None and key >= end):
+                return b"retry"     # split moved the key: re-resolve
+        self.load_recorder.record(key)
         store = self.values.setdefault(tenant, {})
         if op == OP_DEL:
             existed = store.pop(topic, None) is not None

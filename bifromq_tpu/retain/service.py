@@ -45,10 +45,10 @@ class RetainService:
                  throttler: Optional[IResourceThrottler] = None,
                  index: Optional[RetainedIndex] = None,
                  engine=None, node_id: str = "local", voters=None,
-                 transport=None, raft_store=None,
+                 transport=None, raft_store_factory=None,
                  tick_interval: float = 0.01, clock=time.time) -> None:
         from ..kv.engine import InMemKVEngine
-        from ..kv.range import ReplicatedKVRange
+        from ..kv.store import KVRangeStore
         from ..raft.transport import InMemTransport
         from .coproc import RetainCoProc
 
@@ -57,39 +57,66 @@ class RetainService:
         self.clock = clock
         self.tick_interval = tick_interval
         engine = engine or InMemKVEngine()
-        self.coproc = RetainCoProc(index)
         self._transport = (transport if transport is not None
                            else InMemTransport())
-        self.range = ReplicatedKVRange(
-            "retain", f"{node_id}:retain",
-            [f"{n}:retain" for n in (voters or [node_id])],
-            self._transport, engine.create_space("retain_data"),
-            coproc=self.coproc, raft_store=raft_store)
-        if hasattr(self._transport, "register"):
-            self._transport.register(self.range.raft)
-        self.coproc.reset(self.range.space)
+        # the retain keyspace on a MULTI-RANGE store ("retain_" prefix
+        # namespaces its spaces on a shared durable engine); per-range
+        # derived RetainedIndex instances rebuild via reset-from-KV
+        self._index_template = index
+        self.kvstore = KVRangeStore(
+            node_id, self._transport, engine,
+            coproc_factory=self._mk_coproc,
+            member_nodes=voters or [node_id],
+            raft_store_factory=raft_store_factory,
+            space_prefix="retain_", legacy_space="retain_data")
+        self.kvstore.open()
         self._tick_task = None
+
+    def _mk_coproc(self, rid: str):
+        from .coproc import RetainCoProc
+        tmpl = self._index_template
+        idx = (RetainedIndex(max_levels=tmpl.max_levels,
+                             k_states=tmpl.k_states)
+               if tmpl is not None else None)
+        return RetainCoProc(idx)
+
+    # ---------------- per-range access -------------------------------------
+
+    def _coprocs(self):
+        return self.kvstore.coprocs.values()
+
+    def _coproc_for(self, tenant_id: str, topic: str):
+        from ..kv import schema as _schema
+        key = _schema.retain_key(tenant_id, topic)
+        rid = self.kvstore.router.find_by_key(key)
+        if rid is None:
+            raise KeyError(f"no range covers retain key {key!r}")
+        return self.kvstore.coprocs[rid], self.kvstore.ranges[rid]
 
     @property
     def index(self) -> RetainedIndex:
-        return self.coproc.index
+        """Single-range introspection convenience (tests)."""
+        if len(self.kvstore.ranges) != 1:
+            raise RuntimeError("multiple ranges; use kvstore.coprocs")
+        return next(iter(self.kvstore.coprocs.values())).index
 
     async def start(self) -> None:
         import asyncio
 
         from ..raft.node import Role
-        if len(self.range.raft.voters) == 1:
+        if self.kvstore.member_nodes == [self.kvstore.node_id]:
             for _ in range(10_000):
-                if self.range.raft.role == Role.LEADER:
+                if all(r.raft.role == Role.LEADER
+                       for r in self.kvstore.ranges.values()):
                     break
-                self.range.raft.tick()
+                self.kvstore.tick()
                 pump = getattr(self._transport, "pump", None)
                 if pump is not None:
                     pump()
 
         async def loop():
             while True:
-                self.range.raft.tick()
+                self.kvstore.tick()
                 pump = getattr(self._transport, "pump", None)
                 if pump is not None:
                     pump()
@@ -100,11 +127,12 @@ class RetainService:
         if self._tick_task is not None:
             self._tick_task.cancel()
             self._tick_task = None
-        self.range.raft.stop()
+        self.kvstore.stop()
 
     def _decode(self, tenant_id: str, topic: str) -> Optional[RetainedMsg]:
         from .coproc import dec_retained
-        raw = self.coproc.values.get(tenant_id, {}).get(topic)
+        coproc, _rng = self._coproc_for(tenant_id, topic)
+        raw = coproc.values.get(tenant_id, {}).get(topic)
         if raw is None:
             return None
         expire_at, publisher, msg = dec_retained(raw)
@@ -113,30 +141,24 @@ class RetainService:
 
     # ---------------- mutations (≈ batchRetain) ----------------------------
 
-    async def _mutate(self, payload: bytes, timeout: float = 5.0) -> bytes:
+    async def _mutate(self, tenant_id: str, topic: str, payload: bytes,
+                      timeout: float = 5.0) -> bytes:
         import asyncio
         import time as _time
 
-        from ..raft.node import NotLeaderError
-        from ..raft.node import Role
+        from ..kv.range import propose_with_leader_wait
         deadline = _time.monotonic() + timeout
         while True:
-            try:
-                return await self.range.mutate_coproc(payload)
-            except NotLeaderError:
-                if _time.monotonic() >= deadline or self.range.raft.stopped:
-                    raise
-                if len(self.range.raft.voters) == 1:
-                    # standalone range used without start(): self-elect
-                    for _ in range(200):
-                        if self.range.raft.role == Role.LEADER:
-                            break
-                        self.range.raft.tick()
-                    continue
-                if self.range.raft.leader_id not in (None,
-                                                     self.range.raft.id):
-                    raise
-                await asyncio.sleep(0.01)
+            _coproc, rng = self._coproc_for(tenant_id, topic)
+            out = await propose_with_leader_wait(
+                rng, lambda rng=rng: rng.mutate_coproc(payload),
+                timeout=max(0.01, deadline - _time.monotonic()),
+                tick_single_voter=True)  # standalone use without start()
+            if out != b"retry":
+                return out
+            if _time.monotonic() >= deadline:
+                raise TimeoutError("retain op kept racing splits")
+            await asyncio.sleep(0)    # split raced: re-resolve the range
 
     async def retain(self, publisher: ClientInfo, topic: str,
                      message: Message) -> bool:
@@ -144,10 +166,12 @@ class RetainService:
         from .coproc import OP_DEL, OP_SET, enc_op, enc_retained
 
         tenant_id = publisher.tenant_id
-        existing = self.coproc.values.get(tenant_id, {})
+        coproc, _rng = self._coproc_for(tenant_id, topic)
+        existing = coproc.values.get(tenant_id, {})
         if not message.payload:
             # empty payload clears the retained message [MQTT-3.3.1-10/11]
-            out = await self._mutate(enc_op(OP_DEL, tenant_id, topic))
+            out = await self._mutate(tenant_id, topic,
+                                     enc_op(OP_DEL, tenant_id, topic))
             if out == b"\x01":
                 self.events.report(Event(EventType.RETAIN_MSG_CLEARED,
                                          tenant_id, {"topic": topic}))
@@ -165,7 +189,8 @@ class RetainService:
             expire_at = self.clock() + message.expiry_seconds
         value = enc_retained(_schema.encode_message(message), publisher,
                              expire_at)
-        await self._mutate(enc_op(OP_SET, tenant_id, topic, value))
+        await self._mutate(tenant_id, topic,
+                           enc_op(OP_SET, tenant_id, topic, value))
         self.events.report(Event(EventType.MSG_RETAINED, tenant_id,
                                  {"topic": topic}))
         return True
@@ -179,7 +204,27 @@ class RetainService:
 
     async def match_batch(self, queries: Sequence[Tuple[str, Sequence[str]]],
                           limit: int) -> List[List[Tuple[str, Message]]]:
-        raw = self.index.match_batch(queries, limit=limit)
+        from ..kv import schema as _schema
+
+        # per-tenant boundary intersect over the multi-range store, then
+        # union per-range index hits (≈ dist worker's range routing)
+        tenant_rids: Dict[str, List[str]] = {}
+        for tenant_id, _lv in queries:
+            if tenant_id not in tenant_rids:
+                pfx = _schema.retain_prefix(tenant_id)
+                tenant_rids[tenant_id] = self.kvstore.router.intersecting(
+                    pfx, _schema.prefix_end(pfx))
+        range_queries: Dict[str, List[int]] = {}
+        for qi, (tenant_id, _lv) in enumerate(queries):
+            for rid in tenant_rids[tenant_id]:
+                range_queries.setdefault(rid, []).append(qi)
+        raw: List[List[str]] = [[] for _ in queries]
+        for rid, idxs in range_queries.items():
+            sub = [queries[qi] for qi in idxs]
+            res = self.kvstore.coprocs[rid].index.match_batch(sub,
+                                                             limit=limit)
+            for qi, topics in zip(idxs, res):
+                raw[qi].extend(topics)
         now = self.clock()
         out: List[List[Tuple[str, Message]]] = []
         for (tenant_id, _), topics in zip(queries, raw):
@@ -207,24 +252,30 @@ class RetainService:
     async def gc(self, tenant_id: Optional[str] = None) -> int:
         now = self.clock()
         removed = 0
-        tenants = ([tenant_id] if tenant_id is not None
-                   else list(self.coproc.values))
-        for t in tenants:
-            for topic in list(self.coproc.values.get(t, {})):
-                rm = self._decode(t, topic)
-                if rm is not None and rm.expire_at is not None \
-                        and rm.expire_at <= now:
-                    await self._expire(t, rm)
-                    removed += 1
+        for coproc in list(self._coprocs()):
+            tenants = ([tenant_id] if tenant_id is not None
+                       else list(coproc.values))
+            for t in tenants:
+                for topic in list(coproc.values.get(t, {})):
+                    rm = self._decode(t, topic)
+                    if rm is not None and rm.expire_at is not None \
+                            and rm.expire_at <= now:
+                        await self._expire(t, rm)
+                        removed += 1
         return removed
 
     async def _expire(self, tenant_id: str, rm: RetainedMsg) -> None:
         from .coproc import OP_DEL, enc_op
-        await self._mutate(enc_op(OP_DEL, tenant_id, rm.topic))
+        await self._mutate(tenant_id, rm.topic,
+                           enc_op(OP_DEL, tenant_id, rm.topic))
 
     def topic_count(self, tenant_id: str) -> int:
-        return len(self.coproc.values.get(tenant_id, {}))
+        return sum(len(c.values.get(tenant_id, {}))
+                   for c in self._coprocs())
 
     def topics(self, tenant_id: str) -> List[str]:
         """Retained topic listing (introspection/API)."""
-        return sorted(self.coproc.values.get(tenant_id, {}))
+        out: List[str] = []
+        for c in self._coprocs():
+            out.extend(c.values.get(tenant_id, {}))
+        return sorted(out)

@@ -1,0 +1,104 @@
+"""LocalTopicRouter: N local transient subscribers to one filter produce
+ONE route-table entry and one delivery hop (≈ LocalTopicRouter.java:36,
+VERDICT-r2 missing item 6)."""
+
+import asyncio
+
+import pytest
+
+from bifromq_tpu.mqtt.broker import MQTTBroker
+from bifromq_tpu.mqtt.client import MQTTClient
+from bifromq_tpu.mqtt.localrouter import LOCAL_ROUTER_SUB_BROKER_ID
+
+pytestmark = pytest.mark.asyncio
+
+
+def _routes_for(broker, tf):
+    return [(t, r) for t, r in broker.dist.worker._iter_all_routes()
+            if r.matcher.mqtt_topic_filter == tf]
+
+
+class TestLocalTopicRouter:
+    async def test_n_subscribers_one_route_one_hop(self):
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        await broker.start()
+        try:
+            subs = []
+            for i in range(5):
+                c = MQTTClient("127.0.0.1", broker.port,
+                               client_id=f"fan{i}")
+                await c.connect()
+                await c.subscribe("lr/+/t", qos=1)
+                subs.append(c)
+            # ONE shared route, owned by the local router
+            routes = _routes_for(broker, "lr/+/t")
+            assert len(routes) == 1, routes
+            assert routes[0][1].broker_id == LOCAL_ROUTER_SUB_BROKER_ID
+            assert routes[0][1].receiver_id.startswith("lr://")
+            assert broker.local_router.local_subscribers(
+                routes[0][0], "lr/+/t") == 5
+
+            # one publish reaches all five local subscribers
+            pub = MQTTClient("127.0.0.1", broker.port, client_id="pub")
+            await pub.connect()
+            await pub.publish("lr/x/t", b"fanout", qos=1)
+            for c in subs:
+                msg = await asyncio.wait_for(c.messages.get(), 10)
+                assert msg.payload == b"fanout"
+
+            # four leave: the shared route survives
+            for c in subs[:4]:
+                await c.unsubscribe("lr/+/t")
+            assert len(_routes_for(broker, "lr/+/t")) == 1
+            # the last one leaves: the route is retracted
+            await subs[4].unsubscribe("lr/+/t")
+            assert len(_routes_for(broker, "lr/+/t")) == 0
+            for c in subs + [pub]:
+                await c.disconnect()
+        finally:
+            await broker.stop()
+
+    async def test_session_close_retires_route(self):
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        await broker.start()
+        try:
+            a = MQTTClient("127.0.0.1", broker.port, client_id="ca")
+            b = MQTTClient("127.0.0.1", broker.port, client_id="cb")
+            await a.connect()
+            await b.connect()
+            await a.subscribe("close/t", qos=0)
+            await b.subscribe("close/t", qos=0)
+            assert len(_routes_for(broker, "close/t")) == 1
+            await a.disconnect()
+            await asyncio.sleep(0.2)
+            assert len(_routes_for(broker, "close/t")) == 1
+            # remaining subscriber still receives
+            pub = MQTTClient("127.0.0.1", broker.port, client_id="cp")
+            await pub.connect()
+            await pub.publish("close/t", b"still", qos=0)
+            msg = await asyncio.wait_for(b.messages.get(), 10)
+            assert msg.payload == b"still"
+            await b.disconnect()
+            await asyncio.sleep(0.2)
+            assert len(_routes_for(broker, "close/t")) == 0
+            await pub.disconnect()
+        finally:
+            await broker.stop()
+
+    async def test_shared_subs_keep_per_session_routes(self):
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        await broker.start()
+        try:
+            cs = []
+            for i in range(3):
+                c = MQTTClient("127.0.0.1", broker.port,
+                               client_id=f"sh{i}")
+                await c.connect()
+                await c.subscribe("$share/g/lrs/t", qos=1)
+                cs.append(c)
+            routes = _routes_for(broker, "$share/g/lrs/t")
+            assert len(routes) == 3, routes    # group election needs each
+            for c in cs:
+                await c.disconnect()
+        finally:
+            await broker.stop()

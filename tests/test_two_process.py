@@ -126,9 +126,12 @@ class TestTwoProcess:
             cb = MQTTClient("127.0.0.1", fb.port, client_id="cb")
             await cb.connect()
             await cb.subscribe("scope/+", qos=0)
+            from bifromq_tpu.mqtt.localrouter import \
+                LOCAL_ROUTER_SUB_BROKER_ID
             # frontend A sweeps its own (empty) route set
             purged = await fa.dist.worker.purge_broker_routes(
-                0, deliverer_prefix=fa.server_id + "|")
+                LOCAL_ROUTER_SUB_BROKER_ID,
+                deliverer_prefix=fa.server_id + "|")
             assert purged == 0
             # B's subscription still matches
             res = await fb.dist.worker.match_batch(
@@ -137,7 +140,8 @@ class TestTwoProcess:
             assert len(res[0].normal) == 1
             # B's own sweep with its prefix removes its route
             purged = await fb.dist.worker.purge_broker_routes(
-                0, deliverer_prefix=fb.server_id + "|")
+                LOCAL_ROUTER_SUB_BROKER_ID,
+                deliverer_prefix=fb.server_id + "|")
             assert purged == 1
             await cb.disconnect()
         finally:
