@@ -41,11 +41,12 @@ class MemberState:
     # agent_id -> metadata dict (services this node exposes)
     agents: Dict[str, dict] = field(default_factory=dict)
     status_at: float = field(default_factory=time.time)
+    tcp_port: int = 0   # large-payload plane (0 = none advertised)
 
     def record(self) -> dict:
         return {"id": self.node_id, "addr": list(self.addr),
                 "inc": self.incarnation, "st": self.status,
-                "agents": self.agents}
+                "agents": self.agents, "tcp": self.tcp_port}
 
 
 class AgentHost(asyncio.DatagramProtocol):
@@ -70,7 +71,11 @@ class AgentHost(asyncio.DatagramProtocol):
         self.transport: Optional[asyncio.DatagramTransport] = None
         self._probe_task: Optional[asyncio.Task] = None
         self._acks: Dict[int, asyncio.Future] = {}
+        # relayed-ping bookkeeping: our seq -> (origin, origin seq, ts);
+        # expired in the probe loop (dead targets never ack)
+        self._relays: Dict[int, Tuple] = {}
         self._seq = 0
+        self._tcp_server: Optional[asyncio.AbstractServer] = None
         self._listeners: List[Callable[[], None]] = []
         self._payload_handlers: Dict[str, Callable[[str, dict], None]] = {}
         self.stopped = False
@@ -82,8 +87,15 @@ class AgentHost(asyncio.DatagramProtocol):
         self.transport, _ = await loop.create_datagram_endpoint(
             lambda: self, local_addr=(self.host, self.port))
         self.port = self.transport.get_extra_info("sockname")[1]
+        # large-payload plane: UDP datagrams cap out near 64KB (and
+        # fragment badly well before); oversized payloads ride TCP (the
+        # reference's dual UDP/TCP cluster transport)
+        self._tcp_server = await asyncio.start_server(
+            self._on_tcp, self.host, 0)
+        tcp_port = self._tcp_server.sockets[0].getsockname()[1]
         self.members[self.node_id] = MemberState(
-            node_id=self.node_id, addr=(self.host, self.port))
+            node_id=self.node_id, addr=(self.host, self.port),
+            tcp_port=tcp_port)
         for seed in self.seeds:
             self._send(tuple(seed), {"t": "join"})
         self._probe_task = loop.create_task(self._probe_loop())
@@ -94,6 +106,8 @@ class AgentHost(asyncio.DatagramProtocol):
             self._probe_task.cancel()
         if self.transport is not None:
             self.transport.close()
+        if self._tcp_server is not None:
+            self._tcp_server.close()
 
     # ---------------- payload channel (cluster messenger) -------------------
 
@@ -102,14 +116,49 @@ class AgentHost(asyncio.DatagramProtocol):
         """Subscribe to application payloads on ``channel`` (≈ Messenger)."""
         self._payload_handlers[channel] = cb
 
+    UDP_MAX = 60_000    # payloads beyond this ride the TCP plane
+
     def send_payload(self, node_id: str, channel: str, data: dict) -> bool:
-        """Fire-and-forget payload to a member by node id."""
+        """Fire-and-forget payload to a member by node id; large payloads
+        fall back to the TCP plane (a UDP datagram would be truncated or
+        rejected outright)."""
         m = self.members.get(node_id)
         if m is None:
             return False
+        msg = {"t": "payload", "ch": channel, "data": data,
+               "from": self.node_id, "gossip": []}
+        raw = json.dumps(msg).encode()
+        if len(raw) > self.UDP_MAX and m.tcp_port:
+            asyncio.ensure_future(
+                self._send_tcp((m.addr[0], m.tcp_port), raw))
+            return True
         self._send(tuple(m.addr), {"t": "payload", "ch": channel,
                                    "data": data})
         return True
+
+    async def _send_tcp(self, addr: Tuple[str, int], raw: bytes) -> None:
+        try:
+            _r, w = await asyncio.wait_for(
+                asyncio.open_connection(*addr), 2.0)
+            w.write(len(raw).to_bytes(4, "big") + raw)
+            await w.drain()
+            w.close()
+        except Exception:  # noqa: BLE001 — fire-and-forget like UDP
+            pass
+
+    async def _on_tcp(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            hdr = await reader.readexactly(4)
+            n = int.from_bytes(hdr, "big")
+            if n > 64 * 1024 * 1024:    # sanity cap
+                return
+            raw = await reader.readexactly(n)
+            self.datagram_received(raw, writer.get_extra_info("peername"))
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
 
     # ---------------- agents (service groups) ------------------------------
 
@@ -176,14 +225,25 @@ class AgentHost(asyncio.DatagramProtocol):
         elif t == "ping":
             self._send(addr, {"t": "ack", "seq": msg.get("seq")})
         elif t == "ping-req":
-            # indirect probe on behalf of the requester (SWIM)
+            # indirect probe on behalf of the requester (SWIM k-relay):
+            # ping the target with OUR seq and relay the requester's ack
+            # only once the TARGET answers — a helper must confirm the
+            # target, not merely its own liveness
             target = msg.get("target")
             ts = self.members.get(target)
             if ts is not None:
-                self._send(ts.addr, {"t": "ping", "seq": -1})
-            self._send(addr, {"t": "ack", "seq": msg.get("seq")})
+                self._seq += 1
+                self._relays[self._seq] = (addr, msg.get("seq"),
+                                           time.time())
+                self._send(ts.addr, {"t": "ping", "seq": self._seq})
         elif t == "ack":
-            fut = self._acks.pop(msg.get("seq"), None)
+            seq = msg.get("seq")
+            relay = self._relays.pop(seq, None)
+            if relay is not None:       # target answered our relayed ping
+                origin_addr, origin_seq, _ts = relay
+                self._send(tuple(origin_addr), {"t": "ack",
+                                                "seq": origin_seq})
+            fut = self._acks.pop(seq, None)
             if fut is not None and not fut.done():
                 fut.set_result(True)
         elif t == "payload":
@@ -213,7 +273,8 @@ class AgentHost(asyncio.DatagramProtocol):
         if cur is None:
             self.members[nid] = MemberState(
                 node_id=nid, addr=tuple(rec.get("addr", ("", 0))),
-                incarnation=inc, status=st, agents=rec.get("agents", {}))
+                incarnation=inc, status=st, agents=rec.get("agents", {}),
+                tcp_port=rec.get("tcp", 0))
             changed = True
         else:
             # precedence: higher incarnation wins; at equal incarnation a
@@ -227,6 +288,7 @@ class AgentHost(asyncio.DatagramProtocol):
                     cur.status = st
                     cur.status_at = time.time()
                 cur.agents = rec.get("agents", cur.agents)
+                cur.tcp_port = rec.get("tcp", cur.tcp_port)
                 changed = True
         if changed:
             self._notify()
@@ -238,6 +300,11 @@ class AgentHost(asyncio.DatagramProtocol):
             while not self.stopped:
                 await asyncio.sleep(self.PROBE_INTERVAL)
                 self._advance_suspicions()
+                # relay entries for targets that never ack must not leak
+                cutoff = time.time() - 5.0
+                for seq in [s for s, (_a, _q, ts) in self._relays.items()
+                            if ts < cutoff]:
+                    del self._relays[seq]
                 target = self._pick_probe_target()
                 if target is None:
                     continue
@@ -268,27 +335,34 @@ class AgentHost(asyncio.DatagramProtocol):
             return False
 
     async def _indirect_probe(self, target: MemberState) -> bool:
+        """k-relay probing (≈ FailureDetector.java:54 scaled indirect
+        probes): ask K alive helpers to ping the target; ANY relay-
+        confirmed ack proves the target alive even when the direct
+        requester→target path is partitioned."""
         helpers = [m for m in self.members.values()
                    if m.status == ALIVE
                    and m.node_id not in (self.node_id, target.node_id)]
         self.rng.shuffle(helpers)
-        ok = False
-        for helper in helpers[:self.INDIRECT_K]:
+        helpers = helpers[:self.INDIRECT_K]
+        if not helpers:
+            return False
+        futs = []
+        seqs = []
+        for helper in helpers:
             self._seq += 1
             seq = self._seq
             fut = asyncio.get_running_loop().create_future()
             self._acks[seq] = fut
+            seqs.append(seq)
+            futs.append(fut)
             self._send(helper.addr, {"t": "ping-req", "seq": seq,
                                      "target": target.node_id})
-            try:
-                await asyncio.wait_for(fut, self.PROBE_TIMEOUT)
-                ok = True
-            except asyncio.TimeoutError:
-                self._acks.pop(seq, None)
-        # a direct re-probe after helpers relayed a ping settles it
-        if ok:
-            return await self._probe(target)
-        return False
+        done, pending = await asyncio.wait(
+            futs, timeout=self.PROBE_TIMEOUT * 2,
+            return_when=asyncio.FIRST_COMPLETED)
+        for seq in seqs:
+            self._acks.pop(seq, None)
+        return bool(done)
 
     def _suspect(self, target: MemberState) -> None:
         if target.status == ALIVE:

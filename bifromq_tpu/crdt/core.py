@@ -215,3 +215,165 @@ class ORMap:
 
     def delta_for(self, key: str) -> Dict[str, dict]:
         return {key: self.get(key).to_dict()}
+
+
+class RWORSet(_DotStoreCRDT):
+    """Remove-wins observed-remove set (≈ RWORSet.java): a concurrent
+    add || remove of the same element resolves to REMOVED. Dots carry
+    (element, is_add) pairs; an element is present iff it has at least
+    one live add-dot and NO live remove-dot."""
+
+    def add(self, replica_id: str, element) -> "RWORSet":
+        retired = [d for d, (el, _k) in self.store.items() if el == element]
+        dot = self.ctx.next_dot(replica_id)
+        for d in retired:
+            del self.store[d]
+        self.store[dot] = (element, True)
+        delta = RWORSet()
+        delta.store[dot] = (element, True)
+        delta.ctx.add(dot)
+        for d in retired:
+            delta.ctx.add(d)
+        delta.ctx.compact()
+        return delta
+
+    def remove(self, replica_id: str, element) -> "RWORSet":
+        """Remove leaves a live remove-dot (the wins marker), unlike
+        AWORSet's pure retraction."""
+        retired = [d for d, (el, _k) in self.store.items() if el == element]
+        dot = self.ctx.next_dot(replica_id)
+        for d in retired:
+            del self.store[d]
+        self.store[dot] = (element, False)
+        delta = RWORSet()
+        delta.store[dot] = (element, False)
+        delta.ctx.add(dot)
+        for d in retired:
+            delta.ctx.add(d)
+        delta.ctx.compact()
+        return delta
+
+    def __contains__(self, element) -> bool:
+        has_add = has_rm = False
+        for el, is_add in self.store.values():
+            if el == element:
+                if is_add:
+                    has_add = True
+                else:
+                    has_rm = True
+        return has_add and not has_rm
+
+    def elements(self) -> List:
+        seen = []
+        for _, (el, _k) in sorted(self.store.items()):
+            if el not in seen and el in self:
+                seen.append(el)
+        return seen
+
+    @classmethod
+    def from_dict(cls, d: dict):
+        o = super().from_dict(d)
+        o.store = {dot: tuple(v) for dot, v in o.store.items()}
+        return o
+
+
+class EWFlag(_DotStoreCRDT):
+    """Enable-wins flag (≈ EWFlagOperation.java): concurrent
+    enable || disable resolves to ENABLED (the enable's fresh dot
+    survives the disable's observed retraction)."""
+
+    def enable(self, replica_id: str) -> "EWFlag":
+        retired = list(self.store)
+        dot = self.ctx.next_dot(replica_id)
+        for d in retired:
+            del self.store[d]
+        self.store[dot] = True
+        delta = EWFlag()
+        delta.store[dot] = True
+        delta.ctx.add(dot)
+        for d in retired:
+            delta.ctx.add(d)
+        delta.ctx.compact()
+        return delta
+
+    def disable(self) -> "EWFlag":
+        retired = list(self.store)
+        delta = EWFlag()
+        for d in retired:
+            del self.store[d]
+            delta.ctx.add(d)
+        delta.ctx.compact()
+        return delta
+
+    def read(self) -> bool:
+        return bool(self.store)
+
+
+class DWFlag(_DotStoreCRDT):
+    """Disable-wins flag (≈ DWFlagOperation.java): the dual of EWFlag —
+    dots mark DISABLED, so a concurrent disable survives an enable's
+    retraction and the flag reads disabled."""
+
+    def disable(self, replica_id: str) -> "DWFlag":
+        retired = list(self.store)
+        dot = self.ctx.next_dot(replica_id)
+        for d in retired:
+            del self.store[d]
+        self.store[dot] = False
+        delta = DWFlag()
+        delta.store[dot] = False
+        delta.ctx.add(dot)
+        for d in retired:
+            delta.ctx.add(d)
+        delta.ctx.compact()
+        return delta
+
+    def enable(self) -> "DWFlag":
+        retired = list(self.store)
+        delta = DWFlag()
+        for d in retired:
+            del self.store[d]
+            delta.ctx.add(d)
+        delta.ctx.compact()
+        return delta
+
+    def read(self) -> bool:
+        return not self.store
+
+
+class CCounter(_DotStoreCRDT):
+    """Causal counter (≈ CCounterOperation.java): each replica's
+    contribution rides ONE dot; increments re-tag the replica's dot with
+    the accumulated value, and zero() causally retracts every observed
+    contribution (concurrent increments survive a reset — add-wins)."""
+
+    def _own(self, replica_id: str) -> int:
+        return sum(v for (r, _n), v in self.store.items()
+                   if r == replica_id)
+
+    def inc(self, replica_id: str, n: int = 1) -> "CCounter":
+        retired = [d for d in self.store if d[0] == replica_id]
+        total = self._own(replica_id) + n
+        dot = self.ctx.next_dot(replica_id)
+        for d in retired:
+            del self.store[d]
+        self.store[dot] = total
+        delta = CCounter()
+        delta.store[dot] = total
+        delta.ctx.add(dot)
+        for d in retired:
+            delta.ctx.add(d)
+        delta.ctx.compact()
+        return delta
+
+    def zero(self) -> "CCounter":
+        retired = list(self.store)
+        delta = CCounter()
+        for d in retired:
+            del self.store[d]
+            delta.ctx.add(d)
+        delta.ctx.compact()
+        return delta
+
+    def read(self) -> int:
+        return sum(self.store.values())

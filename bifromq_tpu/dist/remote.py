@@ -23,70 +23,11 @@ from ..rpc.fabric import (RPCClient, RPCServer, ServiceRegistry, _len16,
                           _read16)
 from ..types import RouteMatcher
 from . import worker as dw
+# ONE match-result codec, owned by the worker module (coproc RO replies
+# and this RPC service speak the same frames)
+from .worker import _dec_route, _enc_route, decode_matched, encode_matched
 
 SERVICE = "dist-worker"
-
-
-def _enc_route(r: Route) -> bytes:
-    return (_len16(r.matcher.mqtt_topic_filter.encode())
-            + struct.pack(">I", r.broker_id)
-            + _len16(r.receiver_id.encode())
-            + _len16(r.deliverer_key.encode())
-            + struct.pack(">q", r.incarnation))
-
-
-def _dec_route(buf: bytes, pos: int) -> Tuple[Route, int]:
-    tf, pos = _read16(buf, pos)
-    broker = struct.unpack_from(">I", buf, pos)[0]
-    pos += 4
-    recv, pos = _read16(buf, pos)
-    dk, pos = _read16(buf, pos)
-    inc = struct.unpack_from(">q", buf, pos)[0]
-    pos += 8
-    return Route(matcher=RouteMatcher.from_topic_filter(tf.decode()),
-                 broker_id=broker, receiver_id=recv.decode(),
-                 deliverer_key=dk.decode(), incarnation=inc), pos
-
-
-def encode_matched(m: MatchedRoutes) -> bytes:
-    flags = ((1 if m.max_persistent_fanout_exceeded else 0)
-             | (2 if m.max_group_fanout_exceeded else 0))
-    out = bytearray([flags])
-    out += struct.pack(">I", len(m.normal))
-    for r in m.normal:
-        out += _enc_route(r)
-    out += struct.pack(">H", len(m.groups))
-    for tf, members in m.groups.items():
-        out += _len16(tf.encode())
-        out += struct.pack(">I", len(members))
-        for r in members:
-            out += _enc_route(r)
-    return bytes(out)
-
-
-def decode_matched(buf: bytes, pos: int = 0) -> Tuple[MatchedRoutes, int]:
-    m = MatchedRoutes()
-    flags = buf[pos]
-    pos += 1
-    m.max_persistent_fanout_exceeded = bool(flags & 1)
-    m.max_group_fanout_exceeded = bool(flags & 2)
-    n = struct.unpack_from(">I", buf, pos)[0]
-    pos += 4
-    for _ in range(n):
-        r, pos = _dec_route(buf, pos)
-        m.normal.append(r)
-    ng = struct.unpack_from(">H", buf, pos)[0]
-    pos += 2
-    for _ in range(ng):
-        tf, pos = _read16(buf, pos)
-        nm = struct.unpack_from(">I", buf, pos)[0]
-        pos += 4
-        members = []
-        for _ in range(nm):
-            r, pos = _dec_route(buf, pos)
-            members.append(r)
-        m.groups[tf.decode()] = members
-    return m, pos
 
 
 class DistWorkerRPCService:

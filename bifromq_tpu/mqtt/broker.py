@@ -41,7 +41,8 @@ class Connection:
     """One client transport; owns the write side and the decode loop."""
 
     def __init__(self, broker: "MQTTBroker", reader: asyncio.StreamReader,
-                 writer: asyncio.StreamWriter) -> None:
+                 writer: asyncio.StreamWriter,
+                 peer_addr=None) -> None:
         self.broker = broker
         self.reader = reader
         self.writer = writer
@@ -50,6 +51,10 @@ class Connection:
         self.protocol_level = 4
         self._closed = False
         self._pending_packets: list = []
+        # the REAL client address: the proxy-protocol stage overrides the
+        # socket peername when a load balancer fronts the listener
+        self.peer_addr = (peer_addr if peer_addr is not None
+                          else writer.get_extra_info("peername"))
 
     # ------------- write side ---------------------------------------------
 
@@ -192,7 +197,7 @@ class Connection:
         from ..plugin.auth import AuthResult, ExtAuthData
 
         broker = self.broker
-        peer = str(self.writer.get_extra_info("peername"))
+        peer = str(self.peer_addr)
         step = ExtAuthData(
             client_id=c.client_id, method=method,
             data=(c.properties or {}).get(PropertyId.AUTHENTICATION_DATA,
@@ -237,7 +242,7 @@ class Connection:
     async def _on_connect(self, c: pk.Connect) -> None:
         broker = self.broker
         v5 = c.protocol_level >= PROTOCOL_MQTT5
-        peer = self.writer.get_extra_info("peername")
+        peer = self.peer_addr
         auth_method = None
         if v5 and c.properties:
             auth_method = c.properties.get(PropertyId.AUTHENTICATION_METHOD)
@@ -430,9 +435,14 @@ class MQTTBroker:
                  balancer=None, session_dict=None, mem_usage=None,
                  tls_port: Optional[int] = None, tls_ssl_context=None,
                  ws_port: Optional[int] = None,
-                 ws_path: str = "/mqtt", ws_ssl_context=None) -> None:
+                 ws_path: str = "/mqtt", ws_ssl_context=None,
+                 proxy_protocol: bool = False) -> None:
         self.host = host
         self.port = port
+        # PROXY-protocol stage on the plain-TCP listener (a fronting LB
+        # prepends the real client address; ≈ HAProxyMessageDecoder +
+        # ClientAddr channel attribute, MQTTBroker.java:177-240)
+        self.proxy_protocol = proxy_protocol
         self.ssl_context = ssl_context  # TLS listener (≈ 8883/netty-tcnative)
         self.tls_port = tls_port        # additional TLS listener (8883)
         self.tls_ssl_context = tls_ssl_context
@@ -608,7 +618,21 @@ class MQTTBroker:
         if rejected is not None:
             self._reject(writer, rejected)
             return
-        conn = Connection(self, reader, writer)
+        peer_addr = None
+        # PROXY headers only exist on the plain-TCP listener: a TLS
+        # connection's first plaintext bytes are MQTT (the LB's header
+        # would have to precede the TLS handshake, which asyncio already
+        # completed before this callback)
+        if (self.proxy_protocol
+                and writer.get_extra_info("ssl_object") is None):
+            from .proxyproto import read_proxy_header
+            try:
+                peer_addr = await asyncio.wait_for(
+                    read_proxy_header(reader), CONNECT_TIMEOUT)
+            except Exception:  # noqa: BLE001 — malformed/missing header
+                self._reject(writer, EventType.PROTOCOL_VIOLATION)
+                return
+        conn = Connection(self, reader, writer, peer_addr=peer_addr)
         await conn.run()
 
     async def _on_ws_client(self, reader: asyncio.StreamReader,

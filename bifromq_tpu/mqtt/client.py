@@ -29,11 +29,12 @@ class MQTTClient:
                  will: Optional[pk.Will] = None,
                  properties: Optional[dict] = None,
                  ssl_context=None, ws_path: Optional[str] = None,
-                 auth_handler=None) -> None:
+                 auth_handler=None, prelude: bytes = b"") -> None:
         self.host = host
         self.port = port
         self.ssl_context = ssl_context
         self.ws_path = ws_path  # MQTT-over-WebSocket when set
+        self.prelude = prelude
         # enhanced-auth responder: fn(server_data: bytes) -> bytes (MQTT5)
         self.auth_handler = auth_handler
         self.client_id = client_id
@@ -66,6 +67,11 @@ class MQTTClient:
         else:
             self._reader, self._writer = await asyncio.open_connection(
                 self.host, self.port, ssl=self.ssl_context)
+        if self.prelude:
+            # raw bytes before MQTT (e.g. a PROXY-protocol header when
+            # simulating a fronting load balancer)
+            self._writer.write(self.prelude)
+            await self._writer.drain()
         await self._send(pk.Connect(
             client_id=self.client_id, protocol_level=self.protocol_level,
             clean_start=self.clean_start, keep_alive=self.keep_alive,

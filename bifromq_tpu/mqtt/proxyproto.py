@@ -1,0 +1,102 @@
+"""PROXY protocol v1/v2 parsing — the real-client-address stage.
+
+≈ the reference's optional proxy-protocol pipeline stage + ClientAddr
+attribute (MQTTBroker.java:177-240 installing HAProxyMessageDecoder and
+stamping the decoded source address onto the channel): a load balancer
+in front of the broker prepends one header carrying the ORIGINAL client
+address; auth/events must see that address, not the LB's.
+
+``read_proxy_header`` consumes exactly the header bytes from the stream
+and returns the advertised (src_ip, src_port), or None when the sender
+declared LOCAL/UNKNOWN (health checks). Malformed headers raise
+ValueError — the connection must be dropped, never interpreted as MQTT.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import struct
+from typing import Optional, Tuple
+
+_V2_SIG = b"\r\n\r\n\x00\r\nQUIT\n"
+_V1_MAX = 107   # per the PROXY protocol spec
+
+
+async def read_proxy_header(reader: asyncio.StreamReader,
+                            ) -> Optional[Tuple[str, int]]:
+    probe = await reader.readexactly(6)
+    if probe == b"PROXY ":
+        return await _read_v1(reader)
+    if probe == _V2_SIG[:6]:
+        rest = await reader.readexactly(6)
+        if rest != _V2_SIG[6:]:
+            raise ValueError("bad PROXY v2 signature")
+        return await _read_v2(reader)
+    raise ValueError("missing PROXY header")
+
+
+async def _read_v1(reader: asyncio.StreamReader
+                   ) -> Optional[Tuple[str, int]]:
+    line = bytearray()
+    while not line.endswith(b"\r\n"):
+        if len(line) > _V1_MAX:
+            raise ValueError("PROXY v1 header too long")
+        line += await reader.readexactly(1)
+    parts = line[:-2].decode("ascii", "strict").split(" ")
+    if parts[0] == "UNKNOWN":
+        return None
+    if len(parts) != 5 or parts[0] not in ("TCP4", "TCP6"):
+        raise ValueError(f"bad PROXY v1 header {bytes(line)!r}")
+    fam = socket.AF_INET if parts[0] == "TCP4" else socket.AF_INET6
+    socket.inet_pton(fam, parts[1])     # validate the address shape
+    return parts[1], int(parts[3])
+
+
+async def _read_v2(reader: asyncio.StreamReader
+                   ) -> Optional[Tuple[str, int]]:
+    hdr = await reader.readexactly(4)
+    ver_cmd, fam_proto, length = hdr[0], hdr[1], struct.unpack(
+        ">H", hdr[2:])[0]
+    if ver_cmd >> 4 != 2:
+        raise ValueError("bad PROXY v2 version")
+    body = await reader.readexactly(length)
+    if ver_cmd & 0x0F == 0x00:      # LOCAL (health check): keep peername
+        return None
+    if ver_cmd & 0x0F != 0x01:
+        raise ValueError("bad PROXY v2 command")
+    fam = fam_proto >> 4
+    if fam == 0x1:                  # AF_INET
+        if length < 12:
+            raise ValueError("short PROXY v2 IPv4 body")
+        src = socket.inet_ntop(socket.AF_INET, body[0:4])
+        (sport,) = struct.unpack(">H", body[8:10])
+        return src, sport
+    if fam == 0x2:                  # AF_INET6
+        if length < 36:
+            raise ValueError("short PROXY v2 IPv6 body")
+        src = socket.inet_ntop(socket.AF_INET6, body[0:16])
+        (sport,) = struct.unpack(">H", body[32:34])
+        return src, sport
+    return None                     # AF_UNSPEC/UNIX: keep peername
+
+
+def encode_v1(src_ip: str, src_port: int, dst_ip: str = "127.0.0.1",
+              dst_port: int = 0) -> bytes:
+    """Client-side encoder (tests / LB simulation)."""
+    fam = "TCP6" if ":" in src_ip else "TCP4"
+    return (f"PROXY {fam} {src_ip} {dst_ip} {src_port} {dst_port}\r\n"
+            .encode("ascii"))
+
+
+def encode_v2(src_ip: str, src_port: int, dst_ip: str = "",
+              dst_port: int = 0) -> bytes:
+    v6 = ":" in src_ip
+    fam = socket.AF_INET6 if v6 else socket.AF_INET
+    if not dst_ip:
+        dst_ip = "::1" if v6 else "127.0.0.1"
+    body = (socket.inet_pton(fam, src_ip) + socket.inet_pton(fam, dst_ip)
+            + struct.pack(">HH", src_port, dst_port))
+    fam_proto = (0x2 if v6 else 0x1) << 4 | 0x1     # STREAM
+    return (_V2_SIG + bytes([0x21, fam_proto])
+            + struct.pack(">H", len(body)) + body)

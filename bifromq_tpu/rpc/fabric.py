@@ -286,26 +286,108 @@ class ServiceRegistry:
     (HRWRouter semantics)."""
 
     TRAFFIC_URI = "traffic"
+    DIRECTIVE_URI = "traffic-directive"
 
     def __init__(self, agent_host=None, crdt_store=None) -> None:
         self.agent_host = agent_host
         self.crdt_store = crdt_store
         self._static: Dict[str, List[str]] = {}
         self._clients: Dict[str, RPCClient] = {}
+        # traffic governor state (≈ IRPCServiceTrafficGovernor.java:29):
+        # address -> server-group tag, and per-service tenant-prefix
+        # directives mapping group -> weight
+        self._groups: Dict[str, str] = {}
+        self._directives: Dict[str, Dict[str, Dict[str, int]]] = {}
 
     # -- server side --------------------------------------------------------
 
-    def announce(self, service: str, address: str) -> None:
+    def announce(self, service: str, address: str,
+                 group: str = "") -> None:
+        """Announce an endpoint, optionally tagged with a server GROUP
+        (the traffic governor's unit of weighted tenant assignment)."""
+        element = f"{address}|{group}" if group else address
         if self.crdt_store is not None:
-            self.crdt_store.set_add(self.TRAFFIC_URI, service, address)
+            self.crdt_store.set_add(self.TRAFFIC_URI, service, element)
         if self.agent_host is not None:
             self.agent_host.host_agent(f"rpc:{service}",
-                                       {"address": address})
+                                       {"address": address,
+                                        "group": group})
         self._static.setdefault(service, []).append(address)
+        if group:
+            self._groups[address] = group
+
+    # -- traffic directives (≈ setTrafficDirective) -------------------------
+
+    def set_traffic_directive(self, service: str, tenant_prefix: str,
+                              group_weights: Dict[str, int]) -> None:
+        """Route tenants matching ``tenant_prefix`` across server groups
+        by weight (weight 0 = drain). The LONGEST matching prefix wins;
+        tenants matching no directive spread over all endpoints."""
+        self._directives.setdefault(service, {})[tenant_prefix] = \
+            dict(group_weights)
+        getattr(self, "_directive_cache", {}).pop(service, None)
+        if self.crdt_store is not None:
+            import json as _json
+            key = f"{service}/{tenant_prefix}"
+            for el in self.crdt_store.elements(self.DIRECTIVE_URI, key):
+                self.crdt_store.set_remove(self.DIRECTIVE_URI, key, el)
+            self.crdt_store.set_add(self.DIRECTIVE_URI, key,
+                                    _json.dumps(group_weights,
+                                                sort_keys=True))
+
+    def unset_traffic_directive(self, service: str,
+                                tenant_prefix: str) -> None:
+        self._directives.get(service, {}).pop(tenant_prefix, None)
+        getattr(self, "_directive_cache", {}).pop(service, None)
+        if self.crdt_store is not None:
+            self.crdt_store.remove_key(self.DIRECTIVE_URI,
+                                       f"{service}/{tenant_prefix}")
+
+    _DIRECTIVE_CACHE_TTL = 1.0
+
+    def _directive_for(self, service: str,
+                       key: str) -> Optional[Dict[str, int]]:
+        import time as _time
+        cached = getattr(self, "_directive_cache", None)
+        if cached is None:
+            cached = self._directive_cache = {}
+        hit = cached.get(service)
+        if hit is not None and hit[0] > _time.monotonic():
+            directives = hit[1]
+        else:
+            directives = dict(self._directives.get(service, {}))
+            if self.crdt_store is not None:
+                import json as _json
+                prefix = f"{service}/"
+                for k in self.crdt_store.keys(self.DIRECTIVE_URI):
+                    if k.startswith(prefix):
+                        for el in self.crdt_store.elements(
+                                self.DIRECTIVE_URI, k):
+                            try:
+                                directives.setdefault(k[len(prefix):],
+                                                      _json.loads(el))
+                            except ValueError:
+                                continue
+            # bounded staleness beats O(directives) JSON parsing on every
+            # routed message (pick() is the per-request hot path)
+            cached[service] = (_time.monotonic()
+                               + self._DIRECTIVE_CACHE_TTL, directives)
+        best = None
+        for pfx in directives:
+            if key.startswith(pfx) and (best is None
+                                        or len(pfx) > len(best)):
+                best = pfx
+        return directives[best] if best is not None else None
 
     def withdraw(self, service: str, address: str) -> None:
         if self.crdt_store is not None:
-            self.crdt_store.set_remove(self.TRAFFIC_URI, service, address)
+            # grouped endpoints are stored as "address|group": remove every
+            # element whose address part matches
+            for el in list(self.crdt_store.elements(self.TRAFFIC_URI,
+                                                    service)):
+                if el == address or el.startswith(address + "|"):
+                    self.crdt_store.set_remove(self.TRAFFIC_URI, service,
+                                               el)
         if self.agent_host is not None:
             self.agent_host.stop_agent(f"rpc:{service}")
         if address in self._static.get(service, []):
@@ -316,23 +398,51 @@ class ServiceRegistry:
     def endpoints(self, service: str) -> List[str]:
         out = []
         if self.crdt_store is not None:
-            out.extend(self.crdt_store.elements(self.TRAFFIC_URI, service))
+            for el in self.crdt_store.elements(self.TRAFFIC_URI, service):
+                addr, _, group = el.partition("|")
+                if group:
+                    self._groups[addr] = group
+                if addr not in out:
+                    out.append(addr)
         if self.agent_host is not None:
             for _node, meta in self.agent_host.agent_members(
                     f"rpc:{service}").items():
                 addr = (meta or {}).get("address")
                 if addr and addr not in out:
                     out.append(addr)
+                    if (meta or {}).get("group"):
+                        self._groups[addr] = meta["group"]
         for addr in self._static.get(service, []):
             if addr not in out:
                 out.append(addr)
         return sorted(out)
 
     def pick(self, service: str, key: str) -> Optional[str]:
-        """Rendezvous hash (≈ base-util RendezvousHash / HRWRouter)."""
+        """Weighted rendezvous hash (≈ HRWRouter with traffic-governor
+        directives): the longest tenant-prefix directive scales each
+        endpoint's score by its group weight; weight-0 groups drain."""
         eps = self.endpoints(service)
         if not eps:
             return None
+        directive = self._directive_for(service, key)
+        if directive is not None:
+            weighted = [ep for ep in eps
+                        if directive.get(self._groups.get(ep, ""), 0) > 0]
+            if weighted:
+                def wscore(ep: str) -> float:
+                    w = directive.get(self._groups.get(ep, ""), 0)
+                    h = hashlib.blake2b(f"{ep}|{key}".encode(),
+                                        digest_size=8).digest()
+                    # weighted rendezvous: u^(1/w) ordering via -w/ln(u).
+                    # Map the top 52 hash bits into (0,1) EXCLUSIVE with
+                    # representable float endpoints — a u that rounds to
+                    # exactly 0.0 or 1.0 would crash log for that
+                    # (endpoint, tenant) pair deterministically forever
+                    import math
+                    u = ((int.from_bytes(h, "big") >> 12) + 1) \
+                        / float((1 << 52) + 2)
+                    return -w / math.log(u)
+                return max(weighted, key=wscore)
 
         def score(ep: str) -> int:
             h = hashlib.blake2b(f"{ep}|{key}".encode(),

@@ -90,7 +90,8 @@ class Standalone:
             tls_port=(int(tls.get("port", 8883)) if tls else None),
             tls_ssl_context=(_tls_context(tls) if tls else None),
             ws_port=(int(ws["port"]) if ws else None),
-            ws_path=(ws.get("path", "/mqtt") if ws else "/mqtt"))
+            ws_path=(ws.get("path", "/mqtt") if ws else "/mqtt"),
+            proxy_protocol=bool(tcp.get("proxy_protocol", False)))
         await self.broker.start()
 
         if self.agent_host is not None:

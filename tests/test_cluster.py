@@ -96,3 +96,54 @@ class TestMembership:
             await wait_for(lambda: "h1" in late.agent_members("svc"))
         finally:
             await stop_all(hosts)
+
+
+class TestRobustness:
+    async def test_indirect_probe_survives_asymmetric_partition(self):
+        """Direct a<->b traffic is dropped; the k-relay path through c
+        confirms liveness (≈ FailureDetector.java:54 scaled indirect
+        probes), so no false eviction happens."""
+        hosts = await start_cluster(3)
+        a, b, c = hosts
+        try:
+            await wait_for(lambda: all(
+                len(h.alive_members()) == 3 for h in hosts))
+            addr_b = ("127.0.0.1", b.port)
+            addr_a = ("127.0.0.1", a.port)
+            orig_a, orig_b = a._send, b._send
+
+            def drop(orig, blocked):
+                def send(addr, msg):
+                    if tuple(addr) == blocked:
+                        return
+                    orig(addr, msg)
+                return send
+
+            a._send = drop(orig_a, addr_b)
+            b._send = drop(orig_b, addr_a)
+            # direct probe fails, the relay-confirmed indirect succeeds
+            assert not await a._probe(a.members[b.node_id])
+            assert await a._indirect_probe(a.members[b.node_id])
+            # no false eviction across several probe cycles
+            await asyncio.sleep(2.0)
+            assert a.members[b.node_id].status != DEAD
+            assert b.members[a.node_id].status != DEAD
+        finally:
+            await stop_all(hosts)
+
+    async def test_large_payload_rides_tcp(self):
+        hosts = await start_cluster(2)
+        a, b = hosts
+        try:
+            await wait_for(lambda: all(
+                len(h.alive_members()) == 2 for h in hosts))
+            got = asyncio.get_running_loop().create_future()
+            b.register_payload_handler(
+                "big", lambda frm, data: (not got.done()
+                                          and got.set_result((frm, data))))
+            blob = "x" * 200_000    # far beyond a UDP datagram
+            assert a.send_payload(b.node_id, "big", {"blob": blob})
+            frm, data = await asyncio.wait_for(got, 5)
+            assert frm == a.node_id and data["blob"] == blob
+        finally:
+            await stop_all(hosts)

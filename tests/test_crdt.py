@@ -224,3 +224,66 @@ class TestCRDTOverGossip:
             await aeb.stop()
             await a.stop()
             await b.stop()
+
+
+from bifromq_tpu.crdt.core import CCounter, DWFlag, EWFlag, RWORSet
+
+
+class TestExtendedTypes:
+    def test_rworset_remove_wins(self):
+        a, b = RWORSet(), RWORSet()
+        a.join(RWORSet.from_dict(b.to_dict()))
+        a.add("a", "x")
+        b.join(RWORSet.from_dict(a.to_dict()))
+        assert "x" in a and "x" in b
+        # concurrent: a removes, b re-adds -> REMOVE wins after joins
+        a.remove("a", "x")
+        b.add("b", "x")
+        a.join(RWORSet.from_dict(b.to_dict()))
+        b.join(RWORSet.from_dict(a.to_dict()))
+        assert "x" not in a and "x" not in b
+        assert a.elements() == b.elements() == []
+        # a later (causal) re-add resurrects it
+        a.add("a", "x")
+        b.join(RWORSet.from_dict(a.to_dict()))
+        assert "x" in b
+
+    def test_ewflag_enable_wins(self):
+        a, b = EWFlag(), EWFlag()
+        a.enable("a")
+        b.join(EWFlag.from_dict(a.to_dict()))
+        assert b.read()
+        # concurrent disable(a) || enable(b): ENABLED wins
+        a.disable()
+        b.enable("b")
+        a.join(EWFlag.from_dict(b.to_dict()))
+        b.join(EWFlag.from_dict(a.to_dict()))
+        assert a.read() and b.read()
+
+    def test_dwflag_disable_wins(self):
+        a, b = DWFlag(), DWFlag()
+        a.disable("a")
+        b.join(DWFlag.from_dict(a.to_dict()))
+        assert not b.read()
+        b.enable()
+        a.disable("a")          # concurrent with b's enable
+        a.join(DWFlag.from_dict(b.to_dict()))
+        b.join(DWFlag.from_dict(a.to_dict()))
+        assert not a.read() and not b.read()
+
+    def test_ccounter_concurrent_incs_and_reset(self):
+        a, b = CCounter(), CCounter()
+        a.inc("a", 5)
+        b.inc("b", 3)
+        a.join(CCounter.from_dict(b.to_dict()))
+        b.join(CCounter.from_dict(a.to_dict()))
+        assert a.read() == b.read() == 8
+        # a resets while b concurrently increments: b's inc survives
+        a.zero()
+        b.inc("b", 2)
+        a.join(CCounter.from_dict(b.to_dict()))
+        b.join(CCounter.from_dict(a.to_dict()))
+        assert a.read() == b.read() == 5   # b's re-tagged total (3+2)
+        a.inc("a", 1)
+        b.join(CCounter.from_dict(a.to_dict()))
+        assert b.read() == 6

@@ -80,8 +80,9 @@ class TestDistWorkerCoProc:
         reply = await c.drive(leader.query_coproc(
             dw.encode_match_query("T", ["a/b", "zzz"])))
         matches = dw.decode_match_reply(reply)
-        assert matches[0] == [(0, "rx", "d0")]
-        assert matches[1] == []
+        assert [(r.broker_id, r.receiver_id, r.deliverer_key)
+                for r in matches[0].all_routes()] == [(0, "rx", "d0")]
+        assert matches[1].all_routes() == []
 
     async def test_every_replica_can_serve_matches(self):
         c = CoProcCluster()

@@ -151,3 +151,45 @@ class TestRegistry:
         finally:
             await a.stop()
             await b.stop()
+
+
+class TestTrafficGovernor:
+    async def test_weighted_groups_and_tenant_directives(self):
+        """≈ IRPCServiceTrafficGovernor: tenant-prefix directives assign
+        weighted server groups; weight 0 drains a group."""
+        reg = ServiceRegistry()
+        for i in range(3):
+            reg.announce("svc", f"10.0.0.{i}:1", group="gA")
+        for i in range(3, 6):
+            reg.announce("svc", f"10.0.0.{i}:1", group="gB")
+        # tenants under "vip" pin to gB only
+        reg.set_traffic_directive("svc", "vip", {"gB": 1})
+        for t in ("vipX", "vip-co", "vip"):
+            ep = reg.pick("svc", t)
+            assert reg._groups[ep] == "gB", (t, ep)
+        # everyone else spreads over ALL endpoints
+        others = {reg.pick("svc", f"t{i}") for i in range(50)}
+        assert any(reg._groups.get(e) == "gA" for e in others)
+        # longest prefix wins
+        reg.set_traffic_directive("svc", "vip-co", {"gA": 1})
+        assert reg._groups[reg.pick("svc", "vip-co")] == "gA"
+        assert reg._groups[reg.pick("svc", "vipX")] == "gB"
+        # weighted spread: 3:1 weights shift most tenants to gA
+        reg.set_traffic_directive("svc", "", {"gA": 3, "gB": 1})
+        counts = {"gA": 0, "gB": 0}
+        for i in range(200):
+            counts[reg._groups[reg.pick("svc", f"w{i}")]] += 1
+        assert counts["gA"] > counts["gB"] * 1.5, counts
+        # drain gA entirely
+        reg.set_traffic_directive("svc", "", {"gA": 0, "gB": 1})
+        for i in range(20):
+            assert reg._groups[reg.pick("svc", f"d{i}")] == "gB"
+
+    async def test_stability_under_directives(self):
+        reg = ServiceRegistry()
+        for i in range(4):
+            reg.announce("svc", f"10.1.0.{i}:1", group="g1")
+        reg.set_traffic_directive("svc", "", {"g1": 2})
+        before = {f"k{i}": reg.pick("svc", f"k{i}") for i in range(50)}
+        # re-picking is deterministic
+        assert all(reg.pick("svc", k) == v for k, v in before.items())
