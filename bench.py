@@ -53,24 +53,39 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def _measure_match(tries, probe_fn, *, name, k_states=K_STATES,
-                   iters=ITERS, batch=BATCH, max_levels=16):
-    """Compile `tries`, probe with batches from probe_fn(i) -> queries.
-
-    Returns dict of measured numbers. probe_fn yields (levels_list, tenant)
-    pairs resolved against the compiled roots.
-    """
-    import jax
-
-    from bifromq_tpu.models.automaton import compile_tries, tokenize
-    from bifromq_tpu.ops.match import (DeviceTrie, Probes, walk_count_only)
+def _compile(tries, *, name, max_levels=16):
+    from bifromq_tpu.models.automaton import compile_tries
+    from bifromq_tpu.ops.match import DeviceTrie
 
     t0 = time.time()
     ct = compile_tries(tries, max_levels=max_levels)
     t1 = time.time()
     log(f"[{name}] compiled: nodes={ct.n_nodes} slots={ct.n_slots} "
         f"({t1 - t0:.1f}s)")
-    dev = DeviceTrie.from_compiled(ct)
+    return ct, DeviceTrie.from_compiled(ct), t1 - t0
+
+
+def _measure_match(tries, probe_fn, *, name, k_states=K_STATES,
+                   iters=ITERS, batch=BATCH, max_levels=16,
+                   compiled=None):
+    """Compile `tries` (or reuse ``compiled``), probe with batches from
+    probe_fn(i) -> queries.
+
+    Returns dict of measured numbers. probe_fn yields (levels_list, tenant)
+    pairs resolved against the compiled roots.
+    """
+    import jax
+
+    from bifromq_tpu.models.automaton import tokenize
+    from bifromq_tpu.ops.match import Probes, walk_count_only
+
+    if compiled is None:
+        ct, dev, compile_s = _compile(tries, name=name,
+                                      max_levels=max_levels)
+    else:
+        ct, dev, compile_s = compiled
+    t0 = time.time()
+    t1 = t0 + compile_s
 
     n_batches = 4
     probe_sets = []
@@ -174,11 +189,39 @@ def bench_config1():
 def bench_config2():
     from bifromq_tpu import workloads
     tries = workloads.config_wildcard(N_SUBS, seed=SEED)
+    name = f"c2_wildcard_{N_SUBS}"
+    if os.environ.get("BENCH_SWEEP"):
+        sweep_b = [int(x) for x in os.environ.get(
+            "BENCH_SWEEP_B", "8192,32768").split(",") if x]
+        sweep_k = [int(x) for x in os.environ.get(
+            "BENCH_SWEEP_K", "8,16").split(",") if x]
+        # one compile, a (batch × k_states) grid of measurements; the best
+        # cell becomes the headline (VERDICT-r3 sweep: B∈{8192,32768} ×
+        # K∈{8,16} on the sort-compaction kernel)
+        compiled = _compile(tries, name=name)
+        best, grid = None, {}
+        for b in sweep_b:
+            topics = workloads.probe_topics(b * 4, seed=SEED + 1)
+
+            def probe(i, batch, topics=topics):
+                return [(t, "tenant0")
+                        for t in topics[i * batch:(i + 1) * batch]]
+            for k in sweep_k:
+                r = _measure_match(tries, probe,
+                                   name=f"{name}_B{b}_K{k}",
+                                   batch=b, k_states=k, compiled=compiled)
+                grid[f"B{b}_K{k}"] = r
+                if best is None or r["topics_per_s"] > best["topics_per_s"]:
+                    best = r
+        log(f"[{name}] sweep grid: {json.dumps(grid)}")
+        log(f"[{name}] best cell: B={best['batch']} K={best['k_states']}")
+        return best
+
     topics = workloads.probe_topics(BATCH * 4, seed=SEED + 1)
 
     def probe(i, batch):
         return [(t, "tenant0") for t in topics[i * batch:(i + 1) * batch]]
-    return _measure_match(tries, probe, name=f"c2_wildcard_{N_SUBS}")
+    return _measure_match(tries, probe, name=name)
 
 
 def bench_config3():

@@ -144,6 +144,8 @@ class APIServer:
             if route == ("GET", "/metrics"):
                 return 200, (self.metrics.snapshot()
                              if self.metrics is not None else {})
+            if route == ("GET", "/ranges"):
+                return self._ranges()
             return 404, {"error": f"no route {method} {url.path}"}
         except KeyError as e:
             return 400, {"error": f"missing parameter {e}"}
@@ -239,6 +241,24 @@ class APIServer:
                       if t == tenant]
         return 200, {"online": sorted(online),
                      "persistent": sorted(persistent)}
+
+    def _ranges(self) -> Tuple[int, object]:
+        """Per-range observability (≈ KVRangeMetricManager): key counts,
+        raft health, and the load profile feeding the split hinters —
+        for the dist, inbox, and retain stores."""
+        from ..kv.metrics import range_stats
+
+        out = {}
+        worker_store = getattr(self.broker.dist.worker, "store", None)
+        if worker_store is not None:
+            out["dist"] = range_stats(worker_store)
+        inbox_store = getattr(self.broker.inbox, "kvstore", None)
+        if inbox_store is not None:
+            out["inbox"] = range_stats(inbox_store)
+        retain_store = getattr(self.broker.retain_service, "kvstore", None)
+        if retain_store is not None:
+            out["retain"] = range_stats(retain_store)
+        return 200, out
 
     def _routes(self, arg) -> Tuple[int, object]:
         tenant = arg("tenant_id") or "DevOnly"

@@ -140,15 +140,38 @@ class DistService:
         route = Route(matcher=matcher, broker_id=broker_id,
                       receiver_id=receiver_id, deliverer_key=deliverer_key,
                       incarnation=incarnation)
-        return await self.worker.add_route(tenant_id, route) in ("ok",
-                                                                 "exists")
+        try:
+            out = await self.worker.add_route(tenant_id, route)
+        except Exception:  # noqa: BLE001 — consensus/transport failure
+            self.events.report(Event(EventType.MATCH_ERROR, tenant_id,
+                                     {"filter":
+                                      matcher.mqtt_topic_filter}))
+            raise
+        ok = out in ("ok", "exists")
+        self.events.report(Event(
+            EventType.MATCHED if ok else EventType.MATCH_ERROR, tenant_id,
+            {"filter": matcher.mqtt_topic_filter}
+            | ({} if ok else {"reason": out})))
+        return ok
 
     async def unmatch(self, tenant_id: str, matcher: RouteMatcher,
                       broker_id: int, receiver_id: str, deliverer_key: str,
                       incarnation: int = 0) -> bool:
-        return await self.worker.remove_route(
-            tenant_id, matcher, (broker_id, receiver_id, deliverer_key),
-            incarnation) == "ok"
+        try:
+            out = await self.worker.remove_route(
+                tenant_id, matcher, (broker_id, receiver_id, deliverer_key),
+                incarnation)
+        except Exception:  # noqa: BLE001
+            self.events.report(Event(EventType.UNMATCH_ERROR, tenant_id,
+                                     {"filter":
+                                      matcher.mqtt_topic_filter}))
+            raise
+        ok = out == "ok"
+        self.events.report(Event(
+            EventType.UNMATCHED if ok else EventType.UNMATCH_ERROR,
+            tenant_id, {"filter": matcher.mqtt_topic_filter}
+            | ({} if ok else {"reason": out})))
+        return ok
 
     # ---------------- publish path -----------------------------------------
 

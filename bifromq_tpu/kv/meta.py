@@ -137,6 +137,7 @@ class BaseKVStoreServer:
             "describe": self._on_describe,
             "ensure_range": self._on_ensure_range,
             "recover": self._on_recover,
+            "range_stats": self._on_range_stats,
         })
         messenger.attach(server)
 
@@ -307,6 +308,11 @@ class BaseKVStoreServer:
                     if spec["end"] is not None else None)
         self.store.ensure_range(rid_b.decode(), boundary, spec["voters"])
         return b"ok"
+
+    async def _on_range_stats(self, _payload: bytes, _okey: str) -> bytes:
+        """Per-range observability (≈ KVRangeMetricManager snapshot)."""
+        from .metrics import range_stats
+        return json.dumps(range_stats(self.store)).encode()
 
     async def _on_recover(self, payload: bytes, _okey: str) -> bytes:
         """Operator quorum-loss recovery RPC

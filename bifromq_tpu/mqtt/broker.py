@@ -152,7 +152,13 @@ class Connection:
                     await self.close_transport()
                     return
                 buf_packets = self.decoder.feed(data)
-        except (asyncio.TimeoutError, MalformedPacket):
+        except asyncio.TimeoutError:
+            # no CONNECT within the prelude window (≈ ConnectTimeout)
+            self.broker.events.report(Event(EventType.CONNECT_TIMEOUT,
+                                            "", {}))
+            await self.close_transport()
+            return
+        except MalformedPacket:
             await self.close_transport()
             return
         first = buf_packets[0]
@@ -253,10 +259,20 @@ class Connection:
             if auth_result is None:
                 return  # exchange failed; connection already closed
         else:
-            auth_result = await broker.auth.auth(AuthData(
-                client_id=c.client_id, protocol_level=c.protocol_level,
-                username=c.username, password=c.password,
-                remote_addr=str(peer)))
+            try:
+                auth_result = await broker.auth.auth(AuthData(
+                    client_id=c.client_id, protocol_level=c.protocol_level,
+                    username=c.username, password=c.password,
+                    remote_addr=str(peer)))
+            except Exception:  # noqa: BLE001 — plugin failure ≠ crash
+                log.exception("auth provider failed")
+                broker.events.report(Event(EventType.AUTH_ERROR, "",
+                                           {"client_id": c.client_id}))
+                rc = (ReasonCode.UNSPECIFIED_ERROR if v5
+                      else CONNACK_REFUSED_NOT_AUTHORIZED)
+                await self.send(pk.Connack(reason_code=rc))
+                await self.close_transport()
+                return
         if not auth_result.ok:
             rc = (ReasonCode.NOT_AUTHORIZED if v5
                   else CONNACK_REFUSED_NOT_AUTHORIZED)

@@ -213,6 +213,9 @@ class Session:
         self.local_registry.register(self)
         await self.session_registry.register(self)
         await self._global_kick()
+        self.events.report(Event(
+            EventType.MQTT_SESSION_START, self.client_info.tenant_id,
+            {"client_id": self.client_info.meta().get("clientId", "")}))
 
     async def _global_kick(self) -> None:
         """Cluster-wide single-owner kick via the session-dict service
@@ -243,6 +246,11 @@ class Session:
         if fire_will and self.will is not None and not self._will_suppressed:
             await self._fire_will()
         await self.conn.close_transport()
+        # after cleanup: a throwing event-collector plugin must not be
+        # able to abort teardown (closed is already True — no retry)
+        self.events.report(Event(
+            EventType.MQTT_SESSION_STOP, self.client_info.tenant_id,
+            {"client_id": self.client_info.meta().get("clientId", "")}))
         self.events.report(Event(EventType.CLIENT_DISCONNECTED,
                                  self.client_info.tenant_id,
                                  {"client_id": self.client_id}))
@@ -565,6 +573,11 @@ class Session:
         matches = await self.retain_service.match(
             self.client_info.tenant_id, list(sub.matcher.filter_levels),
             limit)
+        if matches:
+            self.events.report(Event(
+                EventType.RETAIN_MSG_MATCHED, self.client_info.tenant_id,
+                {"filter": sub.matcher.mqtt_topic_filter,
+                 "count": len(matches)}))
         for topic, msg in matches:
             await self._send_publish(topic, msg, sub, retained=True)
 
@@ -695,12 +708,19 @@ class Session:
         st = self._outbound.pop(pid, None)
         if st is not None:
             self._pid_alloc.release(pid)
+            self.events.report(Event(EventType.PUB_ACKED,
+                                     self.client_info.tenant_id,
+                                     {"packet_id": pid}))
 
     async def _on_pubrec(self, pid: int) -> None:
         st = self._outbound.get(pid)
         if st is None or st.publish.qos != 2:
             await self.conn.send(pk.PubRel(packet_id=pid))
             return
+        if st.phase != 2:       # retransmitted PUBREC: report once
+            self.events.report(Event(EventType.PUB_RECED,
+                                     self.client_info.tenant_id,
+                                     {"packet_id": pid}))
         st.phase = 2
         await self.conn.send(pk.PubRel(packet_id=pid))
 

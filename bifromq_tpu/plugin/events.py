@@ -64,6 +64,22 @@ class EventType(enum.Enum):
     INBOX_DETACHED = "inbox_detached"
     INBOX_EXPIRED = "inbox_expired"
     INBOX_DELETED = "inbox_deleted"
+    # session lifecycle (≈ MQTTSessionStart/Stop)
+    MQTT_SESSION_START = "mqtt_session_start"
+    MQTT_SESSION_STOP = "mqtt_session_stop"
+    # route mutation family (≈ distservice Matched/Unmatched/...Error)
+    MATCHED = "matched"
+    UNMATCHED = "unmatched"
+    MATCH_ERROR = "match_error"
+    UNMATCH_ERROR = "unmatch_error"
+    # connect detail (≈ ConnectTimeout / AuthError)
+    CONNECT_TIMEOUT = "connect_timeout"
+    AUTH_ERROR = "auth_error"
+    # retain detail (≈ RetainMsgMatched)
+    RETAIN_MSG_MATCHED = "retain_msg_matched"
+    # outbound-ack family (≈ QoS1PubAcked / QoS2PubReced)
+    PUB_ACKED = "pub_acked"
+    PUB_RECED = "pub_reced"
 
 
 @dataclass
