@@ -188,6 +188,14 @@ class DistWorkerCoProc(IKVRangeCoProc):
         # (b"retry") so the caller re-resolves, never landing a key outside
         # the boundary (≈ KVRangeFSM boundary check on command apply)
         self.boundary = None
+        # Fact: the ACTUAL stored key span [first, last] of this range
+        # (≈ the reference's per-range Fact with first/last filter levels,
+        # TenantRangeLookupCache.java:78-89): a range whose boundary
+        # intersects a tenant's keyspace but whose real keys don't is
+        # pruned from match fan-in. None = empty; "dirty" = rescan needed.
+        self._fact = None
+        self._fact_dirty = True
+        self._fact_reader = None
 
     # ---------------- RW (≈ batchAddRoute / batchRemoveRoute) --------------
 
@@ -235,6 +243,11 @@ class DistWorkerCoProc(IKVRangeCoProc):
             writer.put(key, value)
             overlay[key] = value
             self.matcher.add_route(tenant_id, route)
+            if not self._fact_dirty:    # widen the span in O(1)
+                f = self._fact
+                self._fact = ((min(f[0], key), max(f[1], key))
+                              if f is not None else (key, key))
+            self._fact_reader = reader
             return b"ok" if existing is None else b"exists"
         if op == _OP_REMOVE:
             existing = current(key)
@@ -247,8 +260,30 @@ class DistWorkerCoProc(IKVRangeCoProc):
             overlay[key] = None
             self.matcher.remove_route(tenant_id, route.matcher,
                                       route.receiver_url, incarnation)
+            if self._fact is not None and key in self._fact:
+                self._fact_dirty = True     # span may shrink: lazy rescan
+            self._fact_reader = reader
             return b"ok"
         return b"bad_op"
+
+    def fact(self) -> Optional[Tuple[bytes, bytes]]:
+        """The stored [first, last] route-key span, or None when empty."""
+        if self._fact_dirty:
+            self._fact = None
+            if self._fact_reader is not None:
+                lo = schema.TAG_DIST
+                hi = schema.prefix_end(schema.TAG_DIST)
+                # two O(1) endpoint probes, not a full scan — this runs on
+                # the match hot path after endpoint removals
+                first = next(
+                    (k for k, _v in self._fact_reader.iterate(lo, hi)),
+                    None)
+                if first is not None:
+                    last = next(k for k, _v in self._fact_reader.iterate(
+                        lo, hi, reverse=True))
+                    self._fact = (first, last)
+            self._fact_dirty = False
+        return self._fact
 
     # ---------------- RO (≈ batchDist) -------------------------------------
 
@@ -276,6 +311,8 @@ class DistWorkerCoProc(IKVRangeCoProc):
 
     def reset(self, reader: IKVSpace) -> None:
         """Rebuild the matcher (derived state) from the route keyspace."""
+        self._fact_reader = reader
+        self._fact_dirty = True
         self.matcher = self.matcher.clone_empty()
         for key, value in reader.iterate(schema.TAG_DIST,
                                          schema.prefix_end(schema.TAG_DIST)):
@@ -518,8 +555,23 @@ class DistWorker:
         for tenant_id, _levels in queries:
             if tenant_id not in tenant_ranges:
                 pfx = schema.tenant_route_prefix(tenant_id)
-                tenant_ranges[tenant_id] = self.store.router.intersecting(
-                    pfx, schema.prefix_end(pfx))
+                pfx_end = schema.prefix_end(pfx)
+                rids = self.store.router.intersecting(pfx, pfx_end)
+                # Fact pruning (≈ TenantRangeLookupCache first/last-key
+                # filtering): drop ranges whose ACTUAL stored key span
+                # doesn't touch the tenant's keyspace — a boundary can
+                # cover a tenant the range holds no routes for
+                pruned = []
+                for rid in rids:
+                    fact_fn = getattr(self.store.coprocs[rid], "fact",
+                                      None)
+                    if fact_fn is not None:
+                        span = fact_fn()
+                        if span is None or span[1] < pfx \
+                                or span[0] >= pfx_end:
+                            continue
+                    pruned.append(rid)
+                tenant_ranges[tenant_id] = pruned
         range_queries = {}      # rid -> [query index]
         for qi, (tenant_id, _levels) in enumerate(queries):
             for rid in tenant_ranges[tenant_id]:

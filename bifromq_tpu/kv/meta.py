@@ -396,18 +396,37 @@ class ClusterKVClient:
         else:
             self.refresh()
 
+    @staticmethod
+    def _replica_pick(stores: Dict[str, str], key: bytes,
+                      exclude=()) -> Optional[str]:
+        import hashlib
+        candidates = [s for s in stores if s not in exclude] or list(stores)
+        if not candidates:
+            return None
+
+        def score(sid: str) -> int:
+            h = hashlib.blake2b(sid.encode() + b"|" + key,
+                                digest_size=8).digest()
+            return int.from_bytes(h, "big")
+        return max(candidates, key=score)
+
     async def _call(self, method: str, key: bytes, payload: bytes,
-                    *, order_key: str = "") -> bytes:
+                    *, order_key: str = "",
+                    any_replica: bool = False) -> bytes:
         last_err: Optional[Exception] = None
         prefer: Optional[str] = None
-        for attempt in range(self.MAX_ATTEMPTS):
-            route = self.find(key)
+        failed: set = set()     # stores that errored THIS call: a dead
+        for attempt in range(self.MAX_ATTEMPTS):  # rendezvous winner must
+            route = self.find(key)                # not eat every retry
             if route is None:
                 await asyncio.sleep(0.05)
                 await self._refresh()
                 continue
             rid, leader, stores = route
-            target = prefer or leader
+            if any_replica and prefer is None:
+                target = self._replica_pick(stores, key, exclude=failed)
+            else:
+                target = prefer or leader
             addr = stores.get(target) if target else None
             if addr is None:            # no known leader: probe any replica
                 addr = next(iter(stores.values()), None)
@@ -427,6 +446,8 @@ class ClusterKVClient:
             except Exception as e:  # noqa: BLE001 — dead store: re-route
                 last_err = e
                 prefer = None
+                if target is not None:
+                    failed.add(target)
                 await asyncio.sleep(0.05 * (attempt + 1))
                 await self._refresh()
                 continue
@@ -451,8 +472,13 @@ class ClusterKVClient:
 
     async def query(self, key: bytes, payload: bytes, *,
                     linearized: bool = True) -> bytes:
+        """Linearized queries go to the leader (read-index barrier);
+        non-linearized ones REPLICA-SPREAD by rendezvous hash over every
+        store hosting the range (≈ BatchDistServerCall.replicaSelect:245
+        scaling reads across followers)."""
         return await self._call(
-            "query", key, bytes([int(linearized)]) + payload)
+            "query", key, bytes([int(linearized)]) + payload,
+            any_replica=not linearized)
 
     async def mutate(self, key: bytes, payload: bytes, *,
                      order_key: str = "") -> bytes:

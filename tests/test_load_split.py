@@ -78,3 +78,53 @@ class TestFanoutSplit:
             assert len(res[1].all_routes()) == 3
         finally:
             await w.stop()
+
+
+class TestFactPruning:
+    async def test_fact_prunes_empty_ranges_and_stays_exact(self):
+        """≈ TenantRangeLookupCache.java:78-89: a range whose boundary
+        intersects the tenant but whose STORED span doesn't is pruned
+        from match fan-in; results stay exact through churn."""
+        w = DistWorker()
+        await w.start()
+        try:
+            for t in ("aa", "mm", "zz"):
+                for i in range(10):
+                    await w.add_route(t, mk_route(f"f/{i}", f"r{t}{i}"))
+            rid = next(iter(w.store.ranges))
+            # split between mm and zz: left holds aa+mm, right holds zz
+            await w.store.split(rid, schema.tenant_route_prefix("zz"))
+            assert len(w.store.ranges) == 2
+            (left, right) = sorted(
+                w.store.ranges, key=lambda r: w.store.boundaries[r][0])
+            # facts reflect actual spans
+            lf = w.store.coprocs[left].fact()
+            rf = w.store.coprocs[right].fact()
+            assert lf is not None and rf is not None
+            assert rf[0] >= schema.tenant_route_prefix("zz")
+            # a left-range query must NOT touch the right range's matcher
+            called = []
+            orig = w.store.coprocs[right].matcher.match_batch
+
+            def spy(queries, **kw):
+                called.append(len(queries))
+                return orig(queries, **kw)
+            w.store.coprocs[right].matcher.match_batch = spy
+            res = await w.match_batch([("aa", ["f", "3"])],
+                                      max_persistent_fanout=1 << 30,
+                                      max_group_fanout=1 << 30)
+            assert [r.receiver_id for r in res[0].all_routes()] == ["raa3"]
+            assert called == [], "right range should be Fact-pruned"
+            # removing every zz route empties the right range's fact;
+            # zz queries then fan into zero ranges and return empty
+            for i in range(10):
+                await w.remove_route(
+                    "zz", RouteMatcher.from_topic_filter(f"f/{i}"),
+                    (0, f"rzz{i}", "d0"))
+            assert w.store.coprocs[right].fact() is None
+            res = await w.match_batch([("zz", ["f", "3"])],
+                                      max_persistent_fanout=1 << 30,
+                                      max_group_fanout=1 << 30)
+            assert res[0].all_routes() == []
+        finally:
+            await w.stop()
