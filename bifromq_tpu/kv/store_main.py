@@ -29,6 +29,19 @@ def _coproc_factory(kind: str):
             return DistWorkerCoProc()
         return make
 
+    if kind == "inbox":
+        from ..inbox.coproc import InboxStoreCoProc
+        from ..plugin.events import IEventCollector
+
+        class _NoEvents(IEventCollector):
+            def report(self, event):
+                pass
+        return lambda range_id: InboxStoreCoProc(_NoEvents())
+
+    if kind == "retain":
+        from ..retain.coproc import RetainCoProc
+        return lambda range_id: RetainCoProc()
+
     from .range import IKVRangeCoProc
 
     class _EchoCoProc(IKVRangeCoProc):
@@ -92,7 +105,8 @@ def main(argv=None) -> None:
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--peers", required=True,
                     help="node=host:port,... (must include --node)")
-    ap.add_argument("--coproc", default="echo", choices=["echo", "dist"])
+    ap.add_argument("--coproc", default="echo",
+                    choices=["echo", "dist", "inbox", "retain"])
     ap.add_argument("--data-dir", default="")
     ap.add_argument("--tick-interval", type=float, default=0.02)
     args = ap.parse_args(argv)

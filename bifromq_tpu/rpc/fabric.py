@@ -101,33 +101,83 @@ class _OrderedRunner:
         self._tasks.clear()
 
 
-class RPCServer:
-    """One listener hosting many services."""
+# process-local server table: calls addressed to a server in THIS process
+# bypass TCP entirely (≈ the reference's in-proc RPC bypass, where client
+# and server stubs short-circuit inside one JVM)
+_LOCAL_SERVERS: Dict[str, "RPCServer"] = {}
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+
+class RPCServer:
+    """One listener hosting many services.
+
+    ``ssl_context`` (server-side) enables TLS on the listener — the
+    counterpart of the reference's SSL-capable RPC servers."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 ssl_context=None) -> None:
         self.host = host
         self.port = port
+        self.ssl_context = ssl_context
         self._services: Dict[str, Dict[str, Handler]] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._conn_tasks: set = set()
+        self._local_runner: Optional[_OrderedRunner] = None
 
     def register(self, service: str, methods: Dict[str, Handler]) -> None:
         self._services.setdefault(service, {}).update(methods)
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(self._on_conn, self.host,
-                                                  self.port)
+                                                  self.port,
+                                                  ssl=self.ssl_context)
         self.port = self._server.sockets[0].getsockname()[1]
+        _LOCAL_SERVERS[self.address] = self
 
     @property
     def address(self) -> str:
         return f"{self.host}:{self.port}"
 
     async def stop(self) -> None:
+        _LOCAL_SERVERS.pop(self.address, None)
         if self._server is not None:
             self._server.close()
+        if self._local_runner is not None:
+            self._local_runner.close()
+            self._local_runner = None
         for t in list(self._conn_tasks):
             t.cancel()
+
+    async def dispatch_local(self, service: str, method: str,
+                             payload: bytes, order_key: str) -> bytes:
+        """In-proc bypass entry: same semantics as the wire path —
+        handler errors surface as RPCError, and calls sharing an
+        order_key execute FIFO through the same runner machinery."""
+        handler = self._services.get(service, {}).get(method)
+        if handler is None:
+            raise RPCError("no such method")
+
+        async def run() -> bytes:
+            try:
+                return await handler(payload, order_key)
+            except Exception as e:  # noqa: BLE001 — wire-path parity
+                raise RPCError(repr(e)) from e
+
+        if not order_key:
+            return await run()
+        if self._local_runner is None:
+            self._local_runner = _OrderedRunner()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+
+        async def ordered() -> None:
+            try:
+                res = await run()
+                if not fut.done():      # caller may have been cancelled
+                    fut.set_result(res)
+            except BaseException as e:  # noqa: BLE001
+                if not fut.done():
+                    fut.set_exception(e)
+        self._local_runner.submit(order_key, ordered)
+        return await fut
 
     async def _on_conn(self, reader: asyncio.StreamReader,
                        writer: asyncio.StreamWriter) -> None:
@@ -185,11 +235,16 @@ class RPCServer:
 
 
 class RPCClient:
-    """Multiplexed client for one server address; reconnects lazily."""
+    """Multiplexed client for one server address; reconnects lazily.
+    Calls addressed to a server living in THIS process short-circuit
+    through ``dispatch_local`` (no sockets). ``ssl_context`` dials TLS."""
 
-    def __init__(self, host: str, port: int) -> None:
+    def __init__(self, host: str, port: int, *, ssl_context=None,
+                 local_bypass: bool = True) -> None:
         self.host = host
         self.port = port
+        self.ssl_context = ssl_context
+        self.local_bypass = local_bypass
         self._writer: Optional[asyncio.StreamWriter] = None
         self._reader_task: Optional[asyncio.Task] = None
         self._pending: Dict[int, asyncio.Future] = {}
@@ -205,8 +260,8 @@ class RPCClient:
         async with self._conn_lock:
             if self._writer is not None and not self._writer.is_closing():
                 return self._writer
-            reader, writer = await asyncio.open_connection(self.host,
-                                                           self.port)
+            reader, writer = await asyncio.open_connection(
+                self.host, self.port, ssl=self.ssl_context)
             # per-connection pending map: a dead connection's cleanup must
             # only fail ITS calls, never a successor connection's
             self._writer = writer
@@ -248,6 +303,19 @@ class RPCClient:
 
     async def call(self, service: str, method: str, payload: bytes, *,
                    order_key: str = "", timeout: float = 30.0) -> bytes:
+        if self.local_bypass:
+            local = _LOCAL_SERVERS.get(f"{self.host}:{self.port}")
+            if (local is not None and local._server is not None
+                    and local._server.is_serving()):
+                # in-proc bypass: no sockets, no codec. The handler runs
+                # as a DETACHED task shielded from the client timeout —
+                # on the wire path a timed-out call still completes
+                # server-side, and the bypass must not diverge (a
+                # cancelled mutate could be half-applied)
+                task = asyncio.ensure_future(local.dispatch_local(
+                    service, method, payload, order_key))
+                return await asyncio.wait_for(asyncio.shield(task),
+                                              timeout)
         writer = await self._ensure_conn()
         pending = self._pending
         self._next_id += 1
@@ -288,9 +356,13 @@ class ServiceRegistry:
     TRAFFIC_URI = "traffic"
     DIRECTIVE_URI = "traffic-directive"
 
-    def __init__(self, agent_host=None, crdt_store=None) -> None:
+    def __init__(self, agent_host=None, crdt_store=None, *,
+                 local_bypass: bool = True,
+                 client_ssl_context=None) -> None:
         self.agent_host = agent_host
         self.crdt_store = crdt_store
+        self.local_bypass = local_bypass        # in-proc short-circuit
+        self.client_ssl_context = client_ssl_context  # TLS dialing
         self._static: Dict[str, List[str]] = {}
         self._clients: Dict[str, RPCClient] = {}
         # traffic governor state (≈ IRPCServiceTrafficGovernor.java:29):
@@ -459,7 +531,10 @@ class ServiceRegistry:
     def client_for(self, addr: str) -> RPCClient:
         c = self._clients.get(addr)
         if c is None:
-            c = self._clients[addr] = RPCClient.from_address(addr)
+            host, port = addr.rsplit(":", 1)
+            c = self._clients[addr] = RPCClient(
+                host, int(port), ssl_context=self.client_ssl_context,
+                local_bypass=self.local_bypass)
         return c
 
     async def close(self) -> None:

@@ -83,3 +83,16 @@ def pytest_fixture_setup(fixturedef, request):
         fixturedef.cached_result = (value, fixturedef.cache_key(request), None)
         return value
     return None
+
+
+@pytest.fixture(scope="session")
+def certs(tmp_path_factory):
+    """Self-signed TLS cert pair shared by TLS listener/RPC tests."""
+    import subprocess
+    d = tmp_path_factory.mktemp("certs")
+    key, crt = str(d / "k.pem"), str(d / "c.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", crt, "-days", "1",
+         "-subj", "/CN=localhost"], check=True, capture_output=True)
+    return key, crt

@@ -100,7 +100,7 @@ class TestPlacement:
         across joining stores (ensure_range + config change + raft
         catch-up); killing one store makes
         UnreachableReplicaRemovalBalancer prune it back out."""
-        registry = ServiceRegistry()
+        registry = ServiceRegistry(local_bypass=False)  # real TCP
         meta = MetaService()
         alive = {"s1", "s2", "s3"}
         s1 = _mk_store("s1", registry, meta, member_nodes=["s1"])
@@ -149,7 +149,7 @@ class TestPlacement:
     async def test_zombie_quit_on_config_exclusion(self):
         """A replica excluded by a committed config change retires itself
         (zombie-quit): its store destroys the local range state."""
-        registry = ServiceRegistry()
+        registry = ServiceRegistry(local_bypass=False)  # real TCP
         meta = MetaService()
         members = ["z1", "z2", "z3"]
         servers = {n: _mk_store(n, registry, meta, member_nodes=members)
@@ -182,7 +182,7 @@ class TestPlacement:
     async def test_leader_balancer_spreads_leadership(self):
         """A store leading every range hands one off to its least-loaded
         voter peer (RangeLeaderBalancer)."""
-        registry = ServiceRegistry()
+        registry = ServiceRegistry(local_bypass=False)  # real TCP
         meta = MetaService()
         members = ["l1", "l2", "l3"]
         servers = {n: _mk_store(n, registry, meta, member_nodes=members)

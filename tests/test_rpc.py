@@ -193,3 +193,74 @@ class TestTrafficGovernor:
         before = {f"k{i}": reg.pick("svc", f"k{i}") for i in range(50)}
         # re-picking is deterministic
         assert all(reg.pick("svc", k) == v for k, v in before.items())
+
+
+class TestInProcBypass:
+    async def test_bypass_skips_sockets_preserves_semantics(self):
+        """A call addressed to a server in THIS process short-circuits
+        (no connection), with wire-path error and order_key FIFO
+        semantics intact."""
+        import asyncio
+
+        from bifromq_tpu.rpc.fabric import RPCClient, RPCError, RPCServer
+
+        seen = []
+
+        async def echo(payload, okey):
+            await asyncio.sleep(0.01 if payload == b"slow" else 0)
+            seen.append(payload)
+            return b"<" + payload + b">"
+
+        async def boom(payload, okey):
+            raise ValueError("kaboom")
+
+        server = RPCServer(port=0)
+        server.register("svc", {"echo": echo, "boom": boom})
+        await server.start()
+        try:
+            client = RPCClient("127.0.0.1", server.port)
+            assert await client.call("svc", "echo", b"hi") == b"<hi>"
+            assert client._writer is None, "bypass must not open sockets"
+            with pytest.raises(RPCError):
+                await client.call("svc", "boom", b"")
+            # order_key FIFO: a slow first call still completes first
+            r = await asyncio.gather(
+                client.call("svc", "echo", b"slow", order_key="k"),
+                client.call("svc", "echo", b"fast", order_key="k"))
+            assert r == [b"<slow>", b"<fast>"]
+            assert seen[-2:] == [b"slow", b"fast"]
+            # opting out really dials TCP
+            direct = RPCClient("127.0.0.1", server.port,
+                               local_bypass=False)
+            assert await direct.call("svc", "echo", b"tcp") == b"<tcp>"
+            assert direct._writer is not None
+            await direct.close()
+        finally:
+            await server.stop()
+
+
+class TestTLSFabric:
+    async def test_rpc_over_tls(self, certs):
+        import ssl as _ssl
+
+        from bifromq_tpu.rpc.fabric import RPCClient, RPCServer
+
+        key, crt = certs
+        sctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+        sctx.load_cert_chain(crt, key)
+        server = RPCServer(port=0, ssl_context=sctx)
+
+        async def echo(payload, okey):
+            return b"tls:" + payload
+        server.register("svc", {"echo": echo})
+        await server.start()
+        try:
+            cctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_CLIENT)
+            cctx.check_hostname = False
+            cctx.verify_mode = _ssl.CERT_NONE
+            client = RPCClient("127.0.0.1", server.port,
+                               ssl_context=cctx, local_bypass=False)
+            assert await client.call("svc", "echo", b"x") == b"tls:x"
+            await client.close()
+        finally:
+            await server.stop()

@@ -108,3 +108,59 @@ class TestThreeProcess:
                 except Exception:
                     pass
             await registry.close()
+
+
+class TestInboxStoreProcess:
+    async def test_inbox_coproc_store_cluster(self):
+        """The standalone store process hosts the INBOX coproc too: a
+        3-process cluster applies attach/sub ops through consensus
+        (the reference's inbox-store as its own base-kv service)."""
+        import struct
+
+        from bifromq_tpu.inbox.coproc import (_OP_ATTACH, _OP_SUB,
+                                              _enc_lwt, _enc_opt,
+                                              _enc_str, _envelope)
+        from bifromq_tpu.types import QoS, TopicFilterOption
+
+        ports = _free_ports(3)
+        peers = ",".join(f"{n}=127.0.0.1:{p}"
+                         for n, p in zip(NODES, ports))
+        addrs = {n: f"127.0.0.1:{p}" for n, p in zip(NODES, ports)}
+        procs = {}
+        for n, p in zip(NODES, ports):
+            env = os.environ.copy()
+            env["JAX_PLATFORMS"] = "cpu"
+            pr = subprocess.Popen(
+                [sys.executable, "-m", "bifromq_tpu.kv.store_main",
+                 "--node", n, "--port", str(p), "--peers", peers,
+                 "--coproc", "inbox", "--tick-interval", "0.01"],
+                cwd=REPO, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True)
+            assert pr.stdout.readline().startswith("READY")
+            procs[n] = pr
+        registry = ServiceRegistry()
+        client = ClusterKVClient(MetaService(), registry,
+                                 seeds=list(addrs.values()))
+        try:
+            attach = _envelope(_OP_ATTACH, 1000.0, "T", "dev1")
+            attach += b"\x00" + struct.pack(">I", 3600)
+            attach += struct.pack(">H", 0) + _enc_lwt(None)
+            from bifromq_tpu.kv import schema
+            key = schema.inbox_prefix("T", "dev1")
+            out = await client.mutate(key, bytes(attach))
+            assert out in (b"\x00", b"\x01"), out
+            sub = _envelope(_OP_SUB, 1001.0, "T", "dev1")
+            sub += _enc_str("a/+")
+            sub += _enc_opt(TopicFilterOption(qos=QoS.AT_LEAST_ONCE))
+            sub += struct.pack(">I", 10)
+            out = await client.mutate(key, bytes(sub))
+            assert out[2:4] == b"ok", out
+        finally:
+            for p in procs.values():
+                p.kill()
+            for p in procs.values():
+                try:
+                    p.wait(timeout=5)
+                except Exception:
+                    pass
+            await registry.close()

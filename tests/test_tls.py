@@ -2,7 +2,6 @@
 
 import asyncio
 import ssl
-import subprocess
 
 import pytest
 
@@ -10,17 +9,6 @@ from bifromq_tpu.mqtt.broker import MQTTBroker
 from bifromq_tpu.mqtt.client import MQTTClient
 
 pytestmark = pytest.mark.asyncio
-
-
-@pytest.fixture(scope="module")
-def certs(tmp_path_factory):
-    d = tmp_path_factory.mktemp("certs")
-    key, crt = str(d / "k.pem"), str(d / "c.pem")
-    subprocess.run(
-        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
-         "-keyout", key, "-out", crt, "-days", "1",
-         "-subj", "/CN=localhost"], check=True, capture_output=True)
-    return key, crt
 
 
 class TestTLS:

@@ -94,7 +94,7 @@ async def _wait_leader(servers, range_id="r0", timeout=5.0):
 
 class TestWireCluster:
     async def test_replicate_failover_catchup(self):
-        registry = ServiceRegistry()
+        registry = ServiceRegistry(local_bypass=False)  # real TCP
         meta = MetaService()
         servers = {}
         for n in NODES:
@@ -157,7 +157,7 @@ class TestWireCluster:
         """Non-linearized queries rendezvous-spread across ALL replicas
         (≈ BatchDistServerCall.replicaSelect): followers serve local
         reads; results match the replicated state."""
-        registry = ServiceRegistry()
+        registry = ServiceRegistry(local_bypass=False)  # real TCP
         meta = MetaService()
         servers = {}
         for n in NODES:
@@ -207,7 +207,7 @@ class TestWireCluster:
         leader forwarding)."""
         from bifromq_tpu.rpc.fabric import _len16
 
-        registry = ServiceRegistry()
+        registry = ServiceRegistry(local_bypass=False)  # real TCP
         meta = MetaService()
         servers = {}
         for n in NODES:
