@@ -1,0 +1,165 @@
+"""Cross-broker delivery plane (≈ bifromq-deliverer + mqtt-broker-client).
+
+In the reference, the dist-worker's fan-out reaches SESSIONS ON OTHER MQTT
+SERVERS through the sub-broker RPC (IMqttBrokerClient deliver() targeting
+the server that owns the deliverer key). Here every broker node exposes a
+``mqtt-deliverer:{server_id}`` RPC service; ``DistService._fan_out``
+routes each delivery group by its deliverer-key server prefix — local
+groups hit the in-process sub-brokers, foreign ones make one RPC hop to
+the owning broker, whose local sub-brokers finish the delivery.
+
+Wire format (big-endian): see ``encode_deliver`` — one frame carries
+(tenant, broker_id, deliverer_key, TopicMessagePack, match infos); the
+reply is one DeliveryResult byte per match info, index-aligned.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Sequence, Tuple
+
+from ..kv import schema
+from ..plugin.subbroker import DeliveryPack, DeliveryResult
+from ..rpc.fabric import RPCServer, _len16, _read16
+from ..types import (ClientInfo, MatchInfo, PublisherMessagePack,
+                     RouteMatcher, TopicMessagePack)
+
+SERVICE_PREFIX = "mqtt-deliverer"
+
+_RESULTS = [DeliveryResult.OK, DeliveryResult.NO_SUB,
+            DeliveryResult.NO_RECEIVER, DeliveryResult.ERROR]
+_RESULT_CODE = {r: i for i, r in enumerate(_RESULTS)}
+
+
+def server_of(deliverer_key: str) -> str:
+    """The owning server id of a ``{server_id}|...`` deliverer key."""
+    sid, sep, _ = deliverer_key.partition("|")
+    return sid if sep else ""
+
+
+def _enc_client(c: ClientInfo) -> bytes:
+    out = _len16(c.tenant_id.encode()) + _len16(c.type.encode())
+    out += struct.pack(">H", len(c.metadata))
+    for k, v in c.metadata:
+        out += _len16(k.encode()) + _len16(v.encode())
+    return out
+
+
+def _dec_client(buf: bytes, pos: int) -> Tuple[ClientInfo, int]:
+    tenant_b, pos = _read16(buf, pos)
+    type_b, pos = _read16(buf, pos)
+    (n,) = struct.unpack_from(">H", buf, pos)
+    pos += 2
+    meta = []
+    for _ in range(n):
+        k, pos = _read16(buf, pos)
+        v, pos = _read16(buf, pos)
+        meta.append((k.decode(), v.decode()))
+    return ClientInfo(tenant_id=tenant_b.decode(), type=type_b.decode(),
+                      metadata=tuple(meta)), pos
+
+
+def encode_deliver(tenant_id: str, broker_id: int, deliverer_key: str,
+                   pack: TopicMessagePack,
+                   match_infos: Sequence[MatchInfo]) -> bytes:
+    out = bytearray(_len16(tenant_id.encode()))
+    out += struct.pack(">I", broker_id)
+    out += _len16(deliverer_key.encode())
+    out += _len16(pack.topic.encode())
+    out += struct.pack(">H", len(pack.packs))
+    for pp in pack.packs:
+        out += _enc_client(pp.publisher)
+        out += struct.pack(">H", len(pp.messages))
+        for msg in pp.messages:
+            raw = schema.encode_message(msg)
+            # 32-bit frame: an encoded message (payload + headers +
+            # properties) can exceed 64KB
+            out += struct.pack(">I", len(raw)) + raw
+    out += struct.pack(">H", len(match_infos))
+    for mi in match_infos:
+        out += _len16(mi.matcher.mqtt_topic_filter.encode())
+        out += _len16(mi.receiver_id.encode())
+        out += struct.pack(">q", mi.incarnation)
+    return bytes(out)
+
+
+def decode_deliver(buf: bytes):
+    tenant_b, pos = _read16(buf, 0)
+    (broker_id,) = struct.unpack_from(">I", buf, pos)
+    pos += 4
+    dkey_b, pos = _read16(buf, pos)
+    topic_b, pos = _read16(buf, pos)
+    (np,) = struct.unpack_from(">H", buf, pos)
+    pos += 2
+    packs = []
+    for _ in range(np):
+        publisher, pos = _dec_client(buf, pos)
+        (nm,) = struct.unpack_from(">H", buf, pos)
+        pos += 2
+        msgs = []
+        for _ in range(nm):
+            (rlen,) = struct.unpack_from(">I", buf, pos)
+            pos += 4
+            raw = buf[pos:pos + rlen]
+            pos += rlen
+            msgs.append(schema.decode_message(raw))
+        packs.append(PublisherMessagePack(publisher=publisher,
+                                          messages=tuple(msgs)))
+    (nmi,) = struct.unpack_from(">H", buf, pos)
+    pos += 2
+    mis = []
+    for _ in range(nmi):
+        tf, pos = _read16(buf, pos)
+        recv, pos = _read16(buf, pos)
+        (inc,) = struct.unpack_from(">q", buf, pos)
+        pos += 8
+        mis.append(MatchInfo(
+            matcher=RouteMatcher.from_topic_filter(tf.decode()),
+            receiver_id=recv.decode(), incarnation=inc))
+    pack = TopicMessagePack(topic=topic_b.decode(), packs=tuple(packs))
+    return tenant_b.decode(), broker_id, dkey_b.decode(), pack, mis
+
+
+class DelivererRPCService:
+    """Server side: delivers into THIS broker's local sub-brokers."""
+
+    def __init__(self, sub_brokers, server_id: str) -> None:
+        self.sub_brokers = sub_brokers
+        self.service = f"{SERVICE_PREFIX}:{server_id}"
+
+    def register(self, server: RPCServer) -> None:
+        server.register(self.service, {"deliver": self._on_deliver})
+
+    async def _on_deliver(self, payload: bytes, _okey: str) -> bytes:
+        tenant_id, broker_id, dkey, pack, mis = decode_deliver(payload)
+        if not self.sub_brokers.has(broker_id):
+            return bytes([_RESULT_CODE[DeliveryResult.NO_RECEIVER]] *
+                         len(mis))
+        broker = self.sub_brokers.get(broker_id)
+        dp = DeliveryPack(message_pack=pack, match_infos=tuple(mis))
+        res = await broker.deliver(tenant_id, dkey, [dp])
+        return bytes(_RESULT_CODE[res.get(mi, DeliveryResult.ERROR)]
+                     for mi in mis)
+
+
+async def remote_deliver(registry, server_id: str, tenant_id: str,
+                         broker_id: int, deliverer_key: str,
+                         pack: TopicMessagePack,
+                         match_infos: Sequence[MatchInfo]
+                         ) -> Dict[MatchInfo, DeliveryResult]:
+    """Client side: one RPC hop to the owning broker node."""
+    service = f"{SERVICE_PREFIX}:{server_id}"
+    eps = registry.endpoints(service)
+    if not eps:
+        # owner endpoint not (yet) known — a gossip propagation window or
+        # a down node. That is a TRANSPORT failure, never evidence the
+        # subscription is dead: raising makes _fan_out report
+        # DELIVER_ERROR and SKIP route cleanup (reaping a live route here
+        # would silently unsubscribe a healthy remote client)
+        raise ConnectionError(f"no endpoint for {service}")
+    payload = encode_deliver(tenant_id, broker_id, deliverer_key, pack,
+                             match_infos)
+    out = await registry.client_for(eps[0]).call(service, "deliver",
+                                                 payload)
+    return {mi: _RESULTS[out[i]] if i < len(out) else DeliveryResult.ERROR
+            for i, mi in enumerate(match_infos)}

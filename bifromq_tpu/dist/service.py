@@ -65,6 +65,11 @@ class DistService:
             from .worker import DistWorker
             worker = DistWorker()
         self.worker = worker
+        # cross-broker delivery plane (clustered frontends): set by the
+        # starter — registry resolving mqtt-deliverer:{server_id} + this
+        # node's own server id (local keys skip the hop)
+        self.deliverer_registry = None
+        self.server_id = ""
         self._rng = random.Random(rng_seed)
         self._pub_scheduler: BatchCallScheduler[PubCall, PubResult] = \
             BatchCallScheduler(lambda tenant: self._make_pub_batch(tenant),
@@ -227,19 +232,40 @@ class DistService:
                                         messages=(call.message,)),))
         fanout = 0
         for (broker_id, dkey), routes in by_deliverer.items():
-            if not self.sub_brokers.has(broker_id):
-                continue
-            broker = self.sub_brokers.get(broker_id)
             match_infos = tuple(
                 MatchInfo(matcher=r.matcher, receiver_id=r.receiver_id,
                           incarnation=r.incarnation) for r in routes)
-            dp = DeliveryPack(message_pack=pack, match_infos=match_infos)
-            try:
-                res = await broker.deliver(tenant_id, dkey, [dp])
-            except Exception as e:  # noqa: BLE001
-                self.events.report(Event(EventType.DELIVER_ERROR, tenant_id,
-                                         {"error": repr(e)}))
+            # cross-broker delivery (≈ mqtt-broker-client deliver RPC):
+            # a deliverer key owned by ANOTHER server makes one RPC hop
+            # to that broker node, whose local sub-brokers finish it
+            owner = None
+            if self.deliverer_registry is not None and self.server_id:
+                from .deliverer import server_of
+                owner = server_of(dkey)
+            if owner and owner != self.server_id:
+                from .deliverer import remote_deliver
+                try:
+                    res = await remote_deliver(
+                        self.deliverer_registry, owner, tenant_id,
+                        broker_id, dkey, pack, match_infos)
+                except Exception as e:  # noqa: BLE001
+                    self.events.report(Event(EventType.DELIVER_ERROR,
+                                             tenant_id,
+                                             {"error": repr(e)}))
+                    continue
+            elif not self.sub_brokers.has(broker_id):
                 continue
+            else:
+                broker = self.sub_brokers.get(broker_id)
+                dp = DeliveryPack(message_pack=pack,
+                                  match_infos=match_infos)
+                try:
+                    res = await broker.deliver(tenant_id, dkey, [dp])
+                except Exception as e:  # noqa: BLE001
+                    self.events.report(Event(EventType.DELIVER_ERROR,
+                                             tenant_id,
+                                             {"error": repr(e)}))
+                    continue
             for route, mi in zip(routes, match_infos):
                 outcome = res.get(mi, DeliveryResult.ERROR)
                 if outcome == DeliveryResult.OK:

@@ -68,6 +68,7 @@ class InboxService:
                  node_id: str = "local", voters=None, transport=None,
                  raft_store_factory=None, tick_interval: float = 0.01,
                  split_threshold: Optional[int] = None,
+                 server_id: str = "",
                  clock=time.time) -> None:
         from ..kv.store import KVRangeStore
         from ..raft.transport import InMemTransport
@@ -76,6 +77,7 @@ class InboxService:
         self.dist = dist
         self.events = events
         self.settings = settings
+        self.server_id = server_id
         self.clock = clock
         self.tick_interval = tick_interval
         engine = engine or InMemKVEngine()
@@ -238,9 +240,15 @@ class InboxService:
                               incarnation=opt.incarnation)
     # ---------------- subscriptions ----------------------------------------
 
-    @staticmethod
-    def _deliverer_key(inbox_id: str) -> str:
-        return f"i{hash(inbox_id) % 16}"
+    def _deliverer_key(self, inbox_id: str) -> str:
+        # server-id prefix: in clustered topologies the cross-broker
+        # deliverer routes a pack to the node whose inbox STORE holds
+        # this inbox (without it, a publish on another frontend would
+        # persist the message into the publisher node's store — lost to
+        # the subscriber's fetch loop). Persistent routes are NOT
+        # touched by the transient startup purge (different broker_id).
+        return f"{self.server_id}|i{hash(inbox_id) % 16}" \
+            if self.server_id else f"i{hash(inbox_id) % 16}"
 
     async def sub(self, tenant_id: str, inbox_id: str, topic_filter: str,
                   opt: TopicFilterOption) -> str:

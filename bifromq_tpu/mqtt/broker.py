@@ -535,7 +535,8 @@ class MQTTBroker:
         self.retain_service = retain_service
         from ..inbox.service import InboxService, InboxSubBroker
         self.inbox = InboxService(self.dist, self.events, self.settings,
-                                  engine=inbox_engine)
+                                  engine=inbox_engine,
+                                  server_id=self.server_id)
         self.sub_brokers.register(InboxSubBroker(self.inbox))
         self._server: Optional[asyncio.AbstractServer] = None
         self._tls_server: Optional[asyncio.AbstractServer] = None

@@ -19,6 +19,13 @@ Config shape (all keys optional):
       node_id: node1
       port: 7946
       seeds: ["10.0.0.1:7946"]
+    dist:
+      mode: local | worker | remote      # clustered dist-plane role:
+        # local  = in-process worker (default; standalone)
+        # worker = host the route table here AND serve it on the RPC
+        #          fabric (announced over gossip, ≈ a dist-worker node)
+        # remote = frontend-only: the dist plane lives on worker nodes
+        #          discovered via gossip (≈ mqtt-frontend role)
 """
 
 from __future__ import annotations
@@ -69,8 +76,10 @@ class Standalone:
             engine = NativeKVEngine(cfg["data_dir"])
 
         cluster_cfg = cfg.get("cluster")
+        registry = None
         if cluster_cfg:
             from .cluster.membership import AgentHost
+            from .rpc.fabric import ServiceRegistry
             seeds = []
             for s in cluster_cfg.get("seeds", []):
                 h, p = str(s).rsplit(":", 1)
@@ -80,33 +89,82 @@ class Standalone:
                 host=host, port=int(cluster_cfg.get("port", 0)),
                 seeds=seeds)
             await self.agent_host.start()
+            registry = ServiceRegistry(agent_host=self.agent_host)
+
+        # dist-plane role (clustered deployments): a "remote" frontend's
+        # route table lives on "worker" nodes discovered over gossip —
+        # the reference's mqtt-frontend → dist-worker split in YAML
+        dist_cfg = cfg.get("dist", {})
+        dist_mode = dist_cfg.get("mode", "local")
+        if dist_mode not in ("local", "worker", "remote"):
+            raise ValueError(f"unknown dist.mode {dist_mode!r} "
+                             "(local | worker | remote)")
+        if dist_mode in ("worker", "remote") and registry is None:
+            # silently degrading to local would strand every remote
+            # frontend with 'no endpoints for dist-worker'
+            raise ValueError(f"dist.mode={dist_mode} requires a cluster "
+                             "section (discovery rides gossip)")
+        dist = None
+        if dist_mode == "remote":
+            from .dist.remote import RemoteDistWorker
+            from .dist.service import DistService
+            from .plugin.events import CollectingEventCollector
+            from .plugin.settings import DefaultSettingProvider
+            from .plugin.subbroker import SubBrokerRegistry
+            sub_brokers = SubBrokerRegistry()
+            dist = DistService(sub_brokers, CollectingEventCollector(),
+                               DefaultSettingProvider(),
+                               worker=RemoteDistWorker(registry))
 
         tcp = mqtt_cfg.get("tcp", {"port": 1883})
         tls = mqtt_cfg.get("tls")
         ws = mqtt_cfg.get("ws")
         self.broker = MQTTBroker(
             host=host, port=int(tcp.get("port", 1883)),
-            inbox_engine=engine,
+            inbox_engine=engine, dist=dist,
             tls_port=(int(tls.get("port", 8883)) if tls else None),
             tls_ssl_context=(_tls_context(tls) if tls else None),
             ws_port=(int(ws["port"]) if ws else None),
             ws_path=(ws.get("path", "/mqtt") if ws else "/mqtt"),
             proxy_protocol=bool(tcp.get("proxy_protocol", False)))
+        if dist is not None:
+            # the remote dist plane delivers into THIS broker's sub-brokers
+            dist.sub_brokers = self.broker.sub_brokers
+            dist.events = self.broker.events
+            dist.settings = self.broker.settings
         await self.broker.start()
 
         if self.agent_host is not None:
             # clustered: expose the session-dict service on the RPC fabric
             # and discover peers over gossip, so (tenant, client) stays
             # single-owner cluster-wide
-            from .rpc.fabric import RPCServer, ServiceRegistry
+            from .rpc.fabric import RPCServer
             from .sessiondict import (SessionDictClient,
                                       SessionDictRPCService)
             from .sessiondict.service import SERVICE as _SD
             self.rpc_server = RPCServer(host=host)
             SessionDictRPCService(self.broker).register(self.rpc_server)
+            if dist_mode == "worker":
+                # serve THIS node's route table to remote frontends
+                from .dist.remote import DistWorkerRPCService
+                DistWorkerRPCService(self.broker.dist.worker).register(
+                    self.rpc_server)
+            # cross-broker delivery: every clustered broker serves its
+            # local sessions to the fleet (≈ mqtt-broker-client deliver)
+            from .dist.deliverer import SERVICE_PREFIX as _DP
+            from .dist.deliverer import DelivererRPCService
+            DelivererRPCService(self.broker.sub_brokers,
+                                self.broker.server_id).register(
+                self.rpc_server)
             await self.rpc_server.start()
-            registry = ServiceRegistry(agent_host=self.agent_host)
             registry.announce(_SD, self.rpc_server.address)
+            if dist_mode == "worker":
+                from .dist.remote import SERVICE as _DW
+                registry.announce(_DW, self.rpc_server.address)
+            registry.announce(f"{_DP}:{self.broker.server_id}",
+                              self.rpc_server.address)
+            self.broker.dist.deliverer_registry = registry
+            self.broker.dist.server_id = self.broker.server_id
             self.broker.session_dict = SessionDictClient(
                 registry, self_address=self.rpc_server.address)
 

@@ -266,3 +266,79 @@ class TestClusteredStarter:
         finally:
             await n2.stop()
             await n1.stop()
+
+
+class TestClusteredDistPlane:
+    async def test_frontends_share_worker_with_cross_broker_delivery(self):
+        """Full clustered topology from YAML alone: worker node W hosts
+        the route table; frontends A and B run dist.mode=remote; a
+        subscriber on A receives a publish made on B — match on W,
+        delivery via the cross-broker deliverer RPC hop to A
+        (≈ mqtt-frontend -> dist-worker -> mqtt-broker-client deliver)."""
+        from bifromq_tpu.starter import Standalone
+
+        w = Standalone({"mqtt": {"host": "127.0.0.1", "tcp": {"port": 0}},
+                        "dist": {"mode": "worker"},
+                        "cluster": {"node_id": "w", "port": 0}})
+        await w.start()
+        seeds = [f"127.0.0.1:{w.agent_host.port}"]
+        fa = Standalone({"mqtt": {"host": "127.0.0.1", "tcp": {"port": 0}},
+                         "dist": {"mode": "remote"},
+                         "cluster": {"node_id": "fa", "port": 0,
+                                     "seeds": seeds}})
+        fb = Standalone({"mqtt": {"host": "127.0.0.1", "tcp": {"port": 0}},
+                         "dist": {"mode": "remote"},
+                         "cluster": {"node_id": "fb", "port": 0,
+                                     "seeds": seeds}})
+        await fa.start()
+        await fb.start()
+        try:
+            # wait for gossip: frontends must see the worker AND each
+            # other's deliverer endpoints
+            from bifromq_tpu.dist.deliverer import SERVICE_PREFIX
+            from bifromq_tpu.dist.remote import SERVICE as DW
+
+            def ready():
+                reg_a = fa.broker.dist.deliverer_registry
+                reg_b = fb.broker.dist.deliverer_registry
+                return (reg_a.endpoints(DW) and reg_b.endpoints(DW)
+                        and reg_b.endpoints(
+                            f"{SERVICE_PREFIX}:"
+                            f"{fa.broker.server_id}"))
+            for _ in range(400):
+                if ready():
+                    break
+                await asyncio.sleep(0.02)
+            assert ready()
+
+            sub = MQTTClient("127.0.0.1", fa.broker.port, client_id="xa")
+            await sub.connect()
+            await sub.subscribe("xnode/+", qos=1)
+            pub = MQTTClient("127.0.0.1", fb.broker.port, client_id="xb")
+            await pub.connect()
+            await pub.publish("xnode/t", b"crossed-brokers", qos=1)
+            msg = await asyncio.wait_for(sub.messages.get(), 10)
+            assert msg.payload == b"crossed-brokers"
+            await sub.disconnect()
+
+            # persistent session on A: a publish on B must persist into
+            # A's inbox STORE (server-prefixed inbox deliverer key) and
+            # reach the session when it reconnects to A
+            ps = MQTTClient("127.0.0.1", fa.broker.port, client_id="px",
+                            clean_start=False)
+            await ps.connect()
+            await ps.subscribe("xinbox/+", qos=1)
+            await ps.disconnect()
+            await pub.publish("xinbox/t", b"stored-on-A", qos=1)
+            await asyncio.sleep(0.3)
+            ps2 = MQTTClient("127.0.0.1", fa.broker.port, client_id="px",
+                             clean_start=False)
+            await ps2.connect()
+            msg = await asyncio.wait_for(ps2.messages.get(), 10)
+            assert msg.payload == b"stored-on-A"
+            await ps2.disconnect()
+            await pub.disconnect()
+        finally:
+            await fb.stop()
+            await fa.stop()
+            await w.stop()

@@ -231,3 +231,50 @@ class TestWireCluster:
                     await srv.stop()
                 except Exception:
                     pass
+
+
+class TestLandscapeOverGossip:
+    async def test_landscape_replicates_via_crdt_anti_entropy(self):
+        """The FULL control-plane layering of the reference: store
+        descriptors ride the CRDT landscape (MetaService), whose deltas
+        anti-entropy over the gossip hosts' UDP payload channel — a
+        client on host B routes to a store announced on host A with no
+        static seeds."""
+        from bifromq_tpu.cluster.membership import AgentHost
+        from bifromq_tpu.crdt.store import (AgentMessenger, AntiEntropy,
+                                            CRDTStore)
+
+        ga = AgentHost("ha")
+        await ga.start()
+        gb = AgentHost("hb", seeds=[("127.0.0.1", ga.port)])
+        await gb.start()
+        ca = CRDTStore("ha", AgentMessenger(ga))
+        cb = CRDTStore("hb", AgentMessenger(gb))
+        aea = AntiEntropy(ca, interval=0.02)
+        aeb = AntiEntropy(cb, interval=0.02)
+        await aea.start()
+        await aeb.start()
+        registry = ServiceRegistry(local_bypass=False)
+        meta_a = MetaService(crdt_store=ca)
+        meta_b = MetaService(crdt_store=cb)
+        srv, _ = _mk_store("s1", registry, meta_a)
+        # sole voter for this deployment shape
+        srv.store.ranges["r0"].raft.recover(["s1:r0"])
+        await srv.start()
+        try:
+            client = ClusterKVClient(meta_b, registry)   # host B's view
+            deadline = asyncio.get_running_loop().time() + 8
+            while asyncio.get_running_loop().time() < deadline:
+                client.refresh()
+                if client.find(b"g") is not None:
+                    break
+                await asyncio.sleep(0.05)
+            assert client.find(b"g") is not None, "landscape never arrived"
+            assert await client.mutate(b"g", b"g=via-gossip") == b"ok:g"
+            assert await client.query(b"g", b"g") == b"via-gossip"
+        finally:
+            await srv.stop()
+            await aea.stop()
+            await aeb.stop()
+            await ga.stop()
+            await gb.stop()
