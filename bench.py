@@ -302,6 +302,67 @@ def bench_config5():
         tries, probe, name=f"c5_multitenant_{MT_TENANTS}x{MT_SUBS}")
 
 
+def bench_broker():
+    """End-to-end MQTT broker throughput over loopback TCP: QoS0/QoS1
+    publish → dist match (device matcher) → local fan-out → delivery.
+    The BROKER-plane number (supplement to the match-kernel configs);
+    enable with "b" in BENCH_CONFIGS."""
+    import asyncio
+
+    from bifromq_tpu.mqtt.broker import MQTTBroker
+    from bifromq_tpu.mqtt.client import MQTTClient
+
+    n_subs = int(os.environ.get("BENCH_BROKER_SUBS", "20"))
+    n_msgs = int(os.environ.get("BENCH_BROKER_MSGS", "2000"))
+
+    async def run():
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        await broker.start()
+        subs = []
+        for i in range(n_subs):
+            c = MQTTClient("127.0.0.1", broker.port, client_id=f"bs{i}")
+            await c.connect()
+            await c.subscribe(f"bench/{i}/t", qos=0)
+            subs.append(c)
+        pub = MQTTClient("127.0.0.1", broker.port, client_id="bp")
+        await pub.connect()
+        # QoS0 ingest: fire n_msgs, one matching subscriber each
+        t0 = time.perf_counter()
+        for i in range(n_msgs):
+            await pub.publish(f"bench/{i % n_subs}/t", b"x", qos=0)
+        # barrier: all deliveries drained
+        got = 0
+        deadline = asyncio.get_event_loop().time() + 30
+        while got < n_msgs and asyncio.get_event_loop().time() < deadline:
+            pending = sum(s.messages.qsize() for s in subs)
+            if pending >= n_msgs:
+                got = pending
+                break
+            await asyncio.sleep(0.01)
+        qos0_dt = time.perf_counter() - t0
+        delivered = sum(s.messages.qsize() for s in subs)
+        # QoS1 round-trips (ack-gated, serial per publisher)
+        t0 = time.perf_counter()
+        for i in range(min(n_msgs, 500)):
+            await pub.publish(f"bench/{i % n_subs}/t", b"x", qos=1)
+        qos1_dt = time.perf_counter() - t0
+        for c in subs + [pub]:
+            await c.disconnect()
+        await broker.stop()
+        return {
+            # honest rate: only messages that actually ARRIVED count
+            "qos0_pub_to_deliver_msgs_per_s": round(delivered / qos0_dt, 1),
+            "qos0_delivered": delivered,
+            "qos0_published": n_msgs,
+            "qos1_acked_pubs_per_s": round(min(n_msgs, 500) / qos1_dt, 1),
+            "subscribers": n_subs,
+        }
+
+    out = asyncio.run(run())
+    log(f"[broker_e2e] {json.dumps(out)}")
+    return out
+
+
 def main():
     import jax
     log(f"devices: {jax.devices()}")
@@ -318,6 +379,8 @@ def main():
         results["c4"] = bench_config4()
     if "5" in CONFIGS:
         results["c5"] = bench_config5()
+    if "b" in CONFIGS:
+        results["broker"] = bench_broker()
 
     log(f"extras: {json.dumps(results)}")
     metric = f"device_match_throughput@{N_SUBS}_wildcard_subs"

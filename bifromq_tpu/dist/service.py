@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import hashlib
 import random
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -71,6 +72,9 @@ class DistService:
         self.deliverer_registry = None
         self.server_id = ""
         self._rng = random.Random(rng_seed)
+        # (tenant, topic) -> (tenant epoch, expiry, MatchedRoutes)
+        self._match_cache: Dict[Tuple[str, str], Tuple] = {}
+        self._tenant_epoch: Dict[str, int] = {}
         self._pub_scheduler: BatchCallScheduler[PubCall, PubResult] = \
             BatchCallScheduler(lambda tenant: self._make_pub_batch(tenant),
                                max_burst_latency=max_burst_latency)
@@ -134,6 +138,7 @@ class DistService:
                 if not ok:
                     await self.worker.remove_route(
                         tenant_id, r.matcher, r.receiver_url, r.incarnation)
+                    self._invalidate_tenant(tenant_id)
                     removed += 1
         return removed
 
@@ -153,6 +158,8 @@ class DistService:
                                       matcher.mqtt_topic_filter}))
             raise
         ok = out in ("ok", "exists")
+        if ok:
+            self._invalidate_tenant(tenant_id)
         self.events.report(Event(
             EventType.MATCHED if ok else EventType.MATCH_ERROR, tenant_id,
             {"filter": matcher.mqtt_topic_filter}
@@ -172,6 +179,8 @@ class DistService:
                                       matcher.mqtt_topic_filter}))
             raise
         ok = out == "ok"
+        if ok:
+            self._invalidate_tenant(tenant_id)
         self.events.report(Event(
             EventType.UNMATCHED if ok else EventType.UNMATCH_ERROR,
             tenant_id, {"filter": matcher.mqtt_topic_filter}
@@ -185,20 +194,74 @@ class DistService:
         call = PubCall(publisher=publisher, topic=topic, message=message)
         return await self._pub_scheduler.submit(publisher.tenant_id, call)
 
+    # pub-side match cache (≈ SubscriptionCache/TenantRouteCache.java:65:
+    # matched routes per (tenant, topic), invalidated by local route
+    # mutations via a per-tenant epoch; the TTL bounds staleness from
+    # mutations made on OTHER nodes, the reference's refresh window)
+    MATCH_CACHE_TTL = 1.0
+    MATCH_CACHE_MAX = 8192
+
+    def _cache_get(self, tenant_id: str, topic: str):
+        ent = self._match_cache.get((tenant_id, topic))
+        if ent is None:
+            return None
+        epoch, expires, m = ent
+        if (epoch != self._tenant_epoch.get(tenant_id, 0)
+                or expires < time.monotonic()):
+            del self._match_cache[(tenant_id, topic)]
+            return None
+        return m
+
+    def _cache_put(self, tenant_id: str, topic: str, m,
+                   epoch: int) -> None:
+        """``epoch`` MUST be snapshotted BEFORE the match query was
+        issued: a mutation landing during the awaited match would
+        otherwise have its invalidation erased by stamping the stale
+        result with the post-bump epoch."""
+        key = (tenant_id, topic)
+        if key not in self._match_cache \
+                and len(self._match_cache) >= self.MATCH_CACHE_MAX:
+            # bounded: drop the oldest inserted entry (dict is FIFO)
+            self._match_cache.pop(next(iter(self._match_cache)))
+        self._match_cache[key] = (
+            epoch, time.monotonic() + self.MATCH_CACHE_TTL, m)
+
+    def _invalidate_tenant(self, tenant_id: str) -> None:
+        self._tenant_epoch[tenant_id] = \
+            self._tenant_epoch.get(tenant_id, 0) + 1
+
     def _make_pub_batch(self, tenant_id: str):
         async def process(calls: Sequence[PubCall]) -> List[PubResult]:
             mpf = self.settings.provide(
                 Setting.MaxPersistentFanout, tenant_id)
             mgf = self.settings.provide(Setting.MaxGroupFanout, tenant_id)
-            queries = [(tenant_id, topic_util.parse(c.topic)) for c in calls]
-            matched = await self.worker.match_batch(
-                queries,
-                max_persistent_fanout=(
-                    mpf if mpf is not None
-                    else Setting.MaxPersistentFanout.default),
-                max_group_fanout=(
-                    mgf if mgf is not None
-                    else Setting.MaxGroupFanout.default))
+            matched: List[Optional[MatchedRoutes]] = []
+            miss_topics: List[str] = []     # deduped (hot-topic bursts
+            miss_pos: Dict[str, int] = {}   # must not fan into N queries)
+            for qi, c in enumerate(calls):
+                m = self._cache_get(tenant_id, c.topic)
+                matched.append(m)
+                if m is None and c.topic not in miss_pos:
+                    miss_pos[c.topic] = len(miss_topics)
+                    miss_topics.append(c.topic)
+            if miss_topics:
+                # snapshot BEFORE the (awaited) match: a mutation landing
+                # mid-flight must make the stored entry instantly stale
+                epoch = self._tenant_epoch.get(tenant_id, 0)
+                fresh = await self.worker.match_batch(
+                    [(tenant_id, topic_util.parse(t))
+                     for t in miss_topics],
+                    max_persistent_fanout=(
+                        mpf if mpf is not None
+                        else Setting.MaxPersistentFanout.default),
+                    max_group_fanout=(
+                        mgf if mgf is not None
+                        else Setting.MaxGroupFanout.default))
+                for t, m in zip(miss_topics, fresh):
+                    self._cache_put(tenant_id, t, m, epoch)
+                for qi, c in enumerate(calls):
+                    if matched[qi] is None:
+                        matched[qi] = fresh[miss_pos[c.topic]]
             results: List[PubResult] = []
             for call, m in zip(calls, matched):
                 fanout = await self._fan_out(tenant_id, call, m)
@@ -276,6 +339,7 @@ class DistService:
                     await self.worker.remove_route(
                         tenant_id, route.matcher, route.receiver_url,
                         route.incarnation)
+                    self._invalidate_tenant(tenant_id)
         return fanout
 
     def _elect(self, mqtt_filter: str, members: List[Route],

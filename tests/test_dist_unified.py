@@ -143,3 +143,49 @@ class TestBrokerOnReplicatedRoutes:
             assert len(list(broker2.dist.worker.space.iterate())) == 0
         finally:
             await broker2.stop()
+
+
+class TestMatchCache:
+    async def test_pub_match_cache_hits_and_invalidates(self):
+        """≈ SubscriptionCache/TenantRouteCache: repeated publishes to one
+        topic match once; a local subscribe/unsubscribe invalidates
+        instantly (epoch), so delivery correctness never lags the cache."""
+        import asyncio
+
+        from bifromq_tpu.mqtt.broker import MQTTBroker
+        from bifromq_tpu.mqtt.client import MQTTClient
+
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        await broker.start()
+        try:
+            s1 = MQTTClient("127.0.0.1", broker.port, client_id="mc1")
+            await s1.connect()
+            await s1.subscribe("mc/t", qos=0)
+            p = MQTTClient("127.0.0.1", broker.port, client_id="mcp")
+            await p.connect()
+            for _ in range(10):
+                await p.publish("mc/t", b"a", qos=1)
+            for _ in range(10):
+                await asyncio.wait_for(s1.messages.get(), 5)
+            assert len(broker.dist._match_cache) >= 1
+            # a NEW subscriber must see the very next publish (epoch
+            # invalidation beats the TTL)
+            s2 = MQTTClient("127.0.0.1", broker.port, client_id="mc2")
+            await s2.connect()
+            await s2.subscribe("mc/t", qos=0)
+            await p.publish("mc/t", b"b", qos=1)
+            m = await asyncio.wait_for(s2.messages.get(), 5)
+            assert m.payload == b"b"
+            # s1 was still subscribed: drain its copy of "b" too
+            m = await asyncio.wait_for(s1.messages.get(), 5)
+            assert m.payload == b"b"
+            # and an unsubscribe stops delivery on the very next publish
+            await s1.unsubscribe("mc/t")
+            await s2.unsubscribe("mc/t")
+            await p.publish("mc/t", b"c", qos=1)
+            await asyncio.sleep(0.3)
+            assert s1.messages.empty() and s2.messages.empty()
+            for c in (s1, s2, p):
+                await c.disconnect()
+        finally:
+            await broker.stop()
