@@ -351,10 +351,26 @@ class ClusterKVClient:
                     "start": bytes.fromhex(rd["start"]),
                     "end": (bytes.fromhex(rd["end"])
                             if rd["end"] is not None else None),
+                    "boundary_from_leader": rd["is_leader"],
+                    "boundary_epoch": desc["epoch"],
                     "leader": None, "leader_epoch": -1, "stores": {}})
                 rec["stores"][sid] = desc["address"]
-                # freshest claim wins: a dead store's stale is_leader flag
-                # must not shadow the survivor's newer election result
+                # boundary: trust the LEADER's descriptor — a range's
+                # leader has always applied its latest split/merge (they
+                # commit through its own log), while a lagging follower
+                # republishing for unrelated reasons can carry a stale
+                # wide boundary at a fresher store epoch. Follower
+                # boundaries are only a fallback while no leader claims.
+                if rd["is_leader"] and (
+                        not rec["boundary_from_leader"]
+                        or desc["epoch"] > rec["boundary_epoch"]):
+                    rec["start"] = bytes.fromhex(rd["start"])
+                    rec["end"] = (bytes.fromhex(rd["end"])
+                                  if rd["end"] is not None else None)
+                    rec["boundary_from_leader"] = True
+                    rec["boundary_epoch"] = desc["epoch"]
+                # freshest leader claim wins: a dead store's stale
+                # is_leader flag must not shadow a newer election result
                 if rd["is_leader"] and desc["epoch"] > rec["leader_epoch"]:
                     rec["leader"] = sid
                     rec["leader_epoch"] = desc["epoch"]
