@@ -54,6 +54,7 @@ class MQTTClient:
         self._read_task: Optional[asyncio.Task] = None
         self._pending: Dict[Tuple[str, int], asyncio.Future] = {}
         self._next_pid = 1
+        self._recv_alias = {}
         self.closed = asyncio.Event()
 
     # ---------------- lifecycle -------------------------------------------
@@ -220,7 +221,10 @@ class MQTTClient:
                     break
                 for p in self._decoder.feed(data):
                     await self._on_packet(p)
-        except (asyncio.CancelledError, ConnectionError, MalformedPacket):
+        except (asyncio.CancelledError, ConnectionError, MalformedPacket,
+                MQTTClientError):
+            # protocol violations (e.g. unresolvable alias) close the
+            # connection like a spec client's DISCONNECT(0x82) would
             pass
         finally:
             for fut in self._pending.values():
@@ -234,6 +238,23 @@ class MQTTClient:
             self._decoder.protocol_level = self.protocol_level
             self._resolve("connack", 0, p)
         elif isinstance(p, pk.Publish):
+            # inbound topic alias resolution (v5): an empty topic with an
+            # alias refers to the last full topic sent with that alias
+            alias = (p.properties or {}).get(PropertyId.TOPIC_ALIAS) \
+                if self.protocol_level >= PROTOCOL_MQTT5 else None
+            if alias is not None:
+                if p.topic:
+                    self._recv_alias[alias] = p.topic
+                else:
+                    known = self._recv_alias.get(alias)
+                    if known is None:
+                        # spec-compliant hard failure [MQTT-3.3.2-7]: the
+                        # conformance client must SURFACE broker aliasing
+                        # bugs, not swallow them as empty-topic messages
+                        raise MQTTClientError(
+                            f"unresolvable topic alias {alias}")
+                    from dataclasses import replace
+                    p = replace(p, topic=known)
             if p.qos == 1:
                 await self._send(pk.PubAck(packet_id=p.packet_id))
             elif p.qos == 2:

@@ -206,6 +206,14 @@ class Session:
         self._client_recv_max = int(
             self.connect_props.get(PropertyId.RECEIVE_MAXIMUM, 65535)
             if protocol_level >= PROTOCOL_MQTT5 else 65535)
+        # outbound topic aliasing (v5, ≈ SenderTopicAliasManager): the
+        # client's TopicAliasMaximum caps how many topics we may alias
+        # on the way OUT; repeated topics then ship a 2-byte alias
+        # instead of the full string
+        self._send_alias_max = int(
+            self.connect_props.get(PropertyId.TOPIC_ALIAS_MAXIMUM, 0)
+            if protocol_level >= PROTOCOL_MQTT5 else 0)
+        self._send_alias: Dict[str, int] = {}
 
     # ---------------- lifecycle -------------------------------------------
 
@@ -653,6 +661,24 @@ class Session:
                 await self._send_publish(pack.topic, msg, sub)
         return True
 
+    def _outbound_alias(self, topic: str):
+        """(topic-to-send, extra props): first use of a topic registers an
+        alias (full topic + alias property); later uses send the alias
+        with an EMPTY topic [MQTT-3.3.2-12]. No eviction — the alias
+        space is first-come (the reference's LRU matters only when
+        distinct topics exceed the client's cap; beyond it we simply
+        stop aliasing)."""
+        if not self._send_alias_max:
+            return topic, None
+        alias = self._send_alias.get(topic)
+        if alias is not None:
+            return "", {PropertyId.TOPIC_ALIAS: alias}
+        if len(self._send_alias) < self._send_alias_max:
+            alias = len(self._send_alias) + 1
+            self._send_alias[topic] = alias
+            return topic, {PropertyId.TOPIC_ALIAS: alias}
+        return topic, None
+
     # transient semantics: a full receive window DROPS QoS>0 messages;
     # persistent sessions override this to pause their fetch loop instead
     _drop_on_recv_max = True
@@ -673,10 +699,24 @@ class Session:
                 props[PropertyId.USER_PROPERTY] = list(msg.user_properties)
             if not props:
                 props = None
+
+        def aliased(base_props):
+            # resolved at SEND time only: a blocked publish must not
+            # consume an alias the client never learns. ``topic`` (the
+            # original) stays intact for event reporting.
+            wire_topic, alias_props = self._outbound_alias(topic)
+            if alias_props:
+                out = dict(base_props or {})
+                out.update(alias_props)
+                return wire_topic, out
+            return wire_topic, base_props
+
         if qos == 0:
-            await self.conn.send(pk.Publish(topic=topic, payload=msg.payload,
+            wire_topic, wprops = aliased(props)
+            await self.conn.send(pk.Publish(topic=wire_topic,
+                                            payload=msg.payload,
                                             qos=0, retain=retain_flag,
-                                            properties=props))
+                                            properties=wprops))
             self.events.report(Event(EventType.DELIVERED,
                                      self.client_info.tenant_id,
                                      {"topic": topic, "qos": 0}))
@@ -693,9 +733,10 @@ class Session:
                                          {"topic": topic,
                                           "reason": "recv_max"}))
             return BLOCKED
-        publish = pk.Publish(topic=topic, payload=msg.payload, qos=qos,
+        wire_topic, wprops = aliased(props)
+        publish = pk.Publish(topic=wire_topic, payload=msg.payload, qos=qos,
                              retain=retain_flag, packet_id=pid,
-                             properties=props)
+                             properties=wprops)
         self._outbound[pid] = _OutboundQoS(packet_id=pid, publish=publish,
                                            phase=1)
         await self.conn.send(publish)

@@ -267,3 +267,40 @@ class TestMultiListener:
             await ws_pub.disconnect()
         finally:
             await broker.stop()
+
+
+class TestOutboundTopicAlias:
+    async def test_broker_aliases_repeated_outbound_topics(self):
+        """MQTT5 sender-side aliasing (≈ SenderTopicAliasManager): once a
+        client announces TopicAliasMaximum, repeated broker->client
+        publishes of one topic ship an alias with an empty topic name."""
+        from bifromq_tpu.mqtt.protocol import PropertyId
+
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        await broker.start()
+        try:
+            sub = MQTTClient("127.0.0.1", broker.port, client_id="al1",
+                             protocol_level=5,
+                             properties={
+                                 PropertyId.TOPIC_ALIAS_MAXIMUM: 8})
+            await sub.connect()
+            await sub.subscribe("alias/t", qos=0)
+            p = MQTTClient("127.0.0.1", broker.port, client_id="al2")
+            await p.connect()
+            topics_on_wire = []
+            for i in range(3):
+                await p.publish("alias/t", b"m%d" % i, qos=1)
+                m = await asyncio.wait_for(sub.messages.get(), 5)
+                topics_on_wire.append(m.topic)
+                assert m.payload == b"m%d" % i
+            # the CLIENT sees the resolved topic every time (alias decode)
+            assert topics_on_wire == ["alias/t"] * 3
+            # and the session actually registered an outbound alias
+            session = next(
+                s for s in broker.local_sessions._by_id.values()
+                if s.client_id == "al1")
+            assert session._send_alias.get("alias/t") == 1
+            await sub.disconnect()
+            await p.disconnect()
+        finally:
+            await broker.stop()
