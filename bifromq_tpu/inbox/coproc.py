@@ -113,8 +113,59 @@ class InboxStoreCoProc(IKVRangeCoProc):
         self.store = InboxStore(reader, _MutedEvents(),
                                 clock=lambda: self._now)
 
+    # RO query ops (the inbox-store-as-a-service read side: a remote
+    # frontend reads metadata/queues over the store RPC instead of
+    # needing a local replica — ≈ InboxStoreCoProc's RO batchGet/batchFetch)
+    Q_EXISTS = 0
+    Q_META = 1
+    Q_FETCH = 2
+
     def query(self, input_data: bytes, reader: IKVSpace) -> bytes:
-        return b""  # reads go through the local store facade
+        from ..kv.range import BoundaryBounce
+
+        if not input_data:
+            return b""
+        store = self._ensure_store(reader)
+        op = input_data[0]
+        (self._now,) = struct.unpack_from(">d", input_data, 1)
+        pos = 9
+        tenant_b, pos = _read16(input_data, pos)
+        inbox_b, pos = _read16(input_data, pos)
+        tenant, inbox = tenant_b.decode(), inbox_b.decode()
+        group_key = schema.inbox_prefix(tenant, inbox)
+        if self.boundary is not None:
+            start, end = self.boundary
+            if group_key < start or (end is not None and group_key >= end):
+                # split/seal raced the caller's routing: a read of the
+                # emptied span must bounce, not report "no such inbox"
+                raise BoundaryBounce(f"{tenant}/{inbox}")
+        if op == self.Q_EXISTS:
+            return b"\x01" if store.exists(tenant, inbox) else b"\x00"
+        if op == self.Q_META:
+            from .store import _enc_meta
+            meta = store.get(tenant, inbox)
+            if meta is None:
+                return b"\x00"
+            return b"\x01" + _enc_meta(meta)
+        if op == self.Q_FETCH:
+            (max_fetch, q0a, bfa) = struct.unpack_from(">Iqq", input_data,
+                                                       pos)
+            raw = store.fetch_raw(
+                tenant, inbox, max_fetch=max_fetch,
+                qos0_after=None if q0a < 0 else q0a,
+                buffer_after=None if bfa < 0 else bfa)
+            if raw is None:         # no such inbox: empty result
+                return struct.pack(">II", 0, 0)
+            # stored records ship VERBATIM (len16 topic ‖ message bytes):
+            # zero per-message codec work on the serving side
+            out = bytearray()
+            for part in raw:
+                out += struct.pack(">I", len(part))
+                for seq, record in part:
+                    out += struct.pack(">Q", seq)
+                    out += struct.pack(">I", len(record)) + record
+            return bytes(out)
+        return b""
 
     def align_split_key(self, candidate: bytes) -> Optional[bytes]:
         """Snap a split-key hint onto the owning inbox's prefix start so a
@@ -459,3 +510,79 @@ class ShardedInboxStore(ReplicatedInboxStore):
             if _time.monotonic() >= deadline:
                 raise TimeoutError("inbox op kept racing splits")
             await asyncio.sleep(0)    # split raced: re-resolve the range
+
+
+# ---------------- remote read side (inbox-store-as-a-service) ---------------
+
+def enc_query(op: int, now: float, tenant: str, inbox: str,
+              *, max_fetch: int = 100, qos0_after=None,
+              buffer_after=None) -> bytes:
+    out = _envelope(op, now, tenant, inbox)
+    if op == InboxStoreCoProc.Q_FETCH:
+        out += struct.pack(
+            ">Iqq", max_fetch,
+            -1 if qos0_after is None else qos0_after,
+            -1 if buffer_after is None else buffer_after)
+    return bytes(out)
+
+
+def dec_fetched(buf: bytes):
+    from .store import Fetched
+    pos = 0
+    parts = []
+    for _ in range(2):
+        (n,) = struct.unpack_from(">I", buf, pos)
+        pos += 4
+        items = []
+        for _ in range(n):
+            (seq,) = struct.unpack_from(">Q", buf, pos)
+            pos += 8
+            (rlen,) = struct.unpack_from(">I", buf, pos)
+            pos += 4
+            record = buf[pos:pos + rlen]
+            pos += rlen
+            topic_b, tpos = _read16(record, 0)
+            items.append((seq, topic_b.decode(),
+                          schema.decode_message(record[tpos:])))
+        parts.append(items)
+    return Fetched(qos0=parts[0], buffer=parts[1])
+
+
+class RemoteInboxReader:
+    """Read a SHARED inbox store over the wire (ClusterKVClient routes by
+    inbox prefix to the store cluster hosting the keyspace) — the read
+    half of running inbox-store as its own base-kv service, so a
+    frontend needs NO local replica to serve fetch/exists."""
+
+    def __init__(self, client, *, clock=time.time) -> None:
+        self.client = client        # kv.meta.ClusterKVClient
+        self.clock = clock
+
+    @staticmethod
+    def _key(tenant: str, inbox: str) -> bytes:
+        return schema.inbox_prefix(tenant, inbox)
+
+    async def exists(self, tenant: str, inbox: str) -> bool:
+        out = await self.client.query(
+            self._key(tenant, inbox),
+            enc_query(InboxStoreCoProc.Q_EXISTS, self.clock(), tenant,
+                      inbox))
+        return out == b"\x01"
+
+    async def get(self, tenant: str, inbox: str):
+        from .store import _dec_meta
+        out = await self.client.query(
+            self._key(tenant, inbox),
+            enc_query(InboxStoreCoProc.Q_META, self.clock(), tenant,
+                      inbox))
+        if not out or out[0] == 0:
+            return None
+        return _dec_meta(inbox, out[1:])
+    async def fetch(self, tenant: str, inbox: str, *, max_fetch: int = 100,
+                    qos0_after=None, buffer_after=None):
+        out = await self.client.query(
+            self._key(tenant, inbox),
+            enc_query(InboxStoreCoProc.Q_FETCH, self.clock(), tenant,
+                      inbox, max_fetch=max_fetch, qos0_after=qos0_after,
+                      buffer_after=buffer_after))
+        return dec_fetched(out)

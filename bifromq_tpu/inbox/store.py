@@ -337,6 +337,36 @@ class InboxStore:
                         meta.buffer_start_seq,
                         max_fetch if max_buffer is None else max_buffer))
 
+    def fetch_raw(self, tenant_id: str, inbox_id: str, *,
+                  max_fetch: int = 100,
+                  qos0_after: Optional[int] = None,
+                  buffer_after: Optional[int] = None):
+        """fetch() without decoding: (seq, stored-record-bytes) pairs —
+        the wire-serving path copies stored bytes straight into the RPC
+        reply instead of decode+re-encode per message."""
+        meta = self._load(tenant_id, inbox_id)
+        if meta is None:
+            return None
+
+        def scan(key_fn, after, start_seq, cap):
+            if cap <= 0:
+                return []
+            from_seq = start_seq if after is None else max(after + 1,
+                                                           start_seq)
+            out = []
+            start = key_fn(tenant_id, inbox_id, from_seq)
+            end = key_fn(tenant_id, inbox_id, 2 ** 63 - 1)
+            for key, value in self.space.iterate(start, end):
+                if len(out) >= cap:
+                    break
+                out.append((schema.seq_of(key), value))
+            return out
+
+        return (scan(schema.inbox_qos0_key, qos0_after,
+                     meta.qos0_start_seq, max_fetch),
+                scan(schema.inbox_buffer_key, buffer_after,
+                     meta.buffer_start_seq, max_fetch))
+
     def commit(self, tenant_id: str, inbox_id: str, *,
                qos0_up_to: Optional[int] = None,
                buffer_up_to: Optional[int] = None) -> bool:

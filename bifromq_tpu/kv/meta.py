@@ -237,6 +237,8 @@ class BaseKVStoreServer:
         return bytes([_NOT_LEADER]) + _len16(hint.encode())
 
     async def _on_query(self, payload: bytes, _okey: str) -> bytes:
+        from .range import BoundaryBounce
+
         rid_b, pos = _read16(payload, 0)
         linearized = bool(payload[pos])
         r = self._range(rid_b.decode())
@@ -247,6 +249,8 @@ class BaseKVStoreServer:
                                        linearized=linearized)
         except NotLeaderError:
             return self._leader_hint(r)
+        except BoundaryBounce:      # split/merge raced: re-resolve
+            return bytes([_RETRY])
         return bytes([_OK]) + out
 
     async def _on_mutate(self, payload: bytes, okey: str) -> bytes:

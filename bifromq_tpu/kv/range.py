@@ -24,6 +24,13 @@ from ..raft.node import LogEntry, RaftNode
 from .engine import IKVSpace, KVWriteBatch
 
 
+class BoundaryBounce(Exception):
+    """Raised by a coproc QUERY whose key fell outside this range's
+    boundary (split/merge raced the caller's routing): the RPC facade
+    maps it to the RETRY status so the client re-resolves — the read-side
+    twin of the mutate path's ``b"retry"`` sentinel."""
+
+
 class IKVRangeCoProc:
     """Domain-logic plug point (≈ base-kv-store-coproc-api IKVRangeCoProc)."""
 

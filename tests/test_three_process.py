@@ -155,6 +155,28 @@ class TestInboxStoreProcess:
             sub += struct.pack(">I", 10)
             out = await client.mutate(key, bytes(sub))
             assert out[2:4] == b"ok", out
+            # the READ side over the wire (inbox-store-as-a-service): a
+            # frontend with NO local replica reads state from the cluster
+            from bifromq_tpu.inbox.coproc import RemoteInboxReader
+            from bifromq_tpu.types import Message
+            reader = RemoteInboxReader(client, clock=lambda: 1002.0)
+            assert await reader.exists("T", "dev1")
+            meta = await reader.get("T", "dev1")
+            assert meta is not None and "a/+" in meta.filters
+            # insert a message through consensus, fetch it over the wire
+            from bifromq_tpu.inbox.coproc import _OP_INSERT
+            ins = _envelope(_OP_INSERT, 1003.0, "T", "dev1")
+            ins += struct.pack(">I", 100) + b"\x00" + _enc_str("")
+            ins += b"\x00" * 8 + struct.pack(">H", 1)
+            msg = Message(message_id=9, pub_qos=QoS.AT_LEAST_ONCE,
+                          payload=b"wire-read", timestamp=9)
+            from bifromq_tpu.kv import schema as _schema
+            ins += _enc_str("a/b") + _enc_str("a/+")
+            ins += _len16(_schema.encode_message(msg))
+            out = await client.mutate(key, bytes(ins))
+            fetched = await reader.fetch("T", "dev1")
+            assert len(fetched.buffer) == 1
+            assert fetched.buffer[0][2].payload == b"wire-read"
         finally:
             for p in procs.values():
                 p.kill()
