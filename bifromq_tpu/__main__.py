@@ -1,13 +1,9 @@
 """``python -m bifromq_tpu --config conf.yml`` — standalone broker CLI."""
 
-import os
+from .utils.jaxenv import pin_jax_platform
 
-if os.environ.get("JAX_PLATFORMS"):
-    # config-level override beats a sitecustomize-registered platform plugin
-    import jax
+pin_jax_platform()
 
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
-from .starter import main
+from .starter import main  # noqa: E402
 
 main()

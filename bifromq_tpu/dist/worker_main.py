@@ -15,12 +15,9 @@ import asyncio
 import os
 import sys
 
-if os.environ.get("JAX_PLATFORMS"):
-    # the env var alone does not beat a sitecustomize-registered platform
-    # plugin; the config knob does (must run before first jax device use)
-    import jax
+from ..utils.jaxenv import pin_jax_platform
 
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+pin_jax_platform()
 
 
 async def serve(args) -> None:

@@ -18,7 +18,12 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import os
 import sys
+
+from ..utils.jaxenv import pin_jax_platform
+
+pin_jax_platform()
 
 
 def _coproc_factory(kind: str):

@@ -77,8 +77,47 @@ class RetainCoProc(IKVRangeCoProc):
             self.values.setdefault(tenant, {})[topic] = value
             self.index.add_topic(tenant, topic_util.parse(topic), topic)
 
+    # RO wildcard match over the wire (retain-store-as-a-service read
+    # side, ≈ RetainStoreCoProc's RO batchMatch): a replica-less frontend
+    # matches retained messages via the store RPC. Wire:
+    #   req  := 0x01 ‖ len16 tenant ‖ u32 limit ‖ len16 filter
+    #   resp := u32 n ‖ n × (len16 topic ‖ len32 stored-value)
+    Q_MATCH = 1
+
     def query(self, input_data: bytes, reader: IKVSpace) -> bytes:
-        return b""  # queries go through the local index/service
+        from ..kv.range import BoundaryBounce
+
+        if not input_data or input_data[0] != self.Q_MATCH:
+            return b""  # local reads go through the index/service
+        tenant_b, pos = _read16(input_data, 1)
+        (limit,) = struct.unpack_from(">I", input_data, pos)
+        pos += 4
+        filter_b, pos = _read16(input_data, pos)
+        tenant = tenant_b.decode()
+        if self.boundary is not None:
+            start, end = self.boundary
+            pfx = schema.retain_prefix(tenant)
+            if pfx < start or (end is not None and pfx >= end):
+                # split/seal raced the routing: bounce, never answer
+                # "no retained messages" from an emptied span
+                raise BoundaryBounce(tenant)
+        topics = self.index.match_batch(
+            [(tenant, topic_util.parse(filter_b.decode()))],
+            limit=limit)[0]
+        vals = self.values.get(tenant, {})
+        out = bytearray(struct.pack(">I", 0))
+        n = 0
+        for topic in topics:
+            raw = vals.get(topic)
+            if raw is None:
+                continue
+            out += _len16(topic.encode())
+            out += struct.pack(">I", len(raw)) + raw
+            n += 1
+            if n >= limit:
+                break
+        struct.pack_into(">I", out, 0, n)
+        return bytes(out)
 
     def mutate(self, input_data: bytes, reader: IKVSpace,
                writer: KVWriteBatch) -> bytes:
@@ -114,3 +153,50 @@ class RetainCoProc(IKVRangeCoProc):
 def enc_op(op: int, tenant: str, topic: str, value: bytes = b"") -> bytes:
     return (bytes([op]) + _len16(tenant.encode()) + _len16(topic.encode())
             + value)
+
+
+def enc_match_query(tenant_id: str, topic_filter: str,
+                    limit: int) -> bytes:
+    return (bytes([RetainCoProc.Q_MATCH]) + _len16(tenant_id.encode())
+            + struct.pack(">I", limit) + _len16(topic_filter.encode()))
+
+
+def dec_match_reply(buf: bytes):
+    """[(topic, expire_at, publisher, Message)] from a Q_MATCH reply."""
+    (n,) = struct.unpack_from(">I", buf, 0)
+    pos = 4
+    out = []
+    for _ in range(n):
+        topic_b, pos = _read16(buf, pos)
+        (rlen,) = struct.unpack_from(">I", buf, pos)
+        pos += 4
+        expire_at, publisher, msg = dec_retained(buf[pos:pos + rlen])
+        pos += rlen
+        out.append((topic_b.decode(), expire_at, publisher, msg))
+    return out
+
+
+class RemoteRetainReader:
+    """Match retained messages on a SHARED retain store over the wire
+    (routes by the tenant's retain prefix through ClusterKVClient) —
+    expired hits are filtered client-side like the local service does.
+
+    Routing targets the range covering the tenant's prefix START; a
+    tenant whose retain keyspace was split across ranges needs the
+    client to union over ``ClusterKVClient.ranges()`` (the local
+    RetainService does exactly that with its in-proc router)."""
+
+    def __init__(self, client, *, clock=None) -> None:
+        import time as _time
+        self.client = client        # kv.meta.ClusterKVClient
+        self.clock = clock or _time.time
+
+    async def match(self, tenant_id: str, topic_filter: str,
+                    limit: int = 100):
+        out = await self.client.query(
+            schema.retain_prefix(tenant_id),
+            enc_match_query(tenant_id, topic_filter, limit))
+        now = self.clock()
+        return [(topic, msg) for topic, expire_at, _pub, msg
+                in dec_match_reply(out)
+                if expire_at is None or expire_at > now]

@@ -1,0 +1,20 @@
+"""JAX platform pinning for subprocess entrypoints.
+
+The session environment may register a hardware PJRT plugin (e.g. the
+axon TPU tunnel) via sitecustomize at interpreter start; the
+JAX_PLATFORMS env var alone does NOT override that — the config knob
+does, and it must run before first jax device use. Every spawned
+entrypoint whose coprocs can touch jax (dist matchers, the retained
+index) calls this first.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_jax_platform() -> None:
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

@@ -54,6 +54,36 @@ def _spawn(node, port, peers):
     return proc
 
 
+def _spawn_store_cluster(coproc):
+    """(procs, addrs): 3 store_main processes with the given coproc."""
+    ports = _free_ports(3)
+    peers = ",".join(f"{n}=127.0.0.1:{p}" for n, p in zip(NODES, ports))
+    addrs = {n: f"127.0.0.1:{p}" for n, p in zip(NODES, ports)}
+    procs = {}
+    for n, p in zip(NODES, ports):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        pr = subprocess.Popen(
+            [sys.executable, "-m", "bifromq_tpu.kv.store_main",
+             "--node", n, "--port", str(p), "--peers", peers,
+             "--coproc", coproc, "--tick-interval", "0.01"],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True)
+        assert pr.stdout.readline().startswith("READY")
+        procs[n] = pr
+    return procs, addrs
+
+
+def _kill_cluster(procs):
+    for p in procs.values():
+        p.kill()
+    for p in procs.values():
+        try:
+            p.wait(timeout=5)
+        except Exception:
+            pass
+
+
 class TestThreeProcess:
     async def test_crash_failover_and_catchup(self):
         ports = _free_ports(3)
@@ -100,13 +130,7 @@ class TestThreeProcess:
                 await asyncio.sleep(0.1)
             assert got == b"v2"
         finally:
-            for p in procs.values():
-                p.kill()
-            for p in procs.values():
-                try:
-                    p.wait(timeout=5)
-                except Exception:
-                    pass
+            _kill_cluster(procs)
             await registry.close()
 
 
@@ -122,22 +146,7 @@ class TestInboxStoreProcess:
                                               _enc_str, _envelope)
         from bifromq_tpu.types import QoS, TopicFilterOption
 
-        ports = _free_ports(3)
-        peers = ",".join(f"{n}=127.0.0.1:{p}"
-                         for n, p in zip(NODES, ports))
-        addrs = {n: f"127.0.0.1:{p}" for n, p in zip(NODES, ports)}
-        procs = {}
-        for n, p in zip(NODES, ports):
-            env = os.environ.copy()
-            env["JAX_PLATFORMS"] = "cpu"
-            pr = subprocess.Popen(
-                [sys.executable, "-m", "bifromq_tpu.kv.store_main",
-                 "--node", n, "--port", str(p), "--peers", peers,
-                 "--coproc", "inbox", "--tick-interval", "0.01"],
-                cwd=REPO, env=env, stdout=subprocess.PIPE,
-                stderr=subprocess.DEVNULL, text=True)
-            assert pr.stdout.readline().startswith("READY")
-            procs[n] = pr
+        procs, addrs = _spawn_store_cluster("inbox")
         registry = ServiceRegistry()
         client = ClusterKVClient(MetaService(), registry,
                                  seeds=list(addrs.values()))
@@ -178,11 +187,41 @@ class TestInboxStoreProcess:
             assert len(fetched.buffer) == 1
             assert fetched.buffer[0][2].payload == b"wire-read"
         finally:
-            for p in procs.values():
-                p.kill()
-            for p in procs.values():
-                try:
-                    p.wait(timeout=5)
-                except Exception:
-                    pass
+            _kill_cluster(procs)
+            await registry.close()
+
+
+class TestRetainStoreProcess:
+    async def test_retain_coproc_store_cluster(self):
+        """Standalone RETAIN store cluster: SET through consensus, remote
+        wildcard MATCH over the wire from a replica-less client."""
+        from bifromq_tpu.kv import schema
+        from bifromq_tpu.retain.coproc import (OP_SET, RemoteRetainReader,
+                                               enc_op, enc_retained)
+        from bifromq_tpu.types import ClientInfo, Message, QoS
+
+        procs, addr_map = _spawn_store_cluster("retain")
+        registry = ServiceRegistry()
+        client = ClusterKVClient(MetaService(), registry,
+                                 seeds=list(addr_map.values()))
+        try:
+            pub = ClientInfo(tenant_id="T")
+            for i in range(4):
+                msg = Message(message_id=i, pub_qos=QoS.AT_MOST_ONCE,
+                              payload=b"r%d" % i, timestamp=i)
+                val = enc_retained(schema.encode_message(msg), pub, None)
+                out = await client.mutate(
+                    schema.retain_key("T", f"sensors/{i}/temp"),
+                    enc_op(OP_SET, "T", f"sensors/{i}/temp", val))
+                assert out == b"\x01", out
+            reader = RemoteRetainReader(client)
+            hits = await reader.match("T", "sensors/+/temp", limit=10)
+            assert sorted(t for t, _m in hits) == [
+                f"sensors/{i}/temp" for i in range(4)]
+            assert sorted(m.payload for _t, m in hits) == [
+                b"r%d" % i for i in range(4)]
+            hits = await reader.match("T", "sensors/2/#", limit=10)
+            assert [t for t, _m in hits] == ["sensors/2/temp"]
+        finally:
+            _kill_cluster(procs)
             await registry.close()
