@@ -104,6 +104,7 @@ def _store_descriptor(store: KVRangeStore, address: str,
             "is_leader": r.is_leader,
             "leader_store": node_of(leader) if leader else None,
             "voters": sorted(node_of(v) for v in r.raft.voters),
+            "learners": sorted(node_of(m) for m in r.raft.learners),
         })
     return {"store_id": store.node_id, "address": address, "epoch": epoch,
             "ranges": ranges}
@@ -200,7 +201,9 @@ class BaseKVStoreServer:
                 for rd in desc["ranges"]:
                     if (rd["id"] == rid and rd["is_leader"]
                             and self.store.node_id
-                            not in rd.get("voters", [])):
+                            not in rd.get("voters", [])
+                            and self.store.node_id
+                            not in rd.get("learners", [])):
                         excluded = True
             if not excluded:
                 self._zombie_rounds.pop(rid, None)
@@ -310,7 +313,8 @@ class BaseKVStoreServer:
         boundary = (bytes.fromhex(spec["start"]),
                     bytes.fromhex(spec["end"])
                     if spec["end"] is not None else None)
-        self.store.ensure_range(rid_b.decode(), boundary, spec["voters"])
+        self.store.ensure_range(rid_b.decode(), boundary, spec["voters"],
+                                spec.get("learners"))
         return b"ok"
 
     async def _on_range_stats(self, _payload: bytes, _okey: str) -> bytes:

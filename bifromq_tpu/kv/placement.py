@@ -40,20 +40,24 @@ def _voter_nodes(raft) -> Set[str]:
 
 class EnsureReplicaCommand:
     def __init__(self, store_id: str, range_id: str, boundary,
-                 voter_nodes: List[str]) -> None:
+                 voter_nodes: List[str],
+                 learner_nodes: Optional[List[str]] = None) -> None:
         self.store_id = store_id
         self.range_id = range_id
         self.boundary = boundary
         self.voter_nodes = voter_nodes
+        self.learner_nodes = list(learner_nodes or [])
 
     def __repr__(self) -> str:
         return f"EnsureReplica({self.range_id} on {self.store_id})"
 
 
 class ConfigChangeCommand:
-    def __init__(self, range_id: str, voter_nodes: List[str]) -> None:
+    def __init__(self, range_id: str, voter_nodes: List[str],
+                 learner_nodes: Optional[List[str]] = None) -> None:
         self.range_id = range_id
         self.voter_nodes = voter_nodes
+        self.learner_nodes = learner_nodes   # None = keep current
 
     def __repr__(self) -> str:
         return f"ConfigChange({self.range_id} -> {self.voter_nodes})"
@@ -83,8 +87,9 @@ class ReplicaCntBalancer:
             if not r.is_leader or r.raft.voters_old is not None:
                 continue    # no stacking on an in-flight change
             nodes = _voter_nodes(r.raft)
-            if len(nodes) < self.target:
-                candidates = sorted(alive - nodes)
+            learner_nodes = {_node_of(m) for m in r.raft.learners}
+            if len(nodes) + len(learner_nodes) < self.target:
+                candidates = sorted(alive - nodes - learner_nodes)
                 if not candidates:
                     continue
 
@@ -93,10 +98,16 @@ class ReplicaCntBalancer:
                                         digest_size=8).digest()
                     return int.from_bytes(h, "big")
                 new_node = max(candidates, key=score)
-                new_nodes = sorted(nodes | {new_node})
+                # stage as LEARNER: the shell catches up via appends or a
+                # dump session WITHOUT weakening quorum; the promotion
+                # balancer flips it to voter once caught up (the
+                # reference's learner->voter placement flow)
+                new_learners = sorted(learner_nodes | {new_node})
                 out.append(EnsureReplicaCommand(
-                    new_node, rid, store.boundaries[rid], new_nodes))
-                out.append(ConfigChangeCommand(rid, new_nodes))
+                    new_node, rid, store.boundaries[rid], sorted(nodes),
+                    new_learners))
+                out.append(ConfigChangeCommand(rid, sorted(nodes),
+                                               new_learners))
             elif len(nodes) > self.target:
                 dead = sorted(nodes - alive - {store.node_id})
                 live_followers = sorted(nodes & alive - {store.node_id})
@@ -128,6 +139,8 @@ class UnreachableReplicaRemovalBalancer:
             live = nodes & alive | {store.node_id}
             if len(live) * 2 <= len(nodes):
                 continue    # majority gone: recover territory
+            learner_nodes = {_node_of(m) for m in r.raft.learners}
+            removed_this_range = False
             for node in sorted(nodes - alive - {store.node_id}):
                 key = f"{rid}/{node}"
                 seen.add(key)
@@ -136,10 +149,55 @@ class UnreachableReplicaRemovalBalancer:
                 if n >= self.miss_rounds:
                     out.append(ConfigChangeCommand(
                         rid, sorted(nodes - {node})))
+                    removed_this_range = True
                     break   # one removal per range per round
+            if removed_this_range:
+                continue
+            for node in sorted(learner_nodes - alive):
+                # a dead LEARNER wedges re-replication (it counts toward
+                # the target but can never promote); dropping it never
+                # touches quorum, so prune on the same miss schedule
+                key = f"{rid}/L/{node}"
+                seen.add(key)
+                n = self._misses.get(key, 0) + 1
+                self._misses[key] = n
+                if n >= self.miss_rounds:
+                    out.append(ConfigChangeCommand(
+                        rid, sorted(nodes),
+                        sorted(learner_nodes - {node})))
+                    break
         for key in list(self._misses):
             if key not in seen:
                 del self._misses[key]
+        return out
+
+
+class LearnerPromotionBalancer:
+    """Promote caught-up learners to voters (the second half of the
+    learner->voter placement flow): a learner whose match index reached
+    the leader's commit gets a one-voter-delta config change."""
+
+    LAG_SLACK = 4   # entries a learner may trail and still promote
+
+    def balance(self, store: KVRangeStore, alive: Set[str]) -> List:
+        out: List = []
+        for rid, r in store.ranges.items():
+            raft = r.raft
+            if not r.is_leader or raft.voters_old is not None \
+                    or not raft.learners:
+                continue
+            for member in sorted(raft.learners):
+                if _node_of(member) not in alive:
+                    continue    # never promote a dead learner to voter
+                match = raft._match_index.get(member, 0)
+                if match and match >= raft.commit_index - self.LAG_SLACK:
+                    nodes = _voter_nodes(raft)
+                    learner_nodes = {_node_of(m) for m in raft.learners}
+                    promoted = _node_of(member)
+                    out.append(ConfigChangeCommand(
+                        rid, sorted(nodes | {promoted}),
+                        sorted(learner_nodes - {promoted})))
+                    break   # one promotion per range per round
         return out
 
 
@@ -177,8 +235,8 @@ class ClusterPlacementController:
         self.server = server            # BaseKVStoreServer
         self.store: KVRangeStore = server.store
         self.balancers = balancers if balancers is not None else [
-            ReplicaCntBalancer(), UnreachableReplicaRemovalBalancer(),
-            RangeLeaderBalancer()]
+            ReplicaCntBalancer(), LearnerPromotionBalancer(),
+            UnreachableReplicaRemovalBalancer(), RangeLeaderBalancer()]
         self.interval = interval
         # default liveness = landscape membership (gossip deployments pass
         # AgentHost.alive_members)
@@ -229,7 +287,8 @@ class ClusterPlacementController:
             payload = _len16(cmd.range_id.encode()) + json.dumps({
                 "start": s.hex(),
                 "end": e.hex() if e is not None else None,
-                "voters": cmd.voter_nodes}).encode()
+                "voters": cmd.voter_nodes,
+                "learners": cmd.learner_nodes}).encode()
             await asyncio.wait_for(
                 self.server.registry.client_for(addr).call(
                     self.server.service, "ensure_range", payload),
@@ -237,8 +296,12 @@ class ClusterPlacementController:
         elif isinstance(cmd, ConfigChangeCommand):
             r = self.store.ranges[cmd.range_id]
             voters = [f"{n}:{cmd.range_id}" for n in cmd.voter_nodes]
+            learners = (None if cmd.learner_nodes is None else
+                        [f"{n}:{cmd.range_id}"
+                         for n in cmd.learner_nodes])
             await asyncio.wait_for(
-                asyncio.shield(r.raft.change_config(voters)), 10.0)
+                asyncio.shield(r.raft.change_config(voters, learners)),
+                10.0)
         elif isinstance(cmd, TransferLeaderCommand):
             r = self.store.ranges[cmd.range_id]
             r.raft.transfer_leadership(

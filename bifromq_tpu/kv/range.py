@@ -129,7 +129,8 @@ class ReplicatedKVRange:
     def __init__(self, range_id: str, node_id: str, voters: List[str],
                  transport, space: IKVSpace,
                  coproc: Optional[IKVRangeCoProc] = None,
-                 raft_store=None) -> None:
+                 raft_store=None,
+                 learners: Optional[List[str]] = None) -> None:
         self.range_id = range_id
         self.space = space
         self.coproc = coproc
@@ -151,6 +152,7 @@ class ReplicatedKVRange:
                                    struct.pack(">Q", applied))
         self.raft = RaftNode(
             node_id, voters, transport,
+            learners=learners,
             apply_cb=self._apply,
             snapshot_cb=self._snapshot,
             restore_cb=self._restore,

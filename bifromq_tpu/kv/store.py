@@ -119,7 +119,8 @@ class KVRangeStore:
                     rec["id"],
                     (bytes.fromhex(rec["start"]),
                      bytes.fromhex(rec["end"]) if rec["end"] else None),
-                    voters=rec.get("voters"))
+                    voters=rec.get("voters"),
+                    learners=rec.get("learners"))
         elif not bootstrap:
             return
         else:
@@ -144,7 +145,8 @@ class KVRangeStore:
     def _persist_meta(self) -> None:
         recs = [{"id": rid, "start": b[0].hex(),
                  "end": b[1].hex() if b[1] is not None else None,
-                 "voters": sorted(self.ranges[rid].raft.voters)}
+                 "voters": sorted(self.ranges[rid].raft.voters),
+                 "learners": sorted(self.ranges[rid].raft.learners)}
                 for rid, b in self.boundaries.items()]
         self._meta.put_metadata(_META_RANGES,
                                 json.dumps(sorted(recs,
@@ -152,7 +154,8 @@ class KVRangeStore:
                                            ).encode())
 
     def _open_range(self, range_id: str, boundary: Boundary, *,
-                    voters: Optional[List[str]] = None
+                    voters: Optional[List[str]] = None,
+                    learners: Optional[List[str]] = None
                     ) -> ReplicatedKVRange:
         space = self.engine.create_space(
             f"{self.space_prefix}range_{range_id}")
@@ -163,7 +166,8 @@ class KVRangeStore:
         if voters is None:
             voters = [f"{n}:{range_id}" for n in self.member_nodes]
         r = ReplicatedKVRange(range_id, member_id, voters, self.transport,
-                              space, coproc=coproc, raft_store=raft_store)
+                              space, coproc=coproc, raft_store=raft_store,
+                              learners=learners)
         r.on_split = lambda split_key, rid=range_id: self._apply_split(
             rid, split_key)
         r.on_seal = lambda sealed, rid=range_id: self._apply_seal(
@@ -431,7 +435,9 @@ class KVRangeStore:
     # ---------------- placement / recovery ---------------------------------
 
     def ensure_range(self, range_id: str, boundary: Boundary,
-                     voter_nodes: List[str]) -> ReplicatedKVRange:
+                     voter_nodes: List[str],
+                     learner_nodes: Optional[List[str]] = None
+                     ) -> ReplicatedKVRange:
         """Open a replica shell for ``range_id`` on this store (the target
         half of replica placement: a balancer adds this store to the
         range's config, then the leader catches the shell up via appends or
@@ -440,7 +446,9 @@ class KVRangeStore:
         if r is not None:
             return r
         voters = [f"{n}:{range_id}" for n in sorted(voter_nodes)]
-        r = self._open_range(range_id, boundary, voters=voters)
+        learners = [f"{n}:{range_id}" for n in sorted(learner_nodes or [])]
+        r = self._open_range(range_id, boundary, voters=voters,
+                             learners=learners)
         self._persist_meta()
         return r
 

@@ -37,9 +37,12 @@ class LogEntry:
     data: bytes
     # config-change entries carry the new voter set instead of user data;
     # joint-consensus entries additionally carry the outgoing set
-    # (C_old,new — ≈ RaftConfigChanger's two-phase change)
+    # (C_old,new — ≈ RaftConfigChanger's two-phase change). ``learners``
+    # is the NON-VOTING replica set (≈ ClusterConfig.learners): they
+    # receive appends/snapshots but never count for quorum or elections.
     config: Optional[Tuple[str, ...]] = None
     config_old: Optional[Tuple[str, ...]] = None
+    learners: Optional[Tuple[str, ...]] = None
 
 
 @dataclass
@@ -49,6 +52,7 @@ class Snapshot:
     data: bytes
     voters: Tuple[str, ...]
     voters_old: Optional[Tuple[str, ...]] = None
+    learners: Tuple[str, ...] = ()
 
 
 # ------------------------------ messages ------------------------------------
@@ -173,6 +177,7 @@ class RaftNode:
 
     def __init__(self, node_id: str, voters: List[str],
                  transport: ITransport, *,
+                 learners: Optional[List[str]] = None,
                  apply_cb: Callable[[LogEntry], None],
                  snapshot_cb: Callable[[], bytes] = lambda: b"",
                  restore_cb: Callable[[bytes], None] = lambda b: None,
@@ -182,6 +187,9 @@ class RaftNode:
         self.voters: Set[str] = set(voters)
         # outgoing voter set while a joint config (C_old,new) is in flight
         self.voters_old: Optional[Set[str]] = None
+        # non-voting replicas (≈ ClusterConfig.learners): replicated to,
+        # never counted for quorum, never campaign
+        self.learners: Set[str] = set(learners or [])
         self.transport = transport
         self.apply_cb = apply_cb
         self.snapshot_cb = snapshot_cb
@@ -195,7 +203,8 @@ class RaftNode:
         self.leader_id: Optional[str] = None
         # log[0] is a sentinel for (snap_index, snap_term)
         self.snap = Snapshot(last_index=0, last_term=0, data=b"",
-                             voters=tuple(voters))
+                             voters=tuple(voters),
+                             learners=tuple(sorted(self.learners)))
         self.log: List[LogEntry] = []
         self.commit_index = 0
         self.last_applied = 0
@@ -238,6 +247,7 @@ class RaftNode:
             self.voters = set(snap.voters)
             self.voters_old = (set(snap.voters_old)
                                if snap.voters_old is not None else None)
+            self.learners = set(snap.learners)
         self.log = self.store.load_entries()
         # drop any persisted prefix the snapshot already covers
         self.log = [e for e in self.log if e.index > self.snap.last_index]
@@ -259,6 +269,9 @@ class RaftNode:
 
     def _rand_election(self) -> int:
         return self.rng.randint(*self.ELECTION_TICKS)
+
+    def _replication_targets(self) -> Set[str]:
+        return self._all_voters() | self.learners
 
     def _all_voters(self) -> Set[str]:
         return (self.voters | self.voters_old if self.voters_old is not None
@@ -353,7 +366,9 @@ class RaftNode:
         self._broadcast_append(read_ctx=ctx)
         return fut
 
-    def change_config(self, new_voters: List[str]) -> "asyncio.Future[int]":
+    def change_config(self, new_voters: List[str],
+                      new_learners: Optional[List[str]] = None
+                      ) -> "asyncio.Future[int]":
         """Cluster membership change (≈ RaftNode.changeClusterConfig():206).
 
         A one-voter delta commits as a single config entry (raft
@@ -361,6 +376,11 @@ class RaftNode:
         consensus (≈ RaftConfigChanger): first a C_old,new entry requiring
         majorities in BOTH sets, then — once that commits — the final C_new
         entry. The returned future resolves when the FINAL config commits.
+
+        ``new_learners`` (None = keep current) replaces the non-voting
+        set; learner changes never affect quorum so they always ride the
+        entry directly (promotion learner→voter counts as a one-voter
+        delta).
         """
         fut = asyncio.get_running_loop().create_future()
         if self.role != Role.LEADER:
@@ -370,22 +390,27 @@ class RaftNode:
             fut.set_exception(RuntimeError("config change in progress"))
             return fut
         target = tuple(sorted(new_voters))
+        learner_target = tuple(sorted(
+            set(self.learners if new_learners is None else new_learners)
+            - set(new_voters)))
         diff = self.voters.symmetric_difference(new_voters)
         if len(diff) <= 1:
             entry = LogEntry(term=self.term, index=self.last_index + 1,
-                             data=b"", config=target)
+                             data=b"", config=target,
+                             learners=learner_target)
             self._propose_waiters[entry.index] = fut
         else:
             entry = LogEntry(term=self.term, index=self.last_index + 1,
                              data=b"", config=target,
-                             config_old=tuple(sorted(self.voters)))
+                             config_old=tuple(sorted(self.voters)),
+                             learners=learner_target)
             # resolved when the final (C_new-only) entry commits
             self._config_final_fut = fut
-        before = self._all_voters()
+        before = self._replication_targets()
         self.log.append(entry)
         self._persist_append([entry])
         # a config entry takes effect as soon as it is appended
-        self._set_config(entry.config, entry.config_old)
+        self._set_config(entry.config, entry.config_old, entry.learners)
         if entry.config_old is not None:
             self._joint_index = entry.index
         self._match_index[self.id] = self.last_index
@@ -394,7 +419,7 @@ class RaftNode:
         # how they learn they're out (→ zombie-quit at their store); in the
         # joint path removed peers are still in _all_voters() and the
         # broadcast above already reached them
-        for peer in before - self._all_voters() - {self.id}:
+        for peer in before - self._replication_targets() - {self.id}:
             self._send_append(peer)
         self._maybe_commit()
         return fut
@@ -420,10 +445,10 @@ class RaftNode:
                     RuntimeError("config change superseded by recover()"))
             self._config_final_fut = None
         entry = LogEntry(term=self.term, index=self.last_index + 1,
-                         data=b"", config=tuple(sorted(new)))
+                         data=b"", config=tuple(sorted(new)), learners=())
         self.log.append(entry)
         self._persist_append([entry])
-        self._set_config(entry.config, None)
+        self._set_config(entry.config, None, ())
         self._joint_index = None
         # campaign immediately: with the forced config this member can win
         self._start_election()
@@ -433,7 +458,7 @@ class RaftNode:
         """True once a config that excludes this member took effect — the
         hosting store retires such replicas (≈ the reference's zombie-quit:
         a replica outside the latest config destroys itself)."""
-        return self.id not in self._all_voters()
+        return self.id not in self._replication_targets()
 
     def transfer_leadership(self, target: str) -> None:
         """(≈ RaftNode.transferLeadership():171)"""
@@ -581,7 +606,7 @@ class RaftNode:
         self.leader_id = self.id
         self._transfer_target = None
         self._heartbeat_elapsed = 0
-        peers = self._all_voters()
+        peers = self._replication_targets()
         self._next_index = {p: self.last_index + 1 for p in peers}
         self._match_index = {p: 0 for p in peers}
         self._match_index[self.id] = self.last_index
@@ -603,7 +628,7 @@ class RaftNode:
     # ---------------- replication ------------------------------------------
 
     def _broadcast_append(self, read_ctx: Optional[int] = None) -> None:
-        for peer in self._all_voters() - {self.id}:
+        for peer in self._replication_targets() - {self.id}:
             self._send_append(peer, read_ctx=read_ctx)
 
     def _send_append(self, peer: str,
@@ -776,7 +801,8 @@ class RaftNode:
                              voters=tuple(sorted(self.voters)),
                              voters_old=(tuple(sorted(self.voters_old))
                                          if self.voters_old is not None
-                                         else None))
+                                         else None),
+                             learners=tuple(sorted(self.learners)))
         self.log = new_log
         if self.store is not None:
             self.store.save_snapshot(self.snap)
@@ -827,7 +853,8 @@ class RaftNode:
                 meta = Snapshot(last_index=snap.last_index,
                                 last_term=snap.last_term, data=b"",
                                 voters=snap.voters,
-                                voters_old=snap.voters_old)
+                                voters_old=snap.voters_old,
+                                learners=snap.learners)
             self.transport.send(peer, self.id, SnapshotChunk(
                 term=self.term, leader=self.id, session_id=sess["id"],
                 seq=sess["next_seq"], data=chunk, last=last, meta=meta))
@@ -876,7 +903,8 @@ class RaftNode:
                             last_term=meta.last_term,
                             data=b"".join(rs["chunks"]),
                             voters=meta.voters,
-                            voters_old=meta.voters_old)
+                            voters_old=meta.voters_old,
+                            learners=meta.learners)
             self._install_snapshot_obj(sender, snap)
 
     def _install_snapshot_obj(self, sender: str, snapshot: Snapshot) -> None:
@@ -891,6 +919,7 @@ class RaftNode:
         self.voters = set(snapshot.voters)
         self.voters_old = (set(snapshot.voters_old)
                            if snapshot.voters_old is not None else None)
+        self.learners = set(snapshot.learners)
         self._joint_index = (snapshot.last_index
                              if self.voters_old is not None else None)
         if self.store is not None:
@@ -923,20 +952,26 @@ class RaftNode:
         config entry wins) — used after load and after conflict truncation."""
         voters: Tuple[str, ...] = tuple(self.snap.voters)
         old = self.snap.voters_old
+        learners: Tuple[str, ...] = tuple(self.snap.learners)
         ji = self.snap.last_index if old is not None else None
         for e in self.log:
             if e.config is not None:
                 voters, old = e.config, e.config_old
+                if e.learners is not None:
+                    learners = e.learners
                 ji = e.index if e.config_old is not None else None
-        self._set_config(voters, old)
+        self._set_config(voters, old, learners)
         self._joint_index = ji
 
     def _set_config(self, voters: Tuple[str, ...],
-                    voters_old: Optional[Tuple[str, ...]] = None) -> None:
+                    voters_old: Optional[Tuple[str, ...]] = None,
+                    learners: Optional[Tuple[str, ...]] = None) -> None:
         self.voters = set(voters)
         self.voters_old = set(voters_old) if voters_old is not None else None
+        if learners is not None:
+            self.learners = set(learners) - self.voters
         if self.role == Role.LEADER:
-            for p in self._all_voters():
+            for p in self._replication_targets():
                 self._next_index.setdefault(p, self.last_index + 1)
                 self._match_index.setdefault(p, 0)
 
@@ -944,7 +979,8 @@ class RaftNode:
         """Phase 2 of joint consensus: leave the joint config."""
         removed = self._all_voters() - self.voters
         entry = LogEntry(term=self.term, index=self.last_index + 1, data=b"",
-                         config=tuple(sorted(self.voters)))
+                         config=tuple(sorted(self.voters)),
+                         learners=tuple(sorted(self.learners)))
         self.log.append(entry)
         self._persist_append([entry])
         self._set_config(entry.config, None)

@@ -43,6 +43,10 @@ def _enc_strs(strs: Optional[Sequence[str]]) -> bytes:
 
 
 def _dec_strs(buf: bytes, pos: int) -> Tuple[Optional[Tuple[str, ...]], int]:
+    if pos >= len(buf):
+        # records persisted before a trailing field was added (e.g. the
+        # learners set) simply end here: absent, not corrupt
+        return None, pos
     n = struct.unpack_from(">i", buf, pos)[0]
     pos += 4
     if n < 0:
@@ -59,6 +63,7 @@ def encode_entry(entry) -> bytes:
     out += _frame(entry.data)
     out += _enc_strs(entry.config)
     out += _enc_strs(getattr(entry, "config_old", None))
+    out += _enc_strs(getattr(entry, "learners", None))
     return bytes(out)
 
 
@@ -68,8 +73,9 @@ def decode_entry(buf: bytes):
     data, pos = _read_frame(buf, 16)
     config, pos = _dec_strs(buf, pos)
     config_old, pos = _dec_strs(buf, pos)
+    learners, pos = _dec_strs(buf, pos)
     return LogEntry(term=term, index=index, data=data, config=config,
-                    config_old=config_old)
+                    config_old=config_old, learners=learners)
 
 
 def encode_snapshot(snap) -> bytes:
@@ -77,6 +83,7 @@ def encode_snapshot(snap) -> bytes:
     out += _frame(snap.data)
     out += _enc_strs(snap.voters)
     out += _enc_strs(getattr(snap, "voters_old", None))
+    out += _enc_strs(tuple(getattr(snap, "learners", ()) or ()))
     return bytes(out)
 
 
@@ -86,8 +93,10 @@ def decode_snapshot(buf: bytes):
     data, pos = _read_frame(buf, 16)
     voters, pos = _dec_strs(buf, pos)
     voters_old, pos = _dec_strs(buf, pos)
+    learners, pos = _dec_strs(buf, pos)
     return Snapshot(last_index=last_index, last_term=last_term, data=data,
-                    voters=voters or (), voters_old=voters_old)
+                    voters=voters or (), voters_old=voters_old,
+                    learners=learners or ())
 
 
 class IRaftStateStore:

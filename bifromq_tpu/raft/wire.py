@@ -86,7 +86,7 @@ def _r_strs(buf: bytes, pos: int) -> Tuple[Optional[Tuple[str, ...]], int]:
 
 def _entry(e: LogEntry) -> bytes:
     return (struct.pack(">QQ", e.term, e.index) + _b32(e.data)
-            + _strs(e.config) + _strs(e.config_old))
+            + _strs(e.config) + _strs(e.config_old) + _strs(e.learners))
 
 
 def _r_entry(buf: bytes, pos: int) -> Tuple[LogEntry, int]:
@@ -95,13 +95,14 @@ def _r_entry(buf: bytes, pos: int) -> Tuple[LogEntry, int]:
     data, pos = _rb32(buf, pos)
     config, pos = _r_strs(buf, pos)
     config_old, pos = _r_strs(buf, pos)
+    learners, pos = _r_strs(buf, pos)
     return LogEntry(term=term, index=index, data=data, config=config,
-                    config_old=config_old), pos
+                    config_old=config_old, learners=learners), pos
 
 
 def _snap(s: Snapshot) -> bytes:
     return (struct.pack(">QQ", s.last_index, s.last_term) + _b32(s.data)
-            + _strs(s.voters) + _strs(s.voters_old))
+            + _strs(s.voters) + _strs(s.voters_old) + _strs(s.learners))
 
 
 def _r_snap(buf: bytes, pos: int) -> Tuple[Snapshot, int]:
@@ -110,8 +111,10 @@ def _r_snap(buf: bytes, pos: int) -> Tuple[Snapshot, int]:
     data, pos = _rb32(buf, pos)
     voters, pos = _r_strs(buf, pos)
     voters_old, pos = _r_strs(buf, pos)
+    learners, pos = _r_strs(buf, pos)
     return Snapshot(last_index=li, last_term=lt, data=data,
-                    voters=voters or (), voters_old=voters_old), pos
+                    voters=voters or (), voters_old=voters_old,
+                    learners=learners or ()), pos
 
 
 def encode_msg(msg) -> bytes:

@@ -14,6 +14,7 @@ from bifromq_tpu.kv.engine import InMemKVEngine
 from bifromq_tpu.kv.messenger import StoreMessenger
 from bifromq_tpu.kv.meta import BaseKVStoreServer, ClusterKVClient, MetaService
 from bifromq_tpu.kv.placement import (ClusterPlacementController,
+                                      LearnerPromotionBalancer,
                                       RangeLeaderBalancer,
                                       ReplicaCntBalancer,
                                       UnreachableReplicaRemovalBalancer)
@@ -113,16 +114,19 @@ class TestPlacement:
             await srv.start()
         ctrl = ClusterPlacementController(
             s1, [ReplicaCntBalancer(target=3),
+                 LearnerPromotionBalancer(),
                  UnreachableReplicaRemovalBalancer(miss_rounds=2)],
             interval=0.1, alive_fn=lambda: set(alive))
         await ctrl.start()
         try:
             client = ClusterKVClient(meta, registry)
             assert await client.mutate(b"k", b"k=1") == b"ok:k"
-            # -- growth to 3 voters, replicas land on s2 and s3 ------------
+            # -- growth to 3 voters via learner staging + promotion --------
+            # (new replicas join as LEARNERS, catch up, then promote)
             ok = await _wait(lambda: len(
-                s1.store.ranges["r0"].raft.voters) == 3)
-            assert ok, s1.store.ranges["r0"].raft.voters
+                s1.store.ranges["r0"].raft.voters) == 3, timeout=12.0)
+            assert ok, (s1.store.ranges["r0"].raft.voters,
+                        s1.store.ranges["r0"].raft.learners)
             ok = await _wait(lambda: ("r0" in s2.store.ranges
                                       and "r0" in s3.store.ranges))
             assert ok
@@ -220,6 +224,125 @@ class TestPlacement:
                 await ctrl.stop()
                 assert ok
         finally:
+            for srv in servers.values():
+                try:
+                    await srv.stop()
+                except Exception:
+                    pass
+
+
+class TestLearners:
+    async def test_learner_replicates_without_quorum_weight(self):
+        """A learner receives appends but never counts for commit quorum
+        or campaigns; promotion via change_config flips it to voter."""
+        applied = {n: [] for n in ("a", "b", "lx")}
+        t = InMemTransport()
+        nodes = {}
+        for n in ("a", "b"):
+            nodes[n] = RaftNode(n, ["a", "b"], t, learners=["lx"],
+                                apply_cb=lambda e, n=n: applied[n].append(
+                                    e.data))
+            t.register(nodes[n])
+        nodes["lx"] = RaftNode("lx", ["a", "b"], t, learners=["lx"],
+                               apply_cb=lambda e: applied["lx"].append(
+                                   e.data))
+        t.register(nodes["lx"])
+
+        def pump(n=300):
+            for _ in range(n):
+                t.pump()
+                for nd in nodes.values():
+                    nd.tick()
+                if any(nd.role == Role.LEADER for nd in nodes.values()):
+                    return
+
+        pump()
+        leader = next(nd for nd in nodes.values()
+                      if nd.role == Role.LEADER)
+        assert leader.id != "lx", "a learner must never win an election"
+        fut = leader.propose(b"x1")
+        for _ in range(100):
+            t.pump()
+            if fut.done():
+                break
+        await fut
+        for _ in range(50):     # commit reaches the learner on the next
+            for nd in nodes.values():   # heartbeat round
+                nd.tick()
+            t.pump()
+            if applied["lx"]:
+                break
+        assert applied["lx"] == [b"x1"], "learner must receive appends"
+        # quorum independence: kill the learner; commits still flow
+        t.kill("lx")
+        fut = leader.propose(b"x2")
+        for _ in range(100):
+            t.pump()
+            if fut.done():
+                break
+        assert fut.done(), "learner must not gate the commit quorum"
+        # promotion: learner -> voter is a one-voter delta
+        fut = leader.change_config(["a", "b", "lx"], [])
+        for _ in range(200):
+            t.pump()
+            if fut.done():
+                break
+        assert leader.voters == {"a", "b", "lx"}
+        assert leader.learners == set()
+
+    async def test_dead_learner_pruned_and_rereplicated(self):
+        """A learner whose store dies before promotion must not wedge
+        re-replication: the unreachable balancer prunes it (quorum-safe)
+        and ReplicaCntBalancer stages a fresh learner elsewhere."""
+        registry = ServiceRegistry(local_bypass=False)
+        meta = MetaService()
+        alive = {"s1", "s2", "s3", "s4"}
+        servers = {}
+        servers["s1"] = _mk_store("s1", registry, meta,
+                                  member_nodes=["s1"])
+        for n in ("s2", "s3", "s4"):
+            servers[n] = _mk_store(n, registry, meta, member_nodes=[n],
+                                   bootstrap=False)
+        for srv in servers.values():
+            await srv.start()
+        ctrl = ClusterPlacementController(
+            s1 := servers["s1"],
+            [ReplicaCntBalancer(target=2),
+             LearnerPromotionBalancer(),
+             UnreachableReplicaRemovalBalancer(miss_rounds=2)],
+            interval=0.1, alive_fn=lambda: set(alive))
+        try:
+            # stage ONE learner, then kill its store before promotion can
+            # complete by freezing the controller until the kill
+            ok = await _wait(lambda: bool(
+                s1.store.ranges["r0"].raft.learners
+                or len(s1.store.ranges["r0"].raft.voters) == 2),
+                timeout=0.1)
+            await ctrl.run_once()   # stages the learner
+            raft = s1.store.ranges["r0"].raft
+            staged = {m.split(":")[0] for m in raft.learners}
+            if staged:
+                victim = staged.pop()
+                await servers[victim].stop()
+                alive.discard(victim)
+                await ctrl.start()
+                # pruned, then re-replicated onto a live store
+                ok = await _wait(lambda: not any(
+                    m.startswith(victim)
+                    for m in s1.store.ranges["r0"].raft.learners),
+                    timeout=10.0)
+                assert ok, s1.store.ranges["r0"].raft.learners
+                ok = await _wait(lambda: len(
+                    s1.store.ranges["r0"].raft.voters) == 2,
+                    timeout=12.0)
+                assert ok, (s1.store.ranges["r0"].raft.voters,
+                            s1.store.ranges["r0"].raft.learners)
+                await ctrl.stop()
+        finally:
+            try:
+                await ctrl.stop()
+            except Exception:
+                pass
             for srv in servers.values():
                 try:
                     await srv.stop()

@@ -36,9 +36,12 @@ class TestWireCodec:
             LogEntry(term=3, index=6, data=b"", config=("a:r0", "b:r0")),
             LogEntry(term=3, index=7, data=b"", config=("a:r0",),
                      config_old=("a:r0", "b:r0")),
+            LogEntry(term=4, index=8, data=b"", config=("a:r0",),
+                     learners=("l:r0", "m:r0")),
         ]
         snap = Snapshot(last_index=9, last_term=3, data=b"snapdata",
-                        voters=("a:r0", "b:r0"), voters_old=None)
+                        voters=("a:r0", "b:r0"), voters_old=None,
+                        learners=("l:r0",))
         snap_joint = Snapshot(last_index=9, last_term=3, data=b"",
                               voters=("a:r0",), voters_old=("a:r0", "b:r0"))
         msgs = [
