@@ -146,9 +146,14 @@ class BaseKVStoreServer:
         if self.server._server is None:
             await self.server.start()
         addr = self.server.address
-        # per-node raft ingress + the shared query/mutate service
-        self.registry.announce(f"{self.messenger.service}:"
-                               f"{self.store.node_id}", addr)
+        # per-node raft ingress: EXCLUSIVE ownership — a crashed
+        # predecessor's stale address must not shadow this incarnation
+        # (peers' messengers resolve the first endpoint)
+        node_svc = f"{self.messenger.service}:{self.store.node_id}"
+        for stale in list(self.registry.endpoints(node_svc)):
+            if stale != addr:
+                self.registry.withdraw(node_svc, stale)
+        self.registry.announce(node_svc, addr)
         self.registry.announce(self.service, addr)
         await self.messenger.start()
         self._publish(force=True)
