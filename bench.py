@@ -23,6 +23,7 @@ measured oracle rate.
 Env knobs: BENCH_CONFIGS ("1,2,3,4,5" default; "2" = headline only),
 BENCH_SUBS (config-2 subs, default 1_000_000), BENCH_BATCH (8192),
 BENCH_ITERS (30), BENCH_K (16), BENCH_SEED (0), BENCH_RETAINED (1_000_000),
+BENCH_COMPACTION (sort|scatter),
 BENCH_SHARED_TENANTS (1000), BENCH_SHARED_SUBS (1000), BENCH_MT_TENANTS
 (10_000), BENCH_MT_SUBS (1_000_000).
 """
@@ -102,8 +103,13 @@ def _measure_match(tries, probe_fn, *, name, k_states=K_STATES,
     t3 = time.time()
     tok_rate = batch * n_batches / (t3 - t2)
 
+    compaction = os.environ.get("BENCH_COMPACTION", "sort")
+    if compaction not in ("sort", "scatter"):
+        raise ValueError(f"BENCH_COMPACTION={compaction!r} "
+                         "(must be sort|scatter)")
     run = lambda p: walk_count_only(dev, p, probe_len=ct.probe_len,
-                                    k_states=k_states)
+                                    k_states=k_states,
+                                    compaction=compaction)
     cnt, ovf = run(probe_sets[0])
     jax.block_until_ready((cnt, ovf))
     t4 = time.time()

@@ -10,7 +10,9 @@ This replaces the reference's per-PUBLISH iterator join
 - Literal-edge lookup = ``probe_len`` linear probes of the open-addressing
   edge table: one [B,K,4] row gather per probe.
 - '+' / '#' transitions = one packed node-record gather per step.
-- Successor compaction = mask + cumsum + scatter-drop (no sort).
+- Successor compaction to K slots: per-row SORT by default (bitonic,
+  VPU-friendly); a mask+cumsum+scatter alternative is selectable for
+  on-hardware A/B (``compaction="scatter"``).
 - Topics whose active set would exceed K set an overflow flag and are
   re-matched on the host oracle — the same bounded-work-then-fallback contract
   the reference's 20-probe seek heuristic embodies
@@ -149,11 +151,20 @@ def _edge_lookup(edge_tab: jax.Array, probe_len: int, node: jax.Array,
 
 
 def _advance(trie: DeviceTrie, probes: Probes, probe_len: int, b: int,
-             k: int, i, act, valid, allow_wc, node_rec):
-    """One NFA step: literal + '+' successors, sort-compacted to K slots.
+             k: int, i, act, valid, allow_wc, node_rec,
+             compaction: str = "sort"):
+    """One NFA step: literal + '+' successors, compacted to K slots.
 
     Shared by walk() and walk_count_only() so the successor semantics have
-    exactly one definition. Returns (new_act [B,K], overflowed [B])."""
+    exactly one definition. Returns (new_act [B,K], overflowed [B]).
+
+    ``compaction`` picks the compaction strategy (A/B-able on real
+    hardware via the bench's BENCH_COMPACTION knob):
+    - "sort": per-row bitonic sort of 2K lanes — vectorizes on the TPU
+      VPU; descending order puts valid nodes first.
+    - "scatter": mask + cumsum + one scatter per row — fewer total ops
+      but the scatter can serialize on some backends.
+    """
     stepping = (i < probes.lengths)[:, None]
     h1 = jnp.broadcast_to(
         jax.lax.dynamic_index_in_dim(probes.tok_h1, i, axis=1), (b, k))
@@ -165,16 +176,27 @@ def _advance(trie: DeviceTrie, probes: Probes, probe_len: int, b: int,
                      node_rec[..., NODE_PLUS], -1)
     cand = jnp.concatenate([exact, plus], axis=1)        # [B,2K]
     overflowed = (cand >= 0).sum(axis=1) > k
-    # successor compaction by per-row SORT, not scatter: a bitonic sort of
-    # 2K lanes vectorizes on TPU where the scatter serializes (the active
-    # set is a set — order is immaterial); descending puts valid nodes first
-    new_act = -jnp.sort(-cand, axis=1)[:, :k]
+    if compaction == "scatter":
+        live = cand >= 0
+        # deterministic compaction: position = exclusive cumsum of live
+        # lanes; dead lanes and overflow (pos >= k) fall to mode="drop" —
+        # no duplicate indices, so the first K live candidates in lane
+        # order always win
+        pos = jnp.cumsum(live.astype(jnp.int32), axis=1) - 1
+        pos = jnp.where(live, pos, 2 * k)      # out of range = dropped
+        new_act = jnp.full((b, k), -1, jnp.int32)
+        rows = jnp.broadcast_to(jnp.arange(b)[:, None], cand.shape)
+        new_act = new_act.at[rows, pos].set(cand, mode="drop")
+    else:
+        # per-row SORT: the active set is a set — order is immaterial
+        new_act = -jnp.sort(-cand, axis=1)[:, :k]
     return new_act, overflowed
 
 
-@functools.partial(jax.jit, static_argnames=("probe_len", "k_states"))
+@functools.partial(jax.jit,
+                   static_argnames=("probe_len", "k_states", "compaction"))
 def walk(trie: DeviceTrie, probes: Probes, *, probe_len: int,
-         k_states: int = 32) -> WalkResult:
+         k_states: int = 32, compaction: str = "sort") -> WalkResult:
     """Run the NFA walk for a batch of topics. See module docstring."""
     b, width = probes.tok_h1.shape
     max_levels = width - 1
@@ -205,7 +227,8 @@ def walk(trie: DeviceTrie, probes: Probes, *, probe_len: int,
 
         # 3. successors for topics that still have levels left
         new_act, overflowed = _advance(trie, probes, probe_len, b, k, i,
-                                       act, valid, allow_wc, node_rec)
+                                       act, valid, allow_wc, node_rec,
+                                       compaction)
         return new_act, hash_acc, final_acc, overflow | overflowed
 
     # dynamic trip count: stop at the longest topic actually in the batch
@@ -230,17 +253,22 @@ def count_routes(trie: DeviceTrie, result: WalkResult) -> jax.Array:
     return hash_cnt + final_cnt
 
 
-@functools.partial(jax.jit, static_argnames=("probe_len", "k_states"))
+@functools.partial(jax.jit,
+                   static_argnames=("probe_len", "k_states", "compaction"))
 def walk_and_count(trie: DeviceTrie, probes: Probes, *, probe_len: int,
-                   k_states: int = 32) -> Tuple[WalkResult, jax.Array]:
+                   k_states: int = 32, compaction: str = "sort"
+                   ) -> Tuple[WalkResult, jax.Array]:
     """Fused walk + per-topic fan-out count (bench entry point)."""
-    res = walk(trie, probes, probe_len=probe_len, k_states=k_states)
+    res = walk(trie, probes, probe_len=probe_len, k_states=k_states,
+               compaction=compaction)
     return res, count_routes(trie, res)
 
 
-@functools.partial(jax.jit, static_argnames=("probe_len", "k_states"))
+@functools.partial(jax.jit,
+                   static_argnames=("probe_len", "k_states", "compaction"))
 def walk_count_only(trie: DeviceTrie, probes: Probes, *, probe_len: int,
-                    k_states: int = 32) -> Tuple[jax.Array, jax.Array]:
+                    k_states: int = 32, compaction: str = "sort"
+                    ) -> Tuple[jax.Array, jax.Array]:
     """Walk that accumulates per-topic matched-slot counts in the loop body
     and never materializes the accept tensors — the cheapest full-match
     measurement (and the shape a pure fan-out-counting service would use).
@@ -268,7 +296,8 @@ def walk_count_only(trie: DeviceTrie, probes: Probes, *, probe_len: int,
         fin_cnt = jnp.where(is_final & valid, node_rec[..., NODE_RCOUNT], 0)
         cnt = cnt + fin_cnt.sum(axis=1, dtype=jnp.int32)
         new_act, overflowed = _advance(trie, probes, probe_len, b, k, i,
-                                       act, valid, allow_wc, node_rec)
+                                       act, valid, allow_wc, node_rec,
+                                       compaction)
         return new_act, cnt, overflow | overflowed
 
     upper = jnp.clip(jnp.max(probes.lengths, initial=-1) + 1, 0, width)

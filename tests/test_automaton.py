@@ -273,7 +273,7 @@ class TestWalkCountOnly:
                                                  max_depth=4)
             trie.add(mk_route("/".join(levels), receiver=f"r{i}"))
         tries = {"T": trie}
-        ct = compile_tries(tries, max_levels=8)
+        ct = am.compile_tries(tries, max_levels=8)
         dev = DeviceTrie.from_compiled(ct)
         topics = [workloads.gen_topic_levels(rng, names, weights, max_depth=4)
                   for _ in range(64)]
@@ -290,3 +290,44 @@ class TestWalkCountOnly:
             # matched-slot count = normal routes + distinct group matchings
             assert cnt[qi] == len(want.normal) + len(want.groups), (
                 qi, levels)
+
+
+class TestCompactionParity:
+    def test_scatter_equals_sort_on_workload(self):
+        """Both compaction strategies produce identical accepting SETS and
+        fan-out counts (order differs by design) — the scatter path must
+        never drift from the serving default."""
+        import numpy as np
+
+        from bifromq_tpu import workloads
+        from bifromq_tpu.ops.match import (DeviceTrie, Probes, walk,
+                                           walk_count_only)
+
+        tries = workloads.config_wildcard(3000, seed=7)
+        ct = am.compile_tries(tries, max_levels=8)
+        dev = DeviceTrie.from_compiled(ct)
+        topics = workloads.probe_topics(256, seed=8)
+        tok = am.tokenize(topics,
+                          [ct.root_of("tenant0")] * len(topics),
+                       max_levels=ct.max_levels, salt=ct.salt, batch=256)
+        probes = Probes.from_tokenized(tok)
+        for k in (8, 16):
+            a = walk(dev, probes, probe_len=ct.probe_len, k_states=k,
+                     compaction="sort")
+            s = walk(dev, probes, probe_len=ct.probe_len, k_states=k,
+                     compaction="scatter")
+            for qi in range(256):
+                if bool(a.overflow[qi]):
+                    assert bool(s.overflow[qi])
+                    continue
+                sa = (set(np.asarray(a.final_acc[qi]))
+                      | set(np.asarray(a.hash_acc[qi]).ravel()))
+                sb = (set(np.asarray(s.final_acc[qi]))
+                      | set(np.asarray(s.hash_acc[qi]).ravel()))
+                assert sa == sb, (k, qi)
+            ca, oa = walk_count_only(dev, probes, probe_len=ct.probe_len,
+                                     k_states=k, compaction="sort")
+            cb, ob = walk_count_only(dev, probes, probe_len=ct.probe_len,
+                                     k_states=k, compaction="scatter")
+            assert np.array_equal(np.asarray(ca), np.asarray(cb))
+            assert np.array_equal(np.asarray(oa), np.asarray(ob))
