@@ -346,6 +346,7 @@ class DistWorker:
                  tick_interval: float = 0.01,
                  split_threshold: Optional[int] = None,
                  load_split_threshold: Optional[float] = None,
+                 merge_threshold: Optional[int] = None,
                  matcher_factory=None) -> None:
         from ..kv.engine import InMemKVEngine
         from ..kv.store import KVRangeStore
@@ -382,6 +383,10 @@ class DistWorker:
             from ..kv.load import LoadSplitBalancer
             balancers.append(LoadSplitBalancer(
                 max_load_per_second=load_split_threshold))
+        if merge_threshold is not None:
+            from ..kv.balance import RangeMergeBalancer
+            balancers.append(RangeMergeBalancer(
+                min_keys=merge_threshold))
         if balancers:
             from ..kv.balance import KVStoreBalanceController
             self.balance_controller = KVStoreBalanceController(

@@ -447,6 +447,7 @@ class MQTTBroker:
                  events: Optional[IEventCollector] = None,
                  dist: Optional[DistService] = None,
                  retain_service=None, inbox_engine=None,
+                 dist_worker_kwargs=None,
                  ssl_context=None, throttler=None,
                  balancer=None, session_dict=None, mem_usage=None,
                  tls_port: Optional[int] = None, tls_ssl_context=None,
@@ -525,7 +526,8 @@ class MQTTBroker:
             dist = DistService(self.sub_brokers, self.events, self.settings,
                                worker=DistWorker(
                                    engine=engine,
-                                   raft_store_factory=raft_store_factory))
+                                   raft_store_factory=raft_store_factory,
+                                   **(dist_worker_kwargs or {})))
         self.dist = dist
         if retain_service is None:
             from ..retain.service import RetainService

@@ -20,6 +20,9 @@ Config shape (all keys optional):
       port: 7946
       seeds: ["10.0.0.1:7946"]
     dist:
+      split_threshold: 100000            # route-table elasticity knobs
+      load_split_threshold: 50000        # (per-range keys / load rate;
+      merge_threshold: 1000              #  omit to disable a balancer)
       mode: local | worker | remote      # clustered dist-plane role:
         # local  = in-process worker (default; standalone)
         # worker = host the route table here AND serve it on the RPC
@@ -104,6 +107,9 @@ class Standalone:
             # frontend with 'no endpoints for dist-worker'
             raise ValueError(f"dist.mode={dist_mode} requires a cluster "
                              "section (discovery rides gossip)")
+        elastic = {k: dist_cfg[k] for k in
+                   ("split_threshold", "load_split_threshold",
+                    "merge_threshold") if k in dist_cfg}
         dist = None
         if dist_mode == "remote":
             from .dist.remote import RemoteDistWorker
@@ -116,12 +122,21 @@ class Standalone:
                                DefaultSettingProvider(),
                                worker=RemoteDistWorker(registry))
 
+        if dist is not None and elastic:
+            # the route table lives on worker NODES in remote mode; the
+            # knobs belong in THEIR config — dropping them silently would
+            # let an operator believe splits are enabled
+            raise ValueError("dist elasticity knobs have no effect with "
+                             "dist.mode=remote; set them on the worker "
+                             "nodes instead")
+
         tcp = mqtt_cfg.get("tcp", {"port": 1883})
         tls = mqtt_cfg.get("tls")
         ws = mqtt_cfg.get("ws")
         self.broker = MQTTBroker(
             host=host, port=int(tcp.get("port", 1883)),
             inbox_engine=engine, dist=dist,
+            dist_worker_kwargs=elastic or None,
             tls_port=(int(tls.get("port", 8883)) if tls else None),
             tls_ssl_context=(_tls_context(tls) if tls else None),
             ws_port=(int(ws["port"]) if ws else None),

@@ -342,3 +342,36 @@ class TestClusteredDistPlane:
             await fb.stop()
             await fa.stop()
             await w.stop()
+
+
+class TestElasticityFromYAML:
+    async def test_split_threshold_via_starter_config(self):
+        """Route-table elasticity configured purely in YAML: enough
+        subscriptions trip the key-count split balancer."""
+        from bifromq_tpu.starter import Standalone
+
+        node = Standalone({
+            "mqtt": {"host": "127.0.0.1", "tcp": {"port": 0}},
+            "dist": {"split_threshold": 60}})
+        await node.start()
+        try:
+            worker = node.broker.dist.worker
+            assert worker.balance_controller is not None
+            c = MQTTClient("127.0.0.1", node.broker.port, client_id="ey")
+            await c.connect()
+            for i in range(100):
+                await c.subscribe(f"ey/{i:03d}/+", qos=0)
+            ok = False
+            for _ in range(100):
+                if len(worker.store.ranges) >= 2:
+                    ok = True
+                    break
+                await asyncio.sleep(0.1)
+            assert ok, worker.store.describe()
+            # routing still exact across the split
+            await c.publish("ey/042/x", b"post-split", qos=1)
+            msg = await asyncio.wait_for(c.messages.get(), 10)
+            assert msg.payload == b"post-split"
+            await c.disconnect()
+        finally:
+            await node.stop()
