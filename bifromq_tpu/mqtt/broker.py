@@ -491,9 +491,8 @@ class MQTTBroker:
             high_watermark=get(SysProp.INGRESS_SLOWDOWN_MEM_USAGE))
         # token bucket for connection-rate limiting
         # (≈ ConnectionRateLimitHandler)
-        self._conn_rate_limit = get(SysProp.MAX_CONN_PER_SECOND)
-        self._conn_tokens = float(self._conn_rate_limit)
-        self._conn_refill_at = 0.0
+        from ..utils.ratelimit import TokenBucket
+        self._conn_bucket = TokenBucket(get(SysProp.MAX_CONN_PER_SECOND))
         self.settings = settings or DefaultSettingProvider()
         self.events = events or CollectingEventCollector()
         self.local_sessions = LocalSessionRegistry()
@@ -612,19 +611,10 @@ class MQTTBroker:
         """Frontend admission stage (≈ ConnectionRateLimitHandler +
         ConditionalRejectHandler): token-bucket connection rate + process
         memory pressure. Returns the rejection event type, or None."""
-        import time as _time
-        now = _time.monotonic()
-        if self._conn_refill_at:
-            self._conn_tokens = min(
-                float(self._conn_rate_limit),
-                self._conn_tokens
-                + (now - self._conn_refill_at) * self._conn_rate_limit)
-        self._conn_refill_at = now
-        if self._conn_tokens < 1.0:
+        if not self._conn_bucket.try_take():
             return EventType.CONNECTION_RATE_EXCEEDED
         if self.mem_usage.under_pressure():
             return EventType.SERVER_BUSY
-        self._conn_tokens -= 1.0
         return None
 
     def _reject(self, writer, reason: EventType) -> None:

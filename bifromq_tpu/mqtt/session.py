@@ -201,6 +201,11 @@ class Session:
         self._outbound: Dict[int, _OutboundQoS] = {}
         self._inbound_qos2: Set[int] = set()
         self._recv_topic_alias: Dict[int, str] = {}
+        # per-session publish-rate token bucket (≈ ExceedPubRate guard,
+        # MsgPubPerSec tenant setting)
+        from ..utils.ratelimit import TokenBucket
+        self._pub_bucket = TokenBucket(
+            float(self.settings[Setting.MsgPubPerSec] or 0))
         self.last_active = time.monotonic()
         # client's receive maximum (v5) — simple in-flight cap
         self._client_recv_max = int(
@@ -388,6 +393,22 @@ class Session:
         if len(p.payload) > ts[Setting.MaxUserPayloadBytes]:
             await self.conn.protocol_error(
                 "payload too large", ReasonCode.PACKET_TOO_LARGE)
+            return
+        # QoS2 DUP retransmits of an in-flight packet are not new
+        # publishes — they must never drain the rate bucket
+        is_qos2_dup = p.qos == 2 and p.packet_id in self._inbound_qos2
+        if self._pub_bucket.rate > 0 and not is_qos2_dup \
+                and not self._pub_bucket.try_take():
+            # the reference treats sustained over-rate publishing as a
+            # session-fatal violation (ExceedPubRate → disconnect)
+            self.events.report(Event(
+                EventType.EXCEED_PUB_RATE,
+                self.client_info.tenant_id,
+                {"client_id": self.client_id,
+                 "limit": self._pub_bucket.rate}))
+            await self.conn.disconnect_with(
+                ReasonCode.MESSAGE_RATE_TOO_HIGH
+                if self.protocol_level >= PROTOCOL_MQTT5 else 0)
             return
         from ..plugin.throttler import TenantResourceType
         if not self.throttler.has_resource(

@@ -80,6 +80,8 @@ class EventType(enum.Enum):
     # outbound-ack family (≈ QoS1PubAcked / QoS2PubReced)
     PUB_ACKED = "pub_acked"
     PUB_RECED = "pub_reced"
+    # publish-rate guard (≈ ExceedPubRate)
+    EXCEED_PUB_RATE = "exceed_pub_rate"
 
 
 @dataclass

@@ -1,0 +1,26 @@
+"""Token bucket (the ONE rate-limiter impl: connection admission and
+per-session publish rate share it — two hand-rolled copies drift)."""
+
+from __future__ import annotations
+
+import time
+
+
+class TokenBucket:
+    def __init__(self, rate: float, *, capacity: float = None,
+                 clock=time.monotonic) -> None:
+        self.rate = float(rate)
+        self.capacity = float(capacity if capacity is not None else rate)
+        self.tokens = self.capacity
+        self.clock = clock
+        self._refill_at = clock()
+
+    def try_take(self, n: float = 1.0) -> bool:
+        now = self.clock()
+        self.tokens = min(self.capacity,
+                          self.tokens + (now - self._refill_at) * self.rate)
+        self._refill_at = now
+        if self.tokens < n:
+            return False
+        self.tokens -= n
+        return True

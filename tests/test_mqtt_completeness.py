@@ -304,3 +304,53 @@ class TestOutboundTopicAlias:
             await p.disconnect()
         finally:
             await broker.stop()
+
+
+class TestPubRateGuard:
+    async def test_exceed_pub_rate_disconnects(self):
+        """≈ ExceedPubRate: sustained publishing beyond MsgPubPerSec is a
+        session-fatal violation; compliant publishers are untouched."""
+        from bifromq_tpu.plugin.events import (CollectingEventCollector,
+                                               EventType)
+        from bifromq_tpu.plugin.settings import (DefaultSettingProvider,
+                                                 Setting)
+
+        class LowRate(DefaultSettingProvider):
+            def provide(self, setting, tenant_id):
+                if setting is Setting.MsgPubPerSec:
+                    return 5
+                return super().provide(setting, tenant_id)
+
+        ev = CollectingEventCollector()
+        broker = MQTTBroker(host="127.0.0.1", port=0, settings=LowRate(),
+                            events=ev)
+        await broker.start()
+        try:
+            c = MQTTClient("127.0.0.1", broker.port, client_id="flood",
+                           protocol_level=5)
+            await c.connect()
+            # the bucket starts full (5 tokens); a burst beyond it dies
+            disconnected = False
+            for i in range(20):
+                try:
+                    await c.publish(f"fl/{i}", b"x", qos=0)
+                except Exception:
+                    disconnected = True
+                    break
+                await asyncio.sleep(0)
+            await asyncio.wait_for(c.closed.wait(), 5)
+            assert disconnected or c.closed.is_set()
+            types = {e.type for e in ev.events}
+            assert EventType.EXCEED_PUB_RATE in types
+            # a compliant client (within rate) keeps working
+            ok = MQTTClient("127.0.0.1", broker.port, client_id="calm")
+            await ok.connect()
+            await ok.subscribe("calm/t", qos=0)
+            for i in range(3):
+                await ok.publish("calm/t", b"fine", qos=1)
+                m = await asyncio.wait_for(ok.messages.get(), 5)
+                assert m.payload == b"fine"
+                await asyncio.sleep(0.25)
+            await ok.disconnect()
+        finally:
+            await broker.stop()
