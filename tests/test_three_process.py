@@ -39,18 +39,20 @@ def _free_ports(n):
     return ports
 
 
-def _spawn(node, port, peers):
+def _spawn(node, port, peers, *extra_argv):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
     proc = subprocess.Popen(
         [sys.executable, "-m", "bifromq_tpu.kv.store_main",
          "--node", node, "--port", str(port), "--peers", peers,
-         "--tick-interval", "0.01"],
+         "--tick-interval", "0.01", *extra_argv],
         cwd=REPO, env=env, stdout=subprocess.PIPE,
         stderr=subprocess.DEVNULL, text=True)
     line = proc.stdout.readline().strip()
-    assert line.startswith("READY "), line
+    if not line.startswith("READY "):
+        proc.kill()     # failed child must not outlive the assert
+        raise AssertionError(f"no READY from {node}: {line!r}")
     return proc
 
 
@@ -224,4 +226,38 @@ class TestRetainStoreProcess:
             assert [t for t, _m in hits] == ["sensors/2/temp"]
         finally:
             _kill_cluster(procs)
+            await registry.close()
+
+
+class TestDurableStoreProcess:
+    async def test_sigkill_restart_resumes_from_wal(self, tmp_path):
+        """A store process with --data-dir (native C++ engine + durable
+        raft) is SIGKILLed and restarted on the SAME directory: acked
+        writes survive in the WAL-backed spaces."""
+        port = _free_ports(1)[0]
+        peers = f"d1=127.0.0.1:{port}"
+        data = str(tmp_path / "store")
+
+        proc = _spawn("d1", port, peers, "--data-dir", data)
+        registry = ServiceRegistry()
+        client = ClusterKVClient(MetaService(), registry,
+                                 seeds=[f"127.0.0.1:{port}"])
+        try:
+            for i in range(50):
+                out = await client.mutate(b"wal%02d" % i,
+                                          b"wal%02d=v%d" % (i, i))
+                assert out.startswith(b"ok"), out
+            proc.kill()
+            proc.wait(timeout=10)
+            proc = _spawn("d1", port, peers, "--data-dir", data)
+            await client.refresh_remote()
+            for i in (0, 25, 49):
+                got = await client.query(b"wal%02d" % i, b"wal%02d" % i)
+                assert got == b"v%d" % i, (i, got)
+        finally:
+            proc.kill()
+            try:
+                proc.wait(timeout=5)
+            except Exception:
+                pass
             await registry.close()
