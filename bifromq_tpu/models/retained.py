@@ -78,7 +78,7 @@ class RetainedIndex:
     """
 
     def __init__(self, *, max_levels: int = 16, k_states: int = 32,
-                 probe_len: int = 8, device=None) -> None:
+                 probe_len: int = 32, device=None) -> None:
         self.max_levels = max_levels
         self.k_states = k_states
         self.probe_len = probe_len

@@ -7,16 +7,20 @@ This replaces the reference's per-PUBLISH iterator join
 - B topics × K active NFA states advance one topic level per step
   (``lax.fori_loop`` over max_levels+1 static iterations — XLA-friendly, no
   data-dependent control flow).
-- Literal-edge lookup = ``probe_len`` linear probes of the open-addressing
-  edge table: one [B,K,4] row gather per probe.
-- '+' / '#' transitions = one packed node-record gather per step.
-- Successor compaction to K slots: per-row SORT by default (bitonic,
-  VPU-friendly); a mask+cumsum+scatter alternative is selectable for
-  on-hardware A/B (``compaction="scatter"``).
+- Literal-edge lookup = ONE contiguous bucket-row gather of the
+  single-choice hash table (TPU gather cost is per-index, not per-byte).
+- '+' / '#' transitions = one packed node-record gather per step; the '#'
+  child's route count is folded into the parent record (NODE_HRCOUNT) so
+  counting costs no extra gather.
+- Successor compaction to K slots: per-row descending sort via a static
+  bitonic compare-exchange network (_bitonic_desc — XLA's generic sort
+  lowering measured 10x slower); a mask+cumsum+scatter alternative is
+  selectable for on-hardware A/B (``compaction="scatter"``).
 - Topics whose active set would exceed K set an overflow flag and are
-  re-matched on the host oracle — the same bounded-work-then-fallback contract
-  the reference's 20-probe seek heuristic embodies
-  (TenantRouteMatcher.java:129-136).
+  re-walked on device at higher K in a fused escalation pass
+  (walk_count_only); only rows that exceed even that fall back to the host
+  oracle — the same bounded-work-then-fallback contract the reference's
+  20-probe seek heuristic embodies (TenantRouteMatcher.java:129-136).
 
 Outputs are accepting *node ids*; route expansion to delivery targets happens
 host-side (models.automaton matchings), while fan-out counting stays on device
@@ -115,39 +119,57 @@ def _mix_u32(node: jax.Array, h1: jax.Array, h2: jax.Array) -> jax.Array:
     return x
 
 
-def _mix2_u32(node: jax.Array, h1: jax.Array, h2: jax.Array) -> jax.Array:
-    """MUST stay in sync with models.automaton._mix2_u32."""
-    x = node.astype(jnp.uint32) * jnp.uint32(0x7FEB352D)
-    x = x ^ (h2.astype(jnp.uint32) * jnp.uint32(0x846CA68B))
-    x = x ^ (x >> jnp.uint32(16))
-    x = x * jnp.uint32(0x9E3779B1)
-    x = x ^ (h1.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35))
-    x = x ^ (x >> jnp.uint32(14))
-    return x
-
-
 def _edge_lookup(edge_tab: jax.Array, probe_len: int, node: jax.Array,
                  h1: jax.Array, h2: jax.Array) -> jax.Array:
     """Exact literal-child lookup; node/h1/h2 are [B,K]; returns child or -1.
 
-    The edge table is two-choice bucketed ([NB, P, 4],
-    automaton._build_edge_table): a key lives in one of its two candidate
-    buckets, so the lookup is exactly two contiguous bucket-row gathers —
-    TPU gather cost is per-index, not per-byte, so fetching a whole 128-byte
-    bucket costs the same as one element.
+    The edge table is single-choice bucketed ([NB, P, 4],
+    automaton._build_edge_table): every key lives in bucket mix1(key), so
+    the lookup is exactly ONE contiguous bucket-row gather — TPU gather
+    cost is per-index, not per-byte, so fetching a whole bucket row (512B
+    at the default probe_len=32) costs the same as one element (and the
+    old second-choice gather measured ~12ms/batch on v5e).
     """
     nb = edge_tab.shape[0]
     mask = jnp.uint32(nb - 1)
     flat = edge_tab.reshape(nb, probe_len * 4)
     b1 = (_mix_u32(node, h1, h2) & mask).astype(jnp.int32)
-    b2 = (_mix2_u32(node, h1, h2) & mask).astype(jnp.int32)
-    shape = node.shape + (probe_len, 4)
-    rows = jnp.concatenate([flat[b1].reshape(shape),
-                            flat[b2].reshape(shape)], axis=-2)  # [B,K,2P,4]
+    rows = flat[b1].reshape(node.shape + (probe_len, 4))  # [B,K,P,4]
     hit = ((rows[..., 0] == node[..., None])
            & (rows[..., 1] == h1[..., None])
            & (rows[..., 2] == h2[..., None]))
     return jnp.max(jnp.where(hit, rows[..., 3], -1), axis=-1)
+
+
+def _bitonic_desc(x: jax.Array) -> jax.Array:
+    """Descending sort along axis 1 as a static compare-exchange network.
+
+    XLA's generic variadic-sort lowering measured ~3.9ms/step on v5e for
+    [8192, 32] int32; this network is nothing but static lane permutations
+    and min/max, which the Mosaic/XLA backend turns into cheap vector
+    shuffles. Non-power-of-two widths (e.g. k_states=6 -> 12 candidate
+    lanes) are padded with INT32_MIN, which sorts past every real value
+    including the -1 empty marker; the caller's [:, :k] slice never sees
+    the pad lanes."""
+    orig = x.shape[1]
+    n = 1 << (orig - 1).bit_length()
+    if n != orig:
+        pad = jnp.full((x.shape[0], n - orig), jnp.iinfo(jnp.int32).min,
+                       dtype=x.dtype)
+        x = jnp.concatenate([x, pad], axis=1)
+    lane = np.arange(n)
+    stage = 2
+    while stage <= n:
+        step = stage // 2
+        while step >= 1:
+            partner = lane ^ step
+            y = x[:, partner]
+            take_max = jnp.asarray(((lane & stage) == 0)
+                                   == (lane < partner))[None, :]
+            x = jnp.where(take_max, jnp.maximum(x, y), jnp.minimum(x, y))
+            step //= 2
+        stage *= 2
+    return x
 
 
 def _advance(trie: DeviceTrie, probes: Probes, probe_len: int, b: int,
@@ -156,25 +178,33 @@ def _advance(trie: DeviceTrie, probes: Probes, probe_len: int, b: int,
     """One NFA step: literal + '+' successors, compacted to K slots.
 
     Shared by walk() and walk_count_only() so the successor semantics have
-    exactly one definition. Returns (new_act [B,K], overflowed [B]).
+    exactly one definition. ``act`` may be narrower than K ([B, cap] for
+    the progressively-widening prefix steps — after s steps at most 2^s
+    states are active, so early steps gather far fewer indices); when the
+    2*cap candidates still fit in K, no compaction happens and overflow is
+    statically impossible. Returns (new_act [B, min(2*cap, K)],
+    overflowed [B]).
 
     ``compaction`` picks the compaction strategy (A/B-able on real
     hardware via the bench's BENCH_COMPACTION knob):
-    - "sort": per-row bitonic sort of 2K lanes — vectorizes on the TPU
-      VPU; descending order puts valid nodes first.
+    - "sort": per-row descending sort of 2K lanes via a static bitonic
+      compare-exchange network (vectorizes on the TPU VPU).
     - "scatter": mask + cumsum + one scatter per row — fewer total ops
       but the scatter can serialize on some backends.
     """
+    cap = act.shape[1]
     stepping = (i < probes.lengths)[:, None]
     h1 = jnp.broadcast_to(
-        jax.lax.dynamic_index_in_dim(probes.tok_h1, i, axis=1), (b, k))
+        jax.lax.dynamic_index_in_dim(probes.tok_h1, i, axis=1), (b, cap))
     h2 = jnp.broadcast_to(
-        jax.lax.dynamic_index_in_dim(probes.tok_h2, i, axis=1), (b, k))
+        jax.lax.dynamic_index_in_dim(probes.tok_h2, i, axis=1), (b, cap))
     exact = _edge_lookup(trie.edge_tab, probe_len, act.clip(0), h1, h2)
     exact = jnp.where(stepping & valid, exact, -1)
     plus = jnp.where(stepping & valid & allow_wc,
                      node_rec[..., NODE_PLUS], -1)
-    cand = jnp.concatenate([exact, plus], axis=1)        # [B,2K]
+    cand = jnp.concatenate([exact, plus], axis=1)        # [B,2*cap]
+    if 2 * cap <= k:
+        return cand, jnp.zeros((b,), dtype=bool)
     overflowed = (cand >= 0).sum(axis=1) > k
     if compaction == "scatter":
         live = cand >= 0
@@ -189,7 +219,7 @@ def _advance(trie: DeviceTrie, probes: Probes, probe_len: int, b: int,
         new_act = new_act.at[rows, pos].set(cand, mode="drop")
     else:
         # per-row SORT: the active set is a set — order is immaterial
-        new_act = -jnp.sort(-cand, axis=1)[:, :k]
+        new_act = _bitonic_desc(cand)[:, :k]
     return new_act, overflowed
 
 
@@ -202,28 +232,29 @@ def walk(trie: DeviceTrie, probes: Probes, *, probe_len: int,
     max_levels = width - 1
     k = k_states
 
-    act0 = jnp.full((b, k), -1, dtype=jnp.int32)
-    act0 = act0.at[:, 0].set(jnp.where(probes.lengths >= 0, probes.roots, -1))
-    hash_acc0 = jnp.full((b, max_levels + 1, k), -1, dtype=jnp.int32)
-    final_acc0 = jnp.full((b, k), -1, dtype=jnp.int32)
-    overflow0 = jnp.zeros((b,), dtype=bool)
+    def pad_k(x):
+        cap = x.shape[1]
+        if cap == k:
+            return x
+        return jnp.concatenate(
+            [x, jnp.full((b, k - cap), -1, jnp.int32)], axis=1)
 
-    def body(i, carry):
-        act, hash_acc, final_acc, overflow = carry
+    def step(i, act, hash_acc, final_acc, overflow):
         in_range = (i <= probes.lengths)[:, None]           # [B,1]
-        valid = (act >= 0) & in_range                       # [B,K]
+        valid = (act >= 0) & in_range                       # [B,cap]
         # [MQTT-4.7.2-1]: block the root's wildcard children for '$'-topics
         allow_wc = jnp.logical_not(probes.sys_mask & (i == 0))[:, None]
-        node_rec = trie.node_tab[act.clip(0)]               # [B,K,NODE_COLS]
+        node_rec = trie.node_tab[act.clip(0)]               # [B,cap,NODE_COLS]
 
         # 1. '#'-child accepts: match regardless of remaining levels
         hc = jnp.where(valid & allow_wc, node_rec[..., NODE_HASH], -1)
         hash_acc = jax.lax.dynamic_update_slice_in_dim(
-            hash_acc, hc[:, None, :], i, axis=1)
+            hash_acc, pad_k(hc)[:, None, :], i, axis=1)
 
         # 2. final accepts once the whole topic is consumed
         is_final = (i == probes.lengths)[:, None]
-        final_acc = jnp.where(is_final, jnp.where(valid, act, -1), final_acc)
+        final_acc = jnp.where(is_final, pad_k(jnp.where(valid, act, -1)),
+                              final_acc)
 
         # 3. successors for topics that still have levels left
         new_act, overflowed = _advance(trie, probes, probe_len, b, k, i,
@@ -231,11 +262,25 @@ def walk(trie: DeviceTrie, probes: Probes, *, probe_len: int,
                                        compaction)
         return new_act, hash_acc, final_acc, overflow | overflowed
 
-    # dynamic trip count: stop at the longest topic actually in the batch
-    # (lowered to a while loop; the padded tail of short batches costs nothing)
-    upper = jnp.clip(jnp.max(probes.lengths, initial=-1) + 1, 0, max_levels + 1)
-    act, hash_acc, final_acc, overflow = jax.lax.fori_loop(
-        0, upper, body, (act0, hash_acc0, final_acc0, overflow0))
+    hash_acc = jnp.full((b, max_levels + 1, k), -1, dtype=jnp.int32)
+    final_acc = jnp.full((b, k), -1, dtype=jnp.int32)
+    overflow = jnp.zeros((b,), dtype=bool)
+    # progressively-widening unrolled prefix (see _count_walk): at most 2^s
+    # states live after s steps, so early steps run with narrow lanes.
+    act = jnp.where(probes.lengths >= 0, probes.roots, -1)[:, None]
+    i = 0
+    while act.shape[1] < k and i < width:
+        act, hash_acc, final_acc, overflow = step(
+            jnp.int32(i), act, hash_acc, final_acc, overflow)
+        i += 1
+    if i < width:
+        def body(j, carry):
+            return step(j, *carry)
+        # dynamic trip count: stop at the longest topic actually in the
+        # batch (lowered to a while loop; short batches' tail costs nothing)
+        upper = jnp.clip(jnp.max(probes.lengths, initial=-1) + 1, i, width)
+        act, hash_acc, final_acc, overflow = jax.lax.fori_loop(
+            i, upper, body, (act, hash_acc, final_acc, overflow))
     return WalkResult(hash_acc=hash_acc, final_acc=final_acc,
                       overflow=overflow)
 
@@ -264,33 +309,29 @@ def walk_and_count(trie: DeviceTrie, probes: Probes, *, probe_len: int,
     return res, count_routes(trie, res)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("probe_len", "k_states", "compaction"))
-def walk_count_only(trie: DeviceTrie, probes: Probes, *, probe_len: int,
-                    k_states: int = 32, compaction: str = "sort"
-                    ) -> Tuple[jax.Array, jax.Array]:
-    """Walk that accumulates per-topic matched-slot counts in the loop body
-    and never materializes the accept tensors — the cheapest full-match
-    measurement (and the shape a pure fan-out-counting service would use).
+def _count_walk(trie: DeviceTrie, probes: Probes, probe_len: int,
+                k_states: int, compaction: str
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Count-only walk body (shared by the primary and escalation passes):
+    accumulates per-topic matched-slot counts in the loop and never
+    materializes the accept tensors — the cheapest full-match measurement
+    (and the shape a pure fan-out-counting service would use).
+
+    '#'-accept counting reads the NODE_HRCOUNT column (the hash child's
+    route count folded into the parent record at compile time) — on v5e the
+    separate hash-child gather was ~half the whole walk's time.
     Returns ([B] counts, [B] overflow)."""
-    from ..models.automaton import NODE_RCOUNT
+    from ..models.automaton import NODE_HRCOUNT
 
     b, width = probes.tok_h1.shape
     k = k_states
 
-    act0 = jnp.full((b, k), -1, dtype=jnp.int32)
-    act0 = act0.at[:, 0].set(jnp.where(probes.lengths >= 0, probes.roots, -1))
-    cnt0 = jnp.zeros((b,), dtype=jnp.int32)
-    overflow0 = jnp.zeros((b,), dtype=bool)
-
-    def body(i, carry):
-        act, cnt, overflow = carry
+    def step(i, act, cnt, overflow):
         in_range = (i <= probes.lengths)[:, None]
         valid = (act >= 0) & in_range
         allow_wc = jnp.logical_not(probes.sys_mask & (i == 0))[:, None]
         node_rec = trie.node_tab[act.clip(0)]
-        hc = jnp.where(valid & allow_wc, node_rec[..., NODE_HASH], -1)
-        hc_cnt = jnp.where(hc >= 0, trie.node_tab[hc.clip(0), NODE_RCOUNT], 0)
+        hc_cnt = jnp.where(valid & allow_wc, node_rec[..., NODE_HRCOUNT], 0)
         cnt = cnt + hc_cnt.sum(axis=1, dtype=jnp.int32)
         is_final = (i == probes.lengths)[:, None]
         fin_cnt = jnp.where(is_final & valid, node_rec[..., NODE_RCOUNT], 0)
@@ -300,7 +341,80 @@ def walk_count_only(trie: DeviceTrie, probes: Probes, *, probe_len: int,
                                        compaction)
         return new_act, cnt, overflow | overflowed
 
-    upper = jnp.clip(jnp.max(probes.lengths, initial=-1) + 1, 0, width)
-    _, cnt, overflow = jax.lax.fori_loop(0, upper, body,
-                                         (act0, cnt0, overflow0))
+    # progressively-widening unrolled prefix: after s steps at most 2^s
+    # states can be active, so early steps run with 1, 2, 4, ... lanes —
+    # gathers are the whole walk cost (~14.5ns/index on v5e) and this
+    # nearly halves the total index count (112 -> 63 per topic at K=16).
+    # Steps past a topic's length are per-row no-ops, so running the
+    # prefix unconditionally is semantics-preserving.
+    act = jnp.where(probes.lengths >= 0, probes.roots, -1)[:, None]
+    cnt = jnp.zeros((b,), dtype=jnp.int32)
+    overflow = jnp.zeros((b,), dtype=bool)
+    i = 0
+    while act.shape[1] < k and i < width:
+        act, cnt, overflow = step(jnp.int32(i), act, cnt, overflow)
+        i += 1
+    if i < width:
+        def body(j, carry):
+            return step(j, *carry)
+        upper = jnp.clip(jnp.max(probes.lengths, initial=-1) + 1, i, width)
+        act, cnt, overflow = jax.lax.fori_loop(i, upper, body,
+                                               (act, cnt, overflow))
     return cnt, overflow
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("probe_len", "k_states", "compaction",
+                                    "esc_k", "esc_rows"))
+def walk_count_only(trie: DeviceTrie, probes: Probes, *, probe_len: int,
+                    k_states: int = 32, compaction: str = "sort",
+                    esc_k=None, esc_rows=None
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Count-only walk + fused on-device overflow escalation.
+
+    Overflowed topics (active set > k_states) are re-walked ON DEVICE in the
+    same jit call: up to ``esc_rows`` overflow rows (default b/64, min 64)
+    are compacted into a small sub-batch and run at ``esc_k`` states
+    (default 2*k_states, capped at 128). Only rows that overflow even at
+    esc_k — or beyond the esc_rows budget — report overflow to the host
+    fallback. This replaces a ~360 topics/s host-oracle penalty with a
+    small second device pass (measured free at [128 rows, 32 states]
+    against an [8192, 16] primary on v5e) that lax.cond skips entirely
+    when nothing overflowed.
+
+    Returns ([B] counts, [B] overflow)."""
+    b = probes.tok_h1.shape[0]
+    cnt, overflow = _count_walk(trie, probes, probe_len, k_states, compaction)
+    if esc_k is None:
+        esc_k = min(2 * k_states, 128)
+    if not esc_k or esc_k <= k_states:
+        return cnt, overflow
+    if esc_rows is None:
+        esc_rows = max(64, b // 64)
+    e = min(esc_rows, b)
+
+    def escalate(args):
+        cnt, overflow = args
+        n_found = overflow.sum(dtype=jnp.int32)
+        idx = jnp.nonzero(overflow, size=e, fill_value=0)[0]
+        sel = jnp.arange(e) < n_found
+        sub = Probes(
+            tok_h1=probes.tok_h1[idx],
+            tok_h2=probes.tok_h2[idx],
+            lengths=jnp.where(sel, probes.lengths[idx], -1),
+            roots=probes.roots[idx],
+            sys_mask=probes.sys_mask[idx],
+        )
+        cnt2, ovf2 = _count_walk(trie, sub, probe_len, esc_k, compaction)
+        success = sel & jnp.logical_not(ovf2)
+        # duplicate pad indices (fill 0) make plain scatter-set racy;
+        # max-combining is order-independent: pads contribute 0/False
+        succ_full = jnp.zeros(b, jnp.int32).at[idx].max(
+            success.astype(jnp.int32)).astype(bool)
+        cnt2_full = jnp.zeros_like(cnt).at[idx].max(
+            jnp.where(success, cnt2, 0))
+        return (jnp.where(succ_full, cnt2_full, cnt),
+                overflow & jnp.logical_not(succ_full))
+
+    return jax.lax.cond(overflow.any(), escalate, lambda a: a,
+                        (cnt, overflow))

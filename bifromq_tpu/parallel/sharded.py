@@ -62,7 +62,7 @@ class ShardedTables:
 
 
 def build_sharded(tries: Dict[str, SubscriptionTrie], n_shards: int, *,
-                  max_levels: int = 16, probe_len: int = 8) -> ShardedTables:
+                  max_levels: int = 16, probe_len: int = 32) -> ShardedTables:
     """Compile each tenant shard with a common edge-table capacity.
 
     All shards share one edge-table size (power of two) so the device-side
@@ -76,8 +76,8 @@ def build_sharded(tries: Dict[str, SubscriptionTrie], n_shards: int, *,
                 for s in by_shard]
     # common bucket count: the mixing mask must be identical across shards
     cap = max(ct.edge_tab.shape[0] for ct in compiled)
-    # re-sync: growing one shard to `cap` can itself grow (eviction spill);
-    # iterate until all bucket counts agree.
+    # re-sync: rebuilding one shard at `cap` can itself overflow a bucket
+    # and grow past it; iterate until all bucket counts agree.
     while True:
         compiled = [
             ct if ct.edge_tab.shape[0] == cap else compile_tries(
@@ -174,7 +174,7 @@ class MeshMatcher(TpuMatcher):
 
     def __init__(self, tries: Optional[Dict[str, SubscriptionTrie]] = None,
                  mesh: Optional[Mesh] = None, *,
-                 max_levels: int = 16, probe_len: int = 8,
+                 max_levels: int = 16, probe_len: int = 32,
                  k_states: int = 32, auto_compact: bool = True,
                  compact_threshold: int = 2048) -> None:
         assert mesh is not None, "MeshMatcher requires a mesh"

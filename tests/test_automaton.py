@@ -88,18 +88,15 @@ class TestCompile:
             trie.add(mk_route(lv, receiver=lv))
         ct = am.compile_tries({"t": trie})
         root = ct.root_of("t")
-        # every literal level must be findable in one of its two buckets
+        # every literal level must be findable in its single-choice bucket
         tab = ct.edge_tab
         nb = tab.shape[0]
         for lv in levels:
             h1, h2 = am.level_hash(lv, ct.salt)
             args = (np.int32(root), np.int32(h1), np.int32(h2))
-            found = False
-            for b in (int(am._mix_u32(*args) & np.uint32(nb - 1)),
-                      int(am._mix2_u32(*args) & np.uint32(nb - 1))):
-                for row in tab[b]:
-                    if row[0] == root and row[1] == h1 and row[2] == h2:
-                        found = True
+            b = int(am._mix_u32(*args) & np.uint32(nb - 1))
+            found = any(row[0] == root and row[1] == h1 and row[2] == h2
+                        for row in tab[b])
             assert found, lv
 
 
@@ -331,3 +328,117 @@ class TestCompactionParity:
                                      k_states=k, compaction="scatter")
             assert np.array_equal(np.asarray(ca), np.asarray(cb))
             assert np.array_equal(np.asarray(oa), np.asarray(ob))
+
+
+class TestOverflowEscalation:
+    def test_escalation_recovers_on_device(self):
+        """Topics that overflow k_states=2 re-walk at esc_k on device and
+        report oracle-exact counts with no overflow flag; esc_k=0 restores
+        the old always-fall-back behavior."""
+        import numpy as np
+
+        from bifromq_tpu.models.automaton import tokenize
+        from bifromq_tpu.models.oracle import SubscriptionTrie
+        from bifromq_tpu.ops.match import (DeviceTrie, Probes,
+                                           walk_count_only)
+
+        trie = SubscriptionTrie()
+        # many overlapping wildcard filters -> wide NFA active sets
+        filters = ["a/+/c", "a/b/+", "+/b/c", "a/b/c", "+/+/c", "a/+/+",
+                   "+/b/+", "+/+/+", "a/#", "#"]
+        for i, f in enumerate(filters):
+            trie.add(mk_route(f, receiver=f"r{i}"))
+        tries = {"T": trie}
+        ct = am.compile_tries(tries, max_levels=8)
+        dev = DeviceTrie.from_compiled(ct)
+        topics = [["a", "b", "c"], ["x", "b", "c"], ["a", "q", "c"],
+                  ["z", "z", "z"]] * 16
+        tok = tokenize(topics, [ct.root_of("T")] * len(topics),
+                       max_levels=8, salt=ct.salt)
+        probes = Probes.from_tokenized(tok)
+        base_cnt, base_ovf = walk_count_only(
+            dev, probes, probe_len=ct.probe_len, k_states=2, esc_k=0)
+        assert np.asarray(base_ovf).any(), "k=2 must overflow this workload"
+        cnt, ovf = walk_count_only(dev, probes, probe_len=ct.probe_len,
+                                   k_states=2, esc_k=16)
+        ovf = np.asarray(ovf)
+        assert not ovf.any()
+        cnt = np.asarray(cnt)
+        for qi, levels in enumerate(topics):
+            want = trie.match(levels)
+            assert cnt[qi] == len(want.normal) + len(want.groups), (
+                qi, levels)
+
+    def test_escalation_budget_exhaustion_still_flags(self):
+        """More overflow rows than esc_rows: the excess keeps the overflow
+        flag (host fallback), the budgeted rows recover."""
+        import numpy as np
+
+        from bifromq_tpu.models.automaton import tokenize
+        from bifromq_tpu.models.oracle import SubscriptionTrie
+        from bifromq_tpu.ops.match import (DeviceTrie, Probes,
+                                           walk_count_only)
+
+        trie = SubscriptionTrie()
+        filters = ["a/+/c", "a/b/+", "+/b/c", "+/+/c", "a/+/+", "+/b/+",
+                   "+/+/+", "a/b/c"]
+        for i, f in enumerate(filters):
+            trie.add(mk_route(f, receiver=f"r{i}"))
+        ct = am.compile_tries({"T": trie}, max_levels=8)
+        dev = DeviceTrie.from_compiled(ct)
+        topics = [["a", "b", "c"]] * 64  # every row overflows k=2
+        tok = tokenize(topics, [ct.root_of("T")] * 64,
+                       max_levels=8, salt=ct.salt)
+        probes = Probes.from_tokenized(tok)
+        cnt, ovf = walk_count_only(dev, probes, probe_len=ct.probe_len,
+                                   k_states=2, esc_k=16, esc_rows=16)
+        ovf = np.asarray(ovf)
+        assert ovf.sum() == 64 - 16
+        want = trie.match(["a", "b", "c"])
+        expect = len(want.normal) + len(want.groups)
+        cnt = np.asarray(cnt)
+        assert (cnt[~ovf] == expect).all()
+
+
+class TestBitonicNetwork:
+    def test_matches_jnp_sort_descending(self):
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from bifromq_tpu.ops.match import _bitonic_desc
+
+        rng = np.random.default_rng(42)
+        for width in (2, 4, 8, 16, 32, 64, 128):
+            x = rng.integers(-1, 1 << 20, (37, width), dtype=np.int32)
+            got = np.asarray(_bitonic_desc(jnp.asarray(x)))
+            want = -np.sort(-x, axis=1)
+            assert np.array_equal(got, want), width
+
+    def test_non_power_of_two_k_states(self):
+        """k_states that aren't powers of two (e.g. 6, 24) must work with
+        the default sort compaction (regression: the bitonic network
+        asserted power-of-two width)."""
+        import numpy as np
+
+        from bifromq_tpu import workloads
+        from bifromq_tpu.models.automaton import tokenize
+        from bifromq_tpu.ops.match import (DeviceTrie, Probes,
+                                           walk_count_only)
+
+        tries = workloads.config_wildcard(2000, seed=3)
+        ct = am.compile_tries(tries, max_levels=8)
+        dev = DeviceTrie.from_compiled(ct)
+        topics = workloads.probe_topics(128, seed=4)
+        tok = tokenize(topics, [ct.root_of("tenant0")] * len(topics),
+                       max_levels=ct.max_levels, salt=ct.salt, batch=128)
+        probes = Probes.from_tokenized(tok)
+        ref_cnt, ref_ovf = walk_count_only(dev, probes,
+                                           probe_len=ct.probe_len,
+                                           k_states=32, esc_k=0)
+        for k in (6, 24):
+            cnt, ovf = walk_count_only(dev, probes, probe_len=ct.probe_len,
+                                       k_states=k, esc_k=0)
+            ok = ~np.asarray(ovf) & ~np.asarray(ref_ovf)
+            assert np.array_equal(np.asarray(cnt)[ok],
+                                  np.asarray(ref_cnt)[ok]), k
