@@ -39,12 +39,32 @@ import numpy as np
 from ..utils import topic as topic_util
 from .automaton import (
     NODE_RCOUNT, NODE_RSTART, CompiledTrie, GroupMatching, Matching,
-    compile_tries, tokenize,
+    TokenizedTopics, compile_tries, tokenize,
 )
 from .oracle import (
     PERSISTENT_SUB_BROKER_ID, UNCAPPED_FANOUT, MatchedRoutes, Route,
     SubscriptionTrie,
 )
+
+
+def _pow2_batch(n: int, floor: int = 16) -> int:
+    """Snap a batch size up to a power of two: every distinct batch shape
+    costs an XLA compile, so live traffic must reuse a small set of
+    shapes."""
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+def _pad_rows(a: np.ndarray, rows: int, fill=0) -> np.ndarray:
+    """Pad a row-gathered array up to ``rows`` rows (escalation sub-batch
+    shapes snap to powers of two so live traffic reuses XLA compiles)."""
+    if a.shape[0] == rows:
+        return a
+    out = np.full((rows,) + a.shape[1:], fill, dtype=a.dtype)
+    out[:a.shape[0]] = a
+    return out
 
 # tombstone key: (full mqtt topic filter incl. any share prefix, receiver_url)
 _TombKey = Tuple[str, Tuple[int, str, str]]
@@ -266,11 +286,7 @@ class TpuMatcher:
             self.refresh()
         ct = self._base_ct
         if batch is None:
-            # pad to power-of-two buckets: every distinct batch shape costs an
-            # XLA compile, so live traffic must reuse a small set of shapes
-            batch = 16
-            while batch < len(queries):
-                batch *= 2
+            batch = _pow2_batch(len(queries))
         roots = [ct.root_of(t) for t, _ in queries]
         tok = tokenize([levels for _, levels in queries], roots,
                        max_levels=ct.max_levels, salt=ct.salt, batch=batch)
@@ -280,6 +296,34 @@ class TpuMatcher:
         hash_acc = np.asarray(res.hash_acc)
         final_acc = np.asarray(res.final_acc)
         overflow = np.asarray(res.overflow)
+
+        # device-side escalation: rows whose active set overflowed k_states
+        # re-walk in one compacted sub-batch at a higher state budget — the
+        # device walk is orders of magnitude faster than the host-trie
+        # fallback (~360 topics/s measured), so only rows that overflow
+        # even esc_k fall through to the oracle below.
+        esc_nodes = {}
+        esc_k = min(4 * self.k_states, 128)
+        ovf_rows = np.nonzero(overflow[:len(queries)]
+                              & (tok.lengths[:len(queries)] >= 0))[0]
+        if len(ovf_rows) and esc_k > self.k_states:
+            eb = _pow2_batch(len(ovf_rows))
+            sub = Probes.from_tokenized(TokenizedTopics(
+                tok_h1=_pad_rows(tok.tok_h1[ovf_rows], eb),
+                tok_h2=_pad_rows(tok.tok_h2[ovf_rows], eb),
+                lengths=_pad_rows(tok.lengths[ovf_rows], eb, fill=-1),
+                roots=_pad_rows(tok.roots[ovf_rows], eb, fill=-1),
+                sys_mask=_pad_rows(tok.sys_mask[ovf_rows], eb),
+            ), device=self.device)
+            res2 = walk(self._device_trie, sub, probe_len=ct.probe_len,
+                        k_states=esc_k)
+            h2 = np.asarray(res2.hash_acc)
+            f2 = np.asarray(res2.final_acc)
+            o2 = np.asarray(res2.overflow)
+            for j, qi in enumerate(ovf_rows):
+                if not o2[j]:
+                    nn = np.concatenate([h2[j].ravel(), f2[j]])
+                    esc_nodes[int(qi)] = nn[nn >= 0]
         out: List[MatchedRoutes] = []
         for qi, (tenant_id, levels) in enumerate(queries):
             tomb = self._tomb.get(tenant_id)
@@ -295,7 +339,8 @@ class TpuMatcher:
                 else:
                     out.append(MatchedRoutes())
                 continue
-            needs_fallback = overflow[qi] or tok.lengths[qi] < 0
+            needs_fallback = ((overflow[qi] and qi not in esc_nodes)
+                              or tok.lengths[qi] < 0)
             if needs_fallback:
                 trie = self.tries.get(tenant_id)
                 out.append(trie.match(
@@ -303,8 +348,12 @@ class TpuMatcher:
                     max_group_fanout=max_group_fanout)
                     if trie is not None else MatchedRoutes())
                 continue
-            nodes = np.concatenate([hash_acc[qi].ravel(), final_acc[qi]])
-            nodes = nodes[nodes >= 0]
+            if qi in esc_nodes:
+                nodes = esc_nodes[qi]
+            else:
+                nodes = np.concatenate([hash_acc[qi].ravel(),
+                                        final_acc[qi]])
+                nodes = nodes[nodes >= 0]
             if not tomb and delta is None:
                 # fast path: no overlay for this tenant
                 out.append(self._expand(ct, nodes, max_persistent_fanout,

@@ -21,6 +21,8 @@ _SO = os.path.join(os.path.dirname(_SRC), "libtokenizer.so")
 
 _lib = None
 _lib_lock = threading.Lock()
+# below this row count, thread spawn overhead beats the parallel win
+_MT_THRESHOLD = 2048
 
 
 def load_lib():
@@ -34,8 +36,8 @@ def load_lib():
             if not (os.path.exists(_SO)
                     and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
                 subprocess.run(
-                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC,
-                     "-o", _SO],
+                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                     "-pthread", _SRC, "-o", _SO],
                     check=True, capture_output=True)
         except Exception:
             _lib = False
@@ -43,9 +45,11 @@ def load_lib():
         lib = ctypes.CDLL(_SO)
         i32p = ctypes.POINTER(ctypes.c_int32)
         u8p = ctypes.POINTER(ctypes.c_uint8)
-        lib.tok_topics.argtypes = [
+        base_args = [
             u8p, i32p, ctypes.c_int, i32p, ctypes.c_int, ctypes.c_uint64,
             ctypes.c_int, i32p, i32p, i32p, i32p, i32p, u8p, ctypes.c_int]
+        lib.tok_topics.argtypes = base_args
+        lib.tok_topics_mt.argtypes = base_args + [ctypes.c_int]
         _lib = lib
         return lib
 
@@ -94,10 +98,15 @@ def tokenize_topics_native(topics: Sequence, roots: Sequence[int], *,
     def p32(a):
         return a.ctypes.data_as(i32p)
 
-    lib.tok_topics(
+    args = (
         data_arr.ctypes.data_as(u8p), p32(offsets), n, p32(roots_arr),
         max_levels, ctypes.c_uint64(salt & 0xFFFFFFFFFFFFFFFF),
         int(filter_mode), p32(tok_h1), p32(tok_h2),
         p32(tok_kind) if tok_kind is not None else i32p(),
         p32(lengths), p32(root_out), sys_mask.ctypes.data_as(u8p), width)
+    if n >= _MT_THRESHOLD:
+        # rows are independent; ctypes releases the GIL for the whole call
+        lib.tok_topics_mt(*args, min(8, os.cpu_count() or 1))
+    else:
+        lib.tok_topics(*args)
     return tok_h1, tok_h2, tok_kind, lengths, root_out, sys_mask.astype(bool)

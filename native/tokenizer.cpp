@@ -13,8 +13,11 @@
 //
 // C ABI for ctypes. Thread-safe (no globals).
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 namespace {
 
@@ -105,11 +108,8 @@ static uint64_t blake2b8(const uint8_t* msg, size_t len,
     return h[0];  // first 8 little-endian digest bytes
 }
 
-}  // namespace
-
-extern "C" {
-
-// Tokenize a batch of '/'-separated topics into fixed-shape probe arrays.
+// Tokenize rows [lo, hi) of a batch of '/'-separated topics into
+// fixed-shape probe arrays.
 //
 // data/offsets: topic i is the UTF-8 bytes data[offsets[i]:offsets[i+1]].
 // Outputs are row-major [batch, width] (width = max_levels + 1) int32 for
@@ -120,14 +120,13 @@ extern "C" {
 // filter_mode != 0 treats '+'/'#' levels as wildcard kinds (retained-probe
 // tokenization) and skips their hashing; kind codes match automaton.py
 // (0=literal, 1='+', 2='#'). tok_kind may be null when filter_mode == 0.
-void tok_topics(const uint8_t* data, const int32_t* offsets, int n_topics,
-                const int32_t* roots, int max_levels, uint64_t salt,
-                int filter_mode, int32_t* tok_h1, int32_t* tok_h2,
-                int32_t* tok_kind, int32_t* lengths, int32_t* root_out,
-                uint8_t* sys_mask, int width) {
-    uint8_t salt16[16] = {0};
-    memcpy(salt16, &salt, 8);  // little-endian, zero-padded like hashlib
-    for (int i = 0; i < n_topics; i++) {
+static void tok_rows(const uint8_t* data, const int32_t* offsets, int lo,
+                     int hi, const int32_t* roots, int max_levels,
+                     const uint8_t salt16[16], int filter_mode,
+                     int32_t* tok_h1, int32_t* tok_h2, int32_t* tok_kind,
+                     int32_t* lengths, int32_t* root_out, uint8_t* sys_mask,
+                     int width) {
+    for (int i = lo; i < hi; i++) {
         const uint8_t* s = data + offsets[i];
         int tlen = offsets[i + 1] - offsets[i];
         // count levels ('/' separators + 1)
@@ -160,6 +159,56 @@ void tok_topics(const uint8_t* data, const int32_t* offsets, int n_topics,
             }
         }
     }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Serial tokenization (original ABI); see tok_rows for the contract.
+void tok_topics(const uint8_t* data, const int32_t* offsets, int n_topics,
+                const int32_t* roots, int max_levels, uint64_t salt,
+                int filter_mode, int32_t* tok_h1, int32_t* tok_h2,
+                int32_t* tok_kind, int32_t* lengths, int32_t* root_out,
+                uint8_t* sys_mask, int width) {
+    uint8_t salt16[16] = {0};
+    memcpy(salt16, &salt, 8);  // little-endian, zero-padded like hashlib
+    tok_rows(data, offsets, 0, n_topics, roots, max_levels, salt16,
+             filter_mode, tok_h1, tok_h2, tok_kind, lengths, root_out,
+             sys_mask, width);
+}
+
+// Multithreaded tokenization: rows are independent and each thread writes a
+// disjoint row range, so the split is embarrassingly parallel. ctypes
+// releases the GIL for the whole call. n_threads <= 1 degrades to serial.
+void tok_topics_mt(const uint8_t* data, const int32_t* offsets, int n_topics,
+                   const int32_t* roots, int max_levels, uint64_t salt,
+                   int filter_mode, int32_t* tok_h1, int32_t* tok_h2,
+                   int32_t* tok_kind, int32_t* lengths, int32_t* root_out,
+                   uint8_t* sys_mask, int width, int n_threads) {
+    uint8_t salt16[16] = {0};
+    memcpy(salt16, &salt, 8);
+    int hw = (int)std::thread::hardware_concurrency();
+    int nt = std::min({n_threads > 0 ? n_threads : (hw > 0 ? hw : 1),
+                       n_topics, 64});
+    if (nt <= 1) {
+        tok_rows(data, offsets, 0, n_topics, roots, max_levels, salt16,
+                 filter_mode, tok_h1, tok_h2, tok_kind, lengths, root_out,
+                 sys_mask, width);
+        return;
+    }
+    std::vector<std::thread> threads;
+    threads.reserve(nt);
+    int chunk = (n_topics + nt - 1) / nt;
+    for (int t = 0; t < nt; t++) {
+        int lo = t * chunk;
+        int hi = std::min(n_topics, lo + chunk);
+        if (lo >= hi) break;
+        threads.emplace_back(tok_rows, data, offsets, lo, hi, roots,
+                             max_levels, salt16, filter_mode, tok_h1, tok_h2,
+                             tok_kind, lengths, root_out, sys_mask, width);
+    }
+    for (auto& th : threads) th.join();
 }
 
 }  // extern "C"

@@ -442,3 +442,30 @@ class TestBitonicNetwork:
             ok = ~np.asarray(ovf) & ~np.asarray(ref_ovf)
             assert np.array_equal(np.asarray(cnt)[ok],
                                   np.asarray(ref_cnt)[ok]), k
+
+
+class TestMatcherEscalation:
+    def test_match_batch_escalates_before_oracle(self):
+        """Overflow rows at a tiny k_states are served by the device
+        escalation pass (exact results), not the host trie."""
+        m = TpuMatcher(k_states=2)
+        filters = ["a/+/c", "a/b/+", "+/b/c", "a/b/c", "+/+/c", "a/+/+",
+                   "+/b/+", "+/+/+", "a/#", "#"]
+        for i, f in enumerate(filters):
+            m.add_route("T", mk_route(f, receiver=f"r{i}"))
+        m.refresh()
+        oracle = SubscriptionTrie()
+        for i, f in enumerate(filters):
+            oracle.add(mk_route(f, receiver=f"r{i}"))
+        # the device escalation pass must serve these — poison the host
+        # fallback so the test fails (not passes vacuously) if it's taken
+        def _no_fallback(*a, **k):
+            raise AssertionError("host-trie fallback taken")
+        for trie in m.tries.values():
+            trie.match = _no_fallback
+        res = m.match_batch([("T", ["a", "b", "c"]), ("T", ["z", "b", "c"])])
+        for got, levels in zip(res, (["a", "b", "c"], ["z", "b", "c"])):
+            want = oracle.match(levels)
+            assert ({r.receiver_id for r in got.normal}
+                    == {r.receiver_id for r in want.normal})
+            assert set(got.groups) == set(want.groups)

@@ -79,3 +79,31 @@ class TestParity:
         np.testing.assert_array_equal(py.lengths, nat.lengths)
         np.testing.assert_array_equal(py.roots, nat.roots)
         np.testing.assert_array_equal(py.tok_h1, nat.tok_h1)
+
+
+def test_mt_path_matches_serial():
+    """Batches >= the MT threshold take the multithreaded path; outputs must
+    be bit-identical to the serial path (disjoint row ranges, same hash)."""
+    import numpy as np
+
+    from bifromq_tpu.models import native_tok
+    from bifromq_tpu import workloads
+
+    topics = workloads.probe_topics(4096, seed=9)
+    topics[7] = ["$SYS", "x"]       # sys flag row
+    topics[11] = ["lv"] * 20        # > max_levels padding row
+    roots = list(range(len(topics)))
+    assert len(topics) >= native_tok._MT_THRESHOLD
+    mt = native_tok.tokenize_topics_native(
+        topics, roots, max_levels=16, salt=3)
+    lib = native_tok.load_lib()
+    saved = native_tok._MT_THRESHOLD
+    try:
+        native_tok._MT_THRESHOLD = 1 << 30   # force serial
+        ser = native_tok.tokenize_topics_native(
+            topics, roots, max_levels=16, salt=3)
+    finally:
+        native_tok._MT_THRESHOLD = saved
+    for a, b in zip(mt[:2] + mt[3:5], ser[:2] + ser[3:5]):
+        assert np.array_equal(a, b)
+    assert np.array_equal(mt[5], ser[5])
