@@ -284,8 +284,22 @@ def bench_config4():
     filters = workloads.probe_filters(BATCH * 4, seed=SEED + 2)
     batches = [[("tenant0", f) for f in filters[i * BATCH:(i + 1) * BATCH]]
                for i in range(4)]
-    # warmup
-    res = idx.match_batch(batches[0], batch=BATCH)
+    # ---- device-only walk rate (pipelined, like _measure_match) -----------
+    probe_sets = [idx.device_probes(batches[i], batch=BATCH)[0]
+                  for i in range(4)]
+    run = idx.walk_device
+    for p in probe_sets:
+        np.asarray(run(p)[0])  # true sync (block_until_ready is a no-op)
+    dev_iters = ITERS
+    s = time.perf_counter()
+    for it in range(dev_iters - 1):
+        run(probe_sets[it % 4])
+    r_last, _ = run(probe_sets[(dev_iters - 1) % 4])
+    np.asarray(r_last)
+    dev_rate = BATCH * dev_iters / (time.perf_counter() - s)
+
+    # ---- end-to-end (device walk + host range expansion, sync per call) ---
+    res = idx.match_batch(batches[0], batch=BATCH)  # warmup
     iters = max(4, ITERS // 4)
     s = time.perf_counter()
     matched = 0
@@ -295,6 +309,7 @@ def bench_config4():
     elapsed = time.perf_counter() - s
     out = {
         "filters_per_s": round(BATCH * iters / elapsed, 1),
+        "device_filters_per_s": round(dev_rate, 1),
         "matched_retained_per_s": round(matched / elapsed, 1),
         "n_retained": N_RETAINED,
         "compile_s": round(t1 - t0, 1),

@@ -124,6 +124,36 @@ class RetainedIndex:
             self._dirty = False
         return self._compiled
 
+    def device_probes(self, queries: Sequence[Tuple[str, Sequence[str]]],
+                      *, batch: Optional[int] = None):
+        """Tokenize (tenant, filter_levels) pairs into device filter probes.
+
+        Returns (probes, roots, lengths) — lengths is the host-side
+        per-row level count (-1 = over-deep padding row needing host
+        fallback). The ONE probe-construction definition — match_batch and
+        the benchmark both use it, so they can never desynchronize."""
+        from ..ops.retained import FilterProbes
+
+        from .matcher import _pow2_batch
+
+        ct = self.refresh()
+        if batch is None:
+            batch = _pow2_batch(len(queries))
+        roots = [ct.root_of(t) for t, _ in queries]
+        tok = tokenize_filters([f for _, f in queries], roots,
+                               max_levels=ct.max_levels, salt=ct.salt,
+                               batch=batch)
+        return (FilterProbes.from_tokenized(tok, device=self.device),
+                roots, tok.lengths)
+
+    def walk_device(self, probes):
+        """Dispatch the retained walk on the current compiled tables."""
+        from ..ops.retained import retained_walk
+
+        ct = self.refresh()
+        return retained_walk(self._device_trie, probes,
+                             probe_len=ct.probe_len, k_states=self.k_states)
+
     def match_batch(self, queries: Sequence[Tuple[str, Sequence[str]]],
                     *, batch: Optional[int] = None,
                     limit: Optional[int] = None) -> List[List[str]]:
@@ -133,23 +163,11 @@ class RetainedIndex:
         reference's RetainMessageMatchLimit): expired entries filtered by the
         caller may reduce the final result below the limit.
         """
-        from ..ops.retained import FilterProbes, retained_walk
-
         if not queries:
             return []
         ct = self.refresh()
-        if batch is None:
-            batch = 16
-            while batch < len(queries):
-                batch *= 2
-        roots = [ct.root_of(t) for t, _ in queries]
-        tok = tokenize_filters([f for _, f in queries], roots,
-                               max_levels=ct.max_levels, salt=ct.salt,
-                               batch=batch)
-        probes = FilterProbes.from_tokenized(tok, device=self.device)
-        ranges, overflow = retained_walk(self._device_trie, probes,
-                                         probe_len=ct.probe_len,
-                                         k_states=self.k_states)
+        probes, roots, lengths = self.device_probes(queries, batch=batch)
+        ranges, overflow = self.walk_device(probes)
         ranges = np.asarray(ranges)
         overflow = np.asarray(overflow)
         out: List[List[str]] = []
@@ -158,7 +176,7 @@ class RetainedIndex:
                 out.append([])
                 continue
             cap = limit if limit is not None else 2 ** 31 - 1
-            if overflow[qi] or tok.lengths[qi] < 0:
+            if overflow[qi] or lengths[qi] < 0:
                 out.append(match_filter_host(self.tries[tenant_id],
                                              list(levels))[:cap])
                 continue

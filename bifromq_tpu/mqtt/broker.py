@@ -108,6 +108,10 @@ class Connection:
                                                   timeout=timeout)
                 except asyncio.TimeoutError:
                     self.broker.events.report(Event(
+                        EventType.IDLE,
+                        self.session.client_info.tenant_id,
+                        {"client_id": self.session.client_id}))
+                    self.broker.events.report(Event(
                         EventType.CLIENT_DISCONNECTED,
                         self.session.client_info.tenant_id,
                         {"reason": "keepalive_timeout"}))

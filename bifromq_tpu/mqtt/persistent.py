@@ -110,11 +110,7 @@ class PersistentSession(Session):
 
     async def kick(self) -> None:
         self._kicked_replaced = True
-        self._will_suppressed = True
-        if self.protocol_level >= PROTOCOL_MQTT5:
-            await self.conn.send(pk.Disconnect(
-                reason_code=ReasonCode.SESSION_TAKEN_OVER))
-        await self.close(fire_will=False)
+        await super().kick()
 
     # ---------------- subscriptions ----------------------------------------
 

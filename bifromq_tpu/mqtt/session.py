@@ -242,6 +242,12 @@ class Session:
     async def kick(self) -> None:
         """Another session took over this (tenant, client_id)."""
         self._will_suppressed = True
+        # server-initiated disconnect: reported for EVERY protocol level
+        # (only the DISCONNECT packet itself is MQTT5-only)
+        self.events.report(Event(EventType.BY_SERVER,
+                                 self.client_info.tenant_id,
+                                 {"client_id": self.client_id,
+                                  "reason": "kicked"}))
         if self.protocol_level >= PROTOCOL_MQTT5:
             await self.conn.send(pk.Disconnect(
                 reason_code=ReasonCode.SESSION_TAKEN_OVER))
@@ -303,6 +309,9 @@ class Session:
                                      self.client_info.tenant_id, {}))
             await self.conn.send(pk.PingResp())
         elif isinstance(packet, pk.Disconnect):
+            self.events.report(Event(EventType.BY_CLIENT,
+                                     self.client_info.tenant_id,
+                                     {"client_id": self.client_id}))
             if (self.protocol_level >= PROTOCOL_MQTT5
                     and packet.reason_code ==
                     ReasonCode.DISCONNECT_WITH_WILL):
@@ -450,10 +459,17 @@ class Session:
                 await self.conn.send(pk.PubRec(packet_id=p.packet_id))
                 return
             self._inbound_qos2.add(p.packet_id)
+            self.events.report(Event(EventType.QOS2_RECEIVED,
+                                     self.client_info.tenant_id,
+                                     {"packet_id": p.packet_id}))
 
+        expiry = 0xFFFFFFFF
+        if self.protocol_level >= PROTOCOL_MQTT5 and p.properties:
+            expiry = p.properties.get(PropertyId.MESSAGE_EXPIRY_INTERVAL,
+                                      0xFFFFFFFF)
         msg = Message(message_id=p.packet_id or 0, pub_qos=QoS(p.qos),
                       payload=p.payload, timestamp=HLC.INST.get(),
-                      is_retain=p.retain)
+                      expiry_seconds=expiry, is_retain=p.retain)
         self.events.report(Event(EventType.PUB_RECEIVED,
                                  self.client_info.tenant_id,
                                  {"topic": topic, "qos": p.qos}))
@@ -709,11 +725,29 @@ class Session:
         """Returns None (sent as qos0), the packet id (sent qos>0), or
         ``BLOCKED`` (receive-maximum / packet-id window exhausted)."""
         qos = min(int(msg.pub_qos), sub.qos)
+        remaining_expiry = None
+        if msg.expiry_seconds != 0xFFFFFFFF:
+            # [MQTT-3.3.2-5]: drop once the expiry interval has elapsed;
+            # [MQTT-3.3.2-6]: forward the REMAINING interval to receivers
+            elapsed_s = max(0, HLC.INST.physical(HLC.INST.get())
+                            - HLC.INST.physical(msg.timestamp)) / 1000.0
+            remaining_expiry = msg.expiry_seconds - elapsed_s
+            if remaining_expiry <= 0:
+                self.events.report(Event(
+                    EventType.QOS0_DROPPED if qos == 0 else
+                    (EventType.QOS1_DROPPED if qos == 1
+                     else EventType.QOS2_DROPPED),
+                    self.client_info.tenant_id,
+                    {"topic": topic, "reason": "message_expired"}))
+                return None
         retain_flag = (retained if not sub.retain_as_published
                        else (msg.is_retain or retained))
         props = None
         if self.protocol_level >= PROTOCOL_MQTT5:
             props = {}
+            if remaining_expiry is not None:
+                props[PropertyId.MESSAGE_EXPIRY_INTERVAL] = max(
+                    1, int(remaining_expiry))
             if sub.sub_id is not None:
                 props[PropertyId.SUBSCRIPTION_IDENTIFIER] = [sub.sub_id]
             if msg.user_properties:
@@ -738,6 +772,9 @@ class Session:
                                             payload=msg.payload,
                                             qos=0, retain=retain_flag,
                                             properties=wprops))
+            self.events.report(Event(EventType.QOS0_PUSHED,
+                                     self.client_info.tenant_id,
+                                     {"topic": topic}))
             self.events.report(Event(EventType.DELIVERED,
                                      self.client_info.tenant_id,
                                      {"topic": topic, "qos": 0}))
@@ -761,6 +798,9 @@ class Session:
         self._outbound[pid] = _OutboundQoS(packet_id=pid, publish=publish,
                                            phase=1)
         await self.conn.send(publish)
+        self.events.report(Event(
+            EventType.QOS1_PUSHED if qos == 1 else EventType.QOS2_PUSHED,
+            self.client_info.tenant_id, {"topic": topic}))
         self.events.report(Event(EventType.DELIVERED,
                                  self.client_info.tenant_id,
                                  {"topic": topic, "qos": qos}))
@@ -768,15 +808,26 @@ class Session:
 
     def _on_puback(self, pid: int) -> None:
         st = self._outbound.pop(pid, None)
-        if st is not None:
-            self._pid_alloc.release(pid)
-            self.events.report(Event(EventType.PUB_ACKED,
+        if st is None:
+            self.events.report(Event(EventType.PUB_ACK_DROPPED,
                                      self.client_info.tenant_id,
                                      {"packet_id": pid}))
+            return
+        self._pid_alloc.release(pid)
+        if st.publish.qos == 1:
+            self.events.report(Event(EventType.QOS1_CONFIRMED,
+                                     self.client_info.tenant_id,
+                                     {"packet_id": pid}))
+        self.events.report(Event(EventType.PUB_ACKED,
+                                 self.client_info.tenant_id,
+                                 {"packet_id": pid}))
 
     async def _on_pubrec(self, pid: int) -> None:
         st = self._outbound.get(pid)
         if st is None or st.publish.qos != 2:
+            self.events.report(Event(EventType.PUB_REC_DROPPED,
+                                     self.client_info.tenant_id,
+                                     {"packet_id": pid}))
             await self.conn.send(pk.PubRel(packet_id=pid))
             return
         if st.phase != 2:       # retransmitted PUBREC: report once
@@ -790,3 +841,7 @@ class Session:
         st = self._outbound.pop(pid, None)
         if st is not None:
             self._pid_alloc.release(pid)
+            if st.publish.qos == 2:
+                self.events.report(Event(EventType.QOS2_CONFIRMED,
+                                         self.client_info.tenant_id,
+                                         {"packet_id": pid}))

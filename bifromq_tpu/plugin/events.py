@@ -82,6 +82,22 @@ class EventType(enum.Enum):
     PUB_RECED = "pub_reced"
     # publish-rate guard (≈ ExceedPubRate)
     EXCEED_PUB_RATE = "exceed_pub_rate"
+    # outbound push family by QoS (≈ QoS0Pushed/QoS1Pushed/QoS2Pushed)
+    QOS0_PUSHED = "qos0_pushed"
+    QOS1_PUSHED = "qos1_pushed"
+    QOS2_PUSHED = "qos2_pushed"
+    # outbound confirm family (≈ QoS1Confirmed/QoS2Confirmed)
+    QOS1_CONFIRMED = "qos1_confirmed"
+    QOS2_CONFIRMED = "qos2_confirmed"
+    # inbound QoS2 accepted, awaiting PUBREL (≈ QoS2Received)
+    QOS2_RECEIVED = "qos2_received"
+    # late/unknown outbound acks (≈ PubAckDropped/PubRecDropped)
+    PUB_ACK_DROPPED = "pub_ack_dropped"
+    PUB_REC_DROPPED = "pub_rec_dropped"
+    # disconnect reason family (≈ ByClient/ByServer/Idle client events)
+    BY_CLIENT = "by_client"
+    BY_SERVER = "by_server"
+    IDLE = "idle"
 
 
 @dataclass

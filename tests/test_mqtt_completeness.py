@@ -354,3 +354,145 @@ class TestPubRateGuard:
             await ok.disconnect()
         finally:
             await broker.stop()
+
+
+class TestEventTaxonomyQoSFamily:
+    async def test_push_confirm_disconnect_events(self):
+        """The QoS-level push/confirm events and disconnect-reason events
+        (≈ reference QoS{0,1,2}Pushed, QoS{1,2}Confirmed, QoS2Received,
+        ByClient) fire from live broker traffic."""
+        from bifromq_tpu.plugin.events import CollectingEventCollector
+
+        ev = CollectingEventCollector()
+        broker = MQTTBroker(host="127.0.0.1", port=0, events=ev)
+        await broker.start()
+        try:
+            sub = MQTTClient("127.0.0.1", broker.port, client_id="s1")
+            await sub.connect()
+            await sub.subscribe("t/0", qos=0)
+            await sub.subscribe("t/1", qos=1)
+            await sub.subscribe("t/2", qos=2)
+            pub = MQTTClient("127.0.0.1", broker.port, client_id="p1")
+            await pub.connect()
+            await pub.publish("t/0", b"a", qos=0)
+            # generous ack timeouts: the first publish jit-compiles the
+            # match walk, which can exceed 5s under parallel test load
+            await pub.publish("t/1", b"b", qos=1, timeout=30)
+            await pub.publish("t/2", b"c", qos=2, timeout=30)
+            for _ in range(3):
+                await asyncio.wait_for(sub.messages.get(), 15)
+            await asyncio.sleep(0.2)   # let acks drain
+            await pub.disconnect()
+            await sub.disconnect()
+            await asyncio.sleep(0.1)
+            types = {e.type for e in ev.events}
+            for t in (EventType.QOS0_PUSHED, EventType.QOS1_PUSHED,
+                      EventType.QOS2_PUSHED, EventType.QOS1_CONFIRMED,
+                      EventType.QOS2_CONFIRMED, EventType.QOS2_RECEIVED,
+                      EventType.BY_CLIENT):
+                assert t in types, t
+        finally:
+            await broker.stop()
+
+
+class TestDisconnectReasonEvents:
+    async def test_takeover_reports_by_server_for_mqtt3(self):
+        """A kicked MQTT 3.1.1 session reports BY_SERVER (the event marks
+        the server-initiated disconnect, not the MQTT5 DISCONNECT packet)."""
+        from bifromq_tpu.plugin.events import CollectingEventCollector
+
+        ev = CollectingEventCollector()
+        broker = MQTTBroker(host="127.0.0.1", port=0, events=ev)
+        await broker.start()
+        try:
+            c1 = MQTTClient("127.0.0.1", broker.port, client_id="dup",
+                            protocol_level=4)
+            await c1.connect()
+            c2 = MQTTClient("127.0.0.1", broker.port, client_id="dup",
+                            protocol_level=4)
+            await c2.connect()
+            await asyncio.wait_for(c1.closed.wait(), 5)
+            types = {e.type for e in ev.events}
+            assert EventType.BY_SERVER in types
+            assert EventType.SESSION_KICKED in types
+            await c2.disconnect()
+        finally:
+            await broker.stop()
+
+    async def test_stray_puback_reports_drop(self):
+        """A PUBACK for an unknown packet id reports PUB_ACK_DROPPED."""
+        from bifromq_tpu.mqtt import packets as pkts
+        from bifromq_tpu.plugin.events import CollectingEventCollector
+
+        ev = CollectingEventCollector()
+        broker = MQTTBroker(host="127.0.0.1", port=0, events=ev)
+        await broker.start()
+        try:
+            c = MQTTClient("127.0.0.1", broker.port, client_id="stray")
+            await c.connect()
+            await c._send(pkts.PubAck(packet_id=777))
+            await asyncio.sleep(0.2)
+            types = {e.type for e in ev.events}
+            assert EventType.PUB_ACK_DROPPED in types
+            await c.disconnect()
+        finally:
+            await broker.stop()
+
+
+class TestMessageExpiry:
+    async def test_remaining_interval_forwarded_and_expired_dropped(self):
+        """[MQTT-3.3.2-5/6]: the broker forwards the REMAINING message
+        expiry interval and drops messages whose interval elapsed while
+        queued (exercised through the persistent-session inbox)."""
+        from bifromq_tpu.mqtt.protocol import PropertyId
+
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        await broker.start()
+        try:
+            sub = MQTTClient("127.0.0.1", broker.port, client_id="exp-sub",
+                             protocol_level=5)
+            await sub.connect()
+            await sub.subscribe("exp/t", qos=1)
+            pub = MQTTClient("127.0.0.1", broker.port, client_id="exp-pub",
+                             protocol_level=5)
+            await pub.connect()
+            # generous ack timeout: the first publish jit-compiles the
+            # match walk, which can exceed 5s under parallel test load
+            await pub.publish(
+                "exp/t", b"live", qos=1, timeout=30,
+                properties={PropertyId.MESSAGE_EXPIRY_INTERVAL: 300})
+            m = await asyncio.wait_for(sub.messages.get(), 5)
+            assert m.payload == b"live"
+            assert m.properties and (
+                0 < m.properties[PropertyId.MESSAGE_EXPIRY_INTERVAL] <= 300)
+            await sub.disconnect()
+
+            # persistent subscriber goes offline; a 1s-expiry message ages
+            # out in the inbox and must NOT be delivered on reconnect
+            from bifromq_tpu.mqtt.protocol import (
+                PropertyId as PId)
+            ps = MQTTClient(
+                "127.0.0.1", broker.port, client_id="exp-ps",
+                protocol_level=5, clean_start=True,
+                properties={PId.SESSION_EXPIRY_INTERVAL: 300})
+            await ps.connect()
+            await ps.subscribe("exp/p", qos=1)
+            await asyncio.sleep(0.2)   # let the route commit
+            await ps.disconnect()
+            await pub.publish(
+                "exp/p", b"stale", qos=1, timeout=30,
+                properties={PropertyId.MESSAGE_EXPIRY_INTERVAL: 1})
+            await pub.publish("exp/p", b"fresh", qos=1, timeout=30)
+            await asyncio.sleep(1.5)   # "stale" (1s expiry) ages out
+            ps2 = MQTTClient(
+                "127.0.0.1", broker.port, client_id="exp-ps",
+                protocol_level=5, clean_start=False,
+                properties={PId.SESSION_EXPIRY_INTERVAL: 300})
+            await ps2.connect()
+            got = await asyncio.wait_for(ps2.messages.get(), 10)
+            assert got.payload == b"fresh"
+            assert ps2.messages.empty()
+            await ps2.disconnect()
+            await pub.disconnect()
+        finally:
+            await broker.stop()
