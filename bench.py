@@ -414,30 +414,46 @@ def main():
     # device-init watchdog: a dead axon tunnel makes jax.devices() hang
     # FOREVER inside C++ PJRT init (uninterruptible by signals) — probe
     # in a SUBPROCESS with a hard timeout so the driver gets a clean
-    # failure line instead of a wedged run (BENCH_r02 died this way)
+    # failure line instead of a wedged run (BENCH_r02 died this way).
+    # The tunnel also flaps for stretches (r3 observed multi-hour
+    # outages), so keep re-probing for BENCH_DEVICE_WAIT seconds before
+    # giving up — a patient bench beats an rc=1 round record.
     timeout_s = int(os.environ.get("BENCH_DEVICE_TIMEOUT", "180"))
-    try:
-        # the probe must honor a JAX_PLATFORMS pin the same way our
-        # entrypoints do (env alone doesn't beat the sitecustomize-
-        # registered plugin; the config knob does)
-        subprocess.run(
-            [sys.executable, "-c",
-             "from bifromq_tpu.utils.jaxenv import pin_jax_platform; "
-             "pin_jax_platform(); import jax; jax.devices()"],
-            timeout=timeout_s, check=True, capture_output=True,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
-    except (subprocess.TimeoutExpired,
-            subprocess.CalledProcessError) as e:
-        detail = ""
-        if isinstance(e, subprocess.CalledProcessError) and e.stderr:
-            detail = " :: " + e.stderr.decode(
-                "utf-8", "replace").strip()[-400:]
-        msg = (f"jax device init failed/hung within {timeout_s}s "
-               f"({type(e).__name__}) — TPU tunnel down?{detail}")
-        log(f"FATAL: {msg}")
-        print(json.dumps({"metric": "device_init", "value": 0,
-                          "unit": "error", "error": msg}), flush=True)
-        sys.exit(1)
+    wait_s = int(os.environ.get("BENCH_DEVICE_WAIT", "900"))
+    deadline = time.time() + wait_s
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            # the probe must honor a JAX_PLATFORMS pin the same way our
+            # entrypoints do (env alone doesn't beat the sitecustomize-
+            # registered plugin; the config knob does)
+            subprocess.run(
+                [sys.executable, "-c",
+                 "from bifromq_tpu.utils.jaxenv import pin_jax_platform; "
+                 "pin_jax_platform(); import jax; jax.devices()"],
+                timeout=timeout_s, check=True, capture_output=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            break
+        except (subprocess.TimeoutExpired,
+                subprocess.CalledProcessError) as e:
+            detail = ""
+            if isinstance(e, subprocess.CalledProcessError) and e.stderr:
+                detail = " :: " + e.stderr.decode(
+                    "utf-8", "replace").strip()[-400:]
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                msg = (f"jax device init failed/hung through {attempt} "
+                       f"probes over {wait_s}s ({type(e).__name__}) — "
+                       f"TPU tunnel down?{detail}")
+                log(f"FATAL: {msg}")
+                print(json.dumps({"metric": "device_init", "value": 0,
+                                  "unit": "error", "error": msg}),
+                      flush=True)
+                sys.exit(1)
+            log(f"device probe {attempt} failed ({type(e).__name__}); "
+                f"retrying for another {remaining:.0f}s")
+            time.sleep(min(30, max(1, remaining)))
     import jax
     log(f"devices: {jax.devices()}")
     results = {}

@@ -27,7 +27,8 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..models.matcher import TpuMatcher
-from ..models.oracle import MatchedRoutes, Route
+from ..models.oracle import (PERSISTENT_SUB_BROKER_ID, MatchedRoutes,
+                             Route)
 from ..plugin.events import Event, EventType, IEventCollector
 from ..plugin.settings import ISettingProvider, Setting
 from ..plugin.subbroker import (DeliveryPack, DeliveryResult, ISubBroker,
@@ -282,6 +283,32 @@ class DistService:
             elected = self._elect(mqtt_filter, members, call.topic)
             if elected is not None:
                 targets.append(elected)
+        # byte-based persistent fan-out cap (≈ MaxPersistentFanoutBytes in
+        # DeliverExecutorGroup.java:132), applied over the FULL target set
+        # (normal + elected shared-group members — an elected persistent
+        # member consumes budget too); transient receivers are untouched
+        max_pf_bytes = self.settings.provide(
+            Setting.MaxPersistentFanoutBytes, tenant_id)
+        if max_pf_bytes is None:
+            max_pf_bytes = Setting.MaxPersistentFanoutBytes.default
+        payload_len = len(call.message.payload)
+        n_persistent = sum(1 for r in targets
+                           if r.broker_id == PERSISTENT_SUB_BROKER_ID)
+        if payload_len and n_persistent * payload_len > max_pf_bytes:
+            allowed = int(max_pf_bytes // payload_len)
+            kept: List[Route] = []
+            used = 0
+            for r in targets:
+                if r.broker_id != PERSISTENT_SUB_BROKER_ID:
+                    kept.append(r)
+                elif used < allowed:
+                    kept.append(r)
+                    used += 1
+            targets = kept
+            self.events.report(Event(
+                EventType.PERSISTENT_FANOUT_THROTTLED, tenant_id,
+                {"topic": call.topic, "reason": "bytes",
+                 "allowed": allowed}))
         if not targets:
             return 0
         # group per (broker, deliverer_key) ≈ BatchDeliveryCall grouping

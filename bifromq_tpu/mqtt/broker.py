@@ -348,6 +348,14 @@ class Connection:
                 **auth_result.attrs,
             }.items())))
 
+        if (c.will is not None and len(c.will.payload)
+                > settings[Setting.MaxLastWillBytes]):
+            await self.send(pk.Connack(reason_code=(
+                ReasonCode.PACKET_TOO_LARGE if v5
+                else CONNACK_REFUSED_NOT_AUTHORIZED)))
+            await self.close_transport()
+            return
+
         keep_alive = c.keep_alive
         min_ka = settings[Setting.MinKeepAliveSeconds]
         server_keep_alive = None
@@ -364,6 +372,10 @@ class Connection:
                 PropertyId.SESSION_EXPIRY_INTERVAL, 0))
         elif not c.clean_start:
             session_expiry = settings[Setting.MaxSessionExpirySeconds]
+        requested_expiry = session_expiry
+        if session_expiry:
+            session_expiry = max(session_expiry,
+                                 settings[Setting.MinSessionExpirySeconds])
         session_expiry = min(session_expiry,
                              settings[Setting.MaxSessionExpirySeconds])
         persistent = session_expiry > 0 and not settings[
@@ -424,6 +436,10 @@ class Connection:
             }
             if assigned:
                 props[PropertyId.ASSIGNED_CLIENT_IDENTIFIER] = assigned
+            if session_expiry != requested_expiry:
+                # [MQTT-3.2.2.3.2]: a server using a different Session
+                # Expiry Interval MUST advertise it in the CONNACK
+                props[PropertyId.SESSION_EXPIRY_INTERVAL] = session_expiry
             if server_keep_alive is not None:
                 props[PropertyId.SERVER_KEEP_ALIVE] = server_keep_alive
             if getattr(self, "auth_method", None) is not None:
@@ -599,8 +615,11 @@ class MQTTBroker:
         for sid in list(self.local_sessions._by_id):
             session = self.local_sessions.get(sid)
             if session is not None:
-                session._will_suppressed = True
-                await session.close(fire_will=False)
+                no_lwt = session.settings[
+                    Setting.NoLWTWhenServerShuttingDown]
+                if no_lwt:
+                    session._will_suppressed = True
+                await session.close(fire_will=not no_lwt)
         if self._server is not None:
             try:
                 await asyncio.wait_for(self._server.wait_closed(), 5)

@@ -1,0 +1,52 @@
+"""Adaptive outbound in-flight quota (≈ mqtt handler AdaptiveReceiveQuota).
+
+The reference paces QoS>0 delivery to each client with a latency-steered
+AIMD quota bounded by [MinSendPerSec, client receive-maximum]
+(MQTTSessionHandler.java:373): ack latency is tracked with a fast and a
+slow EWMA; when the fast one runs ahead of the slow one the client is
+congesting and the window shrinks multiplicatively, otherwise it grows
+additively toward the ceiling. This is that contract re-expressed
+compactly — same bounds, same congestion signal, simpler scheduling (we
+evaluate on every ack instead of on a 200ms timer).
+"""
+
+from __future__ import annotations
+
+
+class AdaptiveReceiveQuota:
+    """Latency-AIMD in-flight window in [recv_min, recv_max]."""
+
+    # fast/slow EWMA smoothing and the congestion band around ratio 1.0
+    FAST_ALPHA = 0.3
+    SLOW_ALPHA = 0.05
+    EPS_LOW = 0.05     # healthy if fast/slow <= 1 + EPS_LOW
+    EPS_HIGH = 0.15    # congested if fast/slow >= 1 + EPS_HIGH
+    SHRINK_RATIO = 0.9
+
+    def __init__(self, recv_min: int, recv_max: int) -> None:
+        self.recv_min = max(1, min(recv_min, recv_max))
+        self.recv_max = max(1, recv_max)
+        # start at the ceiling: a fresh client is presumed healthy and the
+        # first congestion signal shrinks fast (multiplicative)
+        self.quota = self.recv_max
+        self._fast = 0.0
+        self._slow = 0.0
+
+    def on_ack(self, latency_s: float) -> None:
+        latency_s = max(0.0, latency_s)
+        if self._slow == 0.0:
+            self._fast = self._slow = latency_s
+            return
+        self._fast += self.FAST_ALPHA * (latency_s - self._fast)
+        self._slow += self.SLOW_ALPHA * (latency_s - self._slow)
+        if self._slow <= 0.0:
+            return
+        ratio = self._fast / self._slow
+        if ratio >= 1 + self.EPS_HIGH:
+            self.quota = max(self.recv_min,
+                             int(self.quota * self.SHRINK_RATIO))
+        elif ratio <= 1 + self.EPS_LOW:
+            self.quota = min(self.recv_max, self.quota + 1)
+
+    def has_room(self, inflight: int) -> bool:
+        return inflight < self.quota

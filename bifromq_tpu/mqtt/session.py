@@ -152,6 +152,7 @@ class _OutboundQoS:
     packet_id: int
     publish: pk.Publish
     phase: int  # 1 = awaiting PUBACK/PUBREC, 2 = awaiting PUBCOMP
+    sent_at: float = 0.0  # monotonic send time (ack-latency pacing)
 
 
 # _send_publish result: the send was gated by receive-maximum / packet-id
@@ -207,10 +208,16 @@ class Session:
         self._pub_bucket = TokenBucket(
             float(self.settings[Setting.MsgPubPerSec] or 0))
         self.last_active = time.monotonic()
-        # client's receive maximum (v5) — simple in-flight cap
+        # client's receive maximum (v5) ceiling + latency-AIMD pacing
+        # floor (MinSendPerSec) — ≈ AdaptiveReceiveQuota at
+        # MQTTSessionHandler.java:373
         self._client_recv_max = int(
             self.connect_props.get(PropertyId.RECEIVE_MAXIMUM, 65535)
             if protocol_level >= PROTOCOL_MQTT5 else 65535)
+        from .quota import AdaptiveReceiveQuota
+        self._recv_quota = AdaptiveReceiveQuota(
+            int(self.settings[Setting.MinSendPerSec] or 1),
+            self._client_recv_max)
         # outbound topic aliasing (v5, ≈ SenderTopicAliasManager): the
         # client's TopicAliasMaximum caps how many topics we may alias
         # on the way OUT; repeated topics then ship a 2-byte alias
@@ -780,7 +787,7 @@ class Session:
                                      {"topic": topic, "qos": 0}))
             return None
         pid = None
-        if len(self._outbound) < self._client_recv_max:
+        if self._recv_quota.has_room(len(self._outbound)):
             pid = self._pid_alloc.alloc()
         if pid is None:
             if self._drop_on_recv_max:
@@ -796,7 +803,8 @@ class Session:
                              retain=retain_flag, packet_id=pid,
                              properties=wprops)
         self._outbound[pid] = _OutboundQoS(packet_id=pid, publish=publish,
-                                           phase=1)
+                                           phase=1,
+                                           sent_at=time.monotonic())
         await self.conn.send(publish)
         self.events.report(Event(
             EventType.QOS1_PUSHED if qos == 1 else EventType.QOS2_PUSHED,
@@ -814,6 +822,8 @@ class Session:
                                      {"packet_id": pid}))
             return
         self._pid_alloc.release(pid)
+        if st.sent_at:
+            self._recv_quota.on_ack(time.monotonic() - st.sent_at)
         if st.publish.qos == 1:
             self.events.report(Event(EventType.QOS1_CONFIRMED,
                                      self.client_info.tenant_id,
@@ -831,6 +841,8 @@ class Session:
             await self.conn.send(pk.PubRel(packet_id=pid))
             return
         if st.phase != 2:       # retransmitted PUBREC: report once
+            if st.sent_at:
+                self._recv_quota.on_ack(time.monotonic() - st.sent_at)
             self.events.report(Event(EventType.PUB_RECED,
                                      self.client_info.tenant_id,
                                      {"packet_id": pid}))

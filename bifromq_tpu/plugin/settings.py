@@ -48,6 +48,11 @@ class Setting(enum.Enum):
     MaxPersistentFanout = enum.auto()
     MaxGroupFanout = enum.auto()
     MinKeepAliveSeconds = enum.auto()
+    MaxLastWillBytes = enum.auto()
+    MinSessionExpirySeconds = enum.auto()
+    NoLWTWhenServerShuttingDown = enum.auto()
+    MinSendPerSec = enum.auto()
+    MaxPersistentFanoutBytes = enum.auto()
 
     @property
     def default(self) -> Any:
@@ -88,6 +93,12 @@ _DEFAULTS: Dict["Setting", Any] = {
     Setting.MaxPersistentFanout: 1000,
     Setting.MaxGroupFanout: 100,
     Setting.MinKeepAliveSeconds: 60,
+    # 128 BYTES is the reference's own initial value (Setting.java:54)
+    Setting.MaxLastWillBytes: 128,
+    Setting.MinSessionExpirySeconds: 0,
+    Setting.NoLWTWhenServerShuttingDown: True,
+    Setting.MinSendPerSec: 8,
+    Setting.MaxPersistentFanoutBytes: 2 ** 63 - 1,
 }
 
 

@@ -496,3 +496,167 @@ class TestMessageExpiry:
             await pub.disconnect()
         finally:
             await broker.stop()
+
+
+class TestAdaptiveReceiveQuota:
+    def test_congestion_shrinks_recovery_grows(self):
+        from bifromq_tpu.mqtt.quota import AdaptiveReceiveQuota
+
+        q = AdaptiveReceiveQuota(4, 64)
+        assert q.quota == 64
+        q.on_ack(0.01)                    # seed EWMAs
+        for _ in range(40):               # latency blowing up -> shrink
+            q.on_ack(1.0)
+        assert q.quota < 64
+        shrunk = q.quota
+        assert shrunk >= 4                # floored at recv_min
+        for _ in range(500):              # healthy again -> grow back
+            q.on_ack(0.01)
+        assert q.quota > shrunk
+
+    def test_floor_respected_under_sustained_congestion(self):
+        from bifromq_tpu.mqtt.quota import AdaptiveReceiveQuota
+
+        q = AdaptiveReceiveQuota(8, 32)
+        q.on_ack(0.001)
+        lat = 0.001
+        qmin = q.quota
+        for _ in range(200):              # monotonically worsening acks
+            lat *= 1.3
+            q.on_ack(lat)
+            qmin = min(qmin, q.quota)
+        # the floor is reached while latency degrades and never undercut
+        assert qmin == 8
+
+
+class TestNewTenantSettings:
+    async def test_oversized_will_rejected(self):
+        from bifromq_tpu.mqtt import packets as pkts
+        from bifromq_tpu.plugin.settings import (DefaultSettingProvider,
+                                                 Setting)
+
+        class TinyWill(DefaultSettingProvider):
+            def provide(self, setting, tenant_id):
+                if setting is Setting.MaxLastWillBytes:
+                    return 4
+                return super().provide(setting, tenant_id)
+
+        broker = MQTTBroker(host="127.0.0.1", port=0, settings=TinyWill())
+        await broker.start()
+        try:
+            c = MQTTClient(
+                "127.0.0.1", broker.port, client_id="bigwill",
+                protocol_level=5,
+                will=pkts.Will(topic="w/t", payload=b"x" * 64))
+            with pytest.raises(Exception):
+                await c.connect()
+            ok = MQTTClient(
+                "127.0.0.1", broker.port, client_id="smallwill",
+                protocol_level=5,
+                will=pkts.Will(topic="w/t", payload=b"ok"))
+            await ok.connect()
+            await ok.disconnect()
+        finally:
+            await broker.stop()
+
+    async def test_lwt_fires_at_shutdown_when_allowed(self):
+        """NoLWTWhenServerShuttingDown=False: broker stop() fires wills."""
+        from bifromq_tpu.mqtt import packets as pkts
+        from bifromq_tpu.plugin.events import CollectingEventCollector
+        from bifromq_tpu.plugin.settings import (DefaultSettingProvider,
+                                                 Setting)
+
+        class FireLWT(DefaultSettingProvider):
+            def provide(self, setting, tenant_id):
+                if setting is Setting.NoLWTWhenServerShuttingDown:
+                    return False
+                return super().provide(setting, tenant_id)
+
+        ev = CollectingEventCollector()
+        broker = MQTTBroker(host="127.0.0.1", port=0, settings=FireLWT(),
+                            events=ev)
+        await broker.start()
+        c = MQTTClient("127.0.0.1", broker.port, client_id="lwt",
+                       will=pkts.Will(topic="lwt/t", payload=b"gone"))
+        await c.connect()
+        await broker.stop()
+        types = {e.type for e in ev.events}
+        assert EventType.WILL_DISTED in types
+
+    async def test_lwt_suppressed_at_shutdown_by_default(self):
+        from bifromq_tpu.mqtt import packets as pkts
+        from bifromq_tpu.plugin.events import CollectingEventCollector
+
+        ev = CollectingEventCollector()
+        broker = MQTTBroker(host="127.0.0.1", port=0, events=ev)
+        await broker.start()
+        c = MQTTClient("127.0.0.1", broker.port, client_id="lwt2",
+                       will=pkts.Will(topic="lwt/t", payload=b"gone"))
+        await c.connect()
+        await broker.stop()
+        types = {e.type for e in ev.events}
+        assert EventType.WILL_DISTED not in types
+
+    async def test_persistent_fanout_byte_cap(self):
+        """MaxPersistentFanoutBytes (≈ DeliverExecutorGroup.java:132):
+        cumulative persistent fan-out payload beyond the byte budget is
+        throttled; transient subscribers are untouched."""
+        from bifromq_tpu.plugin.events import CollectingEventCollector
+        from bifromq_tpu.plugin.settings import (DefaultSettingProvider,
+                                                 Setting)
+        from bifromq_tpu.mqtt.protocol import PropertyId as PId
+
+        class ByteCap(DefaultSettingProvider):
+            def provide(self, setting, tenant_id):
+                if setting is Setting.MaxPersistentFanoutBytes:
+                    return 8     # exactly one 8-byte payload
+                return super().provide(setting, tenant_id)
+
+        ev = CollectingEventCollector()
+        broker = MQTTBroker(host="127.0.0.1", port=0, settings=ByteCap(),
+                            events=ev)
+        await broker.start()
+        try:
+            subs = []
+            for i in range(3):
+                c = MQTTClient(
+                    "127.0.0.1", broker.port, client_id=f"pfb{i}",
+                    protocol_level=5,
+                    properties={PId.SESSION_EXPIRY_INTERVAL: 300})
+                await c.connect()
+                await c.subscribe("pfb/t", qos=1)
+                subs.append(c)
+            trans = MQTTClient("127.0.0.1", broker.port, client_id="pfbt")
+            await trans.connect()
+            await trans.subscribe("pfb/t", qos=0)
+            await asyncio.sleep(0.2)
+            for c in subs:
+                await c.disconnect()
+            pub = MQTTClient("127.0.0.1", broker.port, client_id="pfbp")
+            await pub.connect()
+            await pub.publish("pfb/t", b"12345678", qos=1, timeout=30)
+            # transient sub still receives despite the persistent cap
+            m = await asyncio.wait_for(trans.messages.get(), 10)
+            assert m.payload == b"12345678"
+            await asyncio.sleep(0.3)
+            got = 0
+            for i in range(3):
+                c2 = MQTTClient(
+                    "127.0.0.1", broker.port, client_id=f"pfb{i}",
+                    protocol_level=5, clean_start=False,
+                    properties={PId.SESSION_EXPIRY_INTERVAL: 300})
+                await c2.connect()
+                try:
+                    m = await asyncio.wait_for(c2.messages.get(), 1.0)
+                    if m.payload == b"12345678":
+                        got += 1
+                except asyncio.TimeoutError:
+                    pass
+                await c2.disconnect()
+            assert got == 1, got
+            types = {e.type for e in ev.events}
+            assert EventType.PERSISTENT_FANOUT_THROTTLED in types
+            await pub.disconnect()
+            await trans.disconnect()
+        finally:
+            await broker.stop()
