@@ -422,6 +422,8 @@ def main():
     wait_s = int(os.environ.get("BENCH_DEVICE_WAIT", "900"))
     deadline = time.time() + wait_s
     attempt = 0
+    same_err = 0
+    last_err = None
     while True:
         attempt += 1
         try:
@@ -441,6 +443,15 @@ def main():
             if isinstance(e, subprocess.CalledProcessError) and e.stderr:
                 detail = " :: " + e.stderr.decode(
                     "utf-8", "replace").strip()[-400:]
+            # a tunnel flap looks like a timeout or a changing stderr; the
+            # SAME CalledProcessError stderr over and over is a permanent
+            # failure (ImportError, bad platform pin) — fail fast instead
+            # of burning the whole wait window on it
+            if isinstance(e, subprocess.CalledProcessError):
+                same_err = same_err + 1 if detail == last_err else 1
+                last_err = detail
+                if same_err >= 5:
+                    deadline = 0.0
             remaining = deadline - time.time()
             if remaining <= 0:
                 msg = (f"jax device init failed/hung through {attempt} "

@@ -61,7 +61,8 @@ class AgentHost(asyncio.DatagramProtocol):
 
     def __init__(self, node_id: str, host: str = "127.0.0.1",
                  port: int = 0, *, seeds: Optional[List[Tuple[str, int]]] = None,
-                 rng: Optional[random.Random] = None) -> None:
+                 rng: Optional[random.Random] = None,
+                 tls_server_ctx=None, tls_client_ctx=None) -> None:
         self.node_id = node_id
         self.host = host
         self.port = port
@@ -76,6 +77,12 @@ class AgentHost(asyncio.DatagramProtocol):
         self._relays: Dict[int, Tuple] = {}
         self._seq = 0
         self._tcp_server: Optional[asyncio.AbstractServer] = None
+        # optional TLS for the TCP large-payload plane (the reference's
+        # cluster transport supports TLS on both planes; UDP gossip here
+        # stays clear like the reference's default — basecluster
+        # transport/AbstractTransport.java)
+        self._tls_server_ctx = tls_server_ctx
+        self._tls_client_ctx = tls_client_ctx
         self._listeners: List[Callable[[], None]] = []
         self._payload_handlers: Dict[str, Callable[[str, dict], None]] = {}
         self.stopped = False
@@ -91,7 +98,7 @@ class AgentHost(asyncio.DatagramProtocol):
         # fragment badly well before); oversized payloads ride TCP (the
         # reference's dual UDP/TCP cluster transport)
         self._tcp_server = await asyncio.start_server(
-            self._on_tcp, self.host, 0)
+            self._on_tcp, self.host, 0, ssl=self._tls_server_ctx)
         tcp_port = self._tcp_server.sockets[0].getsockname()[1]
         self.members[self.node_id] = MemberState(
             node_id=self.node_id, addr=(self.host, self.port),
@@ -139,7 +146,8 @@ class AgentHost(asyncio.DatagramProtocol):
     async def _send_tcp(self, addr: Tuple[str, int], raw: bytes) -> None:
         try:
             _r, w = await asyncio.wait_for(
-                asyncio.open_connection(*addr), 2.0)
+                asyncio.open_connection(*addr, ssl=self._tls_client_ctx),
+                2.0)
             w.write(len(raw).to_bytes(4, "big") + raw)
             await w.drain()
             w.close()

@@ -29,19 +29,22 @@ class AdaptiveReceiveQuota:
         # start at the ceiling: a fresh client is presumed healthy and the
         # first congestion signal shrinks fast (multiplicative)
         self.quota = self.recv_max
-        self._fast = 0.0
-        self._slow = 0.0
+        from ..scheduler.batcher import EMA
+        self._fast = EMA(self.FAST_ALPHA)
+        self._slow = EMA(self.SLOW_ALPHA)
+        self._seeded = False
 
     def on_ack(self, latency_s: float) -> None:
         latency_s = max(0.0, latency_s)
-        if self._slow == 0.0:
-            self._fast = self._slow = latency_s
+        if not self._seeded:
+            self._fast.value = self._slow.value = latency_s
+            self._seeded = True
             return
-        self._fast += self.FAST_ALPHA * (latency_s - self._fast)
-        self._slow += self.SLOW_ALPHA * (latency_s - self._slow)
-        if self._slow <= 0.0:
+        fast = self._fast.update(latency_s)
+        slow = self._slow.update(latency_s)
+        if slow <= 0.0:
             return
-        ratio = self._fast / self._slow
+        ratio = fast / slow
         if ratio >= 1 + self.EPS_HIGH:
             self.quota = max(self.recv_min,
                              int(self.quota * self.SHRINK_RATIO))

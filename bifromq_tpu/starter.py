@@ -87,10 +87,20 @@ class Standalone:
             for s in cluster_cfg.get("seeds", []):
                 h, p = str(s).rsplit(":", 1)
                 seeds.append((h, int(p)))
+            # optional TLS on the TCP large-payload plane:
+            #   cluster: {tls: {cert: c.pem, key: k.pem, verify: false}}
+            tls_srv = tls_cli = None
+            tls_cfg = cluster_cfg.get("tls")
+            if tls_cfg:
+                tls_srv = _tls_context(tls_cfg)
+                tls_cli = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_CLIENT)
+                if not tls_cfg.get("verify", False):
+                    tls_cli.check_hostname = False
+                    tls_cli.verify_mode = ssl_mod.CERT_NONE
             self.agent_host = AgentHost(
                 cluster_cfg.get("node_id", "node"),
                 host=host, port=int(cluster_cfg.get("port", 0)),
-                seeds=seeds)
+                seeds=seeds, tls_server_ctx=tls_srv, tls_client_ctx=tls_cli)
             await self.agent_host.start()
             registry = ServiceRegistry(agent_host=self.agent_host)
 

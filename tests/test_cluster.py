@@ -147,3 +147,35 @@ class TestRobustness:
             assert frm == a.node_id and data["blob"] == blob
         finally:
             await stop_all(hosts)
+
+    async def test_large_payload_rides_tcp_with_tls(self, certs):
+        """The TCP large-payload plane can run TLS (≈ the reference's
+        optional TLS on the cluster transport, base-cluster
+        transport/AbstractTransport.java)."""
+        import ssl as _ssl
+
+        key, crt = certs
+        srv = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+        srv.load_cert_chain(crt, key)
+        cli = _ssl.SSLContext(_ssl.PROTOCOL_TLS_CLIENT)
+        cli.check_hostname = False
+        cli.verify_mode = _ssl.CERT_NONE
+        from bifromq_tpu.cluster.membership import AgentHost
+        a = AgentHost("tls-a", tls_server_ctx=srv, tls_client_ctx=cli)
+        await a.start()
+        b = AgentHost("tls-b", seeds=[("127.0.0.1", a.port)],
+                      tls_server_ctx=srv, tls_client_ctx=cli)
+        await b.start()
+        try:
+            await wait_for(lambda: all(
+                len(h.alive_members()) == 2 for h in (a, b)))
+            got = asyncio.get_running_loop().create_future()
+            b.register_payload_handler(
+                "big", lambda frm, data: (not got.done()
+                                          and got.set_result((frm, data))))
+            blob = "y" * 200_000
+            assert a.send_payload(b.node_id, "big", {"blob": blob})
+            frm, data = await asyncio.wait_for(got, 5)
+            assert frm == a.node_id and data["blob"] == blob
+        finally:
+            await stop_all([a, b])

@@ -576,10 +576,12 @@ class TestNewTenantSettings:
         broker = MQTTBroker(host="127.0.0.1", port=0, settings=FireLWT(),
                             events=ev)
         await broker.start()
-        c = MQTTClient("127.0.0.1", broker.port, client_id="lwt",
-                       will=pkts.Will(topic="lwt/t", payload=b"gone"))
-        await c.connect()
-        await broker.stop()
+        try:
+            c = MQTTClient("127.0.0.1", broker.port, client_id="lwt",
+                           will=pkts.Will(topic="lwt/t", payload=b"gone"))
+            await c.connect()
+        finally:
+            await broker.stop()
         types = {e.type for e in ev.events}
         assert EventType.WILL_DISTED in types
 
@@ -590,10 +592,12 @@ class TestNewTenantSettings:
         ev = CollectingEventCollector()
         broker = MQTTBroker(host="127.0.0.1", port=0, events=ev)
         await broker.start()
-        c = MQTTClient("127.0.0.1", broker.port, client_id="lwt2",
-                       will=pkts.Will(topic="lwt/t", payload=b"gone"))
-        await c.connect()
-        await broker.stop()
+        try:
+            c = MQTTClient("127.0.0.1", broker.port, client_id="lwt2",
+                           will=pkts.Will(topic="lwt/t", payload=b"gone"))
+            await c.connect()
+        finally:
+            await broker.stop()
         types = {e.type for e in ev.events}
         assert EventType.WILL_DISTED not in types
 
