@@ -21,7 +21,7 @@ same way), host-fallback cost for overflowed topics folded in at the
 measured oracle rate.
 
 Env knobs: BENCH_CONFIGS ("1,2,3,4,5" default; "2" = headline only),
-BENCH_SUBS (config-2 subs, default 1_000_000), BENCH_BATCH (8192),
+BENCH_SUBS (config-2 subs, default 1_000_000), BENCH_BATCH (16384),
 BENCH_ITERS (30), BENCH_K (16), BENCH_SEED (0), BENCH_RETAINED (1_000_000),
 BENCH_COMPACTION (sort|scatter),
 BENCH_SHARED_TENANTS (1000), BENCH_SHARED_SUBS (1000), BENCH_MT_TENANTS
@@ -39,7 +39,7 @@ ASSUMED_STOCK_RATE = 100_000.0
 
 CONFIGS = os.environ.get("BENCH_CONFIGS", "1,2,3,4,5").split(",")
 N_SUBS = int(os.environ.get("BENCH_SUBS", "1000000"))
-BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
+BATCH = int(os.environ.get("BENCH_BATCH", "16384"))
 ITERS = int(os.environ.get("BENCH_ITERS", "30"))
 K_STATES = int(os.environ.get("BENCH_K", "16"))
 SEED = int(os.environ.get("BENCH_SEED", "0"))
@@ -216,7 +216,7 @@ def bench_config2():
     name = f"c2_wildcard_{N_SUBS}"
     if os.environ.get("BENCH_SWEEP"):
         sweep_b = [int(x) for x in os.environ.get(
-            "BENCH_SWEEP_B", "8192,32768").split(",") if x]
+            "BENCH_SWEEP_B", "8192,16384,32768").split(",") if x]
         sweep_k = [int(x) for x in os.environ.get(
             "BENCH_SWEEP_K", "8,16").split(",") if x]
         # one compile, a (batch × k_states) grid of measurements; the best

@@ -32,9 +32,9 @@ Table layout (all int32, device-friendly):
 - ``edge_tab [NB, P, 4]``: single-choice bucketed hash table of literal
   edges, entries ``(node, h1, h2, child)``. Every key lives in bucket
   mix1(key) (the table grows until no bucket overflows), so a device lookup
-  is exactly ONE contiguous bucket-row gather — on TPU, gather cost is
-  per-index, not per-byte, so one bucket row (512 bytes at the default
-  probe_len=32) costs the same as one 4-byte element.
+  is exactly ONE contiguous bucket-row gather — per-index fetch dominates
+  gather cost, though row bytes still matter (the r3 v5e sweep picked
+  probe_len=16, 256B rows, as the sweet spot; see ops.match._edge_lookup).
 - ``child_list [E]``: literal child node ids in CSR order (DFS order).
 
 Level strings are hashed to 64 bits (two int32 lanes) with BLAKE2b + salt; the
@@ -151,7 +151,7 @@ def _node_matchings(node: _TrieNode) -> List[Matching]:
 
 
 def compile_tries(tries: Dict[str, SubscriptionTrie], *, max_levels: int = 16,
-                  probe_len: int = 32, salt: int = 0, min_edge_cap: int = 8,
+                  probe_len: int = 16, salt: int = 0, min_edge_cap: int = 8,
                   _max_salt_retries: int = 4) -> CompiledTrie:
     """Compile per-tenant subscription tries into one packed automaton.
 

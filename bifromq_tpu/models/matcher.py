@@ -72,7 +72,7 @@ _TombKey = Tuple[str, Tuple[int, str, str]]
 
 class TpuMatcher:
     def __init__(self, *, max_levels: int = 16, k_states: int = 32,
-                 probe_len: int = 32, device=None,
+                 probe_len: int = 16, device=None,
                  auto_compact: bool = True,
                  compact_threshold: int = 2048) -> None:
         self.max_levels = max_levels

@@ -38,7 +38,10 @@ class AdaptiveReceiveQuota:
         latency_s = max(0.0, latency_s)
         if not self._seeded:
             self._fast.value = self._slow.value = latency_s
-            self._seeded = True
+            # a 0.0 sample (coarse clock) is no seed at all: the EMAs would
+            # converge at different alphas and fake a congestion ratio —
+            # keep re-seeding until a positive latency arrives
+            self._seeded = latency_s > 0.0
             return
         fast = self._fast.update(latency_s)
         slow = self._slow.update(latency_s)

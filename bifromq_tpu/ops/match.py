@@ -42,6 +42,15 @@ from ..models.automaton import (
 )
 
 
+# narrow count-walk table layout (see DeviceTrie.count_tab). CT_PLUS MUST
+# stay at column 0: _advance reads its node-record argument at NODE_PLUS=0,
+# and the count walk passes count_tab records straight through it.
+CT_PLUS = 0
+CT_HRCOUNT = 1
+CT_RCOUNT = 2
+CT_COLS = 4      # padded to a power of two for clean gather tiling
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class DeviceTrie:
@@ -49,9 +58,17 @@ class DeviceTrie:
     node_tab: jax.Array   # [N, NODE_COLS] int32
     edge_tab: jax.Array   # [T, 4] int32
     child_list: jax.Array  # [E] int32
+    # [N, CT_COLS] int32 — just the columns the count walk touches
+    # (plus-child, folded '#'-route count, final-route count): the full
+    # node record is 12 cols = 48B/row, of which the fan-out-count walk
+    # reads 3; gathering the narrow row cuts per-step node bytes 3x.
+    # Optional: paths that only run the full walk() (e.g. the shard_map
+    # mesh step) may leave it None; walk_count_only requires it.
+    count_tab: "jax.Array | None" = None
 
     def tree_flatten(self):
-        return (self.node_tab, self.edge_tab, self.child_list), None
+        return (self.node_tab, self.edge_tab, self.child_list,
+                self.count_tab), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -59,11 +76,18 @@ class DeviceTrie:
 
     @staticmethod
     def from_compiled(ct: CompiledTrie, device=None) -> "DeviceTrie":
+        from ..models.automaton import NODE_HRCOUNT
         put = functools.partial(jax.device_put, device=device)
+        count_cols = np.zeros((ct.node_tab.shape[0], CT_COLS),
+                              dtype=np.int32)
+        count_cols[:, CT_PLUS] = ct.node_tab[:, NODE_PLUS]
+        count_cols[:, CT_HRCOUNT] = ct.node_tab[:, NODE_HRCOUNT]
+        count_cols[:, CT_RCOUNT] = ct.node_tab[:, NODE_RCOUNT]
         return DeviceTrie(
             node_tab=put(ct.node_tab),
             edge_tab=put(ct.edge_tab),
             child_list=put(ct.child_list),
+            count_tab=put(count_cols),
         )
 
 
@@ -125,10 +149,12 @@ def _edge_lookup(edge_tab: jax.Array, probe_len: int, node: jax.Array,
 
     The edge table is single-choice bucketed ([NB, P, 4],
     automaton._build_edge_table): every key lives in bucket mix1(key), so
-    the lookup is exactly ONE contiguous bucket-row gather — TPU gather
-    cost is per-index, not per-byte, so fetching a whole bucket row (512B
-    at the default probe_len=32) costs the same as one element (and the
-    old second-choice gather measured ~12ms/batch on v5e).
+    the lookup is exactly ONE contiguous bucket-row gather. Gather cost is
+    dominated by the per-index fetch, but row BYTES matter too: the r3
+    probe_len sweep on v5e measured 241K topics/s @ P=32 (512B rows),
+    300K @ P=16, 262K @ P=8 (table bytes double each halving; P=8's 256MB
+    table loses more to cache pressure than the narrower row wins) — so
+    the compiler default is probe_len=16.
     """
     nb = edge_tab.shape[0]
     mask = jnp.uint32(nb - 1)
@@ -317,12 +343,10 @@ def _count_walk(trie: DeviceTrie, probes: Probes, probe_len: int,
     materializes the accept tensors — the cheapest full-match measurement
     (and the shape a pure fan-out-counting service would use).
 
-    '#'-accept counting reads the NODE_HRCOUNT column (the hash child's
+    '#'-accept counting reads the CT_HRCOUNT column (the hash child's
     route count folded into the parent record at compile time) — on v5e the
     separate hash-child gather was ~half the whole walk's time.
     Returns ([B] counts, [B] overflow)."""
-    from ..models.automaton import NODE_HRCOUNT
-
     b, width = probes.tok_h1.shape
     k = k_states
 
@@ -330,11 +354,14 @@ def _count_walk(trie: DeviceTrie, probes: Probes, probe_len: int,
         in_range = (i <= probes.lengths)[:, None]
         valid = (act >= 0) & in_range
         allow_wc = jnp.logical_not(probes.sys_mask & (i == 0))[:, None]
-        node_rec = trie.node_tab[act.clip(0)]
-        hc_cnt = jnp.where(valid & allow_wc, node_rec[..., NODE_HRCOUNT], 0)
+        # narrow gather: count_tab carries exactly the 3 columns this walk
+        # reads, with the plus-child at column 0 so the record can be
+        # handed to _advance unchanged (layout contract at CT_PLUS)
+        node_rec = trie.count_tab[act.clip(0)]
+        hc_cnt = jnp.where(valid & allow_wc, node_rec[..., CT_HRCOUNT], 0)
         cnt = cnt + hc_cnt.sum(axis=1, dtype=jnp.int32)
         is_final = (i == probes.lengths)[:, None]
-        fin_cnt = jnp.where(is_final & valid, node_rec[..., NODE_RCOUNT], 0)
+        fin_cnt = jnp.where(is_final & valid, node_rec[..., CT_RCOUNT], 0)
         cnt = cnt + fin_cnt.sum(axis=1, dtype=jnp.int32)
         new_act, overflowed = _advance(trie, probes, probe_len, b, k, i,
                                        act, valid, allow_wc, node_rec,

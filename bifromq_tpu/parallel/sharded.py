@@ -62,7 +62,7 @@ class ShardedTables:
 
 
 def build_sharded(tries: Dict[str, SubscriptionTrie], n_shards: int, *,
-                  max_levels: int = 16, probe_len: int = 32) -> ShardedTables:
+                  max_levels: int = 16, probe_len: int = 16) -> ShardedTables:
     """Compile each tenant shard with a common edge-table capacity.
 
     All shards share one edge-table size (power of two) so the device-side
@@ -174,7 +174,7 @@ class MeshMatcher(TpuMatcher):
 
     def __init__(self, tries: Optional[Dict[str, SubscriptionTrie]] = None,
                  mesh: Optional[Mesh] = None, *,
-                 max_levels: int = 16, probe_len: int = 32,
+                 max_levels: int = 16, probe_len: int = 16,
                  k_states: int = 32, auto_compact: bool = True,
                  compact_threshold: int = 2048) -> None:
         assert mesh is not None, "MeshMatcher requires a mesh"

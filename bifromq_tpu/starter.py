@@ -94,7 +94,17 @@ class Standalone:
             if tls_cfg:
                 tls_srv = _tls_context(tls_cfg)
                 tls_cli = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_CLIENT)
-                if not tls_cfg.get("verify", False):
+                if tls_cfg.get("verify", False):
+                    # trust store: explicit CA if given, else the cluster's
+                    # own cert (self-signed deployments), else system CAs.
+                    # check_hostname stays off — peers dial by gossip IP.
+                    tls_cli.check_hostname = False
+                    ca = tls_cfg.get("ca") or tls_cfg.get("cert")
+                    if ca:
+                        tls_cli.load_verify_locations(ca)
+                    else:
+                        tls_cli.load_default_certs()
+                else:
                     tls_cli.check_hostname = False
                     tls_cli.verify_mode = ssl_mod.CERT_NONE
             self.agent_host = AgentHost(
