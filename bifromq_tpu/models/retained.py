@@ -172,7 +172,6 @@ class RetainedIndex:
         """
         if not queries:
             return []
-        self.refresh()
         probes, roots, lengths = self.device_probes(queries, batch=batch)
         ranges, overflow = self.walk_device(probes)
         nq = len(queries)

@@ -324,6 +324,9 @@ class Connection:
         enabled = {3: Setting.MQTT3Enabled, 4: Setting.MQTT4Enabled,
                    5: Setting.MQTT5Enabled}[c.protocol_level]
         if not settings[enabled]:
+            broker.events.report(Event(
+                EventType.UNACCEPTED_PROTOCOL_VER, tenant_id,
+                {"ver": c.protocol_level}))
             rc = (ReasonCode.UNSUPPORTED_PROTOCOL_VERSION if v5 else 1)
             await self.send(pk.Connack(reason_code=rc))
             await self.close_transport()
@@ -333,6 +336,8 @@ class Connection:
         assigned = None
         if not client_id:
             if not c.clean_start and not v5:
+                broker.events.report(Event(
+                    EventType.IDENTIFIER_REJECTED, tenant_id, {}))
                 await self.send(pk.Connack(
                     reason_code=CONNACK_REFUSED_IDENTIFIER_REJECTED))
                 await self.close_transport()
@@ -350,6 +355,9 @@ class Connection:
 
         if (c.will is not None and len(c.will.payload)
                 > settings[Setting.MaxLastWillBytes]):
+            broker.events.report(Event(
+                EventType.OVERSIZE_WILL_REJECTED, tenant_id,
+                {"bytes": len(c.will.payload)}))
             await self.send(pk.Connack(reason_code=(
                 ReasonCode.PACKET_TOO_LARGE if v5
                 else CONNACK_REFUSED_NOT_AUTHORIZED)))

@@ -534,6 +534,9 @@ class Session:
         ts = self.settings
         v5 = self.protocol_level >= PROTOCOL_MQTT5
         if len(s.subscriptions) > ts[Setting.MaxTopicFiltersPerSub]:
+            self.events.report(Event(EventType.TOO_LARGE_SUBSCRIPTION,
+                                     self.client_info.tenant_id,
+                                     {"count": len(s.subscriptions)}))
             await self.conn.protocol_error(
                 "too many filters", ReasonCode.QUOTA_EXCEEDED)
             return
@@ -635,8 +638,25 @@ class Session:
 
     async def _on_unsubscribe(self, u: pk.Unsubscribe) -> None:
         v5 = self.protocol_level >= PROTOCOL_MQTT5
+        ts = self.settings
+        if len(u.topic_filters) > ts[Setting.MaxTopicFiltersPerSub]:
+            self.events.report(Event(EventType.TOO_LARGE_UNSUBSCRIPTION,
+                                     self.client_info.tenant_id,
+                                     {"count": len(u.topic_filters)}))
+            await self.conn.protocol_error(
+                "too many filters", ReasonCode.QUOTA_EXCEEDED)
+            return
         codes: List[int] = []
         for tf in u.topic_filters:
+            # unsub permission check (≈ MQTTSessionHandler checkAndUnsub →
+            # UnsubActionDisallow event)
+            if not await self.auth.check_permission(
+                    self.client_info, MQTTAction.UNSUB, tf):
+                self.events.report(Event(
+                    EventType.UNSUB_ACTION_DISALLOWED,
+                    self.client_info.tenant_id, {"filter": tf}))
+                codes.append(ReasonCode.NOT_AUTHORIZED if v5 else 0x80)
+                continue
             sub = self.subscriptions.pop(tf, None)
             if sub is None:
                 codes.append(ReasonCode.NO_SUBSCRIPTION_EXISTED if v5 else 0)

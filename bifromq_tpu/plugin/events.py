@@ -57,6 +57,13 @@ class EventType(enum.Enum):
     # sub detail family
     SHARED_SUB_UNSUPPORTED = "shared_sub_unsupported"
     WILDCARD_SUB_UNSUPPORTED = "wildcard_sub_unsupported"
+    UNSUB_ACTION_DISALLOWED = "unsub_action_disallowed"
+    TOO_LARGE_SUBSCRIPTION = "too_large_subscription"
+    TOO_LARGE_UNSUBSCRIPTION = "too_large_unsubscription"
+    # connect guard detail family (≈ channelclosed/* events)
+    UNACCEPTED_PROTOCOL_VER = "unaccepted_protocol_ver"
+    IDENTIFIER_REJECTED = "identifier_rejected"
+    OVERSIZE_WILL_REJECTED = "oversize_will_rejected"
     # lwt detail
     WILL_DIST_ERROR = "will_dist_error"
     # inbox detail family

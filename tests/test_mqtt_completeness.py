@@ -664,3 +664,116 @@ class TestNewTenantSettings:
             await trans.disconnect()
         finally:
             await broker.stop()
+
+
+class TestGuardEvents:
+    """The connect/sub guard events added for parity with the reference's
+    channelclosed/accessctrl event families (UnsubActionDisallow.java,
+    UnacceptedProtocolVer.java, TooLargeSubscription.java, ...)."""
+
+    async def test_unsub_permission_denied(self):
+        from bifromq_tpu.plugin.auth import MQTTAction
+        from bifromq_tpu.plugin.events import CollectingEventCollector
+        from bifromq_tpu.mqtt.protocol import ReasonCode
+
+        class NoUnsub(AllowAllAuthProvider):
+            async def check_permission(self, client, action, topic):
+                return action is not MQTTAction.UNSUB
+
+        ev = CollectingEventCollector()
+        broker = MQTTBroker(host="127.0.0.1", port=0, auth=NoUnsub(),
+                            events=ev)
+        await broker.start()
+        try:
+            c = MQTTClient("127.0.0.1", broker.port, client_id="nu",
+                           protocol_level=5)
+            await c.connect()
+            await c.subscribe("a/b", qos=0)
+            ack = await c.unsubscribe("a/b")
+            assert ack.reason_codes == [ReasonCode.NOT_AUTHORIZED]
+            # the subscription survives a denied unsubscribe
+            p = MQTTClient("127.0.0.1", broker.port, client_id="np")
+            await p.connect()
+            await p.publish("a/b", b"still", qos=1)
+            msg = await asyncio.wait_for(c.messages.get(), 5)
+            assert msg.payload == b"still"
+            assert EventType.UNSUB_ACTION_DISALLOWED in {
+                e.type for e in ev.events}
+            await c.disconnect()
+            await p.disconnect()
+        finally:
+            await broker.stop()
+
+    async def test_too_large_sub_and_unsub(self):
+        from bifromq_tpu.plugin.events import CollectingEventCollector
+        from bifromq_tpu.plugin.settings import (DefaultSettingProvider,
+                                                 Setting)
+
+        class TwoFilters(DefaultSettingProvider):
+            def provide(self, setting, tenant_id):
+                if setting is Setting.MaxTopicFiltersPerSub:
+                    return 2
+                return super().provide(setting, tenant_id)
+
+        ev = CollectingEventCollector()
+        broker = MQTTBroker(host="127.0.0.1", port=0,
+                            settings=TwoFilters(), events=ev)
+        await broker.start()
+        try:
+            c = MQTTClient("127.0.0.1", broker.port, client_id="tl",
+                           protocol_level=5)
+            await c.connect()
+            with pytest.raises(Exception):
+                await c.subscribe(["x/1", "x/2", "x/3"])
+            assert EventType.TOO_LARGE_SUBSCRIPTION in {
+                e.type for e in ev.events}
+            c2 = MQTTClient("127.0.0.1", broker.port, client_id="tl2",
+                            protocol_level=5)
+            await c2.connect()
+            with pytest.raises(Exception):
+                await c2.unsubscribe(["x/1", "x/2", "x/3"])
+            assert EventType.TOO_LARGE_UNSUBSCRIPTION in {
+                e.type for e in ev.events}
+        finally:
+            await broker.stop()
+
+    async def test_unaccepted_protocol_version(self):
+        from bifromq_tpu.plugin.events import CollectingEventCollector
+        from bifromq_tpu.plugin.settings import (DefaultSettingProvider,
+                                                 Setting)
+
+        class NoV3(DefaultSettingProvider):
+            def provide(self, setting, tenant_id):
+                if setting is Setting.MQTT4Enabled:
+                    return False
+                return super().provide(setting, tenant_id)
+
+        ev = CollectingEventCollector()
+        broker = MQTTBroker(host="127.0.0.1", port=0, settings=NoV3(),
+                            events=ev)
+        await broker.start()
+        try:
+            c = MQTTClient("127.0.0.1", broker.port, client_id="v4",
+                           protocol_level=4)
+            with pytest.raises(Exception):
+                await c.connect()
+            assert EventType.UNACCEPTED_PROTOCOL_VER in {
+                e.type for e in ev.events}
+        finally:
+            await broker.stop()
+
+    async def test_empty_client_id_rejected_v3_persistent(self):
+        from bifromq_tpu.plugin.events import CollectingEventCollector
+
+        ev = CollectingEventCollector()
+        broker = MQTTBroker(host="127.0.0.1", port=0, events=ev)
+        await broker.start()
+        try:
+            c = MQTTClient("127.0.0.1", broker.port, client_id="",
+                           protocol_level=4, clean_start=False)
+            with pytest.raises(Exception):
+                await c.connect()
+            assert EventType.IDENTIFIER_REJECTED in {
+                e.type for e in ev.events}
+        finally:
+            await broker.stop()
