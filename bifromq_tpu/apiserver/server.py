@@ -38,12 +38,14 @@ log = logging.getLogger("bifromq_tpu.api")
 
 class APIServer:
     def __init__(self, broker: MQTTBroker, host: str = "127.0.0.1",
-                 port: int = 0, *, cluster=None, metrics=None) -> None:
+                 port: int = 0, *, cluster=None, metrics=None,
+                 registry=None) -> None:
         self.broker = broker
         self.host = host
         self.port = port
         self.cluster = cluster
         self.metrics = metrics
+        self.registry = registry    # rpc.fabric.ServiceRegistry (clustered)
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> None:
@@ -146,6 +148,16 @@ class APIServer:
                              if self.metrics is not None else {})
             if route == ("GET", "/ranges"):
                 return self._ranges()
+            if route == ("GET", "/balancer"):
+                return self._balancer_state()
+            if route == ("PUT", "/balancer"):
+                return self._balancer_toggle(arg)
+            if route == ("GET", "/traffic"):
+                return self._traffic_get()
+            if route == ("PUT", "/traffic"):
+                return self._traffic_set(arg, body)
+            if route == ("DELETE", "/traffic"):
+                return self._traffic_unset(arg)
             return 404, {"error": f"no route {method} {url.path}"}
         except KeyError as e:
             return 400, {"error": f"missing parameter {e}"}
@@ -259,6 +271,72 @@ class APIServer:
         if retain_store is not None:
             out["retain"] = range_stats(retain_store)
         return 200, out
+
+    # -- balancer admin (≈ apiserver balancer enable/disable/state handlers)
+
+    def _controllers(self) -> Dict[str, object]:
+        out: Dict[str, object] = {}
+        ctl = getattr(getattr(self.broker.dist, "worker", None),
+                      "balance_controller", None)
+        if ctl is not None:
+            out["dist"] = ctl
+        for name, svc in (("inbox", self.broker.inbox),
+                          ("retain", self.broker.retain_service)):
+            c = getattr(svc, "balance_controller", None)
+            if c is not None:
+                out[name] = c
+        return out
+
+    def _balancer_state(self) -> Tuple[int, object]:
+        return 200, {name: c.state()
+                     for name, c in self._controllers().items()}
+
+    def _balancer_toggle(self, arg) -> Tuple[int, object]:
+        enable = (arg("enable") or "true").lower() in ("1", "true", "yes")
+        target = arg("store")      # omit = all
+        hit = []
+        for name, c in self._controllers().items():
+            if target in (None, name):
+                c.enabled = enable
+                hit.append(name)
+        if not hit:
+            return 404, {"error": f"no balance controller {target!r}"}
+        return 200, {"enabled": enable, "stores": hit}
+
+    # -- traffic directives (≈ apiserver traffic-rules handlers over the
+    #    RPC traffic governor)
+
+    def _traffic_get(self) -> Tuple[int, object]:
+        if self.registry is None:
+            return 404, {"error": "no service registry (standalone mode)"}
+        return 200, self.registry.traffic_directives()
+
+    def _traffic_set(self, arg, body: bytes) -> Tuple[int, object]:
+        if self.registry is None:
+            return 404, {"error": "no service registry (standalone mode)"}
+        service = arg("service")
+        if not service:
+            return 400, {"error": "missing parameter 'service'"}
+        groups = json.loads(body or b"{}")
+        # a bad weight stored here would TypeError inside every routed RPC
+        # for matching tenants — reject at the admin boundary instead
+        if (not isinstance(groups, dict)
+                or not all(isinstance(w, int) and not isinstance(w, bool)
+                           and w >= 0 for w in groups.values())):
+            return 400, {"error": "body must be {server_group: weight>=0}"}
+        self.registry.set_traffic_directive(
+            service, arg("tenant_prefix") or "", groups)
+        return 200, {"ok": True}
+
+    def _traffic_unset(self, arg) -> Tuple[int, object]:
+        if self.registry is None:
+            return 404, {"error": "no service registry (standalone mode)"}
+        service = arg("service")
+        if not service:
+            return 400, {"error": "missing parameter 'service'"}
+        self.registry.unset_traffic_directive(
+            service, arg("tenant_prefix") or "")
+        return 200, {"ok": True}
 
     def _routes(self, arg) -> Tuple[int, object]:
         tenant = arg("tenant_id") or "DevOnly"

@@ -108,8 +108,23 @@ class KVStoreBalanceController:
         self.balancers = balancers or [RangeSplitBalancer()]
         self.interval = interval
         self._task = None
+        # admin toggle + last-commands ring (≈ the reference apiserver's
+        # balancer enable/disable/state endpoints over
+        # KVStoreBalanceController)
+        self.enabled = True
+        self.history: list = []
+
+    def state(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "interval_s": self.interval,
+            "balancers": [type(b).__name__ for b in self.balancers],
+            "recent_commands": list(self.history[-20:]),
+        }
 
     async def run_once(self) -> int:
+        if not self.enabled:
+            return 0
         executed = 0
         for b in self.balancers:
             for cmd in b.balance(self.store):
@@ -118,14 +133,20 @@ class KVStoreBalanceController:
                         sib = await self.store.split(cmd.range_id,
                                                      cmd.split_key)
                         log.info("split %s -> %s", cmd.range_id, sib)
+                        self.history.append(
+                            {"cmd": "split", "range": cmd.range_id})
                         executed += 1
                     elif isinstance(cmd, MergeCommand):
                         await self.store.merge(cmd.left_id, cmd.right_id)
                         log.info("merged %s <- %s", cmd.left_id,
                                  cmd.right_id)
+                        self.history.append(
+                            {"cmd": "merge", "left": cmd.left_id,
+                             "right": cmd.right_id})
                         executed += 1
                 except Exception:  # noqa: BLE001 — keep balancing others
                     log.exception("balance command failed: %r", cmd)
+        del self.history[:-100]
         return executed
 
     async def start(self) -> None:

@@ -243,6 +243,14 @@ class ClusterPlacementController:
         self.alive_fn = alive_fn or (lambda: set(
             self.server.meta.landscape(self.server.cluster)))
         self._task = None
+        self.enabled = True
+
+    def state(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "interval_s": self.interval,
+            "balancers": [type(b).__name__ for b in self.balancers],
+        }
 
     def _leader_counts(self) -> Dict[str, int]:
         counts: Dict[str, int] = {}
@@ -253,6 +261,8 @@ class ClusterPlacementController:
         return counts
 
     async def run_once(self) -> int:
+        if not self.enabled:
+            return 0
         alive = set(self.alive_fn())
         executed = 0
         for b in self.balancers:

@@ -407,6 +407,12 @@ class ServiceRegistry:
                                     _json.dumps(group_weights,
                                                 sort_keys=True))
 
+    def traffic_directives(self) -> Dict[str, Dict[str, Dict[str, int]]]:
+        """Snapshot of service → tenant_prefix → {group: weight} (the
+        apiserver's GET /traffic introspection)."""
+        return {svc: {pfx: dict(gw) for pfx, gw in rules.items()}
+                for svc, rules in self._directives.items()}
+
     def unset_traffic_directive(self, service: str,
                                 tenant_prefix: str) -> None:
         self._directives.get(service, {}).pop(tenant_prefix, None)

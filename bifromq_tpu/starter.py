@@ -210,7 +210,8 @@ class Standalone:
             self.api = APIServer(self.broker,
                                  metrics=MetricsRegistry(),
                                  host=host,
-                                 port=int(api_cfg.get("port", 9090)))
+                                 port=int(api_cfg.get("port", 9090)),
+                                 registry=registry)
             await self.api.start()
         log.info("standalone up: mqtt=%s:%s%s%s", host, self.broker.port,
                  f" ws={self.broker.ws_port}" if ws else "",

@@ -165,3 +165,73 @@ class TestAPI:
         assert status == 400
         status, out = await http(api.port, "PUT", "/pub?topic=t&qos=7", b"x")
         assert status == 400
+
+
+class TestAdminEndpoints:
+    """Balancer enable/disable/state + traffic directives (≈ the reference
+    apiserver's balancer and traffic-rules handler families)."""
+
+    async def test_balancer_state_and_toggle(self):
+        # elasticity knobs configured → the dist worker runs a balance
+        # controller the admin API can inspect and toggle
+        broker = MQTTBroker(port=0,
+                            dist_worker_kwargs={"split_threshold": 100})
+        await broker.start()
+        api = APIServer(broker, port=0)
+        await api.start()
+        try:
+            status, state = await http(api.port, "GET", "/balancer")
+            assert status == 200
+            assert "dist" in state and state["dist"]["enabled"] is True
+            assert "RangeSplitBalancer" in state["dist"]["balancers"]
+
+            status, out = await http(api.port, "PUT",
+                                     "/balancer?enable=false")
+            assert status == 200 and "dist" in out["stores"]
+            ctl = broker.dist.worker.balance_controller
+            assert ctl.enabled is False
+            assert await ctl.run_once() == 0   # disabled loop is a no-op
+            status, state = await http(api.port, "GET", "/balancer")
+            assert state["dist"]["enabled"] is False
+            await http(api.port, "PUT", "/balancer?enable=true")
+            assert ctl.enabled is True
+
+            status, _ = await http(api.port, "PUT",
+                                   "/balancer?enable=false&store=nope")
+            assert status == 404
+        finally:
+            await api.stop()
+            broker.inbox.close()
+            await broker.stop()
+
+    async def test_traffic_endpoints_standalone_404(self, stack):
+        _, api, _ = stack
+        status, _ = await http(api.port, "GET", "/traffic")
+        assert status == 404
+
+    async def test_traffic_set_get_unset_with_registry(self):
+        from bifromq_tpu.rpc.fabric import ServiceRegistry
+        broker = MQTTBroker(port=0)
+        await broker.start()
+        reg = ServiceRegistry()
+        api = APIServer(broker, port=0, registry=reg)
+        await api.start()
+        try:
+            body = json.dumps({"groupA": 2, "groupB": 1}).encode()
+            status, _ = await http(
+                api.port, "PUT", "/traffic?service=dist&tenant_prefix=acme",
+                body)
+            assert status == 200
+            status, rules = await http(api.port, "GET", "/traffic")
+            assert status == 200
+            assert rules == {"dist": {"acme": {"groupA": 2, "groupB": 1}}}
+            status, _ = await http(
+                api.port, "DELETE",
+                "/traffic?service=dist&tenant_prefix=acme")
+            assert status == 200
+            _, rules = await http(api.port, "GET", "/traffic")
+            assert rules == {"dist": {}}
+        finally:
+            await api.stop()
+            broker.inbox.close()
+            await broker.stop()
