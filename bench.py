@@ -91,21 +91,32 @@ def _measure_match(tries, probe_fn, *, name, k_states=K_STATES,
     n_batches = 4
     probe_sets = []
     all_queries = []
+    toks = []
     t2 = time.time()
     for i in range(n_batches):
         queries = probe_fn(i, batch)
         all_queries.append(queries)
-        tok = tokenize([q[0] for q in queries],
-                       [ct.root_of(q[1]) for q in queries],
-                       max_levels=ct.max_levels, salt=ct.salt, batch=batch)
-        probe_sets.append(Probes.from_tokenized(tok))
+        toks.append(tokenize([q[0] for q in queries],
+                             [ct.root_of(q[1]) for q in queries],
+                             max_levels=ct.max_levels, salt=ct.salt,
+                             batch=batch))
+    t3 = time.time()
+    # tokenize-only rate: device_put is timed apart — the axon tunnel
+    # uploads at ~1MB/s, which used to drown the tokenizer number (r3
+    # measured the tokenizer itself at ~400K topics/s while the old
+    # combined metric read 4K)
+    tok_rate = batch * n_batches / (t3 - t2)
+    probe_sets = [Probes.from_tokenized(t) for t in toks]
     # block_until_ready is a NO-OP on the axon tunnel backend — only a
     # readback truly synchronizes (verify-skill gotcha; re-confirmed by
     # bisection: an unsynced warmup left jit compilation inside the timed
-    # loop, 78 vs 10.8 ms/iter)
-    np.asarray(probe_sets[-1].tok_h1)
-    t3 = time.time()
-    tok_rate = batch * n_batches / (t3 - t2)
+    # loop, 78 vs 10.8 ms/iter). Read back a slice of EVERY array of every
+    # set so no in-flight upload bleeds into the warmup number.
+    for p in probe_sets:
+        for a in (p.tok_h1, p.tok_h2, p.lengths, p.roots, p.sys_mask):
+            np.asarray(a[:1])
+    t4u = time.time()
+    upload_s = t4u - t3
 
     compaction = os.environ.get("BENCH_COMPACTION", "sort")
     if compaction not in ("sort", "scatter"):
@@ -118,8 +129,8 @@ def _measure_match(tries, probe_fn, *, name, k_states=K_STATES,
     for p in probe_sets:
         np.asarray(run(p)[0])  # true sync per set (see note above)
     t4 = time.time()
-    log(f"[{name}] warmup+jit {t4 - t3:.1f}s; host tokenize "
-        f"{tok_rate:,.0f} topics/s")
+    log(f"[{name}] warmup+jit {t4 - t4u:.1f}s; probe upload {upload_s:.1f}s; "
+        f"host tokenize {tok_rate:,.0f} topics/s")
 
     # ---- pipelined throughput: one readback at the end --------------------
     # fire-and-forget dispatch, sync once on the LAST call's output. On the
@@ -191,6 +202,7 @@ def _measure_match(tries, probe_fn, *, name, k_states=K_STATES,
         "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
         "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
         "host_tokenize_topics_per_s": round(tok_rate, 1),
+        "probe_upload_s": round(upload_s, 2),
         "compile_s": round(t1 - t0, 1),
         "batch": batch,
         "k_states": k_states,

@@ -292,7 +292,14 @@ class APIServer:
                      for name, c in self._controllers().items()}
 
     def _balancer_toggle(self, arg) -> Tuple[int, object]:
-        enable = (arg("enable") or "true").lower() in ("1", "true", "yes")
+        raw = (arg("enable") or "true").lower()
+        if raw in ("1", "true", "yes", "on"):
+            enable = True
+        elif raw in ("0", "false", "no", "off"):
+            enable = False
+        else:
+            # a typo must not silently disable elasticity cluster-wide
+            return 400, {"error": f"enable={raw!r} (use true|false)"}
         target = arg("store")      # omit = all
         hit = []
         for name, c in self._controllers().items():

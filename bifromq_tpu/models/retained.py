@@ -153,13 +153,14 @@ class RetainedIndex:
         return (FilterProbes.from_tokenized(tok, device=self.device),
                 roots, tok.lengths)
 
-    def walk_device(self, probes):
+    def walk_device(self, probes, *, k_states: Optional[int] = None):
         """Dispatch the retained walk on the current compiled tables."""
         from ..ops.retained import retained_walk
 
         ct = self.refresh()
         return retained_walk(self._device_trie, probes,
-                             probe_len=ct.probe_len, k_states=self.k_states)
+                             probe_len=ct.probe_len,
+                             k_states=k_states or self.k_states)
 
     def match_batch(self, queries: Sequence[Tuple[str, Sequence[str]]],
                     *, batch: Optional[int] = None,
@@ -176,19 +177,55 @@ class RetainedIndex:
         ranges, overflow = self.walk_device(probes)
         nq = len(queries)
         ranges = np.asarray(ranges)[:nq]            # [Q, R, 2]
-        overflow = np.asarray(overflow)[:nq]
+        # writable copy: escalation clears rescued rows in place (a bare
+        # asarray view of a jax buffer is read-only)
+        overflow = np.array(overflow)[:nq]
         lengths = np.asarray(lengths)[:nq]
         roots_a = np.asarray(roots[:nq])
 
+        # on-device escalation: rows whose '+'-expansion outgrew K states
+        # re-walk in a small sub-batch at a much wider K — the host oracle
+        # for a '#'-tailed filter walks whole subtrees in Python (seconds
+        # per filter on a 1M-topic trie), so every row rescued here is a
+        # ~1000x save (mirrors ops.match.walk_count_only's fused pass)
+        esc_k = min(8 * self.k_states, 256)
+        esc = np.nonzero(overflow & (lengths >= 0)
+                         & (roots_a >= 0))[0]
+        esc_map: Dict[int, np.ndarray] = {}
+        if esc.size and esc_k > self.k_states:
+            sub = [queries[i] for i in esc]
+            # floor the sub-batch at 256 lanes: retained_walk jit-compiles
+            # per (batch, k_states) shape, and letting every overflow count
+            # pick its own pow2 would recompile (seconds each) on the
+            # serving path; the floor caps the variant ladder
+            from .matcher import _pow2_batch
+            probes2, _, _ = self.device_probes(
+                sub, batch=max(256, _pow2_batch(len(sub))))
+            r2, ovf2 = self.walk_device(probes2, k_states=esc_k)
+            r2 = np.asarray(r2)[:len(sub)]
+            ovf2 = np.asarray(ovf2)[:len(sub)]
+            for j, qi in enumerate(esc):
+                if not ovf2[j]:
+                    esc_map[int(qi)] = r2[j]
+                    overflow[qi] = False
+
         starts = ranges[..., 0].astype(np.int64)
         counts = np.maximum(ranges[..., 1], 0).astype(np.int64)
+        host_rows = overflow | (lengths < 0)
+        counts[host_rows | (roots_a < 0)] = 0   # row mask: no device expansion
+        # splice escalated rows in (widths differ: pad grid to esc_k lanes)
+        if esc_map:
+            pad = esc_k - counts.shape[1]
+            starts = np.pad(starts, ((0, 0), (0, pad)))
+            counts = np.pad(counts, ((0, 0), (0, pad)))
+            for qi, rr in esc_map.items():
+                starts[qi] = rr[:, 0]
+                counts[qi] = np.maximum(rr[:, 1], 0)
         if limit is not None:
             # clip each query's ranges so the cumulative expansion stops
             # at the cap (scan-bounded like RetainMessageMatchLimit)
             cum = np.cumsum(counts, axis=1)
             counts = np.clip(limit - (cum - counts), 0, counts)
-        host_rows = overflow | (lengths < 0)
-        counts[host_rows | (roots_a < 0)] = 0   # row mask: no device expansion
         fc = counts.ravel()
         total = int(fc.sum())
         if total:

@@ -80,6 +80,25 @@ class TestRetainedIndex:
         got = sorted(idx.match("T", ["+", "x"]))
         assert got == sorted(topics)
 
+    def test_plus_overflow_escalates_on_device(self, monkeypatch):
+        """40 children > k_states=8 but < esc_k=64: the second device pass
+        rescues the row; the Python oracle must never run (on a 1M-topic
+        trie a single '#'-tailed oracle walk costs seconds)."""
+        from bifromq_tpu.models import retained as mod
+        topics = [f"t{i}/x" for i in range(40)]
+        idx = self.build(topics, k_states=8)
+
+        def boom(*a, **k):
+            raise AssertionError("host oracle used despite escalation")
+        monkeypatch.setattr(mod, "match_filter_host", boom)
+        got = sorted(idx.match("T", ["+", "x"]))
+        assert got == sorted(topics)
+        # beyond even esc_k: the oracle IS the correct last resort
+        monkeypatch.undo()
+        many = [f"m{i}" for i in range(300)]     # 300 roots > 8*8 esc_k=64
+        idx2 = self.build(many, k_states=8)
+        assert sorted(idx2.match("T", ["+"])) == sorted(many)
+
     def test_remove(self):
         idx = self.build(["a/b", "a/c"])
         idx.remove_topic("T", ["a", "b"], "a/b")
