@@ -253,6 +253,16 @@ class Connection:
         broker = self.broker
         v5 = c.protocol_level >= PROTOCOL_MQTT5
         peer = self.peer_addr
+        if (v5 and c.properties
+                and c.properties.get(PropertyId.MAXIMUM_PACKET_SIZE) == 0):
+            # MQTT5 3.1.2.11.4: a zero Maximum Packet Size is a Protocol
+            # Error — it must not be read as "no limit"
+            broker.events.report(Event(EventType.PROTOCOL_VIOLATION, "",
+                                       {"reason": "max_packet_size_0"}))
+            await self.send(pk.Connack(
+                reason_code=ReasonCode.MALFORMED_PACKET))
+            await self.close_transport()
+            return
         auth_method = None
         if v5 and c.properties:
             auth_method = c.properties.get(PropertyId.AUTHENTICATION_METHOD)

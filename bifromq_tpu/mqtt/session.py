@@ -226,6 +226,11 @@ class Session:
             self.connect_props.get(PropertyId.TOPIC_ALIAS_MAXIMUM, 0)
             if protocol_level >= PROTOCOL_MQTT5 else 0)
         self._send_alias: Dict[str, int] = {}
+        # client's Maximum Packet Size (v5): outbound packets beyond it
+        # are dropped, never sent [MQTT-3.1.2-25]
+        self._client_max_packet = int(
+            self.connect_props.get(PropertyId.MAXIMUM_PACKET_SIZE, 0)
+            if protocol_level >= PROTOCOL_MQTT5 else 0)
 
     # ---------------- lifecycle -------------------------------------------
 
@@ -283,9 +288,20 @@ class Session:
 
     async def _fire_will(self) -> None:
         will = self.will
+        wp = will.properties or {}
         msg = Message(message_id=0, pub_qos=QoS(will.qos),
                       payload=will.payload, timestamp=HLC.INST.get(),
-                      is_retain=will.retain)
+                      is_retain=will.retain,
+                      expiry_seconds=wp.get(
+                          PropertyId.MESSAGE_EXPIRY_INTERVAL, 0xFFFFFFFF),
+                      user_properties=tuple(
+                          wp.get(PropertyId.USER_PROPERTY) or ()),
+                      content_type=wp.get(PropertyId.CONTENT_TYPE, ""),
+                      response_topic=wp.get(PropertyId.RESPONSE_TOPIC, ""),
+                      correlation_data=wp.get(
+                          PropertyId.CORRELATION_DATA, b""),
+                      payload_format_indicator=int(
+                          wp.get(PropertyId.PAYLOAD_FORMAT_INDICATOR, 0)))
         await self.dist.pub(self.client_info, will.topic, msg)
         if will.retain and self.retain_service is not None:
             await self.retain_service.retain(self.client_info, will.topic, msg)
@@ -471,12 +487,24 @@ class Session:
                                      {"packet_id": p.packet_id}))
 
         expiry = 0xFFFFFFFF
+        uprops: tuple = ()
+        ctype, rtopic, cdata, pfi = "", "", b"", 0
         if self.protocol_level >= PROTOCOL_MQTT5 and p.properties:
-            expiry = p.properties.get(PropertyId.MESSAGE_EXPIRY_INTERVAL,
-                                      0xFFFFFFFF)
+            pp = p.properties
+            expiry = pp.get(PropertyId.MESSAGE_EXPIRY_INTERVAL, 0xFFFFFFFF)
+            # request/response + content metadata travel end-to-end
+            # [MQTT-3.3.2-15..20] (≈ the reference's Message proto fields)
+            uprops = tuple(pp.get(PropertyId.USER_PROPERTY) or ())
+            ctype = pp.get(PropertyId.CONTENT_TYPE, "")
+            rtopic = pp.get(PropertyId.RESPONSE_TOPIC, "")
+            cdata = pp.get(PropertyId.CORRELATION_DATA, b"")
+            pfi = int(pp.get(PropertyId.PAYLOAD_FORMAT_INDICATOR, 0))
         msg = Message(message_id=p.packet_id or 0, pub_qos=QoS(p.qos),
                       payload=p.payload, timestamp=HLC.INST.get(),
-                      expiry_seconds=expiry, is_retain=p.retain)
+                      expiry_seconds=expiry, is_retain=p.retain,
+                      user_properties=uprops, content_type=ctype,
+                      response_topic=rtopic, correlation_data=cdata,
+                      payload_format_indicator=pfi)
         self.events.report(Event(EventType.PUB_RECEIVED,
                                  self.client_info.tenant_id,
                                  {"topic": topic, "qos": p.qos}))
@@ -779,8 +807,39 @@ class Session:
                 props[PropertyId.SUBSCRIPTION_IDENTIFIER] = [sub.sub_id]
             if msg.user_properties:
                 props[PropertyId.USER_PROPERTY] = list(msg.user_properties)
+            if msg.content_type:
+                props[PropertyId.CONTENT_TYPE] = msg.content_type
+            if msg.response_topic:
+                props[PropertyId.RESPONSE_TOPIC] = msg.response_topic
+            if msg.correlation_data:
+                props[PropertyId.CORRELATION_DATA] = msg.correlation_data
+            if msg.payload_format_indicator:
+                props[PropertyId.PAYLOAD_FORMAT_INDICATOR] = \
+                    msg.payload_format_indicator
             if not props:
                 props = None
+        # [MQTT-3.1.2-25]: never send a packet beyond the client's announced
+        # Maximum Packet Size — drop it and record the event (≈
+        # OversizePacketDropped.java). The probe encodes the full topic plus
+        # a margin for a possible TOPIC_ALIAS property (the registration
+        # send carries BOTH the topic and the alias, so it can only be
+        # larger); packets nowhere near the cap skip the probe encode.
+        if self._client_max_packet and (
+                len(msg.payload) + len(topic) + 512
+                >= self._client_max_packet):
+            from .codec import encode as _encode
+            probe = pk.Publish(topic=topic, payload=msg.payload, qos=qos,
+                               retain=retain_flag,
+                               packet_id=1 if qos else None,
+                               properties=props)
+            alias_margin = 8 if self._send_alias_max else 0
+            if len(_encode(probe, self.protocol_level)) + alias_margin \
+                    > self._client_max_packet:
+                self.events.report(Event(
+                    EventType.OVERSIZE_PACKET_DROPPED,
+                    self.client_info.tenant_id,
+                    {"topic": topic, "limit": self._client_max_packet}))
+                return None
 
         def aliased(base_props):
             # resolved at SEND time only: a blocked publish must not

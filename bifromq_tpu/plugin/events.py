@@ -64,6 +64,7 @@ class EventType(enum.Enum):
     UNACCEPTED_PROTOCOL_VER = "unaccepted_protocol_ver"
     IDENTIFIER_REJECTED = "identifier_rejected"
     OVERSIZE_WILL_REJECTED = "oversize_will_rejected"
+    OVERSIZE_PACKET_DROPPED = "oversize_packet_dropped"
     # lwt detail
     WILL_DIST_ERROR = "will_dist_error"
     # inbox detail family

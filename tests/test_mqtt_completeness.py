@@ -777,3 +777,124 @@ class TestGuardEvents:
                 e.type for e in ev.events}
         finally:
             await broker.stop()
+
+
+class TestMQTT5ContentProps:
+    async def test_request_response_props_end_to_end(self):
+        """RESPONSE_TOPIC/CORRELATION_DATA/CONTENT_TYPE/PFI/user props
+        travel publisher → subscriber [MQTT-3.3.2-15..20]."""
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        await broker.start()
+        try:
+            sub = MQTTClient("127.0.0.1", broker.port, client_id="rr-sub",
+                             protocol_level=5)
+            await sub.connect()
+            await sub.subscribe("rr/q", qos=1)
+            p = MQTTClient("127.0.0.1", broker.port, client_id="rr-pub",
+                           protocol_level=5)
+            await p.connect()
+            await p.publish("rr/q", b"ask", qos=1, properties={
+                PropertyId.RESPONSE_TOPIC: "rr/answers",
+                PropertyId.CORRELATION_DATA: b"req-77",
+                PropertyId.CONTENT_TYPE: "application/json",
+                PropertyId.PAYLOAD_FORMAT_INDICATOR: 1,
+                PropertyId.USER_PROPERTY: [("k", "v"), ("k2", "v2")],
+            })
+            m = await asyncio.wait_for(sub.messages.get(), 5)
+            pr = m.properties or {}
+            assert pr.get(PropertyId.RESPONSE_TOPIC) == "rr/answers"
+            assert pr.get(PropertyId.CORRELATION_DATA) == b"req-77"
+            assert pr.get(PropertyId.CONTENT_TYPE) == "application/json"
+            assert pr.get(PropertyId.PAYLOAD_FORMAT_INDICATOR) == 1
+            assert pr.get(PropertyId.USER_PROPERTY) == [("k", "v"),
+                                                        ("k2", "v2")]
+            await sub.disconnect()
+            await p.disconnect()
+        finally:
+            await broker.stop()
+
+    async def test_oversize_packet_dropped_for_small_client(self):
+        """A client announcing a small Maximum Packet Size never receives
+        a larger PUBLISH [MQTT-3.1.2-25]; a sibling without the limit
+        gets the same message (≈ OversizePacketDropped.java)."""
+        from bifromq_tpu.plugin.events import CollectingEventCollector
+        ev = CollectingEventCollector()
+        broker = MQTTBroker(host="127.0.0.1", port=0, events=ev)
+        await broker.start()
+        try:
+            small = MQTTClient("127.0.0.1", broker.port, client_id="small",
+                               protocol_level=5,
+                               properties={
+                                   PropertyId.MAXIMUM_PACKET_SIZE: 64})
+            await small.connect()
+            await small.subscribe("big/t", qos=0)
+            normal = MQTTClient("127.0.0.1", broker.port,
+                                client_id="normal", protocol_level=5)
+            await normal.connect()
+            await normal.subscribe("big/t", qos=0)
+            p = MQTTClient("127.0.0.1", broker.port, client_id="bp",
+                           protocol_level=5)
+            await p.connect()
+            await p.publish("big/t", b"y" * 500, qos=0)
+            m = await asyncio.wait_for(normal.messages.get(), 5)
+            assert m.payload == b"y" * 500
+            await asyncio.sleep(0.3)
+            assert small.messages.qsize() == 0
+            assert EventType.OVERSIZE_PACKET_DROPPED in {
+                e.type for e in ev.events}
+            # small packets still flow to the limited client
+            await p.publish("big/t", b"ok", qos=0)
+            m = await asyncio.wait_for(small.messages.get(), 5)
+            assert m.payload == b"ok"
+            for c in (small, normal, p):
+                await c.disconnect()
+        finally:
+            await broker.stop()
+
+    async def test_zero_max_packet_size_is_protocol_error(self):
+        """MQTT5 3.1.2.11.4: Maximum Packet Size = 0 must be rejected,
+        not read as 'no limit'."""
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        await broker.start()
+        try:
+            c = MQTTClient("127.0.0.1", broker.port, client_id="z",
+                           protocol_level=5,
+                           properties={PropertyId.MAXIMUM_PACKET_SIZE: 0})
+            with pytest.raises(Exception):
+                await c.connect()
+        finally:
+            await broker.stop()
+
+    async def test_will_carries_content_properties(self):
+        """A v5 will's RESPONSE_TOPIC/CORRELATION_DATA/user props reach
+        will subscribers (the request-response death-notification
+        pattern)."""
+        from bifromq_tpu.mqtt import packets as pkts
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        await broker.start()
+        try:
+            sub = MQTTClient("127.0.0.1", broker.port, client_id="wsub2",
+                             protocol_level=5)
+            await sub.connect()
+            await sub.subscribe("wills/rr", qos=0)
+            dying = MQTTClient(
+                "127.0.0.1", broker.port, client_id="dying",
+                protocol_level=5,
+                will=pkts.Will(topic="wills/rr", payload=b"gone",
+                               properties={
+                                   PropertyId.RESPONSE_TOPIC: "wills/ack",
+                                   PropertyId.CORRELATION_DATA: b"w1",
+                                   PropertyId.USER_PROPERTY: [("a", "b")],
+                               }))
+            await dying.connect()
+            # ungraceful close → will fires
+            dying._writer.close()
+            m = await asyncio.wait_for(sub.messages.get(), 5)
+            pr = m.properties or {}
+            assert m.payload == b"gone"
+            assert pr.get(PropertyId.RESPONSE_TOPIC) == "wills/ack"
+            assert pr.get(PropertyId.CORRELATION_DATA) == b"w1"
+            assert pr.get(PropertyId.USER_PROPERTY) == [("a", "b")]
+            await sub.disconnect()
+        finally:
+            await broker.stop()
