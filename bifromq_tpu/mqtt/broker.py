@@ -260,7 +260,7 @@ class Connection:
             broker.events.report(Event(EventType.PROTOCOL_VIOLATION, "",
                                        {"reason": "max_packet_size_0"}))
             await self.send(pk.Connack(
-                reason_code=ReasonCode.MALFORMED_PACKET))
+                reason_code=ReasonCode.PROTOCOL_ERROR))
             await self.close_transport()
             return
         auth_method = None
@@ -643,6 +643,9 @@ class MQTTBroker:
                 await asyncio.wait_for(self._server.wait_closed(), 5)
             except asyncio.TimeoutError:
                 pass
+        # pending delayed wills must not outlive the broker (they'd fire
+        # into a stopped dist)
+        self.session_registry.close()
         await self.inbox.stop()
         if hasattr(self.retain_service, "stop"):
             await self.retain_service.stop()
@@ -668,6 +671,13 @@ class MQTTBroker:
         if rejected is not None:
             self._reject(writer, rejected)
             return
+        # lift the transport's pause threshold above the session's QoS0
+        # discard watermark (SEND_BUFFER_HIGH_WATER): drain() must not
+        # block the fan-out loop before the discard check can fire
+        try:
+            writer.transport.set_write_buffer_limits(high=1024 * 1024)
+        except (AttributeError, RuntimeError):
+            pass
         peer_addr = None
         # PROXY headers only exist on the plain-TCP listener: a TLS
         # connection's first plaintext bytes are MQTT (the LB's header
@@ -695,6 +705,12 @@ class MQTTBroker:
         if not await ws.server_handshake(reader, writer, self.ws_path):
             writer.close()
             return
+        # same slow-consumer contract as the TCP listener: buffer up to 1MB
+        # without pausing so the QoS0 discard watermark can fire
+        try:
+            writer.transport.set_write_buffer_limits(high=1024 * 1024)
+        except (AttributeError, RuntimeError):
+            pass
         stream = ws.server_stream(reader, writer)
         conn = Connection(self, stream, stream)
         await conn.run()

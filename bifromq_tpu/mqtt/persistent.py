@@ -47,12 +47,12 @@ class PersistentSession(Session):
         tenant = self.client_info.tenant_id
         lwt = None
         if self.will is not None:
+            from .session import will_delay_seconds, will_to_message
             lwt = LWT(topic=self.will.topic,
-                      message=Message(message_id=0,
-                                      pub_qos=QoS(self.will.qos),
-                                      payload=self.will.payload,
-                                      timestamp=HLC.INST.get(),
-                                      is_retain=self.will.retain))
+                      delay_seconds=will_delay_seconds(
+                          self.will, self.protocol_level),
+                      message=will_to_message(self.will,
+                                              self.protocol_level))
         meta, present = await self.inbox.attach(
             tenant, self.inbox_id, clean_start=self.clean_start,
             expiry_seconds=self.expiry_seconds,
@@ -92,8 +92,10 @@ class PersistentSession(Session):
             pass
         elif fire_will and self.will is not None \
                 and not self._will_suppressed:
-            # abnormal close: fire the will now, then let the inbox expire
-            await self._fire_will()
+            # abnormal close: fire the will (or arm its MQTT5 delay — a
+            # reconnect inside the window suppresses it), then let the
+            # inbox expire without double-firing the LWT
+            await self._fire_or_schedule_will()
             await self.inbox.detach(tenant, self.inbox_id,
                                     fire_lwt_on_expiry=False)
         elif self.expiry_seconds <= 0:

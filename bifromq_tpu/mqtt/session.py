@@ -16,6 +16,7 @@ SessionRegistry kicks the previous owner on re-register
 from __future__ import annotations
 
 import asyncio
+import functools
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -71,9 +72,18 @@ class SessionRegistry:
     def __init__(self, events: IEventCollector) -> None:
         self._owners: Dict[Tuple[str, str], "Session"] = {}
         self._events = events
+        # MQTT5 Will Delay [MQTT-3.1.3.2.2]: pending delayed wills keyed by
+        # session slot. Registry-owned so a reconnect DISCARDS the pending
+        # will, a re-schedule replaces it (no double fire), and broker
+        # shutdown cancels them all. The fire callback must capture plain
+        # refs (dist/events/will fields), never the Session object.
+        self._pending_wills: Dict[Tuple[str, str], asyncio.Task] = {}
 
     async def register(self, session: "Session") -> None:
         key = (session.client_info.tenant_id, session.client_id)
+        pending = self._pending_wills.pop(key, None)
+        if pending is not None:
+            pending.cancel()
         prev = self._owners.get(key)
         self._owners[key] = session
         if prev is not None and prev is not session:
@@ -93,6 +103,32 @@ class SessionRegistry:
     def client_ids(self, tenant_id: str) -> List[str]:
         """Connected client ids for a tenant (introspection)."""
         return [cid for (t, cid) in self._owners if t == tenant_id]
+
+    def schedule_will(self, tenant_id: str, client_id: str,
+                      delay_s: float, fire) -> None:
+        """Arm (or re-arm) the delayed will for a session slot; ``fire``
+        is an async callable holding no Session reference."""
+        key = (tenant_id, client_id)
+        old = self._pending_wills.pop(key, None)
+        if old is not None:
+            old.cancel()
+
+        async def run():
+            try:
+                await asyncio.sleep(delay_s)
+                await fire()
+            finally:
+                if self._pending_wills.get(key) is task:
+                    del self._pending_wills[key]
+
+        task = asyncio.get_running_loop().create_task(run())
+        self._pending_wills[key] = task
+
+    def close(self) -> None:
+        """Cancel every pending delayed will (broker shutdown)."""
+        for t in self._pending_wills.values():
+            t.cancel()
+        self._pending_wills.clear()
 
 
 class TransientSubBroker(ISubBroker):
@@ -159,6 +195,42 @@ class _OutboundQoS:
 # exhaustion. Transient sessions drop (and report); persistent sessions
 # stop fetching and retry after acks free the window.
 BLOCKED = object()
+
+
+def will_to_message(will: pk.Will, protocol_level: int) -> Message:
+    """The ONE will→Message definition (transient fire, delayed fire, and
+    the persistent LWT all share it, so v5 will properties cannot diverge
+    between paths)."""
+    wp = (will.properties or {}) if protocol_level >= PROTOCOL_MQTT5 else {}
+    return Message(
+        message_id=0, pub_qos=QoS(will.qos), payload=will.payload,
+        timestamp=HLC.INST.get(), is_retain=will.retain,
+        expiry_seconds=wp.get(PropertyId.MESSAGE_EXPIRY_INTERVAL,
+                              0xFFFFFFFF),
+        user_properties=tuple(wp.get(PropertyId.USER_PROPERTY) or ()),
+        content_type=wp.get(PropertyId.CONTENT_TYPE, ""),
+        response_topic=wp.get(PropertyId.RESPONSE_TOPIC, ""),
+        correlation_data=wp.get(PropertyId.CORRELATION_DATA, b""),
+        payload_format_indicator=int(
+            wp.get(PropertyId.PAYLOAD_FORMAT_INDICATOR, 0)))
+
+
+def will_delay_seconds(will: Optional[pk.Will], protocol_level: int) -> int:
+    if will is None or protocol_level < PROTOCOL_MQTT5:
+        return 0
+    return int((will.properties or {}).get(
+        PropertyId.WILL_DELAY_INTERVAL, 0))
+
+
+async def fire_will(*, will: pk.Will, msg: Message, client_info: ClientInfo,
+                    dist, retain_service, events: IEventCollector) -> None:
+    """Publish a will (shared by immediate and delayed paths; holds only
+    the refs it needs — never a Session)."""
+    await dist.pub(client_info, will.topic, msg)
+    if will.retain and retain_service is not None:
+        await retain_service.retain(client_info, will.topic, msg)
+    events.report(Event(EventType.WILL_DISTED, client_info.tenant_id,
+                        {"topic": will.topic}))
 
 
 class Session:
@@ -275,7 +347,7 @@ class Session:
             await self._unroute(sub)
         self.subscriptions.clear()
         if fire_will and self.will is not None and not self._will_suppressed:
-            await self._fire_will()
+            await self._fire_or_schedule_will()
         await self.conn.close_transport()
         # after cleanup: a throwing event-collector plugin must not be
         # able to abort teardown (closed is already True — no retry)
@@ -286,28 +358,31 @@ class Session:
                                  self.client_info.tenant_id,
                                  {"client_id": self.client_id}))
 
+    async def _fire_or_schedule_will(self) -> None:
+        """Immediate fire, or — MQTT5 Will Delay [MQTT-3.1.3.2-2] — arm the
+        registry-owned pending will: a reconnect into this
+        (tenant, client_id) slot discards it, re-arming replaces it, and
+        broker shutdown cancels it. The callback captures plain refs,
+        never the Session."""
+        delay = will_delay_seconds(self.will, self.protocol_level)
+        if delay > 0:
+            self.session_registry.schedule_will(
+                self.client_info.tenant_id, self.client_id, delay,
+                functools.partial(
+                    fire_will, will=self.will,
+                    msg=will_to_message(self.will, self.protocol_level),
+                    client_info=self.client_info, dist=self.dist,
+                    retain_service=self.retain_service,
+                    events=self.events))
+        else:
+            await self._fire_will()
+
     async def _fire_will(self) -> None:
         will = self.will
-        wp = will.properties or {}
-        msg = Message(message_id=0, pub_qos=QoS(will.qos),
-                      payload=will.payload, timestamp=HLC.INST.get(),
-                      is_retain=will.retain,
-                      expiry_seconds=wp.get(
-                          PropertyId.MESSAGE_EXPIRY_INTERVAL, 0xFFFFFFFF),
-                      user_properties=tuple(
-                          wp.get(PropertyId.USER_PROPERTY) or ()),
-                      content_type=wp.get(PropertyId.CONTENT_TYPE, ""),
-                      response_topic=wp.get(PropertyId.RESPONSE_TOPIC, ""),
-                      correlation_data=wp.get(
-                          PropertyId.CORRELATION_DATA, b""),
-                      payload_format_indicator=int(
-                          wp.get(PropertyId.PAYLOAD_FORMAT_INDICATOR, 0)))
-        await self.dist.pub(self.client_info, will.topic, msg)
-        if will.retain and self.retain_service is not None:
-            await self.retain_service.retain(self.client_info, will.topic, msg)
-        self.events.report(Event(EventType.WILL_DISTED,
-                                 self.client_info.tenant_id,
-                                 {"topic": will.topic}))
+        await fire_will(
+            will=will, msg=will_to_message(will, self.protocol_level),
+            client_info=self.client_info, dist=self.dist,
+            retain_service=self.retain_service, events=self.events)
 
     # ---------------- inbound packet handling ------------------------------
 
@@ -775,6 +850,10 @@ class Session:
     # persistent sessions override this to pause their fetch loop instead
     _drop_on_recv_max = True
 
+    # outbound socket-buffer bytes beyond which QoS0 pushes are discarded
+    # rather than awaited (slow-consumer isolation)
+    SEND_BUFFER_HIGH_WATER = 512 * 1024
+
     async def _send_publish(self, topic: str, msg: Message,
                             sub: Subscription, retained: bool = False):
         """Returns None (sent as qos0), the packet id (sent qos>0), or
@@ -824,8 +903,21 @@ class Session:
         # a margin for a possible TOPIC_ALIAS property (the registration
         # send carries BOTH the topic and the alias, so it can only be
         # larger); packets nowhere near the cap skip the probe encode.
+        props_est = 0
+        if props:
+            # forwarded properties are unbounded (user props, correlation
+            # data...) — they must count toward the skip heuristic. String
+            # lengths are CHARS; count 4 bytes each (UTF-8 worst case) so
+            # non-ASCII content can only make the estimate conservative —
+            # a too-low estimate would skip the exact probe and let an
+            # oversize packet through.
+            props_est = sum(
+                4 * (len(k) + len(v)) for k, v in (
+                    props.get(PropertyId.USER_PROPERTY) or ())) \
+                + 4 * (len(msg.content_type) + len(msg.response_topic)) \
+                + len(msg.correlation_data)
         if self._client_max_packet and (
-                len(msg.payload) + len(topic) + 512
+                len(msg.payload) + 4 * len(topic) + props_est + 512
                 >= self._client_max_packet):
             from .codec import encode as _encode
             probe = pk.Publish(topic=topic, payload=msg.payload, qos=qos,
@@ -853,6 +945,19 @@ class Session:
             return wire_topic, base_props
 
         if qos == 0:
+            # unwritable channel → DROP the QoS0 push instead of awaiting
+            # drain: one slow consumer must never stall the fan-out loop
+            # for its siblings (≈ MQTTTransientSessionHandler's
+            # channel-writability drop + Discard event)
+            transport = getattr(self.conn.writer, "transport", None)
+            if (transport is not None
+                    and transport.get_write_buffer_size()
+                    > self.SEND_BUFFER_HIGH_WATER):
+                self.events.report(Event(
+                    EventType.DISCARDED, self.client_info.tenant_id,
+                    {"topic": topic, "client_id": self.client_id,
+                     "reason": "channel_unwritable"}))
+                return None
             wire_topic, wprops = aliased(props)
             await self.conn.send(pk.Publish(topic=wire_topic,
                                             payload=msg.payload,

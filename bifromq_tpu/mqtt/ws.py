@@ -211,6 +211,13 @@ class _WSStream:
     def get_extra_info(self, name: str):
         return self._writer.get_extra_info(name)
 
+    @property
+    def transport(self):
+        """Expose the underlying TCP transport so the session's QoS0
+        slow-consumer discard (write-buffer watermark check) works on the
+        WebSocket listener exactly like on TCP/TLS."""
+        return self._writer.transport
+
 
 def server_stream(reader, writer) -> "_WSStream":
     return _WSStream(reader, writer, mask_out=False)

@@ -65,6 +65,7 @@ class EventType(enum.Enum):
     IDENTIFIER_REJECTED = "identifier_rejected"
     OVERSIZE_WILL_REJECTED = "oversize_will_rejected"
     OVERSIZE_PACKET_DROPPED = "oversize_packet_dropped"
+    DISCARDED = "discarded"    # QoS0 to an unwritable channel (≈ Discard)
     # lwt detail
     WILL_DIST_ERROR = "will_dist_error"
     # inbox detail family

@@ -898,3 +898,90 @@ class TestMQTT5ContentProps:
             await sub.disconnect()
         finally:
             await broker.stop()
+
+
+class TestSlowConsumer:
+    async def test_slow_qos0_consumer_discarded_not_blocking(self):
+        """A subscriber that stops reading must not stall fan-out to its
+        siblings: once its socket buffer passes the high-water mark, QoS0
+        pushes to it are DISCARDED (≈ the reference's channel-writability
+        drop + Discard event) while the healthy sibling keeps receiving."""
+        from bifromq_tpu.plugin.events import CollectingEventCollector
+        ev = CollectingEventCollector()
+        broker = MQTTBroker(host="127.0.0.1", port=0, events=ev)
+        await broker.start()
+        try:
+            slow = MQTTClient("127.0.0.1", broker.port, client_id="slow",
+                              protocol_level=5)
+            await slow.connect()
+            await slow.subscribe("flood/t", qos=0)
+            # stop the client from reading: pause its reader task so TCP
+            # backpressure fills the broker-side socket buffer
+            slow._read_task.cancel()
+            fast = MQTTClient("127.0.0.1", broker.port, client_id="fast",
+                              protocol_level=5)
+            await fast.connect()
+            await fast.subscribe("flood/t", qos=0)
+            p = MQTTClient("127.0.0.1", broker.port, client_id="fp",
+                           protocol_level=5)
+            await p.connect()
+            payload = b"x" * 60_000
+            n = 300   # ~18MB total: beyond kernel + user-space buffering
+            t0 = asyncio.get_event_loop().time()
+            for i in range(n):
+                await p.publish("flood/t", payload, qos=0)
+            publish_time = asyncio.get_event_loop().time() - t0
+            # QoS0 under pressure is lossy BY CONTRACT — assert isolation,
+            # not losslessness: the healthy sibling keeps receiving, the
+            # broker never stalls, and drops for the dead reader are
+            # visible as DISCARDED events
+            got = 0
+            deadline = asyncio.get_event_loop().time() + 10
+            while got < n and asyncio.get_event_loop().time() < deadline:
+                got = fast.messages.qsize()
+                await asyncio.sleep(0.05)
+            assert got >= n // 3, got
+            discarded_for = {e.meta.get("client_id")
+                             for e in ev.events
+                             if e.type is EventType.DISCARDED}
+            assert "slow" in discarded_for, discarded_for
+            assert publish_time < 15, publish_time
+            await fast.disconnect()
+            await p.disconnect()
+        finally:
+            await broker.stop()
+
+    async def test_will_delay_cancelled_by_reconnect(self):
+        """MQTT5 Will Delay: a reconnect inside the window suppresses the
+        will; without reconnect the will fires after the delay."""
+        from bifromq_tpu.mqtt import packets as pkts
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        await broker.start()
+        try:
+            sub = MQTTClient("127.0.0.1", broker.port, client_id="wdsub",
+                             protocol_level=5)
+            await sub.connect()
+            await sub.subscribe("wd/t", qos=0)
+
+            def dying_client():
+                return MQTTClient(
+                    "127.0.0.1", broker.port, client_id="wd-dying",
+                    protocol_level=5,
+                    will=pkts.Will(topic="wd/t", payload=b"dead",
+                                   properties={
+                                       PropertyId.WILL_DELAY_INTERVAL: 1}))
+            c1 = dying_client()
+            await c1.connect()
+            c1._writer.close()              # ungraceful drop
+            await asyncio.sleep(0.2)
+            c2 = dying_client()             # reconnect INSIDE the window
+            await c2.connect()
+            await asyncio.sleep(1.2)        # past the original deadline
+            assert sub.messages.qsize() == 0, "will fired despite reconnect"
+            # now drop for real and let the delay elapse
+            c2._writer.close()
+            m = await asyncio.wait_for(sub.messages.get(), 5)
+            assert m.payload == b"dead"
+            await sub.disconnect()
+        finally:
+            await broker.stop()
