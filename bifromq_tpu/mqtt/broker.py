@@ -24,6 +24,7 @@ from ..plugin.settings import (DefaultSettingProvider, ISettingProvider,
                                Setting, TenantSettings)
 from ..plugin.subbroker import SubBrokerRegistry
 from ..types import ClientInfo
+from ..utils import topic as topic_util
 from . import packets as pk
 from .codec import StreamDecoder, encode
 from .protocol import (CONNACK_ACCEPTED, CONNACK_REFUSED_IDENTIFIER_REJECTED,
@@ -35,6 +36,18 @@ from .session import (LocalSessionRegistry, Session, SessionRegistry,
 log = logging.getLogger("bifromq_tpu.mqtt")
 
 CONNECT_TIMEOUT = 10.0  # ≈ MQTTPreludeHandler timeout
+
+
+def _lift_write_buffer_limit(writer: asyncio.StreamWriter) -> None:
+    """Raise the transport's pause threshold ABOVE the session's QoS0
+    discard watermark: drain() must never block the fan-out loop before
+    the slow-consumer discard check can fire. Derived (2x) from the one
+    constant so the two can't drift apart."""
+    try:
+        writer.transport.set_write_buffer_limits(
+            high=2 * Session.SEND_BUFFER_HIGH_WATER)
+    except (AttributeError, RuntimeError):
+        pass
 
 
 class Connection:
@@ -344,6 +357,23 @@ class Connection:
 
         client_id = c.client_id
         assigned = None
+        # length + UTF-8 sanity guards (≈ MaxMqtt3/5ClientIdLength,
+        # SanityCheckMqttUtf8String sysprops)
+        from ..utils import sysprops as sp
+        max_cid = sp.get(sp.SysProp.MAX_MQTT5_CLIENT_ID_LENGTH if v5
+                         else sp.SysProp.MAX_MQTT3_CLIENT_ID_LENGTH)
+        bad_utf8 = (sp.get(sp.SysProp.SANITY_CHECK_MQTT_UTF8)
+                    and not topic_util.is_well_formed_utf8(client_id))
+        if len(client_id.encode()) > max_cid or bad_utf8:
+            broker.events.report(Event(
+                EventType.IDENTIFIER_REJECTED, tenant_id,
+                {"length": len(client_id),
+                 "reason": "malformed" if bad_utf8 else "too_long"}))
+            await self.send(pk.Connack(reason_code=(
+                ReasonCode.CLIENT_IDENTIFIER_NOT_VALID if v5
+                else CONNACK_REFUSED_IDENTIFIER_REJECTED)))
+            await self.close_transport()
+            return
         if not client_id:
             if not c.clean_start and not v5:
                 broker.events.report(Event(
@@ -620,8 +650,62 @@ class MQTTBroker:
             self.ws_port = self._ws_server.sockets[0].getsockname()[1]
             log.info("mqtt-over-ws listening on %s:%s%s", self.host,
                      self.ws_port, self.ws_path)
+        from ..utils.sysprops import SysProp, get
+        self._redirect_task = asyncio.get_running_loop().create_task(
+            self._redirect_sweep(
+                get(SysProp.CLIENT_REDIRECT_CHECK_INTERVAL_SECONDS)))
+
+    async def _redirect_sweep(self, interval: float) -> None:
+        """Periodic IClientBalancer re-check on LIVE sessions (≈ the
+        reference's ClientRedirectCheckIntervalSeconds loop): a balancer
+        that starts redirecting (drain, rebalance) moves already-connected
+        clients, not just new CONNECTs."""
+        from ..plugin.balancer import RedirectType
+        while True:
+            await asyncio.sleep(interval)
+            for sid in list(self.local_sessions._by_id):
+                # a throwing plugin (balancer OR event collector) or a
+                # failing close must cost one session's sweep, never the
+                # sweep task itself
+                try:
+                    session = self.local_sessions.get(sid)
+                    if session is None or session.closed:
+                        continue
+                    redirect = self.balancer.need_redirect(
+                        session.client_info)
+                    if redirect is None:
+                        continue
+                    self.events.report(Event(
+                        EventType.REDIRECTED,
+                        session.client_info.tenant_id,
+                        {"client_id": session.client_id,
+                         "server_reference": redirect.server_reference}))
+                    if session.protocol_level >= PROTOCOL_MQTT5:
+                        rc = (ReasonCode.SERVER_MOVED
+                              if redirect.type == RedirectType.MOVE
+                              else ReasonCode.USE_ANOTHER_SERVER)
+                        props = ({PropertyId.SERVER_REFERENCE:
+                                  redirect.server_reference}
+                                 if redirect.server_reference else None)
+                        # a slow consumer's paused transport must not
+                        # wedge the whole sweep in drain()
+                        try:
+                            await asyncio.wait_for(
+                                session.conn.send(pk.Disconnect(
+                                    reason_code=rc, properties=props)),
+                                5.0)
+                        except asyncio.TimeoutError:
+                            pass
+                    session._will_suppressed = True  # a move ≠ a death
+                    await session.close(fire_will=False)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001
+                    log.exception("redirect sweep failed for one session")
 
     async def stop(self) -> None:
+        if getattr(self, "_redirect_task", None) is not None:
+            self._redirect_task.cancel()
         if self._server is not None:
             self._server.close()
         if self._tls_server is not None:
@@ -643,8 +727,12 @@ class MQTTBroker:
                 await asyncio.wait_for(self._server.wait_closed(), 5)
             except asyncio.TimeoutError:
                 pass
-        # pending delayed wills must not outlive the broker (they'd fire
-        # into a stopped dist)
+        # the delay window ends with the server: fire armed wills now
+        # (unless the tenant suppresses shutdown LWTs), then cancel — a
+        # task surviving stop() would fire into a stopped dist
+        await self.session_registry.flush_pending_wills(
+            lambda tenant: not TenantSettings.resolve(
+                self.settings, tenant)[Setting.NoLWTWhenServerShuttingDown])
         self.session_registry.close()
         await self.inbox.stop()
         if hasattr(self.retain_service, "stop"):
@@ -671,13 +759,7 @@ class MQTTBroker:
         if rejected is not None:
             self._reject(writer, rejected)
             return
-        # lift the transport's pause threshold above the session's QoS0
-        # discard watermark (SEND_BUFFER_HIGH_WATER): drain() must not
-        # block the fan-out loop before the discard check can fire
-        try:
-            writer.transport.set_write_buffer_limits(high=1024 * 1024)
-        except (AttributeError, RuntimeError):
-            pass
+        _lift_write_buffer_limit(writer)
         peer_addr = None
         # PROXY headers only exist on the plain-TCP listener: a TLS
         # connection's first plaintext bytes are MQTT (the LB's header
@@ -705,12 +787,7 @@ class MQTTBroker:
         if not await ws.server_handshake(reader, writer, self.ws_path):
             writer.close()
             return
-        # same slow-consumer contract as the TCP listener: buffer up to 1MB
-        # without pausing so the QoS0 discard watermark can fire
-        try:
-            writer.transport.set_write_buffer_limits(high=1024 * 1024)
-        except (AttributeError, RuntimeError):
-            pass
+        _lift_write_buffer_limit(writer)
         stream = ws.server_stream(reader, writer)
         conn = Connection(self, stream, stream)
         await conn.run()

@@ -110,6 +110,11 @@ class PersistentSession(Session):
 
     _kicked_replaced = False
 
+    def _will_delay_cap(self) -> int:
+        # the session survives the connection for expiry_seconds — the
+        # will may defer up to that window [MQTT-3.1.3.2-2]
+        return max(0, int(self.expiry_seconds))
+
     async def kick(self) -> None:
         self._kicked_replaced = True
         await super().kick()

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import logging
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -73,17 +74,30 @@ class SessionRegistry:
         self._owners: Dict[Tuple[str, str], "Session"] = {}
         self._events = events
         # MQTT5 Will Delay [MQTT-3.1.3.2.2]: pending delayed wills keyed by
-        # session slot. Registry-owned so a reconnect DISCARDS the pending
-        # will, a re-schedule replaces it (no double fire), and broker
-        # shutdown cancels them all. The fire callback must capture plain
-        # refs (dist/events/will fields), never the Session object.
-        self._pending_wills: Dict[Tuple[str, str], asyncio.Task] = {}
+        # session slot, value = (task, fire callback). Registry-owned so a
+        # reconnect DISCARDS the pending will, a re-schedule replaces it
+        # (no double fire), and broker shutdown flushes them (the window
+        # ends with the server). The fire callback must capture plain refs
+        # (dist/events/will fields), never the Session object.
+        self._pending_wills: Dict[Tuple[str, str], Tuple] = {}
 
     async def register(self, session: "Session") -> None:
         key = (session.client_info.tenant_id, session.client_id)
         pending = self._pending_wills.pop(key, None)
         if pending is not None:
-            pending.cancel()
+            task, fire = pending
+            task.cancel()
+            if session.clean_start:
+                # a clean-start reconnect ENDS the old session — per
+                # [MQTT-3.1.3.2-2] the will fires at session end, it is
+                # not silently discarded (only a resuming reconnect
+                # suppresses it)
+                try:
+                    await fire()
+                except Exception:  # noqa: BLE001
+                    self._events.report(Event(
+                        EventType.WILL_DIST_ERROR, key[0],
+                        {"client_id": key[1]}))
         prev = self._owners.get(key)
         self._owners[key] = session
         if prev is not None and prev is not session:
@@ -111,22 +125,51 @@ class SessionRegistry:
         key = (tenant_id, client_id)
         old = self._pending_wills.pop(key, None)
         if old is not None:
-            old.cancel()
+            old[0].cancel()
 
         async def run():
             try:
                 await asyncio.sleep(delay_s)
-                await fire()
+                try:
+                    await fire()
+                except Exception:  # noqa: BLE001 — a lost will must be
+                    # plugin-visible, like the inbox LWT path
+                    self._events.report(Event(
+                        EventType.WILL_DIST_ERROR, tenant_id,
+                        {"client_id": client_id}))
             finally:
-                if self._pending_wills.get(key) is task:
+                if self._pending_wills.get(key, (None,))[0] is task:
                     del self._pending_wills[key]
 
         task = asyncio.get_running_loop().create_task(run())
-        self._pending_wills[key] = task
+        self._pending_wills[key] = (task, fire)
+
+    async def flush_pending_wills(self, should_fire) -> None:
+        """Broker shutdown: the delay window ends with the server — fire
+        each armed will now unless ``should_fire(tenant_id)`` says the
+        tenant suppresses shutdown LWTs (NoLWTWhenServerShuttingDown)."""
+        pending = list(self._pending_wills.items())
+        self._pending_wills.clear()
+        for (tenant_id, client_id), (task, fire) in pending:
+            task.cancel()
+            try:
+                # a throwing settings plugin must not abort shutdown; the
+                # safe default is to fire (NoLWT… defaults to False)
+                fire_it = True
+                try:
+                    fire_it = should_fire(tenant_id)
+                except Exception:  # noqa: BLE001
+                    log.exception("settings plugin failed during shutdown")
+                if fire_it:
+                    await fire()
+            except Exception:  # noqa: BLE001
+                self._events.report(Event(
+                    EventType.WILL_DIST_ERROR, tenant_id,
+                    {"client_id": client_id}))
 
     def close(self) -> None:
         """Cancel every pending delayed will (broker shutdown)."""
-        for t in self._pending_wills.values():
+        for t, _fire in self._pending_wills.values():
             t.cancel()
         self._pending_wills.clear()
 
@@ -196,6 +239,8 @@ class _OutboundQoS:
 # stop fetching and retry after acks free the window.
 BLOCKED = object()
 
+log = logging.getLogger(__name__)
+
 
 def will_to_message(will: pk.Will, protocol_level: int) -> Message:
     """The ONE will→Message definition (transient fire, delayed fire, and
@@ -222,10 +267,17 @@ def will_delay_seconds(will: Optional[pk.Will], protocol_level: int) -> int:
         PropertyId.WILL_DELAY_INTERVAL, 0))
 
 
-async def fire_will(*, will: pk.Will, msg: Message, client_info: ClientInfo,
-                    dist, retain_service, events: IEventCollector) -> None:
+async def fire_will(*, will: pk.Will, client_info: ClientInfo,
+                    dist, retain_service, events: IEventCollector,
+                    protocol_level: int = PROTOCOL_MQTT5,
+                    msg: Optional[Message] = None) -> None:
     """Publish a will (shared by immediate and delayed paths; holds only
-    the refs it needs — never a Session)."""
+    the refs it needs — never a Session). When ``msg`` is omitted it is
+    built HERE, at fire time — a will's MESSAGE_EXPIRY_INTERVAL starts
+    when the will is published, so stamping it at arm time would burn the
+    delay window out of the expiry."""
+    if msg is None:
+        msg = will_to_message(will, protocol_level)
     await dist.pub(client_info, will.topic, msg)
     if will.retain and retain_service is not None:
         await retain_service.retain(client_info, will.topic, msg)
@@ -358,19 +410,27 @@ class Session:
                                  self.client_info.tenant_id,
                                  {"client_id": self.client_id}))
 
+    # Will Delay only defers when session state OUTLIVES the connection
+    # [MQTT-3.1.3.2-2]: the will fires at min(delay, session end), and a
+    # transient session ends the instant the network connection drops —
+    # PersistentSession overrides this with its expiry window.
+    def _will_delay_cap(self) -> int:
+        return 0
+
     async def _fire_or_schedule_will(self) -> None:
         """Immediate fire, or — MQTT5 Will Delay [MQTT-3.1.3.2-2] — arm the
         registry-owned pending will: a reconnect into this
         (tenant, client_id) slot discards it, re-arming replaces it, and
-        broker shutdown cancels it. The callback captures plain refs,
+        broker shutdown flushes it. The callback captures plain refs,
         never the Session."""
-        delay = will_delay_seconds(self.will, self.protocol_level)
+        delay = min(will_delay_seconds(self.will, self.protocol_level),
+                    self._will_delay_cap())
         if delay > 0:
             self.session_registry.schedule_will(
                 self.client_info.tenant_id, self.client_id, delay,
                 functools.partial(
                     fire_will, will=self.will,
-                    msg=will_to_message(self.will, self.protocol_level),
+                    protocol_level=self.protocol_level,
                     client_info=self.client_info, dist=self.dist,
                     retain_service=self.retain_service,
                     events=self.events))

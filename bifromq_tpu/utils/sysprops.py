@@ -41,6 +41,14 @@ class SysProp(enum.Enum):
     # raft / kv
     RAFT_TICK_INTERVAL_SECONDS = ("RAFT_TICK_INTERVAL_SECONDS", float, 0.01)
     KV_SYNC_ON_COMMIT = ("KV_SYNC_ON_COMMIT", _bool, False)
+    # connect guards (≈ MaxMqtt3ClientIdLength / MaxMqtt5ClientIdLength /
+    # SanityCheckMqttUtf8String — same 65535 defaults as the reference)
+    MAX_MQTT3_CLIENT_ID_LENGTH = ("MAX_MQTT3_CLIENT_ID_LENGTH", int, 65535)
+    MAX_MQTT5_CLIENT_ID_LENGTH = ("MAX_MQTT5_CLIENT_ID_LENGTH", int, 65535)
+    SANITY_CHECK_MQTT_UTF8 = ("SANITY_CHECK_MQTT_UTF8", _bool, False)
+    # live-session redirect sweep (≈ ClientRedirectCheckIntervalSeconds)
+    CLIENT_REDIRECT_CHECK_INTERVAL_SECONDS = (
+        "CLIENT_REDIRECT_CHECK_INTERVAL_SECONDS", float, 600.0)
 
     def __init__(self, env_suffix: str, parser: Callable[[str], Any],
                  default: Any) -> None:

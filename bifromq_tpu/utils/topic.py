@@ -207,3 +207,18 @@ def matches(topic_levels: List[str], filter_levels: List[str]) -> bool:
         ti += 1
         fi += 1
     return ti == nt
+
+
+def is_well_formed_utf8(s: str) -> bool:
+    """MQTT UTF-8 sanity (≈ UTF8Util.isWellFormed with sanity check on):
+    no U+0000, no C0/C1 control characters, no Unicode non-characters
+    [MQTT-1.5.4-1/2]."""
+    for ch in s:
+        cp = ord(ch)
+        if cp == 0x0000:
+            return False
+        if cp <= 0x001F or 0x007F <= cp <= 0x009F:      # C0 / DEL+C1
+            return False
+        if 0xFDD0 <= cp <= 0xFDEF or (cp & 0xFFFE) == 0xFFFE:
+            return False
+    return True

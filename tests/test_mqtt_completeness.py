@@ -952,8 +952,10 @@ class TestSlowConsumer:
             await broker.stop()
 
     async def test_will_delay_cancelled_by_reconnect(self):
-        """MQTT5 Will Delay: a reconnect inside the window suppresses the
-        will; without reconnect the will fires after the delay."""
+        """MQTT5 Will Delay (persistent session — the delay only applies
+        while session state outlives the connection [MQTT-3.1.3.2-2]):
+        a reconnect inside the window suppresses the will; without
+        reconnect the will fires after the delay."""
         from bifromq_tpu.mqtt import packets as pkts
         broker = MQTTBroker(host="127.0.0.1", port=0)
         await broker.start()
@@ -966,7 +968,8 @@ class TestSlowConsumer:
             def dying_client():
                 return MQTTClient(
                     "127.0.0.1", broker.port, client_id="wd-dying",
-                    protocol_level=5,
+                    protocol_level=5, clean_start=False,
+                    properties={PropertyId.SESSION_EXPIRY_INTERVAL: 300},
                     will=pkts.Will(topic="wd/t", payload=b"dead",
                                    properties={
                                        PropertyId.WILL_DELAY_INTERVAL: 1}))
@@ -982,6 +985,170 @@ class TestSlowConsumer:
             c2._writer.close()
             m = await asyncio.wait_for(sub.messages.get(), 5)
             assert m.payload == b"dead"
+            await sub.disconnect()
+        finally:
+            await broker.stop()
+
+    async def test_transient_will_fires_immediately_despite_delay(self):
+        """A clean-start (transient) session ENDS at disconnect, so its
+        will must publish at once even with WILL_DELAY_INTERVAL set."""
+        from bifromq_tpu.mqtt import packets as pkts
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        await broker.start()
+        try:
+            sub = MQTTClient("127.0.0.1", broker.port, client_id="twsub",
+                             protocol_level=5)
+            await sub.connect()
+            await sub.subscribe("tw/t", qos=0)
+            c = MQTTClient("127.0.0.1", broker.port, client_id="tw-dying",
+                           protocol_level=5,
+                           will=pkts.Will(topic="tw/t", payload=b"now",
+                                          properties={
+                                              PropertyId.WILL_DELAY_INTERVAL:
+                                              60}))
+            await c.connect()
+            c._writer.close()
+            m = await asyncio.wait_for(sub.messages.get(), 5)
+            assert m.payload == b"now"
+            await sub.disconnect()
+        finally:
+            await broker.stop()
+
+    async def test_armed_will_fires_at_broker_shutdown(self):
+        """Broker stop inside the delay window: the window ends with the
+        server — the armed will must flush, not vanish."""
+        from bifromq_tpu.mqtt import packets as pkts
+        from bifromq_tpu.plugin.events import CollectingEventCollector
+        from bifromq_tpu.plugin.settings import (DefaultSettingProvider,
+                                                 Setting)
+
+        class FireLWT(DefaultSettingProvider):
+            def provide(self, setting, tenant_id):
+                if setting is Setting.NoLWTWhenServerShuttingDown:
+                    return False
+                return super().provide(setting, tenant_id)
+
+        ev = CollectingEventCollector()
+        broker = MQTTBroker(host="127.0.0.1", port=0, settings=FireLWT(),
+                            events=ev)
+        await broker.start()
+        try:
+            c = MQTTClient("127.0.0.1", broker.port, client_id="sd-dying",
+                           protocol_level=5, clean_start=False,
+                           properties={PropertyId.SESSION_EXPIRY_INTERVAL:
+                                       300},
+                           will=pkts.Will(topic="sd/t", payload=b"flush",
+                                          properties={
+                                              PropertyId.WILL_DELAY_INTERVAL:
+                                              120}))
+            await c.connect()
+            c._writer.close()
+            await asyncio.sleep(0.5)    # let the broker arm the will
+            assert len(broker.session_registry._pending_wills) == 1
+        finally:
+            await broker.stop()
+        assert EventType.WILL_DISTED in {e.type for e in ev.events}
+
+
+class TestConnectGuardsSysprops:
+    async def test_client_id_length_cap(self):
+        from bifromq_tpu.utils import sysprops as sp
+        sp.override(sp.SysProp.MAX_MQTT5_CLIENT_ID_LENGTH, 8)
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        await broker.start()
+        try:
+            c = MQTTClient("127.0.0.1", broker.port,
+                           client_id="way-too-long-client-id",
+                           protocol_level=5)
+            with pytest.raises(MQTTClientError, match="133"):
+                await c.connect()
+            ok = MQTTClient("127.0.0.1", broker.port, client_id="short",
+                            protocol_level=5)
+            await ok.connect()
+            await ok.disconnect()
+        finally:
+            sp.override(sp.SysProp.MAX_MQTT5_CLIENT_ID_LENGTH, None)
+            await broker.stop()
+
+    async def test_utf8_sanity_check(self):
+        from bifromq_tpu.utils import sysprops as sp
+        sp.override(sp.SysProp.SANITY_CHECK_MQTT_UTF8, True)
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        await broker.start()
+        try:
+            c = MQTTClient("127.0.0.1", broker.port,
+                           client_id="ctl\x01chr", protocol_level=5)
+            with pytest.raises(MQTTClientError, match="133"):
+                await c.connect()
+        finally:
+            sp.override(sp.SysProp.SANITY_CHECK_MQTT_UTF8, None)
+            await broker.stop()
+
+    async def test_live_session_redirect_sweep(self):
+        """A balancer that starts redirecting moves CONNECTED clients on
+        the next sweep (≈ ClientRedirectCheckIntervalSeconds loop)."""
+        from bifromq_tpu.plugin.balancer import (IClientBalancer,
+                                                 RedirectType,
+                                                 ServerRedirection)
+        from bifromq_tpu.utils import sysprops as sp
+
+        class DrainLater(IClientBalancer):
+            draining = False
+
+            def need_redirect(self, client):
+                if self.draining:
+                    return ServerRedirection(
+                        type=RedirectType.PERMANENT_MOVE
+                        if hasattr(RedirectType, "PERMANENT_MOVE")
+                        else RedirectType.MOVE,
+                        server_reference="other-broker:1883")
+                return None
+
+        sp.override(sp.SysProp.CLIENT_REDIRECT_CHECK_INTERVAL_SECONDS, 0.3)
+        bal = DrainLater()
+        broker = MQTTBroker(host="127.0.0.1", port=0, balancer=bal)
+        await broker.start()
+        try:
+            c = MQTTClient("127.0.0.1", broker.port, client_id="mv",
+                           protocol_level=5)
+            await c.connect()       # admitted: not draining yet
+            bal.draining = True
+            deadline = asyncio.get_event_loop().time() + 5
+            while (broker.session_registry.get("DevOnly", "mv") is not None
+                   and asyncio.get_event_loop().time() < deadline):
+                await asyncio.sleep(0.05)
+            assert broker.session_registry.get("DevOnly", "mv") is None
+            assert EventType.REDIRECTED in {
+                e.type for e in broker.events.events}
+        finally:
+            sp.override(sp.SysProp.CLIENT_REDIRECT_CHECK_INTERVAL_SECONDS,
+                        None)
+            await broker.stop()
+
+    async def test_delayed_will_expiry_starts_at_fire_time(self):
+        """MESSAGE_EXPIRY_INTERVAL on a will starts when the will is
+        PUBLISHED, not when it is armed — a delay longer than the expiry
+        must not eat the message."""
+        from bifromq_tpu.mqtt import packets as pkts
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        await broker.start()
+        try:
+            sub = MQTTClient("127.0.0.1", broker.port, client_id="exsub",
+                             protocol_level=5)
+            await sub.connect()
+            await sub.subscribe("ex/t", qos=0)
+            c = MQTTClient(
+                "127.0.0.1", broker.port, client_id="ex-dying",
+                protocol_level=5, clean_start=False,
+                properties={PropertyId.SESSION_EXPIRY_INTERVAL: 300},
+                will=pkts.Will(topic="ex/t", payload=b"fresh",
+                               properties={
+                                   PropertyId.WILL_DELAY_INTERVAL: 2,
+                                   PropertyId.MESSAGE_EXPIRY_INTERVAL: 1}))
+            await c.connect()
+            c._writer.close()
+            m = await asyncio.wait_for(sub.messages.get(), 8)
+            assert m.payload == b"fresh"
             await sub.disconnect()
         finally:
             await broker.stop()
