@@ -173,6 +173,17 @@ class PersistentSession(Session):
                     if fetched is None:
                         return
                     if not fetched.qos0 and not fetched.buffer:
+                        if budget <= 0 and self._pid_to_seq:
+                            # window full — but only a genuine backlog is a
+                            # stall (fetch(max_buffer=0) can't tell "empty"
+                            # from "window-gated"; a 1-message probe can,
+                            # and fetch never advances cursors)
+                            probe = self.inbox.store.fetch(
+                                tenant, self.inbox_id, max_fetch=1,
+                                qos0_after=self._qos0_cursor,
+                                buffer_after=self._buf_cursor, max_buffer=1)
+                            if probe is not None and probe.buffer:
+                                self._report_stalled()
                         break  # drained (or window full): wait for a wake
                     for seq, topic, msg in fetched.qos0:
                         self._qos0_cursor = seq
@@ -188,6 +199,7 @@ class PersistentSession(Session):
                             break  # retry this seq after acks free the window
                         self._buf_cursor = seq
                     if blocked:
+                        self._report_stalled()
                         break  # _commit_acked wakes us
         except asyncio.CancelledError:
             pass
@@ -227,10 +239,24 @@ class PersistentSession(Session):
         self._acked_seqs.add(seq)
         self._advance_commit()
 
+    _stall_reported = False
+
+    def _report_stalled(self) -> None:
+        """Once per stall transition (≈ SubStalled.java), not per wake —
+        the flag clears when an ack frees window budget."""
+        if self._stall_reported:
+            return
+        self._stall_reported = True
+        self.events.report(Event(
+            EventType.SUB_STALLED, self.client_info.tenant_id,
+            {"client_id": self.client_id,
+             "inflight": len(self._pid_to_seq)}))
+
     def _commit_acked(self, pid: int) -> None:
         seq = self._pid_to_seq.pop(pid, None)
         if seq is None:
             return
+        self._stall_reported = False
         self._acked_seqs.add(seq)
         self._advance_commit()
         self._fetch_wake.set()  # freed in-flight budget

@@ -66,6 +66,7 @@ class EventType(enum.Enum):
     OVERSIZE_WILL_REJECTED = "oversize_will_rejected"
     OVERSIZE_PACKET_DROPPED = "oversize_packet_dropped"
     DISCARDED = "discarded"    # QoS0 to an unwritable channel (≈ Discard)
+    SUB_STALLED = "sub_stalled"  # persistent delivery paused on full window
     # lwt detail
     WILL_DIST_ERROR = "will_dist_error"
     # inbox detail family

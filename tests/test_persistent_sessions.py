@@ -321,6 +321,9 @@ class TestReviewRegressions:
             except asyncio.TimeoutError:
                 break
         assert len(got) == 3  # exactly receive-maximum in flight, no more
+        # the paused delivery is plugin-visible, once per stall transition
+        stalls = broker.events.of(EventType.SUB_STALLED)
+        assert len(stalls) == 1, stalls
         await c2.disconnect()
 
     async def test_raft_snapshot_no_double_apply(self):
