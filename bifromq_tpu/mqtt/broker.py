@@ -516,6 +516,8 @@ class MQTTBroker:
                  dist: Optional[DistService] = None,
                  retain_service=None, inbox_engine=None,
                  dist_worker_kwargs=None,
+                 inbox_split_threshold: Optional[int] = None,
+                 retain_split_threshold: Optional[int] = None,
                  ssl_context=None, throttler=None,
                  balancer=None, session_dict=None, mem_usage=None,
                  tls_port: Optional[int] = None, tls_ssl_context=None,
@@ -599,13 +601,21 @@ class MQTTBroker:
         if retain_service is None:
             from ..retain.service import RetainService
             # share the durable engine so retained messages survive restart
-            retain_service = RetainService(self.events,
-                                           engine=inbox_engine)
+            retain_service = RetainService(
+                self.events, engine=inbox_engine,
+                split_threshold=retain_split_threshold)
+        elif retain_split_threshold is not None:
+            # dropping the knob silently would let an operator believe
+            # splits are enabled (same contract as the starter's dist check)
+            raise ValueError("retain_split_threshold has no effect with a "
+                             "caller-supplied retain_service; configure the "
+                             "service directly")
         self.retain_service = retain_service
         from ..inbox.service import InboxService, InboxSubBroker
         self.inbox = InboxService(self.dist, self.events, self.settings,
                                   engine=inbox_engine,
-                                  server_id=self.server_id)
+                                  server_id=self.server_id,
+                                  split_threshold=inbox_split_threshold)
         self.sub_brokers.register(InboxSubBroker(self.inbox))
         self._server: Optional[asyncio.AbstractServer] = None
         self._tls_server: Optional[asyncio.AbstractServer] = None

@@ -173,7 +173,8 @@ class PersistentSession(Session):
                     if fetched is None:
                         return
                     if not fetched.qos0 and not fetched.buffer:
-                        if budget <= 0 and self._pid_to_seq:
+                        if budget <= 0 and self._pid_to_seq \
+                                and not self._stall_reported:
                             # window full — but only a genuine backlog is a
                             # stall (fetch(max_buffer=0) can't tell "empty"
                             # from "window-gated"; a 1-message probe can,
@@ -253,10 +254,13 @@ class PersistentSession(Session):
              "inflight": len(self._pid_to_seq)}))
 
     def _commit_acked(self, pid: int) -> None:
+        # ANY ack frees send-window budget (direct retained deliveries
+        # included), so the stall transition resets before the inbox-seq
+        # check can early-return
+        self._stall_reported = False
         seq = self._pid_to_seq.pop(pid, None)
         if seq is None:
             return
-        self._stall_reported = False
         self._acked_seqs.add(seq)
         self._advance_commit()
         self._fetch_wake.set()  # freed in-flight budget

@@ -46,7 +46,8 @@ class RetainService:
                  index: Optional[RetainedIndex] = None,
                  engine=None, node_id: str = "local", voters=None,
                  transport=None, raft_store_factory=None,
-                 tick_interval: float = 0.01, clock=time.time) -> None:
+                 tick_interval: float = 0.01, clock=time.time,
+                 split_threshold: Optional[int] = None) -> None:
         from ..kv.engine import InMemKVEngine
         from ..kv.store import KVRangeStore
         from ..raft.transport import InMemTransport
@@ -70,6 +71,13 @@ class RetainService:
             raft_store_factory=raft_store_factory,
             space_prefix="retain_", legacy_space="retain_data")
         self.kvstore.open()
+        self.balance_controller = None
+        if split_threshold is not None:
+            from ..kv.balance import (KVStoreBalanceController,
+                                      RangeSplitBalancer)
+            self.balance_controller = KVStoreBalanceController(
+                self.kvstore,
+                [RangeSplitBalancer(max_keys=split_threshold)])
         self._tick_task = None
 
     def _mk_coproc(self, rid: str):
@@ -122,8 +130,12 @@ class RetainService:
                     pump()
                 await asyncio.sleep(self.tick_interval)
         self._tick_task = asyncio.create_task(loop())
+        if self.balance_controller is not None:
+            await self.balance_controller.start()
 
     async def stop(self) -> None:
+        if self.balance_controller is not None:
+            await self.balance_controller.stop()
         if self._tick_task is not None:
             self._tick_task.cancel()
             self._tick_task = None

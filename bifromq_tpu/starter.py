@@ -23,6 +23,10 @@ Config shape (all keys optional):
       split_threshold: 100000            # route-table elasticity knobs
       load_split_threshold: 50000        # (per-range keys / load rate;
       merge_threshold: 1000              #  omit to disable a balancer)
+    inbox:
+      split_threshold: 100000            # inbox-keyspace range split
+    retain:
+      split_threshold: 100000            # retain-keyspace range split
       mode: local | worker | remote      # clustered dist-plane role:
         # local  = in-process worker (default; standalone)
         # worker = host the route table here AND serve it on the RPC
@@ -153,10 +157,18 @@ class Standalone:
         tcp = mqtt_cfg.get("tcp", {"port": 1883})
         tls = mqtt_cfg.get("tls")
         ws = mqtt_cfg.get("ws")
+        inbox_cfg = cfg.get("inbox", {})
+        retain_cfg = cfg.get("retain", {})
         self.broker = MQTTBroker(
             host=host, port=int(tcp.get("port", 1883)),
             inbox_engine=engine, dist=dist,
             dist_worker_kwargs=elastic or None,
+            inbox_split_threshold=(
+                int(inbox_cfg["split_threshold"])
+                if "split_threshold" in inbox_cfg else None),
+            retain_split_threshold=(
+                int(retain_cfg["split_threshold"])
+                if "split_threshold" in retain_cfg else None),
             tls_port=(int(tls.get("port", 8883)) if tls else None),
             tls_ssl_context=(_tls_context(tls) if tls else None),
             ws_port=(int(ws["port"]) if ws else None),

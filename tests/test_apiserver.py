@@ -172,18 +172,22 @@ class TestAdminEndpoints:
     apiserver's balancer and traffic-rules handler families)."""
 
     async def test_balancer_state_and_toggle(self):
-        # elasticity knobs configured → the dist worker runs a balance
-        # controller the admin API can inspect and toggle
+        # elasticity knobs configured → dist, inbox AND retain stores run
+        # balance controllers the admin API can inspect and toggle
         broker = MQTTBroker(port=0,
-                            dist_worker_kwargs={"split_threshold": 100})
+                            dist_worker_kwargs={"split_threshold": 100},
+                            inbox_split_threshold=500,
+                            retain_split_threshold=500)
         await broker.start()
         api = APIServer(broker, port=0)
         await api.start()
         try:
             status, state = await http(api.port, "GET", "/balancer")
             assert status == 200
-            assert "dist" in state and state["dist"]["enabled"] is True
+            assert set(state) == {"dist", "inbox", "retain"}
+            assert state["dist"]["enabled"] is True
             assert "RangeSplitBalancer" in state["dist"]["balancers"]
+            assert state["inbox"]["enabled"] and state["retain"]["enabled"]
 
             status, out = await http(api.port, "PUT",
                                      "/balancer?enable=false")
