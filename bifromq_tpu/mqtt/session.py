@@ -594,8 +594,7 @@ class Session:
                     packet_id=p.packet_id,
                     reason_code=ReasonCode.QUOTA_EXCEEDED))
             return
-        allowed = await self.auth.check_permission(
-            self.client_info, MQTTAction.PUB, topic)
+        allowed = await self._check_permission(MQTTAction.PUB, topic)
         if not allowed:
             self.events.report(Event(EventType.PUB_ACTION_DISALLOWED,
                                      self.client_info.tenant_id,
@@ -691,6 +690,23 @@ class Session:
         self._inbound_qos2.discard(packet_id)
         await self.conn.send(pk.PubComp(packet_id=packet_id))
 
+    async def _check_permission(self, action, topic: str) -> bool:
+        """Exception-isolated permission check (≈ the reference's
+        auth-provider helper wrapper): a throwing plugin DENIES (fail
+        closed) and surfaces ACCESS_CONTROL_ERROR instead of crashing the
+        session."""
+        try:
+            return await self.auth.check_permission(
+                self.client_info, action, topic)
+        except Exception:  # noqa: BLE001
+            log.exception("auth plugin check_permission failed")
+            self.events.report(Event(
+                EventType.ACCESS_CONTROL_ERROR,
+                self.client_info.tenant_id,
+                {"action": getattr(action, "value", str(action)),
+                 "topic": topic}))
+            return False
+
     # -------- SUBSCRIBE/UNSUBSCRIBE (≈ MQTTSessionHandler.doSubscribe) -----
 
     async def _on_subscribe(self, s: pk.Subscribe) -> None:
@@ -761,8 +777,7 @@ class Session:
                                      self.client_info.tenant_id,
                                      {"filter": tf, "resource": "sub"}))
             return ReasonCode.QUOTA_EXCEEDED if v5 else 0x80
-        allowed = await self.auth.check_permission(
-            self.client_info, MQTTAction.SUB, tf)
+        allowed = await self._check_permission(MQTTAction.SUB, tf)
         if not allowed:
             self.events.report(Event(EventType.SUB_ACTION_DISALLOWED,
                                      self.client_info.tenant_id,
@@ -813,8 +828,7 @@ class Session:
         for tf in u.topic_filters:
             # unsub permission check (≈ MQTTSessionHandler checkAndUnsub →
             # UnsubActionDisallow event)
-            if not await self.auth.check_permission(
-                    self.client_info, MQTTAction.UNSUB, tf):
+            if not await self._check_permission(MQTTAction.UNSUB, tf):
                 self.events.report(Event(
                     EventType.UNSUB_ACTION_DISALLOWED,
                     self.client_info.tenant_id, {"filter": tf}))

@@ -67,6 +67,7 @@ class EventType(enum.Enum):
     OVERSIZE_PACKET_DROPPED = "oversize_packet_dropped"
     DISCARDED = "discarded"    # QoS0 to an unwritable channel (≈ Discard)
     SUB_STALLED = "sub_stalled"  # persistent delivery paused on full window
+    ACCESS_CONTROL_ERROR = "access_control_error"  # auth plugin threw
     # lwt detail
     WILL_DIST_ERROR = "will_dist_error"
     # inbox detail family

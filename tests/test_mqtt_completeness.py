@@ -1152,3 +1152,35 @@ class TestConnectGuardsSysprops:
             await sub.disconnect()
         finally:
             await broker.stop()
+
+
+class TestPluginIsolation:
+    async def test_throwing_auth_plugin_denies_not_crashes(self):
+        """check_permission raising must FAIL CLOSED (deny + event), never
+        kill the session (≈ the reference's auth helper wrapper)."""
+        from bifromq_tpu.plugin.events import CollectingEventCollector
+
+        class Flaky(AllowAllAuthProvider):
+            async def check_permission(self, client, action, topic):
+                raise RuntimeError("plugin bug")
+
+        ev = CollectingEventCollector()
+        broker = MQTTBroker(host="127.0.0.1", port=0, auth=Flaky(),
+                            events=ev)
+        await broker.start()
+        try:
+            c = MQTTClient("127.0.0.1", broker.port, client_id="fp",
+                           protocol_level=5)
+            await c.connect()
+            ack = await c.subscribe("px/t", qos=1)
+            assert ack.reason_codes == [ReasonCode.NOT_AUTHORIZED]
+            rc = await c.publish("px/t", b"x", qos=1)
+            assert rc == ReasonCode.NOT_AUTHORIZED
+            # session is ALIVE after both denials
+            ack = await c.subscribe("px/u", qos=0)
+            assert ack.reason_codes == [ReasonCode.NOT_AUTHORIZED]
+            assert EventType.ACCESS_CONTROL_ERROR in {
+                e.type for e in ev.events}
+            await c.disconnect()
+        finally:
+            await broker.stop()
