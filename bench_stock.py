@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Measure the stock-CPU baseline for bench.py's vs_baseline.
+
+The image has no JVM, so the reference Java broker cannot run here. Instead
+`native/stockmatch.cpp` re-implements the reference's match hot loop
+(TenantRouteMatcher.matchAll + TopicFilterIterator, see the .cpp header for
+file:line cites) with only stock-FAVORING simplifications, and this script
+runs it over the exact config-2 workload bench.py uses (same seeds, same
+generator): the measured rate is a conservative stand-in for the stock
+single-node dist-worker match rate on this box's CPU.
+
+Writes bench_results/stock_baseline.json; bench.py picks that up instead of
+the old ASSUMED_STOCK_RATE.
+
+Env knobs: STOCK_SUBS (1_000_000), STOCK_BATCH (16384), STOCK_ITERS (8),
+STOCK_SEED (0), STOCK_CONFIGS ("1,2"), STOCK_SWEEP_B ("" = just
+STOCK_BATCH; e.g. "4096,16384,65536" measures each and keeps the best —
+the stock side gets its best operating point).
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+N_SUBS = int(os.environ.get("STOCK_SUBS", "1000000"))
+BATCH = int(os.environ.get("STOCK_BATCH", "16384"))
+ITERS = int(os.environ.get("STOCK_ITERS", "8"))
+SEED = int(os.environ.get("STOCK_SEED", "0"))
+
+
+def export_config2(routes_path: str, topics_path: str, *,
+                   n_subs: int = N_SUBS, seed: int = SEED,
+                   n_topics: int = None) -> None:
+    """Write the config-2 route filters and probe topics to flat files.
+
+    Replays workloads.config_wildcard's exact rng sequence (filter gen +
+    the persistent_ratio draw) so the filters are identical to what
+    bench.py compiles onto the device, and probe_topics with the same
+    seed+1 bench.py uses.
+    """
+    sys.path.insert(0, REPO)
+    from bifromq_tpu import workloads
+
+    rng = random.Random(seed)
+    names, weights = workloads._zipf_levels(1000)
+    with open(routes_path, "w") as f:
+        for _ in range(n_subs):
+            levels = workloads.gen_filter_levels(rng, names, weights,
+                                                 max_depth=6)
+            rng.random()  # config_wildcard's persistent_ratio draw
+            f.write("/".join(levels) + "\n")
+    topics = workloads.probe_topics(n_topics or BATCH * 4, seed=seed + 1)
+    with open(topics_path, "w") as f:
+        for t in topics:
+            f.write("/".join(t) + "\n")
+
+
+def export_config1(routes_path: str, topics_path: str, *,
+                   n_subs: int = 10_000, seed: int = SEED,
+                   n_topics: int = None) -> None:
+    """Config-1 export: exact-topic subs (workloads.config_exact replay)
+    + bench.py's c1 probe topics (same n_level_names derivation)."""
+    sys.path.insert(0, REPO)
+    from bifromq_tpu import workloads
+
+    rng = random.Random(seed)
+    n_names = max(64, n_subs // 100)
+    names, weights = workloads._zipf_levels(n_names)
+    with open(routes_path, "w") as f:
+        for _ in range(n_subs):
+            levels = workloads.gen_topic_levels(rng, names, weights)
+            rng.random()  # config_exact's persistent_ratio draw
+            f.write("/".join(levels) + "\n")
+    topics = workloads.probe_topics(n_topics or BATCH * 4, seed=seed + 1,
+                                    n_level_names=n_names)
+    with open(topics_path, "w") as f:
+        for t in topics:
+            f.write("/".join(t) + "\n")
+
+
+def ensure_binary() -> str:
+    binary = os.path.join(REPO, "native", "stockmatch")
+    src = os.path.join(REPO, "native", "stockmatch.cpp")
+    if (not os.path.exists(binary)
+            or os.path.getmtime(binary) < os.path.getmtime(src)):
+        subprocess.run(["g++", "-O3", "-std=c++17", "-march=native",
+                        "-o", binary, src], check=True)
+    return binary
+
+
+def run_stock(config: str, *, n_subs: int, batch: int = BATCH,
+              iters: int = ITERS, seed: int = SEED) -> dict:
+    binary = ensure_binary()
+    n_topics = max(batch * 4, 262144)
+    routes_path = f"/tmp/stock_c{config}_routes_{n_subs}_{seed}.txt"
+    topics_path = f"/tmp/stock_c{config}_topics_{n_topics}_{seed}.txt"
+    if not (os.path.exists(routes_path) and os.path.exists(topics_path)):
+        t0 = time.time()
+        exporter = export_config1 if config == "1" else export_config2
+        exporter(routes_path, topics_path, n_subs=n_subs, seed=seed,
+                 n_topics=n_topics)
+        print(f"[c{config}] exported workload in {time.time() - t0:.1f}s",
+              file=sys.stderr)
+    out = subprocess.run([binary, routes_path, topics_path, str(batch),
+                          str(iters)], check=True, capture_output=True,
+                         text=True)
+    res = json.loads(out.stdout)
+    res["n_subs"] = n_subs
+    print(f"[c{config}] B={batch}: {json.dumps(res)}", file=sys.stderr)
+    return res
+
+
+def main():
+    configs = os.environ.get("STOCK_CONFIGS", "1,2").split(",")
+    sweep_b = [int(x) for x in os.environ.get("STOCK_SWEEP_B", "").split(",")
+               if x] or [BATCH]
+    out = {
+        "note": ("faithful C++ re-implementation of the reference "
+                 "TenantRouteMatcher.matchAll hot loop (no JVM in image); "
+                 "simplifications all favor the stock side — see "
+                 "native/stockmatch.cpp header. Best batch size wins per "
+                 "config (the stock side gets its best operating point)."),
+        "nproc": os.cpu_count(),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    for config in configs:
+        n_subs = 10_000 if config == "1" else N_SUBS
+        best, cells = None, {}
+        for b in sweep_b:
+            r = run_stock(config, n_subs=n_subs, batch=b,
+                          iters=max(1, ITERS // max(1, b // BATCH)))
+            cells[f"B{b}"] = r
+            if best is None or r["topics_per_s"] > best["topics_per_s"]:
+                best = r
+        key = "c1_exact_10000" if config == "1" else f"c2_wildcard_{n_subs}"
+        out[key] = {"best": best, "cells": cells}
+
+    os.makedirs(os.path.join(REPO, "bench_results"), exist_ok=True)
+    path = os.path.join(REPO, "bench_results", "stock_baseline.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

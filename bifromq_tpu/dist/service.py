@@ -249,15 +249,16 @@ class DistService:
                 # snapshot BEFORE the (awaited) match: a mutation landing
                 # mid-flight must make the stored entry instantly stale
                 epoch = self._tenant_epoch.get(tenant_id, 0)
-                fresh = await self.worker.match_batch(
-                    [(tenant_id, topic_util.parse(t))
-                     for t in miss_topics],
-                    max_persistent_fanout=(
-                        mpf if mpf is not None
-                        else Setting.MaxPersistentFanout.default),
-                    max_group_fanout=(
-                        mgf if mgf is not None
-                        else Setting.MaxGroupFanout.default))
+                try:
+                    fresh = await self._match_missing(
+                        tenant_id, miss_topics, mpf, mgf)
+                except Exception:  # noqa: BLE001 — match backend failure
+                    # ≈ DistError event + failed PubResults (caller acks
+                    # the client with an error / QoS0 drops)
+                    self.events.report(Event(
+                        EventType.DIST_ERROR, tenant_id,
+                        {"topics": len(miss_topics)}))
+                    raise
                 for t, m in zip(miss_topics, fresh):
                     self._cache_put(tenant_id, t, m, epoch)
                 for qi, c in enumerate(calls):
@@ -267,8 +268,23 @@ class DistService:
             for call, m in zip(calls, matched):
                 fanout = await self._fan_out(tenant_id, call, m)
                 results.append(PubResult(ok=True, fanout=fanout))
+                if fanout:
+                    # ≈ Disted event (dist call accepted + fanned out)
+                    self.events.report(Event(
+                        EventType.DISTED, tenant_id,
+                        {"topic": call.topic, "fanout": fanout}))
             return results
         return process
+
+    async def _match_missing(self, tenant_id, miss_topics, mpf, mgf):
+        return await self.worker.match_batch(
+            [(tenant_id, topic_util.parse(t)) for t in miss_topics],
+            max_persistent_fanout=(
+                mpf if mpf is not None
+                else Setting.MaxPersistentFanout.default),
+            max_group_fanout=(
+                mgf if mgf is not None
+                else Setting.MaxGroupFanout.default))
 
     async def _fan_out(self, tenant_id: str, call: PubCall,
                        matched: MatchedRoutes) -> int:
@@ -306,9 +322,8 @@ class DistService:
                     used += 1
             targets = kept
             self.events.report(Event(
-                EventType.PERSISTENT_FANOUT_THROTTLED, tenant_id,
-                {"topic": call.topic, "reason": "bytes",
-                 "allowed": allowed}))
+                EventType.PERSISTENT_FANOUT_BYTES_THROTTLED, tenant_id,
+                {"topic": call.topic, "allowed": allowed}))
         if not targets:
             return 0
         # group per (broker, deliverer_key) ≈ BatchDeliveryCall grouping

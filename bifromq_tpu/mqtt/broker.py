@@ -31,7 +31,7 @@ from .protocol import (CONNACK_ACCEPTED, CONNACK_REFUSED_IDENTIFIER_REJECTED,
                        CONNACK_REFUSED_NOT_AUTHORIZED, PROTOCOL_MQTT5,
                        MalformedPacket, PropertyId, ReasonCode)
 from .session import (LocalSessionRegistry, Session, SessionRegistry,
-                      TransientSubBroker)
+                      SessionStartAborted, TransientSubBroker)
 
 log = logging.getLogger("bifromq_tpu.mqtt")
 
@@ -144,12 +144,31 @@ class Connection:
                         return
         except MalformedPacket as e:
             if self.session is not None:
-                await self.protocol_error(str(e), e.reason)
+                # undecodable packet mid-session (≈ BadPacket close event)
+                self.broker.events.report(Event(
+                    EventType.BAD_PACKET,
+                    self.session.client_info.tenant_id,
+                    {"detail": str(e)}))
+                await self.disconnect_with(e.reason)
             else:
+                self.broker.events.report(Event(
+                    EventType.CHANNEL_ERROR, "", {"detail": str(e)}))
                 await self.close_transport()
-        except (ConnectionError, asyncio.IncompleteReadError):
+        except (ConnectionError, asyncio.IncompleteReadError) as e:
             if self.session is not None:
+                self.broker.events.report(Event(
+                    EventType.CLIENT_CHANNEL_ERROR,
+                    self.session.client_info.tenant_id,
+                    {"detail": type(e).__name__}))
                 await self.session.close(fire_will=True)
+            else:
+                self.broker.events.report(Event(
+                    EventType.CHANNEL_ERROR, "",
+                    {"detail": type(e).__name__}))
+        except SessionStartAborted:
+            # session reported its own close event (e.g.
+            # INBOX_TRANSIENT_ERROR) and shut the transport — unwind quietly
+            pass
         except Exception:  # noqa: BLE001
             log.exception("connection crashed")
             if self.session is not None:
@@ -175,11 +194,17 @@ class Connection:
                                             "", {}))
             await self.close_transport()
             return
-        except MalformedPacket:
+        except MalformedPacket as e:
+            self.broker.events.report(Event(
+                EventType.CHANNEL_ERROR, "", {"detail": str(e)}))
             await self.close_transport()
             return
         first = buf_packets[0]
         if not isinstance(first, pk.Connect):
+            # first packet must be CONNECT (≈ ProtocolError close event)
+            self.broker.events.report(Event(
+                EventType.PROTOCOL_ERROR, "",
+                {"detail": "first packet not CONNECT"}))
             await self.close_transport()
             return
         self.protocol_level = first.protocol_level
@@ -250,6 +275,13 @@ class Connection:
                 reason_code=ReasonCode.CONTINUE_AUTHENTICATION,
                 properties=props))
             reply = await self._next_packet()
+            if isinstance(reply, pk.Disconnect):
+                # client aborted the exchange with DISCONNECT [MQTT-4.12.4]
+                broker.events.report(Event(
+                    EventType.ENHANCED_AUTH_ABORT_BY_CLIENT, "",
+                    {"client_id": c.client_id, "method": method}))
+                await self.close_transport()
+                return None
             if not isinstance(reply, pk.Auth) or (reply.properties or {}).get(
                     PropertyId.AUTHENTICATION_METHOD) != method:
                 await self.close_transport()
@@ -304,6 +336,13 @@ class Connection:
             rc = (ReasonCode.NOT_AUTHORIZED if v5
                   else CONNACK_REFUSED_NOT_AUTHORIZED)
             await self.send(pk.Connack(reason_code=rc))
+            # ≈ UnauthenticatedClient vs NotAuthorizedClient close events
+            # (reject code from the auth provider, Reject.Code analog)
+            etype = (EventType.NOT_AUTHORIZED_CLIENT
+                     if getattr(auth_result, "code", "") == "not_authorized"
+                     else EventType.UNAUTHENTICATED_CLIENT)
+            broker.events.report(Event(etype, "",
+                                       {"reason": auth_result.reason}))
             broker.events.report(Event(EventType.CONNECT_REJECTED, "",
                                        {"reason": auth_result.reason}))
             await self.close_transport()
@@ -319,6 +358,10 @@ class Connection:
             broker.events.report(Event(
                 EventType.OUT_OF_TENANT_RESOURCE, tenant_id,
                 {"resource": "total_connections"}))
+            # the channel-close reason twin (≈ ResourceThrottled)
+            broker.events.report(Event(
+                EventType.RESOURCE_THROTTLED, tenant_id,
+                {"resource": "total_connections"}))
             await self.close_transport()
             return
         redirect = broker.balancer.need_redirect(ClientInfo(
@@ -327,7 +370,7 @@ class Connection:
         if redirect is not None:
             # server redirection (≈ IClientBalancer → MQTT5 Server Reference)
             broker.events.report(Event(
-                EventType.REDIRECTED, tenant_id,
+                EventType.SERVER_REDIRECTED, tenant_id,
                 {"server_reference": redirect.server_reference}))
             from ..plugin.balancer import RedirectType
             if v5:
@@ -365,8 +408,11 @@ class Connection:
         bad_utf8 = (sp.get(sp.SysProp.SANITY_CHECK_MQTT_UTF8)
                     and not topic_util.is_well_formed_utf8(client_id))
         if len(client_id.encode()) > max_cid or bad_utf8:
+            # length → IdentifierRejected; malformed UTF-8 →
+            # MalformedClientIdentifier (distinct reference close events)
             broker.events.report(Event(
-                EventType.IDENTIFIER_REJECTED, tenant_id,
+                EventType.MALFORMED_CLIENT_IDENTIFIER if bad_utf8
+                else EventType.IDENTIFIER_REJECTED, tenant_id,
                 {"length": len(client_id),
                  "reason": "malformed" if bad_utf8 else "too_long"}))
             await self.send(pk.Connack(reason_code=(
@@ -393,6 +439,32 @@ class Connection:
                 **auth_result.attrs,
             }.items())))
 
+        if (c.username is not None
+                and sp.get(sp.SysProp.SANITY_CHECK_MQTT_UTF8)
+                and not topic_util.is_well_formed_utf8(c.username)):
+            broker.events.report(Event(
+                EventType.MALFORMED_USERNAME, tenant_id, {}))
+            await self.send(pk.Connack(reason_code=(
+                ReasonCode.MALFORMED_PACKET if v5
+                else CONNACK_REFUSED_NOT_AUTHORIZED)))
+            await self.close_transport()
+            return
+        if (c.will is not None
+                and (not topic_util.is_valid_topic(
+                        c.will.topic, settings[Setting.MaxTopicLevelLength],
+                        settings[Setting.MaxTopicLevels],
+                        settings[Setting.MaxTopicLength])
+                     or (sp.get(sp.SysProp.SANITY_CHECK_MQTT_UTF8)
+                         and not topic_util.is_well_formed_utf8(
+                             c.will.topic)))):
+            broker.events.report(Event(
+                EventType.MALFORMED_WILL_TOPIC, tenant_id,
+                {"topic": c.will.topic}))
+            await self.send(pk.Connack(reason_code=(
+                ReasonCode.TOPIC_NAME_INVALID if v5
+                else CONNACK_REFUSED_NOT_AUTHORIZED)))
+            await self.close_transport()
+            return
         if (c.will is not None and len(c.will.payload)
                 > settings[Setting.MaxLastWillBytes]):
             broker.events.report(Event(
@@ -448,7 +520,8 @@ class Connection:
             connect_props=c.properties,
             retain_service=broker.retain_service,
             throttler=broker.throttler,
-            auth_method=getattr(self, "auth_method", None))
+            auth_method=getattr(self, "auth_method", None),
+            user_props_customizer=broker.user_props_customizer)
         if persistent:
             from .persistent import PersistentSession
             session = PersistentSession(inbox=broker.inbox,
@@ -523,7 +596,8 @@ class MQTTBroker:
                  tls_port: Optional[int] = None, tls_ssl_context=None,
                  ws_port: Optional[int] = None,
                  ws_path: str = "/mqtt", ws_ssl_context=None,
-                 proxy_protocol: bool = False) -> None:
+                 proxy_protocol: bool = False,
+                 user_props_customizer=None) -> None:
         self.host = host
         self.port = port
         # PROXY-protocol stage on the plain-TCP listener (a fronting LB
@@ -565,6 +639,10 @@ class MQTTBroker:
         self._conn_bucket = TokenBucket(get(SysProp.MAX_CONN_PER_SECOND))
         self.settings = settings or DefaultSettingProvider()
         self.events = events or CollectingEventCollector()
+        # ≈ IUserPropsCustomizerFactory SPI (mqtt-server-spi)
+        from ..plugin.userprops import NoopUserPropsCustomizer
+        self.user_props_customizer = (user_props_customizer
+                                      or NoopUserPropsCustomizer())
         self.local_sessions = LocalSessionRegistry()
         self.session_registry = SessionRegistry(self.events)
         self.sub_brokers = SubBrokerRegistry()
@@ -686,7 +764,7 @@ class MQTTBroker:
                     if redirect is None:
                         continue
                     self.events.report(Event(
-                        EventType.REDIRECTED,
+                        EventType.SERVER_REDIRECTED,
                         session.client_info.tenant_id,
                         {"client_id": session.client_id,
                          "server_reference": redirect.server_reference}))

@@ -53,10 +53,23 @@ class PersistentSession(Session):
                           self.will, self.protocol_level),
                       message=will_to_message(self.will,
                                               self.protocol_level))
-        meta, present = await self.inbox.attach(
-            tenant, self.inbox_id, clean_start=self.clean_start,
-            expiry_seconds=self.expiry_seconds,
-            client_meta=self.client_info.metadata, lwt=lwt)
+        try:
+            meta, present = await self.inbox.attach(
+                tenant, self.inbox_id, clean_start=self.clean_start,
+                expiry_seconds=self.expiry_seconds,
+                client_meta=self.client_info.metadata, lwt=lwt)
+        except Exception as e:  # noqa: BLE001 — inbox store unavailable
+            # ≈ InboxTransientError close event: the persistent session
+            # cannot come up without its inbox; drop the connection and
+            # unwind via the quiet sentinel (the outage is already
+            # event-reported — no "connection crashed" stack spam)
+            from .session import SessionStartAborted
+            self.events.report(Event(
+                EventType.INBOX_TRANSIENT_ERROR, tenant,
+                {"client_id": self.client_id}))
+            self.closed = True
+            await self.conn.close_transport()
+            raise SessionStartAborted(str(e)) from e
         self.session_present = present
         if present:
             # restore subscription state (routes already exist in dist)
@@ -172,6 +185,12 @@ class PersistentSession(Session):
                         max_buffer=max(0, budget))
                     if fetched is None:
                         return
+                    if fetched.qos0 or fetched.buffer:
+                        # ≈ MsgFetched (inbox fetcher drained a page)
+                        self.events.report(Event(
+                            EventType.MSG_FETCHED, tenant,
+                            {"count": len(fetched.qos0)
+                             + len(fetched.buffer)}))
                     if not fetched.qos0 and not fetched.buffer:
                         if budget <= 0 and self._pid_to_seq \
                                 and not self._stall_reported:

@@ -102,7 +102,7 @@ class SessionRegistry:
         self._owners[key] = session
         if prev is not None and prev is not session:
             self._events.report(Event(
-                EventType.SESSION_KICKED, session.client_info.tenant_id,
+                EventType.KICKED, session.client_info.tenant_id,
                 {"client_id": session.client_id}))
             await prev.kick()
 
@@ -208,6 +208,11 @@ class TransientSubBroker(ISubBroker):
         return out
 
 
+class SessionStartAborted(Exception):
+    """Session.start() failed after already reporting its own event and
+    closing the transport — callers must unwind quietly (no crash log)."""
+
+
 class _PacketIdAllocator:
     def __init__(self) -> None:
         self._next = 1
@@ -297,7 +302,8 @@ class Session:
                  session_registry: SessionRegistry,
                  connect_props: Optional[dict] = None,
                  retain_service=None, throttler=None,
-                 auth_method: Optional[str] = None) -> None:
+                 auth_method: Optional[str] = None,
+                 user_props_customizer=None) -> None:
         self.conn = conn
         self.client_id = client_id
         self.client_info = client_info
@@ -317,6 +323,11 @@ class Session:
         self.auth_method = auth_method  # enhanced-auth method (MQTT5)
         self._reauth_pending = False
         self.connect_props = connect_props or {}
+        # ≈ IUserPropsCustomizer SPI (mqtt-server-spi): stamps extra user
+        # properties at the inbound and outbound edges
+        from ..plugin.userprops import NoopUserPropsCustomizer
+        self.user_props_customizer = (user_props_customizer
+                                      or NoopUserPropsCustomizer())
 
         self.session_id = uuid.uuid4().hex
         self.subscriptions: Dict[str, Subscription] = {}
@@ -519,9 +530,10 @@ class Session:
             data=props.get(PropertyId.AUTHENTICATION_DATA, b""),
             is_reauth=True))
         if res.kind == "fail":
-            self.events.report(Event(EventType.CONNECT_REJECTED,
+            # ≈ ReAuthFailed close event
+            self.events.report(Event(EventType.RE_AUTH_FAILED,
                                      self.client_info.tenant_id,
-                                     {"reason": f"re-auth: {res.reason}"}))
+                                     {"reason": res.reason}))
             await self.conn.protocol_error("re-authentication failed",
                                            ReasonCode.NOT_AUTHORIZED)
             return
@@ -544,12 +556,18 @@ class Session:
         if topic is None:
             return  # error already sent by _resolve_topic_alias
         ts = self.settings
-        if not topic_util.is_valid_topic(
+        from ..utils import sysprops as sp
+        bad_utf8 = (sp.get(sp.SysProp.SANITY_CHECK_MQTT_UTF8)
+                    and not topic_util.is_well_formed_utf8(topic))
+        if bad_utf8 or not topic_util.is_valid_topic(
                 topic, ts[Setting.MaxTopicLevelLength],
                 ts[Setting.MaxTopicLevels], ts[Setting.MaxTopicLength]):
-            self.events.report(Event(EventType.MALFORMED_TOPIC,
-                                     self.client_info.tenant_id,
-                                     {"topic": topic}))
+            # bad UTF-8 → MalformedTopic; structural violation (wildcard/
+            # empty/too long) → InvalidTopic (distinct reference events)
+            self.events.report(Event(
+                EventType.MALFORMED_TOPIC if bad_utf8
+                else EventType.INVALID_TOPIC,
+                self.client_info.tenant_id, {"topic": topic}))
             await self.conn.protocol_error(
                 "invalid topic", ReasonCode.TOPIC_NAME_INVALID)
             return
@@ -596,10 +614,17 @@ class Session:
             return
         allowed = await self._check_permission(MQTTAction.PUB, topic)
         if not allowed:
-            self.events.report(Event(EventType.PUB_ACTION_DISALLOWED,
+            self.events.report(Event(EventType.PUB_ACTION_DISALLOW,
                                      self.client_info.tenant_id,
                                      {"topic": topic}))
-            if p.qos == 1:
+            if self.protocol_level < PROTOCOL_MQTT5 and p.qos > 0:
+                # MQTT3 acks cannot convey an error: the reference closes
+                # the channel instead (NoPubPermission close event)
+                self.events.report(Event(EventType.NO_PUB_PERMISSION,
+                                         self.client_info.tenant_id,
+                                         {"topic": topic}))
+                await self.conn.disconnect_with(0)
+            elif p.qos == 1:
                 await self.conn.send(pk.PubAck(
                     packet_id=p.packet_id,
                     reason_code=ReasonCode.NOT_AUTHORIZED))
@@ -614,6 +639,17 @@ class Session:
             if p.packet_id in self._inbound_qos2:
                 # duplicate delivery of an unreleased QoS2 publish
                 await self.conn.send(pk.PubRec(packet_id=p.packet_id))
+                return
+            if len(self._inbound_qos2) >= ts[Setting.ReceivingMaximum]:
+                # client exceeded the server's advertised Receive Maximum
+                # [MQTT-3.3.4-9] (≈ ExceedReceivingLimit close event)
+                self.events.report(Event(
+                    EventType.EXCEED_RECEIVING_LIMIT,
+                    self.client_info.tenant_id,
+                    {"limit": ts[Setting.ReceivingMaximum]}))
+                await self.conn.disconnect_with(
+                    ReasonCode.RECEIVE_MAXIMUM_EXCEEDED
+                    if self.protocol_level >= PROTOCOL_MQTT5 else 0)
                 return
             self._inbound_qos2.add(p.packet_id)
             self.events.report(Event(EventType.QOS2_RECEIVED,
@@ -633,10 +669,17 @@ class Session:
             rtopic = pp.get(PropertyId.RESPONSE_TOPIC, "")
             cdata = pp.get(PropertyId.CORRELATION_DATA, b"")
             pfi = int(pp.get(PropertyId.PAYLOAD_FORMAT_INDICATOR, 0))
+        hlc_now = HLC.INST.get()
+        try:
+            extra = tuple(self.user_props_customizer.inbound(
+                topic, p.qos, p.payload, self.client_info, hlc_now))
+        except Exception:  # noqa: BLE001 — SPI failure must not drop the pub
+            log.exception("user-props customizer inbound failed")
+            extra = ()
         msg = Message(message_id=p.packet_id or 0, pub_qos=QoS(p.qos),
-                      payload=p.payload, timestamp=HLC.INST.get(),
+                      payload=p.payload, timestamp=hlc_now,
                       expiry_seconds=expiry, is_retain=p.retain,
-                      user_properties=uprops, content_type=ctype,
+                      user_properties=uprops + extra, content_type=ctype,
                       response_topic=rtopic, correlation_data=cdata,
                       payload_format_indicator=pfi)
         self.events.report(Event(EventType.PUB_RECEIVED,
@@ -645,7 +688,31 @@ class Session:
         if p.retain and self.retain_service is not None:
             if ts[Setting.RetainEnabled]:
                 await self.retain_service.retain(self.client_info, topic, msg)
-        result = await self.dist.pub(self.client_info, topic, msg)
+        try:
+            result = await self.dist.pub(self.client_info, topic, msg)
+        except Exception:  # noqa: BLE001 — dist backend failure
+            log.exception("dist.pub failed")
+            # ≈ QoS{0,1,2}DistError events; QoS1/2 get an error ack so the
+            # client can retry, QoS0 is silently lost (at-most-once)
+            self.events.report(Event(
+                (EventType.QOS0_DIST_ERROR, EventType.QOS1_DIST_ERROR,
+                 EventType.QOS2_DIST_ERROR)[p.qos],
+                self.client_info.tenant_id, {"topic": topic}))
+            if p.qos == 2:
+                # forget the undistributed publish on EVERY version —
+                # otherwise a v3 retry hits the duplicate guard, gets a
+                # bare PUBREC, and the message is silently lost
+                self._inbound_qos2.discard(p.packet_id)
+            if self.protocol_level >= PROTOCOL_MQTT5:
+                if p.qos == 1:
+                    await self.conn.send(pk.PubAck(
+                        packet_id=p.packet_id,
+                        reason_code=ReasonCode.UNSPECIFIED_ERROR))
+                elif p.qos == 2:
+                    await self.conn.send(pk.PubRec(
+                        packet_id=p.packet_id,
+                        reason_code=ReasonCode.UNSPECIFIED_ERROR))
+            return
         if p.qos == 1:
             rc = (ReasonCode.SUCCESS if result.fanout > 0
                   else ReasonCode.NO_MATCHING_SUBSCRIBERS)
@@ -744,12 +811,18 @@ class Session:
         ts = self.settings
         v5 = self.protocol_level >= PROTOCOL_MQTT5
         tf = req.topic_filter
-        if not topic_util.is_valid_topic_filter(
+        from ..utils import sysprops as sp
+        tf_bad_utf8 = (sp.get(sp.SysProp.SANITY_CHECK_MQTT_UTF8)
+                       and not topic_util.is_well_formed_utf8(tf))
+        if tf_bad_utf8 or not topic_util.is_valid_topic_filter(
                 tf, ts[Setting.MaxTopicLevelLength],
                 ts[Setting.MaxTopicLevels], ts[Setting.MaxTopicLength]):
-            self.events.report(Event(EventType.MALFORMED_TOPIC_FILTER,
-                                     self.client_info.tenant_id,
-                                     {"filter": tf}))
+            # bad UTF-8 → MalformedTopicFilter; structural violation
+            # (misplaced wildcard etc.) → InvalidTopicFilter
+            self.events.report(Event(
+                EventType.MALFORMED_TOPIC_FILTER if tf_bad_utf8
+                else EventType.INVALID_TOPIC_FILTER,
+                self.client_info.tenant_id, {"filter": tf}))
             return (ReasonCode.TOPIC_FILTER_INVALID if v5 else 0x80)
         if (topic_util.is_wildcard_topic_filter(tf)
                 and not ts[Setting.WildcardSubscriptionEnabled]):
@@ -779,7 +852,7 @@ class Session:
             return ReasonCode.QUOTA_EXCEEDED if v5 else 0x80
         allowed = await self._check_permission(MQTTAction.SUB, tf)
         if not allowed:
-            self.events.report(Event(EventType.SUB_ACTION_DISALLOWED,
+            self.events.report(Event(EventType.SUB_ACTION_DISALLOW,
                                      self.client_info.tenant_id,
                                      {"filter": tf}))
             return ReasonCode.NOT_AUTHORIZED if v5 else 0x80
@@ -803,9 +876,17 @@ class Session:
 
     async def _deliver_retained(self, sub: Subscription) -> None:
         limit = self.settings[Setting.RetainMessageMatchLimit]
-        matches = await self.retain_service.match(
-            self.client_info.tenant_id, list(sub.matcher.filter_levels),
-            limit)
+        try:
+            matches = await self.retain_service.match(
+                self.client_info.tenant_id,
+                list(sub.matcher.filter_levels), limit)
+        except Exception:  # noqa: BLE001 — retain backend failure
+            log.exception("retain match failed")
+            # ≈ MatchRetainError: the SUBSCRIBE itself stays granted
+            self.events.report(Event(
+                EventType.MATCH_RETAIN_ERROR, self.client_info.tenant_id,
+                {"filter": sub.matcher.mqtt_topic_filter}))
+            return
         if matches:
             self.events.report(Event(
                 EventType.RETAIN_MSG_MATCHED, self.client_info.tenant_id,
@@ -830,7 +911,7 @@ class Session:
             # UnsubActionDisallow event)
             if not await self._check_permission(MQTTAction.UNSUB, tf):
                 self.events.report(Event(
-                    EventType.UNSUB_ACTION_DISALLOWED,
+                    EventType.UNSUB_ACTION_DISALLOW,
                     self.client_info.tenant_id, {"filter": tf}))
                 codes.append(ReasonCode.NOT_AUTHORIZED if v5 else 0x80)
                 continue
@@ -950,6 +1031,20 @@ class Session:
                 return None
         retain_flag = (retained if not sub.retain_as_published
                        else (msg.is_retain or retained))
+        # ≈ IUserPropsCustomizer.outbound — extra props stamped at the push
+        # edge, counted against Maximum Packet Size like any other property.
+        # v3 subscribers carry no properties on the wire: skip the SPI call
+        # entirely on their (hot) push path
+        out_extra = ()
+        if self.protocol_level >= PROTOCOL_MQTT5:
+            try:
+                out_extra = tuple(self.user_props_customizer.outbound(
+                    topic, msg, None,
+                    sub.matcher.mqtt_topic_filter if sub.matcher else "",
+                    self.client_info, HLC.INST.get()))
+            except Exception:  # noqa: BLE001 — SPI failure ≠ dropped push
+                log.exception("user-props customizer outbound failed")
+                out_extra = ()
         props = None
         if self.protocol_level >= PROTOCOL_MQTT5:
             props = {}
@@ -958,8 +1053,9 @@ class Session:
                     1, int(remaining_expiry))
             if sub.sub_id is not None:
                 props[PropertyId.SUBSCRIPTION_IDENTIFIER] = [sub.sub_id]
-            if msg.user_properties:
-                props[PropertyId.USER_PROPERTY] = list(msg.user_properties)
+            if msg.user_properties or out_extra:
+                props[PropertyId.USER_PROPERTY] = (
+                    list(msg.user_properties) + list(out_extra))
             if msg.content_type:
                 props[PropertyId.CONTENT_TYPE] = msg.content_type
             if msg.response_topic:
@@ -1028,7 +1124,7 @@ class Session:
                     and transport.get_write_buffer_size()
                     > self.SEND_BUFFER_HIGH_WATER):
                 self.events.report(Event(
-                    EventType.DISCARDED, self.client_info.tenant_id,
+                    EventType.DISCARD, self.client_info.tenant_id,
                     {"topic": topic, "client_id": self.client_id,
                      "reason": "channel_unwritable"}))
                 return None
@@ -1063,7 +1159,17 @@ class Session:
         self._outbound[pid] = _OutboundQoS(packet_id=pid, publish=publish,
                                            phase=1,
                                            sent_at=time.monotonic())
-        await self.conn.send(publish)
+        try:
+            await self.conn.send(publish)
+        except (ConnectionError, OSError) as e:
+            # ≈ QoS1PushError / QoS2PushError: the write failed; the
+            # in-flight record stays for redelivery on reconnect
+            self.events.report(Event(
+                EventType.QOS1_PUSH_ERROR if qos == 1
+                else EventType.QOS2_PUSH_ERROR,
+                self.client_info.tenant_id,
+                {"topic": topic, "detail": type(e).__name__}))
+            return pid
         self.events.report(Event(
             EventType.QOS1_PUSHED if qos == 1 else EventType.QOS2_PUSHED,
             self.client_info.tenant_id, {"topic": topic}))

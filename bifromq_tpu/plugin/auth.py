@@ -38,6 +38,10 @@ class AuthResult:
     tenant_id: str = ""
     user_id: str = ""
     reason: str = ""
+    # reject code (≈ Reject.Code in the reference auth proto):
+    # "unauthenticated" = credentials bad; "not_authorized" = authenticated
+    # but banned/denied; "error" = provider failure
+    code: str = "unauthenticated"
     # extra attrs copied into ClientInfo metadata
     attrs: Dict[str, str] = field(default_factory=dict)
 
@@ -47,8 +51,8 @@ class AuthResult:
                           attrs=dict(attrs))
 
     @staticmethod
-    def reject(reason: str) -> "AuthResult":
-        return AuthResult(ok=False, reason=reason)
+    def reject(reason: str, code: str = "unauthenticated") -> "AuthResult":
+        return AuthResult(ok=False, reason=reason, code=code)
 
 
 @dataclass(frozen=True)

@@ -1,8 +1,11 @@
 """Event collector extension point (≈ plugin-event-collector).
 
-The reference streams 94 pooled event types through IEventCollector — the
-operational firehose. Here events are lightweight dataclasses; the EventType
-enum covers the families the broker currently emits and grows with it.
+The reference streams 84 pooled event types through IEventCollector
+(eventcollector/EventType.java) — the operational firehose. Here events are
+lightweight dataclasses; the EventType enum carries every reference type
+under its reference name, plus repo-specific extras (INBOX_*, PUB_RECEIVED,
+CONNECT_REJECTED, ...). Every member is emitted by a live code path —
+tests/test_events_parity.py enforces both properties.
 """
 
 from __future__ import annotations
@@ -16,11 +19,11 @@ class EventType(enum.Enum):
     # connect family (reference eventcollector/mqttbroker/clientconnected/...)
     CLIENT_CONNECTED = "client_connected"
     CONNECT_REJECTED = "connect_rejected"
-    SESSION_KICKED = "session_kicked"
+    KICKED = "kicked"
     CLIENT_DISCONNECTED = "client_disconnected"
     # pub/deliver family
     PUB_RECEIVED = "pub_received"
-    PUB_ACTION_DISALLOWED = "pub_action_disallowed"
+    PUB_ACTION_DISALLOW = "pub_action_disallow"
     DELIVERED = "delivered"
     DELIVER_ERROR = "deliver_error"
     QOS0_DROPPED = "qos0_dropped"
@@ -28,7 +31,7 @@ class EventType(enum.Enum):
     QOS2_DROPPED = "qos2_dropped"
     # sub family
     SUB_ACKED = "sub_acked"
-    SUB_ACTION_DISALLOWED = "sub_action_disallowed"
+    SUB_ACTION_DISALLOW = "sub_action_disallow"
     UNSUB_ACKED = "unsub_acked"
     # dist family
     DIST_ERROR = "dist_error"
@@ -38,7 +41,7 @@ class EventType(enum.Enum):
     WILL_DISTED = "will_disted"
     RETAIN_MSG_CLEARED = "retain_msg_cleared"
     MSG_RETAINED = "msg_retained"
-    RETAIN_ERROR = "retain_error"
+    MSG_RETAINED_ERROR = "msg_retained_error"
     # resource throttling (≈ OutOfTenantResource event family)
     OUT_OF_TENANT_RESOURCE = "out_of_tenant_resource"
     # inbox family
@@ -51,13 +54,13 @@ class EventType(enum.Enum):
     MALFORMED_TOPIC_FILTER = "malformed_topic_filter"
     CONNECTION_RATE_EXCEEDED = "connection_rate_exceeded"
     SERVER_BUSY = "server_busy"
-    REDIRECTED = "redirected"
+    SERVER_REDIRECTED = "server_redirected"
     # ping family
     PING_REQ = "ping_req"
     # sub detail family
     SHARED_SUB_UNSUPPORTED = "shared_sub_unsupported"
     WILDCARD_SUB_UNSUPPORTED = "wildcard_sub_unsupported"
-    UNSUB_ACTION_DISALLOWED = "unsub_action_disallowed"
+    UNSUB_ACTION_DISALLOW = "unsub_action_disallow"
     TOO_LARGE_SUBSCRIPTION = "too_large_subscription"
     TOO_LARGE_UNSUBSCRIPTION = "too_large_unsubscription"
     # connect guard detail family (≈ channelclosed/* events)
@@ -65,7 +68,7 @@ class EventType(enum.Enum):
     IDENTIFIER_REJECTED = "identifier_rejected"
     OVERSIZE_WILL_REJECTED = "oversize_will_rejected"
     OVERSIZE_PACKET_DROPPED = "oversize_packet_dropped"
-    DISCARDED = "discarded"    # QoS0 to an unwritable channel (≈ Discard)
+    DISCARD = "discard"    # QoS0 to an unwritable channel (≈ Discard)
     SUB_STALLED = "sub_stalled"  # persistent delivery paused on full window
     ACCESS_CONTROL_ERROR = "access_control_error"  # auth plugin threw
     # lwt detail
@@ -109,6 +112,45 @@ class EventType(enum.Enum):
     BY_CLIENT = "by_client"
     BY_SERVER = "by_server"
     IDLE = "idle"
+    # channel-close / decode family (≈ BadPacket/ChannelError/
+    # ClientChannelError/ProtocolError)
+    BAD_PACKET = "bad_packet"            # undecodable packet mid-session
+    CHANNEL_ERROR = "channel_error"      # transport error before a session
+    CLIENT_CHANNEL_ERROR = "client_channel_error"  # transport error after
+    PROTOCOL_ERROR = "protocol_error"    # pre-session protocol breach
+    # connect-reject detail family (≈ UnauthenticatedClient/
+    # NotAuthorizedClient/MalformedClientIdentifier/MalformedUsername/
+    # MalformedWillTopic/ResourceThrottled)
+    UNAUTHENTICATED_CLIENT = "unauthenticated_client"
+    NOT_AUTHORIZED_CLIENT = "not_authorized_client"
+    MALFORMED_CLIENT_IDENTIFIER = "malformed_client_identifier"
+    MALFORMED_USERNAME = "malformed_username"
+    MALFORMED_WILL_TOPIC = "malformed_will_topic"
+    RESOURCE_THROTTLED = "resource_throttled"
+    # enhanced-auth family (≈ EnhancedAuthAbortByClient/ReAuthFailed)
+    ENHANCED_AUTH_ABORT_BY_CLIENT = "enhanced_auth_abort_by_client"
+    RE_AUTH_FAILED = "re_auth_failed"
+    # structural topic/filter violations (≈ InvalidTopic/InvalidTopicFilter
+    # — distinct from the MALFORMED_* UTF-8 family)
+    INVALID_TOPIC = "invalid_topic"
+    INVALID_TOPIC_FILTER = "invalid_topic_filter"
+    # inbound flow control (≈ ExceedReceivingLimit)
+    EXCEED_RECEIVING_LIMIT = "exceed_receiving_limit"
+    # pub permission close reason for MQTT3 QoS1/2 (≈ NoPubPermission)
+    NO_PUB_PERMISSION = "no_pub_permission"
+    # per-QoS dist/push failures (≈ QoS{0,1,2}DistError, QoS{1,2}PushError)
+    QOS0_DIST_ERROR = "qos0_dist_error"
+    QOS1_DIST_ERROR = "qos1_dist_error"
+    QOS2_DIST_ERROR = "qos2_dist_error"
+    QOS1_PUSH_ERROR = "qos1_push_error"
+    QOS2_PUSH_ERROR = "qos2_push_error"
+    # dist success (≈ Disted) + byte-capped persistent fanout
+    DISTED = "disted"
+    PERSISTENT_FANOUT_BYTES_THROTTLED = "persistent_fanout_bytes_throttled"
+    # retain-match failure on SUBSCRIBE (≈ MatchRetainError)
+    MATCH_RETAIN_ERROR = "match_retain_error"
+    # persistent-session inbox op failed transiently (≈ InboxTransientError)
+    INBOX_TRANSIENT_ERROR = "inbox_transient_error"
 
 
 @dataclass

@@ -1,0 +1,43 @@
+"""User-properties customizer extension point (≈ mqtt-server-spi
+IUserPropsCustomizer.java:37 / UserPropsCustomizerFactory).
+
+Lets a deployment stamp extra MQTT5 user properties onto messages at the
+two edges of the broker: ``inbound`` as a PUBLISH enters (before dist),
+``outbound`` as a message is pushed to a subscriber. The additions ride
+the normal user-property channel, so they are subject to the subscriber's
+Maximum Packet Size like any other property.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Tuple
+
+UserProps = Iterable[Tuple[str, str]]
+
+
+class IUserPropsCustomizer:
+    """SPI. Both hooks return extra (key, value) pairs to append."""
+
+    def inbound(self, topic: str, pub_qos: int, payload: bytes,
+                publisher, hlc: int) -> UserProps:
+        """Extra user properties for an inbound PUBLISH
+        (≈ IUserPropsCustomizer.inbound)."""
+        raise NotImplementedError
+
+    def outbound(self, topic: str, message, publisher,
+                 topic_filter: str, subscriber, hlc: int) -> UserProps:
+        """Extra user properties for an outbound push
+        (≈ IUserPropsCustomizer.outbound)."""
+        raise NotImplementedError
+
+
+class NoopUserPropsCustomizer(IUserPropsCustomizer):
+    """Default: adds nothing (the reference default when no factory is
+    configured)."""
+
+    def inbound(self, topic, pub_qos, payload, publisher, hlc):
+        return ()
+
+    def outbound(self, topic, message, publisher, topic_filter,
+                 subscriber, hlc):
+        return ()

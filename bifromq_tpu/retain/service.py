@@ -193,7 +193,7 @@ class RetainService:
         # eventually-consistent gate, not a transactional reservation
         if topic not in existing and not self.throttler.has_resource(
                 tenant_id, TenantResourceType.TOTAL_RETAIN_TOPICS):
-            self.events.report(Event(EventType.RETAIN_ERROR, tenant_id,
+            self.events.report(Event(EventType.MSG_RETAINED_ERROR, tenant_id,
                                      {"topic": topic, "reason": "quota"}))
             return False
         expire_at = None

@@ -23,7 +23,7 @@ class TenantMetric(enum.Enum):
     CONNECTIONS = "connections"
     CONNECT_COUNT = "connect_count"
     DISCONNECT_COUNT = "disconnect_count"
-    SESSION_KICKED = "session_kicked"
+    KICKED = "kicked"
     PUB_RECEIVED = "pub_received"
     DELIVERED = "delivered"
     DELIVER_ERRORS = "deliver_errors"
@@ -73,7 +73,7 @@ class MetricsRegistry:
 _EVENT_TO_METRIC = {
     EventType.CLIENT_CONNECTED: TenantMetric.CONNECT_COUNT,
     EventType.CLIENT_DISCONNECTED: TenantMetric.DISCONNECT_COUNT,
-    EventType.SESSION_KICKED: TenantMetric.SESSION_KICKED,
+    EventType.KICKED: TenantMetric.KICKED,
     EventType.PUB_RECEIVED: TenantMetric.PUB_RECEIVED,
     EventType.DELIVERED: TenantMetric.DELIVERED,
     EventType.DELIVER_ERROR: TenantMetric.DELIVER_ERRORS,
@@ -83,6 +83,8 @@ _EVENT_TO_METRIC = {
     EventType.SUB_ACKED: TenantMetric.SUB_COUNT,
     EventType.UNSUB_ACKED: TenantMetric.UNSUB_COUNT,
     EventType.PERSISTENT_FANOUT_THROTTLED: TenantMetric.FANOUT_THROTTLED,
+    EventType.PERSISTENT_FANOUT_BYTES_THROTTLED:
+        TenantMetric.FANOUT_THROTTLED,
     EventType.GROUP_FANOUT_THROTTLED: TenantMetric.FANOUT_THROTTLED,
     EventType.MSG_RETAINED: TenantMetric.RETAINED,
     EventType.RETAIN_MSG_CLEARED: TenantMetric.RETAIN_CLEARED,

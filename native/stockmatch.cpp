@@ -1,0 +1,476 @@
+// Stock-CPU baseline proxy: a faithful C++ re-implementation of the
+// reference dist-worker's route-match hot loop, used ONLY to measure the
+// "stock broker on this box's CPU" baseline that bench.py divides by.
+//
+// The image ships no JVM (java/mvnw cannot run), so the reference's own
+// JMH harnesses cannot execute here. This binary re-creates the exact
+// algorithm of
+//   bifromq-dist/bifromq-dist-worker/src/main/java/org/apache/bifromq/
+//     dist/worker/cache/TenantRouteMatcher.java:68 (matchAll: per-batch
+//     topic trie + sorted route sweep with the probe-20 seek heuristic)
+//   bifromq-dist/bifromq-dist-coproc-proto/src/main/java/org/apache/
+//     bifromq/dist/trie/TopicFilterIterator.java:38 (expansion-set
+//     iterator: seek/next over the virtual filter trie)
+//   .../trie/{N,S,M}TopicFilterTrieNode.java (normal/"+"/"#" nodes)
+//   .../trie/TopicTrieNode.java (per-batch topic trie, $-topics not
+//     wildcard-matchable at the first level)
+// in C++ with these *stock-favoring* simplifications (each makes the
+// baseline FASTER than the real Java broker, so the vs_baseline multiple
+// we report is conservative):
+//   - routes live in a sorted in-memory vector (lower_bound seek) instead
+//     of RocksDB; no proto decode per entry (buildMatchRoute skipped)
+//   - matches accumulate into flat per-topic counters instead of
+//     MatchedRoutes object graphs
+//   - no fan-out cap bookkeeping, no event collector, no timers
+//   - C++ with -O3 vs JIT'd Java
+// Java's String.compareTo is UTF-16 code-unit order; level names here are
+// ASCII so byte order is identical.
+//
+// Usage: stockmatch <routes_file> <topics_file> <batch> <iters>
+//   routes_file: one topic filter per line (levels '/'-joined)
+//   topics_file: one concrete topic per line
+// Prints one JSON line: topics/s over the timed sweep plus cross-check
+// totals (total matched route entries) that tests compare against the
+// repo's own oracle/device matcher.
+
+#include <algorithm>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+const std::string NUL = std::string(1, '\0');   // TopicConst.NUL
+const std::string SINGLE = "+";
+const std::string MULTI = "#";
+
+// ---------------------------------------------------------------------------
+// Per-batch topic trie (TopicTrieNode.java)
+// ---------------------------------------------------------------------------
+struct TopicTrieNode {
+    std::string level_name;
+    bool wildcard_matchable = false;
+    std::map<std::string, TopicTrieNode *> children;
+    int topic_id = -1;  // >=0: this node IS a user topic (values non-empty)
+
+    bool is_user_topic() const { return topic_id >= 0; }
+};
+
+struct TopicTrieArena {
+    std::vector<std::unique_ptr<TopicTrieNode>> nodes;
+    TopicTrieNode *make(const std::string &name, bool wm) {
+        nodes.emplace_back(new TopicTrieNode());
+        nodes.back()->level_name = name;
+        nodes.back()->wildcard_matchable = wm;
+        return nodes.back().get();
+    }
+};
+
+// TopicTrieNode.Builder.addChild (non-global: first level of a $-topic is
+// not wildcard matchable)
+void add_topic(TopicTrieArena &arena, TopicTrieNode *root,
+               const std::vector<std::string> &levels, int topic_id) {
+    TopicTrieNode *node = root;
+    for (size_t i = 0; i < levels.size(); ++i) {
+        bool wm = i > 0 || levels[i].rfind('$', 0) != 0;
+        auto it = node->children.find(levels[i]);
+        if (it == node->children.end()) {
+            TopicTrieNode *child = arena.make(levels[i], wm);
+            it = node->children.emplace(levels[i], child).first;
+        }
+        node = it->second;
+    }
+    node->topic_id = topic_id;
+}
+
+// ---------------------------------------------------------------------------
+// Virtual filter-trie nodes ({N,S,M}TopicFilterTrieNode.java)
+// ---------------------------------------------------------------------------
+struct FilterNode {
+    enum Kind { N, S, M } kind = N;
+    FilterNode *parent = nullptr;
+    std::string level_name;
+    // child iteration state (names sorted; pos==-1 <=> invalid child)
+    std::vector<std::string> sub_level_names;
+    std::map<std::string, std::vector<TopicTrieNode *>> sub_topic_nodes;
+    std::vector<TopicTrieNode *> sub_wildcard_matchable;
+    std::vector<TopicTrieNode *> backing_topics;
+    int pos = -1;
+
+    bool at_valid_child() const {
+        return pos >= 0 && pos < (int)sub_level_names.size();
+    }
+    void seek_child(const std::string &name) {  // ceiling
+        auto it = std::lower_bound(sub_level_names.begin(),
+                                   sub_level_names.end(), name);
+        pos = it == sub_level_names.end() ? -1
+                                          : int(it - sub_level_names.begin());
+    }
+    void next_child() {
+        if (pos >= 0) {
+            ++pos;
+            if (pos >= (int)sub_level_names.size()) pos = -1;
+        }
+    }
+};
+
+void collect_topics(TopicTrieNode *node, std::set<TopicTrieNode *> &out) {
+    if (node->is_user_topic()) out.insert(node);
+    for (auto &kv : node->children) collect_topics(kv.second, out);
+}
+
+struct FilterArena {
+    std::vector<std::unique_ptr<FilterNode>> pool;
+    std::vector<FilterNode *> free_list;  // node pooling, like the
+                                          // reference's Caffeine POOL
+    FilterNode *alloc() {
+        if (!free_list.empty()) {
+            FilterNode *n = free_list.back();
+            free_list.pop_back();
+            n->sub_level_names.clear();
+            n->sub_topic_nodes.clear();
+            n->sub_wildcard_matchable.clear();
+            n->backing_topics.clear();
+            n->pos = -1;
+            return n;
+        }
+        pool.emplace_back(new FilterNode());
+        return pool.back().get();
+    }
+    void release(FilterNode *n) { free_list.push_back(n); }
+};
+
+// shared N/S init: children = merged children of the sibling set; "#"
+// child if backing topics or wildcard-matchable children exist; "+" child
+// if wildcard-matchable children exist
+void init_children(FilterNode *n,
+                   const std::vector<TopicTrieNode *> &siblings,
+                   bool only_wildcard_matchable_backing) {
+    std::set<std::string> names;
+    for (TopicTrieNode *s : siblings) {
+        if (s->is_user_topic()) n->backing_topics.push_back(s);
+        for (auto &kv : s->children) {
+            TopicTrieNode *sub = kv.second;
+            if (sub->wildcard_matchable)
+                n->sub_wildcard_matchable.push_back(sub);
+            n->sub_topic_nodes[sub->level_name].push_back(sub);
+            names.insert(sub->level_name);
+        }
+    }
+    (void)only_wildcard_matchable_backing;
+    if (!n->backing_topics.empty()) names.insert(MULTI);
+    if (!n->sub_wildcard_matchable.empty()) {
+        names.insert(MULTI);
+        names.insert(SINGLE);
+    }
+    n->sub_level_names.assign(names.begin(), names.end());
+    n->seek_child("");
+}
+
+FilterNode *make_n(FilterArena &a, FilterNode *parent,
+                   const std::string &level_name,
+                   const std::vector<TopicTrieNode *> &siblings) {
+    FilterNode *n = a.alloc();
+    n->kind = FilterNode::N;
+    n->parent = parent;
+    n->level_name = level_name;
+    init_children(n, siblings, false);
+    return n;
+}
+
+FilterNode *make_s(FilterArena &a, FilterNode *parent,
+                   const std::vector<TopicTrieNode *> &siblings) {
+    FilterNode *n = a.alloc();
+    n->kind = FilterNode::S;
+    n->parent = parent;
+    n->level_name = SINGLE;
+    init_children(n, siblings, true);
+    return n;
+}
+
+FilterNode *make_m(FilterArena &a, FilterNode *parent,
+                   const std::vector<TopicTrieNode *> &siblings) {
+    FilterNode *n = a.alloc();
+    n->kind = FilterNode::M;
+    n->parent = parent;
+    n->level_name = MULTI;
+    std::set<TopicTrieNode *> topics;  // MTopicFilterTrieNode.init: parent
+    if (parent)                        // backing + whole sibling subtrees
+        topics.insert(parent->backing_topics.begin(),
+                      parent->backing_topics.end());
+    for (TopicTrieNode *s : siblings) collect_topics(s, topics);
+    n->backing_topics.assign(topics.begin(), topics.end());
+    // M node has no children (leaf in the filter trie)
+    return n;
+}
+
+FilterNode *child_node(FilterArena &a, FilterNode *n) {
+    const std::string &name = n->sub_level_names[n->pos];
+    if (name == MULTI) return make_m(a, n, n->sub_wildcard_matchable);
+    if (name == SINGLE) return make_s(a, n, n->sub_wildcard_matchable);
+    return make_n(a, n, name, n->sub_topic_nodes[name]);
+}
+
+// ---------------------------------------------------------------------------
+// Expansion-set iterator (TopicFilterIterator.java — seek/next subset used
+// by matchAll; seekPrev/prev are not on the matchAll path)
+// ---------------------------------------------------------------------------
+struct ExpansionIterator {
+    FilterArena arena;
+    TopicTrieNode *root = nullptr;
+    std::vector<FilterNode *> stack;
+
+    void pop_release() {
+        arena.release(stack.back());
+        stack.pop_back();
+    }
+    void clear() {
+        while (!stack.empty()) pop_release();
+    }
+    bool valid() const { return !stack.empty(); }
+
+    void init(TopicTrieNode *r) {
+        root = r;
+        seek({});
+    }
+
+    void seek(const std::vector<std::string> &filter_levels) {
+        clear();
+        stack.push_back(make_n(arena, nullptr, root->level_name, {root}));
+        int i = -1;
+        bool drained = false;
+        while (!stack.empty() && i < (int)filter_levels.size()) {
+            const std::string &to_seek = i == -1 ? NUL : filter_levels[i];
+            ++i;
+            FilterNode *node = stack.back();
+            int cmp = to_seek.compare(node->level_name);
+            if (cmp < 0) {
+                break;
+            } else if (cmp == 0) {
+                if (i == (int)filter_levels.size()) break;
+                node->seek_child(filter_levels[i]);
+                if (node->at_valid_child()) {
+                    stack.push_back(child_node(arena, node));
+                } else {
+                    pop_release();
+                    if (stack.empty()) break;
+                    bool descended = false;
+                    while (!stack.empty()) {
+                        FilterNode *parent = stack.back();
+                        parent->next_child();
+                        if (parent->at_valid_child()) {
+                            stack.push_back(child_node(arena, parent));
+                            descended = true;
+                            break;
+                        }
+                        pop_release();
+                    }
+                    if (descended) break;
+                }
+            } else {
+                // to_seek > level name: nothing >= filter exists
+                clear();
+                drained = true;
+            }
+        }
+        (void)drained;
+        // descend to the least filter with backing topics
+        while (!stack.empty()) {
+            FilterNode *node = stack.back();
+            if (node->backing_topics.empty()) {
+                // invariant from the reference: a childless filter node
+                // always has backing topics, so at_valid_child holds here
+                stack.push_back(child_node(arena, node));
+            } else {
+                break;
+            }
+        }
+    }
+
+    void next() {
+        while (!stack.empty()) {
+            FilterNode *node = stack.back();
+            if (node->at_valid_child()) {
+                FilterNode *sub = child_node(arena, node);
+                stack.push_back(sub);
+                if (!sub->backing_topics.empty()) break;
+            } else {
+                pop_release();
+                if (!stack.empty()) stack.back()->next_child();
+            }
+        }
+    }
+
+    // key(): current filter (prefix of non-NUL ancestor level names + own)
+    std::vector<std::string> key() const {
+        std::vector<std::string> out;
+        for (FilterNode *n : stack)
+            if (n->level_name != NUL) out.push_back(n->level_name);
+        return out;
+    }
+
+    const std::vector<TopicTrieNode *> &value_topics() const {
+        return stack.back()->backing_topics;
+    }
+};
+
+// ---------------------------------------------------------------------------
+// matchAll (TenantRouteMatcher.java:68) over a sorted in-memory route set
+// ---------------------------------------------------------------------------
+struct MatchStats {
+    uint64_t matched_entries = 0;  // (route entry, topic) pairs added
+    uint64_t seeks = 0;
+    uint64_t probes = 0;
+};
+
+void match_all(const std::vector<std::vector<std::string>> &routes,
+               const std::vector<std::vector<std::string>> &topics,
+               size_t begin, size_t end, std::vector<uint64_t> &per_topic,
+               MatchStats &stats) {
+    TopicTrieArena arena;
+    TopicTrieNode *root = arena.make(NUL, false);
+    for (size_t t = begin; t < end; ++t)
+        add_topic(arena, root, topics[t], (int)t);
+
+    ExpansionIterator exp;
+    exp.init(root);
+    if (!exp.valid()) return;
+
+    // matchedTopicFilters memo: filter -> topic ids
+    std::unordered_map<std::string, std::vector<int>> memo;
+    auto memo_key = [](const std::vector<std::string> &levels) {
+        std::string k;
+        for (const auto &l : levels) {
+            k += l;
+            k += '\0';
+        }
+        return k;
+    };
+
+    size_t itr = 0;  // route iterator (sorted); seek == lower_bound
+    ++stats.seeks;
+    int probe = 0;
+    while (itr < routes.size()) {
+        const std::vector<std::string> &filter = routes[itr];
+        auto mit = memo.find(memo_key(filter));
+        if (mit == memo.end()) {
+            exp.seek(filter);
+            ++stats.seeks;
+            if (!exp.valid()) break;  // no more filters can match
+            std::vector<std::string> to_match = exp.key();
+            if (to_match == filter) {
+                std::vector<int> ids;
+                for (TopicTrieNode *n : exp.value_topics()) {
+                    per_topic[n->topic_id] += 1;
+                    ++stats.matched_entries;
+                    ids.push_back(n->topic_id);
+                }
+                memo.emplace(memo_key(filter), std::move(ids));
+                ++itr;
+                probe = 0;
+            } else if (probe++ < 20) {
+                // next() is much cheaper than seek(): probe the following
+                // 20 route entries (TenantRouteMatcher.java:129)
+                ++itr;
+                ++stats.probes;
+            } else {
+                itr = std::lower_bound(routes.begin(), routes.end(),
+                                       to_match) -
+                      routes.begin();
+                ++stats.seeks;
+            }
+        } else {
+            ++itr;
+            for (int id : mit->second) {
+                per_topic[id] += 1;
+                ++stats.matched_entries;
+            }
+        }
+    }
+}
+
+std::vector<std::string> split_levels(const std::string &line) {
+    std::vector<std::string> out;
+    size_t start = 0;
+    for (size_t i = 0; i <= line.size(); ++i) {
+        if (i == line.size() || line[i] == '/') {
+            out.push_back(line.substr(start, i - start));
+            start = i + 1;
+        }
+    }
+    return out;
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+    if (argc != 5) {
+        std::fprintf(stderr,
+                     "usage: %s <routes_file> <topics_file> <batch> <iters>\n",
+                     argv[0]);
+        return 2;
+    }
+    const char *routes_path = argv[1];
+    const char *topics_path = argv[2];
+    size_t batch = std::strtoul(argv[3], nullptr, 10);
+    size_t iters = std::strtoul(argv[4], nullptr, 10);
+
+    std::vector<std::vector<std::string>> routes;
+    {
+        std::ifstream f(routes_path);
+        std::string line;
+        while (std::getline(f, line))
+            if (!line.empty()) routes.push_back(split_levels(line));
+    }
+    // KV order: escaped filter keys sort like level-list lexicographic order
+    std::sort(routes.begin(), routes.end());
+
+    std::vector<std::vector<std::string>> topics;
+    {
+        std::ifstream f(topics_path);
+        std::string line;
+        while (std::getline(f, line))
+            if (!line.empty()) topics.push_back(split_levels(line));
+    }
+    if (topics.size() < batch) {
+        std::fprintf(stderr, "not enough topics (%zu < %zu)\n", topics.size(),
+                     batch);
+        return 2;
+    }
+
+    std::vector<uint64_t> per_topic(topics.size(), 0);
+    MatchStats warm;
+    match_all(routes, topics, 0, std::min(batch, topics.size()), per_topic,
+              warm);  // warmup (page in, allocate pools)
+
+    std::fill(per_topic.begin(), per_topic.end(), 0);
+    MatchStats stats;
+    auto t0 = std::chrono::steady_clock::now();
+    size_t done = 0;
+    for (size_t it = 0; it < iters; ++it) {
+        size_t begin = (it * batch) % (topics.size() - batch + 1);
+        match_all(routes, topics, begin, begin + batch, per_topic, stats);
+        done += batch;
+    }
+    auto t1 = std::chrono::steady_clock::now();
+    double secs = std::chrono::duration<double>(t1 - t0).count();
+
+    std::printf(
+        "{\"topics_per_s\": %.1f, \"batch\": %zu, \"iters\": %zu, "
+        "\"routes\": %zu, \"matched_entries\": %llu, "
+        "\"matched_routes_per_s\": %.1f, \"seeks\": %llu, \"probes\": %llu, "
+        "\"elapsed_s\": %.3f}\n",
+        done / secs, batch, iters, routes.size(),
+        (unsigned long long)stats.matched_entries,
+        stats.matched_entries / secs, (unsigned long long)stats.seeks,
+        (unsigned long long)stats.probes, secs);
+    return 0;
+}

@@ -75,7 +75,7 @@ class TestConnect:
         c1 = await mk_client(broker, client_id="same")
         c2 = await mk_client(broker, client_id="same")
         await asyncio.wait_for(c1.closed.wait(), 5)
-        assert broker.events.of(EventType.SESSION_KICKED)
+        assert broker.events.of(EventType.KICKED)
         await c2.disconnect()
 
 

@@ -414,7 +414,7 @@ class TestDisconnectReasonEvents:
             await asyncio.wait_for(c1.closed.wait(), 5)
             types = {e.type for e in ev.events}
             assert EventType.BY_SERVER in types
-            assert EventType.SESSION_KICKED in types
+            assert EventType.KICKED in types
             await c2.disconnect()
         finally:
             await broker.stop()
@@ -659,7 +659,7 @@ class TestNewTenantSettings:
                 await c2.disconnect()
             assert got == 1, got
             types = {e.type for e in ev.events}
-            assert EventType.PERSISTENT_FANOUT_THROTTLED in types
+            assert EventType.PERSISTENT_FANOUT_BYTES_THROTTLED in types
             await pub.disconnect()
             await trans.disconnect()
         finally:
@@ -697,7 +697,7 @@ class TestGuardEvents:
             await p.publish("a/b", b"still", qos=1)
             msg = await asyncio.wait_for(c.messages.get(), 5)
             assert msg.payload == b"still"
-            assert EventType.UNSUB_ACTION_DISALLOWED in {
+            assert EventType.UNSUB_ACTION_DISALLOW in {
                 e.type for e in ev.events}
             await c.disconnect()
             await p.disconnect()
@@ -904,7 +904,7 @@ class TestSlowConsumer:
     async def test_slow_qos0_consumer_discarded_not_blocking(self):
         """A subscriber that stops reading must not stall fan-out to its
         siblings: once its socket buffer passes the high-water mark, QoS0
-        pushes to it are DISCARDED (≈ the reference's channel-writability
+        pushes to it are DISCARD (≈ the reference's channel-writability
         drop + Discard event) while the healthy sibling keeps receiving."""
         from bifromq_tpu.plugin.events import CollectingEventCollector
         ev = CollectingEventCollector()
@@ -934,7 +934,7 @@ class TestSlowConsumer:
             # QoS0 under pressure is lossy BY CONTRACT — assert isolation,
             # not losslessness: the healthy sibling keeps receiving, the
             # broker never stalls, and drops for the dead reader are
-            # visible as DISCARDED events
+            # visible as DISCARD events
             got = 0
             deadline = asyncio.get_event_loop().time() + 10
             while got < n and asyncio.get_event_loop().time() < deadline:
@@ -943,7 +943,7 @@ class TestSlowConsumer:
             assert got >= n // 3, got
             discarded_for = {e.meta.get("client_id")
                              for e in ev.events
-                             if e.type is EventType.DISCARDED}
+                             if e.type is EventType.DISCARD}
             assert "slow" in discarded_for, discarded_for
             assert publish_time < 15, publish_time
             await fast.disconnect()
@@ -1118,7 +1118,7 @@ class TestConnectGuardsSysprops:
                    and asyncio.get_event_loop().time() < deadline):
                 await asyncio.sleep(0.05)
             assert broker.session_registry.get("DevOnly", "mv") is None
-            assert EventType.REDIRECTED in {
+            assert EventType.SERVER_REDIRECTED in {
                 e.type for e in broker.events.events}
         finally:
             sp.override(sp.SysProp.CLIENT_REDIRECT_CHECK_INTERVAL_SECONDS,

@@ -187,7 +187,7 @@ class TestRetainService:
         assert await svc.retain(PUB, "one", mk_msg())
         assert not await svc.retain(PUB, "two", mk_msg())
         assert await svc.retain(PUB, "one", mk_msg(b"update"))  # replace ok
-        assert ev.of(EventType.RETAIN_ERROR)
+        assert ev.of(EventType.MSG_RETAINED_ERROR)
 
 
 class TestRetainReplicatedDurability:

@@ -1,0 +1,49 @@
+"""Cross-check the stock-CPU baseline proxy against the oracle trie.
+
+native/stockmatch.cpp re-implements the reference match hot loop
+(TenantRouteMatcher.matchAll + TopicFilterIterator — cites in the .cpp
+header) to measure the stock baseline bench.py divides by. If its matched
+totals diverge from our oracle SubscriptionTrie on the same workload, the
+baseline number is garbage — so tie them together here.
+"""
+
+import json
+import subprocess
+
+import pytest
+
+from bench_stock import ensure_binary, export_config2
+
+
+@pytest.mark.parametrize("n_subs,batch,seed", [
+    (2000, 512, 0),
+    (5000, 1024, 7),
+])
+def test_stockmatch_totals_match_oracle(tmp_path, n_subs, batch, seed):
+    routes_path = tmp_path / "routes.txt"
+    topics_path = tmp_path / "topics.txt"
+    export_config2(str(routes_path), str(topics_path), n_subs=n_subs,
+                   seed=seed, n_topics=batch)
+
+    binary = ensure_binary()
+    out = subprocess.run(
+        [binary, str(routes_path), str(topics_path), str(batch), "1"],
+        check=True, capture_output=True, text=True)
+    res = json.loads(out.stdout)
+
+    # oracle: same filters into a SubscriptionTrie, match each UNIQUE topic
+    # (matchAll dedupes its topic batch via the per-batch trie)
+    from bifromq_tpu.models.oracle import Route, SubscriptionTrie
+    from bifromq_tpu.workloads import _mk_matcher
+
+    filters = [line.split("/")
+               for line in routes_path.read_text().splitlines() if line]
+    trie = SubscriptionTrie()
+    for i, levels in enumerate(filters):
+        trie.add(Route(matcher=_mk_matcher(levels), broker_id=0,
+                       receiver_id=f"r{i}", deliverer_key="d0"))
+    topics = {tuple(line.split("/"))
+              for line in topics_path.read_text().splitlines() if line}
+    expect = sum(len(trie.match(list(t)).all_routes()) for t in topics)
+
+    assert res["matched_entries"] == expect

@@ -1,0 +1,64 @@
+"""UserPropsCustomizer SPI (≈ mqtt-server-spi IUserPropsCustomizer):
+inbound/outbound extra user properties ride the normal property channel
+end-to-end, and a throwing customizer never drops messages."""
+
+import asyncio
+
+import pytest
+
+from bifromq_tpu.mqtt.broker import MQTTBroker
+from bifromq_tpu.mqtt.client import MQTTClient
+from bifromq_tpu.mqtt.protocol import PropertyId
+from bifromq_tpu.plugin.userprops import IUserPropsCustomizer
+
+pytestmark = pytest.mark.asyncio
+
+
+class StampingCustomizer(IUserPropsCustomizer):
+    def inbound(self, topic, pub_qos, payload, publisher, hlc):
+        return (("in-edge", topic),)
+
+    def outbound(self, topic, message, publisher, topic_filter,
+                 subscriber, hlc):
+        return (("out-filter", topic_filter),)
+
+
+class ThrowingCustomizer(IUserPropsCustomizer):
+    def inbound(self, *a):
+        raise RuntimeError("boom")
+
+    def outbound(self, *a):
+        raise RuntimeError("boom")
+
+
+async def _roundtrip(customizer):
+    broker = MQTTBroker(host="127.0.0.1", port=0,
+                        user_props_customizer=customizer)
+    await broker.start()
+    try:
+        sub = MQTTClient("127.0.0.1", broker.port, client_id="ups",
+                         protocol_level=5)
+        await sub.connect()
+        await sub.subscribe("up/+", qos=1)
+        p = MQTTClient("127.0.0.1", broker.port, client_id="upp",
+                       protocol_level=5)
+        await p.connect()
+        await p.publish("up/x", b"v", qos=1)
+        msg = await asyncio.wait_for(sub.messages.get(), 5)
+        await sub.disconnect()
+        await p.disconnect()
+        return msg
+    finally:
+        await broker.stop()
+
+
+async def test_customizer_stamps_both_edges():
+    msg = await _roundtrip(StampingCustomizer())
+    props = dict((msg.properties or {}).get(PropertyId.USER_PROPERTY) or ())
+    assert props.get("in-edge") == "up/x"
+    assert props.get("out-filter") == "up/+"
+
+
+async def test_throwing_customizer_does_not_drop_messages():
+    msg = await _roundtrip(ThrowingCustomizer())
+    assert msg.payload == b"v"
