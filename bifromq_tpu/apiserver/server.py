@@ -139,6 +139,8 @@ class APIServer:
                 return self._cluster_info()
             if route == ("GET", "/sessions"):
                 return self._sessions(arg)
+            if route == ("GET", "/inbox-state"):
+                return await self._inbox_state(arg)
             if route == ("GET", "/routes"):
                 return self._routes(arg)
             if route == ("GET", "/retained"):
@@ -152,6 +154,8 @@ class APIServer:
                 return self._balancer_state()
             if route == ("PUT", "/balancer"):
                 return self._balancer_toggle(arg)
+            if route == ("PUT", "/balancer-rules"):
+                return self._balancer_rules_set(arg, body)
             if route == ("GET", "/traffic"):
                 return self._traffic_get()
             if route == ("PUT", "/traffic"):
@@ -185,6 +189,10 @@ class APIServer:
         return 200, {"fanout": result.fanout}
 
     async def _sub(self, arg) -> Tuple[int, object]:
+        """Sub-on-behalf (≈ SessionDictService.sub): a LIVE session gets
+        the subscription through its own session object (permission checks,
+        retained delivery, route registration all apply); only an OFFLINE
+        persistent session falls back to the direct inbox write."""
         tenant = arg("tenant_id") or "DevOnly"
         client_id = arg("client_id")
         tf = arg("topic_filter")
@@ -193,11 +201,15 @@ class APIServer:
         if not topic_util.is_valid_topic_filter(tf):
             return 400, {"error": "invalid topic filter"}
         qos = int(arg("qos", "0"))
+        res = await self._live_on_behalf("sub", tenant, client_id, tf, qos)
+        if res is not None and res != "no_session":
+            code = 200 if res in ("ok", "exists") else 403
+            return code, {"result": res, "live": True}
         from ..types import TopicFilterOption
         res = await self.broker.inbox.sub(tenant, client_id, tf,
                                     TopicFilterOption(qos=QoS(qos)))
         if res == "no_inbox":
-            return 404, {"error": "no such persistent session"}
+            return 404, {"error": "no such session (live or persistent)"}
         return 200, {"result": res}
 
     async def _unsub(self, arg) -> Tuple[int, object]:
@@ -206,8 +218,50 @@ class APIServer:
         tf = arg("topic_filter")
         if not client_id or not tf:
             return 400, {"error": "client_id and topic_filter required"}
+        res = await self._live_on_behalf("unsub", tenant, client_id, tf)
+        if res is not None and res != "no_session":
+            code = 200 if res == "ok" else (404 if res == "no_sub" else 403)
+            return code, {"result": res, "live": True}
         removed = await self.broker.inbox.unsub(tenant, client_id, tf)
         return (200 if removed else 404), {"removed": removed}
+
+    async def _live_on_behalf(self, op: str, tenant: str, client_id: str,
+                              tf: str, qos: int = 0):
+        """Try the live session: local registry first, then the cluster
+        session dict. Returns a result name or None/no_session."""
+        session = self.broker.session_registry.get(tenant, client_id)
+        if session is not None and not session.closed:
+            if op == "sub":
+                return await session.admin_sub(tf, qos)
+            return await session.admin_unsub(tf)
+        sd = getattr(self.broker, "session_dict", None)
+        if sd is not None:
+            try:
+                if op == "sub":
+                    return await sd.sub(tenant, client_id, tf, qos)
+                return await sd.unsub(tenant, client_id, tf)
+            except Exception:  # noqa: BLE001 — dict unavailable: fall back
+                return None
+        return None
+
+    async def _inbox_state(self, arg) -> Tuple[int, object]:
+        """Live-session state (≈ SessionDictService.inboxState)."""
+        tenant = arg("tenant_id") or "DevOnly"
+        client_id = arg("client_id")
+        if not client_id:
+            return 400, {"error": "client_id required"}
+        session = self.broker.session_registry.get(tenant, client_id)
+        if session is not None and not session.closed:
+            return 200, session.inbox_state()
+        sd = getattr(self.broker, "session_dict", None)
+        if sd is not None:
+            try:
+                state = await sd.inbox_state(tenant, client_id)
+            except Exception:  # noqa: BLE001
+                state = None
+            if state is not None:
+                return 200, state
+        return 404, {"error": "no live session"}
 
     async def _kill(self, arg) -> Tuple[int, object]:
         tenant = arg("tenant_id") or "DevOnly"
@@ -290,6 +344,31 @@ class APIServer:
     def _balancer_state(self) -> Tuple[int, object]:
         return 200, {name: c.state()
                      for name, c in self._controllers().items()}
+
+    def _balancer_rules_set(self, arg, body: bytes) -> Tuple[int, object]:
+        """Install declarative placement rules on a store's controller
+        (≈ KVStoreBalanceController.updateLoadRules via the reference's
+        PUT LoadRules admin API). Body: the rule JSON document."""
+        try:
+            rules = json.loads(body.decode() or "{}")
+        except ValueError:
+            return 400, {"error": "body must be a JSON rule document"}
+        target = arg("store")      # omit = all rule-capable controllers
+        hit = []
+        for name, c in self._controllers().items():
+            if target in (None, name):
+                if not hasattr(c, "set_rules"):
+                    if target == name:
+                        return 400, {"error":
+                                     f"controller {name!r} takes no rules"}
+                    continue
+                err = c.set_rules(rules)
+                if err is not None:
+                    return 400, {"error": err}
+                hit.append(name)
+        if not hit:
+            return 404, {"error": f"no rule-capable controller {target!r}"}
+        return 200, {"rules": rules, "stores": hit}
 
     def _balancer_toggle(self, arg) -> Tuple[int, object]:
         raw = (arg("enable") or "true").lower()

@@ -1,10 +1,12 @@
 """Replica placement balancers + cluster placement controller.
 
-≈ base-kv-store-balance-controller's placement balancer set
+≈ base-kv-store-balance-controller's FULL placement balancer set
 (impl/ReplicaCntBalancer.java:51, RangeLeaderBalancer.java,
-RedundantEpochRemovalBalancer / UnreachableReplicaRemovalBalancer,
-RangeBootstrapBalancer) re-expressed over this repo's landscape
-(kv/meta.py) instead of CRDT store descriptors.
+UnreachableReplicaRemovalBalancer.java, RangeBootstrapBalancer.java:52,
+RedundantRangeRemovalBalancer.java, RuleBasedPlacementBalancer.java:30 —
+the last fed by operator rule documents like the reference's LoadRules
+admin API) re-expressed over this repo's landscape (kv/meta.py) instead
+of CRDT store descriptors.
 
 Decentralized like the reference: every store runs the controller against
 its own view, but a balancer only emits commands for ranges whose LEADER
@@ -70,6 +72,28 @@ class TransferLeaderCommand:
 
     def __repr__(self) -> str:
         return f"TransferLeader({self.range_id} -> {self.target_node})"
+
+
+class BootstrapCommand:
+    """Create the genesis full-boundary range on this store
+    (≈ balance/command/BootstrapCommand.java)."""
+
+    def __init__(self, range_id: str) -> None:
+        self.range_id = range_id
+
+    def __repr__(self) -> str:
+        return f"Bootstrap({self.range_id})"
+
+
+class QuitCommand:
+    """Retire a local (conflicting) replica
+    (≈ balance/command/QuitCommand.java)."""
+
+    def __init__(self, range_id: str) -> None:
+        self.range_id = range_id
+
+    def __repr__(self) -> str:
+        return f"Quit({self.range_id})"
 
 
 class ReplicaCntBalancer:
@@ -224,6 +248,188 @@ class RangeLeaderBalancer:
         return []
 
 
+class RangeBootstrapBalancer:
+    """Create the first full-boundary range when a store group comes up
+    empty (≈ RangeBootstrapBalancer.java:52: bootstrap-as-a-balancer-
+    decision, replacing manual ensure_range bootstrap).
+
+    The reference races randomized suspicion timers and lets
+    RedundantRangeRemovalBalancer clean up a double bootstrap; here the
+    decision is deterministic — only the smallest-id alive store
+    bootstraps — so a conflict cannot arise in a connected landscape. The
+    debounce (``wait_rounds``) covers slow landscape convergence at cold
+    start, like the reference's suspicion window."""
+
+    def __init__(self, wait_rounds: int = 10) -> None:
+        self.wait_rounds = wait_rounds
+        self._rounds_empty = 0
+
+    def balance(self, store: KVRangeStore, alive: Set[str],
+                landscape: Dict[str, dict]) -> List:
+        if store.ranges or any(d.get("ranges")
+                               for d in landscape.values()):
+            self._rounds_empty = 0
+            return []
+        if alive and store.node_id != min(alive):
+            return []
+        self._rounds_empty += 1
+        if self._rounds_empty < self.wait_rounds:
+            return []
+        self._rounds_empty = 0
+        return [BootstrapCommand("r0")]
+
+
+class RedundantRangeRemovalBalancer:
+    """Retire local leader ranges whose boundary overlaps another leader
+    range in the landscape (≈ RedundantRangeRemovalBalancer.java's
+    boundary/id-conflict cleanup; config-excluded replicas are handled by
+    the store's zombie-quit instead). Deterministic survivor rule: among
+    conflicting leader ranges, the lexicographically smallest range id
+    wins; the local leader of any other conflicting range quits after
+    ``wait_rounds`` consecutive observations (debounce against stale
+    landscape views)."""
+
+    def __init__(self, wait_rounds: int = 5) -> None:
+        self.wait_rounds = wait_rounds
+        self._pending: Dict[str, int] = {}   # rid -> consecutive rounds
+
+    @staticmethod
+    def _overlaps(a_start: bytes, a_end, b_start: bytes, b_end) -> bool:
+        if a_end is not None and a_end <= b_start:
+            return False
+        if b_end is not None and b_end <= a_start:
+            return False
+        return True
+
+    def balance(self, store: KVRangeStore, alive: Set[str],
+                landscape: Dict[str, dict]) -> List:
+        # all leader ranges in the landscape, deduped by id
+        leaders: Dict[str, tuple] = {}
+        for desc in landscape.values():
+            for rd in desc.get("ranges", ()):
+                if rd.get("is_leader"):
+                    leaders[rd["id"]] = (
+                        bytes.fromhex(rd["start"]),
+                        bytes.fromhex(rd["end"]) if rd["end"] else None)
+        out: List = []
+        still_pending = set()
+        for rid, r in store.ranges.items():
+            if not r.is_leader:
+                continue
+            s, e = store.boundaries[rid]
+            conflicted = any(
+                other != rid and other < rid
+                and self._overlaps(s, e, os_, oe)
+                for other, (os_, oe) in leaders.items())
+            if not conflicted:
+                continue
+            n = self._pending.get(rid, 0) + 1
+            self._pending[rid] = n
+            still_pending.add(rid)
+            if n >= self.wait_rounds:
+                log.info("redundant-range-removal: retiring %s "
+                         "(boundary conflict with a smaller-id leader)",
+                         rid)
+                out.append(QuitCommand(rid))
+                still_pending.discard(rid)
+        self._pending = {rid: n for rid, n in self._pending.items()
+                         if rid in still_pending}
+        return out
+
+
+class RuleBasedPlacementBalancer:
+    """Declarative placement rules → convergence commands
+    (≈ RuleBasedPlacementBalancer.java:30: an operator-fed rule document
+    generates the expected range layout; the balancer diffs it against the
+    current config and emits one migration step per round per range).
+
+    Rule document (set via the placement controller / admin API):
+      - ``replica_count``: target voter count per range
+      - ``exclude_stores``: drain list — replicas migrate off these stores
+      - ``pin_leaders``: {range_id: store_id} — desired leadership
+    """
+
+    def __init__(self, rules: Optional[dict] = None) -> None:
+        self.rules = rules or {}
+
+    @staticmethod
+    def validate(rules: dict) -> Optional[str]:
+        """Returns an error string, or None when the document is valid
+        (≈ RuleBasedPlacementBalancer.validate)."""
+        if not isinstance(rules, dict):
+            return "rules must be an object"
+        rc = rules.get("replica_count")
+        if rc is not None and (not isinstance(rc, int) or rc < 1):
+            return "replica_count must be a positive integer"
+        ex = rules.get("exclude_stores", [])
+        if not isinstance(ex, list) or any(not isinstance(s, str)
+                                           for s in ex):
+            return "exclude_stores must be a list of store ids"
+        pins = rules.get("pin_leaders", {})
+        if not isinstance(pins, dict):
+            return "pin_leaders must be an object of range_id -> store_id"
+        return None
+
+    def _expected_voters(self, rid: str, current: Set[str],
+                         alive: Set[str]) -> Optional[List[str]]:
+        rc = self.rules.get("replica_count") or len(current)
+        excluded = set(self.rules.get("exclude_stores", ()))
+        eligible = alive - excluded
+        if not eligible:
+            return None
+        # keep current eligible voters (stability), then fill by
+        # per-range rendezvous hash — same placement everywhere
+        keep = sorted(current & eligible)
+
+        def score(n: str) -> int:
+            h = hashlib.blake2b(f"{n}|{rid}".encode(),
+                                digest_size=8).digest()
+            return int.from_bytes(h, "big")
+        fill = sorted(eligible - current, key=score, reverse=True)
+        expected = (keep + fill)[:rc]
+        return sorted(expected) if expected else None
+
+    def balance(self, store: KVRangeStore, alive: Set[str]) -> List:
+        if not self.rules:
+            return []
+        out: List = []
+        for rid, r in store.ranges.items():
+            if not r.is_leader or r.raft.voters_old is not None:
+                continue
+            current = _voter_nodes(r.raft)
+            expected = self._expected_voters(rid, current, alive)
+            if expected is None or set(expected) == current:
+                # voters converged: apply leader pin if any
+                pin = self.rules.get("pin_leaders", {}).get(rid)
+                if (pin and pin != store.node_id and pin in current
+                        and pin in alive):
+                    out.append(TransferLeaderCommand(rid, pin))
+                continue
+            learner_nodes = {_node_of(m) for m in r.raft.learners}
+            to_add = sorted(set(expected) - current - learner_nodes)
+            if to_add:
+                # stage ONE newcomer as learner (promotion balancer flips
+                # it to voter once caught up), like ReplicaCntBalancer
+                new_learners = sorted(learner_nodes | {to_add[0]})
+                out.append(EnsureReplicaCommand(
+                    to_add[0], rid, store.boundaries[rid],
+                    sorted(current), new_learners))
+                out.append(ConfigChangeCommand(rid, sorted(current),
+                                               new_learners))
+                continue
+            to_drop = sorted(current - set(expected) - {store.node_id})
+            if to_drop:
+                out.append(ConfigChangeCommand(
+                    rid, sorted(current - {to_drop[0]})))
+            elif store.node_id not in expected and len(current) > 1:
+                # the leader itself must drain: hand off first, quit on a
+                # later round once a peer leads
+                peers = sorted((current - {store.node_id}) & alive)
+                if peers:
+                    out.append(TransferLeaderCommand(rid, peers[0]))
+        return out
+
+
 class ClusterPlacementController:
     """Executes placement commands for one store (run by its
     BaseKVStoreServer): ensure-replica travels over the RPC fabric; config
@@ -235,8 +441,10 @@ class ClusterPlacementController:
         self.server = server            # BaseKVStoreServer
         self.store: KVRangeStore = server.store
         self.balancers = balancers if balancers is not None else [
-            ReplicaCntBalancer(), LearnerPromotionBalancer(),
-            UnreachableReplicaRemovalBalancer(), RangeLeaderBalancer()]
+            RangeBootstrapBalancer(), ReplicaCntBalancer(),
+            LearnerPromotionBalancer(),
+            UnreachableReplicaRemovalBalancer(), RangeLeaderBalancer(),
+            RedundantRangeRemovalBalancer(), RuleBasedPlacementBalancer()]
         self.interval = interval
         # default liveness = landscape membership (gossip deployments pass
         # AgentHost.alive_members)
@@ -250,7 +458,29 @@ class ClusterPlacementController:
             "enabled": self.enabled,
             "interval_s": self.interval,
             "balancers": [type(b).__name__ for b in self.balancers],
+            "rules": self.rules,
         }
+
+    @property
+    def rules(self) -> dict:
+        for b in self.balancers:
+            if isinstance(b, RuleBasedPlacementBalancer):
+                return b.rules
+        return {}
+
+    def set_rules(self, rules: dict) -> Optional[str]:
+        """Install a declarative placement-rule document
+        (≈ KVStoreBalanceController.updateLoadRules). Returns an error
+        string or None on success."""
+        err = RuleBasedPlacementBalancer.validate(rules)
+        if err is not None:
+            return err
+        for b in self.balancers:
+            if isinstance(b, RuleBasedPlacementBalancer):
+                b.rules = rules
+                return None
+        self.balancers.append(RuleBasedPlacementBalancer(rules))
+        return None
 
     def _leader_counts(self) -> Dict[str, int]:
         counts: Dict[str, int] = {}
@@ -265,9 +495,26 @@ class ClusterPlacementController:
             return 0
         alive = set(self.alive_fn())
         executed = 0
+        landscape = None
+        rules_active = bool(self.rules)
         for b in self.balancers:
+            if rules_active and isinstance(b, ReplicaCntBalancer):
+                # an operator rule document owns replica counts while
+                # installed — running the default-count balancer alongside
+                # would oscillate (add/drop forever) against any rule with
+                # a different count or an exclude list
+                continue
             if isinstance(b, RangeLeaderBalancer):
+                if rules_active and self.rules.get("pin_leaders"):
+                    # pinned leadership would fight the spread balancer
+                    continue
                 cmds = b.balance(self.store, alive, self._leader_counts())
+            elif isinstance(b, (RangeBootstrapBalancer,
+                                RedundantRangeRemovalBalancer)):
+                if landscape is None:
+                    landscape = self.server.meta.landscape(
+                        self.server.cluster)
+                cmds = b.balance(self.store, alive, landscape)
             else:
                 cmds = b.balance(self.store, alive)
             failed_ranges: Set[str] = set()
@@ -316,6 +563,13 @@ class ClusterPlacementController:
             r = self.store.ranges[cmd.range_id]
             r.raft.transfer_leadership(
                 f"{cmd.target_node}:{cmd.range_id}")
+        elif isinstance(cmd, BootstrapCommand):
+            # genesis: single-voter full-boundary range on this store;
+            # ReplicaCntBalancer grows it to target on later rounds
+            self.store.ensure_range(cmd.range_id, (b"", None),
+                                    [self.store.node_id])
+        elif isinstance(cmd, QuitCommand):
+            self.store.retire_replica(cmd.range_id)
 
     async def start(self) -> None:
         import asyncio

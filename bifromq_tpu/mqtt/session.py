@@ -895,6 +895,60 @@ class Session:
         for topic, msg in matches:
             await self._send_publish(topic, msg, sub, retained=True)
 
+    # ------- on-behalf management surface (≈ SessionDictService sub/unsub/
+    # inboxState, SessionDictService.proto:38-40) -----------------------------
+
+    async def admin_sub(self, tf: str, qos: int) -> str:
+        """Subscribe on behalf of this live session (admin/API initiated).
+        Returns a SubReply.Result name (lower-case)."""
+        prior = self.subscriptions.get(tf)
+        if prior is not None and int(prior.qos) == int(qos):
+            return "exists"
+        req = pk.SubscriptionRequest(topic_filter=tf, qos=qos)
+        # _subscribe_one runs the full SUBSCRIBE pipeline including
+        # retained delivery under its own guards — nothing extra here
+        code = await self._subscribe_one(req, None)
+        if code < 0x80:
+            return "ok"
+        return {
+            ReasonCode.QUOTA_EXCEEDED: "exceed_limit",
+            ReasonCode.NOT_AUTHORIZED: "not_authorized",
+            ReasonCode.TOPIC_FILTER_INVALID: "topic_filter_invalid",
+            ReasonCode.WILDCARD_SUBSCRIPTIONS_NOT_SUPPORTED:
+                "wildcard_not_supported",
+            ReasonCode.SHARED_SUBSCRIPTIONS_NOT_SUPPORTED:
+                "shared_subscription_not_supported",
+        }.get(code, "error")
+
+    async def admin_unsub(self, tf: str) -> str:
+        """Unsubscribe on behalf of this live session. Returns an
+        UnsubReply.Result name (lower-case)."""
+        if not await self._check_permission(MQTTAction.UNSUB, tf):
+            self.events.report(Event(
+                EventType.UNSUB_ACTION_DISALLOW,
+                self.client_info.tenant_id, {"filter": tf}))
+            return "not_authorized"
+        sub = self.subscriptions.pop(tf, None)
+        if sub is None:
+            return "no_sub"
+        await self._unroute(sub)
+        return "ok"
+
+    def inbox_state(self) -> dict:
+        """Live-session state for the management API (≈ the transient
+        InboxState reply of SessionDictService.inboxState)."""
+        return {
+            "client_id": self.client_id,
+            "session_id": self.session_id,
+            "subscriptions": {
+                tf: {"qos": int(s.qos), "no_local": bool(s.no_local),
+                     "retain_as_published": bool(s.retain_as_published),
+                     "retain_handling": int(s.retain_handling)}
+                for tf, s in self.subscriptions.items()},
+            "inflight": len(self._outbound),
+            "inbound_qos2": len(self._inbound_qos2),
+        }
+
     async def _on_unsubscribe(self, u: pk.Unsubscribe) -> None:
         v5 = self.protocol_level >= PROTOCOL_MQTT5
         ts = self.settings
@@ -980,7 +1034,8 @@ class Session:
                 if sub.no_local and (pub_pack.publisher.meta().get("sessionId")
                                      == self.session_id):
                     continue
-                await self._send_publish(pack.topic, msg, sub)
+                await self._send_publish(pack.topic, msg, sub,
+                                         publisher=pub_pack.publisher)
         return True
 
     def _outbound_alias(self, topic: str):
@@ -1010,9 +1065,12 @@ class Session:
     SEND_BUFFER_HIGH_WATER = 512 * 1024
 
     async def _send_publish(self, topic: str, msg: Message,
-                            sub: Subscription, retained: bool = False):
+                            sub: Subscription, retained: bool = False,
+                            publisher=None):
         """Returns None (sent as qos0), the packet id (sent qos>0), or
-        ``BLOCKED`` (receive-maximum / packet-id window exhausted)."""
+        ``BLOCKED`` (receive-maximum / packet-id window exhausted).
+        ``publisher`` is the originating ClientInfo when the caller knows
+        it (live fan-out); None on retained/inbox replay."""
         qos = min(int(msg.pub_qos), sub.qos)
         remaining_expiry = None
         if msg.expiry_seconds != 0xFFFFFFFF:
@@ -1039,7 +1097,7 @@ class Session:
         if self.protocol_level >= PROTOCOL_MQTT5:
             try:
                 out_extra = tuple(self.user_props_customizer.outbound(
-                    topic, msg, None,
+                    topic, msg, publisher,
                     sub.matcher.mqtt_topic_filter if sub.matcher else "",
                     self.client_info, HLC.INST.get()))
             except Exception:  # noqa: BLE001 — SPI failure ≠ dropped push

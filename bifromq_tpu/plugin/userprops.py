@@ -27,7 +27,9 @@ class IUserPropsCustomizer:
     def outbound(self, topic: str, message, publisher,
                  topic_filter: str, subscriber, hlc: int) -> UserProps:
         """Extra user properties for an outbound push
-        (≈ IUserPropsCustomizer.outbound)."""
+        (≈ IUserPropsCustomizer.outbound). ``publisher`` is the
+        originating ClientInfo on live fan-out, or None when the push is
+        a retained/inbox replay whose publisher is no longer known."""
         raise NotImplementedError
 
 

@@ -35,6 +35,9 @@ class SessionDictRPCService:
             "kill": self._kill,
             "exist": self._exist,
             "clients": self._clients,
+            "sub": self._sub,
+            "unsub": self._unsub,
+            "inbox_state": self._inbox_state,
         })
 
     async def _kill(self, payload: bytes, okey: str) -> bytes:
@@ -66,6 +69,41 @@ class SessionDictRPCService:
         for cid in ids:
             out += _len16(cid.encode())
         return bytes(out)
+
+    # on-behalf management surface (≈ SessionDictService.proto sub/unsub/
+    # inboxState): operate on a LIVE session hosted by this broker
+    async def _sub(self, payload: bytes, okey: str) -> bytes:
+        tenant_b, pos = _read16(payload, 0)
+        client_b, pos = _read16(payload, pos)
+        tf_b, pos = _read16(payload, pos)
+        (qos,) = struct.unpack_from(">B", payload, pos)
+        session = self.broker.session_registry.get(tenant_b.decode(),
+                                                   client_b.decode())
+        if session is None or session.closed:
+            return _len16(b"no_session")
+        res = await session.admin_sub(tf_b.decode(), qos)
+        return _len16(res.encode())
+
+    async def _unsub(self, payload: bytes, okey: str) -> bytes:
+        tenant_b, pos = _read16(payload, 0)
+        client_b, pos = _read16(payload, pos)
+        tf_b, pos = _read16(payload, pos)
+        session = self.broker.session_registry.get(tenant_b.decode(),
+                                                   client_b.decode())
+        if session is None or session.closed:
+            return _len16(b"no_session")
+        res = await session.admin_unsub(tf_b.decode())
+        return _len16(res.encode())
+
+    async def _inbox_state(self, payload: bytes, okey: str) -> bytes:
+        import json
+        tenant_b, pos = _read16(payload, 0)
+        client_b, pos = _read16(payload, pos)
+        session = self.broker.session_registry.get(tenant_b.decode(),
+                                                   client_b.decode())
+        if session is None or session.closed:
+            return _len16(b"")
+        return _len16(json.dumps(session.inbox_state()).encode())
 
 
 class SessionDictClient:
@@ -112,6 +150,59 @@ class SessionDictClient:
             else:
                 kicked += out[0]
         return kicked
+
+    async def inbox_state(self, tenant_id: str, client_id: str):
+        """Live-session state lookup (≈ inboxState); None if not online."""
+        import json
+        payload = _len16(tenant_id.encode()) + _len16(client_id.encode())
+        body = await self._on_behalf_raw("inbox_state", tenant_id,
+                                         client_id, payload,
+                                         miss=b"")
+        return json.loads(body.decode()) if body else None
+
+    async def sub(self, tenant_id: str, client_id: str, tf: str,
+                  qos: int) -> str:
+        """Subscribe on behalf of a live session wherever it is hosted
+        (≈ SessionDictService.sub). Returns a SubReply.Result name."""
+        payload = (_len16(tenant_id.encode()) + _len16(client_id.encode())
+                   + _len16(tf.encode()) + struct.pack(">B", qos))
+        out = await self._on_behalf_raw("sub", tenant_id, client_id,
+                                        payload, miss=b"no_session")
+        return out.decode()
+
+    async def unsub(self, tenant_id: str, client_id: str, tf: str) -> str:
+        """Unsubscribe on behalf of a live session (≈ unsub)."""
+        payload = (_len16(tenant_id.encode()) + _len16(client_id.encode())
+                   + _len16(tf.encode()))
+        out = await self._on_behalf_raw("unsub", tenant_id, client_id,
+                                        payload, miss=b"no_session")
+        return out.decode()
+
+    async def _on_behalf_raw(self, method: str, tenant_id: str,
+                             client_id: str, payload: bytes, *,
+                             miss: bytes) -> bytes:
+        """Fan the call to PEER brokers concurrently (the caller has
+        already checked its own registry; self is excluded like
+        kick_everywhere); at most one broker hosts the session, so at
+        most one answer differs from ``miss``."""
+        peers = [ep for ep in self.registry.endpoints(SERVICE)
+                 if ep != self.self_address]
+        if not peers:
+            return miss
+        outs = await asyncio.gather(
+            *(self._call_peer(ep, method, payload,
+                              order_key=f"{tenant_id}/{client_id}")
+              for ep in peers),
+            return_exceptions=True)
+        for ep, out in zip(peers, outs):
+            if isinstance(out, BaseException):
+                log.debug("session-dict %s to %s failed: %r",
+                          method, ep, out)
+                continue
+            body, _ = _read16(out, 0)
+            if body != miss:
+                return body
+        return miss
 
     async def exist(self, tenant_id: str,
                     client_ids: List[str]) -> List[bool]:

@@ -95,6 +95,52 @@ class TestAPI:
             "/unsub?tenant_id=DevOnly&client_id=dev9&topic_filter=a/%23")
         assert status == 200 and out["removed"]
 
+    async def test_sub_on_behalf_live_session(self, stack):
+        """A LIVE (transient) session gets the on-behalf subscription
+        through its own session object (≈ SessionDictService.sub): messages
+        flow to the connected client immediately, and /inbox-state exposes
+        the live subscription set."""
+        broker, api, _ = stack
+        c = MQTTClient(port=broker.port, client_id="live1")
+        await c.connect()
+        status, out = await http(
+            api.port, "PUT",
+            "/sub?tenant_id=DevOnly&client_id=live1"
+            "&topic_filter=lv/%23&qos=1")
+        assert status == 200 and out["result"] == "ok" and out["live"]
+        # the live session now receives matching traffic
+        status, _ = await http(api.port, "PUT", "/pub?topic=lv/x&qos=1",
+                               b"to-live")
+        assert status == 200
+        msg = await c.recv()
+        assert msg.payload == b"to-live"
+        # duplicate sub with same qos reports exists
+        status, out = await http(
+            api.port, "PUT",
+            "/sub?tenant_id=DevOnly&client_id=live1"
+            "&topic_filter=lv/%23&qos=1")
+        assert status == 200 and out["result"] == "exists"
+        # inbox-state surfaces the subscription
+        status, state = await http(
+            api.port, "GET",
+            "/inbox-state?tenant_id=DevOnly&client_id=live1")
+        assert status == 200
+        assert state["subscriptions"]["lv/#"]["qos"] == 1
+        # unsub on behalf detaches it
+        status, out = await http(
+            api.port, "DELETE",
+            "/unsub?tenant_id=DevOnly&client_id=live1&topic_filter=lv/%23")
+        assert status == 200 and out["result"] == "ok" and out["live"]
+        status, _ = await http(
+            api.port, "DELETE",
+            "/unsub?tenant_id=DevOnly&client_id=live1&topic_filter=lv/%23")
+        assert status == 404
+        await c.disconnect()
+        status, _ = await http(
+            api.port, "GET",
+            "/inbox-state?tenant_id=DevOnly&client_id=live1")
+        assert status == 404
+
     async def test_session_expire_and_listing(self, stack):
         broker, api, _ = stack
         c = MQTTClient(port=broker.port, client_id="listme",

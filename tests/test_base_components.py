@@ -194,6 +194,60 @@ class TestSessionDict:
                 await s.stop()
 
 
+class TestSessionDictOnBehalf:
+    async def test_cross_node_sub_unsub_and_inbox_state(self):
+        """Sub/unsub/inboxState on behalf of a session hosted on ANOTHER
+        broker (≈ SessionDictService.proto:38-40): the dict fans the call
+        out and the hosting broker's live session applies it."""
+        from bifromq_tpu.rpc.fabric import RPCServer, ServiceRegistry
+        from bifromq_tpu.sessiondict import (SessionDictClient,
+                                             SessionDictRPCService)
+        from bifromq_tpu.sessiondict.service import SERVICE
+
+        reg = ServiceRegistry()
+        brokers, servers = [], []
+        for _ in range(2):
+            b = MQTTBroker(host="127.0.0.1", port=0)
+            await b.start()
+            srv = RPCServer()
+            SessionDictRPCService(b).register(srv)
+            await srv.start()
+            reg.announce(SERVICE, srv.address)
+            b.session_dict = SessionDictClient(reg,
+                                              self_address=srv.address)
+            brokers.append(b)
+            servers.append(srv)
+        try:
+            c = MQTTClient("127.0.0.1", brokers[0].port, client_id="ob",
+                           protocol_level=5)
+            await c.connect()
+            # call through broker B's dict — session lives on broker A
+            sd = brokers[1].session_dict
+            assert await sd.sub("DevOnly", "ob", "ob/+", 1) == "ok"
+            assert await sd.sub("DevOnly", "ob", "ob/+", 1) == "exists"
+            state = await sd.inbox_state("DevOnly", "ob")
+            assert state is not None
+            assert state["subscriptions"]["ob/+"]["qos"] == 1
+            # traffic published on broker A reaches the on-behalf sub
+            p = MQTTClient("127.0.0.1", brokers[0].port, client_id="obp")
+            await p.connect()
+            await p.publish("ob/x", b"cross", qos=1)
+            msg = await asyncio.wait_for(c.messages.get(), 5)
+            assert msg.payload == b"cross"
+            assert await sd.unsub("DevOnly", "ob", "ob/+") == "ok"
+            assert await sd.unsub("DevOnly", "ob", "ob/+") == "no_sub"
+            assert await sd.sub("DevOnly", "ghost", "g/+", 0) \
+                == "no_session"
+            assert await sd.inbox_state("DevOnly", "ghost") is None
+            await p.disconnect()
+            await c.disconnect()
+        finally:
+            for b in brokers:
+                await b.stop()
+            for s in servers:
+                await s.stop()
+
+
 class TestClientBalancer:
     async def test_redirect_on_connect(self):
         from bifromq_tpu.plugin.balancer import (IClientBalancer,
