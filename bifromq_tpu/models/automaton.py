@@ -23,7 +23,9 @@ Table layout (all int32, device-friendly):
     col 10 hash_rcount  (route_count of the '#' child, 0 if none — folded
            into the parent record so the walk's per-step '#'-accept counting
            needs NO extra gather; measured 37ms/batch on v5e, half the walk)
-    col 11 reserved
+    col 11 hash_rstart  (route_start of the '#' child — folded for the same
+           reason: the route-materializing walk emits the '#'-child's slot
+           interval (start, count) straight from the parent record)
 
   '$'-prefixed children sorting first makes both their child_list entries and
   their subtree slots contiguous prefixes, so the retained-mode walk can
@@ -71,6 +73,7 @@ NODE_SUB_RCOUNT = 7
 NODE_SYS_CCOUNT = 8
 NODE_SYS_SLOTS = 9
 NODE_HRCOUNT = 10
+NODE_HRSTART = 11
 NODE_COLS = 12
 
 _EMPTY = -1
@@ -134,6 +137,42 @@ class CompiledTrie:
 
     def root_of(self, tenant_id: str) -> int:
         return self.tenant_root.get(tenant_id, _EMPTY)
+
+    # ---- slot metadata for vectorized host expansion ----------------------
+    # (models/matcher.py expands device-emitted slot INTERVALS with one
+    # ragged-arange + fancy-index instead of a per-slot Python loop — the
+    # loop was the c4 92-filters/s failure mode, VERDICT r4 #2)
+
+    SLOT_NORMAL = 0
+    SLOT_PERSISTENT = 1
+    SLOT_GROUP = 2
+
+    @property
+    def slot_kind(self) -> np.ndarray:
+        """[S] int8: SLOT_NORMAL / SLOT_PERSISTENT / SLOT_GROUP per slot."""
+        sk = getattr(self, "_slot_kind", None)
+        if sk is None or len(sk) != len(self.matchings):
+            from .oracle import PERSISTENT_SUB_BROKER_ID
+            sk = np.fromiter(
+                (self.SLOT_GROUP if isinstance(m, GroupMatching)
+                 else (self.SLOT_PERSISTENT
+                       if m.broker_id == PERSISTENT_SUB_BROKER_ID
+                       else self.SLOT_NORMAL)
+                 for m in self.matchings),
+                dtype=np.int8, count=len(self.matchings))
+            object.__setattr__(self, "_slot_kind", sk)
+        return sk
+
+    @property
+    def matchings_arr(self) -> np.ndarray:
+        """[S] object ndarray of matchings (fancy-indexable by slot id)."""
+        ma = getattr(self, "_matchings_arr", None)
+        if ma is None or len(ma) != len(self.matchings):
+            ma = np.empty(len(self.matchings), dtype=object)
+            for i, m in enumerate(self.matchings):
+                ma[i] = m
+            object.__setattr__(self, "_matchings_arr", ma)
+        return ma
 
 
 def _node_matchings(node: _TrieNode) -> List[Matching]:
@@ -281,6 +320,8 @@ def _compile_once(tries: Dict[str, SubscriptionTrie], *, max_levels: int,
         hc = node_tab[:n, NODE_HASH]
         node_tab[:n, NODE_HRCOUNT] = np.where(
             hc >= 0, node_tab[hc.clip(0), NODE_RCOUNT], 0)
+        node_tab[:n, NODE_HRSTART] = np.where(
+            hc >= 0, node_tab[hc.clip(0), NODE_RSTART], 0)
 
     # --- pass 2: build the open-addressing edge table ----------------------
     edge_tab = _build_edge_table(edges, probe_len, min_cap=min_edge_cap)

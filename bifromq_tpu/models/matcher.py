@@ -74,10 +74,12 @@ class TpuMatcher:
     def __init__(self, *, max_levels: int = 16, k_states: int = 32,
                  probe_len: int = 16, device=None,
                  auto_compact: bool = True,
-                 compact_threshold: int = 2048) -> None:
+                 compact_threshold: int = 2048,
+                 max_intervals: int = 32) -> None:
         self.max_levels = max_levels
         self.k_states = k_states
         self.probe_len = probe_len
+        self.max_intervals = max_intervals
         self.device = device
         self.auto_compact = auto_compact
         self.compact_threshold = compact_threshold
@@ -107,7 +109,8 @@ class TpuMatcher:
         return TpuMatcher(max_levels=self.max_levels, k_states=self.k_states,
                           probe_len=self.probe_len, device=self.device,
                           auto_compact=self.auto_compact,
-                          compact_threshold=self.compact_threshold)
+                          compact_threshold=self.compact_threshold,
+                          max_intervals=self.max_intervals)
 
     # ---------------- mutation side (≈ batchAddRoute/batchRemoveRoute) -----
 
@@ -276,8 +279,14 @@ class TpuMatcher:
 
         Exact at every instant: base walk ⊕ overlay ⊖ tombstones equals a
         match against the authoritative tries.
+
+        The device emits matched-slot INTERVALS (ops.match.walk_routes, the
+        compressed MatchedRoutes form) with overflow escalation fused into
+        the same jit call; the host expands all rows with one vectorized
+        ragged-arange (ops.match.expand_intervals) — never a per-slot
+        Python loop (the c4 92-filters/s failure mode, VERDICT r4 #2).
         """
-        from ..ops.match import Probes, walk
+        from ..ops.match import Probes, expand_intervals, walk_routes
 
         if not queries:
             return []
@@ -291,39 +300,12 @@ class TpuMatcher:
         tok = tokenize([levels for _, levels in queries], roots,
                        max_levels=ct.max_levels, salt=ct.salt, batch=batch)
         probes = Probes.from_tokenized(tok, device=self.device)
-        res = walk(self._device_trie, probes, probe_len=ct.probe_len,
-                   k_states=self.k_states)
-        hash_acc = np.asarray(res.hash_acc)
-        final_acc = np.asarray(res.final_acc)
+        res = walk_routes(self._device_trie, probes, probe_len=ct.probe_len,
+                          k_states=self.k_states,
+                          max_intervals=self.max_intervals,
+                          esc_k=min(4 * self.k_states, 128))
         overflow = np.asarray(res.overflow)
-
-        # device-side escalation: rows whose active set overflowed k_states
-        # re-walk in one compacted sub-batch at a higher state budget — the
-        # device walk is orders of magnitude faster than the host-trie
-        # fallback (~360 topics/s measured), so only rows that overflow
-        # even esc_k fall through to the oracle below.
-        esc_nodes = {}
-        esc_k = min(4 * self.k_states, 128)
-        ovf_rows = np.nonzero(overflow[:len(queries)]
-                              & (tok.lengths[:len(queries)] >= 0))[0]
-        if len(ovf_rows) and esc_k > self.k_states:
-            eb = _pow2_batch(len(ovf_rows))
-            sub = Probes.from_tokenized(TokenizedTopics(
-                tok_h1=_pad_rows(tok.tok_h1[ovf_rows], eb),
-                tok_h2=_pad_rows(tok.tok_h2[ovf_rows], eb),
-                lengths=_pad_rows(tok.lengths[ovf_rows], eb, fill=-1),
-                roots=_pad_rows(tok.roots[ovf_rows], eb, fill=-1),
-                sys_mask=_pad_rows(tok.sys_mask[ovf_rows], eb),
-            ), device=self.device)
-            res2 = walk(self._device_trie, sub, probe_len=ct.probe_len,
-                        k_states=esc_k)
-            h2 = np.asarray(res2.hash_acc)
-            f2 = np.asarray(res2.final_acc)
-            o2 = np.asarray(res2.overflow)
-            for j, qi in enumerate(ovf_rows):
-                if not o2[j]:
-                    nn = np.concatenate([h2[j].ravel(), f2[j]])
-                    esc_nodes[int(qi)] = nn[nn >= 0]
+        slots, offs = expand_intervals(res.start, res.count)
         out: List[MatchedRoutes] = []
         for qi, (tenant_id, levels) in enumerate(queries):
             tomb = self._tomb.get(tenant_id)
@@ -339,34 +321,70 @@ class TpuMatcher:
                 else:
                     out.append(MatchedRoutes())
                 continue
-            needs_fallback = ((overflow[qi] and qi not in esc_nodes)
-                              or tok.lengths[qi] < 0)
-            if needs_fallback:
+            if overflow[qi] or tok.lengths[qi] < 0:
+                # even the fused device escalation overflowed (or the topic
+                # is too deep for the walk shape): host oracle re-match
                 trie = self.tries.get(tenant_id)
                 out.append(trie.match(
                     list(levels), max_persistent_fanout=max_persistent_fanout,
                     max_group_fanout=max_group_fanout)
                     if trie is not None else MatchedRoutes())
                 continue
-            if qi in esc_nodes:
-                nodes = esc_nodes[qi]
-            else:
-                nodes = np.concatenate([hash_acc[qi].ravel(),
-                                        final_acc[qi]])
-                nodes = nodes[nodes >= 0]
+            row = slots[offs[qi]:offs[qi + 1]]
             if not tomb and delta is None:
                 # fast path: no overlay for this tenant
-                out.append(self._expand(ct, nodes, max_persistent_fanout,
-                                        max_group_fanout))
+                out.append(self._routes_from_slots(
+                    ct, row, max_persistent_fanout, max_group_fanout))
                 continue
             out.append(self._expand_with_overlay(
-                ct, nodes, tomb or (), delta, list(levels),
-                max_persistent_fanout, max_group_fanout))
+                ct, row, tomb or (), delta, list(levels),
+                max_persistent_fanout, max_group_fanout,
+                nodes_are_slots=True))
         return out
 
     def match(self, tenant_id: str, topic: str, **kwargs) -> MatchedRoutes:
         return self.match_batch([(tenant_id, topic_util.parse(topic))],
                                 **kwargs)[0]
+
+    @staticmethod
+    def _routes_from_slots(ct: CompiledTrie, row: np.ndarray,
+                           max_persistent_fanout: int,
+                           max_group_fanout: int) -> MatchedRoutes:
+        """Slot ids → MatchedRoutes, caps applied vectorized.
+
+        Same cap semantics as _expand (MatchedRoutes.java:38 rules) but all
+        per-slot work is numpy: kind masks + cumsum ranks instead of a
+        Python loop over slots. Group filters are unique per topic (one
+        GroupMatching slot per (node, filter)), so a rank cutoff equals the
+        reference's distinct-filter cap.
+        """
+        out = MatchedRoutes()
+        if row.size == 0:
+            return out
+        kinds = ct.slot_kind[row]
+        pers_mask = kinds == CompiledTrie.SLOT_PERSISTENT
+        if (max_persistent_fanout != UNCAPPED_FANOUT
+                and int(pers_mask.sum()) > max_persistent_fanout):
+            out.max_persistent_fanout_exceeded = True
+            drop = pers_mask & (np.cumsum(pers_mask)
+                                > max_persistent_fanout)
+            row, kinds, pers_mask = (row[~drop], kinds[~drop],
+                                     pers_mask[~drop])
+        out.persistent_fanout = int(pers_mask.sum())
+        grp_mask = kinds == CompiledTrie.SLOT_GROUP
+        arr = ct.matchings_arr
+        if grp_mask.any():
+            grp_slots = row[grp_mask]
+            if (max_group_fanout != UNCAPPED_FANOUT
+                    and grp_slots.size > max_group_fanout):
+                out.max_group_fanout_exceeded = True
+                grp_slots = grp_slots[:max_group_fanout]
+            for m in arr[grp_slots]:
+                out.groups[m.mqtt_topic_filter] = list(m.members)
+            out.normal = arr[row[~grp_mask]].tolist()
+        else:
+            out.normal = arr[row].tolist()
+        return out
 
     @staticmethod
     def _expand(ct: CompiledTrie, nodes: np.ndarray,
@@ -399,25 +417,34 @@ class TpuMatcher:
                              tomb, delta: Optional[SubscriptionTrie],
                              levels: List[str],
                              max_persistent_fanout: int,
-                             max_group_fanout: int) -> MatchedRoutes:
-        """Base expansion ⊖ tombstones ⊕ delta matches, then caps."""
+                             max_group_fanout: int, *,
+                             nodes_are_slots: bool = False) -> MatchedRoutes:
+        """Base expansion ⊖ tombstones ⊕ delta matches, then caps.
+
+        ``nodes`` are accepting node ids by default (mesh path); the
+        interval path passes slot ids directly (``nodes_are_slots=True``).
+        """
         normal: List[Route] = []
         groups: Dict[str, List[Route]] = {}
         node_tab = ct.node_tab
-        for n in nodes:
-            start = int(node_tab[n, NODE_RSTART])
-            count = int(node_tab[n, NODE_RCOUNT])
-            for slot in range(start, start + count):
-                m: Matching = ct.matchings[slot]
-                if isinstance(m, GroupMatching):
-                    members = [r for r in m.members
-                               if (m.mqtt_topic_filter, r.receiver_url)
-                               not in tomb]
-                    if members:
-                        groups[m.mqtt_topic_filter] = members
-                else:
-                    if (m.matcher.mqtt_topic_filter, m.receiver_url) not in tomb:
-                        normal.append(m)
+        if nodes_are_slots:
+            slot_iter = [int(s) for s in nodes]
+        else:
+            slot_iter = [s for n in nodes
+                         for s in range(int(node_tab[n, NODE_RSTART]),
+                                        int(node_tab[n, NODE_RSTART])
+                                        + int(node_tab[n, NODE_RCOUNT]))]
+        for slot in slot_iter:
+            m: Matching = ct.matchings[slot]
+            if isinstance(m, GroupMatching):
+                members = [r for r in m.members
+                           if (m.mqtt_topic_filter, r.receiver_url)
+                           not in tomb]
+                if members:
+                    groups[m.mqtt_topic_filter] = members
+            else:
+                if (m.matcher.mqtt_topic_filter, m.receiver_url) not in tomb:
+                    normal.append(m)
         if delta is not None:
             dm = delta.match(levels)
             normal.extend(dm.normal)

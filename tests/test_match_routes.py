@@ -1,0 +1,163 @@
+"""Route-materializing interval walk (ops.match.walk_routes) parity tests.
+
+The device emits per-topic matched-slot INTERVALS (compressed MatchedRoutes,
+reference .../worker/cache/MatchedRoutes.java:38); expand_intervals turns
+them into slot ids with one vectorized ragged-arange. Parity target: the
+expanded slot multiset must equal the oracle trie's match set exactly.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from bifromq_tpu import workloads
+from bifromq_tpu.models import automaton as am
+from bifromq_tpu.models.automaton import GroupMatching
+from bifromq_tpu.models.oracle import SubscriptionTrie
+from bifromq_tpu.ops.match import (
+    DeviceTrie, Probes, expand_intervals, walk_routes,
+)
+from tests.test_automaton import mk_route, route_key
+
+
+def _slot_keys(ct, slots):
+    """Slot ids -> sorted matching keys (normal route keys + group filters)."""
+    normal, groups = [], []
+    for s in slots:
+        m = ct.matchings[int(s)]
+        if isinstance(m, GroupMatching):
+            groups.append(m.mqtt_topic_filter)
+        else:
+            normal.append(route_key(m))
+    return sorted(normal), sorted(groups)
+
+
+def _oracle_keys(trie, levels):
+    want = trie.match(list(levels))
+    return (sorted(route_key(r) for r in want.normal),
+            sorted(want.groups.keys()))
+
+
+class TestWalkRoutesParity:
+    @pytest.mark.parametrize("seed", [0, 3, 9])
+    def test_interval_parity_vs_oracle(self, seed):
+        rng = random.Random(seed)
+        names, weights = workloads._zipf_levels(30)
+        trie = SubscriptionTrie()
+        for i in range(300):
+            levels = workloads.gen_filter_levels(rng, names, weights,
+                                                 max_depth=4)
+            tf = "/".join(levels)
+            if rng.random() < 0.15:
+                tf = f"$share/g{rng.randint(0, 2)}/{tf}"
+            trie.add(mk_route(tf, receiver=f"r{i}"))
+        ct = am.compile_tries({"T": trie}, max_levels=8)
+        dev = DeviceTrie.from_compiled(ct)
+        n = 64
+        topics = [workloads.gen_topic_levels(rng, names, weights, max_depth=4)
+                  for _ in range(n)]
+        tok = am.tokenize(topics, [ct.root_of("T")] * n,
+                          max_levels=8, salt=ct.salt)
+        res = walk_routes(dev, Probes.from_tokenized(tok),
+                          probe_len=ct.probe_len, k_states=16)
+        starts = np.asarray(res.start)
+        counts = np.asarray(res.count)
+        n_routes = np.asarray(res.n_routes)
+        overflow = np.asarray(res.overflow)
+        slots, offs = expand_intervals(starts, counts)
+        for qi, levels in enumerate(topics):
+            if overflow[qi]:
+                continue
+            row = slots[offs[qi]:offs[qi + 1]]
+            assert len(row) == n_routes[qi]
+            assert _slot_keys(ct, row) == _oracle_keys(trie, levels), (
+                qi, levels)
+
+    def test_multi_tenant_and_sys(self):
+        t1, t2 = SubscriptionTrie(), SubscriptionTrie()
+        for tf in ["a/b", "a/+", "a/#", "#", "+/b", "$SYS/health", "$SYS/#"]:
+            t1.add(mk_route(tf, receiver="A:" + tf))
+        for tf in ["a/b", "c/#"]:
+            t2.add(mk_route(tf, receiver="B:" + tf))
+        ct = am.compile_tries({"T1": t1, "T2": t2}, max_levels=8)
+        dev = DeviceTrie.from_compiled(ct)
+        queries = [("T1", ["a", "b"]), ("T1", ["$SYS", "health"]),
+                   ("T1", ["a"]), ("T2", ["a", "b"]), ("T2", ["c", "x"]),
+                   ("T1", ["x"])]
+        tok = am.tokenize([q[1] for q in queries],
+                          [ct.root_of(q[0]) for q in queries],
+                          max_levels=8, salt=ct.salt, batch=16)
+        res = walk_routes(dev, Probes.from_tokenized(tok),
+                          probe_len=ct.probe_len, k_states=8)
+        slots, offs = expand_intervals(np.asarray(res.start),
+                                       np.asarray(res.count))
+        tries = {"T1": t1, "T2": t2}
+        for qi, (tenant, levels) in enumerate(queries):
+            assert not np.asarray(res.overflow)[qi]
+            row = slots[offs[qi]:offs[qi + 1]]
+            assert _slot_keys(ct, row) == _oracle_keys(tries[tenant],
+                                                       levels), (tenant,
+                                                                 levels)
+
+    def test_interval_overflow_escalates_on_device(self):
+        """A filter-dense node set that exceeds max_intervals=2 in the
+        primary pass must recover via the fused escalation pass (which runs
+        at a higher state budget but the same interval budget — rows whose
+        interval count exceeds it either way stay flagged)."""
+        trie = SubscriptionTrie()
+        # 6 distinct matching filters for topic a/b/c -> 6 intervals
+        for tf in ["a/b/c", "a/b/+", "a/+/c", "+/b/c", "a/#", "#"]:
+            trie.add(mk_route(tf, receiver=tf))
+        ct = am.compile_tries({"T": trie}, max_levels=8)
+        dev = DeviceTrie.from_compiled(ct)
+        tok = am.tokenize([["a", "b", "c"]], [ct.root_of("T")],
+                          max_levels=8, salt=ct.salt, batch=16)
+        res = walk_routes(dev, Probes.from_tokenized(tok),
+                          probe_len=ct.probe_len, k_states=16,
+                          max_intervals=2)
+        # 6 intervals never fit in 2 lanes: row must be flagged, not wrong
+        assert bool(np.asarray(res.overflow)[0])
+        res2 = walk_routes(dev, Probes.from_tokenized(tok),
+                           probe_len=ct.probe_len, k_states=16,
+                           max_intervals=8)
+        assert not bool(np.asarray(res2.overflow)[0])
+        slots, offs = expand_intervals(np.asarray(res2.start),
+                                       np.asarray(res2.count))
+        assert _slot_keys(ct, slots[offs[0]:offs[1]]) == _oracle_keys(
+            trie, ["a", "b", "c"])
+
+    def test_state_overflow_escalation_recovers(self):
+        """Rows that overflow k_states=2 escalate on device and still emit
+        correct intervals (mirrors TestOverflowEscalation for counts)."""
+        trie = SubscriptionTrie()
+        for i in range(6):
+            parts = ["+" if (i >> b) & 1 else "x" for b in range(3)]
+            trie.add(mk_route("/".join(parts), receiver=f"r{i}"))
+        ct = am.compile_tries({"T": trie}, max_levels=8)
+        dev = DeviceTrie.from_compiled(ct)
+        tok = am.tokenize([["x", "x", "x"]], [ct.root_of("T")],
+                          max_levels=8, salt=ct.salt, batch=16)
+        res = walk_routes(dev, Probes.from_tokenized(tok),
+                          probe_len=ct.probe_len, k_states=2,
+                          max_intervals=16, esc_k=8)
+        assert not bool(np.asarray(res.overflow)[0])
+        slots, offs = expand_intervals(np.asarray(res.start),
+                                       np.asarray(res.count))
+        assert _slot_keys(ct, slots[offs[0]:offs[1]]) == _oracle_keys(
+            trie, ["x", "x", "x"])
+
+
+class TestExpandIntervals:
+    def test_ragged_arange(self):
+        s = np.array([[5, 100, 0], [0, 0, 0], [7, 0, 0]], np.int32)
+        c = np.array([[2, 3, 0], [0, 0, 0], [1, 0, 0]], np.int32)
+        slots, offs = expand_intervals(s, c)
+        assert slots.tolist() == [5, 6, 100, 101, 102, 7]
+        assert offs.tolist() == [0, 5, 5, 6]
+
+    def test_empty(self):
+        slots, offs = expand_intervals(np.zeros((2, 4), np.int32),
+                                       np.zeros((2, 4), np.int32))
+        assert slots.size == 0
+        assert offs.tolist() == [0, 0, 0]
