@@ -269,13 +269,15 @@ def _measure_routes(tries, probe_fn, *, name, compiled,
     (conservative: real serving overlaps the multicore C++ tokenizer with
     device compute).
     """
-    from bifromq_tpu.models.automaton import tokenize
+    from bifromq_tpu.models.automaton import TokenCache, tokenize
     from bifromq_tpu.ops.match import (Probes, expand_intervals,
                                        walk_routes)
     k_states = k_states or K_STATES
     iters = iters or ITERS
     batch = batch or BATCH
     max_intervals = max_intervals or INTERVALS
+    tok_cache = (TokenCache()
+                 if os.environ.get("BENCH_TOK_CACHE", "1") != "0" else None)
 
     ct, dev, compile_s = compiled
     n_batches = 4
@@ -283,10 +285,11 @@ def _measure_routes(tries, probe_fn, *, name, compiled,
     t2 = time.time()
     toks = [tokenize([q[0] for q in queries],
                      [ct.root_of(q[1]) for q in queries],
-                     max_levels=ct.max_levels, salt=ct.salt, batch=batch)
+                     max_levels=ct.max_levels, salt=ct.salt, batch=batch,
+                     cache=tok_cache)
             for queries in all_queries]
     t3 = time.time()
-    tok_rate = batch * n_batches / (t3 - t2)
+    tok_rate = batch * n_batches / (t3 - t2)  # COLD (first-touch) rate
     probe_sets = [Probes.from_tokenized(t) for t in toks]
     for p in probe_sets:
         for a in (p.tok_h1, p.tok_h2, p.lengths, p.roots, p.sys_mask):
@@ -362,7 +365,8 @@ def _measure_routes(tries, probe_fn, *, name, compiled,
         s0 = time.perf_counter()
         tk = tokenize([q[0] for q in queries],
                       [ct.root_of(q[1]) for q in queries],
-                      max_levels=ct.max_levels, salt=ct.salt, batch=batch)
+                      max_levels=ct.max_levels, salt=ct.salt, batch=batch,
+                      cache=tok_cache)
         s1 = time.perf_counter()
         p = Probes.from_tokenized(tk)
         np.asarray(p.tok_h1[:1])
@@ -389,6 +393,11 @@ def _measure_routes(tries, probe_fn, *, name, compiled,
         "oracle_fallback_topics_per_s": (round(oracle_rate, 1)
                                          if oracle_rate else None),
         "host_tokenize_topics_per_s": round(tok_rate, 1),
+        "host_tokenize_warm_topics_per_s": round(
+            batch / (float(np.percentile(phases["tok_ms"], 50)) / 1e3), 1),
+        "tok_cache_hit_rate": (round(tok_cache.hits / max(
+            1, tok_cache.hits + tok_cache.misses), 3)
+            if tok_cache is not None else None),
         "e2e_p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
         "e2e_p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
         "phase_ms_p50": {k: round(float(np.percentile(v, 50)), 2)

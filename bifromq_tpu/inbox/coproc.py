@@ -33,6 +33,7 @@ _OP_UNSUB = 3
 _OP_INSERT = 4
 _OP_COMMIT = 5
 _OP_DELETE = 6
+_OP_CLEAR_LWT = 7
 
 _len16 = schema._len16
 _read16 = schema._read_len16
@@ -215,6 +216,8 @@ class InboxStoreCoProc(IKVRangeCoProc):
             keep_lwt = buf[pos] == 1
             meta = store.detach(tenant, inbox, keep_lwt=keep_lwt)
             return b"\x01" if meta is not None else b"\x00"
+        if op == _OP_CLEAR_LWT:
+            return b"\x01" if store.clear_lwt(tenant, inbox) else b"\x00"
         if op == _OP_SUB:
             tf_b, pos = _read16(buf, pos)
             opt, pos = _dec_opt(buf, pos)
@@ -423,6 +426,10 @@ class ReplicatedInboxStore:
 
     async def delete(self, tenant, inbox) -> bool:
         out = _envelope(_OP_DELETE, self.clock(), tenant, inbox)
+        return (await self._mutate(out)) == b"\x01"
+
+    async def clear_lwt(self, tenant, inbox) -> bool:
+        out = _envelope(_OP_CLEAR_LWT, self.clock(), tenant, inbox)
         return (await self._mutate(out)) == b"\x01"
 
 

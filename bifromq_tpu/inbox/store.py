@@ -194,6 +194,15 @@ class InboxStore:
         self._store(tenant_id, meta)
         return meta
 
+    def clear_lwt(self, tenant_id: str, inbox_id: str) -> bool:
+        """Drop the stored LWT after it fired at its delay deadline (the
+        inbox itself lives on until session expiry)."""
+        meta = self._load(tenant_id, inbox_id)
+        if meta is None or meta.lwt is None:
+            return False
+        self._store(tenant_id, replace(meta, lwt=None))
+        return True
+
     def delete(self, tenant_id: str, inbox_id: str) -> bool:
         prefix = schema.inbox_prefix(tenant_id, inbox_id)
         existed = self._load(tenant_id, inbox_id) is not None

@@ -394,10 +394,56 @@ class TokenizedTopics:
         return self.tok_h1.shape[0]
 
 
+class TokenCache:
+    """Per-topic token-row LRU (VERDICT r4 #7 — the reference's whole
+    TenantRouteCache bet is that topics repeat).
+
+    Keyed by the raw topic (string or level tuple); rows depend only on
+    (topic, salt, max_levels), so the cache SURVIVES trie recompiles —
+    only a salt change (hash-collision recompile, astronomically rare)
+    clears it. Roots are per-batch and never cached.
+    """
+
+    def __init__(self, max_entries: int = 1 << 18) -> None:
+        self.max_entries = max_entries
+        self._salt: Optional[int] = None
+        self._width: Optional[int] = None
+        # value: (h1_row [L+1] int32, h2_row, length, sys) — numpy rows
+        self._d: "dict" = {}
+        self.hits = 0
+        self.misses = 0
+
+    def match_config(self, salt: int, width: int) -> None:
+        if self._salt != salt or self._width != width:
+            self._d.clear()
+            self._salt, self._width = salt, width
+
+    def get(self, key):
+        v = self._d.get(key)
+        if v is not None:
+            self.hits += 1
+            # true LRU: refresh recency so the eviction sweep (insertion-
+            # ordered) drops cold keys, not the hottest ones
+            del self._d[key]
+            self._d[key] = v
+        else:
+            self.misses += 1
+        return v
+
+    def put(self, key, value) -> None:
+        if len(self._d) >= self.max_entries:
+            # amortized sweep: drop the oldest half (insertion order)
+            drop = len(self._d) // 2
+            for k in list(self._d)[:drop]:
+                del self._d[k]
+        self._d[key] = value
+
+
 def tokenize(topics: Sequence[Sequence[str]], roots: Sequence[int],
              *, max_levels: int, salt: int,
              batch: Optional[int] = None,
-             native: bool = True) -> TokenizedTopics:
+             native: bool = True,
+             cache: Optional[TokenCache] = None) -> TokenizedTopics:
     """Hash topic levels into a padded probe batch.
 
     ``topics`` are pre-parsed level lists (utils.topic.parse) or raw topic
@@ -407,8 +453,47 @@ def tokenize(topics: Sequence[Sequence[str]], roots: Sequence[int],
     host fallback.
 
     Uses the native (C++) tokenizer when available — the Python loop below
-    is the semantics reference and fallback.
+    is the semantics reference and fallback. With ``cache``, repeated
+    topics skip hashing entirely (row-level memo).
     """
+    if cache is not None:
+        n = len(topics)
+        b = batch or n
+        width = max_levels + 1
+        cache.match_config(salt, width)
+        keys = [t if isinstance(t, str) else tuple(t) for t in topics]
+        miss_idx = []
+        miss_topics = []
+        vals = []
+        for i, k in enumerate(keys):
+            v = cache.get(k)
+            vals.append(v)
+            if v is None:
+                miss_idx.append(i)
+                miss_topics.append(topics[i])
+        if miss_idx:
+            sub = tokenize(miss_topics, [0] * len(miss_topics),
+                           max_levels=max_levels, salt=salt,
+                           native=native)
+            for j, i in enumerate(miss_idx):
+                v = (sub.tok_h1[j].copy(), sub.tok_h2[j].copy(),
+                     int(sub.lengths[j]), bool(sub.sys_mask[j]))
+                cache.put(keys[i], v)
+                vals[i] = v
+        tok_h1 = np.zeros((b, width), dtype=np.int32)
+        tok_h2 = np.zeros((b, width), dtype=np.int32)
+        lengths = np.full(b, _EMPTY, dtype=np.int32)
+        rootv = np.full(b, _EMPTY, dtype=np.int32)
+        sys_mask = np.zeros(b, dtype=bool)
+        for i, (h1, h2, ln, sm) in enumerate(vals):
+            tok_h1[i] = h1
+            tok_h2[i] = h2
+            lengths[i] = ln
+            rootv[i] = roots[i] if ln >= 0 else _EMPTY
+            sys_mask[i] = sm
+        return TokenizedTopics(tok_h1=tok_h1, tok_h2=tok_h2,
+                               lengths=lengths, roots=rootv,
+                               sys_mask=sys_mask)
     if native:
         try:
             from .native_tok import tokenize_topics_native

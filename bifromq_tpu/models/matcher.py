@@ -93,6 +93,10 @@ class TpuMatcher:
         self._delta: Dict[str, SubscriptionTrie] = {}
         self._tomb: Dict[str, Set[_TombKey]] = {}
         self._overlay_n = 0
+        # per-topic token-row cache (topics repeat — the reference's
+        # TenantRouteCache bet); survives recompiles, cleared on salt change
+        from .automaton import TokenCache
+        self._tok_cache = TokenCache()
         # mutation log since the shadow copy last synced; shadow is the
         # frozen snapshot source for off-thread compiles
         self._log: List[Tuple] = []
@@ -188,7 +192,29 @@ class TpuMatcher:
                            probe_len=self.probe_len)
         from ..ops.match import DeviceTrie  # deferred: keeps jax optional
         dev = DeviceTrie.from_compiled(ct, device=self.device)
+        self._warm_walk(ct, dev)
         return ct, dev
+
+    def _warm_walk(self, ct: CompiledTrie, dev) -> None:
+        """Pre-compile the serving walk for this table's shapes at the
+        smallest serving batch (16, the _pow2_batch floor).
+
+        XLA re-compiles whenever the table SHAPES change, and an
+        uncompiled walk on the serving path delays the first match by
+        seconds — enough to expire a short-MESSAGE_EXPIRY will that fired
+        right before it. Warming here (mutation-triggered background
+        compile path) keeps the publish path jit-warm."""
+        try:
+            from ..ops.match import Probes, walk_routes
+            tok = tokenize([["warm"]], [-1], max_levels=ct.max_levels,
+                           salt=ct.salt, batch=16)
+            res = walk_routes(dev, Probes.from_tokenized(
+                tok, device=self.device), probe_len=ct.probe_len,
+                k_states=self.k_states,
+                max_intervals=self.max_intervals, esc_k=0)
+            np.asarray(res.overflow)
+        except Exception:  # noqa: BLE001 — warm-up is best-effort
+            pass
 
     def refresh(self) -> CompiledTrie:
         """Blocking compaction: fold every pending mutation into a fresh base.
@@ -213,11 +239,18 @@ class TpuMatcher:
         for op in self._log:
             self._overlay_record(op)
 
-    def _maybe_compact(self) -> None:
-        if (not self.auto_compact
-                or self._overlay_n < self.compact_threshold
-                or self._base_ct is None
-                or self._compact_thread is not None):
+    def _maybe_compact(self, force: bool = False) -> None:
+        # trigger on the FIRST mutation too (base is None): the first base
+        # builds in the background so the first publish finds trie tables
+        # AND the walk jit already warm, instead of paying both compiles
+        # inline (the reference's refresh-on-mutation contract,
+        # TenantRouteCache.java:100). ``force`` recompiles regardless of
+        # overlay size (shard re-placement: new pins need a new build).
+        if (self._compact_thread is not None
+                or (not force
+                    and (not self.auto_compact
+                         or (self._base_ct is not None
+                             and self._overlay_n < self.compact_threshold)))):
             self._apply_pending_swap()
             return
         # snapshot: fold the log into the shadow NOW (serving thread, cheap —
@@ -298,14 +331,50 @@ class TpuMatcher:
             batch = _pow2_batch(len(queries))
         roots = [ct.root_of(t) for t, _ in queries]
         tok = tokenize([levels for _, levels in queries], roots,
-                       max_levels=ct.max_levels, salt=ct.salt, batch=batch)
+                       max_levels=ct.max_levels, salt=ct.salt, batch=batch,
+                       cache=self._tok_cache)
         probes = Probes.from_tokenized(tok, device=self.device)
+        # esc_k=0: escalation stays a SEPARATE lazily-compiled dispatch
+        # below — fusing it into this jit (like the bench does) would
+        # compile the high-K escalation walk on the first serving query,
+        # doubling cold-start latency for a pass that almost never runs
         res = walk_routes(self._device_trie, probes, probe_len=ct.probe_len,
                           k_states=self.k_states,
-                          max_intervals=self.max_intervals,
-                          esc_k=min(4 * self.k_states, 128))
-        overflow = np.asarray(res.overflow)
-        slots, offs = expand_intervals(res.start, res.count)
+                          max_intervals=self.max_intervals, esc_k=0)
+        # writable copies: escalation patches rescued rows in place (a
+        # bare asarray view of a jax buffer is read-only)
+        overflow = np.array(res.overflow)
+        starts_a = np.array(res.start)
+        counts_a = np.array(res.count)
+
+        # host-triggered escalation: rows whose active set (or interval
+        # budget) overflowed re-walk in one compacted sub-batch at a higher
+        # state budget — only rows that overflow even esc_k fall through
+        # to the host oracle
+        esc_k = min(4 * self.k_states, 128)
+        ovf_rows = np.nonzero(overflow[:len(queries)]
+                              & (tok.lengths[:len(queries)] >= 0))[0]
+        if len(ovf_rows) and esc_k > self.k_states:
+            eb = _pow2_batch(len(ovf_rows))
+            sub = Probes.from_tokenized(TokenizedTopics(
+                tok_h1=_pad_rows(tok.tok_h1[ovf_rows], eb),
+                tok_h2=_pad_rows(tok.tok_h2[ovf_rows], eb),
+                lengths=_pad_rows(tok.lengths[ovf_rows], eb, fill=-1),
+                roots=_pad_rows(tok.roots[ovf_rows], eb, fill=-1),
+                sys_mask=_pad_rows(tok.sys_mask[ovf_rows], eb),
+            ), device=self.device)
+            res2 = walk_routes(self._device_trie, sub,
+                               probe_len=ct.probe_len, k_states=esc_k,
+                               max_intervals=self.max_intervals, esc_k=0)
+            o2 = np.asarray(res2.overflow)
+            s2 = np.asarray(res2.start)
+            c2 = np.asarray(res2.count)
+            ok = ~o2[:len(ovf_rows)]
+            fixed = ovf_rows[ok]
+            starts_a[fixed] = s2[:len(ovf_rows)][ok]
+            counts_a[fixed] = c2[:len(ovf_rows)][ok]
+            overflow[fixed] = False
+        slots, offs = expand_intervals(starts_a, counts_a)
         out: List[MatchedRoutes] = []
         for qi, (tenant_id, levels) in enumerate(queries):
             tomb = self._tomb.get(tenant_id)

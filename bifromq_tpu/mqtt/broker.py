@@ -784,8 +784,13 @@ class MQTTBroker:
                                 5.0)
                         except asyncio.TimeoutError:
                             pass
-                    session._will_suppressed = True  # a move ≠ a death
-                    await session.close(fire_will=False)
+                    # per MQTT5 only a client DISCONNECT 0x00 removes the
+                    # will, and the reference's onRedirect farewell keeps
+                    # the LWT — close via normal teardown so the will
+                    # fires (or arms its delay) like any server-initiated
+                    # disconnect (ADVICE r3: a forced _will_suppressed
+                    # silently dropped transient wills on admin moves)
+                    await session.close(fire_will=True)
                 except asyncio.CancelledError:
                     raise
                 except Exception:  # noqa: BLE001
@@ -818,6 +823,9 @@ class MQTTBroker:
         # the delay window ends with the server: fire armed wills now
         # (unless the tenant suppresses shutdown LWTs), then cancel — a
         # task surviving stop() would fire into a stopped dist
+        await self.inbox.flush_pending_lwts(
+            lambda tenant: not TenantSettings.resolve(
+                self.settings, tenant)[Setting.NoLWTWhenServerShuttingDown])
         await self.session_registry.flush_pending_wills(
             lambda tenant: not TenantSettings.resolve(
                 self.settings, tenant)[Setting.NoLWTWhenServerShuttingDown])

@@ -105,12 +105,24 @@ class PersistentSession(Session):
             pass
         elif fire_will and self.will is not None \
                 and not self._will_suppressed:
-            # abnormal close: fire the will (or arm its MQTT5 delay — a
-            # reconnect inside the window suppresses it), then let the
-            # inbox expire without double-firing the LWT
-            await self._fire_or_schedule_will()
-            await self.inbox.detach(tenant, self.inbox_id,
-                                    fire_lwt_on_expiry=False)
+            from .session import will_delay_seconds
+            delay = min(will_delay_seconds(self.will, self.protocol_level),
+                        self._will_delay_cap())
+            if delay > 0:
+                # MQTT5 Will Delay, DURABLE: the inbox store already holds
+                # the LWT (attach carried it with delay_seconds) — let the
+                # inbox service fire it server-side at detached_at +
+                # min(delay, expiry). An in-memory timer here would lose
+                # the will if the broker crashed inside the window
+                # (ADVICE r3 finding 1; reference InboxStoreCoProc LWT)
+                await self.inbox.detach(tenant, self.inbox_id,
+                                        fire_lwt_on_expiry=True)
+            else:
+                # immediate fire, then let the inbox expire without
+                # double-firing the LWT
+                await self._fire_or_schedule_will()
+                await self.inbox.detach(tenant, self.inbox_id,
+                                        fire_lwt_on_expiry=False)
         elif self.expiry_seconds <= 0:
             # session expiry 0: state dies with the connection (v5 semantics)
             await self.inbox.delete(tenant, self.inbox_id)

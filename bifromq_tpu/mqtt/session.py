@@ -85,19 +85,30 @@ class SessionRegistry:
         key = (session.client_info.tenant_id, session.client_id)
         pending = self._pending_wills.pop(key, None)
         if pending is not None:
-            task, fire = pending
-            task.cancel()
-            if session.clean_start:
-                # a clean-start reconnect ENDS the old session — per
-                # [MQTT-3.1.3.2-2] the will fires at session end, it is
-                # not silently discarded (only a resuming reconnect
-                # suppresses it)
+            task, fire, state = pending
+            if state["firing"]:
+                # the delay expired concurrently and fire() is already in
+                # flight (e.g. past dist.pub, before retain/event):
+                # cancelling mid-fire and then re-firing would DOUBLE-
+                # publish — let the in-flight fire finish instead (the
+                # will belongs to the old session's end either way)
                 try:
-                    await fire()
-                except Exception:  # noqa: BLE001
-                    self._events.report(Event(
-                        EventType.WILL_DIST_ERROR, key[0],
-                        {"client_id": key[1]}))
+                    await asyncio.shield(task)
+                except Exception:  # noqa: BLE001 — run() reports its own
+                    pass
+            else:
+                task.cancel()
+                if session.clean_start:
+                    # a clean-start reconnect ENDS the old session — per
+                    # [MQTT-3.1.3.2-2] the will fires at session end, it is
+                    # not silently discarded (only a resuming reconnect
+                    # suppresses it)
+                    try:
+                        await fire()
+                    except Exception:  # noqa: BLE001
+                        self._events.report(Event(
+                            EventType.WILL_DIST_ERROR, key[0],
+                            {"client_id": key[1]}))
         prev = self._owners.get(key)
         self._owners[key] = session
         if prev is not None and prev is not session:
@@ -124,12 +135,17 @@ class SessionRegistry:
         is an async callable holding no Session reference."""
         key = (tenant_id, client_id)
         old = self._pending_wills.pop(key, None)
-        if old is not None:
+        if old is not None and not old[2]["firing"]:
             old[0].cancel()
+        state = {"firing": False}
 
         async def run():
             try:
                 await asyncio.sleep(delay_s)
+                # point of no return: from here a cancel() cannot prevent
+                # the publish — register()/flush await us instead of
+                # re-firing (the cancel-then-refire double-publish race)
+                state["firing"] = True
                 try:
                     await fire()
                 except Exception:  # noqa: BLE001 — a lost will must be
@@ -142,7 +158,7 @@ class SessionRegistry:
                     del self._pending_wills[key]
 
         task = asyncio.get_running_loop().create_task(run())
-        self._pending_wills[key] = (task, fire)
+        self._pending_wills[key] = (task, fire, state)
 
     async def flush_pending_wills(self, should_fire) -> None:
         """Broker shutdown: the delay window ends with the server — fire
@@ -150,12 +166,23 @@ class SessionRegistry:
         tenant suppresses shutdown LWTs (NoLWTWhenServerShuttingDown)."""
         pending = list(self._pending_wills.items())
         self._pending_wills.clear()
-        for (tenant_id, client_id), (task, fire) in pending:
+        for (tenant_id, client_id), (task, fire, state) in pending:
+            if state["firing"]:
+                # fire() already in flight: await it, never re-fire
+                try:
+                    await asyncio.shield(task)
+                except Exception:  # noqa: BLE001 — run() reports its own
+                    pass
+                continue
             task.cancel()
             try:
-                # a throwing settings plugin must not abort shutdown; the
-                # safe default is to fire (NoLWT… defaults to False)
-                fire_it = True
+                # a throwing settings plugin must not abort shutdown; fall
+                # back to the setting's CONFIGURED default
+                # (NoLWTWhenServerShuttingDown defaults to True — suppress;
+                # both here and in the reference, Setting.java) instead of
+                # inverting it
+                from ..plugin.settings import _DEFAULTS, Setting
+                fire_it = not _DEFAULTS[Setting.NoLWTWhenServerShuttingDown]
                 try:
                     fire_it = should_fire(tenant_id)
                 except Exception:  # noqa: BLE001
@@ -169,7 +196,7 @@ class SessionRegistry:
 
     def close(self) -> None:
         """Cancel every pending delayed will (broker shutdown)."""
-        for t, _fire in self._pending_wills.values():
+        for t, _fire, _state in self._pending_wills.values():
             t.cancel()
         self._pending_wills.clear()
 
@@ -1139,11 +1166,19 @@ class Session:
             # non-ASCII content can only make the estimate conservative —
             # a too-low estimate would skip the exact probe and let an
             # oversize packet through.
+            # per-property wire overhead: a user property costs an id byte
+            # plus TWO 2-byte length prefixes (5B/pair beyond the chars),
+            # string/bytes properties an id byte plus one prefix (3B) —
+            # count 8 per property so hundreds of tiny properties cannot
+            # erode the fixed margin below
             props_est = sum(
-                4 * (len(k) + len(v)) for k, v in (
+                8 + 4 * (len(k) + len(v)) for k, v in (
                     props.get(PropertyId.USER_PROPERTY) or ())) \
-                + 4 * (len(msg.content_type) + len(msg.response_topic)) \
-                + len(msg.correlation_data)
+                + (8 + 4 * len(msg.content_type) if msg.content_type else 0) \
+                + (8 + 4 * len(msg.response_topic)
+                   if msg.response_topic else 0) \
+                + (8 + len(msg.correlation_data)
+                   if msg.correlation_data else 0)
         if self._client_max_packet and (
                 len(msg.payload) + 4 * len(topic) + props_est + 512
                 >= self._client_max_packet):

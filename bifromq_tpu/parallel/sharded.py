@@ -45,7 +45,14 @@ def tenant_shard(tenant_id: str, n_shards: int) -> int:
 
 @dataclass
 class ShardedTables:
-    """Per-shard compiled automata padded/stacked for mesh placement."""
+    """Per-shard compiled automata padded/stacked for mesh placement.
+
+    ``pins`` is the tenant→shard OVERRIDE map this build was compiled
+    with (load-driven re-placement, SURVEY §2.8 placement row): routing
+    MUST consult the snapshot's own pins — a pin applied after this build
+    only takes effect when the recompiled tables swap in, so queries
+    always route to the shard that actually holds the tenant.
+    """
     node_tab: np.ndarray    # [S, N, NODE_COLS]
     edge_tab: np.ndarray    # [S, T, 4]
     child_list: np.ndarray  # [S, E]
@@ -53,8 +60,15 @@ class ShardedTables:
     n_shards: int
     probe_len: int
     max_levels: int
+    pins: Optional[Dict[str, int]] = None
 
     def shard_of(self, tenant_id: str) -> int:
+        if self.pins:
+            pin = self.pins.get(tenant_id)
+            # same range guard as build_sharded: an out-of-range pin fell
+            # back to hash placement at build time, so routing must too
+            if pin is not None and 0 <= pin < self.n_shards:
+                return pin
         return tenant_shard(tenant_id, self.n_shards)
 
     def root_of(self, tenant_id: str) -> int:
@@ -62,7 +76,8 @@ class ShardedTables:
 
 
 def build_sharded(tries: Dict[str, SubscriptionTrie], n_shards: int, *,
-                  max_levels: int = 16, probe_len: int = 16) -> ShardedTables:
+                  max_levels: int = 16, probe_len: int = 16,
+                  pins: Optional[Dict[str, int]] = None) -> ShardedTables:
     """Compile each tenant shard with a common edge-table capacity.
 
     All shards share one edge-table size (power of two) so the device-side
@@ -70,7 +85,10 @@ def build_sharded(tries: Dict[str, SubscriptionTrie], n_shards: int, *,
     """
     by_shard: List[Dict[str, SubscriptionTrie]] = [dict() for _ in range(n_shards)]
     for tenant_id, trie in tries.items():
-        by_shard[tenant_shard(tenant_id, n_shards)][tenant_id] = trie
+        sh = (pins or {}).get(tenant_id)
+        if sh is None or not (0 <= sh < n_shards):
+            sh = tenant_shard(tenant_id, n_shards)
+        by_shard[sh][tenant_id] = trie
 
     compiled = [compile_tries(s, max_levels=max_levels, probe_len=probe_len)
                 for s in by_shard]
@@ -102,7 +120,8 @@ def build_sharded(tries: Dict[str, SubscriptionTrie], n_shards: int, *,
     return ShardedTables(node_tab=node_tab, edge_tab=edge_tab,
                          child_list=child_list, compiled=compiled,
                          n_shards=n_shards, probe_len=probe_len,
-                         max_levels=max_levels)
+                         max_levels=max_levels,
+                         pins=dict(pins) if pins else None)
 
 
 def make_mesh(n_replicas: int, n_shards: int,
@@ -162,6 +181,64 @@ def make_match_step(mesh: Mesh, *, probe_len: int, k_states: int = 32):
     return step
 
 
+@dataclass(frozen=True)
+class ShardMoveCommand:
+    """One balancer decision: re-pin a tenant's automaton shard (the
+    TPU-shard analog of the reference's balancer→command pattern,
+    KVStoreBalanceController.java:85)."""
+    tenant_id: str
+    from_shard: int
+    to_shard: int
+    reason: str
+
+
+class ShardPlacementBalancer:
+    """Heat-driven tenant→shard re-placement (closes SURVEY §2.8's
+    placement row for the TPU plane).
+
+    Observes per-tenant query heat (MeshMatcher.query_heat — the same
+    role kv/load.py's KVLoadRecorder plays for KV ranges) and, when the
+    hottest shard carries more than ``imbalance_factor`` × the coldest
+    shard's heat, emits ONE command moving that shard's hottest tenant to
+    the coldest shard. One move per round, like the KV balancers: each
+    recompile is a placement epoch, and convergence beats thrash.
+    """
+
+    def __init__(self, *, imbalance_factor: float = 2.0,
+                 min_heat: int = 64) -> None:
+        self.imbalance_factor = imbalance_factor
+        self.min_heat = min_heat
+
+    def balance(self, heat: Dict[str, int], tables: ShardedTables
+                ) -> Optional[ShardMoveCommand]:
+        s = tables.n_shards
+        shard_heat = [0] * s
+        by_shard: List[List[Tuple[int, str]]] = [[] for _ in range(s)]
+        for tenant_id, h in heat.items():
+            sh = tables.shard_of(tenant_id)
+            shard_heat[sh] += h
+            by_shard[sh].append((h, tenant_id))
+        hot = max(range(s), key=lambda i: shard_heat[i])
+        cold = min(range(s), key=lambda i: shard_heat[i])
+        if shard_heat[hot] < self.min_heat:
+            return None
+        if shard_heat[hot] <= self.imbalance_factor * max(1,
+                                                          shard_heat[cold]):
+            return None
+        # move the hottest tenant whose relocation actually improves the
+        # max: new cold-shard heat must stay below the current hot-shard
+        # heat (moving a shard's ONLY tenant to a busier target is a loss)
+        by_shard[hot].sort(reverse=True)
+        for h, tenant_id in by_shard[hot]:
+            if shard_heat[cold] + h < shard_heat[hot]:
+                return ShardMoveCommand(
+                    tenant_id=tenant_id, from_shard=hot, to_shard=cold,
+                    reason=f"shard {hot} heat {shard_heat[hot]} > "
+                           f"{self.imbalance_factor}x shard {cold} "
+                           f"heat {shard_heat[cold]}")
+        return None
+
+
 class MeshMatcher(TpuMatcher):
     """The multi-device match plane with TpuMatcher's full mutation
     machinery — delta overlay, tombstones, background shadow-compile
@@ -187,6 +264,12 @@ class MeshMatcher(TpuMatcher):
         self._step = make_match_step(mesh, probe_len=probe_len,
                                      k_states=k_states)
         self._table_sharding = NamedSharding(mesh, P(SHARD_AXIS))
+        # load-driven shard re-placement (SURVEY §2.8 placement): desired
+        # tenant→shard pins; the serving snapshot routes by ITS OWN pin
+        # copy until a recompile swaps the new assignment in
+        self._pins: Dict[str, int] = {}
+        self.query_heat: Dict[str, int] = {}
+        self.shard_balancer = ShardPlacementBalancer()
         if tries:
             # seed path: write straight into authoritative + shadow state
             # and compile one base — building a full overlay that the
@@ -211,11 +294,45 @@ class MeshMatcher(TpuMatcher):
         self.compile_count += 1
         tables = build_sharded(self._shadow, self.n_shards,
                                max_levels=self.max_levels,
-                               probe_len=self.probe_len)
+                               probe_len=self.probe_len,
+                               pins=dict(self._pins))
         dev = (jax.device_put(tables.node_tab, self._table_sharding),
                jax.device_put(tables.edge_tab, self._table_sharding),
                jax.device_put(tables.child_list, self._table_sharding))
         return tables, dev
+
+    # ---------------- load-driven shard re-placement ------------------------
+
+    def pin_tenant(self, tenant_id: str, shard: int) -> None:
+        """Pin a tenant's automaton to a shard; takes effect when the next
+        recompiled snapshot swaps in (serving stays exact throughout —
+        the installed snapshot keeps routing by its own assignment)."""
+        assert 0 <= shard < self.n_shards
+        self._pins[tenant_id] = shard
+
+    def rebalance_step(self) -> Optional[ShardMoveCommand]:
+        """One balancer round (≈ KVStoreBalanceController.java:85's
+        observe→command→apply loop for TPU shards): consult the heat
+        profile, apply at most one move, kick a background recompile,
+        and decay the heat window."""
+        # defer while a compaction is in flight: the compile thread reads
+        # the frozen shadow, and replaying the log (or re-pinning) under
+        # it would race; the heat profile persists, so the next round
+        # re-evaluates after the swap
+        if self._base_ct is None or self._compact_thread is not None:
+            self._apply_pending_swap()
+            return None
+        cmd = self.shard_balancer.balance(self.query_heat, self._base_ct)
+        if cmd is not None:
+            self.pin_tenant(cmd.tenant_id, cmd.to_shard)
+            # fold pending mutations + new pins into a fresh shadow build
+            # on the compaction thread (_maybe_compact replays the log
+            # itself, safely, before spawning); serving swaps atomically
+            self._maybe_compact(force=True)
+        # exponential decay: old heat fades, the window tracks current load
+        self.query_heat = {t: h // 2 for t, h in self.query_heat.items()
+                           if h // 2 > 0}
+        return cmd
 
     # ---------------- query side -------------------------------------------
 
@@ -238,9 +355,12 @@ class MeshMatcher(TpuMatcher):
         # route each query to its shard, then round-robin across replicas
         slots: List[List[int]] = [[] for _ in range(r * s)]
         for qi, (tenant_id, _) in enumerate(queries):
-            sh = tenant_shard(tenant_id, s)
+            # route via the INSTALLED snapshot's assignment (incl. pins)
+            sh = tables.shard_of(tenant_id)
             rep = min(range(r), key=lambda j: len(slots[j * s + sh]))
             slots[rep * s + sh].append(qi)
+            self.query_heat[tenant_id] = \
+                self.query_heat.get(tenant_id, 0) + 1
         if per_device_batch is None:
             per_device_batch = batch
         if per_device_batch is None:

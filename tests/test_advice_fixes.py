@@ -1,0 +1,227 @@
+"""Regression tests for the five round-3 advisor findings (ADVICE.md r3,
+VERDICT r4 weak #5): durable delayed wills, shutdown-flush fail-open
+default, cancel-then-refire double publish, redirect-sweep will
+suppression, oversize-estimate per-property undercount.
+"""
+
+import asyncio
+
+import pytest
+
+from bifromq_tpu.kv.engine import InMemKVEngine
+from bifromq_tpu.mqtt import packets as pkts
+from bifromq_tpu.mqtt.broker import MQTTBroker
+from bifromq_tpu.mqtt.client import MQTTClient
+from bifromq_tpu.mqtt.protocol import PropertyId
+from bifromq_tpu.mqtt.session import SessionRegistry
+from bifromq_tpu.plugin.events import (CollectingEventCollector, EventType)
+from bifromq_tpu.types import ClientInfo
+
+
+class TestDurableDelayedWill:
+    async def test_delayed_will_survives_broker_restart(self):
+        """ADVICE r3 #1: a persistent session's delayed will lives in the
+        inbox STORE (reference InboxStoreCoProc LWT), so a broker restart
+        inside the delay window re-arms and fires it — an in-memory-only
+        timer would lose it."""
+        engine = InMemKVEngine()
+        b1 = MQTTBroker(host="127.0.0.1", port=0, inbox_engine=engine)
+        await b1.start()
+        dying = MQTTClient(
+            "127.0.0.1", b1.port, client_id="dw-dying",
+            protocol_level=5, clean_start=False,
+            properties={PropertyId.SESSION_EXPIRY_INTERVAL: 300},
+            will=pkts.Will(topic="dw/t", payload=b"late",
+                           properties={PropertyId.WILL_DELAY_INTERVAL: 2}))
+        await dying.connect()
+        dying._writer.close()               # ungraceful drop
+        await asyncio.sleep(0.3)
+        # the pending will is server-side persistent, NOT an in-memory task
+        assert len(b1.session_registry._pending_wills) == 0
+        metas = [m for _t, _i, m in b1.inbox.store.all_inboxes()
+                 if m.lwt is not None and m.detached_at is not None]
+        assert len(metas) == 1
+        # "crash": stop b1 (NoLWTWhenServerShuttingDown defaults True, so
+        # the flush KEEPS the stored will for the restart to re-arm)
+        await b1.stop()
+        b2 = MQTTBroker(host="127.0.0.1", port=0, inbox_engine=engine)
+        await b2.start()
+        try:
+            sub = MQTTClient("127.0.0.1", b2.port, client_id="dw-sub",
+                             protocol_level=5)
+            await sub.connect()
+            await sub.subscribe("dw/t", qos=0)
+            m = await asyncio.wait_for(sub.messages.get(), 8)
+            assert m.payload == b"late"
+            assert EventType.WILL_DISTED in {e.type
+                                             for e in b2.events.events}
+            await sub.disconnect()
+        finally:
+            await b2.stop()
+
+    async def test_reconnect_discards_stored_delayed_will(self):
+        """A resuming reconnect inside the window discards the stored
+        will (parity with the old in-memory contract)."""
+        engine = InMemKVEngine()
+        broker = MQTTBroker(host="127.0.0.1", port=0, inbox_engine=engine)
+        await broker.start()
+        try:
+            sub = MQTTClient("127.0.0.1", broker.port, client_id="rw-sub",
+                             protocol_level=5)
+            await sub.connect()
+            await sub.subscribe("rw/t", qos=0)
+
+            def dying_client():
+                return MQTTClient(
+                    "127.0.0.1", broker.port, client_id="rw-dying",
+                    protocol_level=5, clean_start=False,
+                    properties={PropertyId.SESSION_EXPIRY_INTERVAL: 300},
+                    will=pkts.Will(topic="rw/t", payload=b"boom",
+                                   properties={
+                                       PropertyId.WILL_DELAY_INTERVAL: 1}))
+            c1 = dying_client()
+            await c1.connect()
+            c1._writer.close()
+            await asyncio.sleep(0.3)
+            c2 = dying_client()
+            await c2.connect()          # resume inside the window
+            await asyncio.sleep(1.2)    # past the original deadline
+            assert sub.messages.qsize() == 0
+            await c2.disconnect()
+            await sub.disconnect()
+        finally:
+            await broker.stop()
+
+
+class TestFlushFailOpen:
+    async def test_settings_plugin_failure_uses_configured_default(self):
+        """ADVICE r3 #2: a throwing settings plugin during shutdown must
+        fall back to NoLWTWhenServerShuttingDown's configured default
+        (True => suppress), not invert it."""
+        ev = CollectingEventCollector()
+        reg = SessionRegistry(ev)
+        fired = []
+
+        async def fire():
+            fired.append(1)
+
+        async def run():
+            reg.schedule_will("t0", "c0", 100.0, fire)
+
+            def should_fire(_tenant):
+                raise RuntimeError("settings plugin down")
+
+            await reg.flush_pending_wills(should_fire)
+        await run()
+        assert fired == []          # default-suppressed, not fail-fired
+
+
+class TestCancelRefireRace:
+    async def test_register_awaits_inflight_fire_no_double_publish(self):
+        """ADVICE r3 #3: a reconnect landing while fire() is already in
+        flight must await it, never cancel-then-refire (double publish)."""
+        ev = CollectingEventCollector()
+        reg = SessionRegistry(ev)
+        fired = []
+        release = asyncio.Event()
+
+        async def fire():
+            fired.append(1)
+            await release.wait()    # hold mid-fire (≈ awaiting dist.pub)
+            fired.append(2)
+
+        reg.schedule_will("t0", "c0", 0.05, fire)
+        await asyncio.sleep(0.2)    # delay elapsed; fire() is in flight
+        assert fired == [1]
+
+        class FakeSession:
+            client_id = "c0"
+            clean_start = True      # would re-fire under the old code
+            client_info = ClientInfo(tenant_id="t0", metadata=())
+
+        async def unblock():
+            await asyncio.sleep(0.05)
+            release.set()
+        asyncio.get_running_loop().create_task(unblock())
+        await reg.register(FakeSession())
+        # exactly ONE full fire: the in-flight one completed, no re-fire
+        assert fired == [1, 2]
+
+
+class TestRedirectWill:
+    async def test_redirect_sweep_fires_transient_will(self):
+        """ADVICE r3 #4: an admin-driven move is not a clean client
+        DISCONNECT 0x00 — the moved session's will must fire (reference
+        onRedirect farewell keeps the LWT)."""
+        from bifromq_tpu.plugin.balancer import (IClientBalancer,
+                                                 RedirectType,
+                                                 ServerRedirection)
+        from bifromq_tpu.utils import sysprops as sp
+
+        class DrainLater(IClientBalancer):
+            draining = False
+
+            def need_redirect(self, client):
+                cid = dict(client.metadata).get("clientId", "")
+                if self.draining and cid == "rdw-mv":
+                    return ServerRedirection(
+                        type=RedirectType.MOVE,
+                        server_reference="other:1883")
+                return None
+
+        sp.override(sp.SysProp.CLIENT_REDIRECT_CHECK_INTERVAL_SECONDS, 0.3)
+        bal = DrainLater()
+        broker = MQTTBroker(host="127.0.0.1", port=0, balancer=bal)
+        await broker.start()
+        try:
+            sub = MQTTClient("127.0.0.1", broker.port, client_id="rdw-sub",
+                             protocol_level=5)
+            await sub.connect()
+            await sub.subscribe("rdw/t", qos=0)
+            c = MQTTClient("127.0.0.1", broker.port, client_id="rdw-mv",
+                           protocol_level=5,
+                           will=pkts.Will(topic="rdw/t", payload=b"moved"))
+            await c.connect()
+            bal.draining = True
+            m = await asyncio.wait_for(sub.messages.get(), 8)
+            assert m.payload == b"moved"
+            await sub.disconnect()
+        finally:
+            sp.override(sp.SysProp.CLIENT_REDIRECT_CHECK_INTERVAL_SECONDS,
+                        None)
+            await broker.stop()
+
+
+class TestOversizeEstimate:
+    async def test_empty_user_properties_cannot_bypass_probe(self):
+        """ADVICE r3 #5: per-property wire overhead (5B/pair) must count —
+        200 empty-string user properties are ~1000 wire bytes but 0 under
+        the old chars-only estimate, letting an oversize packet skip the
+        exact encode probe and ship."""
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        await broker.start()
+        try:
+            sub = MQTTClient(
+                "127.0.0.1", broker.port, client_id="os-sub",
+                protocol_level=5,
+                properties={PropertyId.MAXIMUM_PACKET_SIZE: 1000})
+            await sub.connect()
+            await sub.subscribe("os/t", qos=0)
+            p = MQTTClient("127.0.0.1", broker.port, client_id="os-pub",
+                           protocol_level=5)
+            await p.connect()
+            await p.publish(
+                "os/t", b"x" * 300, qos=0,
+                properties={PropertyId.USER_PROPERTY: [("", "")] * 200})
+            deadline = asyncio.get_event_loop().time() + 3
+            while (EventType.OVERSIZE_PACKET_DROPPED not in
+                   {e.type for e in broker.events.events}
+                   and asyncio.get_event_loop().time() < deadline):
+                await asyncio.sleep(0.05)
+            assert EventType.OVERSIZE_PACKET_DROPPED in {
+                e.type for e in broker.events.events}
+            assert sub.messages.qsize() == 0
+            await sub.disconnect()
+            await p.disconnect()
+        finally:
+            await broker.stop()

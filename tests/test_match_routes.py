@@ -148,6 +148,41 @@ class TestWalkRoutesParity:
             trie, ["x", "x", "x"])
 
 
+class TestTokenCache:
+    def test_cached_equals_uncached(self):
+        from bifromq_tpu.models.automaton import TokenCache, tokenize
+        topics = [["a", "b"], ["$SYS", "x"], "a/b", ["deep"] * 12,
+                  ["a", "b"], [""], ["a", "+", "#"]]
+        roots = [3, 5, 3, 7, 9, 2, 4]
+        cache = TokenCache()
+        for _ in range(2):  # second pass: all hits
+            got = tokenize(topics, roots, max_levels=8, salt=0,
+                           batch=16, cache=cache)
+            want = tokenize(topics, roots, max_levels=8, salt=0, batch=16)
+            np.testing.assert_array_equal(got.tok_h1, want.tok_h1)
+            np.testing.assert_array_equal(got.tok_h2, want.tok_h2)
+            np.testing.assert_array_equal(got.lengths, want.lengths)
+            np.testing.assert_array_equal(got.roots, want.roots)
+            np.testing.assert_array_equal(got.sys_mask, want.sys_mask)
+        assert cache.hits > 0
+
+    def test_salt_change_clears(self):
+        from bifromq_tpu.models.automaton import TokenCache, tokenize
+        cache = TokenCache()
+        a = tokenize([["a"]], [0], max_levels=4, salt=0, cache=cache)
+        b = tokenize([["a"]], [0], max_levels=4, salt=1, cache=cache)
+        assert a.tok_h1[0, 0] != b.tok_h1[0, 0]
+
+    def test_overlong_topic_stays_fallback(self):
+        from bifromq_tpu.models.automaton import TokenCache, tokenize
+        cache = TokenCache()
+        for _ in range(2):
+            got = tokenize([["x"] * 10], [5], max_levels=4, salt=0,
+                           cache=cache)
+            assert got.lengths[0] == -1
+            assert got.roots[0] == -1
+
+
 class TestExpandIntervals:
     def test_ragged_arange(self):
         s = np.array([[5, 100, 0], [0, 0, 0], [7, 0, 0]], np.int32)

@@ -1044,7 +1044,11 @@ class TestSlowConsumer:
             await c.connect()
             c._writer.close()
             await asyncio.sleep(0.5)    # let the broker arm the will
-            assert len(broker.session_registry._pending_wills) == 1
+            # durable Will Delay: the pending will lives in the inbox
+            # STORE (server-side persistent), not an in-memory timer
+            armed = [m for _t, _i, m in broker.inbox.store.all_inboxes()
+                     if m.lwt is not None and m.detached_at is not None]
+            assert len(armed) == 1
         finally:
             await broker.stop()
         assert EventType.WILL_DISTED in {e.type for e in ev.events}

@@ -1,0 +1,140 @@
+"""TPU shard re-placement loop (VERDICT r4 #9, SURVEY §2.8 placement row).
+
+A hot tenant's automaton shard migrates under load through the same
+balancer→command→apply pattern as kv/placement.py
+(≈ KVStoreBalanceController.java:85), with exact matches throughout:
+serving routes by the INSTALLED snapshot's pin map until the recompiled
+tables swap in atomically.
+"""
+
+import random
+
+import jax
+import pytest
+
+from bifromq_tpu.models.oracle import SubscriptionTrie
+from bifromq_tpu.parallel import sharded as sh
+from tests.test_sharded import build_tries, mk_route, result_keys
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8,
+                                reason="needs the 8-device CPU mesh")
+
+
+def _colliding_tenants(n_shards=4, want=3):
+    """Tenant ids that hash to the same default shard."""
+    target = sh.tenant_shard("tenant0", n_shards)
+    out = ["tenant0"]
+    i = 1
+    while len(out) < want:
+        tid = f"tenant{i}"
+        if sh.tenant_shard(tid, n_shards) == target:
+            out.append(tid)
+        i += 1
+    return target, out
+
+
+class TestShardPlacementBalancer:
+    def test_no_move_when_balanced(self):
+        tables = sh.build_sharded(build_tries(8), 4)
+        bal = sh.ShardPlacementBalancer(min_heat=10)
+        heat = {t: 100 for t in build_tries(8)}  # uniform
+        cmd = bal.balance(heat, tables)
+        # uniform hashing may still be slightly skewed, but no shard can
+        # exceed 2x the coldest with equal per-tenant heat unless hashing
+        # crowded tenants together — accept either None or a real move
+        if cmd is not None:
+            assert cmd.from_shard != cmd.to_shard
+
+    def test_below_min_heat_never_moves(self):
+        tables = sh.build_sharded(build_tries(8), 4)
+        bal = sh.ShardPlacementBalancer(min_heat=1000)
+        cmd = bal.balance({"tenant0": 50}, tables)
+        assert cmd is None
+
+    def test_colocated_hot_tenants_split(self):
+        """TWO hot tenants hashed onto one shard: the winnable case —
+        moving one halves the max-shard heat."""
+        tries = build_tries(12)
+        tables = sh.build_sharded(tries, 4)
+        _target, crowd = _colliding_tenants(4, want=2)
+        heat = {t: 10 for t in tries}
+        heat[crowd[0]] = 5_000
+        heat[crowd[1]] = 4_000
+        bal = sh.ShardPlacementBalancer(min_heat=10)
+        cmd = bal.balance(heat, tables)
+        assert cmd is not None
+        assert cmd.tenant_id == crowd[0]   # hottest of the hot shard
+        assert cmd.from_shard == tables.shard_of(crowd[0])
+        assert cmd.to_shard != cmd.from_shard
+
+    def test_single_dominant_tenant_not_thrashed(self):
+        """One tenant IS the load: no single move reduces the max —
+        the balancer must not thrash it around."""
+        tries = build_tries(12)
+        tables = sh.build_sharded(tries, 4)
+        heat = {t: 10 for t in tries}
+        heat["tenant0"] = 10_000
+        bal = sh.ShardPlacementBalancer(min_heat=10)
+        cmd = bal.balance(heat, tables)
+        assert cmd is None or cmd.tenant_id != "tenant0"
+
+
+class TestHotTenantMigration:
+    def test_hot_tenant_migrates_under_churn_with_exact_matches(self):
+        mesh = sh.make_mesh(2, 4)
+        tries = build_tries(12, n_filters=25)
+        # huge threshold: only the balancer's force-recompile may swap
+        m = sh.MeshMatcher(tries, mesh, compact_threshold=1 << 30)
+        oracle = {t: tr for t, tr in tries.items()}
+        _target, crowd = _colliding_tenants(4, want=2)
+        hot, warm = crowd[0], crowd[1]
+
+        def check_exact(queries):
+            got = m.match_batch(queries)
+            for (tenant_id, levels), res in zip(queries, got):
+                want = oracle[tenant_id].match(list(levels))
+                assert result_keys(res) == result_keys(want), (tenant_id,
+                                                               levels)
+
+        rng = random.Random(7)
+        alphabet = ["a", "b", "c", "d", "x1"]
+
+        def rand_topic():
+            return [rng.choice(alphabet)
+                    for _ in range(rng.randint(1, 4))]
+
+        # skewed traffic: two co-located hot tenants crowd one shard
+        queries = [(hot, rand_topic()) for _ in range(300)]
+        queries += [(warm, rand_topic()) for _ in range(250)]
+        queries += [(t, rand_topic()) for t in oracle for _ in range(3)]
+        check_exact(queries)
+
+        before = m._base_ct.shard_of(hot)
+        cmd = m.rebalance_step()
+        assert cmd is not None and cmd.tenant_id == hot
+        assert cmd.from_shard == before
+
+        # churn while the re-placement compile runs in the background:
+        # mutations land in the overlay and must stay exact
+        r_new = mk_route("zz/new", receiver="hot-new")
+        m.add_route(hot, r_new)
+        oracle[hot].add(r_new)
+        check_exact([(hot, ["zz", "new"]), (hot, rand_topic())])
+
+        m.drain()       # wait for the recompiled snapshot to swap in
+        after = m._base_ct.shard_of(hot)
+        assert after == cmd.to_shard != before
+        # exact after the move too (including the churned route)
+        check_exact([(hot, ["zz", "new"])])
+        check_exact([(t, rand_topic()) for t in oracle for _ in range(2)])
+
+    def test_pin_roundtrip_via_build(self):
+        tries = build_tries(6)
+        pins = {"tenant0": 2}
+        tables = sh.build_sharded(tries, 4, pins=pins)
+        assert tables.shard_of("tenant0") == 2
+        # the pinned tenant's routes live in shard 2's compiled trie
+        assert tables.compiled[2].root_of("tenant0") >= 0
+        default = sh.tenant_shard("tenant0", 4)
+        if default != 2:
+            assert tables.compiled[default].root_of("tenant0") < 0
