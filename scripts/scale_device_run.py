@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""Full-scale device run (VERDICT r4 #3): upload the tables saved by
+scale_probe.py and measure the walk on the real chip.
+
+Run ONLY when the tunnel is up (probe first). Reads
+/tmp/scale_tables_<cfg>.npz, uploads each table with its own timing (the
+axon tunnel uploads slowly — the record keeps upload separate from
+compute), then measures the config's OWN serving kernel — the match-plane
+interval walk for c5/c2_10m, the roles-swapped retained filter walk for
+c4 — appending to bench_results/r5_fullscale.json.
+
+Usage: python scripts/scale_device_run.py c5 [batch] [iters]
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bifromq_tpu.utils.jaxenv import pin_jax_platform  # noqa: E402
+
+
+def _load_tables(cfg):
+    from bifromq_tpu.models.automaton import CompiledTrie
+    z = np.load(f"/tmp/scale_tables_{cfg}.npz")
+    ct = CompiledTrie(node_tab=z["node_tab"], edge_tab=z["edge_tab"],
+                      child_list=z["child_list"], matchings=[],
+                      tenant_root={}, salt=int(z["salt"]),
+                      probe_len=int(z["probe_len"]),
+                      max_levels=int(z["max_levels"]))
+    roots_path = f"/tmp/scale_roots_{cfg}.json"
+    if os.path.exists(roots_path):
+        with open(roots_path) as f:
+            ct.tenant_root = json.load(f)
+    elif cfg == "c5":
+        # a multi-tenant table probed at root 0 would silently measure a
+        # single tenant's subtree — wrong-but-plausible numbers
+        raise SystemExit(f"{roots_path} missing: re-run scale_probe.py c5")
+    return ct
+
+
+def _upload(ct, rec, *, need_route_tabs=True):
+    from bifromq_tpu.ops.match import DeviceTrie
+    import jax
+    t0 = time.time()
+    if need_route_tabs:
+        dev = DeviceTrie.from_compiled(ct)
+        names = ("node_tab", "edge_tab", "child_list", "count_tab",
+                 "route_tab")
+    else:
+        # retained walk reads only the base tables — don't push the
+        # derived count/route tables through the ~1MB/s tunnel
+        dev = DeviceTrie(node_tab=jax.device_put(ct.node_tab),
+                         edge_tab=jax.device_put(ct.edge_tab),
+                         child_list=jax.device_put(ct.child_list))
+        names = ("node_tab", "edge_tab", "child_list")
+    for name in names:
+        a = getattr(dev, name)
+        np.asarray(a[:1])  # force the transfer (block_until_ready no-ops)
+        print(f"uploaded {name}: {a.nbytes/1e6:.0f}MB "
+              f"(cum {time.time()-t0:.0f}s)", flush=True)
+    rec["upload_s"] = round(time.time() - t0, 1)
+    return dev
+
+
+def _pipelined(run, probe_sets, sync, batch, iters):
+    """Fire-and-forget dispatch, one sync at the end; returns topics/s."""
+    s = time.perf_counter()
+    for it in range(iters - 1):
+        run(probe_sets[it % len(probe_sets)])
+    sync(run(probe_sets[(iters - 1) % len(probe_sets)]))
+    return batch * iters / (time.perf_counter() - s)
+
+
+def run_match(cfg, ct, dev, rec, batch, iters, k_states):
+    """c5 / c2_10m: PUBLISH topics through the match-plane walks."""
+    from bifromq_tpu.models.automaton import tokenize
+    from bifromq_tpu.ops.match import (Probes, expand_intervals,
+                                       walk_count_only, walk_routes)
+    from bifromq_tpu import workloads
+
+    n_batches = 4
+    topics = workloads.probe_topics(batch * n_batches, seed=1)
+    if cfg == "c5":
+        import random
+        rng = random.Random(3)
+        tenants = sorted(ct.tenant_root)
+        cum, acc = [], 0.0
+        for i in range(len(tenants)):
+            acc += 1.0 / (i + 1)
+            cum.append(acc)
+        tenant_seq = rng.choices(tenants, cum_weights=cum,
+                                 k=batch * n_batches)
+        roots = [ct.tenant_root[t] for t in tenant_seq]
+    else:
+        roots = [ct.tenant_root.get("tenant0", 0)] * (batch * n_batches)
+    t0 = time.time()
+    toks = [tokenize(topics[i * batch:(i + 1) * batch],
+                     roots[i * batch:(i + 1) * batch],
+                     max_levels=ct.max_levels, salt=ct.salt, batch=batch)
+            for i in range(n_batches)]
+    rec["tokenize_topics_per_s"] = round(
+        batch * n_batches / (time.time() - t0), 1)
+    probe_sets = [Probes.from_tokenized(t) for t in toks]
+    for p in probe_sets:
+        for a in (p.tok_h1, p.tok_h2, p.lengths, p.roots, p.sys_mask):
+            np.asarray(a[:1])
+
+    # ---- count walk: warmup collects counts+overflow in the SAME pass
+    run_c = lambda p: walk_count_only(dev, p, probe_len=ct.probe_len,
+                                      k_states=k_states)
+    t0 = time.time()
+    outs = [run_c(p) for p in probe_sets]
+    total_cnt = sum(float(np.asarray(c, dtype=np.float64).sum())
+                    for c, _ in outs)
+    total_ovf = sum(int(np.asarray(o).sum()) for _, o in outs)
+    rec["count_jit_s"] = round(time.time() - t0, 1)
+    rec["overflow_frac"] = round(total_ovf / (batch * n_batches), 5)
+    rec["routes_per_topic"] = round(total_cnt / (batch * n_batches), 2)
+    rec["count_topics_per_s"] = round(_pipelined(
+        run_c, probe_sets, lambda r: np.asarray(r[0]), batch, iters), 1)
+
+    # ---- routes walk: pipelined with readback + expand per iter ----------
+    run_r = lambda p: walk_routes(dev, p, probe_len=ct.probe_len,
+                                  k_states=k_states, max_intervals=32)
+
+    def process(r):
+        slots, _ = expand_intervals(np.asarray(r.start),
+                                    np.asarray(r.count))
+        return slots.size
+
+    t0 = time.time()
+    for p in probe_sets:
+        process(run_r(p))
+    rec["routes_jit_s"] = round(time.time() - t0, 1)
+    s = time.perf_counter()
+    prev = None
+    total_routes = 0
+    for it in range(iters):
+        h = run_r(probe_sets[it % n_batches])
+        if prev is not None:
+            total_routes += process(prev)
+        prev = h
+    total_routes += process(prev)
+    el = time.perf_counter() - s
+    rec["routes_topics_per_s"] = round(batch * iters / el, 1)
+    rec["routes_matched_per_s"] = round(total_routes / el, 1)
+
+    lat = []
+    for it in range(8):
+        s = time.perf_counter()
+        process(run_r(probe_sets[it % n_batches]))
+        lat.append(time.perf_counter() - s)
+    rec["routes_p50_ms"] = round(float(np.percentile(lat, 50)) * 1e3, 2)
+    rec["routes_p99_ms"] = round(float(np.percentile(lat, 99)) * 1e3, 2)
+
+
+def run_retained(ct, dev, rec, batch, iters, k_states):
+    """c4: wildcard FILTERS through the roles-swapped retained walk."""
+    from bifromq_tpu.models.automaton import tokenize_filters
+    from bifromq_tpu.ops.retained import FilterProbes, retained_walk
+    from bifromq_tpu import workloads
+
+    n_batches = 4
+    filters = workloads.probe_filters(batch * n_batches, seed=2)
+    root = ct.tenant_root.get("tenant0", 0)
+    t0 = time.time()
+    toks = [tokenize_filters(filters[i * batch:(i + 1) * batch],
+                             [root] * batch, max_levels=ct.max_levels,
+                             salt=ct.salt, batch=batch)
+            for i in range(n_batches)]
+    rec["tokenize_filters_per_s"] = round(
+        batch * n_batches / (time.time() - t0), 1)
+    probe_sets = [FilterProbes.from_tokenized(t) for t in toks]
+    for p in probe_sets:
+        for a in (p.tok_h1, p.tok_h2, p.tok_kind, p.lengths, p.roots):
+            np.asarray(a[:1])
+
+    run = lambda p: retained_walk(dev, p, probe_len=ct.probe_len,
+                                  k_states=k_states)
+    t0 = time.time()
+    outs = [run(p) for p in probe_sets]
+    total_matched = sum(
+        float(np.maximum(np.asarray(r)[..., 1], 0).sum())
+        for r, _ in outs)
+    total_ovf = sum(int(np.asarray(o).sum()) for _, o in outs)
+    rec["jit_s"] = round(time.time() - t0, 1)
+    rec["overflow_frac"] = round(total_ovf / (batch * n_batches), 5)
+    rec["matched_per_filter"] = round(total_matched / (batch * n_batches), 2)
+    rec["filters_per_s"] = round(_pipelined(
+        run, probe_sets, lambda r: np.asarray(r[0]), batch, iters), 1)
+    rec["matched_retained_per_s"] = round(
+        rec["filters_per_s"] * rec["matched_per_filter"], 1)
+
+    lat = []
+    for it in range(8):
+        s = time.perf_counter()
+        np.asarray(run(probe_sets[it % n_batches])[0])
+        lat.append(time.perf_counter() - s)
+    rec["p50_ms"] = round(float(np.percentile(lat, 50)) * 1e3, 2)
+    rec["p99_ms"] = round(float(np.percentile(lat, 99)) * 1e3, 2)
+
+
+def main():
+    cfg = sys.argv[1] if len(sys.argv) > 1 else "c5"
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 16384
+    iters = int(sys.argv[3]) if len(sys.argv) > 3 else 20
+    k_states = int(os.environ.get("SCALE_K", "16"))
+
+    pin_jax_platform()
+    import jax
+    print("devices:", jax.devices(), flush=True)
+
+    ct = _load_tables(cfg)
+    rec = {"config": cfg, "batch": batch, "iters": iters,
+           "k_states": k_states, "n_nodes": int(ct.n_nodes)}
+    dev = _upload(ct, rec, need_route_tabs=(cfg != "c4"))
+    if cfg == "c4":
+        run_retained(ct, dev, rec, batch, iters, k_states)
+    else:
+        run_match(cfg, ct, dev, rec, batch, iters, k_states)
+    rec["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    rec["platform"] = jax.devices()[0].platform
+
+    out_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench_results", "r5_fullscale.json")
+    results = {}
+    if os.path.exists(out_path):
+        with open(out_path) as f:
+            results = json.load(f)
+    results[f"{cfg}_B{batch}_K{k_states}"] = rec
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps(rec, indent=1))
+
+
+if __name__ == "__main__":
+    main()
