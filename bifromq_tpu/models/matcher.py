@@ -38,8 +38,8 @@ import numpy as np
 
 from ..utils import topic as topic_util
 from .automaton import (
-    NODE_RCOUNT, NODE_RSTART, CompiledTrie, GroupMatching, Matching,
-    TokenizedTopics, compile_tries, tokenize,
+    CompiledTrie, GroupMatching, Matching, TokenizedTopics, compile_tries,
+    tokenize,
 )
 from .oracle import (
     PERSISTENT_SUB_BROKER_ID, UNCAPPED_FANOUT, MatchedRoutes, Route,
@@ -407,8 +407,7 @@ class TpuMatcher:
                 continue
             out.append(self._expand_with_overlay(
                 ct, row, tomb or (), delta, list(levels),
-                max_persistent_fanout, max_group_fanout,
-                nodes_are_slots=True))
+                max_persistent_fanout, max_group_fanout))
         return out
 
     def match(self, tenant_id: str, topic: str, **kwargs) -> MatchedRoutes:
@@ -455,55 +454,18 @@ class TpuMatcher:
             out.normal = arr[row].tolist()
         return out
 
-    @staticmethod
-    def _expand(ct: CompiledTrie, nodes: np.ndarray,
-                max_persistent_fanout: int,
-                max_group_fanout: int) -> MatchedRoutes:
-        """Accepting nodes → routes, applying MatchedRoutes.java cap rules."""
-        out = MatchedRoutes()
-        node_tab = ct.node_tab
-        for n in nodes:
-            start = int(node_tab[n, NODE_RSTART])
-            count = int(node_tab[n, NODE_RCOUNT])
-            for slot in range(start, start + count):
-                m: Matching = ct.matchings[slot]
-                if isinstance(m, GroupMatching):
-                    if (m.mqtt_topic_filter not in out.groups
-                            and len(out.groups) >= max_group_fanout):
-                        out.max_group_fanout_exceeded = True
-                        continue
-                    out.groups[m.mqtt_topic_filter] = list(m.members)
-                else:
-                    if m.broker_id == PERSISTENT_SUB_BROKER_ID:
-                        if out.persistent_fanout >= max_persistent_fanout:
-                            out.max_persistent_fanout_exceeded = True
-                            continue
-                        out.persistent_fanout += 1
-                    out.normal.append(m)
-        return out
-
-    def _expand_with_overlay(self, ct: CompiledTrie, nodes: np.ndarray,
+    def _expand_with_overlay(self, ct: CompiledTrie, slots: np.ndarray,
                              tomb, delta: Optional[SubscriptionTrie],
                              levels: List[str],
                              max_persistent_fanout: int,
-                             max_group_fanout: int, *,
-                             nodes_are_slots: bool = False) -> MatchedRoutes:
+                             max_group_fanout: int) -> MatchedRoutes:
         """Base expansion ⊖ tombstones ⊕ delta matches, then caps.
 
-        ``nodes`` are accepting node ids by default (mesh path); the
-        interval path passes slot ids directly (``nodes_are_slots=True``).
-        """
+        ``slots`` are matched slot ids from the interval walk (single-chip
+        and mesh paths both expand intervals before calling)."""
         normal: List[Route] = []
         groups: Dict[str, List[Route]] = {}
-        node_tab = ct.node_tab
-        if nodes_are_slots:
-            slot_iter = [int(s) for s in nodes]
-        else:
-            slot_iter = [s for n in nodes
-                         for s in range(int(node_tab[n, NODE_RSTART]),
-                                        int(node_tab[n, NODE_RSTART])
-                                        + int(node_tab[n, NODE_RCOUNT]))]
-        for slot in slot_iter:
+        for slot in (int(s) for s in slots):
             m: Matching = ct.matchings[slot]
             if isinstance(m, GroupMatching):
                 members = [r for r in m.members

@@ -63,6 +63,22 @@ RT_RSTART = 4
 RT_COLS = 8
 
 
+def route_cols_from_node_tab(node_tab: np.ndarray) -> np.ndarray:
+    """Extract the RT_* route-walk columns from a full node table — the
+    ONE construction site for the layout (single-chip DeviceTrie and the
+    mesh's per-shard stacking both use it)."""
+    from ..models.automaton import (
+        NODE_HRCOUNT, NODE_HRSTART, NODE_RSTART,
+    )
+    route_cols = np.zeros((node_tab.shape[0], RT_COLS), dtype=np.int32)
+    route_cols[:, RT_PLUS] = node_tab[:, NODE_PLUS]
+    route_cols[:, RT_HRCOUNT] = node_tab[:, NODE_HRCOUNT]
+    route_cols[:, RT_RCOUNT] = node_tab[:, NODE_RCOUNT]
+    route_cols[:, RT_HRSTART] = node_tab[:, NODE_HRSTART]
+    route_cols[:, RT_RSTART] = node_tab[:, NODE_RSTART]
+    return route_cols
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class DeviceTrie:
@@ -91,28 +107,19 @@ class DeviceTrie:
 
     @staticmethod
     def from_compiled(ct: CompiledTrie, device=None) -> "DeviceTrie":
-        from ..models.automaton import (
-            NODE_HRCOUNT, NODE_HRSTART, NODE_RSTART,
-        )
+        from ..models.automaton import NODE_HRCOUNT
         put = functools.partial(jax.device_put, device=device)
         count_cols = np.zeros((ct.node_tab.shape[0], CT_COLS),
                               dtype=np.int32)
         count_cols[:, CT_PLUS] = ct.node_tab[:, NODE_PLUS]
         count_cols[:, CT_HRCOUNT] = ct.node_tab[:, NODE_HRCOUNT]
         count_cols[:, CT_RCOUNT] = ct.node_tab[:, NODE_RCOUNT]
-        route_cols = np.zeros((ct.node_tab.shape[0], RT_COLS),
-                              dtype=np.int32)
-        route_cols[:, RT_PLUS] = ct.node_tab[:, NODE_PLUS]
-        route_cols[:, RT_HRCOUNT] = ct.node_tab[:, NODE_HRCOUNT]
-        route_cols[:, RT_RCOUNT] = ct.node_tab[:, NODE_RCOUNT]
-        route_cols[:, RT_HRSTART] = ct.node_tab[:, NODE_HRSTART]
-        route_cols[:, RT_RSTART] = ct.node_tab[:, NODE_RSTART]
         return DeviceTrie(
             node_tab=put(ct.node_tab),
             edge_tab=put(ct.edge_tab),
             child_list=put(ct.child_list),
             count_tab=put(count_cols),
-            route_tab=put(route_cols),
+            route_tab=put(route_cols_from_node_tab(ct.node_tab)),
         )
 
 

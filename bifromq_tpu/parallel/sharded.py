@@ -28,10 +28,15 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.automaton import NODE_COLS, CompiledTrie, compile_tries, tokenize
-from ..models.oracle import UNCAPPED_FANOUT, MatchedRoutes, SubscriptionTrie
+from ..models.automaton import (
+    NODE_COLS, CompiledTrie, compile_tries, tokenize,
+)
 from ..models.matcher import TpuMatcher
-from ..ops.match import DeviceTrie, Probes, count_routes, walk
+from ..models.oracle import UNCAPPED_FANOUT, MatchedRoutes, SubscriptionTrie
+from ..ops.match import (
+    RT_COLS, DeviceTrie, Probes, _route_walk, expand_intervals,
+    route_cols_from_node_tab,
+)
 
 REPLICA_AXIS = "replica"
 SHARD_AXIS = "shard"
@@ -61,6 +66,7 @@ class ShardedTables:
     probe_len: int
     max_levels: int
     pins: Optional[Dict[str, int]] = None
+    route_tab: Optional[np.ndarray] = None   # [S, N, RT_COLS]
 
     def shard_of(self, tenant_id: str) -> int:
         if self.pins:
@@ -113,15 +119,19 @@ def build_sharded(tries: Dict[str, SubscriptionTrie], n_shards: int, *,
     node_tab = np.full((n_shards, n_max, NODE_COLS), -1, dtype=np.int32)
     edge_tab = np.full((n_shards, cap, probe_len, 4), -1, dtype=np.int32)
     child_list = np.full((n_shards, e_max), -1, dtype=np.int32)
+    route_tab = np.zeros((n_shards, n_max, RT_COLS), dtype=np.int32)
     for s, ct in enumerate(compiled):
-        node_tab[s, :ct.node_tab.shape[0]] = ct.node_tab
+        n = ct.node_tab.shape[0]
+        node_tab[s, :n] = ct.node_tab
         edge_tab[s] = ct.edge_tab
         child_list[s, :ct.child_list.shape[0]] = ct.child_list
+        route_tab[s, :n] = route_cols_from_node_tab(ct.node_tab)
     return ShardedTables(node_tab=node_tab, edge_tab=edge_tab,
                          child_list=child_list, compiled=compiled,
                          n_shards=n_shards, probe_len=probe_len,
                          max_levels=max_levels,
-                         pins=dict(pins) if pins else None)
+                         pins=dict(pins) if pins else None,
+                         route_tab=route_tab)
 
 
 def make_mesh(n_replicas: int, n_shards: int,
@@ -137,33 +147,42 @@ def make_mesh(n_replicas: int, n_shards: int,
 _STEP_CACHE: Dict[Tuple, object] = {}
 
 
-def make_match_step(mesh: Mesh, *, probe_len: int, k_states: int = 32):
+def make_match_step(mesh: Mesh, *, probe_len: int, k_states: int = 32,
+                    max_intervals: int = 32):
     """Build (or reuse) the jitted multi-device match step — memoized per
-    (mesh, probe_len, k_states): clone_empty()/reset and per-range
-    matchers must share one compiled program, not re-trace identical
-    closures at ~seconds each.
+    (mesh, probe_len, k_states, max_intervals): clone_empty()/reset and
+    per-range matchers must share one compiled program, not re-trace
+    identical closures at ~seconds each.
 
     Inputs:  tables sharded [S, ...] over SHARD_AXIS (replicated over
              REPLICA_AXIS); probes [R, S, B, ...] split over both axes.
-    Outputs: walk results [R, S, B, ...] with the same layout, per-topic
-             route counts, and a globally psum'd total matched-route count.
+    Outputs: per-topic matched-slot INTERVALS [R, S, B, A] × (start,
+             count) — the same compressed MatchedRoutes the single-chip
+             walk_routes emits — plus per-topic totals, overflow, and a
+             globally psum'd matched-route count. Cross-device traffic is
+             exactly one psum: probes are shard-routed host-side, so the
+             match itself needs no collective.
     """
-    key = (mesh, probe_len, k_states)
+    key = (mesh, probe_len, k_states, max_intervals)
     cached = _STEP_CACHE.get(key)
     if cached is not None:
         return cached
 
-    def local_step(node_tab, edge_tab, child_list, tok_h1, tok_h2, lengths,
-                   roots, sys_mask):
-        trie = DeviceTrie(node_tab[0], edge_tab[0], child_list[0])
+    def local_step(edge_tab, child_list, route_tab,
+                   tok_h1, tok_h2, lengths, roots, sys_mask):
+        # the interval walk reads ONLY route_tab + edge_tab (+ child_list
+        # for shape plumbing) — the 48B/row full node table never ships
+        # to the mesh (route_tab stands in for the unused node_tab slot)
+        trie = DeviceTrie(route_tab[0], edge_tab[0], child_list[0],
+                          None, route_tab[0])
         probes = Probes(tok_h1[0, 0], tok_h2[0, 0], lengths[0, 0],
                         roots[0, 0], sys_mask[0, 0])
-        res = walk(trie, probes, probe_len=probe_len, k_states=k_states)
-        counts = count_routes(trie, res)
-        total = jax.lax.psum(counts.sum(), (REPLICA_AXIS, SHARD_AXIS))
+        ivl_s, ivl_c, n_routes, overflow = _route_walk(
+            trie, probes, probe_len, k_states, "sort", max_intervals)
+        total = jax.lax.psum(n_routes.sum(), (REPLICA_AXIS, SHARD_AXIS))
         expand = lambda x: x[None, None]
-        return (expand(res.hash_acc), expand(res.final_acc),
-                expand(res.overflow), expand(counts), total)
+        return (expand(ivl_s), expand(ivl_c), expand(n_routes),
+                expand(overflow), total)
 
     table_spec = P(SHARD_AXIS)
     probe_spec = P(REPLICA_AXIS, SHARD_AXIS)
@@ -296,9 +315,11 @@ class MeshMatcher(TpuMatcher):
                                max_levels=self.max_levels,
                                probe_len=self.probe_len,
                                pins=dict(self._pins))
-        dev = (jax.device_put(tables.node_tab, self._table_sharding),
-               jax.device_put(tables.edge_tab, self._table_sharding),
-               jax.device_put(tables.child_list, self._table_sharding))
+        # node_tab intentionally NOT uploaded: the interval step never
+        # gathers from it (route_tab carries every column the walk reads)
+        dev = (jax.device_put(tables.edge_tab, self._table_sharding),
+               jax.device_put(tables.child_list, self._table_sharding),
+               jax.device_put(tables.route_tab, self._table_sharding))
         return tables, dev
 
     # ---------------- load-driven shard re-placement ------------------------
@@ -350,7 +371,7 @@ class MeshMatcher(TpuMatcher):
         if self._base_ct is None:
             self.refresh()
         tables: ShardedTables = self._base_ct
-        dev_node, dev_edge, dev_child = self._device_trie
+        dev_edge, dev_child, dev_route = self._device_trie
         r, s = self.n_replicas, self.n_shards
         # route each query to its shard, then round-robin across replicas
         slots: List[List[int]] = [[] for _ in range(r * s)]
@@ -395,12 +416,16 @@ class MeshMatcher(TpuMatcher):
                 roots[rep, sh] = tk.roots
                 sys_mask[rep, sh] = tk.sys_mask
 
-        hash_acc, final_acc, overflow, _counts, _total = self._step(
-            dev_node, dev_edge, dev_child,
+        ivl_s, ivl_c, _n_routes, overflow, _total = self._step(
+            dev_edge, dev_child, dev_route,
             tok_h1, tok_h2, lengths, roots, sys_mask)
-        hash_acc = np.asarray(hash_acc)
-        final_acc = np.asarray(final_acc)
+        ivl_s = np.asarray(ivl_s)       # [R, S, B, A]
+        ivl_c = np.asarray(ivl_c)
         overflow = np.asarray(overflow)
+        # one vectorized expansion for the whole [R*S*B] grid
+        a = ivl_s.shape[-1]
+        flat_slots, flat_offs = expand_intervals(
+            ivl_s.reshape(-1, a), ivl_c.reshape(-1, a))
 
         out: List[MatchedRoutes] = [MatchedRoutes() for _ in queries]
         for rep in range(r):
@@ -427,15 +452,14 @@ class MeshMatcher(TpuMatcher):
                             max_group_fanout=max_group_fanout)
                             if trie is not None else MatchedRoutes())
                         continue
-                    nodes = np.concatenate([hash_acc[rep, sh, bi].ravel(),
-                                            final_acc[rep, sh, bi]])
-                    nodes = nodes[nodes >= 0]
+                    row = (rep * s + sh) * b + bi
+                    srow = flat_slots[flat_offs[row]:flat_offs[row + 1]]
                     if not tomb and delta is None:
-                        out[qi] = self._expand(ct, nodes,
-                                               max_persistent_fanout,
-                                               max_group_fanout)
+                        out[qi] = self._routes_from_slots(
+                            ct, srow, max_persistent_fanout,
+                            max_group_fanout)
                     else:
                         out[qi] = self._expand_with_overlay(
-                            ct, nodes, tomb or (), delta, list(levels),
+                            ct, srow, tomb or (), delta, list(levels),
                             max_persistent_fanout, max_group_fanout)
         return out
