@@ -1,0 +1,59 @@
+"""Child-process side of plugin isolation (see plugin/isolated.py).
+
+Loads ONE hook instance and serves length-prefixed pickled requests on
+stdin/stdout. "call" messages get exactly one response; "fire" messages
+get none. Plugin exceptions are reported back as ("err", repr) for calls
+and swallowed (after logging to stderr) for fires — the broker process
+never sees a plugin stack unwind.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import sys
+import traceback
+
+
+def main() -> None:
+    hook_path = sys.argv[1]
+    stdin = sys.stdin.buffer
+    stdout = sys.stdout.buffer
+
+    from ..utils.hookloader import load_hook
+    try:
+        obj = load_hook(hook_path)
+        load_err = None
+    except Exception as e:  # noqa: BLE001 — reported via __ready__
+        obj = None
+        load_err = f"{type(e).__name__}: {e}"
+
+    def respond(msg) -> None:
+        blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        stdout.write(struct.pack(">I", len(blob)) + blob)
+        stdout.flush()
+
+    while True:
+        hdr = stdin.read(4)
+        if len(hdr) < 4:
+            return          # parent closed the pipe: exit quietly
+        (n,) = struct.unpack(">I", hdr)
+        kind, method, args = pickle.loads(stdin.read(n))
+        if method == "__ready__":
+            respond(("ok", None) if load_err is None
+                    else ("err", load_err))
+            if load_err is not None:
+                return
+            continue
+        try:
+            result = getattr(obj, method)(*args)
+            if kind == "call":
+                respond(("ok", result))
+        except Exception as e:  # noqa: BLE001
+            traceback.print_exc(file=sys.stderr)
+            if kind == "call":
+                respond(("err", f"{type(e).__name__}: {e}"))
+
+
+if __name__ == "__main__":
+    main()

@@ -70,6 +70,66 @@ class Standalone:
         self.api = None
         self.agent_host = None
         self.rpc_server = None
+        self._isolated_hosts = []
+
+    @staticmethod
+    def _load_plugins(pcfg: dict) -> dict:
+        """YAML ``plugins:`` section → MQTTBroker plugin kwargs.
+
+        Each entry is ``name: module:Class`` or
+        ``name: {path: module:Class, isolated: true}`` (≈ the reference
+        starter naming plugin FQCNs in config, BifroMQPluginManager).
+        ``isolated: true`` runs the plugin out-of-process
+        (plugin/isolated.py) — supported for settings / events /
+        user_props; latency-critical SPIs load in-process.
+        """
+        from .plugin.auth import IAuthProvider
+        from .plugin.balancer import IClientBalancer
+        from .plugin.events import IEventCollector
+        from .plugin.settings import ISettingProvider
+        from .plugin.throttler import IResourceThrottler
+        from .plugin.userprops import IUserPropsCustomizer
+        from .utils.hookloader import load_optional
+
+        kinds = {
+            "auth": ("auth", IAuthProvider, None),
+            "settings": ("settings", ISettingProvider,
+                         "IsolatedSettingProvider"),
+            "events": ("events", IEventCollector,
+                       "IsolatedEventCollector"),
+            "throttler": ("throttler", IResourceThrottler, None),
+            "balancer": ("balancer", IClientBalancer, None),
+            "user_props": ("user_props_customizer", IUserPropsCustomizer,
+                           "IsolatedUserPropsCustomizer"),
+        }
+        out = {}
+        for name, spec in (pcfg or {}).items():
+            if name not in kinds:
+                raise ValueError(f"unknown plugin kind {name!r} "
+                                 f"(one of {sorted(kinds)})")
+            kwarg, iface, iso_cls = kinds[name]
+            if isinstance(spec, str):
+                spec = {"path": spec}
+            path = spec["path"]
+            if spec.get("isolated"):
+                if iso_cls is None:
+                    raise ValueError(
+                        f"plugin kind {name!r} cannot be isolated "
+                        "(latency-critical SPI; loads in-process)")
+                from .plugin import isolated as iso
+                if name == "events":
+                    # keep an in-process mirror fed: the broker's own
+                    # introspection reads the local collector
+                    from .plugin.events import CollectingEventCollector
+                    out[kwarg] = iso.IsolatedEventCollector(
+                        path, mirror=CollectingEventCollector())
+                else:
+                    out[kwarg] = getattr(iso, iso_cls)(path)
+            else:
+                obj = load_optional(path, iface)
+                if obj is not None:
+                    out[kwarg] = obj
+        return out
 
     async def start(self) -> None:
         from .mqtt.broker import MQTTBroker
@@ -159,7 +219,9 @@ class Standalone:
         ws = mqtt_cfg.get("ws")
         inbox_cfg = cfg.get("inbox", {})
         retain_cfg = cfg.get("retain", {})
+        plug = self._load_plugins(cfg.get("plugins", {}))
         self.broker = MQTTBroker(
+            **plug,
             host=host, port=int(tcp.get("port", 1883)),
             inbox_engine=engine, dist=dist,
             dist_worker_kwargs=elastic or None,
@@ -180,6 +242,8 @@ class Standalone:
             dist.events = self.broker.events
             dist.settings = self.broker.settings
         await self.broker.start()
+        self._isolated_hosts = [
+            v.host for v in plug.values() if hasattr(v, "host")]
 
         if self.agent_host is not None:
             # clustered: expose the session-dict service on the RPC fabric
@@ -241,6 +305,8 @@ class Standalone:
             await self.broker.stop()
         if self.agent_host is not None:
             await self.agent_host.stop()
+        for host in self._isolated_hosts:
+            host.close()
 
 
 async def run(config: dict) -> None:

