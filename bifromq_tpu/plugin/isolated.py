@@ -10,16 +10,20 @@ a blocking call cannot take the broker down — calls time out and fall
 back to defaults, the child is respawned (bounded), and a plugin that
 never comes up leaves the broker running on its default SPI.
 
-Scope: the non-latency-critical SPIs (settings, events, user-props).
-Latency-critical SPIs on the per-message path (auth handshakes,
-sub-broker delivery) stay in-process with exception isolation, like the
-reference keeps delivery SPIs on its hot path.
+Scope: the non-latency-critical SPIs — settings (TTL-cached in the
+parent, so steady-state reads never touch the pipe) and events
+(fire-and-forget through a bounded queue that DROPS under backpressure
+rather than ever blocking the broker). Per-message SPIs (auth
+handshakes, user-props, sub-broker delivery) stay in-process with
+exception isolation, like the reference keeps delivery SPIs on its hot
+path.
 
-Protocol (child: plugin/isolated_child.py): each message is
-``len:u32 || pickle((kind, method, args))``; kind "call" gets exactly one
-``len:u32 || pickle(("ok"|"err", value))`` response, kind "fire" gets
-none. The parent serializes all writes under one lock, so responses
-arrive in call order.
+Protocol (child: plugin/isolated_child.py): each frame is
+``len:u32 || pickle((kind, seq, method, args))``; kind "call" gets one
+``len:u32 || pickle((seq, "ok"|"err", value))`` response, kind "fire"
+none. A dedicated writer thread owns stdin and a dedicated reader thread
+owns stdout, so no broker thread ever blocks on pipe I/O; stale
+responses from timed-out calls are discarded by sequence number.
 """
 
 from __future__ import annotations
@@ -27,18 +31,25 @@ from __future__ import annotations
 import logging
 import os
 import pickle
+import queue
 import struct
 import subprocess
 import sys
 import threading
 import time
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 from .events import IEventCollector
 from .settings import ISettingProvider
-from .userprops import IUserPropsCustomizer
 
 log = logging.getLogger(__name__)
+
+_EOF = object()
+
+
+class PluginSerializationError(Exception):
+    """Parent-side pickling failure — a caller bug, NOT plugin death;
+    must never kill the (healthy) child or burn the restart budget."""
 
 
 class IsolatedPluginHost:
@@ -46,14 +57,20 @@ class IsolatedPluginHost:
 
     def __init__(self, hook_path: str, *, call_timeout: float = 1.0,
                  restart_limit: int = 5,
-                 restart_window_s: float = 60.0) -> None:
+                 restart_window_s: float = 60.0,
+                 fire_queue_max: int = 4096) -> None:
         self.hook_path = hook_path
         self.call_timeout = call_timeout
         self.restart_limit = restart_limit
         self.restart_window_s = restart_window_s
+        self.fire_queue_max = fire_queue_max
         self._proc: Optional[subprocess.Popen] = None
-        self._lock = threading.Lock()
-        self._restarts: list = []   # monotonic timestamps of respawns
+        self._out_q: Optional[queue.Queue] = None
+        self._resp_q: Optional[queue.Queue] = None
+        self._lock = threading.Lock()   # serializes call(); spawn state
+        self._seq = 0
+        self._restarts: list = []       # monotonic timestamps of respawns
+        self.dropped_fires = 0
         self._ensure_child()
 
     # ---------------- lifecycle -------------------------------------------
@@ -70,7 +87,7 @@ class IsolatedPluginHost:
             return False    # crash-looping: stay on defaults
         self._restarts.append(now)
         try:
-            self._proc = subprocess.Popen(
+            proc = subprocess.Popen(
                 [sys.executable, "-m", "bifromq_tpu.plugin.isolated_child",
                  self.hook_path],
                 stdin=subprocess.PIPE, stdout=subprocess.PIPE,
@@ -78,22 +95,69 @@ class IsolatedPluginHost:
                 # into the protocol pipe
                 stderr=None,
                 cwd=os.getcwd())
-            # handshake: the child loads the hook and reports readiness,
-            # so an import-time crash is detected HERE, not on first call
-            ok, val = self._roundtrip(("call", "__ready__", ()),
-                                      timeout=max(5.0, self.call_timeout))
+        except Exception:  # noqa: BLE001
+            log.exception("isolated plugin %s failed to spawn",
+                          self.hook_path)
+            return False
+        self._proc = proc
+        out_q: queue.Queue = queue.Queue(self.fire_queue_max)
+        resp_q: queue.Queue = queue.Queue()
+        self._out_q, self._resp_q = out_q, resp_q
+        threading.Thread(target=self._writer_loop, args=(proc, out_q),
+                         daemon=True,
+                         name=f"plug-w-{self.hook_path}").start()
+        threading.Thread(target=self._reader_loop, args=(proc, resp_q),
+                         daemon=True,
+                         name=f"plug-r-{self.hook_path}").start()
+        # handshake: the child loads the hook and reports readiness, so an
+        # import-time crash is detected HERE, not on first call
+        try:
+            ok, val = self._call_locked("__ready__", (),
+                                        timeout=max(5.0, self.call_timeout))
             if not ok:
                 raise RuntimeError(f"plugin failed to load: {val}")
             return True
-        except Exception:  # noqa: BLE001 — any spawn failure: defaults
+        except Exception:  # noqa: BLE001
             log.exception("isolated plugin %s failed to start",
                           self.hook_path)
             self._kill()
             return False
 
+    @staticmethod
+    def _writer_loop(proc, out_q) -> None:
+        """Owns stdin: broker threads never block on a full pipe."""
+        try:
+            while True:
+                frame = out_q.get()
+                if frame is _EOF:
+                    return
+                proc.stdin.write(frame)
+                proc.stdin.flush()
+        except Exception:  # noqa: BLE001 — pipe died; reader reports EOF
+            pass
+
+    @staticmethod
+    def _reader_loop(proc, resp_q) -> None:
+        """Owns stdout: one persistent thread, no per-call thread churn."""
+        try:
+            while True:
+                hdr = proc.stdout.read(4)
+                if len(hdr) < 4:
+                    break
+                (n,) = struct.unpack(">I", hdr)
+                resp_q.put(pickle.loads(proc.stdout.read(n)))
+        except Exception:  # noqa: BLE001
+            pass
+        resp_q.put(_EOF)
+
     def _kill(self) -> None:
         p = self._proc
         self._proc = None
+        if self._out_q is not None:
+            try:
+                self._out_q.put_nowait(_EOF)
+            except queue.Full:
+                pass
         if p is not None:
             try:
                 p.kill()
@@ -108,54 +172,59 @@ class IsolatedPluginHost:
     # ---------------- wire -------------------------------------------------
 
     @staticmethod
-    def _send(pipe, msg) -> None:
-        blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
-        pipe.write(struct.pack(">I", len(blob)) + blob)
-        pipe.flush()
+    def _frame(msg) -> bytes:
+        try:
+            blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception as e:  # noqa: BLE001
+            raise PluginSerializationError(str(e)) from e
+        return struct.pack(">I", len(blob)) + blob
 
-    def _roundtrip(self, msg, *, timeout: float):
-        """Send a call and read its one response; MUST hold no lock —
-        callers serialize. Raises on pipe/timeout failure."""
-        p = self._proc
-        self._send(p.stdin, msg)
-        # a blocking plugin must not wedge the broker: bounded wait via a
-        # reader thread (pipes have no portable read timeout)
-        result = {}
-        done = threading.Event()
-
-        def read():
+    def _call_locked(self, method: str, args: tuple, *,
+                     timeout: float) -> Tuple[bool, Any]:
+        """One call round-trip; caller holds self._lock."""
+        self._seq += 1
+        seq = self._seq
+        frame = self._frame(("call", seq, method, args))
+        try:
+            self._out_q.put(frame, timeout=timeout)
+        except queue.Full:
+            raise TimeoutError("plugin write queue full")
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"plugin call timed out after {timeout}s")
             try:
-                hdr = p.stdout.read(4)
-                if len(hdr) < 4:
-                    raise EOFError("child closed")
-                (n,) = struct.unpack(">I", hdr)
-                result["v"] = pickle.loads(p.stdout.read(n))
-            except Exception as e:  # noqa: BLE001
-                result["e"] = e
-            finally:
-                done.set()
-
-        t = threading.Thread(target=read, daemon=True)
-        t.start()
-        if not done.wait(timeout):
-            raise TimeoutError(f"plugin call timed out after {timeout}s")
-        if "e" in result:
-            raise result["e"]
-        status, value = result["v"]
-        return status == "ok", value
+                resp = self._resp_q.get(timeout=remaining)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"plugin call timed out after {timeout}s")
+            if resp is _EOF:
+                raise EOFError("plugin child exited")
+            rseq, status, value = resp
+            if rseq < seq:
+                continue        # stale response from a timed-out call
+            return status == "ok", value
 
     # ---------------- public ----------------------------------------------
 
     def call(self, method: str, *args) -> Any:
-        """Invoke a plugin method; raises on failure (caller falls back)."""
+        """Invoke a plugin method; raises on failure (caller falls back).
+
+        NOTE: blocking (pipe round-trip). The provided SPI wrappers keep
+        this OFF per-message paths (settings are TTL-cached, events are
+        fire-and-forget)."""
         with self._lock:
             if not self._ensure_child():
                 raise RuntimeError("plugin unavailable (crash-looping)")
             try:
-                ok, val = self._roundtrip(("call", method, args),
-                                          timeout=self.call_timeout)
+                ok, val = self._call_locked(method, args,
+                                            timeout=self.call_timeout)
+            except PluginSerializationError:
+                raise   # caller bug: the healthy child stays up
             except Exception:
-                # pipe is now desynced or dead: kill, respawn next call
+                # child hung or pipe died: kill, respawn on next use
                 self._kill()
                 raise
             if not ok:
@@ -163,29 +232,51 @@ class IsolatedPluginHost:
             return val
 
     def fire(self, method: str, *args) -> None:
-        """Fire-and-forget (events): never raises, never blocks on the
-        plugin's execution (only on the pipe write)."""
+        """Fire-and-forget (events): NEVER blocks and never raises — a
+        slow child fills the bounded queue and further fires are dropped
+        (counted), which is the correct QoS0 behavior for telemetry."""
+        try:
+            frame = self._frame(("fire", 0, method, args))
+        except PluginSerializationError:
+            self.dropped_fires += 1
+            return
         with self._lock:
             if not self._ensure_child():
                 return
-            try:
-                self._send(self._proc.stdin, ("fire", method, args))
-            except Exception:  # noqa: BLE001
-                self._kill()
+        try:
+            self._out_q.put_nowait(frame)
+        except queue.Full:
+            self.dropped_fires += 1
 
 
 class IsolatedSettingProvider(ISettingProvider):
-    """ISettingProvider served from an isolated child; any failure
-    returns None (= the setting's default)."""
+    """ISettingProvider served from an isolated child.
 
-    def __init__(self, hook_path: str, **kw) -> None:
+    Responses are TTL-cached per (setting, tenant) so steady-state reads
+    (per-CONNECT resolution, per-pub-batch lookups) never touch the pipe;
+    any failure returns None (= the setting's default), uncached, so a
+    recovered plugin is consulted again."""
+
+    def __init__(self, hook_path: str, *, cache_ttl_s: float = 5.0,
+                 **kw) -> None:
         self.host = IsolatedPluginHost(hook_path, **kw)
+        self.cache_ttl_s = cache_ttl_s
+        self._cache: dict = {}   # (setting, tenant) -> (expires, value)
 
     def provide(self, setting, tenant_id):
+        key = (setting, tenant_id)
+        now = time.monotonic()
+        hit = self._cache.get(key)
+        if hit is not None and hit[0] > now:
+            return hit[1]
         try:
-            return self.host.call("provide", setting, tenant_id)
+            val = self.host.call("provide", setting, tenant_id)
         except Exception:  # noqa: BLE001 — default on any failure
             return None
+        if len(self._cache) > 65536:
+            self._cache.clear()   # bounded: rebuild from the child
+        self._cache[key] = (now + self.cache_ttl_s, val)
+        return val
 
 
 class IsolatedEventCollector(IEventCollector):
@@ -202,22 +293,3 @@ class IsolatedEventCollector(IEventCollector):
         if self.mirror is not None:
             self.mirror.report(event)
         self.host.fire("report", event)
-
-
-class IsolatedUserPropsCustomizer(IUserPropsCustomizer):
-    """IUserPropsCustomizer behind the child; failure = no extra props."""
-
-    def __init__(self, hook_path: str, **kw) -> None:
-        self.host = IsolatedPluginHost(hook_path, **kw)
-
-    def inbound(self, *args):
-        try:
-            return tuple(self.host.call("inbound", *args) or ())
-        except Exception:  # noqa: BLE001
-            return ()
-
-    def outbound(self, *args):
-        try:
-            return tuple(self.host.call("outbound", *args) or ())
-        except Exception:  # noqa: BLE001
-            return ()

@@ -38,21 +38,21 @@ def main() -> None:
         if len(hdr) < 4:
             return          # parent closed the pipe: exit quietly
         (n,) = struct.unpack(">I", hdr)
-        kind, method, args = pickle.loads(stdin.read(n))
+        kind, seq, method, args = pickle.loads(stdin.read(n))
         if method == "__ready__":
-            respond(("ok", None) if load_err is None
-                    else ("err", load_err))
+            respond((seq, "ok", None) if load_err is None
+                    else (seq, "err", load_err))
             if load_err is not None:
                 return
             continue
         try:
             result = getattr(obj, method)(*args)
             if kind == "call":
-                respond(("ok", result))
+                respond((seq, "ok", result))
         except Exception as e:  # noqa: BLE001
             traceback.print_exc(file=sys.stderr)
             if kind == "call":
-                respond(("err", f"{type(e).__name__}: {e}"))
+                respond((seq, "err", f"{type(e).__name__}: {e}"))
 
 
 if __name__ == "__main__":

@@ -99,36 +99,46 @@ class Standalone:
                        "IsolatedEventCollector"),
             "throttler": ("throttler", IResourceThrottler, None),
             "balancer": ("balancer", IClientBalancer, None),
+            # user_props runs per-message: isolation's pipe round-trip
+            # does not belong on that path — in-process only
             "user_props": ("user_props_customizer", IUserPropsCustomizer,
-                           "IsolatedUserPropsCustomizer"),
+                           None),
         }
         out = {}
-        for name, spec in (pcfg or {}).items():
-            if name not in kinds:
-                raise ValueError(f"unknown plugin kind {name!r} "
-                                 f"(one of {sorted(kinds)})")
-            kwarg, iface, iso_cls = kinds[name]
-            if isinstance(spec, str):
-                spec = {"path": spec}
-            path = spec["path"]
-            if spec.get("isolated"):
-                if iso_cls is None:
-                    raise ValueError(
-                        f"plugin kind {name!r} cannot be isolated "
-                        "(latency-critical SPI; loads in-process)")
-                from .plugin import isolated as iso
-                if name == "events":
-                    # keep an in-process mirror fed: the broker's own
-                    # introspection reads the local collector
-                    from .plugin.events import CollectingEventCollector
-                    out[kwarg] = iso.IsolatedEventCollector(
-                        path, mirror=CollectingEventCollector())
+        try:
+            for name, spec in (pcfg or {}).items():
+                if name not in kinds:
+                    raise ValueError(f"unknown plugin kind {name!r} "
+                                     f"(one of {sorted(kinds)})")
+                kwarg, iface, iso_cls = kinds[name]
+                if isinstance(spec, str):
+                    spec = {"path": spec}
+                path = spec["path"]
+                if spec.get("isolated"):
+                    if iso_cls is None:
+                        raise ValueError(
+                            f"plugin kind {name!r} cannot be isolated "
+                            "(latency-critical SPI; loads in-process)")
+                    from .plugin import isolated as iso
+                    if name == "events":
+                        # keep an in-process mirror fed: the broker's own
+                        # introspection reads the local collector
+                        from .plugin.events import CollectingEventCollector
+                        out[kwarg] = iso.IsolatedEventCollector(
+                            path, mirror=CollectingEventCollector())
+                    else:
+                        out[kwarg] = getattr(iso, iso_cls)(path)
                 else:
-                    out[kwarg] = getattr(iso, iso_cls)(path)
-            else:
-                obj = load_optional(path, iface)
-                if obj is not None:
-                    out[kwarg] = obj
+                    obj = load_optional(path, iface)
+                    if obj is not None:
+                        out[kwarg] = obj
+        except Exception:
+            # a later entry failing must not orphan already-spawned
+            # children of earlier entries
+            for v in out.values():
+                if hasattr(v, "host"):
+                    v.host.close()
+            raise
         return out
 
     async def start(self) -> None:
@@ -220,6 +230,10 @@ class Standalone:
         inbox_cfg = cfg.get("inbox", {})
         retain_cfg = cfg.get("retain", {})
         plug = self._load_plugins(cfg.get("plugins", {}))
+        # register spawned children for cleanup IMMEDIATELY: a failing
+        # broker.start() below must not orphan plugin processes
+        self._isolated_hosts = [
+            v.host for v in plug.values() if hasattr(v, "host")]
         self.broker = MQTTBroker(
             **plug,
             host=host, port=int(tcp.get("port", 1883)),
@@ -242,8 +256,6 @@ class Standalone:
             dist.events = self.broker.events
             dist.settings = self.broker.settings
         await self.broker.start()
-        self._isolated_hosts = [
-            v.host for v in plug.values() if hasattr(v, "host")]
 
         if self.agent_host is not None:
             # clustered: expose the session-dict service on the RPC fabric
@@ -311,7 +323,12 @@ class Standalone:
 
 async def run(config: dict) -> None:
     node = Standalone(config)
-    await node.start()
+    try:
+        await node.start()
+    except BaseException:
+        # half-started node: release listeners + isolated plugin children
+        await node.stop()
+        raise
     stop_ev = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
