@@ -348,13 +348,20 @@ class TpuMatcher:
         counts_a = np.array(res.count)
 
         # host-triggered escalation: rows whose active set (or interval
-        # budget) overflowed re-walk in one compacted sub-batch at a higher
-        # state budget — only rows that overflow even esc_k fall through
-        # to the host oracle
+        # budget) overflowed re-walk in one compacted sub-batch at a
+        # higher state budget AND a wider interval budget (a separate
+        # dispatch, so its lane width is free to differ — the host merges
+        # by slot arrays) — only rows that overflow even that fall
+        # through to the host oracle
         esc_k = min(4 * self.k_states, 128)
+        # never narrower than the base budget (a narrower re-walk is
+        # guaranteed-futile for interval overflows)
+        esc_a = max(min(4 * self.max_intervals, 256), self.max_intervals)
+        esc_slots = {}
         ovf_rows = np.nonzero(overflow[:len(queries)]
                               & (tok.lengths[:len(queries)] >= 0))[0]
-        if len(ovf_rows) and esc_k > self.k_states:
+        if len(ovf_rows) and (esc_k > self.k_states
+                              or esc_a > self.max_intervals):
             eb = _pow2_batch(len(ovf_rows))
             sub = Probes.from_tokenized(TokenizedTopics(
                 tok_h1=_pad_rows(tok.tok_h1[ovf_rows], eb),
@@ -365,15 +372,13 @@ class TpuMatcher:
             ), device=self.device)
             res2 = walk_routes(self._device_trie, sub,
                                probe_len=ct.probe_len, k_states=esc_k,
-                               max_intervals=self.max_intervals, esc_k=0)
+                               max_intervals=esc_a, esc_k=0)
             o2 = np.asarray(res2.overflow)
-            s2 = np.asarray(res2.start)
-            c2 = np.asarray(res2.count)
-            ok = ~o2[:len(ovf_rows)]
-            fixed = ovf_rows[ok]
-            starts_a[fixed] = s2[:len(ovf_rows)][ok]
-            counts_a[fixed] = c2[:len(ovf_rows)][ok]
-            overflow[fixed] = False
+            slots2, offs2 = expand_intervals(res2.start, res2.count)
+            for j, qi in enumerate(ovf_rows):
+                if not o2[j]:
+                    esc_slots[int(qi)] = slots2[offs2[j]:offs2[j + 1]]
+                    overflow[qi] = False
         slots, offs = expand_intervals(starts_a, counts_a)
         out: List[MatchedRoutes] = []
         for qi, (tenant_id, levels) in enumerate(queries):
@@ -399,7 +404,8 @@ class TpuMatcher:
                     max_group_fanout=max_group_fanout)
                     if trie is not None else MatchedRoutes())
                 continue
-            row = slots[offs[qi]:offs[qi + 1]]
+            row = (esc_slots[qi] if qi in esc_slots
+                   else slots[offs[qi]:offs[qi + 1]])
             if not tomb and delta is None:
                 # fast path: no overlay for this tenant
                 out.append(self._routes_from_slots(

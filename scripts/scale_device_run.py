@@ -126,7 +126,7 @@ def run_match(cfg, ct, dev, rec, batch, iters, k_states):
 
     # ---- routes walk: pipelined with readback + expand per iter ----------
     run_r = lambda p: walk_routes(dev, p, probe_len=ct.probe_len,
-                                  k_states=k_states, max_intervals=32)
+                                  k_states=k_states, max_intervals=64)
 
     def process(r):
         slots, _ = expand_intervals(np.asarray(r.start),

@@ -1,0 +1,32 @@
+#!/bin/bash
+# Tunnel-up measurement session (round 5), ordered by value so an early
+# tunnel flap still leaves the headline numbers on disk:
+#   1. c2@1M honest e2e headline (+ latency frontier + B/K sweep cells)
+#   2. all five BASELINE configs (count + routes modes)
+#   3. full-scale c4 (2.0GB upload), then c5 (4.4GB upload) — the long
+#      uploads go LAST; a flap mid-upload loses only the full-scale runs.
+# Each step appends to its own log; the script never aborts on failure.
+cd /root/repo || exit 1
+mkdir -p bench_results/r5_logs
+L=bench_results/r5_logs
+export BENCH_DEVICE_WAIT=180 BENCH_DEVICE_TIMEOUT=90
+
+echo "=== step 1: c2 headline + latency $(date +%T)" | tee -a $L/session.log
+BENCH_CONFIGS=2 BENCH_LATENCY=1 timeout 2400 python bench.py \
+  > $L/c2_headline.json 2> $L/c2_headline.log
+echo "step 1 rc=$? $(date +%T)" | tee -a $L/session.log
+
+echo "=== step 2: all configs $(date +%T)" | tee -a $L/session.log
+timeout 4800 python bench.py > $L/full.json 2> $L/full.log
+echo "step 2 rc=$? $(date +%T)" | tee -a $L/session.log
+
+echo "=== step 3: c4 full-scale $(date +%T)" | tee -a $L/session.log
+timeout 5400 python scripts/scale_device_run.py c4 16384 20 \
+  > $L/c4_fullscale.log 2>&1
+echo "step 3 rc=$? $(date +%T)" | tee -a $L/session.log
+
+echo "=== step 4: c5 full-scale $(date +%T)" | tee -a $L/session.log
+timeout 9000 python scripts/scale_device_run.py c5 16384 20 \
+  > $L/c5_fullscale.log 2>&1
+echo "step 4 rc=$? $(date +%T)" | tee -a $L/session.log
+echo "=== session done $(date +%T)" | tee -a $L/session.log
