@@ -27,22 +27,13 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
-def _build() -> str:
-    if not (os.path.exists(_SO)
-            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
-        subprocess.run(
-            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC,
-             "-o", _SO],
-            check=True, capture_output=True)
-    return _SO
-
-
 def load_lib():
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
-        lib = ctypes.CDLL(_build())
+        from ..utils.nativelib import compile_and_load
+        lib = compile_and_load(_SRC, _SO)
         lib.kv_open.restype = ctypes.c_void_p
         lib.kv_open.argtypes = [ctypes.c_char_p]
         lib.kv_close.argtypes = [ctypes.c_void_p]

@@ -19,30 +19,14 @@ _SRC = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native", "tokenizer.cpp")
 _SO = os.path.join(os.path.dirname(_SRC), "libtokenizer.so")
 
-_lib = None
-_lib_lock = threading.Lock()
 # below this row count, thread spawn overhead beats the parallel win
 _MT_THRESHOLD = 2048
 
 
 def load_lib():
-    global _lib
-    with _lib_lock:
-        if _lib is False:  # cached failure: don't re-spawn g++ per call
-            raise RuntimeError("native tokenizer unavailable")
-        if _lib is not None:
-            return _lib
-        try:
-            if not (os.path.exists(_SO)
-                    and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
-                subprocess.run(
-                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                     "-pthread", _SRC, "-o", _SO],
-                    check=True, capture_output=True)
-        except Exception:
-            _lib = False
-            raise
-        lib = ctypes.CDLL(_SO)
+    from ..utils.nativelib import compile_and_load
+    lib = compile_and_load(_SRC, _SO, extra_flags=("-pthread",))
+    if not getattr(lib, "_tok_typed", False):
         i32p = ctypes.POINTER(ctypes.c_int32)
         u8p = ctypes.POINTER(ctypes.c_uint8)
         base_args = [
@@ -50,8 +34,8 @@ def load_lib():
             ctypes.c_int, i32p, i32p, i32p, i32p, i32p, u8p, ctypes.c_int]
         lib.tok_topics.argtypes = base_args
         lib.tok_topics_mt.argtypes = base_args + [ctypes.c_int]
-        _lib = lib
-        return lib
+        lib._tok_typed = True
+    return lib
 
 
 def _pack(topics: Sequence) -> tuple:

@@ -29,44 +29,63 @@ def _topic_route(topic_levels: Sequence[str], topic_str: str) -> Route:
 
 
 def match_filter_host(trie: SubscriptionTrie,
-                      filter_levels: Sequence[str]) -> List[str]:
-    """Exact filter-over-topic-trie match (host fallback & test oracle)."""
+                      filter_levels: Sequence[str],
+                      limit: Optional[int] = None) -> List[str]:
+    """Exact filter-over-topic-trie match (host fallback & test oracle).
+
+    ``limit`` makes the walk scan-bounded (early exit once ``limit``
+    topics are collected — the RetainMessageMatchLimit contract): the
+    production serving path always passes it. DEPTH-first traversal so a
+    bounded lookup costs ~O(limit × depth) even for '+'-heavy filters
+    over a million-topic trie — the level-synchronous frontier expansion
+    paid the whole '+' fan-out before emitting anything (measured ~10ms
+    per fallback at limit=10 on a 200K-topic trie; DFS is ~free).
+    """
     out: List[str] = []
+    cap = limit if limit is not None else (1 << 62)
+    if cap <= 0:
+        return out
+    n_levels = len(filter_levels)
+
+    class _Full(Exception):
+        pass
+
+    def emit(r) -> None:
+        out.append(r.receiver_id)
+        if len(out) >= cap:
+            raise _Full()
 
     def collect_subtree(node: _TrieNode, skip_sys: bool) -> None:
         for r in node.routes.values():
-            out.append(r.receiver_id)
+            emit(r)
         for level, child in node.children.items():
             if skip_sys and level.startswith(topic_util.SYS_PREFIX):
                 continue
             collect_subtree(child, False)
 
-    active: List[_TrieNode] = [trie._root]
-    n = len(filter_levels)
-    for i, lvl in enumerate(filter_levels):
+    def walk(node: _TrieNode, i: int) -> None:
+        if i == n_levels:
+            for r in node.routes.values():
+                emit(r)
+            return
+        lvl = filter_levels[i]
         at_root = i == 0
         if lvl == topic_util.MULTI_WILDCARD:
-            for node in active:
-                collect_subtree(node, skip_sys=at_root)
-            return out
-        nxt: List[_TrieNode] = []
-        if lvl == topic_util.SINGLE_WILDCARD:
-            for node in active:
-                for level, child in node.children.items():
-                    if at_root and level.startswith(topic_util.SYS_PREFIX):
-                        continue
-                    nxt.append(child)
+            collect_subtree(node, skip_sys=at_root)
+        elif lvl == topic_util.SINGLE_WILDCARD:
+            for name, child in node.children.items():
+                if at_root and name.startswith(topic_util.SYS_PREFIX):
+                    continue
+                walk(child, i + 1)
         else:
-            for node in active:
-                child = node.children.get(lvl)
-                if child is not None:
-                    nxt.append(child)
-        active = nxt
-        if not active:
-            return out
-    for node in active:
-        for r in node.routes.values():
-            out.append(r.receiver_id)
+            child = node.children.get(lvl)
+            if child is not None:
+                walk(child, i + 1)
+
+    try:
+        walk(trie._root, 0)
+    except _Full:
+        pass
     return out
 
 
@@ -183,44 +202,45 @@ class RetainedIndex:
         lengths = np.asarray(lengths)[:nq]
         roots_a = np.asarray(roots[:nq])
 
-        # on-device escalation: rows whose '+'-expansion outgrew K states
-        # re-walk in a small sub-batch at a much wider K — the host oracle
-        # for a '#'-tailed filter walks whole subtrees in Python (seconds
-        # per filter on a 1M-topic trie), so every row rescued here is a
-        # ~1000x save (mirrors ops.match.walk_count_only's fused pass)
-        esc_k = min(8 * self.k_states, 256)
+        # native escalation: rows whose '+'-expansion outgrew the device
+        # lane budget resolve EXACTLY via the C++ DFS over the same
+        # compiled tables (native/retainedwalk.cpp — no lane concept, no
+        # extra XLA compile; ~two orders faster than the Python oracle,
+        # which stays as the last-resort fallback when the range budget
+        # blows or no compiler exists)
         esc = np.nonzero(overflow & (lengths >= 0)
                          & (roots_a >= 0))[0]
-        esc_map: Dict[int, np.ndarray] = {}
-        if esc.size and esc_k > self.k_states:
-            sub = [queries[i] for i in esc]
-            # floor the sub-batch at 256 lanes: retained_walk jit-compiles
-            # per (batch, k_states) shape, and letting every overflow count
-            # pick its own pow2 would recompile (seconds each) on the
-            # serving path; the floor caps the variant ladder
-            from .matcher import _pow2_batch
-            probes2, _, _ = self.device_probes(
-                sub, batch=max(256, _pow2_batch(len(sub))))
-            r2, ovf2 = self.walk_device(probes2, k_states=esc_k)
-            r2 = np.asarray(r2)[:len(sub)]
-            ovf2 = np.asarray(ovf2)[:len(sub)]
-            for j, qi in enumerate(esc):
-                if not ovf2[j]:
-                    esc_map[int(qi)] = r2[j]
-                    overflow[qi] = False
+        native_map: Dict[int, np.ndarray] = {}
+        if esc.size:
+            try:
+                from .native_retained import match_rows_native
+                ct = self._compiled
+                sub_tok = tokenize_filters(
+                    [list(queries[i][1]) for i in esc],
+                    [int(roots_a[i]) for i in esc],
+                    max_levels=ct.max_levels, salt=ct.salt)
+                rr, rn, rovf = match_rows_native(
+                    ct, sub_tok.tok_h1, sub_tok.tok_h2, sub_tok.tok_kind,
+                    sub_tok.lengths, sub_tok.roots, limit=limit)
+                for j, qi in enumerate(esc):
+                    if not rovf[j]:
+                        n = int(rn[j])
+                        s0 = rr[j, :n, 0].astype(np.int64)
+                        c0 = np.maximum(rr[j, :n, 1], 0).astype(np.int64)
+                        if limit is not None and n:
+                            cum = np.cumsum(c0)
+                            c0 = np.clip(limit - (cum - c0), 0, c0)
+                        native_map[int(qi)] = (s0, c0)
+                        overflow[qi] = False
+            except Exception:  # noqa: BLE001 — no compiler / load failure:
+                pass    # rows stay on the (exact) oracle path
 
         starts = ranges[..., 0].astype(np.int64)
         counts = np.maximum(ranges[..., 1], 0).astype(np.int64)
         host_rows = overflow | (lengths < 0)
         counts[host_rows | (roots_a < 0)] = 0   # row mask: no device expansion
-        # splice escalated rows in (widths differ: pad grid to esc_k lanes)
-        if esc_map:
-            pad = esc_k - counts.shape[1]
-            starts = np.pad(starts, ((0, 0), (0, pad)))
-            counts = np.pad(counts, ((0, 0), (0, pad)))
-            for qi, rr in esc_map.items():
-                starts[qi] = rr[:, 0]
-                counts[qi] = np.maximum(rr[:, 1], 0)
+        for qi in native_map:
+            counts[qi] = 0      # grid contributes nothing for native rows
         if limit is not None:
             # clip each query's ranges so the cumulative expansion stops
             # at the cap (scan-bounded like RetainMessageMatchLimit)
@@ -238,14 +258,23 @@ class RetainedIndex:
             recv = np.empty(0, dtype=object)
         chunks = np.split(recv, np.cumsum(counts.sum(axis=1))[:-1])
 
-        cap = limit if limit is not None else 2 ** 31 - 1
         out: List[List[str]] = []
         for qi, (tenant_id, levels) in enumerate(queries):
             if roots_a[qi] < 0:
                 out.append([])
+            elif qi in native_map:
+                s0, c0 = native_map[qi]
+                tot = int(c0.sum())
+                if tot:
+                    o = np.cumsum(c0) - c0
+                    flat = (np.arange(tot, dtype=np.int64)
+                            - np.repeat(o, c0) + np.repeat(s0, c0))
+                    out.append(list(self._receiver_arr[flat]))
+                else:
+                    out.append([])
             elif host_rows[qi]:
                 out.append(match_filter_host(self.tries[tenant_id],
-                                             list(levels))[:cap])
+                                             list(levels), limit=limit))
             else:
                 out.append(list(chunks[qi]))
         return out

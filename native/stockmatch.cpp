@@ -59,9 +59,14 @@ struct TopicTrieNode {
     std::string level_name;
     bool wildcard_matchable = false;
     std::map<std::string, TopicTrieNode *> children;
-    int topic_id = -1;  // >=0: this node IS a user topic (values non-empty)
+    // instance ids of every probe-batch topic that lands on this node:
+    // DUPLICATE topics are distinct instances — each needs its route set
+    // delivered, so matched_entries credits every instance (the earlier
+    // last-writer-wins int dropped duplicates and undercounted the stock
+    // side ~2x on Zipf probe streams)
+    std::vector<int> topic_ids;
 
-    bool is_user_topic() const { return topic_id >= 0; }
+    bool is_user_topic() const { return !topic_ids.empty(); }
 };
 
 struct TopicTrieArena {
@@ -88,7 +93,7 @@ void add_topic(TopicTrieArena &arena, TopicTrieNode *root,
         }
         node = it->second;
     }
-    node->topic_id = topic_id;
+    node->topic_ids.push_back(topic_id);
 }
 
 // ---------------------------------------------------------------------------
@@ -364,14 +369,25 @@ void match_all(const std::vector<std::vector<std::string>> &routes,
         if (mit == memo.end()) {
             exp.seek(filter);
             ++stats.seeks;
-            if (!exp.valid()) break;  // no more filters can match
+            if (!exp.valid()) {
+                if (std::getenv("STOCKMATCH_DEBUG")) {
+                    std::string f;
+                    for (auto &l : filter) { f += l; f += '/'; }
+                    std::fprintf(stderr,
+                                 "DRAIN at itr=%zu/%zu filter=%s\n",
+                                 itr, routes.size(), f.c_str());
+                }
+                break;  // no more filters can match
+            }
             std::vector<std::string> to_match = exp.key();
             if (to_match == filter) {
                 std::vector<int> ids;
                 for (TopicTrieNode *n : exp.value_topics()) {
-                    per_topic[n->topic_id] += 1;
-                    ++stats.matched_entries;
-                    ids.push_back(n->topic_id);
+                    for (int id : n->topic_ids) {
+                        per_topic[id] += 1;
+                        ++stats.matched_entries;
+                        ids.push_back(id);
+                    }
                 }
                 memo.emplace(memo_key(filter), std::move(ids));
                 ++itr;
@@ -472,5 +488,12 @@ int main(int argc, char **argv) {
         (unsigned long long)stats.matched_entries,
         stats.matched_entries / secs, (unsigned long long)stats.seeks,
         (unsigned long long)stats.probes, secs);
+    // STOCKMATCH_DUMP=<path>: per-topic match counts from the timed
+    // passes (parity diagnostics vs the oracle — tests/test_stockmatch)
+    if (const char *dump = std::getenv("STOCKMATCH_DUMP")) {
+        std::ofstream df(dump);
+        for (size_t i = 0; i < per_topic.size(); ++i)
+            df << per_topic[i] << "\n";
+    }
     return 0;
 }

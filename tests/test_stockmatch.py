@@ -42,8 +42,11 @@ def test_stockmatch_totals_match_oracle(tmp_path, n_subs, batch, seed):
     for i, levels in enumerate(filters):
         trie.add(Route(matcher=_mk_matcher(levels), broker_id=0,
                        receiver_id=f"r{i}", deliverer_key="d0"))
-    topics = {tuple(line.split("/"))
-              for line in topics_path.read_text().splitlines() if line}
+    # per-INSTANCE, not per-unique: duplicate probe topics are distinct
+    # publishes, each needing its route set delivered (the original set
+    # comprehension here masked a ~2x stock undercount on Zipf streams)
+    topics = [tuple(line.split("/"))
+              for line in topics_path.read_text().splitlines() if line]
     expect = sum(len(trie.match(list(t)).all_routes()) for t in topics)
 
     assert res["matched_entries"] == expect
