@@ -903,18 +903,29 @@ def main():
     record["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
     record["platform"] = jax.devices()[0].platform
     record["n_subs"] = N_SUBS
-    # persist last-known-good ONLY for a real DEVICE headline: a partial
-    # run (broker-only, error path) or a CPU-platform run must never
-    # clobber the stale-fallback record the driver may later publish
-    if (record.get("value", 0) > 0
-            and "matched_routes" in record["metric"]
-            and record["platform"] != "cpu"):
-        try:
-            os.makedirs(os.path.dirname(LAST_GOOD_PATH), exist_ok=True)
-            with open(LAST_GOOD_PATH, "w") as f:
-                json.dump(record, f)
-        except OSError as e:  # noqa: BLE001 — persistence is best-effort
-            log(f"last_good write failed: {e}")
+    # persist last-known-good for a real headline only (a partial
+    # broker-only or error-path run must never clobber it). A CPU-platform
+    # headline IS a valid record — the stock baseline ran on the same
+    # host CPU, so vs_baseline stays same-hardware honest and the
+    # platform label tells the reader exactly what it is — but it never
+    # OVERWRITES a device-measured record.
+    if record.get("value", 0) > 0 and "matched_routes" in record["metric"]:
+        keep = True
+        if record["platform"] == "cpu":
+            try:
+                with open(LAST_GOOD_PATH) as f:
+                    existing = json.load(f)
+                keep = (not isinstance(existing, dict)
+                        or existing.get("platform") == "cpu")
+            except (OSError, ValueError):
+                keep = True     # nothing recorded yet
+        if keep:
+            try:
+                os.makedirs(os.path.dirname(LAST_GOOD_PATH), exist_ok=True)
+                with open(LAST_GOOD_PATH, "w") as f:
+                    json.dump(record, f)
+            except OSError as e:  # noqa: BLE001 — best-effort
+                log(f"last_good write failed: {e}")
     print(json.dumps(record), flush=True)
 
 
