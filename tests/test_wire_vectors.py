@@ -272,7 +272,107 @@ class TestGoldenVectorsV4:
             await b.stop()
 
 
+# v3.1.1 CONNECT, clean-session CLEAR (persistent), client id "pers"
+CONNECT_V4_PERSIST = bytes([
+    0x10, 0x10,
+    0x00, 0x04, 0x4D, 0x51, 0x54, 0x54,
+    0x04,
+    0x00,                                     # clean session NOT set
+    0x00, 0x3C,
+    0x00, 0x04, 0x70, 0x65, 0x72, 0x73,       # "pers"
+])
+CONNACK_V4_PRESENT = bytes([0x20, 0x02, 0x01, 0x00])
+
+# QoS2 PUBLISH pid=0x0007 "a/b" payload "q2"
+PUBLISH_V4_Q2 = bytes([
+    0x34, 0x09,
+    0x00, 0x03, 0x61, 0x2F, 0x62,
+    0x00, 0x07,
+    0x71, 0x32,
+])
+PUBREC_7 = bytes([0x50, 0x02, 0x00, 0x07])
+PUBREL_7 = bytes([0x62, 0x02, 0x00, 0x07])
+PUBCOMP_7 = bytes([0x70, 0x02, 0x00, 0x07])
+
+# v5 UNSUBSCRIBE pid=5, props len 0, "a/b"
+UNSUBSCRIBE_V5_AB = bytes([
+    0xA2, 0x08,
+    0x00, 0x05,
+    0x00,                                     # properties length 0
+    0x00, 0x03, 0x61, 0x2F, 0x62,
+])
+
+# v5 SUBSCRIBE pid=4, props len 0, "a/b" options=0x00
+SUBSCRIBE_V5_AB = bytes([
+    0x82, 0x09,
+    0x00, 0x04,
+    0x00,
+    0x00, 0x03, 0x61, 0x2F, 0x62,
+    0x00,
+])
+
+
+class TestGoldenVectorsV4More:
+    async def test_session_present_flag_roundtrip(self):
+        """[MQTT-3.2.2-2]: reconnecting a persistent session sets the
+        CONNACK session-present flag; the first connect clears it."""
+        b = await _broker()
+        try:
+            c = await RawConn(b.port).open()
+            await c.send(CONNECT_V4_PERSIST)
+            assert await c.recv(4) == bytes([0x20, 0x02, 0x00, 0x00])
+            await c.send(DISCONNECT_V4)
+            await c.close()
+            await asyncio.sleep(0.2)
+            c2 = await RawConn(b.port).open()
+            await c2.send(CONNECT_V4_PERSIST)
+            assert await c2.recv(4) == CONNACK_V4_PRESENT
+            await c2.send(DISCONNECT_V4)
+            await c2.close()
+        finally:
+            await b.stop()
+
+    async def test_qos2_four_packet_exchange(self):
+        """PUBREC/PUBREL/PUBCOMP byte-exact [MQTT-4.3.3]."""
+        b = await _broker()
+        try:
+            c = await RawConn(b.port).open()
+            await c.send(CONNECT_V4)
+            assert await c.recv(4) == CONNACK_V4_OK
+            await c.send(PUBLISH_V4_Q2)
+            assert await c.recv(4) == PUBREC_7
+            await c.send(PUBREL_7)
+            assert await c.recv(4) == PUBCOMP_7
+            await c.close()
+        finally:
+            await b.stop()
+
+
 class TestGoldenVectorsV5:
+    async def test_unsuback_reason_codes(self):
+        """v5 UNSUBACK: 0x00 after a real subscription, 0x11 (No
+        subscription existed) when nothing was subscribed."""
+        b = await _broker()
+        try:
+            c = await RawConn(b.port).open()
+            await c.send(CONNECT_V5)
+            await c.recv_packet()
+            # unsubscribe with no subscription -> 0x11
+            await c.send(UNSUBSCRIBE_V5_AB)
+            pkt = await c.recv_packet()
+            assert pkt[0] == 0xB0 and pkt[-1] == 0x11
+            # subscribe, then unsubscribe -> 0x00
+            await c.send(SUBSCRIBE_V5_AB)
+            assert (await c.recv_packet())[0] == 0x90
+            # same vector, pid 6 (derivation idiom: pid is bytes 2-3)
+            await c.send(UNSUBSCRIBE_V5_AB[:3] + bytes([0x06])
+                         + UNSUBSCRIBE_V5_AB[4:])
+            pkt = await c.recv_packet()
+            assert pkt[0] == 0xB0 and pkt[-1] == 0x00
+            await c.close()
+        finally:
+            await b.stop()
+
     async def test_connect_v5_connack(self):
         b = await _broker()
         try:
