@@ -19,9 +19,13 @@ import struct
 from typing import List, Optional, Sequence, Tuple
 
 from ..models.oracle import MatchedRoutes, Route
-from ..rpc.fabric import (RPCClient, RPCServer, ServiceRegistry, _len16,
+from ..resilience.policy import (DEFAULT_RETRY_POLICY, RetryPolicy,
+                                 is_idempotent)
+from ..rpc.fabric import (RPCCircuitOpenError, RPCServer,
+                          RPCTransportError, ServiceRegistry, _len16,
                           _read16)
 from ..types import RouteMatcher
+from ..utils.metrics import FABRIC, FabricMetric
 from . import worker as dw
 # ONE match-result codec, owned by the worker module (coproc RO replies
 # and this RPC service speak the same frames)
@@ -87,9 +91,22 @@ class RemoteDistWorker:
     local DistWorker, but served by a dist-worker process over RPC."""
 
     def __init__(self, registry: ServiceRegistry, *,
-                 service: str = SERVICE) -> None:
+                 service: str = SERVICE,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 call_timeout: float = 1.0,
+                 mutation_timeout: float = 10.0) -> None:
         self.registry = registry
         self.service = service
+        self.retry_policy = retry_policy or DEFAULT_RETRY_POLICY
+        # per-attempt MATCH timeout: deliberately SMALLER than the match
+        # deadline budget (DistService.MATCH_DEADLINE_S = 5s) so a dropped
+        # frame leaves room for several retries within the scope (the
+        # budget caps each attempt further via remaining_budget)
+        self.call_timeout = call_timeout
+        # mutations wait longer (the worker-side _mutate leadership wait
+        # is 5s) but must not hang SUBSCRIBE for the 30s default against
+        # a blackholed endpoint
+        self.mutation_timeout = mutation_timeout
 
     # DistService lifecycle hooks
     async def start(self) -> None:
@@ -103,16 +120,15 @@ class RemoteDistWorker:
         raise RuntimeError("remote dist worker has no local matcher; "
                            "introspect on the worker process")
 
-    def _client(self, key: str) -> RPCClient:
-        c = self.registry.client(self.service, key)
-        if c is None:
-            raise RuntimeError(f"no endpoints for service {self.service}")
-        return c
-
     async def add_route(self, tenant_id: str, route: Route) -> str:
         payload = _len16(tenant_id.encode()) + _enc_route(route)
-        out = await self._client(tenant_id).call(
-            self.service, "add_route", payload, order_key=tenant_id)
+        # breaker-aware pick, normalized taxonomy; NOT auto-retried —
+        # mutations aren't on the idempotency whitelist, the caller owns
+        # the ambiguity of a transport failure mid-mutation
+        out = await self.registry.call_resilient(
+            self.service, tenant_id, "add_route", payload,
+            order_key=tenant_id, policy=self.retry_policy,
+            timeout=self.mutation_timeout)
         return out.decode()
 
     async def remove_route(self, tenant_id: str, matcher: RouteMatcher,
@@ -122,8 +138,10 @@ class RemoteDistWorker:
                       receiver_id=receiver_url[1],
                       deliverer_key=receiver_url[2], incarnation=incarnation)
         payload = _len16(tenant_id.encode()) + _enc_route(route)
-        out = await self._client(tenant_id).call(
-            self.service, "remove_route", payload, order_key=tenant_id)
+        out = await self.registry.call_resilient(
+            self.service, tenant_id, "remove_route", payload,
+            order_key=tenant_id, policy=self.retry_policy,
+            timeout=self.mutation_timeout)
         return out.decode()
 
     async def match_batch(self, queries: Sequence[Tuple[str, Sequence[str]]],
@@ -132,15 +150,6 @@ class RemoteDistWorker:
                           linearized: bool = False) -> List[MatchedRoutes]:
         if not queries:
             return []
-        # shard the batch by the SAME rendezvous key mutations use (tenant),
-        # so each sub-batch lands on the worker that holds those routes;
-        # sub-calls run concurrently and results stitch back by index
-        by_ep: dict = {}
-        for qi, (tenant_id, levels) in enumerate(queries):
-            ep = self.registry.pick(self.service, tenant_id)
-            if ep is None:
-                raise RuntimeError(f"no endpoints for {self.service}")
-            by_ep.setdefault(ep, []).append(qi)
 
         async def call_one(ep: str, idxs: List[int]) -> List[MatchedRoutes]:
             payload = bytearray(struct.pack(
@@ -151,7 +160,8 @@ class RemoteDistWorker:
                 payload += _len16(tenant_id.encode())
                 payload += _len16("/".join(levels).encode())
             out = await self.registry.client_for(ep).call(
-                self.service, "match_batch", bytes(payload))
+                self.service, "match_batch", bytes(payload),
+                timeout=self.call_timeout)
             (n,) = struct.unpack_from(">I", out, 0)
             pos = 4
             results = []
@@ -160,12 +170,60 @@ class RemoteDistWorker:
                 results.append(m)
             return results
 
-        parts = await asyncio.gather(
-            *(call_one(ep, idxs) for ep, idxs in by_ep.items()))
+        # Shard the batch by the SAME rendezvous key mutations use (tenant),
+        # so each sub-batch lands on the worker that holds those routes;
+        # sub-calls run concurrently and results stitch back by index.
+        # Match is an RO coproc query on the whitelist: sub-batches that
+        # die on a transport failure re-shard over the surviving endpoints
+        # (the breaker-aware pick skips open circuits, ``exclude`` masks
+        # the endpoints THIS batch already failed against) and retry with
+        # backoff — replicated workers then serve the failed tenants'
+        # matches from the next-ranked replica (ISSUE 1 failover). A
+        # custom service name not registered idempotent gets fail-fast.
+        may_retry = is_idempotent(self.service, "match_batch")
         stitched: List[Optional[MatchedRoutes]] = [None] * len(queries)
-        for (ep, idxs), res in zip(by_ep.items(), parts):
-            for qi, m in zip(idxs, res):
-                stitched[qi] = m
+        remaining = list(range(len(queries)))
+        failed_eps: set = set()
+        attempt = 0
+        while remaining:
+            attempt += 1
+            by_ep: dict = {}
+            for qi in remaining:
+                ep = self.registry.pick(self.service, queries[qi][0],
+                                        exclude=failed_eps)
+                if ep is None:
+                    raise RPCTransportError(
+                        f"no endpoints for {self.service}")
+                by_ep.setdefault(ep, []).append(qi)
+            parts = await asyncio.gather(
+                *(call_one(ep, idxs) for ep, idxs in by_ep.items()),
+                return_exceptions=True)
+            still_failed: List[int] = []
+            last_err: Optional[BaseException] = None
+            all_never_sent = True
+            for (ep, idxs), res in zip(by_ep.items(), parts):
+                if isinstance(res, RPCTransportError):
+                    failed_eps.add(ep)
+                    still_failed.extend(idxs)
+                    last_err = res
+                    if not isinstance(res, RPCCircuitOpenError):
+                        all_never_sent = False
+                elif isinstance(res, BaseException):
+                    raise res       # handler/codec error: not retryable
+                else:
+                    for qi, m in zip(idxs, res):
+                        stitched[qi] = m
+            if not still_failed:
+                break
+            # circuit-open refusals were never transmitted, so a round
+            # that only hit open circuits may fail over regardless of
+            # the whitelist
+            if not (may_retry or all_never_sent) \
+                    or not self.retry_policy.should_retry(attempt):
+                raise last_err
+            FABRIC.inc(FabricMetric.RPC_RETRIES)
+            await asyncio.sleep(self.retry_policy.backoff(attempt))
+            remaining = still_failed
         return stitched
 
     async def purge_broker_routes(self, broker_id: int,

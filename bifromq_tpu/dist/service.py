@@ -67,6 +67,11 @@ class DistService:
             from .worker import DistWorker
             worker = DistWorker()
         self.worker = worker
+        # degradation surface (ISSUE 1): a local worker's host-oracle
+        # fallback reports MATCH_DEGRADED through the event stream (the
+        # remote worker meters in its own process)
+        if hasattr(worker, "on_degraded"):
+            worker.on_degraded = self._on_match_degraded
         # cross-broker delivery plane (clustered frontends): set by the
         # starter — registry resolving mqtt-deliverer:{server_id} + this
         # node's own server id (local keys skip the hop)
@@ -276,15 +281,30 @@ class DistService:
             return results
         return process
 
+    # match-path deadline budget (ISSUE 1): caps every RPC hop to a
+    # remote worker (per-attempt timeout + retries) and gates the local
+    # device walk at each range's dispatch boundary — an exhausted budget
+    # degrades to the host oracle instead of failing the publish. (An
+    # in-flight device call is not preempted; only remote hops carry a
+    # hard per-attempt timeout.)
+    MATCH_DEADLINE_S = 5.0
+
+    def _on_match_degraded(self, n_queries: int, reason: str) -> None:
+        self.events.report(Event(EventType.MATCH_DEGRADED, "-",
+                                 {"queries": n_queries,
+                                  "reason": reason}))
+
     async def _match_missing(self, tenant_id, miss_topics, mpf, mgf):
-        return await self.worker.match_batch(
-            [(tenant_id, topic_util.parse(t)) for t in miss_topics],
-            max_persistent_fanout=(
-                mpf if mpf is not None
-                else Setting.MaxPersistentFanout.default),
-            max_group_fanout=(
-                mgf if mgf is not None
-                else Setting.MaxGroupFanout.default))
+        from ..resilience.policy import deadline_scope
+        with deadline_scope(self.MATCH_DEADLINE_S):
+            return await self.worker.match_batch(
+                [(tenant_id, topic_util.parse(t)) for t in miss_topics],
+                max_persistent_fanout=(
+                    mpf if mpf is not None
+                    else Setting.MaxPersistentFanout.default),
+                max_group_fanout=(
+                    mgf if mgf is not None
+                    else Setting.MaxGroupFanout.default))
 
     async def _fan_out(self, tenant_id: str, call: PubCall,
                        matched: MatchedRoutes) -> int:

@@ -15,7 +15,9 @@ serve matches (the reference's replica-spread reads).
 
 from __future__ import annotations
 
+import logging
 import struct
+import time as _time
 from typing import List, Optional, Sequence, Tuple
 
 from ..kv import schema
@@ -23,8 +25,11 @@ from ..kv.engine import IKVSpace, KVWriteBatch
 from ..kv.range import IKVRangeCoProc
 from ..models.matcher import TpuMatcher
 from ..models.oracle import MatchedRoutes, Route
+from ..resilience.faults import get_injector
+from ..resilience.policy import current_deadline
 from ..types import RouteMatcher
 from ..utils import topic as topic_util
+from ..utils.metrics import FABRIC, FabricMetric
 
 _OP_ADD = 0
 _OP_REMOVE = 1
@@ -467,7 +472,6 @@ class DistWorker:
         in multi-voter groups still raise after the timeout — leader
         forwarding rides the RPC fabric)."""
         import asyncio
-        import time as _time
 
         from ..raft.node import NotLeaderError
 
@@ -542,17 +546,64 @@ class DistWorker:
                 route.incarnation))
         return len(doomed)
 
+    # ---------------- graceful degradation (ISSUE 1) -----------------------
+
+    # called with (n_queries, reason) whenever a range's match is served
+    # from the host oracle; DistService hooks this to emit MATCH_DEGRADED
+    # events (the worker itself stays event-plumbing-free)
+    on_degraded = None
+
+    def _match_on_range(self, coproc, sub, max_persistent_fanout,
+                        max_group_fanout, deadline):
+        """One range's match dispatch behind the failure boundary: a
+        TPU-matcher fault (device error, injected chaos) or an exhausted
+        deadline budget serves the HOST-ORACLE fallback — the matcher's
+        authoritative per-tenant tries, exact by construction — instead
+        of failing the publish (Tailwind's accelerator-offload-behind-a-
+        failure-boundary discipline; ops/match.py already does this for
+        bounded-work overflow)."""
+        try:
+            get_injector().check_raise("matcher", "tpu-matcher", "match")
+            if deadline is not None and _time.monotonic() >= deadline:
+                raise TimeoutError("match deadline budget exhausted")
+            return coproc.matcher.match_batch(
+                sub, max_persistent_fanout=max_persistent_fanout,
+                max_group_fanout=max_group_fanout)
+        except Exception as e:  # noqa: BLE001 — degrade, don't fail
+            oracle = getattr(coproc.matcher, "match_from_tries", None)
+            if oracle is None:
+                raise       # no authoritative host state: nothing to serve
+            FABRIC.inc(FabricMetric.MATCH_DEGRADED, len(sub))
+            logging.getLogger(__name__).warning(
+                "match degraded to host oracle (%d queries): %r",
+                len(sub), e)
+            cb = self.on_degraded
+            if cb is not None:
+                cb(len(sub), repr(e))
+            return oracle(sub, max_persistent_fanout=max_persistent_fanout,
+                          max_group_fanout=max_group_fanout)
+
     async def match_batch(self, queries, *, max_persistent_fanout,
-                          max_group_fanout, linearized: bool = False):
+                          max_group_fanout, linearized: bool = False,
+                          deadline: Optional[float] = None):
         """Serve matches from this replica's derived matchers, unioning
         across every range whose boundary intersects the query tenant's
         keyspace (per-tenant boundary intersect ≈ batchDist:515).
 
         ``linearized=True`` adds a read-index barrier per touched range
-        (leader only); the pub hot path uses the default local read."""
-        from ..models.oracle import MatchedRoutes
+        (leader only); the pub hot path uses the default local read.
 
+        ``deadline`` (absolute ``time.monotonic()``; defaults to the
+        propagated RPC deadline budget) is checked at each range's
+        dispatch boundary: an already-exhausted budget (or a raising
+        device path) degrades that range to the host oracle rather than
+        timing the publish out. A device call that STALLS mid-dispatch is
+        not preempted — remote hops surface that through the RPC-level
+        per-attempt timeout instead."""
         from ..models.oracle import PERSISTENT_SUB_BROKER_ID
+
+        if deadline is None:
+            deadline = current_deadline()
 
         # resolve the range set per tenant once; each range walks ONLY the
         # queries whose tenant keyspace intersects it
@@ -588,9 +639,8 @@ class DistWorker:
         for rid, idxs in range_queries.items():
             sub = [queries[qi] for qi in idxs]
             coproc = self.store.coprocs[rid]
-            res = coproc.matcher.match_batch(
-                sub, max_persistent_fanout=max_persistent_fanout,
-                max_group_fanout=max_group_fanout)
+            res = self._match_on_range(coproc, sub, max_persistent_fanout,
+                                       max_group_fanout, deadline)
             rec = getattr(coproc, "load_recorder", None)
             for qi, m in zip(idxs, res):
                 per_query[(rid, qi)] = m

@@ -350,6 +350,10 @@ class ClusterKVClient:
         self.cluster = cluster
         self.seeds = list(seeds or [])   # store addresses to poll when the
         self.service = f"basekv:{cluster}"  # landscape isn't CRDT-replicated
+        # NOTE: basekv deliberately does NOT use the idempotency
+        # whitelist — _call below is its own at-least-once retry loop
+        # (leader-hint rerouting incl. mutations, whose idempotence the
+        # keyspace contracts guarantee; see mutate()'s docstring)
         # range_id -> (start, end, leader_store, {store_id: address})
         self._routes: List[Tuple[bytes, Optional[bytes], str,
                                  Optional[str], Dict[str, str]]] = []

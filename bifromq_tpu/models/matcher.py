@@ -387,22 +387,18 @@ class TpuMatcher:
             if roots[qi] < 0:
                 # tenant absent from the base snapshot: all its routes (if
                 # any) are newer than the base — serve from authoritative
-                if tenant_id in self.tries:
-                    out.append(self.tries[tenant_id].match(
-                        list(levels),
-                        max_persistent_fanout=max_persistent_fanout,
-                        max_group_fanout=max_group_fanout))
-                else:
-                    out.append(MatchedRoutes())
+                out.append(self.match_from_tries(
+                    [(tenant_id, levels)],
+                    max_persistent_fanout=max_persistent_fanout,
+                    max_group_fanout=max_group_fanout)[0])
                 continue
             if overflow[qi] or tok.lengths[qi] < 0:
                 # even the fused device escalation overflowed (or the topic
                 # is too deep for the walk shape): host oracle re-match
-                trie = self.tries.get(tenant_id)
-                out.append(trie.match(
-                    list(levels), max_persistent_fanout=max_persistent_fanout,
-                    max_group_fanout=max_group_fanout)
-                    if trie is not None else MatchedRoutes())
+                out.append(self.match_from_tries(
+                    [(tenant_id, levels)],
+                    max_persistent_fanout=max_persistent_fanout,
+                    max_group_fanout=max_group_fanout)[0])
                 continue
             row = (esc_slots[qi] if qi in esc_slots
                    else slots[offs[qi]:offs[qi + 1]])
@@ -419,6 +415,23 @@ class TpuMatcher:
     def match(self, tenant_id: str, topic: str, **kwargs) -> MatchedRoutes:
         return self.match_batch([(tenant_id, topic_util.parse(topic))],
                                 **kwargs)[0]
+
+    def match_from_tries(self, queries: Sequence[Tuple[str, Sequence[str]]],
+                         *, max_persistent_fanout: int = UNCAPPED_FANOUT,
+                         max_group_fanout: int = UNCAPPED_FANOUT
+                         ) -> List[MatchedRoutes]:
+        """Match straight from the authoritative host tries — the ONE
+        exact-oracle fallback surface, shared by the walk's overflow path
+        and the dist worker's fault/deadline degradation path (keeping
+        their semantics identical by construction)."""
+        out: List[MatchedRoutes] = []
+        for tenant_id, levels in queries:
+            trie = self.tries.get(tenant_id)
+            out.append(trie.match(
+                list(levels), max_persistent_fanout=max_persistent_fanout,
+                max_group_fanout=max_group_fanout)
+                if trie is not None else MatchedRoutes())
+        return out
 
     @staticmethod
     def _routes_from_slots(ct: CompiledTrie, row: np.ndarray,

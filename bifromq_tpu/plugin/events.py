@@ -35,6 +35,9 @@ class EventType(enum.Enum):
     UNSUB_ACKED = "unsub_acked"
     # dist family
     DIST_ERROR = "dist_error"
+    # TPU-matcher failure/deadline served via the host-oracle fallback
+    # (ISSUE 1 graceful degradation — delivery correct, device path down)
+    MATCH_DEGRADED = "match_degraded"
     PERSISTENT_FANOUT_THROTTLED = "persistent_fanout_throttled"
     GROUP_FANOUT_THROTTLED = "group_fanout_throttled"
     # lwt / retain

@@ -22,7 +22,13 @@ class InMemTransport(ITransport):
         self._blocked: Set[frozenset] = set()
         self._down: Set[str] = set()
         self.drop_fn: Optional[Callable[[str, str, object], bool]] = None
+        # latency injection (ISSUE 1 chaos surface): returns how many pump
+        # rounds to defer a message (0 = deliver now). Lets tests slow the
+        # append path without severing it — raft must still commit.
+        self.delay_fn: Optional[Callable[[str, str, object], int]] = None
+        self._delayed: Deque[Tuple[int, str, str, object]] = deque()
         self.delivered = 0
+        self.deferred = 0
 
     def register(self, node: RaftNode) -> None:
         self.nodes[node.id] = node
@@ -61,15 +67,40 @@ class InMemTransport(ITransport):
     # ---------------- pumping ----------------------------------------------
 
     def pump(self, max_msgs: int = 10_000) -> int:
-        """Deliver queued messages (and those they generate). Returns count."""
+        """Deliver queued messages (and those they generate). Returns the
+        number processed; while messages sit deferred the return stays
+        nonzero, so drain-until-quiet drivers keep pumping them ripe."""
         n = 0
+        # age the deferred set one round; ripe messages deliver DIRECTLY
+        # (never re-consulting delay_fn — a deterministic delay_fn would
+        # otherwise re-defer the same message forever)
+        if self._delayed:
+            for _ in range(len(self._delayed)):
+                rounds, to, sender, msg = self._delayed.popleft()
+                if rounds > 1:
+                    self._delayed.append((rounds - 1, to, sender, msg))
+                    continue
+                n += 1
+                if self._deliverable(to, sender, msg):
+                    node = self.nodes.get(to)
+                    if node is not None:
+                        node.receive(sender, msg)
+                        self.delivered += 1
         while self.queue and n < max_msgs:
             to, sender, msg = self.queue.popleft()
             n += 1
             if not self._deliverable(to, sender, msg):
                 continue
+            if self.delay_fn is not None:
+                rounds = self.delay_fn(to, sender, msg)
+                if rounds > 0:
+                    self._delayed.append((rounds, to, sender, msg))
+                    self.deferred += 1
+                    continue
             node = self.nodes.get(to)
             if node is not None:
                 node.receive(sender, msg)
                 self.delivered += 1
-        return n
+        # still-deferred messages are pending work: report it so callers
+        # looping `while pump():` don't stop with traffic in flight
+        return n if not self._delayed else max(n, 1)

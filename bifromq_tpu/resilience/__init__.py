@@ -1,0 +1,28 @@
+"""Resilience fabric: retry/backoff policies, circuit breakers, fault
+injection (SURVEY.md §2.4 — the reference rides gRPC deadlines/retries and
+the traffic governor steers clients off dead servers; our asyncio
+re-expression provides the same discipline here).
+
+- ``policy``: RetryPolicy (exponential backoff + full jitter), per-call
+  deadline budgets propagated across RPC hops, idempotency whitelist.
+- ``breaker``: per-endpoint circuit breaker (closed → open → half-open)
+  fed by call outcomes; ``ServiceRegistry`` consults it so rendezvous
+  hashing fails over around open circuits.
+- ``faults``: process-global FaultInjector hooked into the RPC fabric's
+  frame I/O (drop/delay/corrupt/error/disconnect by service/method/
+  probability) — the TCP fabric's counterpart of
+  ``raft.transport.InMemTransport.partition/kill``.
+"""
+
+from .breaker import BreakerRegistry, CircuitBreaker
+from .faults import FaultInjector, FaultRule, get_injector
+from .policy import (DEFAULT_RETRY_POLICY, RetryPolicy, current_deadline,
+                     deadline_scope, is_idempotent, register_idempotent,
+                     remaining_budget)
+
+__all__ = [
+    "BreakerRegistry", "CircuitBreaker", "FaultInjector", "FaultRule",
+    "get_injector", "RetryPolicy", "DEFAULT_RETRY_POLICY",
+    "current_deadline", "deadline_scope", "remaining_budget",
+    "is_idempotent", "register_idempotent",
+]

@@ -1,0 +1,151 @@
+"""Per-endpoint circuit breakers (closed → open → half-open).
+
+The traffic-governor analog of the reference steering tenants off dead
+servers: call outcomes feed a breaker per endpoint; ``ServiceRegistry``
+consults the breaker set so rendezvous hashing skips open circuits and
+fails over to the next-ranked live server. Transitions are metered through
+``utils.metrics.FABRIC``.
+
+State machine (classic Nygard breaker):
+
+- CLOSED: all traffic flows; ``failure_threshold`` CONSECUTIVE transport
+  failures trip it open (a status-1 handler error is a *successful* round
+  trip — the server is alive — and resets the streak).
+- OPEN: picks avoid the endpoint for ``recovery_time`` seconds.
+- HALF_OPEN: after recovery_time, a bounded number of probe calls pass;
+  one success closes the circuit, one failure re-opens it (with the full
+  recovery window again).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+def _meter(metric_name: str) -> None:
+    from ..utils.metrics import FABRIC, FabricMetric
+    FABRIC.inc(FabricMetric(metric_name))
+
+
+class CircuitBreaker:
+    def __init__(self, *, failure_threshold: int = 5,
+                 recovery_time: float = 1.0,
+                 half_open_max_probes: int = 1,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.failure_threshold = failure_threshold
+        self.recovery_time = recovery_time
+        self.half_open_max_probes = half_open_max_probes
+        self._clock = clock
+        self._state = CLOSED
+        self._failures = 0          # consecutive failure streak
+        self._opened_at = 0.0
+        self._probes_inflight = 0
+        # observability
+        self.open_count = 0
+        self.last_error: Optional[str] = None
+
+    # ---------------- state ------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        """Current state; lazily advances OPEN → HALF_OPEN by the clock."""
+        if (self._state == OPEN
+                and self._clock() - self._opened_at >= self.recovery_time):
+            self._state = HALF_OPEN
+            self._probes_inflight = 0
+            _meter("breaker_half_open_total")
+        return self._state
+
+    def available(self) -> bool:
+        """Non-consuming routing check (used by pick()): may this endpoint
+        receive traffic right now? HALF_OPEN counts as available — the
+        probe budget is charged by ``allow()`` at call time."""
+        return self.state != OPEN
+
+    def allow(self) -> bool:
+        """Consuming admission check at call time. HALF_OPEN charges one
+        probe slot; excess concurrent probes are refused."""
+        s = self.state
+        if s == CLOSED:
+            return True
+        if s == OPEN:
+            return False
+        if self._probes_inflight >= self.half_open_max_probes:
+            return False
+        self._probes_inflight += 1
+        return True
+
+    # ---------------- outcome feed -----------------------------------------
+
+    def release_probe(self) -> None:
+        """Return an admission charged by ``allow()`` WITHOUT a verdict
+        (cancelled call, caller-budget timeout): the probe budget must
+        not leak, or a HALF_OPEN breaker wedges refusing forever."""
+        if self._probes_inflight > 0:
+            self._probes_inflight -= 1
+
+    def record_success(self) -> None:
+        if self._state == HALF_OPEN:
+            _meter("breaker_closed_total")
+        self._state = CLOSED
+        self._failures = 0
+        self._probes_inflight = 0
+
+    def record_failure(self, error: Optional[str] = None) -> None:
+        self.last_error = error
+        if self._state == HALF_OPEN:
+            self._trip()
+            return
+        self._failures += 1
+        if self._state == CLOSED and self._failures >= self.failure_threshold:
+            self._trip()
+
+    def _trip(self) -> None:
+        self._state = OPEN
+        self._opened_at = self._clock()
+        self._failures = 0
+        self._probes_inflight = 0
+        self.open_count += 1
+        _meter("breaker_open_total")
+
+    def force_open(self) -> None:
+        """Operator/test hook: trip immediately."""
+        self._trip()
+
+    def snapshot(self) -> dict:
+        return {"state": self.state, "failures": self._failures,
+                "open_count": self.open_count,
+                "last_error": self.last_error}
+
+
+class BreakerRegistry:
+    """One breaker per endpoint address, created lazily with shared
+    parameters. The unit ``ServiceRegistry`` routes around."""
+
+    def __init__(self, *, failure_threshold: int = 5,
+                 recovery_time: float = 1.0,
+                 half_open_max_probes: int = 1,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self._kw = dict(failure_threshold=failure_threshold,
+                        recovery_time=recovery_time,
+                        half_open_max_probes=half_open_max_probes,
+                        clock=clock)
+        self._breakers: Dict[str, CircuitBreaker] = {}
+
+    def for_endpoint(self, address: str) -> CircuitBreaker:
+        b = self._breakers.get(address)
+        if b is None:
+            b = self._breakers[address] = CircuitBreaker(**self._kw)
+        return b
+
+    def available(self, address: str) -> bool:
+        b = self._breakers.get(address)
+        return True if b is None else b.available()
+
+    def snapshot(self) -> Dict[str, dict]:
+        return {addr: b.snapshot() for addr, b in self._breakers.items()}

@@ -1,0 +1,130 @@
+"""Process-global wire-level fault injection for the RPC fabric.
+
+``raft/transport.py``'s InMemTransport gives raft partition/kill/drop
+chaos; this gives the REAL TCP fabric the same surface. Rules match by
+(service, method, side) with a probability, and fire one of:
+
+- ``drop``: the frame vanishes (client: request never sent; server:
+  request never dispatched → the caller times out).
+- ``delay``: the frame is held ``delay`` seconds before proceeding.
+- ``corrupt``: payload bytes are mangled (codec robustness).
+- ``error``: the call fails immediately (client: synthetic transport
+  error; server: status-1 reply; matcher: raised exception).
+- ``disconnect``: the underlying connection is torn down mid-call.
+
+The injector is also the chaos hook for NON-wire failure points: the
+dist worker consults ``service="tpu-matcher"`` before device dispatch so
+tests can force the host-oracle degradation path.
+
+Everything is deterministic under a seeded ``random.Random``; injected
+faults are counted globally (``utils.metrics.FABRIC``) and per rule.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class FaultRule:
+    service: str = "*"
+    method: str = "*"
+    side: str = "*"            # "client" | "server" | "*"
+    probability: float = 1.0
+    action: str = "error"      # drop | delay | corrupt | error | disconnect
+    delay: float = 0.0         # seconds, for action="delay"
+    max_hits: Optional[int] = None   # stop firing after N hits
+    hits: int = field(default=0, init=False)
+
+    def matches(self, side: str, service: str, method: str) -> bool:
+        if self.max_hits is not None and self.hits >= self.max_hits:
+            return False
+        return ((self.side in ("*", side))
+                and (self.service in ("*", service))
+                and (self.method in ("*", method)))
+
+
+class InjectedFault(Exception):
+    """Raised for action="error" at non-wire hook points (e.g. the
+    tpu-matcher): carries the rule that fired."""
+
+
+class FaultInjector:
+    def __init__(self, seed: Optional[int] = None) -> None:
+        self.rules: List[FaultRule] = []
+        self.rng = random.Random(seed)
+        self.enabled = False
+        self.injected_total = 0
+
+    # ---------------- configuration ----------------------------------------
+
+    def add_rule(self, **kw) -> FaultRule:
+        rule = FaultRule(**kw)
+        self.rules.append(rule)
+        self.enabled = True
+        return rule
+
+    def remove_rule(self, rule: FaultRule) -> None:
+        if rule in self.rules:
+            self.rules.remove(rule)
+        self.enabled = bool(self.rules)
+
+    def reset(self, seed: Optional[int] = None) -> None:
+        self.rules.clear()
+        self.enabled = False
+        self.injected_total = 0
+        if seed is not None:
+            self.rng = random.Random(seed)
+
+    # ---------------- decision points --------------------------------------
+
+    def decide(self, side: str, service: str, method: str,
+               actions: Optional[tuple] = None) -> Optional[FaultRule]:
+        """First matching rule that fires, or None. ``actions`` restricts
+        which rule actions a hook point can honor — rules it cannot act
+        on are left untouched (hits/counters unconsumed) for the hook
+        that can. O(1) when disabled — the hot path pays a single
+        attribute check."""
+        if not self.enabled:
+            return None
+        for rule in self.rules:
+            if actions is not None and rule.action not in actions:
+                continue
+            if rule.matches(side, service, method) \
+                    and self.rng.random() < rule.probability:
+                rule.hits += 1
+                self.injected_total += 1
+                self._meter()
+                return rule
+        return None
+
+    def check_raise(self, side: str, service: str, method: str) -> None:
+        """Non-wire hook: raise InjectedFault when an ``error`` rule fires
+        (other actions are meaningless without a frame and are NOT
+        consumed — they stay armed for the wire hooks)."""
+        if self.decide(side, service, method,
+                       actions=("error",)) is not None:
+            raise InjectedFault(f"{service}/{method} ({side})")
+
+    @staticmethod
+    def _meter() -> None:
+        from ..utils.metrics import FABRIC, FabricMetric
+        FABRIC.inc(FabricMetric.FAULTS_INJECTED)
+
+    def corrupt(self, payload: bytes) -> bytes:
+        """Flip a byte (or fabricate one for empty payloads)."""
+        if not payload:
+            return b"\xff"
+        i = self.rng.randrange(len(payload))
+        return payload[:i] + bytes([payload[i] ^ 0xFF]) + payload[i + 1:]
+
+
+# the process-global injector the fabric consults (tests reconfigure it;
+# production leaves it disabled — one bool check per frame)
+_INJECTOR = FaultInjector()
+
+
+def get_injector() -> FaultInjector:
+    return _INJECTOR

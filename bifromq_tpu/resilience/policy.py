@@ -1,0 +1,160 @@
+"""Retry/backoff policy + per-call deadline budgets + idempotency whitelist.
+
+The reference leans on gRPC's deadline propagation and service-config
+retries (base-rpc, SURVEY.md §2.4). Here:
+
+- ``RetryPolicy``: exponential backoff with FULL jitter (AWS architecture
+  blog discipline: sleep = uniform(0, min(cap, base * mult**attempt))) —
+  retry storms decorrelate instead of synchronizing.
+- Deadline budgets: a caller opens ``deadline_scope(budget_s)``; every RPC
+  issued inside the scope caps its timeout at the remaining budget AND
+  stamps the remainder into the request header (u32 milliseconds), so a
+  downstream handler inherits the shrunken budget across process hops —
+  gRPC ``grpc-timeout`` semantics re-expressed.
+- Idempotency whitelist: only (service, method) pairs registered safe —
+  RO coproc queries (match), registry/meta lookups — auto-retry after an
+  AMBIGUOUS transport failure (the request may have executed server-side).
+  Unlisted methods fail fast to the caller, who owns the ambiguity.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import random
+import time
+from dataclasses import dataclass
+from typing import Iterator, Optional, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# deadline budget propagation (≈ gRPC deadline / grpc-timeout header)
+# ---------------------------------------------------------------------------
+
+# absolute time.monotonic() deadline for the current logical call tree
+_DEADLINE: contextvars.ContextVar[Optional[float]] = contextvars.ContextVar(
+    "rpc_deadline", default=None)
+
+
+def current_deadline() -> Optional[float]:
+    """Absolute ``time.monotonic()`` deadline of the active scope (None =
+    unbounded)."""
+    return _DEADLINE.get()
+
+
+def remaining_budget() -> Optional[float]:
+    """Seconds left in the active deadline scope; None = unbounded.
+    Clamped at 0.0 — an exhausted budget never goes negative."""
+    d = _DEADLINE.get()
+    if d is None:
+        return None
+    return max(0.0, d - time.monotonic())
+
+
+@contextlib.contextmanager
+def deadline_scope(budget_s: Optional[float]) -> Iterator[Optional[float]]:
+    """Bound everything inside to ``budget_s`` seconds from now. Nested
+    scopes only ever SHRINK the deadline (a callee cannot outlive its
+    caller's budget). ``None`` is a no-op passthrough."""
+    if budget_s is None:
+        yield _DEADLINE.get()
+        return
+    new = time.monotonic() + budget_s
+    cur = _DEADLINE.get()
+    if cur is not None:
+        new = min(new, cur)
+    token = _DEADLINE.set(new)
+    try:
+        yield new
+    finally:
+        _DEADLINE.reset(token)
+
+
+@contextlib.contextmanager
+def absolute_deadline(deadline: Optional[float]) -> Iterator[None]:
+    """Install an ABSOLUTE monotonic deadline (server side: re-arm the
+    scope from a decoded wire header). Shrink-only, like deadline_scope."""
+    if deadline is None:
+        yield
+        return
+    cur = _DEADLINE.get()
+    if cur is not None:
+        deadline = min(deadline, cur)
+    token = _DEADLINE.set(deadline)
+    try:
+        yield
+    finally:
+        _DEADLINE.reset(token)
+
+
+# ---------------------------------------------------------------------------
+# idempotency whitelist
+# ---------------------------------------------------------------------------
+
+# (service, method); method "*" whitelists a whole service. Seeded with the
+# RO surfaces that are safe to re-issue after an ambiguous failure: match
+# queries never mutate, session-dict presence checks are reads. Route
+# mutations are NOT listed even though the incarnation guards make them
+# mostly idempotent — the caller decides. (The basekv client deliberately
+# bypasses this whitelist: ClusterKVClient._call is its own at-least-once
+# leader-rerouting loop.)
+_IDEMPOTENT: Set[Tuple[str, str]] = {
+    ("dist-worker", "match_batch"),
+    ("session-dict", "exist"),
+    ("session-dict", "clients"),
+    ("session-dict", "inbox_state"),
+}
+
+
+def register_idempotent(service: str, method: str = "*") -> None:
+    _IDEMPOTENT.add((service, method))
+
+
+def unregister_idempotent(service: str, method: str = "*") -> None:
+    _IDEMPOTENT.discard((service, method))
+
+
+def is_idempotent(service: str, method: str) -> bool:
+    return ((service, method) in _IDEMPOTENT
+            or (service, "*") in _IDEMPOTENT)
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff + full jitter, bounded by attempts AND budget."""
+
+    max_attempts: int = 4          # total tries (1 = no retry)
+    base_delay: float = 0.02       # first-retry backoff cap (seconds)
+    max_delay: float = 1.0         # per-retry backoff ceiling
+    multiplier: float = 2.0
+
+    def _cap(self, attempt: int) -> float:
+        """Worst-case backoff before retry ``attempt`` — the ONE place
+        the growth curve lives (backoff() jitters under it, should_retry()
+        checks it fits the budget)."""
+        return min(self.max_delay,
+                   self.base_delay * (self.multiplier ** (attempt - 1)))
+
+    def backoff(self, attempt: int,
+                rng: Optional[random.Random] = None) -> float:
+        """Sleep before retry ``attempt`` (1-based: attempt 1 = first
+        retry). Full jitter: uniform over (0, cap]."""
+        r = rng.random() if rng is not None else random.random()
+        return self._cap(attempt) * r
+
+    def should_retry(self, attempt: int) -> bool:
+        """More attempts allowed after ``attempt`` failures, within the
+        active deadline budget: the next retry's worst-case backoff must
+        still FIT the remaining budget — sleeping past the deadline just
+        converts the genuine endpoint failure into a budget-exhaustion
+        timeout one attempt later."""
+        if attempt >= self.max_attempts:
+            return False
+        rem = remaining_budget()
+        return rem is None or rem > self._cap(attempt)
+
+
+DEFAULT_RETRY_POLICY = RetryPolicy()

@@ -1,2 +1,3 @@
-from .fabric import (RPCClient, RPCError, RPCServer,  # noqa: F401
-                     ServiceRegistry)
+from .fabric import (RPCCircuitOpenError, RPCClient,  # noqa: F401
+                     RPCError, RPCServer, RPCTimeoutError,
+                     RPCTransportError, ServiceRegistry)

@@ -20,7 +20,16 @@ Wire format (all big-endian):
   frame   := u32 length ‖ body
   request := 0x01 ‖ u64 id ‖ len16 service ‖ len16 method ‖ len16 order_key
              ‖ payload
+  request2:= 0x03 ‖ u64 id ‖ len16 service ‖ len16 method ‖ len16 order_key
+             ‖ u32 deadline_ms ‖ payload       (deadline header, ISSUE 1 —
+             the remaining call budget, ≈ gRPC's grpc-timeout; 0 = none)
   reply   := 0x02 ‖ u64 id ‖ u8 status ‖ payload      (status 0 = OK)
+
+Resilience (ISSUE 1): transport failures surface as ``RPCTransportError``
+and timeouts as ``RPCTimeoutError`` (both ``RPCError``), call outcomes
+feed per-endpoint circuit breakers so ``ServiceRegistry.pick`` routes
+around open circuits, and the process-global ``resilience.faults``
+injector hooks both ends of the frame path for chaos tests.
 """
 
 from __future__ import annotations
@@ -29,18 +38,41 @@ import asyncio
 import hashlib
 import logging
 import struct
+import time
 from typing import Awaitable, Callable, Dict, List, Optional, Tuple
+
+from ..resilience import faults as _faults
+from ..resilience import policy as _policy
 
 log = logging.getLogger(__name__)
 
 _REQ = 0x01
 _REP = 0x02
+_REQ2 = 0x03
 
 Handler = Callable[[bytes, str], Awaitable[bytes]]
 
 
 class RPCError(Exception):
-    pass
+    """Base of the fabric's error taxonomy (also: handler-raised errors
+    reflected back over the wire as status-1 replies)."""
+
+
+class RPCTransportError(RPCError):
+    """The connection failed (dial, write, or mid-call loss). The request
+    may or may not have executed server-side — only idempotent methods
+    auto-retry (``resilience.policy.is_idempotent``)."""
+
+
+class RPCTimeoutError(RPCTransportError):
+    """The per-call timeout or the propagated deadline budget expired."""
+
+
+class RPCCircuitOpenError(RPCTransportError):
+    """Refused pre-send by an OPEN circuit (or an exhausted half-open
+    probe budget): the request was never transmitted, so there is ZERO
+    execution ambiguity — even non-idempotent calls may safely fail over
+    to another endpoint."""
 
 
 def _len16(b: bytes) -> bytes:
@@ -67,6 +99,8 @@ class _OrderedRunner:
     """Per-order-key FIFO execution (≈ base-util AsyncRunner: a serialized
     async task queue; the reference pins one response pipeline per key)."""
 
+    IDLE_RETIRE_S = 30.0
+
     def __init__(self) -> None:
         self._queues: Dict[str, asyncio.Queue] = {}
         self._tasks: Dict[str, asyncio.Task] = {}
@@ -81,13 +115,24 @@ class _OrderedRunner:
     async def _drain(self, key: str, q: asyncio.Queue) -> None:
         while True:
             try:
-                coro_fn = await asyncio.wait_for(q.get(), timeout=30)
+                coro_fn = await asyncio.wait_for(q.get(),
+                                                 timeout=self.IDLE_RETIRE_S)
             except asyncio.TimeoutError:
-                # idle: retire the queue (bounded state per key)
-                if q.empty():
-                    self._queues.pop(key, None)
+                # idle: retire ATOMICALLY — deregister FIRST, then re-check
+                # the queue. A submit() that raced the wait_for timeout
+                # (its enqueue landed between the timeout firing and this
+                # block — incl. the pre-3.12 wait_for lost-wakeup window)
+                # left the queue non-empty: re-register and keep draining
+                # instead of abandoning its item. submit() itself is
+                # synchronous on the event loop, so it can never observe
+                # the deregistered-but-nonempty intermediate state.
+                if self._queues.get(key) is q:
+                    del self._queues[key]
                     self._tasks.pop(key, None)
+                if q.empty():
                     return
+                self._queues[key] = q
+                self._tasks[key] = asyncio.current_task()
                 continue
             try:
                 await coro_fn()
@@ -121,6 +166,13 @@ class RPCServer:
         self._services: Dict[str, Dict[str, Handler]] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._conn_tasks: set = set()
+        # unordered handler tasks, strongly held server-wide: a bare
+        # ensure_future is only weakly referenced (GC could collect it
+        # mid-flight, silently dropping the reply). They run to
+        # COMPLETION even if their connection dies — wire-path parity
+        # with the local bypass's shielded dispatch (a cancelled mutate
+        # could be half-applied) — and are cancelled only by stop().
+        self._handler_tasks: set = set()
         self._local_runner: Optional[_OrderedRunner] = None
 
     def register(self, service: str, methods: Dict[str, Handler]) -> None:
@@ -146,6 +198,11 @@ class RPCServer:
             self._local_runner = None
         for t in list(self._conn_tasks):
             t.cancel()
+        # stop == crash semantics for in-flight handlers (raft/kv
+        # invariants must tolerate that anyway); cancelling here keeps
+        # them from dying as destroyed-pending tasks at loop teardown
+        for t in list(self._handler_tasks):
+            t.cancel()
 
     async def dispatch_local(self, service: str, method: str,
                              payload: bytes, order_key: str) -> bytes:
@@ -155,10 +212,15 @@ class RPCServer:
         handler = self._services.get(service, {}).get(method)
         if handler is None:
             raise RPCError("no such method")
+        # capture the CALLER's deadline: the ordered path below runs the
+        # handler in the _OrderedRunner drain task, whose context would
+        # otherwise silently drop the budget the wire path re-arms
+        deadline = _policy.current_deadline()
 
         async def run() -> bytes:
             try:
-                return await handler(payload, order_key)
+                with _policy.absolute_deadline(deadline):
+                    return await handler(payload, order_key)
             except Exception as e:  # noqa: BLE001 — wire-path parity
                 raise RPCError(repr(e)) from e
 
@@ -190,7 +252,7 @@ class RPCServer:
                 body = await _read_frame(reader)
                 # hostile/truncated frames (port scanners, bad peers) drop
                 # the connection without an unhandled-traceback path
-                if not body or body[0] != _REQ:
+                if not body or body[0] not in (_REQ, _REQ2):
                     if not body:
                         break
                     continue
@@ -199,32 +261,67 @@ class RPCServer:
                     service_b, pos = _read16(body, 9)
                     method_b, pos = _read16(body, pos)
                     okey_b, pos = _read16(body, pos)
-                except (struct.error, IndexError):
+                    deadline = None
+                    if body[0] == _REQ2:
+                        # deadline header: remaining budget in ms (0 = none)
+                        (ms,) = struct.unpack_from(">I", body, pos)
+                        pos += 4
+                        if ms:
+                            deadline = time.monotonic() + ms / 1000.0
+                    service = service_b.decode()
+                    method = method_b.decode()
+                    okey = okey_b.decode()
+                except (struct.error, IndexError, UnicodeDecodeError):
                     break
                 payload = body[pos:]
-                handler = self._services.get(service_b.decode(), {}).get(
-                    method_b.decode())
+                fault = _faults.get_injector().decide("server", service,
+                                                      method)
+                if fault is not None:
+                    if fault.action == "drop":
+                        continue        # request vanishes: caller times out
+                    if fault.action == "disconnect":
+                        break
+                handler = self._services.get(service, {}).get(method)
 
                 async def run(rid=rid, handler=handler, payload=payload,
-                              okey=okey_b.decode()):
-                    if handler is None:
+                              okey=okey, deadline=deadline, fault=fault):
+                    if fault is not None and fault.action == "delay":
+                        await asyncio.sleep(fault.delay)
+                    if fault is not None and fault.action == "error":
+                        status, out = 1, b"injected fault"
+                    elif handler is None:
                         status, out = 1, b"no such method"
                     else:
                         try:
-                            out = await handler(payload, okey)
+                            # re-arm the caller's budget so handler-issued
+                            # downstream RPCs inherit the shrunken deadline
+                            with _policy.absolute_deadline(deadline):
+                                out = await handler(payload, okey)
                             status = 0
                         except Exception as e:  # noqa: BLE001
                             status, out = 1, repr(e).encode()
-                    async with send_lock:
-                        _write_frame(writer, bytes([_REP])
-                                     + struct.pack(">Q", rid)
-                                     + bytes([status]) + out)
-                        await writer.drain()
+                    if fault is not None and fault.action == "corrupt":
+                        out = _faults.get_injector().corrupt(out)
+                    try:
+                        async with send_lock:
+                            _write_frame(writer, bytes([_REP])
+                                         + struct.pack(">Q", rid)
+                                         + bytes([status]) + out)
+                            await writer.drain()
+                    except (ConnectionError, OSError, RuntimeError):
+                        # the caller is gone (died/disconnected mid-call):
+                        # its reply has nowhere to go — never let a
+                        # detached handler task die with an unretrieved
+                        # exception over it (RuntimeError: write() on a
+                        # transport closed by connection teardown)
+                        pass
 
-                if okey_b:
-                    runner.submit(okey_b.decode(), run)
+                if okey:
+                    runner.submit(okey, run)
                 else:
-                    asyncio.ensure_future(run())
+                    t = asyncio.ensure_future(run())
+                    self._handler_tasks.add(t)
+                    t.add_done_callback(self._handler_tasks.discard)
         except (asyncio.IncompleteReadError, ConnectionError,
                 asyncio.CancelledError):
             pass
@@ -240,11 +337,14 @@ class RPCClient:
     through ``dispatch_local`` (no sockets). ``ssl_context`` dials TLS."""
 
     def __init__(self, host: str, port: int, *, ssl_context=None,
-                 local_bypass: bool = True) -> None:
+                 local_bypass: bool = True, breaker=None) -> None:
         self.host = host
         self.port = port
         self.ssl_context = ssl_context
         self.local_bypass = local_bypass
+        # optional resilience.breaker.CircuitBreaker fed by wire-path call
+        # outcomes (a status-1 handler error is a SUCCESSFUL round trip)
+        self.breaker = breaker
         self._writer: Optional[asyncio.StreamWriter] = None
         self._reader_task: Optional[asyncio.Task] = None
         self._pending: Dict[int, asyncio.Future] = {}
@@ -260,8 +360,12 @@ class RPCClient:
         async with self._conn_lock:
             if self._writer is not None and not self._writer.is_closing():
                 return self._writer
-            reader, writer = await asyncio.open_connection(
-                self.host, self.port, ssl=self.ssl_context)
+            try:
+                reader, writer = await asyncio.open_connection(
+                    self.host, self.port, ssl=self.ssl_context)
+            except (ConnectionError, OSError) as e:
+                raise RPCTransportError(f"dial {self.host}:{self.port} "
+                                        f"failed: {e!r}") from e
             # per-connection pending map: a dead connection's cleanup must
             # only fail ITS calls, never a successor connection's
             self._writer = writer
@@ -288,21 +392,41 @@ class RPCClient:
                     if status == 0:
                         fut.set_result(payload)
                     else:
-                        fut.set_exception(RPCError(payload.decode()))
+                        # errors="replace": a corrupted error reply (chaos
+                        # injection, hostile peer) must not kill the read
+                        # loop with a UnicodeDecodeError
+                        fut.set_exception(RPCError(
+                            payload.decode(errors="replace")))
         except (asyncio.IncompleteReadError, ConnectionError,
                 asyncio.CancelledError):
             pass
         finally:
             for fut in pending.values():
                 if not fut.done():
-                    fut.set_exception(RPCError("connection lost"))
+                    fut.set_exception(RPCTransportError("connection lost"))
             pending.clear()
             writer.close()
             if self._writer is writer:
                 self._writer = None
 
+    def _effective_timeout(self, timeout: float) -> Tuple[float, bool]:
+        """Per-call timeout capped by the propagated deadline budget; an
+        exhausted budget fails fast (metered) instead of dispatching.
+        Returns (timeout, budget_capped) — when the budget is the binding
+        constraint, a resulting timeout says nothing about endpoint
+        health and must not feed the breaker."""
+        rem = _policy.remaining_budget()
+        if rem is None:
+            return timeout, False
+        if rem <= 0.0:
+            from ..utils.metrics import FABRIC, FabricMetric
+            FABRIC.inc(FabricMetric.RPC_DEADLINE_EXPIRED)
+            raise RPCTimeoutError("deadline budget exhausted")
+        return min(timeout, rem), rem < timeout
+
     async def call(self, service: str, method: str, payload: bytes, *,
                    order_key: str = "", timeout: float = 30.0) -> bytes:
+        timeout, budget_capped = self._effective_timeout(timeout)
         if self.local_bypass:
             local = _LOCAL_SERVERS.get(f"{self.host}:{self.port}")
             if (local is not None and local._server is not None
@@ -314,21 +438,118 @@ class RPCClient:
                 # cancelled mutate could be half-applied)
                 task = asyncio.ensure_future(local.dispatch_local(
                     service, method, payload, order_key))
-                return await asyncio.wait_for(asyncio.shield(task),
-                                              timeout)
+                try:
+                    return await asyncio.wait_for(asyncio.shield(task),
+                                                  timeout)
+                except asyncio.TimeoutError as e:
+                    raise RPCTimeoutError(
+                        f"{service}/{method} timed out after "
+                        f"{timeout:.3f}s (local)") from e
+        if self.breaker is not None and not self.breaker.allow():
+            # OPEN circuit (or half-open probe budget exhausted): fail fast
+            # without dialing — and without recording a new failure, a
+            # refused admission is not a fresh outcome. The distinct type
+            # tells retrying callers the request was NEVER sent (safe to
+            # fail over even for non-idempotent methods).
+            raise RPCCircuitOpenError(
+                f"circuit open for {self.host}:{self.port}")
+        fault = _faults.get_injector().decide("client", service, method)
+        if fault is not None and fault.action == "error":
+            self._record(False, "injected fault")
+            raise RPCTransportError("injected fault")
+        try:
+            out = await self._call_wire(service, method, payload,
+                                        order_key, timeout, fault)
+        except RPCTimeoutError as e:
+            # a timeout whose clock was the CALLER's nearly-spent budget
+            # says nothing about endpoint health: release the admission
+            # without a verdict instead of tripping a healthy breaker
+            if budget_capped:
+                if self.breaker is not None:
+                    self.breaker.release_probe()
+            else:
+                self._record(False, repr(e))
+            raise
+        except RPCTransportError as e:
+            # breaker food: transport failures only
+            self._record(False, repr(e))
+            raise
+        except RPCError:
+            # a reflected handler error is a SUCCESSFUL round trip — the
+            # endpoint is alive. Recording success here also releases a
+            # HALF_OPEN probe slot (a handler-error probe must close the
+            # circuit, not strand it half-open forever)
+            self._record(True)
+            raise
+        except BaseException:
+            # cancellation (or any non-RPC failure) mid-call: no verdict
+            # on endpoint health, but a charged HALF_OPEN probe slot must
+            # be returned or the breaker wedges half-open forever
+            if self.breaker is not None:
+                self.breaker.release_probe()
+            raise
+        self._record(True)
+        return out
+
+    def _record(self, ok: bool, error: Optional[str] = None) -> None:
+        if self.breaker is not None:
+            if ok:
+                self.breaker.record_success()
+            else:
+                self.breaker.record_failure(error)
+
+    async def _call_wire(self, service: str, method: str, payload: bytes,
+                         order_key: str, timeout: float, fault) -> bytes:
         writer = await self._ensure_conn()
+        if fault is not None:
+            if fault.action == "delay":
+                # injected latency counts AGAINST the per-call timeout,
+                # exactly like real network delay would
+                await asyncio.sleep(fault.delay)
+                timeout -= fault.delay
+                if timeout <= 0:
+                    raise RPCTimeoutError(
+                        f"{service}/{method} timed out under injected "
+                        f"{fault.delay:.3f}s delay")
+            elif fault.action == "corrupt":
+                payload = _faults.get_injector().corrupt(payload)
+            elif fault.action == "disconnect":
+                writer.close()
+                raise RPCTransportError("injected disconnect")
         pending = self._pending
         self._next_id += 1
         rid = self._next_id
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         pending[rid] = fut
-        body = (bytes([_REQ]) + struct.pack(">Q", rid)
-                + _len16(service.encode()) + _len16(method.encode())
-                + _len16(order_key.encode()) + payload)
-        _write_frame(writer, body)
-        await writer.drain()
+        rem = _policy.remaining_budget()
+        if rem is not None:
+            # request2: stamp the remaining budget so the server (and its
+            # downstream calls) inherit the shrunken deadline
+            body = (bytes([_REQ2]) + struct.pack(">Q", rid)
+                    + _len16(service.encode()) + _len16(method.encode())
+                    + _len16(order_key.encode())
+                    + struct.pack(">I", max(1, int(rem * 1000)))
+                    + payload)
+        else:
+            body = (bytes([_REQ]) + struct.pack(">Q", rid)
+                    + _len16(service.encode()) + _len16(method.encode())
+                    + _len16(order_key.encode()) + payload)
+        if fault is not None and fault.action == "drop":
+            # the request frame vanishes on the wire: the reply future can
+            # only time out (exactly what a blackholed network does)
+            pass
+        else:
+            try:
+                _write_frame(writer, body)
+                await writer.drain()
+            except (ConnectionError, OSError) as e:
+                pending.pop(rid, None)
+                raise RPCTransportError(f"send failed: {e!r}") from e
         try:
             return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError as e:
+            raise RPCTimeoutError(f"{service}/{method} timed out after "
+                                  f"{timeout:.3f}s") from e
         finally:
             # a timed-out call must not leak its correlation entry
             pending.pop(rid, None)
@@ -358,11 +579,16 @@ class ServiceRegistry:
 
     def __init__(self, agent_host=None, crdt_store=None, *,
                  local_bypass: bool = True,
-                 client_ssl_context=None) -> None:
+                 client_ssl_context=None, breakers=None) -> None:
+        from ..resilience.breaker import BreakerRegistry
         self.agent_host = agent_host
         self.crdt_store = crdt_store
         self.local_bypass = local_bypass        # in-proc short-circuit
         self.client_ssl_context = client_ssl_context  # TLS dialing
+        # per-endpoint circuit breakers: pick() routes around open
+        # circuits; clients created here feed them with call outcomes
+        self.breakers = (breakers if breakers is not None
+                         else BreakerRegistry())
         self._static: Dict[str, List[str]] = {}
         self._clients: Dict[str, RPCClient] = {}
         # traffic governor state (≈ IRPCServiceTrafficGovernor.java:29):
@@ -495,13 +721,27 @@ class ServiceRegistry:
                 out.append(addr)
         return sorted(out)
 
-    def pick(self, service: str, key: str) -> Optional[str]:
+    def pick(self, service: str, key: str,
+             exclude: Optional[set] = None) -> Optional[str]:
         """Weighted rendezvous hash (≈ HRWRouter with traffic-governor
         directives): the longest tenant-prefix directive scales each
-        endpoint's score by its group weight; weight-0 groups drain."""
+        endpoint's score by its group weight; weight-0 groups drain.
+
+        Endpoints whose circuit breaker is OPEN are skipped, so the hash
+        falls over to the next-ranked live server (ISSUE 1 failover);
+        ``exclude`` additionally masks endpoints a retrying caller already
+        failed against THIS call. Candidate tiers degrade gracefully:
+        (1) breaker-available and not excluded, (2) breaker-available —
+        a retry that has failed against EVERY endpoint must prefer a
+        live-looking one over a known-open circuit, (3) everything
+        (total outage stays no worse than before breakers existed)."""
         eps = self.endpoints(service)
         if not eps:
             return None
+        available = [ep for ep in eps if self.breakers.available(ep)]
+        live = (available if exclude is None
+                else [ep for ep in available if ep not in exclude])
+        eps = live or available or eps
         directive = self._directive_for(service, key)
         if directive is not None:
             weighted = [ep for ep in eps
@@ -528,20 +768,66 @@ class ServiceRegistry:
             return int.from_bytes(h, "big")
         return max(eps, key=score)
 
-    def client(self, service: str, key: str) -> Optional[RPCClient]:
-        addr = self.pick(service, key)
-        if addr is None:
-            return None
-        return self.client_for(addr)
-
     def client_for(self, addr: str) -> RPCClient:
         c = self._clients.get(addr)
         if c is None:
             host, port = addr.rsplit(":", 1)
             c = self._clients[addr] = RPCClient(
                 host, int(port), ssl_context=self.client_ssl_context,
-                local_bypass=self.local_bypass)
+                local_bypass=self.local_bypass,
+                breaker=self.breakers.for_endpoint(addr))
         return c
+
+    async def call_resilient(self, service: str, key: str, method: str,
+                             payload: bytes, *, order_key: str = "",
+                             timeout: float = 30.0, policy=None,
+                             idempotent: Optional[bool] = None,
+                             rng=None) -> bytes:
+        """Pick → call with retry + endpoint failover (the fabric's
+        bounded-work-then-fallback discipline, ISSUE 1 tentpole).
+
+        Each attempt rendezvous-picks over the live (breaker-closed)
+        endpoint set, excluding endpoints that already failed THIS call;
+        transport failures on idempotent methods back off (exponential +
+        full jitter) and fail over; non-idempotent methods fail fast —
+        the request may have executed server-side and the caller owns
+        that ambiguity. Handler errors (plain RPCError) never retry: the
+        server answered. Retries/failovers are metered."""
+        from ..resilience.policy import (DEFAULT_RETRY_POLICY,
+                                         is_idempotent)
+        from ..utils.metrics import FABRIC, FabricMetric
+        if policy is None:
+            policy = DEFAULT_RETRY_POLICY
+        if idempotent is None:
+            idempotent = is_idempotent(service, method)
+        tried_and_failed: set = set()
+        attempt = 0
+        last_failed: Optional[str] = None
+        while True:
+            attempt += 1
+            addr = self.pick(service, key, exclude=tried_and_failed)
+            if addr is None:
+                raise RPCTransportError(
+                    f"no endpoints for service {service}")
+            if last_failed is not None and addr != last_failed:
+                FABRIC.inc(FabricMetric.RPC_FAILOVERS)
+            try:
+                return await self.client_for(addr).call(
+                    service, method, payload, order_key=order_key,
+                    timeout=timeout)
+            except RPCTransportError as e:
+                tried_and_failed.add(addr)
+                last_failed = addr
+                # a circuit-open refusal was NEVER sent: zero execution
+                # ambiguity, so even non-idempotent methods fail over
+                retryable = (idempotent
+                             or isinstance(e, RPCCircuitOpenError))
+                if not retryable or not policy.should_retry(attempt):
+                    raise
+                FABRIC.inc(FabricMetric.RPC_RETRIES)
+                log.debug("retrying %s/%s after %r (attempt %d)",
+                          service, method, e, attempt)
+                await asyncio.sleep(policy.backoff(attempt, rng))
 
     async def close(self) -> None:
         for c in self._clients.values():

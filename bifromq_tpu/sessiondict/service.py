@@ -125,9 +125,35 @@ class SessionDictClient:
 
     async def _call_peer(self, ep: str, method: str,
                          payload: bytes, order_key: str = "") -> bytes:
-        return await self.registry.client_for(ep).call(
-            SERVICE, method, payload, order_key=order_key,
-            timeout=self.PEER_TIMEOUT)
+        from ..resilience.policy import (DEFAULT_RETRY_POLICY,
+                                         is_idempotent)
+        from ..rpc.fabric import (RPCCircuitOpenError, RPCTimeoutError,
+                                  RPCTransportError)
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                return await self.registry.client_for(ep).call(
+                    SERVICE, method, payload, order_key=order_key,
+                    timeout=self.PEER_TIMEOUT)
+            except (RPCTimeoutError, RPCCircuitOpenError):
+                # a peer that sat silent for a full PEER_TIMEOUT window
+                # (or whose breaker will deterministically refuse again)
+                # gains nothing from a same-peer re-send; fail fast — the
+                # invariant PEER_TIMEOUT exists to protect CONNECT
+                raise
+            except RPCTransportError:
+                # whitelisted reads (exist/clients/inbox_state) retry the
+                # SAME peer briefly on dial/connection-loss blips — a
+                # transient drop must not report a live session as
+                # offline; mutations (kill/sub/unsub) fail fast and the
+                # caller's fan-out semantics handle it
+                if not is_idempotent(SERVICE, method) \
+                        or not DEFAULT_RETRY_POLICY.should_retry(attempt):
+                    raise
+                from ..utils.metrics import FABRIC, FabricMetric
+                FABRIC.inc(FabricMetric.RPC_RETRIES)
+                await asyncio.sleep(DEFAULT_RETRY_POLICY.backoff(attempt))
 
     async def kick_everywhere(self, tenant_id: str, client_id: str) -> int:
         """Kick (tenant, client) on every peer broker concurrently;

@@ -37,6 +37,49 @@ class TenantMetric(enum.Enum):
     INBOX_OVERFLOW = "inbox_overflow"
 
 
+class FabricMetric(enum.Enum):
+    """Process-wide (tenant-agnostic) resilience counters: the RPC fabric's
+    retry/breaker/fault/degradation observability (ISSUE 1)."""
+
+    RPC_RETRIES = "rpc_retries_total"
+    RPC_FAILOVERS = "rpc_failovers_total"
+    RPC_DEADLINE_EXPIRED = "rpc_deadline_expired_total"
+    BREAKER_OPENED = "breaker_open_total"
+    BREAKER_HALF_OPEN = "breaker_half_open_total"
+    BREAKER_CLOSED = "breaker_closed_total"
+    FAULTS_INJECTED = "faults_injected_total"
+    MATCH_DEGRADED = "match_degraded_total"
+
+
+class FabricMetrics:
+    """Global counter registry for fabric-level metrics (per-tenant flows
+    stay in ``MetricsRegistry``). Thread-safe: breakers/retries fire from
+    RPC tasks while compaction threads may report too."""
+
+    def __init__(self) -> None:
+        self._counters: Dict[str, int] = defaultdict(int)
+        self._lock = threading.Lock()
+
+    def inc(self, metric: FabricMetric, n: int = 1) -> None:
+        with self._lock:
+            self._counters[metric.value] += n
+
+    def get(self, metric: FabricMetric) -> int:
+        return self._counters.get(metric.value, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+
+
+# the process-global instance the resilience fabric reports into
+FABRIC = FabricMetrics()
+
+
 class MetricsRegistry:
     def __init__(self) -> None:
         self._counters: Dict[Tuple[str, str], int] = defaultdict(int)
@@ -67,7 +110,8 @@ class MetricsRegistry:
                 except Exception:  # noqa: BLE001
                     pass
             return {"uptime_s": round(time.time() - self.started_at, 1),
-                    "tenants": dict(per_tenant)}
+                    "tenants": dict(per_tenant),
+                    "fabric": FABRIC.snapshot()}
 
 
 _EVENT_TO_METRIC = {

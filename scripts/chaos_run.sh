@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Tier-2 chaos gate: the wire-level fault-injection suite (ISSUE 1).
+# Runs the chaos-marked tests under a hard timeout on the CPU mesh
+# (JAX_PLATFORMS=cpu, same virtual 8-device config as tier-1).
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+
+timeout -k 10 "${CHAOS_TIMEOUT:-300}" \
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
+    -p no:cacheprovider -p no:xdist -p no:randomly "$@"
+rc=$?
+if [ $rc -eq 124 ] || [ $rc -eq 137 ]; then
+    echo "chaos suite TIMED OUT (rc=$rc)" >&2
+fi
+exit $rc

@@ -1,0 +1,781 @@
+"""Resilience fabric: retry policy + backoff, deadline budgets, circuit
+breakers + registry failover, fault injection, transport-error taxonomy,
+ordered-runner retirement, and the match-path host-oracle degradation
+(ISSUE 1)."""
+
+import asyncio
+import random
+import time
+
+import pytest
+
+from bifromq_tpu.dist.worker import DistWorker
+from bifromq_tpu.models.oracle import Route
+from bifromq_tpu.resilience.breaker import (BreakerRegistry, CircuitBreaker,
+                                            CLOSED, HALF_OPEN, OPEN)
+from bifromq_tpu.resilience.faults import (FaultInjector, InjectedFault,
+                                           get_injector)
+from bifromq_tpu.resilience.policy import (RetryPolicy, deadline_scope,
+                                           is_idempotent,
+                                           register_idempotent,
+                                           remaining_budget,
+                                           unregister_idempotent)
+from bifromq_tpu.rpc.fabric import (RPCClient, RPCError, RPCServer,
+                                    RPCTimeoutError, RPCTransportError,
+                                    ServiceRegistry, _OrderedRunner)
+from bifromq_tpu.types import RouteMatcher
+from bifromq_tpu.utils.metrics import FABRIC, FabricMetric
+
+pytestmark = pytest.mark.asyncio
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    get_injector().reset(seed=7)
+    yield
+    get_injector().reset()
+
+
+async def _echo(payload: bytes, okey: str) -> bytes:
+    return b"echo:" + payload
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+class TestRetryPolicy:
+    async def test_backoff_exponential_full_jitter(self):
+        p = RetryPolicy(max_attempts=6, base_delay=0.1, max_delay=1.0,
+                        multiplier=2.0)
+        rng = random.Random(3)
+        for attempt in range(1, 7):
+            cap = min(1.0, 0.1 * 2 ** (attempt - 1))
+            for _ in range(50):
+                d = p.backoff(attempt, rng)
+                assert 0.0 <= d <= cap
+        # jitter actually spreads (not a constant)
+        ds = {round(p.backoff(3, rng), 6) for _ in range(20)}
+        assert len(ds) > 10
+
+    async def test_attempt_budget(self):
+        p = RetryPolicy(max_attempts=3)
+        assert p.should_retry(1) and p.should_retry(2)
+        assert not p.should_retry(3)
+
+    async def test_deadline_budget_gates_retries(self):
+        p = RetryPolicy(max_attempts=10)
+        with deadline_scope(0.0):
+            assert not p.should_retry(1)
+        with deadline_scope(5.0):
+            assert p.should_retry(1)
+
+    async def test_deadline_scope_nests_shrink_only(self):
+        assert remaining_budget() is None
+        with deadline_scope(1.0):
+            outer = remaining_budget()
+            assert outer is not None and 0.9 < outer <= 1.0
+            with deadline_scope(10.0):    # cannot OUTLIVE the outer scope
+                assert remaining_budget() <= outer
+            with deadline_scope(0.05):    # but can shrink
+                assert remaining_budget() <= 0.05
+        assert remaining_budget() is None
+
+    async def test_idempotency_whitelist(self):
+        assert is_idempotent("dist-worker", "match_batch")
+        assert not is_idempotent("dist-worker", "add_route")
+        register_idempotent("svcX", "*")
+        try:
+            assert is_idempotent("svcX", "anything")
+        finally:
+            unregister_idempotent("svcX", "*")
+        assert not is_idempotent("svcX", "anything")
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    async def test_closed_open_half_open_cycle(self):
+        now = [0.0]
+        b = CircuitBreaker(failure_threshold=3, recovery_time=1.0,
+                           clock=lambda: now[0])
+        assert b.state == CLOSED and b.allow()
+        b.record_failure("e1")
+        b.record_failure("e2")
+        assert b.state == CLOSED          # below threshold
+        b.record_failure("e3")
+        assert b.state == OPEN and not b.allow() and not b.available()
+        now[0] += 1.5                     # recovery window elapses
+        assert b.state == HALF_OPEN and b.available()
+        assert b.allow()                  # one probe admitted
+        assert not b.allow()              # probe budget (1) exhausted
+        b.record_success()
+        assert b.state == CLOSED and b.allow()
+
+    async def test_half_open_failure_reopens(self):
+        now = [0.0]
+        b = CircuitBreaker(failure_threshold=1, recovery_time=1.0,
+                           clock=lambda: now[0])
+        b.record_failure()
+        assert b.state == OPEN
+        now[0] += 1.1
+        assert b.allow()                  # half-open probe
+        b.record_failure()
+        assert b.state == OPEN            # probe failed: full window again
+        assert not b.allow()
+        assert b.open_count == 2
+
+    async def test_success_resets_failure_streak(self):
+        b = CircuitBreaker(failure_threshold=2)
+        b.record_failure()
+        b.record_success()                # streak broken
+        b.record_failure()
+        assert b.state == CLOSED
+
+    async def test_transition_metrics(self):
+        base = FABRIC.get(FabricMetric.BREAKER_OPENED)
+        now = [0.0]
+        b = CircuitBreaker(failure_threshold=1, recovery_time=0.5,
+                           clock=lambda: now[0])
+        b.record_failure()
+        assert FABRIC.get(FabricMetric.BREAKER_OPENED) == base + 1
+        now[0] += 1.0
+        _ = b.state
+        assert FABRIC.get(FabricMetric.BREAKER_HALF_OPEN) >= 1
+        b.record_success()
+        assert FABRIC.get(FabricMetric.BREAKER_CLOSED) >= 1
+
+
+# ---------------------------------------------------------------------------
+# registry: breaker-aware pick + failover
+# ---------------------------------------------------------------------------
+
+class TestRegistryFailover:
+    async def test_pick_skips_open_circuits(self):
+        reg = ServiceRegistry()
+        reg.announce("svc", "10.0.0.1:1")
+        reg.announce("svc", "10.0.0.2:1")
+        # with both closed, 50 tenants spread over both endpoints
+        picks = {reg.pick("svc", f"t{i}") for i in range(50)}
+        assert picks == {"10.0.0.1:1", "10.0.0.2:1"}
+        reg.breakers.for_endpoint("10.0.0.1:1").force_open()
+        picks = {reg.pick("svc", f"t{i}") for i in range(50)}
+        assert picks == {"10.0.0.2:1"}    # failover to next-ranked live
+        # ALL open: fall back to the full set rather than routing nowhere
+        reg.breakers.for_endpoint("10.0.0.2:1").force_open()
+        assert reg.pick("svc", "t0") is not None
+
+    async def test_exclude_masks_endpoints(self):
+        reg = ServiceRegistry()
+        reg.announce("svc", "10.0.0.1:1")
+        reg.announce("svc", "10.0.0.2:1")
+        ep = reg.pick("svc", "k")
+        other = reg.pick("svc", "k", exclude={ep})
+        assert other is not None and other != ep
+
+    async def test_call_resilient_fails_over_to_live_server(self):
+        s1 = RPCServer()
+        s1.register("svc", {"echo": _echo})
+        await s1.start()
+        s2 = RPCServer()
+        s2.register("svc", {"echo": _echo})
+        await s2.start()
+        reg = ServiceRegistry(
+            local_bypass=False,
+            breakers=BreakerRegistry(failure_threshold=1,
+                                     recovery_time=30.0))
+        reg.announce("svc", s1.address)
+        reg.announce("svc", s2.address)
+        register_idempotent("svc", "echo")
+        try:
+            # find a key routed to s1, then kill s1
+            key = next(f"k{i}" for i in range(200)
+                       if reg.pick("svc", f"k{i}") == s1.address)
+            await s1.stop()
+            await asyncio.sleep(0.02)
+            base_r = FABRIC.get(FabricMetric.RPC_RETRIES)
+            out = await reg.call_resilient(
+                "svc", key, "echo", b"x",
+                policy=RetryPolicy(max_attempts=4, base_delay=0.01,
+                                   max_delay=0.02))
+            assert out == b"echo:x"
+            assert FABRIC.get(FabricMetric.RPC_RETRIES) > base_r
+            # the dead endpoint's breaker opened from the recorded failure
+            assert reg.breakers.for_endpoint(s1.address).state == OPEN
+        finally:
+            unregister_idempotent("svc", "echo")
+            await reg.close()
+            await s2.stop()
+
+    async def test_open_circuit_fails_fast_without_dialing(self):
+        """The client-side admission check: an OPEN breaker refuses the
+        call before any socket work, and a refused admission records no
+        fresh failure (state churn stays outcome-driven)."""
+        server = RPCServer()
+        server.register("svc", {"echo": _echo})
+        await server.start()
+        b = CircuitBreaker(failure_threshold=1, recovery_time=60.0)
+        c = RPCClient("127.0.0.1", server.port, local_bypass=False,
+                      breaker=b)
+        try:
+            assert await c.call("svc", "echo", b"x") == b"echo:x"
+            b.force_open()
+            open_count = b.open_count
+            with pytest.raises(RPCTransportError, match="circuit open"):
+                await c.call("svc", "echo", b"x")
+            assert b.open_count == open_count    # refusal ≠ new failure
+        finally:
+            await c.close()
+            await server.stop()
+
+    async def test_call_resilient_non_idempotent_fails_fast(self):
+        reg = ServiceRegistry(local_bypass=False)
+        reg.announce("svc", "127.0.0.1:1")   # nothing listens there
+        try:
+            with pytest.raises(RPCTransportError):
+                await reg.call_resilient("svc", "k", "mutate", b"x")
+        finally:
+            await reg.close()
+
+    async def test_circuit_open_refusal_fails_over_even_non_idempotent(
+            self):
+        """A circuit-open refusal was never transmitted (zero execution
+        ambiguity), so call_resilient may fail a MUTATION over to a
+        healthy endpoint."""
+        seen = []
+
+        async def mutate(payload, okey):
+            seen.append(payload)
+            return b"ok"
+
+        s = RPCServer()
+        s.register("svc", {"mutate": mutate})
+        await s.start()
+        reg = ServiceRegistry(local_bypass=False)
+        reg.announce("svc", "10.9.9.9:1")    # never dialed: breaker open
+        reg.announce("svc", s.address)
+        try:
+            # find a key routed to the doomed endpoint, then trip it
+            key = next(f"k{i}" for i in range(200)
+                       if reg.pick("svc", f"k{i}") == "10.9.9.9:1")
+            reg.breakers.for_endpoint("10.9.9.9:1").force_open()
+            # pick() skips the open circuit outright, but even if a call
+            # reaches it, the refusal itself must be retryable:
+            c = reg.client_for("10.9.9.9:1")
+            from bifromq_tpu.rpc.fabric import RPCCircuitOpenError
+            with pytest.raises(RPCCircuitOpenError):
+                await c.call("svc", "mutate", b"x")
+            out = await reg.call_resilient(
+                "svc", key, "mutate", b"x",
+                policy=RetryPolicy(max_attempts=3, base_delay=0.01))
+            assert out == b"ok" and seen == [b"x"]
+        finally:
+            await reg.close()
+            await s.stop()
+
+
+# ---------------------------------------------------------------------------
+# transport-error taxonomy (satellite: normalize transport exceptions)
+# ---------------------------------------------------------------------------
+
+class TestErrorTaxonomy:
+    async def test_dial_failure_is_transport_error(self):
+        c = RPCClient("127.0.0.1", 1, local_bypass=False)  # closed port
+        with pytest.raises(RPCTransportError) as ei:
+            await c.call("svc", "m", b"")
+        assert isinstance(ei.value, RPCError)     # one taxonomy root
+        await c.close()
+
+    async def test_timeout_is_rpc_timeout_error(self):
+        async def slow(payload, okey):
+            await asyncio.sleep(5)
+            return b""
+        server = RPCServer()
+        server.register("svc", {"slow": slow})
+        await server.start()
+        c = RPCClient("127.0.0.1", server.port, local_bypass=False)
+        try:
+            with pytest.raises(RPCTimeoutError) as ei:
+                await c.call("svc", "slow", b"", timeout=0.05)
+            assert isinstance(ei.value, RPCTransportError)
+        finally:
+            await c.close()
+            await server.stop()
+
+    async def test_mid_call_connection_loss_is_transport_error(self):
+        async def slow(payload, okey):
+            await asyncio.sleep(5)
+            return b""
+        server = RPCServer()
+        server.register("svc", {"slow": slow})
+        await server.start()
+        c = RPCClient("127.0.0.1", server.port, local_bypass=False)
+        try:
+            fut = asyncio.ensure_future(c.call("svc", "slow", b""))
+            await asyncio.sleep(0.05)
+            await server.stop()
+            with pytest.raises(RPCTransportError):
+                await asyncio.wait_for(fut, 2)
+        finally:
+            await c.close()
+
+    async def test_half_open_probe_with_handler_error_closes_circuit(self):
+        """A HALF_OPEN probe answered with a status-1 handler error is a
+        successful round trip: the breaker must CLOSE (and release the
+        probe slot), not strand half-open with its budget leaked."""
+        async def boom(payload, okey):
+            raise ValueError("bad")
+        server = RPCServer()
+        server.register("svc", {"boom": boom, "echo": _echo})
+        await server.start()
+        now = [0.0]
+        b = CircuitBreaker(failure_threshold=1, recovery_time=1.0,
+                           clock=lambda: now[0])
+        c = RPCClient("127.0.0.1", server.port, local_bypass=False,
+                      breaker=b)
+        try:
+            b.force_open()
+            now[0] += 1.5                     # OPEN → HALF_OPEN
+            with pytest.raises(RPCError):
+                await c.call("svc", "boom", b"")   # the probe
+            assert b.state == CLOSED
+            assert await c.call("svc", "echo", b"x") == b"echo:x"
+        finally:
+            await c.close()
+            await server.stop()
+
+    async def test_cancelled_half_open_probe_releases_slot(self):
+        """Cancelling the HALF_OPEN probe call must return the probe
+        budget — the breaker may not wedge refusing forever."""
+        async def slow(payload, okey):
+            await asyncio.sleep(30)
+            return b""
+        server = RPCServer()
+        server.register("svc", {"slow": slow, "echo": _echo})
+        await server.start()
+        now = [0.0]
+        b = CircuitBreaker(failure_threshold=1, recovery_time=1.0,
+                           clock=lambda: now[0])
+        c = RPCClient("127.0.0.1", server.port, local_bypass=False,
+                      breaker=b)
+        try:
+            b.force_open()
+            now[0] += 1.5                     # OPEN → HALF_OPEN
+            probe = asyncio.ensure_future(c.call("svc", "slow", b""))
+            await asyncio.sleep(0.05)
+            probe.cancel()
+            try:
+                await probe
+            except asyncio.CancelledError:
+                pass
+            # slot released: the next probe is admitted and closes it
+            assert await c.call("svc", "echo", b"x") == b"echo:x"
+            assert b.state == CLOSED
+        finally:
+            await c.close()
+            await server.stop()
+
+    async def test_budget_capped_timeout_not_breaker_food(self):
+        """A timeout whose clock was the caller's nearly-spent deadline
+        budget must not trip a healthy endpoint's breaker."""
+        async def slow(payload, okey):
+            await asyncio.sleep(5)
+            return b""
+        server = RPCServer()
+        server.register("svc", {"slow": slow})
+        await server.start()
+        b = CircuitBreaker(failure_threshold=1)
+        c = RPCClient("127.0.0.1", server.port, local_bypass=False,
+                      breaker=b)
+        try:
+            with deadline_scope(0.1):     # budget caps the 30s timeout
+                with pytest.raises(RPCTimeoutError):
+                    await c.call("svc", "slow", b"", timeout=30.0)
+            assert b.state == CLOSED      # healthy endpoint: no verdict
+            # an UNCAPPED timeout is a real endpoint verdict
+            with pytest.raises(RPCTimeoutError):
+                await c.call("svc", "slow", b"", timeout=0.1)
+            assert b.state == OPEN
+        finally:
+            await c.close()
+            await server.stop()
+
+    async def test_handler_error_stays_plain_rpc_error(self):
+        async def boom(payload, okey):
+            raise ValueError("bad")
+        server = RPCServer()
+        server.register("svc", {"boom": boom})
+        await server.start()
+        c = RPCClient("127.0.0.1", server.port, local_bypass=False,
+                      breaker=CircuitBreaker(failure_threshold=1))
+        try:
+            with pytest.raises(RPCError) as ei:
+                await c.call("svc", "boom", b"")
+            assert not isinstance(ei.value, RPCTransportError)
+            # a reflected handler error is a SUCCESSFUL round trip: the
+            # breaker must not trip
+            assert c.breaker.state == CLOSED
+        finally:
+            await c.close()
+            await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# deadline budget propagation across the wire
+# ---------------------------------------------------------------------------
+
+class TestDeadlinePropagation:
+    async def test_budget_caps_timeout_and_reaches_handler(self):
+        seen = {}
+
+        async def probe(payload, okey):
+            seen["budget"] = remaining_budget()
+            return b"ok"
+
+        server = RPCServer()
+        server.register("svc", {"probe": probe})
+        await server.start()
+        c = RPCClient("127.0.0.1", server.port, local_bypass=False)
+        try:
+            with deadline_scope(2.0):
+                assert await c.call("svc", "probe", b"") == b"ok"
+            # the server handler inherited the (shrunken) budget
+            assert seen["budget"] is not None and 0.0 < seen["budget"] <= 2.0
+            # outside a scope there is no header and no budget
+            seen.clear()
+            assert await c.call("svc", "probe", b"") == b"ok"
+            assert seen["budget"] is None
+        finally:
+            await c.close()
+            await server.stop()
+
+    async def test_exhausted_budget_fails_fast(self):
+        server = RPCServer()
+        server.register("svc", {"echo": _echo})
+        await server.start()
+        c = RPCClient("127.0.0.1", server.port, local_bypass=False)
+        base = FABRIC.get(FabricMetric.RPC_DEADLINE_EXPIRED)
+        try:
+            with deadline_scope(0.0):
+                t0 = time.monotonic()
+                with pytest.raises(RPCTimeoutError):
+                    await c.call("svc", "echo", b"", timeout=30.0)
+                assert time.monotonic() - t0 < 1.0   # no 30s wait
+            assert FABRIC.get(FabricMetric.RPC_DEADLINE_EXPIRED) == base + 1
+        finally:
+            await c.close()
+            await server.stop()
+
+    async def test_local_bypass_honors_budget(self):
+        seen = {}
+
+        async def probe(payload, okey):
+            seen["budget"] = remaining_budget()
+            return b"ok"
+
+        server = RPCServer()
+        server.register("svc", {"probe": probe})
+        await server.start()
+        c = RPCClient("127.0.0.1", server.port)   # bypass on
+        try:
+            with deadline_scope(2.0):
+                await c.call("svc", "probe", b"")
+            # contextvars flow straight through the in-proc dispatch
+            assert seen["budget"] is not None and seen["budget"] <= 2.0
+            # the ORDERED bypass path runs in the drain task's context —
+            # the deadline must be re-armed there explicitly
+            seen.clear()
+            with deadline_scope(2.0):
+                await c.call("svc", "probe", b"", order_key="k")
+            assert seen["budget"] is not None and 0.0 < seen["budget"] <= 2.0
+        finally:
+            await c.close()
+            await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+class TestFaultInjector:
+    async def test_rule_matching_probability_and_max_hits(self):
+        inj = FaultInjector(seed=1)
+        rule = inj.add_rule(service="s", method="m", probability=1.0,
+                            action="error", max_hits=2)
+        assert inj.decide("client", "s", "m") is rule
+        assert inj.decide("client", "s", "m") is rule
+        assert inj.decide("client", "s", "m") is None     # hits exhausted
+        assert inj.decide("client", "other", "m") is None  # no match
+        inj.add_rule(service="z", probability=0.0)
+        assert inj.decide("client", "z", "m") is None      # p=0 never fires
+
+    async def test_client_error_injection(self):
+        server = RPCServer()
+        server.register("svc", {"echo": _echo})
+        await server.start()
+        c = RPCClient("127.0.0.1", server.port, local_bypass=False)
+        inj = get_injector()
+        base = inj.injected_total
+        inj.add_rule(service="svc", method="echo", side="client",
+                     action="error", max_hits=1)
+        try:
+            with pytest.raises(RPCTransportError, match="injected"):
+                await c.call("svc", "echo", b"x")
+            assert inj.injected_total == base + 1
+            assert FABRIC.get(FabricMetric.FAULTS_INJECTED) >= 1
+            # rule exhausted: traffic flows again
+            assert await c.call("svc", "echo", b"x") == b"echo:x"
+        finally:
+            await c.close()
+            await server.stop()
+
+    async def test_server_drop_times_out_then_recovers(self):
+        server = RPCServer()
+        server.register("svc", {"echo": _echo})
+        await server.start()
+        c = RPCClient("127.0.0.1", server.port, local_bypass=False)
+        get_injector().add_rule(service="svc", method="echo", side="server",
+                                action="drop", max_hits=1)
+        try:
+            with pytest.raises(RPCTimeoutError):
+                await c.call("svc", "echo", b"x", timeout=0.1)
+            assert await c.call("svc", "echo", b"x") == b"echo:x"
+        finally:
+            await c.close()
+            await server.stop()
+
+    async def test_server_delay_injection(self):
+        server = RPCServer()
+        server.register("svc", {"echo": _echo})
+        await server.start()
+        c = RPCClient("127.0.0.1", server.port, local_bypass=False)
+        get_injector().add_rule(service="svc", method="echo", side="server",
+                                action="delay", delay=0.2, max_hits=1)
+        try:
+            t0 = time.monotonic()
+            assert await c.call("svc", "echo", b"x") == b"echo:x"
+            assert time.monotonic() - t0 >= 0.2
+        finally:
+            await c.close()
+            await server.stop()
+
+    async def test_server_disconnect_fails_pending_fast(self):
+        server = RPCServer()
+        server.register("svc", {"echo": _echo})
+        await server.start()
+        c = RPCClient("127.0.0.1", server.port, local_bypass=False)
+        get_injector().add_rule(service="svc", method="echo", side="server",
+                                action="disconnect", max_hits=1)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(RPCTransportError):
+                await c.call("svc", "echo", b"x", timeout=10.0)
+            assert time.monotonic() - t0 < 2.0    # no timeout wait
+            assert await c.call("svc", "echo", b"x") == b"echo:x"
+        finally:
+            await c.close()
+            await server.stop()
+
+    async def test_check_raise_for_non_wire_hooks(self):
+        inj = FaultInjector()
+        inj.add_rule(service="tpu-matcher", action="error", max_hits=1)
+        with pytest.raises(InjectedFault):
+            inj.check_raise("matcher", "tpu-matcher", "match")
+        inj.check_raise("matcher", "tpu-matcher", "match")   # exhausted
+
+    async def test_check_raise_leaves_wire_actions_armed(self):
+        """A hook point that can only honor ``error`` must not consume
+        (or meter) wildcard rules carrying wire-only actions."""
+        inj = FaultInjector(seed=1)
+        rule = inj.add_rule(service="*", action="drop", probability=1.0,
+                            max_hits=1)
+        inj.check_raise("matcher", "tpu-matcher", "match")
+        assert rule.hits == 0 and inj.injected_total == 0
+        # the wire hook can still fire it
+        assert inj.decide("server", "svc", "m") is rule
+        assert rule.hits == 1
+
+    async def test_corrupt_flips_bytes(self):
+        inj = FaultInjector(seed=2)
+        assert inj.corrupt(b"") == b"\xff"
+        p = b"hello"
+        q = inj.corrupt(p)
+        assert len(q) == len(p) and q != p
+
+
+# ---------------------------------------------------------------------------
+# ordered runner retirement (satellite: _drain idle-retirement race)
+# ---------------------------------------------------------------------------
+
+class TestOrderedRunnerRetirement:
+    async def test_idle_retirement_bounds_state_and_revives(self):
+        runner = _OrderedRunner()
+        runner.IDLE_RETIRE_S = 0.05
+        ran = []
+
+        def mk(i):
+            async def one():
+                ran.append(i)
+            return one
+
+        runner.submit("k", mk(0))
+        for _ in range(100):
+            if "k" not in runner._queues:
+                break
+            await asyncio.sleep(0.02)
+        assert "k" not in runner._queues and "k" not in runner._tasks
+        # a fresh submit after retirement spawns a new runner and runs
+        runner.submit("k", mk(1))
+        await asyncio.sleep(0.02)
+        assert ran == [0, 1]
+        runner.close()
+
+    async def test_no_submission_lost_around_retirement_windows(self):
+        """Hammer submissions right at the idle-retirement boundary: no
+        coro_fn may ever be silently dropped (the pre-fix failure mode:
+        an enqueue racing retirement landed on an abandoned queue)."""
+        runner = _OrderedRunner()
+        runner.IDLE_RETIRE_S = 0.03
+        ran = []
+
+        def mk(i):
+            async def one():
+                ran.append(i)
+            return one
+
+        n = 0
+        for delay in (0.028, 0.03, 0.031, 0.032, 0.029) * 4:
+            runner.submit("k", mk(n))
+            n += 1
+            await asyncio.sleep(delay)
+        for _ in range(100):
+            if len(ran) == n:
+                break
+            await asyncio.sleep(0.02)
+        assert sorted(ran) == list(range(n))      # nothing lost
+        assert ran == sorted(ran)                 # FIFO preserved
+        runner.close()
+
+    async def test_timeout_with_pending_item_requeues_not_drops(
+            self, monkeypatch):
+        """Deterministic reproduction of the retirement race: wait_for
+        times out even though an item IS in the queue (the pre-3.12
+        lost-wakeup window). The pre-fix _drain retired the queue and
+        silently abandoned the item; the fixed _drain deregisters first,
+        sees the non-empty queue, re-registers itself and drains it."""
+        runner = _OrderedRunner()
+        ran = []
+
+        async def one():
+            ran.append("x")
+
+        real_wait_for = asyncio.wait_for
+        calls = {"n": 0}
+
+        async def racy_wait_for(aw, timeout):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                # dispose of q.get() WITHOUT consuming the queued item,
+                # then report a timeout — exactly the lost-wakeup shape
+                t = asyncio.ensure_future(aw)
+                t.cancel()
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
+                raise asyncio.TimeoutError
+            return await real_wait_for(aw, timeout)
+
+        monkeypatch.setattr(asyncio, "wait_for", racy_wait_for)
+        runner.submit("k", one)
+        for _ in range(100):
+            if ran:
+                break
+            await asyncio.sleep(0.01)
+        assert ran == ["x"], "item abandoned by idle retirement"
+        assert "k" in runner._queues     # the runner re-registered itself
+        runner.close()
+
+
+# ---------------------------------------------------------------------------
+# match-path degradation (tentpole: TPU fault / deadline → host oracle)
+# ---------------------------------------------------------------------------
+
+def _mk_route(tf, receiver, broker=0, inc=0):
+    return Route(matcher=RouteMatcher.from_topic_filter(tf),
+                 broker_id=broker, receiver_id=receiver,
+                 deliverer_key="d0", incarnation=inc)
+
+
+class TestMatchDegradation:
+    async def test_matcher_fault_serves_host_oracle(self):
+        w = DistWorker()
+        await w.start()
+        try:
+            await w.add_route("T", _mk_route("a/+", "r1"))
+            await w.add_route("T", _mk_route("a/b", "r2"))
+            await w.add_route("T", _mk_route("$share/g/a/+", "g1"))
+            degraded = []
+            w.on_degraded = lambda n, reason: degraded.append((n, reason))
+            base = FABRIC.get(FabricMetric.MATCH_DEGRADED)
+            get_injector().add_rule(service="tpu-matcher", action="error",
+                                    max_hits=1)
+            res = await w.match_batch([("T", ["a", "b"])],
+                                      max_persistent_fanout=100,
+                                      max_group_fanout=100)
+            # correct fan-out despite the dead device path
+            assert sorted(r.receiver_id for r in res[0].normal) \
+                == ["r1", "r2"]
+            assert list(res[0].groups) == ["$share/g/a/+"]
+            assert FABRIC.get(FabricMetric.MATCH_DEGRADED) == base + 1
+            assert degraded and degraded[0][0] == 1
+            # rule exhausted: the device path serves again, same answer
+            res2 = await w.match_batch([("T", ["a", "b"])],
+                                       max_persistent_fanout=100,
+                                       max_group_fanout=100)
+            assert sorted(r.receiver_id for r in res2[0].normal) \
+                == ["r1", "r2"]
+            assert FABRIC.get(FabricMetric.MATCH_DEGRADED) == base + 1
+        finally:
+            await w.stop()
+
+    async def test_exhausted_deadline_degrades_not_fails(self):
+        w = DistWorker()
+        await w.start()
+        try:
+            await w.add_route("T", _mk_route("x/#", "r9"))
+            base = FABRIC.get(FabricMetric.MATCH_DEGRADED)
+            res = await w.match_batch([("T", ["x", "y"])],
+                                      max_persistent_fanout=100,
+                                      max_group_fanout=100,
+                                      deadline=time.monotonic() - 1.0)
+            assert [r.receiver_id for r in res[0].normal] == ["r9"]
+            assert FABRIC.get(FabricMetric.MATCH_DEGRADED) == base + 1
+        finally:
+            await w.stop()
+
+    async def test_degradation_matches_oracle_exactly(self):
+        """Host-oracle results equal the device path's for a non-trivial
+        route set (the fallback is exact, not approximate)."""
+        w = DistWorker()
+        await w.start()
+        try:
+            for i in range(40):
+                await w.add_route("T", _mk_route(f"s/{i}/+", f"r{i}"))
+            await w.add_route("T", _mk_route("s/#", "wild"))
+            queries = [("T", ["s", str(i), "leaf"]) for i in range(40)]
+            normal = await w.match_batch(queries,
+                                         max_persistent_fanout=100,
+                                         max_group_fanout=100)
+            get_injector().add_rule(service="tpu-matcher", action="error",
+                                    max_hits=1)
+            degraded = await w.match_batch(queries,
+                                           max_persistent_fanout=100,
+                                           max_group_fanout=100)
+            for a, b in zip(normal, degraded):
+                assert sorted(r.receiver_id for r in a.normal) \
+                    == sorted(r.receiver_id for r in b.normal)
+        finally:
+            await w.stop()

@@ -94,6 +94,48 @@ class TestRPC:
             await client.close()
             await server.stop()
 
+    async def test_pending_calls_fail_fast_and_no_leak_across_conns(self):
+        """Killing the server must fail every pending call promptly (no
+        hung futures) and leave no _pending entries behind; after a
+        restart on the same port the client re-dials transparently and
+        the fresh connection starts with a clean correlation map."""
+        from bifromq_tpu.rpc.fabric import RPCTransportError
+
+        async def slow(payload, okey):
+            await asyncio.sleep(30)
+            return b""
+
+        server = RPCServer()
+        server.register("svc", {"slow": slow, "echo": _echo})
+        await server.start()
+        port = server.port
+        client = RPCClient("127.0.0.1", port, local_bypass=False)
+        try:
+            pend = [asyncio.ensure_future(
+                client.call("svc", "slow", b"", timeout=30))
+                for _ in range(5)]
+            await asyncio.sleep(0.05)
+            assert len(client._pending) == 5
+            t0 = asyncio.get_running_loop().time()
+            await server.stop()
+            done, _ = await asyncio.wait(pend, timeout=5)
+            assert len(done) == 5, "pending calls hung after server death"
+            assert asyncio.get_running_loop().time() - t0 < 5
+            for f in done:
+                assert isinstance(f.exception(), RPCTransportError)
+            assert not client._pending, "leaked correlation entries"
+            # restart on the SAME port: the next call re-dials and works
+            server2 = RPCServer(port=port)
+            server2.register("svc", {"echo": _echo})
+            await server2.start()
+            try:
+                assert await client.call("svc", "echo", b"hi") == b"echo:hi"
+                assert not client._pending
+            finally:
+                await server2.stop()
+        finally:
+            await client.close()
+
     async def test_reconnect_after_server_restart(self):
         server = RPCServer()
         server.register("svc", {"echo": _echo})
