@@ -682,6 +682,13 @@ def bench_broker():
                 return 100_000_000
             return super().provide(setting, tenant_id)
 
+    # per-stage latency breakdown (ISSUE 2): the hot path feeds the
+    # always-on stage histograms (ingest / queue_wait / device / deliver,
+    # + rpc in clustered mode) whether or not span sampling is enabled —
+    # reset here so the breakdown covers exactly this run
+    from bifromq_tpu.utils.metrics import STAGES
+    STAGES.reset()
+
     async def run():
         broker = MQTTBroker(host="127.0.0.1", port=0,
                             settings=BenchSettings())
@@ -740,6 +747,7 @@ def bench_broker():
         }
 
     out = asyncio.run(run())
+    out["stage_latency_ms"] = STAGES.snapshot()
     log(f"[broker_e2e] {json.dumps(out)}")
     return out
 
@@ -903,6 +911,11 @@ def main():
     record["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
     record["platform"] = jax.devices()[0].platform
     record["n_subs"] = N_SUBS
+    # per-stage p50/p99 next to the headline (ISSUE 2): where the broker
+    # plane actually spends its time (queue-wait vs device vs deliver)
+    stage = results.get("broker", {}).get("stage_latency_ms")
+    if stage:
+        record["stage_latency_ms"] = stage
     # persist last-known-good for a real headline only (a partial
     # broker-only or error-path run must never clobber it). A CPU-platform
     # headline IS a valid record — the stock baseline ran on the same

@@ -148,6 +148,12 @@ class APIServer:
             if route == ("GET", "/metrics"):
                 return 200, (self.metrics.snapshot()
                              if self.metrics is not None else {})
+            if route == ("GET", "/trace"):
+                return self._trace_get(arg, slow=False)
+            if route == ("GET", "/trace/slow"):
+                return self._trace_get(arg, slow=True)
+            if route == ("PUT", "/trace"):
+                return self._trace_config(arg)
             if route == ("GET", "/ranges"):
                 return self._ranges()
             if route == ("GET", "/balancer"):
@@ -289,6 +295,43 @@ class APIServer:
         ok = await self.broker.retain_service.retain(
             ClientInfo(tenant_id=tenant, type="API"), topic, msg)
         return (200 if ok else 429), {"retained": ok and bool(body)}
+
+    # -- flight recorder (ISSUE 2: /trace, /trace/slow + sampling knobs) ----
+
+    def _trace_get(self, arg, slow: bool) -> Tuple[int, object]:
+        from .. import trace as tr
+        spans = tr.TRACER.export(trace_id=arg("trace_id"),
+                                 tenant=arg("tenant_id"),
+                                 limit=int(arg("limit", "256")),
+                                 slow=slow)
+        return 200, {"count": len(spans),
+                     "dropped": (tr.TRACER.slow_ring if slow
+                                 else tr.TRACER.ring).dropped,
+                     "sampling": tr.TRACER.sampler.snapshot(),
+                     "slow_ms": tr.TRACER.slow_ms,
+                     "spans": spans}
+
+    def _trace_config(self, arg) -> Tuple[int, object]:
+        """Runtime sampling knobs: ``rate`` (0..1, per-tenant when
+        ``tenant_id`` is given, else the process default) and ``slow_ms``
+        (0 disarms the always-on-slow capture)."""
+        from .. import trace as tr
+        # parse EVERYTHING before applying anything: a 400 on a bad knob
+        # must not leave sampling half-reconfigured
+        rate = arg("rate")
+        r = float(rate) if rate is not None else None
+        slow = arg("slow_ms")
+        v = float(slow) if slow is not None else None
+        if r is not None:
+            tenant = arg("tenant_id")
+            if tenant:
+                tr.TRACER.sampler.set_rate(tenant, r)
+            else:
+                tr.TRACER.sampler.default_rate = r
+        if v is not None:
+            tr.TRACER.slow_ms = v if v > 0 else None
+        return 200, {"sampling": tr.TRACER.sampler.snapshot(),
+                     "slow_ms": tr.TRACER.slow_ms}
 
     def _cluster_info(self) -> Tuple[int, object]:
         if self.cluster is None:

@@ -18,6 +18,7 @@ from __future__ import annotations
 import struct
 from typing import Dict, List, Sequence, Tuple
 
+from .. import trace
 from ..kv import schema
 from ..plugin.subbroker import DeliveryPack, DeliveryResult
 from ..rpc.fabric import RPCServer, _len16, _read16
@@ -132,14 +133,17 @@ class DelivererRPCService:
 
     async def _on_deliver(self, payload: bytes, _okey: str) -> bytes:
         tenant_id, broker_id, dkey, pack, mis = decode_deliver(payload)
-        if not self.sub_brokers.has(broker_id):
-            return bytes([_RESULT_CODE[DeliveryResult.NO_RECEIVER]] *
-                         len(mis))
-        broker = self.sub_brokers.get(broker_id)
-        dp = DeliveryPack(message_pack=pack, match_infos=tuple(mis))
-        res = await broker.deliver(tenant_id, dkey, [dp])
-        return bytes(_RESULT_CODE[res.get(mi, DeliveryResult.ERROR)]
-                     for mi in mis)
+        with trace.span("deliver.remote", tenant=tenant_id,
+                        broker_id=broker_id, deliverer_key=dkey,
+                        receivers=len(mis)):
+            if not self.sub_brokers.has(broker_id):
+                return bytes([_RESULT_CODE[DeliveryResult.NO_RECEIVER]] *
+                             len(mis))
+            broker = self.sub_brokers.get(broker_id)
+            dp = DeliveryPack(message_pack=pack, match_infos=tuple(mis))
+            res = await broker.deliver(tenant_id, dkey, [dp])
+            return bytes(_RESULT_CODE[res.get(mi, DeliveryResult.ERROR)]
+                         for mi in mis)
 
 
 async def remote_deliver(registry, server_id: str, tenant_id: str,

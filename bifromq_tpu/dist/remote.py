@@ -15,13 +15,16 @@ BatchMatchCall routing by route key).
 from __future__ import annotations
 
 import asyncio
+import json
 import struct
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
+from .. import trace
 from ..models.oracle import MatchedRoutes, Route
+from ..raft.node import NotLeaderError
 from ..resilience.policy import (DEFAULT_RETRY_POLICY, RetryPolicy,
                                  is_idempotent)
-from ..rpc.fabric import (RPCCircuitOpenError, RPCServer,
+from ..rpc.fabric import (RPCCircuitOpenError, RPCError, RPCServer,
                           RPCTransportError, ServiceRegistry, _len16,
                           _read16)
 from ..types import RouteMatcher
@@ -46,20 +49,51 @@ class DistWorkerRPCService:
             "remove_route": self._remove_route,
             "match_batch": self._match_batch,
             "purge_broker": self._purge_broker,
+            "node_id": self._node_id,
+            "trace_spans": self._trace_spans,
         })
 
     async def _add_route(self, payload: bytes, okey: str) -> bytes:
         tenant_b, pos = _read16(payload, 0)
         route, pos = _dec_route(payload, pos)
-        return (await self.worker.add_route(tenant_b.decode(),
-                                            route)).encode()
+        try:
+            return (await self.worker.add_route(tenant_b.decode(),
+                                                route)).encode()
+        except NotLeaderError as e:
+            # follower replica: hand the LEADER HINT back as a structured
+            # status instead of a reflected error — the client follows it
+            # over the fabric (bounded hops) rather than surfacing the
+            # raft topology to MQTT subscribers (ROADMAP follow-up)
+            return f"not_leader:{e.leader_hint or ''}".encode()
 
     async def _remove_route(self, payload: bytes, okey: str) -> bytes:
         tenant_b, pos = _read16(payload, 0)
         route, pos = _dec_route(payload, pos)
-        return (await self.worker.remove_route(
-            tenant_b.decode(), route.matcher, route.receiver_url,
-            route.incarnation)).encode()
+        try:
+            return (await self.worker.remove_route(
+                tenant_b.decode(), route.matcher, route.receiver_url,
+                route.incarnation)).encode()
+        except NotLeaderError as e:
+            return f"not_leader:{e.leader_hint or ''}".encode()
+
+    async def _node_id(self, payload: bytes, okey: str) -> bytes:
+        """Endpoint → raft-node identity (the leader-hint resolver's map)."""
+        return self.worker.store.node_id.encode()
+
+    async def _trace_spans(self, payload: bytes, okey: str) -> bytes:
+        """Export this worker process's span ring (ISSUE 2): payload is an
+        optional JSON filter {trace_id, tenant, limit, slow} — how a
+        frontend (or test) collects the remote half of a distributed
+        trace."""
+        try:
+            args = json.loads(payload.decode() or "{}")
+        except ValueError:
+            args = {}
+        spans = trace.TRACER.export(
+            trace_id=args.get("trace_id"), tenant=args.get("tenant"),
+            limit=int(args.get("limit", 1000)),
+            slow=bool(args.get("slow", False)))
+        return json.dumps(spans).encode()
 
     async def _match_batch(self, payload: bytes, okey: str) -> bytes:
         mpf, mgf, lin, n = struct.unpack_from(">IIBI", payload, 0)
@@ -107,6 +141,81 @@ class RemoteDistWorker:
         # is 5s) but must not hang SUBSCRIBE for the 30s default against
         # a blackholed endpoint
         self.mutation_timeout = mutation_timeout
+        # leader-hint redirects (ROADMAP follow-up): raft node id →
+        # endpoint, learned lazily via the "node_id" method
+        self._node_eps: Dict[str, str] = {}
+
+    # a mutation may bounce follower→leader at most this many times (a
+    # re-election mid-chase gets a fresh hint each hop)
+    MAX_REDIRECT_HOPS = 3
+
+    async def _endpoint_of_node(self, node_id: str) -> Optional[str]:
+        """Resolve a raft leader hint (``node`` or ``node:range`` form) to
+        the RPC endpoint announcing that worker, refreshing the cached map
+        from the live endpoint set on a miss."""
+        node_id = node_id.partition(":")[0]
+        live = set(self.registry.endpoints(self.service))
+        ep = self._node_eps.get(node_id)
+        if ep in live:
+            return ep
+
+        # probe candidates CONCURRENTLY: this runs on the SUBSCRIBE
+        # mutation path, and a sequential scan over N endpoints with
+        # blackholed members would stall it N×timeout instead of one
+        async def probe(cand: str):
+            try:
+                nid = (await self.registry.client_for(cand).call(
+                    self.service, "node_id", b"", timeout=2.0)).decode()
+                return nid.partition(":")[0], cand
+            except RPCError:
+                return None
+
+        for hit in await asyncio.gather(*(probe(c) for c in live)):
+            if hit is not None:
+                self._node_eps[hit[0]] = hit[1]
+        return self._node_eps.get(node_id)
+
+    async def _mutate_rpc(self, method: str, tenant_id: str,
+                          payload: bytes) -> str:
+        """Route mutation with leader-hint forwarding: a ``not_leader:<id>``
+        status from a follower replica redirects the call to the hinted
+        leader's endpoint over the fabric (bounded hops) instead of
+        surfacing ``NotLeaderError`` to the caller. A hint-less bounce
+        (election in progress) backs off and re-picks."""
+        out = (await self.registry.call_resilient(
+            self.service, tenant_id, method, payload,
+            order_key=tenant_id, policy=self.retry_policy,
+            timeout=self.mutation_timeout)).decode()
+        hops = 0
+        while out.startswith("not_leader") and hops < self.MAX_REDIRECT_HOPS:
+            hops += 1
+            hint = out.partition(":")[2].partition(":")[0]
+            ep = await self._endpoint_of_node(hint) if hint else None
+            if ep is None:
+                # no (resolvable) leader yet: brief backoff, then let the
+                # rendezvous pick try again — the election may settle on
+                # any replica. Not metered: nothing was redirected.
+                await asyncio.sleep(self.retry_policy.backoff(hops))
+                out = (await self.registry.call_resilient(
+                    self.service, tenant_id, method, payload,
+                    order_key=tenant_id, policy=self.retry_policy,
+                    timeout=self.mutation_timeout)).decode()
+                continue
+            FABRIC.inc(FabricMetric.LEADER_REDIRECTS)
+            out = (await self.registry.client_for(ep).call(
+                self.service, method, payload, order_key=tenant_id,
+                timeout=self.mutation_timeout)).decode()
+            if out.startswith("not_leader"):
+                # the hinted "leader" bounced too: the cached node→endpoint
+                # mapping may be stale (endpoint reused by another worker)
+                # — drop it so the next hop re-verifies instead of looping
+                # on the same wrong endpoint until hops run out
+                self._node_eps.pop(hint, None)
+        if out.startswith("not_leader"):
+            raise RPCTransportError(
+                f"{method} found no stable leader after {hops} "
+                f"redirect hops (last hint: {out.partition(':')[2] or '?'})")
+        return out
 
     # DistService lifecycle hooks
     async def start(self) -> None:
@@ -122,14 +231,11 @@ class RemoteDistWorker:
 
     async def add_route(self, tenant_id: str, route: Route) -> str:
         payload = _len16(tenant_id.encode()) + _enc_route(route)
-        # breaker-aware pick, normalized taxonomy; NOT auto-retried —
-        # mutations aren't on the idempotency whitelist, the caller owns
-        # the ambiguity of a transport failure mid-mutation
-        out = await self.registry.call_resilient(
-            self.service, tenant_id, "add_route", payload,
-            order_key=tenant_id, policy=self.retry_policy,
-            timeout=self.mutation_timeout)
-        return out.decode()
+        # breaker-aware pick, normalized taxonomy; NOT auto-retried on
+        # transport failure — mutations aren't on the idempotency
+        # whitelist, the caller owns that ambiguity. A not_leader bounce
+        # IS followed (the server answered; nothing executed).
+        return await self._mutate_rpc("add_route", tenant_id, payload)
 
     async def remove_route(self, tenant_id: str, matcher: RouteMatcher,
                            receiver_url: Tuple[int, str, str],
@@ -138,11 +244,7 @@ class RemoteDistWorker:
                       receiver_id=receiver_url[1],
                       deliverer_key=receiver_url[2], incarnation=incarnation)
         payload = _len16(tenant_id.encode()) + _enc_route(route)
-        out = await self.registry.call_resilient(
-            self.service, tenant_id, "remove_route", payload,
-            order_key=tenant_id, policy=self.retry_policy,
-            timeout=self.mutation_timeout)
-        return out.decode()
+        return await self._mutate_rpc("remove_route", tenant_id, payload)
 
     async def match_batch(self, queries: Sequence[Tuple[str, Sequence[str]]],
                           *, max_persistent_fanout: int,

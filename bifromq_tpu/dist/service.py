@@ -33,10 +33,12 @@ from ..plugin.events import Event, EventType, IEventCollector
 from ..plugin.settings import ISettingProvider, Setting
 from ..plugin.subbroker import (DeliveryPack, DeliveryResult, ISubBroker,
                                 SubBrokerRegistry)
+from .. import trace
 from ..scheduler.batcher import BatchCallScheduler
 from ..types import (ClientInfo, MatchInfo, Message, PublisherMessagePack,
                      RouteMatcher, TopicMessagePack)
 from ..utils import topic as topic_util
+from ..utils.metrics import STAGES
 
 
 @dataclass
@@ -83,7 +85,8 @@ class DistService:
         self._tenant_epoch: Dict[str, int] = {}
         self._pub_scheduler: BatchCallScheduler[PubCall, PubResult] = \
             BatchCallScheduler(lambda tenant: self._make_pub_batch(tenant),
-                               max_burst_latency=max_burst_latency)
+                               max_burst_latency=max_burst_latency,
+                               stage="queue_wait")
 
     @property
     def matcher(self) -> TpuMatcher:
@@ -308,6 +311,21 @@ class DistService:
 
     async def _fan_out(self, tenant_id: str, call: PubCall,
                        matched: MatchedRoutes) -> int:
+        """Span-wrapped fan-out (ISSUE 2): one "deliver.fanout" span per
+        publish with the achieved fan-out, feeding the "deliver" stage
+        histogram either way."""
+        t0 = time.perf_counter()
+        try:
+            with trace.span("deliver.fanout", tenant=tenant_id,
+                            topic=call.topic) as sp:
+                fanout = await self._fan_out_inner(tenant_id, call, matched)
+                sp.set_tag("fanout", fanout)
+                return fanout
+        finally:
+            STAGES.record("deliver", time.perf_counter() - t0)
+
+    async def _fan_out_inner(self, tenant_id: str, call: PubCall,
+                             matched: MatchedRoutes) -> int:
         if matched.max_persistent_fanout_exceeded:
             self.events.report(Event(EventType.PERSISTENT_FANOUT_THROTTLED,
                                      tenant_id, {"topic": call.topic}))

@@ -20,6 +20,7 @@ import struct
 import time as _time
 from typing import List, Optional, Sequence, Tuple
 
+from .. import trace
 from ..kv import schema
 from ..kv.engine import IKVSpace, KVWriteBatch
 from ..kv.range import IKVRangeCoProc
@@ -29,7 +30,7 @@ from ..resilience.faults import get_injector
 from ..resilience.policy import current_deadline
 from ..types import RouteMatcher
 from ..utils import topic as topic_util
-from ..utils.metrics import FABRIC, FabricMetric
+from ..utils.metrics import FABRIC, STAGES, FabricMetric
 
 _OP_ADD = 0
 _OP_REMOVE = 1
@@ -562,13 +563,18 @@ class DistWorker:
         of failing the publish (Tailwind's accelerator-offload-behind-a-
         failure-boundary discipline; ops/match.py already does this for
         bounded-work overflow)."""
+        t0 = _time.perf_counter()
         try:
             get_injector().check_raise("matcher", "tpu-matcher", "match")
             if deadline is not None and _time.monotonic() >= deadline:
                 raise TimeoutError("match deadline budget exhausted")
-            return coproc.matcher.match_batch(
-                sub, max_persistent_fanout=max_persistent_fanout,
-                max_group_fanout=max_group_fanout)
+            with trace.span("match.device", tenant=sub[0][0],
+                            n_queries=len(sub)):
+                out = coproc.matcher.match_batch(
+                    sub, max_persistent_fanout=max_persistent_fanout,
+                    max_group_fanout=max_group_fanout)
+            STAGES.record("device", _time.perf_counter() - t0)
+            return out
         except Exception as e:  # noqa: BLE001 — degrade, don't fail
             oracle = getattr(coproc.matcher, "match_from_tries", None)
             if oracle is None:
@@ -580,8 +586,15 @@ class DistWorker:
             cb = self.on_degraded
             if cb is not None:
                 cb(len(sub), repr(e))
-            return oracle(sub, max_persistent_fanout=max_persistent_fanout,
-                          max_group_fanout=max_group_fanout)
+            # degraded-path span: tagged with the reason so /trace can
+            # separate host-oracle serves from true device time
+            with trace.span("match.degraded", tenant=sub[0][0],
+                            n_queries=len(sub), reason=repr(e)[:120]):
+                out = oracle(sub,
+                             max_persistent_fanout=max_persistent_fanout,
+                             max_group_fanout=max_group_fanout)
+            STAGES.record("device", _time.perf_counter() - t0)
+            return out
 
     async def match_batch(self, queries, *, max_persistent_fanout,
                           max_group_fanout, linearized: bool = False,

@@ -21,11 +21,17 @@ pin_jax_platform()
 
 
 async def serve(args) -> None:
+    from .. import trace
     from ..kv.native import NativeKVEngine
     from ..raft.store import KVRaftStateStore
     from ..rpc.fabric import RPCServer
     from .remote import DistWorkerRPCService
     from .worker import DistWorker
+
+    # attribute this process's spans (exported via the "trace_spans"
+    # method / the owning node's /trace) to the worker role
+    if "BIFROMQ_TRACE_SERVICE" not in os.environ:
+        trace.TRACER.service = f"dist-worker:{args.node_id}"
 
     engine = None
     raft_store_factory = None

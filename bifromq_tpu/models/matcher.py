@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from .. import trace
 from ..utils import topic as topic_util
 from .automaton import (
     CompiledTrie, GroupMatching, Matching, TokenizedTopics, compile_tries,
@@ -338,14 +339,23 @@ class TpuMatcher:
         # below — fusing it into this jit (like the bench does) would
         # compile the high-K escalation walk on the first serving query,
         # doubling cold-start latency for a pass that almost never runs
-        res = walk_routes(self._device_trie, probes, probe_len=ct.probe_len,
-                          k_states=self.k_states,
-                          max_intervals=self.max_intervals, esc_k=0)
+        # dispatch vs device time split (ISSUE 2): walk_routes returns as
+        # soon as the device work is ENQUEUED; only the readback below
+        # truly synchronizes (block_until_ready is a no-op on the axon
+        # tunnel backend) — two spans attribute host dispatch cost apart
+        # from real device walk time
+        with trace.span("device.dispatch", batch=batch,
+                        queries=len(queries)):
+            res = walk_routes(self._device_trie, probes,
+                              probe_len=ct.probe_len,
+                              k_states=self.k_states,
+                              max_intervals=self.max_intervals, esc_k=0)
         # writable copies: escalation patches rescued rows in place (a
         # bare asarray view of a jax buffer is read-only)
-        overflow = np.array(res.overflow)
-        starts_a = np.array(res.start)
-        counts_a = np.array(res.count)
+        with trace.span("device.sync"):
+            overflow = np.array(res.overflow)
+            starts_a = np.array(res.start)
+            counts_a = np.array(res.count)
 
         # host-triggered escalation: rows whose active set (or interval
         # budget) overflowed re-walk in one compacted sub-batch at a

@@ -18,6 +18,7 @@ import hashlib
 import logging
 from typing import Dict, List, Sequence, Set, Tuple
 
+from .. import trace
 from ..plugin.subbroker import DeliveryPack, DeliveryResult, ISubBroker
 from ..types import MatchInfo, RouteMatcher
 
@@ -153,6 +154,12 @@ class LocalTopicRouter(ISubBroker):
                       packs: Sequence[DeliveryPack]
                       ) -> Dict[MatchInfo, DeliveryResult]:
         out: Dict[MatchInfo, DeliveryResult] = {}
+        with trace.span("deliver.local_fanout", tenant=tenant_id,
+                        deliverer_key=deliverer_key):
+            await self._deliver_inner(tenant_id, packs, out)
+        return out
+
+    async def _deliver_inner(self, tenant_id, packs, out) -> None:
         for pack in packs:
             for mi in pack.match_infos:
                 tf = mi.matcher.mqtt_topic_filter
@@ -181,7 +188,6 @@ class LocalTopicRouter(ISubBroker):
                     # route-write invariant consistent
                     del self._index[(tenant_id, tf)]
                     out[mi] = DeliveryResult.NO_RECEIVER
-        return out
 
     def _live_subscribers(self, tenant_id: str, topic_filter: str) -> int:
         """Count live index entries, pruning sessions that died or dropped

@@ -29,9 +29,11 @@ from ..plugin.events import Event, EventType, IEventCollector
 from ..plugin.settings import Setting, TenantSettings
 from ..plugin.subbroker import (DeliveryPack, DeliveryResult, ISubBroker,
                                 TRANSIENT_SUB_BROKER_ID)
+from .. import trace
 from ..types import ClientInfo, MatchInfo, Message, QoS, RouteMatcher
 from ..utils import topic as topic_util
 from ..utils.hlc import HLC
+from ..utils.metrics import STAGES
 from . import packets as pk
 from .protocol import (PROTOCOL_MQTT5, PropertyId, ReasonCode,
                        CONNACK_ACCEPTED)
@@ -213,14 +215,18 @@ class TransientSubBroker(ISubBroker):
                       packs: Sequence[DeliveryPack]
                       ) -> Dict[MatchInfo, DeliveryResult]:
         out: Dict[MatchInfo, DeliveryResult] = {}
-        for pack in packs:
-            for mi in pack.match_infos:
-                session = self.registry.get(mi.receiver_id)
-                if session is None or session.closed:
-                    out[mi] = DeliveryResult.NO_RECEIVER
-                    continue
-                ok = await session.deliver(pack.message_pack, mi)
-                out[mi] = DeliveryResult.OK if ok else DeliveryResult.NO_SUB
+        with trace.span("deliver.transient", tenant=tenant_id,
+                        deliverer_key=deliverer_key) as sp:
+            for pack in packs:
+                for mi in pack.match_infos:
+                    session = self.registry.get(mi.receiver_id)
+                    if session is None or session.closed:
+                        out[mi] = DeliveryResult.NO_RECEIVER
+                        continue
+                    ok = await session.deliver(pack.message_pack, mi)
+                    out[mi] = (DeliveryResult.OK if ok
+                               else DeliveryResult.NO_SUB)
+            sp.set_tag("receivers", len(out))
         return out
 
     async def check_subscriptions(self, tenant_id: str,
@@ -712,6 +718,21 @@ class Session:
         self.events.report(Event(EventType.PUB_RECEIVED,
                                  self.client_info.tenant_id,
                                  {"topic": topic, "qos": p.qos}))
+        # ISSUE 2: the publish→match→deliver ROOT span — the per-tenant
+        # sampling draw for the whole distributed trace happens here; the
+        # "ingest" stage histogram records regardless of sampling
+        t0 = time.monotonic()
+        try:
+            with trace.span("pub.ingest", tenant=self.client_info.tenant_id,
+                            topic=topic, qos=p.qos):
+                await self._ingest_publish(p, topic, msg)
+        finally:
+            STAGES.record("ingest", time.monotonic() - t0)
+
+    async def _ingest_publish(self, p: pk.Publish, topic: str,
+                              msg: Message) -> None:
+        """Retain + dist + ack — the traced tail of ``_on_publish``."""
+        ts = self.settings
         if p.retain and self.retain_service is not None:
             if ts[Setting.RetainEnabled]:
                 await self.retain_service.retain(self.client_info, topic, msg)

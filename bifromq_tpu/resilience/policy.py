@@ -99,6 +99,8 @@ def absolute_deadline(deadline: Optional[float]) -> Iterator[None]:
 # leader-rerouting loop.)
 _IDEMPOTENT: Set[Tuple[str, str]] = {
     ("dist-worker", "match_batch"),
+    ("dist-worker", "node_id"),
+    ("dist-worker", "trace_spans"),
     ("session-dict", "exist"),
     ("session-dict", "clients"),
     ("session-dict", "inbox_state"),

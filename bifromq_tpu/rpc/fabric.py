@@ -23,6 +23,12 @@ Wire format (all big-endian):
   request2:= 0x03 ‖ u64 id ‖ len16 service ‖ len16 method ‖ len16 order_key
              ‖ u32 deadline_ms ‖ payload       (deadline header, ISSUE 1 —
              the remaining call budget, ≈ gRPC's grpc-timeout; 0 = none)
+  request3:= 0x04 ‖ u64 id ‖ len16 service ‖ len16 method ‖ len16 order_key
+             ‖ u32 deadline_ms ‖ u8 trace_len ‖ trace_ctx ‖ payload
+             (request2 header family extended with a trace context,
+             ISSUE 2: trace id ‖ parent span id ‖ sampled flag ‖ sender
+             HLC stamp — the receiver merges the stamp so cross-process
+             spans order causally)
   reply   := 0x02 ‖ u64 id ‖ u8 status ‖ payload      (status 0 = OK)
 
 Resilience (ISSUE 1): transport failures surface as ``RPCTransportError``
@@ -41,14 +47,17 @@ import struct
 import time
 from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 
+from .. import trace as _trace
 from ..resilience import faults as _faults
 from ..resilience import policy as _policy
+from ..utils.metrics import STAGES as _STAGES
 
 log = logging.getLogger(__name__)
 
 _REQ = 0x01
 _REP = 0x02
 _REQ2 = 0x03
+_REQ3 = 0x04
 
 Handler = Callable[[bytes, str], Awaitable[bytes]]
 
@@ -212,14 +221,17 @@ class RPCServer:
         handler = self._services.get(service, {}).get(method)
         if handler is None:
             raise RPCError("no such method")
-        # capture the CALLER's deadline: the ordered path below runs the
-        # handler in the _OrderedRunner drain task, whose context would
-        # otherwise silently drop the budget the wire path re-arms
+        # capture the CALLER's deadline + trace context: the ordered path
+        # below runs the handler in the _OrderedRunner drain task, whose
+        # context would otherwise silently drop the budget (and trace)
+        # the wire path re-arms
         deadline = _policy.current_deadline()
+        tctx = _trace.current_ctx()
 
         async def run() -> bytes:
             try:
-                with _policy.absolute_deadline(deadline):
+                with _policy.absolute_deadline(deadline), \
+                        _trace.activate(tctx):
                     return await handler(payload, order_key)
             except Exception as e:  # noqa: BLE001 — wire-path parity
                 raise RPCError(repr(e)) from e
@@ -252,7 +264,7 @@ class RPCServer:
                 body = await _read_frame(reader)
                 # hostile/truncated frames (port scanners, bad peers) drop
                 # the connection without an unhandled-traceback path
-                if not body or body[0] not in (_REQ, _REQ2):
+                if not body or body[0] not in (_REQ, _REQ2, _REQ3):
                     if not body:
                         break
                     continue
@@ -262,12 +274,26 @@ class RPCServer:
                     method_b, pos = _read16(body, pos)
                     okey_b, pos = _read16(body, pos)
                     deadline = None
-                    if body[0] == _REQ2:
+                    tctx = None
+                    if body[0] in (_REQ2, _REQ3):
                         # deadline header: remaining budget in ms (0 = none)
                         (ms,) = struct.unpack_from(">I", body, pos)
                         pos += 4
                         if ms:
                             deadline = time.monotonic() + ms / 1000.0
+                    if body[0] == _REQ3:
+                        # trace context (ISSUE 2): decode merges the
+                        # sender's HLC stamp into the local clock. A
+                        # trace_len overrunning the frame is a malformed
+                        # frame — drop the connection like any other
+                        # garbled header, never run the handler on a
+                        # truncated payload
+                        tlen = body[pos]
+                        pos += 1
+                        if pos + tlen > len(body):
+                            break
+                        tctx = _trace.extract(body[pos:pos + tlen])
+                        pos += tlen
                     service = service_b.decode()
                     method = method_b.decode()
                     okey = okey_b.decode()
@@ -284,7 +310,8 @@ class RPCServer:
                 handler = self._services.get(service, {}).get(method)
 
                 async def run(rid=rid, handler=handler, payload=payload,
-                              okey=okey, deadline=deadline, fault=fault):
+                              okey=okey, deadline=deadline, fault=fault,
+                              tctx=tctx, service=service, method=method):
                     if fault is not None and fault.action == "delay":
                         await asyncio.sleep(fault.delay)
                     if fault is not None and fault.action == "error":
@@ -294,8 +321,16 @@ class RPCServer:
                     else:
                         try:
                             # re-arm the caller's budget so handler-issued
-                            # downstream RPCs inherit the shrunken deadline
-                            with _policy.absolute_deadline(deadline):
+                            # downstream RPCs inherit the shrunken deadline,
+                            # and the caller's trace context so handler
+                            # spans join the distributed trace (activate
+                            # also CLEARS any context leaked from a prior
+                            # request on this connection task)
+                            with _policy.absolute_deadline(deadline), \
+                                    _trace.activate(tctx), \
+                                    _trace.span("rpc.server",
+                                                service=service,
+                                                method=method):
                                 out = await handler(payload, okey)
                             status = 0
                         except Exception as e:  # noqa: BLE001
@@ -425,7 +460,27 @@ class RPCClient:
         return min(timeout, rem), rem < timeout
 
     async def call(self, service: str, method: str, payload: bytes, *,
-                   order_key: str = "", timeout: float = 30.0) -> bytes:
+                   order_key: str = "", timeout: float = 30.0,
+                   trace_tags: Optional[dict] = None) -> bytes:
+        """Span-wrapped call (ISSUE 2): every attempt gets an "rpc.attempt"
+        span tagged with endpoint + breaker state (``trace_tags`` lets
+        ``call_resilient`` stamp attempt/failover counts), and feeds the
+        "rpc" stage histogram whether or not the trace is sampled."""
+        sp = _trace.span("rpc.attempt", service=service, method=method,
+                         endpoint=f"{self.host}:{self.port}",
+                         **(trace_tags or {}))
+        if self.breaker is not None:
+            sp.set_tag("breaker", self.breaker.state)
+        t0 = time.perf_counter()
+        try:
+            with sp:
+                return await self._call(service, method, payload,
+                                        order_key, timeout)
+        finally:
+            _STAGES.record("rpc", time.perf_counter() - t0)
+
+    async def _call(self, service: str, method: str, payload: bytes,
+                    order_key: str, timeout: float) -> bytes:
         timeout, budget_capped = self._effective_timeout(timeout)
         if self.local_bypass:
             local = _LOCAL_SERVERS.get(f"{self.host}:{self.port}")
@@ -522,18 +577,24 @@ class RPCClient:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         pending[rid] = fut
         rem = _policy.remaining_budget()
-        if rem is not None:
+        tblob = _trace.inject()
+        hdr = (struct.pack(">Q", rid) + _len16(service.encode())
+               + _len16(method.encode()) + _len16(order_key.encode()))
+        if tblob is not None:
+            # request3: deadline budget (0 = none) + trace context, so the
+            # server joins the distributed trace in causal HLC order
+            body = (bytes([_REQ3]) + hdr
+                    + struct.pack(">I", 0 if rem is None
+                                  else max(1, int(rem * 1000)))
+                    + bytes([len(tblob)]) + tblob + payload)
+        elif rem is not None:
             # request2: stamp the remaining budget so the server (and its
             # downstream calls) inherit the shrunken deadline
-            body = (bytes([_REQ2]) + struct.pack(">Q", rid)
-                    + _len16(service.encode()) + _len16(method.encode())
-                    + _len16(order_key.encode())
+            body = (bytes([_REQ2]) + hdr
                     + struct.pack(">I", max(1, int(rem * 1000)))
                     + payload)
         else:
-            body = (bytes([_REQ]) + struct.pack(">Q", rid)
-                    + _len16(service.encode()) + _len16(method.encode())
-                    + _len16(order_key.encode()) + payload)
+            body = bytes([_REQ]) + hdr + payload
         if fault is not None and fault.action == "drop":
             # the request frame vanishes on the wire: the reply future can
             # only time out (exactly what a blackholed network does)
@@ -589,6 +650,10 @@ class ServiceRegistry:
         # circuits; clients created here feed them with call outcomes
         self.breakers = (breakers if breakers is not None
                          else BreakerRegistry())
+        # live breaker state shows up in the /metrics "fabric" section
+        # (weakly held — a test-scoped registry dies with its owner)
+        from ..utils.metrics import FABRIC as _FABRIC
+        _FABRIC.register_breakers(self.breakers)
         self._static: Dict[str, List[str]] = {}
         self._clients: Dict[str, RPCClient] = {}
         # traffic governor state (≈ IRPCServiceTrafficGovernor.java:29):
@@ -809,12 +874,15 @@ class ServiceRegistry:
             if addr is None:
                 raise RPCTransportError(
                     f"no endpoints for service {service}")
-            if last_failed is not None and addr != last_failed:
+            failed_over = last_failed is not None and addr != last_failed
+            if failed_over:
                 FABRIC.inc(FabricMetric.RPC_FAILOVERS)
             try:
                 return await self.client_for(addr).call(
                     service, method, payload, order_key=order_key,
-                    timeout=timeout)
+                    timeout=timeout,
+                    trace_tags={"attempt": attempt,
+                                "failed_over": failed_over})
             except RPCTransportError as e:
                 tried_and_failed.add(addr)
                 last_failed = addr

@@ -17,7 +17,11 @@ from __future__ import annotations
 import asyncio
 import time
 from typing import (Awaitable, Callable, Dict, Generic, Hashable, List,
-                    Sequence, Tuple, TypeVar)
+                    Optional, Sequence, Tuple, TypeVar)
+
+from .. import trace
+from ..utils.hlc import HLC
+from ..utils.metrics import STAGES
 
 CallT = TypeVar("CallT")
 ResultT = TypeVar("ResultT")
@@ -48,14 +52,22 @@ class Batcher(Generic[CallT, ResultT]):
 
     def __init__(self, process_batch: BatchFn, *, pipeline_depth: int = 2,
                  max_burst_latency: float = 0.010, max_batch_size: int = 8192,
-                 min_batch_size: int = 1) -> None:
+                 min_batch_size: int = 1,
+                 stage: Optional[str] = None) -> None:
         self._process = process_batch
         self._depth = pipeline_depth
         self._budget = max_burst_latency
         self._max_cap = max_batch_size
         self._cap = max(min_batch_size, 64)
         self._min_cap = min_batch_size
-        self._queue: List[Tuple[CallT, asyncio.Future]] = []
+        # ISSUE 2: a named stage turns on enqueue→emit queue-wait
+        # attribution — per-call histogram records under ``stage`` and,
+        # for sampled calls, deferred "batch.queue_wait" spans stamped
+        # with batch size + the adaptive cap AT EMIT TIME
+        self._stage = stage
+        # queue entries: (call, fut, enqueue_perf, trace_ctx, start_hlc)
+        self._queue: List[Tuple[CallT, asyncio.Future, float,
+                                Optional[object], int]] = []
         self._inflight = 0
         self._latency = EMA(init=0.0)
         # strong refs: the loop only weakly references tasks, and a collected
@@ -67,7 +79,19 @@ class Batcher(Generic[CallT, ResultT]):
 
     def submit(self, call: CallT) -> "asyncio.Future[ResultT]":
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._queue.append((call, fut))
+        if self._stage is not None:
+            tctx = trace.current_ctx()
+            shlc = 0
+            if tctx is not None and tctx.sampled:
+                shlc = HLC.INST.get()
+            else:
+                tctx = None
+            self._queue.append((call, fut, time.perf_counter(), tctx,
+                                shlc))
+        else:
+            # un-staged batchers (e.g. the worker's mutation coalescer)
+            # skip the timing capture entirely — zero added hot-path cost
+            self._queue.append((call, fut, 0.0, None, 0))
         self.calls_submitted += 1
         self.last_activity = time.monotonic()
         self._trigger()
@@ -95,18 +119,43 @@ class Batcher(Generic[CallT, ResultT]):
             self._tasks.add(task)
             task.add_done_callback(self._tasks.discard)
 
-    async def _run(self, batch: List[Tuple[CallT, asyncio.Future]]) -> None:
-        calls = [c for c, _ in batch]
+    async def _run(self, batch: List[Tuple]) -> None:
+        calls = [b[0] for b in batch]
         start = time.perf_counter()
+        rep_ctx = None
+        if self._stage is not None:
+            # enqueue→emit queue-wait per call, stamped at EMIT time with
+            # the batch shape the adaptive cap produced
+            for _, _, enq, tctx, shlc in batch:
+                wait = start - enq
+                STAGES.record(self._stage, wait)
+                if tctx is not None:
+                    if rep_ctx is None:
+                        rep_ctx = tctx
+                    trace.record_finished(
+                        "batch.queue_wait", tctx, start_hlc=shlc,
+                        duration_s=wait,
+                        tags={"batch_size": len(batch), "cap": self._cap,
+                              "stage": self._stage})
         try:
-            results = await self._process(calls)
+            if self._stage is not None:
+                # a batch aggregates many callers' traces; run the
+                # processing under the FIRST sampled caller's context as
+                # the representative parent (and clear any stale context
+                # this task inherited from whichever submit() spawned it)
+                with trace.activate(rep_ctx):
+                    results = await self._process(calls)
+            else:
+                results = await self._process(calls)
             elapsed = time.perf_counter() - start
             self._adapt(len(calls), elapsed)
-            for (_, fut), res in zip(batch, results):
+            for b, res in zip(batch, results):
+                fut = b[1]
                 if not fut.done():
                     fut.set_result(res)
         except Exception as e:  # noqa: BLE001 — batch failure fails all calls
-            for _, fut in batch:
+            for b in batch:
+                fut = b[1]
                 if not fut.done():
                     fut.set_exception(e)
         finally:
@@ -131,11 +180,13 @@ class BatchCallScheduler(Generic[CallT, ResultT]):
     def __init__(self, process_batch_for_key: Callable[
             [Hashable], BatchFn], *, pipeline_depth: int = 2,
             max_burst_latency: float = 0.010,
-            max_batch_size: int = 8192) -> None:
+            max_batch_size: int = 8192,
+            stage: Optional[str] = None) -> None:
         self._factory = process_batch_for_key
         self._depth = pipeline_depth
         self._budget = max_burst_latency
         self._max_batch = max_batch_size
+        self._stage = stage
         self._batchers: Dict[Hashable, Batcher] = {}
         self.calls_seen = 0
 
@@ -144,7 +195,8 @@ class BatchCallScheduler(Generic[CallT, ResultT]):
         if b is None:
             b = Batcher(self._factory(key), pipeline_depth=self._depth,
                         max_burst_latency=self._budget,
-                        max_batch_size=self._max_batch)
+                        max_batch_size=self._max_batch,
+                        stage=self._stage)
             self._batchers[key] = b
         return b
 

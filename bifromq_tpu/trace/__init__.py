@@ -1,0 +1,27 @@
+"""Distributed tracing & hot-path profiling (ISSUE 2): an HLC-stamped
+flight recorder for publish→match→deliver.
+
+Usage at an instrumentation site::
+
+    from .. import trace
+    with trace.span("match.device", tenant=tenant_id, n=len(queries)):
+        ...
+
+Spans are no-ops unless sampling is configured (per-tenant probabilistic
+via ``TRACER.sampler``, always-on-slow via ``TRACER.slow_ms``, env knobs
+``BIFROMQ_TRACE_SAMPLE`` / ``BIFROMQ_TRACE_SLOW_MS``). The RPC fabric
+carries contexts across processes; the API server serves the rings at
+``/trace`` and ``/trace/slow``.
+"""
+
+from .recorder import SpanRing
+from .sampler import TenantSampler
+from .span import Span, SpanContext, decode_ctx, new_id
+from .tracer import (NOOP, TRACER, Tracer, activate, current_ctx, extract,
+                     inject, record_finished, span)
+
+__all__ = [
+    "NOOP", "TRACER", "Tracer", "Span", "SpanContext", "SpanRing",
+    "TenantSampler", "activate", "current_ctx", "decode_ctx", "extract",
+    "inject", "new_id", "record_finished", "span",
+]

@@ -1,0 +1,48 @@
+"""Lock-cheap in-process span ring buffer.
+
+A fixed slot array indexed by a monotonically growing write counter:
+``record`` is one store + one increment (GIL-atomic enough for telemetry —
+a racing writer can at worst clobber one slot, never corrupt the ring).
+No allocation on the steady-state path beyond the span itself; the oldest
+spans are overwritten once the ring wraps.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .span import Span
+
+
+class SpanRing:
+    def __init__(self, capacity: int = 4096) -> None:
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._slots: List[Optional[Span]] = [None] * capacity
+        self._written = 0       # total spans ever recorded
+
+    def record(self, span: Span) -> None:
+        self._slots[self._written % self.capacity] = span
+        self._written += 1
+
+    def __len__(self) -> int:
+        return min(self._written, self.capacity)
+
+    @property
+    def dropped(self) -> int:
+        """Spans overwritten by wraparound."""
+        return max(0, self._written - self.capacity)
+
+    def spans(self) -> List[Span]:
+        """Retained spans, oldest first."""
+        n = self._written
+        if n <= self.capacity:
+            return [s for s in self._slots[:n] if s is not None]
+        head = n % self.capacity
+        out = self._slots[head:] + self._slots[:head]
+        return [s for s in out if s is not None]
+
+    def clear(self) -> None:
+        self._slots = [None] * self.capacity
+        self._written = 0

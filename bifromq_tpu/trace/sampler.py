@@ -1,0 +1,71 @@
+"""Per-tenant probabilistic sampling for the flight recorder.
+
+The decision is DETERMINISTIC in the trace id: ``sample(tenant, trace_id)``
+hashes the id against the tenant's rate, so the same id always gets the
+same verdict (re-sampling a propagated context can never flip mid-trace)
+and tests can pin outcomes. Rates are per-tenant with a process default;
+``active`` is maintained eagerly so the hot path's enabled-check is one
+attribute read, not a dict scan.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+_MASK = (1 << 64) - 1
+# Fibonacci multiplier: spreads sequential/biased ids uniformly over 2^64
+_MIX = 0x9E3779B97F4A7C15
+
+
+class TenantSampler:
+    def __init__(self, default_rate: float = 0.0) -> None:
+        self._default = 0.0
+        self._default_cut = 0
+        self._rates: Dict[str, float] = {}
+        self._cuts: Dict[str, int] = {}
+        self.active = False
+        self.default_rate = default_rate    # through the setter
+
+    @staticmethod
+    def _cut_of(rate: float) -> int:
+        rate = min(1.0, max(0.0, float(rate)))
+        return int(rate * (_MASK + 1))
+
+    @property
+    def default_rate(self) -> float:
+        return self._default
+
+    @default_rate.setter
+    def default_rate(self, rate: float) -> None:
+        self._default = min(1.0, max(0.0, float(rate)))
+        self._default_cut = self._cut_of(rate)
+        self._recompute()
+
+    def set_rate(self, tenant: str, rate: float) -> None:
+        self._rates[tenant] = min(1.0, max(0.0, float(rate)))
+        self._cuts[tenant] = self._cut_of(rate)
+        self._recompute()
+
+    def clear_rate(self, tenant: str) -> None:
+        self._rates.pop(tenant, None)
+        self._cuts.pop(tenant, None)
+        self._recompute()
+
+    def rate_for(self, tenant: str) -> float:
+        return self._rates.get(tenant, self._default)
+
+    def _recompute(self) -> None:
+        self.active = (self._default > 0.0
+                       or any(r > 0.0 for r in self._rates.values()))
+
+    def sample(self, tenant: str, trace_id: int) -> bool:
+        cut = self._cuts.get(tenant, self._default_cut)
+        if cut <= 0:
+            return False
+        if cut > _MASK:
+            return True
+        return ((trace_id * _MIX) & _MASK) < cut
+
+    def snapshot(self) -> dict:
+        return {"default_rate": self._default,
+                "tenant_rates": dict(self._rates)}

@@ -1,0 +1,112 @@
+"""Span + trace-context primitives for the flight recorder.
+
+A trace is a tree of spans sharing one 64-bit ``trace_id``; every span is
+stamped with the process HLC (``utils/hlc.py``) at start and end, so spans
+from DIFFERENT processes order causally as long as the trace context (which
+carries the sender's HLC stamp) rode the wire: the receiver merges the
+stamp via ``HLC.update`` before opening its own spans, making every remote
+child's ``start_hlc`` strictly greater than its parent's.
+
+``SpanContext`` is the tiny propagation unit held in a contextvar and
+serialized into the RPC fabric's request header (25 bytes: trace id, span
+id, flags, HLC stamp — see ``codec``/``decode`` below).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..utils.hlc import HLC
+
+_PID = os.getpid()
+
+# 25-byte wire form: u64 trace_id ‖ u64 span_id ‖ u8 flags ‖ u64 hlc
+CTX_WIRE = struct.Struct(">QQBQ")
+FLAG_SAMPLED = 0x01
+
+
+def new_id() -> int:
+    """Non-zero random 64-bit id (0 is the 'absent' sentinel)."""
+    return random.getrandbits(64) | 1
+
+
+@dataclass
+class SpanContext:
+    """What propagates: identity + the sampling decision. ``tenant`` rides
+    along in-process so child spans inherit attribution; it is NOT sent on
+    the wire (the remote side re-derives it from its own payloads)."""
+
+    __slots__ = ("trace_id", "span_id", "sampled", "tenant")
+
+    trace_id: int
+    span_id: int
+    sampled: bool
+    tenant: str
+
+    def encode(self) -> bytes:
+        return CTX_WIRE.pack(self.trace_id, self.span_id,
+                             FLAG_SAMPLED if self.sampled else 0,
+                             HLC.INST.get())
+
+
+# a remote stamp may only pull the local clock forward by this much: an
+# unbounded merge would let ONE hostile/corrupted frame poison the clock
+# (and, via re-stamped outgoing contexts, the whole cluster) forever
+MAX_CLOCK_DRIFT_MS = 60_000
+
+
+def decode_ctx(blob: bytes) -> Optional["SpanContext"]:
+    """Decode a wire context and MERGE its HLC stamp into the local clock
+    (the causal-ordering handshake). Returns None on a short/garbled blob
+    — tracing must never fail a request. Stamps further than
+    ``MAX_CLOCK_DRIFT_MS`` ahead of local wall time are NOT merged (the
+    context still extracts; only causal ordering for that trace degrades)."""
+    if len(blob) < CTX_WIRE.size:
+        return None
+    trace_id, span_id, flags, stamp = CTX_WIRE.unpack_from(blob)
+    if trace_id == 0:
+        return None
+    import time as _time
+    if HLC.physical(stamp) <= int(_time.time() * 1000) + MAX_CLOCK_DRIFT_MS:
+        HLC.INST.update(stamp)
+    return SpanContext(trace_id, span_id, bool(flags & FLAG_SAMPLED), "-")
+
+
+@dataclass
+class Span:
+    """One finished timing record (spans are materialized at CLOSE time;
+    open spans live only as context managers)."""
+
+    name: str
+    trace_id: int
+    span_id: int
+    parent_id: int
+    tenant: str
+    service: str
+    start_hlc: int
+    end_hlc: int
+    duration_ms: float
+    status: str = "ok"           # ok | error
+    tags: Dict[str, object] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "trace_id": f"{self.trace_id:016x}",
+            "span_id": f"{self.span_id:016x}",
+            "parent_id": (f"{self.parent_id:016x}"
+                          if self.parent_id else ""),
+            "tenant": self.tenant,
+            "service": self.service,
+            "pid": _PID,
+            "start_hlc": self.start_hlc,
+            "end_hlc": self.end_hlc,
+            "start_ms": HLC.physical(self.start_hlc),
+            "duration_ms": round(self.duration_ms, 4),
+            "status": self.status,
+            "tags": self.tags,
+        }

@@ -1,0 +1,292 @@
+"""The flight recorder: HLC-stamped spans, contextvar propagation, per-tenant
+sampling, always-on-slow capture, and a ring-buffer sink.
+
+Design constraints (ISSUE 2 acceptance):
+
+- **No-op when off.** With no sampling configured and no slow threshold,
+  ``span()`` returns a shared singleton whose enter/exit do nothing — the
+  instrumented hot path costs one contextvar read + one attribute check.
+- **Sampling decides at the ROOT.** A root span (no active context) draws a
+  trace id and asks the per-tenant sampler once; the verdict propagates to
+  every child (in-process via the contextvar, cross-process via the wire
+  context), so traces are never fragmented by independent re-sampling.
+  Unsampled roots still install a not-sampled context so descendants don't
+  try to become roots themselves.
+- **Slow outliers are always captured** (when ``slow_ms`` is set): an
+  unsampled root still measures its wall time — two perf_counter calls —
+  and materializes into the slow ring if it crosses the threshold. Child
+  detail is absent for such traces (the decision is only knowable at the
+  end); probabilistically sampled traces that turn out slow land in BOTH
+  rings.
+- **Causal order across processes** comes from the HLC handshake: contexts
+  carry the sender's stamp, ``decode_ctx`` merges it, so remote child spans
+  start at a strictly larger HLC than their parent's start.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import time
+from typing import Dict, Iterator, List, Optional
+
+from ..utils.hlc import HLC
+from .recorder import SpanRing
+from .sampler import TenantSampler
+from .span import Span, SpanContext, decode_ctx, new_id
+
+_CTX: contextvars.ContextVar[Optional[SpanContext]] = contextvars.ContextVar(
+    "bifromq_trace_ctx", default=None)
+
+
+def current_ctx() -> Optional[SpanContext]:
+    return _CTX.get()
+
+
+@contextlib.contextmanager
+def activate(ctx: Optional[SpanContext]) -> Iterator[None]:
+    """Install ``ctx`` as the active trace context for the block. Always
+    sets (a None CLEARS a stale inherited context — batch-emit tasks and
+    server connection loops must not leak a previous request's trace)."""
+    token = _CTX.set(ctx)
+    try:
+        yield
+    finally:
+        _CTX.reset(token)
+
+
+class _NoopSpan:
+    """Shared do-nothing span (tracing disabled / unsampled subtree)."""
+
+    __slots__ = ()
+    sampled = False
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set_tag(self, key: str, value) -> None:
+        pass
+
+
+NOOP = _NoopSpan()
+
+
+class _LiveSpan:
+    """A recording span: installs its context on enter, materializes a
+    ``Span`` into the tracer's ring on exit."""
+
+    __slots__ = ("_tracer", "name", "ctx", "parent_id", "tags",
+                 "start_hlc", "_t0", "_token")
+    sampled = True
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: int,
+                 parent_id: int, tenant: str, tags: Dict) -> None:
+        self._tracer = tracer
+        self.name = name
+        self.ctx = SpanContext(trace_id, new_id(), True, tenant)
+        self.parent_id = parent_id
+        self.tags = tags
+
+    def __enter__(self) -> "_LiveSpan":
+        self._token = _CTX.set(self.ctx)
+        self.start_hlc = HLC.INST.get()
+        self._t0 = time.perf_counter()
+        return self
+
+    def set_tag(self, key: str, value) -> None:
+        self.tags[key] = value
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        duration = time.perf_counter() - self._t0
+        _CTX.reset(self._token)
+        if exc_type is not None:
+            self.tags.setdefault("error", exc_type.__name__)
+        self._tracer._finish(Span(
+            name=self.name, trace_id=self.ctx.trace_id,
+            span_id=self.ctx.span_id, parent_id=self.parent_id,
+            tenant=self.ctx.tenant, service=self._tracer.service,
+            start_hlc=self.start_hlc, end_hlc=HLC.INST.get(),
+            duration_ms=duration * 1e3,
+            status="error" if exc_type is not None else "ok",
+            tags=self.tags))
+        return False
+
+
+class _UnsampledRoot:
+    """Root that lost the sampling draw: blocks descendants (installs a
+    not-sampled context) and, when a slow threshold is armed, measures
+    itself so slow outliers are captured even off-sample."""
+
+    __slots__ = ("_tracer", "name", "tenant", "trace_id", "tags",
+                 "start_hlc", "_t0", "_token")
+    sampled = False
+
+    def __init__(self, tracer: "Tracer", name: str, tenant: str,
+                 trace_id: int, tags: Dict) -> None:
+        self._tracer = tracer
+        self.name = name
+        self.tenant = tenant
+        self.trace_id = trace_id
+        self.tags = tags
+
+    def __enter__(self) -> "_UnsampledRoot":
+        self._token = _CTX.set(SpanContext(self.trace_id, 0, False,
+                                           self.tenant))
+        self.start_hlc = HLC.INST.get()
+        self._t0 = time.perf_counter()
+        return self
+
+    def set_tag(self, key: str, value) -> None:
+        self.tags[key] = value
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        duration_ms = (time.perf_counter() - self._t0) * 1e3
+        _CTX.reset(self._token)
+        slow = self._tracer.slow_ms
+        if slow is not None and duration_ms >= slow:
+            self.tags["slow_only"] = True
+            self._tracer.slow_ring.record(Span(
+                name=self.name, trace_id=self.trace_id, span_id=new_id(),
+                parent_id=0, tenant=self.tenant,
+                service=self._tracer.service, start_hlc=self.start_hlc,
+                end_hlc=HLC.INST.get(), duration_ms=duration_ms,
+                status="error" if exc_type is not None else "ok",
+                tags=self.tags))
+        return False
+
+
+class Tracer:
+    def __init__(self, *, service: str = "bifromq",
+                 sampler: Optional[TenantSampler] = None,
+                 capacity: int = 4096, slow_capacity: int = 512,
+                 slow_ms: Optional[float] = None) -> None:
+        self.service = service
+        self.sampler = sampler or TenantSampler()
+        self.ring = SpanRing(capacity)
+        self.slow_ring = SpanRing(slow_capacity)
+        self.slow_ms = slow_ms
+
+    # ---------------- hot path ---------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self.sampler.active or self.slow_ms is not None
+
+    def span(self, name: str, *, tenant: Optional[str] = None, **tags):
+        """Open a span as a context manager. Child of the active context
+        when one exists; otherwise a root that runs the sampling draw."""
+        parent = _CTX.get()
+        if parent is not None:
+            if not parent.sampled:
+                return NOOP
+            return _LiveSpan(self, name, parent.trace_id, parent.span_id,
+                             tenant or parent.tenant, tags)
+        if not self.enabled:
+            return NOOP
+        tenant = tenant or "-"
+        trace_id = new_id()
+        if self.sampler.sample(tenant, trace_id):
+            return _LiveSpan(self, name, trace_id, 0, tenant, tags)
+        return _UnsampledRoot(self, name, tenant, trace_id, tags)
+
+    def record_finished(self, name: str, ctx: Optional[SpanContext], *,
+                        start_hlc: int, duration_s: float,
+                        tenant: Optional[str] = None,
+                        tags: Optional[Dict] = None) -> None:
+        """Record an already-timed span under ``ctx`` (deferred spans: the
+        batcher measures queue-wait per call but only learns the batch
+        shape at emit time). No-op for absent/unsampled contexts."""
+        if ctx is None or not ctx.sampled:
+            return
+        self._finish(Span(
+            name=name, trace_id=ctx.trace_id, span_id=new_id(),
+            parent_id=ctx.span_id, tenant=tenant or ctx.tenant,
+            service=self.service, start_hlc=start_hlc,
+            end_hlc=HLC.INST.get(), duration_ms=duration_s * 1e3,
+            status="ok", tags=tags or {}))
+
+    def _finish(self, span: Span) -> None:
+        self.ring.record(span)
+        if self.slow_ms is not None and span.duration_ms >= self.slow_ms:
+            self.slow_ring.record(span)
+
+    # ---------------- wire propagation -------------------------------------
+
+    def inject(self) -> Optional[bytes]:
+        """Serialize the active context (with a fresh HLC stamp) for the
+        RPC request header; None when there is nothing to propagate."""
+        ctx = _CTX.get()
+        if ctx is None or ctx.trace_id == 0:
+            return None
+        return ctx.encode()
+
+    @staticmethod
+    def extract(blob: bytes) -> Optional[SpanContext]:
+        return decode_ctx(blob)
+
+    # ---------------- export / admin ---------------------------------------
+
+    def export(self, *, trace_id: Optional[str] = None,
+               tenant: Optional[str] = None, limit: int = 1000,
+               slow: bool = False) -> List[dict]:
+        """JSON-able spans, causally ordered by start HLC. ``trace_id`` is
+        the 16-hex-char export form."""
+        if limit <= 0:
+            return []
+        ring = self.slow_ring if slow else self.ring
+        want_tid = int(trace_id, 16) if trace_id else None
+        out = []
+        for s in ring.spans():
+            if want_tid is not None and s.trace_id != want_tid:
+                continue
+            if tenant is not None and s.tenant != tenant:
+                continue
+            out.append(s)
+        out.sort(key=lambda s: s.start_hlc)
+        return [s.to_dict() for s in out[-limit:]]
+
+    def reset(self) -> None:
+        self.ring.clear()
+        self.slow_ring.clear()
+
+
+def _env_float(name: str) -> Optional[float]:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+# process-global tracer: sampling defaults off (spans are no-ops) unless
+# configured by env, the /trace admin API, or code.
+TRACER = Tracer(
+    service=os.environ.get("BIFROMQ_TRACE_SERVICE", "bifromq"),
+    sampler=TenantSampler(_env_float("BIFROMQ_TRACE_SAMPLE") or 0.0),
+    slow_ms=_env_float("BIFROMQ_TRACE_SLOW_MS"))
+
+
+def span(name: str, *, tenant: Optional[str] = None, **tags):
+    return TRACER.span(name, tenant=tenant, **tags)
+
+
+def inject() -> Optional[bytes]:
+    return TRACER.inject()
+
+
+def extract(blob: bytes) -> Optional[SpanContext]:
+    return decode_ctx(blob)
+
+
+def record_finished(name: str, ctx: Optional[SpanContext], *,
+                    start_hlc: int, duration_s: float,
+                    tenant: Optional[str] = None,
+                    tags: Optional[Dict] = None) -> None:
+    TRACER.record_finished(name, ctx, start_hlc=start_hlc,
+                           duration_s=duration_s, tenant=tenant, tags=tags)

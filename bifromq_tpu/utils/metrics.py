@@ -13,10 +13,87 @@ from __future__ import annotations
 import enum
 import threading
 import time
+import weakref
 from collections import defaultdict
-from typing import Callable, Dict, Tuple
+from typing import Callable, Dict, List, Tuple
 
 from ..plugin.events import Event, EventType, IEventCollector
+
+
+class LatencyHistogram:
+    """Fixed log2-bucketed latency histogram (ISSUE 2): bucket *i* counts
+    samples whose microsecond value has bit_length ``i`` (i.e. the
+    [2^(i-1), 2^i) range), topping out around 2 minutes. Recording is one
+    list-index increment — GIL-atomic, no lock on the hot path; percentile
+    extraction returns the bucket's upper edge (conservative)."""
+
+    N_BUCKETS = 28      # 2^27 µs ≈ 134 s
+
+    def __init__(self) -> None:
+        self._buckets: List[int] = [0] * self.N_BUCKETS
+
+    def record(self, seconds: float) -> None:
+        us = int(seconds * 1e6)
+        i = us.bit_length() if us > 0 else 0
+        if i >= self.N_BUCKETS:
+            i = self.N_BUCKETS - 1
+        self._buckets[i] += 1
+
+    @property
+    def count(self) -> int:
+        return sum(self._buckets)
+
+    def percentile_ms(self, p: float) -> float:
+        """Upper edge (ms) of the bucket containing the p-th percentile."""
+        total = sum(self._buckets)
+        if total == 0:
+            return 0.0
+        target = max(1, int(total * p / 100.0 + 0.5))
+        acc = 0
+        for i, c in enumerate(self._buckets):
+            acc += c
+            if acc >= target:
+                return (1 << i) / 1000.0
+        return (1 << (self.N_BUCKETS - 1)) / 1000.0
+
+    def snapshot(self) -> Dict[str, float]:
+        return {"count": self.count,
+                "p50_ms": self.percentile_ms(50),
+                "p99_ms": self.percentile_ms(99)}
+
+    def reset(self) -> None:
+        self._buckets = [0] * self.N_BUCKETS
+
+
+class StageLatencies:
+    """Named per-stage histograms for the publish→match→deliver hot path
+    (queue_wait / device / rpc / deliver / ingest + ad-hoc stages). Always
+    on — recording is cheap enough to run untraced — so ``/metrics`` and
+    ``bench.py`` get stage breakdowns without sampling."""
+
+    def __init__(self) -> None:
+        self._hists: Dict[str, LatencyHistogram] = {}
+
+    def hist(self, stage: str) -> LatencyHistogram:
+        h = self._hists.get(stage)
+        if h is None:
+            h = self._hists.setdefault(stage, LatencyHistogram())
+        return h
+
+    def record(self, stage: str, seconds: float) -> None:
+        self.hist(stage).record(seconds)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        return {name: h.snapshot() for name, h in self._hists.items()
+                if h.count}
+
+    def reset(self) -> None:
+        for h in self._hists.values():
+            h.reset()
+
+
+# the process-global stage-latency registry the hot path reports into
+STAGES = StageLatencies()
 
 
 class TenantMetric(enum.Enum):
@@ -49,6 +126,7 @@ class FabricMetric(enum.Enum):
     BREAKER_CLOSED = "breaker_closed_total"
     FAULTS_INJECTED = "faults_injected_total"
     MATCH_DEGRADED = "match_degraded_total"
+    LEADER_REDIRECTS = "leader_redirects_total"
 
 
 class FabricMetrics:
@@ -59,6 +137,36 @@ class FabricMetrics:
     def __init__(self) -> None:
         self._counters: Dict[str, int] = defaultdict(int)
         self._lock = threading.Lock()
+        # live breaker registries (weakly held: test-scoped ServiceRegistry
+        # instances must not pin their breakers forever) — feeds the
+        # per-endpoint state gauges in the /metrics "fabric" section
+        self._breaker_sets: "weakref.WeakSet" = weakref.WeakSet()
+
+    def register_breakers(self, breaker_registry) -> None:
+        """Expose a BreakerRegistry's live per-endpoint state through
+        ``breaker_snapshot`` (ISSUE 2 satellite: breaker state next to the
+        monotonic retry/failover totals so traces correlate)."""
+        self._breaker_sets.add(breaker_registry)
+
+    # WeakSet iteration order is arbitrary: when two registries track the
+    # SAME endpoint, keep the operator-conservative (worst) state rather
+    # than whichever registry happened to iterate last
+    _BREAKER_SEVERITY = {"closed": 0, "half_open": 1, "open": 2}
+
+    def breaker_snapshot(self) -> Dict[str, dict]:
+        merged: Dict[str, dict] = {}
+        for reg in list(self._breaker_sets):
+            try:
+                snap = reg.snapshot()
+            except Exception:  # noqa: BLE001 — telemetry must not raise
+                continue
+            for ep, state in snap.items():
+                prev = merged.get(ep)
+                if prev is None or (
+                        self._BREAKER_SEVERITY.get(state.get("state"), 0)
+                        > self._BREAKER_SEVERITY.get(prev.get("state"), 0)):
+                    merged[ep] = state
+        return merged
 
     def inc(self, metric: FabricMetric, n: int = 1) -> None:
         with self._lock:
@@ -109,9 +217,14 @@ class MetricsRegistry:
                     per_tenant[tenant][name] = fn()
                 except Exception:  # noqa: BLE001
                     pass
+            fabric = FABRIC.snapshot()
+            breakers = FABRIC.breaker_snapshot()
+            if breakers:
+                fabric["breakers"] = breakers
             return {"uptime_s": round(time.time() - self.started_at, 1),
                     "tenants": dict(per_tenant),
-                    "fabric": FABRIC.snapshot()}
+                    "fabric": fabric,
+                    "stages": STAGES.snapshot()}
 
 
 _EVENT_TO_METRIC = {

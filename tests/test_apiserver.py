@@ -285,3 +285,79 @@ class TestAdminEndpoints:
             await api.stop()
             broker.inbox.close()
             await broker.stop()
+
+
+class TestTraceEndpoints:
+    """Flight-recorder surface (ISSUE 2): /trace, /trace/slow, and the
+    runtime sampling knobs, plus stage histograms in /metrics."""
+
+    async def test_trace_knobs_and_span_export(self, stack):
+        from bifromq_tpu import trace
+
+        broker, api, _ = stack
+        trace.TRACER.reset()
+        try:
+            # arm sampling at runtime through the API
+            status, out = await http(api.port, "PUT", "/trace?rate=1.0")
+            assert status == 200
+            assert out["sampling"]["default_rate"] == 1.0
+
+            sub = MQTTClient(port=broker.port, client_id="tr1")
+            await sub.connect()
+            await sub.subscribe("trc/t")
+            status, out = await http(
+                api.port, "PUT", "/pub?tenant_id=DevOnly&topic=trc/t&qos=1",
+                b"x")
+            assert status == 200 and out["fanout"] == 1
+            await sub.recv()
+            await sub.disconnect()
+
+            status, out = await http(api.port, "GET",
+                                     "/trace?tenant_id=DevOnly&limit=100")
+            assert status == 200
+            names = {s["name"] for s in out["spans"]}
+            assert {"match.device", "deliver.fanout"} <= names, names
+            # filter by trace id round-trips
+            tid = out["spans"][0]["trace_id"]
+            status, one = await http(api.port, "GET",
+                                     f"/trace?trace_id={tid}")
+            assert status == 200
+            assert all(s["trace_id"] == tid for s in one["spans"])
+
+            # slow ring via knob: everything beyond 0.0001ms is "slow"
+            status, _ = await http(api.port, "PUT", "/trace?slow_ms=0.0001")
+            assert status == 200
+            status, out = await http(
+                api.port, "PUT", "/pub?tenant_id=DevOnly&topic=trc/t&qos=0",
+                b"y")
+            assert status == 200
+            status, slow = await http(api.port, "GET", "/trace/slow")
+            assert status == 200 and slow["count"] >= 1
+            # disarm
+            status, out = await http(api.port, "PUT",
+                                     "/trace?rate=0&slow_ms=0")
+            assert status == 200
+            assert out["sampling"]["default_rate"] == 0.0
+            assert out["slow_ms"] is None
+        finally:
+            trace.TRACER.sampler.default_rate = 0.0
+            trace.TRACER.slow_ms = None
+            trace.TRACER.reset()
+
+    async def test_metrics_stage_breakdown(self, stack):
+        broker, api, _ = stack
+        sub = MQTTClient(port=broker.port, client_id="st1")
+        await sub.connect()
+        await sub.subscribe("stg/t")
+        status, _ = await http(api.port, "PUT",
+                               "/pub?tenant_id=DevOnly&topic=stg/t&qos=1",
+                               b"z")
+        assert status == 200
+        await sub.recv()
+        await sub.disconnect()
+        status, snap = await http(api.port, "GET", "/metrics")
+        assert status == 200
+        stages = snap["stages"]
+        for stage in ("queue_wait", "device", "deliver"):
+            assert stages.get(stage, {}).get("count", 0) >= 1, stages
+            assert "p50_ms" in stages[stage] and "p99_ms" in stages[stage]

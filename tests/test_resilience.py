@@ -779,3 +779,88 @@ class TestMatchDegradation:
                     == sorted(r.receiver_id for r in b.normal)
         finally:
             await w.stop()
+
+
+# ---------------------------------------------------------------------------
+# leader-hint forwarding (ISSUE 2 satellite: route mutations follow the
+# NotLeaderError hint over the fabric instead of surfacing it)
+# ---------------------------------------------------------------------------
+
+class TestLeaderRedirect:
+    async def test_follower_mutation_redirects_to_leader(self):
+        from bifromq_tpu.dist.remote import (SERVICE, DistWorkerRPCService,
+                                             RemoteDistWorker)
+        from bifromq_tpu.raft.transport import InMemTransport
+
+        transport = InMemTransport()
+        w1 = DistWorker(node_id="w1", voters=["w1", "w2"],
+                        transport=transport)
+        w2 = DistWorker(node_id="w2", voters=["w1", "w2"],
+                        transport=transport)
+        await w1.start()
+        await w2.start()
+        servers = []
+        try:
+            def leader_of():
+                for w in (w1, w2):
+                    for r in w.store.ranges.values():
+                        if r.is_leader:
+                            return w
+                return None
+
+            deadline = time.monotonic() + 30
+            while leader_of() is None:
+                assert time.monotonic() < deadline, "no leader elected"
+                await asyncio.sleep(0.02)
+            leader = leader_of()
+            follower = w2 if leader is w1 else w1
+
+            by_worker = {}
+            for w in (w1, w2):
+                s = RPCServer()
+                DistWorkerRPCService(w).register(s)
+                await s.start()
+                servers.append(s)
+                by_worker[w.store.node_id] = s.address
+
+            reg = ServiceRegistry()
+            reg.announce(SERVICE, by_worker["w1"])
+            reg.announce(SERVICE, by_worker["w2"])
+            # pin the rendezvous pick to the FOLLOWER so the mutation
+            # deterministically bounces with a leader hint
+            follower_addr = by_worker[follower.store.node_id]
+            orig_pick = reg.pick
+            reg.pick = lambda svc, key, exclude=None: follower_addr
+
+            base = FABRIC.get(FabricMetric.LEADER_REDIRECTS)
+            remote = RemoteDistWorker(reg)
+            out = await remote.add_route("T", _mk_route("lr/+", "rx"))
+            assert out == "ok"
+            assert FABRIC.get(FabricMetric.LEADER_REDIRECTS) == base + 1
+
+            # the mutation really landed: BOTH replicas serve it
+            for w in (w1, w2):
+                deadline = time.monotonic() + 20
+                while True:
+                    res = await w.match_batch([("T", ["lr", "z"])],
+                                              max_persistent_fanout=10,
+                                              max_group_fanout=10)
+                    if [r.receiver_id for r in res[0].normal] == ["rx"]:
+                        break
+                    assert time.monotonic() < deadline, "not replicated"
+                    await asyncio.sleep(0.02)
+
+            # removal follows the hint the same way
+            base = FABRIC.get(FabricMetric.LEADER_REDIRECTS)
+            out = await remote.remove_route(
+                "T", RouteMatcher.from_topic_filter("lr/+"),
+                (0, "rx", "d0"))
+            assert out == "ok"
+            assert FABRIC.get(FabricMetric.LEADER_REDIRECTS) == base + 1
+            reg.pick = orig_pick
+            await reg.close()
+        finally:
+            for s in servers:
+                await s.stop()
+            await w1.stop()
+            await w2.stop()

@@ -4,12 +4,14 @@ delivers via routes held by process B (the reference's dist-server →
 dist-worker gRPC hop, SURVEY.md §3.3)."""
 
 import asyncio
+import json
 import os
 import subprocess
 import sys
 
 import pytest
 
+from bifromq_tpu import trace
 from bifromq_tpu.dist.remote import SERVICE, RemoteDistWorker
 from bifromq_tpu.dist.service import DistService
 from bifromq_tpu.mqtt.broker import MQTTBroker
@@ -104,6 +106,70 @@ class TestTwoProcess:
             await s2.disconnect()
             await p.disconnect()
         finally:
+            await broker.stop()
+
+    async def test_trace_propagates_across_processes(self, worker_proc):
+        """ISSUE 2 acceptance: a sampled PUBLISH on the frontend process
+        yields ONE trace whose spans come from BOTH processes (frontend
+        ingest/queue/rpc/deliver + worker device match), in causal HLC
+        order, with queue-wait and device time as separate durations."""
+        trace.TRACER.reset()
+        trace.TRACER.sampler.default_rate = 1.0
+        reg = ServiceRegistry()
+        reg.announce(SERVICE, f"127.0.0.1:{worker_proc}")
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        broker.dist = DistService(broker.sub_brokers, broker.events,
+                                  broker.settings,
+                                  worker=RemoteDistWorker(reg))
+        broker.inbox.dist = broker.dist
+        await broker.start()
+        try:
+            sub = MQTTClient("127.0.0.1", broker.port, client_id="tr-s")
+            await sub.connect()
+            await sub.subscribe("trace/+/hop", qos=1)
+            p = MQTTClient("127.0.0.1", broker.port, client_id="tr-p")
+            await p.connect()
+            await p.publish("trace/x/hop", b"spanned", qos=1)
+            msg = await asyncio.wait_for(sub.messages.get(), 15)
+            assert msg.payload == b"spanned"
+
+            local = trace.TRACER.export(limit=1000)
+            ingest = [s for s in local if s["name"] == "pub.ingest"
+                      and s["tags"].get("topic") == "trace/x/hop"]
+            assert ingest, [s["name"] for s in local]
+            tid = ingest[0]["trace_id"]
+            mine = [s for s in local if s["trace_id"] == tid]
+
+            # the worker process recorded spans for the SAME trace id,
+            # exported over the fabric
+            out = await reg.client_for(f"127.0.0.1:{worker_proc}").call(
+                SERVICE, "trace_spans",
+                json.dumps({"trace_id": tid}).encode(), timeout=10.0)
+            remote = json.loads(out)
+            assert remote, "worker process recorded no spans for the trace"
+            assert all(s["trace_id"] == tid for s in remote)
+            assert all(s["pid"] != os.getpid() for s in remote)
+
+            names = ({s["name"] for s in mine}
+                     | {s["name"] for s in remote})
+            assert {"pub.ingest", "batch.queue_wait", "rpc.attempt",
+                    "match.device", "deliver.fanout"} <= names, names
+            assert len(mine) + len(remote) >= 5
+            # causal HLC order across the process boundary: every worker
+            # span starts after the frontend root's start stamp
+            root_hlc = ingest[0]["start_hlc"]
+            for s in remote:
+                assert s["start_hlc"] > root_hlc, s
+            # queue-wait and device time are separate measured durations
+            qw = next(s for s in mine if s["name"] == "batch.queue_wait")
+            dev = next(s for s in remote if s["name"] == "match.device")
+            assert qw["duration_ms"] >= 0.0
+            assert dev["duration_ms"] > 0.0
+            await sub.disconnect()
+            await p.disconnect()
+        finally:
+            trace.TRACER.sampler.default_rate = 0.0
+            trace.TRACER.reset()
             await broker.stop()
 
     async def test_purge_scoped_to_one_frontend(self, worker_proc):
