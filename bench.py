@@ -816,6 +816,29 @@ def main():
                         lg = json.load(f)
                     lg["stale"] = True
                     lg["stale_reason"] = msg[:300]
+                    # ISSUE 3 satellite: a degraded run still localizes
+                    # regressions — surface the last-known per-stage
+                    # breakdown and the live device gauges (which show
+                    # exactly why the device path is down), tagged stale,
+                    # instead of only the headline number
+                    stage = lg.get("stage_latency_ms")
+                    if stage:
+                        log("stale stage breakdown: "
+                            f"{json.dumps(stage)}")
+                    # keep the last-good record's REAL device gauges
+                    # (tagged stale); the current process never ran the
+                    # broker, so its own probe only documents why the
+                    # device is unreachable — stderr, not the record
+                    if isinstance(lg.get("device"), dict):
+                        lg["device"]["stale"] = True
+                        log("stale device gauges (last good): "
+                            f"{json.dumps(lg['device'])}")
+                    try:
+                        from bifromq_tpu.obs import OBS
+                        log("device probe now: "
+                            f"{json.dumps(OBS.device_snapshot())}")
+                    except Exception as dev_e:  # noqa: BLE001
+                        log(f"device gauges unavailable: {dev_e!r}")
                     print(json.dumps(lg), flush=True)
                     sys.exit(0)
                 except (OSError, ValueError):
@@ -916,6 +939,15 @@ def main():
     stage = results.get("broker", {}).get("stage_latency_ms")
     if stage:
         record["stage_latency_ms"] = stage
+    # device-pipeline gauges next to the headline (ISSUE 3): XLA compile
+    # count/time, dispatch queue depth, device memory watermarks — the
+    # same "device" section /metrics serves
+    try:
+        from bifromq_tpu.obs import OBS
+        record["device"] = OBS.device_snapshot()
+        log(f"device gauges: {json.dumps(record['device'])}")
+    except Exception as e:  # noqa: BLE001 — gauges must not fail the bench
+        log(f"device gauges unavailable: {e!r}")
     # persist last-known-good for a real headline only (a partial
     # broker-only or error-path run must never clobber it). A CPU-platform
     # headline IS a valid record — the stock baseline ran on the same

@@ -146,8 +146,17 @@ class APIServer:
             if route == ("GET", "/retained"):
                 return self._retained(arg)
             if route == ("GET", "/metrics"):
-                return 200, (self.metrics.snapshot()
-                             if self.metrics is not None else {})
+                return self._metrics_get(arg)
+            if route == ("GET", "/tenants"):
+                return self._tenants_ranked(arg)
+            if method == "GET" and url.path.startswith("/tenants/"):
+                from urllib.parse import unquote
+                return self._tenant_detail(
+                    unquote(url.path[len("/tenants/"):]))
+            if route == ("GET", "/obs"):
+                return self._obs_state()
+            if route == ("PUT", "/obs"):
+                return self._obs_config(arg)
             if route == ("GET", "/trace"):
                 return self._trace_get(arg, slow=False)
             if route == ("GET", "/trace/slow"):
@@ -332,6 +341,90 @@ class APIServer:
             tr.TRACER.slow_ms = v if v > 0 else None
         return 200, {"sampling": tr.TRACER.sampler.snapshot(),
                      "slow_ms": tr.TRACER.slow_ms}
+
+    # -- tenant SLO surface (ISSUE 3: /tenants, /tenants/<id>, /obs) --------
+
+    def _metrics_get(self, arg) -> Tuple[int, object]:
+        """/metrics: the registry snapshot composed with the obs-layer
+        sections (composition lives HERE so utils.metrics stays below the
+        obs hub). ``?tenant=<id>`` is the lean per-tenant scrape — that
+        tenant's counters + SLO window, no fabric/stages/device payload."""
+        from ..obs import OBS
+        if self.metrics is None:
+            return 200, {}
+        tenant = arg("tenant")
+        snap = self.metrics.snapshot(tenant=tenant)
+        if tenant is not None:
+            snap["slo"] = ({tenant: OBS.windows.snapshot_tenant(tenant)}
+                           if OBS.enabled else {})
+        else:
+            snap["device"] = OBS.device_snapshot()
+            snap["obs"] = OBS.obs_snapshot()
+        return 200, snap
+
+    def _tenants_ranked(self, arg) -> Tuple[int, object]:
+        """Live noisy-neighbor ranking over the windowed RED state: top-K
+        tenants by blended contention score, flags included. Evaluation
+        also refreshes the throttler advisory and emits NOISY_TENANT /
+        SLOW_TENANT events (cooldown-limited)."""
+        from ..obs import OBS
+        top_k = int(arg("top_k", "10"))
+        if top_k < 0:
+            return 400, {"error": f"top_k={top_k} (must be >= 0)"}
+        return 200, OBS.tenants_snapshot(top_k=top_k)
+
+    def _tenant_detail(self, tenant: str) -> Tuple[int, object]:
+        """One tenant's full SLO state: windowed RED + per-stage windows,
+        the ranked row (score/shares/flags), and the monotonic counters."""
+        from ..obs import OBS
+        if not tenant:
+            return 400, {"error": "tenant id required"}
+        windows = OBS.windows.snapshot_tenant(tenant)
+        row = OBS.detector.score_tenant(tenant) if OBS.enabled else None
+        counters = {}
+        if self.metrics is not None:
+            counters = self.metrics.tenant_counters(tenant)
+        if not windows and not counters:
+            return 404, {"error": f"no SLO state for tenant {tenant!r}"}
+        return 200, {"tenant": tenant,
+                     "window_s": OBS.windows.window_s,
+                     "slo": windows,
+                     "rank": row,
+                     "counters": counters}
+
+    def _obs_state(self) -> Tuple[int, object]:
+        from ..obs import OBS
+        return 200, {**OBS.obs_snapshot(),
+                     "window_s": OBS.windows.window_s,
+                     "noisy_threshold": OBS.detector.noisy_threshold,
+                     "slow_p99_ms": OBS.detector.slow_p99_ms}
+
+    def _obs_config(self, arg) -> Tuple[int, object]:
+        """Runtime SLO knobs: ``windows`` (0/1 toggles the window layer),
+        ``noisy_threshold``, ``slow_p99_ms``. Parse everything before
+        applying anything (same contract as PUT /trace)."""
+        from ..obs import OBS
+        raw_windows = arg("windows")
+        raw_thresh = arg("noisy_threshold")
+        raw_slow = arg("slow_p99_ms")
+        windows = None
+        if raw_windows is not None:
+            low = raw_windows.lower()
+            if low in ("1", "true", "on"):
+                windows = True
+            elif low in ("0", "false", "off"):
+                windows = False
+            else:
+                return 400, {"error": f"windows={raw_windows!r}"}
+        thresh = float(raw_thresh) if raw_thresh is not None else None
+        slow = float(raw_slow) if raw_slow is not None else None
+        if windows is not None:
+            OBS.enabled = windows
+        if thresh is not None:
+            OBS.detector.noisy_threshold = thresh
+        if slow is not None:
+            OBS.detector.slow_p99_ms = slow
+        return self._obs_state()
 
     def _cluster_info(self) -> Tuple[int, object]:
         if self.cluster is None:

@@ -37,6 +37,7 @@ from .. import trace
 from ..scheduler.batcher import BatchCallScheduler
 from ..types import (ClientInfo, MatchInfo, Message, PublisherMessagePack,
                      RouteMatcher, TopicMessagePack)
+from ..obs import OBS
 from ..utils import topic as topic_util
 from ..utils.metrics import STAGES
 
@@ -86,7 +87,8 @@ class DistService:
         self._pub_scheduler: BatchCallScheduler[PubCall, PubResult] = \
             BatchCallScheduler(lambda tenant: self._make_pub_batch(tenant),
                                max_burst_latency=max_burst_latency,
-                               stage="queue_wait")
+                               stage="queue_wait",
+                               obs_tenant_key=True)
 
     @property
     def matcher(self) -> TpuMatcher:
@@ -315,6 +317,7 @@ class DistService:
         publish with the achieved fan-out, feeding the "deliver" stage
         histogram either way."""
         t0 = time.perf_counter()
+        fanout = 0
         try:
             with trace.span("deliver.fanout", tenant=tenant_id,
                             topic=call.topic) as sp:
@@ -322,7 +325,12 @@ class DistService:
                 sp.set_tag("fanout", fanout)
                 return fanout
         finally:
-            STAGES.record("deliver", time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            STAGES.record("deliver", dt)
+            # ISSUE 3: achieved fan-out + deliver latency feed the tenant's
+            # SLO windows (fan-out share is the detector's first signal)
+            OBS.record_latency(tenant_id, "deliver", dt)
+            OBS.record_fanout(tenant_id, fanout)
 
     async def _fan_out_inner(self, tenant_id: str, call: PubCall,
                              matched: MatchedRoutes) -> int:

@@ -30,6 +30,7 @@ from ..resilience.faults import get_injector
 from ..resilience.policy import current_deadline
 from ..types import RouteMatcher
 from ..utils import topic as topic_util
+from ..obs import OBS
 from ..utils.metrics import FABRIC, STAGES, FabricMetric
 
 _OP_ADD = 0
@@ -573,7 +574,11 @@ class DistWorker:
                 out = coproc.matcher.match_batch(
                     sub, max_persistent_fanout=max_persistent_fanout,
                     max_group_fanout=max_group_fanout)
-            STAGES.record("device", _time.perf_counter() - t0)
+            dt = _time.perf_counter() - t0
+            STAGES.record("device", dt)
+            # ISSUE 3: device match time attributed to the (range-local)
+            # representative tenant's SLO window
+            OBS.record_latency(sub[0][0], "device", dt)
             return out
         except Exception as e:  # noqa: BLE001 — degrade, don't fail
             oracle = getattr(coproc.matcher, "match_from_tries", None)
@@ -593,7 +598,9 @@ class DistWorker:
                 out = oracle(sub,
                              max_persistent_fanout=max_persistent_fanout,
                              max_group_fanout=max_group_fanout)
-            STAGES.record("device", _time.perf_counter() - t0)
+            dt = _time.perf_counter() - t0
+            STAGES.record("device", dt)
+            OBS.record_latency(sub[0][0], "device", dt)
             return out
 
     async def match_batch(self, queries, *, max_persistent_fanout,

@@ -107,6 +107,10 @@ class TpuMatcher:
         self._compact_done = False
         self._compact_thread: Optional[threading.Thread] = None
         self.compile_count = 0      # full compiles (observability/tests)
+        self.compile_time_s = 0.0   # cumulative wall time in compiles
+        # ISSUE 3: compile count/time surface under /metrics "device"
+        from ..obs import OBS
+        OBS.device.register_matcher(self)
 
     def clone_empty(self) -> "TpuMatcher":
         """A fresh matcher with the same configuration — the reset-from-KV
@@ -188,12 +192,15 @@ class TpuMatcher:
         self._log.clear()
 
     def _compile_shadow(self) -> Tuple[CompiledTrie, object]:
+        import time as _time
+        t0 = _time.perf_counter()
         self.compile_count += 1
         ct = compile_tries(self._shadow, max_levels=self.max_levels,
                            probe_len=self.probe_len)
         from ..ops.match import DeviceTrie  # deferred: keeps jax optional
         dev = DeviceTrie.from_compiled(ct, device=self.device)
         self._warm_walk(ct, dev)
+        self.compile_time_s += _time.perf_counter() - t0
         return ct, dev
 
     def _warm_walk(self, ct: CompiledTrie, dev) -> None:

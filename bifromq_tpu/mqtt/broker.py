@@ -742,6 +742,12 @@ class MQTTBroker:
         self._redirect_task = asyncio.get_running_loop().create_task(
             self._redirect_sweep(
                 get(SysProp.CLIENT_REDIRECT_CHECK_INTERVAL_SECONDS)))
+        # push telemetry export (ISSUE 3): refcounted on the process-global
+        # hub; a no-op unless a sink is configured (BIFROMQ_OBS_EXPORT /
+        # BIFROMQ_OBS_EXPORT_URL). Only a broker that actually acquired a
+        # ref releases one at stop.
+        from ..obs import OBS
+        self._obs_exporter_ref = OBS.start_exporter()
 
     async def _redirect_sweep(self, interval: float) -> None:
         """Periodic IClientBalancer re-check on LIVE sessions (≈ the
@@ -834,6 +840,10 @@ class MQTTBroker:
         if hasattr(self.retain_service, "stop"):
             await self.retain_service.stop()
         await self.dist.stop()
+        if getattr(self, "_obs_exporter_ref", False):
+            self._obs_exporter_ref = False
+            from ..obs import OBS
+            await OBS.stop_exporter()
 
     def _admit_connection(self) -> Optional[EventType]:
         """Frontend admission stage (≈ ConnectionRateLimitHandler +

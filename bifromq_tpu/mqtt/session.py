@@ -33,6 +33,7 @@ from .. import trace
 from ..types import ClientInfo, MatchInfo, Message, QoS, RouteMatcher
 from ..utils import topic as topic_util
 from ..utils.hlc import HLC
+from ..obs import OBS
 from ..utils.metrics import STAGES
 from . import packets as pk
 from .protocol import (PROTOCOL_MQTT5, PropertyId, ReasonCode,
@@ -720,14 +721,18 @@ class Session:
                                  {"topic": topic, "qos": p.qos}))
         # ISSUE 2: the publish→match→deliver ROOT span — the per-tenant
         # sampling draw for the whole distributed trace happens here; the
-        # "ingest" stage histogram records regardless of sampling
+        # "ingest" stage histogram records regardless of sampling.
+        # ISSUE 3: the same measurement feeds the tenant's windowed RED
+        # duration (the /tenants "is this tenant slow NOW" signal)
         t0 = time.monotonic()
         try:
             with trace.span("pub.ingest", tenant=self.client_info.tenant_id,
                             topic=topic, qos=p.qos):
                 await self._ingest_publish(p, topic, msg)
         finally:
-            STAGES.record("ingest", time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            STAGES.record("ingest", dt)
+            OBS.record_latency(self.client_info.tenant_id, "ingest", dt)
 
     async def _ingest_publish(self, p: pk.Publish, topic: str,
                               msg: Message) -> None:

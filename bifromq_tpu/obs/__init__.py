@@ -1,0 +1,210 @@
+"""Tenant SLO observability (ISSUE 3): windowed RED metrics, noisy-neighbor
+detection, device-pipeline gauges, and push telemetry export.
+
+The process-global ``OBS`` hub is the single attachment point:
+
+- hot-path sites call ``OBS.record_*`` (one ``enabled`` check when the
+  window layer is off — same no-op discipline as the tracer);
+- ``MeteringEventCollector`` forwards every metered tenant flow/error;
+- the API server serves ``GET /tenants`` (+ per-tenant detail) from the
+  detector and folds ``OBS.device.snapshot()`` into ``/metrics``;
+- the broker starts/stops the push exporter from env knobs
+  (``BIFROMQ_OBS_EXPORT`` file path or ``BIFROMQ_OBS_EXPORT_URL`` HTTP
+  sink, ``BIFROMQ_OBS_EXPORT_INTERVAL_S``, ``BIFROMQ_OBS_EXPORT_CAP``,
+  ``BIFROMQ_OBS_EXPORT_SAMPLED=1`` to also ship sampled spans).
+
+``BIFROMQ_OBS_WINDOWS=0`` disables the window layer entirely (records
+become a single attribute check); the detector then reports nothing.
+
+Env knobs are read ONCE when the hub is constructed at import (the same
+discipline as ``trace.TRACER``'s ``BIFROMQ_TRACE_*``); everything is
+reconfigurable at runtime through ``PUT /obs`` or the hub's attributes.
+
+Layering: ``utils.metrics`` imports this package (feeding flows/errors
+and sharing the log2 bucket math in ``window``); nothing in ``obs`` may
+import ``utils.metrics`` — that would close an import cycle.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import weakref
+from typing import Callable, Optional
+
+from .device import DeviceGauges
+from .exporter import FileSink, HTTPSink, TelemetryExporter
+from .neighbor import NoisyNeighborDetector
+from .slo import TenantSLO
+from .window import WindowedCounter, WindowedLog2Histogram
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+class ObsHub:
+    def __init__(self, *, clock: Callable[[], float] = time.monotonic,
+                 window_s: Optional[float] = None) -> None:
+        self.enabled = os.environ.get("BIFROMQ_OBS_WINDOWS", "1") != "0"
+        ws = window_s or _env_float("BIFROMQ_OBS_WINDOW_S", 10.0)
+        if ws <= 0:
+            # a bad telemetry knob must never crash the publish hot path
+            # (TenantSLO would raise on the first record)
+            import logging
+            logging.getLogger(__name__).error(
+                "BIFROMQ_OBS_WINDOW_S=%r invalid; using 10.0", ws)
+            ws = 10.0
+        self.windows = TenantSLO(window_s=ws, clock=clock)
+        self.detector = NoisyNeighborDetector(
+            self.windows,
+            slow_p99_ms=_env_float("BIFROMQ_OBS_SLO_MS", 1000.0),
+            clock=clock)
+        self.device = DeviceGauges(clock=clock)
+        self.exporter: Optional[TelemetryExporter] = None
+        self._exporter_refs = 0
+        self._registry_ref = None       # weakref to a MetricsRegistry
+
+    # ---------------- hot-path recording -----------------------------------
+
+    def record_flow(self, tenant: str, n: float = 1.0) -> None:
+        if self.enabled:
+            self.windows.record_flow(tenant, n)
+
+    def record_error(self, tenant: str, n: float = 1.0) -> None:
+        if self.enabled:
+            self.windows.record_error(tenant, n)
+
+    def record_fanout(self, tenant: str, n: float) -> None:
+        if self.enabled:
+            self.windows.record_fanout(tenant, n)
+
+    def record_queue_wait(self, tenant: str, seconds: float) -> None:
+        if self.enabled:
+            self.windows.record_queue_wait(tenant, seconds)
+
+    def record_latency(self, tenant: str, stage: str,
+                       seconds: float) -> None:
+        if self.enabled:
+            self.windows.record_latency(tenant, stage, seconds)
+
+    # ---------------- wiring ------------------------------------------------
+
+    def bind_events(self, collector) -> None:
+        """Give the detector an event outlet (NOISY_TENANT/SLOW_TENANT).
+        Called by MeteringEventCollector so offender events ride the same
+        stream operators already collect."""
+        self.detector.events = collector
+
+    def bind_registry(self, registry) -> None:
+        """Weakly remember the metrics registry so exporter snapshots can
+        include the monotonic per-tenant counters."""
+        self._registry_ref = weakref.ref(registry)
+
+    def is_noisy(self, tenant: str) -> bool:
+        """Throttler advisory: is this tenant currently flagged noisy?"""
+        return self.enabled and self.detector.is_noisy(tenant)
+
+    # ---------------- snapshots --------------------------------------------
+
+    def tenants_snapshot(self, top_k: int = 10, emit: bool = True) -> dict:
+        rows = (self.detector.evaluate(top_k=top_k, emit=emit)
+                if self.enabled else [])
+        return {"window_s": self.windows.window_s,
+                "enabled": self.enabled,
+                "top_k": top_k,
+                "tenants": rows}
+
+    def device_snapshot(self, *, memory: bool = True) -> dict:
+        return self.device.snapshot(memory=memory)
+
+    def obs_snapshot(self) -> dict:
+        out = {"windows_enabled": self.enabled}
+        if self.exporter is not None:
+            out["exporter"] = self.exporter.snapshot()
+        return out
+
+    def _export_snapshot(self) -> dict:
+        """One exporter 'metrics' record: windowed SLO + device + the
+        bound registry's monotonic counters (when still alive)."""
+        out = {"slo": self.windows.snapshot() if self.enabled else {},
+               "device": self.device_snapshot(memory=False)}
+        reg = self._registry_ref() if self._registry_ref else None
+        if reg is not None:
+            try:
+                # the registry snapshot is counters/fabric/stages only
+                # (composition of device/obs sections lives in the API
+                # server) — the flush loop never runs the jax memory probe
+                out["registry"] = reg.snapshot()
+            except Exception:  # noqa: BLE001 — telemetry must not raise
+                pass
+        return out
+
+    # ---------------- exporter lifecycle -----------------------------------
+
+    def exporter_from_env(self) -> Optional[TelemetryExporter]:
+        path = os.environ.get("BIFROMQ_OBS_EXPORT", "").strip()
+        url = os.environ.get("BIFROMQ_OBS_EXPORT_URL", "").strip()
+        if not path and not url:
+            return None
+        try:
+            sink = HTTPSink(url) if url else FileSink(path)
+        except ValueError as e:
+            # a bad telemetry knob must not abort broker startup
+            import logging
+            logging.getLogger(__name__).error(
+                "telemetry export disabled: %s", e)
+            return None
+        return TelemetryExporter(
+            sink,
+            interval_s=_env_float("BIFROMQ_OBS_EXPORT_INTERVAL_S", 2.0),
+            queue_cap=int(_env_float("BIFROMQ_OBS_EXPORT_CAP", 2048)),
+            export_sampled=os.environ.get(
+                "BIFROMQ_OBS_EXPORT_SAMPLED", "0") == "1",
+            snapshot_fn=self._export_snapshot)
+
+    def start_exporter(self,
+                       exporter: Optional[TelemetryExporter] = None) -> bool:
+        """Refcounted start (several brokers may share the process-global
+        hub in tests): the first caller creates/starts, later callers just
+        bump the count. Returns whether a ref was ACQUIRED — a caller
+        whose start was a no-op (no sink configured at the time) must not
+        release someone else's ref at stop."""
+        if self.exporter is None:
+            exporter = exporter or self.exporter_from_env()
+            if exporter is None:
+                return False
+            self.exporter = exporter
+            self.exporter.start()
+        self._exporter_refs += 1
+        return True
+
+    async def stop_exporter(self) -> None:
+        if self.exporter is None:
+            return
+        self._exporter_refs -= 1
+        if self._exporter_refs <= 0:
+            exp, self.exporter = self.exporter, None
+            self._exporter_refs = 0
+            await exp.stop()
+
+    def reset(self) -> None:
+        """Test isolation: drop all windows/flags/gauges (exporter left to
+        its owner)."""
+        self.windows.reset()
+        self.detector.reset()
+        self.device.reset()
+
+
+# the process-global hub every instrumentation site reports into
+OBS = ObsHub()
+
+__all__ = [
+    "OBS", "ObsHub", "TenantSLO", "NoisyNeighborDetector", "DeviceGauges",
+    "TelemetryExporter", "FileSink", "HTTPSink", "WindowedCounter",
+    "WindowedLog2Histogram",
+]

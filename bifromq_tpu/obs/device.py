@@ -1,0 +1,130 @@
+"""Device-pipeline gauges (ISSUE 3, part 4).
+
+As the match hot path moves onto the accelerator, the broker's visibility
+has to follow it below the Python line: XLA recompiles (each one stalls
+serving for seconds), the dispatch queue in front of the device (the
+batcher's backlog is the first thing to grow when the device slows), and
+device memory watermarks. Producers register weakly — a test-scoped
+matcher or scheduler must not be pinned by telemetry — and the snapshot
+is assembled on demand for ``/metrics`` ``"device"`` and ``bench.py``.
+
+jax is only touched inside a guarded, TTL-cached probe: the gauges must
+stay readable (reporting zeros / unavailability) when the device tunnel
+is down — that is exactly when an operator is looking at them.
+"""
+
+from __future__ import annotations
+
+import time
+import weakref
+from typing import Callable, Dict, Optional
+
+
+class DeviceGauges:
+    MEM_PROBE_TTL_S = 5.0
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic) -> None:
+        self._clock = clock
+        self._matchers: "weakref.WeakSet" = weakref.WeakSet()
+        self._schedulers: "weakref.WeakSet" = weakref.WeakSet()
+        self._mem_cache: Optional[dict] = None
+        self._mem_at = -1e18
+        self._mem_peak_bytes = 0
+
+    def register_matcher(self, matcher) -> None:
+        """Track a TpuMatcher's compile count/time (weakly held)."""
+        self._matchers.add(matcher)
+
+    def register_scheduler(self, scheduler) -> None:
+        """Track a BatchCallScheduler's live queue depth (weakly held)."""
+        self._schedulers.add(scheduler)
+
+    # ---------------- probes ------------------------------------------------
+
+    def _compile_stats(self) -> Dict[str, float]:
+        count = 0
+        total_s = 0.0
+        for m in list(self._matchers):
+            count += getattr(m, "compile_count", 0)
+            total_s += getattr(m, "compile_time_s", 0.0)
+        return {"compile_count": count,
+                "compile_time_s": round(total_s, 3)}
+
+    def _dispatch_stats(self) -> Dict[str, float]:
+        depth = inflight = batchers = 0
+        cap = 0
+        for sched in list(self._schedulers):
+            for b in list(getattr(sched, "_batchers", {}).values()):
+                batchers += 1
+                depth += len(getattr(b, "_queue", ()))
+                inflight += getattr(b, "_inflight", 0)
+                cap = max(cap, getattr(b, "_cap", 0))
+        return {"dispatch_queue_depth": depth,
+                "batches_in_flight": inflight,
+                "batchers": batchers,
+                "max_batch_cap": cap}
+
+    def _memory_stats(self) -> dict:
+        now = self._clock()
+        if (self._mem_cache is not None
+                and now - self._mem_at < self.MEM_PROBE_TTL_S):
+            return self._mem_cache
+        out: dict = {"available": False}
+        try:
+            # NEVER trigger backend init from a telemetry scrape: a dead
+            # device tunnel makes first-time PJRT init hang uninterruptibly
+            # (bench.py probes it in a subprocess for exactly this reason).
+            # Only read a backend some real device work already created.
+            import sys
+            if "jax" not in sys.modules:
+                raise LookupError("jax not loaded")
+            import jax
+            from jax._src import xla_bridge as _xb
+            if not getattr(_xb, "_backends", None):
+                raise LookupError("jax backend not initialized")
+            devs = jax.local_devices()
+            per_dev = []
+            for d in devs:
+                try:
+                    ms = d.memory_stats()
+                except Exception:  # noqa: BLE001 — CPU backends lack this
+                    ms = None
+                if ms:
+                    in_use = int(ms.get("bytes_in_use", 0))
+                    self._mem_peak_bytes = max(self._mem_peak_bytes,
+                                               int(ms.get(
+                                                   "peak_bytes_in_use",
+                                                   in_use)))
+                    per_dev.append({
+                        "platform": d.platform,
+                        "bytes_in_use": in_use,
+                        "peak_bytes_in_use": int(ms.get("peak_bytes_in_use",
+                                                        in_use)),
+                        "bytes_limit": int(ms.get("bytes_limit", 0)),
+                    })
+            out = {"available": bool(per_dev),
+                   "n_devices": len(devs),
+                   "platform": devs[0].platform if devs else "none",
+                   "peak_bytes_in_use": self._mem_peak_bytes,
+                   "devices": per_dev}
+        except Exception as e:  # noqa: BLE001 — tunnel down / jax absent
+            out = {"available": False,
+                   "error": f"{type(e).__name__}: {e}"[:120]}
+        self._mem_cache = out
+        self._mem_at = now
+        return out
+
+    def snapshot(self, *, memory: bool = True) -> dict:
+        """The ``/metrics`` ``"device"`` section. ``memory=False`` skips
+        the jax probe (hot scrape loops on a flapping tunnel)."""
+        out = {**self._compile_stats(), **self._dispatch_stats()}
+        if memory:
+            out["memory"] = self._memory_stats()
+        return out
+
+    def reset(self) -> None:
+        self._matchers = weakref.WeakSet()
+        self._schedulers = weakref.WeakSet()
+        self._mem_cache = None
+        self._mem_at = -1e18
+        self._mem_peak_bytes = 0

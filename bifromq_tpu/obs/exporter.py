@@ -1,0 +1,273 @@
+"""Push telemetry export (ISSUE 3, part 3 — closes PR 2's pull-only gap).
+
+Batched JSON-lines records shipped to a file or HTTP sink by a background
+asyncio task:
+
+- **metric snapshots** — the windowed per-tenant SLO state, device gauges,
+  process stage histograms and fabric counters, one record per flush tick;
+- **spans** — incremental drains of the tracer's slow ring (always) and
+  sampled ring (optional), via ``SpanRing.since`` cursors, so every slow
+  trace reaches the sink even though /trace stays pull-able.
+
+Discipline mirrors the delivery plane: the queue is **bounded** (overflow
+increments ``dropped`` and evicts the oldest — telemetry may lag, memory
+may not grow), flush failures retry with the resilience fabric's
+``RetryPolicy`` (full-jitter backoff), and a batch that exhausts its
+retries is counted dropped rather than wedging the loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+from urllib.parse import urlsplit
+
+from ..resilience.policy import RetryPolicy
+
+EXPORT_RETRY = RetryPolicy(max_attempts=3, base_delay=0.05, max_delay=1.0)
+
+
+class FileSink:
+    """Append JSON lines to a local file (fsync-free: the OS page cache is
+    durable enough for telemetry)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+
+    def _write(self, blob: str) -> None:
+        with open(self.path, "a", encoding="utf-8") as f:
+            f.write(blob)
+
+    async def ship(self, lines: List[str]) -> None:
+        # off-loop: a slow/network filesystem must not stall the broker's
+        # event loop (the same loop serving publishes) for the write
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._write, "\n".join(lines) + "\n")
+
+    def describe(self) -> str:
+        return f"file:{self.path}"
+
+
+class HTTPSink:
+    """POST the batch as an ``application/x-ndjson`` body over a raw
+    asyncio connection (dependency-free, same discipline as the API
+    server's HTTP/1.1 plumbing). Any non-2xx status raises so the
+    exporter's retry policy takes over."""
+
+    def __init__(self, url: str, timeout_s: float = 5.0) -> None:
+        u = urlsplit(url)
+        if u.scheme != "http" or not u.hostname:
+            raise ValueError(f"unsupported telemetry sink url {url!r}")
+        self.host = u.hostname
+        self.port = u.port or 80
+        # keep the query string: auth-in-query (?token=...) is the common
+        # telemetry-collector pattern
+        self.path = (u.path or "/") + (f"?{u.query}" if u.query else "")
+        self.timeout_s = timeout_s
+        self.url = url
+
+    async def ship(self, lines: List[str]) -> None:
+        body = ("\n".join(lines) + "\n").encode()
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port), self.timeout_s)
+        try:
+            writer.write(
+                f"POST {self.path} HTTP/1.1\r\nhost: {self.host}\r\n"
+                f"content-type: application/x-ndjson\r\n"
+                f"content-length: {len(body)}\r\n"
+                f"connection: close\r\n\r\n".encode() + body)
+            await asyncio.wait_for(writer.drain(), self.timeout_s)
+            status_line = await asyncio.wait_for(reader.readline(),
+                                                self.timeout_s)
+            parts = status_line.split()
+            if len(parts) < 2 or not parts[1].startswith(b"2"):
+                raise ConnectionError(
+                    f"telemetry sink rejected batch: {status_line!r}")
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def describe(self) -> str:
+        return f"http:{self.url}"
+
+
+class TelemetryExporter:
+    def __init__(self, sink, *, interval_s: float = 2.0,
+                 queue_cap: int = 2048, batch_max: int = 256,
+                 snapshot_fn: Optional[Callable[[], dict]] = None,
+                 export_sampled: bool = False,
+                 retry: RetryPolicy = EXPORT_RETRY,
+                 clock: Callable[[], float] = time.time) -> None:
+        self.sink = sink
+        self.interval_s = interval_s
+        self.queue_cap = queue_cap
+        self.batch_max = batch_max
+        self.snapshot_fn = snapshot_fn
+        self.export_sampled = export_sampled
+        self.retry = retry
+        self._clock = clock
+        self._queue: deque = deque()
+        self._task: Optional[asyncio.Task] = None
+        self._wake: Optional[asyncio.Event] = None
+        # counters surfaced under /metrics "obs"
+        self.enqueued = 0
+        self.shipped = 0
+        self.dropped = 0          # queue overflow + retry-exhausted batches
+        self.ship_failures = 0    # individual failed ship attempts
+        self.batches = 0
+        # incremental ring cursors (slow ring always; main ring optional)
+        self._slow_cursor = 0
+        self._ring_cursor = 0
+        # span ids already enqueued: a slow span lives in BOTH rings (and
+        # a slow root's dragged-in children reach the slow ring a tick
+        # after the sampled drain saw them) — dedupe so consumers never
+        # double-count a span. Bounded FIFO.
+        self._seen_ids: set = set()
+        self._seen_fifo: deque = deque()
+        self.SEEN_CAP = 8192
+
+    # ---------------- producers --------------------------------------------
+
+    def enqueue(self, record: Dict) -> None:
+        """Bounded enqueue: past the cap the OLDEST record is evicted (the
+        newest telemetry is the one an operator is paging through)."""
+        if len(self._queue) >= self.queue_cap:
+            self._queue.popleft()
+            self.dropped += 1
+        self._queue.append(record)
+        self.enqueued += 1
+
+    def _collect(self) -> None:
+        """One flush tick's worth of records: a metric snapshot + any new
+        spans since the last drain."""
+        now = self._clock()
+        if self.snapshot_fn is not None:
+            try:
+                snap = self.snapshot_fn()
+            except Exception:  # noqa: BLE001 — telemetry must not raise
+                snap = None
+            if snap:
+                self.enqueue({"type": "metrics", "ts": now, **snap})
+        from .. import trace
+        self._slow_cursor = self._drain(trace.TRACER.slow_ring,
+                                        self._slow_cursor, now)
+        if self.export_sampled:
+            self._ring_cursor = self._drain(trace.TRACER.ring,
+                                            self._ring_cursor, now)
+
+    def _drain(self, ring, cursor: int, now: float) -> int:
+        """Incrementally drain one span ring into the queue; returns the
+        advanced cursor. The slow ring also holds FAST children dragged
+        in by a slow root — ``slow`` is flagged per-span from its own
+        duration so consumers alerting on slow==true don't count context
+        spans as SLO violations; ``_first_sighting`` dedupes spans that
+        live in both rings."""
+        from .. import trace
+        spans, cursor, missed = ring.since(cursor)
+        self.dropped += missed
+        slow_ms = trace.TRACER.slow_ms
+        for s in spans:
+            if not self._first_sighting(s.span_id):
+                continue
+            self.enqueue({"type": "span", "ts": now,
+                          "slow": (slow_ms is not None
+                                   and s.duration_ms >= slow_ms),
+                          **s.to_dict()})
+        return cursor
+
+    def _first_sighting(self, span_id: int) -> bool:
+        if span_id in self._seen_ids:
+            return False
+        self._seen_ids.add(span_id)
+        self._seen_fifo.append(span_id)
+        if len(self._seen_fifo) > self.SEEN_CAP:
+            self._seen_ids.discard(self._seen_fifo.popleft())
+        return True
+
+    # ---------------- flush loop -------------------------------------------
+
+    async def _flush_once(self) -> None:
+        self._collect()
+        while self._queue:
+            batch = []
+            while self._queue and len(batch) < self.batch_max:
+                batch.append(self._queue.popleft())
+            lines = [json.dumps(r, default=str) for r in batch]
+            attempt = 0
+            try:
+                while True:
+                    try:
+                        await self.sink.ship(lines)
+                        self.shipped += len(batch)
+                        self.batches += 1
+                        break
+                    except Exception:  # noqa: BLE001 — sink down: back off
+                        self.ship_failures += 1
+                        attempt += 1
+                        if not self.retry.should_retry(attempt):
+                            self.dropped += len(batch)
+                            return  # sink is down — try again next tick
+                        await asyncio.sleep(self.retry.backoff(attempt))
+            except asyncio.CancelledError:
+                # cancelled mid-ship (e.g. stop()'s 5s grace expired):
+                # the de-queued batch must still be ACCOUNTED — silent
+                # loss would break the drop-counter contract
+                self.dropped += len(batch)
+                raise
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await asyncio.wait_for(self._wake.wait(), self.interval_s)
+            except asyncio.TimeoutError:
+                pass
+            if self._wake.is_set():     # stop requested: final flush below
+                return
+            try:
+                await self._flush_once()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                import logging
+                logging.getLogger(__name__).exception("telemetry flush")
+
+    def start(self) -> None:
+        if self._task is not None:
+            return
+        self._wake = asyncio.Event()
+        self._task = asyncio.get_running_loop().create_task(
+            self._run(), name="obs-exporter")
+
+    async def stop(self, final_flush: bool = True) -> None:
+        task, self._task = self._task, None
+        if task is None:
+            return
+        self._wake.set()
+        try:
+            await asyncio.wait_for(task, 5.0)
+        except asyncio.TimeoutError:
+            task.cancel()
+        except asyncio.CancelledError:
+            # shutdown itself was cancelled: don't keep flushing into a
+            # possibly-dead sink — propagate after killing the loop task
+            task.cancel()
+            raise
+        if final_flush:
+            try:
+                await self._flush_once()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def snapshot(self) -> dict:
+        return {"sink": self.sink.describe(),
+                "interval_s": self.interval_s,
+                "queue_depth": len(self._queue),
+                "queue_cap": self.queue_cap,
+                "enqueued": self.enqueued,
+                "shipped": self.shipped,
+                "batches": self.batches,
+                "dropped": self.dropped,
+                "ship_failures": self.ship_failures}

@@ -1,0 +1,174 @@
+"""Noisy-neighbor detection over the windowed RED state (ISSUE 3, part 2).
+
+Scores every live tenant on the three signals a multi-tenant broker
+actually contends on:
+
+- **share of fan-out** — routes delivered on this tenant's behalf as a
+  fraction of all delivery work in the window (the fan-out amplifier is
+  how one tenant's publish costs everyone else);
+- **share of queue-wait** — seconds this tenant's calls spent queued in
+  the adaptive batcher, as a fraction of all queue-wait (the direct
+  measurement of "who is filling the pipeline");
+- **error rate** — errors per flow in the window (a tenant drowning in
+  deliver errors/drops is burning retries and inbox space).
+
+``evaluate()`` ranks tenants by the blended score, flags offenders
+(``noisy`` when the blended share crosses the threshold with ≥2 active
+tenants; ``slow`` when the tenant's windowed ingest p99 crosses the SLO),
+emits ``NOISY_TENANT`` / ``SLOW_TENANT`` through the plugin event stream
+(cooldown-limited per tenant), and caches the flag set for the throttler
+advisory (`plugin.throttler.SLOAdvisedResourceThrottler` consults it on
+the connect/publish guard path).
+"""
+
+from __future__ import annotations
+
+import time
+import weakref
+from typing import Callable, Dict, List, Optional, Set
+
+from ..plugin.events import Event, EventType, IEventCollector
+from .slo import TenantSLO
+
+
+class NoisyNeighborDetector:
+    W_FANOUT = 0.4
+    W_QUEUE_WAIT = 0.4
+    W_ERRORS = 0.2
+
+    def __init__(self, slo: TenantSLO, *,
+                 noisy_threshold: float = 0.5,
+                 slow_p99_ms: float = 1000.0,
+                 min_rate_per_s: float = 1.0,
+                 event_cooldown_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.slo = slo
+        self.noisy_threshold = noisy_threshold
+        self.slow_p99_ms = slow_p99_ms
+        # a tenant must carry real traffic before it can be flagged —
+        # shares of a near-empty window are noise, not neighbors
+        self.min_rate_per_s = min_rate_per_s
+        self.event_cooldown_s = event_cooldown_s
+        self._clock = clock
+        self._events_ref = None
+        self._last_emit: Dict[tuple, float] = {}
+        # flag cache for the throttler advisory (refreshed by evaluate())
+        self._noisy: Set[str] = set()
+        self._flags_at = -1e18
+        self.advisory_ttl_s = 1.0
+
+    # ---------------- scoring ----------------------------------------------
+
+    def _row(self, tenant: str, s: dict, totals: Dict[str, float],
+             n_active: int) -> dict:
+        """Score one tenant's windowed snapshot into a ranked row."""
+        fan_share = (s["fanout_per_s"] * self.slo.window_s
+                     / totals["fanout"]) if totals["fanout"] else 0.0
+        wait_share = (s["queue_wait_s"] / totals["queue_wait_s"]
+                      if totals["queue_wait_s"] else 0.0)
+        err = min(1.0, s["error_rate"])
+        score = (self.W_FANOUT * fan_share
+                 + self.W_QUEUE_WAIT * wait_share
+                 + self.W_ERRORS * err)
+        flags = []
+        eligible = s["rate_per_s"] >= self.min_rate_per_s
+        if (eligible and n_active >= 2
+                and score >= self.noisy_threshold):
+            flags.append("noisy")
+        ingest_p99 = s["stages"].get("ingest", {}).get("p99_ms", 0.0)
+        if eligible and ingest_p99 >= self.slow_p99_ms:
+            flags.append("slow")
+        return {"tenant": tenant,
+                "score": round(score, 4),
+                "fanout_share": round(fan_share, 4),
+                "queue_wait_share": round(wait_share, 4),
+                "flags": flags, **s}
+
+    def evaluate(self, top_k: int = 10, emit: bool = True) -> List[dict]:
+        """Rank tenants by blended contention score, refresh the advisory
+        flag set, and (optionally) emit offender events."""
+        snap = self.slo.snapshot()
+        # derive share totals from the snapshot already in hand (a
+        # second slo.totals() pass would re-walk every tenant's windows)
+        totals = {"fanout": sum(s["fanout_per_s"] for s in snap.values())
+                  * self.slo.window_s,
+                  "queue_wait_s": sum(s["queue_wait_s"]
+                                      for s in snap.values())}
+        n_active = sum(1 for s in snap.values() if s["rate_per_s"] > 0)
+        rows = [self._row(tenant, s, totals, n_active)
+                for tenant, s in snap.items()]
+        rows.sort(key=lambda r: (-r["score"], -r["rate_per_s"],
+                                 r["tenant"]))
+        self._noisy = {r["tenant"] for r in rows if "noisy" in r["flags"]}
+        self._flags_at = self._clock()
+        if emit:
+            for r in rows:
+                for flag in r["flags"]:
+                    self._emit(flag, r)
+        return rows[:top_k]
+
+    def score_tenant(self, tenant: str) -> Optional[dict]:
+        """One tenant's ranked row without evaluating every other tenant
+        (``GET /tenants/<id>``): O(this tenant + counter totals), no
+        advisory-cache refresh, no events."""
+        s = self.slo.snapshot_tenant(tenant)
+        if not s:
+            return None
+        return self._row(tenant, s, self.slo.totals(),
+                         self.slo.active_count())
+
+    # the outlet is WEAKLY held (last-binder wins — a process-global hub
+    # discipline): a stopped broker's collector chain must not be pinned
+    # by telemetry, and a dead ref degrades to silent non-emission
+    @property
+    def events(self) -> Optional[IEventCollector]:
+        r = self._events_ref
+        return r() if r is not None else None
+
+    @events.setter
+    def events(self, collector: Optional[IEventCollector]) -> None:
+        self._events_ref = (weakref.ref(collector)
+                            if collector is not None else None)
+
+    def _emit(self, flag: str, row: dict) -> None:
+        events = self.events
+        if events is None:
+            return
+        key = (row["tenant"], flag)
+        now = self._clock()
+        if now - self._last_emit.get(key, -1e18) < self.event_cooldown_s:
+            return
+        if len(self._last_emit) > 1024:
+            # an entry past its cooldown suppresses nothing — prune so
+            # churning tenant ids can't grow the map forever
+            self._last_emit = {
+                k: t for k, t in self._last_emit.items()
+                if now - t < self.event_cooldown_s}
+        self._last_emit[key] = now
+        etype = (EventType.NOISY_TENANT if flag == "noisy"
+                 else EventType.SLOW_TENANT)
+        try:
+            events.report(Event(etype, row["tenant"], {
+                "score": row["score"],
+                "fanout_share": row["fanout_share"],
+                "queue_wait_share": row["queue_wait_share"],
+                "error_rate": row["error_rate"],
+                "p99_ms": row["stages"].get("ingest", {}).get("p99_ms", 0.0),
+            }))
+        except Exception:  # noqa: BLE001 — telemetry must not raise
+            pass
+
+    # ---------------- throttler advisory ------------------------------------
+
+    def is_noisy(self, tenant: str) -> bool:
+        """Advisory lookup for the resource throttler: refreshes the flag
+        set lazily (bounded by ``advisory_ttl_s``) so the guard path never
+        pays a full evaluation per call."""
+        if self._clock() - self._flags_at > self.advisory_ttl_s:
+            self.evaluate(emit=False)
+        return tenant in self._noisy
+
+    def reset(self) -> None:
+        self._last_emit.clear()
+        self._noisy = set()
+        self._flags_at = -1e18

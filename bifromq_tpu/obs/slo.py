@@ -1,0 +1,157 @@
+"""Per-tenant windowed RED aggregation (ISSUE 3 tentpole, part 1).
+
+Rate / Errors / Duration per tenant over a sliding ~10s window, fed from
+three directions:
+
+- **flows** — every metered ``TenantMetric`` event increments the tenant's
+  rate window (``MeteringEventCollector`` forwards into the hub);
+- **errors** — the error-classed subset (deliver errors, QoS drops, inbox
+  overflow) additionally lands in the error window;
+- **durations** — the hot path records per-(tenant, stage) windowed log2
+  histograms (ingest / queue_wait / device / deliver), the per-tenant twin
+  of the process-global ``utils.metrics.STAGES``.
+
+Plus the two share signals the noisy-neighbor detector scores on: fan-out
+(routes actually delivered per publish) and batch queue-wait seconds.
+
+Tenant cardinality is bounded: past ``max_tenants`` the oldest-inserted
+tenant's windows are dropped (dict FIFO, same discipline as the dist match
+cache) — a tenant that keeps publishing simply re-enters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List
+
+from .window import WindowedCounter, WindowedLog2Histogram
+
+
+class _TenantWindows:
+    """One tenant's live RED state."""
+
+    __slots__ = ("flows", "errors", "fanout", "queue_wait_s", "stages",
+                 "_mk_hist")
+
+    def __init__(self, mk_counter, mk_hist) -> None:
+        self.flows = mk_counter()
+        self.errors = mk_counter()
+        self.fanout = mk_counter()
+        self.queue_wait_s = mk_counter()
+        self.stages: Dict[str, WindowedLog2Histogram] = {}
+        self._mk_hist = mk_hist
+
+    def stage(self, name: str) -> WindowedLog2Histogram:
+        h = self.stages.get(name)
+        if h is None:
+            h = self.stages.setdefault(name, self._mk_hist())
+        return h
+
+
+class TenantSLO:
+    """The windowed per-tenant registry. Thread-safe for registration
+    (sessions run on the loop; compaction threads may report too) but
+    recording into an existing window is GIL-atomic list arithmetic —
+    no lock on the steady-state path."""
+
+    def __init__(self, *, window_s: float = 10.0, n_slices: int = 5,
+                 max_tenants: int = 512,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.window_s = float(window_s)
+        self.n_slices = int(n_slices)
+        self.max_tenants = int(max_tenants)
+        self._clock = clock
+        self._tenants: Dict[str, _TenantWindows] = {}
+        self._lock = threading.Lock()
+
+    def _mk_counter(self) -> WindowedCounter:
+        return WindowedCounter(self.window_s, self.n_slices, self._clock)
+
+    def _mk_hist(self) -> WindowedLog2Histogram:
+        return WindowedLog2Histogram(self.window_s, self.n_slices,
+                                     self._clock)
+
+    def _windows(self, tenant: str) -> _TenantWindows:
+        w = self._tenants.get(tenant)
+        if w is None:
+            with self._lock:
+                w = self._tenants.get(tenant)
+                if w is None:
+                    if len(self._tenants) >= self.max_tenants:
+                        # bounded: drop the oldest-inserted tenant
+                        self._tenants.pop(next(iter(self._tenants)))
+                    w = _TenantWindows(self._mk_counter, self._mk_hist)
+                    self._tenants[tenant] = w
+        return w
+
+    # ---------------- recording (hot path) ---------------------------------
+
+    def record_flow(self, tenant: str, n: float = 1.0) -> None:
+        self._windows(tenant).flows.add(n)
+
+    def record_error(self, tenant: str, n: float = 1.0) -> None:
+        self._windows(tenant).errors.add(n)
+
+    def record_fanout(self, tenant: str, n: float) -> None:
+        if n > 0:
+            self._windows(tenant).fanout.add(n)
+
+    def record_queue_wait(self, tenant: str, seconds: float) -> None:
+        self._windows(tenant).queue_wait_s.add(seconds)
+
+    def record_latency(self, tenant: str, stage: str,
+                       seconds: float) -> None:
+        self._windows(tenant).stage(stage).record(seconds)
+
+    # ---------------- snapshots --------------------------------------------
+
+    def tenants(self) -> List[str]:
+        return list(self._tenants)
+
+    def snapshot_tenant(self, tenant: str) -> dict:
+        w = self._tenants.get(tenant)
+        if w is None:
+            return {}
+        flows = w.flows.total()
+        errors = w.errors.total()
+        stages = {}
+        for name, h in w.stages.items():
+            s = h.snapshot()        # ONE merge per histogram
+            if s["count"]:
+                stages[name] = s
+        return {
+            "rate_per_s": round(flows / self.window_s, 3),
+            "errors_per_s": round(errors / self.window_s, 3),
+            "error_rate": round(errors / flows, 4) if flows else 0.0,
+            "fanout_per_s": round(w.fanout.total() / self.window_s, 3),
+            "queue_wait_s": round(w.queue_wait_s.total(), 6),
+            "stages": stages,
+        }
+
+    def snapshot(self) -> Dict[str, dict]:
+        out = {}
+        for tenant in list(self._tenants):
+            snap = self.snapshot_tenant(tenant)
+            if snap and (snap["rate_per_s"] or snap["fanout_per_s"]
+                         or snap["queue_wait_s"] or snap["stages"]):
+                out[tenant] = snap
+        return out
+
+    def active_count(self) -> int:
+        """Tenants with live flow traffic in the window — counter sums
+        only, no histogram merges (cheap enough for per-request use)."""
+        return sum(1 for w in list(self._tenants.values())
+                   if w.flows.total() > 0)
+
+    # share totals the detector normalizes against
+    def totals(self) -> Dict[str, float]:
+        fanout = wait = 0.0
+        for w in list(self._tenants.values()):
+            fanout += w.fanout.total()
+            wait += w.queue_wait_s.total()
+        return {"fanout": fanout, "queue_wait_s": wait}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._tenants.clear()

@@ -1,0 +1,147 @@
+"""Sliding-window primitives for the tenant SLO layer (ISSUE 3).
+
+``utils/metrics`` keeps monotonic counters and cumulative log2 histograms —
+good for totals, useless for "which tenant is slow *right now*". Here the
+same log2-bucket discipline is windowed: a ring of time slices, each an
+independent bucket array; recording lands in the current slice, snapshots
+merge only the slices still inside the window, and expired slices are
+zeroed lazily (decay costs nothing when nothing records).
+
+Everything takes an injectable ``clock`` (seconds, monotonic) so decay is
+deterministic under a fake clock in tests; the slice index is a pure
+function of the clock value.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+# THE log2 bucket discipline, shared with utils.metrics.LatencyHistogram:
+# bucket i counts samples whose microsecond value has bit_length i (the
+# [2^(i-1), 2^i) range), topping out around 2 minutes; percentile
+# extraction returns the bucket's upper edge (conservative).
+N_BUCKETS = 28      # 2^27 µs ≈ 134 s
+
+
+def bucket_index(seconds: float) -> int:
+    us = int(seconds * 1e6)
+    i = us.bit_length() if us > 0 else 0
+    return i if i < N_BUCKETS else N_BUCKETS - 1
+
+
+def percentile_ms_from(buckets, p: float) -> float:
+    """Upper edge (ms) of the bucket containing the p-th percentile."""
+    total = sum(buckets)
+    if total == 0:
+        return 0.0
+    target = max(1, int(total * p / 100.0 + 0.5))
+    acc = 0
+    for i, c in enumerate(buckets):
+        acc += c
+        if acc >= target:
+            return (1 << i) / 1000.0
+    return (1 << (N_BUCKETS - 1)) / 1000.0
+
+
+class _Sliced:
+    """Shared slice-ring mechanics: ``_slot(now)`` returns the current
+    slice index after zeroing any slice whose epoch fell out of the
+    window. ``live_slots(now)`` yields indices still inside the window."""
+
+    def __init__(self, window_s: float, n_slices: int,
+                 clock: Callable[[], float]) -> None:
+        if window_s <= 0 or n_slices <= 0:
+            raise ValueError("window_s and n_slices must be positive")
+        self.window_s = float(window_s)
+        self.n_slices = int(n_slices)
+        self._span = self.window_s / self.n_slices
+        self._clock = clock
+        # epoch of the data each slot currently holds (-1 = empty)
+        self._epochs: List[int] = [-1] * self.n_slices
+
+    def _epoch(self, now: float) -> int:
+        return int(now / self._span)
+
+    def _slot(self, now: float) -> int:
+        epoch = self._epoch(now)
+        slot = epoch % self.n_slices
+        if self._epochs[slot] != epoch:
+            self._zero(slot)
+            self._epochs[slot] = epoch
+        return slot
+
+    def live_slots(self, now: float) -> List[int]:
+        epoch = self._epoch(now)
+        lo = epoch - self.n_slices + 1
+        return [s for s in range(self.n_slices)
+                if lo <= self._epochs[s] <= epoch]
+
+    def _zero(self, slot: int) -> None:  # pragma: no cover — overridden
+        raise NotImplementedError
+
+
+class WindowedCounter(_Sliced):
+    """Float-valued sliding-window accumulator (rates, shares, error
+    counts). ``total()`` is the sum over the live window; ``rate()``
+    normalizes by the window span."""
+
+    def __init__(self, window_s: float = 10.0, n_slices: int = 5,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        super().__init__(window_s, n_slices, clock)
+        self._vals: List[float] = [0.0] * self.n_slices
+
+    def _zero(self, slot: int) -> None:
+        self._vals[slot] = 0.0
+
+    def add(self, v: float = 1.0) -> None:
+        self._vals[self._slot(self._clock())] += v
+
+    def total(self) -> float:
+        return sum(self._vals[s] for s in self.live_slots(self._clock()))
+
+    def rate(self) -> float:
+        return self.total() / self.window_s
+
+
+class WindowedLog2Histogram(_Sliced):
+    """Sliding-window log2 latency histogram: per-slice bucket arrays,
+    merged at snapshot time. Recording is one list-index increment in the
+    current slice — same hot-path cost discipline as the cumulative
+    ``LatencyHistogram``, plus one epoch check."""
+
+    def __init__(self, window_s: float = 10.0, n_slices: int = 5,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        super().__init__(window_s, n_slices, clock)
+        self._buckets: List[List[int]] = [[0] * N_BUCKETS
+                                          for _ in range(self.n_slices)]
+
+    def _zero(self, slot: int) -> None:
+        self._buckets[slot] = [0] * N_BUCKETS
+
+    def record(self, seconds: float) -> None:
+        self._buckets[self._slot(self._clock())][
+            bucket_index(seconds)] += 1
+
+    def merged(self) -> List[int]:
+        out = [0] * N_BUCKETS
+        for s in self.live_slots(self._clock()):
+            b = self._buckets[s]
+            for i in range(N_BUCKETS):
+                out[i] += b[i]
+        return out
+
+    @property
+    def count(self) -> int:
+        return sum(self.merged())
+
+    def percentile_ms(self, p: float,
+                      merged: Optional[List[int]] = None) -> float:
+        return percentile_ms_from(
+            merged if merged is not None else self.merged(), p)
+
+    def snapshot(self) -> Dict[str, float]:
+        b = self.merged()
+        return {"count": sum(b),
+                "p50_ms": self.percentile_ms(50, b),
+                "p99_ms": self.percentile_ms(99, b)}

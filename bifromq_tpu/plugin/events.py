@@ -154,6 +154,11 @@ class EventType(enum.Enum):
     MATCH_RETAIN_ERROR = "match_retain_error"
     # persistent-session inbox op failed transiently (≈ InboxTransientError)
     INBOX_TRANSIENT_ERROR = "inbox_transient_error"
+    # tenant SLO offenders (ISSUE 3, repo-specific): emitted by the
+    # noisy-neighbor detector when a tenant dominates fanout/queue-wait
+    # share or its windowed ingest p99 crosses the SLO threshold
+    NOISY_TENANT = "noisy_tenant"
+    SLOW_TENANT = "slow_tenant"
 
 
 @dataclass

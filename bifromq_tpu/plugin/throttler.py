@@ -33,3 +33,38 @@ class AllowAllResourceThrottler(IResourceThrottler):
     def has_resource(self, tenant_id: str,
                      rtype: TenantResourceType) -> bool:
         return True
+
+
+class SLOAdvisedResourceThrottler(IResourceThrottler):
+    """Throttler decorator fed by the SLO layer's noisy-neighbor advisory
+    (ISSUE 3): when the detector currently flags a tenant noisy, the
+    rate-class resources (ingress/egress bytes per second) are denied —
+    back-pressure lands on the tenant causing the contention, everything
+    else is delegated.
+
+    Advisory by default: ``enforce=False`` only counts the denials it
+    *would* have issued (``advised_denials``) so an operator can watch the
+    signal before arming it."""
+
+    RATE_TYPES = frozenset({
+        TenantResourceType.TOTAL_INGRESS_BYTES_PER_SECOND,
+        TenantResourceType.TOTAL_EGRESS_BYTES_PER_SECOND,
+    })
+
+    def __init__(self, delegate: IResourceThrottler = None, *,
+                 enforce: bool = False) -> None:
+        self.delegate = delegate or AllowAllResourceThrottler()
+        self.enforce = enforce
+        self.advised_denials = 0
+
+    def has_resource(self, tenant_id: str,
+                     rtype: TenantResourceType) -> bool:
+        if not self.delegate.has_resource(tenant_id, rtype):
+            return False
+        if rtype in self.RATE_TYPES:
+            from ..obs import OBS
+            if OBS.is_noisy(tenant_id):
+                self.advised_denials += 1
+                if self.enforce:
+                    return False
+        return True

@@ -20,6 +20,7 @@ from typing import (Awaitable, Callable, Dict, Generic, Hashable, List,
                     Optional, Sequence, Tuple, TypeVar)
 
 from .. import trace
+from ..obs import OBS
 from ..utils.hlc import HLC
 from ..utils.metrics import STAGES
 
@@ -53,7 +54,8 @@ class Batcher(Generic[CallT, ResultT]):
     def __init__(self, process_batch: BatchFn, *, pipeline_depth: int = 2,
                  max_burst_latency: float = 0.010, max_batch_size: int = 8192,
                  min_batch_size: int = 1,
-                 stage: Optional[str] = None) -> None:
+                 stage: Optional[str] = None,
+                 obs_key: Optional[str] = None) -> None:
         self._process = process_batch
         self._depth = pipeline_depth
         self._budget = max_burst_latency
@@ -65,6 +67,10 @@ class Batcher(Generic[CallT, ResultT]):
         # for sampled calls, deferred "batch.queue_wait" spans stamped
         # with batch size + the adaptive cap AT EMIT TIME
         self._stage = stage
+        # ISSUE 3: when the batcher key IS a tenant (the pub scheduler),
+        # queue-wait also lands in that tenant's SLO window — the
+        # noisy-neighbor detector's share-of-queue-wait signal
+        self._obs_key = obs_key
         # queue entries: (call, fut, enqueue_perf, trace_ctx, start_hlc)
         self._queue: List[Tuple[CallT, asyncio.Future, float,
                                 Optional[object], int]] = []
@@ -129,6 +135,9 @@ class Batcher(Generic[CallT, ResultT]):
             for _, _, enq, tctx, shlc in batch:
                 wait = start - enq
                 STAGES.record(self._stage, wait)
+                if self._obs_key is not None:
+                    OBS.record_queue_wait(self._obs_key, wait)
+                    OBS.record_latency(self._obs_key, "queue_wait", wait)
                 if tctx is not None:
                     if rep_ctx is None:
                         rep_ctx = tctx
@@ -181,14 +190,24 @@ class BatchCallScheduler(Generic[CallT, ResultT]):
             [Hashable], BatchFn], *, pipeline_depth: int = 2,
             max_burst_latency: float = 0.010,
             max_batch_size: int = 8192,
-            stage: Optional[str] = None) -> None:
+            stage: Optional[str] = None,
+            obs_tenant_key: bool = False) -> None:
         self._factory = process_batch_for_key
         self._depth = pipeline_depth
         self._budget = max_burst_latency
         self._max_batch = max_batch_size
         self._stage = stage
+        # ISSUE 3: EXPLICIT opt-in that this scheduler's batcher keys are
+        # tenant ids (the pub scheduler) — never inferred from ``stage``,
+        # so a future staged scheduler keyed by range/shard can't leak
+        # bogus rows into the tenant SLO registry
+        self._obs_tenant_key = obs_tenant_key
         self._batchers: Dict[Hashable, Batcher] = {}
         self.calls_seen = 0
+        if stage is not None:
+            # a staged scheduler fronts the device pipeline — expose its
+            # live queue depth through the "device" gauges
+            OBS.device.register_scheduler(self)
 
     def batcher(self, key: Hashable) -> Batcher:
         b = self._batchers.get(key)
@@ -196,7 +215,9 @@ class BatchCallScheduler(Generic[CallT, ResultT]):
             b = Batcher(self._factory(key), pipeline_depth=self._depth,
                         max_burst_latency=self._budget,
                         max_batch_size=self._max_batch,
-                        stage=self._stage)
+                        stage=self._stage,
+                        obs_key=str(key) if self._obs_tenant_key
+                        else None)
             self._batchers[key] = b
         return b
 

@@ -70,6 +70,7 @@ class Standalone:
         self.api = None
         self.agent_host = None
         self.rpc_server = None
+        self.metrics_registry = None
         self._isolated_hosts = []
 
     @staticmethod
@@ -234,6 +235,17 @@ class Standalone:
         # broker.start() below must not orphan plugin processes
         self._isolated_hosts = [
             v.host for v in plug.values() if hasattr(v, "host")]
+        # meter EVERY tenant-visible flow (ISSUE 3): the metering collector
+        # wraps whatever event collector the operator plugged in, feeding
+        # the per-tenant registry the API server serves at /metrics and
+        # the windowed SLO layer behind /tenants — without it a starter
+        # deployment scraped empty tenant counters
+        from .plugin.events import CollectingEventCollector
+        from .utils.metrics import MeteringEventCollector, MetricsRegistry
+        self.metrics_registry = MetricsRegistry()
+        plug["events"] = MeteringEventCollector(
+            self.metrics_registry,
+            plug.get("events") or CollectingEventCollector())
         self.broker = MQTTBroker(
             **plug,
             host=host, port=int(tcp.get("port", 1883)),
@@ -294,9 +306,8 @@ class Standalone:
         api_cfg = cfg.get("api")
         if api_cfg:
             from .apiserver.server import APIServer
-            from .utils.metrics import MetricsRegistry
             self.api = APIServer(self.broker,
-                                 metrics=MetricsRegistry(),
+                                 metrics=self.metrics_registry,
                                  host=host,
                                  port=int(api_cfg.get("port", 9090)),
                                  registry=registry)

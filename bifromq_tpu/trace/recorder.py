@@ -43,6 +43,20 @@ class SpanRing:
         out = self._slots[head:] + self._slots[:head]
         return [s for s in out if s is not None]
 
+    def since(self, cursor: int):
+        """Spans recorded after write-counter ``cursor`` (oldest first),
+        the new cursor, and how many were overwritten before they could be
+        read — the push exporter's incremental drain (ISSUE 3).
+
+        Returns ``(spans, new_cursor, missed)``."""
+        n = self._written
+        if cursor >= n:
+            return [], n, 0
+        missed = max(0, (n - cursor) - self.capacity)
+        fresh = self.spans()[-(n - cursor - missed):] if n > cursor + missed \
+            else []
+        return fresh, n, missed
+
     def clear(self) -> None:
         self._slots = [None] * self.capacity
         self._written = 0

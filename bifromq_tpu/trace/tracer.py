@@ -80,7 +80,7 @@ class _LiveSpan:
     ``Span`` into the tracer's ring on exit."""
 
     __slots__ = ("_tracer", "name", "ctx", "parent_id", "tags",
-                 "start_hlc", "_t0", "_token")
+                 "start_hlc", "_t0", "_token", "_ring_mark")
     sampled = True
 
     def __init__(self, tracer: "Tracer", name: str, trace_id: int,
@@ -93,6 +93,14 @@ class _LiveSpan:
 
     def __enter__(self) -> "_LiveSpan":
         self._token = _CTX.set(self.ctx)
+        # remember the ring write-counter (slow capture armed only): a
+        # slow finish then scans just the spans recorded during its own
+        # lifetime — its local descendants by construction — not the
+        # whole ring. Tracked for EVERY span, not only process-local
+        # roots: the server half of a cross-process trace has a remote
+        # parent id, and its slow spans must drag their children too.
+        self._ring_mark = (self._tracer.ring._written
+                           if self._tracer.slow_ms is not None else None)
         self.start_hlc = HLC.INST.get()
         self._t0 = time.perf_counter()
         return self
@@ -112,7 +120,7 @@ class _LiveSpan:
             start_hlc=self.start_hlc, end_hlc=HLC.INST.get(),
             duration_ms=duration * 1e3,
             status="error" if exc_type is not None else "ok",
-            tags=self.tags))
+            tags=self.tags), ring_mark=self._ring_mark)
         return False
 
 
@@ -209,10 +217,44 @@ class Tracer:
             end_hlc=HLC.INST.get(), duration_ms=duration_s * 1e3,
             status="ok", tags=tags or {}))
 
-    def _finish(self, span: Span) -> None:
+    # a slow ROOT drags at most this many of its children into the slow
+    # ring (ISSUE 3 satellite: /trace/slow returns the full slow trace,
+    # not just the root; bounded so one pathological fan-out can't flush
+    # the whole slow ring)
+    SLOW_CHILD_CAP = 32
+
+    def _finish(self, span: Span, ring_mark: Optional[int] = None) -> None:
         self.ring.record(span)
         if self.slow_ms is not None and span.duration_ms >= self.slow_ms:
             self.slow_ring.record(span)
+            if ring_mark is not None or span.parent_id == 0:
+                self._capture_slow_children(span, ring_mark)
+
+    def _capture_slow_children(self, slow: Span,
+                               ring_mark: Optional[int]) -> None:
+        """Copy a slow span's sampled local descendants from the main
+        ring into the slow ring (children finish before their parent, so
+        they are already recorded). Runs for any slow live span — local
+        roots AND spans whose parent lives in another process (the server
+        half of a cross-process trace). Children that were individually
+        slow are skipped — their own ``_finish`` already placed them.
+        ``ring_mark`` (the ring write-counter at span enter) bounds the
+        scan to spans recorded during the slow span's own lifetime, so
+        the cost tracks the trace's size, not the ring's. A fast span
+        under several nested slow ancestors may be copied more than once
+        — harmless for a ring, and the exporter dedupes by span id."""
+        if ring_mark is not None:
+            candidates, _, _ = self.ring.since(ring_mark)
+        else:               # deferred spans carry no mark: full scan
+            candidates = self.ring.spans()
+        copied = 0
+        for s in candidates:
+            if copied >= self.SLOW_CHILD_CAP:
+                break
+            if (s.trace_id == slow.trace_id and s.span_id != slow.span_id
+                    and s.duration_ms < self.slow_ms):
+                self.slow_ring.record(s)
+                copied += 1
 
     # ---------------- wire propagation -------------------------------------
 

@@ -17,6 +17,8 @@ import weakref
 from collections import defaultdict
 from typing import Callable, Dict, List, Tuple
 
+from ..obs import OBS
+from ..obs import window as _window
 from ..plugin.events import Event, EventType, IEventCollector
 
 
@@ -25,19 +27,17 @@ class LatencyHistogram:
     samples whose microsecond value has bit_length ``i`` (i.e. the
     [2^(i-1), 2^i) range), topping out around 2 minutes. Recording is one
     list-index increment — GIL-atomic, no lock on the hot path; percentile
-    extraction returns the bucket's upper edge (conservative)."""
+    extraction returns the bucket's upper edge (conservative). The bucket
+    math is shared with the windowed twin (``obs.window``) — one place
+    owns the discipline."""
 
-    N_BUCKETS = 28      # 2^27 µs ≈ 134 s
+    N_BUCKETS = _window.N_BUCKETS
 
     def __init__(self) -> None:
         self._buckets: List[int] = [0] * self.N_BUCKETS
 
     def record(self, seconds: float) -> None:
-        us = int(seconds * 1e6)
-        i = us.bit_length() if us > 0 else 0
-        if i >= self.N_BUCKETS:
-            i = self.N_BUCKETS - 1
-        self._buckets[i] += 1
+        self._buckets[_window.bucket_index(seconds)] += 1
 
     @property
     def count(self) -> int:
@@ -45,16 +45,7 @@ class LatencyHistogram:
 
     def percentile_ms(self, p: float) -> float:
         """Upper edge (ms) of the bucket containing the p-th percentile."""
-        total = sum(self._buckets)
-        if total == 0:
-            return 0.0
-        target = max(1, int(total * p / 100.0 + 0.5))
-        acc = 0
-        for i, c in enumerate(self._buckets):
-            acc += c
-            if acc >= target:
-                return (1 << i) / 1000.0
-        return (1 << (self.N_BUCKETS - 1)) / 1000.0
+        return _window.percentile_ms_from(self._buckets, p)
 
     def snapshot(self) -> Dict[str, float]:
         return {"count": self.count,
@@ -207,24 +198,54 @@ class MetricsRegistry:
     def get(self, tenant_id: str, metric: TenantMetric) -> int:
         return self._counters.get((tenant_id, metric.value), 0)
 
-    def snapshot(self) -> dict:
+    def tenant_counters(self, tenant: str) -> Dict[str, float]:
+        """One tenant's counters + evaluated gauges (the lean
+        ``GET /metrics?tenant=`` scrape and ``/tenants/<id>`` detail)."""
         with self._lock:
-            per_tenant: Dict[str, Dict[str, float]] = defaultdict(dict)
-            for (tenant, name), v in self._counters.items():
-                per_tenant[tenant][name] = v
-            for (tenant, name), fn in self._gauges.items():
-                try:
-                    per_tenant[tenant][name] = fn()
-                except Exception:  # noqa: BLE001
-                    pass
-            fabric = FABRIC.snapshot()
-            breakers = FABRIC.breaker_snapshot()
-            if breakers:
-                fabric["breakers"] = breakers
+            counters = {n: float(v) for (t, n), v in self._counters.items()
+                        if t == tenant}
+            gauges = {n: fn for (t, n), fn in self._gauges.items()
+                      if t == tenant}
+        for n, fn in gauges.items():
+            try:
+                counters[n] = fn()
+            except Exception:  # noqa: BLE001
+                pass
+        return counters
+
+    def snapshot(self, tenant: str = None) -> dict:
+        """The registry's part of the /metrics payload: per-tenant
+        counters/gauges plus the process fabric/stage sections. With
+        ``tenant`` set (ISSUE 3 satellite: ``GET /metrics?tenant=<id>``)
+        only that tenant ships. The API server composes the higher-level
+        "device"/"obs"/"slo" sections on top — this module stays below
+        the obs hub in the layering."""
+        if tenant is not None:
             return {"uptime_s": round(time.time() - self.started_at, 1),
-                    "tenants": dict(per_tenant),
-                    "fabric": fabric,
-                    "stages": STAGES.snapshot()}
+                    "tenants": {tenant: self.tenant_counters(tenant)}}
+        # copy the raw maps under the lock, assemble OUTSIDE it: gauge
+        # callables must never run while holding the lock every metered
+        # event's inc() takes — a wedged gauge would otherwise block the
+        # publish path behind a telemetry scrape
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+        per_tenant: Dict[str, Dict[str, float]] = defaultdict(dict)
+        for (t, name), v in counters.items():
+            per_tenant[t][name] = v
+        for (t, name), fn in gauges.items():
+            try:
+                per_tenant[t][name] = fn()
+            except Exception:  # noqa: BLE001
+                pass
+        fabric = FABRIC.snapshot()
+        breakers = FABRIC.breaker_snapshot()
+        if breakers:
+            fabric["breakers"] = breakers
+        return {"uptime_s": round(time.time() - self.started_at, 1),
+                "tenants": dict(per_tenant),
+                "fabric": fabric,
+                "stages": STAGES.snapshot()}
 
 
 _EVENT_TO_METRIC = {
@@ -250,17 +271,45 @@ _EVENT_TO_METRIC = {
 }
 
 
+# the error-classed subset feeding the windowed RED "E" (ISSUE 3)
+_ERROR_METRICS = frozenset({
+    TenantMetric.DELIVER_ERRORS,
+    TenantMetric.QOS_DROPPED,
+    TenantMetric.INBOX_OVERFLOW,
+})
+
+
 class MeteringEventCollector(IEventCollector):
-    """Event-collector decorator: meters events, then forwards downstream."""
+    """Event-collector decorator: meters events (monotonic registry +
+    windowed SLO layer), then forwards downstream."""
 
     def __init__(self, registry: MetricsRegistry,
                  downstream: IEventCollector = None) -> None:
         self.registry = registry
         self.downstream = downstream
+        # SLO wiring (ISSUE 3): offender events ride this same collector
+        # chain, and exporter snapshots can include the registry counters
+        OBS.bind_events(self)
+        OBS.bind_registry(registry)
 
     def report(self, event: Event) -> None:
         metric = _EVENT_TO_METRIC.get(event.type)
         if metric is not None:
-            self.registry.inc(event.tenant_id or "-", metric)
+            tenant = event.tenant_id or "-"
+            self.registry.inc(tenant, metric)
+            OBS.record_flow(tenant)
+            if metric in _ERROR_METRICS:
+                OBS.record_error(tenant)
         if self.downstream is not None:
             self.downstream.report(event)
+
+    # decorator transparency: code that inspects a collecting tail
+    # (``broker.events.events`` / ``.of(...)``) keeps working when the
+    # metering layer wraps the default CollectingEventCollector
+    @property
+    def events(self):
+        return getattr(self.downstream, "events", [])
+
+    def of(self, etype) -> list:
+        of_fn = getattr(self.downstream, "of", None)
+        return of_fn(etype) if of_fn is not None else []
