@@ -81,9 +81,26 @@ class DistService:
         self.deliverer_registry = None
         self.server_id = ""
         self._rng = random.Random(rng_seed)
-        # (tenant, topic) -> (tenant epoch, expiry, MatchedRoutes)
-        self._match_cache: Dict[Tuple[str, str], Tuple] = {}
-        self._tenant_epoch: Dict[str, int] = {}
+        # pub-side match cache (ISSUE 4: the shared TenantMatchCache, ≈
+        # SubscriptionCache/TenantRouteCache.java:65): matched routes per
+        # (tenant, topic) with filter-aware invalidation. The TTL bounds
+        # staleness from mutations applied on OTHER nodes when the worker
+        # is remote; with a local worker the coproc apply-stream hook
+        # below makes invalidation exact (replayed mutations included).
+        from ..models.matchcache import TenantMatchCache
+        from ..models.matcher import _match_cache_default
+        self._match_cache = TenantMatchCache(
+            scope="pub", ttl_s=self._MATCH_CACHE_TTL_DEFAULT,
+            max_topics_per_tenant=self.MATCH_CACHE_MAX,
+            max_entries=self.MATCH_CACHE_MAX)   # same TOTAL bound as the
+        # hand-rolled predecessor: TTL expiry is lazy, the bound is the
+        # memory wall
+        # BIFROMQ_MATCH_CACHE=0 is the kill-switch for the WHOLE cache
+        # plane: this pub layer bypasses lookups/stores too (the cache
+        # object stays constructed so invalidation plumbing is inert-safe)
+        self._pub_cache_enabled = _match_cache_default()
+        if hasattr(worker, "on_route_mutation"):
+            worker.on_route_mutation = self._on_route_mutation
         self._pub_scheduler: BatchCallScheduler[PubCall, PubResult] = \
             BatchCallScheduler(lambda tenant: self._make_pub_batch(tenant),
                                max_burst_latency=max_burst_latency,
@@ -149,7 +166,8 @@ class DistService:
                 if not ok:
                     await self.worker.remove_route(
                         tenant_id, r.matcher, r.receiver_url, r.incarnation)
-                    self._invalidate_tenant(tenant_id)
+                    self._match_cache.invalidate(tenant_id,
+                                                 r.matcher.filter_levels)
                     removed += 1
         return removed
 
@@ -170,7 +188,9 @@ class DistService:
             raise
         ok = out in ("ok", "exists")
         if ok:
-            self._invalidate_tenant(tenant_id)
+            # filter-aware (ISSUE 4): an exact filter evicts one topic
+            # key, a wildcard bumps the tenant epoch
+            self._match_cache.invalidate(tenant_id, matcher.filter_levels)
         self.events.report(Event(
             EventType.MATCHED if ok else EventType.MATCH_ERROR, tenant_id,
             {"filter": matcher.mqtt_topic_filter}
@@ -191,7 +211,7 @@ class DistService:
             raise
         ok = out == "ok"
         if ok:
-            self._invalidate_tenant(tenant_id)
+            self._match_cache.invalidate(tenant_id, matcher.filter_levels)
         self.events.report(Event(
             EventType.UNMATCHED if ok else EventType.UNMATCH_ERROR,
             tenant_id, {"filter": matcher.mqtt_topic_filter}
@@ -205,60 +225,69 @@ class DistService:
         call = PubCall(publisher=publisher, topic=topic, message=message)
         return await self._pub_scheduler.submit(publisher.tenant_id, call)
 
-    # pub-side match cache (≈ SubscriptionCache/TenantRouteCache.java:65:
-    # matched routes per (tenant, topic), invalidated by local route
-    # mutations via a per-tenant epoch; the TTL bounds staleness from
-    # mutations made on OTHER nodes, the reference's refresh window)
-    MATCH_CACHE_TTL = 1.0
+    # pub-side match cache knobs (see __init__): the TTL bounds staleness
+    # from mutations made on OTHER nodes, the reference's refresh window
+    _MATCH_CACHE_TTL_DEFAULT = 1.0
     MATCH_CACHE_MAX = 8192
 
-    def _cache_get(self, tenant_id: str, topic: str):
-        ent = self._match_cache.get((tenant_id, topic))
-        if ent is None:
-            return None
-        epoch, expires, m = ent
-        if (epoch != self._tenant_epoch.get(tenant_id, 0)
-                or expires < time.monotonic()):
-            del self._match_cache[(tenant_id, topic)]
-            return None
-        return m
+    @property
+    def MATCH_CACHE_TTL(self) -> float:
+        return self._match_cache.ttl_s
 
-    def _cache_put(self, tenant_id: str, topic: str, m,
-                   epoch: int) -> None:
-        """``epoch`` MUST be snapshotted BEFORE the match query was
-        issued: a mutation landing during the awaited match would
-        otherwise have its invalidation erased by stamping the stale
-        result with the post-bump epoch."""
-        key = (tenant_id, topic)
-        if key not in self._match_cache \
-                and len(self._match_cache) >= self.MATCH_CACHE_MAX:
-            # bounded: drop the oldest inserted entry (dict is FIFO)
-            self._match_cache.pop(next(iter(self._match_cache)))
-        self._match_cache[key] = (
-            epoch, time.monotonic() + self.MATCH_CACHE_TTL, m)
+    @MATCH_CACHE_TTL.setter
+    def MATCH_CACHE_TTL(self, value: float) -> None:
+        # a runtime knob, not a constructor snapshot: tests/operators set
+        # it on a live service (chaos suite pins 0.0 so every publish
+        # exercises the fabric)
+        self._match_cache.ttl_s = value
 
-    def _invalidate_tenant(self, tenant_id: str) -> None:
-        self._tenant_epoch[tenant_id] = \
-            self._tenant_epoch.get(tenant_id, 0) + 1
+    def _on_route_mutation(self, tenant_id, filter_levels) -> None:
+        """Apply-stream invalidation (ISSUE 4): fires for every route
+        mutation the local worker's coprocs apply — including mutations
+        REPLAYED from raft peers that never passed through this service's
+        match/unmatch — keeping the pub cache filter-aware-fresh without
+        waiting out the TTL."""
+        if tenant_id is None:
+            self._match_cache.bump_all()
+        else:
+            self._match_cache.invalidate(tenant_id, filter_levels)
 
     def _make_pub_batch(self, tenant_id: str):
         async def process(calls: Sequence[PubCall]) -> List[PubResult]:
             mpf = self.settings.provide(
                 Setting.MaxPersistentFanout, tenant_id)
+            if mpf is None:
+                mpf = Setting.MaxPersistentFanout.default
             mgf = self.settings.provide(Setting.MaxGroupFanout, tenant_id)
+            if mgf is None:
+                mgf = Setting.MaxGroupFanout.default
+            caps = (mpf, mgf)
             matched: List[Optional[MatchedRoutes]] = []
             miss_topics: List[str] = []     # deduped (hot-topic bursts
             miss_pos: Dict[str, int] = {}   # must not fan into N queries)
+            cache_on = self._pub_cache_enabled
+            n_miss_calls = 0
             for qi, c in enumerate(calls):
-                m = self._cache_get(tenant_id, c.topic)
+                m = (self._match_cache.get(tenant_id, c.topic, caps)
+                     if cache_on else None)
                 matched.append(m)
-                if m is None and c.topic not in miss_pos:
-                    miss_pos[c.topic] = len(miss_topics)
-                    miss_topics.append(c.topic)
+                if m is None:
+                    n_miss_calls += 1
+                    if c.topic not in miss_pos:
+                        miss_pos[c.topic] = len(miss_topics)
+                        miss_topics.append(c.topic)
+            if cache_on:
+                OBS.record_match_cache(tenant_id,
+                                       len(calls) - n_miss_calls,
+                                       n_miss_calls)
+                # global section totals: one locked inc per pub batch
+                from ..utils.metrics import MATCH_CACHE
+                MATCH_CACHE.inc("pub", "hits", len(calls) - n_miss_calls)
+                MATCH_CACHE.inc("pub", "misses", n_miss_calls)
             if miss_topics:
                 # snapshot BEFORE the (awaited) match: a mutation landing
                 # mid-flight must make the stored entry instantly stale
-                epoch = self._tenant_epoch.get(tenant_id, 0)
+                token = self._match_cache.token(tenant_id)
                 try:
                     fresh = await self._match_missing(
                         tenant_id, miss_topics, mpf, mgf)
@@ -269,8 +298,10 @@ class DistService:
                         EventType.DIST_ERROR, tenant_id,
                         {"topics": len(miss_topics)}))
                     raise
-                for t, m in zip(miss_topics, fresh):
-                    self._cache_put(tenant_id, t, m, epoch)
+                if cache_on:
+                    for t, m in zip(miss_topics, fresh):
+                        self._match_cache.put(tenant_id, t, caps, m,
+                                              token)
                 for qi, c in enumerate(calls):
                     if matched[qi] is None:
                         matched[qi] = fresh[miss_pos[c.topic]]
@@ -302,14 +333,10 @@ class DistService:
     async def _match_missing(self, tenant_id, miss_topics, mpf, mgf):
         from ..resilience.policy import deadline_scope
         with deadline_scope(self.MATCH_DEADLINE_S):
+            # caps arrive pre-resolved (they are also the cache key dims)
             return await self.worker.match_batch(
                 [(tenant_id, topic_util.parse(t)) for t in miss_topics],
-                max_persistent_fanout=(
-                    mpf if mpf is not None
-                    else Setting.MaxPersistentFanout.default),
-                max_group_fanout=(
-                    mgf if mgf is not None
-                    else Setting.MaxGroupFanout.default))
+                max_persistent_fanout=mpf, max_group_fanout=mgf)
 
     async def _fan_out(self, tenant_id: str, call: PubCall,
                        matched: MatchedRoutes) -> int:
@@ -427,7 +454,8 @@ class DistService:
                     await self.worker.remove_route(
                         tenant_id, route.matcher, route.receiver_url,
                         route.incarnation)
-                    self._invalidate_tenant(tenant_id)
+                    self._match_cache.invalidate(
+                        tenant_id, route.matcher.filter_levels)
         return fanout
 
     def _elect(self, mqtt_filter: str, members: List[Route],

@@ -185,6 +185,12 @@ class DistWorkerCoProc(IKVRangeCoProc):
     def __init__(self, matcher: Optional[TpuMatcher] = None) -> None:
         from ..kv.load import KVLoadRecorder
         self.matcher = matcher or TpuMatcher()
+        # ISSUE 4: apply-stream invalidation outlet — fires for EVERY
+        # applied route mutation (local proposals and raft-replicated
+        # ones alike) with (tenant_id, filter_levels); (None, None) means
+        # "everything changed" (reset-from-KV). DistWorker relays this to
+        # the frontend's pub-side match cache.
+        self.on_mutation = None
         # per-range load profile (≈ KVLoadRecorder + FanoutSplitHinter
         # food): mutates record the route key, matches record the tenant
         # prefix weighted by fan-out (see DistWorker.match_batch)
@@ -255,6 +261,7 @@ class DistWorkerCoProc(IKVRangeCoProc):
                 self._fact = ((min(f[0], key), max(f[1], key))
                               if f is not None else (key, key))
             self._fact_reader = reader
+            self._notify_mutation(tenant_id, route.matcher.filter_levels)
             return b"ok" if existing is None else b"exists"
         if op == _OP_REMOVE:
             existing = current(key)
@@ -270,8 +277,18 @@ class DistWorkerCoProc(IKVRangeCoProc):
             if self._fact is not None and key in self._fact:
                 self._fact_dirty = True     # span may shrink: lazy rescan
             self._fact_reader = reader
+            self._notify_mutation(tenant_id, route.matcher.filter_levels)
             return b"ok"
         return b"bad_op"
+
+    def _notify_mutation(self, tenant_id, filter_levels) -> None:
+        cb = self.on_mutation
+        if cb is not None:
+            try:
+                cb(tenant_id, filter_levels)
+            except Exception:  # noqa: BLE001 — cache upkeep must not
+                logging.getLogger(__name__).exception(  # poison the apply
+                    "route-mutation hook failed")
 
     def fact(self) -> Optional[Tuple[bytes, bytes]]:
         """The stored [first, last] route-key span, or None when empty."""
@@ -326,6 +343,9 @@ class DistWorkerCoProc(IKVRangeCoProc):
             tenant_id = _tenant_of_key(key)
             self.matcher.add_route(tenant_id,
                                    schema.decode_route(tenant_id, key, value))
+        # snapshot restore rewrote the world: wholesale invalidation
+        # upstream (the rebuilt matcher starts with an empty cache)
+        self._notify_mutation(None, None)
 
 
 class DistWorker:
@@ -366,10 +386,21 @@ class DistWorker:
         # derived matcher with the multi-device mesh plane instead of the
         # single-chip TpuMatcher (SURVEY §2.8 scale-out)
         self.matcher_factory = matcher_factory
+        # ISSUE 4: frontend invalidation outlet — every coproc relays its
+        # applied route mutations here (see DistWorkerCoProc.on_mutation);
+        # DistService subscribes its pub-side match cache, so mutations
+        # REPLAYED from raft peers invalidate it too, not just local calls
+        self.on_route_mutation = None
+
+        def _mk_coproc(rid):
+            cp = DistWorkerCoProc(matcher_factory() if matcher_factory
+                                  else None)
+            cp.on_mutation = self._relay_mutation
+            return cp
+
         self.store = KVRangeStore(
             node_id, self.transport, self.engine,
-            coproc_factory=lambda rid: DistWorkerCoProc(
-                matcher_factory() if matcher_factory else None),
+            coproc_factory=_mk_coproc,
             member_nodes=voters or [node_id],
             raft_store_factory=raft_store_factory,
             legacy_space="dist_routes")
@@ -398,6 +429,11 @@ class DistWorker:
             from ..kv.balance import KVStoreBalanceController
             self.balance_controller = KVStoreBalanceController(
                 self.store, balancers)
+
+    def _relay_mutation(self, tenant_id, filter_levels) -> None:
+        cb = self.on_route_mutation
+        if cb is not None:
+            cb(tenant_id, filter_levels)
 
     @property
     def matcher(self) -> TpuMatcher:
@@ -565,20 +601,35 @@ class DistWorker:
         failure-boundary discipline; ops/match.py already does this for
         bounded-work overflow)."""
         t0 = _time.perf_counter()
+        cache = getattr(coproc.matcher, "match_cache", None)
+        c0 = cache.counts() if cache is not None else (0, 0)
         try:
             get_injector().check_raise("matcher", "tpu-matcher", "match")
             if deadline is not None and _time.monotonic() >= deadline:
                 raise TimeoutError("match deadline budget exhausted")
             with trace.span("match.device", tenant=sub[0][0],
-                            n_queries=len(sub)):
+                            n_queries=len(sub)) as sp:
                 out = coproc.matcher.match_batch(
                     sub, max_persistent_fanout=max_persistent_fanout,
                     max_group_fanout=max_group_fanout)
+                if cache is not None and sp is not trace.NOOP:
+                    # ISSUE 4: cache disposition on the device span —
+                    # "hit" = the whole batch skipped the device,
+                    # "dedup" = misses collapsed into fewer walks. Only
+                    # computed for a RECORDED span: the O(n) dedup set is
+                    # not worth building for a no-op.
+                    hits = cache.counts()[0] - c0[0]
+                    misses = cache.counts()[1] - c0[1]
+                    dup = len(sub) - len(
+                        {(t, tuple(lv)) for t, lv in sub})
+                    sp.set_tag("cache",
+                               "hit" if misses == 0
+                               else ("dedup" if dup else "miss"))
+                    sp.set_tag("cache_hits", hits)
+                    sp.set_tag("cache_misses", misses)
             dt = _time.perf_counter() - t0
             STAGES.record("device", dt)
-            # ISSUE 3: device match time attributed to the (range-local)
-            # representative tenant's SLO window
-            OBS.record_latency(sub[0][0], "device", dt)
+            self._attribute_device_time(sub, dt)
             return out
         except Exception as e:  # noqa: BLE001 — degrade, don't fail
             oracle = getattr(coproc.matcher, "match_from_tries", None)
@@ -600,8 +651,22 @@ class DistWorker:
                              max_group_fanout=max_group_fanout)
             dt = _time.perf_counter() - t0
             STAGES.record("device", dt)
-            OBS.record_latency(sub[0][0], "device", dt)
+            self._attribute_device_time(sub, dt)
             return out
+
+    @staticmethod
+    def _attribute_device_time(sub, dt: float) -> None:
+        """Per-row tenant attribution of a range batch's device time
+        (ISSUE 4 satellite, closing the PR-3 follow-up): each tenant's SLO
+        window gets its row-count share of the batch instead of the whole
+        batch landing on the representative tenant — /tenants device
+        shares stay honest under mixed batches."""
+        counts: dict = {}
+        for tenant_id, _levels in sub:
+            counts[tenant_id] = counts.get(tenant_id, 0) + 1
+        n = len(sub)
+        for tenant_id, c in counts.items():
+            OBS.record_latency(tenant_id, "device", dt * c / n)
 
     async def match_batch(self, queries, *, max_persistent_fanout,
                           max_group_fanout, linearized: bool = False,

@@ -71,12 +71,19 @@ def _pad_rows(a: np.ndarray, rows: int, fill=0) -> np.ndarray:
 _TombKey = Tuple[str, Tuple[int, str, str]]
 
 
+def _match_cache_default() -> bool:
+    import os
+    return os.environ.get("BIFROMQ_MATCH_CACHE", "1").lower() \
+        not in ("0", "off", "false")
+
+
 class TpuMatcher:
     def __init__(self, *, max_levels: int = 16, k_states: int = 32,
                  probe_len: int = 16, device=None,
                  auto_compact: bool = True,
                  compact_threshold: int = 2048,
-                 max_intervals: int = 32) -> None:
+                 max_intervals: int = 32,
+                 match_cache: Optional[bool] = None) -> None:
         self.max_levels = max_levels
         self.k_states = k_states
         self.probe_len = probe_len
@@ -98,6 +105,15 @@ class TpuMatcher:
         # TenantRouteCache bet); survives recompiles, cleared on salt change
         from .automaton import TokenCache
         self._tok_cache = TokenCache()
+        # ISSUE 4 tentpole: match-RESULT cache plane in front of the device
+        # walk — a repeated (tenant, topic) is a dict probe, not a
+        # dispatch. Filter-aware invalidation lives in add/remove_route;
+        # base rebuilds bump the generation (_install_base).
+        if match_cache is None:
+            match_cache = _match_cache_default()
+        from .matchcache import TenantMatchCache
+        self.match_cache = (TenantMatchCache(scope="matcher")
+                            if match_cache else None)
         # mutation log since the shadow copy last synced; shadow is the
         # frozen snapshot source for off-thread compiles
         self._log: List[Tuple] = []
@@ -119,7 +135,24 @@ class TpuMatcher:
                           probe_len=self.probe_len, device=self.device,
                           auto_compact=self.auto_compact,
                           compact_threshold=self.compact_threshold,
-                          max_intervals=self.max_intervals)
+                          max_intervals=self.max_intervals,
+                          match_cache=self.match_cache is not None)
+
+    @classmethod
+    def from_tries(cls, tries: Dict[str, SubscriptionTrie],
+                   **kwargs) -> "TpuMatcher":
+        """Seed a matcher from pre-built tries WITHOUT replaying every
+        route through the mutation log/overlay (bench + tier-2 gate bulk
+        loads). The trie objects are SHARED between authoritative and
+        shadow state: later add/remove_route traffic stays correct (the
+        shadow replay re-applies each op idempotently), but the compile
+        thread then reads live tries — serve-only or serially-mutating
+        workloads only."""
+        m = cls(**kwargs)
+        m.tries = tries
+        m._shadow = tries
+        m.refresh()
+        return m
 
     # ---------------- mutation side (≈ batchAddRoute/batchRemoveRoute) -----
 
@@ -131,6 +164,11 @@ class TpuMatcher:
         op = ("add", tenant_id, route)
         self._log.append(op)
         self._overlay_record(op)
+        if self.match_cache is not None:
+            # filter-aware (ISSUE 4): exact filters evict one topic key,
+            # wildcard filters bump the tenant epoch
+            self.match_cache.invalidate(tenant_id,
+                                        route.matcher.filter_levels)
         self._maybe_compact()
         return created
 
@@ -147,6 +185,8 @@ class TpuMatcher:
         op = ("rm", tenant_id, matcher, receiver_url, incarnation)
         self._log.append(op)
         self._overlay_record(op)
+        if self.match_cache is not None:
+            self.match_cache.invalidate(tenant_id, matcher.filter_levels)
         self._maybe_compact()
         return True
 
@@ -246,6 +286,12 @@ class TpuMatcher:
         self._overlay_n = 0
         for op in self._log:
             self._overlay_record(op)
+        # ISSUE 4: a base rebuild (overlay compaction / salt-change
+        # recompile) invalidates every tenant's cached results wholesale —
+        # serving stays exact either way, this is the conservative mirror
+        # of the reference's refresh-on-rebuild discipline
+        if self.match_cache is not None:
+            self.match_cache.bump_all()
 
     def _maybe_compact(self, force: bool = False) -> None:
         # trigger on the FIRST mutation too (base is None): the first base
@@ -315,7 +361,77 @@ class TpuMatcher:
     def match_batch(self, queries: Sequence[Tuple[str, Sequence[str]]],
                     *, max_persistent_fanout: int = UNCAPPED_FANOUT,
                     max_group_fanout: int = UNCAPPED_FANOUT,
-                    batch: Optional[int] = None) -> List[MatchedRoutes]:
+                    batch: Optional[int] = None,
+                    **device_kw) -> List[MatchedRoutes]:
+        """The cache-plane front-end (ISSUE 4, ≈ SubscriptionCache.get →
+        TenantRouteCache): per-query cache probe, then in-batch dedup so N
+        identical (tenant, topic) rows walk ONCE — only the unique misses
+        reach ``_match_batch_device``, so hits also shrink the padded
+        device batch. Cached/fanned-out results are shared objects and
+        must be treated read-only by callers (the established contract of
+        the dist pub cache)."""
+        if not queries:
+            return []
+        cache = self.match_cache
+        if cache is None:
+            return self._match_batch_device(
+                queries, max_persistent_fanout=max_persistent_fanout,
+                max_group_fanout=max_group_fanout, batch=batch, **device_kw)
+        # fold any finished background compaction in BEFORE probing: its
+        # generation bump must land before this batch's token snapshots,
+        # not mid-walk (which would refuse every put of the batch)
+        self._apply_pending_swap()
+        caps = (max_persistent_fanout, max_group_fanout)
+        out: List[Optional[MatchedRoutes]] = [None] * len(queries)
+        uniq: Dict[Tuple[str, Tuple[str, ...]], int] = {}
+        uniq_queries: List[Tuple[str, Sequence[str]]] = []
+        miss_rows: List[Tuple[int, int]] = []   # (query idx, unique pos)
+        for qi, (tenant_id, levels) in enumerate(queries):
+            key = tuple(levels)
+            m = cache.get(tenant_id, key, caps)
+            if m is not None:
+                out[qi] = m
+                continue
+            uk = (tenant_id, key)
+            pos = uniq.get(uk)
+            if pos is None:
+                pos = uniq[uk] = len(uniq_queries)
+                uniq_queries.append((tenant_id, levels))
+            miss_rows.append((qi, pos))
+        if uniq_queries:
+            # snapshot invalidation tokens BEFORE the walk: this path is
+            # synchronous, but the discipline has ONE definition — a
+            # mutation landing mid-match must defeat the store (the dist
+            # service's awaited path genuinely races)
+            tokens = {t: cache.token(t)
+                      for t in {q[0] for q in uniq_queries}}
+            res = self._match_batch_device(
+                uniq_queries, max_persistent_fanout=max_persistent_fanout,
+                max_group_fanout=max_group_fanout, batch=batch, **device_kw)
+            for (tenant_id, key), pos in uniq.items():
+                cache.put(tenant_id, key, caps, res[pos],
+                          tokens[tenant_id])
+            for qi, pos in miss_rows:
+                out[qi] = res[pos]
+        # global section totals: ONE locked inc per batch, not per row.
+        # Per-tenant OBS hit rates are fed by the PUB plane alone
+        # (dist/service.py) — recording both planes into one window made
+        # the /tenants number interpretable as neither.
+        from ..utils.metrics import MATCH_CACHE
+        MATCH_CACHE.inc(cache.scope, "hits",
+                        len(queries) - len(miss_rows))
+        MATCH_CACHE.inc(cache.scope, "misses", len(miss_rows))
+        if uniq_queries:
+            MATCH_CACHE.record_dedup(len(uniq_queries),
+                                     len(miss_rows) - len(uniq_queries))
+        return out
+
+    def _match_batch_device(self, queries: Sequence[Tuple[str,
+                                                          Sequence[str]]],
+                            *, max_persistent_fanout: int = UNCAPPED_FANOUT,
+                            max_group_fanout: int = UNCAPPED_FANOUT,
+                            batch: Optional[int] = None
+                            ) -> List[MatchedRoutes]:
         """Match (tenant_id, topic_levels) pairs; returns per-query routes.
 
         Exact at every instant: base walk ⊕ overlay ⊖ tombstones equals a

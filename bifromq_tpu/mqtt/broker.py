@@ -748,6 +748,18 @@ class MQTTBroker:
         # ref releases one at stop.
         from ..obs import OBS
         self._obs_exporter_ref = OBS.start_exporter()
+        # ISSUE 4 satellite: an armed SLO-advised throttler gets its flag
+        # set refreshed on a background tick, so the connect/publish guard
+        # path (has_resource) never pays a detector evaluation
+        from ..plugin.throttler import SLOAdvisedResourceThrottler
+        self._obs_tick_ref = False
+        t = self.throttler
+        while t is not None:
+            if isinstance(t, SLOAdvisedResourceThrottler):
+                OBS.start_advisory_tick()
+                self._obs_tick_ref = True
+                break
+            t = getattr(t, "delegate", None)
 
     async def _redirect_sweep(self, interval: float) -> None:
         """Periodic IClientBalancer re-check on LIVE sessions (≈ the
@@ -844,6 +856,10 @@ class MQTTBroker:
             self._obs_exporter_ref = False
             from ..obs import OBS
             await OBS.stop_exporter()
+        if getattr(self, "_obs_tick_ref", False):
+            self._obs_tick_ref = False
+            from ..obs import OBS
+            await OBS.stop_advisory_tick()
 
     def _admit_connection(self) -> Optional[EventType]:
         """Frontend admission stage (≈ ConnectionRateLimitHandler +

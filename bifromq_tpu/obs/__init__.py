@@ -68,6 +68,11 @@ class ObsHub:
         self.exporter: Optional[TelemetryExporter] = None
         self._exporter_refs = 0
         self._registry_ref = None       # weakref to a MetricsRegistry
+        # throttler-advisory background refresh (ISSUE 4 satellite): when
+        # armed, the detector's flag set refreshes on this tick instead of
+        # lazily on the connect/publish guard path
+        self._advisory_task = None
+        self._advisory_refs = 0
 
     # ---------------- hot-path recording -----------------------------------
 
@@ -91,6 +96,13 @@ class ObsHub:
                        seconds: float) -> None:
         if self.enabled:
             self.windows.record_latency(tenant, stage, seconds)
+
+    def record_match_cache(self, tenant: str, hits: int,
+                           misses: int) -> None:
+        """Match-result cache lookups (ISSUE 4): feeds the per-tenant hit
+        rate in ``GET /tenants``."""
+        if self.enabled and (hits or misses):
+            self.windows.record_match_cache(tenant, hits, misses)
 
     # ---------------- wiring ------------------------------------------------
 
@@ -192,9 +204,56 @@ class ObsHub:
             self._exporter_refs = 0
             await exp.stop()
 
+    # ---------------- throttler-advisory tick (ISSUE 4 satellite) ----------
+
+    def start_advisory_tick(self,
+                            interval_s: Optional[float] = None) -> None:
+        """Refcounted background flag refresh: arming a
+        ``SLOAdvisedResourceThrottler`` on a max-tenant deployment must not
+        pay a full detector evaluation on the publish/connect guard path —
+        the tick evaluates off-path and ``is_noisy`` becomes a set probe."""
+        import asyncio
+
+        self._advisory_refs += 1
+        if self._advisory_task is not None:
+            return
+        interval = interval_s or self.detector.advisory_ttl_s
+        self.detector.tick_armed = True
+
+        async def loop() -> None:
+            while True:
+                await asyncio.sleep(interval)
+                try:
+                    # evaluate even with the window layer disabled: the
+                    # decayed (or empty) windows then CLEAR stale noisy
+                    # flags instead of freezing them — ObsHub.is_noisy
+                    # short-circuits on enabled, but the flag set must
+                    # not go stale for a later re-enable
+                    self.detector.evaluate(emit=False)
+                except Exception:  # noqa: BLE001 — telemetry must not die
+                    import logging
+                    logging.getLogger(__name__).exception("advisory tick")
+
+        self._advisory_task = asyncio.get_event_loop().create_task(loop())
+
+    async def stop_advisory_tick(self) -> None:
+        if self._advisory_task is None:
+            return
+        self._advisory_refs -= 1
+        if self._advisory_refs > 0:
+            return
+        task, self._advisory_task = self._advisory_task, None
+        self._advisory_refs = 0
+        self.detector.tick_armed = False
+        task.cancel()
+        try:
+            await task
+        except BaseException:  # noqa: BLE001 — cancellation
+            pass
+
     def reset(self) -> None:
-        """Test isolation: drop all windows/flags/gauges (exporter left to
-        its owner)."""
+        """Test isolation: drop all windows/flags/gauges (exporter and
+        advisory tick left to their owners)."""
         self.windows.reset()
         self.detector.reset()
         self.device.reset()

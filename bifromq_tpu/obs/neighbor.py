@@ -56,6 +56,10 @@ class NoisyNeighborDetector:
         self._noisy: Set[str] = set()
         self._flags_at = -1e18
         self.advisory_ttl_s = 1.0
+        # ISSUE 4 satellite: with a background refresh armed
+        # (ObsHub.start_advisory_tick), is_noisy skips the lazy TTL
+        # evaluation entirely — the guard path is a set probe
+        self.tick_armed = False
 
     # ---------------- scoring ----------------------------------------------
 
@@ -161,10 +165,13 @@ class NoisyNeighborDetector:
     # ---------------- throttler advisory ------------------------------------
 
     def is_noisy(self, tenant: str) -> bool:
-        """Advisory lookup for the resource throttler: refreshes the flag
-        set lazily (bounded by ``advisory_ttl_s``) so the guard path never
-        pays a full evaluation per call."""
-        if self._clock() - self._flags_at > self.advisory_ttl_s:
+        """Advisory lookup for the resource throttler. With the background
+        tick armed (ObsHub.start_advisory_tick) this is a pure set probe —
+        zero added guard-path latency; otherwise the flag set refreshes
+        lazily (bounded by ``advisory_ttl_s``), one full evaluation per
+        TTL window at most."""
+        if (not self.tick_armed
+                and self._clock() - self._flags_at > self.advisory_ttl_s):
             self.evaluate(emit=False)
         return tenant in self._noisy
 

@@ -31,14 +31,18 @@ from .window import WindowedCounter, WindowedLog2Histogram
 class _TenantWindows:
     """One tenant's live RED state."""
 
-    __slots__ = ("flows", "errors", "fanout", "queue_wait_s", "stages",
-                 "_mk_hist")
+    __slots__ = ("flows", "errors", "fanout", "queue_wait_s",
+                 "cache_hits", "cache_misses", "stages", "_mk_hist")
 
     def __init__(self, mk_counter, mk_hist) -> None:
         self.flows = mk_counter()
         self.errors = mk_counter()
         self.fanout = mk_counter()
         self.queue_wait_s = mk_counter()
+        # match-result cache lookups (ISSUE 4): per-tenant hit rate for
+        # GET /tenants
+        self.cache_hits = mk_counter()
+        self.cache_misses = mk_counter()
         self.stages: Dict[str, WindowedLog2Histogram] = {}
         self._mk_hist = mk_hist
 
@@ -100,6 +104,14 @@ class TenantSLO:
     def record_queue_wait(self, tenant: str, seconds: float) -> None:
         self._windows(tenant).queue_wait_s.add(seconds)
 
+    def record_match_cache(self, tenant: str, hits: float,
+                           misses: float) -> None:
+        w = self._windows(tenant)
+        if hits:
+            w.cache_hits.add(hits)
+        if misses:
+            w.cache_misses.add(misses)
+
     def record_latency(self, tenant: str, stage: str,
                        seconds: float) -> None:
         self._windows(tenant).stage(stage).record(seconds)
@@ -120,12 +132,16 @@ class TenantSLO:
             s = h.snapshot()        # ONE merge per histogram
             if s["count"]:
                 stages[name] = s
+        cache_hits = w.cache_hits.total()
+        cache_lookups = cache_hits + w.cache_misses.total()
         return {
             "rate_per_s": round(flows / self.window_s, 3),
             "errors_per_s": round(errors / self.window_s, 3),
             "error_rate": round(errors / flows, 4) if flows else 0.0,
             "fanout_per_s": round(w.fanout.total() / self.window_s, 3),
             "queue_wait_s": round(w.queue_wait_s.total(), 6),
+            "match_cache_hit_rate": (round(cache_hits / cache_lookups, 4)
+                                     if cache_lookups else 0.0),
             "stages": stages,
         }
 

@@ -272,11 +272,13 @@ class MeshMatcher(TpuMatcher):
                  mesh: Optional[Mesh] = None, *,
                  max_levels: int = 16, probe_len: int = 16,
                  k_states: int = 32, auto_compact: bool = True,
-                 compact_threshold: int = 2048) -> None:
+                 compact_threshold: int = 2048,
+                 match_cache: Optional[bool] = None) -> None:
         assert mesh is not None, "MeshMatcher requires a mesh"
         super().__init__(max_levels=max_levels, k_states=k_states,
                          probe_len=probe_len, auto_compact=auto_compact,
-                         compact_threshold=compact_threshold)
+                         compact_threshold=compact_threshold,
+                         match_cache=match_cache)
         self.mesh = mesh
         self.n_replicas = mesh.shape[REPLICA_AXIS]
         self.n_shards = mesh.shape[SHARD_AXIS]
@@ -305,7 +307,8 @@ class MeshMatcher(TpuMatcher):
         return MeshMatcher(mesh=self.mesh, max_levels=self.max_levels,
                            probe_len=self.probe_len, k_states=self.k_states,
                            auto_compact=self.auto_compact,
-                           compact_threshold=self.compact_threshold)
+                           compact_threshold=self.compact_threshold,
+                           match_cache=self.match_cache is not None)
 
     # ---------------- compile target: sharded tables on the mesh -----------
 
@@ -357,14 +360,17 @@ class MeshMatcher(TpuMatcher):
 
     # ---------------- query side -------------------------------------------
 
-    def match_batch(self, queries: Sequence[Tuple[str, Sequence[str]]],
-                    *, max_persistent_fanout: int = UNCAPPED_FANOUT,
-                    max_group_fanout: int = UNCAPPED_FANOUT,
-                    batch: Optional[int] = None,
-                    per_device_batch: Optional[int] = None
-                    ) -> List[MatchedRoutes]:
+    def _match_batch_device(self, queries: Sequence[Tuple[str,
+                                                          Sequence[str]]],
+                            *, max_persistent_fanout: int = UNCAPPED_FANOUT,
+                            max_group_fanout: int = UNCAPPED_FANOUT,
+                            batch: Optional[int] = None,
+                            per_device_batch: Optional[int] = None
+                            ) -> List[MatchedRoutes]:
         """Match (tenant, topic_levels) pairs across the mesh; exact at
-        every instant (base walk ⊕ overlay ⊖ tombstones) like TpuMatcher."""
+        every instant (base walk ⊕ overlay ⊖ tombstones) like TpuMatcher.
+        The cache/dedup front-end (TpuMatcher.match_batch, ISSUE 4) is
+        inherited — only the device plane differs."""
         if not queries:
             return []
         self._apply_pending_swap()

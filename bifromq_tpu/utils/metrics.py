@@ -179,6 +179,60 @@ class FabricMetrics:
 FABRIC = FabricMetrics()
 
 
+class MatchCacheMetrics:
+    """Process-global counters for the match-result cache plane (ISSUE 4):
+    hits/misses/evictions/epoch-bumps per scope (``"matcher"`` = the
+    per-range TpuMatcher caches, ``"pub"`` = the dist service's frontend
+    cache) plus the in-batch dedup tally. Served under ``/metrics``
+    ``"match_cache"`` and printed by ``bench.py`` next to the stage
+    breakdown. Thread-safe: range matchers may serve from coproc appliers
+    while the pub cache runs on the loop."""
+
+    _FIELDS = ("hits", "misses", "evictions", "epoch_bumps")
+
+    def __init__(self) -> None:
+        self._scopes: Dict[str, Dict[str, int]] = {}
+        self._lock = threading.Lock()
+        self.dedup_walked = 0      # unique rows actually dispatched
+        self.dedup_saved = 0       # duplicate rows served by fan-out
+
+    def inc(self, scope: str, field: str, n: int = 1) -> None:
+        with self._lock:
+            s = self._scopes.setdefault(scope, dict.fromkeys(self._FIELDS, 0))
+            s[field] += n
+
+    def record_dedup(self, walked: int, saved: int) -> None:
+        with self._lock:
+            self.dedup_walked += walked
+            self.dedup_saved += saved
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {}
+            for scope, s in self._scopes.items():
+                lookups = s["hits"] + s["misses"]
+                out[scope] = dict(s)
+                out[scope]["hit_rate"] = (round(s["hits"] / lookups, 4)
+                                          if lookups else 0.0)
+            rows = self.dedup_walked + self.dedup_saved
+            out["dedup"] = {
+                "walked": self.dedup_walked,
+                "saved": self.dedup_saved,
+                "ratio": round(self.dedup_saved / rows, 4) if rows else 0.0,
+            }
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._scopes.clear()
+            self.dedup_walked = 0
+            self.dedup_saved = 0
+
+
+# the process-global instance every TenantMatchCache reports into
+MATCH_CACHE = MatchCacheMetrics()
+
+
 class MetricsRegistry:
     def __init__(self) -> None:
         self._counters: Dict[Tuple[str, str], int] = defaultdict(int)
@@ -245,7 +299,8 @@ class MetricsRegistry:
         return {"uptime_s": round(time.time() - self.started_at, 1),
                 "tenants": dict(per_tenant),
                 "fabric": fabric,
-                "stages": STAGES.snapshot()}
+                "stages": STAGES.snapshot(),
+                "match_cache": MATCH_CACHE.snapshot()}
 
 
 _EVENT_TO_METRIC = {

@@ -1,0 +1,440 @@
+"""Match-result cache plane (ISSUE 4): per-tenant LRU + filter-aware
+invalidation + in-batch dedup in front of the device walk, the pub-side
+cache riding the same class, and the apply-stream invalidation hook.
+
+The centerpiece is the randomized mutation/query interleaving gate: with
+the cache ON, every match result must stay bit-identical to the host
+oracle at every step — no stale result may survive a mutation."""
+
+import random
+
+import pytest
+
+from bifromq_tpu.models.matchcache import (TenantMatchCache,
+                                           filter_is_wildcard)
+from bifromq_tpu.models.matcher import TpuMatcher
+from bifromq_tpu.models.oracle import Route
+from bifromq_tpu.types import RouteMatcher
+from bifromq_tpu.utils.metrics import MATCH_CACHE
+
+UNCAPPED = (2 ** 31 - 1, 2 ** 31 - 1)
+
+
+def mk_route(tf, receiver, inc=0, broker=0):
+    return Route(matcher=RouteMatcher.from_topic_filter(tf), broker_id=broker,
+                 receiver_id=receiver, deliverer_key="d0", incarnation=inc)
+
+
+def assert_same(matched, oracle_matched, ctx=""):
+    got = sorted((r.matcher.mqtt_topic_filter, r.receiver_url)
+                 for r in matched.normal)
+    want = sorted((r.matcher.mqtt_topic_filter, r.receiver_url)
+                  for r in oracle_matched.normal)
+    assert got == want, f"normal mismatch {ctx}: {got} != {want}"
+    got_g = {f: sorted(r.receiver_url for r in ms)
+             for f, ms in matched.groups.items()}
+    want_g = {f: sorted(r.receiver_url for r in ms)
+              for f, ms in oracle_matched.groups.items()}
+    assert got_g == want_g, f"group mismatch {ctx}"
+
+
+class TestTenantMatchCache:
+    def test_put_get_and_lru_eviction(self):
+        c = TenantMatchCache(max_topics_per_tenant=4)
+        for i in range(4):
+            c.put("T", ("t", str(i)), UNCAPPED, f"m{i}", c.token("T"))
+        # touch topic 0 so it is the most recently used
+        assert c.get("T", ("t", "0"), UNCAPPED) == "m0"
+        c.put("T", ("t", "4"), UNCAPPED, "m4", c.token("T"))
+        # the sweep dropped the oldest entries, not the refreshed one
+        assert c.get("T", ("t", "0"), UNCAPPED) == "m0"
+        assert c.get("T", ("t", "4"), UNCAPPED) == "m4"
+        assert c.evictions > 0
+
+    def test_total_entry_bound_across_tenants(self):
+        """max_entries caps the WHOLE cache, not just each tenant: N
+        tenants x M topics must never exceed it (the pub cache's memory
+        bound — TTL expiry is lazy, so the bound is the only wall)."""
+        c = TenantMatchCache(max_entries=16, max_topics_per_tenant=100)
+        for t in range(8):
+            for i in range(4):
+                c.put(f"T{t}", ("x", str(i)), UNCAPPED, "m",
+                      c.token(f"T{t}"))
+        assert len(c) <= 16
+        assert c.evictions > 0
+        # a single over-budget tenant is bounded too
+        c2 = TenantMatchCache(max_entries=8, max_topics_per_tenant=100)
+        for i in range(20):
+            c2.put("T", ("x", str(i)), UNCAPPED, "m", c2.token("T"))
+        assert len(c2) <= 8
+
+    def test_slot_recreation_never_aliases_inflight_token(self):
+        """A tenant slot evicted by the cardinality bound and recreated
+        must not reproduce an in-flight token's (gen, epoch, seq): every
+        seq is a unique draw, so the stale put is refused."""
+        c = TenantMatchCache(max_tenants=2)
+        for _ in range(3):      # burn exact-filter seq bumps on A
+            c.token("A")
+            c.invalidate("A", ["a", "b"])
+        token = c.token("A")    # in-flight match snapshot
+        c.token("B")
+        c.token("C")            # churn evicts A's slot
+        assert "A" not in c._slots
+        c.invalidate("A", ["a", "b"])   # the mutation the put must lose to
+        # recreate A's slot through other traffic, then try the stale put
+        c.token("A")
+        assert not c.put("A", ("a", "b"), UNCAPPED, "stale", token)
+        assert c.get("A", ("a", "b"), UNCAPPED) is None
+
+    def test_tenant_cardinality_bound(self):
+        c = TenantMatchCache(max_tenants=2)
+        for t in ("A", "B", "C"):
+            c.put(t, ("x",), UNCAPPED, t, c.token(t))
+        assert c.get("A", ("x",), UNCAPPED) is None  # oldest dropped
+        assert c.get("C", ("x",), UNCAPPED) == "C"
+
+    def test_exact_filter_evicts_one_topic_both_key_forms(self):
+        c = TenantMatchCache()
+        c.put("T", ("a", "b"), UNCAPPED, "tuple-key", c.token("T"))
+        c.put("T", "a/b", UNCAPPED, "string-key", c.token("T"))
+        c.put("T", ("a", "c"), UNCAPPED, "other", c.token("T"))
+        c.invalidate("T", ["a", "b"])
+        assert c.get("T", ("a", "b"), UNCAPPED) is None
+        assert c.get("T", "a/b", UNCAPPED) is None
+        assert c.get("T", ("a", "c"), UNCAPPED) == "other"
+
+    def test_wildcard_filter_bumps_tenant_epoch(self):
+        c = TenantMatchCache()
+        c.put("T", ("a", "b"), UNCAPPED, "m1", c.token("T"))
+        c.put("U", ("a", "b"), UNCAPPED, "m2", c.token("U"))
+        assert filter_is_wildcard(["a", "+"])
+        c.invalidate("T", ["a", "+"])
+        assert c.get("T", ("a", "b"), UNCAPPED) is None
+        assert c.get("U", ("a", "b"), UNCAPPED) == "m2"  # other tenant kept
+        assert c.epoch_bumps == 1
+
+    def test_bump_all_invalidates_every_tenant(self):
+        c = TenantMatchCache()
+        c.put("T", ("x",), UNCAPPED, "m", c.token("T"))
+        c.put("U", ("x",), UNCAPPED, "m", c.token("U"))
+        c.bump_all()
+        assert c.get("T", ("x",), UNCAPPED) is None
+        assert c.get("U", ("x",), UNCAPPED) is None
+
+    def test_ttl_expiry_with_injected_clock(self):
+        now = [0.0]
+        c = TenantMatchCache(ttl_s=1.0, clock=lambda: now[0])
+        c.put("T", ("x",), UNCAPPED, "m", c.token("T"))
+        assert c.get("T", ("x",), UNCAPPED) == "m"
+        now[0] = 1.5
+        assert c.get("T", ("x",), UNCAPPED) is None
+
+    def test_ttl_zero_disables_serving_and_is_live(self):
+        """ttl_s is a LIVE knob (the chaos suite pins 0.0 on a running
+        service so every publish exercises the fabric)."""
+        c = TenantMatchCache(ttl_s=None)
+        c.put("T", ("x",), UNCAPPED, "m", c.token("T"))
+        assert c.get("T", ("x",), UNCAPPED) == "m"
+        c.ttl_s = 0.0
+        c.put("T", ("x",), UNCAPPED, "m", c.token("T"))
+        assert c.get("T", ("x",), UNCAPPED) is None
+
+    def test_caps_are_part_of_the_key(self):
+        c = TenantMatchCache()
+        c.put("T", ("x",), (10, 10), "capped", c.token("T"))
+        assert c.get("T", ("x",), (20, 20)) is None
+        c.put("T", ("x",), (20, 20), "wider", c.token("T"))
+        assert c.get("T", ("x",), (20, 20)) == "wider"
+
+    def test_mutation_during_flight_defeats_put(self):
+        """The epoch-snapshot discipline: an invalidation landing between
+        token() and put() must refuse the (stale) store — for BOTH the
+        wholesale and the exact-filter form."""
+        c = TenantMatchCache()
+        token = c.token("T")
+        c.invalidate("T", ["a", "+"])           # wildcard mid-flight
+        assert not c.put("T", ("a", "b"), UNCAPPED, "stale", token)
+        assert c.get("T", ("a", "b"), UNCAPPED) is None
+        token = c.token("T")
+        c.invalidate("T", ["a", "b"])           # exact mid-flight
+        assert not c.put("T", ("a", "b"), UNCAPPED, "stale", token)
+        assert c.get("T", ("a", "b"), UNCAPPED) is None
+        # and a clean round-trip still stores
+        token = c.token("T")
+        assert c.put("T", ("a", "b"), UNCAPPED, "fresh", token)
+        assert c.get("T", ("a", "b"), UNCAPPED) == "fresh"
+
+
+class TestMatcherCachePlane:
+    def test_repeat_batch_skips_the_device(self):
+        m = TpuMatcher(max_levels=8, auto_compact=False, match_cache=True)
+        m.add_route("T", mk_route("a/+", "r1"))
+        m.refresh()
+        q = [("T", ["a", "b"]), ("T", ["a", "c"])]
+        first = m.match_batch(q)
+        calls = []
+        orig = m._match_batch_device
+        m._match_batch_device = lambda *a, **k: calls.append(a) or orig(
+            *a, **k)
+        second = m.match_batch(q)
+        assert calls == [], "repeat batch reached the device plane"
+        for a, b in zip(first, second):
+            assert_same(a, b)
+
+    def test_in_batch_dedup_walks_unique_rows_once(self):
+        m = TpuMatcher(max_levels=8, auto_compact=False, match_cache=True)
+        m.add_route("T", mk_route("a/b", "r1"))
+        m.refresh()
+        seen = []
+        orig = m._match_batch_device
+        m._match_batch_device = (
+            lambda queries, **k: seen.append(len(queries))
+            or orig(queries, **k))
+        res = m.match_batch([("T", ["a", "b"])] * 8 + [("T", ["a", "c"])])
+        assert seen == [2], f"device saw {seen}, expected one 2-row batch"
+        for r in res[:8]:
+            assert [x.receiver_id for x in r.normal] == ["r1"]
+        assert res[8].all_routes() == []
+
+    def test_cache_off_is_a_pure_bypass(self):
+        m = TpuMatcher(max_levels=8, auto_compact=False, match_cache=False)
+        assert m.match_cache is None
+        m.add_route("T", mk_route("a/b", "r1"))
+        res = m.match_batch([("T", ["a", "b"])])
+        assert [r.receiver_id for r in res[0].normal] == ["r1"]
+
+    def test_exact_mutation_preserves_sibling_entries(self):
+        m = TpuMatcher(max_levels=8, auto_compact=False, match_cache=True)
+        m.add_route("T", mk_route("a/b", "r1"))
+        m.add_route("T", mk_route("a/c", "r2"))
+        m.refresh()
+        m.match_batch([("T", ["a", "b"]), ("T", ["a", "c"])])
+        h0 = m.match_cache.hits
+        m.add_route("T", mk_route("a/b", "r3"))   # exact: evicts only a/b
+        res = m.match_batch([("T", ["a", "b"]), ("T", ["a", "c"])])
+        assert m.match_cache.hits == h0 + 1       # a/c stayed cached
+        assert sorted(r.receiver_id for r in res[0].normal) == ["r1", "r3"]
+        assert [r.receiver_id for r in res[1].normal] == ["r2"]
+
+    def test_compaction_bumps_generation(self):
+        m = TpuMatcher(max_levels=8, auto_compact=False, match_cache=True)
+        m.add_route("T", mk_route("a/b", "r1"))
+        m.refresh()
+        m.match_batch([("T", ["a", "b"])])
+        bumps = m.match_cache.epoch_bumps
+        m.add_route("T", mk_route("x/y", "r2"))
+        m.refresh()                                # base rebuild
+        assert m.match_cache.epoch_bumps > bumps
+        h0 = m.match_cache.hits
+        res = m.match_batch([("T", ["a", "b"])])
+        assert m.match_cache.hits == h0            # miss after rebuild
+        assert [r.receiver_id for r in res[0].normal] == ["r1"]
+
+    def test_randomized_mutation_query_interleaving_parity(self):
+        """THE invalidation correctness gate (ISSUE 4): interleave
+        add/remove/overlay-compaction with match queries and assert the
+        cache-on results equal the host oracle on every step."""
+        filters = ["a/b", "a/+", "a/#", "+/b", "x/y/z", "a/b/c", "#",
+                   "s/1/t", "s/2/t", "$share/g1/a/b", "$share/g1/a/+",
+                   "$oshare/g2/x/y"]
+        topics = [["a", "b"], ["a", "c"], ["a", "b", "c"], ["x", "y", "z"],
+                  ["s", "1", "t"], ["s", "2", "t"], ["q"]]
+        tenants = ["T1", "T2"]
+        m = TpuMatcher(max_levels=8, k_states=16, auto_compact=False,
+                       match_cache=True)
+        rng = random.Random(13)
+        for step in range(400):
+            r = rng.random()
+            tenant = rng.choice(tenants)
+            if r < 0.25:
+                m.add_route(tenant, mk_route(rng.choice(filters),
+                                             f"r{rng.randrange(30)}",
+                                             inc=step))
+            elif r < 0.4:
+                tf = rng.choice(filters)
+                m.remove_route(tenant, RouteMatcher.from_topic_filter(tf),
+                               (0, f"r{rng.randrange(30)}", "d0"),
+                               incarnation=step)
+            elif r < 0.45:
+                m.refresh()     # overlay compaction mid-stream
+            else:
+                # duplicate-heavy batch: dedup + cache must stay exact
+                batch = [(tenant, rng.choice(topics))
+                         for _ in range(rng.randrange(1, 6))]
+                batch += [batch[0]] * rng.randrange(0, 3)
+                got = m.match_batch(batch)
+                want = m.match_from_tries(batch)
+                for g, w, q in zip(got, want, batch):
+                    assert_same(g, w, f"step {step} {q}")
+        stats = m.match_cache.snapshot()
+        assert stats["hits"] > 0, "cache never hit — the test lost its bite"
+
+    def test_per_tenant_hit_rate_feeds_obs(self):
+        """The per-tenant OBS window is fed by the PUB plane only (the
+        publish-path number; the matcher plane stays in the global
+        /metrics scopes) — here the plumbing: record → window → /tenants
+        row. The pub-plane feed itself is asserted in
+        TestServiceCachePlane below."""
+        from bifromq_tpu.obs import OBS
+        OBS.reset()
+        OBS.record_match_cache("TT", 1, 1)
+        snap = OBS.windows.snapshot_tenant("TT")
+        assert snap["match_cache_hit_rate"] == 0.5
+        # the ranked row GET /tenants serves carries the hit rate too
+        row = OBS.detector.score_tenant("TT")
+        assert row["match_cache_hit_rate"] == 0.5
+        OBS.reset()
+
+    @pytest.mark.asyncio
+    async def test_pub_plane_feeds_per_tenant_hit_rate(self):
+        from bifromq_tpu.dist.service import DistService
+        from bifromq_tpu.obs import OBS
+        from bifromq_tpu.plugin.events import CollectingEventCollector
+        from bifromq_tpu.plugin.settings import DefaultSettingProvider
+        from bifromq_tpu.plugin.subbroker import SubBrokerRegistry
+        from bifromq_tpu.types import ClientInfo, Message, QoS
+
+        OBS.reset()
+        svc = DistService(SubBrokerRegistry(), CollectingEventCollector(),
+                          DefaultSettingProvider())
+        await svc.start()
+        try:
+            pub = ClientInfo(tenant_id="TT", type="test")
+            msg = Message(message_id=1, pub_qos=QoS.AT_MOST_ONCE,
+                          payload=b"x", timestamp=0)
+            for _ in range(4):
+                await svc.pub(pub, "a/b", msg)
+            snap = OBS.windows.snapshot_tenant("TT")
+            assert snap["match_cache_hit_rate"] > 0.5
+        finally:
+            await svc.stop()
+            OBS.reset()
+
+
+class TestServiceCachePlane:
+    @pytest.mark.asyncio
+    async def test_replayed_mutation_invalidates_pub_cache(self):
+        """A mutation applied through the WORKER (never passing this
+        service's match/unmatch — the replayed-mutation path) must
+        invalidate the pub-side cache via the apply-stream hook, not
+        wait out the TTL."""
+        from bifromq_tpu.dist.service import DistService
+        from bifromq_tpu.plugin.events import CollectingEventCollector
+        from bifromq_tpu.plugin.settings import DefaultSettingProvider
+        from bifromq_tpu.plugin.subbroker import SubBrokerRegistry
+        from bifromq_tpu.types import ClientInfo, Message, QoS
+
+        svc = DistService(SubBrokerRegistry(), CollectingEventCollector(),
+                          DefaultSettingProvider())
+        # make the TTL effectively infinite so only the hook can help
+        svc._match_cache.ttl_s = 3600.0
+        await svc.start()
+        try:
+            pub = ClientInfo(tenant_id="T", type="test")
+            msg = Message(message_id=1, pub_qos=QoS.AT_MOST_ONCE,
+                          payload=b"x", timestamp=0)
+            r = await svc.pub(pub, "a/b", msg)
+            assert r.fanout == 0
+            assert len(svc._match_cache) >= 1
+            # mutate via the worker directly (≈ a raft-replicated apply)
+            assert await svc.worker.add_route(
+                "T", mk_route("a/b", "r1", broker=7)) == "ok"
+            # the very next pub must see the new route (fanout attempt —
+            # no broker 7 registered, so fanout stays 0, but the match
+            # cache entry must be GONE and re-matched)
+            before = svc._match_cache.misses
+            await svc.pub(pub, "a/b", msg)
+            assert svc._match_cache.misses > before, \
+                "stale pub-cache entry served after a replayed mutation"
+        finally:
+            await svc.stop()
+
+    @pytest.mark.asyncio
+    async def test_reset_from_kv_bumps_pub_cache(self):
+        from bifromq_tpu.dist.service import DistService
+        from bifromq_tpu.plugin.events import CollectingEventCollector
+        from bifromq_tpu.plugin.settings import DefaultSettingProvider
+        from bifromq_tpu.plugin.subbroker import SubBrokerRegistry
+
+        svc = DistService(SubBrokerRegistry(), CollectingEventCollector(),
+                          DefaultSettingProvider())
+        await svc.start()
+        try:
+            c = svc._match_cache
+            c.put("T", "a/b", UNCAPPED, "m", c.token("T"))
+            svc._on_route_mutation(None, None)   # ≈ coproc reset relay
+            assert c.get("T", "a/b", UNCAPPED) is None
+        finally:
+            await svc.stop()
+
+
+class TestMatchCacheMetricsSection:
+    def test_metrics_snapshot_has_match_cache_section(self):
+        from bifromq_tpu.utils.metrics import MetricsRegistry
+        MATCH_CACHE.reset()
+        m = TpuMatcher(max_levels=8, auto_compact=False, match_cache=True)
+        m.add_route("T", mk_route("a/b", "r1"))
+        m.refresh()
+        m.match_batch([("T", ["a", "b"]), ("T", ["a", "b"])])
+        m.match_batch([("T", ["a", "b"])])
+        snap = MetricsRegistry().snapshot()["match_cache"]
+        assert snap["matcher"]["hits"] == 1
+        assert snap["matcher"]["misses"] == 2
+        assert snap["matcher"]["epoch_bumps"] >= 1
+        assert snap["dedup"]["saved"] == 1
+        assert snap["dedup"]["walked"] == 1
+        assert 0 < snap["matcher"]["hit_rate"] < 1
+
+
+class TestAdvisoryTick:
+    def test_is_noisy_is_a_pure_probe_when_tick_armed(self):
+        from bifromq_tpu.obs.neighbor import NoisyNeighborDetector
+        from bifromq_tpu.obs.slo import TenantSLO
+
+        det = NoisyNeighborDetector(TenantSLO())
+        calls = []
+        orig = det.evaluate
+        det.evaluate = lambda **k: calls.append(1) or orig(**k)
+        det.tick_armed = True
+        assert det.is_noisy("T") is False
+        assert calls == [], "armed guard path still paid an evaluation"
+        det.tick_armed = False
+        det.is_noisy("T")
+        assert calls, "lazy TTL refresh stopped working when disarmed"
+
+    @pytest.mark.asyncio
+    async def test_background_tick_refreshes_flags_and_stops(self):
+        import asyncio
+
+        from bifromq_tpu.obs import OBS
+
+        calls = []
+        orig = OBS.detector.evaluate
+        OBS.detector.evaluate = lambda **k: calls.append(1) or orig(**k)
+        try:
+            OBS.start_advisory_tick(interval_s=0.01)
+            assert OBS.detector.tick_armed
+            await asyncio.sleep(0.1)
+            assert calls, "tick never evaluated"
+            await OBS.stop_advisory_tick()
+            assert not OBS.detector.tick_armed
+            assert OBS._advisory_task is None
+        finally:
+            OBS.detector.evaluate = orig
+            OBS.detector.tick_armed = False
+
+    @pytest.mark.asyncio
+    async def test_broker_arms_tick_for_slo_advised_throttler(self):
+        from bifromq_tpu.mqtt.broker import MQTTBroker
+        from bifromq_tpu.obs import OBS
+        from bifromq_tpu.plugin.throttler import SLOAdvisedResourceThrottler
+
+        broker = MQTTBroker(host="127.0.0.1", port=0,
+                            throttler=SLOAdvisedResourceThrottler())
+        await broker.start()
+        try:
+            assert OBS.detector.tick_armed
+            assert OBS._advisory_task is not None
+        finally:
+            await broker.stop()
+        assert not OBS.detector.tick_armed

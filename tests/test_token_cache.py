@@ -1,0 +1,104 @@
+"""TokenCache coverage (ISSUE 4 satellite): eviction at max_entries,
+salt/width-change clearing, and cached-vs-uncached tokenize parity."""
+
+import random
+import string
+
+import numpy as np
+
+from bifromq_tpu.models.automaton import TokenCache, tokenize
+
+
+def assert_tok_equal(a, b, ctx=""):
+    assert np.array_equal(a.tok_h1, b.tok_h1), f"tok_h1 {ctx}"
+    assert np.array_equal(a.tok_h2, b.tok_h2), f"tok_h2 {ctx}"
+    assert np.array_equal(a.lengths, b.lengths), f"lengths {ctx}"
+    assert np.array_equal(a.roots, b.roots), f"roots {ctx}"
+    assert np.array_equal(a.sys_mask, b.sys_mask), f"sys_mask {ctx}"
+
+
+class TestTokenCacheEviction:
+    def test_eviction_at_max_entries(self):
+        c = TokenCache(max_entries=8)
+        topics = [[f"lvl{i}", "x"] for i in range(12)]
+        for t in topics:
+            tokenize([t], [0], max_levels=4, salt=0, cache=c)
+        # the sweep keeps the map bounded: never above max_entries + 1
+        assert len(c._d) <= 8
+        # the most recent topics survived the amortized half-sweep
+        misses = c.misses
+        tokenize([topics[-1]], [0], max_levels=4, salt=0, cache=c)
+        assert c.misses == misses, "most-recent entry was evicted"
+
+    def test_lru_refresh_protects_hot_keys(self):
+        c = TokenCache(max_entries=4)
+        hot = ["hot", "t"]
+        tokenize([hot], [0], max_levels=4, salt=0, cache=c)
+        for i in range(3):
+            tokenize([[f"cold{i}", "t"]], [0], max_levels=4, salt=0,
+                     cache=c)
+            # keep the hot key recent so the sweep drops cold ones
+            tokenize([hot], [0], max_levels=4, salt=0, cache=c)
+        tokenize([["cold3", "t"]], [0], max_levels=4, salt=0, cache=c)
+        misses = c.misses
+        tokenize([hot], [0], max_levels=4, salt=0, cache=c)
+        assert c.misses == misses, "hot key evicted despite LRU refresh"
+
+
+class TestTokenCacheClearing:
+    def test_salt_change_clears(self):
+        c = TokenCache()
+        tokenize([["a", "b"]], [0], max_levels=4, salt=1, cache=c)
+        assert len(c._d) == 1
+        t2 = tokenize([["a", "b"]], [0], max_levels=4, salt=2, cache=c)
+        assert c._salt == 2
+        # the row was re-hashed under the new salt, not served stale
+        want = tokenize([["a", "b"]], [0], max_levels=4, salt=2)
+        assert_tok_equal(t2, want, "salt change")
+        assert c.misses == 2    # both calls missed (clear between)
+
+    def test_width_change_clears(self):
+        c = TokenCache()
+        tokenize([["a"]], [0], max_levels=4, salt=0, cache=c)
+        t2 = tokenize([["a"]], [0], max_levels=8, salt=0, cache=c)
+        want = tokenize([["a"]], [0], max_levels=8, salt=0)
+        assert_tok_equal(t2, want, "width change")
+
+
+class TestTokenizeParityProperty:
+    def test_cached_rows_identical_to_uncached(self):
+        """Property test: for random topics (deep, '$'-prefixed, repeated,
+        over-long), tokenize with a cache — cold AND warm — must produce
+        rows identical to the uncached path."""
+        rng = random.Random(29)
+        names = ["".join(rng.choices(string.ascii_lowercase, k=3))
+                 for _ in range(20)] + ["$SYS", "$share", ""]
+        max_levels = 6
+        for trial in range(20):
+            n = rng.randrange(1, 12)
+            topics = []
+            for _ in range(n):
+                depth = rng.randrange(1, 9)  # up to max_levels + 2
+                topics.append([rng.choice(names) for _ in range(depth)])
+            # force repeats so the warm path actually serves hits
+            if n > 2:
+                topics[n // 2] = topics[0]
+            roots = [rng.randrange(-1, 5) for _ in range(n)]
+            batch = 1 << (n - 1).bit_length() if n > 1 else 1
+            salt = rng.randrange(3)
+            want = tokenize(topics, roots, max_levels=max_levels,
+                            salt=salt, batch=batch)
+            cache = TokenCache()
+            cold = tokenize(topics, roots, max_levels=max_levels,
+                            salt=salt, batch=batch, cache=cache)
+            warm = tokenize(topics, roots, max_levels=max_levels,
+                            salt=salt, batch=batch, cache=cache)
+            assert_tok_equal(cold, want, f"trial {trial} cold")
+            assert_tok_equal(warm, want, f"trial {trial} warm")
+            assert cache.hits >= n  # the warm pass served from the cache
+
+    def test_string_and_levels_keys_agree(self):
+        c = TokenCache()
+        a = tokenize(["x/y"], [0], max_levels=4, salt=0, cache=c)
+        b = tokenize([["x", "y"]], [0], max_levels=4, salt=0, cache=c)
+        assert_tok_equal(a, b, "string vs levels")
