@@ -39,13 +39,14 @@ log = logging.getLogger("bifromq_tpu.api")
 class APIServer:
     def __init__(self, broker: MQTTBroker, host: str = "127.0.0.1",
                  port: int = 0, *, cluster=None, metrics=None,
-                 registry=None) -> None:
+                 registry=None, clusterview=None) -> None:
         self.broker = broker
         self.host = host
         self.port = port
         self.cluster = cluster
         self.metrics = metrics
         self.registry = registry    # rpc.fabric.ServiceRegistry (clustered)
+        self.clusterview = clusterview  # obs.clusterview.ClusterView
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> None:
@@ -137,6 +138,13 @@ class APIServer:
                 return await self._retain(arg, body)
             if route == ("GET", "/cluster"):
                 return self._cluster_info()
+            if route == ("GET", "/cluster/tenants"):
+                return await self._cluster_tenants(arg)
+            if method == "GET" and url.path.startswith("/cluster/trace/"):
+                return await self._cluster_trace(
+                    url.path[len("/cluster/trace/"):], arg)
+            if route == ("GET", "/cluster/route"):
+                return self._cluster_route(arg)
             if route == ("GET", "/sessions"):
                 return self._sessions(arg)
             if route == ("GET", "/inbox-state"):
@@ -397,16 +405,20 @@ class APIServer:
         return 200, {**OBS.obs_snapshot(),
                      "window_s": OBS.windows.window_s,
                      "noisy_threshold": OBS.detector.noisy_threshold,
-                     "slow_p99_ms": OBS.detector.slow_p99_ms}
+                     "slow_p99_ms": OBS.detector.slow_p99_ms,
+                     "detector": OBS.detector.config_snapshot()}
 
     def _obs_config(self, arg) -> Tuple[int, object]:
         """Runtime SLO knobs: ``windows`` (0/1 toggles the window layer),
-        ``noisy_threshold``, ``slow_p99_ms``. Parse everything before
-        applying anything (same contract as PUT /trace)."""
+        ``noisy_threshold``, ``slow_p99_ms``, blend weights (``w_fanout``
+        / ``w_queue_wait`` / ``w_errors``). With ``tenant_id`` set the
+        threshold/weight knobs install a per-tenant override instead
+        (ISSUE 5 satellite; ``clear=1`` drops that tenant's overrides).
+        Parse everything before applying anything (same contract as
+        PUT /trace)."""
         from ..obs import OBS
+        det = OBS.detector
         raw_windows = arg("windows")
-        raw_thresh = arg("noisy_threshold")
-        raw_slow = arg("slow_p99_ms")
         windows = None
         if raw_windows is not None:
             low = raw_windows.lower()
@@ -416,24 +428,107 @@ class APIServer:
                 windows = False
             else:
                 return 400, {"error": f"windows={raw_windows!r}"}
-        thresh = float(raw_thresh) if raw_thresh is not None else None
-        slow = float(raw_slow) if raw_slow is not None else None
-        if windows is not None:
+        knobs = {}
+        for name in sorted(det.TENANT_KNOBS):
+            raw = arg(name)
+            if raw is not None:
+                knobs[name] = float(raw)      # ValueError → 400 upstream
+        tenant = arg("tenant_id")
+        if windows is not None:       # process-wide regardless of tenant
             OBS.enabled = windows
-        if thresh is not None:
-            OBS.detector.noisy_threshold = thresh
-        if slow is not None:
-            OBS.detector.slow_p99_ms = slow
+        if tenant:
+            # clear-then-set: ?clear=1&slow_p99_ms=150 drops the old
+            # override and installs the new knob, never discards it
+            if arg("clear") in ("1", "true"):
+                det.clear_tenant(tenant)
+            if knobs:
+                det.configure_tenant(tenant, **knobs)
+        else:
+            # process-wide defaults: noisy_threshold / slow_p99_ms / w_*
+            for name, v in knobs.items():
+                setattr(det, name, v)
         return self._obs_state()
 
     def _cluster_info(self) -> Tuple[int, object]:
+        """``GET /cluster``: the merged node table (ISSUE 5) — liveness,
+        gossiped health digest + its age, and hosted agents per member.
+        Falls back to the plain membership table when no cluster view is
+        wired (and to standalone when there is no cluster at all)."""
         if self.cluster is None:
             return 200, {"mode": "standalone"}
+        if self.clusterview is not None:
+            return 200, {"mode": "cluster",
+                         "self": self.clusterview.node_id,
+                         "unhealthy_endpoints":
+                             self.clusterview.unhealthy_endpoints(),
+                         "members": self.clusterview.cluster_table()}
         return 200, {
             "mode": "cluster",
             "members": {m.node_id: {"status": m.status,
                                     "agents": sorted(m.agents)}
                         for m in self.cluster.members.values()},
+        }
+
+    async def _cluster_tenants(self, arg) -> Tuple[int, object]:
+        """``GET /cluster/tenants``: per-tenant RED merged across every
+        node (scatter-gather under a deadline budget; log2 histograms
+        merged bucket-wise). Standalone/unwired nodes degrade to a
+        local-only view with the same shape."""
+        top_k = int(arg("top_k", "0"))
+        timeout_s = float(arg("timeout_s", "2.0"))
+        if self.clusterview is not None:
+            out = await self.clusterview.federated_tenants(
+                timeout_s=timeout_s, top_k=top_k)
+            return 200, out
+        from ..obs import OBS
+        from ..obs.clusterview import derive_red_row, merge_tenant_raws
+        merged = merge_tenant_raws(
+            [OBS.windows.raw_snapshot() if OBS.enabled else {}])
+        rows = {t: derive_red_row(r, OBS.windows.window_s)
+                for t, r in merged.items()}
+        if top_k > 0:       # same contract as the federated path
+            keep = sorted(rows, key=lambda t: -rows[t]["rate_per_s"])[:top_k]
+            rows = {t: rows[t] for t in keep}
+        return 200, {"window_s": OBS.windows.window_s,
+                     "nodes": {OBS.node_id: "local"},
+                     "tenants": rows}
+
+    async def _cluster_trace(self, trace_id: str, arg) -> Tuple[int, object]:
+        """``GET /cluster/trace/<id>``: the full cross-process trace,
+        every peer's span rings queried and the union ordered by HLC."""
+        if not trace_id:
+            return 400, {"error": "trace id required"}
+        timeout_s = float(arg("timeout_s", "2.0"))
+        if self.clusterview is not None:
+            return 200, await self.clusterview.federated_trace(
+                trace_id, timeout_s=timeout_s)
+        from .. import trace as tr
+        from ..obs import OBS
+        spans = tr.TRACER.export(trace_id=trace_id, limit=1000)
+        return 200, {"trace_id": trace_id, "count": len(spans),
+                     "nodes": {OBS.node_id: "local"},
+                     "processes": 1 if spans else 0,
+                     "spans": [dict(s, node=OBS.node_id) for s in spans]}
+
+    def _cluster_route(self, arg) -> Tuple[int, object]:
+        """``GET /cluster/route?service=&key=``: where would this tenant
+        key route right now? Operator introspection for the health-aware
+        rendezvous pick (and the tier-2 cluster gate's probe)."""
+        if self.registry is None:
+            return 404, {"error": "no service registry (standalone mode)"}
+        service = arg("service")
+        if not service:
+            return 400, {"error": "missing parameter 'service'"}
+        key = arg("key") or ""
+        rh = self.registry.remote_health
+        return 200, {
+            "service": service,
+            "key": key,
+            "endpoint": self.registry.pick(service, key),
+            "endpoints": self.registry.endpoints(service),
+            "unhealthy": (rh.unhealthy_endpoints()
+                          if rh is not None
+                          and hasattr(rh, "unhealthy_endpoints") else []),
         }
 
     def _sessions(self, arg) -> Tuple[int, object]:

@@ -62,12 +62,29 @@ class AgentHost(asyncio.DatagramProtocol):
     def __init__(self, node_id: str, host: str = "127.0.0.1",
                  port: int = 0, *, seeds: Optional[List[Tuple[str, int]]] = None,
                  rng: Optional[random.Random] = None,
-                 tls_server_ctx=None, tls_client_ctx=None) -> None:
+                 tls_server_ctx=None, tls_client_ctx=None,
+                 probe_interval_s: Optional[float] = None,
+                 probe_timeout_s: Optional[float] = None,
+                 suspect_timeout_s: Optional[float] = None,
+                 dead_reap_s: Optional[float] = None) -> None:
         self.node_id = node_id
         self.host = host
         self.port = port
         self.seeds = seeds or []
         self.rng = rng or random.Random()
+        # failure-detector timing knobs (ISSUE 5): instance overrides of
+        # the class defaults. Full broker nodes carry heavier event loops
+        # than the in-process test clusters these defaults were tuned on
+        # — an operator (or the starter config) can trade detection
+        # latency for stability under GC/compile stalls.
+        if probe_interval_s is not None:
+            self.PROBE_INTERVAL = float(probe_interval_s)
+        if probe_timeout_s is not None:
+            self.PROBE_TIMEOUT = float(probe_timeout_s)
+        if suspect_timeout_s is not None:
+            self.SUSPECT_TIMEOUT = float(suspect_timeout_s)
+        if dead_reap_s is not None:
+            self.DEAD_REAP = float(dead_reap_s)
         self.members: Dict[str, MemberState] = {}
         self.transport: Optional[asyncio.DatagramTransport] = None
         self._probe_task: Optional[asyncio.Task] = None
@@ -197,6 +214,14 @@ class AgentHost(asyncio.DatagramProtocol):
     def on_change(self, cb: Callable[[], None]) -> None:
         self._listeners.append(cb)
 
+    def remove_on_change(self, cb: Callable[[], None]) -> None:
+        """Deregister a change listener (a stopped consumer — e.g. a
+        ClusterView — must not be pinned/driven by the host forever)."""
+        try:
+            self._listeners.remove(cb)
+        except ValueError:
+            pass
+
     def _notify(self) -> None:
         for cb in self._listeners:
             cb()
@@ -315,6 +340,14 @@ class AgentHost(asyncio.DatagramProtocol):
                     del self._relays[seq]
                 target = self._pick_probe_target()
                 if target is None:
+                    # alone with seeds configured: keep knocking
+                    # (≈ AutoSeeder). The startup join is a single UDP
+                    # datagram — a seed still booting when it arrived
+                    # would otherwise orphan this node forever, and a
+                    # view that collapsed to self (mutual reap after a
+                    # long stall) could never heal.
+                    for seed in self.seeds:
+                        self._send(tuple(seed), {"t": "join"})
                     continue
                 ok = await self._probe(target)
                 if not ok:

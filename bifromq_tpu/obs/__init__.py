@@ -73,6 +73,16 @@ class ObsHub:
         # lazily on the connect/publish guard path
         self._advisory_task = None
         self._advisory_refs = 0
+        self._advisory_interval = float("inf")
+        # extra callbacks run on each advisory tick (ISSUE 5: the cluster
+        # view refreshes its gossiped health digest here)
+        self._tick_hooks: list = []
+        # node identity for federated sinks (ISSUE 5 satellite): stamped
+        # into every exporter record's resource envelope; the starter
+        # overrides from the cluster config
+        self.node_id = os.environ.get("BIFROMQ_NODE_ID",
+                                      "").strip() or f"pid-{os.getpid()}"
+        self.cluster_id = os.environ.get("BIFROMQ_CLUSTER_ID", "").strip()
 
     # ---------------- hot-path recording -----------------------------------
 
@@ -120,6 +130,33 @@ class ObsHub:
     def is_noisy(self, tenant: str) -> bool:
         """Throttler advisory: is this tenant currently flagged noisy?"""
         return self.enabled and self.detector.is_noisy(tenant)
+
+    def set_identity(self, node_id: Optional[str] = None,
+                     cluster_id: Optional[str] = None) -> None:
+        """Pin the node/cluster identity federated sinks attribute by."""
+        if node_id:
+            self.node_id = node_id
+        if cluster_id is not None:
+            self.cluster_id = cluster_id
+
+    def resource_envelope(self) -> dict:
+        """The per-record attribution envelope (ISSUE 5 satellite)."""
+        from .exporter import SCHEMA_VERSION
+        return {"node_id": self.node_id,
+                "cluster_id": self.cluster_id,
+                "schema_version": SCHEMA_VERSION}
+
+    def on_advisory_tick(self, cb: Callable[[], None]) -> None:
+        """Run ``cb`` on every advisory tick (after the detector refresh).
+        Idempotent per callback."""
+        if cb not in self._tick_hooks:
+            self._tick_hooks.append(cb)
+
+    def remove_advisory_hook(self, cb: Callable[[], None]) -> None:
+        try:
+            self._tick_hooks.remove(cb)
+        except ValueError:
+            pass
 
     # ---------------- snapshots --------------------------------------------
 
@@ -177,7 +214,8 @@ class ObsHub:
             queue_cap=int(_env_float("BIFROMQ_OBS_EXPORT_CAP", 2048)),
             export_sampled=os.environ.get(
                 "BIFROMQ_OBS_EXPORT_SAMPLED", "0") == "1",
-            snapshot_fn=self._export_snapshot)
+            snapshot_fn=self._export_snapshot,
+            resource=self.resource_envelope())
 
     def start_exporter(self,
                        exporter: Optional[TelemetryExporter] = None) -> bool:
@@ -211,13 +249,23 @@ class ObsHub:
         """Refcounted background flag refresh: arming a
         ``SLOAdvisedResourceThrottler`` on a max-tenant deployment must not
         pay a full detector evaluation on the publish/connect guard path —
-        the tick evaluates off-path and ``is_noisy`` becomes a set probe."""
+        the tick evaluates off-path and ``is_noisy`` becomes a set probe.
+
+        Re-arming with a SHORTER interval restarts the shared task at the
+        faster cadence (ISSUE 5: the cluster view's digest refresh must
+        honor ``BIFROMQ_CLUSTER_OBS_INTERVAL_S`` even when the broker
+        armed the tick first for the throttler advisory)."""
         import asyncio
 
         self._advisory_refs += 1
         if self._advisory_task is not None:
-            return
+            if interval_s is not None and interval_s < self._advisory_interval:
+                task, self._advisory_task = self._advisory_task, None
+                task.cancel()
+            else:
+                return
         interval = interval_s or self.detector.advisory_ttl_s
+        self._advisory_interval = interval
         self.detector.tick_armed = True
 
         async def loop() -> None:
@@ -233,6 +281,13 @@ class ObsHub:
                 except Exception:  # noqa: BLE001 — telemetry must not die
                     import logging
                     logging.getLogger(__name__).exception("advisory tick")
+                for cb in list(self._tick_hooks):
+                    try:
+                        cb()
+                    except Exception:  # noqa: BLE001
+                        import logging
+                        logging.getLogger(__name__).exception(
+                            "advisory tick hook")
 
         self._advisory_task = asyncio.get_event_loop().create_task(loop())
 
@@ -244,6 +299,7 @@ class ObsHub:
             return
         task, self._advisory_task = self._advisory_task, None
         self._advisory_refs = 0
+        self._advisory_interval = float("inf")
         self.detector.tick_armed = False
         task.cancel()
         try:

@@ -1,0 +1,519 @@
+"""Cluster observability plane (ISSUE 5 tentpole).
+
+PRs 2–4 built rich per-node surfaces (``/metrics``, ``/tenants``,
+``/trace``) that answer only for the local process. This module federates
+them into one cluster-wide plane riding the broker's OWN gossip — no
+external middleware, the same discipline as upstream BifroMQ:
+
+- **Health digests.** Every node publishes a compact digest — non-closed
+  breaker states per endpoint, device gauges (dispatch queue depth,
+  compile count, memory watermark), match-cache hit rate, top-3 noisy
+  tenants, an HLC stamp — into its gossip agent metadata
+  (``AgentHost.host_agent("obs", ...)``), refreshed on the ObsHub
+  advisory tick. Digests age out: a killed node's last digest goes
+  *stale* in the table instead of lying forever.
+- **Health-aware routing.** ``ClusterView.suspect(endpoint)`` answers
+  from the gossiped digests: an endpoint some OTHER node's breaker holds
+  open, or a node self-reporting a deep dispatch queue, is demoted by
+  ``ServiceRegistry.pick`` *before* any local failure is observed —
+  closing the PR-1 "breaker state is per-process" follow-up.
+- **Federated views.** ``ClusterObsRPCService`` serves each node's raw
+  tenant windows and span rings on the RPC fabric; ``federated_tenants``
+  scatter-gathers them under a PR-1 deadline budget and merges per-tenant
+  RED **bucket-wise** (log2 histograms add exactly), and
+  ``federated_trace`` assembles a full cross-process trace ordered by the
+  HLC stamps PR 2 already records.
+
+Layering: this module lives in ``obs`` and therefore must not import
+``utils.metrics`` at module level (``utils.metrics`` imports the obs
+package); the match-cache scrape happens lazily inside ``build_digest``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..utils.hlc import HLC
+from .window import N_BUCKETS, percentile_ms_from
+
+log = logging.getLogger(__name__)
+
+# gossip agent carrying the digests (one per node, LWW by incarnation)
+AGENT_ID = "obs"
+# RPC fabric service for the scatter-gather plane
+SERVICE = "cluster-obs"
+DIGEST_VERSION = 1
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+# ---------------------------------------------------------------------------
+# bucket-wise RED merge (the federation math, unit-testable on its own)
+# ---------------------------------------------------------------------------
+
+_RAW_SCALARS = ("flows", "errors", "fanout", "queue_wait_s",
+                "cache_hits", "cache_misses")
+
+
+def merge_tenant_raws(raws: List[Dict[str, dict]]) -> Dict[str, dict]:
+    """Merge several nodes' raw per-tenant window exports
+    (``TenantSLO.raw_snapshot``) into one: scalar windows add, per-stage
+    log2 histograms add **bucket-wise** — mathematically identical to one
+    histogram having observed every node's samples."""
+    out: Dict[str, dict] = {}
+    for raw in raws:
+        for tenant, r in (raw or {}).items():
+            dst = out.get(tenant)
+            if dst is None:
+                dst = out[tenant] = {k: 0.0 for k in _RAW_SCALARS}
+                dst["stages"] = {}
+            for k in _RAW_SCALARS:
+                dst[k] += float(r.get(k, 0.0))
+            for stage, buckets in (r.get("stages") or {}).items():
+                cur = dst["stages"].get(stage)
+                if cur is None:
+                    dst["stages"][stage] = list(buckets)[:N_BUCKETS]
+                else:
+                    for i, c in enumerate(buckets[:N_BUCKETS]):
+                        cur[i] += c
+    return out
+
+
+def derive_red_row(raw: dict, window_s: float) -> dict:
+    """Raw merged windows → the same derived RED row shape
+    ``TenantSLO.snapshot_tenant`` serves locally (rates, error rate,
+    cache hit rate, per-stage count/p50/p99)."""
+    flows = raw.get("flows", 0.0)
+    errors = raw.get("errors", 0.0)
+    hits = raw.get("cache_hits", 0.0)
+    lookups = hits + raw.get("cache_misses", 0.0)
+    stages = {}
+    for stage, buckets in (raw.get("stages") or {}).items():
+        count = sum(buckets)
+        if count:
+            stages[stage] = {"count": count,
+                             "p50_ms": percentile_ms_from(buckets, 50),
+                             "p99_ms": percentile_ms_from(buckets, 99)}
+    return {
+        "rate_per_s": round(flows / window_s, 3),
+        "errors_per_s": round(errors / window_s, 3),
+        "error_rate": round(errors / flows, 4) if flows else 0.0,
+        "fanout_per_s": round(raw.get("fanout", 0.0) / window_s, 3),
+        "queue_wait_s": round(raw.get("queue_wait_s", 0.0), 6),
+        "match_cache_hit_rate": (round(hits / lookups, 4)
+                                 if lookups else 0.0),
+        "stages": stages,
+    }
+
+
+# ---------------------------------------------------------------------------
+# the per-node view
+# ---------------------------------------------------------------------------
+
+class ClusterView:
+    """One node's participation in the cluster observability plane.
+
+    Publishes this node's digest, decodes peers', and keeps a cached
+    unhealthy-endpoint set ``ServiceRegistry.pick`` probes per request
+    (set membership only — the hot path never walks gossip state)."""
+
+    def __init__(self, node_id: str, agent_host, *, hub=None,
+                 registry=None, rpc_address: str = "", api_port: int = 0,
+                 stale_after_s: Optional[float] = None,
+                 queue_depth_threshold: Optional[float] = None,
+                 interval_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.time) -> None:
+        from . import OBS
+        self.node_id = node_id
+        self.agent_host = agent_host
+        self.hub = hub if hub is not None else OBS
+        self.registry = registry          # rpc.fabric.ServiceRegistry
+        self.rpc_address = rpc_address
+        self.api_port = api_port
+        # a digest older than this is display-only: it neither demotes
+        # nor clears endpoints (the node may be dead — its last report
+        # says nothing about NOW)
+        self.stale_after_s = (stale_after_s if stale_after_s is not None
+                              else _env_float("BIFROMQ_CLUSTER_OBS_STALE_S",
+                                              10.0))
+        # a node self-reporting a dispatch queue at/after this depth is
+        # browned out: its endpoints demote fleet-wide
+        self.queue_depth_threshold = (
+            queue_depth_threshold if queue_depth_threshold is not None
+            else _env_float("BIFROMQ_CLUSTER_OBS_QUEUE_DEPTH", 4096.0))
+        self.interval_s = (interval_s if interval_s is not None
+                           else _env_float("BIFROMQ_CLUSTER_OBS_INTERVAL_S",
+                                           1.0))
+        self._clock = clock
+        self._unhealthy: frozenset = frozenset()
+        # node_id -> (last digest HLC stamp seen, local receipt time):
+        # digest age is measured from when WE saw the stamp change, so
+        # staleness is immune to inter-node wall-clock skew (a peer 15s
+        # behind must not look permanently stale, nor a dead fast-clock
+        # peer permanently fresh)
+        self._digest_seen: Dict[str, tuple] = {}
+        self._started = False
+
+    # ---------------- digest (publisher side) -------------------------------
+
+    def build_digest(self) -> dict:
+        """This node's compact health digest. Kept small on purpose: it
+        piggybacks on UDP gossip packets alongside up to 7 other member
+        records."""
+        hub = self.hub
+        device = hub.device.snapshot(memory=False)
+        digest = {
+            "v": DIGEST_VERSION,
+            "hlc": HLC.INST.get(),
+            "breakers": self._breaker_states(),
+            "device": {
+                "dispatch_queue_depth": device.get("dispatch_queue_depth",
+                                                   0),
+                "batches_in_flight": device.get("batches_in_flight", 0),
+                "compile_count": device.get("compile_count", 0),
+                "mem_peak_bytes": hub.device.peak_memory_bytes,
+            },
+            "match_cache_hit_rate": self._match_cache_hit_rate(),
+            "noisy": [{"tenant": r["tenant"], "score": r["score"],
+                       "flags": r["flags"]}
+                      for r in self._noisy_rows()[:3]],
+        }
+        return digest
+
+    def _noisy_rows(self) -> list:
+        """Ranked rows for the digest: reuse the advisory tick's fresh
+        evaluation when available (the tick just ran one; a second full
+        scoring pass per second is pure waste on a max-tenant node)."""
+        if not self.hub.enabled:
+            return []
+        rows = self.hub.detector.recent_rows(self.interval_s)
+        if rows is None:
+            rows = self.hub.detector.evaluate(top_k=3, emit=False)
+        return rows
+
+    def _breaker_states(self) -> Dict[str, str]:
+        """Non-closed breaker states per endpoint (closed is the default
+        — absent means healthy, keeping the gossip payload compact)."""
+        if self.registry is None:
+            return {}
+        try:
+            return self.registry.breakers.states(include_closed=False)
+        except Exception:  # noqa: BLE001 — telemetry must not raise
+            return {}
+
+    @staticmethod
+    def _match_cache_hit_rate() -> float:
+        # lazy: utils.metrics imports the obs package (layering note in
+        # the module docstring)
+        try:
+            from ..utils.metrics import MATCH_CACHE
+            snap = MATCH_CACHE.snapshot()
+            hits = misses = 0
+            for scope, s in snap.items():
+                if scope == "dedup":
+                    continue
+                hits += s.get("hits", 0)
+                misses += s.get("misses", 0)
+            return round(hits / (hits + misses), 4) if hits + misses \
+                else 0.0
+        except Exception:  # noqa: BLE001
+            return 0.0
+
+    def refresh(self) -> None:
+        """Publish a fresh digest into the gossip agent metadata (bumping
+        the member incarnation so peers merge it) and recompute the
+        unhealthy set from what peers have gossiped back."""
+        try:
+            self.agent_host.host_agent(AGENT_ID, {
+                "addr": self.rpc_address,
+                "api": self.api_port,
+                "digest": self.build_digest(),
+            })
+        except Exception:  # noqa: BLE001 — telemetry must not raise
+            log.exception("digest publish failed")
+        self._recompute()
+
+    # ---------------- peers (consumer side) ----------------------------------
+
+    def digest_age_s(self, node: str,
+                     digest: Optional[dict]) -> Optional[float]:
+        """Seconds since this node's digest last CHANGED, measured on the
+        LOCAL clock at receipt: a fresh HLC stamp resets the age. Skew
+        between node wall clocks cannot fake freshness or staleness —
+        only a peer actually going silent ages out."""
+        if not digest or "hlc" not in digest:
+            self._digest_seen.pop(node, None)
+            return None
+        now = self._clock()
+        seen = self._digest_seen.get(node)
+        if seen is None or seen[0] != digest["hlc"]:
+            self._digest_seen[node] = (digest["hlc"], now)
+            return 0.0
+        return max(0.0, now - seen[1])
+
+    def peers(self, include_self: bool = False) -> Dict[str, dict]:
+        """node_id → {addr, api, digest, age_s, stale} for every ALIVE
+        node hosting the obs agent."""
+        out = {}
+        members = self.agent_host.agent_members(AGENT_ID)
+        for node, meta in members.items():
+            if node == self.node_id and not include_self:
+                continue
+            digest = (meta or {}).get("digest") or {}
+            age = self.digest_age_s(node, digest)
+            out[node] = {
+                "addr": (meta or {}).get("addr", ""),
+                "api": (meta or {}).get("api", 0),
+                "digest": digest,
+                "age_s": age,
+                "stale": age is None or age > self.stale_after_s,
+            }
+        # receipt entries for departed members must not pin forever
+        for node in [n for n in self._digest_seen if n not in members]:
+            del self._digest_seen[node]
+        return out
+
+    def cluster_table(self) -> Dict[str, dict]:
+        """The merged node table behind ``GET /cluster``: every known
+        member (any status) with its digest, digest age, and liveness."""
+        peers = self.peers(include_self=True)
+        out = {}
+        for m in self.agent_host.members.values():
+            row = {"status": m.status,
+                   "alive": m.status == "alive",
+                   "agents": sorted(m.agents)}
+            p = peers.get(m.node_id)
+            if p is not None:
+                row.update(addr=p["addr"], api=p["api"],
+                           digest=p["digest"],
+                           digest_age_s=(round(p["age_s"], 3)
+                                         if p["age_s"] is not None
+                                         else None),
+                           stale=p["stale"])
+            out[m.node_id] = row
+        return out
+
+    # ---------------- health-aware routing -----------------------------------
+
+    def _recompute(self) -> None:
+        """Rebuild the cached unhealthy-endpoint set from fresh peer
+        digests. Called on the advisory tick and on gossip membership
+        change — never from ``suspect`` (the pick hot path)."""
+        bad = set()
+        try:
+            for node, p in self.peers().items():
+                if p["stale"]:
+                    continue
+                digest = p["digest"]
+                # another node's circuit to an endpoint is OPEN: demote
+                # it here before our own breaker has to trip
+                for ep, state in (digest.get("breakers") or {}).items():
+                    if state == "open":
+                        bad.add(ep)
+                # the node itself reports a browned-out device pipeline
+                dev = digest.get("device") or {}
+                if (p["addr"] and dev.get("dispatch_queue_depth", 0)
+                        >= self.queue_depth_threshold):
+                    bad.add(p["addr"])
+            # never let gossip rumors blackhole OUR OWN endpoint for the
+            # local picker: local breakers already own that verdict
+            bad.discard(self.rpc_address)
+        except Exception:  # noqa: BLE001 — telemetry must not raise
+            return
+        self._unhealthy = frozenset(bad)
+
+    def suspect(self, endpoint: str) -> bool:
+        """Hot-path probe for ``ServiceRegistry.pick``: is this endpoint
+        flagged unhealthy by gossiped remote state? Pure set membership."""
+        return endpoint in self._unhealthy
+
+    def unhealthy_endpoints(self) -> List[str]:
+        return sorted(self._unhealthy)
+
+    # ---------------- federation (scatter-gather) ----------------------------
+
+    async def _scatter(self, method: str, payload: dict,
+                       timeout_s: float) -> Dict[str, dict]:
+        """Call ``cluster-obs/<method>`` on every fresh peer under one
+        deadline budget; per-node failures degrade to error rows instead
+        of failing the whole view (an operator debugging a sick node
+        needs the healthy ones' answer MORE)."""
+        from ..resilience.policy import deadline_scope
+        if self.registry is None:
+            return {}
+        peers = {n: p for n, p in self.peers().items()
+                 if p["addr"] and not p["stale"]}
+
+        async def one(addr: str):
+            out = await self.registry.client_for(addr).call(
+                SERVICE, method, json.dumps(payload).encode(),
+                timeout=timeout_s)
+            return json.loads(out)
+
+        results: Dict[str, dict] = {}
+        with deadline_scope(timeout_s):
+            done = await asyncio.gather(
+                *(one(p["addr"]) for p in peers.values()),
+                return_exceptions=True)
+        for node, res in zip(peers, done):
+            if isinstance(res, BaseException):
+                results[node] = {"error": repr(res)}
+            else:
+                results[node] = res
+        return results
+
+    async def federated_tenants(self, timeout_s: float = 2.0,
+                                top_k: int = 0) -> dict:
+        """``GET /cluster/tenants``: per-tenant RED merged across every
+        node (bucket-wise histogram merge), plus per-node fetch status.
+
+        A peer running a different ``BIFROMQ_OBS_WINDOW_S`` has its
+        scalar totals rescaled to the coordinator's window before the
+        merge, so the derived rates stay true; its histogram BUCKETS
+        merge raw (quantiles are window-agnostic, only the absolute
+        stage counts then span mixed windows)."""
+        hub = self.hub
+        window_s = hub.windows.window_s
+        local_raw = hub.windows.raw_snapshot() if hub.enabled else {}
+        raws = [local_raw]
+        nodes = {self.node_id: "local"}
+        for node, res in (await self._scatter(
+                "tenants", {}, timeout_s)).items():
+            if "error" in res:
+                nodes[node] = f"error: {res['error']}"
+                continue
+            nodes[node] = "ok"
+            raw = res.get("tenants") or {}
+            peer_w = float(res.get("window_s") or window_s)
+            if peer_w > 0 and peer_w != window_s:
+                scale = window_s / peer_w
+                raw = {t: {**r, **{k: r.get(k, 0.0) * scale
+                                   for k in _RAW_SCALARS}}
+                       for t, r in raw.items()}
+                nodes[node] = f"ok (window_s={peer_w:g}, rescaled)"
+            raws.append(raw)
+        merged = merge_tenant_raws(raws)
+        rows = {t: derive_red_row(r, window_s) for t, r in merged.items()}
+        if top_k > 0:
+            keep = sorted(rows, key=lambda t: -rows[t]["rate_per_s"])[:top_k]
+            rows = {t: rows[t] for t in keep}
+        return {"window_s": window_s, "nodes": nodes, "tenants": rows}
+
+    async def federated_trace(self, trace_id: str,
+                              timeout_s: float = 2.0) -> dict:
+        """``GET /cluster/trace/<id>``: assemble the full cross-process
+        trace — every peer's span rings queried for the id, spans merged
+        with the local ring's and ordered by the causal HLC stamps."""
+        from .. import trace as tr
+        spans = [dict(s, node=self.node_id)
+                 for s in tr.TRACER.export(trace_id=trace_id, limit=1000)]
+        # slow-only captures live in the slow ring exclusively
+        seen = {s["span_id"] for s in spans}
+        for s in tr.TRACER.export(trace_id=trace_id, limit=1000, slow=True):
+            if s["span_id"] not in seen:
+                spans.append(dict(s, node=self.node_id))
+                seen.add(s["span_id"])
+        nodes = {self.node_id: "local"}
+        for node, res in (await self._scatter(
+                "trace_spans", {"trace_id": trace_id},
+                timeout_s)).items():
+            if "error" in res:
+                nodes[node] = f"error: {res['error']}"
+                continue
+            nodes[node] = "ok"
+            for s in res.get("spans") or []:
+                if s.get("span_id") not in seen:
+                    spans.append(dict(s, node=res.get("node", node)))
+                    seen.add(s.get("span_id"))
+        spans.sort(key=lambda s: s.get("start_hlc", 0))
+        return {"trace_id": trace_id,
+                "count": len(spans),
+                "nodes": nodes,
+                "processes": len({s.get("node") for s in spans}),
+                "spans": spans}
+
+    # ---------------- lifecycle ----------------------------------------------
+
+    def start(self) -> None:
+        """Publish the first digest and ride the ObsHub advisory tick for
+        refreshes (refcounted — shares the tick with the throttler
+        advisory)."""
+        if self._started:
+            return
+        self._started = True
+        self.refresh()
+        self.agent_host.on_change(self._recompute)
+        self.hub.on_advisory_tick(self.refresh)
+        self.hub.start_advisory_tick(self.interval_s)
+
+    async def stop(self) -> None:
+        if not self._started:
+            return
+        self._started = False
+        self.hub.remove_advisory_hook(self.refresh)
+        await self.hub.stop_advisory_tick()
+        remove = getattr(self.agent_host, "remove_on_change", None)
+        if remove is not None:
+            remove(self._recompute)
+        try:
+            self.agent_host.stop_agent(AGENT_ID)
+        except Exception:  # noqa: BLE001 — host may already be stopped
+            pass
+
+
+# ---------------------------------------------------------------------------
+# the RPC service every node serves (the scatter-gather's far end)
+# ---------------------------------------------------------------------------
+
+class ClusterObsRPCService:
+    """Serves this node's raw tenant windows and span rings to peers."""
+
+    def __init__(self, view: ClusterView) -> None:
+        self.view = view
+
+    def register(self, server) -> None:
+        server.register(SERVICE, {
+            "tenants": self._tenants,
+            "trace_spans": self._trace_spans,
+            "digest": self._digest,
+        })
+
+    async def _tenants(self, payload: bytes, okey: str) -> bytes:
+        hub = self.view.hub
+        return json.dumps({
+            "node": self.view.node_id,
+            "window_s": hub.windows.window_s,
+            "tenants": hub.windows.raw_snapshot() if hub.enabled else {},
+        }).encode()
+
+    async def _trace_spans(self, payload: bytes, okey: str) -> bytes:
+        from .. import trace as tr
+        try:
+            args = json.loads(payload.decode() or "{}")
+        except ValueError:
+            args = {}
+        tid = args.get("trace_id")
+        limit = int(args.get("limit", 1000))
+        spans = tr.TRACER.export(trace_id=tid, limit=limit)
+        seen = {s["span_id"] for s in spans}
+        for s in tr.TRACER.export(trace_id=tid, limit=limit, slow=True):
+            if s["span_id"] not in seen:
+                spans.append(s)
+                seen.add(s["span_id"])
+        return json.dumps({"node": self.view.node_id,
+                           "spans": spans}).encode()
+
+    async def _digest(self, payload: bytes, okey: str) -> bytes:
+        return json.dumps({"node": self.view.node_id,
+                           "digest": self.view.build_digest()}).encode()

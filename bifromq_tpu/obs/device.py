@@ -39,6 +39,13 @@ class DeviceGauges:
         """Track a BatchCallScheduler's live queue depth (weakly held)."""
         self._schedulers.add(scheduler)
 
+    @property
+    def peak_memory_bytes(self) -> int:
+        """High-water device memory (ISSUE 5): last probed peak, readable
+        without triggering a fresh jax probe — the gossip digest refreshes
+        every second and must never block on the device tunnel."""
+        return self._mem_peak_bytes
+
     # ---------------- probes ------------------------------------------------
 
     def _compile_stats(self) -> Dict[str, float]:

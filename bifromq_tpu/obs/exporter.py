@@ -29,6 +29,10 @@ from ..resilience.policy import RetryPolicy
 
 EXPORT_RETRY = RetryPolicy(max_attempts=3, base_delay=0.05, max_delay=1.0)
 
+# telemetry record schema generation (ISSUE 5 satellite): bumped when the
+# line format changes shape, so federated sinks can route per version
+SCHEMA_VERSION = "bifromq-tpu.telemetry/1"
+
 
 class FileSink:
     """Append JSON lines to a local file (fsync-free: the OS page cache is
@@ -102,6 +106,7 @@ class TelemetryExporter:
                  snapshot_fn: Optional[Callable[[], dict]] = None,
                  export_sampled: bool = False,
                  retry: RetryPolicy = EXPORT_RETRY,
+                 resource: Optional[Dict] = None,
                  clock: Callable[[], float] = time.time) -> None:
         self.sink = sink
         self.interval_s = interval_s
@@ -110,6 +115,10 @@ class TelemetryExporter:
         self.snapshot_fn = snapshot_fn
         self.export_sampled = export_sampled
         self.retry = retry
+        # resource envelope (ISSUE 5 satellite): node/cluster identity +
+        # schema version stamped on every record, so a federated sink
+        # ingesting many brokers' lines can attribute each one
+        self.resource = resource
         self._clock = clock
         self._queue: deque = deque()
         self._task: Optional[asyncio.Task] = None
@@ -136,6 +145,8 @@ class TelemetryExporter:
     def enqueue(self, record: Dict) -> None:
         """Bounded enqueue: past the cap the OLDEST record is evicted (the
         newest telemetry is the one an operator is paging through)."""
+        if self.resource is not None:
+            record.setdefault("resource", self.resource)
         if len(self._queue) >= self.queue_cap:
             self._queue.popleft()
             self.dropped += 1
@@ -263,6 +274,7 @@ class TelemetryExporter:
 
     def snapshot(self) -> dict:
         return {"sink": self.sink.describe(),
+                "resource": self.resource,
                 "interval_s": self.interval_s,
                 "queue_depth": len(self._queue),
                 "queue_cap": self.queue_cap,

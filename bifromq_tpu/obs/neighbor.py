@@ -36,6 +36,11 @@ class NoisyNeighborDetector:
     W_QUEUE_WAIT = 0.4
     W_ERRORS = 0.2
 
+    # knobs a per-tenant SLO override may carry (ISSUE 5 satellite:
+    # closes the "detector weights are constants" follow-up)
+    TENANT_KNOBS = frozenset({"noisy_threshold", "slow_p99_ms",
+                              "w_fanout", "w_queue_wait", "w_errors"})
+
     def __init__(self, slo: TenantSLO, *,
                  noisy_threshold: float = 0.5,
                  slow_p99_ms: float = 1000.0,
@@ -45,6 +50,15 @@ class NoisyNeighborDetector:
         self.slo = slo
         self.noisy_threshold = noisy_threshold
         self.slow_p99_ms = slow_p99_ms
+        # blend weights are runtime-configurable (PUT /obs / broker
+        # config); the class constants stay as the documented defaults
+        self.w_fanout = self.W_FANOUT
+        self.w_queue_wait = self.W_QUEUE_WAIT
+        self.w_errors = self.W_ERRORS
+        # tenant → {knob: value} overrides (a latency-sensitive tenant
+        # can run a tighter slow SLO; a fan-out-heavy-by-design tenant a
+        # higher noisy threshold) — consulted per row in _row
+        self.tenant_overrides: Dict[str, Dict[str, float]] = {}
         # a tenant must carry real traffic before it can be flagged —
         # shares of a near-empty window are noise, not neighbors
         self.min_rate_per_s = min_rate_per_s
@@ -55,32 +69,69 @@ class NoisyNeighborDetector:
         # flag cache for the throttler advisory (refreshed by evaluate())
         self._noisy: Set[str] = set()
         self._flags_at = -1e18
+        self._last_rows: List[dict] = []
         self.advisory_ttl_s = 1.0
         # ISSUE 4 satellite: with a background refresh armed
         # (ObsHub.start_advisory_tick), is_noisy skips the lazy TTL
         # evaluation entirely — the guard path is a set probe
         self.tick_armed = False
 
+    # ---------------- per-tenant config (ISSUE 5 satellite) -----------------
+
+    def configure_tenant(self, tenant: str, **knobs: float) -> None:
+        """Install (merge) per-tenant SLO knobs. Unknown knob names raise
+        ``ValueError`` at the admin boundary — a typo must not silently
+        leave the default in force."""
+        bad = set(knobs) - self.TENANT_KNOBS
+        if bad:
+            raise ValueError(f"unknown detector knob(s) {sorted(bad)} "
+                             f"(one of {sorted(self.TENANT_KNOBS)})")
+        cfg = self.tenant_overrides.setdefault(tenant, {})
+        cfg.update({k: float(v) for k, v in knobs.items()})
+
+    def clear_tenant(self, tenant: str) -> None:
+        self.tenant_overrides.pop(tenant, None)
+
+    def config_snapshot(self) -> dict:
+        """The effective detector config (``GET /obs``)."""
+        return {"noisy_threshold": self.noisy_threshold,
+                "slow_p99_ms": self.slow_p99_ms,
+                "weights": {"fanout": self.w_fanout,
+                            "queue_wait": self.w_queue_wait,
+                            "errors": self.w_errors},
+                "tenant_overrides": {t: dict(c) for t, c
+                                     in self.tenant_overrides.items()}}
+
+    def _knob(self, tenant: str, name: str, default: float) -> float:
+        cfg = self.tenant_overrides.get(tenant)
+        if cfg is None:
+            return default
+        return cfg.get(name, default)
+
     # ---------------- scoring ----------------------------------------------
 
     def _row(self, tenant: str, s: dict, totals: Dict[str, float],
              n_active: int) -> dict:
-        """Score one tenant's windowed snapshot into a ranked row."""
+        """Score one tenant's windowed snapshot into a ranked row, under
+        that tenant's effective (default or overridden) knobs."""
         fan_share = (s["fanout_per_s"] * self.slo.window_s
                      / totals["fanout"]) if totals["fanout"] else 0.0
         wait_share = (s["queue_wait_s"] / totals["queue_wait_s"]
                       if totals["queue_wait_s"] else 0.0)
         err = min(1.0, s["error_rate"])
-        score = (self.W_FANOUT * fan_share
-                 + self.W_QUEUE_WAIT * wait_share
-                 + self.W_ERRORS * err)
+        score = (self._knob(tenant, "w_fanout", self.w_fanout) * fan_share
+                 + self._knob(tenant, "w_queue_wait",
+                              self.w_queue_wait) * wait_share
+                 + self._knob(tenant, "w_errors", self.w_errors) * err)
         flags = []
         eligible = s["rate_per_s"] >= self.min_rate_per_s
         if (eligible and n_active >= 2
-                and score >= self.noisy_threshold):
+                and score >= self._knob(tenant, "noisy_threshold",
+                                        self.noisy_threshold)):
             flags.append("noisy")
         ingest_p99 = s["stages"].get("ingest", {}).get("p99_ms", 0.0)
-        if eligible and ingest_p99 >= self.slow_p99_ms:
+        if eligible and ingest_p99 >= self._knob(tenant, "slow_p99_ms",
+                                                 self.slow_p99_ms):
             flags.append("slow")
         return {"tenant": tenant,
                 "score": round(score, 4),
@@ -105,11 +156,22 @@ class NoisyNeighborDetector:
                                  r["tenant"]))
         self._noisy = {r["tenant"] for r in rows if "noisy" in r["flags"]}
         self._flags_at = self._clock()
+        # full ranked rows from the latest evaluation: consumers running
+        # right after a tick (the cluster digest) reuse them instead of
+        # paying a second whole-registry scoring pass (ISSUE 5)
+        self._last_rows = rows
         if emit:
             for r in rows:
                 for flag in r["flags"]:
                     self._emit(flag, r)
         return rows[:top_k]
+
+    def recent_rows(self, max_age_s: float) -> Optional[List[dict]]:
+        """The last evaluation's FULL ranked rows, if no older than
+        ``max_age_s`` — None forces the caller to evaluate itself."""
+        if self._clock() - self._flags_at <= max_age_s:
+            return self._last_rows
+        return None
 
     def score_tenant(self, tenant: str) -> Optional[dict]:
         """One tenant's ranked row without evaluating every other tenant
@@ -179,3 +241,5 @@ class NoisyNeighborDetector:
         self._last_emit.clear()
         self._noisy = set()
         self._flags_at = -1e18
+        self._last_rows = []
+        self.tenant_overrides.clear()

@@ -154,6 +154,36 @@ class TenantSLO:
                 out[tenant] = snap
         return out
 
+    def raw_tenant(self, tenant: str) -> dict:
+        """One tenant's UN-derived window state (ISSUE 5): scalar totals
+        plus per-stage merged log2 bucket arrays — the federation unit
+        ``/cluster/tenants`` merges bucket-wise across nodes (derived
+        percentiles cannot be merged; buckets add exactly)."""
+        w = self._tenants.get(tenant)
+        if w is None:
+            return {}
+        stages = {}
+        for name, h in w.stages.items():
+            b = h.merged()
+            if any(b):
+                stages[name] = b
+        return {"flows": w.flows.total(),
+                "errors": w.errors.total(),
+                "fanout": w.fanout.total(),
+                "queue_wait_s": round(w.queue_wait_s.total(), 6),
+                "cache_hits": w.cache_hits.total(),
+                "cache_misses": w.cache_misses.total(),
+                "stages": stages}
+
+    def raw_snapshot(self) -> Dict[str, dict]:
+        out = {}
+        for tenant in list(self._tenants):
+            r = self.raw_tenant(tenant)
+            if r and (r["flows"] or r["fanout"] or r["queue_wait_s"]
+                      or r["stages"]):
+                out[tenant] = r
+        return out
+
     def active_count(self) -> int:
         """Tenants with live flow traffic in the window — counter sums
         only, no histogram merges (cheap enough for per-request use)."""

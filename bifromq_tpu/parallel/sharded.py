@@ -42,6 +42,24 @@ REPLICA_AXIS = "replica"
 SHARD_AXIS = "shard"
 
 
+def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """Version-compat shard_map across three jax API generations: the
+    image's 0.4.x has only ``jax.experimental.shard_map`` with
+    ``check_rep``; mid versions expose top-level ``jax.shard_map`` still
+    with ``check_rep``; current ones renamed it ``check_vma``. Probe the
+    signature rather than the module path."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    import inspect
+    try:
+        has_vma = "check_vma" in inspect.signature(sm).parameters
+    except (TypeError, ValueError):  # C-accelerated / wrapped callables
+        has_vma = True
+    kw = {"check_vma": check_vma} if has_vma else {"check_rep": check_vma}
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
 def tenant_shard(tenant_id: str, n_shards: int) -> int:
     """Stable tenant → shard assignment (≈ range ownership by tenant prefix)."""
     d = hashlib.blake2b(tenant_id.encode("utf-8"), digest_size=4).digest()
@@ -186,7 +204,7 @@ def make_match_step(mesh: Mesh, *, probe_len: int, k_states: int = 32,
 
     table_spec = P(SHARD_AXIS)
     probe_spec = P(REPLICA_AXIS, SHARD_AXIS)
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         local_step, mesh=mesh,
         in_specs=(table_spec, table_spec, table_spec,
                   probe_spec, probe_spec, probe_spec, probe_spec, probe_spec),

@@ -149,3 +149,10 @@ class BreakerRegistry:
 
     def snapshot(self) -> Dict[str, dict]:
         return {addr: b.snapshot() for addr, b in self._breakers.items()}
+
+    def states(self, include_closed: bool = True) -> Dict[str, str]:
+        """Compact endpoint → state map (ISSUE 5: the gossip health
+        digest). ``include_closed=False`` drops CLOSED entries — absent
+        means healthy, keeping the UDP gossip payload small."""
+        return {addr: b.state for addr, b in self._breakers.items()
+                if include_closed or b.state != CLOSED}

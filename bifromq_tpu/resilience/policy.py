@@ -104,6 +104,8 @@ _IDEMPOTENT: Set[Tuple[str, str]] = {
     ("session-dict", "exist"),
     ("session-dict", "clients"),
     ("session-dict", "inbox_state"),
+    # the federated observability plane (ISSUE 5) is read-only end to end
+    ("cluster-obs", "*"),
 }
 
 

@@ -654,6 +654,12 @@ class ServiceRegistry:
         # (weakly held — a test-scoped registry dies with its owner)
         from ..utils.metrics import FABRIC as _FABRIC
         _FABRIC.register_breakers(self.breakers)
+        # gossiped remote health (ISSUE 5): an object with
+        # ``suspect(endpoint) -> bool`` (obs.clusterview.ClusterView) —
+        # pick() demotes endpoints the CLUSTER says are unhealthy (a
+        # peer's open breaker, a self-reported deep dispatch queue)
+        # before any local failure is observed
+        self.remote_health = None
         self._static: Dict[str, List[str]] = {}
         self._clients: Dict[str, RPCClient] = {}
         # traffic governor state (≈ IRPCServiceTrafficGovernor.java:29):
@@ -796,17 +802,30 @@ class ServiceRegistry:
         falls over to the next-ranked live server (ISSUE 1 failover);
         ``exclude`` additionally masks endpoints a retrying caller already
         failed against THIS call. Candidate tiers degrade gracefully:
-        (1) breaker-available and not excluded, (2) breaker-available —
+        (1) locally available AND clear of gossiped remote health flags
+        (ISSUE 5: a peer's open breaker or a node's self-reported deep
+        dispatch queue demotes it here, before any local failure),
+        (2) breaker-available and not excluded, (3) breaker-available —
         a retry that has failed against EVERY endpoint must prefer a
-        live-looking one over a known-open circuit, (3) everything
+        live-looking one over a known-open circuit, (4) everything
         (total outage stays no worse than before breakers existed)."""
         eps = self.endpoints(service)
         if not eps:
             return None
         available = [ep for ep in eps if self.breakers.available(ep)]
-        live = (available if exclude is None
-                else [ep for ep in available if ep not in exclude])
-        eps = live or available or eps
+        healthy = available
+        rh = self.remote_health
+        if rh is not None:
+            try:
+                healthy = [ep for ep in available if not rh.suspect(ep)]
+            except Exception:  # noqa: BLE001 — advisory only: routing
+                healthy = available  # must survive a telemetry bug
+        if exclude:
+            tier1 = [ep for ep in healthy if ep not in exclude]
+            tier2 = [ep for ep in available if ep not in exclude]
+        else:
+            tier1, tier2 = healthy, available
+        eps = tier1 or tier2 or available or eps
         directive = self._directive_for(service, key)
         if directive is not None:
             weighted = [ep for ep in eps

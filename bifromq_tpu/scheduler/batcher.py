@@ -129,6 +129,7 @@ class Batcher(Generic[CallT, ResultT]):
         calls = [b[0] for b in batch]
         start = time.perf_counter()
         rep_ctx = None
+        links: List[Tuple[int, int]] = []
         if self._stage is not None:
             # enqueue→emit queue-wait per call, stamped at EMIT time with
             # the batch shape the adaptive cap produced
@@ -141,6 +142,11 @@ class Batcher(Generic[CallT, ResultT]):
                 if tctx is not None:
                     if rep_ctx is None:
                         rep_ctx = tctx
+                    elif len(links) < trace.LINK_CAP:
+                        # every LATER sampled caller becomes a span link
+                        # on the batch-emit span below, so its trace still
+                        # reaches the device work it shared
+                        links.append((tctx.trace_id, tctx.span_id))
                     trace.record_finished(
                         "batch.queue_wait", tctx, start_hlc=shlc,
                         duration_s=wait,
@@ -151,9 +157,20 @@ class Batcher(Generic[CallT, ResultT]):
                 # a batch aggregates many callers' traces; run the
                 # processing under the FIRST sampled caller's context as
                 # the representative parent (and clear any stale context
-                # this task inherited from whichever submit() spawned it)
+                # this task inherited from whichever submit() spawned it).
+                # With MORE than one sampled caller, a "batch.emit" span
+                # records the others as links (multi-parent causality —
+                # the single-caller common case pays nothing extra).
                 with trace.activate(rep_ctx):
-                    results = await self._process(calls)
+                    if links:
+                        sp = trace.span("batch.emit",
+                                        batch_size=len(batch),
+                                        cap=self._cap, stage=self._stage)
+                        sp.set_links(links)
+                        with sp:
+                            results = await self._process(calls)
+                    else:
+                        results = await self._process(calls)
             else:
                 results = await self._process(calls)
             elapsed = time.perf_counter() - start

@@ -71,7 +71,37 @@ class Standalone:
         self.agent_host = None
         self.rpc_server = None
         self.metrics_registry = None
+        self.clusterview = None
         self._isolated_hosts = []
+
+    @staticmethod
+    def _apply_obs_config(ocfg: dict) -> None:
+        """YAML ``obs:`` section → detector knobs (ISSUE 5 satellite):
+        process defaults, blend weights, and per-tenant SLO overrides.
+
+            obs:
+              noisy_threshold: 0.5
+              slow_p99_ms: 1000
+              weights: {fanout: 0.4, queue_wait: 0.4, errors: 0.2}
+              tenants:
+                latency-sensitive-tenant: {slow_p99_ms: 150}
+        """
+        from .obs import OBS
+        det = OBS.detector
+        if "noisy_threshold" in ocfg:
+            det.noisy_threshold = float(ocfg["noisy_threshold"])
+        if "slow_p99_ms" in ocfg:
+            det.slow_p99_ms = float(ocfg["slow_p99_ms"])
+        weights = ocfg.get("weights") or {}
+        for key, attr in (("fanout", "w_fanout"),
+                          ("queue_wait", "w_queue_wait"),
+                          ("errors", "w_errors")):
+            if key in weights:
+                setattr(det, attr, float(weights[key]))
+        for tenant, knobs in (ocfg.get("tenants") or {}).items():
+            det.configure_tenant(str(tenant),
+                                 **{k: float(v)
+                                    for k, v in (knobs or {}).items()})
 
     @staticmethod
     def _load_plugins(pcfg: dict) -> dict:
@@ -148,6 +178,11 @@ class Standalone:
         cfg = self.config
         mqtt_cfg = cfg.get("mqtt", {})
         host = mqtt_cfg.get("host", "127.0.0.1")
+        if cfg.get("obs"):
+            # detector knobs + per-tenant SLO overrides: applied before
+            # the broker starts so the exporter/detector see them from
+            # the first record
+            self._apply_obs_config(cfg["obs"])
         engine = None
         if cfg.get("data_dir"):
             from .kv.native import NativeKVEngine
@@ -182,12 +217,25 @@ class Standalone:
                 else:
                     tls_cli.check_hostname = False
                     tls_cli.verify_mode = ssl_mod.CERT_NONE
+            # optional SWIM timing overrides (ISSUE 5):
+            #   cluster: {probe_timeout_s: 0.5, suspect_timeout_s: 3.0, …}
+            timing = {k: float(cluster_cfg[k]) for k in
+                      ("probe_interval_s", "probe_timeout_s",
+                       "suspect_timeout_s", "dead_reap_s")
+                      if k in cluster_cfg}
             self.agent_host = AgentHost(
                 cluster_cfg.get("node_id", "node"),
                 host=host, port=int(cluster_cfg.get("port", 0)),
-                seeds=seeds, tls_server_ctx=tls_srv, tls_client_ctx=tls_cli)
+                seeds=seeds, tls_server_ctx=tls_srv, tls_client_ctx=tls_cli,
+                **timing)
             await self.agent_host.start()
             registry = ServiceRegistry(agent_host=self.agent_host)
+            # identity for the telemetry resource envelope (ISSUE 5
+            # satellite) — pinned before the broker starts the exporter
+            from .obs import OBS
+            OBS.set_identity(
+                node_id=self.agent_host.node_id,
+                cluster_id=str(cluster_cfg.get("cluster_id", "") or ""))
 
         # dist-plane role (clustered deployments): a "remote" frontend's
         # route table lives on "worker" nodes discovered over gossip —
@@ -302,6 +350,17 @@ class Standalone:
             self.broker.dist.server_id = self.broker.server_id
             self.broker.session_dict = SessionDictClient(
                 registry, self_address=self.rpc_server.address)
+            # cluster observability plane (ISSUE 5): publish this node's
+            # health digest over gossip, serve the scatter-gather RPC
+            # surface, and let pick() consult gossiped remote health
+            from .obs.clusterview import (ClusterObsRPCService,
+                                          ClusterView)
+            self.clusterview = ClusterView(
+                self.agent_host.node_id, self.agent_host,
+                registry=registry, rpc_address=self.rpc_server.address)
+            ClusterObsRPCService(self.clusterview).register(
+                self.rpc_server)
+            registry.remote_health = self.clusterview
 
         api_cfg = cfg.get("api")
         if api_cfg:
@@ -310,13 +369,21 @@ class Standalone:
                                  metrics=self.metrics_registry,
                                  host=host,
                                  port=int(api_cfg.get("port", 9090)),
-                                 registry=registry)
+                                 registry=registry,
+                                 cluster=self.agent_host,
+                                 clusterview=self.clusterview)
             await self.api.start()
+        if self.clusterview is not None:
+            if self.api is not None:
+                self.clusterview.api_port = self.api.port
+            self.clusterview.start()
         log.info("standalone up: mqtt=%s:%s%s%s", host, self.broker.port,
                  f" ws={self.broker.ws_port}" if ws else "",
                  f" api={self.api.port}" if self.api else "")
 
     async def stop(self) -> None:
+        if self.clusterview is not None:
+            await self.clusterview.stop()
         if self.api is not None:
             await self.api.stop()
         if self.rpc_server is not None:

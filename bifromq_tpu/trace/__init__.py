@@ -17,11 +17,11 @@ carries contexts across processes; the API server serves the rings at
 from .recorder import SpanRing
 from .sampler import TenantSampler
 from .span import Span, SpanContext, decode_ctx, new_id
-from .tracer import (NOOP, TRACER, Tracer, activate, current_ctx, extract,
-                     inject, record_finished, span)
+from .tracer import (LINK_CAP, NOOP, TRACER, Tracer, activate, current_ctx,
+                     extract, inject, record_finished, span)
 
 __all__ = [
-    "NOOP", "TRACER", "Tracer", "Span", "SpanContext", "SpanRing",
-    "TenantSampler", "activate", "current_ctx", "decode_ctx", "extract",
-    "inject", "new_id", "record_finished", "span",
+    "LINK_CAP", "NOOP", "TRACER", "Tracer", "Span", "SpanContext",
+    "SpanRing", "TenantSampler", "activate", "current_ctx", "decode_ctx",
+    "extract", "inject", "new_id", "record_finished", "span",
 ]

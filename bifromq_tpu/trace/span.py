@@ -92,9 +92,14 @@ class Span:
     duration_ms: float
     status: str = "ok"           # ok | error
     tags: Dict[str, object] = field(default_factory=dict)
+    # multi-parent causality (ISSUE 5 satellite): a batch-emit span
+    # parents under ONE representative caller but links every other
+    # sampled caller's (trace_id, span_id) — OpenTelemetry span-link
+    # semantics, bounded by the recorder
+    links: tuple = ()
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "name": self.name,
             "trace_id": f"{self.trace_id:016x}",
             "span_id": f"{self.span_id:016x}",
@@ -110,3 +115,8 @@ class Span:
             "status": self.status,
             "tags": self.tags,
         }
+        if self.links:
+            out["links"] = [{"trace_id": f"{t:016x}",
+                             "span_id": f"{s:016x}"}
+                            for t, s in self.links]
+        return out

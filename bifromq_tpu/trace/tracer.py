@@ -71,15 +71,23 @@ class _NoopSpan:
     def set_tag(self, key: str, value) -> None:
         pass
 
+    def set_links(self, links) -> None:
+        pass
+
 
 NOOP = _NoopSpan()
+
+# a span links at most this many extra callers (ISSUE 5 satellite: one
+# pathological batch must not bloat a ring slot). THE bound — the batcher
+# collects against it too.
+LINK_CAP = 16
 
 
 class _LiveSpan:
     """A recording span: installs its context on enter, materializes a
     ``Span`` into the tracer's ring on exit."""
 
-    __slots__ = ("_tracer", "name", "ctx", "parent_id", "tags",
+    __slots__ = ("_tracer", "name", "ctx", "parent_id", "tags", "links",
                  "start_hlc", "_t0", "_token", "_ring_mark")
     sampled = True
 
@@ -90,6 +98,7 @@ class _LiveSpan:
         self.ctx = SpanContext(trace_id, new_id(), True, tenant)
         self.parent_id = parent_id
         self.tags = tags
+        self.links: tuple = ()
 
     def __enter__(self) -> "_LiveSpan":
         self._token = _CTX.set(self.ctx)
@@ -108,6 +117,11 @@ class _LiveSpan:
     def set_tag(self, key: str, value) -> None:
         self.tags[key] = value
 
+    def set_links(self, links) -> None:
+        """Record additional sampled callers as (trace_id, span_id) span
+        links (bounded): the batch-emit multi-parent satellite."""
+        self.links = tuple(links)[:LINK_CAP]
+
     def __exit__(self, exc_type, exc, tb) -> bool:
         duration = time.perf_counter() - self._t0
         _CTX.reset(self._token)
@@ -120,7 +134,7 @@ class _LiveSpan:
             start_hlc=self.start_hlc, end_hlc=HLC.INST.get(),
             duration_ms=duration * 1e3,
             status="error" if exc_type is not None else "ok",
-            tags=self.tags), ring_mark=self._ring_mark)
+            tags=self.tags, links=self.links), ring_mark=self._ring_mark)
         return False
 
 
@@ -150,6 +164,9 @@ class _UnsampledRoot:
 
     def set_tag(self, key: str, value) -> None:
         self.tags[key] = value
+
+    def set_links(self, links) -> None:
+        pass
 
     def __exit__(self, exc_type, exc, tb) -> bool:
         duration_ms = (time.perf_counter() - self._t0) * 1e3

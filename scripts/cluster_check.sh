@@ -1,0 +1,233 @@
+#!/usr/bin/env bash
+# Tier-2 cluster observability gate (ISSUE 5): boots a REAL 3-node starter
+# cluster (one dist-worker + two remote frontends, gossip membership, API
+# servers), then asserts the federated plane end to end:
+#   1. GET /cluster on every node shows all 3 members alive with fresh
+#      health digests.
+#   2. An induced brownout — a probe process joins gossip and, using the
+#      PR-1 wire FaultInjector, fails its calls to one node until its
+#      circuit opens — shifts ServiceRegistry.pick on the OTHER nodes away
+#      from the browned-out endpoint (observed via GET /cluster/route)
+#      with zero local failures there.
+#   3. A sampled cross-node publish yields GET /cluster/trace/<id> with
+#      spans from >= 2 OS processes, HLC-ordered.
+# Runs on CPU (JAX_PLATFORMS=cpu), hard timeout like the other gates.
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+
+WORKDIR="$(mktemp -d /tmp/cluster_check_XXXX)"
+trap 'kill $(cat "$WORKDIR"/*.pid 2>/dev/null) 2>/dev/null; rm -rf "$WORKDIR"' EXIT
+
+timeout -k 10 "${CLUSTER_CHECK_TIMEOUT:-300}" \
+    env JAX_PLATFORMS=cpu \
+        BIFROMQ_TRACE_SAMPLE=1 \
+        BIFROMQ_CLUSTER_OBS_INTERVAL_S=0.5 \
+        CLUSTER_CHECK_DIR="$WORKDIR" \
+    python - <<'EOF'
+import asyncio, json, os, socket, subprocess, sys
+
+WORKDIR = os.environ["CLUSTER_CHECK_DIR"]
+NODES = ["cn0", "cn1", "cn2"]
+
+
+def free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+async def http(port, path):
+    r, w = await asyncio.open_connection("127.0.0.1", port)
+    w.write(f"GET {path} HTTP/1.1\r\nhost: x\r\ncontent-length: 0\r\n"
+            f"connection: close\r\n\r\n".encode())
+    await w.drain()
+    # read to EOF: one read() returns only the first chunk, and sampled
+    # /trace bodies span many TCP segments
+    raw = b""
+    while True:
+        chunk = await r.read(65536)
+        if not chunk:
+            break
+        raw += chunk
+    w.close()
+    head, _, payload = raw.partition(b"\r\n\r\n")
+    return int(head.split(b" ")[1]), json.loads(payload)
+
+
+async def main():
+    mqtt, api, gossip = free_ports(3), free_ports(3), free_ports(3)
+    env = dict(os.environ)
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+    procs = []
+    for i, node in enumerate(NODES):
+        cfg = {"mqtt": {"host": "127.0.0.1", "tcp": {"port": mqtt[i]}},
+               "api": {"port": api[i]},
+               "cluster": {"node_id": node, "port": gossip[i],
+                           "probe_timeout_s": 0.5,
+                           "suspect_timeout_s": 3.0,
+                           **({"seeds": [f"127.0.0.1:{gossip[0]}"]}
+                              if i else {})},
+               "dist": {"mode": "worker" if i == 0 else "remote"}}
+        path = os.path.join(WORKDIR, f"{node}.yml")
+        open(path, "w").write(json.dumps(cfg))
+        p = subprocess.Popen(
+            [sys.executable, "-m", "bifromq_tpu", "--config", path],
+            env=env, stdout=open(os.path.join(WORKDIR, f"{node}.log"), "w"),
+            stderr=subprocess.STDOUT)
+        open(os.path.join(WORKDIR, f"{node}.pid"), "w").write(str(p.pid))
+        procs.append(p)
+
+    # ---- 1. all nodes alive with fresh digests on every /cluster -------
+    for _ in range(240):
+        ok = 0
+        for port in api:
+            try:
+                _s, body = await http(port, "/cluster")
+            except OSError:
+                break
+            alive = [n for n, m in body.get("members", {}).items()
+                     if m.get("alive") and m.get("digest")
+                     and not m.get("stale")]
+            if len(alive) >= 3:
+                ok += 1
+        if ok == 3:
+            break
+        await asyncio.sleep(0.5)
+    else:
+        print("FAIL: cluster never converged on 3 alive digest-bearing "
+              "members")
+        sys.exit(1)
+    print("ok: /cluster shows 3 alive members with fresh digests "
+          "on every node")
+
+    # ---- 2. induced brownout shifts pick() away ------------------------
+    _s, info = await http(api[0], "/cluster")
+    victim = info["members"][NODES[2]]["addr"]
+    assert victim, info["members"][NODES[2]]
+    baseline = set()
+    for i in range(32):
+        _s, r = await http(api[0],
+                           f"/cluster/route?service=session-dict&key=b{i}")
+        baseline.add(r["endpoint"])
+    if victim not in baseline:
+        print(f"FAIL: sanity — {victim} never picked pre-brownout")
+        sys.exit(1)
+
+    from bifromq_tpu.cluster.membership import AgentHost
+    from bifromq_tpu.obs import ObsHub
+    from bifromq_tpu.obs.clusterview import ClusterView
+    from bifromq_tpu.resilience import faults
+    from bifromq_tpu.rpc.fabric import RPCError, ServiceRegistry
+
+    probe = AgentHost("probe", seeds=[("127.0.0.1", gossip[0])])
+    await probe.start()
+    reg = ServiceRegistry()
+    # the PR-1 wire fault injector browns out the probe→victim path: every
+    # client call errors, so the probe's per-endpoint breaker opens from
+    # REAL recorded failures (not a hand-forced state)
+    rule = faults.get_injector().add_rule(side="client",
+                                          service="session-dict",
+                                          action="error")
+    client = reg.client_for(victim)
+    for _ in range(8):
+        try:
+            await client.call("session-dict", "exist", b"{}", timeout=1.0)
+        except RPCError:
+            pass
+    faults.get_injector().remove_rule(rule)
+    states = reg.breakers.states(include_closed=False)
+    if states.get(victim) != "open":
+        print(f"FAIL: injected faults never opened the breaker: {states}")
+        sys.exit(1)
+    view = ClusterView("probe", probe, hub=ObsHub(), registry=reg)
+    shifted = False
+    for _ in range(60):
+        view.refresh()
+        _s, r = await http(api[0],
+                           "/cluster/route?service=session-dict&key=b0")
+        if victim in r["unhealthy"]:
+            picks = set()
+            for i in range(32):
+                _s, r = await http(
+                    api[0], f"/cluster/route?service=session-dict&key=b{i}")
+                picks.add(r["endpoint"])
+            shifted = victim not in picks
+            break
+        await asyncio.sleep(0.25)
+    await probe.stop()
+    if not shifted:
+        print("FAIL: gossiped open breaker did not shift pick() away "
+              f"from {victim}")
+        sys.exit(1)
+    print(f"ok: fault-injected brownout of {victim} gossiped to cn0 and "
+          "shifted ServiceRegistry.pick away from it")
+
+    # ---- 3. cross-node trace assembly ----------------------------------
+    from bifromq_tpu.mqtt.client import MQTTClient
+    sub = MQTTClient("127.0.0.1", mqtt[1], client_id="cc-s",
+                     username="traced/u")
+    await sub.connect()
+    await sub.subscribe("cc/+/t", qos=1)
+    pub = MQTTClient("127.0.0.1", mqtt[2], client_id="cc-p",
+                     username="traced/u")
+    await pub.connect()
+    delivered = False
+    for _ in range(30):
+        await pub.publish("cc/x/t", b"spanned", qos=0)
+        try:
+            await asyncio.wait_for(sub.messages.get(), 1.0)
+            delivered = True
+            break
+        except asyncio.TimeoutError:
+            continue
+    if not delivered:
+        print("FAIL: publish never crossed the cluster")
+        sys.exit(1)
+    _s, local = await http(api[2], "/trace?limit=1000")
+    ingest = [s for s in local["spans"] if s["name"] == "pub.ingest"
+              and s["tags"].get("topic") == "cc/x/t"]
+    if not ingest:
+        print("FAIL: no sampled pub.ingest span on the publisher node")
+        sys.exit(1)
+    tid = ingest[-1]["trace_id"]
+    tf = None
+    for _ in range(20):
+        _s, tf = await http(api[0], f"/cluster/trace/{tid}")
+        if tf["processes"] >= 2:
+            break
+        await asyncio.sleep(0.5)
+    if tf["processes"] < 2:
+        print(f"FAIL: federated trace covers {tf['processes']} process(es);"
+              f" nodes={tf['nodes']}")
+        sys.exit(1)
+    hlcs = [s["start_hlc"] for s in tf["spans"]]
+    if hlcs != sorted(hlcs):
+        print("FAIL: federated trace is not HLC-ordered")
+        sys.exit(1)
+    print(f"ok: /cluster/trace/{tid} assembled {tf['count']} spans from "
+          f"{tf['processes']} processes, HLC-ordered")
+    await sub.disconnect()
+    await pub.disconnect()
+    for p in procs:
+        p.kill()
+    print("CLUSTER CHECK PASSED")
+
+
+asyncio.run(main())
+EOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "cluster_check FAILED (rc=$rc)"
+    for f in "$WORKDIR"/*.log; do
+        echo "--- $f"; tail -20 "$f"
+    done
+    exit $rc
+fi

@@ -1,0 +1,521 @@
+"""Cluster observability plane (ISSUE 5): digest publish/decode over real
+gossip, stale-digest expiry under a fake clock, bucket-wise federation math
+verified against a single combined histogram, health-aware rendezvous pick,
+per-tenant detector overrides, batch-emit span links, and the exporter's
+resource envelope."""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from bifromq_tpu import trace
+from bifromq_tpu.cluster.membership import AgentHost
+from bifromq_tpu.obs import ObsHub
+from bifromq_tpu.obs.clusterview import (AGENT_ID, SERVICE,
+                                         ClusterObsRPCService, ClusterView,
+                                         derive_red_row, merge_tenant_raws)
+from bifromq_tpu.obs.slo import TenantSLO
+from bifromq_tpu.rpc.fabric import RPCServer, ServiceRegistry
+from bifromq_tpu.utils.hlc import HLC
+
+pytestmark = pytest.mark.asyncio
+
+
+async def wait_for(cond, timeout=8.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if cond():
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError("condition not reached")
+
+
+class FakeHost:
+    """Minimal AgentHost stand-in: just the agent-metadata surface the
+    ClusterView consumes (real-gossip coverage lives in the tests that
+    spin actual AgentHosts)."""
+
+    def __init__(self, node_id="me"):
+        self.node_id = node_id
+        self.agent_meta = {}        # node_id -> meta dict
+        self.members = {}
+        self._listeners = []
+
+    def agent_members(self, agent_id):
+        return dict(self.agent_meta)
+
+    def host_agent(self, agent_id, meta=None):
+        self.agent_meta[self.node_id] = meta or {}
+
+    def stop_agent(self, agent_id):
+        self.agent_meta.pop(self.node_id, None)
+
+    def on_change(self, cb):
+        self._listeners.append(cb)
+
+
+def _peer_digest(**over):
+    d = {"v": 1, "hlc": HLC.INST.get(), "breakers": {},
+         "device": {"dispatch_queue_depth": 0, "batches_in_flight": 0,
+                    "compile_count": 0, "mem_peak_bytes": 0},
+         "match_cache_hit_rate": 0.0, "noisy": []}
+    d.update(over)
+    return d
+
+
+def _fresh_hub(clock=None):
+    kw = {"clock": clock} if clock is not None else {}
+    hub = ObsHub(**kw)
+    hub.enabled = True
+    return hub
+
+
+class TestDigest:
+    async def test_digest_builds_all_fields(self):
+        hub = _fresh_hub()
+        hub.windows.record_flow("loud", 30)
+        hub.windows.record_fanout("loud", 50)
+        reg = ServiceRegistry()
+        reg.breakers.for_endpoint("10.0.0.9:1").force_open()
+        view = ClusterView("n1", FakeHost("n1"), hub=hub, registry=reg,
+                           rpc_address="127.0.0.1:7777")
+        d = view.build_digest()
+        assert d["breakers"] == {"10.0.0.9:1": "open"}
+        assert "dispatch_queue_depth" in d["device"]
+        assert "mem_peak_bytes" in d["device"]
+        assert "match_cache_hit_rate" in d
+        assert d["noisy"] and d["noisy"][0]["tenant"] == "loud"
+        assert HLC.physical(d["hlc"]) > 0
+        # compact: closed breakers are ABSENT, not listed
+        reg.breakers.for_endpoint("10.0.0.8:1")  # stays closed
+        assert "10.0.0.8:1" not in view.build_digest()["breakers"]
+
+    async def test_digest_publish_decode_over_real_gossip(self):
+        """A digest published into agent metadata on one host arrives,
+        intact, in a peer's ClusterView over real loopback UDP gossip."""
+        a = AgentHost("ha")
+        await a.start()
+        b = AgentHost("hb", seeds=[("127.0.0.1", a.port)])
+        await b.start()
+        try:
+            hub = _fresh_hub()
+            reg = ServiceRegistry()
+            reg.breakers.for_endpoint("127.0.0.1:9999").force_open()
+            view_a = ClusterView("ha", a, hub=hub, registry=reg,
+                                 rpc_address="127.0.0.1:5001", api_port=81)
+            view_a.refresh()
+            view_b = ClusterView("hb", b, hub=_fresh_hub())
+            await wait_for(lambda: "ha" in view_b.peers())
+            p = view_b.peers()["ha"]
+            assert p["addr"] == "127.0.0.1:5001"
+            assert p["api"] == 81
+            assert not p["stale"]
+            assert p["age_s"] < 5.0
+            assert p["digest"]["breakers"] == {"127.0.0.1:9999": "open"}
+            # ...and the peer's pick-demotion set reflects it
+            view_b._recompute()
+            assert view_b.suspect("127.0.0.1:9999")
+            # the full member table carries the digest + age
+            table = view_b.cluster_table()
+            assert table["ha"]["alive"] and not table["ha"]["stale"]
+            assert table["ha"]["digest"]["breakers"]
+        finally:
+            await a.stop()
+            await b.stop()
+
+    async def test_stale_digest_expiry_fake_clock(self):
+        """A digest ages out deterministically: past ``stale_after_s`` it
+        is flagged stale and stops feeding the unhealthy set (a dead
+        node's last report says nothing about NOW)."""
+        t0 = time.time()
+        now = [t0]
+        host = FakeHost("me")
+        host.agent_meta["peer"] = {
+            "addr": "127.0.0.1:6000",
+            "digest": _peer_digest(breakers={"127.0.0.1:6001": "open"})}
+        view = ClusterView("me", host, hub=_fresh_hub(),
+                           stale_after_s=5.0, clock=lambda: now[0])
+        view._recompute()
+        assert not view.peers()["peer"]["stale"]
+        assert view.suspect("127.0.0.1:6001")
+        now[0] = t0 + 60.0                      # the peer went silent
+        assert view.peers()["peer"]["stale"]
+        view._recompute()
+        assert not view.suspect("127.0.0.1:6001")
+        # age is receipt-based: a CHANGED stamp resets it even though the
+        # peer's wall clock may be skewed arbitrarily from ours
+        host.agent_meta["peer"]["digest"] = _peer_digest(
+            breakers={"127.0.0.1:6001": "open"})
+        p = view.peers()["peer"]
+        assert p["age_s"] == 0.0 and not p["stale"]
+        view._recompute()
+        assert view.suspect("127.0.0.1:6001")
+        # a digest with no stamp at all is stale by definition
+        host.agent_meta["peer"]["digest"] = {}
+        assert view.peers()["peer"]["stale"]
+
+
+class TestFederationMath:
+    def test_bucketwise_merge_matches_single_combined_histogram(self):
+        """Merging N nodes' raw windows bucket-wise must be EXACTLY what
+        one histogram would report had it observed every sample."""
+        t = [1000.0]
+        clock = lambda: t[0]                          # noqa: E731
+        node_a = TenantSLO(window_s=10.0, clock=clock)
+        node_b = TenantSLO(window_s=10.0, clock=clock)
+        combined = TenantSLO(window_s=10.0, clock=clock)
+        samples_a = [0.001, 0.004, 0.016, 0.064, 0.256]
+        samples_b = [0.002, 0.008, 0.032, 0.128, 0.512, 2.048]
+        for s in samples_a:
+            node_a.record_latency("T", "ingest", s)
+            combined.record_latency("T", "ingest", s)
+            node_a.record_flow("T")
+            combined.record_flow("T")
+        for s in samples_b:
+            node_b.record_latency("T", "ingest", s)
+            combined.record_latency("T", "ingest", s)
+            node_b.record_flow("T")
+            combined.record_flow("T")
+        node_b.record_error("T", 3)
+        combined.record_error("T", 3)
+        merged = merge_tenant_raws([node_a.raw_snapshot(),
+                                    node_b.raw_snapshot()])
+        row = derive_red_row(merged["T"], 10.0)
+        ref = combined.snapshot_tenant("T")
+        assert row["rate_per_s"] == ref["rate_per_s"]
+        assert row["errors_per_s"] == ref["errors_per_s"]
+        assert row["error_rate"] == ref["error_rate"]
+        assert row["stages"]["ingest"] == ref["stages"]["ingest"]
+        # and the raw buckets themselves add exactly
+        raw_c = combined.raw_snapshot()["T"]["stages"]["ingest"]
+        assert merged["T"]["stages"]["ingest"] == raw_c
+
+    def test_merge_disjoint_tenants_is_union(self):
+        merged = merge_tenant_raws([
+            {"a": {"flows": 1, "stages": {}}},
+            {"b": {"flows": 2, "stages": {}}},
+            {"a": {"flows": 4, "stages": {}}},
+        ])
+        assert merged["a"]["flows"] == 5 and merged["b"]["flows"] == 2
+
+
+class TestHealthAwarePick:
+    EPS = ["127.0.0.1:9001", "127.0.0.1:9002", "127.0.0.1:9003"]
+
+    def _registry(self):
+        reg = ServiceRegistry()
+        for ep in self.EPS:
+            reg.announce("svc", ep)
+        return reg
+
+    async def test_gossiped_open_breaker_demotes_endpoint(self):
+        """The acceptance shape: an endpoint some OTHER node's breaker
+        holds open is never picked — with zero local failures observed."""
+        reg = self._registry()
+        host = FakeHost("me")
+        host.agent_meta["peer"] = {
+            "addr": "127.0.0.1:8000",
+            "digest": _peer_digest(breakers={self.EPS[1]: "open"})}
+        view = ClusterView("me", host, hub=_fresh_hub())
+        view._recompute()
+        # sanity: without remote health, some key routes to the endpoint
+        assert any(reg.pick("svc", f"k{i}") == self.EPS[1]
+                   for i in range(64))
+        reg.remote_health = view
+        picks = {reg.pick("svc", f"k{i}") for i in range(64)}
+        assert self.EPS[1] not in picks
+        assert picks <= set(self.EPS)
+        # local breakers never tripped — the demotion was pure gossip
+        assert reg.breakers.states(include_closed=False) == {}
+
+    async def test_deep_dispatch_queue_browns_out_node(self):
+        reg = self._registry()
+        host = FakeHost("me")
+        host.agent_meta["worker"] = {
+            "addr": self.EPS[2],
+            "digest": _peer_digest(
+                device={"dispatch_queue_depth": 999999,
+                        "batches_in_flight": 2, "compile_count": 1,
+                        "mem_peak_bytes": 0})}
+        view = ClusterView("me", host, hub=_fresh_hub(),
+                           queue_depth_threshold=4096)
+        view._recompute()
+        reg.remote_health = view
+        assert view.suspect(self.EPS[2])
+        assert all(reg.pick("svc", f"k{i}") != self.EPS[2]
+                   for i in range(64))
+
+    async def test_all_flagged_falls_back_to_available(self):
+        """Gossip rumors must never blackhole the whole service: with
+        every endpoint flagged, pick degrades to the available tier."""
+        reg = self._registry()
+        host = FakeHost("me")
+        host.agent_meta["peer"] = {
+            "addr": "127.0.0.1:8000",
+            "digest": _peer_digest(
+                breakers={ep: "open" for ep in self.EPS})}
+        view = ClusterView("me", host, hub=_fresh_hub())
+        view._recompute()
+        reg.remote_health = view
+        assert reg.pick("svc", "k") in self.EPS
+
+    async def test_own_endpoint_never_self_flagged(self):
+        host = FakeHost("me")
+        host.agent_meta["peer"] = {
+            "addr": "127.0.0.1:8000",
+            "digest": _peer_digest(breakers={"127.0.0.1:5555": "open"})}
+        view = ClusterView("me", host, hub=_fresh_hub(),
+                           rpc_address="127.0.0.1:5555")
+        view._recompute()
+        assert not view.suspect("127.0.0.1:5555")
+
+    async def test_suspect_errors_never_break_pick(self):
+        reg = self._registry()
+
+        class Broken:
+            def suspect(self, ep):
+                raise RuntimeError("telemetry bug")
+        reg.remote_health = Broken()
+        assert reg.pick("svc", "k") in self.EPS
+
+
+class TestFederatedViews:
+    async def test_federated_tenants_merges_remote_node(self):
+        """Two in-process 'nodes' with SEPARATE hubs: the federated view
+        served from A includes B's tenants, fetched over the fabric."""
+        hub_a, hub_b = _fresh_hub(), _fresh_hub()
+        hub_a.windows.record_flow("only-a", 20)
+        hub_b.windows.record_flow("only-b", 10)
+        hub_b.windows.record_latency("only-b", "ingest", 0.004)
+        hub_a.windows.record_flow("shared", 5)
+        hub_b.windows.record_flow("shared", 7)
+        server = RPCServer()
+        host = FakeHost("A")
+        view_b = ClusterView("B", FakeHost("B"), hub=hub_b)
+        ClusterObsRPCService(view_b).register(server)
+        await server.start()
+        try:
+            host.agent_meta["B"] = {"addr": server.address,
+                                    "digest": _peer_digest()}
+            view_a = ClusterView("A", host, hub=hub_a,
+                                 registry=ServiceRegistry())
+            out = await view_a.federated_tenants()
+            assert out["nodes"] == {"A": "local", "B": "ok"}
+            rows = out["tenants"]
+            assert set(rows) == {"only-a", "only-b", "shared"}
+            assert rows["shared"]["rate_per_s"] == round(12 / 10.0, 3)
+            assert rows["only-b"]["stages"]["ingest"]["count"] == 1
+        finally:
+            await server.stop()
+
+    async def test_federated_tenants_rescales_mismatched_window(self):
+        """A peer on a different BIFROMQ_OBS_WINDOW_S must not inflate
+        merged rates: its scalar totals rescale to the coordinator's
+        window before the merge."""
+        hub_a = _fresh_hub()
+        hub_b = ObsHub(window_s=30.0)
+        hub_b.enabled = True
+        hub_b.windows.record_flow("t", 30)      # 1.0 flow/s over B's 30s
+        server = RPCServer()
+        view_b = ClusterView("B", FakeHost("B"), hub=hub_b)
+        ClusterObsRPCService(view_b).register(server)
+        await server.start()
+        try:
+            host = FakeHost("A")
+            host.agent_meta["B"] = {"addr": server.address,
+                                    "digest": _peer_digest()}
+            view_a = ClusterView("A", host, hub=hub_a,
+                                 registry=ServiceRegistry())
+            out = await view_a.federated_tenants()
+            assert out["nodes"]["B"].startswith("ok (window_s=30")
+            # NOT 30/10 = 3.0: B's totals were rescaled, not re-divided
+            assert out["tenants"]["t"]["rate_per_s"] == 1.0
+        finally:
+            await server.stop()
+
+    async def test_federated_tenants_degrades_on_dead_peer(self):
+        hub_a = _fresh_hub()
+        hub_a.windows.record_flow("local-t", 3)
+        host = FakeHost("A")
+        host.agent_meta["dead"] = {"addr": "127.0.0.1:1",
+                                   "digest": _peer_digest()}
+        view_a = ClusterView("A", host, hub=hub_a,
+                             registry=ServiceRegistry())
+        out = await view_a.federated_tenants(timeout_s=0.5)
+        assert out["nodes"]["dead"].startswith("error")
+        assert "local-t" in out["tenants"]
+
+    async def test_federated_trace_collects_remote_spans(self):
+        trace.TRACER.reset()
+        trace.TRACER.sampler.default_rate = 1.0
+        try:
+            with trace.span("pub.ingest", tenant="t") as root:
+                tid = f"{root.ctx.trace_id:016x}"
+            server = RPCServer()
+            view_b = ClusterView("B", FakeHost("B"), hub=_fresh_hub())
+            ClusterObsRPCService(view_b).register(server)
+            await server.start()
+            try:
+                host = FakeHost("A")
+                host.agent_meta["B"] = {"addr": server.address,
+                                        "digest": _peer_digest()}
+                view_a = ClusterView("A", host, hub=_fresh_hub(),
+                                     registry=ServiceRegistry())
+                out = await view_a.federated_trace(tid)
+                assert out["nodes"]["B"] == "ok"
+                assert [s["name"] for s in out["spans"]] == ["pub.ingest"]
+                # HLC-ordered output (single node here, still sorted)
+                hlcs = [s["start_hlc"] for s in out["spans"]]
+                assert hlcs == sorted(hlcs)
+            finally:
+                await server.stop()
+        finally:
+            trace.TRACER.sampler.default_rate = 0.0
+            trace.TRACER.reset()
+
+
+class TestTenantOverrides:
+    def _slo_with_traffic(self, clock):
+        slo = TenantSLO(window_s=10.0, clock=clock)
+        for tenant in ("a", "b"):
+            for _ in range(20):
+                slo.record_flow(tenant)
+                slo.record_latency(tenant, "ingest", 0.050)
+        return slo
+
+    def test_per_tenant_slow_threshold(self):
+        from bifromq_tpu.obs.neighbor import NoisyNeighborDetector
+        t = [1000.0]
+        slo = self._slo_with_traffic(lambda: t[0])
+        det = NoisyNeighborDetector(slo, slow_p99_ms=1000.0,
+                                    clock=lambda: t[0])
+        rows = {r["tenant"]: r for r in det.evaluate(emit=False)}
+        assert "slow" not in rows["a"]["flags"]
+        det.configure_tenant("a", slow_p99_ms=10.0)
+        rows = {r["tenant"]: r for r in det.evaluate(emit=False)}
+        assert "slow" in rows["a"]["flags"]
+        assert "slow" not in rows["b"]["flags"]
+        det.clear_tenant("a")
+        rows = {r["tenant"]: r for r in det.evaluate(emit=False)}
+        assert "slow" not in rows["a"]["flags"]
+
+    def test_weights_and_threshold_overrides(self):
+        from bifromq_tpu.obs.neighbor import NoisyNeighborDetector
+        t = [1000.0]
+        slo = TenantSLO(window_s=10.0, clock=lambda: t[0])
+        # two tenants, one dominating fan-out
+        for _ in range(20):
+            slo.record_flow("big")
+            slo.record_flow("small")
+        slo.record_fanout("big", 900)
+        slo.record_fanout("small", 100)
+        det = NoisyNeighborDetector(slo, noisy_threshold=0.5,
+                                    clock=lambda: t[0])
+        rows = {r["tenant"]: r for r in det.evaluate(emit=False)}
+        assert "noisy" not in rows["big"]["flags"]   # 0.4*0.9 < 0.5
+        # weight fan-out fully: big crosses, small does not
+        det.w_fanout, det.w_queue_wait, det.w_errors = 1.0, 0.0, 0.0
+        rows = {r["tenant"]: r for r in det.evaluate(emit=False)}
+        assert "noisy" in rows["big"]["flags"]
+        assert "noisy" not in rows["small"]["flags"]
+        # per-tenant threshold raise whitelists the by-design fan-out
+        det.configure_tenant("big", noisy_threshold=0.95)
+        rows = {r["tenant"]: r for r in det.evaluate(emit=False)}
+        assert "noisy" not in rows["big"]["flags"]
+        assert det.config_snapshot()["tenant_overrides"]["big"] == {
+            "noisy_threshold": 0.95}
+
+    def test_unknown_knob_rejected(self):
+        from bifromq_tpu.obs.neighbor import NoisyNeighborDetector
+        det = NoisyNeighborDetector(TenantSLO())
+        with pytest.raises(ValueError):
+            det.configure_tenant("t", bogus_knob=1.0)
+
+
+class TestBatchLinks:
+    async def test_batch_emit_links_every_sampled_caller(self):
+        """ISSUE 5 satellite (closes the PR-2 follow-up): a batch holding
+        several sampled callers records a batch.emit span linking every
+        caller beyond the representative parent."""
+        from bifromq_tpu.scheduler.batcher import Batcher
+        trace.TRACER.reset()
+        trace.TRACER.sampler.default_rate = 1.0
+        gate = asyncio.Event()
+
+        async def process(calls):
+            await gate.wait()
+            return list(calls)
+
+        b = Batcher(process, pipeline_depth=1, stage="device")
+        roots = []
+        try:
+            with trace.span("r0", tenant="t"):
+                f0 = b.submit("c0")          # occupies the pipeline
+            for name in ("r1", "r2", "r3"):
+                with trace.span(name, tenant="t") as sp:
+                    roots.append(sp.ctx)
+                    b.submit(name)
+            gate.set()
+            await asyncio.wait_for(f0, 5)
+            await asyncio.sleep(0.05)        # drain the second batch
+            spans = trace.TRACER.export(limit=1000)
+            emits = [s for s in spans if s["name"] == "batch.emit"]
+            assert emits, [s["name"] for s in spans]
+            emit = emits[-1]
+            # parented under r1 (the representative), linking r2 + r3
+            assert emit["trace_id"] == f"{roots[0].trace_id:016x}"
+            linked = {l["trace_id"] for l in emit["links"]}
+            assert linked == {f"{roots[1].trace_id:016x}",
+                              f"{roots[2].trace_id:016x}"}
+        finally:
+            trace.TRACER.sampler.default_rate = 0.0
+            trace.TRACER.reset()
+
+
+class TestResourceEnvelope:
+    async def test_exporter_stamps_resource_on_every_record(self):
+        from bifromq_tpu.obs.exporter import (SCHEMA_VERSION, FileSink,
+                                              TelemetryExporter)
+        res = {"node_id": "n7", "cluster_id": "c1",
+               "schema_version": SCHEMA_VERSION}
+        exp = TelemetryExporter(FileSink("/dev/null"), resource=res,
+                                snapshot_fn=lambda: {"x": 1})
+        exp._collect()
+        assert exp._queue, "no record collected"
+        assert all(r["resource"] == res for r in exp._queue)
+        assert exp.snapshot()["resource"] == res
+
+    async def test_hub_envelope_defaults(self):
+        hub = _fresh_hub()
+        env = hub.resource_envelope()
+        assert env["node_id"] and "schema_version" in env
+        hub.set_identity(node_id="node-x", cluster_id="prod")
+        env = hub.resource_envelope()
+        assert env["node_id"] == "node-x" and env["cluster_id"] == "prod"
+
+
+class TestClusterObsRPC:
+    async def test_digest_method_serves_fresh_digest(self):
+        hub = _fresh_hub()
+        hub.windows.record_flow("t", 5)
+        server = RPCServer()
+        view = ClusterView("N", FakeHost("N"), hub=hub,
+                           registry=ServiceRegistry())
+        ClusterObsRPCService(view).register(server)
+        await server.start()
+        try:
+            reg = ServiceRegistry()
+            out = await reg.client_for(server.address).call(
+                SERVICE, "digest", b"")
+            got = json.loads(out)
+            assert got["node"] == "N"
+            assert "hlc" in got["digest"]
+            await reg.close()
+        finally:
+            await server.stop()
+
+    async def test_agent_id_constant(self):
+        # the gossip agent id is wire surface: peers key on it
+        assert AGENT_ID == "obs"

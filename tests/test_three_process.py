@@ -10,6 +10,7 @@ the snapshot dump session to catch it up.
 """
 
 import asyncio
+import json
 import os
 import socket
 import subprocess
@@ -227,6 +228,252 @@ class TestRetainStoreProcess:
         finally:
             _kill_cluster(procs)
             await registry.close()
+
+
+FED_NODES = ["fn0", "fn1", "fn2"]
+
+
+@pytest.fixture(scope="module")
+def broker_cluster(tmp_path_factory):
+    """Three full starter broker processes gossiping into one cluster
+    (ISSUE 5 federation): fn0 hosts the dist-worker role, fn1/fn2 are
+    remote frontends, every node serves the management API and publishes
+    its health digest. Module-scoped: the three jax-importing boots are
+    paid once and shared by the federation tests below."""
+    d = tmp_path_factory.mktemp("fedcluster")
+    mqtt_ports = _free_ports(3)
+    api_ports = _free_ports(3)
+    gossip_ports = _free_ports(3)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+    env["BIFROMQ_TRACE_SAMPLE"] = "1"
+    env["BIFROMQ_CLUSTER_OBS_STALE_S"] = "3"
+    env["BIFROMQ_CLUSTER_OBS_INTERVAL_S"] = "0.5"
+    procs = []
+    try:
+        for i, node in enumerate(FED_NODES):
+            cfg = {
+                "mqtt": {"host": "127.0.0.1",
+                         "tcp": {"port": mqtt_ports[i]}},
+                "api": {"port": api_ports[i]},
+                # gentler SWIM timing than the in-process defaults: full
+                # broker nodes stall their loops on XLA compiles, and a
+                # false suspicion tripping DEAD mid-test is flake fuel
+                "cluster": {"node_id": node, "port": gossip_ports[i],
+                            "probe_timeout_s": 0.5,
+                            "suspect_timeout_s": 3.0,
+                            **({"seeds":
+                                [f"127.0.0.1:{gossip_ports[0]}"]}
+                               if i else {})},
+                "dist": {"mode": "worker" if i == 0 else "remote"},
+            }
+            path = d / f"{node}.yml"
+            path.write_text(json.dumps(cfg))       # JSON is valid YAML
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "bifromq_tpu", "--config",
+                 str(path)],
+                cwd=REPO, env=env,
+                stdout=open(d / f"{node}.log", "w"),
+                stderr=subprocess.STDOUT))
+        # synchronous readiness poll (outside the per-test async budget):
+        # every API answers /cluster with 3 alive members + fresh digests
+        import http.client
+        import time as _time
+        deadline = _time.monotonic() + 180
+        ready = [False] * 3
+        while _time.monotonic() < deadline and not all(ready):
+            for i, port in enumerate(api_ports):
+                if ready[i]:
+                    continue
+                try:
+                    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                      timeout=2)
+                    conn.request("GET", "/cluster")
+                    body = json.loads(conn.getresponse().read())
+                    conn.close()
+                except Exception:
+                    continue
+                members = body.get("members", {})
+                alive = [n for n, m in members.items()
+                         if m.get("alive") and m.get("digest")]
+                ready[i] = len(alive) >= 3
+            if not all(ready):
+                _time.sleep(0.5)
+        if not all(ready):
+            tails = {n: (d / f"{n}.log").read_text()[-1500:]
+                     for n in FED_NODES}
+            raise AssertionError(
+                f"federation cluster not ready: {ready}\n{tails}")
+        yield {"mqtt": mqtt_ports, "api": api_ports,
+               "gossip": gossip_ports, "procs": procs}
+    finally:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except Exception:
+                pass
+
+
+async def _http(port, method, path, body=b""):
+    r, w = await asyncio.open_connection("127.0.0.1", port)
+    w.write(f"{method} {path} HTTP/1.1\r\nhost: x\r\n"
+            f"content-length: {len(body)}\r\n"
+            f"connection: close\r\n\r\n".encode() + body)
+    await w.drain()
+    # read to EOF: a single read() returns the first chunk only, and a
+    # sampled /trace body can span many TCP segments
+    raw = b""
+    while True:
+        chunk = await r.read(65536)
+        if not chunk:
+            break
+        raw += chunk
+    w.close()
+    head, _, payload = raw.partition(b"\r\n\r\n")
+    return int(head.split(b" ")[1]), json.loads(payload)
+
+
+class TestClusterFederation:
+    """ISSUE 5 acceptance: /cluster/tenants merges per-tenant RED across
+    nodes, /cluster/trace assembles a cross-process trace, gossiped
+    breaker state demotes pick() with no local failure, and a killed
+    node's digest goes stale in the /cluster table."""
+
+    async def test_cluster_tenants_union_and_cross_process_trace(
+            self, broker_cluster):
+        from bifromq_tpu.mqtt.client import MQTTClient
+        api = broker_cluster["api"]
+        mqtt = broker_cluster["mqtt"]
+        # one shared-tenant pub/sub pair crossing fn2 → fn0 → fn1, plus a
+        # unique single-node tenant per frontend so the union assertion
+        # exercises tenants living on DIFFERENT nodes
+        sub = MQTTClient("127.0.0.1", mqtt[1], client_id="fed-s",
+                         username="fed/u")
+        await sub.connect()
+        await sub.subscribe("fed/+/t", qos=1)
+        pub = MQTTClient("127.0.0.1", mqtt[2], client_id="fed-p",
+                         username="fed/u")
+        await pub.connect()
+        solo1 = MQTTClient("127.0.0.1", mqtt[1], client_id="fed-o1",
+                           username="onlyfn1/u")
+        await solo1.connect()
+        await solo1.publish("noop/t", b"x", qos=0)      # flows on fn1 only
+        solo2 = MQTTClient("127.0.0.1", mqtt[2], client_id="fed-o2",
+                           username="onlyfn2/u")
+        await solo2.connect()
+        await solo2.publish("noop/t", b"x", qos=0)      # flows on fn2 only
+        # first match jit-compiles on the worker node: publish until one
+        # crosses (each publish is an independent sampled trace)
+        delivered = False
+        for _ in range(30):
+            await pub.publish("fed/x/t", b"crossed", qos=0)
+            try:
+                await asyncio.wait_for(sub.messages.get(), 1.0)
+                delivered = True
+                break
+            except asyncio.TimeoutError:
+                continue
+        assert delivered, "publish never crossed the cluster"
+
+        # -- /cluster/tenants equals the union of per-node /tenants ------
+        fed_tenants = union = fed = None
+        for _ in range(10):
+            union = set()
+            for port in api:
+                _s, out = await _http(port, "GET", "/tenants?top_k=100")
+                union |= {r["tenant"] for r in out["tenants"]}
+            status, fed = await _http(api[0], "GET", "/cluster/tenants")
+            assert status == 200
+            assert all(v in ("local", "ok")
+                       for v in fed["nodes"].values()), fed["nodes"]
+            fed_tenants = set(fed["tenants"])
+            if (fed_tenants == union
+                    and {"fed", "onlyfn1", "onlyfn2"} <= union):
+                break
+            await asyncio.sleep(0.5)
+        assert fed_tenants == union
+        assert {"fed", "onlyfn1", "onlyfn2"} <= fed_tenants
+        # single-node tenants live on fn1/fn2 only, yet fn0 serves them
+        assert fed["tenants"]["onlyfn2"]["rate_per_s"] > 0
+        await solo1.disconnect()
+        await solo2.disconnect()
+
+        # -- /cluster/trace/<id>: one trace, >= 2 OS processes -----------
+        _s, local = await _http(api[2], "GET", "/trace?limit=1000")
+        ingest = [s for s in local["spans"] if s["name"] == "pub.ingest"
+                  and s["tags"].get("topic") == "fed/x/t"]
+        assert ingest, [s["name"] for s in local["spans"]][:40]
+        tid = ingest[-1]["trace_id"]
+        trace_fed = None
+        for _ in range(10):
+            status, trace_fed = await _http(
+                api[0], "GET", f"/cluster/trace/{tid}")
+            assert status == 200
+            if trace_fed["processes"] >= 2:
+                break
+            await asyncio.sleep(0.5)
+        assert trace_fed["processes"] >= 2, trace_fed["nodes"]
+        assert len({s["pid"] for s in trace_fed["spans"]}) >= 2
+        hlcs = [s["start_hlc"] for s in trace_fed["spans"]]
+        assert hlcs == sorted(hlcs), "spans not HLC-ordered"
+        await sub.disconnect()
+        await pub.disconnect()
+
+    async def test_gossiped_brownout_demotes_pick_then_kill_goes_stale(
+            self, broker_cluster):
+        from bifromq_tpu.cluster.membership import AgentHost
+        from bifromq_tpu.obs import ObsHub
+        from bifromq_tpu.obs.clusterview import ClusterView
+        api = broker_cluster["api"]
+        _s, info = await _http(api[0], "GET", "/cluster")
+        addr2 = info["members"]["fn2"]["addr"]
+        assert addr2
+        # an observer joins gossip and reports ITS breaker to fn2 open
+        # (the fleet-shared breaker state PR 1 left per-process)
+        probe_host = AgentHost("probe",
+                               seeds=[("127.0.0.1",
+                                       broker_cluster["gossip"][0])])
+        await probe_host.start()
+        reg = ServiceRegistry()
+        reg.breakers.for_endpoint(addr2).force_open()
+        view = ClusterView("probe", probe_host, hub=ObsHub(),
+                           registry=reg)
+        try:
+            flagged = False
+            for _ in range(40):
+                view.refresh()      # re-publish digest (incarnation bump)
+                _s, r = await _http(
+                    api[0], "GET",
+                    "/cluster/route?service=session-dict&key=k")
+                if addr2 in r["unhealthy"]:
+                    flagged = True
+                    break
+                await asyncio.sleep(0.25)
+            assert flagged, "gossiped breaker never reached fn0"
+            # fn0 now routes every key away from fn2 — although fn0
+            # itself never observed a failure against it
+            for i in range(24):
+                _s, r = await _http(
+                    api[0], "GET",
+                    f"/cluster/route?service=session-dict&key=t{i}")
+                assert r["endpoint"] != addr2, r
+        finally:
+            await probe_host.stop()
+
+        # -- kill fn2: its row goes non-alive / stale in the table -------
+        broker_cluster["procs"][2].kill()
+        gone = False
+        for _ in range(40):
+            _s, info = await _http(api[0], "GET", "/cluster")
+            row = info["members"].get("fn2")
+            if row is None or not row["alive"] or row.get("stale"):
+                gone = True
+                break
+            await asyncio.sleep(0.5)
+        assert gone, info["members"].get("fn2")
 
 
 class TestDurableStoreProcess:
