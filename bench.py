@@ -39,8 +39,18 @@ dedicated repeated-vs-unique-topic A/B through TpuMatcher.match_batch and
 the broker config prints hit rate + dedup ratio next to the stage
 breakdown.
 
+DEVICE PIPELINE (ISSUE 6): config "7" A/Bs the sync blocking serve
+against the async double-buffered dispatch ring (BENCH_PIPE_SUBS caps
+its sub count, BENCH_PIPE_SMALL sets the shallow-queue batch;
+BIFROMQ_PIPELINE_DEPTH / BIFROMQ_FUSED_KERNEL steer the pipeline
+itself) and reports batch p50/p99 per leg + the dispatch/ready/fetch
+stage split. Every run is stamped with device_kind + stale so
+CPU-fallback rounds stay comparable; routes-mode reports tunnel RTT
+apart from device-kernel time.
+
 Env knobs: BENCH_CONFIGS ("1,2,3,4,5" default; "2" = headline only;
-"6" = match-cache A/B; BENCH_CACHE_HOT_TOPICS sizes its Zipf pool),
+"6" = match-cache A/B; "7" = pipeline A/B;
+BENCH_CACHE_HOT_TOPICS sizes config 6's Zipf pool),
 BENCH_SUBS (config-2 subs, default 1_000_000), BENCH_BATCH (16384),
 BENCH_ITERS (30), BENCH_K (16), BENCH_SEED (0), BENCH_RETAINED (1_000_000),
 BENCH_COMPACTION (sort|scatter), BENCH_INTERVALS (64, route-walk lanes),
@@ -377,6 +387,19 @@ def _measure_routes(tries, probe_fn, *, name, compiled,
     e2e_topics = batch * iters / (eff_elapsed + tok_s)
     e2e_routes = total_routes / (eff_elapsed + tok_s)
 
+    # ---- tunnel RTT vs device-kernel time (ISSUE 6 satellite) ------------
+    # a tiny scalar round trip isolates the TRANSPORT cost (the axon
+    # tunnel pays ~70ms per sync; CPU pays microseconds); walk_read minus
+    # RTT approximates the kernel's own time, so CPU-fallback trajectory
+    # records (BENCH_r02–r05) stay comparable to real-TPU ones
+    import jax
+    rtts = []
+    for _ in range(8):
+        s0 = time.perf_counter()
+        np.asarray(jax.device_put(np.zeros(1, np.int32)))
+        rtts.append(time.perf_counter() - s0)
+    rtt_ms = float(np.percentile(rtts, 50)) * 1e3
+
     # ---- sync latency: tokenize + upload + walk + readback + expand ------
     lat = []
     phases = {"tok_ms": [], "upload_ms": [], "walk_read_ms": [],
@@ -423,6 +446,9 @@ def _measure_routes(tries, probe_fn, *, name, compiled,
         "e2e_p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
         "phase_ms_p50": {k: round(float(np.percentile(v, 50)), 2)
                          for k, v in phases.items()},
+        "tunnel_rtt_ms_p50": round(rtt_ms, 3),
+        "device_kernel_ms_p50": round(max(0.0, float(np.percentile(
+            phases["walk_read_ms"], 50)) - rtt_ms), 2),
         "batch": batch,
         "k_states": k_states,
         "max_intervals": max_intervals,
@@ -775,6 +801,121 @@ def bench_config6():
     return out
 
 
+def bench_config7():
+    """Device-pipeline A/B (ISSUE 6): per-batch serving latency through
+    the full TpuMatcher plane.
+
+    - **sync leg** — the BENCH_r01 shape: every batch is a blocking
+      full-size `match_batch` round trip (queue → pow2 pad → dispatch →
+      device_get), so every topic's latency is the whole batch's.
+    - **pipelined leg** — the same topic stream as SMALL adaptive batches
+      (the shallow-queue floor the ring emits) through
+      `match_batch_async`: `pipeline_depth` workers keep the ring full,
+      dispatch overlaps fetch, and per-batch latency is what a publish
+      actually waits.
+
+    Prints both legs' topics/s + batch p50/p99 and the p99 speedup (the
+    acceptance bar is ≥10×), plus the dispatch/ready/fetch stage
+    histograms that replace the old blocking `device.sync` stage.
+    """
+    import asyncio
+
+    from bifromq_tpu import workloads
+    from bifromq_tpu.models.matcher import TpuMatcher
+    from bifromq_tpu.models.pipeline import pipeline_depth
+    from bifromq_tpu.utils.metrics import STAGES
+
+    n_subs = min(N_SUBS, int(os.environ.get("BENCH_PIPE_SUBS", "200000")))
+    tries = workloads.config_wildcard(n_subs, seed=SEED)
+    big = min(BATCH, 4096)
+    iters = max(8, ITERS // 2)
+    try:
+        small = int(os.environ.get("BENCH_PIPE_SMALL", "16"))
+    except ValueError:
+        small = 16
+    # clamp to [1, big]: small > big would compute an empty pipelined
+    # workload (n_small = 0 → sm[0] IndexError), small < 1 divides by zero
+    small = max(1, min(small, big))
+    topics = workloads.probe_topics(big * 4, seed=SEED + 1)
+    name = f"c7_pipeline_{n_subs}"
+    m = TpuMatcher.from_tries(tries, match_cache=False,
+                              auto_compact=False)
+
+    batches = [[("tenant0", t) for t in topics[i * big:(i + 1) * big]]
+               for i in range(4)]
+    # ---- sync leg ---------------------------------------------------------
+    m.match_batch(batches[0])   # warm the big-batch shape
+    lat = []
+    s = time.perf_counter()
+    for it in range(iters):
+        s0 = time.perf_counter()
+        m.match_batch(batches[it % 4])
+        lat.append(time.perf_counter() - s0)
+    sync_elapsed = time.perf_counter() - s
+    lat = np.array(lat)
+    sync = {
+        "batch": big,
+        "topics_per_s": round(big * iters / sync_elapsed, 1),
+        "batch_p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+        "batch_p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+    }
+    log(f"[{name}] sync: {json.dumps(sync)}")
+
+    # ---- pipelined leg ----------------------------------------------------
+    n_small = max(1, min(big * iters // small, 2048))
+    sm = [[("tenant0", topics[(j * small + k) % len(topics)])
+           for k in range(small)] for j in range(n_small)]
+    STAGES.reset()
+
+    async def run_pipe():
+        lats = []
+        nxt = {"i": 0}
+        peak = {"v": 0}
+
+        async def worker():
+            while nxt["i"] < len(sm):
+                b = sm[nxt["i"]]
+                nxt["i"] += 1
+                s0 = time.perf_counter()
+                await m.match_batch_async(b, batch=None)
+                lats.append(time.perf_counter() - s0)
+                ring = m._ring
+                if ring is not None:
+                    peak["v"] = max(peak["v"], ring.peak_inflight)
+
+        # warm the small shapes before timing
+        await m.match_batch_async(sm[0])
+        s = time.perf_counter()
+        workers = [asyncio.ensure_future(worker())
+                   for _ in range(pipeline_depth())]
+        await asyncio.gather(*workers)
+        return lats, time.perf_counter() - s, peak["v"]
+
+    lats, pipe_elapsed, peak_inflight = asyncio.run(run_pipe())
+    lats = np.array(lats)
+    pipe = {
+        "batch": small,
+        "topics_per_s": round(small * len(sm) / pipe_elapsed, 1),
+        "batch_p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 2),
+        "batch_p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 2),
+        "peak_in_flight": peak_inflight,
+        "ring_depth": pipeline_depth(),
+    }
+    log(f"[{name}] pipelined: {json.dumps(pipe)}")
+    stages = {k: v for k, v in STAGES.snapshot().items()
+              if k.startswith("device")}
+    out = {
+        "sync": sync,
+        "pipelined": pipe,
+        "batch_p99_speedup": round(
+            sync["batch_p99_ms"] / max(1e-9, pipe["batch_p99_ms"]), 2),
+        "stage_latency_ms": stages,
+    }
+    log(f"[{name}] p99 speedup {out['batch_p99_speedup']}x; "
+        f"stages: {json.dumps(stages)}")
+    return out
+
+
 def bench_broker():
     """End-to-end MQTT broker throughput over loopback TCP: QoS0/QoS1
     publish → dist match (device matcher) → local fan-out → delivery.
@@ -988,6 +1129,8 @@ def main():
         results["c5"] = bench_config5()
     if "6" in CONFIGS:
         results["c6"] = bench_config6()
+    if "7" in CONFIGS:
+        results["c7"] = bench_config7()
     if "b" in CONFIGS:
         results["broker"] = bench_broker()
 
@@ -1057,7 +1200,24 @@ def main():
                 }
     record["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
     record["platform"] = jax.devices()[0].platform
+    # ISSUE 6 satellite: stamp the hardware + freshness so CPU-fallback
+    # trajectory rounds (the r02–r05 failure mode) are self-describing
+    try:
+        record["device_kind"] = jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001 — CPU backends may lack the attr
+        record["device_kind"] = record["platform"]
+    record["stale"] = False
     record["n_subs"] = N_SUBS
+    # pipeline A/B next to the headline (ISSUE 6): the dispatch/ready/
+    # fetch stage split + the sync-vs-pipelined batch-latency comparison
+    if "c7" in results:
+        record["pipeline"] = {
+            "batch_p99_speedup": results["c7"]["batch_p99_speedup"],
+            "sync_batch_p99_ms": results["c7"]["sync"]["batch_p99_ms"],
+            "pipelined_batch_p99_ms":
+                results["c7"]["pipelined"]["batch_p99_ms"],
+            "stage_latency_ms": results["c7"]["stage_latency_ms"],
+        }
     # per-stage p50/p99 next to the headline (ISSUE 2): where the broker
     # plane actually spends its time (queue-wait vs device vs deliver)
     stage = results.get("broker", {}).get("stage_latency_ms")

@@ -103,6 +103,7 @@ class DistService:
             worker.on_route_mutation = self._on_route_mutation
         self._pub_scheduler: BatchCallScheduler[PubCall, PubResult] = \
             BatchCallScheduler(lambda tenant: self._make_pub_batch(tenant),
+                               pipeline_depth=None,  # BIFROMQ_PIPELINE_DEPTH
                                max_burst_latency=max_burst_latency,
                                stage="queue_wait",
                                obs_tenant_key=True)

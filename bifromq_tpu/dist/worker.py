@@ -411,7 +411,10 @@ class DistWorker:
         from ..scheduler.batcher import BatchCallScheduler
         self._mutation_scheduler = BatchCallScheduler(
             lambda rid: (lambda calls: self._propose_batch(rid, calls)),
-            max_burst_latency=0.005)
+            max_burst_latency=0.005,
+            # consensus batches are pure throughput (one raft propose per
+            # batch): never decay the cap toward idle between bursts
+            shallow_decay=False)
         self.balance_controller = None
         balancers = []
         if split_threshold is not None:
@@ -591,15 +594,22 @@ class DistWorker:
     # events (the worker itself stays event-plumbing-free)
     on_degraded = None
 
-    def _match_on_range(self, coproc, sub, max_persistent_fanout,
-                        max_group_fanout, deadline):
+    async def _match_on_range(self, coproc, sub, max_persistent_fanout,
+                              max_group_fanout, deadline):
         """One range's match dispatch behind the failure boundary: a
         TPU-matcher fault (device error, injected chaos) or an exhausted
         deadline budget serves the HOST-ORACLE fallback — the matcher's
         authoritative per-tenant tries, exact by construction — instead
         of failing the publish (Tailwind's accelerator-offload-behind-a-
         failure-boundary discipline; ops/match.py already does this for
-        bounded-work overflow)."""
+        bounded-work overflow).
+
+        ISSUE 6: routes through the matcher's ASYNC pipeline when it has
+        one — the device walk dispatches, the event loop keeps serving
+        (the next batch tokenizes + dispatches in the gap), and the fetch
+        happens on readiness; the `device.dispatch`/`device.sync` span
+        pair of the sync era becomes dispatch/ready/fetch inside
+        ``match_batch_async``."""
         t0 = _time.perf_counter()
         cache = getattr(coproc.matcher, "match_cache", None)
         c0 = cache.counts() if cache is not None else (0, 0)
@@ -607,11 +617,18 @@ class DistWorker:
             get_injector().check_raise("matcher", "tpu-matcher", "match")
             if deadline is not None and _time.monotonic() >= deadline:
                 raise TimeoutError("match deadline budget exhausted")
+            stats: dict = {}
             with trace.span("match.device", tenant=sub[0][0],
                             n_queries=len(sub)) as sp:
-                out = coproc.matcher.match_batch(
-                    sub, max_persistent_fanout=max_persistent_fanout,
-                    max_group_fanout=max_group_fanout)
+                amatch = getattr(coproc.matcher, "match_batch_async", None)
+                if amatch is not None:
+                    out = await amatch(
+                        sub, max_persistent_fanout=max_persistent_fanout,
+                        max_group_fanout=max_group_fanout, stats=stats)
+                else:
+                    out = coproc.matcher.match_batch(
+                        sub, max_persistent_fanout=max_persistent_fanout,
+                        max_group_fanout=max_group_fanout)
                 if cache is not None and sp is not trace.NOOP:
                     # ISSUE 4: cache disposition on the device span —
                     # "hit" = the whole batch skipped the device,
@@ -627,7 +644,15 @@ class DistWorker:
                                else ("dedup" if dup else "miss"))
                     sp.set_tag("cache_hits", hits)
                     sp.set_tag("cache_misses", misses)
-            dt = _time.perf_counter() - t0
+            # overlapped pipeline: the outer wall clock also counts
+            # ring-acquire waits and CONCURRENT batches' host work, so
+            # per-tenant device shares use the matcher-reported per-batch
+            # time (this batch's cache probe + dispatch+ready+fetch +
+            # expand — the same span the sync wall clock covers, so the
+            # "device" stage measures the same thing either side of
+            # BIFROMQ_PIPELINE); the sync fallback keeps wall time,
+            # which there IS that span
+            dt = stats.get("device_s", _time.perf_counter() - t0)
             STAGES.record("device", dt)
             self._attribute_device_time(sub, dt)
             return out
@@ -724,8 +749,9 @@ class DistWorker:
         for rid, idxs in range_queries.items():
             sub = [queries[qi] for qi in idxs]
             coproc = self.store.coprocs[rid]
-            res = self._match_on_range(coproc, sub, max_persistent_fanout,
-                                       max_group_fanout, deadline)
+            res = await self._match_on_range(coproc, sub,
+                                             max_persistent_fanout,
+                                             max_group_fanout, deadline)
             rec = getattr(coproc, "load_recorder", None)
             for qi, m in zip(idxs, res):
                 per_query[(rid, qi)] = m

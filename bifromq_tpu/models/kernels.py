@@ -1,0 +1,168 @@
+"""Fused Pallas TPU trie-walk kernel (ISSUE 6 tentpole part 3).
+
+The lax serving walk (``ops.match.walk_routes``) lowers to a *sequence*
+of XLA ops — per-level hash-mix, bucket-row gather, successor compaction,
+interval emission, final cumsum/scatter pack — which XLA is free to
+schedule as many kernel launches with intermediate HBM round-trips. This
+module fuses the whole per-batch pipeline — token hash-mix → level walk →
+slot-interval gather → compaction — into ONE ``pl.pallas_call`` (the
+SNIPPETS [2] Pallas-TPU idiom, and the single-launch trie-walk shape of
+TrieJax / "Vectorizing the Trie", PAPERS.md), so the walk state lives in
+VMEM for the whole launch instead of bouncing through HBM between stages.
+
+Semantics: the kernel body REUSES ``ops.match._route_walk`` — the exact
+step/compaction math of the lax walk — operating on refs instead of HBM
+arrays. Row-identical output to ``walk_routes(..., esc_k=0)`` is
+therefore by construction, and the parity suite (tests/test_kernels.py)
+enforces it against both the lax walk and the host oracle.
+
+Deployment gates (all are consulted by ``fused_enabled``):
+
+- ``BIFROMQ_FUSED_KERNEL`` env: ``0``/``off`` kills the fused path
+  everywhere (the ISSUE 6 kill-switch); ``1``/``on`` forces it on every
+  backend (interpreter mode off-TPU); unset/``auto`` enables it only on
+  a real TPU backend — the interpreter is a correctness surface, not a
+  serving surface, and the lax walk is faster on CPU.
+- VMEM capacity: the single-launch kernel keeps the automaton tables
+  resident in VMEM, so it only compiles when the table bytes fit
+  ``BIFROMQ_FUSED_VMEM_MB`` (default 12 MB of the ~16 MB/core budget);
+  bigger automatons fall back to the lax walk (auto mode) — the
+  multi-chip sharding item (ROADMAP) is what shrinks per-core tables.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.match import DeviceTrie, Probes, RouteIntervals, _route_walk
+
+_VMEM_BUDGET_MB_DEFAULT = 12
+
+
+def _env_mode() -> str:
+    v = os.environ.get("BIFROMQ_FUSED_KERNEL", "auto").lower()
+    if v in ("0", "off", "false"):
+        return "off"
+    if v in ("1", "on", "true"):
+        return "on"
+    return "auto"
+
+
+def fused_vmem_budget_bytes() -> int:
+    # fused_enabled runs on every serving dispatch: a malformed knob must
+    # fall back to the default, never crash the match path
+    try:
+        mb = int(os.environ.get("BIFROMQ_FUSED_VMEM_MB",
+                                str(_VMEM_BUDGET_MB_DEFAULT)))
+    except ValueError:
+        mb = _VMEM_BUDGET_MB_DEFAULT
+    return mb * (1 << 20)
+
+
+def _table_bytes(trie: DeviceTrie) -> int:
+    total = 0
+    for a in (trie.edge_tab, trie.route_tab):
+        if a is not None:
+            total += a.size * a.dtype.itemsize
+    return total
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # noqa: BLE001 — backend init failure = no device
+        return False
+
+
+def fused_enabled(trie: Optional[DeviceTrie] = None) -> bool:
+    """Should the serving walk route through the fused kernel?
+
+    Read per-dispatch (cheap: one env read + a size check) so tests and
+    operators can flip ``BIFROMQ_FUSED_KERNEL`` on a live process.
+    """
+    mode = _env_mode()
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    # auto: compiled TPU only, and only when the tables fit VMEM
+    if not _on_tpu():
+        return False
+    if trie is not None and _table_bytes(trie) > fused_vmem_budget_bytes():
+        return False
+    return True
+
+
+@functools.lru_cache(maxsize=64)
+def _build_fused(b: int, width: int, nb: int, probe_len: int, n_nodes: int,
+                 rt_cols: int, k_states: int, compaction: str,
+                 max_intervals: int, interpret: bool):
+    """One compiled fused walk per (shape, config) class.
+
+    The pallas_call is rebuilt per shape class exactly like jit re-traces
+    per shape; the lru_cache plays the role of jit's trace cache.
+    """
+    from jax.experimental import pallas as pl
+
+    def kernel(edge_ref, route_ref, t1_ref, t2_ref, len_ref, roots_ref,
+               sys_ref, ivl_s_ref, ivl_c_ref, nr_ref, ovf_ref):
+        # the tables load once into kernel memory and every walk stage —
+        # hash-mix, bucket probe, successor compaction, interval emission,
+        # final pack — runs inside this single launch. node_tab is the
+        # route_tab view: _route_walk only reads RT_* columns and the
+        # _advance plus-child contract pins RT_PLUS at column 0.
+        tab = route_ref[...]
+        trie = DeviceTrie(node_tab=tab, edge_tab=edge_ref[...],
+                          child_list=None, route_tab=tab)
+        probes = Probes(t1_ref[...], t2_ref[...], len_ref[...],
+                        roots_ref[...], sys_ref[...])
+        s, c, nr, ovf = _route_walk(trie, probes, probe_len, k_states,
+                                    compaction, max_intervals)
+        ivl_s_ref[...] = s
+        ivl_c_ref[...] = c
+        nr_ref[...] = nr
+        ovf_ref[...] = ovf
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((b, max_intervals), jnp.int32),
+            jax.ShapeDtypeStruct((b, max_intervals), jnp.int32),
+            jax.ShapeDtypeStruct((b,), jnp.int32),
+            jax.ShapeDtypeStruct((b,), jnp.bool_),
+        ),
+        interpret=interpret,
+    )
+    return jax.jit(lambda e, r, t1, t2, ln, ro, sm: call(
+        e, r, t1, t2, ln, ro, sm))
+
+
+def fused_walk_routes(trie: DeviceTrie, probes: Probes, *, probe_len: int,
+                      k_states: int = 32, compaction: str = "sort",
+                      max_intervals: int = 32,
+                      interpret: Optional[bool] = None) -> RouteIntervals:
+    """The fused single-launch serving walk.
+
+    Drop-in for ``walk_routes(..., esc_k=0)`` (no on-device escalation —
+    the matcher's host-triggered escalation re-walks overflow rows through
+    this same entry at a higher budget). ``interpret=None`` auto-selects
+    interpreter mode off-TPU (the CPU fallback the ISSUE requires).
+    """
+    if trie.route_tab is None:
+        raise ValueError("fused walk requires DeviceTrie.route_tab")
+    if interpret is None:
+        interpret = not _on_tpu()
+    b, width = probes.tok_h1.shape
+    fn = _build_fused(b, width, int(trie.edge_tab.shape[0]), probe_len,
+                      int(trie.route_tab.shape[0]),
+                      int(trie.route_tab.shape[1]), k_states, compaction,
+                      max_intervals, bool(interpret))
+    s, c, nr, ovf = fn(trie.edge_tab, trie.route_tab, probes.tok_h1,
+                       probes.tok_h2, probes.lengths, probes.roots,
+                       probes.sys_mask)
+    return RouteIntervals(start=s, count=c, n_routes=nr, overflow=ovf)

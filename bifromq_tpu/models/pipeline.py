@@ -1,0 +1,190 @@
+"""Async device-dispatch ring (ISSUE 6 tentpole part 1).
+
+BENCH_r01 measured the sync serving path at p50 ≈ 666ms per batch: every
+publish paid `batcher queue → pow2 pad → device dispatch → BLOCKING
+device_get` with nothing overlapped. This module is the overlap plane:
+
+- a **dispatch ring** bounds the number of in-flight device batches
+  (``BIFROMQ_PIPELINE_DEPTH``, default 2 = double-buffered; 3 = triple):
+  batch N+1 tokenizes and enqueues on device while batch N is still
+  walking, because the await happens on *readiness*, not inside dispatch;
+- results come back via **fetch-on-ready**: the dispatch starts a
+  ``copy_to_host_async`` immediately, the serving coroutine polls
+  ``jax.Array.is_ready`` (yielding the event loop between polls — other
+  batches dispatch in those gaps) and only then pays the final host copy;
+- the ring's occupancy is the **queue-depth signal** for adaptive batch
+  shaping: an idle ring means a shallow dispatch queue, so the pow2 pad
+  floor drops to ``BIFROMQ_PIPELINE_MIN_BATCH`` (default 8) to cut
+  time-to-first-result; a busy ring keeps the throughput floor (16).
+
+The ring deliberately has NO asyncio primitives bound at construction
+(no Semaphore/Event): matchers outlive event loops in tests and
+multi-loop processes, so waiters are plain per-call futures created on
+whatever loop is running the dispatch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from collections import deque
+from typing import Deque, Optional
+
+
+def pipeline_enabled() -> bool:
+    """Kill-switch for the async dispatch path (``BIFROMQ_PIPELINE=0``
+    degrades ``match_batch_async`` to the sync serving path)."""
+    return os.environ.get("BIFROMQ_PIPELINE", "1").lower() \
+        not in ("0", "off", "false")
+
+
+def pipeline_depth() -> int:
+    """In-flight device batches (2 = double-buffered, 3 = triple)."""
+    try:
+        d = int(os.environ.get("BIFROMQ_PIPELINE_DEPTH", "2"))
+    except ValueError:
+        d = 2
+    return max(1, min(d, 8))
+
+
+def pipeline_min_floor() -> int:
+    """Shallow-queue pow2 pad floor (the latency floor; 16 stays the
+    throughput floor). Each extra floor is one more XLA shape class, so
+    it is a single knob, not a free sweep."""
+    try:
+        f = int(os.environ.get("BIFROMQ_PIPELINE_MIN_BATCH", "8"))
+    except ValueError:
+        f = 8
+    return max(1, min(f, 16))
+
+
+def donation_enabled() -> bool:
+    """Donate in-flight probe buffers to XLA (``walk_routes_donated``).
+    Default on — the ring never re-reads a dispatched Probes object (the
+    escalation/readback paths only touch the host TokenizedTopics copy)."""
+    return os.environ.get("BIFROMQ_DONATE_BUFFERS", "1").lower() \
+        not in ("0", "off", "false")
+
+
+class DispatchRing:
+    """Bounded in-flight dispatch slots + the queue-depth signal.
+
+    One per TpuMatcher (created lazily on the first async match). The
+    gauge surface (obs/device.py) reads ``in_flight`` / ``waiters`` /
+    ``depth`` weakly; ``effective_floor`` feeds the adaptive pow2 pad.
+    """
+
+    def __init__(self, depth: Optional[int] = None,
+                 min_floor: Optional[int] = None,
+                 base_floor: int = 16) -> None:
+        self.depth = depth if depth is not None else pipeline_depth()
+        self.min_floor = (min_floor if min_floor is not None
+                          else pipeline_min_floor())
+        self.base_floor = base_floor
+        self._inflight = 0
+        self._waiters: Deque[asyncio.Future] = deque()
+        # observability (tests assert overlap through these)
+        self.dispatched_total = 0
+        self.peak_inflight = 0
+
+    # ---------------- slot management --------------------------------------
+
+    @property
+    def in_flight(self) -> int:
+        return self._inflight
+
+    @property
+    def waiting(self) -> int:
+        return len(self._waiters)
+
+    async def acquire(self) -> None:
+        while self._inflight >= self.depth:
+            fut = asyncio.get_running_loop().create_future()
+            self._waiters.append(fut)
+            try:
+                await fut
+            except BaseException:
+                # cancellation hygiene: a parked waiter withdraws itself
+                # (a cancelled future is done(), so it must be REMOVED —
+                # a stale entry would overcount ring.waiting and pin
+                # effective_floor at the throughput floor); a waiter that
+                # was already granted a wake but dies before using it
+                # passes the wake on so the slot isn't lost
+                if fut in self._waiters:
+                    self._waiters.remove(fut)
+                elif fut.done() and not fut.cancelled():
+                    self._wake_one()
+                raise
+        self._inflight += 1
+        self.dispatched_total += 1
+        self.peak_inflight = max(self.peak_inflight, self._inflight)
+
+    def _wake_one(self) -> None:
+        while self._waiters:
+            fut = self._waiters.popleft()
+            if not fut.done():
+                fut.set_result(None)
+                break
+
+    def release(self) -> None:
+        self._inflight = max(0, self._inflight - 1)
+        self._wake_one()
+
+    # ---------------- adaptive pad floor ------------------------------------
+
+    def effective_floor(self) -> int:
+        """Shallow queue (nothing else in flight, nobody parked) ⇒ the
+        small latency floor; any concurrency ⇒ the throughput floor.
+
+        Called AFTER acquire, so ``in_flight`` counts this dispatch too:
+        1 in flight and no waiters is the idle-broker single-publish
+        shape the latency floor exists for.
+        """
+        if self._inflight <= 1 and not self._waiters:
+            return self.min_floor
+        return self.base_floor
+
+    # ---------------- fetch-on-ready ----------------------------------------
+
+    @staticmethod
+    def start_fetch(res) -> None:
+        """Kick the device→host copy without blocking (fetch-on-ready
+        half 1); ``np.asarray`` later finds the bytes already local.
+        Only the leaves ``_fetch_walk`` actually reads — ``n_routes`` is
+        derivable from ``count`` and never fetched, so copying it would
+        be one wasted D2H transfer per batch on the tunnel backend."""
+        for leaf in (res.start, res.count, res.overflow):
+            copy_async = getattr(leaf, "copy_to_host_async", None)
+            if copy_async is not None:
+                try:
+                    copy_async()
+                except Exception:  # noqa: BLE001 — backend-optional fast path
+                    return
+
+    @staticmethod
+    async def wait_ready(res, poll_s: float = 0.0005,
+                         spin_polls: int = 50) -> None:
+        """Yield the event loop until every result leaf is ready (half 2).
+
+        ``is_ready`` is a PJRT-buffer query, not a sync: other coroutines
+        (the NEXT batch's tokenize + dispatch) run between polls. Backends
+        whose arrays lack ``is_ready`` fall through to the blocking fetch
+        the caller performs next — still correct, just unoverlapped.
+
+        Two-phase poll: the first ``spin_polls`` misses use ``sleep(0)``
+        — a bare loop yield costing microseconds, which sub-millisecond
+        CPU walks finish within (a timed sleep would quantize to the
+        loop's ~1ms timer and tax every fast batch) — then back off to
+        ``poll_s`` timed sleeps for genuinely long completions (the axon
+        tunnel's ~70ms RTT), where spinning would burn a core for nothing.
+        """
+        leaves = [res.start, res.count, res.overflow]
+        polls = 0
+        while True:
+            try:
+                if all(leaf.is_ready() for leaf in leaves):
+                    return
+            except AttributeError:
+                return
+            await asyncio.sleep(0 if polls < spin_polls else poll_s)
+            polls += 1

@@ -27,6 +27,7 @@ class DeviceGauges:
         self._clock = clock
         self._matchers: "weakref.WeakSet" = weakref.WeakSet()
         self._schedulers: "weakref.WeakSet" = weakref.WeakSet()
+        self._rings: "weakref.WeakSet" = weakref.WeakSet()
         self._mem_cache: Optional[dict] = None
         self._mem_at = -1e18
         self._mem_peak_bytes = 0
@@ -38,6 +39,15 @@ class DeviceGauges:
     def register_scheduler(self, scheduler) -> None:
         """Track a BatchCallScheduler's live queue depth (weakly held)."""
         self._schedulers.add(scheduler)
+
+    def register_ring(self, ring) -> None:
+        """Track a DispatchRing's in-flight occupancy (ISSUE 6: the async
+        pipeline's half of the dispatch-queue picture — batches PAST the
+        batcher queue but not yet fetched; weakly held). The adaptive
+        shaping signals themselves live at the sources (Batcher._adapt's
+        depth-at-emit, DispatchRing.effective_floor); this surface is
+        observability only."""
+        self._rings.add(ring)
 
     @property
     def peak_memory_bytes(self) -> int:
@@ -66,10 +76,23 @@ class DeviceGauges:
                 depth += len(getattr(b, "_queue", ()))
                 inflight += getattr(b, "_inflight", 0)
                 cap = max(cap, getattr(b, "_cap", 0))
+        ring_inflight = ring_waiting = ring_peak = ring_depth = 0
+        for ring in list(self._rings):
+            ring_inflight += getattr(ring, "in_flight", 0)
+            ring_waiting += getattr(ring, "waiting", 0)
+            ring_peak = max(ring_peak, getattr(ring, "peak_inflight", 0))
+            ring_depth = max(ring_depth, getattr(ring, "depth", 0))
         return {"dispatch_queue_depth": depth,
                 "batches_in_flight": inflight,
                 "batchers": batchers,
-                "max_batch_cap": cap}
+                "max_batch_cap": cap,
+                # ISSUE 6: device-side pipeline occupancy (the ring holds
+                # batches already dispatched to the device, distinct from
+                # the batcher queue waiting in front of it)
+                "ring_in_flight": ring_inflight,
+                "ring_waiting": ring_waiting,
+                "ring_peak_in_flight": ring_peak,
+                "ring_depth": ring_depth}
 
     def _memory_stats(self) -> dict:
         now = self._clock()
@@ -132,6 +155,7 @@ class DeviceGauges:
     def reset(self) -> None:
         self._matchers = weakref.WeakSet()
         self._schedulers = weakref.WeakSet()
+        self._rings = weakref.WeakSet()
         self._mem_cache = None
         self._mem_at = -1e18
         self._mem_peak_bytes = 0

@@ -216,15 +216,22 @@ def _bitonic_desc(x: jax.Array) -> jax.Array:
         pad = jnp.full((x.shape[0], n - orig), jnp.iinfo(jnp.int32).min,
                        dtype=x.dtype)
         x = jnp.concatenate([x, pad], axis=1)
-    lane = np.arange(n)
+    # partner/direction WITHOUT numpy closure constants (the Pallas
+    # tracer rejects captured arrays — this one body serves both the lax
+    # walk and the fused kernel, models/kernels.py): the lane^step
+    # exchange is a REGULAR blocked swap, so it lowers as reshape + a
+    # static reversed slice (vector shuffles, no gather), and the
+    # direction mask is elementwise on an iota — lane < (lane^step) iff
+    # lane's step-bit is 0 — which XLA constant-folds.
+    b = x.shape[0]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
     stage = 2
     while stage <= n:
         step = stage // 2
         while step >= 1:
-            partner = lane ^ step
-            y = x[:, partner]
-            take_max = jnp.asarray(((lane & stage) == 0)
-                                   == (lane < partner))[None, :]
+            y = x.reshape(b, n // (2 * step), 2, step)[:, :, ::-1, :] \
+                 .reshape(b, n)
+            take_max = ((lane & stage) == 0) == ((lane & step) == 0)
             x = jnp.where(take_max, jnp.maximum(x, y), jnp.minimum(x, y))
             step //= 2
         stage *= 2
@@ -591,13 +598,10 @@ def _route_walk(trie: DeviceTrie, probes: Probes, probe_len: int,
     return ivl_s, ivl_c, n_routes, overflow | (n_ivl > a)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("probe_len", "k_states", "compaction",
-                                    "max_intervals", "esc_k", "esc_rows"))
-def walk_routes(trie: DeviceTrie, probes: Probes, *, probe_len: int,
-                k_states: int = 32, compaction: str = "sort",
-                max_intervals: int = 32, esc_k=None, esc_rows=None
-                ) -> RouteIntervals:
+def _walk_routes_fn(trie: DeviceTrie, probes: Probes, *, probe_len: int,
+                    k_states: int = 32, compaction: str = "sort",
+                    max_intervals: int = 32, esc_k=None, esc_rows=None
+                    ) -> RouteIntervals:
     """Interval walk + fused on-device overflow escalation.
 
     Same escalation contract as walk_count_only: overflowed rows (active
@@ -650,6 +654,37 @@ def walk_routes(trie: DeviceTrie, probes: Probes, *, probe_len: int,
     out = jax.lax.cond(overflow.any(), escalate, lambda a: a,
                        (ivl_s, ivl_c, n_routes, overflow))
     return RouteIntervals(*out)
+
+
+_WALK_ROUTES_STATICS = ("probe_len", "k_states", "compaction",
+                        "max_intervals", "esc_k", "esc_rows")
+
+walk_routes = functools.partial(
+    jax.jit, static_argnames=_WALK_ROUTES_STATICS)(_walk_routes_fn)
+
+# ISSUE 6 tentpole: the dispatch ring's variant DONATES the probe buffers
+# (arg 1) — the backend frees (or reuses) their device memory as soon as
+# the walk consumes them, so a depth-N in-flight pipeline holds N result
+# buffers, not N probe + N result. Callers must treat the Probes object
+# as CONSUMED after the call (re-reading a donated jax buffer raises
+# "Array has been deleted"); the matcher's escalation/readback paths only
+# ever touch the HOST TokenizedTopics copy, never the donated device
+# arrays.
+_walk_routes_donated_jit = functools.partial(
+    jax.jit, static_argnames=_WALK_ROUTES_STATICS,
+    donate_argnums=(1,))(_walk_routes_fn)
+
+
+def walk_routes_donated(trie, probes, **kw):
+    import warnings
+    with warnings.catch_warnings():
+        # probe shapes ([B, W] tokens) rarely tile onto the result shapes
+        # ([B, A] intervals), so XLA reports the donation as "not usable"
+        # for aliasing — the EARLY FREE is the point here, and the hint
+        # would fire on every new shape class in live serving
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        return _walk_routes_donated_jit(trie, probes, **kw)
 
 
 def _expand_lib():

@@ -286,6 +286,12 @@ class MeshMatcher(TpuMatcher):
     serves live add_route/remove_route traffic, answering VERDICT-r2's
     'MeshMatcher is a demo' finding."""
 
+    # the shard-routed [R,S,B] device plane replaces _match_batch_device
+    # wholesale, so the ISSUE 6 async dispatch ring (which drives
+    # TpuMatcher._dispatch_device) degrades to this sync path; pipelining
+    # the mesh step is the ROADMAP multi-chip item's business
+    supports_async = False
+
     def __init__(self, tries: Optional[Dict[str, SubscriptionTrie]] = None,
                  mesh: Optional[Mesh] = None, *,
                  max_levels: int = 16, probe_len: int = 16,

@@ -47,21 +47,44 @@ class Batcher(Generic[CallT, ResultT]):
     """One batching pipeline (≈ Batcher.java:46).
 
     - bounded in-flight pipeline (``pipeline_depth``)
-    - adaptive batch cap: grows while observed batch latency stays within
-      ``max_burst_latency``, shrinks multiplicatively when it overruns
+    - queue-depth-adaptive batch cap (ISSUE 6, replacing the
+      latency-EWMA-only heuristic): the cap grows toward the
+      throughput-optimal max while the queue stays SATURATED (depth at
+      emit ≥ cap) within the latency budget, and decays back toward the
+      idle cap while the queue runs SHALLOW — so after a burst drains,
+      the next trickle of calls emits small batches (time-to-first-result)
+      instead of padding to a stale burst-sized cap. A latency overrun
+      still halves the cap (the ``maxBurstLatency`` guard).
     """
 
-    def __init__(self, process_batch: BatchFn, *, pipeline_depth: int = 2,
+    #: cap a freshly-built (or drained-idle) batcher starts from
+    IDLE_CAP = 64
+
+    def __init__(self, process_batch: BatchFn, *,
+                 pipeline_depth: Optional[int] = 2,
                  max_burst_latency: float = 0.010, max_batch_size: int = 8192,
                  min_batch_size: int = 1,
                  stage: Optional[str] = None,
-                 obs_key: Optional[str] = None) -> None:
+                 obs_key: Optional[str] = None,
+                 shallow_decay: bool = True,
+                 clock: Callable[[], float] = time.perf_counter) -> None:
+        if pipeline_depth is None:
+            # ISSUE 6: one knob rules the whole pipeline — the batcher's
+            # in-flight batches and the matcher's dispatch ring share
+            # BIFROMQ_PIPELINE_DEPTH (double/triple buffering)
+            from ..models.pipeline import pipeline_depth as _env_depth
+            pipeline_depth = _env_depth()
         self._process = process_batch
         self._depth = pipeline_depth
         self._budget = max_burst_latency
         self._max_cap = max_batch_size
-        self._cap = max(min_batch_size, 64)
+        self._idle_cap = min(max(min_batch_size, self.IDLE_CAP),
+                             max_batch_size)
+        self._cap = self._idle_cap
         self._min_cap = min_batch_size
+        # injectable time source (fake-clock adaptive-sizing tests drive
+        # the latency/depth signals deterministically)
+        self._clock = clock
         # ISSUE 2: a named stage turns on enqueue→emit queue-wait
         # attribution — per-call histogram records under ``stage`` and,
         # for sampled calls, deferred "batch.queue_wait" spans stamped
@@ -76,6 +99,16 @@ class Batcher(Generic[CallT, ResultT]):
                                 Optional[object], int]] = []
         self._inflight = 0
         self._latency = EMA(init=0.0)
+        # queue depth observed at emit (EMA smooths one-batch spikes so a
+        # single burst doesn't whipsaw the cap)
+        self._depth_ema = EMA(alpha=0.3, init=0.0)
+        # shallow-queue decay exists for time-to-first-result on SERVING
+        # batchers; coalescers whose batches are purely throughput (the
+        # worker's consensus-mutation batcher: one raft propose per
+        # batch) opt out, or each bursty drain tail would shrink the cap
+        # and the next burst would re-grow from idle in many small,
+        # per-batch-expensive proposes
+        self._shallow_decay = shallow_decay
         # strong refs: the loop only weakly references tasks, and a collected
         # batch task would strand every future in that batch
         self._tasks: set = set()
@@ -92,7 +125,7 @@ class Batcher(Generic[CallT, ResultT]):
                 shlc = HLC.INST.get()
             else:
                 tctx = None
-            self._queue.append((call, fut, time.perf_counter(), tctx,
+            self._queue.append((call, fut, self._clock(), tctx,
                                 shlc))
         else:
             # un-staged batchers (e.g. the worker's mutation coalescer)
@@ -115,19 +148,29 @@ class Batcher(Generic[CallT, ResultT]):
     def avg_latency(self) -> float:
         return self._latency.value
 
+    @property
+    def queue_depth(self) -> int:
+        """Calls enqueued but not yet emitted (the obs/device.py
+        dispatch-queue gauge reads this via ``_queue``)."""
+        return len(self._queue)
+
     def _trigger(self) -> None:
         while self._queue and self._inflight < self._depth:
+            # depth BEFORE slicing: the saturation signal _adapt keys on
+            # is "how much work was waiting when this batch emitted"
+            depth_at_emit = len(self._queue)
             batch = self._queue[:self._cap]
             del self._queue[:len(batch)]
             self._inflight += 1
             self.batches_emitted += 1
-            task = asyncio.get_running_loop().create_task(self._run(batch))
+            task = asyncio.get_running_loop().create_task(
+                self._run(batch, depth_at_emit))
             self._tasks.add(task)
             task.add_done_callback(self._tasks.discard)
 
-    async def _run(self, batch: List[Tuple]) -> None:
+    async def _run(self, batch: List[Tuple], depth_at_emit: int = 0) -> None:
         calls = [b[0] for b in batch]
-        start = time.perf_counter()
+        start = self._clock()
         rep_ctx = None
         links: List[Tuple[int, int]] = []
         if self._stage is not None:
@@ -173,8 +216,8 @@ class Batcher(Generic[CallT, ResultT]):
                         results = await self._process(calls)
             else:
                 results = await self._process(calls)
-            elapsed = time.perf_counter() - start
-            self._adapt(len(calls), elapsed)
+            elapsed = self._clock() - start
+            self._adapt(len(calls), elapsed, depth_at_emit)
             for b, res in zip(batch, results):
                 fut = b[1]
                 if not fut.done():
@@ -188,12 +231,29 @@ class Batcher(Generic[CallT, ResultT]):
             self._inflight -= 1
             self._trigger()
 
-    def _adapt(self, batch_size: int, elapsed: float) -> None:
+    def _adapt(self, batch_size: int, elapsed: float,
+               depth_at_emit: int = 0) -> None:
+        """Queue-depth-adaptive cap (ISSUE 6). Three regimes:
+
+        - latency overrun ⇒ halve (unchanged ``maxBurstLatency`` guard);
+        - saturated (the queue held ≥ a full cap when this batch emitted)
+          within budget ⇒ double toward the throughput-optimal cap;
+        - shallow (smoothed depth under a quarter cap) ⇒ decay halfway
+          toward the idle cap, so the cap tracks the LIVE queue instead
+          of whatever the last burst grew it to.
+        """
         self._latency.update(elapsed)
+        self._depth_ema.update(depth_at_emit)
         if elapsed > self._budget:
             self._cap = max(self._min_cap, self._cap // 2)
-        elif batch_size >= self._cap and self._latency.value < self._budget / 2:
+            return
+        if (depth_at_emit >= self._cap
+                and self._latency.value < self._budget / 2):
             self._cap = min(self._max_cap, self._cap * 2)
+        elif (self._shallow_decay
+                and self._depth_ema.value < self._cap / 4
+                and self._cap > self._idle_cap):
+            self._cap = max(self._idle_cap, self._cap // 2)
 
 
 class BatchCallScheduler(Generic[CallT, ResultT]):
@@ -204,11 +264,12 @@ class BatchCallScheduler(Generic[CallT, ResultT]):
     """
 
     def __init__(self, process_batch_for_key: Callable[
-            [Hashable], BatchFn], *, pipeline_depth: int = 2,
+            [Hashable], BatchFn], *, pipeline_depth: Optional[int] = 2,
             max_burst_latency: float = 0.010,
             max_batch_size: int = 8192,
             stage: Optional[str] = None,
-            obs_tenant_key: bool = False) -> None:
+            obs_tenant_key: bool = False,
+            shallow_decay: bool = True) -> None:
         self._factory = process_batch_for_key
         self._depth = pipeline_depth
         self._budget = max_burst_latency
@@ -219,6 +280,7 @@ class BatchCallScheduler(Generic[CallT, ResultT]):
         # so a future staged scheduler keyed by range/shard can't leak
         # bogus rows into the tenant SLO registry
         self._obs_tenant_key = obs_tenant_key
+        self._shallow_decay = shallow_decay
         self._batchers: Dict[Hashable, Batcher] = {}
         self.calls_seen = 0
         if stage is not None:
@@ -234,7 +296,8 @@ class BatchCallScheduler(Generic[CallT, ResultT]):
                         max_batch_size=self._max_batch,
                         stage=self._stage,
                         obs_key=str(key) if self._obs_tenant_key
-                        else None)
+                        else None,
+                        shallow_decay=self._shallow_decay)
             self._batchers[key] = b
         return b
 

@@ -1,0 +1,143 @@
+#!/usr/bin/env bash
+# Tier-2 device-pipeline latency gate (ISSUE 6): exercises the async
+# dispatch ring + queue-depth-adaptive batching on CPU-scaled inputs and
+# asserts
+#   1. pipelined small-batch serving lands e2e batch p99 under a
+#      CPU-scaled threshold (default 50ms; the TPU target is <1ms),
+#   2. the pipelined p99 beats the sync full-batch baseline by >=10x
+#      (the BENCH_r01 666ms-sync failure shape),
+#   3. fused-kernel on (interpret mode on CPU) and off produce IDENTICAL
+#      match results on a randomized workload,
+#   4. the match-cache hit path does not regress: a repeated-topic
+#      workload still serves >80% from cache through the async path and
+#      a pure compaction does not cold-start it.
+# Runs on CPU (JAX_PLATFORMS=cpu), hard timeout like the other gates.
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+
+timeout -k 10 "${LATENCY_CHECK_TIMEOUT:-420}" \
+    env JAX_PLATFORMS=cpu \
+    python - <<'EOF'
+import asyncio, os, random, time
+
+import numpy as np
+
+from bifromq_tpu import workloads
+from bifromq_tpu.models.matcher import TpuMatcher
+from bifromq_tpu.models.pipeline import pipeline_depth
+
+N_SUBS = 20_000
+BIG = 2048
+SMALL = 16
+ITERS = 10
+P99_MS_MAX = float(os.environ.get("LATENCY_CHECK_P99_MS", "50"))
+
+tries = workloads.config_wildcard(N_SUBS, seed=0)
+topics = workloads.probe_topics(BIG * 4, seed=1)
+
+
+def canon(m):
+    return (sorted((r.matcher.mqtt_topic_filter, r.receiver_url)
+                   for r in m.normal),
+            {f: sorted(r.receiver_url for r in ms)
+             for f, ms in m.groups.items()})
+
+
+# ---- 1+2: sync baseline vs pipelined p99 --------------------------------
+m = TpuMatcher.from_tries(tries, match_cache=False, auto_compact=False)
+big_batches = [[("tenant0", t) for t in topics[i * BIG:(i + 1) * BIG]]
+               for i in range(4)]
+m.match_batch(big_batches[0])           # warm
+sync_lat = []
+for it in range(ITERS):
+    s0 = time.perf_counter()
+    m.match_batch(big_batches[it % 4])
+    sync_lat.append(time.perf_counter() - s0)
+sync_p99 = float(np.percentile(sync_lat, 99)) * 1e3
+
+sm = [[("tenant0", topics[(j * SMALL + k) % len(topics)])
+       for k in range(SMALL)] for j in range(512)]
+
+
+async def run_pipe():
+    lats = []
+    nxt = {"i": 0}
+
+    async def worker():
+        while nxt["i"] < len(sm):
+            b = sm[nxt["i"]]
+            nxt["i"] += 1
+            s0 = time.perf_counter()
+            await m.match_batch_async(b)
+            lats.append(time.perf_counter() - s0)
+
+    await m.match_batch_async(sm[0])    # warm the small shape
+    await asyncio.gather(*[worker() for _ in range(pipeline_depth())])
+    return lats
+
+pipe_lat = asyncio.run(run_pipe())
+pipe_p99 = float(np.percentile(pipe_lat, 99)) * 1e3
+speedup = sync_p99 / max(1e-9, pipe_p99)
+print(f"sync batch p99 {sync_p99:.1f}ms, pipelined batch p99 "
+      f"{pipe_p99:.2f}ms, speedup {speedup:.1f}x "
+      f"(ring peak in-flight {m._ring.peak_inflight})")
+assert pipe_p99 < P99_MS_MAX, \
+    f"pipelined p99 {pipe_p99:.1f}ms over the {P99_MS_MAX}ms CPU bound"
+assert speedup >= 10, f"p99 speedup {speedup:.1f}x < 10x"
+
+# ---- 3: fused-kernel on/off parity --------------------------------------
+rng = random.Random(3)
+probe = [("tenant0", topics[rng.randrange(len(topics))])
+         for _ in range(64)]
+legs = {}
+for mode in ("0", "1"):
+    os.environ["BIFROMQ_FUSED_KERNEL"] = mode
+    mm = TpuMatcher.from_tries(tries, match_cache=False,
+                               auto_compact=False, k_states=8)
+    legs[mode] = [canon(r) for r in mm.match_batch(probe, batch=64)]
+os.environ.pop("BIFROMQ_FUSED_KERNEL")
+assert legs["0"] == legs["1"], "fused kernel diverged from lax walk"
+print("fused on/off parity ok (64 randomized queries)")
+
+# ---- 4: cache hit path through the async pipeline -----------------------
+mc = TpuMatcher.from_tries(tries, match_cache=True, auto_compact=False)
+hot = [("tenant0", topics[i]) for i in range(24)]
+
+
+async def hot_loop():
+    for _ in range(20):
+        res = await mc.match_batch_async(hot)
+        for r, q in zip(res, hot):
+            want = mc.match_from_tries([q])[0]
+            assert canon(r) == canon(want), "cached serve diverged"
+
+asyncio.run(hot_loop())
+hits, misses = mc.match_cache.counts()
+rate = hits / max(1, hits + misses)
+print(f"async hit rate {rate:.3f} ({hits} hits / {misses} misses)")
+assert rate > 0.8, f"hit rate {rate:.3f} <= 0.8"
+
+# pure compaction must not cold-start the cache (ISSUE 6 satellite):
+# an exact-filter mutation evicts ONE key, then the fold into a fresh
+# same-salt base must leave the generation (and the hot set) alone
+from bifromq_tpu.models.oracle import Route
+from bifromq_tpu.types import RouteMatcher
+mc.add_route("tenant0", Route(
+    matcher=RouteMatcher.from_topic_filter("gate/exact/key"),
+    broker_id=0, receiver_id="gate", deliverer_key="d0"))
+gen0 = mc.match_cache._gen
+mc.refresh()    # real compaction: folds the overlay into a new base
+assert mc.match_cache._gen == gen0, "pure compaction bumped generation"
+h0 = mc.match_cache.hits
+asyncio.run(hot_loop())
+assert mc.match_cache.hits > h0, "compaction cold-started the cache"
+print("pure-compaction cache retention ok")
+print("LATENCY GATE PASS")
+EOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "latency_check: FAIL (rc=$rc)" >&2
+    exit $rc
+fi
+echo "latency_check: PASS"

@@ -1,0 +1,149 @@
+"""Fused Pallas trie-walk kernel tests (ISSUE 6): row-for-row parity
+against the lax walk and the host oracle under randomized subscriptions,
+plus the env kill-switch / auto-gating contract."""
+
+import random
+
+import numpy as np
+import pytest
+
+from bifromq_tpu.models import kernels as K
+from bifromq_tpu.models.automaton import compile_tries, tokenize
+from bifromq_tpu.models.kernels import fused_enabled, fused_walk_routes
+from bifromq_tpu.models.matcher import TpuMatcher
+from bifromq_tpu.models.oracle import Route, SubscriptionTrie
+from bifromq_tpu.ops.match import (DeviceTrie, Probes, expand_intervals,
+                                   walk_routes)
+from bifromq_tpu.types import RouteMatcher
+
+
+def _random_world(seed: int, n_routes: int = 120, n_names: int = 12):
+    """Randomized subscriptions (exact / '+' / '#' / '$SYS') + probe
+    topics, with the oracle trie to check expansions against."""
+    rng = random.Random(seed)
+    names = [f"n{i}" for i in range(n_names)]
+    trie = SubscriptionTrie()
+    for i in range(n_routes):
+        depth = rng.randint(1, 5)
+        levels = [rng.choice(names + ["+"]) for _ in range(depth)]
+        if rng.random() < 0.25:
+            levels.append("#")
+        if rng.random() < 0.1:
+            levels[0] = "$SYS"
+        trie.add(Route(matcher=RouteMatcher.from_topic_filter(
+            "/".join(levels)), broker_id=0, receiver_id=f"r{i}",
+            deliverer_key="d0"))
+    topics = []
+    for _ in range(40):
+        t = [rng.choice(names) for _ in range(rng.randint(1, 5))]
+        if rng.random() < 0.1:
+            t[0] = "$SYS"
+        topics.append(t)
+    return trie, topics
+
+
+@pytest.mark.parametrize("seed", [0, 7, 21])
+def test_fused_row_identical_to_lax_and_oracle(seed):
+    trie, topics = _random_world(seed)
+    ct = compile_tries({"T": trie}, max_levels=8)
+    dev = DeviceTrie.from_compiled(ct)
+    tok = tokenize(topics, [ct.root_of("T")] * len(topics),
+                   max_levels=ct.max_levels, salt=ct.salt, batch=64)
+    probes = Probes.from_tokenized(tok)
+    kw = dict(probe_len=ct.probe_len, k_states=8, max_intervals=16)
+    lax = walk_routes(dev, probes, esc_k=0, **kw)
+    fused = fused_walk_routes(dev, probes, **kw)    # interpret on CPU
+    for field in ("start", "count", "n_routes", "overflow"):
+        a = np.asarray(getattr(lax, field))
+        b = np.asarray(getattr(fused, field))
+        assert (a == b).all(), f"{field} diverged at seed {seed}"
+    # non-overflow rows expand to exactly the oracle's route set
+    slots, offs = expand_intervals(fused.start, fused.count)
+    ovf = np.asarray(fused.overflow)
+    arr = ct.matchings_arr
+    for qi, levels in enumerate(topics):
+        if ovf[qi]:
+            continue
+        got = sorted(m.receiver_id for m in arr[slots[offs[qi]:offs[qi + 1]]]
+                     if not hasattr(m, "members"))
+        exp = sorted(r.receiver_id
+                     for r in trie.match(list(levels)).normal)
+        assert got == exp, f"row {qi} ({levels}) diverged at seed {seed}"
+
+
+def test_fused_escalation_budget_parity():
+    """High-fanout rows: the fused kernel must flag the same overflow
+    rows and agree with the lax walk at the escalated budget too."""
+    trie = SubscriptionTrie()
+    # 24 overlapping '+' filters -> active sets larger than k_states=4
+    for i in range(24):
+        trie.add(Route(matcher=RouteMatcher.from_topic_filter(f"+/f{i}"),
+                       broker_id=0, receiver_id=f"w{i}",
+                       deliverer_key="d0"))
+        trie.add(Route(matcher=RouteMatcher.from_topic_filter("a/+"),
+                       broker_id=0, receiver_id=f"p{i}",
+                       deliverer_key="d0", incarnation=i))
+    ct = compile_tries({"T": trie}, max_levels=4)
+    dev = DeviceTrie.from_compiled(ct)
+    tok = tokenize([["a", "f0"], ["a", "zz"]], [ct.root_of("T")] * 2,
+                   max_levels=ct.max_levels, salt=ct.salt, batch=16)
+    probes = Probes.from_tokenized(tok)
+    for k_states, max_intervals in ((4, 4), (32, 32)):
+        kw = dict(probe_len=ct.probe_len, k_states=k_states,
+                  max_intervals=max_intervals)
+        lax = walk_routes(dev, probes, esc_k=0, **kw)
+        fused = fused_walk_routes(dev, probes, **kw)
+        for field in ("start", "count", "n_routes", "overflow"):
+            assert (np.asarray(getattr(lax, field))
+                    == np.asarray(getattr(fused, field))).all()
+
+
+class TestGating:
+    def test_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("BIFROMQ_FUSED_KERNEL", "0")
+        assert fused_enabled() is False
+
+    def test_force_on(self, monkeypatch):
+        monkeypatch.setenv("BIFROMQ_FUSED_KERNEL", "1")
+        assert fused_enabled() is True
+
+    def test_auto_is_off_on_cpu(self, monkeypatch):
+        monkeypatch.delenv("BIFROMQ_FUSED_KERNEL", raising=False)
+        # CI runs on the CPU backend: auto must pick the lax walk
+        assert fused_enabled() is False
+
+    def test_auto_vmem_gate_on_tpu(self, monkeypatch):
+        monkeypatch.delenv("BIFROMQ_FUSED_KERNEL", raising=False)
+        monkeypatch.setattr(K, "_on_tpu", lambda: True)
+        small = DeviceTrie(
+            node_tab=np.zeros((4, 12), np.int32),
+            edge_tab=np.zeros((4, 16, 4), np.int32),
+            child_list=np.zeros((4,), np.int32),
+            route_tab=np.zeros((4, 8), np.int32))
+        assert fused_enabled(small) is True
+        monkeypatch.setenv("BIFROMQ_FUSED_VMEM_MB", "1")
+        big = DeviceTrie(
+            node_tab=np.zeros((4, 12), np.int32),
+            edge_tab=np.zeros((1 << 14, 16, 4), np.int32),  # 4 MB
+            child_list=np.zeros((4,), np.int32),
+            route_tab=np.zeros((4, 8), np.int32))
+        assert fused_enabled(big) is False
+
+
+def test_matcher_serves_identically_through_fused(monkeypatch):
+    """End-to-end kill-switch A/B: TpuMatcher.match_batch results must be
+    identical with the fused kernel forced on (interpret mode on CPU) and
+    forced off."""
+    trie, topics = _random_world(99, n_routes=60)
+    results = {}
+    for mode in ("0", "1"):
+        monkeypatch.setenv("BIFROMQ_FUSED_KERNEL", mode)
+        m = TpuMatcher(max_levels=8, k_states=8, auto_compact=False,
+                       match_cache=False)
+        m.tries = {"T": trie}
+        m._shadow = m.tries
+        m.refresh()
+        res = m.match_batch([("T", t) for t in topics[:16]], batch=16)
+        results[mode] = [sorted(r.receiver_id for r in mr.normal)
+                        for mr in res]
+    assert results["0"] == results["1"]

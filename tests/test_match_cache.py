@@ -216,18 +216,46 @@ class TestMatcherCachePlane:
         assert sorted(r.receiver_id for r in res[0].normal) == ["r1", "r3"]
         assert [r.receiver_id for r in res[1].normal] == ["r2"]
 
-    def test_compaction_bumps_generation(self):
+    def test_pure_compaction_keeps_cache(self):
+        """ISSUE 6 satellite (PR-4 follow-up): a compaction that folds
+        the overlay into a SAME-SALT base produces an equivalent
+        automaton — it must NOT cold-start the cache (the mutation itself
+        already did its filter-aware invalidation at apply time)."""
         m = TpuMatcher(max_levels=8, auto_compact=False, match_cache=True)
         m.add_route("T", mk_route("a/b", "r1"))
         m.refresh()
         m.match_batch([("T", ["a", "b"])])
         bumps = m.match_cache.epoch_bumps
-        m.add_route("T", mk_route("x/y", "r2"))
-        m.refresh()                                # base rebuild
-        assert m.match_cache.epoch_bumps > bumps
+        m.add_route("T", mk_route("x/y", "r2"))    # exact filter: evicts
+        m.refresh()                                # only the x/y key
+        assert m.match_cache.epoch_bumps == bumps  # no generation bump
         h0 = m.match_cache.hits
         res = m.match_batch([("T", ["a", "b"])])
-        assert m.match_cache.hits == h0            # miss after rebuild
+        assert m.match_cache.hits == h0 + 1        # still cached
+        assert [r.receiver_id for r in res[0].normal] == ["r1"]
+        # the evicted key re-matches fresh and correct
+        res = m.match_batch([("T", ["x", "y"])])
+        assert [r.receiver_id for r in res[0].normal] == ["r2"]
+
+    def test_salt_change_still_bumps_generation(self):
+        """The conservative half of the compaction-skip contract: a base
+        whose SALT differs (hash-collision recompile) bumps the global
+        generation wholesale."""
+        from bifromq_tpu.models.automaton import compile_tries
+        from bifromq_tpu.ops.match import DeviceTrie
+
+        m = TpuMatcher(max_levels=8, auto_compact=False, match_cache=True)
+        m.add_route("T", mk_route("a/b", "r1"))
+        m.refresh()
+        m.match_batch([("T", ["a", "b"])])
+        gen0 = m.match_cache._gen
+        ct2 = compile_tries(m.tries, max_levels=8,
+                            salt=m._base_ct.salt + 1)
+        m._install_base(ct2, DeviceTrie.from_compiled(ct2))
+        assert m.match_cache._gen > gen0
+        h0 = m.match_cache.hits
+        res = m.match_batch([("T", ["a", "b"])])
+        assert m.match_cache.hits == h0            # miss after salt change
         assert [r.receiver_id for r in res[0].normal] == ["r1"]
 
     def test_randomized_mutation_query_interleaving_parity(self):

@@ -1,0 +1,419 @@
+"""Device-pipeline tests (ISSUE 6): queue-depth-adaptive batch sizing
+(fake clock), in-flight overlap through the dispatch ring, donation
+safety, and the _InFlight snapshot discipline under mid-flight mutations
+and compaction swaps."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from bifromq_tpu.models.matcher import TpuMatcher
+from bifromq_tpu.models.oracle import Route
+from bifromq_tpu.models.pipeline import DispatchRing
+from bifromq_tpu.scheduler.batcher import Batcher
+from bifromq_tpu.types import RouteMatcher
+
+
+def mk_route(topic_filter: str, receiver: str, incarnation: int = 0):
+    return Route(matcher=RouteMatcher.from_topic_filter(topic_filter),
+                 broker_id=0, receiver_id=receiver, deliverer_key="d0",
+                 incarnation=incarnation)
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------- adaptive batch sizing (fake clock) ------------------------
+
+
+class TestAdaptiveSizing:
+    async def test_deep_queue_grows_cap(self):
+        clk = FakeClock()
+
+        async def fast(calls):
+            clk.advance(0.001)      # well under the budget
+            return list(calls)
+
+        b = Batcher(fast, max_burst_latency=0.5, pipeline_depth=1,
+                    clock=clk)
+        for _ in range(6):
+            futs = [b.submit(i) for i in range(b.batch_cap * 2)]
+            await asyncio.gather(*futs)
+        assert b.batch_cap > Batcher.IDLE_CAP
+
+    async def test_shallow_queue_emits_small_batches(self):
+        clk = FakeClock()
+        sizes = []
+
+        async def fast(calls):
+            sizes.append(len(calls))
+            clk.advance(0.001)
+            return list(calls)
+
+        b = Batcher(fast, max_burst_latency=0.5, pipeline_depth=2,
+                    clock=clk)
+        # trickle: one call at a time, each fully drained — every batch
+        # must emit immediately at size 1, never padded/held to the cap
+        for i in range(10):
+            await b.submit(i)
+        assert sizes == [1] * 10
+        assert b.batch_cap == Batcher.IDLE_CAP    # never grew
+
+    async def test_cap_decays_after_burst_drains(self):
+        clk = FakeClock()
+
+        async def fast(calls):
+            clk.advance(0.001)
+            return list(calls)
+
+        b = Batcher(fast, max_burst_latency=0.5, pipeline_depth=1,
+                    clock=clk)
+        # burst: saturate until the cap grows well past idle
+        for _ in range(6):
+            futs = [b.submit(i) for i in range(b.batch_cap * 2)]
+            await asyncio.gather(*futs)
+        grown = b.batch_cap
+        assert grown > Batcher.IDLE_CAP
+        # trickle: the depth EMA decays, the cap halves back toward idle
+        for i in range(80):
+            await b.submit(i)
+        assert b.batch_cap == Batcher.IDLE_CAP < grown
+
+    async def test_shallow_decay_opt_out_keeps_grown_cap(self):
+        # coalescer shape (the worker's consensus-mutation batcher):
+        # batches are pure throughput, so the cap must survive each
+        # burst's drain tail instead of re-growing from idle every burst
+        clk = FakeClock()
+
+        async def fast(calls):
+            clk.advance(0.001)
+            return list(calls)
+
+        b = Batcher(fast, max_burst_latency=0.5, pipeline_depth=1,
+                    shallow_decay=False, clock=clk)
+        for _ in range(6):
+            futs = [b.submit(i) for i in range(b.batch_cap * 2)]
+            await asyncio.gather(*futs)
+        grown = b.batch_cap
+        assert grown > Batcher.IDLE_CAP
+        for i in range(80):
+            await b.submit(i)
+        assert b.batch_cap == grown          # no decay
+        # the latency-overrun guard still applies to opted-out batchers
+        async def slow(calls):
+            clk.advance(1.0)
+            return list(calls)
+
+        b._process = slow
+        futs = [b.submit(i) for i in range(grown)]
+        await asyncio.gather(*futs)
+        assert b.batch_cap < grown
+
+    async def test_latency_overrun_still_halves(self):
+        clk = FakeClock()
+
+        async def slow(calls):
+            clk.advance(0.2)        # blows the budget every time
+            return list(calls)
+
+        b = Batcher(slow, max_burst_latency=0.01, clock=clk)
+        start = b.batch_cap
+        futs = [b.submit(i) for i in range(200)]
+        await asyncio.gather(*futs)
+        assert b.batch_cap < start
+
+    async def test_queue_depth_property(self):
+        started = asyncio.Event()
+        release = asyncio.Event()
+
+        async def block(calls):
+            started.set()
+            await release.wait()
+            return list(calls)
+
+        b = Batcher(block, pipeline_depth=1)
+        futs = [b.submit(i) for i in range(5)]
+        await started.wait()
+        # one in flight (the first emitted immediately), four queued
+        assert b.queue_depth == 4
+        release.set()
+        await asyncio.gather(*futs)
+        assert b.queue_depth == 0
+
+
+# ---------------- dispatch ring -------------------------------------------
+
+
+class TestDispatchRing:
+    async def test_ring_bounds_inflight_and_tracks_peak(self):
+        ring = DispatchRing(depth=2)
+        await ring.acquire()
+        await ring.acquire()
+        assert ring.in_flight == 2
+        third = asyncio.ensure_future(ring.acquire())
+        await asyncio.sleep(0)
+        assert not third.done()         # parked: ring is full
+        assert ring.waiting == 1
+        ring.release()
+        await asyncio.sleep(0)
+        assert third.done()
+        assert ring.peak_inflight == 2
+        ring.release()
+        ring.release()
+
+    async def test_cancelled_waiter_withdraws_from_queue(self):
+        """A parked waiter that gets cancelled must not linger in the
+        waiter deque — a stale entry overcounts ring.waiting and pins
+        effective_floor at the throughput floor on an idle broker."""
+        ring = DispatchRing(depth=1, min_floor=8)
+        await ring.acquire()
+        parked = asyncio.ensure_future(ring.acquire())
+        await asyncio.sleep(0)
+        assert ring.waiting == 1
+        parked.cancel()
+        await asyncio.sleep(0)
+        assert ring.waiting == 0
+        assert ring.effective_floor() == 8      # idle again: latency floor
+        # the slot still cycles: release + re-acquire works
+        ring.release()
+        await ring.acquire()
+        ring.release()
+
+    async def test_effective_floor_shallow_vs_busy(self):
+        ring = DispatchRing(depth=3, min_floor=8)
+        await ring.acquire()
+        assert ring.effective_floor() == 8      # alone in flight: latency
+        await ring.acquire()
+        assert ring.effective_floor() == 16     # concurrency: throughput
+        ring.release()
+        ring.release()
+
+
+# ---------------- matcher async pipeline -----------------------------------
+
+
+class _Gate:
+    def __init__(self) -> None:
+        self.open = False
+
+
+class _GatedLeaf:
+    """numpy-backed stand-in for a jax result buffer whose readiness the
+    test controls (CPU completes too fast to observe real overlap)."""
+
+    def __init__(self, arr, gate: _Gate) -> None:
+        self._arr = np.asarray(arr)
+        self._gate = gate
+
+    def is_ready(self) -> bool:
+        return self._gate.open
+
+    def copy_to_host_async(self) -> None:
+        pass
+
+    def __array__(self, dtype=None):
+        return (self._arr if dtype is None
+                else self._arr.astype(dtype, copy=False))
+
+
+def _gate_matcher(m: TpuMatcher, gate: _Gate):
+    """Wrap the primary walk so its results report not-ready until the
+    gate opens — the device is 'still walking'."""
+    from bifromq_tpu.ops.match import RouteIntervals
+    real = m._walk_primary
+
+    def gated(probes, ct, *, donate):
+        res, kernel = real(probes, ct, donate=donate)
+        return RouteIntervals(
+            start=_GatedLeaf(res.start, gate),
+            count=_GatedLeaf(res.count, gate),
+            n_routes=_GatedLeaf(res.n_routes, gate),
+            overflow=_GatedLeaf(res.overflow, gate)), kernel
+
+    m._walk_primary = gated
+
+
+@pytest.fixture(scope="module")
+def matcher():
+    m = TpuMatcher(max_levels=8, k_states=8, auto_compact=False,
+                   match_cache=True)
+    m.add_route("T", mk_route("a/b", "r1"))
+    m.add_route("T", mk_route("a/+", "r2"))
+    m.add_route("T", mk_route("x/#", "r3"))
+    m.add_route("T", mk_route("deep/q/w", "r4"))
+    m.refresh()
+    return m
+
+
+def _ids(res):
+    return sorted(r.receiver_id for r in res.normal)
+
+
+class TestMatcherAsync:
+    async def test_async_parity_with_sync(self, matcher):
+        qs = [("T", ["a", "b"]), ("T", ["x", "y", "z"]),
+              ("T", ["deep", "q", "w"]), ("T", ["nomatch"])]
+        sync = matcher.match_batch(qs)
+        matcher.match_cache.clear()
+        got = await matcher.match_batch_async(qs)
+        for a, b in zip(got, sync):
+            assert _ids(a) == _ids(b)
+
+    async def test_two_batches_in_flight_concurrently(self):
+        m = TpuMatcher(max_levels=8, k_states=8, auto_compact=False,
+                       match_cache=False)
+        m.add_route("T", mk_route("a/b", "r1"))
+        m.refresh()
+        gate = _Gate()
+        _gate_matcher(m, gate)
+        t1 = asyncio.ensure_future(
+            m.match_batch_async([("T", ["a", "b"])], batch=16))
+        t2 = asyncio.ensure_future(
+            m.match_batch_async([("T", ["a", "c"])], batch=16))
+        # let both tasks run to their readiness await
+        for _ in range(10):
+            await asyncio.sleep(0)
+        ring = m._ring
+        assert ring.in_flight >= 2, \
+            "batch N+1 must dispatch while batch N is still walking"
+        gate.open = True
+        r1, r2 = await asyncio.gather(t1, t2)
+        assert _ids(r1[0]) == ["r1"]
+        assert _ids(r2[0]) == []
+        assert ring.peak_inflight >= 2
+
+    async def test_ring_depth_bounds_inflight(self):
+        m = TpuMatcher(max_levels=8, k_states=8, auto_compact=False,
+                       match_cache=False)
+        m.add_route("T", mk_route("a/b", "r1"))
+        m.refresh()
+        gate = _Gate()
+        _gate_matcher(m, gate)
+        m._pipeline_ring().depth = 2
+        tasks = [asyncio.ensure_future(
+            m.match_batch_async([("T", ["a", str(i)])], batch=16))
+            for i in range(5)]
+        for _ in range(10):
+            await asyncio.sleep(0)
+        assert m._ring.in_flight == 2       # 3 parked behind the ring
+        assert m._ring.waiting == 3
+        gate.open = True
+        await asyncio.gather(*tasks)
+        assert m._ring.in_flight == 0
+
+    async def test_mutation_mid_flight_defeats_cache_store(self):
+        m = TpuMatcher(max_levels=8, k_states=8, auto_compact=False,
+                       match_cache=True)
+        m.add_route("T", mk_route("a/b", "r1"))
+        m.refresh()
+        gate = _Gate()
+        _gate_matcher(m, gate)
+        task = asyncio.ensure_future(
+            m.match_batch_async([("T", ["a", "b"])], batch=16))
+        for _ in range(10):
+            await asyncio.sleep(0)
+        # a second subscriber lands WHILE the walk is in flight
+        m.add_route("T", mk_route("a/b", "r9"))
+        gate.open = True
+        await task
+        # the in-flight result must not have been stamped into the cache:
+        # the next (sync) match sees the new route
+        res = m.match_batch([("T", ["a", "b"])])
+        assert _ids(res[0]) == ["r1", "r9"]
+
+    async def test_compaction_swap_mid_flight_keeps_overlay(self):
+        """_InFlight snapshot discipline: a blocking compaction swapping
+        the base between dispatch and fetch must not lose overlay routes
+        the old-base expansion needs."""
+        m = TpuMatcher(max_levels=8, k_states=8, auto_compact=False,
+                       match_cache=False)
+        m.add_route("T", mk_route("a/b", "r1"))
+        m.refresh()
+        m.add_route("T", mk_route("a/+", "r2"))     # overlay-only route
+        gate = _Gate()
+        _gate_matcher(m, gate)
+        task = asyncio.ensure_future(
+            m.match_batch_async([("T", ["a", "b"])], batch=16))
+        for _ in range(10):
+            await asyncio.sleep(0)
+        m.refresh()     # folds r2 into a fresh base, clears the overlay
+        gate.open = True
+        res = await task
+        assert _ids(res[0]) == ["r1", "r2"]
+
+    async def test_pipeline_kill_switch(self, matcher, monkeypatch):
+        monkeypatch.setenv("BIFROMQ_PIPELINE", "0")
+        matcher.match_cache.clear()
+        res = await matcher.match_batch_async([("T", ["a", "b"])])
+        assert _ids(res[0]) == ["r1", "r2"]
+        # the sync fallback never touched the ring
+        assert matcher._ring is None or matcher._ring.in_flight == 0
+
+
+class TestDonationSafety:
+    def test_donated_probes_are_consumed_and_results_match(self):
+        """walk_routes_donated must produce identical results while
+        actually consuming the probe buffers (use-after-donate raises)."""
+        from bifromq_tpu.models.automaton import compile_tries, tokenize
+        from bifromq_tpu.ops.match import (DeviceTrie, Probes, walk_routes,
+                                           walk_routes_donated)
+        m = TpuMatcher(max_levels=8, k_states=8, auto_compact=False,
+                       match_cache=False)
+        m.add_route("T", mk_route("a/b", "r1"))
+        m.add_route("T", mk_route("a/+", "r2"))
+        ct = compile_tries(m.tries, max_levels=8)
+        dev = DeviceTrie.from_compiled(ct)
+        tok = tokenize([["a", "b"], ["a", "z"]], [ct.root_of("T")] * 2,
+                       max_levels=ct.max_levels, salt=ct.salt, batch=16)
+        kw = dict(probe_len=ct.probe_len, k_states=8, max_intervals=16,
+                  esc_k=0)
+        base = walk_routes(dev, Probes.from_tokenized(tok), **kw)
+        p = Probes.from_tokenized(tok)
+        got = walk_routes_donated(dev, p, **kw)
+        assert (np.asarray(got.count) == np.asarray(base.count)).all()
+        assert (np.asarray(got.start) == np.asarray(base.start)).all()
+        # after donation the buffer is in one of exactly two SAFE states:
+        # deleted (XLA aliased it — reading raises) or intact (XLA
+        # declined the alias for shape reasons and left it alone); silent
+        # corruption would surface as a parity failure above
+        try:
+            h1 = np.asarray(p.tok_h1)
+        except RuntimeError:
+            pass    # consumed, as the donated-jit contract promises
+        else:
+            assert (h1 == tok.tok_h1).all()
+
+    async def test_pipelined_serving_never_reuses_donated_buffers(self):
+        """End-to-end: repeated donated dispatches through the async path
+        stay correct — any use-after-donate inside the pipeline would
+        raise 'Array has been deleted'."""
+        m = TpuMatcher(max_levels=8, k_states=8, auto_compact=False,
+                       match_cache=False)
+        m.add_route("T", mk_route("a/b", "r1"))
+        m.add_route("T", mk_route("a/+", "r2"))
+        m.refresh()
+        for _ in range(4):
+            res = await m.match_batch_async(
+                [("T", ["a", "b"]), ("T", ["a", "q"])])
+            assert _ids(res[0]) == ["r1", "r2"]
+            assert _ids(res[1]) == ["r2"]
+
+
+class TestGauges:
+    def test_device_snapshot_reports_ring(self):
+        from bifromq_tpu.obs import OBS
+        m = TpuMatcher(max_levels=8, k_states=8, auto_compact=False,
+                       match_cache=False)
+        ring = m._pipeline_ring()
+        snap = OBS.device.snapshot(memory=False)
+        assert snap["ring_depth"] >= ring.depth
+        assert "ring_in_flight" in snap and "ring_waiting" in snap
