@@ -15,6 +15,7 @@ serve matches (the reference's replica-spread reads).
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import struct
 import time as _time
@@ -482,6 +483,20 @@ class DistWorker:
     async def stop(self) -> None:
         if self.balance_controller is not None:
             await self.balance_controller.stop()
+        # ISSUE 7 graceful drain: give in-flight device batches a bounded
+        # window to retire before the stores (and their matchers' base
+        # tables) are torn down under them. Concurrent — the drains are
+        # independent waits, and a wedged device must cost ONE timeout,
+        # not one per hosted range.
+        async def _drain(coproc) -> None:
+            drain = getattr(coproc.matcher, "drain_device", None)
+            if drain is not None:
+                try:
+                    await drain()
+                except Exception:  # noqa: BLE001 — shutdown must proceed
+                    logging.getLogger(__name__).exception("device drain")
+        await asyncio.gather(*(_drain(c)
+                               for c in list(self.store.coprocs.values())))
         if self._tick_task is not None:
             self._tick_task.cancel()
             try:
@@ -644,6 +659,18 @@ class DistWorker:
                                else ("dedup" if dup else "miss"))
                     sp.set_tag("cache_hits", hits)
                     sp.set_tag("cache_misses", misses)
+                if stats.get("degraded") and sp is not trace.NOOP:
+                    sp.set_tag("degraded", stats["degraded"])
+            # ISSUE 7: the matcher now absorbs device faults internally
+            # (breaker open / watchdog timeout / device error all serve
+            # its host oracle without raising) and reports the reason via
+            # stats — relay it to the event plane so MATCH_DEGRADED still
+            # fires for operators. FABRIC counters were already bumped at
+            # the matcher; only the event outlet lives up here.
+            if stats.get("degraded"):
+                cb = self.on_degraded
+                if cb is not None:
+                    cb(len(sub), f"device:{stats['degraded']}")
             # overlapped pipeline: the outer wall clock also counts
             # ring-acquire waits and CONCURRENT batches' host work, so
             # per-tenant device shares use the matcher-reported per-batch

@@ -31,6 +31,8 @@ contract, re-designed for an immutable compiled automaton):
 
 from __future__ import annotations
 
+import asyncio
+import logging
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
@@ -88,9 +90,10 @@ class _InFlight:
     """
 
     __slots__ = ("queries", "ct", "dev", "tok", "roots", "res", "tomb",
-                 "delta", "batch", "kernel")
+                 "delta", "batch", "kernel", "fault")
 
     def __init__(self, **kw) -> None:
+        self.fault = None   # fired device FaultRule (ISSUE 7 chaos hook)
         for k, v in kw.items():
             setattr(self, k, v)
 
@@ -146,6 +149,16 @@ class TpuMatcher:
         # ISSUE 6: async dispatch ring (lazy — sync-only deployments never
         # pay for it); see models/pipeline.py for the knobs
         self._ring = None
+        # ISSUE 7: per-device circuit breaker fed by device timeouts and
+        # errors — open serves the exact host-oracle degraded path with
+        # no dispatch at all, half-open admits ONE canary batch that
+        # re-closes only on row parity with the oracle. Registered on
+        # the process-global board so /metrics "fabric.breakers" and the
+        # gossip health digest see it.
+        from ..resilience.device import (DEVICE_BREAKERS,
+                                         device_breaker_enabled)
+        self.device_breaker = (DEVICE_BREAKERS.create()
+                               if device_breaker_enabled() else None)
         # mutation log since the shadow copy last synced; shadow is the
         # frozen snapshot source for off-thread compiles
         self._log: List[Tuple] = []
@@ -448,6 +461,7 @@ class TpuMatcher:
                     *, max_persistent_fanout: int = UNCAPPED_FANOUT,
                     max_group_fanout: int = UNCAPPED_FANOUT,
                     batch: Optional[int] = None,
+                    stats: Optional[dict] = None,
                     **device_kw) -> List[MatchedRoutes]:
         """The cache-plane front-end (ISSUE 4, ≈ SubscriptionCache.get →
         TenantRouteCache): per-query cache probe, then in-batch dedup so N
@@ -462,7 +476,8 @@ class TpuMatcher:
         if cache is None:
             return self._match_batch_device(
                 queries, max_persistent_fanout=max_persistent_fanout,
-                max_group_fanout=max_group_fanout, batch=batch, **device_kw)
+                max_group_fanout=max_group_fanout, batch=batch,
+                stats=stats, **device_kw)
         # fold any finished background compaction in BEFORE probing: its
         # generation bump must land before this batch's token snapshots,
         # not mid-walk (which would refuse every put of the batch)
@@ -473,7 +488,8 @@ class TpuMatcher:
         if uniq_queries:
             res = self._match_batch_device(
                 uniq_queries, max_persistent_fanout=max_persistent_fanout,
-                max_group_fanout=max_group_fanout, batch=batch, **device_kw)
+                max_group_fanout=max_group_fanout, batch=batch,
+                stats=stats, **device_kw)
             self._frontend_fill(out, res, uniq, miss_rows, tokens, caps)
         self._frontend_metrics(len(queries), uniq_queries, miss_rows)
         return out
@@ -536,6 +552,22 @@ class TpuMatcher:
             OBS.device.register_ring(self._ring)
         return self._ring
 
+    async def drain_device(self, timeout_s: Optional[float] = None) -> bool:
+        """Graceful drain (ISSUE 7): wait bounded for in-flight device
+        batches to retire, then sweep the quarantine. Shutdown and
+        compaction call this so a slot mid-walk finishes (or is given up
+        on) instead of being torn down under the device. Returns whether
+        the ring actually went idle."""
+        ring = self._ring
+        if ring is None:
+            return True
+        from ..resilience.device import drain_timeout_s
+        if timeout_s is None:
+            timeout_s = drain_timeout_s()
+        idle = await ring.wait_idle(timeout_s)
+        ring.quarantine.sweep()
+        return idle
+
     async def match_batch_async(self, queries, *,
                                 max_persistent_fanout: int = UNCAPPED_FANOUT,
                                 max_group_fanout: int = UNCAPPED_FANOUT,
@@ -556,19 +588,24 @@ class TpuMatcher:
         instead of their outer wall clock, which under an overlapped
         pipeline also counts that wait and concurrent batches' work —
         and with it, toggling ``BIFROMQ_PIPELINE`` does not shift what
-        the "device" stage histograms measure.
+        the "device" stage histograms measure. ``stats["degraded"]``
+        carries the reason when the batch was served from the host
+        oracle (ISSUE 7: breaker open, watchdog timeout, device error)
+        so the worker can emit MATCH_DEGRADED events without a raising
+        boundary.
 
         Degrades to the sync path when the pipeline is disabled
         (``BIFROMQ_PIPELINE=0``) or the subclass replaced the device plane
         (``supports_async = False``).
         """
-        from .pipeline import donation_enabled, pipeline_enabled
+        from .pipeline import pipeline_enabled
         if not queries:
             return []
         if not (self.supports_async and pipeline_enabled()):
             return self.match_batch(
                 queries, max_persistent_fanout=max_persistent_fanout,
-                max_group_fanout=max_group_fanout, batch=batch, **device_kw)
+                max_group_fanout=max_group_fanout, batch=batch,
+                stats=stats, **device_kw)
         if device_kw:
             # the sync path would TypeError on unknown kwargs inside
             # _match_batch_device; an env flag must not turn that into a
@@ -590,33 +627,10 @@ class TpuMatcher:
             # all-hit batches: the cache probe IS the whole match cost
             stats["device_s"] = front_s
         if uniq_queries:
-            ring = self._pipeline_ring()
-            await ring.acquire()
-            try:
-                t_disp = time.perf_counter()
-                if batch is None:
-                    # queue-depth-adaptive pow2 floor: idle ring ⇒ small
-                    # pad to cut time-to-first-result, busy ring ⇒ the
-                    # throughput floor (see DispatchRing.effective_floor)
-                    batch = _pow2_batch(len(uniq_queries),
-                                        floor=ring.effective_floor())
-                fl = self._dispatch_device(uniq_queries, batch,
-                                           donate=donation_enabled())
-                ring.start_fetch(fl.res)
-                t0 = time.perf_counter()
-                with trace.span("device.ready", batch=fl.batch,
-                                kernel=fl.kernel):
-                    await ring.wait_ready(fl.res)
-                STAGES.record("device.ready", time.perf_counter() - t0)
-            finally:
-                ring.release()
-            t0 = time.perf_counter()
-            with trace.span("device.fetch"):
-                overflow, starts_a, counts_a = self._fetch_walk(fl.res)
-            STAGES.record("device.fetch", time.perf_counter() - t0)
-            res = self._expand_walk(fl, overflow, starts_a, counts_a,
-                                    max_persistent_fanout,
-                                    max_group_fanout)
+            t_disp = time.perf_counter()
+            res, degraded, acquire_s = await self._device_serve_async(
+                uniq_queries, batch, max_persistent_fanout,
+                max_group_fanout)
             if cache is not None:
                 self._frontend_fill(out, res, uniq, miss_rows, tokens,
                                     caps)
@@ -625,16 +639,180 @@ class TpuMatcher:
             if stats is not None:
                 # probe + this batch's dispatch→expand→fill: everything
                 # the sync wall clock covers except the ring-acquire wait
-                stats["device_s"] = front_s + (time.perf_counter() - t_disp)
+                # (queue time under a saturated pipeline, not match cost —
+                # folding it in would inflate the "device" stage and the
+                # per-tenant attribution feeding the noisy detector)
+                stats["device_s"] = front_s + (
+                    time.perf_counter() - t_disp - acquire_s)
+                if degraded is not None:
+                    stats["degraded"] = degraded
         if cache is not None:
             self._frontend_metrics(len(queries), uniq_queries, miss_rows)
         return out
+
+    async def _device_serve_async(self, uniq_queries, batch,
+                                  max_persistent_fanout, max_group_fanout):
+        """The failure-bounded device leg of the async path (ISSUE 7).
+
+        Returns ``(results, degraded_reason, acquire_s)`` —
+        ``degraded_reason`` is None when the device served, else one of
+        ``breaker`` (circuit open: dispatch skipped entirely), ``timeout``
+        (watchdog fired: the ring slot was reclaimed, the orphaned arrays
+        quarantined), or ``device_error`` (dispatch/fetch raised);
+        ``acquire_s`` is the ring-acquire wait the caller subtracts from
+        its device-time accounting. Every degraded serve comes from
+        ``match_from_tries`` — the authoritative host oracle, exact by
+        construction — so the publish path NEVER fails on a sick device;
+        it just loses the accelerator speedup until the canary re-closes
+        the breaker."""
+        from ..resilience.device import DeviceTimeoutError
+        from ..utils.metrics import FABRIC, FabricMetric
+        br = self.device_breaker
+        verdict = br.admit() if br is not None else "ok"
+        reason = None
+        oracle_rows = None
+        timing = {"acquire_s": 0.0}
+        if verdict == "rejected":
+            reason = "breaker"
+        else:
+            settled = False
+            try:
+                res = await self._device_leg_async(
+                    uniq_queries, batch, max_persistent_fanout,
+                    max_group_fanout, timing)
+                if br is not None:
+                    if verdict == "canary":
+                        ok, oracle_rows = self._canary_parity(
+                            uniq_queries, res, max_persistent_fanout,
+                            max_group_fanout)
+                        if ok:
+                            br.record_success()
+                        else:
+                            br.record_failure("canary row parity")
+                            reason = "canary_parity"
+                    elif br.state == "closed":
+                        # an "ok"-admitted batch completing while the
+                        # breaker is no longer closed is a pre-trip
+                        # STRAGGLER: its success must not close the
+                        # circuit past the canary parity bar (not even
+                        # indirectly, by landing while a canary is out)
+                        br.record_success()
+                settled = True
+                if reason is None:
+                    return res, None, timing["acquire_s"]
+            except DeviceTimeoutError as e:
+                FABRIC.inc(FabricMetric.DEVICE_TIMEOUT)
+                if br is not None:
+                    br.record_failure(repr(e))
+                    settled = True
+                reason = "timeout"
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — degrade, don't fail
+                if br is not None:
+                    br.record_failure(repr(e))
+                    settled = True
+                logging.getLogger(__name__).warning(
+                    "device match failed; serving host oracle: %r", e)
+                reason = "device_error"
+            finally:
+                if br is not None and verdict == "canary" and not settled:
+                    # cancelled mid-probe with no verdict: the half-open
+                    # budget must not leak or the breaker wedges refusing
+                    br.release_probe()
+        FABRIC.inc(FabricMetric.MATCH_DEGRADED, len(uniq_queries))
+        with trace.span("match.degraded", reason=reason,
+                        n_queries=len(uniq_queries)):
+            if oracle_rows is None:
+                # parity failures already walked the oracle — reuse it
+                oracle_rows = self.match_from_tries(
+                    uniq_queries,
+                    max_persistent_fanout=max_persistent_fanout,
+                    max_group_fanout=max_group_fanout)
+            return oracle_rows, reason, timing["acquire_s"]
+
+    async def _device_leg_async(self, uniq_queries, batch,
+                                max_persistent_fanout, max_group_fanout,
+                                timing=None):
+        """dispatch → fetch-on-ready → expand through the bounded ring,
+        with the ISSUE 7 watchdog armed on the readiness wait. A timeout
+        RECLAIMS the slot: the ring releases it immediately (the next
+        batch keeps flowing) and the orphaned result arrays — which may
+        alias donated probe buffers the device is still writing — go to
+        quarantine until actually ready. ``timing["acquire_s"]`` reports
+        the ring-acquire wait (queue time, not match cost) even when the
+        leg later raises."""
+        from ..resilience.device import DeviceTimeoutError
+        from .pipeline import donation_enabled
+        ring = self._pipeline_ring()
+        t_acq = time.perf_counter()
+        await ring.acquire()
+        if timing is not None:
+            timing["acquire_s"] = time.perf_counter() - t_acq
+        try:
+            if batch is None:
+                # queue-depth-adaptive pow2 floor: idle ring ⇒ small
+                # pad to cut time-to-first-result, busy ring ⇒ the
+                # throughput floor (see DispatchRing.effective_floor)
+                batch = _pow2_batch(len(uniq_queries),
+                                    floor=ring.effective_floor())
+            fl = self._dispatch_device(uniq_queries, batch,
+                                       donate=donation_enabled(),
+                                       watchdogged=True)
+            ring.start_fetch(fl.res)
+            t0 = time.perf_counter()
+            try:
+                with trace.span("device.ready", batch=fl.batch,
+                                kernel=fl.kernel):
+                    await ring.wait_ready(fl.res, fault=fl.fault)
+            except DeviceTimeoutError:
+                ring.reclaim(fl.res)
+                raise
+            except BaseException:
+                # cancelled mid-wait (caller timeout, client disconnect):
+                # the arrays may still be in flight and may alias donated
+                # probe buffers — park them like a timeout does, minus
+                # the timeout accounting, or dropping the last reference
+                # here would be the exact use-after-donate the
+                # quarantine exists to prevent
+                ring.quarantine.add(fl.res)
+                raise
+            STAGES.record("device.ready", time.perf_counter() - t0)
+        finally:
+            ring.release()
+        t0 = time.perf_counter()
+        with trace.span("device.fetch"):
+            overflow, starts_a, counts_a = self._fetch_walk(fl.res)
+        STAGES.record("device.fetch", time.perf_counter() - t0)
+        return self._expand_walk(fl, overflow, starts_a, counts_a,
+                                 max_persistent_fanout, max_group_fanout)
+
+    def _canary_parity(self, queries, device_rows,
+                       max_persistent_fanout, max_group_fanout):
+        """Half-open success bar: the canary batch's device rows must be
+        row-identical to the host oracle (receivers + groups per row) —
+        a device that returns plausible-but-wrong rows after a fault must
+        NOT re-close the breaker. Returns ``(ok, oracle_rows)`` so a
+        failed parity check can serve the already-computed oracle rows
+        instead of walking the host tries a second time."""
+        oracle = self.match_from_tries(
+            queries, max_persistent_fanout=max_persistent_fanout,
+            max_group_fanout=max_group_fanout)
+
+        def canon(m):
+            return (sorted((r.matcher.mqtt_topic_filter, r.receiver_url)
+                           for r in m.normal),
+                    {f: sorted(r.receiver_url for r in ms)
+                     for f, ms in m.groups.items()})
+        return all(canon(d) == canon(o)
+                   for d, o in zip(device_rows, oracle)), oracle
 
     def _match_batch_device(self, queries: Sequence[Tuple[str,
                                                           Sequence[str]]],
                             *, max_persistent_fanout: int = UNCAPPED_FANOUT,
                             max_group_fanout: int = UNCAPPED_FANOUT,
-                            batch: Optional[int] = None
+                            batch: Optional[int] = None,
+                            stats: Optional[dict] = None
                             ) -> List[MatchedRoutes]:
         """Match (tenant_id, topic_levels) pairs; returns per-query routes.
 
@@ -648,19 +826,70 @@ class TpuMatcher:
         r4 #2). This sync entry is dispatch+fetch+expand back to back; the
         async pipeline (match_batch_async) runs the same three stages with
         an is_ready await between dispatch and fetch.
+
+        ISSUE 7: the device breaker gates this sync leg too — open
+        serves the host oracle with no dispatch, a device error feeds
+        the breaker and then PROPAGATES (the worker's degradation
+        boundary owns the sync fallback), and a half-open admission
+        holds the canary batch to oracle row parity. The watchdog itself
+        is the async pipeline's: this leg's fetch is a blocking
+        synchronize that cannot be preempted.
         """
         if not queries:
             return []
-        fl = self._dispatch_device(queries, batch)
-        t0 = time.perf_counter()
-        with trace.span("device.fetch"):
-            overflow, starts_a, counts_a = self._fetch_walk(fl.res)
-        STAGES.record("device.fetch", time.perf_counter() - t0)
-        return self._expand_walk(fl, overflow, starts_a, counts_a,
-                                 max_persistent_fanout, max_group_fanout)
+        br = self.device_breaker
+        verdict = br.admit() if br is not None else "ok"
+        if verdict == "rejected":
+            from ..utils.metrics import FABRIC, FabricMetric
+            FABRIC.inc(FabricMetric.MATCH_DEGRADED, len(queries))
+            if stats is not None:
+                # the sync serve has no raising boundary here — the
+                # worker's MATCH_DEGRADED event outlet keys on this
+                stats["degraded"] = "breaker"
+            with trace.span("match.degraded", reason="breaker",
+                            n_queries=len(queries)):
+                return self.match_from_tries(
+                    queries, max_persistent_fanout=max_persistent_fanout,
+                    max_group_fanout=max_group_fanout)
+        try:
+            fl = self._dispatch_device(queries, batch)
+            t0 = time.perf_counter()
+            with trace.span("device.fetch"):
+                overflow, starts_a, counts_a = self._fetch_walk(fl.res)
+            STAGES.record("device.fetch", time.perf_counter() - t0)
+            out = self._expand_walk(fl, overflow, starts_a, counts_a,
+                                    max_persistent_fanout,
+                                    max_group_fanout)
+        except BaseException as e:
+            if br is not None:
+                if isinstance(e, Exception):
+                    br.record_failure(repr(e))
+                elif verdict == "canary":
+                    br.release_probe()
+            raise
+        if br is not None:
+            if verdict == "canary":
+                ok, oracle_rows = self._canary_parity(
+                    queries, out, max_persistent_fanout, max_group_fanout)
+                if not ok:
+                    br.record_failure("canary row parity")
+                    from ..utils.metrics import FABRIC, FabricMetric
+                    FABRIC.inc(FabricMetric.MATCH_DEGRADED, len(queries))
+                    if stats is not None:
+                        stats["degraded"] = "canary_parity"
+                    with trace.span("match.degraded",
+                                    reason="canary_parity",
+                                    n_queries=len(queries)):
+                        return oracle_rows
+                br.record_success()
+            elif br.state == "closed":
+                # pre-trip straggler guard, same as the async leg
+                br.record_success()
+        return out
 
     def _dispatch_device(self, queries, batch: Optional[int] = None, *,
-                         donate: bool = False) -> _InFlight:
+                         donate: bool = False,
+                         watchdogged: bool = False) -> _InFlight:
         """Stage 1: tokenize + upload + enqueue the device walk.
 
         Returns as soon as the walk is ENQUEUED (walk_routes returns on
@@ -672,6 +901,19 @@ class TpuMatcher:
         only the HOST TokenizedTopics copy.
         """
         from ..ops.match import Probes
+        from ..resilience.faults import get_injector
+        # ISSUE 7 device-fault hook: error rules raise here; readiness-
+        # shaping rules (hang/slow/flaky_ready) ride the _InFlight into
+        # wait_ready — but ONLY the watchdogged async leg has a readiness
+        # poll to thread them into. The sync leg's fetch is a blocking
+        # synchronize: consuming a hang/slow/flaky_ready rule there would
+        # burn its hit budget (and count an injection) without injecting
+        # anything. One attribute check when the injector is disabled.
+        if watchdogged:
+            fault = get_injector().device_rule("dispatch")
+        else:
+            get_injector().check_raise("device", "tpu-device", "dispatch")
+            fault = None
         self._apply_pending_swap()
         if self._base_ct is None:
             self.refresh()
@@ -700,7 +942,7 @@ class TpuMatcher:
         return _InFlight(queries=list(queries), ct=ct,
                          dev=self._device_trie, tok=tok, roots=roots,
                          res=res, tomb=self._tomb, delta=self._delta,
-                         batch=batch, kernel=kernel)
+                         batch=batch, kernel=kernel, fault=fault)
 
     def _walk_primary(self, probes, ct, *, donate: bool):
         """The primary serving walk: fused Pallas kernel when enabled
@@ -724,7 +966,11 @@ class TpuMatcher:
     def _fetch_walk(res):
         """Stage 2: the one true synchronization — writable host copies
         (escalation patches rescued rows in place; a bare asarray view of
-        a jax buffer is read-only)."""
+        a jax buffer is read-only). ISSUE 7: the fetch-side device-fault
+        hook fires here (error rules only — a readback can crash, it
+        cannot hang-inject)."""
+        from ..resilience.faults import get_injector
+        get_injector().check_raise("device", "tpu-device", "fetch")
         overflow = np.array(res.overflow)
         starts_a = np.array(res.start)
         counts_a = np.array(res.count)

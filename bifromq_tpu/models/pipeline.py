@@ -27,8 +27,11 @@ from __future__ import annotations
 
 import asyncio
 import os
-from collections import deque
-from typing import Deque, Optional
+import time
+from typing import Optional
+
+from ..resilience.device import (BoundedSlots, BufferQuarantine,
+                                 DeviceTimeoutError, device_deadline_s)
 
 
 def pipeline_enabled() -> bool:
@@ -66,69 +69,72 @@ def donation_enabled() -> bool:
         not in ("0", "off", "false")
 
 
-class DispatchRing:
+class DispatchRing(BoundedSlots):
     """Bounded in-flight dispatch slots + the queue-depth signal.
 
     One per TpuMatcher (created lazily on the first async match). The
     gauge surface (obs/device.py) reads ``in_flight`` / ``waiters`` /
     ``depth`` weakly; ``effective_floor`` feeds the adaptive pow2 pad.
+    Slot admission (bound, parked-waiter futures, cancellation hygiene)
+    is the shared :class:`~bifromq_tpu.resilience.device.BoundedSlots`
+    machinery — the same core that gates QoS>0 ingest.
     """
 
     def __init__(self, depth: Optional[int] = None,
                  min_floor: Optional[int] = None,
                  base_floor: int = 16) -> None:
-        self.depth = depth if depth is not None else pipeline_depth()
+        super().__init__(depth if depth is not None else pipeline_depth())
         self.min_floor = (min_floor if min_floor is not None
                           else pipeline_min_floor())
         self.base_floor = base_floor
-        self._inflight = 0
-        self._waiters: Deque[asyncio.Future] = deque()
         # observability (tests assert overlap through these)
         self.dispatched_total = 0
-        self.peak_inflight = 0
+        # ISSUE 7: timed-out slots park their orphaned result arrays here
+        # until the device actually finishes with them — a reclaimed slot
+        # must never let donated buffers be reused mid-flight
+        self.quarantine = BufferQuarantine()
+        self.timeouts_total = 0
 
     # ---------------- slot management --------------------------------------
 
     @property
-    def in_flight(self) -> int:
-        return self._inflight
+    def depth(self) -> int:
+        return self.capacity
 
-    @property
-    def waiting(self) -> int:
-        return len(self._waiters)
+    @depth.setter
+    def depth(self, v: int) -> None:
+        self.capacity = v
 
     async def acquire(self) -> None:
-        while self._inflight >= self.depth:
-            fut = asyncio.get_running_loop().create_future()
-            self._waiters.append(fut)
-            try:
-                await fut
-            except BaseException:
-                # cancellation hygiene: a parked waiter withdraws itself
-                # (a cancelled future is done(), so it must be REMOVED —
-                # a stale entry would overcount ring.waiting and pin
-                # effective_floor at the throughput floor); a waiter that
-                # was already granted a wake but dies before using it
-                # passes the wake on so the slot isn't lost
-                if fut in self._waiters:
-                    self._waiters.remove(fut)
-                elif fut.done() and not fut.cancelled():
-                    self._wake_one()
-                raise
-        self._inflight += 1
+        await super().acquire()
         self.dispatched_total += 1
-        self.peak_inflight = max(self.peak_inflight, self._inflight)
-
-    def _wake_one(self) -> None:
-        while self._waiters:
-            fut = self._waiters.popleft()
-            if not fut.done():
-                fut.set_result(None)
-                break
 
     def release(self) -> None:
-        self._inflight = max(0, self._inflight - 1)
-        self._wake_one()
+        super().release()
+        # opportunistic quarantine sweep: O(1) when nothing is parked
+        if len(self.quarantine):
+            self.quarantine.sweep()
+
+    def reclaim(self, res) -> None:
+        """A slot timed out: park its (possibly donated-aliasing) result
+        arrays in quarantine until the device reports them ready. The
+        caller releases the slot itself — the ring stays bounded AND
+        live, instead of one stuck dispatch wedging a slot forever."""
+        self.timeouts_total += 1
+        self.quarantine.add(res)
+
+    async def wait_idle(self, timeout_s: float = 2.0,
+                        poll_s: float = 0.002) -> bool:
+        """Graceful drain (ISSUE 7): wait bounded for every in-flight
+        slot to retire. Returns False on timeout — the caller proceeds
+        with shutdown/compaction anyway (in-flight coroutines release
+        their slots when cancelled)."""
+        deadline = time.monotonic() + timeout_s
+        while self._inflight > 0:
+            if time.monotonic() >= deadline:
+                return False
+            await asyncio.sleep(poll_s)
+        return True
 
     # ---------------- adaptive pad floor ------------------------------------
 
@@ -163,7 +169,9 @@ class DispatchRing:
 
     @staticmethod
     async def wait_ready(res, poll_s: float = 0.0005,
-                         spin_polls: int = 50) -> None:
+                         spin_polls: int = 50,
+                         deadline_s: Optional[float] = None,
+                         fault=None) -> None:
         """Yield the event loop until every result leaf is ready (half 2).
 
         ``is_ready`` is a PJRT-buffer query, not a sync: other coroutines
@@ -177,14 +185,50 @@ class DispatchRing:
         loop's ~1ms timer and tax every fast batch) — then back off to
         ``poll_s`` timed sleeps for genuinely long completions (the axon
         tunnel's ~70ms RTT), where spinning would burn a core for nothing.
+
+        ISSUE 7 watchdog: past ``deadline_s`` (default derived from the
+        dispatch-stage p99, env ``BIFROMQ_DEVICE_DEADLINE_S``) a
+        :class:`DeviceTimeoutError` fires so one hung dispatch cannot
+        wedge a ring slot forever. The deadline check is one monotonic
+        read per poll — the sub-ms spin phase stays spin (no timed sleep
+        is ever added to it). ``fault`` is a fired device FaultRule
+        (models/matcher threads it from the dispatch hook): ``hang``
+        withholds readiness while the rule stays installed, ``slow``
+        withholds it for the rule's delay, ``flaky_ready`` makes each
+        poll lie with the rule's probability.
         """
+        if deadline_s is None:
+            deadline_s = device_deadline_s()
+        t0 = time.monotonic()
         leaves = [res.start, res.count, res.overflow]
         polls = 0
+        injector = None
+        if fault is not None:
+            from ..resilience.faults import get_injector
+            injector = get_injector()
         while True:
-            try:
-                if all(leaf.is_ready() for leaf in leaves):
+            faulted = False
+            if fault is not None:
+                if fault.action == "hang":
+                    faulted = injector.rule_active(fault)
+                elif fault.action == "slow":
+                    faulted = time.monotonic() - t0 < fault.delay
+                elif fault.action == "flaky_ready":
+                    # the documented contract is delayed-never-denied:
+                    # clamp the per-poll lie below 1.0 so a rule with the
+                    # default probability (1.0) stays a flake, not a hang
+                    # (hang is its own action)
+                    faulted = (injector.rule_active(fault)
+                               and injector.rng.random()
+                               < min(fault.probability, 0.95))
+            if not faulted:
+                try:
+                    if all(leaf.is_ready() for leaf in leaves):
+                        return
+                except AttributeError:
                     return
-            except AttributeError:
-                return
+            if (deadline_s is not None
+                    and time.monotonic() - t0 >= deadline_s):
+                raise DeviceTimeoutError(deadline_s)
             await asyncio.sleep(0 if polls < spin_polls else poll_s)
             polls += 1

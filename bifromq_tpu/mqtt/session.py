@@ -736,13 +736,41 @@ class Session:
 
     async def _ingest_publish(self, p: pk.Publish, topic: str,
                               msg: Message) -> None:
-        """Retain + dist + ack — the traced tail of ``_on_publish``."""
+        """Retain + dist + ack — the traced tail of ``_on_publish``.
+
+        ISSUE 7 overload discipline: under device-pipeline overload
+        (ring pressure + batcher backlog past the shed bound) QoS0
+        publishes are SHED — tenant-fair, noisy tenants first — before
+        they cost a match; at-most-once loss is the contract. QoS>0 is
+        never shed: it backpressures through the bounded ingest gate
+        instead (the session's read loop parks, TCP pushes back on the
+        publisher) so at-least-once work cannot queue without bound.
+        """
+        from ..resilience.device import INGEST_GATE, SHEDDER
         ts = self.settings
         if p.retain and self.retain_service is not None:
             if ts[Setting.RetainEnabled]:
+                # retained state lands BEFORE any shed decision: the shed
+                # contract covers at-most-once DELIVERY, not the durable
+                # retain-store write (dropping it would leave stale
+                # retained payloads long after the overload clears), and
+                # the write costs no device match
                 await self.retain_service.retain(self.client_info, topic, msg)
+        if p.qos == 0 and SHEDDER.should_shed(self.client_info.tenant_id):
+            self.events.report(Event(
+                EventType.SHED_QOS0, self.client_info.tenant_id,
+                {"topic": topic, "reason": "overload"}))
+            return
         try:
-            result = await self.dist.pub(self.client_info, topic, msg)
+            if p.qos > 0:
+                await INGEST_GATE.acquire()
+                try:
+                    result = await self.dist.pub(self.client_info, topic,
+                                                 msg)
+                finally:
+                    INGEST_GATE.release()
+            else:
+                result = await self.dist.pub(self.client_info, topic, msg)
         except Exception:  # noqa: BLE001 — dist backend failure
             log.exception("dist.pub failed")
             # ≈ QoS{0,1,2}DistError events; QoS1/2 get an error ack so the

@@ -32,19 +32,12 @@ import time
 import weakref
 from typing import Callable, Optional
 
+from ..utils.env import env_float as _env_float
 from .device import DeviceGauges
 from .exporter import FileSink, HTTPSink, TelemetryExporter
 from .neighbor import NoisyNeighborDetector
 from .slo import TenantSLO
 from .window import WindowedCounter, WindowedLog2Histogram
-
-
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name, "").strip()
-    try:
-        return float(raw) if raw else default
-    except ValueError:
-        return default
 
 
 class ObsHub:

@@ -34,10 +34,10 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
-import os
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..utils.env import env_float as _env_float
 from ..utils.hlc import HLC
 from .window import N_BUCKETS, percentile_ms_from
 
@@ -48,14 +48,6 @@ AGENT_ID = "obs"
 # RPC fabric service for the scatter-gather plane
 SERVICE = "cluster-obs"
 DIGEST_VERSION = 1
-
-
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name, "").strip()
-    try:
-        return float(raw) if raw else default
-    except ValueError:
-        return default
 
 
 # ---------------------------------------------------------------------------
@@ -133,6 +125,7 @@ class ClusterView:
                  stale_after_s: Optional[float] = None,
                  queue_depth_threshold: Optional[float] = None,
                  interval_s: Optional[float] = None,
+                 hysteresis_s: Optional[float] = None,
                  clock: Callable[[], float] = time.time) -> None:
         from . import OBS
         self.node_id = node_id
@@ -155,6 +148,16 @@ class ClusterView:
         self.interval_s = (interval_s if interval_s is not None
                            else _env_float("BIFROMQ_CLUSTER_OBS_INTERVAL_S",
                                            1.0))
+        # ISSUE 7 satellite: demotion hysteresis — an endpoint stays
+        # demoted until it has looked healthy for a full cooldown window
+        # since its LAST bad observation, so a node flapping between
+        # healthy and suspect (a breaker oscillating open/half-open, a
+        # queue sawtoothing around the threshold) cannot oscillate the
+        # routing tier with it
+        self.hysteresis_s = (hysteresis_s if hysteresis_s is not None
+                             else _env_float(
+                                 "BIFROMQ_CLUSTER_OBS_HYSTERESIS_S", 5.0))
+        self._last_bad: Dict[str, float] = {}
         self._clock = clock
         self._unhealthy: frozenset = frozenset()
         # node_id -> (last digest HLC stamp seen, local receipt time):
@@ -183,6 +186,11 @@ class ClusterView:
                 "batches_in_flight": device.get("batches_in_flight", 0),
                 "compile_count": device.get("compile_count", 0),
                 "mem_peak_bytes": hub.device.peak_memory_bytes,
+                # ISSUE 7: worst local DEVICE breaker state — peers
+                # demote a device-sick node (serving oracle-degraded)
+                # before routing to it; "closed" is omitted to keep the
+                # UDP payload small
+                **self._device_breaker_field(),
             },
             "match_cache_hit_rate": self._match_cache_hit_rate(),
             "noisy": [{"tenant": r["tenant"], "score": r["score"],
@@ -209,6 +217,15 @@ class ClusterView:
             return {}
         try:
             return self.registry.breakers.states(include_closed=False)
+        except Exception:  # noqa: BLE001 — telemetry must not raise
+            return {}
+
+    @staticmethod
+    def _device_breaker_field() -> Dict[str, str]:
+        try:
+            from ..resilience.device import DEVICE_BREAKERS
+            worst = DEVICE_BREAKERS.worst_state()
+            return {} if worst == "closed" else {"breaker": worst}
         except Exception:  # noqa: BLE001 — telemetry must not raise
             return {}
 
@@ -321,14 +338,34 @@ class ClusterView:
                 for ep, state in (digest.get("breakers") or {}).items():
                     if state == "open":
                         bad.add(ep)
-                # the node itself reports a browned-out device pipeline
+                # the node itself reports a browned-out device pipeline —
+                # a deep dispatch queue, or (ISSUE 7) a non-closed DEVICE
+                # breaker: the node is serving, but oracle-degraded, so
+                # peers with a healthy accelerator should be ranked first
                 dev = digest.get("device") or {}
-                if (p["addr"] and dev.get("dispatch_queue_depth", 0)
-                        >= self.queue_depth_threshold):
+                if p["addr"] and (
+                        dev.get("dispatch_queue_depth", 0)
+                        >= self.queue_depth_threshold
+                        or dev.get("breaker") in ("open", "half_open")):
                     bad.add(p["addr"])
             # never let gossip rumors blackhole OUR OWN endpoint for the
             # local picker: local breakers already own that verdict
             bad.discard(self.rpc_address)
+            # ISSUE 7 satellite — demotion hysteresis: an endpoint leaves
+            # the unhealthy set only after a full cooldown of CONSECUTIVE
+            # healthy observations; any bad sighting restarts the clock,
+            # so a flapping endpoint stays demoted instead of oscillating
+            # the pick tier
+            now = self._clock()
+            for ep in bad:
+                self._last_bad[ep] = now
+            sticky = set()
+            for ep, at in list(self._last_bad.items()):
+                if now - at < self.hysteresis_s:
+                    sticky.add(ep)
+                else:       # cooled off: forget it (bounds the map too)
+                    del self._last_bad[ep]
+            bad |= sticky
         except Exception:  # noqa: BLE001 — telemetry must not raise
             return
         self._unhealthy = frozenset(bad)
@@ -414,7 +451,26 @@ class ClusterView:
                               timeout_s: float = 2.0) -> dict:
         """``GET /cluster/trace/<id>``: assemble the full cross-process
         trace — every peer's span rings queried for the id, spans merged
-        with the local ring's and ordered by the causal HLC stamps."""
+        with the local ring's and ordered by the causal HLC stamps.
+
+        ISSUE 7 satellite: when a contributing ring has WRAPPED (its
+        oldest spans overwritten), the assembled trace may be missing
+        spans that once existed. The response annotates the gap instead
+        of silently returning a partial trace — and the wrap signal is
+        PER-TRACE, not the ring's lifetime drop counter (which would
+        brand every trace incomplete forever after one wrap on a
+        long-running node): a ring counts as wrapped *for this trace*
+        only when the trace shows a visible tear (a returned span
+        references a parent absent from the assembly) or the trace's
+        earliest known span starts at-or-before the ring's wrap horizon
+        (the ``end_hlc`` of its oldest surviving span — everything
+        overwritten ended before that, so only a trace overlapping the
+        horizon can have lost leaf spans). ``spans_dropped`` counts the
+        dangling parent ids, ``complete`` goes false whenever any ring
+        wrapped over this trace's window, and ``rings_wrapped`` names
+        the nodes. A fully-captured recent trace on a long-wrapped ring
+        reports complete; without any wrapped ring, missing parents are
+        attributed to slow-only captures / peer errors, not drops."""
         from .. import trace as tr
         spans = [dict(s, node=self.node_id)
                  for s in tr.TRACER.export(trace_id=trace_id, limit=1000)]
@@ -424,23 +480,49 @@ class ClusterView:
             if s["span_id"] not in seen:
                 spans.append(dict(s, node=self.node_id))
                 seen.add(s["span_id"])
+        horizons: Dict[str, int] = {}
+        local_hz = tr.TRACER.ring.wrap_horizon()
+        if local_hz is not None:
+            horizons[self.node_id] = local_hz
         nodes = {self.node_id: "local"}
+        peer_errors = False
         for node, res in (await self._scatter(
                 "trace_spans", {"trace_id": trace_id},
                 timeout_s)).items():
             if "error" in res:
                 nodes[node] = f"error: {res['error']}"
+                peer_errors = True
                 continue
             nodes[node] = "ok"
+            if res.get("wrap_horizon") is not None:
+                horizons[res.get("node", node)] = res["wrap_horizon"]
             for s in res.get("spans") or []:
                 if s.get("span_id") not in seen:
                     spans.append(dict(s, node=res.get("node", node)))
                     seen.add(s.get("span_id"))
         spans.sort(key=lambda s: s.get("start_hlc", 0))
+        # the visible tears: parents referenced but absent everywhere.
+        # A peer that ERRORED is the more plausible owner of a dangling
+        # parent than some node's ancient wrap — with an error in the
+        # response (already visible in ``nodes``) the tears are not
+        # attributed to wraps at all.
+        missing = {s.get("parent_id") for s in spans
+                   if s.get("parent_id")
+                   and s.get("parent_id") not in seen} \
+            if not peer_errors else set()
+        trace_min = min((s.get("start_hlc", 0) for s in spans),
+                        default=None)
+        wrapped = [node for node, hz in horizons.items()
+                   if missing
+                   or (trace_min is not None and trace_min <= hz)]
+        dropped = len(missing) if wrapped else 0
         return {"trace_id": trace_id,
                 "count": len(spans),
                 "nodes": nodes,
                 "processes": len({s.get("node") for s in spans}),
+                "spans_dropped": dropped,
+                "complete": not wrapped,
+                "rings_wrapped": wrapped,
                 "spans": spans}
 
     # ---------------- lifecycle ----------------------------------------------
@@ -512,6 +594,11 @@ class ClusterObsRPCService:
                 spans.append(s)
                 seen.add(s["span_id"])
         return json.dumps({"node": self.view.node_id,
+                           # ISSUE 7: how far back does surviving ring
+                           # history reach? (None = never wrapped; the
+                           # coordinator's per-trace gap annotation keys
+                           # on it, not on the lifetime drop counter)
+                           "wrap_horizon": tr.TRACER.ring.wrap_horizon(),
                            "spans": spans}).encode()
 
     async def _digest(self, payload: bytes, okey: str) -> bytes:

@@ -77,11 +77,17 @@ class DeviceGauges:
                 inflight += getattr(b, "_inflight", 0)
                 cap = max(cap, getattr(b, "_cap", 0))
         ring_inflight = ring_waiting = ring_peak = ring_depth = 0
+        ring_timeouts = ring_quarantined = 0
         for ring in list(self._rings):
             ring_inflight += getattr(ring, "in_flight", 0)
             ring_waiting += getattr(ring, "waiting", 0)
             ring_peak = max(ring_peak, getattr(ring, "peak_inflight", 0))
             ring_depth = max(ring_depth, getattr(ring, "depth", 0))
+            # ISSUE 7: watchdog reclaims + quarantined orphan buffers
+            ring_timeouts += getattr(ring, "timeouts_total", 0)
+            q = getattr(ring, "quarantine", None)
+            if q is not None:
+                ring_quarantined += len(q)
         return {"dispatch_queue_depth": depth,
                 "batches_in_flight": inflight,
                 "batchers": batchers,
@@ -92,7 +98,35 @@ class DeviceGauges:
                 "ring_in_flight": ring_inflight,
                 "ring_waiting": ring_waiting,
                 "ring_peak_in_flight": ring_peak,
-                "ring_depth": ring_depth}
+                "ring_depth": ring_depth,
+                "ring_timeouts_total": ring_timeouts,
+                "ring_quarantined": ring_quarantined}
+
+    # ---------------- overload signals (ISSUE 7) ----------------------------
+
+    def queue_pressure(self) -> float:
+        """Dispatch-ring pressure for the load shedder: the worst ring's
+        (in-flight + parked waiters) / depth. 0 = idle, 1.0 = a full but
+        healthy pipeline, > 1 = dispatches parked behind the ring. Pure
+        attribute reads — safe on the publish hot path."""
+        worst = 0.0
+        for ring in list(self._rings):
+            depth = getattr(ring, "depth", 0) or 1
+            occ = (getattr(ring, "in_flight", 0)
+                   + getattr(ring, "waiting", 0)) / depth
+            if occ > worst:
+                worst = occ
+        return worst
+
+    def dispatch_queue_depth(self) -> int:
+        """Live batcher backlog (calls enqueued, not yet emitted) summed
+        across registered schedulers — the second overload signal, read
+        without the memory probe."""
+        depth = 0
+        for sched in list(self._schedulers):
+            for b in list(getattr(sched, "_batchers", {}).values()):
+                depth += len(getattr(b, "_queue", ()))
+        return depth
 
     def _memory_stats(self) -> dict:
         now = self._clock()

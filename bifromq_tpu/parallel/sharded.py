@@ -389,12 +389,16 @@ class MeshMatcher(TpuMatcher):
                             *, max_persistent_fanout: int = UNCAPPED_FANOUT,
                             max_group_fanout: int = UNCAPPED_FANOUT,
                             batch: Optional[int] = None,
-                            per_device_batch: Optional[int] = None
+                            per_device_batch: Optional[int] = None,
+                            stats: Optional[dict] = None
                             ) -> List[MatchedRoutes]:
         """Match (tenant, topic_levels) pairs across the mesh; exact at
         every instant (base walk ⊕ overlay ⊖ tombstones) like TpuMatcher.
         The cache/dedup front-end (TpuMatcher.match_batch, ISSUE 4) is
-        inherited — only the device plane differs."""
+        inherited — only the device plane differs. ``stats`` is accepted
+        for signature parity with the front-end; the mesh plane has no
+        device breaker yet (ROADMAP follow-up) so it never sets
+        ``degraded``."""
         if not queries:
             return []
         self._apply_pending_swap()

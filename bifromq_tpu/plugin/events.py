@@ -159,6 +159,10 @@ class EventType(enum.Enum):
     # share or its windowed ingest p99 crosses the SLO threshold
     NOISY_TENANT = "noisy_tenant"
     SLOW_TENANT = "slow_tenant"
+    # QoS0 publish shed under device-pipeline overload, tenant-fair —
+    # noisy tenants shed first (ISSUE 7, repo-specific); QoS1/2 never
+    # shed, they backpressure through the bounded ingest gate
+    SHED_QOS0 = "shed_qos0"
 
 
 @dataclass

@@ -70,15 +70,22 @@ class CircuitBreaker:
     def allow(self) -> bool:
         """Consuming admission check at call time. HALF_OPEN charges one
         probe slot; excess concurrent probes are refused."""
+        return self.admit() != "rejected"
+
+    def admit(self) -> str:
+        """Like ``allow()`` but tells the caller WHICH admission it got:
+        ``"ok"`` (closed — normal traffic), ``"canary"`` (half-open —
+        this call is the probe, and ISSUE 7's device breaker holds it to
+        a stricter success bar: oracle row parity), or ``"rejected"``."""
         s = self.state
         if s == CLOSED:
-            return True
+            return "ok"
         if s == OPEN:
-            return False
+            return "rejected"
         if self._probes_inflight >= self.half_open_max_probes:
-            return False
+            return "rejected"
         self._probes_inflight += 1
-        return True
+        return "canary"
 
     # ---------------- outcome feed -----------------------------------------
 
@@ -90,7 +97,17 @@ class CircuitBreaker:
             self._probes_inflight -= 1
 
     def record_success(self) -> None:
-        if self._state == HALF_OPEN:
+        s = self._state
+        if s == OPEN:
+            # a STALE success: the call was admitted before the trip and
+            # only now completed. Re-closing here would bypass the
+            # recovery window and the half-open probe (for the device
+            # breaker, the canary row-parity bar) — the streak that
+            # tripped the breaker is better evidence than one straggler.
+            return
+        if s == HALF_OPEN:
+            if self._probes_inflight == 0:
+                return   # not the probe's verdict — same straggler case
             _meter("breaker_closed_total")
         self._state = CLOSED
         self._failures = 0
@@ -116,6 +133,15 @@ class CircuitBreaker:
     def force_open(self) -> None:
         """Operator/test hook: trip immediately."""
         self._trip()
+
+    def force_close(self) -> None:
+        """Operator/test hook: reset to CLOSED immediately, bypassing
+        the recovery window (symmetric with ``force_open``; a stray
+        ``record_success`` can no longer do this — stale in-flight
+        successes are ignored while OPEN)."""
+        self._state = CLOSED
+        self._failures = 0
+        self._probes_inflight = 0
 
     def snapshot(self) -> dict:
         return {"state": self.state, "failures": self._failures,

@@ -16,6 +16,22 @@ The injector is also the chaos hook for NON-wire failure points: the
 dist worker consults ``service="tpu-matcher"`` before device dispatch so
 tests can force the host-oracle degradation path.
 
+ISSUE 7 adds the DEVICE-side rule set (``service="tpu-device"``), hooked
+into the matcher's dispatch/fetch stages and the ring's readiness poll:
+
+- ``error``: the dispatch (method="dispatch") or fetch (method="fetch")
+  raises — a crashed kernel / poisoned buffer.
+- ``hang``: the dispatched batch NEVER reports ready while the rule
+  stays installed — a wedged accelerator; the watchdog deadline is the
+  only way out. Removing the rule "un-wedges" the device (the arrays
+  were really ready all along), which is exactly how the chaos gate
+  drives breaker recovery.
+- ``slow``: readiness is withheld for ``delay`` seconds — a saturated
+  device / long tunnel RTT.
+- ``flaky_ready``: each readiness poll lies "not ready" with the rule's
+  probability — a glitchy PJRT buffer query; completion is only delayed,
+  never denied.
+
 Everything is deterministic under a seeded ``random.Random``; injected
 faults are counted globally (``utils.metrics.FABRIC``) and per rule.
 """
@@ -107,6 +123,26 @@ class FaultInjector:
         if self.decide(side, service, method,
                        actions=("error",)) is not None:
             raise InjectedFault(f"{service}/{method} ({side})")
+
+    #: the device-side action taxonomy (ISSUE 7) — see module docstring
+    DEVICE_ACTIONS = ("error", "hang", "slow", "flaky_ready")
+
+    def device_rule(self, method: str) -> Optional[FaultRule]:
+        """Device-fault hook for ``service="tpu-device"`` rules at the
+        matcher's dispatch/fetch stages. ``error`` rules raise here; the
+        readiness-shaping actions (hang/slow/flaky_ready) return the
+        fired rule for the caller to thread into ``wait_ready``. O(1)
+        when the injector is disabled."""
+        rule = self.decide("device", "tpu-device", method,
+                           actions=self.DEVICE_ACTIONS)
+        if rule is not None and rule.action == "error":
+            raise InjectedFault(f"tpu-device/{method} (device)")
+        return rule
+
+    def rule_active(self, rule: Optional[FaultRule]) -> bool:
+        """Is a previously-fired rule still installed? The hang action
+        polls this so REMOVING the rule un-wedges the device mid-wait."""
+        return rule is not None and rule in self.rules
 
     @staticmethod
     def _meter() -> None:

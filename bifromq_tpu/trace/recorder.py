@@ -34,6 +34,19 @@ class SpanRing:
         """Spans overwritten by wraparound."""
         return max(0, self._written - self.capacity)
 
+    def wrap_horizon(self) -> Optional[int]:
+        """The ``end_hlc`` of the oldest retained span, or None when the
+        ring has never wrapped. Every overwritten span ended at-or-before
+        this stamp (HLC is monotonic with record order), so a trace whose
+        spans all start after the horizon cannot have lost LEAF spans to
+        the wrap — the per-trace gap annotation (ISSUE 7) keys on this
+        instead of the lifetime ``dropped`` counter, which would flag
+        every trace forever after one wrap."""
+        if self._written <= self.capacity:
+            return None
+        oldest = self._slots[self._written % self.capacity]
+        return getattr(oldest, "end_hlc", 0) if oldest is not None else 0
+
     def spans(self) -> List[Span]:
         """Retained spans, oldest first."""
         n = self._written

@@ -17,6 +17,17 @@ import time
 from typing import Optional
 
 
+def env_float(name: str, default: float) -> float:
+    """Float env knob with a default on unset/blank/garbage — the one
+    shared parser for BIFROMQ_* tunables (obs, clusterview, resilience),
+    so fallback behavior cannot diverge between copies."""
+    raw = os.environ.get(name, "").strip()
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
 class EnvProvider:
     """Names + sizes the process's auxiliary executors."""
 

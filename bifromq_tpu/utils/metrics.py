@@ -103,6 +103,8 @@ class TenantMetric(enum.Enum):
     RETAIN_CLEARED = "retain_cleared"
     WILL_DISTED = "will_disted"
     INBOX_OVERFLOW = "inbox_overflow"
+    # ISSUE 7: QoS0 publishes shed under device overload (tenant-fair)
+    MATCH_SHED = "match_shed_total"
 
 
 class FabricMetric(enum.Enum):
@@ -118,6 +120,9 @@ class FabricMetric(enum.Enum):
     FAULTS_INJECTED = "faults_injected_total"
     MATCH_DEGRADED = "match_degraded_total"
     LEADER_REDIRECTS = "leader_redirects_total"
+    # ISSUE 7: device-fault resilience plane
+    DEVICE_TIMEOUT = "device_timeout_total"
+    MATCH_SHED = "match_shed_total"
 
 
 class FabricMetrics:
@@ -296,11 +301,19 @@ class MetricsRegistry:
         breakers = FABRIC.breaker_snapshot()
         if breakers:
             fabric["breakers"] = breakers
-        return {"uptime_s": round(time.time() - self.started_at, 1),
-                "tenants": dict(per_tenant),
-                "fabric": fabric,
-                "stages": STAGES.snapshot(),
-                "match_cache": MATCH_CACHE.snapshot()}
+        out = {"uptime_s": round(time.time() - self.started_at, 1),
+               "tenants": dict(per_tenant),
+               "fabric": fabric,
+               "stages": STAGES.snapshot(),
+               "match_cache": MATCH_CACHE.snapshot()}
+        # ISSUE 7: per-tenant shed counters (match_shed_total{tenant}) —
+        # only shipped once something actually shed, so the happy-path
+        # payload doesn't grow. Lazy import: resilience ← utils.metrics
+        # would otherwise close a cycle through obs.exporter.
+        from ..resilience.device import SHEDDER
+        if SHEDDER.shed_total:
+            out["shed"] = SHEDDER.snapshot()
+        return out
 
 
 _EVENT_TO_METRIC = {
@@ -323,14 +336,19 @@ _EVENT_TO_METRIC = {
     EventType.RETAIN_MSG_CLEARED: TenantMetric.RETAIN_CLEARED,
     EventType.WILL_DISTED: TenantMetric.WILL_DISTED,
     EventType.OVERFLOWED: TenantMetric.INBOX_OVERFLOW,
+    EventType.SHED_QOS0: TenantMetric.MATCH_SHED,
 }
 
 
-# the error-classed subset feeding the windowed RED "E" (ISSUE 3)
+# the error-classed subset feeding the windowed RED "E" (ISSUE 3).
+# SHED_QOS0 counts as an error on purpose: a shed IS a drop, and charging
+# it to the shedded tenant's error rate keeps the noisy flag sticky while
+# that tenant is being shed — mild hysteresis, not a bug (ISSUE 7).
 _ERROR_METRICS = frozenset({
     TenantMetric.DELIVER_ERRORS,
     TenantMetric.QOS_DROPPED,
     TenantMetric.INBOX_OVERFLOW,
+    TenantMetric.MATCH_SHED,
 })
 
 

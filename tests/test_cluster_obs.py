@@ -519,3 +519,155 @@ class TestClusterObsRPC:
     async def test_agent_id_constant(self):
         # the gossip agent id is wire surface: peers key on it
         assert AGENT_ID == "obs"
+
+
+class TestDemotionHysteresis:
+    """ISSUE 7 satellite: an endpoint flapping between healthy and
+    suspect within the cooldown window stays demoted — the pick tier
+    must not oscillate with a sawtoothing health signal."""
+
+    EP = "127.0.0.1:6001"
+
+    def _view(self, clock, hysteresis_s=5.0):
+        host = FakeHost("me")
+        host.agent_meta["peer"] = {
+            "addr": "127.0.0.1:8000",
+            "digest": _peer_digest(breakers={self.EP: "open"})}
+        view = ClusterView("me", host, hub=_fresh_hub(),
+                           hysteresis_s=hysteresis_s, clock=clock)
+        return host, view
+
+    def test_flapping_endpoint_stays_demoted_until_cooldown(self):
+        t = [1000.0]
+        host, view = self._view(lambda: t[0])
+        view._recompute()
+        assert view.suspect(self.EP)
+        # the breaker half-opens: the digest stops naming the endpoint,
+        # but inside the cooldown the demotion is sticky
+        host.agent_meta["peer"]["digest"] = _peer_digest()
+        t[0] += 1.0
+        view._recompute()
+        assert view.suspect(self.EP)
+        # it flaps bad again — the cooldown clock RESTARTS
+        host.agent_meta["peer"]["digest"] = _peer_digest(
+            breakers={self.EP: "open"})
+        t[0] += 1.0
+        view._recompute()
+        host.agent_meta["peer"]["digest"] = _peer_digest()
+        t[0] += 4.0                 # 4s healthy < 5s cooldown
+        view._recompute()
+        assert view.suspect(self.EP)
+        # a FULL cooldown of consecutive health finally clears it
+        t[0] += 5.1
+        view._recompute()
+        assert not view.suspect(self.EP)
+
+    def test_steady_healthy_endpoint_never_demoted(self):
+        t = [1000.0]
+        host = FakeHost("me")
+        host.agent_meta["peer"] = {"addr": "127.0.0.1:8000",
+                                   "digest": _peer_digest()}
+        view = ClusterView("me", host, hub=_fresh_hub(),
+                           hysteresis_s=5.0, clock=lambda: t[0])
+        for _ in range(5):
+            t[0] += 1.0
+            view._recompute()
+            assert not view.suspect(self.EP)
+
+    def test_device_breaker_open_demotes_node(self):
+        """ISSUE 7: a node gossiping a non-closed DEVICE breaker (it is
+        serving, but oracle-degraded) is demoted like a browned-out
+        node — peers with a healthy accelerator rank first."""
+        t = [1000.0]
+        host = FakeHost("me")
+        host.agent_meta["worker"] = {
+            "addr": "127.0.0.1:9100",
+            "digest": _peer_digest(
+                device={"dispatch_queue_depth": 0,
+                        "batches_in_flight": 0, "compile_count": 0,
+                        "mem_peak_bytes": 0, "breaker": "open"})}
+        view = ClusterView("me", host, hub=_fresh_hub(),
+                           clock=lambda: t[0])
+        view._recompute()
+        assert view.suspect("127.0.0.1:9100")
+
+
+class TestTraceGapAnnotation:
+    """ISSUE 7 satellite: a wrapped SpanRing must not silently serve a
+    partial trace — /cluster/trace/<id> annotates the gap."""
+
+    def _span(self, name, tid, sid, parent, hlc):
+        from bifromq_tpu.trace.span import Span
+        return Span(name=name, trace_id=tid, span_id=sid,
+                    parent_id=parent, tenant="t", service="svc",
+                    start_hlc=hlc, end_hlc=hlc + 1, duration_ms=1.0)
+
+    async def test_wrapped_ring_annotates_dropped_spans(self):
+        from bifromq_tpu.trace.recorder import SpanRing
+        tr = trace.TRACER
+        old_ring = tr.ring
+        tr.ring = SpanRing(4)
+        try:
+            tid = 0xABC123
+            # an early span of the trace...
+            tr.ring.record(self._span("pub.ingest", tid, 0x1, 0, 10))
+            # ...rolls off under unrelated traffic...
+            for i in range(6):
+                tr.ring.record(self._span("noise", 0x999, 0x100 + i, 0,
+                                          20 + i))
+            # ...before a late child (parented under it) is recorded
+            tr.ring.record(self._span("deliver.fanout", tid, 0x2, 0x1, 40))
+            view = ClusterView("A", FakeHost("A"), hub=_fresh_hub())
+            out = await view.federated_trace(f"{tid:016x}")
+            assert [s["name"] for s in out["spans"]] == ["deliver.fanout"]
+            assert out["spans_dropped"] == 1
+            assert out["complete"] is False
+            assert "A" in out["rings_wrapped"]
+        finally:
+            tr.ring = old_ring
+
+    async def test_old_wrap_does_not_flag_recent_complete_trace(self):
+        """The wrap signal is per-trace: a ring that wrapped under OLD
+        unrelated traffic must not brand a fully-captured recent trace
+        incomplete (the lifetime ``dropped`` counter is monotonic — the
+        annotation keys on the wrap horizon instead)."""
+        from bifromq_tpu.trace.recorder import SpanRing
+        tr = trace.TRACER
+        old_ring = tr.ring
+        tr.ring = SpanRing(4)
+        try:
+            # unrelated history rolls the ring over...
+            for i in range(8):
+                tr.ring.record(self._span("noise", 0x999, 0x100 + i, 0,
+                                          10 + i))
+            # ...long before a complete parent+child trace is recorded
+            tid = 0x5EC0FD
+            tr.ring.record(self._span("pub.ingest", tid, 0x1, 0, 100))
+            tr.ring.record(self._span("deliver.fanout", tid, 0x2, 0x1,
+                                      110))
+            view = ClusterView("A", FakeHost("A"), hub=_fresh_hub())
+            out = await view.federated_trace(f"{tid:016x}")
+            assert out["count"] == 2
+            assert out["spans_dropped"] == 0
+            assert out["complete"] is True
+            assert out["rings_wrapped"] == []
+        finally:
+            tr.ring = old_ring
+
+    async def test_unwrapped_ring_reports_complete(self):
+        from bifromq_tpu.trace.recorder import SpanRing
+        tr = trace.TRACER
+        old_ring = tr.ring
+        tr.ring = SpanRing(16)
+        try:
+            tid = 0xDEF456
+            tr.ring.record(self._span("pub.ingest", tid, 0x1, 0, 10))
+            tr.ring.record(self._span("deliver.fanout", tid, 0x2, 0x1, 20))
+            view = ClusterView("A", FakeHost("A"), hub=_fresh_hub())
+            out = await view.federated_trace(f"{tid:016x}")
+            assert out["count"] == 2
+            assert out["spans_dropped"] == 0
+            assert out["complete"] is True
+            assert out["rings_wrapped"] == []
+        finally:
+            tr.ring = old_ring
