@@ -139,6 +139,12 @@ def _compile(tries, *, name, max_levels=16):
     t1 = time.time()
     log(f"[{name}] compiled: nodes={ct.n_nodes} slots={ct.n_slots} "
         f"({t1 - t0:.1f}s)")
+    # ISSUE 8: bench builds bypass TpuMatcher, so stamp the compile into
+    # the ledger here — the record's compile_ledger must attribute the
+    # build that produced the headline table, not come back empty on
+    # direct-walk configs (shared derivation with the matcher installs)
+    from bifromq_tpu.obs.capacity import record_compile_event
+    record_compile_event(ct, reason=f"bench:{name}", duration_s=t1 - t0)
     return ct, DeviceTrie.from_compiled(ct), t1 - t0
 
 
@@ -1236,6 +1242,37 @@ def main():
         log(f"device gauges: {json.dumps(record['device'])}")
     except Exception as e:  # noqa: BLE001 — gauges must not fail the bench
         log(f"device gauges unavailable: {e!r}")
+    # continuous-profiler snapshot on every record (ISSUE 8): the
+    # rtt/kernel split, padding waste / dedup / cache-bypass efficiency
+    # and the compile-event ledger — the same data GET /profile serves,
+    # so trajectory records stay analyzable post-hoc
+    try:
+        from bifromq_tpu.obs import OBS
+        record["profile"] = OBS.profiler.snapshot(brief=True)
+        log(f"profile: {json.dumps(record['profile'])}")
+    except Exception as e:  # noqa: BLE001 — must not fail the bench
+        log(f"profile snapshot unavailable: {e!r}")
+    # capacity accounting next to it (ISSUE 8): model-vs-live parity for
+    # every registered matcher + the planner's verdict for the HEADLINE
+    # subscription count on this device
+    try:
+        from bifromq_tpu.obs.capacity import capacity_report
+        record["capacity"] = capacity_report(n_subs=N_SUBS)
+        cap = record["capacity"]
+        log(f"capacity: table_bytes={cap.get('table_bytes')} "
+            f"parity_error={cap.get('parity_error')} "
+            f"fits={json.dumps(cap.get('fits', {}).get('fused_vmem'))}")
+    except Exception as e:  # noqa: BLE001 — must not fail the bench
+        log(f"capacity report unavailable: {e!r}")
+    # persist the profile into the segment store when one is configured
+    # (BIFROMQ_OBS_STORE): post-hoc analysis survives the TPU session
+    try:
+        from bifromq_tpu.obs import OBS
+        if OBS.start_persistence():
+            OBS.persist_now()
+            OBS.stop_persistence(final_flush=False)
+    except Exception as e:  # noqa: BLE001
+        log(f"profile persistence failed: {e!r}")
     # persist last-known-good for a real headline only (a partial
     # broker-only or error-path run must never clobber it). A CPU-platform
     # headline IS a valid record — the stock baseline ran on the same
@@ -1244,14 +1281,21 @@ def main():
     # OVERWRITES a device-measured record.
     if record.get("value", 0) > 0 and "matched_routes" in record["metric"]:
         keep = True
-        if record["platform"] == "cpu":
-            try:
-                with open(LAST_GOOD_PATH) as f:
-                    existing = json.load(f)
-                keep = (not isinstance(existing, dict)
-                        or existing.get("platform") == "cpu")
-            except (OSError, ValueError):
-                keep = True     # nothing recorded yet
+        try:
+            with open(LAST_GOOD_PATH) as f:
+                existing = json.load(f)
+        except (OSError, ValueError):
+            existing = None     # nothing recorded yet
+        if isinstance(existing, dict):
+            if record["platform"] == "cpu" \
+                    and existing.get("platform") != "cpu":
+                keep = False
+            # a small smoke run (BENCH_SUBS down-scaled for a drive-by
+            # verification) must never clobber the full-population
+            # headline either — the record is only last-KNOWN-GOOD if
+            # it measures at least the population the existing one did
+            if record.get("n_subs", 0) < existing.get("n_subs", 0):
+                keep = False
         if keep:
             try:
                 os.makedirs(os.path.dirname(LAST_GOOD_PATH), exist_ok=True)

@@ -25,12 +25,14 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import time
 from typing import Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
 from ..mqtt.broker import MQTTBroker
 from ..types import ClientInfo, Message, QoS
 from ..utils import topic as topic_util
+from ..utils.env import env_float as _env_float
 from ..utils.hlc import HLC
 
 log = logging.getLogger("bifromq_tpu.api")
@@ -48,6 +50,10 @@ class APIServer:
         self.registry = registry    # rpc.fabric.ServiceRegistry (clustered)
         self.clusterview = clusterview  # obs.clusterview.ClusterView
         self._server: Optional[asyncio.AbstractServer] = None
+        # ISSUE 8 satellite: periodic merged /cluster/tenants cache —
+        # (monotonic stamp, full merged payload); served with max-age /
+        # age headers instead of scatter-gathering per request
+        self._tenants_cache: Optional[Tuple[float, dict]] = None
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(self._on_client, self.host,
@@ -69,16 +75,26 @@ class APIServer:
                 if request is None:
                     break
                 method, path, headers, body = request
-                status, payload = await self._route(method, path, headers,
-                                                    body)
+                result = await self._route(method, path, headers, body)
+                # handlers return (status, payload) or, when they carry
+                # response headers (ISSUE 8: the tenants cache's max-age
+                # / age pair), (status, payload, extra_headers)
+                if len(result) == 3:
+                    status, payload, extra = result
+                else:
+                    status, payload = result
+                    extra = {}
                 data = json.dumps(payload).encode() + b"\n"
                 reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
                           429: "Too Many Requests",
                           500: "Internal Server Error"}.get(status, "Status")
+                head = (f"HTTP/1.1 {status} {reason}\r\n"
+                        f"content-type: application/json\r\n")
+                for k, v in extra.items():
+                    head += f"{k}: {v}\r\n"
                 writer.write(
-                    f"HTTP/1.1 {status} {reason}\r\n"
-                    f"content-type: application/json\r\n"
-                    f"content-length: {len(data)}\r\n\r\n".encode() + data)
+                    (head + f"content-length: {len(data)}\r\n\r\n").encode()
+                    + data)
                 await writer.drain()
                 if headers.get("connection", "").lower() == "close":
                     break
@@ -140,6 +156,12 @@ class APIServer:
                 return self._cluster_info()
             if route == ("GET", "/cluster/tenants"):
                 return await self._cluster_tenants(arg)
+            if route == ("GET", "/cluster/capacity"):
+                return self._cluster_capacity()
+            if route == ("GET", "/capacity"):
+                return self._capacity_get(arg)
+            if route == ("GET", "/profile"):
+                return self._profile_get(arg)
             if method == "GET" and url.path.startswith("/cluster/trace/"):
                 return await self._cluster_trace(
                     url.path[len("/cluster/trace/"):], arg)
@@ -469,29 +491,103 @@ class APIServer:
                         for m in self.cluster.members.values()},
         }
 
-    async def _cluster_tenants(self, arg) -> Tuple[int, object]:
+    async def _cluster_tenants(self, arg) -> Tuple:
         """``GET /cluster/tenants``: per-tenant RED merged across every
         node (scatter-gather under a deadline budget; log2 histograms
         merged bucket-wise). Standalone/unwired nodes degrade to a
-        local-only view with the same shape."""
+        local-only view with the same shape.
+
+        ISSUE 8 satellite: the merged view is CACHED — a scrape loop or
+        dashboard polling every second no longer fans an RPC out to
+        every node per request. The full (top_k=0) merge is cached for
+        ``BIFROMQ_CLUSTER_TENANTS_TTL_S`` (request override:
+        ``?max_age_s=``, 0 forces a refresh); top_k filtering applies
+        per request on the cached rows, and the response carries
+        ``cache-control: max-age`` + ``age`` headers so consumers can
+        see exactly how fresh the merge is."""
         top_k = int(arg("top_k", "0"))
         timeout_s = float(arg("timeout_s", "2.0"))
+        ttl = float(arg("max_age_s", "") or _env_float(
+            "BIFROMQ_CLUSTER_TENANTS_TTL_S", 2.0))
+        now = time.monotonic()
+        cached = self._tenants_cache
+        if cached is not None and ttl > 0 and now - cached[0] < ttl:
+            age = now - cached[0]
+            out = cached[1]
+        else:
+            out = await self._cluster_tenants_fetch(timeout_s)
+            self._tenants_cache = (now, out)
+            age = 0.0
+        payload = dict(out)
+        rows = payload.get("tenants") or {}
+        if top_k > 0:       # filter per request; the cache stays full
+            keep = sorted(rows,
+                          key=lambda t: -rows[t]["rate_per_s"])[:top_k]
+            payload["tenants"] = {t: rows[t] for t in keep}
+        payload["cache"] = {"age_s": round(age, 3), "max_age_s": ttl}
+        return 200, payload, {"cache-control": f"max-age={ttl:g}",
+                              "age": f"{age:.3f}"}
+
+    async def _cluster_tenants_fetch(self, timeout_s: float) -> dict:
+        """One full (unfiltered) merge — the cache's fill path."""
         if self.clusterview is not None:
-            out = await self.clusterview.federated_tenants(
-                timeout_s=timeout_s, top_k=top_k)
-            return 200, out
+            return await self.clusterview.federated_tenants(
+                timeout_s=timeout_s, top_k=0)
         from ..obs import OBS
         from ..obs.clusterview import derive_red_row, merge_tenant_raws
         merged = merge_tenant_raws(
             [OBS.windows.raw_snapshot() if OBS.enabled else {}])
         rows = {t: derive_red_row(r, OBS.windows.window_s)
                 for t, r in merged.items()}
-        if top_k > 0:       # same contract as the federated path
-            keep = sorted(rows, key=lambda t: -rows[t]["rate_per_s"])[:top_k]
-            rows = {t: rows[t] for t in keep}
-        return 200, {"window_s": OBS.windows.window_s,
-                     "nodes": {OBS.node_id: "local"},
-                     "tenants": rows}
+        return {"window_s": OBS.windows.window_s,
+                "nodes": {OBS.node_id: "local"},
+                "tenants": rows}
+
+    # -- capacity & profiling plane (ISSUE 8) -------------------------------
+
+    def _capacity_get(self, arg) -> Tuple[int, object]:
+        """``GET /capacity``: model-vs-live byte parity for every
+        registered matcher, guarded HBM stats, planner coefficients;
+        ``?n_subs=`` (+ optional ``shards=``) adds a full ``fits``
+        verdict — HBM headroom and the fused-VMEM gate — computed
+        without dispatching anything."""
+        from ..obs.capacity import capacity_report
+        kw = {}
+        n_subs = arg("n_subs")
+        if n_subs is not None:
+            kw["n_subs"] = int(n_subs)
+        shards = arg("shards")
+        if shards is not None:
+            kw["mesh"] = int(shards)
+        return 200, capacity_report(
+            memory=arg("memory", "1") != "0", **kw)
+
+    def _profile_get(self, arg) -> Tuple[int, object]:
+        """``GET /profile``: the continuous profiler's live snapshot —
+        dispatch/ready/fetch split with the tunnel-RTT vs kernel-time
+        decomposition, padding waste, dedup savings, cache bypasses,
+        the compile-event ledger, and segment-store state. The RTT
+        shown is the cached estimate; ``?probe=1`` pays a fresh device
+        round-trip probe (blocks this handler ~4×RTT — explicit
+        operator opt-in, never the scrape-loop default)."""
+        from ..obs import OBS
+        return 200, OBS.profile_snapshot(
+            brief=arg("brief", "0") in ("1", "true"),
+            probe=arg("probe", "0") in ("1", "true"))
+
+    def _cluster_capacity(self) -> Tuple[int, object]:
+        """``GET /cluster/capacity``: per-node capacity federated from
+        the gossiped health digests (no scatter-gather RPC)."""
+        if self.clusterview is not None:
+            return 200, self.clusterview.capacity_table()
+        from ..obs import OBS
+        from ..obs.capacity import digest_capacity
+        local = digest_capacity(OBS)
+        return 200, {"nodes": {OBS.node_id: {"capacity": local,
+                                             "stale": False,
+                                             "self": True}},
+                     "total_table_bytes": local.get("table_bytes", 0),
+                     "max_mem_peak_bytes": local.get("mem_peak_bytes", 0)}
 
     async def _cluster_trace(self, trace_id: str, arg) -> Tuple[int, object]:
         """``GET /cluster/trace/<id>``: the full cross-process trace,

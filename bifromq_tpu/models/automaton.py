@@ -138,6 +138,16 @@ class CompiledTrie:
     def root_of(self, tenant_id: str) -> int:
         return self.tenant_root.get(tenant_id, _EMPTY)
 
+    def arena_bytes(self) -> Dict[str, int]:
+        """Exact host-side bytes of the packed arenas (ISSUE 8 capacity
+        model). These three ship to device verbatim; the upload path
+        additionally derives the narrow count/route column tables
+        (``DeviceTrie.from_compiled``), which ``obs.capacity`` accounts
+        from the CT/RT layout constants."""
+        return {"node_tab": int(self.node_tab.nbytes),
+                "edge_tab": int(self.edge_tab.nbytes),
+                "child_list": int(self.child_list.nbytes)}
+
     # ---- slot metadata for vectorized host expansion ----------------------
     # (models/matcher.py expands device-emitted slot INTERVALS with one
     # ragged-arange + fancy-index instead of a per-slot Python loop — the

@@ -72,6 +72,21 @@ def _table_bytes(trie: DeviceTrie) -> int:
     return total
 
 
+def fused_table_bytes(trie: DeviceTrie) -> int:
+    """The bytes the VMEM gate weighs (edge + route tables — the two the
+    kernel keeps resident). Public so the capacity plane (obs/capacity)
+    reports the same number the gate compares."""
+    return _table_bytes(trie)
+
+
+def fused_fits_vmem(table_bytes: int) -> bool:
+    """THE VMEM-capacity comparison — one definition shared by the
+    serving gate below and the capacity planner's predicted verdict
+    (ISSUE 8): a planner that re-derived the comparison could drift from
+    what the dispatch path actually does."""
+    return table_bytes <= fused_vmem_budget_bytes()
+
+
 def _on_tpu() -> bool:
     try:
         return jax.default_backend() == "tpu"
@@ -93,7 +108,7 @@ def fused_enabled(trie: Optional[DeviceTrie] = None) -> bool:
     # auto: compiled TPU only, and only when the tables fit VMEM
     if not _on_tpu():
         return False
-    if trie is not None and _table_bytes(trie) > fused_vmem_budget_bytes():
+    if trie is not None and not fused_fits_vmem(_table_bytes(trie)):
         return False
     return True
 

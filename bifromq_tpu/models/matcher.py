@@ -90,10 +90,11 @@ class _InFlight:
     """
 
     __slots__ = ("queries", "ct", "dev", "tok", "roots", "res", "tomb",
-                 "delta", "batch", "kernel", "fault")
+                 "delta", "batch", "kernel", "fault", "dispatch_s")
 
     def __init__(self, **kw) -> None:
         self.fault = None   # fired device FaultRule (ISSUE 7 chaos hook)
+        self.dispatch_s = 0.0  # dispatch-stage seconds (ISSUE 8 profiler)
         for k, v in kw.items():
             setattr(self, k, v)
 
@@ -169,6 +170,11 @@ class TpuMatcher:
         self._compact_thread: Optional[threading.Thread] = None
         self.compile_count = 0      # full compiles (observability/tests)
         self.compile_time_s = 0.0   # cumulative wall time in compiles
+        # ISSUE 8 compile-event ledger: what triggered the build the
+        # NEXT _install_base lands (first_base / threshold / forced /
+        # refresh), and how long that compile ran
+        self._compile_reason = "first_base"
+        self._last_compile_s = 0.0
         # ISSUE 3: compile count/time surface under /metrics "device"
         from ..obs import OBS
         OBS.device.register_matcher(self)
@@ -285,7 +291,8 @@ class TpuMatcher:
         from ..ops.match import DeviceTrie  # deferred: keeps jax optional
         dev = DeviceTrie.from_compiled(ct, device=self.device)
         self._warm_walk(ct, dev)
-        self.compile_time_s += _time.perf_counter() - t0
+        self._last_compile_s = _time.perf_counter() - t0
+        self.compile_time_s += self._last_compile_s
         return ct, dev
 
     def _warm_walk(self, ct: CompiledTrie, dev) -> None:
@@ -352,6 +359,8 @@ class TpuMatcher:
         """
         self.drain()
         if self._log or self._base_ct is None:
+            self._compile_reason = ("first_base" if self._base_ct is None
+                                    else "refresh")
             self._replay_log_into_shadow()
             ct, dev = self._compile_shadow()
             self._install_base(ct, dev)
@@ -388,9 +397,25 @@ class TpuMatcher:
         # (hash-collision recompile) or the first install still bumps the
         # global generation; reset-from-KV rebuilds through clone_empty
         # (fresh cache) and never reaches here.
+        bumped = False
         if self.match_cache is not None:
             if prev is None or self._base_salt(prev) != self._base_salt(ct):
                 self.match_cache.bump_all()
+                bumped = True
+        self._ledger_record(ct, bumped)
+
+    def _ledger_record(self, ct, bumped: bool) -> None:
+        """ISSUE 8: stamp this install into the compile-event ledger so
+        rebuild storms are attributable — trigger reason, compile wall
+        time, salt, table bytes, the fused VMEM verdict, and whether the
+        match-cache generation was bumped. The byte/VMEM derivation
+        lives in one place (obs.capacity.record_compile_event — bench
+        builds stamp through it too)."""
+        from ..obs.capacity import record_compile_event
+        record_compile_event(ct, reason=self._compile_reason,
+                             duration_s=self._last_compile_s,
+                             salt=self._base_salt(ct),
+                             generation_bumped=bumped)
 
     def _maybe_compact(self, force: bool = False) -> None:
         # trigger on the FIRST mutation too (base is None): the first base
@@ -406,6 +431,9 @@ class TpuMatcher:
                              and self._overlay_n < self.compact_threshold)))):
             self._apply_pending_swap()
             return
+        # ledger attribution (ISSUE 8): why this build is happening
+        self._compile_reason = ("first_base" if self._base_ct is None
+                                else ("forced" if force else "threshold"))
         # snapshot: fold the log into the shadow NOW (serving thread, cheap —
         # O(log)); the compile thread then reads only the frozen shadow
         self._replay_log_into_shadow()
@@ -541,6 +569,12 @@ class TpuMatcher:
         if uniq_queries:
             MATCH_CACHE.record_dedup(len(uniq_queries),
                                      len(miss_rows) - len(uniq_queries))
+        # ISSUE 8: the profiler's cache-bypass / dedup-savings counters
+        # (rows that never reached the device) — three int adds
+        from ..obs import OBS
+        OBS.profiler.record_frontend(
+            n_queries, n_queries - len(miss_rows),
+            len(miss_rows) - len(uniq_queries))
 
     # ---------------- async device pipeline (ISSUE 6 tentpole) -------------
 
@@ -721,6 +755,11 @@ class TpuMatcher:
                     # budget must not leak or the breaker wedges refusing
                     br.release_probe()
         FABRIC.inc(FabricMetric.MATCH_DEGRADED, len(uniq_queries))
+        from ..obs import OBS
+        OBS.profiler.record_batch(
+            n_queries=len(uniq_queries), batch=len(uniq_queries),
+            kernel="oracle", dispatch_s=0.0, path="async",
+            degraded=reason)
         with trace.span("match.degraded", reason=reason,
                         n_queries=len(uniq_queries)):
             if oracle_rows is None:
@@ -777,15 +816,26 @@ class TpuMatcher:
                 # quarantine exists to prevent
                 ring.quarantine.add(fl.res)
                 raise
-            STAGES.record("device.ready", time.perf_counter() - t0)
+            ready_s = time.perf_counter() - t0
+            STAGES.record("device.ready", ready_s)
         finally:
             ring.release()
         t0 = time.perf_counter()
         with trace.span("device.fetch"):
             overflow, starts_a, counts_a = self._fetch_walk(fl.res)
-        STAGES.record("device.fetch", time.perf_counter() - t0)
-        return self._expand_walk(fl, overflow, starts_a, counts_a,
-                                 max_persistent_fanout, max_group_fanout)
+        fetch_s = time.perf_counter() - t0
+        STAGES.record("device.fetch", fetch_s)
+        t0 = time.perf_counter()
+        out = self._expand_walk(fl, overflow, starts_a, counts_a,
+                                max_persistent_fanout, max_group_fanout)
+        # ISSUE 8: the continuous profiler's per-batch stage record —
+        # attribute increments + one ring store, nothing else
+        from ..obs import OBS
+        OBS.profiler.record_batch(
+            n_queries=len(fl.queries), batch=fl.batch, kernel=fl.kernel,
+            dispatch_s=fl.dispatch_s, ready_s=ready_s, fetch_s=fetch_s,
+            expand_s=time.perf_counter() - t0, path="async")
+        return out
 
     def _canary_parity(self, queries, device_rows,
                        max_persistent_fanout, max_group_fanout):
@@ -856,10 +906,18 @@ class TpuMatcher:
             t0 = time.perf_counter()
             with trace.span("device.fetch"):
                 overflow, starts_a, counts_a = self._fetch_walk(fl.res)
-            STAGES.record("device.fetch", time.perf_counter() - t0)
+            fetch_s = time.perf_counter() - t0
+            STAGES.record("device.fetch", fetch_s)
+            t0 = time.perf_counter()
             out = self._expand_walk(fl, overflow, starts_a, counts_a,
                                     max_persistent_fanout,
                                     max_group_fanout)
+            from ..obs import OBS
+            OBS.profiler.record_batch(
+                n_queries=len(fl.queries), batch=fl.batch,
+                kernel=fl.kernel, dispatch_s=fl.dispatch_s,
+                fetch_s=fetch_s, expand_s=time.perf_counter() - t0,
+                path="sync")
         except BaseException as e:
             if br is not None:
                 if isinstance(e, Exception):
@@ -938,11 +996,13 @@ class TpuMatcher:
         # ISSUE 6: the `device.sync` stage of the sync era is replaced by
         # the dispatch/ready/fetch split in the always-on stage
         # histograms (/metrics "stages" + the bench breakdown)
-        STAGES.record("device.dispatch", time.perf_counter() - t0)
+        dispatch_s = time.perf_counter() - t0
+        STAGES.record("device.dispatch", dispatch_s)
         return _InFlight(queries=list(queries), ct=ct,
                          dev=self._device_trie, tok=tok, roots=roots,
                          res=res, tomb=self._tomb, delta=self._delta,
-                         batch=batch, kernel=kernel, fault=fault)
+                         batch=batch, kernel=kernel, fault=fault,
+                         dispatch_s=dispatch_s)
 
     def _walk_primary(self, probes, ct, *, donate: bool):
         """The primary serving walk: fused Pallas kernel when enabled

@@ -748,6 +748,13 @@ class MQTTBroker:
         # ref releases one at stop.
         from ..obs import OBS
         self._obs_exporter_ref = OBS.start_exporter()
+        # ISSUE 8: segment-file persistence of profile records, compile
+        # ledger events and slow spans (BIFROMQ_OBS_STORE directory);
+        # flushes ride the advisory tick, so arming persistence also
+        # arms the tick
+        self._obs_store_ref = OBS.start_persistence()
+        if self._obs_store_ref:
+            OBS.start_advisory_tick()
         # ISSUE 4 satellite: an armed SLO-advised throttler gets its flag
         # set refreshed on a background tick, so the connect/publish guard
         # path (has_resource) never pays a detector evaluation
@@ -856,6 +863,11 @@ class MQTTBroker:
             self._obs_exporter_ref = False
             from ..obs import OBS
             await OBS.stop_exporter()
+        if getattr(self, "_obs_store_ref", False):
+            self._obs_store_ref = False
+            from ..obs import OBS
+            OBS.stop_persistence()
+            await OBS.stop_advisory_tick()
         if getattr(self, "_obs_tick_ref", False):
             self._obs_tick_ref = False
             from ..obs import OBS

@@ -36,6 +36,8 @@ from ..utils.env import env_float as _env_float
 from .device import DeviceGauges
 from .exporter import FileSink, HTTPSink, TelemetryExporter
 from .neighbor import NoisyNeighborDetector
+from .profiler import CompileLedger, ContinuousProfiler
+from .segstore import SegmentStore
 from .slo import TenantSLO
 from .window import WindowedCounter, WindowedLog2Histogram
 
@@ -58,6 +60,18 @@ class ObsHub:
             slow_p99_ms=_env_float("BIFROMQ_OBS_SLO_MS", 1000.0),
             clock=clock)
         self.device = DeviceGauges(clock=clock)
+        # ISSUE 8: always-on continuous profiler (per-batch stage split,
+        # padding/dedup/cache efficiency, compile-event ledger) — wall
+        # clock, not the hub's monotonic: its records persist across
+        # process restarts and must be comparable post-hoc
+        self.profiler = ContinuousProfiler()
+        # ISSUE 8: bounded segment-file store for post-hoc analysis
+        # (armed by start_persistence from env knobs)
+        self.store: Optional[SegmentStore] = None
+        self._store_refs = 0
+        self._store_prof_cursor = 0
+        self._store_slow_cursor = 0
+        self._store_ledger_cursor = 0
         self.exporter: Optional[TelemetryExporter] = None
         self._exporter_refs = 0
         self._registry_ref = None       # weakref to a MetricsRegistry
@@ -168,6 +182,21 @@ class ObsHub:
         out = {"windows_enabled": self.enabled}
         if self.exporter is not None:
             out["exporter"] = self.exporter.snapshot()
+        if self.store is not None:
+            out["store"] = self.store.snapshot()
+        return out
+
+    def profile_snapshot(self, *, brief: bool = False,
+                         probe: bool = False) -> dict:
+        """The ``GET /profile`` payload (ISSUE 8): rtt/kernel split,
+        padding/dedup/cache efficiency, compile ledger, store state.
+        ``probe=False`` by default: this serves from a sync handler on
+        the broker's event loop, where 4 tunnel round trips (~280ms on
+        axon) would stall every session — scrape loops get the cached
+        RTT; an operator opts into a fresh probe explicitly."""
+        out = self.profiler.snapshot(brief=brief, probe=probe)
+        if self.store is not None and not brief:
+            out["store"] = self.store.snapshot()
         return out
 
     def _export_snapshot(self) -> dict:
@@ -193,6 +222,13 @@ class ObsHub:
         url = os.environ.get("BIFROMQ_OBS_EXPORT_URL", "").strip()
         if not path and not url:
             return None
+        framing = os.environ.get("BIFROMQ_OBS_FORMAT",
+                                 "jsonl").strip().lower() or "jsonl"
+        if framing not in ("jsonl", "otlp"):
+            import logging
+            logging.getLogger(__name__).error(
+                "BIFROMQ_OBS_FORMAT=%r unknown; using jsonl", framing)
+            framing = "jsonl"
         try:
             sink = HTTPSink(url) if url else FileSink(path)
         except ValueError as e:
@@ -208,7 +244,8 @@ class ObsHub:
             export_sampled=os.environ.get(
                 "BIFROMQ_OBS_EXPORT_SAMPLED", "0") == "1",
             snapshot_fn=self._export_snapshot,
-            resource=self.resource_envelope())
+            resource=self.resource_envelope(),
+            framing=framing)
 
     def start_exporter(self,
                        exporter: Optional[TelemetryExporter] = None) -> bool:
@@ -234,6 +271,92 @@ class ObsHub:
             exp, self.exporter = self.exporter, None
             self._exporter_refs = 0
             await exp.stop()
+
+    # ---------------- segment-store persistence (ISSUE 8) ------------------
+
+    def store_from_env(self) -> Optional[SegmentStore]:
+        """Build the segment store from env knobs: ``BIFROMQ_OBS_STORE``
+        (directory; empty = disabled), ``BIFROMQ_OBS_STORE_SEGMENT_BYTES``
+        and ``BIFROMQ_OBS_STORE_SEGMENTS`` (retention)."""
+        path = os.environ.get("BIFROMQ_OBS_STORE", "").strip()
+        if not path:
+            return None
+        try:
+            return SegmentStore(
+                path,
+                max_segment_bytes=int(_env_float(
+                    "BIFROMQ_OBS_STORE_SEGMENT_BYTES", float(1 << 20))),
+                max_segments=int(_env_float(
+                    "BIFROMQ_OBS_STORE_SEGMENTS", 8.0)))
+        except (ValueError, OSError) as e:
+            # a bad persistence knob must not abort broker startup
+            import logging
+            logging.getLogger(__name__).error(
+                "telemetry store disabled: %s", e)
+            return None
+
+    def start_persistence(self,
+                          store: Optional[SegmentStore] = None) -> bool:
+        """Refcounted start (same contract as the exporter): the first
+        caller creates the store and hooks the flush onto the advisory
+        tick; returns whether a ref was acquired."""
+        if self.store is None:
+            store = store or self.store_from_env()
+            if store is None:
+                return False
+            self.store = store
+            self.on_advisory_tick(self.persist_now)
+        self._store_refs += 1
+        return True
+
+    def stop_persistence(self, final_flush: bool = True) -> None:
+        if self.store is None:
+            return
+        self._store_refs -= 1
+        if self._store_refs > 0:
+            return
+        self._store_refs = 0
+        self.remove_advisory_hook(self.persist_now)
+        if final_flush:
+            try:
+                self.persist_now()
+            except Exception:  # noqa: BLE001
+                pass
+        self.store = None
+
+    def persist_now(self) -> int:
+        """Flush everything new — profiler batch records, compile-ledger
+        events, slow spans — into the segment store as typed records.
+        Incremental via cursors (the same ``since`` discipline as the
+        push exporter's ring drains); returns records written."""
+        store = self.store
+        if store is None:
+            return 0
+        out = []
+        recs, self._store_prof_cursor, _ = \
+            self.profiler.since(self._store_prof_cursor)
+        for r in recs:
+            out.append({"type": "profile", **r.to_dict()})
+        events = self.profiler.ledger.events()
+        n_new = self.profiler.ledger.total - self._store_ledger_cursor
+        for e in (events[-min(n_new, len(events)):] if n_new > 0 else []):
+            out.append({"type": "compile", **e})
+        self._store_ledger_cursor = self.profiler.ledger.total
+        from .. import trace
+        spans, self._store_slow_cursor, _ = \
+            trace.TRACER.slow_ring.since(self._store_slow_cursor)
+        for s in spans:
+            out.append({"type": "span", **s.to_dict()})
+        if out:
+            # one summary record per flush stamps the aggregate view the
+            # post-hoc reader anchors on; probe=False — this runs on the
+            # broker's event loop every advisory tick and must never
+            # stall behind tunnel round trips (cached RTT only)
+            out.append({"type": "profile_summary",
+                        "resource": self.resource_envelope(),
+                        **self.profiler.snapshot(brief=True,
+                                                 probe=False)})
+        return store.append_many(out)
 
     # ---------------- throttler-advisory tick (ISSUE 4 satellite) ----------
 
@@ -306,6 +429,10 @@ class ObsHub:
         self.windows.reset()
         self.detector.reset()
         self.device.reset()
+        self.profiler.reset()
+        self._store_prof_cursor = 0
+        self._store_slow_cursor = 0
+        self._store_ledger_cursor = 0
 
 
 # the process-global hub every instrumentation site reports into
@@ -314,5 +441,6 @@ OBS = ObsHub()
 __all__ = [
     "OBS", "ObsHub", "TenantSLO", "NoisyNeighborDetector", "DeviceGauges",
     "TelemetryExporter", "FileSink", "HTTPSink", "WindowedCounter",
-    "WindowedLog2Histogram",
+    "WindowedLog2Histogram", "ContinuousProfiler", "CompileLedger",
+    "SegmentStore",
 ]

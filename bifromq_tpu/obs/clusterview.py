@@ -126,6 +126,9 @@ class ClusterView:
                  queue_depth_threshold: Optional[float] = None,
                  interval_s: Optional[float] = None,
                  hysteresis_s: Optional[float] = None,
+                 full_every: Optional[int] = None,
+                 demotion_weights: Optional[Dict[str, float]] = None,
+                 demote_threshold: float = 1.0,
                  clock: Callable[[], float] = time.time) -> None:
         from . import OBS
         self.node_id = node_id
@@ -160,6 +163,42 @@ class ClusterView:
         self._last_bad: Dict[str, float] = {}
         self._clock = clock
         self._unhealthy: frozenset = frozenset()
+        # ISSUE 8 satellite — digest delta encoding: between full
+        # snapshots (every ``full_every`` ticks) only the fields that
+        # CHANGED since the last full are gossiped. Deltas are computed
+        # against the last FULL (not the previous tick), so a consumer
+        # that missed intermediate publishes (gossip metadata is
+        # last-writer-wins, not a stream) can still apply any delta
+        # directly onto its cached full snapshot.
+        self.full_every = (full_every if full_every is not None
+                           else max(1, int(_env_float(
+                               "BIFROMQ_CLUSTER_OBS_FULL_EVERY", 10.0))))
+        self._pub_seq = 0
+        self._full_seq = 0
+        self._last_full: Optional[dict] = None
+        # consumer side: node -> (full_seq, full digest) and the live
+        # reconstructed view (full ⊕ applied delta)
+        self._digest_full: Dict[str, tuple] = {}
+        self._digest_view: Dict[str, dict] = {}
+        self.digest_deltas_applied = 0
+        self.digest_gaps = 0
+        # ISSUE 8 satellite — per-signal demotion weighting: signals
+        # accumulate a score per endpoint instead of boolean-OR'ing, so
+        # two sub-threshold signals (a half-open peer breaker + a
+        # climbing-but-not-deep queue) can demote together while either
+        # alone does not. Defaults reproduce the legacy single-signal
+        # verdicts exactly (each full-strength signal alone reaches the
+        # threshold).
+        self.demote_threshold = demote_threshold
+        self.demotion_weights = {
+            "peer_breaker_open": 1.0,
+            "peer_breaker_half": 0.5,
+            "queue_depth": 1.0,          # × min(2, depth/threshold)
+            "device_breaker_open": 1.0,
+            "device_breaker_half": 1.0,
+            **(demotion_weights or {}),
+        }
+        self.demotion_scores: Dict[str, float] = {}
         # node_id -> (last digest HLC stamp seen, local receipt time):
         # digest age is measured from when WE saw the stamp change, so
         # staleness is immune to inter-node wall-clock skew (a peer 15s
@@ -196,8 +235,18 @@ class ClusterView:
             "noisy": [{"tenant": r["tenant"], "score": r["score"],
                        "flags": r["flags"]}
                       for r in self._noisy_rows()[:3]],
+            # ISSUE 8: compact capacity accounting rides the digest so
+            # GET /cluster/capacity federates with no extra RPC plane
+            "capacity": self._capacity_field(),
         }
         return digest
+
+    def _capacity_field(self) -> dict:
+        try:
+            from .capacity import digest_capacity
+            return digest_capacity(self.hub)
+        except Exception:  # noqa: BLE001 — telemetry must not raise
+            return {}
 
     def _noisy_rows(self) -> list:
         """Ranked rows for the digest: reuse the advisory tick's fresh
@@ -248,18 +297,80 @@ class ClusterView:
             return 0.0
 
     def refresh(self) -> None:
-        """Publish a fresh digest into the gossip agent metadata (bumping
-        the member incarnation so peers merge it) and recompute the
-        unhealthy set from what peers have gossiped back."""
+        """Publish a fresh digest (full or delta — see ``_publish_meta``)
+        into the gossip agent metadata (bumping the member incarnation so
+        peers merge it) and recompute the unhealthy set from what peers
+        have gossiped back."""
         try:
-            self.agent_host.host_agent(AGENT_ID, {
-                "addr": self.rpc_address,
-                "api": self.api_port,
-                "digest": self.build_digest(),
-            })
+            self.agent_host.host_agent(AGENT_ID, self._publish_meta())
         except Exception:  # noqa: BLE001 — telemetry must not raise
             log.exception("digest publish failed")
         self._recompute()
+
+    def _publish_meta(self) -> dict:
+        """Delta-encoded digest publication (ISSUE 8 satellite): a full
+        snapshot every ``full_every`` ticks, otherwise only the top-level
+        fields that changed since the last full (the HLC stamp always
+        changes — it is the freshness signal — but a steady node's
+        breakers/device/noisy/capacity sections stop riding every UDP
+        gossip packet)."""
+        digest = self.build_digest()
+        self._pub_seq += 1
+        meta = {"addr": self.rpc_address, "api": self.api_port,
+                "seq": self._pub_seq}
+        if (self._last_full is None or self.full_every <= 1
+                or self._pub_seq - self._full_seq >= self.full_every):
+            meta["digest"] = digest
+            self._last_full = digest
+            self._full_seq = self._pub_seq
+        else:
+            meta["digest_delta"] = {
+                k: v for k, v in digest.items()
+                if self._last_full.get(k) != v}
+            meta["base_seq"] = self._full_seq
+        return meta
+
+    def _decode_digest(self, node: str, meta: Optional[dict]) -> dict:
+        """Reconstruct a peer's digest from full-or-delta metadata.
+        A delta applies only when we hold its base full snapshot; on a
+        gap (we joined after the base was published, or the base was
+        overwritten before we gossiped it in) the last good view keeps
+        serving — it ages out naturally via ``digest_age_s`` if the gap
+        persists — and the next full snapshot repairs the chain."""
+        meta = meta or {}
+        full = meta.get("digest")
+        if full is not None:
+            if meta.get("seq") is not None:
+                self._digest_full[node] = (meta["seq"], full)
+            self._digest_view[node] = full
+            return full
+        delta = meta.get("digest_delta")
+        if delta is not None:
+            cached = self._digest_full.get(node)
+            if cached is not None and cached[0] == meta.get("base_seq"):
+                view = {**cached[1], **delta}
+                self._digest_view[node] = view
+                self.digest_deltas_applied += 1
+                return view
+            # GAP: we never saw this delta's base full (gossip metadata
+            # is last-writer-wins — the one tick holding the full can be
+            # overwritten before we sample it). The delta's VALUES are
+            # still current-absolute (it lists fields that differ from
+            # the publisher's last full), so apply it best-effort onto
+            # whatever view we hold: freshness (the hlc field, always in
+            # the delta) keeps advancing — an alive, gossiping peer must
+            # not age out as stale just because we missed one full —
+            # while any field that changed since OUR base but matches
+            # THEIR base stays ≤ one full cycle behind, until the next
+            # full snapshot resyncs the chain exactly.
+            self.digest_gaps += 1
+            prev = self._digest_view.get(node)
+            if prev is not None:
+                view = {**prev, **delta}
+                self._digest_view[node] = view
+                return view
+            return {}
+        return {}
 
     # ---------------- peers (consumer side) ----------------------------------
 
@@ -287,7 +398,7 @@ class ClusterView:
         for node, meta in members.items():
             if node == self.node_id and not include_self:
                 continue
-            digest = (meta or {}).get("digest") or {}
+            digest = self._decode_digest(node, meta)
             age = self.digest_age_s(node, digest)
             out[node] = {
                 "addr": (meta or {}).get("addr", ""),
@@ -299,6 +410,9 @@ class ClusterView:
         # receipt entries for departed members must not pin forever
         for node in [n for n in self._digest_seen if n not in members]:
             del self._digest_seen[node]
+        for cache in (self._digest_full, self._digest_view):
+            for node in [n for n in cache if n not in members]:
+                del cache[node]
         return out
 
     def cluster_table(self) -> Dict[str, dict]:
@@ -327,30 +441,56 @@ class ClusterView:
         """Rebuild the cached unhealthy-endpoint set from fresh peer
         digests. Called on the advisory tick and on gossip membership
         change — never from ``suspect`` (the pick hot path)."""
-        bad = set()
         try:
+            # ISSUE 8 satellite — per-signal weighted scoring: each
+            # signal contributes its weight to the endpoint's score and
+            # the endpoint demotes at ``demote_threshold``, instead of
+            # any single signal boolean-OR'ing it out. Defaults keep
+            # every legacy verdict (each full-strength signal alone
+            # crosses the threshold) while letting sub-threshold signals
+            # combine: a half-open peer breaker (0.5) plus a queue at
+            # 60% of the brown-out depth (0.6) now demotes.
+            w = self.demotion_weights
+            scores: Dict[str, float] = {}
+
+            def bump(ep: str, amount: float) -> None:
+                if ep and amount > 0:
+                    scores[ep] = scores.get(ep, 0.0) + amount
+
             for node, p in self.peers().items():
                 if p["stale"]:
                     continue
                 digest = p["digest"]
-                # another node's circuit to an endpoint is OPEN: demote
-                # it here before our own breaker has to trip
+                # another node's circuit to an endpoint: OPEN is a full
+                # vote, HALF_OPEN (still probing) a partial one
                 for ep, state in (digest.get("breakers") or {}).items():
                     if state == "open":
-                        bad.add(ep)
-                # the node itself reports a browned-out device pipeline —
-                # a deep dispatch queue, or (ISSUE 7) a non-closed DEVICE
-                # breaker: the node is serving, but oracle-degraded, so
-                # peers with a healthy accelerator should be ranked first
+                        bump(ep, w["peer_breaker_open"])
+                    elif state == "half_open":
+                        bump(ep, w["peer_breaker_half"])
+                # the node itself reports a browning-out device pipeline:
+                # queue depth scores proportionally (capped at 2× so one
+                # signal saturates instead of dwarfing the rest), and
+                # (ISSUE 7) a non-closed DEVICE breaker means the node
+                # serves oracle-degraded — healthy accelerators first
                 dev = digest.get("device") or {}
-                if p["addr"] and (
-                        dev.get("dispatch_queue_depth", 0)
-                        >= self.queue_depth_threshold
-                        or dev.get("breaker") in ("open", "half_open")):
-                    bad.add(p["addr"])
+                if p["addr"]:
+                    depth = dev.get("dispatch_queue_depth", 0)
+                    if depth > 0 and self.queue_depth_threshold > 0:
+                        bump(p["addr"], w["queue_depth"] * min(
+                            2.0, depth / self.queue_depth_threshold))
+                    db = dev.get("breaker")
+                    if db == "open":
+                        bump(p["addr"], w["device_breaker_open"])
+                    elif db == "half_open":
+                        bump(p["addr"], w["device_breaker_half"])
             # never let gossip rumors blackhole OUR OWN endpoint for the
             # local picker: local breakers already own that verdict
-            bad.discard(self.rpc_address)
+            scores.pop(self.rpc_address, None)
+            self.demotion_scores = {ep: round(s, 3)
+                                    for ep, s in scores.items()}
+            bad = {ep for ep, s in scores.items()
+                   if s >= self.demote_threshold}
             # ISSUE 7 satellite — demotion hysteresis: an endpoint leaves
             # the unhealthy set only after a full cooldown of CONSECUTIVE
             # healthy observations; any bad sighting restarts the clock,
@@ -524,6 +664,29 @@ class ClusterView:
                 "complete": not wrapped,
                 "rings_wrapped": wrapped,
                 "spans": spans}
+
+    def capacity_table(self) -> dict:
+        """``GET /cluster/capacity`` (ISSUE 8): per-node device capacity
+        federated from the gossiped digests — automaton table bytes,
+        memory watermarks, fused-VMEM verdicts — plus cluster totals.
+        Pure digest reads: no scatter-gather RPC, a dead node's row just
+        goes stale with its digest."""
+        from .capacity import digest_capacity
+        rows: Dict[str, dict] = {}
+        local = digest_capacity(self.hub)
+        rows[self.node_id] = {"capacity": local, "stale": False,
+                              "self": True}
+        total = int(local.get("table_bytes", 0))
+        peak = int(local.get("mem_peak_bytes", 0))
+        for node, p in self.peers().items():
+            cap = (p["digest"] or {}).get("capacity") or {}
+            rows[node] = {"capacity": cap, "stale": p["stale"]}
+            if not p["stale"]:
+                total += int(cap.get("table_bytes", 0))
+                peak = max(peak, int(cap.get("mem_peak_bytes", 0)))
+        return {"nodes": rows,
+                "total_table_bytes": total,
+                "max_mem_peak_bytes": peak}
 
     # ---------------- lifecycle ----------------------------------------------
 

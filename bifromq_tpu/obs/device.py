@@ -36,6 +36,11 @@ class DeviceGauges:
         """Track a TpuMatcher's compile count/time (weakly held)."""
         self._matchers.add(matcher)
 
+    def matchers(self) -> list:
+        """Live registered matchers (ISSUE 8: the capacity model walks
+        their installed bases for byte accounting)."""
+        return list(self._matchers)
+
     def register_scheduler(self, scheduler) -> None:
         """Track a BatchCallScheduler's live queue depth (weakly held)."""
         self._schedulers.add(scheduler)
@@ -127,6 +132,11 @@ class DeviceGauges:
             for b in list(getattr(sched, "_batchers", {}).values()):
                 depth += len(getattr(b, "_queue", ()))
         return depth
+
+    def memory_stats(self) -> dict:
+        """Public guarded memory probe (ISSUE 8: the capacity planner's
+        HBM-limit source) — TTL-cached, never triggers backend init."""
+        return self._memory_stats()
 
     def _memory_stats(self) -> dict:
         now = self._clock()

@@ -33,6 +33,96 @@ EXPORT_RETRY = RetryPolicy(max_attempts=3, base_delay=0.05, max_delay=1.0)
 # line format changes shape, so federated sinks can route per version
 SCHEMA_VERSION = "bifromq-tpu.telemetry/1"
 
+# ---------------------------------------------------------------------------
+# OTLP-JSON framing (ISSUE 8 satellite: BIFROMQ_OBS_FORMAT=otlp|jsonl)
+#
+# The jsonl mode ships our native records; otlp mode re-frames each flush
+# batch into OpenTelemetry protocol JSON envelopes — spans into
+# resourceSpans, metric snapshots flattened into resourceMetrics gauges,
+# anything else into resourceLogs — so a stock OTLP collector ingests the
+# exporter's stream without a custom shim. The resource envelope
+# (node_id / cluster_id / schema_version) maps onto OTLP resource
+# attributes; scripts/otlp_schema.json pins the emitted shape and the
+# profile_check.sh gate validates against it.
+# ---------------------------------------------------------------------------
+
+_OTLP_SCOPE = {"name": "bifromq_tpu", "version": SCHEMA_VERSION}
+_OTLP_METRIC_CAP = 512      # flattened gauges per metrics record
+
+
+def _otlp_resource(resource: Optional[Dict]) -> dict:
+    from ..trace.span import otlp_attributes
+    attrs = {f"bifromq.{k}": v for k, v in (resource or {}).items()}
+    attrs.setdefault("service.name", "bifromq_tpu")
+    return {"attributes": otlp_attributes(attrs)}
+
+
+def _flatten_numeric(prefix: str, obj, out: List[tuple]) -> None:
+    if len(out) >= _OTLP_METRIC_CAP:
+        return
+    if isinstance(obj, bool):
+        return
+    if isinstance(obj, (int, float)):
+        out.append((prefix, float(obj)))
+    elif isinstance(obj, dict):
+        for k, v in obj.items():
+            _flatten_numeric(f"{prefix}.{k}" if prefix else str(k), v, out)
+
+
+def _otlp_metrics(rec: dict, ts: float) -> List[dict]:
+    ns = str(int(ts * 1e9))
+    leaves: List[tuple] = []
+    for k, v in rec.items():
+        if k in ("type", "ts", "resource"):
+            continue
+        _flatten_numeric(k, v, leaves)
+    return [{"name": name,
+             "gauge": {"dataPoints": [{"asDouble": val,
+                                       "timeUnixNano": ns}]}}
+            for name, val in leaves]
+
+
+def otlp_frame(records: List[Dict],
+               resource: Optional[Dict]) -> List[str]:
+    """Frame one flush batch as OTLP-JSON lines: one resourceSpans
+    envelope for the spans, one resourceMetrics for the metric
+    snapshots, one resourceLogs for everything else."""
+    from ..trace.span import otlp_attributes, otlp_span_from_dict
+    res = _otlp_resource(resource)
+    spans, metrics, logs = [], [], []
+    for rec in records:
+        kind = rec.get("type")
+        if kind == "span":
+            spans.append(otlp_span_from_dict(rec))
+        elif kind == "metrics":
+            metrics.extend(_otlp_metrics(rec, rec.get("ts", 0.0)))
+        else:
+            logs.append({
+                "timeUnixNano": str(int(rec.get("ts", 0.0) * 1e9)),
+                "body": {"stringValue": json.dumps(
+                    {k: v for k, v in rec.items() if k != "resource"},
+                    default=str)},
+                "attributes": otlp_attributes(
+                    {"type": kind or "record"}),
+            })
+    lines = []
+    if spans:
+        lines.append(json.dumps({"resourceSpans": [{
+            "resource": res,
+            "scopeSpans": [{"scope": _OTLP_SCOPE, "spans": spans}],
+        }]}, default=str))
+    if metrics:
+        lines.append(json.dumps({"resourceMetrics": [{
+            "resource": res,
+            "scopeMetrics": [{"scope": _OTLP_SCOPE, "metrics": metrics}],
+        }]}, default=str))
+    if logs:
+        lines.append(json.dumps({"resourceLogs": [{
+            "resource": res,
+            "scopeLogs": [{"scope": _OTLP_SCOPE, "logRecords": logs}],
+        }]}, default=str))
+    return lines
+
 
 class FileSink:
     """Append JSON lines to a local file (fsync-free: the OS page cache is
@@ -107,8 +197,14 @@ class TelemetryExporter:
                  export_sampled: bool = False,
                  retry: RetryPolicy = EXPORT_RETRY,
                  resource: Optional[Dict] = None,
+                 framing: str = "jsonl",
                  clock: Callable[[], float] = time.time) -> None:
+        if framing not in ("jsonl", "otlp"):
+            raise ValueError(f"unknown telemetry framing {framing!r}")
         self.sink = sink
+        # ISSUE 8 satellite: jsonl ships native records; otlp re-frames
+        # each flush batch into OTLP-JSON envelopes (see otlp_frame)
+        self.framing = framing
         self.interval_s = interval_s
         self.queue_cap = queue_cap
         self.batch_max = batch_max
@@ -208,7 +304,10 @@ class TelemetryExporter:
             batch = []
             while self._queue and len(batch) < self.batch_max:
                 batch.append(self._queue.popleft())
-            lines = [json.dumps(r, default=str) for r in batch]
+            if self.framing == "otlp":
+                lines = otlp_frame(batch, self.resource)
+            else:
+                lines = [json.dumps(r, default=str) for r in batch]
             attempt = 0
             try:
                 while True:
@@ -274,6 +373,7 @@ class TelemetryExporter:
 
     def snapshot(self) -> dict:
         return {"sink": self.sink.describe(),
+                "framing": self.framing,
                 "resource": self.resource,
                 "interval_s": self.interval_s,
                 "queue_depth": len(self._queue),

@@ -1,0 +1,315 @@
+"""Always-on continuous profiler for the device match path (ISSUE 8
+tentpole, part 2).
+
+PR 6's async pipeline left "where do the microseconds go between
+dispatch and fetch on a ~70ms-RTT tunnel" answerable only by an offline
+bench run. This module keeps the answer live, at a cost the pipelined
+path cannot feel (<2% — the recording site is a handful of attribute
+increments plus one ring store, the ``SpanRing`` discipline: GIL-atomic
+enough for telemetry, no locks, no allocation beyond the record):
+
+- **Per-batch stage decomposition.** Every device batch (sync or async)
+  records its dispatch / ready / fetch / expand seconds plus batch
+  geometry (queries vs padded rows) and the kernel that served it. The
+  snapshot splits the wall time into a tunnel-RTT estimate (a tiny
+  TTL-cached scalar round trip, same guarded-probe discipline as the
+  memory watermarks — CPU pays microseconds, the axon tunnel ~70ms) and
+  the residual device-kernel time, so CPU-fallback and real-TPU records
+  stay comparable.
+- **Efficiency counters.** Padding waste (pow2 pad rows that walk for
+  nothing), in-batch dedup savings and cache-hit bypasses (rows that
+  never reached the device), batcher emit occupancy, and degraded
+  serves by reason.
+- **Compile-event ledger.** Every base install is attributable: what
+  triggered it (first_base / threshold / forced / refresh), how long the
+  compile ran, the table salt, node count, table bytes, the fused VMEM
+  verdict, and whether it bumped the match-cache generation — so a
+  rebuild storm reads as a sequence of causes, not a mystery latency
+  cliff.
+
+Records drain into the bounded segment store (``obs.segstore``) via
+``since()`` cursors for post-hoc analysis after a TPU session ends.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from ..trace.recorder import SpanRing
+
+
+class BatchRecord:
+    """One device batch's profile. Plain slots — built once per batch on
+    the serving path, so no dataclass/dict overhead."""
+
+    __slots__ = ("ts", "n_queries", "batch", "kernel", "path",
+                 "dispatch_s", "ready_s", "fetch_s", "expand_s",
+                 "degraded")
+
+    def __init__(self, ts, n_queries, batch, kernel, path, dispatch_s,
+                 ready_s, fetch_s, expand_s, degraded) -> None:
+        self.ts = ts
+        self.n_queries = n_queries
+        self.batch = batch
+        self.kernel = kernel
+        self.path = path
+        self.dispatch_s = dispatch_s
+        self.ready_s = ready_s
+        self.fetch_s = fetch_s
+        self.expand_s = expand_s
+        self.degraded = degraded
+
+    def to_dict(self) -> dict:
+        return {"ts": round(self.ts, 3), "n_queries": self.n_queries,
+                "batch": self.batch, "kernel": self.kernel,
+                "path": self.path,
+                "dispatch_ms": round(self.dispatch_s * 1e3, 4),
+                "ready_ms": round(self.ready_s * 1e3, 4),
+                "fetch_ms": round(self.fetch_s * 1e3, 4),
+                "expand_ms": round(self.expand_s * 1e3, 4),
+                "degraded": self.degraded}
+
+
+class CompileLedger:
+    """Bounded ledger of base-install events (ISSUE 8: rebuild storms
+    must be attributable). Appended from the matcher's install path —
+    once per compile, so a deque with a lock-free append is plenty."""
+
+    CAP = 256
+
+    def __init__(self, clock: Callable[[], float] = time.time) -> None:
+        self._clock = clock
+        self._events: deque = deque(maxlen=self.CAP)
+        self.total = 0
+        self.total_compile_s = 0.0
+        self.generation_bumps = 0
+
+    def record(self, *, reason: str, duration_s: float, salt,
+               n_nodes: int, table_bytes: int,
+               vmem_fits: Optional[bool],
+               generation_bumped: bool, kind: str = "single") -> None:
+        self.total += 1
+        self.total_compile_s += duration_s
+        if generation_bumped:
+            self.generation_bumps += 1
+        self._events.append({
+            "ts": round(self._clock(), 3),
+            "reason": reason,
+            "compile_s": round(duration_s, 4),
+            "salt": salt,
+            "n_nodes": n_nodes,
+            "table_bytes": table_bytes,
+            "vmem_fits": vmem_fits,
+            "generation_bumped": generation_bumped,
+            "kind": kind,
+        })
+
+    def events(self, limit: int = 0) -> List[dict]:
+        evs = list(self._events)
+        return evs[-limit:] if limit > 0 else evs
+
+    def snapshot(self, limit: int = 16) -> dict:
+        return {"total": self.total,
+                "total_compile_s": round(self.total_compile_s, 3),
+                "generation_bumps": self.generation_bumps,
+                "events": self.events(limit)}
+
+    def reset(self) -> None:
+        self._events.clear()
+        self.total = 0
+        self.total_compile_s = 0.0
+        self.generation_bumps = 0
+
+
+def _pctl(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
+class ContinuousProfiler:
+    RING_CAP = 2048
+    RTT_PROBE_TTL_S = 30.0
+
+    def __init__(self, clock: Callable[[], float] = time.time) -> None:
+        self._clock = clock
+        # the tracer's fixed-slot ring is record-type-agnostic — reuse
+        # it (record/spans/since cursor math in ONE place) rather than
+        # re-deriving the wrap/missed arithmetic here
+        self._ring = SpanRing(self.RING_CAP)
+        self.ledger = CompileLedger(clock=clock)
+        # counters (monotonic; plain int adds on the hot path)
+        self.batches_total = 0
+        self.queries_total = 0
+        self.padded_rows_total = 0
+        self.cache_hits_total = 0
+        self.dedup_saved_total = 0
+        self.frontend_queries_total = 0
+        self.degraded_total: Dict[str, int] = {}
+        self.emits_total = 0
+        self.emit_calls_total = 0
+        self.emit_cap_total = 0
+        self.emit_depth_total = 0
+        # tunnel-RTT probe cache (guarded: never triggers backend init)
+        self._rtt_ms: Optional[float] = None
+        self._rtt_at = -1e18
+
+    # ---------------- hot-path recording (the <2% budget) ------------------
+
+    def record_batch(self, *, n_queries: int, batch: int, kernel: str,
+                     dispatch_s: float, ready_s: float = 0.0,
+                     fetch_s: float = 0.0, expand_s: float = 0.0,
+                     path: str = "async",
+                     degraded: Optional[str] = None) -> None:
+        self.batches_total += 1
+        self.queries_total += n_queries
+        self.padded_rows_total += max(0, batch - n_queries)
+        if degraded is not None:
+            self.degraded_total[degraded] = \
+                self.degraded_total.get(degraded, 0) + 1
+        self._ring.record(BatchRecord(
+            self._clock(), n_queries, batch, kernel, path,
+            dispatch_s, ready_s, fetch_s, expand_s, degraded))
+
+    def record_frontend(self, n_queries: int, hits: int,
+                        dedup_saved: int) -> None:
+        """Cache-plane bypasses: rows that never reached the device."""
+        self.frontend_queries_total += n_queries
+        self.cache_hits_total += hits
+        self.dedup_saved_total += dedup_saved
+
+    def record_emit(self, batch_size: int, cap: int, depth: int) -> None:
+        """Batcher emit occupancy (scheduler side of padding waste: a
+        batch far under its adaptive cap pads more downstream) plus the
+        queue depth observed at emit (the saturation signal _adapt
+        keys on)."""
+        self.emits_total += 1
+        self.emit_calls_total += batch_size
+        self.emit_cap_total += cap
+        self.emit_depth_total += depth
+
+    # ---------------- snapshots --------------------------------------------
+
+    def records(self, limit: int = 0) -> List[BatchRecord]:
+        out = self._ring.spans()        # oldest first (generic ring)
+        return out[-limit:] if limit > 0 else out
+
+    def since(self, cursor: int):
+        """Records after write-counter ``cursor`` (oldest first), the new
+        cursor, and how many were overwritten unread — the segment
+        store's incremental drain (``SpanRing.since``'s contract,
+        verbatim, because it IS that implementation)."""
+        return self._ring.since(cursor)
+
+    def rtt_probe_ms(self, *, force: bool = False) -> Optional[float]:
+        """Median of 4 tiny scalar device round trips — the transport
+        cost a sync readback pays (axon tunnel ~70ms, CPU ~µs). TTL
+        cached; NEVER triggers backend init (a dead tunnel would hang
+        it), so it returns None until real device work has run."""
+        now = self._clock()
+        if not force and now - self._rtt_at < self.RTT_PROBE_TTL_S:
+            return self._rtt_ms
+        self._rtt_at = now
+        try:
+            import sys
+            if "jax" not in sys.modules:
+                raise LookupError("jax not loaded")
+            import jax
+            from jax._src import xla_bridge as _xb
+            if not getattr(_xb, "_backends", None):
+                raise LookupError("jax backend not initialized")
+            import numpy as np
+            samples = []
+            for _ in range(4):
+                t0 = time.perf_counter()
+                np.asarray(jax.device_put(np.zeros(1, np.int32)))
+                samples.append(time.perf_counter() - t0)
+            samples.sort()
+            self._rtt_ms = round(samples[len(samples) // 2] * 1e3, 4)
+        except Exception:  # noqa: BLE001 — tunnel down / jax absent
+            self._rtt_ms = None
+        return self._rtt_ms
+
+    def split_snapshot(self, *, probe: bool = True) -> dict:
+        """The rtt/kernel decomposition over the retained ring: stage
+        p50/p99 plus the tunnel-RTT estimate and the residual kernel
+        time (ready-wait minus transport). ``probe=False`` uses only
+        the cached RTT (never touches the device) — the advisory-tick
+        persistence path runs on the broker's event loop and must not
+        stall it behind 4 tunnel round trips; operator-initiated
+        scrapes (``GET /profile``, bench) pay the TTL-cached probe."""
+        recs = self.records()
+        out: Dict[str, object] = {"window_batches": len(recs)}
+        for stage in ("dispatch_s", "ready_s", "fetch_s", "expand_s"):
+            vals = sorted(getattr(r, stage) for r in recs)
+            key = stage[:-2]
+            out[f"{key}_ms_p50"] = round(_pctl(vals, 0.50) * 1e3, 4)
+            out[f"{key}_ms_p99"] = round(_pctl(vals, 0.99) * 1e3, 4)
+        rtt = self.rtt_probe_ms() if probe else self._rtt_ms
+        out["tunnel_rtt_ms"] = rtt
+        ready_p50 = out["ready_ms_p50"]
+        fetch_p50 = out["fetch_ms_p50"]
+        if rtt is not None:
+            # the ready wait covers kernel compute + the readiness
+            # round trip; the fetch pays the final host copy
+            out["device_kernel_ms_est"] = round(
+                max(0.0, ready_p50 + fetch_p50 - rtt), 4)
+        else:
+            out["device_kernel_ms_est"] = round(ready_p50 + fetch_p50, 4)
+        kernels: Dict[str, int] = {}
+        for r in recs:
+            kernels[r.kernel] = kernels.get(r.kernel, 0) + 1
+        out["kernels"] = kernels
+        return out
+
+    def snapshot(self, *, brief: bool = False,
+                 probe: bool = True) -> dict:
+        walked = self.queries_total
+        padded = self.padded_rows_total
+        fe = self.frontend_queries_total
+        out = {
+            "batches": self.batches_total,
+            "queries": walked,
+            "padding_waste_ratio": round(
+                padded / max(1, walked + padded), 4),
+            "cache_bypass_rate": round(
+                self.cache_hits_total / max(1, fe), 4),
+            "dedup_saved": self.dedup_saved_total,
+            "degraded": dict(self.degraded_total),
+            "split": self.split_snapshot(probe=probe),
+            "compile_ledger": self.ledger.snapshot(
+                limit=4 if brief else 16),
+        }
+        if not brief:
+            out["emit"] = {
+                "batches": self.emits_total,
+                "avg_batch": round(self.emit_calls_total
+                                   / max(1, self.emits_total), 2),
+                "avg_cap": round(self.emit_cap_total
+                                 / max(1, self.emits_total), 2),
+                "avg_depth_at_emit": round(self.emit_depth_total
+                                           / max(1, self.emits_total),
+                                           2),
+            }
+            out["recent"] = [r.to_dict() for r in self.records(8)]
+        return out
+
+    def reset(self) -> None:
+        self._ring.clear()
+        self.ledger.reset()
+        self.batches_total = 0
+        self.queries_total = 0
+        self.padded_rows_total = 0
+        self.cache_hits_total = 0
+        self.dedup_saved_total = 0
+        self.frontend_queries_total = 0
+        self.degraded_total = {}
+        self.emits_total = 0
+        self.emit_calls_total = 0
+        self.emit_cap_total = 0
+        self.emit_depth_total = 0
+        self._rtt_ms = None
+        self._rtt_at = -1e18

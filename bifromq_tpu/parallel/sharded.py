@@ -98,6 +98,14 @@ class ShardedTables:
     def root_of(self, tenant_id: str) -> int:
         return self.compiled[self.shard_of(tenant_id)].root_of(tenant_id)
 
+    def device_bytes(self) -> Dict[str, object]:
+        """Per-shard HBM accounting (ISSUE 8): exact bytes of the stacks
+        ``MeshMatcher._compile_shadow`` actually uploads (node_tab never
+        ships), each shard's padded slice next to its real rows — the
+        capacity plane the multi-chip ROADMAP item lands against."""
+        from ..obs.capacity import sharded_tables_device_bytes
+        return sharded_tables_device_bytes(self)
+
 
 def build_sharded(tries: Dict[str, SubscriptionTrie], n_shards: int, *,
                   max_levels: int = 16, probe_len: int = 16,
@@ -337,6 +345,8 @@ class MeshMatcher(TpuMatcher):
     # ---------------- compile target: sharded tables on the mesh -----------
 
     def _compile_shadow(self) -> Tuple[ShardedTables, tuple]:
+        import time as _time
+        t0 = _time.perf_counter()
         self.compile_count += 1
         tables = build_sharded(self._shadow, self.n_shards,
                                max_levels=self.max_levels,
@@ -347,6 +357,11 @@ class MeshMatcher(TpuMatcher):
         dev = (jax.device_put(tables.edge_tab, self._table_sharding),
                jax.device_put(tables.child_list, self._table_sharding),
                jax.device_put(tables.route_tab, self._table_sharding))
+        # ISSUE 8: the mesh plane now feeds the same compile accounting
+        # (time + ledger attribution via _install_base) as single-chip —
+        # it previously counted compiles but never their wall time
+        self._last_compile_s = _time.perf_counter() - t0
+        self.compile_time_s += self._last_compile_s
         return tables, dev
 
     # ---------------- load-driven shard re-placement ------------------------
@@ -450,12 +465,24 @@ class MeshMatcher(TpuMatcher):
                 roots[rep, sh] = tk.roots
                 sys_mask[rep, sh] = tk.sys_mask
 
+        import time as _time
+        t_disp = _time.perf_counter()
         ivl_s, ivl_c, _n_routes, overflow, _total = self._step(
             dev_edge, dev_child, dev_route,
             tok_h1, tok_h2, lengths, roots, sys_mask)
+        t_fetch = _time.perf_counter()
         ivl_s = np.asarray(ivl_s)       # [R, S, B, A]
         ivl_c = np.asarray(ivl_c)
         overflow = np.asarray(overflow)
+        t_done = _time.perf_counter()
+        # ISSUE 8: the mesh walk feeds the same per-batch profile stream
+        # as the single-chip paths (kernel tag distinguishes it); padded
+        # rows = the full [R,S,B] grid minus the real queries
+        from ..obs import OBS
+        OBS.profiler.record_batch(
+            n_queries=len(queries), batch=r * s * b, kernel="mesh",
+            dispatch_s=t_fetch - t_disp, fetch_s=t_done - t_fetch,
+            path="sync")
         # one vectorized expansion for the whole [R*S*B] grid
         a = ivl_s.shape[-1]
         flat_slots, flat_offs = expand_intervals(

@@ -218,6 +218,13 @@ class Batcher(Generic[CallT, ResultT]):
                 results = await self._process(calls)
             elapsed = self._clock() - start
             self._adapt(len(calls), elapsed, depth_at_emit)
+            if self._stage is not None:
+                # ISSUE 8: emit occupancy for the continuous profiler
+                # (the scheduler-side half of padding waste: a batch far
+                # under its adaptive cap pads more downstream) — three
+                # int adds, serving batchers only
+                OBS.profiler.record_emit(len(calls), self._cap,
+                                         depth_at_emit)
             for b, res in zip(batch, results):
                 fut = b[1]
                 if not fut.done():

@@ -361,3 +361,116 @@ class TestTraceEndpoints:
         for stage in ("queue_wait", "device", "deliver"):
             assert stages.get(stage, {}).get("count", 0) >= 1, stages
             assert "p50_ms" in stages[stage] and "p99_ms" in stages[stage]
+
+
+async def http_with_headers(port, method, path, body=b""):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(
+        f"{method} {path} HTTP/1.1\r\nhost: x\r\n"
+        f"content-length: {len(body)}\r\nconnection: close\r\n\r\n".encode()
+        + body)
+    await writer.drain()
+    raw = await reader.read(262144)
+    writer.close()
+    head, _, payload = raw.partition(b"\r\n\r\n")
+    lines = head.decode().split("\r\n")
+    status = int(lines[0].split(" ")[1])
+    headers = {}
+    for ln in lines[1:]:
+        k, _, v = ln.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    return status, json.loads(payload), headers
+
+
+class TestCapacityProfileAPI:
+    """ISSUE 8: the capacity & continuous-profiling plane end to end
+    over real HTTP."""
+
+    async def test_capacity_reports_parity_and_planner(self, stack):
+        broker, api, _ = stack
+        sub = MQTTClient(port=broker.port, client_id="cap1")
+        await sub.connect()
+        await sub.subscribe("cap/+")
+        # a publish forces a match → an installed base to account
+        status, _ = await http(api.port, "PUT",
+                               "/pub?tenant_id=DevOnly&topic=cap/x",
+                               b"x")
+        assert status == 200
+        status, out = await http(api.port, "GET", "/capacity")
+        assert status == 200
+        assert out["table_bytes"] > 0
+        # acceptance: planner-vs-live parity within 10% on CPU
+        assert out["parity_error"] < 0.10
+        assert any(r.get("installed") for r in out["matchers"])
+        await sub.disconnect()
+
+    async def test_capacity_fits_verdict_without_dispatch(self, stack):
+        _, api, _ = stack
+        status, out = await http(api.port, "GET",
+                                 "/capacity?n_subs=1000000")
+        assert status == 200
+        fv = out["fits"]["fused_vmem"]
+        # acceptance: the 1M-sub table fails the 12MB VMEM gate, judged
+        # from the model alone (nothing was built or dispatched)
+        assert fv["fits"] is False
+        assert fv["table_bytes"] > fv["budget_bytes"]
+        status, out = await http(api.port, "GET",
+                                 "/capacity?n_subs=1000&shards=4")
+        assert out["fits"]["mesh"]["shards"] == 4
+
+    async def test_profile_serves_split_and_ledger(self, stack):
+        broker, api, _ = stack
+        sub = MQTTClient(port=broker.port, client_id="prof1")
+        await sub.connect()
+        await sub.subscribe("prof/+")
+        await http(api.port, "PUT",
+                   "/pub?tenant_id=DevOnly&topic=prof/x", b"x")
+        status, out = await http(api.port, "GET", "/profile")
+        assert status == 200
+        assert out["batches"] >= 1
+        assert "dispatch_ms_p50" in out["split"]
+        assert "device_kernel_ms_est" in out["split"]
+        assert out["compile_ledger"]["total"] >= 1
+        ev = out["compile_ledger"]["events"][-1]
+        assert {"reason", "compile_s", "salt", "table_bytes",
+                "vmem_fits"} <= set(ev)
+        await sub.disconnect()
+
+    async def test_cluster_capacity_standalone(self, stack):
+        _, api, _ = stack
+        status, out = await http(api.port, "GET", "/cluster/capacity")
+        assert status == 200
+        assert len(out["nodes"]) == 1
+        (row,) = out["nodes"].values()
+        assert row["self"] is True and row["stale"] is False
+
+    async def test_cluster_tenants_cached_with_max_age_header(self, stack):
+        broker, api, _ = stack
+        status, out1, hdr = await http_with_headers(
+            api.port, "GET", "/cluster/tenants")
+        assert status == 200
+        assert hdr["cache-control"].startswith("max-age=")
+        assert float(hdr["age"]) == 0.0
+        assert out1["cache"]["age_s"] == 0.0
+        # second hit inside the TTL serves the cache (age advances)
+        status, out2, hdr2 = await http_with_headers(
+            api.port, "GET", "/cluster/tenants")
+        assert out2["cache"]["age_s"] >= 0.0
+        assert out2["tenants"] == out1["tenants"]
+        # ?max_age_s=0 forces a refresh
+        status, out3, hdr3 = await http_with_headers(
+            api.port, "GET", "/cluster/tenants?max_age_s=0")
+        assert out3["cache"]["age_s"] == 0.0
+
+    async def test_cluster_tenants_top_k_filters_cached_rows(self, stack):
+        broker, api, registry = stack
+        from bifromq_tpu.obs import OBS
+        OBS.record_flow("hot", 50)
+        OBS.record_flow("warm", 5)
+        status, out, _ = await http_with_headers(
+            api.port, "GET", "/cluster/tenants?max_age_s=0")
+        n_all = len(out["tenants"])
+        if n_all >= 2:
+            status, out1, _ = await http_with_headers(
+                api.port, "GET", "/cluster/tenants?top_k=1")
+            assert len(out1["tenants"]) == 1

@@ -1,0 +1,193 @@
+"""Device capacity model & planner (ISSUE 8): model-vs-live byte parity
+on the CPU backend, planner calibration round trips, the fused-VMEM
+verdict reproducing the serving gate's comparison without a dispatch,
+mesh per-shard accounting, and the federated capacity surfaces."""
+
+import pytest
+
+from bifromq_tpu.models.matcher import TpuMatcher
+from bifromq_tpu.models.oracle import Route
+from bifromq_tpu.obs import OBS, ObsHub
+from bifromq_tpu.obs import capacity as cap
+from bifromq_tpu.types import RouteMatcher
+
+
+def mk_route(tf: str, rid: str) -> Route:
+    return Route(matcher=RouteMatcher.from_topic_filter(tf), broker_id=0,
+                 receiver_id=rid, deliverer_key="d")
+
+
+def build_matcher(n: int = 300, tenant: str = "T") -> TpuMatcher:
+    m = TpuMatcher(auto_compact=False)
+    for i in range(n):
+        m.add_route(tenant, mk_route(f"cap/{i}/+", f"r{i}"))
+    m.refresh()
+    return m
+
+
+class TestExactAccounting:
+    def test_model_matches_live_device_bytes_exactly(self):
+        """The acceptance bar is <10%; the shape math makes it exact —
+        the model derives from the same layout the upload path uses."""
+        m = build_matcher(300)
+        rep = cap.measure(m)
+        assert rep["installed"]
+        assert rep["kind"] == "single"
+        assert rep["measured_device_bytes"] > 0
+        assert rep["parity_error"] == 0.0
+        assert rep["predicted"]["total"] == rep["measured_device_bytes"]
+
+    def test_arena_bytes_sum_into_prediction(self):
+        m = build_matcher(64)
+        ct = m._base_ct
+        arenas = ct.arena_bytes()
+        pred = cap.compiled_trie_device_bytes(ct)
+        for k, v in arenas.items():
+            assert pred[k] == v
+        assert pred["total"] == (sum(arenas.values()) + pred["count_tab"]
+                                 + pred["route_tab"])
+
+    def test_uninstalled_matcher_reports_not_installed(self):
+        m = TpuMatcher(auto_compact=False)
+        assert cap.measure(m) == {"installed": False}
+
+    def test_probe_and_result_bytes(self):
+        # [B, L+1] int32 ×2 + [B] int32 ×2 + [B] bool
+        assert cap.probe_bytes(16, max_levels=16) == \
+            16 * (2 * 17 * 4 + 2 * 4 + 1)
+        assert cap.result_bytes(16, max_intervals=32) == \
+            16 * (2 * 32 * 4 + 4 + 1)
+
+    def test_inflight_donation_aliases(self):
+        plain = cap.inflight_bytes(16, ring_depth=2, donated=False)
+        aliased = cap.inflight_bytes(16, ring_depth=2, donated=True)
+        assert plain["per_slot"] == \
+            plain["probe_bytes"] + plain["result_bytes"]
+        assert aliased["per_slot"] == max(aliased["probe_bytes"],
+                                         aliased["result_bytes"])
+        assert plain["total"] == plain["per_slot"] * 2
+
+
+class TestPlanner:
+    def test_calibrated_prediction_is_exact_for_same_workload(self):
+        n = 400
+        m = build_matcher(n)
+        planner = cap.CapacityPlanner().calibrate(m._base_ct, n)
+        pred = planner.predict_tables(n)
+        live = cap.compiled_trie_device_bytes(m._base_ct)
+        # the acceptance criterion's 10% bar, met exactly by calibration
+        assert abs(pred["total"] - live["total"]) / live["total"] < 0.10
+        assert pred["edge_tab"] == \
+            int(m._base_ct.edge_tab.size) * 4
+
+    def test_fits_reproduces_fused_vmem_gate_verdict(self, monkeypatch):
+        """fits() must apply the SAME comparison the dispatch-time gate
+        runs — for the 1M-sub table the default coefficients predict
+        ~118MB of edge+route bytes against the 12MB budget: exceeds,
+        without building or dispatching anything."""
+        from bifromq_tpu.models.kernels import (fused_fits_vmem,
+                                                fused_vmem_budget_bytes)
+        monkeypatch.delenv("BIFROMQ_FUSED_VMEM_MB", raising=False)
+        verdict = cap.CapacityPlanner().fits(1_000_000)
+        fv = verdict["fused_vmem"]
+        assert fv["budget_bytes"] == fused_vmem_budget_bytes()
+        assert fv["fits"] is fused_fits_vmem(fv["table_bytes"])
+        assert fv["fits"] is False          # 1M subs >> 12MB VMEM
+        # a tiny table passes the same gate
+        small = cap.CapacityPlanner().fits(100)
+        assert small["fused_vmem"]["fits"] is True
+
+    def test_fits_honors_vmem_budget_env(self, monkeypatch):
+        monkeypatch.setenv("BIFROMQ_FUSED_VMEM_MB", "1024")
+        verdict = cap.CapacityPlanner().fits(1_000_000)
+        assert verdict["fused_vmem"]["budget_bytes"] == 1024 << 20
+        assert verdict["fused_vmem"]["fits"] is True
+
+    def test_live_gate_agrees_with_model_on_installed_base(self):
+        """The model's fused byte count equals the number the serving
+        gate weighs on the actually-uploaded DeviceTrie."""
+        from bifromq_tpu.models.kernels import fused_table_bytes
+        m = build_matcher(200)
+        assert cap.fused_bytes_from_compiled(m._base_ct) == \
+            fused_table_bytes(m._device_trie)
+
+    def test_hbm_headroom_math(self):
+        verdict = cap.CapacityPlanner().fits(
+            1000, hbm_limit_bytes=1 << 30)
+        hbm = verdict["hbm"]
+        assert hbm["limit_bytes"] == 1 << 30
+        assert hbm["headroom_bytes"] == \
+            (1 << 30) - verdict["per_device_peak_bytes"]
+        assert hbm["fits"] is True
+        tiny = cap.CapacityPlanner().fits(1_000_000,
+                                          hbm_limit_bytes=1 << 20)
+        assert tiny["hbm"]["fits"] is False
+
+    def test_sharding_shrinks_per_device_tables(self):
+        planner = cap.CapacityPlanner()
+        one = planner.fits(1_000_000)
+        four = planner.fits(1_000_000, mesh=(1, 4))
+        assert four["tables"]["total"] < one["tables"]["total"]
+        assert four["mesh"] == {"replicas": 1, "shards": 4}
+        # mesh placement ships no node/count tables
+        assert four["tables"]["node_tab"] == 0
+
+
+class TestMeshAccounting:
+    def test_sharded_tables_device_bytes(self):
+        from bifromq_tpu.models.oracle import SubscriptionTrie
+        from bifromq_tpu.parallel.sharded import build_sharded
+        tries = {}
+        for t in ("a", "b", "c", "d"):
+            trie = SubscriptionTrie()
+            for i in range(40):
+                trie.add(mk_route(f"{t}/x/{i}", f"r{i}"))
+            tries[t] = trie
+        tables = build_sharded(tries, 2)
+        acc = tables.device_bytes()
+        assert acc["n_shards"] == 2
+        expected = (tables.edge_tab.nbytes + tables.child_list.nbytes
+                    + tables.route_tab.nbytes)
+        assert acc["total"]["total"] == expected
+        assert len(acc["per_shard"]) == 2
+        for row in acc["per_shard"]:
+            assert row["padded_bytes"] == expected // 2
+            assert 0 < row["real_bytes"] <= row["padded_bytes"]
+        assert 0.0 <= acc["pad_waste_ratio"] < 1.0
+
+    def test_mesh_matcher_measure(self):
+        import jax
+        from bifromq_tpu.parallel.sharded import MeshMatcher, make_mesh
+        mesh = make_mesh(1, 2, devices=jax.devices()[:2])
+        m = MeshMatcher(mesh=mesh, auto_compact=False)
+        for i in range(50):
+            m.add_route("T", mk_route(f"m/{i}", f"r{i}"))
+        m.refresh()
+        rep = cap.measure(m)
+        assert rep["installed"] and rep["kind"] == "mesh"
+        assert rep["parity_error"] == 0.0
+
+
+class TestReportSurfaces:
+    def test_capacity_report_covers_registered_matchers(self):
+        OBS.device.reset()
+        m = build_matcher(128)
+        rep = cap.capacity_report(n_subs=500)
+        assert rep["table_bytes"] >= \
+            cap.measure(m)["measured_device_bytes"]
+        assert rep["parity_error"] == 0.0
+        assert "fused_vmem" in rep["fits"]
+        assert rep["planner"]["calibrated_from"] is not None
+
+    def test_digest_capacity_is_cheap_and_compact(self):
+        hub = ObsHub()
+        m = build_matcher(64)
+        hub.device.register_matcher(m)
+        d = cap.digest_capacity(hub)
+        assert d["table_bytes"] == \
+            cap.measure(m)["measured_device_bytes"]
+        assert d["vmem_fits"] is True
+
+    def test_hbm_env_override(self, monkeypatch):
+        monkeypatch.setenv("BIFROMQ_HBM_BYTES", str(1 << 31))
+        assert cap._live_hbm_limit() == 1 << 31

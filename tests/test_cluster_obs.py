@@ -671,3 +671,256 @@ class TestTraceGapAnnotation:
             assert out["rings_wrapped"] == []
         finally:
             tr.ring = old_ring
+
+
+class TestDigestDeltaEncoding:
+    """ISSUE 8 satellite: a full digest every ``full_every`` ticks,
+    deltas (changed top-level fields only, computed vs the last FULL)
+    in between; the consumer reconstructs and falls back on a gap."""
+
+    def _view(self, host=None, **kw):
+        kw.setdefault("hub", _fresh_hub())
+        return ClusterView("me", host or FakeHost("me"),
+                           rpc_address="127.0.0.1:7000", api_port=8080,
+                           **kw)
+
+    async def test_publisher_alternates_full_and_delta(self):
+        host = FakeHost("me")
+        view = self._view(host, full_every=3)
+        view.refresh()                          # tick 1: full
+        meta1 = host.agent_meta["me"]
+        assert "digest" in meta1 and "digest_delta" not in meta1
+        view.refresh()                          # tick 2: delta
+        meta2 = host.agent_meta["me"]
+        assert "digest" not in meta2
+        assert meta2["base_seq"] == meta1["seq"]
+        # a steady node's delta carries only the always-changing HLC
+        # stamp (and any genuinely changed section), not the whole digest
+        assert "hlc" in meta2["digest_delta"]
+        assert set(meta2["digest_delta"]) < set(view.build_digest())
+        view.refresh()                          # tick 3
+        view.refresh()                          # tick 4: full again
+        assert "digest" in host.agent_meta["me"]
+
+    async def test_consumer_applies_delta_onto_cached_full(self):
+        host = FakeHost("me")
+        view = self._view(host)
+        full = _peer_digest(match_cache_hit_rate=0.5)
+        host.agent_meta["peer"] = {"addr": "127.0.0.1:6000",
+                                   "seq": 7, "digest": full}
+        assert view.peers()["peer"]["digest"][
+            "match_cache_hit_rate"] == 0.5
+        host.agent_meta["peer"] = {
+            "addr": "127.0.0.1:6000", "seq": 8, "base_seq": 7,
+            "digest_delta": {"hlc": HLC.INST.get(),
+                             "match_cache_hit_rate": 0.9}}
+        d = view.peers()["peer"]["digest"]
+        assert d["match_cache_hit_rate"] == 0.9
+        assert d["breakers"] == full["breakers"]    # carried from full
+        assert view.digest_deltas_applied == 1
+        assert view.digest_gaps == 0
+
+    async def test_gap_applies_delta_best_effort_and_stays_fresh(self):
+        """A delta whose base full we never saw (last-writer-wins gossip
+        overwrote it before we sampled): the delta's absolute values
+        still apply best-effort onto the last view — an alive, gossiping
+        peer must not age out as stale because one full was missed — the
+        gap is counted, and the next full resyncs exactly."""
+        host = FakeHost("me")
+        view = self._view(host)
+        full = _peer_digest(match_cache_hit_rate=0.5)
+        host.agent_meta["peer"] = {"addr": "127.0.0.1:6000",
+                                   "seq": 7, "digest": full}
+        view.peers()
+        fresh_hlc = HLC.INST.get()
+        host.agent_meta["peer"] = {
+            "addr": "127.0.0.1:6000", "seq": 12, "base_seq": 10,
+            "digest_delta": {"hlc": fresh_hlc,
+                             "match_cache_hit_rate": 0.9}}
+        p = view.peers()["peer"]
+        assert p["digest"]["match_cache_hit_rate"] == 0.9
+        assert p["digest"]["breakers"] == full["breakers"]
+        # freshness advanced: the delta's hlc landed, so digest_age_s
+        # reset — the peer does NOT drift toward stale through the gap
+        assert p["digest"]["hlc"] == fresh_hlc and p["age_s"] == 0.0
+        assert view.digest_gaps >= 1
+        # the next full resyncs the chain (deltas chain off it again)
+        host.agent_meta["peer"] = {
+            "addr": "127.0.0.1:6000", "seq": 13,
+            "digest": _peer_digest(match_cache_hit_rate=0.7)}
+        assert view.peers()["peer"]["digest"][
+            "match_cache_hit_rate"] == 0.7
+        host.agent_meta["peer"] = {
+            "addr": "127.0.0.1:6000", "seq": 14, "base_seq": 13,
+            "digest_delta": {"hlc": HLC.INST.get()}}
+        assert view.peers()["peer"]["digest"][
+            "match_cache_hit_rate"] == 0.7
+        assert view.digest_deltas_applied >= 1
+
+    async def test_delta_roundtrip_over_publish_decode(self):
+        """Publisher and consumer compose: a second view decoding the
+        publisher's own metadata sees the same digest the publisher
+        built, across full AND delta ticks."""
+        host = FakeHost("me")
+        view = self._view(host, full_every=4)
+        consumer = ClusterView("other", host, hub=_fresh_hub())
+        for _ in range(5):
+            view.refresh()
+            got = consumer.peers()["me"]["digest"]
+            assert got.get("v") == 1
+            assert "device" in got and "breakers" in got
+
+    async def test_legacy_full_only_meta_still_decodes(self):
+        host = FakeHost("me")
+        view = self._view(host)
+        host.agent_meta["old"] = {"addr": "127.0.0.1:6000",
+                                  "digest": _peer_digest()}
+        assert view.peers()["old"]["digest"]["v"] == 1
+
+
+class TestWeightedDemotion:
+    """ISSUE 8 satellite: per-signal scores accumulate per endpoint and
+    demote at the threshold — two sub-threshold signals combine where
+    either alone would not; every legacy single-signal verdict holds."""
+
+    def _view(self, host, **kw):
+        t0 = time.time()
+        now = [t0]
+        kw.setdefault("hub", _fresh_hub())
+        view = ClusterView("me", host, clock=lambda: now[0],
+                           queue_depth_threshold=1000,
+                           hysteresis_s=5.0, **kw)
+        return view, now
+
+    def _meta(self, addr, *, breakers=None, depth=0, device_breaker=None):
+        dev = {"dispatch_queue_depth": depth, "batches_in_flight": 0,
+               "compile_count": 0, "mem_peak_bytes": 0}
+        if device_breaker:
+            dev["breaker"] = device_breaker
+        return {"addr": addr,
+                "digest": _peer_digest(breakers=breakers or {},
+                                       device=dev)}
+
+    async def test_single_full_signals_still_demote(self):
+        host = FakeHost("me")
+        host.agent_meta["p1"] = self._meta(
+            "127.0.0.1:1", breakers={"127.0.0.1:9": "open"})
+        host.agent_meta["p2"] = self._meta("127.0.0.1:2", depth=1000)
+        host.agent_meta["p3"] = self._meta("127.0.0.1:3",
+                                           device_breaker="half_open")
+        view, _ = self._view(host)
+        view._recompute()
+        assert view.suspect("127.0.0.1:9")      # peer breaker open
+        assert view.suspect("127.0.0.1:2")      # queue at threshold
+        assert view.suspect("127.0.0.1:3")      # device breaker
+
+    async def test_subthreshold_signals_alone_do_not_demote(self):
+        host = FakeHost("me")
+        # queue at 60% of brown-out depth: score 0.6 < 1.0
+        host.agent_meta["p1"] = self._meta("127.0.0.1:2", depth=600)
+        # a half-open PEER breaker alone: 0.5 < 1.0
+        host.agent_meta["p2"] = self._meta(
+            "127.0.0.1:1", breakers={"127.0.0.1:9": "half_open"})
+        view, _ = self._view(host)
+        view._recompute()
+        assert not view.suspect("127.0.0.1:2")
+        assert not view.suspect("127.0.0.1:9")
+        assert view.demotion_scores["127.0.0.1:2"] == 0.6
+        assert view.demotion_scores["127.0.0.1:9"] == 0.5
+
+    async def test_combined_subthreshold_signals_demote(self):
+        host = FakeHost("me")
+        # the same endpoint accumulates: half-open peer breaker (0.5)
+        # + 60%-deep queue (0.6) = 1.1 ≥ 1.0
+        host.agent_meta["p1"] = self._meta(
+            "127.0.0.1:1", breakers={"127.0.0.1:2": "half_open"})
+        host.agent_meta["p2"] = self._meta("127.0.0.1:2", depth=600)
+        view, _ = self._view(host)
+        view._recompute()
+        assert view.demotion_scores["127.0.0.1:2"] == 1.1
+        assert view.suspect("127.0.0.1:2")
+
+    async def test_weights_configurable(self):
+        host = FakeHost("me")
+        host.agent_meta["p1"] = self._meta(
+            "127.0.0.1:1", breakers={"127.0.0.1:9": "open"})
+        view, _ = self._view(
+            host, demotion_weights={"peer_breaker_open": 0.4})
+        view._recompute()
+        assert not view.suspect("127.0.0.1:9")  # 0.4 < threshold 1.0
+
+    async def test_queue_score_saturates_at_2x(self):
+        host = FakeHost("me")
+        host.agent_meta["p1"] = self._meta("127.0.0.1:2", depth=10**9)
+        view, _ = self._view(host)
+        view._recompute()
+        assert view.demotion_scores["127.0.0.1:2"] == 2.0
+
+    async def test_hysteresis_with_fake_clock(self):
+        """Weighted demotion composes with the ISSUE 7 hysteresis: the
+        endpoint stays demoted a full cooldown after its last bad
+        observation, then clears."""
+        host = FakeHost("me")
+        host.agent_meta["p1"] = self._meta("127.0.0.1:2", depth=1000)
+        view, now = self._view(host)
+        view._recompute()
+        assert view.suspect("127.0.0.1:2")
+        # signal clears, but the cooldown holds the demotion
+        host.agent_meta["p1"] = self._meta("127.0.0.1:2", depth=0)
+        now[0] += 2.0
+        view._recompute()
+        assert view.suspect("127.0.0.1:2")
+        now[0] += 10.0                          # past hysteresis_s=5
+        view._recompute()
+        assert not view.suspect("127.0.0.1:2")
+
+
+class TestClusterCapacity:
+    async def test_digest_carries_capacity_field(self):
+        from bifromq_tpu.models.matcher import TpuMatcher
+        from bifromq_tpu.models.oracle import Route
+        from bifromq_tpu.types import RouteMatcher
+        hub = _fresh_hub()
+        m = TpuMatcher(auto_compact=False)
+        m.add_route("T", Route(
+            matcher=RouteMatcher.from_topic_filter("cap/x"),
+            broker_id=0, receiver_id="r", deliverer_key="d"))
+        m.refresh()
+        hub.device.register_matcher(m)
+        view = ClusterView("me", FakeHost("me"), hub=hub)
+        digest = view.build_digest()
+        assert digest["capacity"]["table_bytes"] > 0
+        assert digest["capacity"]["vmem_fits"] is True
+
+    async def test_capacity_table_federates_from_digests(self):
+        host = FakeHost("me")
+        host.agent_meta["peer"] = {
+            "addr": "127.0.0.1:6000",
+            "digest": _peer_digest(
+                capacity={"table_bytes": 12345,
+                          "mem_peak_bytes": 777, "vmem_fits": False})}
+        view = ClusterView("me", host, hub=_fresh_hub())
+        table = view.capacity_table()
+        assert table["nodes"]["me"]["self"] is True
+        peer_row = table["nodes"]["peer"]
+        assert peer_row["capacity"]["table_bytes"] == 12345
+        assert not peer_row["stale"]
+        local_tb = table["nodes"]["me"]["capacity"]["table_bytes"]
+        assert table["total_table_bytes"] == local_tb + 12345
+        assert table["max_mem_peak_bytes"] >= 777
+
+    async def test_stale_peer_excluded_from_totals(self):
+        t0 = time.time()
+        now = [t0]
+        host = FakeHost("me")
+        host.agent_meta["peer"] = {
+            "addr": "127.0.0.1:6000",
+            "digest": _peer_digest(capacity={"table_bytes": 999})}
+        view = ClusterView("me", host, hub=_fresh_hub(),
+                           stale_after_s=5.0, clock=lambda: now[0])
+        view.peers()
+        now[0] = t0 + 60.0
+        table = view.capacity_table()
+        assert table["nodes"]["peer"]["stale"]
+        assert table["total_table_bytes"] == \
+            table["nodes"]["me"]["capacity"]["table_bytes"]
