@@ -48,8 +48,16 @@ stage split. Every run is stamped with device_kind + stale so
 CPU-fallback rounds stay comparable; routes-mode reports tunnel RTT
 apart from device-kernel time.
 
+SUBSCRIPTION CHURN (ISSUE 9): config "8" runs sustained subscribe/
+unsubscribe against a full-size base interleaved with publishes,
+measuring single-mutation patch-apply latency (host plan + narrow device
+scatter) against the full-rebuild cost, match p99 during churn, the
+zero-rebuild/zero-generation-bump window, and exact oracle parity after
+the storm (BENCH_CHURN_SUBS / BENCH_CHURN_OPS; persists
+bench_results/churn_last.json and stamps record["churn"]).
+
 Env knobs: BENCH_CONFIGS ("1,2,3,4,5" default; "2" = headline only;
-"6" = match-cache A/B; "7" = pipeline A/B;
+"6" = match-cache A/B; "7" = pipeline A/B; "8" = churn/patch;
 BENCH_CACHE_HOT_TOPICS sizes config 6's Zipf pool),
 BENCH_SUBS (config-2 subs, default 1_000_000), BENCH_BATCH (16384),
 BENCH_ITERS (30), BENCH_K (16), BENCH_SEED (0), BENCH_RETAINED (1_000_000),
@@ -922,6 +930,159 @@ def bench_config7():
     return out
 
 
+def bench_config8():
+    """Subscription-churn config (ISSUE 9): sustained subscribe /
+    unsubscribe at rate against a full-size base, interleaved with
+    publishes — measuring single-mutation patch-apply latency (host plan
+    + narrow device update, ``_flush_patches`` forced per op so every
+    sample is one mutation end-to-end) and match p99 DURING churn, next
+    to the full-rebuild cost the same mutation used to amortize.
+
+    The acceptance bar: patch apply ≥100× faster than the full rebuild
+    at 1M subs on CPU; steady churn below the tombstone threshold does
+    ZERO full rebuilds and ZERO match-cache generation bumps; results
+    stay row-identical to the host oracle. The cell persists to
+    bench_results/churn_last.json so the measurement survives the run.
+    """
+    from bifromq_tpu import workloads
+    from bifromq_tpu.models.matcher import TpuMatcher
+    from bifromq_tpu.models.oracle import Route
+    from bifromq_tpu.obs import OBS
+    from bifromq_tpu.types import RouteMatcher
+
+    n_subs = int(os.environ.get("BENCH_CHURN_SUBS", str(N_SUBS)))
+    n_ops = int(os.environ.get("BENCH_CHURN_OPS", "256"))
+    name = f"c8_churn_{n_subs}"
+
+    def mk(tf, rid, inc=0):
+        return Route(matcher=RouteMatcher.from_topic_filter(tf),
+                     broker_id=0, receiver_id=rid, deliverer_key="d0",
+                     incarnation=inc)
+
+    t0 = time.perf_counter()
+    tries = workloads.config_wildcard(n_subs, seed=SEED)
+    build_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    m = TpuMatcher.from_tries(tries, match_cache=False)
+    install_s = time.perf_counter() - t0
+    # the cost every compact_threshold'th mutation used to pay: the full
+    # compile + device upload + walk warm of this exact population
+    rebuild_s = m._last_compile_s
+    log(f"[{name}] base: build {build_s:.1f}s, compile+install "
+        f"{rebuild_s:.1f}s, patchable={type(m._base_ct).__name__}")
+    ledger = OBS.profiler.ledger
+    compiles0 = m.compile_count
+    bumps0 = ledger.generation_bumps
+
+    batch = 64
+    topics = workloads.probe_topics(batch * 8, seed=SEED + 1)
+    mb = [[("tenant0", t) for t in topics[i * batch:(i + 1) * batch]]
+          for i in range(8)]
+    # warm the match shapes AND the patch-scatter jit outside the timing —
+    # every probe batch once, so the lazily-compiled escalation walk (an
+    # overflow row's first dispatch pays its XLA compile) lands in warmup,
+    # not in the churn-window p99
+    for wb in mb:
+        m.match_batch(wb)
+    m.add_route("tenant0", mk("bench/churn/warm/+", "w0"))
+    m._flush_patches()
+    m.match_batch(mb[0])
+
+    patch_lat, unsub_lat, match_lat = [], [], []
+    added = []
+    for i in range(n_ops):
+        tf = f"bench/churn/{i}/+"
+        s0 = time.perf_counter()
+        m.add_route("tenant0", mk(tf, f"c{i}", inc=1))
+        m._flush_patches()
+        patch_lat.append(time.perf_counter() - s0)
+        added.append((tf, f"c{i}"))
+        if i % 8 == 4:
+            s0 = time.perf_counter()
+            m.match_batch(mb[(i // 8) % 8])
+            match_lat.append(time.perf_counter() - s0)
+    for i, (tf, rid) in enumerate(added[:n_ops // 2]):
+        s0 = time.perf_counter()
+        m.remove_route("tenant0", RouteMatcher.from_topic_filter(tf),
+                       (0, rid, "d0"), incarnation=1)
+        m._flush_patches()
+        unsub_lat.append(time.perf_counter() - s0)
+
+    # oracle parity after the storm: device serving vs authoritative tries
+    probe = [("tenant0", t) for t in topics[:256]]
+    probe += [("tenant0", ["bench", "churn", str(i), "x"])
+              for i in range(0, n_ops, 7)]
+    got = m.match_batch(probe)
+    want = m.match_from_tries(probe)
+
+    def canon(r):
+        return (sorted((x.matcher.mqtt_topic_filter, x.receiver_url)
+                       for x in r.normal),
+                {f: sorted(x.receiver_url for x in ms)
+                 for f, ms in r.groups.items()})
+    parity = all(canon(a) == canon(b) for a, b in zip(got, want))
+
+    patch_lat = np.array(patch_lat)
+    # degenerate BENCH_CHURN_OPS (<8) can leave the sampled legs empty;
+    # report zeros instead of crashing the whole bench run
+    unsub_lat = np.array(unsub_lat) if unsub_lat else np.zeros(1)
+    match_lat = np.array(match_lat) if match_lat else np.zeros(1)
+    p99 = float(np.percentile(patch_lat, 99))
+    out = {
+        "n_subs": n_subs,
+        "churn_ops": n_ops,
+        "build_s": round(build_s, 1),
+        "full_rebuild_s": round(rebuild_s, 2),
+        "patch_apply_ms": {
+            "p50": round(float(np.percentile(patch_lat, 50)) * 1e3, 3),
+            "p99": round(p99 * 1e3, 3),
+            "mean": round(float(patch_lat.mean()) * 1e3, 3),
+        },
+        "unsubscribe_ms": {
+            "p50": round(float(np.percentile(unsub_lat, 50)) * 1e3, 3),
+            "p99": round(float(np.percentile(unsub_lat, 99)) * 1e3, 3),
+        },
+        "patch_vs_rebuild_speedup": round(rebuild_s / max(1e-9, p99), 1),
+        "match_p50_ms_during_churn": round(
+            float(np.percentile(match_lat, 50)) * 1e3, 2),
+        "match_p99_ms_during_churn": round(
+            float(np.percentile(match_lat, 99)) * 1e3, 2),
+        "match_batch": batch,
+        "full_rebuilds_in_window": m.compile_count - compiles0,
+        "generation_bumps_in_window": ledger.generation_bumps - bumps0,
+        "oracle_parity": parity,
+        "patch": m._base_ct.patch_stats()
+        if hasattr(m._base_ct, "patch_stats") else None,
+        "patch_ledger": {
+            "flushes": ledger.patch_flushes,
+            "mutations": ledger.patch_mutations,
+            "rows": ledger.patch_rows,
+            "bytes": ledger.patch_bytes,
+        },
+        "install_s": round(install_s, 1),
+    }
+    log(f"[{name}] {json.dumps(out)}")
+    try:
+        path = os.path.join(_REPO, "bench_results", "churn_last.json")
+        # same guard as last_good: a down-scaled smoke run must never
+        # clobber the full-population churn record
+        keep = True
+        try:
+            with open(path) as f:
+                if n_subs < json.load(f).get("n_subs", 0):
+                    keep = False
+        except (OSError, ValueError):
+            pass
+        if keep:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(dict(out, measured_at=time.strftime(
+                    "%Y-%m-%dT%H:%M:%S")), f, indent=1)
+    except OSError as e:  # noqa: BLE001 — persistence is best-effort
+        log(f"churn record write failed: {e}")
+    return out
+
+
 def bench_broker():
     """End-to-end MQTT broker throughput over loopback TCP: QoS0/QoS1
     publish → dist match (device matcher) → local fan-out → delivery.
@@ -1137,6 +1298,8 @@ def main():
         results["c6"] = bench_config6()
     if "7" in CONFIGS:
         results["c7"] = bench_config7()
+    if "8" in CONFIGS:
+        results["c8"] = bench_config8()
     if "b" in CONFIGS:
         results["broker"] = bench_broker()
 
@@ -1223,6 +1386,21 @@ def main():
             "pipelined_batch_p99_ms":
                 results["c7"]["pipelined"]["batch_p99_ms"],
             "stage_latency_ms": results["c7"]["stage_latency_ms"],
+        }
+    # churn cell next to the headline (ISSUE 9): patch-apply latency vs
+    # the full rebuild, zero-rebuild/zero-bump window, oracle parity
+    if "c8" in results:
+        c8 = results["c8"]
+        record["churn"] = {
+            "n_subs": c8["n_subs"],
+            "full_rebuild_s": c8["full_rebuild_s"],
+            "patch_apply_ms": c8["patch_apply_ms"],
+            "patch_vs_rebuild_speedup": c8["patch_vs_rebuild_speedup"],
+            "match_p99_ms_during_churn": c8["match_p99_ms_during_churn"],
+            "full_rebuilds_in_window": c8["full_rebuilds_in_window"],
+            "generation_bumps_in_window":
+                c8["generation_bumps_in_window"],
+            "oracle_parity": c8["oracle_parity"],
         }
     # per-stage p50/p99 next to the headline (ISSUE 2): where the broker
     # plane actually spends its time (queue-wait vs device vs deliver)

@@ -583,11 +583,14 @@ class APIServer:
         from ..obs import OBS
         from ..obs.capacity import digest_capacity
         local = digest_capacity(OBS)
+        ls = int(local.get("logical_subs", 0))
         return 200, {"nodes": {OBS.node_id: {"capacity": local,
                                              "stale": False,
                                              "self": True}},
                      "total_table_bytes": local.get("table_bytes", 0),
-                     "max_mem_peak_bytes": local.get("mem_peak_bytes", 0)}
+                     "max_mem_peak_bytes": local.get("mem_peak_bytes", 0),
+                     "logical_subs": {"sum": ls, "dedup": ls,
+                                      "replica_groups": 1 if ls else 0}}
 
     async def _cluster_trace(self, trace_id: str, arg) -> Tuple[int, object]:
         """``GET /cluster/trace/<id>``: the full cross-process trace,

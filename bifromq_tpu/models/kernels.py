@@ -28,6 +28,16 @@ Deployment gates (all are consulted by ``fused_enabled``):
   ``BIFROMQ_FUSED_VMEM_MB`` (default 12 MB of the ~16 MB/core budget);
   bigger automatons fall back to the lax walk (auto mode) — the
   multi-chip sharding item (ROADMAP) is what shrinks per-core tables.
+
+Incremental patching (ISSUE 9): the fused walk reads the SAME patched
+arenas as the lax walk — ``edge_tab``/``route_tab`` are passed per call,
+so a narrow patch flush (models/matcher._flush_patches) is visible on
+the very next launch with no rebuild. The ``_build_fused`` cache keys on
+table SHAPES, and the patchable arenas carry pow2 growth headroom
+precisely so steady churn never reshapes them: patches reuse the cached
+kernel, and only an arena growth / edge-table regrow (pow2-amortized)
+re-traces. The VMEM gate weighs the PADDED table bytes — headroom rows
+are resident whether or not they're live, so that is the honest number.
 """
 
 from __future__ import annotations

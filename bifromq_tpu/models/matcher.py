@@ -43,8 +43,8 @@ from .. import trace
 from ..utils.metrics import STAGES
 from ..utils import topic as topic_util
 from .automaton import (
-    CompiledTrie, GroupMatching, Matching, TokenizedTopics, compile_tries,
-    tokenize,
+    CompiledTrie, GroupMatching, Matching, PatchableTrie, PatchFallback,
+    TokenizedTopics, compile_tries, patch_enabled, tokenize,
 )
 from .oracle import (
     PERSISTENT_SUB_BROKER_ID, UNCAPPED_FANOUT, MatchedRoutes, Route,
@@ -110,6 +110,14 @@ class TpuMatcher:
     # directly; subclasses replacing the whole device plane (MeshMatcher)
     # flip this off and the async entry degrades to their sync path
     supports_async = True
+    # ISSUE 9: single-chip bases are PatchableTrie and mutations fold into
+    # the arenas in place (delta patches + narrow device updates) instead
+    # of accumulating in the overlay until a full rebuild. Subclasses
+    # whose compile target isn't the single-chip CompiledTrie (MeshMatcher
+    # ships per-shard stacks to a mesh) flip this off and keep the
+    # overlay+compaction path — per-shard patching is the ROADMAP
+    # follow-up this PR unlocks.
+    supports_patching = True
 
     def __init__(self, *, max_levels: int = 16, k_states: int = 32,
                  probe_len: int = 16, device=None,
@@ -170,6 +178,13 @@ class TpuMatcher:
         self._compact_thread: Optional[threading.Thread] = None
         self.compile_count = 0      # full compiles (observability/tests)
         self.compile_time_s = 0.0   # cumulative wall time in compiles
+        # ISSUE 9 patch-plane accounting (mutations folded into the base
+        # in place vs ops that fell back to the overlay)
+        self.patch_count = 0        # mutations applied as in-place patches
+        self.patch_fallbacks = 0    # ops the patcher refused (overlay'd)
+        self.patch_flushes = 0      # device patch-update rounds
+        self.patch_host_s = 0.0     # cumulative host plan+arena time
+        self.patch_device_s = 0.0   # cumulative device update time
         # ISSUE 8 compile-event ledger: what triggered the build the
         # NEXT _install_base lands (first_base / threshold / forced /
         # refresh), and how long that compile ran
@@ -214,7 +229,10 @@ class TpuMatcher:
             return False
         op = ("add", tenant_id, route)
         self._log.append(op)
-        self._overlay_record(op)
+        if not self._try_patch(op):
+            # no patchable base (or the op fell back): serve it from the
+            # delta overlay until the next compaction folds it in
+            self._overlay_record(op)
         if self.match_cache is not None:
             # filter-aware (ISSUE 4): exact filters evict one topic key,
             # wildcard filters bump the tenant epoch
@@ -235,11 +253,119 @@ class TpuMatcher:
             del self.tries[tenant_id]
         op = ("rm", tenant_id, matcher, receiver_url, incarnation)
         self._log.append(op)
-        self._overlay_record(op)
+        if not self._try_patch(op):
+            self._overlay_record(op)
         if self.match_cache is not None:
             self.match_cache.invalidate(tenant_id, matcher.filter_levels)
         self._maybe_compact()
         return True
+
+    # ---------------- incremental patching (ISSUE 9 tentpole) --------------
+
+    def _patching_enabled(self) -> bool:
+        return self.supports_patching and patch_enabled()
+
+    def _group_members(self, tenant_id: str, matcher) -> dict:
+        """The authoritative surviving member set for a shared-group op —
+        the patcher replaces the whole GroupMatching slot with it (group
+        member churn is a pure host-side object swap, zero device
+        traffic)."""
+        trie = self.tries.get(tenant_id)
+        node = trie._root if trie is not None else None
+        for level in matcher.filter_levels:
+            if node is None:
+                return {}
+            node = node.children.get(level)
+        if node is None:
+            return {}
+        gkey = (int(matcher.type), matcher.group or "")
+        return dict(node.groups.get(gkey, {}))
+
+    def _try_patch(self, op: Tuple) -> bool:
+        """Fold one log op straight into the installed base arenas.
+
+        Returns False when there is nothing to patch (no base yet, mesh
+        subclass, env kill-switch) or the patcher declined
+        (``PatchFallback``) — the caller then records the op into the
+        overlay, exactly the pre-patching serving path.
+        """
+        base = self._base_ct
+        if base is None or not isinstance(base, PatchableTrie) \
+                or not self._patching_enabled():
+            return False
+        from ..types import RouteMatcherType
+        t0 = time.perf_counter()
+        try:
+            if op[0] == "add":
+                _, tenant_id, route = op
+                gm = None
+                if route.matcher.type != RouteMatcherType.NORMAL:
+                    gm = self._group_members(tenant_id, route.matcher)
+                base.patch_add(tenant_id, route, group_members=gm)
+            else:
+                _, tenant_id, matcher, url, _inc = op
+                gm = None
+                if matcher.type != RouteMatcherType.NORMAL:
+                    gm = self._group_members(tenant_id, matcher)
+                base.patch_remove(tenant_id, matcher, url,
+                                  group_members=gm)
+        except PatchFallback:
+            self.patch_fallbacks += 1
+            return False
+        self.patch_count += 1
+        self.patch_host_s += time.perf_counter() - t0
+        return True
+
+    def _flush_patches(self, own_slots: int = 0) -> None:
+        """Ship accumulated host patches to device as narrow scatter
+        updates (coalesced: at most one flush per dispatch, however many
+        mutations landed since). Functional update by default — the old
+        tables stay alive for in-flight dispatches; when nothing else is
+        in flight the tables are DONATED so XLA updates them in place
+        with no table copy at all. ``own_slots`` is the ring slots the
+        CALLER itself holds (the async leg acquires before dispatching,
+        so its own not-yet-dispatched slot is counted in ``in_flight``
+        but provably isn't a reader of the old tables yet)."""
+        base = self._base_ct
+        if not isinstance(base, PatchableTrie) or not base.dirty \
+                or self._device_trie is None:
+            return
+        from ..ops.match import patch_device_trie
+        ring = self._ring
+        # donation exclusivity rides the matcher's single-serving-thread
+        # contract (the same one the overlay dicts and _apply_pending_swap
+        # already assume): only the serving thread flushes, always BEFORE
+        # its own dispatch, and the sync/async legs both synchronize their
+        # walks (incl. the escalation re-walk) without yielding between
+        # slot release and expansion — so in_flight<=own_slots plus an
+        # empty quarantine (timed-out/cancelled walks still reading the
+        # tables park their arrays there) proves no device reader of the
+        # old tables exists. Mutation-side callers never flush.
+        donate = ring is None or (ring.in_flight <= own_slots
+                                  and not len(ring.quarantine))
+        t0 = time.perf_counter()
+        dev, stats = patch_device_trie(self._device_trie, base,
+                                       device=self.device, donate=donate)
+        self._device_trie = dev
+        dt = time.perf_counter() - t0
+        self.patch_flushes += 1
+        self.patch_device_s += dt
+        # ISSUE 9: every flush lands in the compile ledger's patch stream
+        # (reason / mutations coalesced / rows touched / bytes shipped) so
+        # churn reads as narrow updates, not invisible work
+        from ..obs import OBS
+        OBS.profiler.ledger.record_patch(
+            reason="+".join(stats["full"]) if stats["full"] else "rows",
+            mutations=stats["ops"], rows=stats["rows"],
+            bytes_shipped=stats["bytes"], duration_s=dt)
+        if stats["reshaped"]:
+            # arena growth / edge regrow changed a table shape: the walk
+            # re-traces. The triggering batch inherently pays its own
+            # shape's compile, but the OTHER warm shapes (pipeline
+            # floors) compile on a background thread — same off-thread
+            # warming a compaction install gets from the compile thread.
+            threading.Thread(target=self._warm_walk, args=(base, dev),
+                             name="tpu-matcher-warm", daemon=True).start()
 
     def _overlay_record(self, op: Tuple) -> None:
         """Fold one log op into the serving overlay (delta tries + tombstones).
@@ -288,6 +414,11 @@ class TpuMatcher:
         self.compile_count += 1
         ct = compile_tries(self._shadow, max_levels=self.max_levels,
                            probe_len=self.probe_len)
+        if self._patching_enabled():
+            # ISSUE 9: pad the arenas with pow2 growth headroom so the
+            # serving base accepts in-place patches without reshaping
+            # (the padded shape is what jit compiles against)
+            ct = PatchableTrie(ct)
         from ..ops.match import DeviceTrie  # deferred: keeps jax optional
         dev = DeviceTrie.from_compiled(ct, device=self.device)
         self._warm_walk(ct, dev)
@@ -352,18 +483,31 @@ class TpuMatcher:
             pass
 
     def refresh(self) -> CompiledTrie:
-        """Blocking compaction: fold every pending mutation into a fresh base.
+        """Blocking quiesce: every pending mutation lands in the base.
 
-        Kept for cold start, tests, and explicit quiesce; live mutations use
-        the background path (``_maybe_compact``) instead.
+        ISSUE 9: when the base is patchable and every pending log op was
+        already folded in as a patch (the overlay is empty), quiesce is
+        just a shadow sync + device flush — NO rebuild. The full compile
+        survives for cold start, overlay-resident ops, and mesh bases.
         """
         self.drain()
-        if self._log or self._base_ct is None:
-            self._compile_reason = ("first_base" if self._base_ct is None
-                                    else "refresh")
+        if self._base_ct is None:
+            self._compile_reason = "first_base"
             self._replay_log_into_shadow()
             ct, dev = self._compile_shadow()
             self._install_base(ct, dev)
+        elif self._log:
+            if self._overlay_n == 0 \
+                    and isinstance(self._base_ct, PatchableTrie):
+                # base already exact (patch-first path): sync the shadow
+                # so the next compaction replays from the right snapshot
+                self._replay_log_into_shadow()
+            else:
+                self._compile_reason = "refresh"
+                self._replay_log_into_shadow()
+                ct, dev = self._compile_shadow()
+                self._install_base(ct, dev)
+        self._flush_patches()
         return self._base_ct
 
     @staticmethod
@@ -382,12 +526,17 @@ class TpuMatcher:
         prev = self._base_ct
         self._base_ct = ct
         self._device_trie = dev
-        # overlay = mutations not in this base = the log suffix
+        # mutations not in this base = the log suffix. ISSUE 9: fold them
+        # in as PATCHES on the fresh arenas (the patch methods are
+        # find-or-append idempotent, so replaying an op that raced the
+        # compile snapshot is safe); only ops the patcher declines land
+        # in the overlay. Dirty rows flush on the next dispatch.
         self._delta = {}
         self._tomb = {}
         self._overlay_n = 0
         for op in self._log:
-            self._overlay_record(op)
+            if not self._try_patch(op):
+                self._overlay_record(op)
         # ISSUE 6 satellite (PR-4 follow-up): a PURE compaction — folding
         # the overlay into a new base with the SAME salt — produces an
         # automaton equivalent to base ⊕ overlay, so every cached result
@@ -417,6 +566,13 @@ class TpuMatcher:
                              salt=self._base_salt(ct),
                              generation_bumped=bumped)
 
+    def _patch_frag_pending(self) -> bool:
+        """ISSUE 9 compaction trigger: dead+garbage slots crossed the
+        tombstone threshold. Steady patching churn below it (and ANY
+        volume of pure adds, which never fragment) compacts never."""
+        base = self._base_ct
+        return isinstance(base, PatchableTrie) and base.frag_pending()
+
     def _maybe_compact(self, force: bool = False) -> None:
         # trigger on the FIRST mutation too (base is None): the first base
         # builds in the background so the first publish finds trie tables
@@ -424,16 +580,27 @@ class TpuMatcher:
         # inline (the reference's refresh-on-mutation contract,
         # TenantRouteCache.java:100). ``force`` recompiles regardless of
         # overlay size (shard re-placement: new pins need a new build).
+        # ISSUE 9: with patch-first mutations the overlay stays empty and
+        # the threshold trigger goes quiet; compaction becomes the
+        # FRAGMENTATION fallback (tombstone/garbage ratio) instead of the
+        # every-2048-mutations rebuild.
+        frag = self.auto_compact and self._patch_frag_pending()
         if (self._compact_thread is not None
-                or (not force
+                or (not force and not frag
                     and (not self.auto_compact
                          or (self._base_ct is not None
                              and self._overlay_n < self.compact_threshold)))):
             self._apply_pending_swap()
             return
         # ledger attribution (ISSUE 8): why this build is happening
-        self._compile_reason = ("first_base" if self._base_ct is None
-                                else ("forced" if force else "threshold"))
+        if self._base_ct is None:
+            self._compile_reason = "first_base"
+        elif force:
+            self._compile_reason = "forced"
+        elif self._overlay_n >= self.compact_threshold:
+            self._compile_reason = "threshold"
+        else:
+            self._compile_reason = "frag"
         # snapshot: fold the log into the shadow NOW (serving thread, cheap —
         # O(log)); the compile thread then reads only the frozen shadow
         self._replay_log_into_shadow()
@@ -975,6 +1142,11 @@ class TpuMatcher:
         self._apply_pending_swap()
         if self._base_ct is None:
             self.refresh()
+        # ISSUE 9: ship any host patches accumulated since the last
+        # dispatch (one coalesced narrow update, so this batch walks the
+        # post-mutation tables). watchdogged == the async leg, which
+        # already holds its own (not-yet-dispatched) ring slot.
+        self._flush_patches(own_slots=1 if watchdogged else 0)
         ct = self._base_ct
         if batch is None:
             batch = _pow2_batch(len(queries))
@@ -1145,6 +1317,13 @@ class TpuMatcher:
         if row.size == 0:
             return out
         kinds = ct.slot_kind[row]
+        # ISSUE 9: tombstoned slots ride the interval until compaction
+        # reclaims them — the walk emits them, this is where they die
+        dead = kinds == CompiledTrie.SLOT_DEAD
+        if dead.any():
+            row, kinds = row[~dead], kinds[~dead]
+            if row.size == 0:
+                return out
         pers_mask = kinds == CompiledTrie.SLOT_PERSISTENT
         if (max_persistent_fanout != UNCAPPED_FANOUT
                 and int(pers_mask.sum()) > max_persistent_fanout):
@@ -1180,7 +1359,10 @@ class TpuMatcher:
         and mesh paths both expand intervals before calling)."""
         normal: List[Route] = []
         groups: Dict[str, List[Route]] = {}
+        kind_arr = ct.slot_kind
         for slot in (int(s) for s in slots):
+            if kind_arr[slot] == CompiledTrie.SLOT_DEAD:
+                continue    # ISSUE 9: patch-tombstoned base slot
             m: Matching = ct.matchings[slot]
             if isinstance(m, GroupMatching):
                 members = [r for r in m.members

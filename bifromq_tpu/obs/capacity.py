@@ -39,7 +39,7 @@ from __future__ import annotations
 import math
 import os
 from dataclasses import dataclass
-from typing import Dict, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 _I32 = 4            # every automaton table is int32
 _EDGE_ENTRY_I32 = 4  # edge_tab entries are (node, h1, h2, child)
@@ -192,6 +192,12 @@ def measure(matcher) -> Dict[str, object]:
         "parity_error": round(err, 6),
         "overlay_routes": getattr(matcher, "overlay_size", 0),
     }
+    # ISSUE 9: arena headroom + tombstone/fragmentation accounting for
+    # patchable bases — the numbers the patch-vs-compact decision reads
+    if hasattr(base, "patch_stats"):
+        out["patch"] = base.patch_stats()
+        out["patch_fallbacks"] = getattr(matcher, "patch_fallbacks", 0)
+        out["patched_mutations"] = getattr(matcher, "patch_count", 0)
     ring = getattr(matcher, "_ring", None)
     if ring is not None:
         out["inflight"] = inflight_bytes(
@@ -475,7 +481,15 @@ def digest_capacity(hub) -> Dict[str, object]:
     must never block on the device tunnel."""
     table_bytes = 0
     vmem_fits: Optional[bool] = None
+    logical: List[Tuple[str, int]] = []
     for m in hub.device.matchers():
+        # ISSUE 9 satellite (PR 8 follow-up): dedup-aware LOGICAL
+        # subscription count next to the physical table bytes — counted
+        # from the authoritative tries (one entry per live subscription,
+        # regardless of arena padding/tombstones), fingerprinted so the
+        # cluster rollup can count replicated tables once
+        for tenant_id, trie in (getattr(m, "tries", None) or {}).items():
+            logical.append((tenant_id, len(trie)))
         base = getattr(m, "_base_ct", None)
         if base is None:
             continue
@@ -492,6 +506,13 @@ def digest_capacity(hub) -> Dict[str, object]:
             continue
     out: Dict[str, object] = {"table_bytes": table_bytes,
                               "mem_peak_bytes": hub.device.peak_memory_bytes}
+    out["logical_subs"] = sum(c for _, c in logical)
+    if logical:
+        import hashlib
+        h = hashlib.blake2b(digest_size=8)
+        for tenant_id, c in sorted(logical):
+            h.update(f"{tenant_id}:{c};".encode("utf-8"))
+        out["subs_fp"] = h.hexdigest()
     if vmem_fits is not None:
         out["vmem_fits"] = vmem_fits
     limit = _env_int("BIFROMQ_HBM_BYTES", 0)

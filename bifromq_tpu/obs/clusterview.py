@@ -678,15 +678,41 @@ class ClusterView:
                               "self": True}
         total = int(local.get("table_bytes", 0))
         peak = int(local.get("mem_peak_bytes", 0))
+        # ISSUE 9 satellite (PR 8 follow-up): logical-subscription rollup.
+        # Physical table bytes sum per node (that IS what HBM holds, incl.
+        # replicas); logical subs dedup by the gossiped subscription-set
+        # fingerprint — nodes carrying an identical (tenant, count) census
+        # hold replicas of one logical route table and count ONCE. Nodes
+        # without a fingerprint (older digests, empty tables) count
+        # individually — no dedup evidence, no dedup.
+        logical_sum = 0
+        fp_groups: Dict[str, int] = {}
         for node, p in self.peers().items():
             cap = (p["digest"] or {}).get("capacity") or {}
             rows[node] = {"capacity": cap, "stale": p["stale"]}
             if not p["stale"]:
                 total += int(cap.get("table_bytes", 0))
                 peak = max(peak, int(cap.get("mem_peak_bytes", 0)))
+        for node, row in rows.items():
+            if row.get("stale"):
+                continue
+            cap = row["capacity"]
+            ls = int(cap.get("logical_subs", 0))
+            logical_sum += ls
+            if ls <= 0:
+                # empty tables (or pre-rollup digests) form no replica
+                # group — matches the apiserver single-node fallback
+                continue
+            key = cap.get("subs_fp") or f"node:{node}"
+            fp_groups[key] = max(fp_groups.get(key, 0), ls)
         return {"nodes": rows,
                 "total_table_bytes": total,
-                "max_mem_peak_bytes": peak}
+                "max_mem_peak_bytes": peak,
+                "logical_subs": {
+                    "sum": logical_sum,
+                    "dedup": sum(fp_groups.values()),
+                    "replica_groups": len(fp_groups),
+                }}
 
     # ---------------- lifecycle ----------------------------------------------
 

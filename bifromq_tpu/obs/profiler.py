@@ -75,7 +75,14 @@ class BatchRecord:
 class CompileLedger:
     """Bounded ledger of base-install events (ISSUE 8: rebuild storms
     must be attributable). Appended from the matcher's install path —
-    once per compile, so a deque with a lock-free append is plenty."""
+    once per compile, so a deque with a lock-free append is plenty.
+
+    ISSUE 9: the ledger also carries the PATCH stream — every coalesced
+    device patch flush records its trigger (``rows`` scatter vs a
+    ``node``/``edge`` reshape re-upload), how many mutations it folded,
+    rows touched and host→device bytes shipped — so subscription churn
+    reads as a sequence of narrow updates next to the (now rare)
+    compiles, not as silence."""
 
     CAP = 256
 
@@ -85,6 +92,12 @@ class CompileLedger:
         self.total = 0
         self.total_compile_s = 0.0
         self.generation_bumps = 0
+        self._patch_events: deque = deque(maxlen=self.CAP)
+        self.patch_flushes = 0
+        self.patch_mutations = 0
+        self.patch_rows = 0
+        self.patch_bytes = 0
+        self.patch_total_s = 0.0
 
     def record(self, *, reason: str, duration_s: float, salt,
                n_nodes: int, table_bytes: int,
@@ -106,21 +119,55 @@ class CompileLedger:
             "kind": kind,
         })
 
+    def record_patch(self, *, reason: str, mutations: int, rows: int,
+                     bytes_shipped: int, duration_s: float) -> None:
+        self.patch_flushes += 1
+        self.patch_mutations += mutations
+        self.patch_rows += rows
+        self.patch_bytes += bytes_shipped
+        self.patch_total_s += duration_s
+        self._patch_events.append({
+            "ts": round(self._clock(), 3),
+            "reason": reason,
+            "mutations": mutations,
+            "rows": rows,
+            "bytes": bytes_shipped,
+            "apply_ms": round(duration_s * 1e3, 4),
+        })
+
     def events(self, limit: int = 0) -> List[dict]:
         evs = list(self._events)
+        return evs[-limit:] if limit > 0 else evs
+
+    def patch_events(self, limit: int = 0) -> List[dict]:
+        evs = list(self._patch_events)
         return evs[-limit:] if limit > 0 else evs
 
     def snapshot(self, limit: int = 16) -> dict:
         return {"total": self.total,
                 "total_compile_s": round(self.total_compile_s, 3),
                 "generation_bumps": self.generation_bumps,
-                "events": self.events(limit)}
+                "events": self.events(limit),
+                "patch": {
+                    "flushes": self.patch_flushes,
+                    "mutations": self.patch_mutations,
+                    "rows": self.patch_rows,
+                    "bytes": self.patch_bytes,
+                    "total_apply_s": round(self.patch_total_s, 4),
+                    "events": self.patch_events(limit),
+                }}
 
     def reset(self) -> None:
         self._events.clear()
         self.total = 0
         self.total_compile_s = 0.0
         self.generation_bumps = 0
+        self._patch_events.clear()
+        self.patch_flushes = 0
+        self.patch_mutations = 0
+        self.patch_rows = 0
+        self.patch_bytes = 0
+        self.patch_total_s = 0.0
 
 
 def _pctl(sorted_vals: List[float], q: float) -> float:
@@ -153,8 +200,14 @@ class ContinuousProfiler:
         self.emit_calls_total = 0
         self.emit_cap_total = 0
         self.emit_depth_total = 0
-        # tunnel-RTT probe cache (guarded: never triggers backend init)
-        self._rtt_ms: Optional[float] = None
+        # tunnel-RTT probe cache (guarded: never triggers backend init).
+        # ISSUE 9 satellite (PR 8 follow-up): keyed per device_kind so a
+        # process that falls back from TPU to CPU (or recovers) stops
+        # blending the dispatch/kernel split across backends — a backend
+        # change reads a different cache slot instead of a stale number.
+        self._rtt_cache: dict = {}      # device_kind -> (ms|None, probed_at)
+        self._rtt_ms: Optional[float] = None    # last-probed (compat view)
+        self._rtt_kind: Optional[str] = None    # backend the split speaks for
         self._rtt_at = -1e18
 
     # ---------------- hot-path recording (the <2% budget) ------------------
@@ -204,23 +257,48 @@ class ContinuousProfiler:
         verbatim, because it IS that implementation)."""
         return self._ring.since(cursor)
 
-    def rtt_probe_ms(self, *, force: bool = False) -> Optional[float]:
-        """Median of 4 tiny scalar device round trips — the transport
-        cost a sync readback pays (axon tunnel ~70ms, CPU ~µs). TTL
-        cached; NEVER triggers backend init (a dead tunnel would hang
-        it), so it returns None until real device work has run."""
-        now = self._clock()
-        if not force and now - self._rtt_at < self.RTT_PROBE_TTL_S:
-            return self._rtt_ms
-        self._rtt_at = now
+    @staticmethod
+    def _backend_kind() -> Optional[str]:
+        """The live backend's device_kind WITHOUT triggering backend init
+        (a dead tunnel would hang it) — None until real device work ran."""
         try:
             import sys
             if "jax" not in sys.modules:
-                raise LookupError("jax not loaded")
+                return None
             import jax
             from jax._src import xla_bridge as _xb
             if not getattr(_xb, "_backends", None):
-                raise LookupError("jax backend not initialized")
+                return None
+            d = jax.devices()[0]
+            return getattr(d, "device_kind", None) or d.platform
+        except Exception:  # noqa: BLE001 — backend probe is best-effort
+            return None
+
+    def rtt_probe_ms(self, *, force: bool = False) -> Optional[float]:
+        """Median of 4 tiny scalar device round trips — the transport
+        cost a sync readback pays (axon tunnel ~70ms, CPU ~µs). TTL
+        cached PER device_kind (a CPU-fallback process that later reaches
+        the TPU re-probes instead of reusing the µs CPU number); NEVER
+        triggers backend init, so it returns None until real device work
+        has run."""
+        kind = self._backend_kind()
+        now = self._clock()
+        if kind is None:
+            # no backend yet: keep the old TTL-on-failure behavior so a
+            # flapping tunnel isn't probed on every snapshot
+            if not force and now - self._rtt_at < self.RTT_PROBE_TTL_S:
+                return None
+            self._rtt_at = now
+            self._rtt_ms = None
+            self._rtt_kind = None
+            return None
+        cached = self._rtt_cache.get(kind)
+        if not force and cached is not None \
+                and now - cached[1] < self.RTT_PROBE_TTL_S:
+            self._rtt_ms, self._rtt_kind = cached[0], kind
+            return cached[0]
+        try:
+            import jax
             import numpy as np
             samples = []
             for _ in range(4):
@@ -228,10 +306,14 @@ class ContinuousProfiler:
                 np.asarray(jax.device_put(np.zeros(1, np.int32)))
                 samples.append(time.perf_counter() - t0)
             samples.sort()
-            self._rtt_ms = round(samples[len(samples) // 2] * 1e3, 4)
-        except Exception:  # noqa: BLE001 — tunnel down / jax absent
-            self._rtt_ms = None
-        return self._rtt_ms
+            ms = round(samples[len(samples) // 2] * 1e3, 4)
+        except Exception:  # noqa: BLE001 — tunnel down mid-probe
+            ms = None
+        self._rtt_cache[kind] = (ms, now)
+        self._rtt_ms = ms
+        self._rtt_kind = kind
+        self._rtt_at = now
+        return ms
 
     def split_snapshot(self, *, probe: bool = True) -> dict:
         """The rtt/kernel decomposition over the retained ring: stage
@@ -248,8 +330,17 @@ class ContinuousProfiler:
             key = stage[:-2]
             out[f"{key}_ms_p50"] = round(_pctl(vals, 0.50) * 1e3, 4)
             out[f"{key}_ms_p99"] = round(_pctl(vals, 0.99) * 1e3, 4)
-        rtt = self.rtt_probe_ms() if probe else self._rtt_ms
+        if probe:
+            rtt = self.rtt_probe_ms()
+            kind = self._rtt_kind
+        else:
+            # cached-only path: still resolve the CURRENT backend's slot
+            # so a backend change never serves the other backend's RTT
+            kind = self._backend_kind()
+            rtt = (self._rtt_cache.get(kind, (None, 0.0))[0]
+                   if kind is not None else None)
         out["tunnel_rtt_ms"] = rtt
+        out["rtt_device_kind"] = kind
         ready_p50 = out["ready_ms_p50"]
         fetch_p50 = out["fetch_ms_p50"]
         if rtt is not None:
@@ -311,5 +402,7 @@ class ContinuousProfiler:
         self.emit_calls_total = 0
         self.emit_cap_total = 0
         self.emit_depth_total = 0
+        self._rtt_cache = {}
         self._rtt_ms = None
+        self._rtt_kind = None
         self._rtt_at = -1e18

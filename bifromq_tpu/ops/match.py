@@ -65,9 +65,10 @@ RT_COLS = 8
 
 
 def route_cols_from_node_tab(node_tab: np.ndarray) -> np.ndarray:
-    """Extract the RT_* route-walk columns from a full node table — the
-    ONE construction site for the layout (single-chip DeviceTrie and the
-    mesh's per-shard stacking both use it)."""
+    """Extract the RT_* route-walk columns from a full node table (or any
+    row slice of one) — the ONE construction site for the layout
+    (single-chip DeviceTrie, the mesh's per-shard stacking, and the
+    ISSUE 9 patch flush all use it)."""
     from ..models.automaton import (
         NODE_HRCOUNT, NODE_HRSTART, NODE_RSTART,
     )
@@ -78,6 +79,18 @@ def route_cols_from_node_tab(node_tab: np.ndarray) -> np.ndarray:
     route_cols[:, RT_HRSTART] = node_tab[:, NODE_HRSTART]
     route_cols[:, RT_RSTART] = node_tab[:, NODE_RSTART]
     return route_cols
+
+
+def count_cols_from_node_tab(node_tab: np.ndarray) -> np.ndarray:
+    """Extract the CT_* count-walk columns (same one-construction-site
+    contract as route_cols_from_node_tab; shared by the upload path and
+    the patch flush)."""
+    from ..models.automaton import NODE_HRCOUNT
+    count_cols = np.zeros((node_tab.shape[0], CT_COLS), dtype=np.int32)
+    count_cols[:, CT_PLUS] = node_tab[:, NODE_PLUS]
+    count_cols[:, CT_HRCOUNT] = node_tab[:, NODE_HRCOUNT]
+    count_cols[:, CT_RCOUNT] = node_tab[:, NODE_RCOUNT]
+    return count_cols
 
 
 @jax.tree_util.register_pytree_node_class
@@ -108,18 +121,12 @@ class DeviceTrie:
 
     @staticmethod
     def from_compiled(ct: CompiledTrie, device=None) -> "DeviceTrie":
-        from ..models.automaton import NODE_HRCOUNT
         put = functools.partial(jax.device_put, device=device)
-        count_cols = np.zeros((ct.node_tab.shape[0], CT_COLS),
-                              dtype=np.int32)
-        count_cols[:, CT_PLUS] = ct.node_tab[:, NODE_PLUS]
-        count_cols[:, CT_HRCOUNT] = ct.node_tab[:, NODE_HRCOUNT]
-        count_cols[:, CT_RCOUNT] = ct.node_tab[:, NODE_RCOUNT]
         return DeviceTrie(
             node_tab=put(ct.node_tab),
             edge_tab=put(ct.edge_tab),
             child_list=put(ct.child_list),
-            count_tab=put(count_cols),
+            count_tab=put(count_cols_from_node_tab(ct.node_tab)),
             route_tab=put(route_cols_from_node_tab(ct.node_tab)),
         )
 
@@ -685,6 +692,106 @@ def walk_routes_donated(trie, probes, **kw):
         warnings.filterwarnings(
             "ignore", message="Some donated buffers were not usable")
         return _walk_routes_donated_jit(trie, probes, **kw)
+
+
+# ------------------- device-side patch application (ISSUE 9) ---------------
+#
+# A host patch plan (models.automaton.PatchableTrie) ships to device as
+# NARROW row scatters — idx + row values only, never a whole-table
+# re-upload — unless the arena reshaped (node growth / edge regrow), which
+# re-puts just the reshaped table. The update is FUNCTIONAL by default
+# (`tab.at[idx].set` returns a new array; the old one stays alive for any
+# in-flight dispatch pinning it — the same double-buffer discipline as a
+# compaction swap); with ``donate=True`` XLA aliases the update in place
+# (O(rows) device work, no table copy), which is only legal when the
+# caller proves no in-flight batch references the old tables.
+
+_PATCH_PAD_FLOOR = 8
+
+
+@jax.jit
+def _scatter_rows(tab, idx, vals):
+    return tab.at[idx].set(vals)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_rows_donated(tab, idx, vals):
+    return tab.at[idx].set(vals)
+
+
+def _pad_patch_idx(idx: np.ndarray) -> np.ndarray:
+    """pow2-snap a dirty-row index vector (every distinct scatter shape
+    costs an XLA trace) by repeating the last index — duplicate indices
+    write identical values, so the result is deterministic."""
+    from ..models.automaton import _next_pow2
+    p = _next_pow2(idx.shape[0], floor=_PATCH_PAD_FLOOR)
+    if p == idx.shape[0]:
+        return idx
+    return np.concatenate(
+        [idx, np.full(p - idx.shape[0], idx[-1], idx.dtype)])
+
+
+def patch_device_trie(dev: DeviceTrie, pt, *, device=None,
+                      donate: bool = False):
+    """Apply a PatchableTrie's pending dirty set to the device tables.
+
+    Returns ``(new DeviceTrie, stats)`` with ``stats`` carrying the rows
+    touched, host→device bytes shipped, the mutation count drained, and
+    whether any table reshaped (the caller re-warms the walk jit then).
+    """
+    full, node_rows, edge_rows, ops = pt.drain_dirty()
+    try:
+        return _patch_device_trie(dev, pt, full, node_rows, edge_rows,
+                                  ops, device=device, donate=donate)
+    except BaseException:
+        # the drained rows must not be lost (a donated partial update may
+        # even have consumed a table): fall back to full re-upload dirt
+        pt.restore_dirty(ops)
+        raise
+
+
+def _patch_device_trie(dev, pt, full, node_rows, edge_rows, ops, *,
+                       device, donate):
+    put = functools.partial(jax.device_put, device=device)
+    scatter = _scatter_rows_donated if donate else _scatter_rows
+    stats = {"rows": 0, "bytes": 0, "ops": ops, "reshaped": False,
+             "full": sorted(full), "donated": bool(donate)}
+    node_tab, count_tab, route_tab = (dev.node_tab, dev.count_tab,
+                                      dev.route_tab)
+    edge_tab = dev.edge_tab
+    if "node" in full:
+        stats["reshaped"] |= tuple(pt.node_tab.shape) \
+            != tuple(dev.node_tab.shape)
+        node_tab = put(pt.node_tab)
+        count_tab = put(count_cols_from_node_tab(pt.node_tab))
+        route_tab = put(route_cols_from_node_tab(pt.node_tab))
+        stats["rows"] += int(pt.node_tab.shape[0])
+        stats["bytes"] += int(pt.node_tab.nbytes) \
+            + pt.node_tab.shape[0] * (CT_COLS + RT_COLS) * 4
+    elif node_rows.size:
+        idx = _pad_patch_idx(node_rows.astype(np.int32))
+        rows = pt.node_tab[idx]
+        node_tab = scatter(node_tab, idx, rows)
+        count_tab = scatter(count_tab, idx, count_cols_from_node_tab(rows))
+        route_tab = scatter(route_tab, idx, route_cols_from_node_tab(rows))
+        stats["rows"] += int(node_rows.size)
+        stats["bytes"] += int(idx.nbytes) * 3 + int(rows.nbytes) \
+            + idx.shape[0] * (CT_COLS + RT_COLS) * 4
+    if "edge" in full:
+        stats["reshaped"] |= tuple(pt.edge_tab.shape) \
+            != tuple(dev.edge_tab.shape)
+        edge_tab = put(pt.edge_tab)
+        stats["rows"] += int(pt.edge_tab.shape[0])
+        stats["bytes"] += int(pt.edge_tab.nbytes)
+    elif edge_rows.size:
+        idx = _pad_patch_idx(edge_rows.astype(np.int32))
+        rows = pt.edge_tab[idx]
+        edge_tab = scatter(edge_tab, idx, rows)
+        stats["rows"] += int(edge_rows.size)
+        stats["bytes"] += int(idx.nbytes) + int(rows.nbytes)
+    return DeviceTrie(node_tab=node_tab, edge_tab=edge_tab,
+                      child_list=dev.child_list, count_tab=count_tab,
+                      route_tab=route_tab), stats
 
 
 def _expand_lib():

@@ -299,6 +299,11 @@ class MeshMatcher(TpuMatcher):
     # TpuMatcher._dispatch_device) degrades to this sync path; pipelining
     # the mesh step is the ROADMAP multi-chip item's business
     supports_async = False
+    # ISSUE 9: the compile target is ShardedTables (per-shard stacks on a
+    # mesh), not the single-chip PatchableTrie — mutations keep the
+    # overlay+compaction path; per-shard independent patching is the
+    # sharded-matcher ROADMAP follow-up this PR's arena layout unlocks
+    supports_patching = False
 
     def __init__(self, tries: Optional[Dict[str, SubscriptionTrie]] = None,
                  mesh: Optional[Mesh] = None, *,

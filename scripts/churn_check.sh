@@ -1,0 +1,130 @@
+#!/usr/bin/env bash
+# Tier-2 subscription-churn gate (ISSUE 9): sustained subscribe /
+# unsubscribe against a live base on CPU-scaled inputs, asserting the
+# incremental-patch contract:
+#   1. ZERO full rebuilds inside the churn window (steady churn below the
+#      tombstone threshold must never trigger the old every-2048-mutations
+#      recompile),
+#   2. ZERO match-cache generation bumps (patches and same-salt
+#      compactions keep every cached result valid),
+#   3. single-mutation patch apply (host plan + narrow device update) p99
+#      under a CPU-scaled bound AND >=100x faster than this base's own
+#      full-rebuild cost,
+#   4. exact host-oracle row parity after the storm — including the
+#      tombstone-walk paths ('#'/'+'/$share filters churned and removed).
+# Runs on CPU (JAX_PLATFORMS=cpu), hard timeout like the other gates.
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+
+timeout -k 10 "${CHURN_CHECK_TIMEOUT:-420}" \
+    env JAX_PLATFORMS=cpu \
+    python - <<'EOF'
+import os, random, time
+
+import numpy as np
+
+from bifromq_tpu import workloads
+from bifromq_tpu.models.matcher import TpuMatcher
+from bifromq_tpu.models.oracle import Route
+from bifromq_tpu.obs import OBS
+from bifromq_tpu.types import RouteMatcher
+
+N_SUBS = int(os.environ.get("CHURN_CHECK_SUBS", "20000"))
+N_OPS = int(os.environ.get("CHURN_CHECK_OPS", "400"))
+P99_MS_MAX = float(os.environ.get("CHURN_CHECK_P99_MS", "250"))
+SPEEDUP_MIN = float(os.environ.get("CHURN_CHECK_SPEEDUP", "100"))
+
+
+def mk(tf, rid, inc=0, broker=0):
+    return Route(matcher=RouteMatcher.from_topic_filter(tf),
+                 broker_id=broker, receiver_id=rid, deliverer_key="d0",
+                 incarnation=inc)
+
+
+def canon(m):
+    return (sorted((r.matcher.mqtt_topic_filter, r.receiver_url)
+                   for r in m.normal),
+            {f: sorted(r.receiver_url for r in ms)
+             for f, ms in m.groups.items()})
+
+
+tries = workloads.config_wildcard(N_SUBS, seed=0)
+m = TpuMatcher.from_tries(tries, match_cache=True)
+rebuild_s = m._last_compile_s
+assert hasattr(m._base_ct, "patch_stats"), \
+    "base is not patchable — BIFROMQ_PATCH off?"
+gen0 = m.match_cache._gen
+compiles0 = m.compile_count
+bumps0 = OBS.profiler.ledger.generation_bumps
+
+topics = workloads.probe_topics(1024, seed=1)
+batches = [[("tenant0", t) for t in topics[i * 64:(i + 1) * 64]]
+           for i in range(8)]
+m.match_batch(batches[0])                         # warm walk shapes
+m.add_route("tenant0", mk("churn/warm/+", "w"))   # warm the scatter jit
+m._flush_patches()
+
+# ---- the storm: mixed adds/removes across wildcard + shared filters ----
+rng = random.Random(7)
+kinds = ["churn/{i}/+", "churn/{i}/#", "churn/lit/{i}", "$share/g{g}/churn/{i}/+"]
+live = []
+lat = []
+for i in range(N_OPS):
+    tf = rng.choice(kinds).format(i=i % 64, g=i % 4)
+    rid = f"r{rng.randrange(96)}"
+    s0 = time.perf_counter()
+    if rng.random() < 0.6 or not live:
+        m.add_route("tenant0", mk(tf, rid, inc=i))
+        live.append((tf, rid))
+    else:
+        tf2, rid2 = live.pop(rng.randrange(len(live)))
+        m.remove_route("tenant0", RouteMatcher.from_topic_filter(tf2),
+                       (0, rid2, "d0"), incarnation=i)
+    m._flush_patches()
+    lat.append(time.perf_counter() - s0)
+    if i % 16 == 0:
+        got = m.match_batch(batches[(i // 16) % 8])
+        want = m.match_from_tries(batches[(i // 16) % 8])
+        assert all(canon(a) == canon(b) for a, b in zip(got, want)), \
+            f"mid-storm parity broke at op {i}"
+m.drain()
+
+# ---- 1. zero full rebuilds in the window -------------------------------
+rebuilds = m.compile_count - compiles0
+assert rebuilds == 0, f"{rebuilds} full rebuild(s) during steady churn"
+
+# ---- 2. zero generation bumps ------------------------------------------
+assert m.match_cache._gen == gen0, "match-cache generation bumped"
+assert OBS.profiler.ledger.generation_bumps == bumps0
+
+# ---- 3. patch-apply p99 bound + speedup vs the full rebuild ------------
+p99 = float(np.percentile(lat, 99))
+assert p99 * 1e3 < P99_MS_MAX, \
+    f"patch apply p99 {p99*1e3:.1f}ms >= {P99_MS_MAX}ms"
+speedup = rebuild_s / max(1e-9, p99)
+assert speedup >= SPEEDUP_MIN, \
+    f"patch apply only {speedup:.0f}x faster than the {rebuild_s:.2f}s rebuild"
+
+# ---- 4. exact oracle parity after the storm ----------------------------
+probe = [("tenant0", t) for t in topics[:256]]
+probe += [("tenant0", ["churn", str(i), "leaf"]) for i in range(64)]
+probe += [("tenant0", ["churn", "lit", str(i)]) for i in range(64)]
+got = m.match_batch(probe)
+want = m.match_from_tries(probe)
+bad = sum(1 for a, b in zip(got, want) if canon(a) != canon(b))
+assert bad == 0, f"{bad}/{len(probe)} rows diverge from the oracle"
+
+st = m._base_ct.patch_stats()
+print(f"churn gate OK: {N_OPS} ops, rebuilds=0, generation bumps=0, "
+      f"patch p99 {p99*1e3:.2f}ms ({speedup:.0f}x vs {rebuild_s:.2f}s "
+      f"rebuild), parity {len(probe)}/{len(probe)}, "
+      f"frag={st['frag_ratio']} dead={st['dead_slots']} "
+      f"relocations={st['relocations']}")
+EOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "churn_check: FAILED (rc=$rc)" >&2
+    exit $rc
+fi
+echo "churn_check: OK"

@@ -909,6 +909,57 @@ class TestClusterCapacity:
         assert table["total_table_bytes"] == local_tb + 12345
         assert table["max_mem_peak_bytes"] >= 777
 
+    async def test_logical_subs_rollup_dedups_by_fingerprint(self):
+        """ISSUE 9 satellite (PR 8 follow-up): physical table bytes sum
+        per node (that's what HBM holds), but LOGICAL subscriptions dedup
+        by the gossiped subscription-set fingerprint — two replicas of
+        one route table count once; a disjoint shard counts on top."""
+        host = FakeHost("me")
+        host.agent_meta["rep1"] = {
+            "addr": "127.0.0.1:6001",
+            "digest": _peer_digest(capacity={
+                "table_bytes": 100, "logical_subs": 40,
+                "subs_fp": "aaaa"})}
+        host.agent_meta["rep2"] = {
+            "addr": "127.0.0.1:6002",
+            "digest": _peer_digest(capacity={
+                "table_bytes": 100, "logical_subs": 40,
+                "subs_fp": "aaaa"})}
+        host.agent_meta["shardx"] = {
+            "addr": "127.0.0.1:6003",
+            "digest": _peer_digest(capacity={
+                "table_bytes": 50, "logical_subs": 7,
+                "subs_fp": "bbbb"})}
+        view = ClusterView("me", host, hub=_fresh_hub())
+        table = view.capacity_table()
+        ls = table["logical_subs"]
+        assert ls["sum"] == 40 + 40 + 7          # naive per-node census
+        assert ls["dedup"] == 40 + 7             # replicas counted once
+        # physical bytes stay per-node (replicas DO occupy HBM twice)
+        assert table["total_table_bytes"] >= 100 + 100 + 50
+
+    async def test_local_digest_reports_logical_subs(self):
+        from bifromq_tpu.models.matcher import TpuMatcher
+        from bifromq_tpu.models.oracle import Route
+        from bifromq_tpu.types import RouteMatcher
+        hub = _fresh_hub()
+        m = TpuMatcher(auto_compact=False)
+        for i in range(3):
+            m.add_route("T", Route(
+                matcher=RouteMatcher.from_topic_filter(f"cap/{i}"),
+                broker_id=0, receiver_id=f"r{i}", deliverer_key="d"))
+        m.refresh()
+        hub.device.register_matcher(m)
+        from bifromq_tpu.obs.capacity import digest_capacity
+        cap = digest_capacity(hub)
+        assert cap["logical_subs"] == 3
+        assert len(cap["subs_fp"]) == 16
+        # the fingerprint tracks the census: a removal changes it
+        fp0 = cap["subs_fp"]
+        m.remove_route("T", RouteMatcher.from_topic_filter("cap/0"),
+                       (0, "r0", "d"))
+        assert digest_capacity(hub)["subs_fp"] != fp0
+
     async def test_stale_peer_excluded_from_totals(self):
         t0 = time.time()
         now = [t0]

@@ -92,6 +92,55 @@ class TestProfilerCore:
         assert snap["compile_ledger"]["total"] == 0
 
 
+class TestRTTPerBackend:
+    """ISSUE 9 satellite (PR 8 follow-up): the tunnel-RTT probe caches
+    per device_kind and a backend change reads its own slot instead of
+    blending the other backend's split."""
+
+    def test_backend_change_invalidates_cached_split(self):
+        clock = [1000.0]
+        kind = ["cpu"]
+        p = ContinuousProfiler(clock=lambda: clock[0])
+        p._backend_kind = lambda: kind[0]
+        # seed two backend slots directly (the probe path itself needs a
+        # live device; the caching contract is what's under test)
+        p._rtt_cache["cpu"] = (0.05, clock[0])
+        p._rtt_cache["TPU v5e"] = (70.0, clock[0])
+        assert p.rtt_probe_ms() == 0.05
+        snap = p.split_snapshot(probe=False)
+        assert snap["rtt_device_kind"] == "cpu"
+        assert snap["tunnel_rtt_ms"] == 0.05
+        # the process falls over to the TPU tunnel: same TTL window, but
+        # the split must speak for the NEW backend immediately
+        kind[0] = "TPU v5e"
+        assert p.rtt_probe_ms() == 70.0
+        snap = p.split_snapshot(probe=False)
+        assert snap["rtt_device_kind"] == "TPU v5e"
+        assert snap["tunnel_rtt_ms"] == 70.0
+
+    def test_live_probe_stamps_kind_and_caches(self):
+        # the real path against the initialized CPU backend
+        import jax
+        jax.devices()
+        p = ContinuousProfiler()
+        ms = p.rtt_probe_ms()
+        assert ms is not None and ms >= 0
+        kind = p._rtt_kind
+        assert kind and p._rtt_cache[kind][0] == ms
+        snap = p.split_snapshot(probe=False)
+        assert snap["rtt_device_kind"] == kind
+
+    def test_no_backend_keeps_ttl_on_failure(self):
+        clock = [0.0]
+        p = ContinuousProfiler(clock=lambda: clock[0])
+        p._backend_kind = lambda: None
+        assert p.rtt_probe_ms() is None
+        at0 = p._rtt_at
+        clock[0] += 1.0                 # inside the TTL: no re-probe
+        assert p.rtt_probe_ms() is None
+        assert p._rtt_at == at0
+
+
 class TestMatcherIntegration:
     def _matcher(self, n=60, **kw) -> TpuMatcher:
         m = TpuMatcher(auto_compact=False, **kw)
@@ -124,10 +173,15 @@ class TestMatcherIntegration:
         snap = OBS.profiler.snapshot()
         assert snap["cache_bypass_rate"] > 0
 
-    def test_compile_ledger_attribution_across_forced_compaction(self):
+    def test_compile_ledger_attribution_across_forced_compaction(
+            self, monkeypatch):
         """first_base → threshold → forced, each with duration, salt,
         table bytes and the VMEM verdict — rebuild storms must read as
-        a sequence of causes."""
+        a sequence of causes. Pinned to the overlay path (ISSUE 9: with
+        patching on, mutations fold into the base and the overlay
+        threshold never fires — patched churn is ledgered as `patch`
+        events instead, tests/test_patch.py)."""
+        monkeypatch.setenv("BIFROMQ_PATCH", "0")
         OBS.profiler.reset()
         m = TpuMatcher(auto_compact=True, compact_threshold=8)
         m.add_route("T", mk_route("a/0", "r0"))     # first_base (bg)
