@@ -1,0 +1,123 @@
+"""graftcheck — project-specific static analysis for the device hot path
+(ISSUE 10 tentpole).
+
+Five rules, each a mechanically-detectable bug class a prior PR shipped
+a hand-found fix for:
+
+====  =====================================================
+R1    hot-path host sync (``.item()`` / ``np.asarray`` /
+      ``block_until_ready`` reachable from the jit'd walk
+      bodies and the async dispatch/fetch legs)
+R2    use-after-donate (reads of a ``donate_argnums`` binding
+      after the donating call, no quarantine/reassign between)
+R3    env-knob discipline (raw ``os.environ`` BIFROMQ_* reads,
+      import-time knob freezing, README knob-table drift)
+R4    lock discipline (inconsistent pairwise lock order,
+      blocking calls while holding a lock)
+R5    trace/metric registry drift (span names vs the README
+      span table, stage/metric names vs the registries)
+====  =====================================================
+
+Run ``python -m bifromq_tpu.analysis`` over the package; tier-1 runs it
+as a zero-findings test (tests/test_analysis.py), tier-2 as
+``scripts/analysis_check.sh``. Intentional exceptions live in
+``suppressions.txt`` next to this file — every entry needs a
+justification and must still match a live finding. ``stamp.json`` is
+the checked-in last-run stamp served under ``GET /metrics`` build-info
+so analyzer drift is visible on a live node.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+from .core import (Context, Finding, Report, Rule,  # noqa: F401
+                   SuppressionError, apply_suppressions,
+                   parse_suppressions)
+from .donation import UseAfterDonateRule
+from .drift import RegistryDriftRule
+from .envknobs import EnvKnobRule
+from .hostsync import HostSyncRule
+from .locks import LockDisciplineRule
+
+ALL_RULES = (HostSyncRule, UseAfterDonateRule, EnvKnobRule,
+             LockDisciplineRule, RegistryDriftRule)
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+SUPPRESSIONS_PATH = os.path.join(_PKG_DIR, "suppressions.txt")
+STAMP_PATH = os.path.join(_PKG_DIR, "stamp.json")
+
+
+def default_root() -> str:
+    """The installed bifromq_tpu package directory."""
+    return os.path.dirname(_PKG_DIR)
+
+
+def default_readme() -> Optional[str]:
+    """README.md next to the package (repo checkout); None when the
+    package is installed without one — README-drift checks then skip."""
+    cand = os.path.join(os.path.dirname(default_root()), "README.md")
+    return cand if os.path.exists(cand) else None
+
+
+def run_analysis(root: Optional[str] = None,
+                 readme: Optional[str] = None,
+                 suppressions: Optional[str] = None,
+                 rules: Optional[List[type]] = None) -> Report:
+    """Run graftcheck and fold in suppressions. Defaults analyze the
+    installed package against its own suppression file."""
+    if root is None:
+        root = default_root()
+        if readme is None:
+            readme = default_readme()
+        if suppressions is None:
+            suppressions = SUPPRESSIONS_PATH
+    ctx = Context(root, readme=readme)
+    findings: List[Finding] = list(ctx.parse_errors)
+    rule_ids = []
+    for rule_cls in (rules or ALL_RULES):
+        rule = rule_cls()
+        rule_ids.append(rule.rule_id)
+        findings.extend(rule.run(ctx))
+    sups = parse_suppressions(suppressions) if suppressions else []
+    report = apply_suppressions(findings, sups)
+    report.rule_ids = rule_ids
+    return report
+
+
+def write_stamp(report: Report, path: str = STAMP_PATH) -> dict:
+    global _STAMP_CACHE
+    stamp = report.to_dict()
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(stamp, f, indent=1, sort_keys=True)
+        f.write("\n")
+    _STAMP_CACHE = None     # the process just changed what it serves
+    return stamp
+
+
+def _load_stamp() -> dict:
+    # cached: the checked-in stamp is immutable for the process
+    # lifetime, and /metrics scrapes must not pay file I/O per hit
+    try:
+        with open(STAMP_PATH, encoding="utf-8") as f:
+            stamp = json.load(f)
+        stamp["stamp"] = "ok"
+        return stamp
+    except (OSError, ValueError):
+        return {"stamp": "missing"}
+
+
+_STAMP_CACHE: Optional[dict] = None
+
+
+def build_info() -> dict:
+    """The ``GET /metrics`` build-info payload: the checked-in stamp
+    (rule count, suppression count, last-run hash). Never raises — a
+    missing/corrupt stamp reports as such instead of breaking the
+    metrics scrape."""
+    global _STAMP_CACHE
+    if _STAMP_CACHE is None:
+        _STAMP_CACHE = _load_stamp()
+    return dict(_STAMP_CACHE)

@@ -1,0 +1,73 @@
+"""CLI: ``python -m bifromq_tpu.analysis [--root DIR] [--json]
+[--write-stamp]``.
+
+Exit codes: 0 clean; 1 unsuppressed findings or dead suppressions;
+2 bad invocation / malformed suppression file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import (SUPPRESSIONS_PATH, SuppressionError, run_analysis,
+               write_stamp)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m bifromq_tpu.analysis")
+    p.add_argument("--root", default=None,
+                   help="package dir to analyze (default: the installed "
+                        "bifromq_tpu)")
+    p.add_argument("--readme", default=None,
+                   help="README for the drift checks (default: the "
+                        "repo's when analyzing the installed package)")
+    p.add_argument("--suppressions", default=None,
+                   help=f"suppression file (default: {SUPPRESSIONS_PATH}"
+                        f" for the installed package; none for --root)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    p.add_argument("--write-stamp", action="store_true",
+                   help="refresh the checked-in stamp.json on a clean run")
+    args = p.parse_args(argv)
+    try:
+        report = run_analysis(root=args.root, readme=args.readme,
+                              suppressions=args.suppressions)
+    except SuppressionError as e:
+        print(f"graftcheck: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        payload = report.to_dict()
+        payload["findings"] = [f.render() for f in report.findings]
+        payload["dead"] = [s.key for s in report.dead_suppressions]
+        print(json.dumps(payload, indent=1))
+    else:
+        for f in report.findings:
+            print(f.render())
+        for s in report.dead_suppressions:
+            print(f"suppressions.txt:{s.lineno}: dead suppression "
+                  f"(matches no finding): {s.key}")
+        d = report.to_dict()
+        print(f"graftcheck: {d['rules']} rules, "
+              f"{d['suppressed']} suppressed "
+              f"({d['suppressions']} entries), "
+              f"{d['unsuppressed']} unsuppressed, "
+              f"{d['dead_suppressions']} dead suppressions "
+              f"[hash {d['hash']}]")
+    if report.clean and args.write_stamp:
+        if args.root or args.suppressions or args.readme:
+            # the checked-in stamp describes THE package against ITS
+            # suppression file — a clean run over some other tree must
+            # never overwrite it (GET /metrics serves this file)
+            print("graftcheck: --write-stamp only applies to the "
+                  "default (installed-package) analysis; drop --root/"
+                  "--suppressions/--readme", file=sys.stderr)
+            return 2
+        write_stamp(report)
+        print(f"stamp written: {report.stamp_hash()}")
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,280 @@
+"""graftcheck core: parsed-file context, findings, suppressions (ISSUE 10).
+
+The analyzer is AST-only — it never imports the code it checks, so a
+broken module is a parse finding, not a crash, and the suite can run on
+fixture snippets that intentionally violate the rules. Each rule is a
+``Rule`` subclass producing :class:`Finding` rows; intentional
+exceptions live in a checked-in suppression file keyed by a *site key*
+(rule, relative path, enclosing scope, symbol) — stable across line
+churn, unlike line numbers — and every entry must still match a live
+finding (dead suppressions fail the run, so the file cannot rot).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str       # stable rule id (R1..R5)
+    path: str       # path relative to the analysis root
+    line: int
+    scope: str      # dotted qualname of the enclosing def(s); '' = module
+    symbol: str     # what tripped: call name, knob, lock pair, span name
+    message: str
+
+    @property
+    def key(self) -> str:
+        """The suppression-file site key (line-number-free on purpose)."""
+        return f"{self.rule} {self.path} {self.scope or '<module>'} " \
+               f"{self.symbol}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+                f"  (key: {self.key})")
+
+
+@dataclass
+class Suppression:
+    rule: str
+    path: str
+    scope: str
+    symbol: str
+    justification: str
+    lineno: int
+    hits: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule} {self.path} {self.scope} {self.symbol}"
+
+
+class SuppressionError(ValueError):
+    pass
+
+
+def parse_suppressions(path: str) -> List[Suppression]:
+    """One entry per line: ``RULE path scope symbol -- justification``.
+
+    ``scope`` is the dotted enclosing-def qualname (``<module>`` for
+    module level). The justification is mandatory — a suppression
+    without a reason is indistinguishable from a silenced bug.
+    """
+    out: List[Suppression] = []
+    if not os.path.exists(path):
+        return out
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "--" not in line:
+                raise SuppressionError(
+                    f"{path}:{i}: missing '-- justification'")
+            site, justification = line.split("--", 1)
+            justification = justification.strip()
+            if not justification:
+                raise SuppressionError(
+                    f"{path}:{i}: empty justification")
+            parts = site.split()
+            if len(parts) != 4:
+                raise SuppressionError(
+                    f"{path}:{i}: expected 'RULE path scope symbol', "
+                    f"got {len(parts)} fields")
+            out.append(Suppression(*parts, justification=justification,
+                                   lineno=i))
+    return out
+
+
+@dataclass
+class ParsedFile:
+    path: str           # relative to root
+    abspath: str
+    tree: ast.Module
+    source: str
+
+    _span_index: Optional[List[Tuple[Tuple[int, int], str]]] = \
+        field(default=None, repr=False)
+
+    def scope_of(self, node: ast.AST) -> str:
+        """Dotted qualname of the innermost def/class enclosing ``node``
+        (by position) — '' for module level."""
+        if self._span_index is None:
+            self._span_index = []
+            self._index(self.tree, "")
+        # the index maps a def/class body line span to its qualname; the
+        # innermost (tightest-span) match wins
+        lineno = getattr(node, "lineno", 0)
+        best, best_span = "", None
+        for (lo, hi), name in self._span_index:
+            if lo <= lineno <= hi and (best_span is None
+                                       or (hi - lo) < best_span):
+                best, best_span = name, hi - lo
+        return best
+
+    def _index(self, node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                name = f"{prefix}.{child.name}" if prefix else child.name
+                hi = max((getattr(n, "lineno", child.lineno)
+                          for n in ast.walk(child)), default=child.lineno)
+                self._span_index.append(((child.lineno, hi), name))
+                self._index(child, name)
+            else:
+                self._index(child, prefix)
+
+
+class Context:
+    """Everything a rule may read: parsed files, the README (optional),
+    and the analysis root."""
+
+    def __init__(self, root: str, readme: Optional[str] = None) -> None:
+        self.root = os.path.abspath(root)
+        self.readme_path = readme
+        self.readme_text: Optional[str] = None
+        if readme and os.path.exists(readme):
+            with open(readme, encoding="utf-8") as f:
+                self.readme_text = f.read()
+        self.files: List[ParsedFile] = []
+        self.parse_errors: List[Finding] = []
+        self._load()
+
+    def _load(self) -> None:
+        for dirpath, dirnames, filenames in os.walk(self.root):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                abspath = os.path.join(dirpath, fn)
+                rel = os.path.relpath(abspath, self.root)
+                with open(abspath, encoding="utf-8") as f:
+                    src = f.read()
+                try:
+                    tree = ast.parse(src, filename=rel)
+                except SyntaxError as e:
+                    self.parse_errors.append(Finding(
+                        rule="R0", path=rel, line=e.lineno or 0,
+                        scope="", symbol="syntax",
+                        message=f"unparseable: {e.msg}"))
+                    continue
+                self.files.append(ParsedFile(path=rel, abspath=abspath,
+                                             tree=tree, source=src))
+
+    def file(self, rel: str) -> Optional[ParsedFile]:
+        for pf in self.files:
+            if pf.path == rel:
+                return pf
+        return None
+
+
+class Rule:
+    rule_id = "R?"
+    title = ""
+
+    def run(self, ctx: Context) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+@dataclass
+class Report:
+    findings: List[Finding]
+    suppressed: List[Tuple[Finding, Suppression]]
+    dead_suppressions: List[Suppression]
+    rule_ids: List[str]
+    n_suppressions: int
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.dead_suppressions
+
+    def stamp_hash(self) -> str:
+        """Deterministic digest of the run's outcome: rule set, every
+        finding key (suppressed or not), and every suppression key —
+        two nodes disagreeing on this hash are running different code
+        or different suppressions."""
+        h = hashlib.sha256()
+        for rid in sorted(self.rule_ids):
+            h.update(rid.encode())
+        for f in sorted(self.findings, key=lambda f: f.key):
+            h.update(f.key.encode())
+        for f, s in sorted(self.suppressed, key=lambda p: p[0].key):
+            h.update(f.key.encode())
+            h.update(s.key.encode())
+        return h.hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {"rules": len(self.rule_ids),
+                "rule_ids": sorted(self.rule_ids),
+                "suppressions": self.n_suppressions,
+                "unsuppressed": len(self.findings),
+                "suppressed": len(self.suppressed),
+                "dead_suppressions": len(self.dead_suppressions),
+                "hash": self.stamp_hash()}
+
+
+def apply_suppressions(findings: List[Finding],
+                       sups: List[Suppression]) -> Report:
+    by_key: Dict[str, Suppression] = {s.key: s for s in sups}
+    live: List[Finding] = []
+    suppressed: List[Tuple[Finding, Suppression]] = []
+    for f in findings:
+        s = by_key.get(f.key)
+        if s is not None:
+            s.hits += 1
+            suppressed.append((f, s))
+        else:
+            live.append(f)
+    dead = [s for s in sups if s.hits == 0]
+    return Report(findings=live, suppressed=suppressed,
+                  dead_suppressions=dead, rule_ids=[],
+                  n_suppressions=len(sups))
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+def walk_local(fn: ast.AST):
+    """Walk a function body WITHOUT descending into nested defs/lambdas.
+
+    Rules that analyze one function's linear dataflow (R2) or report
+    per-scope sites (R1) must not mix a nested function's statements
+    into the enclosing scope: the nested body executes at a different
+    time (so e.g. a closure-local reassignment must not close the outer
+    donation window), and the per-FunctionDef driver visits nested defs
+    separately under their own scope key (walking them twice would
+    double-report one site under two suppression keys)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'np.asarray' for Attribute chains, 'open' for Names, '' otherwise."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+def str_literal_prefix(node: ast.AST) -> Optional[str]:
+    """The literal string (or f-string literal prefix) of ``node``."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            return first.value
+    return None

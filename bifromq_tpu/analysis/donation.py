@@ -1,0 +1,232 @@
+"""R2 — use-after-donate dataflow.
+
+``donate_argnums`` hands a buffer's device memory to XLA: the Python
+object survives, but touching its device buffer after the call raises
+"Array has been deleted" — or worse, on backends that alias eagerly,
+reads garbage mid-overwrite. PRs 6–7 each shipped a hand-audited fix for
+this class (the dispatch ring's quarantine exists because of it). This
+rule finds every donating callee — jit wrappers declared with
+``donate_argnums`` in the analyzed tree, plus the known serving wrappers
+— and walks each calling function linearly: a read of a donated binding
+after the donation, with no intervening reassignment or quarantine
+hand-off, is an error.
+
+Aliases are followed one hop (``fn = walk_routes_donated if donate else
+walk_routes`` marks ``fn`` donating — conservative: the donated branch
+is assumed reachable).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from .core import (Context, Finding, ParsedFile, Rule, dotted_name,
+                   walk_local)
+
+# serving wrappers whose donation is declared in another module (the
+# AST pass sees one file at a time): callee name -> donated arg indices
+KNOWN_DONATING = {
+    "walk_routes_donated": (1,),
+    "_walk_routes_donated_jit": (1,),
+    # conditional: only donates when called with donate=<not False> —
+    # the rule special-cases the kwarg before trusting this index
+    "patch_device_trie": (0,),
+}
+
+# receivers whose .add()/.reclaim() park a possibly-donated buffer until
+# the device is done with it — the sanctioned post-donation hand-off
+_QUARANTINE_METHODS = {"add", "reclaim"}
+
+
+def _donating_defs(tree: ast.Module) -> Dict[str, Tuple[int, ...]]:
+    """Names bound to a jit with ``donate_argnums`` in this module:
+    ``@functools.partial(jax.jit, donate_argnums=...)`` decorations and
+    ``name = functools.partial(jax.jit, donate_argnums=...)(fn)``."""
+    out: Dict[str, Tuple[int, ...]] = dict(KNOWN_DONATING)
+
+    def donated_indices(call: ast.Call) -> Tuple[int, ...]:
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                v = kw.value
+                if isinstance(v, ast.Constant) \
+                        and isinstance(v.value, int):
+                    return (v.value,)
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    return tuple(e.value for e in v.elts
+                                 if isinstance(e, ast.Constant))
+        return ()
+
+    def is_jit_partial(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and dotted_name(node.func) in ("functools.partial",
+                                               "partial")
+                and any(dotted_name(a) in ("jax.jit", "jit")
+                        for a in node.args))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if is_jit_partial(dec):
+                    idx = donated_indices(dec)
+                    if idx:
+                        out[node.name] = idx
+    # second pass for `name = partial(jax.jit, donate_argnums=...)(fn)`
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Call)
+                and is_jit_partial(node.value.func)):
+            continue
+        idx = donated_indices(node.value.func)
+        if not idx:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out[tgt.id] = idx
+    return out
+
+
+def _binding_repr(node: ast.AST) -> str:
+    """A trackable binding: a bare name or a ``self.attr`` read."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return f"self.{node.attr}"
+    return ""
+
+
+class UseAfterDonateRule(Rule):
+    rule_id = "R2"
+    title = "use-after-donate"
+
+    def run(self, ctx: Context) -> List[Finding]:
+        out: List[Finding] = []
+        defined: set = set()
+        for pf in ctx.files:
+            donating = _donating_defs(pf.tree)
+            for node in ast.walk(pf.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    defined.add(node.name)
+                    self._check_fn(pf, node, donating, out)
+                elif isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            defined.add(t.id)
+        # dead-config validation (same no-rot contract as dead
+        # suppressions), gated to trees that actually contain the
+        # module the wrappers live in — fixture runs skip it
+        if any(pf.path.replace("\\", "/").endswith("ops/match.py")
+               for pf in ctx.files):
+            for name in sorted(set(KNOWN_DONATING) - defined):
+                out.append(Finding(
+                    rule=self.rule_id, path="ops/match.py", line=0,
+                    scope="<config>", symbol=name,
+                    message=(f"KNOWN_DONATING entry `{name}` is "
+                             f"defined nowhere in the analyzed tree — "
+                             f"renamed donating wrapper silently lost "
+                             f"R2 coverage; update the config")))
+        return out
+
+    def _check_fn(self, pf: ParsedFile, fn: ast.AST,
+                  donating: Dict[str, Tuple[int, ...]],
+                  out: List[Finding]) -> None:
+        local = dict(donating)
+        # one-hop alias: x = donating_callee / x = a if c else b
+        # (walk_local: a nested def's statements belong to ITS scope —
+        # the per-FunctionDef driver analyzes it separately)
+        for node in walk_local(fn):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            v = node.value
+            cands = [v.body, v.orelse] if isinstance(v, ast.IfExp) else [v]
+            for c in cands:
+                name = dotted_name(c)
+                if name in local:
+                    local[node.targets[0].id] = local[name]
+        # linear scan: donation events then later reads, by line order
+        events: List[Tuple[int, str, ast.Call]] = []
+        for node in walk_local(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            # strip module prefixes: ops.match.walk_routes_donated etc.
+            short = callee.rsplit(".", 1)[-1]
+            idx = local.get(callee) or local.get(short)
+            if not idx:
+                continue
+            # `patch_device_trie(dev, ..., donate=False)` is functional —
+            # only a donate kwarg that is not literally False donates
+            if short == "patch_device_trie":
+                dkw = next((kw.value for kw in node.keywords
+                            if kw.arg == "donate"), None)
+                if dkw is None or (isinstance(dkw, ast.Constant)
+                                   and dkw.value is False):
+                    continue
+                idx = (0,)
+            for i in idx:
+                if i < len(node.args):
+                    b = _binding_repr(node.args[i])
+                    if b:
+                        events.append((node.lineno, b, node))
+        if not events:
+            return
+        qual = pf.scope_of(fn)
+        for don_line, binding, call in events:
+            self._check_reads_after(pf, fn, qual, don_line, binding, out)
+
+    def _check_reads_after(self, pf: ParsedFile, fn: ast.AST, qual: str,
+                           don_line: int, binding: str,
+                           out: List[Finding]) -> None:
+        # find the first reassignment after the donation; reads between
+        # donation and reassignment are the violation window. A
+        # reassignment ON the donation line (`x = f(x)`) closes the
+        # window immediately.
+        reassign_line = None
+        for node in walk_local(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if _binding_repr(t) == binding \
+                            and node.lineno >= don_line:
+                        if reassign_line is None \
+                                or node.lineno < reassign_line:
+                            reassign_line = node.lineno
+        for node in walk_local(fn):
+            if not (isinstance(node, (ast.Name, ast.Attribute))
+                    and isinstance(getattr(node, "ctx", None), ast.Load)
+                    and _binding_repr(node) == binding):
+                continue
+            line = node.lineno
+            if line <= don_line:
+                continue
+            if reassign_line is not None and line >= reassign_line:
+                continue
+            if self._is_quarantine_handoff(fn, node):
+                continue
+            out.append(Finding(
+                rule=self.rule_id, path=pf.path, line=line,
+                scope=qual, symbol=binding,
+                message=(f"`{binding}` read after being donated at line "
+                         f"{don_line} — donated buffers may already be "
+                         f"freed/aliased by XLA; re-read the host copy, "
+                         f"reassign, or quarantine")))
+
+    @staticmethod
+    def _is_quarantine_handoff(fn: ast.AST, read: ast.AST) -> bool:
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _QUARANTINE_METHODS
+                    and any(a is read for a in node.args)):
+                recv = dotted_name(node.func.value).lower()
+                if "quarantine" in recv or "ring" in recv:
+                    return True
+        return False

@@ -1,0 +1,206 @@
+"""R5 — trace-span and metric-registry drift.
+
+Observability names are stringly-typed: a typo'd span or stage name
+silently creates a new series nobody dashboards, and a README table row
+for a deleted span misleads the operator reading a live trace. Checks:
+
+- **R5/span-doc**: every span name opened in code (``trace.span(...)``,
+  ``trace.record_finished(...)``) must appear in the README (backticked
+  anywhere); every row of the README "Span taxonomy" table must still be
+  opened somewhere in code.
+- **R5/stage**: every literal stage fed to the always-on stage
+  histograms (``STAGES.record``, ``Batcher(stage=...)``,
+  ``OBS.record_latency``) must be in ``utils.metrics.KNOWN_STAGES``, and
+  every registered stage must be emitted somewhere (dead registry
+  entries fail too).
+- **R5/cache-field**: literal fields passed to ``MATCH_CACHE.inc`` must
+  be declared in ``MatchCacheMetrics._FIELDS``.
+
+Both registries are parsed from the analyzed tree's
+``utils/metrics.py``; when the root has none (fixture runs), the
+installed package's registry is used so fixture snippets still check.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Context, Finding, ParsedFile, Rule, dotted_name
+
+_SPAN_OPENERS = {"span", "record_finished"}
+_SPAN_NAME_RE = re.compile(r"^[a-z_]+(\.[a-z_]+)+$")
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+
+
+def _collect_spans(ctx: Context) -> Dict[str, List[Tuple[str, int, str]]]:
+    spans: Dict[str, List[Tuple[str, int, str]]] = {}
+    for pf in ctx.files:
+        for node in ast.walk(pf.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            callee = dotted_name(node.func).rsplit(".", 1)[-1]
+            if callee not in _SPAN_OPENERS:
+                continue
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, str) \
+                    and _SPAN_NAME_RE.match(a0.value):
+                spans.setdefault(a0.value, []).append(
+                    (pf.path, node.lineno, pf.scope_of(node)))
+    return spans
+
+
+def _readme_span_table(readme: str) -> Set[str]:
+    """Span names from the first cell of every row of the table whose
+    header starts ``| span |``."""
+    out: Set[str] = set()
+    in_table = False
+    for line in readme.splitlines():
+        stripped = line.strip()
+        if stripped.startswith("| span |"):
+            in_table = True
+            continue
+        if in_table:
+            if not stripped.startswith("|"):
+                in_table = False
+                continue
+            first_cell = stripped.split("|")[1]
+            for name in _BACKTICK_RE.findall(first_cell):
+                if _SPAN_NAME_RE.match(name):
+                    out.add(name)
+    return out
+
+
+def _parse_registries(pf: Optional[ParsedFile]) -> Tuple[Set[str],
+                                                         Set[str]]:
+    """(KNOWN_STAGES, MatchCacheMetrics._FIELDS) from a metrics module's
+    AST; falls back to the installed package when the analyzed root has
+    no utils/metrics.py."""
+    if pf is None:
+        from ..utils.metrics import KNOWN_STAGES, MatchCacheMetrics
+        return set(KNOWN_STAGES), set(MatchCacheMetrics._FIELDS)
+    stages: Set[str] = set()
+    fields: Set[str] = set()
+
+    def str_elts(node: ast.AST) -> Set[str]:
+        vals: Set[str] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                vals.add(n.value)
+        return vals
+
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name) and t.id == "KNOWN_STAGES":
+                stages = str_elts(node.value)
+            elif isinstance(t, ast.Name) and t.id == "_FIELDS":
+                fields = str_elts(node.value)
+    return stages, fields
+
+
+class RegistryDriftRule(Rule):
+    rule_id = "R5"
+    title = "trace/metric registry drift"
+
+    def run(self, ctx: Context) -> List[Finding]:
+        out: List[Finding] = []
+        metrics_pf = None
+        for pf in ctx.files:
+            if pf.path.replace("\\", "/").endswith("utils/metrics.py"):
+                metrics_pf = pf
+                break
+        known_stages, cache_fields = _parse_registries(metrics_pf)
+        spans = _collect_spans(ctx)
+
+        # -- span <-> README ------------------------------------------------
+        if ctx.readme_text is not None:
+            # substring check, not backtick pairing: README code fences
+            # make global backtick pairing ambiguous
+            for name, sites in sorted(spans.items()):
+                if name not in ctx.readme_text:
+                    path, line, scope = sites[0]
+                    out.append(Finding(
+                        rule=self.rule_id, path=path, line=line,
+                        scope=scope, symbol=name,
+                        message=(f"span `{name}` is opened in code but "
+                                 f"not documented in README")))
+            for name in sorted(_readme_span_table(ctx.readme_text)):
+                if name not in spans:
+                    out.append(Finding(
+                        rule=self.rule_id, path="README.md", line=0,
+                        scope="<span-table>", symbol=name,
+                        message=(f"README span-taxonomy row `{name}` is "
+                                 f"opened nowhere in code — stale doc")))
+
+        # -- stage registry --------------------------------------------------
+        emitted: Dict[str, List[Tuple[str, int, str]]] = {}
+        for pf in ctx.files:
+            for node in ast.walk(pf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                stage = self._stage_literal(node)
+                if stage is not None:
+                    emitted.setdefault(stage, []).append(
+                        (pf.path, node.lineno, pf.scope_of(node)))
+                self._check_cache_field(pf, node, cache_fields, out)
+        if known_stages:
+            for stage, sites in sorted(emitted.items()):
+                if stage not in known_stages:
+                    path, line, scope = sites[0]
+                    out.append(Finding(
+                        rule=self.rule_id, path=path, line=line,
+                        scope=scope, symbol=stage,
+                        message=(f"stage `{stage}` recorded but not in "
+                                 f"utils.metrics.KNOWN_STAGES — typo'd "
+                                 f"stage names create silent orphan "
+                                 f"histograms")))
+            if metrics_pf is not None:
+                for stage in sorted(known_stages - set(emitted)):
+                    out.append(Finding(
+                        rule=self.rule_id, path=metrics_pf.path, line=0,
+                        scope="<KNOWN_STAGES>", symbol=stage,
+                        message=(f"KNOWN_STAGES entry `{stage}` is "
+                                 f"emitted nowhere — dead registry "
+                                 f"entry")))
+        return out
+
+    @staticmethod
+    def _stage_literal(node: ast.Call) -> Optional[str]:
+        callee = dotted_name(node.func)
+        short = callee.rsplit(".", 1)[-1]
+        # STAGES.record("stage", secs) / STAGES.hist("stage")
+        if short in ("record", "hist") and "STAGES" in callee \
+                and node.args:
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                return a0.value
+        # OBS.record_latency(tenant, "stage", secs)
+        if short == "record_latency" and len(node.args) >= 2:
+            a1 = node.args[1]
+            if isinstance(a1, ast.Constant) and isinstance(a1.value, str):
+                return a1.value
+        # Batcher(..., stage="x") / BatchCallScheduler(..., stage="x")
+        if short in ("Batcher", "BatchCallScheduler"):
+            for kw in node.keywords:
+                if kw.arg == "stage" \
+                        and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    return kw.value.value
+        return None
+
+    def _check_cache_field(self, pf: ParsedFile, node: ast.Call,
+                           fields: Set[str], out: List[Finding]) -> None:
+        callee = dotted_name(node.func)
+        if not (callee.endswith(".inc") and "MATCH_CACHE" in callee
+                and len(node.args) >= 2):
+            return
+        a1 = node.args[1]
+        if isinstance(a1, ast.Constant) and isinstance(a1.value, str) \
+                and fields and a1.value not in fields:
+            out.append(Finding(
+                rule=self.rule_id, path=pf.path, line=node.lineno,
+                scope=pf.scope_of(node), symbol=a1.value,
+                message=(f"MATCH_CACHE field `{a1.value}` not declared "
+                         f"in MatchCacheMetrics._FIELDS")))

@@ -1,0 +1,176 @@
+"""R3 — env-knob discipline.
+
+Three sub-checks, all born from shipped review fixes (PR 7: SHEDDER /
+INGEST_GATE knobs frozen at module import while every sibling resolved
+lazily):
+
+- **R3/direct**: a ``BIFROMQ_*`` knob read through raw ``os.environ``
+  (``.get``, subscript, ``in``, ``os.getenv``) anywhere outside
+  ``utils/env.py`` — every knob must go through the lazy helpers so
+  parse-fallback behavior cannot fork per call site.
+- **R3/import-time**: any knob resolution (helper call included) at
+  module scope — the value freezes before the embedding broker or a
+  monkeypatching test can set its env.
+- **R3/readme**: drift between the knob set referenced in code and the
+  README knob documentation, both directions (an undocumented knob is
+  unusable; a documented-but-deleted knob is a trap). Skipped when the
+  context has no README (fixture runs).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set
+
+from .core import (Context, Finding, ParsedFile, Rule, dotted_name,
+                   str_literal_prefix)
+
+_ENV_HELPERS = {"env_float", "env_int", "env_str", "env_bool",
+                "env_opt_str", "env_opt_float"}
+_KNOB_RE = re.compile(r"^BIFROMQ_[A-Z0-9_]+$")
+_README_KNOB_RE = re.compile(r"BIFROMQ_[A-Z0-9_]+")
+
+
+def _knob_of(node: ast.AST) -> Optional[str]:
+    """The BIFROMQ knob named by a literal (or f-string prefix)."""
+    s = str_literal_prefix(node)
+    if s is None or not s.startswith("BIFROMQ_"):
+        return None
+    if isinstance(node, ast.JoinedStr):
+        return s + "*"      # dynamic suffix (f-string): report the prefix
+    return s if _KNOB_RE.match(s) else None
+
+
+def _environ_read_knob(node: ast.AST) -> Optional[str]:
+    """BIFROMQ knob read through raw os.environ / os.getenv, or None."""
+    if isinstance(node, ast.Call):
+        callee = dotted_name(node.func)
+        if callee in ("os.environ.get", "environ.get", "os.getenv") \
+                and node.args:
+            return _knob_of(node.args[0])
+    if isinstance(node, ast.Subscript) \
+            and isinstance(getattr(node, "ctx", None), ast.Load) \
+            and dotted_name(node.value) in ("os.environ", "environ"):
+        return _knob_of(node.slice)
+    if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+            and isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+            and dotted_name(node.comparators[0]) in ("os.environ",
+                                                     "environ"):
+        return _knob_of(node.left)
+    return None
+
+
+class EnvKnobRule(Rule):
+    rule_id = "R3"
+    title = "env-knob discipline"
+
+    @staticmethod
+    def _import_time_index(pf: ParsedFile) -> tuple:
+        """(function line spans, ids of default-argument expression
+        nodes). Code OUTSIDE every def span executes at import (module
+        scope AND class bodies) — and so do def default expressions,
+        even though their lines sit INSIDE the def's span (the PR 7
+        frozen-knob class wearing a default argument)."""
+        spans = []
+        default_ids = set()
+        for node in ast.walk(pf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                hi = max((getattr(n, "lineno", node.lineno)
+                          for n in ast.walk(node)), default=node.lineno)
+                spans.append((node.lineno, hi))
+                for d in (list(node.args.defaults)
+                          + [k for k in node.args.kw_defaults
+                             if k is not None]):
+                    for sub in ast.walk(d):
+                        default_ids.add(id(sub))
+        return spans, default_ids
+
+    def run(self, ctx: Context) -> List[Finding]:
+        out: List[Finding] = []
+        code_knobs: Set[str] = set()
+        for pf in ctx.files:
+            exempt = pf.path.replace("\\", "/").endswith("utils/env.py")
+            fn_spans, default_ids = self._import_time_index(pf)
+
+            def at_import_time(node) -> bool:
+                if id(node) in default_ids:
+                    return True
+                line = getattr(node, "lineno", 0)
+                return not any(lo <= line <= hi for lo, hi in fn_spans)
+
+            for node in ast.walk(pf.tree):
+                # collect every knob literal for the README drift check
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and _KNOB_RE.match(node.value):
+                    code_knobs.add(node.value)
+                knob = _environ_read_knob(node)
+                if knob is not None and not exempt:
+                    out.append(Finding(
+                        rule=self.rule_id, path=pf.path,
+                        line=node.lineno, scope=pf.scope_of(node),
+                        symbol=knob,
+                        message=(f"raw os.environ read of `{knob}` — "
+                                 f"route BIFROMQ_* knobs through the "
+                                 f"utils/env.py lazy helpers")))
+                # import-time resolution: helper call outside every def
+                # — module scope OR a class body, both run at import
+                # (the PR 7 frozen-knob class)
+                if isinstance(node, ast.Call):
+                    callee = dotted_name(node.func).rsplit(".", 1)[-1]
+                    if callee in _ENV_HELPERS and node.args:
+                        k = _knob_of(node.args[0])
+                        if k is not None and at_import_time(node):
+                            out.append(Finding(
+                                rule=self.rule_id, path=pf.path,
+                                line=node.lineno,
+                                scope=pf.scope_of(node),
+                                symbol=k,
+                                message=(f"`{k}` resolved at import "
+                                         f"time — the value freezes "
+                                         f"before the embedder can set "
+                                         f"its env; resolve lazily at "
+                                         f"first use")))
+            # sysprops-style dynamic knobs: enum tuples whose first
+            # element is the env suffix — register the full name so the
+            # README drift check covers them
+            if pf.path.replace("\\", "/").endswith("utils/sysprops.py"):
+                code_knobs.update(self._sysprops_knobs(pf))
+        out.extend(self._readme_drift(ctx, code_knobs))
+        return out
+
+    @staticmethod
+    def _sysprops_knobs(pf: ParsedFile) -> Set[str]:
+        knobs: Set[str] = set()
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Tuple) \
+                    and node.value.elts \
+                    and isinstance(node.value.elts[0], ast.Constant) \
+                    and isinstance(node.value.elts[0].value, str):
+                suffix = node.value.elts[0].value
+                if re.match(r"^[A-Z0-9_]+$", suffix):
+                    knobs.add(f"BIFROMQ_{suffix}")
+        return knobs
+
+    def _readme_drift(self, ctx: Context,
+                      code_knobs: Set[str]) -> List[Finding]:
+        if ctx.readme_text is None:
+            return []
+        readme_knobs = set(_README_KNOB_RE.findall(ctx.readme_text))
+        out: List[Finding] = []
+        for knob in sorted(code_knobs - readme_knobs):
+            out.append(Finding(
+                rule=self.rule_id, path="README.md", line=0,
+                scope="<knobs>", symbol=knob,
+                message=(f"`{knob}` is read by code but absent from the "
+                         f"README knob documentation")))
+        for knob in sorted(readme_knobs - code_knobs):
+            out.append(Finding(
+                rule=self.rule_id, path="README.md", line=0,
+                scope="<knobs>", symbol=knob,
+                message=(f"`{knob}` is documented in README but no code "
+                         f"reads it — dead doc or renamed knob")))
+        return out

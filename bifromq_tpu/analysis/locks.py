@@ -1,0 +1,246 @@
+"""R4 — lock discipline across the threaded modules.
+
+Two sub-checks over the lock-acquisition graph (locks = module-level
+``NAME = threading.Lock()`` / ``self.NAME = threading.Lock()`` bindings,
+acquisitions = ``with <lock>:`` blocks):
+
+- **R4/order**: inconsistent pairwise lock order — if one code path
+  acquires A then B and another B then A, the process can deadlock the
+  moment both run concurrently. Nesting is tracked syntactically plus
+  one call level (a ``with A:`` body calling a local function that takes
+  B counts as A→B).
+- **R4/blocking**: a blocking call — device fetch, ``time.sleep``,
+  subprocess, file/network I/O, ``Thread.join``, ``Future.result`` — or
+  an ``await`` executed while holding a lock. Every waiter on that lock
+  (often the publish hot path's metric inc) stalls behind the slow
+  operation; the shipped pattern is copy-under-lock, work outside
+  (``MetricsRegistry.snapshot``). Deliberately-serialized I/O (the
+  segment store, the one-time native build) carries suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Context, Finding, ParsedFile, Rule, dotted_name
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+               "Lock", "RLock", "Condition"}
+
+# callee names that block the calling thread
+_BLOCKING_CALLS = {
+    "time.sleep", "sleep", "open",
+    "os.remove", "os.unlink", "os.rename", "os.replace",
+    "subprocess.run", "subprocess.Popen", "subprocess.check_call",
+    "subprocess.check_output", "urlopen", "urllib.request.urlopen",
+    "socket.create_connection",
+    "np.asarray", "np.array", "jax.device_get",
+}
+_BLOCKING_METHODS = {"result", "block_until_ready", "join_thread",
+                     "recv", "sendall", "connect"}
+
+
+def _lock_binding(node: ast.Assign) -> Optional[str]:
+    """'NAME' / 'self.NAME' when this assignment binds a lock ctor."""
+    if not (isinstance(node.value, ast.Call)
+            and dotted_name(node.value.func) in _LOCK_CTORS
+            and len(node.targets) == 1):
+        return None
+    t = node.targets[0]
+    if isinstance(t, ast.Name):
+        return t.id
+    if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+            and t.value.id == "self":
+        return f"self.{t.attr}"
+    return None
+
+
+class LockDisciplineRule(Rule):
+    rule_id = "R4"
+    title = "lock discipline"
+
+    def run(self, ctx: Context) -> List[Finding]:
+        out: List[Finding] = []
+        # ordered pairs across the whole tree: (lockA, lockB) -> sites
+        pair_sites: Dict[Tuple[str, str], List[Tuple[str, int, str]]] = {}
+        for pf in ctx.files:
+            self._scan_file(pf, pair_sites, out)
+        # inconsistent pairwise order
+        for (a, b), sites in sorted(pair_sites.items()):
+            if a < b and (b, a) in pair_sites:
+                rev = pair_sites[(b, a)]
+                for path, line, scope in sites + rev:
+                    out.append(Finding(
+                        rule=self.rule_id, path=path, line=line,
+                        scope=scope, symbol=f"{a}<>{b}",
+                        message=(f"inconsistent lock order: `{a}` and "
+                                 f"`{b}` are acquired in both orders "
+                                 f"across the codebase — deadlock when "
+                                 f"the paths run concurrently")))
+        return out
+
+    def _scan_file(self, pf: ParsedFile, pair_sites, out) -> None:
+        locks = self._collect_locks(pf)
+        if not locks:
+            return
+        # per-function summaries for the one-level call expansion
+        fns = self._functions(pf)
+        summaries: Dict[str, dict] = {}
+        for qual, fn in fns.items():
+            summaries[qual] = self._summarize(pf, fn, locks)
+        for qual, fn in fns.items():
+            self._walk_with_stack(pf, fn, qual, locks, summaries,
+                                  pair_sites, out)
+
+    @staticmethod
+    def _collect_locks(pf: ParsedFile) -> Dict[str, str]:
+        """binding -> lock id (module-qualified, class-scoped for
+        ``self.*`` so two classes' ``self._lock`` stay distinct)."""
+        locks: Dict[str, str] = {}
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Assign):
+                b = _lock_binding(node)
+                if b is None:
+                    continue
+                scope = pf.scope_of(node)
+                cls = scope.split(".")[0] if scope else ""
+                if b.startswith("self."):
+                    locks[f"{cls}|{b}"] = f"{pf.path}::{cls}.{b[5:]}"
+                else:
+                    locks[f"|{b}"] = f"{pf.path}::{b}"
+        return locks
+
+    @staticmethod
+    def _functions(pf: ParsedFile):
+        out = {}
+        for node in ast.walk(pf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out[pf.scope_of(node) or node.name] = node
+        return out
+
+    @staticmethod
+    def _lock_id(locks: Dict[str, str], expr: ast.AST,
+                 scope: str) -> Optional[str]:
+        cls = scope.split(".")[0] if scope else ""
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            return locks.get(f"{cls}|self.{expr.attr}")
+        if isinstance(expr, ast.Name):
+            return locks.get(f"|{expr.id}")
+        return None
+
+    def _summarize(self, pf: ParsedFile, fn: ast.AST,
+                   locks: Dict[str, str]) -> dict:
+        """Direct facts about one function: locks it acquires anywhere,
+        and whether it makes a blocking call outside any with-lock (the
+        caller-holds-a-lock case the one-level expansion flags)."""
+        qual = pf.scope_of(fn) or getattr(fn, "name", "")
+        acquired: Set[str] = set()
+        blocking: List[Tuple[int, str]] = []
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lid = self._lock_id(locks, item.context_expr, qual)
+                    if lid:
+                        acquired.add(lid)
+            sym = self._blocking_symbol(node)
+            if sym:
+                blocking.append((node.lineno, sym))
+        return {"acquires": acquired, "blocking": blocking}
+
+    @staticmethod
+    def _blocking_symbol(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Await):
+            return "await"
+        if not isinstance(node, ast.Call):
+            return None
+        callee = dotted_name(node.func)
+        if callee in _BLOCKING_CALLS:
+            return callee
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _BLOCKING_METHODS:
+            return f".{node.func.attr}"
+        # Thread.join: `.join()` with no args on a non-str receiver is
+        # ambiguous ("sep".join(...) takes an arg, thread.join() doesn't)
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "join" and not node.args \
+                and not isinstance(node.func.value, ast.Constant):
+            return ".join"
+        return None
+
+    def _walk_with_stack(self, pf: ParsedFile, fn: ast.AST, qual: str,
+                         locks, summaries, pair_sites, out) -> None:
+        def visit(node: ast.AST, held: List[str]) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new_held = list(held)
+                for item in node.items:
+                    lid = self._lock_id(locks, item.context_expr, qual)
+                    if lid:
+                        for h in new_held:
+                            if h != lid:
+                                pair_sites.setdefault(
+                                    (h, lid), []).append(
+                                    (pf.path, node.lineno, qual))
+                        new_held.append(lid)
+                    else:
+                        # a non-lock context expression can itself
+                        # block (`with open(...)`); items evaluate left
+                        # to right, so locks acquired by EARLIER items
+                        # of this same statement are already held —
+                        # `with self._lock, open(p):` opens under the
+                        # lock
+                        visit(item.context_expr, new_held)
+                for child in node.body:
+                    visit(child, new_held)
+                return
+            if held:
+                sym = self._blocking_symbol(node)
+                if sym:
+                    out.append(Finding(
+                        rule=self.rule_id, path=pf.path,
+                        line=node.lineno, scope=qual, symbol=sym,
+                        message=(f"blocking call `{sym}` while holding "
+                                 f"`{held[-1].split('::')[-1]}` — every "
+                                 f"waiter on the lock stalls behind it; "
+                                 f"copy under the lock, do the slow "
+                                 f"work outside")))
+                # one-level call expansion: local callee that itself
+                # acquires a lock (order pair) or blocks
+                if isinstance(node, ast.Call):
+                    callee = dotted_name(node.func)
+                    short = callee.replace("self.", "")
+                    target = None
+                    cls = qual.split(".")[0] if "." in qual else ""
+                    for cand in (f"{cls}.{short}", short):
+                        if cand in summaries:
+                            target = cand
+                            break
+                    if target is not None and target != qual:
+                        for lid in summaries[target]["acquires"]:
+                            for h in held:
+                                if h != lid:
+                                    pair_sites.setdefault(
+                                        (h, lid), []).append(
+                                        (pf.path, node.lineno, qual))
+                        for bl, bsym in summaries[target]["blocking"]:
+                            out.append(Finding(
+                                rule=self.rule_id, path=pf.path,
+                                line=node.lineno, scope=qual,
+                                symbol=f"{short}->{bsym}",
+                                message=(f"`{short}()` blocks "
+                                         f"(`{bsym}` at line {bl}) and "
+                                         f"is called while holding "
+                                         f"`{held[-1].split('::')[-1]}`"
+                                         )))
+            # don't descend into nested defs — they run later, not
+            # under this lock
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                visit(child, held)
+
+        for stmt in fn.body:
+            visit(stmt, [])

@@ -1,0 +1,67 @@
+"""Transfer-guard sanitizer (ISSUE 10 — the dynamic half of graftcheck).
+
+The AST rules catch the host-sync shapes they can *name*; anything else
+— a numpy array slipping into a jit'd walk as an implicit host-to-device
+upload, a library call that synchronizes under the hood — needs the
+runtime to object. ``jax.transfer_guard("disallow")`` does exactly that:
+implicit transfers raise, while the hot path's *declared* transfers
+(``jax.device_put`` on probe upload, the ``_fetch_walk`` readback) stay
+legal because they are explicit.
+
+Usage (tests/test_sanitize.py drives sync, async and patched-churn
+match paths through this):
+
+    warm_up_the_path()                  # compiles happen unguarded
+    with sanitize.no_implicit_transfers():
+        serve_the_path()                # any stray transfer raises
+
+``assert_guard_arms()`` first proves the guard actually fires on the
+running jax version — a silently-vacuous sanitizer is worse than none.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+class TransferGuardUnavailable(RuntimeError):
+    """The running jax cannot enforce the transfer guard — the
+    sanitizer tests must FAIL (not skip silently): a green run that
+    guarded nothing is the worst outcome."""
+
+
+def assert_guard_arms() -> None:
+    """Prove ``transfer_guard('disallow')`` rejects an implicit
+    host-to-device transfer on this backend/version."""
+    import jax
+    import numpy as np
+    if not hasattr(jax, "transfer_guard"):
+        raise TransferGuardUnavailable(
+            "jax.transfer_guard missing on this jax version")
+    fn = jax.jit(lambda a: a + 1)
+    probe = np.arange(2, dtype=np.int32)
+    fn(jax.device_put(probe))           # compile outside the guard
+    tripped = False
+    with jax.transfer_guard("disallow"):
+        try:
+            fn(probe)                   # implicit h2d — must raise
+        except Exception:  # noqa: BLE001 — any rejection arms us
+            tripped = True
+    if not tripped:
+        raise TransferGuardUnavailable(
+            "transfer_guard('disallow') did not reject an implicit "
+            "host-to-device transfer — the sanitizer would be vacuous")
+
+
+@contextlib.contextmanager
+def no_implicit_transfers():
+    """Run the enclosed block with implicit device transfers disallowed.
+
+    Explicit ``jax.device_put`` / ``jax.device_get`` stay legal — the
+    discipline this enforces is "every transfer on the hot path is a
+    *decision*, visible at a named call site", which is also exactly
+    what the R1 suppression file documents.
+    """
+    import jax
+    with jax.transfer_guard("disallow"):
+        yield

@@ -390,6 +390,11 @@ class APIServer:
         else:
             snap["device"] = OBS.device_snapshot()
             snap["obs"] = OBS.obs_snapshot()
+            # ISSUE 10: graftcheck build-info (rule count, suppression
+            # count, last-run hash) — two live nodes disagreeing on the
+            # hash are running different code or different suppressions
+            from ..analysis import build_info
+            snap["build_info"] = {"graftcheck": build_info()}
         return 200, snap
 
     def _tenants_ranked(self, arg) -> Tuple[int, object]:

@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import argparse
 import asyncio
-import os
 import sys
 
 from ..utils.jaxenv import pin_jax_platform
@@ -30,7 +29,8 @@ async def serve(args) -> None:
 
     # attribute this process's spans (exported via the "trace_spans"
     # method / the owning node's /trace) to the worker role
-    if "BIFROMQ_TRACE_SERVICE" not in os.environ:
+    from ..utils.env import env_opt_str
+    if env_opt_str("BIFROMQ_TRACE_SERVICE") is None:
         trace.TRACER.service = f"dist-worker:{args.node_id}"
 
     engine = None

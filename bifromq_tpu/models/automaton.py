@@ -52,7 +52,6 @@ expands node → slots → routes for delivery.
 from __future__ import annotations
 
 import hashlib
-import os
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set, Tuple, Union
 
@@ -60,6 +59,7 @@ import numpy as np
 
 from ..types import RouteMatcherType
 from ..utils import topic as topic_util
+from ..utils.env import env_bool, env_float, env_int
 from .oracle import Route, SubscriptionTrie, _TrieNode
 
 # node_tab column indices
@@ -437,35 +437,24 @@ class PatchFallback(RuntimeError):
 
 
 def patch_enabled() -> bool:
-    return os.environ.get("BIFROMQ_PATCH", "1").lower() \
-        not in ("0", "off", "false")
+    return env_bool("BIFROMQ_PATCH", True)
 
 
 def patch_headroom() -> float:
     """Minimum spare-row fraction of the node arena (on top of pow2
     rounding) so steady subscribe churn appends without reshaping."""
-    try:
-        return max(0.0, float(os.environ.get("BIFROMQ_PATCH_HEADROOM",
-                                             "0.125")))
-    except ValueError:
-        return 0.125
+    return max(0.0, env_float("BIFROMQ_PATCH_HEADROOM", 0.125))
 
 
 def patch_frag_ratio() -> float:
     """dead+garbage slot fraction above which compaction folds the arena."""
-    try:
-        return float(os.environ.get("BIFROMQ_PATCH_FRAG_RATIO", "0.25"))
-    except ValueError:
-        return 0.25
+    return env_float("BIFROMQ_PATCH_FRAG_RATIO", 0.25)
 
 
 def patch_frag_floor() -> int:
     """Minimum absolute dead+garbage slots before the ratio can trigger —
     tiny bases must not compact on every other remove."""
-    try:
-        return int(os.environ.get("BIFROMQ_PATCH_FRAG_FLOOR", "64"))
-    except ValueError:
-        return 64
+    return env_int("BIFROMQ_PATCH_FRAG_FLOOR", 64)
 
 
 def _next_pow2(n: int, floor: int = 1) -> int:

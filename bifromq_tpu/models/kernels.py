@@ -43,19 +43,19 @@ are resident whether or not they're live, so that is the honest number.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 from ..ops.match import DeviceTrie, Probes, RouteIntervals, _route_walk
+from ..utils.env import env_int, env_str
 
 _VMEM_BUDGET_MB_DEFAULT = 12
 
 
 def _env_mode() -> str:
-    v = os.environ.get("BIFROMQ_FUSED_KERNEL", "auto").lower()
+    v = env_str("BIFROMQ_FUSED_KERNEL", "auto").lower()
     if v in ("0", "off", "false"):
         return "off"
     if v in ("1", "on", "true"):
@@ -64,14 +64,10 @@ def _env_mode() -> str:
 
 
 def fused_vmem_budget_bytes() -> int:
-    # fused_enabled runs on every serving dispatch: a malformed knob must
-    # fall back to the default, never crash the match path
-    try:
-        mb = int(os.environ.get("BIFROMQ_FUSED_VMEM_MB",
-                                str(_VMEM_BUDGET_MB_DEFAULT)))
-    except ValueError:
-        mb = _VMEM_BUDGET_MB_DEFAULT
-    return mb * (1 << 20)
+    # fused_enabled runs on every serving dispatch: a malformed knob
+    # falls back to the default (env_int), never crashes the match path
+    return env_int("BIFROMQ_FUSED_VMEM_MB",
+                   _VMEM_BUDGET_MB_DEFAULT) * (1 << 20)
 
 
 def _table_bytes(trie: DeviceTrie) -> int:

@@ -100,9 +100,8 @@ class _InFlight:
 
 
 def _match_cache_default() -> bool:
-    import os
-    return os.environ.get("BIFROMQ_MATCH_CACHE", "1").lower() \
-        not in ("0", "off", "false")
+    from ..utils.env import env_bool
+    return env_bool("BIFROMQ_MATCH_CACHE", True)
 
 
 class TpuMatcher:
@@ -176,6 +175,8 @@ class TpuMatcher:
         self._pending_swap = None   # set by the compact thread
         self._compact_done = False
         self._compact_thread: Optional[threading.Thread] = None
+        # ISSUE 10: background patch-scatter warm (joinable by tests)
+        self._scatter_warm_thread: Optional[threading.Thread] = None
         self.compile_count = 0      # full compiles (observability/tests)
         self.compile_time_s = 0.0   # cumulative wall time in compiles
         # ISSUE 9 patch-plane accounting (mutations folded into the base
@@ -479,6 +480,43 @@ class TpuMatcher:
                 res = fn(dev, Probes.from_tokenized(tok,
                                                     device=self.device))
                 np.asarray(res.overflow)
+            # ISSUE 10 satellite (ROADMAP PR 9 follow-up (c)): pre-warm
+            # the patch-scatter jits too, so the FIRST churn flush stops
+            # paying its one-off trace on the serving path. On a
+            # DELAYED background thread: the walk warm gates first
+            # serving and must stay inline, but churn starts long after
+            # install — ~0.6s of scatter traces competing with a cold
+            # process's first serves (workers hold 1s RPC deadlines
+            # across them) would cost more than they save, so the warm
+            # waits out the cold-start window first. Deduped per shape
+            # class
+            # inside warm_patch_scatter, so multi-range workers compile
+            # each class once.
+            from ..ops import match as _om
+            if isinstance(ct, PatchableTrie) \
+                    and ct.node_tab.shape[0] >= _om.WARM_SCATTER_MIN_ROWS:
+                from ..utils.env import env_float
+                # capture ONLY shape classes + device: closing over
+                # self would pin the matcher (and its device breaker on
+                # the process-global board) for the whole delay window,
+                # and holding the live tables would race a donated
+                # flush consuming them mid-delay
+                device = self.device
+                shapes = _om.scatter_warm_shapes(dev)
+                scatter_warm_fn = _om.warm_patch_scatter
+
+                def _warm_scatters():
+                    try:
+                        time.sleep(max(0.0, env_float(
+                            "BIFROMQ_SCATTER_WARM_DELAY_S", 1.0)))
+                        scatter_warm_fn(shapes, device=device)
+                    except Exception:  # noqa: BLE001 — best-effort
+                        pass
+                t = threading.Thread(target=_warm_scatters,
+                                     name="tpu-matcher-warm-scatter",
+                                     daemon=True)
+                self._scatter_warm_thread = t
+                t.start()
         except Exception:  # noqa: BLE001 — warm-up is best-effort
             pass
 

@@ -26,47 +26,37 @@ whatever loop is running the dispatch.
 from __future__ import annotations
 
 import asyncio
-import os
 import time
 from typing import Optional
 
 from ..resilience.device import (BoundedSlots, BufferQuarantine,
                                  DeviceTimeoutError, device_deadline_s)
+from ..utils.env import env_bool, env_int
 
 
 def pipeline_enabled() -> bool:
     """Kill-switch for the async dispatch path (``BIFROMQ_PIPELINE=0``
     degrades ``match_batch_async`` to the sync serving path)."""
-    return os.environ.get("BIFROMQ_PIPELINE", "1").lower() \
-        not in ("0", "off", "false")
+    return env_bool("BIFROMQ_PIPELINE", True)
 
 
 def pipeline_depth() -> int:
     """In-flight device batches (2 = double-buffered, 3 = triple)."""
-    try:
-        d = int(os.environ.get("BIFROMQ_PIPELINE_DEPTH", "2"))
-    except ValueError:
-        d = 2
-    return max(1, min(d, 8))
+    return max(1, min(env_int("BIFROMQ_PIPELINE_DEPTH", 2), 8))
 
 
 def pipeline_min_floor() -> int:
     """Shallow-queue pow2 pad floor (the latency floor; 16 stays the
     throughput floor). Each extra floor is one more XLA shape class, so
     it is a single knob, not a free sweep."""
-    try:
-        f = int(os.environ.get("BIFROMQ_PIPELINE_MIN_BATCH", "8"))
-    except ValueError:
-        f = 8
-    return max(1, min(f, 16))
+    return max(1, min(env_int("BIFROMQ_PIPELINE_MIN_BATCH", 8), 16))
 
 
 def donation_enabled() -> bool:
     """Donate in-flight probe buffers to XLA (``walk_routes_donated``).
     Default on — the ring never re-reads a dispatched Probes object (the
     escalation/readback paths only touch the host TokenizedTopics copy)."""
-    return os.environ.get("BIFROMQ_DONATE_BUFFERS", "1").lower() \
-        not in ("0", "off", "false")
+    return env_bool("BIFROMQ_DONATE_BUFFERS", True)
 
 
 class DispatchRing(BoundedSlots):

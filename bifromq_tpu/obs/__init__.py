@@ -32,7 +32,8 @@ import time
 import weakref
 from typing import Callable, Optional
 
-from ..utils.env import env_float as _env_float
+from ..utils.env import (env_bool as _env_bool, env_float as _env_float,
+                         env_str as _env_str)
 from .device import DeviceGauges
 from .exporter import FileSink, HTTPSink, TelemetryExporter
 from .neighbor import NoisyNeighborDetector
@@ -45,7 +46,7 @@ from .window import WindowedCounter, WindowedLog2Histogram
 class ObsHub:
     def __init__(self, *, clock: Callable[[], float] = time.monotonic,
                  window_s: Optional[float] = None) -> None:
-        self.enabled = os.environ.get("BIFROMQ_OBS_WINDOWS", "1") != "0"
+        self.enabled = _env_bool("BIFROMQ_OBS_WINDOWS", True)
         ws = window_s or _env_float("BIFROMQ_OBS_WINDOW_S", 10.0)
         if ws <= 0:
             # a bad telemetry knob must never crash the publish hot path
@@ -87,9 +88,8 @@ class ObsHub:
         # node identity for federated sinks (ISSUE 5 satellite): stamped
         # into every exporter record's resource envelope; the starter
         # overrides from the cluster config
-        self.node_id = os.environ.get("BIFROMQ_NODE_ID",
-                                      "").strip() or f"pid-{os.getpid()}"
-        self.cluster_id = os.environ.get("BIFROMQ_CLUSTER_ID", "").strip()
+        self.node_id = _env_str("BIFROMQ_NODE_ID") or f"pid-{os.getpid()}"
+        self.cluster_id = _env_str("BIFROMQ_CLUSTER_ID")
 
     # ---------------- hot-path recording -----------------------------------
 
@@ -218,12 +218,11 @@ class ObsHub:
     # ---------------- exporter lifecycle -----------------------------------
 
     def exporter_from_env(self) -> Optional[TelemetryExporter]:
-        path = os.environ.get("BIFROMQ_OBS_EXPORT", "").strip()
-        url = os.environ.get("BIFROMQ_OBS_EXPORT_URL", "").strip()
+        path = _env_str("BIFROMQ_OBS_EXPORT")
+        url = _env_str("BIFROMQ_OBS_EXPORT_URL")
         if not path and not url:
             return None
-        framing = os.environ.get("BIFROMQ_OBS_FORMAT",
-                                 "jsonl").strip().lower() or "jsonl"
+        framing = _env_str("BIFROMQ_OBS_FORMAT", "jsonl").lower()
         if framing not in ("jsonl", "otlp"):
             import logging
             logging.getLogger(__name__).error(
@@ -241,8 +240,7 @@ class ObsHub:
             sink,
             interval_s=_env_float("BIFROMQ_OBS_EXPORT_INTERVAL_S", 2.0),
             queue_cap=int(_env_float("BIFROMQ_OBS_EXPORT_CAP", 2048)),
-            export_sampled=os.environ.get(
-                "BIFROMQ_OBS_EXPORT_SAMPLED", "0") == "1",
+            export_sampled=_env_bool("BIFROMQ_OBS_EXPORT_SAMPLED", False),
             snapshot_fn=self._export_snapshot,
             resource=self.resource_envelope(),
             framing=framing)
@@ -278,7 +276,7 @@ class ObsHub:
         """Build the segment store from env knobs: ``BIFROMQ_OBS_STORE``
         (directory; empty = disabled), ``BIFROMQ_OBS_STORE_SEGMENT_BYTES``
         and ``BIFROMQ_OBS_STORE_SEGMENTS`` (retention)."""
-        path = os.environ.get("BIFROMQ_OBS_STORE", "").strip()
+        path = _env_str("BIFROMQ_OBS_STORE")
         if not path:
             return None
         try:

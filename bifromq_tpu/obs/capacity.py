@@ -37,9 +37,10 @@ forms (models.matcher imports the obs package at module level).
 from __future__ import annotations
 
 import math
-import os
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..utils.env import env_int as _env_int
 
 _I32 = 4            # every automaton table is int32
 _EDGE_ENTRY_I32 = 4  # edge_tab entries are (node, h1, h2, child)
@@ -52,12 +53,6 @@ def _next_pow2(n: int, floor: int = 1) -> int:
     return p
 
 
-def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name, "").strip()
-    try:
-        return int(raw) if raw else default
-    except ValueError:
-        return default
 
 
 # ---------------------------------------------------------------------------

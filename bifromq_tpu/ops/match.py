@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 from dataclasses import dataclass
 from typing import Tuple
 
@@ -769,14 +770,21 @@ def _patch_device_trie(dev, pt, full, node_rows, edge_rows, ops, *,
         stats["bytes"] += int(pt.node_tab.nbytes) \
             + pt.node_tab.shape[0] * (CT_COLS + RT_COLS) * 4
     elif node_rows.size:
-        idx = _pad_patch_idx(node_rows.astype(np.int32))
-        rows = pt.node_tab[idx]
-        node_tab = scatter(node_tab, idx, rows)
-        count_tab = scatter(count_tab, idx, count_cols_from_node_tab(rows))
-        route_tab = scatter(route_tab, idx, route_cols_from_node_tab(rows))
+        # idx/rows device_put EXPLICITLY (ISSUE 10): passing host numpy
+        # into the jit'd scatter was an IMPLICIT h2d transfer per flush —
+        # legal but invisible; the transfer-guard sanitizer now proves
+        # the steady-churn path makes only declared transfers
+        idx_np = _pad_patch_idx(node_rows.astype(np.int32))
+        rows_np = pt.node_tab[idx_np]
+        idx = put(idx_np)
+        node_tab = scatter(node_tab, idx, put(rows_np))
+        count_tab = scatter(count_tab, idx,
+                            put(count_cols_from_node_tab(rows_np)))
+        route_tab = scatter(route_tab, idx,
+                            put(route_cols_from_node_tab(rows_np)))
         stats["rows"] += int(node_rows.size)
-        stats["bytes"] += int(idx.nbytes) * 3 + int(rows.nbytes) \
-            + idx.shape[0] * (CT_COLS + RT_COLS) * 4
+        stats["bytes"] += int(idx_np.nbytes) * 3 + int(rows_np.nbytes) \
+            + idx_np.shape[0] * (CT_COLS + RT_COLS) * 4
     if "edge" in full:
         stats["reshaped"] |= tuple(pt.edge_tab.shape) \
             != tuple(dev.edge_tab.shape)
@@ -784,14 +792,86 @@ def _patch_device_trie(dev, pt, full, node_rows, edge_rows, ops, *,
         stats["rows"] += int(pt.edge_tab.shape[0])
         stats["bytes"] += int(pt.edge_tab.nbytes)
     elif edge_rows.size:
-        idx = _pad_patch_idx(edge_rows.astype(np.int32))
-        rows = pt.edge_tab[idx]
-        edge_tab = scatter(edge_tab, idx, rows)
+        idx_np = _pad_patch_idx(edge_rows.astype(np.int32))
+        rows_np = pt.edge_tab[idx_np]
+        edge_tab = scatter(edge_tab, put(idx_np), put(rows_np))
         stats["rows"] += int(edge_rows.size)
-        stats["bytes"] += int(idx.nbytes) + int(rows.nbytes)
+        stats["bytes"] += int(idx_np.nbytes) + int(rows_np.nbytes)
     return DeviceTrie(node_tab=node_tab, edge_tab=edge_tab,
                       child_list=dev.child_list, count_tab=count_tab,
                       route_tab=route_tab), stats
+
+
+# shape classes already warmed this process: the scatter jit cache is
+# process-global, so re-warming an identical (table shapes, device)
+# class — e.g. one per range-matcher install on a multi-range worker —
+# is pure wasted compile CPU. The claim must be atomic: same-delay warm
+# threads wake together, and a GIL switch between check and add would
+# let several pay the traces.
+_WARMED_SCATTER_KEYS: set = set()
+_WARM_CLAIM_LOCK = threading.Lock()
+
+# node-arena floor below which the install-time warm is skipped: tiny
+# bases (unit tests, cold single-tenant workers) trace their scatters
+# in low tens of ms — background warm threads would cost more in
+# cold-start CPU contention than the first flush saves. Serving-scale
+# arenas (the ~100ms-per-trace class the warm exists for) clear this
+# easily: 20k subs already builds ~30k nodes.
+WARM_SCATTER_MIN_ROWS = 4096
+
+
+def scatter_warm_shapes(dev: DeviceTrie) -> tuple:
+    """The (shape, dtype) classes a patch flush of ``dev`` would
+    scatter into — extracted while the tables are provably alive, so
+    the delayed warm thread never has to touch (or pin) live device
+    arrays that a donated flush may consume in the meantime."""
+    return tuple((tuple(t.shape), np.dtype(t.dtype).name)
+                 for t in (dev.node_tab, dev.count_tab, dev.route_tab,
+                           dev.edge_tab) if t is not None)
+
+
+def warm_patch_scatter(shapes: tuple, *, device=None,
+                       donated: bool = True) -> None:
+    """Pre-compile the patch-flush scatters (ISSUE 10 satellite,
+    ROADMAP PR 9 follow-up (c)).
+
+    The first churn flush otherwise pays a ~100ms one-off XLA trace per
+    (table shape, idx-pad) class — on the serving path, inside
+    ``_dispatch_device``. ``shapes`` is ``scatter_warm_shapes(dev)``;
+    warming compiles the ``_PATCH_PAD_FLOOR``-row scatter (the
+    steady-churn shape; bigger dirty sets re-trace pow2-amortized) per
+    class, functional AND donated variants — both against throwaway
+    device zeros tables (the jit cache keys on avals, not identity, and
+    a live table captured across the warm delay could already be
+    donated-consumed by an early flush). Deduped per shape class per
+    process, key CLAIMED before compiling so concurrently-waking warm
+    threads (multi-range installs share the default delay) don't
+    duplicate the traces and full-table device allocations; the matcher
+    runs this on a DELAYED background thread so a cold process's first
+    serves never compete with it (see ``TpuMatcher._warm_walk``).
+    """
+    import jax.numpy as jnp
+    key = (shapes, donated, str(device))
+    with _WARM_CLAIM_LOCK:
+        if key in _WARMED_SCATTER_KEYS:
+            return
+        _WARMED_SCATTER_KEYS.add(key)
+    idx = jax.device_put(np.zeros(_PATCH_PAD_FLOOR, np.int32),
+                         device=device)
+    for shape, dtype in shapes:
+        try:
+            rows = jax.device_put(
+                np.zeros((_PATCH_PAD_FLOOR,) + tuple(shape[1:]), dtype),
+                device=device)
+            dummy = jax.device_put(jnp.zeros(shape, dtype),
+                                   device=device)
+            _scatter_rows(dummy, idx, rows)
+            if donated:
+                dummy = jax.device_put(jnp.zeros(shape, dtype),
+                                       device=device)
+                _scatter_rows_donated(dummy, idx, rows)
+        except Exception:  # noqa: BLE001 — per-table best-effort: one
+            continue       # failed class must not abort the rest
 
 
 def _expand_lib():

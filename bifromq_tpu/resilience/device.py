@@ -39,14 +39,13 @@ same discipline as ``breaker._meter``).
 from __future__ import annotations
 
 import asyncio
-import os
 import threading
 import time
 import weakref
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional
 
-from ..utils.env import env_float as _env_float
+from ..utils.env import env_bool, env_float as _env_float, env_opt_str
 from .breaker import CircuitBreaker
 
 #: severity order shared with utils.metrics.FabricMetrics
@@ -88,8 +87,8 @@ def device_deadline_s() -> Optional[float]:
     bucket walks — cheap enough per batch, and it tracks the deployment
     (a CPU walk times out in sub-second, the axon tunnel gets seconds).
     """
-    raw = os.environ.get("BIFROMQ_DEVICE_DEADLINE_S", "").strip()
-    if raw:
+    raw = env_opt_str("BIFROMQ_DEVICE_DEADLINE_S")
+    if raw is not None:
         try:
             v = float(raw)
         except ValueError:
@@ -191,8 +190,7 @@ class BufferQuarantine:
 # ---------------------------------------------------------------------------
 
 def device_breaker_enabled() -> bool:
-    return os.environ.get("BIFROMQ_DEVICE_BREAKER", "1").lower() \
-        not in ("0", "off", "false")
+    return env_bool("BIFROMQ_DEVICE_BREAKER", True)
 
 
 class DeviceBreakerBoard:

@@ -28,6 +28,61 @@ def env_float(name: str, default: float) -> float:
         return default
 
 
+# ISSUE 10 (graftcheck R3): every BIFROMQ_* knob resolves through these
+# helpers, lazily at first use — NEVER at module import (the PR 7 bug
+# class: SHEDDER/INGEST_GATE knobs frozen before the embedding broker or
+# a monkeypatching test could set its env). This module is the single
+# os.environ read site the analyzer exempts.
+
+def env_int(name: str, default: int) -> int:
+    """Int env knob, same unset/blank/garbage fallback as env_float."""
+    raw = os.environ.get(name, "").strip()
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def env_str(name: str, default: str = "") -> str:
+    """Stripped string env knob; unset/blank yields the default."""
+    return os.environ.get(name, "").strip() or default
+
+
+def env_opt_str(name: str) -> Optional[str]:
+    """Stripped string knob, or None when unset/blank (for callers that
+    must distinguish 'absent' from any concrete value)."""
+    raw = os.environ.get(name, "").strip()
+    return raw or None
+
+
+def env_opt_float(name: str) -> Optional[float]:
+    """Float knob, or None when unset/blank/garbage (tracer-style
+    optional thresholds)."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+_FALSY = ("0", "off", "false", "no")
+_TRUTHY = ("1", "on", "true", "yes")
+
+
+def env_bool(name: str, default: bool) -> bool:
+    """Boolean env knob: explicit falsy/truthy spellings win, anything
+    else (unset, blank, garbage) yields the default — so a typo'd value
+    can never silently flip a kill-switch."""
+    raw = os.environ.get(name, "").strip().lower()
+    if raw in _FALSY:
+        return False
+    if raw in _TRUTHY:
+        return True
+    return default
+
+
 class EnvProvider:
     """Names + sizes the process's auxiliary executors."""
 

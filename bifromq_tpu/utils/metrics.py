@@ -86,6 +86,23 @@ class StageLatencies:
 # the process-global stage-latency registry the hot path reports into
 STAGES = StageLatencies()
 
+# ISSUE 10 (graftcheck R5): the registered stage-name set. Stage
+# histograms are stringly-typed — a typo'd name at a record site would
+# silently open an orphan series nobody dashboards — so every literal
+# fed to STAGES.record / Batcher(stage=...) / OBS.record_latency must
+# appear here, and every entry here must be emitted somewhere (the
+# analyzer checks both directions).
+KNOWN_STAGES = frozenset({
+    "ingest",           # mqtt/session publish ingest
+    "queue_wait",       # scheduler/batcher enqueue→emit
+    "rpc",              # rpc/fabric attempt wall time
+    "device",           # dist/worker per-range device match
+    "device.dispatch",  # matcher host enqueue cost
+    "device.ready",     # in-flight walk awaited on readiness
+    "device.fetch",     # final host copy
+    "deliver",          # dist/service fan-out
+})
+
 
 class TenantMetric(enum.Enum):
     CONNECTIONS = "connections"

@@ -11,8 +11,9 @@ plus TPU-specific knobs (match batch bucket, walk width).
 from __future__ import annotations
 
 import enum
-import os
 from typing import Any, Callable, Dict, Optional
+
+from .env import env_opt_str
 
 
 def _bool(v: str) -> bool:
@@ -66,7 +67,7 @@ def get(prop: SysProp) -> Any:
     if prop in _overrides:
         return _overrides[prop]
     if prop not in _cache:
-        raw = os.environ.get(f"BIFROMQ_{prop.env_suffix}")
+        raw = env_opt_str(f"BIFROMQ_{prop.env_suffix}")
         if raw is None:
             _cache[prop] = prop.default
         else:

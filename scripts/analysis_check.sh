@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Tier-2 gate (ISSUE 10): graftcheck static analysis + sanitizers.
+#
+# Fails when:
+#   - the analyzer reports ANY unsuppressed finding on the package
+#   - a suppression entry matches no live site (dead suppressions rot)
+#   - the checked-in stamp.json hash disagrees with a fresh run
+#     (someone changed findings/suppressions without --write-stamp)
+#   - a rule fixture stops firing, or the transfer-guard harness fails
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+echo "== graftcheck: zero unsuppressed findings, no dead suppressions =="
+python -m bifromq_tpu.analysis
+
+echo "== stamp freshness (GET /metrics build-info serves this) =="
+fresh=$(python -m bifromq_tpu.analysis --json \
+        | python -c "import json,sys; print(json.load(sys.stdin)['hash'])")
+stamped=$(python -c "import json; \
+print(json.load(open('bifromq_tpu/analysis/stamp.json'))['hash'])")
+if [ "$fresh" != "$stamped" ]; then
+    echo "FAIL: stamp hash drift (fresh=$fresh stamped=$stamped)" >&2
+    echo "      rerun: python -m bifromq_tpu.analysis --write-stamp" >&2
+    exit 1
+fi
+echo "stamp hash $stamped matches fresh run"
+
+echo "== rule fixtures fire + transfer-guard harness =="
+python -m pytest tests/test_analysis.py tests/test_sanitize.py -q \
+    -p no:cacheprovider
+
+echo "analysis_check PASS"

@@ -1,0 +1,27 @@
+"""R1 clean twin: same shapes, no host syncs — graftcheck must stay
+quiet here."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def clean_walk(x):
+    return jnp.asarray(x) + 1       # jnp is traced, not a host sync
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def clean_static(x, k):
+    return x * k
+
+
+def host_side(x):
+    # not a hot zone: un-jitted host helper may use numpy freely
+    return np.asarray(x).sum()
+
+
+def shapes_ok(tab):
+    # metadata reads are host ints, not device fetches
+    return int(tab.shape[0]) + int(tab.nbytes)

@@ -1,0 +1,29 @@
+"""R1 fixture: host syncs inside jit'd bodies (every line here is a
+known-violation snippet graftcheck must flag — never imported, only
+parsed)."""
+import functools
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def bad_asarray(x):
+    return np.asarray(x)            # R1: host sync in a jit body
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def bad_item(x, k):
+    return x.item()                 # R1: .item() blocks on the device
+
+
+@jax.jit
+def bad_scalar(x):
+    return float(x)                 # R1: scalar coercion fetch
+
+
+def outer():
+    @jax.jit
+    def inner(x):
+        return x.tolist()           # R1: nested defs inherit hotness
+    return inner

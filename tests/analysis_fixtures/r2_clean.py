@@ -1,0 +1,24 @@
+"""R2 clean twin: reassign or quarantine after donating."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def donated(x):
+    return x + 1
+
+
+def good_reassign(x):
+    x = donated(x)                  # rebinding closes the window
+    return x
+
+
+def good_last_use(x):
+    return donated(x)               # donation is the final read
+
+
+def good_quarantine(x, ring):
+    res = donated(x)
+    ring.quarantine.add(res)        # hand-off keeps the buffer pinned
+    return None

@@ -1,0 +1,29 @@
+"""R2 fixture: reads after donation."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def donated(x):
+    return x + 1
+
+
+def bad_read_after_donate(x):
+    y = donated(x)
+    return x.sum() + y              # R2: x's buffer belongs to XLA now
+
+
+def bad_alias(x, flag):
+    fn = donated if flag else (lambda a: a)
+    out = fn(x)
+    return x, out                   # R2: donated through the alias
+
+
+def bad_closure_shadow(x):
+    res = donated(x)
+
+    def cb():
+        x = 0                       # closure-local shadow — must NOT
+        return x                    # close the outer donation window
+    return x.sum() + res            # R2: read after donation

@@ -1,0 +1,10 @@
+"""R3 clean twin: helpers, resolved lazily."""
+from bifromq_tpu.utils.env import env_bool, env_float
+
+
+def lazy_knob():
+    return env_float("BIFROMQ_FIXTURE_LAZY", 1.0)
+
+
+def lazy_switch():
+    return env_bool("BIFROMQ_FIXTURE_SWITCH", True)

@@ -1,0 +1,35 @@
+"""R3 fixture: raw and import-frozen knob reads."""
+import os
+
+from bifromq_tpu.utils.env import env_float
+
+# R3: resolved at module import — frozen before the embedder sets env
+FROZEN = env_float("BIFROMQ_FIXTURE_FROZEN", 1.0)
+
+
+def bad_raw_get():
+    # R3: raw os.environ read of a BIFROMQ_* knob
+    return os.environ.get("BIFROMQ_FIXTURE_RAW", "0")
+
+
+def bad_subscript():
+    return os.environ["BIFROMQ_FIXTURE_SUB"]           # R3
+
+
+def bad_membership():
+    return "BIFROMQ_FIXTURE_IN" in os.environ          # R3
+
+
+def bad_fstring(suffix):
+    return os.environ.get(f"BIFROMQ_FIX_{suffix}")     # R3 (dynamic)
+
+
+class BadConfig:
+    # R3: class bodies execute at import — frozen exactly like a
+    # module-level read (the PR 7 SHEDDER/INGEST_GATE bug class)
+    DEPTH = env_float("BIFROMQ_FIXTURE_CLASS_FROZEN", 2.0)
+
+
+def bad_default_arg(v=env_float("BIFROMQ_FIXTURE_DEFAULT_FROZEN", 1.0)):
+    # R3: default expressions evaluate ONCE at import too
+    return v

@@ -1,0 +1,25 @@
+"""R4 clean twin: consistent order, slow work outside the lock."""
+import threading
+import time
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def path_one():
+    with lock_a:
+        with lock_b:
+            pass
+
+
+def path_two():
+    with lock_a:                    # same order everywhere
+        with lock_b:
+            pass
+
+
+def copy_then_work():
+    with lock_a:
+        snapshot = [1, 2, 3]
+    time.sleep(0.0)                 # slow work OUTSIDE the lock
+    return snapshot

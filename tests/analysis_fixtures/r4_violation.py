@@ -1,0 +1,38 @@
+"""R4 fixture: inconsistent lock order + blocking under a lock."""
+import threading
+import time
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def path_one():
+    with lock_a:
+        with lock_b:                # order a -> b
+            pass
+
+
+def path_two():
+    with lock_b:
+        with lock_a:                # R4: order b -> a (inconsistent)
+            pass
+
+
+def bad_sleep_under_lock():
+    with lock_a:
+        time.sleep(0.1)             # R4: blocking call while holding
+
+
+def _slow_helper():
+    time.sleep(0.1)
+
+
+def bad_indirect_block():
+    with lock_b:
+        _slow_helper()              # R4: one-level call expansion
+
+
+def bad_multi_item_with(path):
+    # R4: items evaluate left to right — open() runs under lock_a
+    with lock_a, open(path) as f:
+        return f.read()

@@ -1,0 +1,10 @@
+"""R5 clean twin: registered names only."""
+from bifromq_tpu.utils.metrics import MATCH_CACHE, STAGES
+
+
+def good_stage(dt):
+    STAGES.record("device.dispatch", dt)
+
+
+def good_cache_field():
+    MATCH_CACHE.inc("matcher", "hits", 1)

@@ -1,0 +1,12 @@
+"""R5 fixture: unregistered stage + cache-field typo."""
+from bifromq_tpu.utils.metrics import MATCH_CACHE, STAGES
+
+
+def bad_stage(dt):
+    # R5: not in KNOWN_STAGES — would open an orphan histogram
+    STAGES.record("devcie.dispatch", dt)
+
+
+def bad_cache_field():
+    # R5: typo'd field not in MatchCacheMetrics._FIELDS
+    MATCH_CACHE.inc("matcher", "hist", 1)

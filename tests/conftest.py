@@ -96,3 +96,15 @@ def certs(tmp_path_factory):
          "-keyout", key, "-out", crt, "-days", "1",
          "-subj", "/CN=localhost"], check=True, capture_output=True)
     return key, crt
+
+
+@pytest.fixture
+def no_implicit_transfers():
+    """ISSUE 10 transfer-guard sanitizer: yields a context-manager
+    factory; the test warms its path (compiles) first, then serves
+    inside ``with no_implicit_transfers():`` — any implicit device
+    transfer raises. Proves the guard arms on this jax before handing
+    it out, so the harness can never pass vacuously."""
+    from bifromq_tpu.analysis import sanitize
+    sanitize.assert_guard_arms()
+    return sanitize.no_implicit_transfers

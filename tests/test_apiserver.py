@@ -194,6 +194,26 @@ class TestAPI:
         assert t["delivered"] >= 1
         assert registry.get("DevOnly", TenantMetric.PUB_RECEIVED) >= 1
 
+    async def test_metrics_build_info_graftcheck(self, stack):
+        # ISSUE 10: /metrics stamps the analyzer's checked-in last-run
+        # state (rule count, suppression count, hash) so drift between
+        # nodes is visible on a live scrape
+        _, api, _ = stack
+        status, out = await http(api.port, "GET", "/metrics")
+        assert status == 200
+        g = out["build_info"]["graftcheck"]
+        assert g["stamp"] == "ok"
+        # served VERBATIM from the checked-in stamp — compare against
+        # the file, not literal counts, so a legitimate rule-set change
+        # plus --write-stamp doesn't break an unrelated HTTP test
+        import json as _json
+        from bifromq_tpu.analysis import STAMP_PATH
+        with open(STAMP_PATH, encoding="utf-8") as f:
+            stamp = _json.load(f)
+        for k in ("rules", "suppressions", "unsuppressed", "hash"):
+            assert g[k] == stamp[k]
+        assert len(g["hash"]) == 16
+
     async def test_unknown_route(self, stack):
         _, api, _ = stack
         status, _ = await http(api.port, "GET", "/nope")
