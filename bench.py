@@ -56,8 +56,17 @@ zero-rebuild/zero-generation-bump window, and exact oracle parity after
 the storm (BENCH_CHURN_SUBS / BENCH_CHURN_OPS; persists
 bench_results/churn_last.json and stamps record["churn"]).
 
+INGEST BYTE PLANE (ISSUE 11): config "9" A/Bs publish-side topic prep —
+per-message python loop vs the contiguous-byte-buffer plane (native C++
+/ vectorized numpy) vs the device-side Pallas hash kernel — on the
+topic-diversity corpus, checks exact three-way parity, and verifies the
+profiler attributes a `tokenize` stage on every device batch
+(BENCH_TOK_SUBS sizes its base; every record stamps a "tokenize"
+section when config 9 ran).
+
 Env knobs: BENCH_CONFIGS ("1,2,3,4,5" default; "2" = headline only;
 "6" = match-cache A/B; "7" = pipeline A/B; "8" = churn/patch;
+"9" = ingest byte-plane A/B;
 BENCH_CACHE_HOT_TOPICS sizes config 6's Zipf pool),
 BENCH_SUBS (config-2 subs, default 1_000_000), BENCH_BATCH (16384),
 BENCH_ITERS (30), BENCH_K (16), BENCH_SEED (0), BENCH_RETAINED (1_000_000),
@@ -1083,6 +1092,142 @@ def bench_config8():
     return out
 
 
+def bench_config9():
+    """Ingest byte-plane A/B (ISSUE 11): publish-side topic prep measured
+    on the topic-diversity corpus (realistic level-count / byte-length /
+    unicode mix, not `bench/a/b`) across the three tokenizer paths —
+
+    - **python** — the per-message loop (split + per-level hashlib), the
+      r01 138K-topics/s wall;
+    - **native** — the byte plane: one contiguous TopicBytes pack + the
+      C++ tokenizer (numpy-vectorized BLAKE2b as the no-toolchain leg);
+    - **device** — raw bytes shipped to the Pallas hash kernel
+      (interpret mode on CPU: a correctness surface; its rate is
+      reported, not gated).
+
+    The acceptance bar: byte-plane prep ≥10× the python loop at batch
+    ≥1024, exact three-way parity, and the matcher-integrated leg must
+    attribute a `tokenize` stage on every device batch in the profiler
+    split. Stamps record["tokenize"].
+    """
+    import asyncio
+
+    from bifromq_tpu import workloads
+    from bifromq_tpu.models import bytetok
+    from bifromq_tpu.models.automaton import tokenize
+    from bifromq_tpu.models.bytetok import TopicBytes
+    from bifromq_tpu.models.matcher import TpuMatcher
+
+    n_subs = min(N_SUBS, int(os.environ.get("BENCH_TOK_SUBS", "50000")))
+    batch = max(1024, min(BATCH, 4096))
+    iters = max(8, ITERS // 2)
+    name = f"c9_ingest_{n_subs}"
+    tries = workloads.config_wildcard(n_subs, seed=SEED)
+    m = TpuMatcher.from_tries(tries, match_cache=False, auto_compact=False)
+    ct = m._base_ct
+    corpus = workloads.diverse_topics(batch * 4, seed=SEED + 11)
+    batches = [corpus[i * batch:(i + 1) * batch] for i in range(4)]
+    roots = [ct.root_of("tenant0")] * batch
+
+    def timed(fn, legs=iters):
+        fn(0)   # warm (jit / native lib load / cache shape)
+        s = time.perf_counter()
+        for it in range(legs):
+            fn(it)
+        return batch * legs / (time.perf_counter() - s)
+
+    # --- per-message python loop (the r01 wall: one tokenize per
+    # publish, split + per-level hashlib — the pre-batching shape) -----
+    def py_leg(it):
+        for t in batches[it % 4]:
+            tokenize([t], roots[:1], max_levels=ct.max_levels,
+                     salt=ct.salt, native=False)
+    py_rate = timed(py_leg, legs=2)
+    # batched python loop (one call per batch, still per-row inside):
+    # reported for transparency, not the A/B baseline
+    py_batched = timed(lambda it: tokenize(
+        batches[it % 4], roots, max_levels=ct.max_levels, salt=ct.salt,
+        native=False), legs=max(4, iters // 4))
+    # --- byte plane, native C++ (pack cost included — honest) -------------
+    nat_rate = timed(lambda it: tokenize(
+        TopicBytes.from_topics(batches[it % 4]), roots,
+        max_levels=ct.max_levels, salt=ct.salt))
+    # --- byte plane, vectorized numpy (no-toolchain fallback) -------------
+    np_rate = timed(lambda it: bytetok.tokenize_bytes(
+        TopicBytes.from_topics(batches[it % 4]), roots,
+        max_levels=ct.max_levels, salt=ct.salt))
+    # --- device kernel (interpret on CPU) ---------------------------------
+    from bifromq_tpu.ops.tokenize import device_tokenize
+
+    def dev_leg(it):
+        _, p = device_tokenize(TopicBytes.from_topics(batches[it % 4]),
+                               roots, max_levels=ct.max_levels,
+                               salt=ct.salt, batch=batch)
+        np.asarray(p.tok_h1)
+    dev_rate = timed(dev_leg, legs=max(4, iters // 4))
+
+    # --- three-way parity on one batch ------------------------------------
+    tb0 = TopicBytes.from_topics(batches[0])
+    py = tokenize(batches[0], roots, max_levels=ct.max_levels,
+                  salt=ct.salt, native=False)
+    nat = tokenize(tb0, roots, max_levels=ct.max_levels, salt=ct.salt)
+    h1, h2, ln, _, sm = bytetok.tokenize_bytes(
+        tb0, roots, max_levels=ct.max_levels, salt=ct.salt)
+    mirror, probes = device_tokenize(tb0, roots, max_levels=ct.max_levels,
+                                     salt=ct.salt, batch=batch)
+    sup = mirror.lengths >= 0
+    parity = (np.array_equal(py.tok_h1, nat.tok_h1)
+              and np.array_equal(py.tok_h1, h1)
+              and np.array_equal(py.tok_h2, h2)
+              and np.array_equal(py.lengths, ln)
+              and np.array_equal(py.sys_mask, sm)
+              and np.array_equal(np.asarray(probes.tok_h1)[sup],
+                                 py.tok_h1[sup]))
+
+    # --- matcher-integrated leg: tokenize stage on every device batch -----
+    from bifromq_tpu.obs import OBS
+    prev = os.environ.get("BIFROMQ_DEVICE_TOKENIZE")
+    os.environ["BIFROMQ_DEVICE_TOKENIZE"] = "1"
+    try:
+        rec0 = OBS.profiler.batches_total
+
+        async def run():
+            for i in range(8):
+                sub = [("tenant0", t)
+                       for t in batches[i % 4][:256]]
+                await m.match_batch_async(sub, batch=256)
+        asyncio.run(run())
+    finally:
+        if prev is None:
+            os.environ.pop("BIFROMQ_DEVICE_TOKENIZE", None)
+        else:
+            os.environ["BIFROMQ_DEVICE_TOKENIZE"] = prev
+    recs = OBS.profiler.records()[-(OBS.profiler.batches_total - rec0):]
+    dev_batches = [r for r in recs if r.kernel != "oracle"]
+    tokenized_all = bool(dev_batches) and all(
+        r.tokenize_s > 0 for r in dev_batches)
+    split = OBS.profiler.split_snapshot(probe=False)
+
+    out = {
+        "batch": batch,
+        "corpus": "diverse_topics",
+        "python_topics_per_s": round(py_rate, 1),
+        "python_batched_topics_per_s": round(py_batched, 1),
+        "native_topics_per_s": round(nat_rate, 1),
+        "numpy_topics_per_s": round(np_rate, 1),
+        "device_topics_per_s": round(dev_rate, 1),
+        "speedup_native_vs_python": round(nat_rate / max(1e-9, py_rate),
+                                          2),
+        "speedup_numpy_vs_python": round(np_rate / max(1e-9, py_rate), 2),
+        "three_way_parity": parity,
+        "device_supported_frac": round(float(sup.mean()), 4),
+        "tokenize_stage_on_every_device_batch": tokenized_all,
+        "profiler_tokenize_ms_p50": split.get("tokenize_ms_p50"),
+    }
+    log(f"[{name}] {json.dumps(out)}")
+    return out
+
+
 def bench_broker():
     """End-to-end MQTT broker throughput over loopback TCP: QoS0/QoS1
     publish → dist match (device matcher) → local fan-out → delivery.
@@ -1300,6 +1445,8 @@ def main():
         results["c7"] = bench_config7()
     if "8" in CONFIGS:
         results["c8"] = bench_config8()
+    if "9" in CONFIGS:
+        results["c9"] = bench_config9()
     if "b" in CONFIGS:
         results["broker"] = bench_broker()
 
@@ -1401,6 +1548,20 @@ def main():
             "generation_bumps_in_window":
                 c8["generation_bumps_in_window"],
             "oracle_parity": c8["oracle_parity"],
+        }
+    # ingest byte-plane cell next to the headline (ISSUE 11): the
+    # three-way prep A/B + parity verdict and the profiler's tokenize
+    # attribution — every record carries the tokenize story
+    if "c9" in results:
+        c9 = results["c9"]
+        record["tokenize"] = {
+            "python_topics_per_s": c9["python_topics_per_s"],
+            "native_topics_per_s": c9["native_topics_per_s"],
+            "device_topics_per_s": c9["device_topics_per_s"],
+            "speedup_native_vs_python": c9["speedup_native_vs_python"],
+            "three_way_parity": c9["three_way_parity"],
+            "tokenize_stage_on_every_device_batch":
+                c9["tokenize_stage_on_every_device_batch"],
         }
     # per-stage p50/p99 next to the headline (ISSUE 2): where the broker
     # plane actually spends its time (queue-wait vs device vs deliver)

@@ -26,7 +26,9 @@ from .core import (Context, Finding, ParsedFile, Rule, dotted_name,
 # not inferred — an AST pass has no call graph across jit boundaries)
 HOT_SCOPES: Dict[str, Set[str]] = {
     "models/matcher.py": {
-        "TpuMatcher._dispatch_device", "TpuMatcher._walk_primary",
+        "TpuMatcher._prepare_probes", "TpuMatcher._dispatch_device",
+        "TpuMatcher._dispatch_prepared", "TpuMatcher._walk_primary",
+        "TpuMatcher._await_ready_sync",
         "TpuMatcher._fetch_walk", "TpuMatcher._expand_walk",
         "TpuMatcher._device_leg_async", "TpuMatcher._flush_patches",
     },
@@ -38,6 +40,10 @@ HOT_SCOPES: Dict[str, Set[str]] = {
         "_count_walk", "_route_walk", "_walk_routes_fn",
         "walk_routes_donated", "patch_device_trie", "_patch_device_trie",
     },
+    # ISSUE 11 byte-plane prep: the device hash kernel's math + the
+    # upload/dispatch wrappers feeding it
+    "ops/tokenize.py": {"_hash_lanes", "hash_topics_device",
+                        "device_tokenize"},
     "models/kernels.py": {"_build_fused", "fused_walk_routes"},
 }
 

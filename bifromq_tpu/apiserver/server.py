@@ -555,7 +555,11 @@ class APIServer:
         registered matcher, guarded HBM stats, planner coefficients;
         ``?n_subs=`` (+ optional ``shards=``) adds a full ``fits``
         verdict — HBM headroom and the fused-VMEM gate — computed
-        without dispatching anything."""
+        without dispatching anything. ``?calibrate=1`` (ISSUE 11
+        satellite, ROADMAP sharding follow-up (c)) re-fits the per-sub
+        coefficients from the live base with its true logical sub count
+        and reports old-vs-new deltas; the ``fits`` verdict then uses
+        the re-fit planner."""
         from ..obs.capacity import capacity_report
         kw = {}
         n_subs = arg("n_subs")
@@ -564,6 +568,8 @@ class APIServer:
         shards = arg("shards")
         if shards is not None:
             kw["mesh"] = int(shards)
+        if arg("calibrate", "0") in ("1", "true"):
+            kw["calibrate"] = True
         return 200, capacity_report(
             memory=arg("memory", "1") != "0", **kw)
 

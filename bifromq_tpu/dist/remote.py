@@ -102,8 +102,9 @@ class DistWorkerRPCService:
         for _ in range(n):
             tenant_b, pos = _read16(payload, pos)
             topic_b, pos = _read16(payload, pos)
-            queries.append((tenant_b.decode(),
-                            topic_b.decode().split("/")))
+            # ISSUE 11 byte plane: the decoded topic string flows to the
+            # matcher unsplit (levels materialize only on fallback paths)
+            queries.append((tenant_b.decode(), topic_b.decode()))
         results = await self.worker.match_batch(
             queries, max_persistent_fanout=mpf, max_group_fanout=mgf,
             linearized=bool(lin))
@@ -259,8 +260,17 @@ class RemoteDistWorker:
                 max_group_fanout & 0xFFFFFFFF, int(linearized), len(idxs)))
             for qi in idxs:
                 tenant_id, levels = queries[qi]
+                # ISSUE 11 byte plane: queries carry raw topic strings
+                # (or wire bytes) on the serving path; level lists =
+                # legacy callers
+                if isinstance(levels, bytes):
+                    topic_b = levels
+                elif isinstance(levels, str):
+                    topic_b = levels.encode()
+                else:
+                    topic_b = "/".join(levels).encode()
                 payload += _len16(tenant_id.encode())
-                payload += _len16("/".join(levels).encode())
+                payload += _len16(topic_b)
             out = await self.registry.client_for(ep).call(
                 self.service, "match_batch", bytes(payload),
                 timeout=self.call_timeout)

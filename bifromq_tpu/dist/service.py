@@ -334,9 +334,13 @@ class DistService:
     async def _match_missing(self, tenant_id, miss_topics, mpf, mgf):
         from ..resilience.policy import deadline_scope
         with deadline_scope(self.MATCH_DEADLINE_S):
-            # caps arrive pre-resolved (they are also the cache key dims)
+            # caps arrive pre-resolved (they are also the cache key dims).
+            # ISSUE 11 byte plane: raw topic STRINGS flow to the matcher,
+            # which packs one contiguous byte buffer per batch — no
+            # per-topic parse/list materialization on the publish path;
+            # levels appear only on the matcher's rare fallback legs.
             return await self.worker.match_batch(
-                [(tenant_id, topic_util.parse(t)) for t in miss_topics],
+                [(tenant_id, t) for t in miss_topics],
                 max_persistent_fanout=mpf, max_group_fanout=mgf)
 
     async def _fan_out(self, tenant_id: str, call: PubCall,

@@ -324,8 +324,9 @@ class DistWorkerCoProc(IKVRangeCoProc):
             t, pos = _read_frame(input_data, pos)
             topics.append(t.decode())
         tenant_id = tenant_b.decode()
+        # ISSUE 11 byte plane: raw topic strings through to the matcher
         results = self.matcher.match_batch(
-            [(tenant_id, topic_util.parse(t)) for t in topics])
+            [(tenant_id, t) for t in topics])
         # full group fidelity on the wire (same codec as the RPC service)
         out = bytearray(struct.pack(">I", len(results)))
         for res in results:

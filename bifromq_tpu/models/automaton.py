@@ -884,6 +884,17 @@ class PatchableTrie(CompiledTrie):
 
 # --------------------------- probe tokenization ----------------------------
 
+def pad_rows(a: np.ndarray, rows: int, fill=0) -> np.ndarray:
+    """Pad a row-gathered array up to ``rows`` rows — THE one pad-to-
+    batch helper (escalation sub-batches and the device tokenizer's
+    ragged-grid padding both snap shapes to reusable XLA classes)."""
+    if a.shape[0] == rows:
+        return a
+    out = np.full((rows,) + a.shape[1:], fill, dtype=a.dtype)
+    out[:a.shape[0]] = a
+    return out
+
+
 @dataclass
 class TokenizedTopics:
     """Fixed-shape device probe batch. Padding rows have length == -1."""
@@ -896,6 +907,18 @@ class TokenizedTopics:
     @property
     def batch(self) -> int:
         return self.tok_h1.shape[0]
+
+    def sub_batch(self, rows: np.ndarray, batch: int) -> "TokenizedTopics":
+        """Row-subset probe batch padded to ``batch`` rows — the
+        escalation re-walk's sub-batch constructor (ISSUE 11: shared
+        polymorphically with the device-tokenized mirror, which has no
+        host hash rows and re-tokenizes the selected rows instead)."""
+        return TokenizedTopics(
+            tok_h1=pad_rows(self.tok_h1[rows], batch),
+            tok_h2=pad_rows(self.tok_h2[rows], batch),
+            lengths=pad_rows(self.lengths[rows], batch, fill=_EMPTY),
+            roots=pad_rows(self.roots[rows], batch, fill=_EMPTY),
+            sys_mask=pad_rows(self.sys_mask[rows], batch))
 
 
 class TokenCache:
@@ -943,6 +966,46 @@ class TokenCache:
         self._d[key] = value
 
 
+def _tokenize_cached(keys, roots: Sequence[int], cache: TokenCache, *,
+                     batch: int, width: int, salt: int,
+                     miss_tokenize) -> TokenizedTopics:
+    """The ONE cache-probe + miss-fill + padded-assembly definition,
+    shared by the str/tuple-keyed and byte-slice-keyed paths (ISSUE 11):
+    ``miss_tokenize(miss_idx)`` returns a TokenizedTopics for exactly
+    those rows; cached values are (h1_row, h2_row, length, sys) and
+    depend only on (topic, salt, width) — roots are per-batch, never
+    cached."""
+    cache.match_config(salt, width)
+    miss_idx = []
+    vals = []
+    for i, k in enumerate(keys):
+        v = cache.get(k)
+        vals.append(v)
+        if v is None:
+            miss_idx.append(i)
+    if miss_idx:
+        sub = miss_tokenize(miss_idx)
+        for j, i in enumerate(miss_idx):
+            v = (sub.tok_h1[j].copy(), sub.tok_h2[j].copy(),
+                 int(sub.lengths[j]), bool(sub.sys_mask[j]))
+            cache.put(keys[i], v)
+            vals[i] = v
+    tok_h1 = np.zeros((batch, width), dtype=np.int32)
+    tok_h2 = np.zeros((batch, width), dtype=np.int32)
+    lengths = np.full(batch, _EMPTY, dtype=np.int32)
+    rootv = np.full(batch, _EMPTY, dtype=np.int32)
+    sys_mask = np.zeros(batch, dtype=bool)
+    for i, (h1, h2, ln, sm) in enumerate(vals):
+        tok_h1[i] = h1
+        tok_h2[i] = h2
+        lengths[i] = ln
+        rootv[i] = roots[i] if ln >= 0 else _EMPTY
+        sys_mask[i] = sm
+    return TokenizedTopics(tok_h1=tok_h1, tok_h2=tok_h2,
+                           lengths=lengths, roots=rootv,
+                           sys_mask=sys_mask)
+
+
 def tokenize(topics: Sequence[Sequence[str]], roots: Sequence[int],
              *, max_levels: int, salt: int,
              batch: Optional[int] = None,
@@ -959,45 +1022,29 @@ def tokenize(topics: Sequence[Sequence[str]], roots: Sequence[int],
     Uses the native (C++) tokenizer when available — the Python loop below
     is the semantics reference and fallback. With ``cache``, repeated
     topics skip hashing entirely (row-level memo).
+
+    ISSUE 11: ``topics`` may also be one pre-packed
+    :class:`~bifromq_tpu.models.bytetok.TopicBytes` batch (the byte
+    plane: one contiguous uint8 buffer + offsets, no per-row Python) —
+    the batch feeds the native tokenizer directly, falls back to the
+    vectorized numpy tokenizer (never the per-row loop), and the cache
+    probes on raw byte slices instead of re-encoding.
     """
+    from .bytetok import TopicBytes
+    if isinstance(topics, TopicBytes):
+        return _tokenize_topic_bytes(topics, roots, max_levels=max_levels,
+                                     salt=salt, batch=batch, native=native,
+                                     cache=cache)
     if cache is not None:
         n = len(topics)
-        b = batch or n
-        width = max_levels + 1
-        cache.match_config(salt, width)
-        keys = [t if isinstance(t, str) else tuple(t) for t in topics]
-        miss_idx = []
-        miss_topics = []
-        vals = []
-        for i, k in enumerate(keys):
-            v = cache.get(k)
-            vals.append(v)
-            if v is None:
-                miss_idx.append(i)
-                miss_topics.append(topics[i])
-        if miss_idx:
-            sub = tokenize(miss_topics, [0] * len(miss_topics),
-                           max_levels=max_levels, salt=salt,
-                           native=native)
-            for j, i in enumerate(miss_idx):
-                v = (sub.tok_h1[j].copy(), sub.tok_h2[j].copy(),
-                     int(sub.lengths[j]), bool(sub.sys_mask[j]))
-                cache.put(keys[i], v)
-                vals[i] = v
-        tok_h1 = np.zeros((b, width), dtype=np.int32)
-        tok_h2 = np.zeros((b, width), dtype=np.int32)
-        lengths = np.full(b, _EMPTY, dtype=np.int32)
-        rootv = np.full(b, _EMPTY, dtype=np.int32)
-        sys_mask = np.zeros(b, dtype=bool)
-        for i, (h1, h2, ln, sm) in enumerate(vals):
-            tok_h1[i] = h1
-            tok_h2[i] = h2
-            lengths[i] = ln
-            rootv[i] = roots[i] if ln >= 0 else _EMPTY
-            sys_mask[i] = sm
-        return TokenizedTopics(tok_h1=tok_h1, tok_h2=tok_h2,
-                               lengths=lengths, roots=rootv,
-                               sys_mask=sys_mask)
+        keys = [t if isinstance(t, (str, bytes)) else tuple(t)
+                for t in topics]
+        return _tokenize_cached(
+            keys, roots, cache, batch=batch or n,
+            width=max_levels + 1, salt=salt,
+            miss_tokenize=lambda idx: tokenize(
+                [topics[i] for i in idx], [0] * len(idx),
+                max_levels=max_levels, salt=salt, native=native))
     if native:
         try:
             from .native_tok import tokenize_topics_native
@@ -1017,6 +1064,8 @@ def tokenize(topics: Sequence[Sequence[str]], roots: Sequence[int],
     rootv = np.full(b, _EMPTY, dtype=np.int32)
     sys_mask = np.zeros(b, dtype=bool)
     for i, (levels, root) in enumerate(zip(topics, roots)):
+        if isinstance(levels, bytes):   # raw wire bytes (byte plane)
+            levels = levels.decode("utf-8")
         if isinstance(levels, str):  # raw topic string (native-path parity)
             levels = levels.split(topic_util.DELIMITER)
         if len(levels) > max_levels:
@@ -1031,6 +1080,52 @@ def tokenize(topics: Sequence[Sequence[str]], roots: Sequence[int],
             tok_h2[i, j] = h2
     return TokenizedTopics(tok_h1=tok_h1, tok_h2=tok_h2, lengths=lengths,
                            roots=rootv, sys_mask=sys_mask)
+
+
+def _tokenize_topic_bytes(tb, roots: Sequence[int], *, max_levels: int,
+                          salt: int, batch: Optional[int],
+                          native: bool,
+                          cache: Optional[TokenCache]) -> TokenizedTopics:
+    """The byte-plane leg of :func:`tokenize` (ISSUE 11 tentpole).
+
+    ``native=True`` feeds the raw (data, offsets) pair straight to the
+    C++ tokenizer (zero re-encoding); a missing toolchain degrades to
+    the vectorized numpy tokenizer (``bytetok.tokenize_bytes``), never
+    the per-row Python loop. ``native=False`` decodes back to the
+    Python semantics reference — the parity surface the randomized
+    suite pins all legs against. With ``cache``, keys are the raw byte
+    slices, so the probe allocates one small ``bytes`` per row and
+    hashes nothing.
+    """
+    from . import bytetok
+    n = len(tb)
+    b = batch or n
+    assert b >= n
+    width = max_levels + 1
+    if cache is not None:
+        return _tokenize_cached(
+            [tb.row_bytes(i) for i in range(n)], roots, cache, batch=b,
+            width=width, salt=salt,
+            miss_tokenize=lambda idx: _tokenize_topic_bytes(
+                tb.select(idx), [0] * len(idx), max_levels=max_levels,
+                salt=salt, batch=None, native=native, cache=None))
+    if not native:
+        # the Python reference loop, via decoded rows (parity surface)
+        return tokenize([tb.row_str(i) for i in range(n)], roots,
+                        max_levels=max_levels, salt=salt, batch=b,
+                        native=False)
+    try:
+        from .native_tok import tokenize_topics_native
+        h1, h2, _, lengths, rootv, sysm = tokenize_topics_native(
+            tb, roots, max_levels=max_levels, salt=salt, batch=b)
+        return TokenizedTopics(tok_h1=h1, tok_h2=h2, lengths=lengths,
+                               roots=rootv, sys_mask=sysm)
+    except Exception:  # noqa: BLE001 — e.g. no compiler in env
+        pass
+    h1, h2, lengths, rootv, sysm = bytetok.tokenize_bytes(
+        tb, roots, max_levels=max_levels, salt=salt, batch=b)
+    return TokenizedTopics(tok_h1=h1, tok_h2=h2, lengths=lengths,
+                           roots=rootv, sys_mask=sysm)
 
 
 # ------------------------ filter-probe tokenization -------------------------

@@ -247,9 +247,11 @@ class TenantMatchCache:
         # seq value globally unique (see _TenantSlot)
         s.seq = self._next_seq()
         n = 0
-        # both key forms: parsed level tuple (matcher) and raw string (pub)
-        for key in (tuple(filter_levels),
-                    topic_util.DELIMITER.join(filter_levels)):
+        # all three key forms: parsed level tuple, raw topic string
+        # (ISSUE 11 serving path), and raw wire bytes
+        joined = topic_util.DELIMITER.join(filter_levels)
+        for key in (tuple(filter_levels), joined,
+                    joined.encode("utf-8")):
             if s.entries.pop(key, None) is not None:
                 n += 1
         if n:

@@ -44,7 +44,7 @@ from ..utils.metrics import STAGES
 from ..utils import topic as topic_util
 from .automaton import (
     CompiledTrie, GroupMatching, Matching, PatchableTrie, PatchFallback,
-    TokenizedTopics, compile_tries, patch_enabled, tokenize,
+    compile_tries, patch_enabled, tokenize,
 )
 from .oracle import (
     PERSISTENT_SUB_BROKER_ID, UNCAPPED_FANOUT, MatchedRoutes, Route,
@@ -62,17 +62,44 @@ def _pow2_batch(n: int, floor: int = 16) -> int:
     return b
 
 
-def _pad_rows(a: np.ndarray, rows: int, fill=0) -> np.ndarray:
-    """Pad a row-gathered array up to ``rows`` rows (escalation sub-batch
-    shapes snap to powers of two so live traffic reuses XLA compiles)."""
-    if a.shape[0] == rows:
-        return a
-    out = np.full((rows,) + a.shape[1:], fill, dtype=a.dtype)
-    out[:a.shape[0]] = a
-    return out
+def _parse_levels(levels) -> List[str]:
+    """Queries carry the raw topic — str or wire ``bytes`` (ISSUE 11
+    byte plane: the serving path ships bytes to the tokenizer and only
+    the rare fallback/overlay paths materialize level lists) — or a
+    pre-parsed level sequence; normalize to a level-string list at the
+    point of use."""
+    if isinstance(levels, bytes):
+        levels = levels.decode("utf-8")
+    if isinstance(levels, str):
+        return topic_util.parse(levels)
+    return list(levels)
+
+
+def _query_key(levels):
+    """Cache/dedup key of a query's topic half: the raw string (or wire
+    bytes) is its own key (no re-join, no tuple build); level lists
+    keep the tuple form."""
+    if isinstance(levels, (str, bytes)):
+        return levels
+    return tuple(levels)
 
 # tombstone key: (full mqtt topic filter incl. any share prefix, receiver_url)
 _TombKey = Tuple[str, Tuple[int, str, str]]
+
+
+class _Prepared:
+    """Stage-1 output (ISSUE 11): a tokenized + uploaded probe batch,
+    built BEFORE ring admission so batch N+1's prep overlaps batch N's
+    walk. Holds the base snapshot it tokenized against — the dispatch
+    half re-preps iff a compaction swapped the base in the gap (roots
+    and salt are per-snapshot)."""
+
+    __slots__ = ("queries", "ct", "tok", "probes", "roots", "batch",
+                 "tokenize_s")
+
+    def __init__(self, **kw) -> None:
+        for k, v in kw.items():
+            setattr(self, k, v)
 
 
 class _InFlight:
@@ -90,11 +117,13 @@ class _InFlight:
     """
 
     __slots__ = ("queries", "ct", "dev", "tok", "roots", "res", "tomb",
-                 "delta", "batch", "kernel", "fault", "dispatch_s")
+                 "delta", "batch", "kernel", "fault", "dispatch_s",
+                 "tokenize_s")
 
     def __init__(self, **kw) -> None:
         self.fault = None   # fired device FaultRule (ISSUE 7 chaos hook)
         self.dispatch_s = 0.0  # dispatch-stage seconds (ISSUE 8 profiler)
+        self.tokenize_s = 0.0  # stage-1 prep seconds (ISSUE 11 profiler)
         for k, v in kw.items():
             setattr(self, k, v)
 
@@ -740,7 +769,7 @@ class TpuMatcher:
         uniq_queries: List[Tuple[str, Sequence[str]]] = []
         miss_rows: List[Tuple[int, int]] = []   # (query idx, unique pos)
         for qi, (tenant_id, levels) in enumerate(queries):
-            key = tuple(levels)
+            key = _query_key(levels)
             m = cache.get(tenant_id, key, caps)
             if m is not None:
                 out[qi] = m
@@ -989,42 +1018,65 @@ class TpuMatcher:
         from ..resilience.device import DeviceTimeoutError
         from .pipeline import donation_enabled
         ring = self._pipeline_ring()
+        # ISSUE 11 overlap: stage-1 prep (tokenize + probe upload) runs
+        # BEFORE ring admission — batch N+1 tokenizes while batch N is
+        # still walking, and a full ring stalls only the enqueue, not
+        # the byte plane. Prep TICKETS (depth + 1) bound the probe
+        # batches resident on device: parked callers beyond one
+        # prep-ahead wait un-uploaded, keeping the capacity model's
+        # in-flight byte accounting honest. The dispatch half re-preps
+        # iff a compaction swapped the base during the admission wait.
         t_acq = time.perf_counter()
-        await ring.acquire()
-        if timing is not None:
-            timing["acquire_s"] = time.perf_counter() - t_acq
+        await ring.acquire_prep()
         try:
             if batch is None:
-                # queue-depth-adaptive pow2 floor: idle ring ⇒ small
-                # pad to cut time-to-first-result, busy ring ⇒ the
-                # throughput floor (see DispatchRing.effective_floor)
+                # queue-depth-adaptive pow2 floor: idle ring ⇒ small pad
+                # to cut time-to-first-result, busy ring ⇒ the
+                # throughput floor. Read before slot admission
+                # (planned_floor = the pre-acquire twin).
                 batch = _pow2_batch(len(uniq_queries),
-                                    floor=ring.effective_floor())
-            fl = self._dispatch_device(uniq_queries, batch,
-                                       donate=donation_enabled(),
-                                       watchdogged=True)
-            ring.start_fetch(fl.res)
-            t0 = time.perf_counter()
+                                    floor=ring.planned_floor())
+            prep = self._prepare_probes(uniq_queries, batch)
+            await ring.acquire()
+            if timing is not None:
+                # queue time: prep-ticket wait + slot wait, minus the
+                # prep work itself (match cost, attributed via the
+                # tokenize stage)
+                timing["acquire_s"] = max(
+                    0.0, time.perf_counter() - t_acq - prep.tokenize_s)
             try:
-                with trace.span("device.ready", batch=fl.batch,
-                                kernel=fl.kernel):
-                    await ring.wait_ready(fl.res, fault=fl.fault)
-            except DeviceTimeoutError:
-                ring.reclaim(fl.res)
-                raise
-            except BaseException:
-                # cancelled mid-wait (caller timeout, client disconnect):
-                # the arrays may still be in flight and may alias donated
-                # probe buffers — park them like a timeout does, minus
-                # the timeout accounting, or dropping the last reference
-                # here would be the exact use-after-donate the
-                # quarantine exists to prevent
-                ring.quarantine.add(fl.res)
-                raise
-            ready_s = time.perf_counter() - t0
-            STAGES.record("device.ready", ready_s)
+                fl = self._dispatch_prepared(prep,
+                                             donate=donation_enabled(),
+                                             watchdogged=True)
+                ring.start_fetch(fl.res)
+                t0 = time.perf_counter()
+                try:
+                    with trace.span("device.ready", batch=fl.batch,
+                                    kernel=fl.kernel):
+                        await ring.wait_ready(fl.res, fault=fl.fault)
+                except DeviceTimeoutError:
+                    ring.reclaim(fl.res)
+                    raise
+                except BaseException:
+                    # cancelled mid-wait (caller timeout, client
+                    # disconnect): the arrays may still be in flight and
+                    # may alias donated probe buffers — park them like a
+                    # timeout does, minus the timeout accounting, or
+                    # dropping the last reference here would be the
+                    # exact use-after-donate the quarantine exists to
+                    # prevent
+                    ring.quarantine.add(fl.res)
+                    raise
+                ready_s = time.perf_counter() - t0
+                STAGES.record("device.ready", ready_s)
+            finally:
+                ring.release()
         finally:
-            ring.release()
+            # held for the WHOLE slot tenure: tickets bound prepped +
+            # in-flight batches together at depth+1, so at most ONE
+            # uploaded-but-undispatched probe set exists when the ring
+            # is full — the exact +1 the capacity model counts
+            ring.release_prep()
         t0 = time.perf_counter()
         with trace.span("device.fetch"):
             overflow, starts_a, counts_a = self._fetch_walk(fl.res)
@@ -1038,7 +1090,8 @@ class TpuMatcher:
         from ..obs import OBS
         OBS.profiler.record_batch(
             n_queries=len(fl.queries), batch=fl.batch, kernel=fl.kernel,
-            dispatch_s=fl.dispatch_s, ready_s=ready_s, fetch_s=fetch_s,
+            tokenize_s=fl.tokenize_s, dispatch_s=fl.dispatch_s,
+            ready_s=ready_s, fetch_s=fetch_s,
             expand_s=time.perf_counter() - t0, path="async")
         return out
 
@@ -1086,12 +1139,18 @@ class TpuMatcher:
         serves the host oracle with no dispatch, a device error feeds
         the breaker and then PROPAGATES (the worker's degradation
         boundary owns the sync fallback), and a half-open admission
-        holds the canary batch to oracle row parity. The watchdog itself
-        is the async pipeline's: this leg's fetch is a blocking
-        synchronize that cannot be preempted.
+        holds the canary batch to oracle row parity.
+
+        ISSUE 11 (the PR 7 carry-over): the fetch is no longer a
+        blocking synchronize the watchdog cannot preempt — it waits on
+        the same ``is_ready`` short-poll the async leg uses, honoring
+        ``BIFROMQ_DEVICE_DEADLINE_S``, and a truly hung device degrades
+        THIS caller to the exact host oracle (breaker fed, MATCH_DEGRADED
+        counted) instead of wedging it forever.
         """
         if not queries:
             return []
+        from ..resilience.device import DeviceTimeoutError
         br = self.device_breaker
         verdict = br.admit() if br is not None else "ok"
         if verdict == "rejected":
@@ -1110,6 +1169,7 @@ class TpuMatcher:
             fl = self._dispatch_device(queries, batch)
             t0 = time.perf_counter()
             with trace.span("device.fetch"):
+                self._await_ready_sync(fl.res)
                 overflow, starts_a, counts_a = self._fetch_walk(fl.res)
             fetch_s = time.perf_counter() - t0
             STAGES.record("device.fetch", fetch_s)
@@ -1120,9 +1180,32 @@ class TpuMatcher:
             from ..obs import OBS
             OBS.profiler.record_batch(
                 n_queries=len(fl.queries), batch=fl.batch,
-                kernel=fl.kernel, dispatch_s=fl.dispatch_s,
+                kernel=fl.kernel, tokenize_s=fl.tokenize_s,
+                dispatch_s=fl.dispatch_s,
                 fetch_s=fetch_s, expand_s=time.perf_counter() - t0,
                 path="sync")
+        except DeviceTimeoutError as e:
+            # the watchdog fired on the SYNC leg: reclaimed slot
+            # semantics without a ring — the orphaned (non-donated)
+            # result arrays are dropped to the backend, the breaker is
+            # fed, and this caller serves the exact host oracle
+            from ..obs import OBS
+            from ..utils.metrics import FABRIC, FabricMetric
+            FABRIC.inc(FabricMetric.DEVICE_TIMEOUT)
+            FABRIC.inc(FabricMetric.MATCH_DEGRADED, len(queries))
+            if br is not None:
+                br.record_failure(repr(e))
+            if stats is not None:
+                stats["degraded"] = "timeout"
+            OBS.profiler.record_batch(
+                n_queries=len(queries), batch=len(queries),
+                kernel="oracle", dispatch_s=0.0, path="sync",
+                degraded="timeout")
+            with trace.span("match.degraded", reason="timeout",
+                            n_queries=len(queries)):
+                return self.match_from_tries(
+                    queries, max_persistent_fanout=max_persistent_fanout,
+                    max_group_fanout=max_group_fanout)
         except BaseException as e:
             if br is not None:
                 if isinstance(e, Exception):
@@ -1150,10 +1233,71 @@ class TpuMatcher:
                 br.record_success()
         return out
 
+    def _prepare_probes(self, queries, batch: Optional[int] = None,
+                        ) -> _Prepared:
+        """Stage 0 (ISSUE 11, the ``tokenize`` stage): byte-plane topic
+        prep + probe upload, SEPARATE from the walk enqueue so the async
+        leg runs it before ring admission — batch N+1 tokenizes while
+        batch N is still walking — and the profiler attributes prep
+        apart from dispatch.
+
+        String/bytes topic rows (the serving call sites hand raw topics
+        now) pack into ONE contiguous ``TopicBytes`` buffer; with
+        ``BIFROMQ_DEVICE_TOKENIZE`` on, the raw bytes ship to the device
+        hash kernel and only bytes cross the tunnel. Pre-parsed level
+        lists (legacy callers, tests) keep the token-cache host path.
+        """
+        from ..ops.match import Probes
+        self._apply_pending_swap()
+        if self._base_ct is None:
+            self.refresh()
+        ct = self._base_ct
+        if batch is None:
+            batch = _pow2_batch(len(queries))
+        roots = [ct.root_of(t) for t, _ in queries]
+        t0 = time.perf_counter()
+        with trace.span("device.tokenize", batch=batch,
+                        queries=len(queries)):
+            topics = [levels for _, levels in queries]
+            byte_rows = all(isinstance(t, (str, bytes)) for t in topics)
+            tok = probes = None
+            if byte_rows:
+                from ..models.bytetok import TopicBytes
+                from ..ops.tokenize import (device_tokenize,
+                                            device_tokenize_enabled)
+                tb = TopicBytes.from_topics(topics)
+                if device_tokenize_enabled():
+                    tok, probes = device_tokenize(
+                        tb, roots, max_levels=ct.max_levels,
+                        salt=ct.salt, batch=batch, device=self.device)
+                else:
+                    tok = tokenize(tb, roots, max_levels=ct.max_levels,
+                                   salt=ct.salt, batch=batch,
+                                   cache=self._tok_cache)
+            else:
+                tok = tokenize(topics, roots, max_levels=ct.max_levels,
+                               salt=ct.salt, batch=batch,
+                               cache=self._tok_cache)
+            if probes is None:
+                probes = Probes.from_tokenized(tok, device=self.device)
+        tokenize_s = time.perf_counter() - t0
+        STAGES.record("tokenize", tokenize_s)
+        return _Prepared(queries=list(queries), ct=ct, tok=tok,
+                         probes=probes, roots=roots, batch=batch,
+                         tokenize_s=tokenize_s)
+
     def _dispatch_device(self, queries, batch: Optional[int] = None, *,
                          donate: bool = False,
                          watchdogged: bool = False) -> _InFlight:
-        """Stage 1: tokenize + upload + enqueue the device walk.
+        """Stage 0+1 back to back (the sync leg; the async leg preps
+        before ring admission and calls ``_dispatch_prepared`` itself)."""
+        return self._dispatch_prepared(self._prepare_probes(queries, batch),
+                                       donate=donate,
+                                       watchdogged=watchdogged)
+
+    def _dispatch_prepared(self, prep: _Prepared, *, donate: bool = False,
+                           watchdogged: bool = False) -> _InFlight:
+        """Stage 1: enqueue the device walk for a prepared probe batch.
 
         Returns as soon as the walk is ENQUEUED (walk_routes returns on
         enqueue; only a readback synchronizes — block_until_ready is a
@@ -1161,46 +1305,41 @@ class TpuMatcher:
         the donated jit so XLA reuses the probe buffers for the results
         (the pipeline's in-flight memory bound); callers must then treat
         the device probes as consumed — everything downstream here reads
-        only the HOST TokenizedTopics copy.
+        only the HOST token mirror.
         """
-        from ..ops.match import Probes
         from ..resilience.faults import get_injector
         # ISSUE 7 device-fault hook: error rules raise here; readiness-
         # shaping rules (hang/slow/flaky_ready) ride the _InFlight into
         # wait_ready — but ONLY the watchdogged async leg has a readiness
-        # poll to thread them into. The sync leg's fetch is a blocking
-        # synchronize: consuming a hang/slow/flaky_ready rule there would
-        # burn its hit budget (and count an injection) without injecting
-        # anything. One attribute check when the injector is disabled.
+        # poll to thread them into. The sync leg's fetch now short-polls
+        # too (ISSUE 11), but hang/slow injection stays an async-leg
+        # surface. One attribute check when the injector is disabled.
         if watchdogged:
             fault = get_injector().device_rule("dispatch")
         else:
             get_injector().check_raise("device", "tpu-device", "dispatch")
             fault = None
-        self._apply_pending_swap()
-        if self._base_ct is None:
-            self.refresh()
+        if self._base_ct is not prep.ct:
+            # a compaction swap landed between prep and dispatch (the
+            # async leg awaits ring admission in the gap): roots/salt are
+            # per-snapshot, so re-prep against the installed base —
+            # rare enough that the re-tokenize is noise
+            prep = self._prepare_probes(prep.queries, prep.batch)
         # ISSUE 9: ship any host patches accumulated since the last
         # dispatch (one coalesced narrow update, so this batch walks the
         # post-mutation tables). watchdogged == the async leg, which
         # already holds its own (not-yet-dispatched) ring slot.
         self._flush_patches(own_slots=1 if watchdogged else 0)
-        ct = self._base_ct
-        if batch is None:
-            batch = _pow2_batch(len(queries))
-        roots = [ct.root_of(t) for t, _ in queries]
-        tok = tokenize([levels for _, levels in queries], roots,
-                       max_levels=ct.max_levels, salt=ct.salt, batch=batch,
-                       cache=self._tok_cache)
-        probes = Probes.from_tokenized(tok, device=self.device)
+        ct, tok, roots, batch = prep.ct, prep.tok, prep.roots, prep.batch
         # esc_k=0: escalation stays a SEPARATE lazily-compiled dispatch
         # (_expand_walk) — fusing it into this jit would compile the
         # high-K escalation walk on the first serving query, doubling
         # cold-start latency for a pass that almost never runs
         t0 = time.perf_counter()
         with trace.span("device.dispatch", batch=batch,
-                        queries=len(queries)) as sp:
-            res, kernel = self._walk_primary(probes, ct, donate=donate)
+                        queries=len(prep.queries)) as sp:
+            res, kernel = self._walk_primary(prep.probes, ct,
+                                             donate=donate)
             if sp is not trace.NOOP:
                 sp.set_tag("kernel", kernel)
         # ISSUE 6: the `device.sync` stage of the sync era is replaced by
@@ -1208,11 +1347,12 @@ class TpuMatcher:
         # histograms (/metrics "stages" + the bench breakdown)
         dispatch_s = time.perf_counter() - t0
         STAGES.record("device.dispatch", dispatch_s)
-        return _InFlight(queries=list(queries), ct=ct,
+        return _InFlight(queries=prep.queries, ct=ct,
                          dev=self._device_trie, tok=tok, roots=roots,
                          res=res, tomb=self._tomb, delta=self._delta,
                          batch=batch, kernel=kernel, fault=fault,
-                         dispatch_s=dispatch_s)
+                         dispatch_s=dispatch_s,
+                         tokenize_s=prep.tokenize_s)
 
     def _walk_primary(self, probes, ct, *, donate: bool):
         """The primary serving walk: fused Pallas kernel when enabled
@@ -1231,6 +1371,40 @@ class TpuMatcher:
                   k_states=self.k_states,
                   max_intervals=self.max_intervals,
                   esc_k=0), ("lax_donated" if donate else "lax")
+
+    @staticmethod
+    def _await_ready_sync(res, deadline_s: Optional[float] = None,
+                          spin_polls: int = 50,
+                          poll_s: float = 0.0005) -> None:
+        """ISSUE 11 (PR 7 carry-over): the sync leg's pre-fetch
+        readiness wait — the same two-phase ``is_ready`` short-poll the
+        async watchdog uses (spin for sub-ms completions, timed sleeps
+        for tunnel-RTT ones), minus the event loop. Past the
+        ``BIFROMQ_DEVICE_DEADLINE_S`` deadline a
+        :class:`DeviceTimeoutError` fires so a hung device degrades the
+        SYNC caller to the oracle instead of wedging it inside an
+        uninterruptible PJRT synchronize. Backends whose arrays lack
+        ``is_ready`` fall through to the blocking fetch — still correct,
+        just unpreemptable (the pre-ISSUE-11 behavior)."""
+        from ..resilience.device import DeviceTimeoutError, \
+            device_deadline_s
+        if deadline_s is None:
+            deadline_s = device_deadline_s()
+        leaves = (res.start, res.count, res.overflow)
+        t0 = time.monotonic()
+        polls = 0
+        while True:
+            try:
+                if all(leaf.is_ready() for leaf in leaves):
+                    return
+            except AttributeError:
+                return
+            if (deadline_s is not None
+                    and time.monotonic() - t0 >= deadline_s):
+                raise DeviceTimeoutError(deadline_s)
+            if polls >= spin_polls:
+                time.sleep(poll_s)
+            polls += 1
 
     @staticmethod
     def _fetch_walk(res):
@@ -1269,13 +1443,12 @@ class TpuMatcher:
         if len(ovf_rows) and (esc_k > self.k_states
                               or esc_a > self.max_intervals):
             eb = _pow2_batch(len(ovf_rows))
-            sub = Probes.from_tokenized(TokenizedTopics(
-                tok_h1=_pad_rows(tok.tok_h1[ovf_rows], eb),
-                tok_h2=_pad_rows(tok.tok_h2[ovf_rows], eb),
-                lengths=_pad_rows(tok.lengths[ovf_rows], eb, fill=-1),
-                roots=_pad_rows(tok.roots[ovf_rows], eb, fill=-1),
-                sys_mask=_pad_rows(tok.sys_mask[ovf_rows], eb),
-            ), device=self.device)
+            # ISSUE 11: sub_batch is polymorphic — host-tokenized
+            # batches slice their rows; device-tokenized mirrors (whose
+            # hash lanes never came back to host) re-tokenize just the
+            # overflow rows
+            sub = Probes.from_tokenized(tok.sub_batch(ovf_rows, eb),
+                                        device=self.device)
             res2 = walk_routes(fl.dev, sub,
                                probe_len=ct.probe_len, k_states=esc_k,
                                max_intervals=esc_a, esc_k=0)
@@ -1314,13 +1487,14 @@ class TpuMatcher:
                     ct, row, max_persistent_fanout, max_group_fanout))
                 continue
             out.append(self._expand_with_overlay(
-                ct, row, tomb or (), delta, list(levels),
+                ct, row, tomb or (), delta, _parse_levels(levels),
                 max_persistent_fanout, max_group_fanout))
         return out
 
     def match(self, tenant_id: str, topic: str, **kwargs) -> MatchedRoutes:
-        return self.match_batch([(tenant_id, topic_util.parse(topic))],
-                                **kwargs)[0]
+        # ISSUE 11: the raw topic string flows through — the byte plane
+        # tokenizes it; levels materialize only on fallback paths
+        return self.match_batch([(tenant_id, topic)], **kwargs)[0]
 
     def match_from_tries(self, queries: Sequence[Tuple[str, Sequence[str]]],
                          *, max_persistent_fanout: int = UNCAPPED_FANOUT,
@@ -1334,7 +1508,8 @@ class TpuMatcher:
         for tenant_id, levels in queries:
             trie = self.tries.get(tenant_id)
             out.append(trie.match(
-                list(levels), max_persistent_fanout=max_persistent_fanout,
+                _parse_levels(levels),
+                max_persistent_fanout=max_persistent_fanout,
                 max_group_fanout=max_group_fanout)
                 if trie is not None else MatchedRoutes())
         return out

@@ -39,10 +39,19 @@ def load_lib():
 
 
 def _pack(topics: Sequence) -> tuple:
-    """Join level lists (or accept raw strings) into (bytes, offsets)."""
+    """Join level lists (or accept raw strings) into (bytes, offsets).
+
+    ISSUE 11: a :class:`~bifromq_tpu.models.bytetok.TopicBytes` batch
+    passes through untouched — the serving path packs ONCE per batch and
+    this binding stops re-encoding what is already raw UTF-8."""
+    from .bytetok import TopicBytes
+    if isinstance(topics, TopicBytes):
+        return topics.data, topics.offsets
     enc: List[bytes] = []
     for t in topics:
-        if isinstance(t, str):
+        if isinstance(t, bytes):
+            enc.append(t)
+        elif isinstance(t, str):
             enc.append(t.encode("utf-8"))
         else:
             enc.append("/".join(t).encode("utf-8"))
@@ -57,8 +66,10 @@ def tokenize_topics_native(topics: Sequence, roots: Sequence[int], *,
                            filter_mode: bool = False):
     """Native-equivalent of automaton.tokenize / tokenize_filters.
 
-    Returns (tok_h1, tok_h2, tok_kind, lengths, roots, sys_mask) numpy
-    arrays; tok_kind is None unless ``filter_mode``.
+    ``topics`` may be str / bytes / level-list rows or one pre-packed
+    ``TopicBytes`` batch (the byte-plane serving path). Returns
+    (tok_h1, tok_h2, tok_kind, lengths, roots, sys_mask) numpy arrays;
+    tok_kind is None unless ``filter_mode``.
     """
     lib = load_lib()
     n = len(topics)
@@ -66,8 +77,13 @@ def tokenize_topics_native(topics: Sequence, roots: Sequence[int], *,
     assert b >= n
     width = max_levels + 1
     data, offsets = _pack(topics)
-    data_arr = np.frombuffer(data, dtype=np.uint8) if data else \
-        np.zeros(1, dtype=np.uint8)
+    if isinstance(data, np.ndarray):
+        data_arr = (np.ascontiguousarray(data, dtype=np.uint8)
+                    if data.size else np.zeros(1, dtype=np.uint8))
+        offsets = np.ascontiguousarray(offsets, dtype=np.int32)
+    else:
+        data_arr = np.frombuffer(data, dtype=np.uint8) if data else \
+            np.zeros(1, dtype=np.uint8)
     roots_arr = np.asarray(list(roots), dtype=np.int32)
     tok_h1 = np.zeros((b, width), dtype=np.int32)
     tok_h2 = np.zeros((b, width), dtype=np.int32)

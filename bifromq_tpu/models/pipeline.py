@@ -84,6 +84,17 @@ class DispatchRing(BoundedSlots):
         # must never let donated buffers be reused mid-flight
         self.quarantine = BufferQuarantine()
         self.timeouts_total = 0
+        # ISSUE 11: stage-1 prep (tokenize + probe upload) runs BEFORE
+        # ring admission for overlap, so prep tickets — not ring slots —
+        # bound the probe batches resident on device. A ticket is held
+        # for the whole prep + slot tenure (released WITH the slot), so
+        # prepped + in-flight batches together never exceed depth + 1:
+        # with the ring full, exactly ONE caller can hold an uploaded-
+        # but-undispatched probe set, which is the "+1 prep-ahead" the
+        # capacity model counts (obs/capacity.inflight_bytes). Without
+        # the gate, K parked callers would each hold an upload the
+        # model never saw.
+        self._prep = BoundedSlots(self.capacity + 1)
 
     # ---------------- slot management --------------------------------------
 
@@ -94,6 +105,20 @@ class DispatchRing(BoundedSlots):
     @depth.setter
     def depth(self, v: int) -> None:
         self.capacity = v
+        self._prep.capacity = max(1, v + 1)
+
+    async def acquire_prep(self) -> None:
+        """Admit one stage-1 prep (see ``_prep``): held across tokenize
+        + probe upload + ring admission + the walk's slot tenure,
+        released together with the slot (or when the leg dies)."""
+        await self._prep.acquire()
+
+    def release_prep(self) -> None:
+        self._prep.release()
+
+    @property
+    def prepping(self) -> int:
+        return self._prep.in_flight
 
     async def acquire(self) -> None:
         await super().acquire()
@@ -128,17 +153,24 @@ class DispatchRing(BoundedSlots):
 
     # ---------------- adaptive pad floor ------------------------------------
 
-    def effective_floor(self) -> int:
+    def effective_floor(self, *, pre_acquire: bool = False) -> int:
         """Shallow queue (nothing else in flight, nobody parked) ⇒ the
         small latency floor; any concurrency ⇒ the throughput floor.
 
-        Called AFTER acquire, so ``in_flight`` counts this dispatch too:
-        1 in flight and no waiters is the idle-broker single-publish
-        shape the latency floor exists for.
+        ONE definition for both call shapes: post-acquire (the default;
+        ``in_flight`` counts this dispatch too, so <=1 is the
+        idle-broker single-publish shape) and ``pre_acquire`` (ISSUE 11:
+        stage-1 prep chooses the pad floor BEFORE a slot is held, where
+        the same idle state reads ==0).
         """
-        if self._inflight <= 1 and not self._waiters:
+        own = 0 if pre_acquire else 1
+        if self._inflight <= own and not self._waiters:
             return self.min_floor
         return self.base_floor
+
+    def planned_floor(self) -> int:
+        """The pre-admission floor the async prep leg uses."""
+        return self.effective_floor(pre_acquire=True)
 
     # ---------------- fetch-on-ready ----------------------------------------
 

@@ -134,10 +134,13 @@ def inflight_bytes(batch: int, *, max_levels: int = 16,
                    max_intervals: int = 32, ring_depth: Optional[int] = None,
                    donated: Optional[bool] = None) -> Dict[str, int]:
     """Device bytes pinned by the async dispatch ring: ``ring_depth``
-    in-flight slots, each holding a probe batch and its result arrays.
-    With buffer donation XLA may alias the results into the donated
-    probe buffers, so a slot costs max(probes, results) instead of the
-    sum — the "donated-aliasing double" the non-donated path pays."""
+    in-flight slots, each holding a probe batch and its result arrays,
+    plus ONE prep-ahead probe batch (ISSUE 11: stage-1 prep uploads
+    before ring admission; the ring's prep tickets bound it to depth+1,
+    so exactly one extra probe set can be resident). With buffer
+    donation XLA may alias the results into the donated probe buffers,
+    so a slot costs max(probes, results) instead of the sum — the
+    "donated-aliasing double" the non-donated path pays."""
     if ring_depth is None:
         from ..models.pipeline import pipeline_depth
         ring_depth = pipeline_depth()
@@ -150,7 +153,8 @@ def inflight_bytes(batch: int, *, max_levels: int = 16,
     return {"ring_depth": int(ring_depth), "batch": int(batch),
             "donated": bool(donated), "probe_bytes": pb,
             "result_bytes": rb, "per_slot": per_slot,
-            "total": per_slot * int(ring_depth)}
+            "prep_ahead_bytes": pb,
+            "total": per_slot * int(ring_depth) + pb}
 
 
 def measure(matcher) -> Dict[str, object]:
@@ -408,12 +412,67 @@ def default_planner(matchers: Sequence = ()) -> CapacityPlanner:
     return planner
 
 
+def calibrate_report(*, n_subs: Optional[int] = None,
+                     matchers: Optional[Sequence] = None,
+                     before: Optional[CapacityPlanner] = None
+                     ) -> Dict[str, object]:
+    """Operational ``calibrate`` (ISSUE 11 satellite, ROADMAP sharding
+    follow-up (c)): re-fit the planner's per-subscription coefficients
+    from the live base using the TRUE logical subscription count (one
+    per live route in the authoritative tries — the slot-count proxy
+    ``default_planner`` uses overcounts group slots and tombstones), and
+    report old-vs-new coefficient deltas plus the predicted-bytes shift
+    at a target population. Served by ``GET /capacity?calibrate=1``;
+    ``scripts/calibrate_capacity.sh`` is the one-liner.
+    ``matchers``/``before`` let ``capacity_report`` hand over its
+    already-computed scan instead of walking every base twice."""
+    if matchers is None:
+        from . import OBS
+        matchers = OBS.device.matchers()
+    if before is None:
+        before = default_planner(matchers)
+    best = best_m = None
+    for m in matchers:
+        base = getattr(m, "_base_ct", None)
+        if base is None or hasattr(base, "compiled"):
+            continue
+        if best is None or base.n_slots > best.n_slots:
+            best, best_m = base, m
+    if best is None:
+        return {"calibrated": False,
+                "reason": "no installed single-chip base"}
+    live_subs = sum(len(t) for t in
+                    (getattr(best_m, "tries", None) or {}).values())
+    if live_subs <= 0:
+        live_subs = max(1, best.n_slots)
+    after = CapacityPlanner().calibrate(best, live_subs)
+    fields = ("nodes_per_sub", "edges_per_sub", "slots_per_sub",
+              "edge_load")
+    target = n_subs or live_subs
+    return {
+        "calibrated": True,
+        "n_subs_live": live_subs,
+        "before": before.snapshot(),
+        "after": after.snapshot(),
+        "delta": {k: round(getattr(after, k) - getattr(before, k), 4)
+                  for k in fields},
+        "predicted_table_bytes": {
+            "n_subs": target,
+            "before": before.predict_tables(target)["total"],
+            "after": after.predict_tables(target)["total"],
+        },
+    }
+
+
 def capacity_report(*, n_subs: Optional[int] = None,
                     mesh: Optional[object] = None,
-                    memory: bool = True) -> Dict[str, object]:
+                    memory: bool = True,
+                    calibrate: bool = False) -> Dict[str, object]:
     """The ``GET /capacity`` payload: model-vs-live parity for every
     registered matcher, the guarded HBM stats, the planner coefficients,
-    and (when ``n_subs`` is given) a full ``fits`` verdict."""
+    and (when ``n_subs`` is given) a full ``fits`` verdict. With
+    ``calibrate`` the response also carries the live re-fit + deltas
+    (and the ``fits`` verdict uses the re-fit coefficients)."""
     from . import OBS
     matchers = OBS.device.matchers()
     rows = [measure(m) for m in matchers]
@@ -423,6 +482,16 @@ def capacity_report(*, n_subs: Optional[int] = None,
         "planner": planner.snapshot(),
         "table_bytes": sum(r.get("measured_device_bytes", 0) for r in rows),
     }
+    if calibrate:
+        cal = calibrate_report(n_subs=n_subs, matchers=matchers,
+                               before=planner)
+        out["calibrate"] = cal
+        if cal.get("calibrated"):
+            planner = CapacityPlanner(**{
+                k: cal["after"][k] for k in
+                ("nodes_per_sub", "edges_per_sub", "slots_per_sub",
+                 "edge_load")})
+            planner.calibrated_from = cal["after"]["calibrated_from"]
     installed = [r for r in rows if r.get("installed")]
     if installed:
         out["parity_error"] = max(r["parity_error"] for r in installed)

@@ -9,7 +9,8 @@ increments plus one ring store, the ``SpanRing`` discipline: GIL-atomic
 enough for telemetry, no locks, no allocation beyond the record):
 
 - **Per-batch stage decomposition.** Every device batch (sync or async)
-  records its dispatch / ready / fetch / expand seconds plus batch
+  records its tokenize / dispatch / ready / fetch / expand seconds
+  (ISSUE 11 split the byte-plane prep out of dispatch) plus batch
   geometry (queries vs padded rows) and the kernel that served it. The
   snapshot splits the wall time into a tunnel-RTT estimate (a tiny
   TTL-cached scalar round trip, same guarded-probe discipline as the
@@ -45,16 +46,17 @@ class BatchRecord:
     the serving path, so no dataclass/dict overhead."""
 
     __slots__ = ("ts", "n_queries", "batch", "kernel", "path",
-                 "dispatch_s", "ready_s", "fetch_s", "expand_s",
-                 "degraded")
+                 "tokenize_s", "dispatch_s", "ready_s", "fetch_s",
+                 "expand_s", "degraded")
 
-    def __init__(self, ts, n_queries, batch, kernel, path, dispatch_s,
-                 ready_s, fetch_s, expand_s, degraded) -> None:
+    def __init__(self, ts, n_queries, batch, kernel, path, tokenize_s,
+                 dispatch_s, ready_s, fetch_s, expand_s, degraded) -> None:
         self.ts = ts
         self.n_queries = n_queries
         self.batch = batch
         self.kernel = kernel
         self.path = path
+        self.tokenize_s = tokenize_s
         self.dispatch_s = dispatch_s
         self.ready_s = ready_s
         self.fetch_s = fetch_s
@@ -65,6 +67,7 @@ class BatchRecord:
         return {"ts": round(self.ts, 3), "n_queries": self.n_queries,
                 "batch": self.batch, "kernel": self.kernel,
                 "path": self.path,
+                "tokenize_ms": round(self.tokenize_s * 1e3, 4),
                 "dispatch_ms": round(self.dispatch_s * 1e3, 4),
                 "ready_ms": round(self.ready_s * 1e3, 4),
                 "fetch_ms": round(self.fetch_s * 1e3, 4),
@@ -213,7 +216,8 @@ class ContinuousProfiler:
     # ---------------- hot-path recording (the <2% budget) ------------------
 
     def record_batch(self, *, n_queries: int, batch: int, kernel: str,
-                     dispatch_s: float, ready_s: float = 0.0,
+                     dispatch_s: float, tokenize_s: float = 0.0,
+                     ready_s: float = 0.0,
                      fetch_s: float = 0.0, expand_s: float = 0.0,
                      path: str = "async",
                      degraded: Optional[str] = None) -> None:
@@ -224,7 +228,7 @@ class ContinuousProfiler:
             self.degraded_total[degraded] = \
                 self.degraded_total.get(degraded, 0) + 1
         self._ring.record(BatchRecord(
-            self._clock(), n_queries, batch, kernel, path,
+            self._clock(), n_queries, batch, kernel, path, tokenize_s,
             dispatch_s, ready_s, fetch_s, expand_s, degraded))
 
     def record_frontend(self, n_queries: int, hits: int,
@@ -325,7 +329,8 @@ class ContinuousProfiler:
         scrapes (``GET /profile``, bench) pay the TTL-cached probe."""
         recs = self.records()
         out: Dict[str, object] = {"window_batches": len(recs)}
-        for stage in ("dispatch_s", "ready_s", "fetch_s", "expand_s"):
+        for stage in ("tokenize_s", "dispatch_s", "ready_s", "fetch_s",
+                      "expand_s"):
             vals = sorted(getattr(r, stage) for r in recs)
             key = stage[:-2]
             out[f"{key}_ms_p50"] = round(_pctl(vals, 0.50) * 1e3, 4)

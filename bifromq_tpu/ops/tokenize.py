@@ -1,0 +1,352 @@
+"""Device-side byte-level topic hashing (ISSUE 11 tentpole, device half).
+
+The host byte plane (``models/bytetok.py``) already removes per-row
+Python from topic prep; this module removes the HASH from the host
+entirely: the raw topic bytes ship to device as one ``[B, MAX_BYTES]``
+uint8 block plus the per-lane level boundaries (tiny int32 grids), and a
+kernel computes the ``Probes`` h1/h2 token lanes on device — at serving
+scale only bytes cross the tunnel, the "accelerator-side trie matching
+from raw token streams" move of "Vectorizing the Trie" (PAPERS.md).
+
+The kernel is BLAKE2b (RFC 7693) with digest_size=8 and the automaton
+salt, **bit-exact** with ``automaton.level_hash`` (the randomized parity
+suite enforces it). TPUs have no uint64, so the 64-bit state runs as
+uint32 (lo, hi) lane pairs — add-with-carry, xor, and rotations composed
+from 32-bit shifts. One final-block compression per level (a level
+longer than one 128-byte block is unsupported by construction — the
+host marks such rows padding and they take the exact oracle fallback,
+the same bounded-work contract as the walk's overflow rows).
+
+Two lowering paths, same traced math:
+
+- ``pallas``: one ``pl.pallas_call`` over row tiles (grid streams
+  ``TILE_ROWS`` topics per program; interpret mode on CPU — a
+  correctness surface, not a serving surface, exactly like the fused
+  walk kernel's off-TPU story).
+- ``lax``: the plain jit'd twin, for A/B and as the lowering XLA can
+  fuse into the surrounding dispatch.
+
+Deployment gate (``device_tokenize_enabled``): ``BIFROMQ_DEVICE_TOKENIZE``
+``0``/``off`` kills the path, ``1``/``on`` forces it on every backend
+(interpret-mode Pallas on CPU), unset/``auto`` enables it only on a real
+TPU backend — on CPU the native C++ tokenizer is the faster host, and
+interpreted Pallas would be a de-optimization.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import bytetok
+from ..models.automaton import TokenizedTopics
+from ..utils.env import env_int, env_str
+
+_EMPTY = -1
+_LEVEL_BLOCK = bytetok.MAX_SINGLE_BLOCK_LEVEL   # 128: one BLAKE2b block
+
+# the IV split into uint32 (lo, hi) lanes once at import — the traced
+# kernel body must not coerce device-typed scalars (graftcheck R1)
+_IV_LO = (bytetok.BLAKE2B_IV & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+_IV_HI = (bytetok.BLAKE2B_IV >> np.uint64(32)).astype(np.uint32)
+
+# rows per pallas program: bounds the per-program VMEM working set
+# ([TILE, W, 128] gather blocks ≈ 0.5MB at W=17) while keeping the grid
+# short for realistic batches
+TILE_ROWS = 256
+
+
+def _mode() -> str:
+    v = env_str("BIFROMQ_DEVICE_TOKENIZE", "auto").lower()
+    if v in ("0", "off", "false"):
+        return "off"
+    if v in ("1", "on", "true"):
+        return "on"
+    return "auto"
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # noqa: BLE001 — backend init failure = no device
+        return False
+
+
+def device_tokenize_enabled() -> bool:
+    """Should publish-side prep hash on device? Read per-batch (one env
+    read) so tests and operators can flip the knob on a live process."""
+    mode = _mode()
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    return _on_tpu()
+
+
+def tok_max_bytes() -> int:
+    """Per-topic byte budget of the device path (``BIFROMQ_TOK_MAX_BYTES``,
+    default 256 — MQTT spec allows 64KB but real topics are tens of
+    bytes; longer rows take the host path via the padding contract)."""
+    return max(_LEVEL_BLOCK, env_int("BIFROMQ_TOK_MAX_BYTES", 256))
+
+
+def _kernel_impl() -> str:
+    v = env_str("BIFROMQ_TOK_KERNEL", "pallas").lower()
+    return "lax" if v == "lax" else "pallas"
+
+
+# ------------------- 64-bit-as-uint32-pairs BLAKE2b ------------------------
+
+def _add64(alo, ahi, blo, bhi):
+    lo = alo + blo
+    carry = (lo < alo).astype(jnp.uint32)
+    return lo, ahi + bhi + carry
+
+
+def _rotr64(lo, hi, n: int):
+    if n == 32:
+        return hi, lo
+    if n < 32:
+        return ((lo >> n) | (hi << (32 - n)),
+                (hi >> n) | (lo << (32 - n)))
+    m = n - 32
+    return ((hi >> m) | (lo << (32 - m)),
+            (lo >> m) | (hi << (32 - m)))
+
+
+def _hash_lanes(rows, starts, lens, nlv, h0lo, h0hi):
+    """The shared kernel math: one final-block BLAKE2b-8 per (row, lane).
+
+    ``rows`` [B, MB] uint8 raw topic bytes; ``starts``/``lens`` [B, W]
+    int32 level boundaries (relative to the row); ``nlv`` [B, 1] int32
+    level counts (-1 for padding rows); ``h0lo``/``h0hi`` [1, 8] uint32
+    salt-folded initial state. Returns (h1, h2) [B, W] int32 with lanes
+    past a row's level count zeroed — the exact ``TokenizedTopics``
+    contract."""
+    b, mb = rows.shape
+    w = starts.shape[1]
+    # gather each lane's level bytes into a [B, W, 128] block (on
+    # device — the host ships only the packed rows + tiny grids)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (b, w, _LEVEL_BLOCK), 2)
+    gidx = jnp.clip(starts[:, :, None] + iota, 0, mb - 1)
+    byte = rows[jnp.arange(b)[:, None, None], gidx].astype(jnp.uint32)
+    byte = jnp.where(iota < lens[:, :, None], byte, jnp.uint32(0))
+    # 16 message words as (lo, hi) uint32 pairs, little-endian
+    wb = byte.reshape(b, w, 16, 8)
+    m = []
+    for i in range(16):
+        lo = (wb[..., i, 0] | (wb[..., i, 1] << 8)
+              | (wb[..., i, 2] << 16) | (wb[..., i, 3] << 24))
+        hi = (wb[..., i, 4] | (wb[..., i, 5] << 8)
+              | (wb[..., i, 6] << 16) | (wb[..., i, 7] << 24))
+        m.append((lo, hi))
+    iv_lo = [jnp.uint32(v) for v in _IV_LO]
+    iv_hi = [jnp.uint32(v) for v in _IV_HI]
+    shape = (b, w)
+    def full(x):
+        return jnp.broadcast_to(x, shape)
+    v = [(full(h0lo[0, i]), full(h0hi[0, i])) for i in range(8)]
+    v += [(full(iv_lo[i]), full(iv_hi[i])) for i in range(8)]
+    t = lens.astype(jnp.uint32)                     # t0 (levels ≤ 128B)
+    v[12] = (v[12][0] ^ t, v[12][1])
+    v[14] = (~v[14][0], ~v[14][1])                  # final-block flag
+
+    def g(a, bb, c, d, x, y):
+        v[a] = _add64(*_add64(*v[a], *v[bb]), *x)
+        v[d] = _rotr64(v[d][0] ^ v[a][0], v[d][1] ^ v[a][1], 32)
+        v[c] = _add64(*v[c], *v[d])
+        v[bb] = _rotr64(v[bb][0] ^ v[c][0], v[bb][1] ^ v[c][1], 24)
+        v[a] = _add64(*_add64(*v[a], *v[bb]), *y)
+        v[d] = _rotr64(v[d][0] ^ v[a][0], v[d][1] ^ v[a][1], 16)
+        v[c] = _add64(*v[c], *v[d])
+        v[bb] = _rotr64(v[bb][0] ^ v[c][0], v[bb][1] ^ v[c][1], 63)
+
+    for s in bytetok.BLAKE2B_SIGMA:
+        g(0, 4, 8, 12, m[s[0]], m[s[1]])
+        g(1, 5, 9, 13, m[s[2]], m[s[3]])
+        g(2, 6, 10, 14, m[s[4]], m[s[5]])
+        g(3, 7, 11, 15, m[s[6]], m[s[7]])
+        g(0, 5, 10, 15, m[s[8]], m[s[9]])
+        g(1, 6, 11, 12, m[s[10]], m[s[11]])
+        g(2, 7, 8, 13, m[s[12]], m[s[13]])
+        g(3, 4, 9, 14, m[s[14]], m[s[15]])
+
+    out_lo = full(h0lo[0, 0]) ^ v[0][0] ^ v[8][0]
+    out_hi = full(h0hi[0, 0]) ^ v[0][1] ^ v[8][1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    active = lane < nlv          # nlv == -1 padding rows mask everything
+    h1 = jnp.where(active, out_lo.astype(jnp.int32), 0)
+    h2 = jnp.where(active, out_hi.astype(jnp.int32), 0)
+    return h1, h2
+
+
+_hash_lanes_lax = jax.jit(_hash_lanes)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_pallas(b: int, mb: int, w: int, tile: int, interpret: bool):
+    """One compiled pallas tokenizer per shape class (jit-cache analog,
+    same idiom as models/kernels._build_fused)."""
+    from jax.experimental import pallas as pl
+
+    def kernel(rows_ref, starts_ref, lens_ref, nlv_ref, h0lo_ref,
+               h0hi_ref, h1_ref, h2_ref):
+        h1, h2 = _hash_lanes(rows_ref[...], starts_ref[...],
+                             lens_ref[...], nlv_ref[...],
+                             h0lo_ref[...], h0hi_ref[...])
+        h1_ref[...] = h1
+        h2_ref[...] = h2
+
+    grid = (b // tile,)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, mb), lambda i: (i, 0)),
+            pl.BlockSpec((tile, w), lambda i: (i, 0)),
+            pl.BlockSpec((tile, w), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, 8), lambda i: (0, 0)),
+            pl.BlockSpec((1, 8), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile, w), lambda i: (i, 0)),
+            pl.BlockSpec((tile, w), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, w), jnp.int32),
+            jax.ShapeDtypeStruct((b, w), jnp.int32),
+        ],
+        interpret=interpret,
+    )
+
+
+def hash_topics_device(rows, starts, lens, nlv, salt: int, *,
+                       device=None, impl: Optional[str] = None):
+    """Upload the packed byte batch and hash every level on device.
+
+    All transfers are explicit ``device_put`` (the transfer-guard
+    sanitizer proves the byte plane ships only declared bytes). Returns
+    (h1, h2) device arrays [B, W] int32."""
+    if impl is None:
+        impl = _kernel_impl()
+    h0 = bytetok.blake2b8_h0(salt)
+    h0lo = (h0 & np.uint64(0xFFFFFFFF)).astype(np.uint32).reshape(1, 8)
+    h0hi = (h0 >> np.uint64(32)).astype(np.uint32).reshape(1, 8)
+    put = functools.partial(jax.device_put, device=device)
+    args = (put(rows), put(starts), put(lens), put(nlv), put(h0lo),
+            put(h0hi))
+    if impl == "lax":
+        return _hash_lanes_lax(*args)
+    b, mb = rows.shape
+    w = starts.shape[1]
+    tile = min(TILE_ROWS, b)
+    if b % tile:
+        # the grid streams whole tiles: pad ragged batches up (padding
+        # rows carry nlv == -1, so every padded lane masks to zero) and
+        # slice the outputs back
+        from ..models.automaton import pad_rows
+        pb = ((b + tile - 1) // tile) * tile
+        h1p, h2p = hash_topics_device(
+            pad_rows(rows, pb), pad_rows(starts, pb),
+            pad_rows(lens, pb), pad_rows(nlv, pb, fill=_EMPTY),
+            salt, device=device, impl=impl)
+        return h1p[:b], h2p[:b]
+    fn = _build_pallas(b, mb, w, tile, not _on_tpu())
+    return tuple(fn(*args))
+
+
+class DeviceTokenized:
+    """Host mirror of a device-tokenized probe batch.
+
+    The hash lanes live ONLY on device (that is the point); the host
+    keeps the cheap vectorized structure — lengths / roots / sys flags —
+    plus the raw bytes, so the expansion stage never reads the device
+    token arrays back. The rare paths that need host token rows (the
+    escalation re-walk) re-tokenize just their rows via ``sub_batch``.
+    """
+
+    __slots__ = ("lengths", "roots", "sys_mask", "_tb", "_salt",
+                 "_max_levels")
+
+    def __init__(self, lengths, roots, sys_mask, tb, salt, max_levels):
+        self.lengths = lengths
+        self.roots = roots
+        self.sys_mask = sys_mask
+        self._tb = tb
+        self._salt = salt
+        self._max_levels = max_levels
+
+    @property
+    def batch(self) -> int:
+        return self.lengths.shape[0]
+
+    def sub_batch(self, rows: np.ndarray, batch: int) -> TokenizedTopics:
+        """Host token rows for a row subset (escalation re-walk): the
+        selected topics re-tokenize host-side — a few rows through the
+        native path, paid only on the rare overflow escalation."""
+        from ..models.automaton import tokenize
+        rows = np.asarray(rows, dtype=np.int64)
+        sub_tb = self._tb.select(rows)
+        return tokenize(sub_tb, [int(r) for r in self.roots[rows]],
+                        max_levels=self._max_levels, salt=self._salt,
+                        batch=batch)
+
+
+def device_tokenize(tb, roots: Sequence[int], *, max_levels: int,
+                    salt: int, batch: Optional[int] = None,
+                    device=None, impl: Optional[str] = None
+                    ) -> Tuple[DeviceTokenized, "object"]:
+    """The byte-plane device prep: pack + structure on host (vectorized
+    numpy), hash on device. Returns ``(host_mirror, Probes)``.
+
+    Rows the kernel cannot hash — longer than ``tok_max_bytes()``, a
+    level over one BLAKE2b block, or deeper than ``max_levels`` — are
+    marked padding (length -1) and take the caller's exact host
+    fallback, the same bounded-work-then-fallback contract as the
+    walk's overflow rows.
+    """
+    from .match import Probes
+    n = len(tb)
+    b = batch or n
+    assert b >= n
+    width = max_levels + 1
+    max_bytes = tok_max_bytes()
+    st = bytetok.topic_structure(tb)
+    byte_lens = tb.byte_lens.astype(np.int64)
+    ok = ((st.n_levels <= max_levels) & (byte_lens <= max_bytes)
+          & (st.max_lvl_len <= _LEVEL_BLOCK))
+    lengths = np.full(b, _EMPTY, dtype=np.int32)
+    rootv = np.full(b, _EMPTY, dtype=np.int32)
+    sys_mask = np.zeros(b, dtype=bool)
+    lengths[:n][ok] = st.n_levels[ok]
+    rootv[:n][ok] = np.fromiter(roots, dtype=np.int32, count=n)[ok]
+    sys_mask[:n][ok] = st.sys_mask[ok]
+    # pack supported rows into the fixed [B, MB] block + boundary grids
+    rows = np.zeros((b, max_bytes), dtype=np.uint8)
+    row_of = np.repeat(np.arange(n, dtype=np.int64), byte_lens)
+    pos = bytetok._intra_row_positions(byte_lens)
+    keep = ok[row_of]
+    rows[row_of[keep], pos[keep]] = tb.data[keep]
+    starts = np.zeros((b, width), dtype=np.int32)
+    lens_g = np.zeros((b, width), dtype=np.int32)
+    sel = ok[st.lvl_row]
+    row_off = tb.offsets.astype(np.int64)[:-1]
+    starts[st.lvl_row[sel], st.lvl_idx[sel]] = \
+        (st.lvl_start[sel] - row_off[st.lvl_row[sel]]).astype(np.int32)
+    lens_g[st.lvl_row[sel], st.lvl_idx[sel]] = \
+        st.lvl_len[sel].astype(np.int32)
+    nlv = lengths.reshape(b, 1)
+    h1, h2 = hash_topics_device(rows, starts, lens_g, nlv, salt,
+                                device=device, impl=impl)
+    put = functools.partial(jax.device_put, device=device)
+    probes = Probes(tok_h1=h1, tok_h2=h2, lengths=put(lengths),
+                    roots=put(rootv), sys_mask=put(sys_mask))
+    mirror = DeviceTokenized(lengths=lengths, roots=rootv,
+                             sys_mask=sys_mask, tb=tb, salt=salt,
+                             max_levels=max_levels)
+    return mirror, probes

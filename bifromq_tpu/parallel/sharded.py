@@ -31,7 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.automaton import (
     NODE_COLS, CompiledTrie, compile_tries, tokenize,
 )
-from ..models.matcher import TpuMatcher
+from ..models.matcher import TpuMatcher, _parse_levels
 from ..models.oracle import UNCAPPED_FANOUT, MatchedRoutes, SubscriptionTrie
 from ..ops.match import (
     RT_COLS, DeviceTrie, Probes, _route_walk, expand_intervals,
@@ -506,14 +506,14 @@ class MeshMatcher(TpuMatcher):
                         trie = self.tries.get(tenant_id)
                         if trie is not None:
                             out[qi] = trie.match(
-                                list(levels),
+                                _parse_levels(levels),
                                 max_persistent_fanout=max_persistent_fanout,
                                 max_group_fanout=max_group_fanout)
                         continue
                     if overflow[rep, sh, bi] or lengths[rep, sh, bi] < 0:
                         trie = self.tries.get(tenant_id)
                         out[qi] = (trie.match(
-                            list(levels),
+                            _parse_levels(levels),
                             max_persistent_fanout=max_persistent_fanout,
                             max_group_fanout=max_group_fanout)
                             if trie is not None else MatchedRoutes())
@@ -526,6 +526,7 @@ class MeshMatcher(TpuMatcher):
                             max_group_fanout)
                     else:
                         out[qi] = self._expand_with_overlay(
-                            ct, srow, tomb or (), delta, list(levels),
+                            ct, srow, tomb or (), delta,
+                            _parse_levels(levels),
                             max_persistent_fanout, max_group_fanout)
         return out

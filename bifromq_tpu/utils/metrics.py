@@ -97,7 +97,8 @@ KNOWN_STAGES = frozenset({
     "queue_wait",       # scheduler/batcher enqueue→emit
     "rpc",              # rpc/fabric attempt wall time
     "device",           # dist/worker per-range device match
-    "device.dispatch",  # matcher host enqueue cost
+    "tokenize",         # ISSUE 11: byte-plane topic prep + probe upload
+    "device.dispatch",  # matcher walk enqueue cost
     "device.ready",     # in-flight walk awaited on readiness
     "device.fetch",     # final host copy
     "deliver",          # dist/service fan-out

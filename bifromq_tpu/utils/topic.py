@@ -60,27 +60,23 @@ def is_valid_topic(topic: str, max_level_length: int = 40, max_levels: int = 16,
 
     No wildcards, no NUL, bounded total length / level count / level length.
     A topic beginning with a share prefix is invalid.
+
+    ISSUE 11 (session ingest wall): this runs once per publish, so the
+    old per-character Python loop was a visible slice of `_on_publish`;
+    the checks are now C-speed membership scans plus one split (bounded
+    by max_levels via the count check first). Semantics are identical —
+    the property suite pins it against the reference loop.
     """
     assert max_length <= 65535 and max_level_length <= max_length
     if not topic or len(topic) > max_length:
         return False  # [MQTT-4.7.3-1]
     if topic.startswith(_PREFIX_ORDERED_SHARE) or topic.startswith(_PREFIX_UNORDERED_SHARE):
         return False
-    level_len = 0
-    level = 1
-    for ch in topic:
-        if ch == DELIMITER:
-            level += 1
-            if level > max_levels:
-                return False
-            if level_len > max_level_length:
-                return False
-            level_len = 0
-        else:
-            if ch == NUL or ch == SINGLE_WILDCARD or ch == MULTI_WILDCARD:
-                return False  # [MQTT-4.7.3-2], [MQTT-4.7.1-1]
-            level_len += 1
-    return level_len <= max_level_length
+    if NUL in topic or SINGLE_WILDCARD in topic or MULTI_WILDCARD in topic:
+        return False  # [MQTT-4.7.3-2], [MQTT-4.7.1-1]
+    if topic.count(DELIMITER) + 1 > max_levels:
+        return False
+    return max(map(len, topic.split(DELIMITER))) <= max_level_length
 
 
 def is_valid_topic_filter(topic_filter: str, max_level_length: int = 40,

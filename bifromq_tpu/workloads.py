@@ -177,3 +177,74 @@ def probe_topics(n: int, *, seed: int = 1, n_level_names: int = 1000,
     names, weights = _zipf_levels(n_level_names)
     return [gen_topic_levels(rng, names, weights, max_depth=max_depth)
             for _ in range(n)]
+
+
+# ---------------------- topic-diversity generator (ISSUE 11) ----------------
+#
+# The paper benchmarks its broker against tenant populations whose TOPIC
+# SHAPES differ wildly — short flat telemetry channels, deep per-device
+# vehicle paths, i18n retail catalogs, $SYS operational streams — while
+# `probe_topics` emits uniform `l<i>/l<j>/...` strings whose levels are
+# 2-5 ASCII bytes. Tokenizer cost is byte- and level-count-shaped, so the
+# ingest bench (config 9) must measure on realistic strings, not
+# `bench/a/b`. Profiles mix level counts, level byte lengths, multi-byte
+# UTF-8 density, numeric device-id leaves, and the '$'-root class.
+
+TENANT_TOPIC_PROFILES: dict = {
+    # flat sensor telemetry: shallow, short ASCII levels, numeric leaf
+    "telemetry": dict(weight=0.40, depth=(3, 6), seg_len=(3, 10),
+                      unicode_p=0.0, numeric_leaf_p=0.8, sys_p=0.0),
+    # fleet/vehicle: deep paths, mid-size levels, uuid-ish leaves
+    "fleet": dict(weight=0.25, depth=(6, 12), seg_len=(6, 18),
+                  unicode_p=0.02, numeric_leaf_p=0.5, sys_p=0.0),
+    # retail/i18n: shallow but multi-byte-UTF-8-heavy long levels
+    "retail_i18n": dict(weight=0.20, depth=(2, 5), seg_len=(4, 24),
+                        unicode_p=0.6, numeric_leaf_p=0.1, sys_p=0.0),
+    # operational $SYS streams (exercises the sys-root walk rule)
+    "sysmon": dict(weight=0.05, depth=(2, 4), seg_len=(4, 12),
+                   unicode_p=0.0, numeric_leaf_p=0.0, sys_p=1.0),
+    # adversarial edge: empty levels / separator runs / deep shapes
+    "edge": dict(weight=0.10, depth=(1, 15), seg_len=(0, 8),
+                 unicode_p=0.1, numeric_leaf_p=0.2, sys_p=0.0),
+}
+
+_UNICODE_SEGS = ["日本語", "センサー", "größe", "müller", "caféteria",
+                 "датчик", "température", "aßßen", "चैनल", "중계기"]
+_ASCII = "abcdefghijklmnopqrstuvwxyz"
+
+
+def diverse_topics(n: int, *, seed: int = 0,
+                   profiles: dict = None) -> List[str]:
+    """``n`` topic STRINGS drawn from the tenant profiles above (byte
+    plane: the serving path ships strings/bytes, so the generator does
+    too). Deterministic per seed; used by bench config 9 and the
+    ingest tier-2 gate."""
+    rng = random.Random(seed)
+    profs = profiles or TENANT_TOPIC_PROFILES
+    names = list(profs)
+    cum: List[float] = []
+    acc = 0.0
+    for p in names:
+        acc += profs[p]["weight"]
+        cum.append(acc)
+    out: List[str] = []
+    for _ in range(n):
+        p = profs[rng.choices(names, cum_weights=cum, k=1)[0]]
+        depth = rng.randint(*p["depth"])
+        levels: List[str] = []
+        for j in range(depth):
+            lo, hi = p["seg_len"]
+            seg_len = rng.randint(lo, hi)
+            if seg_len == 0:
+                levels.append("")       # empty level / separator run
+            elif rng.random() < p["unicode_p"]:
+                levels.append(rng.choice(_UNICODE_SEGS))
+            else:
+                levels.append("".join(rng.choice(_ASCII)
+                                      for _ in range(seg_len)))
+        if p["sys_p"] and rng.random() < p["sys_p"]:
+            levels.insert(0, "$SYS")
+        if levels and rng.random() < p["numeric_leaf_p"]:
+            levels.append(f"d{rng.randrange(1 << 20)}")
+        out.append("/".join(levels) if levels else "x")
+    return out

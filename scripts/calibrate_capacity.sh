@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# One-liner for the operational planner re-fit (ISSUE 11 satellite,
+# ROADMAP sharding follow-up (c)): GET /capacity?calibrate=1 re-fits the
+# CapacityPlanner's per-subscription coefficients from the live base
+# (true logical sub count, not the slot-count proxy) and reports
+# old-vs-new coefficient deltas + the predicted-bytes shift.
+#
+# Usage: calibrate_capacity.sh [base_url] [n_subs]
+#   base_url  API server (default http://127.0.0.1:8080)
+#   n_subs    target population for the predicted-bytes delta
+#             (default 1000000)
+set -euo pipefail
+
+BASE="${1:-http://127.0.0.1:8080}"
+N_SUBS="${2:-1000000}"
+
+curl -fsS "${BASE}/capacity?calibrate=1&n_subs=${N_SUBS}" \
+    | python -m json.tool

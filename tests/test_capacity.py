@@ -65,7 +65,10 @@ class TestExactAccounting:
             plain["probe_bytes"] + plain["result_bytes"]
         assert aliased["per_slot"] == max(aliased["probe_bytes"],
                                          aliased["result_bytes"])
-        assert plain["total"] == plain["per_slot"] * 2
+        # ISSUE 11: + one prep-ahead probe batch (the ring's prep
+        # tickets bound stage-1 uploads to depth + 1)
+        assert plain["total"] == \
+            plain["per_slot"] * 2 + plain["probe_bytes"]
 
 
 class TestPlanner:

@@ -304,11 +304,19 @@ class TestMatcherAsync:
             for i in range(5)]
         for _ in range(10):
             await asyncio.sleep(0)
-        assert m._ring.in_flight == 2       # 3 parked behind the ring
-        assert m._ring.waiting == 3
+        assert m._ring.in_flight == 2
+        # ISSUE 11: the 3 excess callers park behind TWO gates now —
+        # prep tickets (depth+1, held for the whole slot tenure) bound
+        # uploaded probe batches, so exactly ONE caller preps ahead and
+        # parks at the slot gate; the other 2 wait un-uploaded at the
+        # prep gate
+        assert m._ring.waiting == 1
+        assert m._ring.prepping == 3        # 2 in flight + 1 prep-ahead
+        assert m._ring._prep.waiting == 2
         gate.open = True
         await asyncio.gather(*tasks)
         assert m._ring.in_flight == 0
+        assert m._ring.prepping == 0
 
     async def test_mutation_mid_flight_defeats_cache_store(self):
         m = TpuMatcher(max_levels=8, k_states=8, auto_compact=False,
