@@ -1202,7 +1202,11 @@ def bench_config9():
             os.environ.pop("BIFROMQ_DEVICE_TOKENIZE", None)
         else:
             os.environ["BIFROMQ_DEVICE_TOKENIZE"] = prev
-    recs = OBS.profiler.records()[-(OBS.profiler.batches_total - rec0):]
+    n_new = OBS.profiler.batches_total - rec0
+    # n_new == 0 must yield an EMPTY window, not the whole ring ([-0:]):
+    # stale records from earlier configs would let the tokenize-stage
+    # verdict pass vacuously on exactly the regression it exists to catch
+    recs = OBS.profiler.records()[-n_new:] if n_new else []
     dev_batches = [r for r in recs if r.kernel != "oracle"]
     tokenized_all = bool(dev_batches) and all(
         r.tokenize_s > 0 for r in dev_batches)

@@ -45,6 +45,10 @@ HOT_SCOPES: Dict[str, Set[str]] = {
     "ops/tokenize.py": {"_hash_lanes", "hash_topics_device",
                         "device_tokenize"},
     "models/kernels.py": {"_build_fused", "fused_walk_routes"},
+    # ISSUE 12: the standby's per-batch device flush runs after every
+    # applied delta batch — it must stay a pure dispatch wrapper (the
+    # narrow scatters live in ops/match, already covered above)
+    "replication/standby.py": {"WarmStandby._flush_device"},
 }
 
 # host-sync call shapes (module-qualified callee names)

@@ -160,6 +160,11 @@ class APIServer:
                 return self._cluster_capacity()
             if route == ("GET", "/capacity"):
                 return self._capacity_get(arg)
+            if route == ("GET", "/replication"):
+                # ISSUE 12: delta-stream status — per-range heads on the
+                # hosting worker, standby cursors/lag, puller cursors
+                from .. import replication
+                return 200, replication.status_report()
             if route == ("GET", "/profile"):
                 return self._profile_get(arg)
             if method == "GET" and url.path.startswith("/cluster/trace/"):

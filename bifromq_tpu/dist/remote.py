@@ -51,7 +51,139 @@ class DistWorkerRPCService:
             "purge_broker": self._purge_broker,
             "node_id": self._node_id,
             "trace_spans": self._trace_spans,
+            # ISSUE 12: the replication fabric rides the same service —
+            # delta fetch (standbys), bounded base resync, exact
+            # invalidation long-poll (frontend pub caches), status
+            "repl_fetch": self._repl_fetch,
+            "repl_base": self._repl_base,
+            "repl_inval": self._repl_inval,
+            "repl_status": self._repl_status,
         })
+
+    # ---------------- replication fabric (ISSUE 12) ------------------------
+
+    # long-poll granularity: the server re-checks the ring this often
+    # while a fetch/inval call waits for records
+    _REPL_POLL_TICK_S = 0.02
+
+    async def _repl_status(self, payload: bytes, okey: str) -> bytes:
+        return json.dumps(self.worker.replication.status()).encode()
+
+    async def _repl_fetch(self, payload: bytes, okey: str) -> bytes:
+        from ..replication.standby import (ST_ANCHOR, ST_GAP, ST_NO_RANGE,
+                                           ST_OK)
+        rid_b, pos = _read16(payload, 0)
+        epoch, seq = struct.unpack_from(">IQ", payload, pos)
+        pos += 12
+        wait_ms, inval_only = struct.unpack_from(">IB", payload, pos)
+        log = self.worker.replication.get(rid_b.decode())
+        if log is None:
+            return bytes([ST_NO_RANGE]) + struct.pack(">IQI", 0, 0, 0)
+        deadline = asyncio.get_running_loop().time() + wait_ms / 1000.0
+        while True:
+            status, recs = log.since(epoch, seq)
+            if status != "ok" or recs \
+                    or asyncio.get_running_loop().time() >= deadline:
+                break
+            await asyncio.sleep(self._REPL_POLL_TICK_S)
+        st = {"ok": ST_OK, "gap": ST_GAP, "anchor": ST_ANCHOR}[status]
+        head_epoch, head_seq = log.cursor()
+        out = bytearray([st])
+        out += struct.pack(">IQ", head_epoch, head_seq)
+        out += struct.pack(">I", len(recs))
+        for rec in recs:
+            b = rec.encoded(inval_only=bool(inval_only))
+            out += struct.pack(">I", len(b)) + b
+        return bytes(out)
+
+    async def _repl_base(self, payload: bytes, okey: str) -> bytes:
+        """Bounded resync: ship THIS replica's host arenas + route set.
+        The matcher quiesces first (pending patches fold in; a lingering
+        overlay — collision fallbacks only — forces one compaction so the
+        shipped base is exact with an empty overlay); the stream cursor
+        captured after the quiesce addresses the snapshot, and nothing
+        awaits in between, so snapshot ⊕ later records is consistent."""
+        from ..replication.records import encode_base
+        from ..replication.standby import ST_NO_RANGE, ST_OK, ST_UNSUPPORTED
+        from ..models.automaton import PatchableTrie
+        rid = _read16(payload, 0)[0].decode()
+        coproc = self.worker.store.coprocs.get(rid)
+        log = self.worker.replication.get(rid)
+        if coproc is None or log is None:
+            return bytes([ST_NO_RANGE])
+        matcher = coproc.matcher
+        for _ in range(3):
+            matcher.refresh()
+            if matcher.overlay_size == 0:
+                break
+            matcher._maybe_compact(force=True)
+            matcher.drain()
+        base = matcher._base_ct
+        if not isinstance(base, PatchableTrie) or matcher.overlay_size:
+            return bytes([ST_UNSUPPORTED])
+        epoch, seq = log.cursor()
+        snap = encode_base(base, matcher.tries)
+        return (bytes([ST_OK]) + _len16(self.worker.store.node_id.encode())
+                + struct.pack(">IQ", epoch, seq)
+                + struct.pack(">I", len(snap)) + snap)
+
+    async def _repl_inval(self, payload: bytes, okey: str) -> bytes:
+        """Exact-invalidation long-poll across ALL hosted ranges: the
+        cache-only consumer leg. ``lost`` is set whenever the caller's
+        window cannot be reconstructed exactly (gap, epoch anchor, a
+        range it has never seen with prior records) — the client then
+        degrades to ONE wholesale bump, the old TTL's semantics."""
+        (n_cursors,) = struct.unpack_from(">H", payload, 0)
+        pos = 2
+        cursors = {}
+        for _ in range(n_cursors):
+            rid_b, pos = _read16(payload, pos)
+            epoch, seq = struct.unpack_from(">IQ", payload, pos)
+            pos += 12
+            cursors[rid_b.decode()] = (epoch, seq)
+        (wait_ms,) = struct.unpack_from(">I", payload, pos)
+        hub = self.worker.replication
+        deadline = asyncio.get_running_loop().time() + wait_ms / 1000.0
+        while True:
+            lost = False
+            invals = []
+            heads = {}
+            for rid in hub.range_ids():
+                log = hub.get(rid)
+                heads[rid] = log.cursor()
+                cur = cursors.get(rid)
+                if cur is None:
+                    # a never-seen range with EMITTED records means the
+                    # caller may have missed invalidations (e.g. a split
+                    # moved routes here). head_seq alone decides — the
+                    # epoch is HLC-boot-seeded and always nonzero, and a
+                    # pristine range (no records this epoch) has nothing
+                    # the caller could have missed: prior-epoch history
+                    # is covered by the cursor-mismatch clause below for
+                    # ranges the caller tracked.
+                    if heads[rid][1] > 0:
+                        lost = True
+                    continue
+                status, recs = log.since(*cur)
+                if status != "ok":
+                    lost = True
+                    continue
+                for rec in recs:
+                    if rec.tenant:
+                        invals.append((rec.tenant, rec.filter_levels))
+            if lost or invals \
+                    or asyncio.get_running_loop().time() >= deadline:
+                break
+            await asyncio.sleep(self._REPL_POLL_TICK_S)
+        out = bytearray([1 if lost else 0])
+        out += struct.pack(">H", len(heads))
+        for rid, (epoch, head) in heads.items():
+            out += _len16(rid.encode()) + struct.pack(">IQ", epoch, head)
+        out += struct.pack(">I", len(invals))
+        for tenant, filters in invals:
+            out += _len16(tenant.encode())
+            out += _len16("/".join(filters).encode())
+        return bytes(out)
 
     async def _add_route(self, payload: bytes, okey: str) -> bytes:
         tenant_b, pos = _read16(payload, 0)
@@ -102,9 +234,11 @@ class DistWorkerRPCService:
         for _ in range(n):
             tenant_b, pos = _read16(payload, pos)
             topic_b, pos = _read16(payload, pos)
-            # ISSUE 11 byte plane: the decoded topic string flows to the
-            # matcher unsplit (levels materialize only on fallback paths)
-            queries.append((tenant_b.decode(), topic_b.decode()))
+            # ISSUE 12 (ROADMAP ingest follow-up (c)): the WIRE BYTES flow
+            # to the matcher as-is — the byte plane packs them without a
+            # decode/re-encode round trip; str materializes only on the
+            # matcher's rare fallback legs
+            queries.append((tenant_b.decode(), bytes(topic_b)))
         results = await self.worker.match_batch(
             queries, max_persistent_fanout=mpf, max_group_fanout=mgf,
             linearized=bool(lin))

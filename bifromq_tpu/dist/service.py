@@ -101,6 +101,14 @@ class DistService:
         self._pub_cache_enabled = _match_cache_default()
         if hasattr(worker, "on_route_mutation"):
             worker.on_route_mutation = self._on_route_mutation
+        # ISSUE 12: a REMOTE worker has no local apply stream — the
+        # exact-invalidation puller (armed in start()) replaces the TTL
+        # wait with per-mutation evictions carried on the delta stream
+        self._inval_puller = None
+        # ISSUE 12 satellite: the pub cache's hot (tenant, topic) key set
+        # rides the PR 5 gossip digest so a failover target pre-warms
+        # before taking traffic
+        OBS.register_pub_cache(self._match_cache)
         self._pub_scheduler: BatchCallScheduler[PubCall, PubResult] = \
             BatchCallScheduler(lambda tenant: self._make_pub_batch(tenant),
                                pipeline_depth=None,  # BIFROMQ_PIPELINE_DEPTH
@@ -116,6 +124,19 @@ class DistService:
 
     async def start(self) -> None:
         await self.worker.start()
+        # ISSUE 12: exact invalidation for the remote-worker deployment —
+        # evictions arrive on the delta stream within one RTT; the TTL
+        # stays only as the backstop for stream loss
+        from ..utils.env import env_bool
+        if (self._inval_puller is None
+                and not hasattr(self.worker, "on_route_mutation")
+                and getattr(self.worker, "registry", None) is not None
+                and env_bool("BIFROMQ_REPL_INVAL", True)):
+            from ..replication.standby import InvalidationPuller
+            self._inval_puller = InvalidationPuller(
+                self.worker.registry, self._on_route_mutation,
+                service=getattr(self.worker, "service", "dist-worker"))
+            await self._inval_puller.start()
         from ..utils.sysprops import SysProp, get
         interval = get(SysProp.DIST_GC_INTERVAL_SECONDS)
         if interval and interval > 0:
@@ -136,6 +157,9 @@ class DistService:
         if task is not None:
             task.cancel()
             self._gc_task = None
+        if self._inval_puller is not None:
+            await self._inval_puller.stop()
+            self._inval_puller = None
         await self.worker.stop()
 
     async def gc_sweep(self) -> int:
@@ -314,7 +338,8 @@ class DistService:
                     # ≈ Disted event (dist call accepted + fanned out)
                     self.events.report(Event(
                         EventType.DISTED, tenant_id,
-                        {"topic": call.topic, "fanout": fanout}))
+                        {"topic": topic_util.to_str(call.topic),
+                         "fanout": fanout}))
             return results
         return process
 
@@ -350,10 +375,14 @@ class DistService:
         histogram either way."""
         t0 = time.perf_counter()
         fanout = 0
+        # ISSUE 12 byte plane: wire-bytes topics decode ONCE here, at the
+        # delivery boundary — the match path upstream never did
+        topic_s = topic_util.to_str(call.topic)
         try:
             with trace.span("deliver.fanout", tenant=tenant_id,
-                            topic=call.topic) as sp:
-                fanout = await self._fan_out_inner(tenant_id, call, matched)
+                            topic=topic_s) as sp:
+                fanout = await self._fan_out_inner(tenant_id, call, matched,
+                                                   topic_s)
                 sp.set_tag("fanout", fanout)
                 return fanout
         finally:
@@ -365,16 +394,17 @@ class DistService:
             OBS.record_fanout(tenant_id, fanout)
 
     async def _fan_out_inner(self, tenant_id: str, call: PubCall,
-                             matched: MatchedRoutes) -> int:
+                             matched: MatchedRoutes,
+                             topic_s: str) -> int:
         if matched.max_persistent_fanout_exceeded:
             self.events.report(Event(EventType.PERSISTENT_FANOUT_THROTTLED,
-                                     tenant_id, {"topic": call.topic}))
+                                     tenant_id, {"topic": topic_s}))
         if matched.max_group_fanout_exceeded:
             self.events.report(Event(EventType.GROUP_FANOUT_THROTTLED,
-                                     tenant_id, {"topic": call.topic}))
+                                     tenant_id, {"topic": topic_s}))
         targets: List[Route] = list(matched.normal)
         for mqtt_filter, members in matched.groups.items():
-            elected = self._elect(mqtt_filter, members, call.topic)
+            elected = self._elect(mqtt_filter, members, topic_s)
             if elected is not None:
                 targets.append(elected)
         # byte-based persistent fan-out cap (≈ MaxPersistentFanoutBytes in
@@ -401,7 +431,7 @@ class DistService:
             targets = kept
             self.events.report(Event(
                 EventType.PERSISTENT_FANOUT_BYTES_THROTTLED, tenant_id,
-                {"topic": call.topic, "allowed": allowed}))
+                {"topic": topic_s, "allowed": allowed}))
         if not targets:
             return 0
         # group per (broker, deliverer_key) ≈ BatchDeliveryCall grouping
@@ -410,7 +440,7 @@ class DistService:
             by_deliverer.setdefault((r.broker_id, r.deliverer_key),
                                     []).append(r)
         pack = TopicMessagePack(
-            topic=call.topic,
+            topic=topic_s,
             packs=(PublisherMessagePack(publisher=call.publisher,
                                         messages=(call.message,)),))
         fanout = 0

@@ -192,6 +192,14 @@ class DistWorkerCoProc(IKVRangeCoProc):
         # "everything changed" (reset-from-KV). DistWorker relays this to
         # the frontend's pub-side match cache.
         self.on_mutation = None
+        # ISSUE 12: replication outlets — every applied mutation's delta
+        # record (logical op + captured PatchPlan) and every base
+        # re-anchor flow to the hosting worker's per-range DeltaLog, so
+        # warm standbys and remote pub caches ride the SAME apply stream
+        # raft followers do. Wired by DistWorker._mk_coproc.
+        self.delta_sink = None      # fn(tenant, filters, op, plan, fb)
+        self.anchor_sink = None     # fn(salt, reason)
+        self._wire_repl_hooks()
         # per-range load profile (≈ KVLoadRecorder + FanoutSplitHinter
         # food): mutates record the route key, matches record the tenant
         # prefix weighted by fan-out (see DistWorker.match_batch)
@@ -210,6 +218,20 @@ class DistWorkerCoProc(IKVRangeCoProc):
         self._fact = None
         self._fact_dirty = True
         self._fact_reader = None
+
+    def _wire_repl_hooks(self) -> None:
+        self.matcher.on_delta = self._emit_delta
+        self.matcher.on_rebase = self._emit_rebase
+
+    def _emit_delta(self, tenant_id, filter_levels, op, plan,
+                    fallback) -> None:
+        from ..models.matcher import _safe_hook
+        _safe_hook(self.delta_sink, "delta sink", tenant_id,
+                   filter_levels, op, plan, fallback)
+
+    def _emit_rebase(self, salt, reason) -> None:
+        from ..models.matcher import _safe_hook
+        _safe_hook(self.anchor_sink, "anchor sink", salt, reason)
 
     # ---------------- RW (≈ batchAddRoute / batchRemoveRoute) --------------
 
@@ -319,10 +341,10 @@ class DistWorkerCoProc(IKVRangeCoProc):
         tenant_b, pos = _read_frame(input_data, 1)
         n = struct.unpack_from(">I", input_data, pos)[0]
         pos += 4
-        topics: List[str] = []
+        topics: List[bytes] = []
         for _ in range(n):
             t, pos = _read_frame(input_data, pos)
-            topics.append(t.decode())
+            topics.append(bytes(t))     # ISSUE 12: wire bytes, no decode
         tenant_id = tenant_b.decode()
         # ISSUE 11 byte plane: raw topic strings through to the matcher
         results = self.matcher.match_batch(
@@ -340,11 +362,21 @@ class DistWorkerCoProc(IKVRangeCoProc):
         self._fact_reader = reader
         self._fact_dirty = True
         self.matcher = self.matcher.clone_empty()
-        for key, value in reader.iterate(schema.TAG_DIST,
-                                         schema.prefix_end(schema.TAG_DIST)):
-            tenant_id = _tenant_of_key(key)
-            self.matcher.add_route(tenant_id,
-                                   schema.decode_route(tenant_id, key, value))
+        self._wire_repl_hooks()
+        # ISSUE 12: snapshot restore rewrote the world — anchor the delta
+        # stream so standbys resync instead of scattering onto arenas
+        # that no longer exist; the rebuild's per-op emission is
+        # suppressed (it is all covered by the anchor's resync)
+        self._emit_rebase(None, "reset")
+        self.matcher._replaying = True
+        try:
+            for key, value in reader.iterate(
+                    schema.TAG_DIST, schema.prefix_end(schema.TAG_DIST)):
+                tenant_id = _tenant_of_key(key)
+                self.matcher.add_route(
+                    tenant_id, schema.decode_route(tenant_id, key, value))
+        finally:
+            self.matcher._replaying = False
         # snapshot restore rewrote the world: wholesale invalidation
         # upstream (the rebuilt matcher starts with an empty cache)
         self._notify_mutation(None, None)
@@ -393,11 +425,23 @@ class DistWorker:
         # DistService subscribes its pub-side match cache, so mutations
         # REPLAYED from raft peers invalidate it too, not just local calls
         self.on_route_mutation = None
+        # ISSUE 12: the per-worker replication hub — one DeltaLog per
+        # hosted range, fed by the coproc apply stream (leader AND
+        # follower replicas), served to standbys/pullers over the fabric
+        from ..replication.stream import ReplicationHub
+        self.replication = ReplicationHub(node_id)
 
         def _mk_coproc(rid):
             cp = DistWorkerCoProc(matcher_factory() if matcher_factory
                                   else None)
             cp.on_mutation = self._relay_mutation
+            log = self.replication.log_for(rid)
+            cp.delta_sink = (lambda tenant, filters, op, plan, fb,
+                             _log=log: _log.append(
+                                 tenant=tenant, filter_levels=filters,
+                                 op=op, plan=plan, fallback=fb))
+            cp.anchor_sink = (lambda salt, reason, _log=log:
+                              _log.anchor(salt, reason))
             return cp
 
         self.store = KVRangeStore(

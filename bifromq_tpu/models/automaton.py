@@ -436,6 +436,52 @@ class PatchFallback(RuntimeError):
     back to the delta-overlay path (and typically schedules a compaction)."""
 
 
+@dataclass
+class PatchPlan:
+    """The physical row-scatter footprint of ONE logical patch op
+    (ISSUE 12 tentpole): everything a byte-identical replica arena needs
+    to reproduce the op WITHOUT re-running descent/hashing — TrieJax's
+    relational framing makes trie mutations orderable row writes, and
+    this is exactly that write set.
+
+    Every field is an ABSOLUTE end-of-op state (node rows, slot
+    contents) or a deterministic instruction (edge upserts replay
+    through the replica's own ``_edge_insert``, which regrows at the
+    same point because the pre-op tables are byte-identical), so a plan
+    is safe to re-apply and safe to apply on any replica whose arena
+    matches the leader's previous state.
+    """
+
+    node_ids: Set[int] = None            # touched node rows (ids)
+    node_rows: List[Tuple[int, np.ndarray]] = None  # filled at take_plan
+    edge_sets: List[Tuple[int, int, int, int]] = None  # (node,h1,h2,child)
+    edge_levels: List[Tuple[int, int, int, str]] = None
+    parent_sets: List[Tuple[int, int]] = None       # (child, parent)
+    slot_ops: List[Tuple] = None   # ("set", idx, Matching) | ("kill", idx)
+    tenant_roots: Dict[str, int] = None
+    n_live_after: int = 0
+    node_cap_after: int = 0
+    n_slots_after: int = 0
+    dead_delta: int = 0
+    garbage_delta: int = 0
+    relocations: int = 0
+
+    def __post_init__(self) -> None:
+        if self.node_ids is None:
+            self.node_ids = set()
+        for f in ("node_rows", "edge_sets", "edge_levels", "parent_sets",
+                  "slot_ops"):
+            if getattr(self, f) is None:
+                setattr(self, f, [])
+        if self.tenant_roots is None:
+            self.tenant_roots = {}
+
+    @property
+    def empty(self) -> bool:
+        return not (self.node_ids or self.node_rows or self.edge_sets
+                    or self.slot_ops or self.tenant_roots)
+
+
 def patch_enabled() -> bool:
     return env_bool("BIFROMQ_PATCH", True)
 
@@ -493,12 +539,47 @@ class PatchableTrie(CompiledTrie):
                          tenant_root=ct.tenant_root, salt=ct.salt,
                          probe_len=ct.probe_len, max_levels=ct.max_levels)
         self.n_live = n
+        self._init_runtime(ct.slot_kind, ct.matchings_arr)
+
+    @classmethod
+    def from_arenas(cls, *, node_tab: np.ndarray, n_live: int,
+                    edge_tab: np.ndarray, child_list: np.ndarray,
+                    matchings: List[Matching], slot_kind: np.ndarray,
+                    tenant_root: Dict[str, int], salt: int, probe_len: int,
+                    max_levels: int, dead_slots: int = 0,
+                    garbage_slots: int = 0) -> "PatchableTrie":
+        """Rebuild a PatchableTrie from SHIPPED host arenas (ISSUE 12
+        bounded resync): a replica installs the leader's exact arenas —
+        including capacity padding, patch-era node ordering and dead
+        slots — with NO trie DFS and NO recompile, so subsequent
+        :class:`PatchPlan` row scatters land on byte-identical state."""
+        self = cls.__new__(cls)
+        CompiledTrie.__init__(
+            self, node_tab=node_tab, edge_tab=edge_tab,
+            child_list=child_list, matchings=list(matchings),
+            tenant_root=dict(tenant_root), salt=salt, probe_len=probe_len,
+            max_levels=max_levels)
+        self.n_live = int(n_live)
+        s = len(self.matchings)
+        marr = np.empty(max(s, 1), dtype=object)
+        for i, m in enumerate(self.matchings):
+            marr[i] = m
+        self._init_runtime(np.asarray(slot_kind, dtype=np.int8), marr[:s])
+        self.dead_slots = int(dead_slots)
+        self.garbage_slots = int(garbage_slots)
+        return self
+
+    def _init_runtime(self, kind_src: np.ndarray, marr_src) -> None:
+        """The non-arena half of construction, shared by the compiled-
+        base path (``__init__``) and the replica resync path
+        (``from_arenas``)."""
+        n, cap = self.n_live, int(self.node_tab.shape[0])
         # parent links (vectorized from the edge table + wildcard columns)
         # so interval changes can re-fold the '#'-child columns upward
         parent = np.full(cap, _EMPTY, dtype=np.int32)
         ids = np.arange(n, dtype=np.int32)
         for col in (NODE_PLUS, NODE_HASH):
-            c = node_tab[:n, col]
+            c = self.node_tab[:n, col]
             m = c >= 0
             parent[c[m]] = ids[m]
         entries = self.edge_tab.reshape(-1, 4)
@@ -512,8 +593,8 @@ class PatchableTrie(CompiledTrie):
         kind = np.full(scap, CompiledTrie.SLOT_NORMAL, dtype=np.int8)
         marr = np.empty(scap, dtype=object)
         if s:
-            kind[:s] = ct.slot_kind
-            marr[:s] = ct.matchings_arr
+            kind[:s] = kind_src
+            marr[:s] = marr_src
         self._kind = kind
         self._marr = marr
         # fragmentation accounting (the compaction trigger)
@@ -528,6 +609,9 @@ class PatchableTrie(CompiledTrie):
         self._dirty_edges: Set[int] = set()
         self._full: Set[str] = set()
         self._pending_ops = 0
+        # ISSUE 12: when armed (begin_plan), every mutator records its
+        # physical write set here for the replication stream
+        self._plan: Optional[PatchPlan] = None
         # level strings of PATCH-inserted edges, keyed (parent, h1, h2):
         # the builder detects same-parent 64-bit hash collisions and
         # re-salts (module docstring: "exact, not probabilistic"); the
@@ -608,8 +692,81 @@ class PatchableTrie(CompiledTrie):
         }
 
     def _mark_node(self, nid: int) -> None:
+        if self._plan is not None:
+            self._plan.node_ids.add(int(nid))
         if "node" not in self._full:
             self._dirty_nodes.add(int(nid))
+
+    # ---------------- patch-plan capture & replica apply (ISSUE 12) ---------
+
+    def begin_plan(self) -> None:
+        """Arm physical write-set capture for the NEXT patch op (the
+        replication emit hook brackets every ``patch_add``/``patch_remove``
+        with begin/take)."""
+        self._plan = PatchPlan()
+
+    def take_plan(self) -> Optional[PatchPlan]:
+        """Detach the captured plan (absolute end-of-op node rows are
+        materialized here — node ids are append-only, so end-of-op
+        capture is exact even when a row was touched repeatedly)."""
+        plan, self._plan = self._plan, None
+        if plan is None:
+            return None
+        plan.node_rows = [(nid, self.node_tab[nid].copy())
+                          for nid in sorted(plan.node_ids)]
+        plan.n_live_after = int(self.n_live)
+        plan.node_cap_after = int(self.node_tab.shape[0])
+        plan.n_slots_after = len(self.matchings)
+        return plan
+
+    def apply_plan(self, plan: PatchPlan) -> None:
+        """Apply a leader-recorded :class:`PatchPlan` to THIS replica's
+        arenas — the row-scatter half of the replication fabric. No
+        descent, no hashing: slot writes and node rows land as absolute
+        states; edge upserts replay through ``_edge_insert`` (which
+        regrows deterministically at the same point the leader did,
+        because the pre-op tables are byte-identical). Touched rows land
+        in the replica's OWN dirty set, so its next dispatch flushes the
+        same narrow device scatters the leader shipped."""
+        if plan.node_cap_after > self.node_tab.shape[0]:
+            while self.node_tab.shape[0] < plan.node_cap_after:
+                self._grow_nodes()
+        if plan.n_live_after > self.n_live:
+            self.n_live = plan.n_live_after
+        for tenant, root in plan.tenant_roots.items():
+            self.tenant_root[tenant] = int(root)
+        for nid, h1, h2, cid in plan.edge_sets:
+            if self._edge_child(nid, h1, h2) < 0:
+                self._edge_insert(nid, h1, h2, cid)
+        for nid, h1, h2, level in plan.edge_levels:
+            self._edge_level[(int(nid), int(h1), int(h2))] = level
+        for cid, par in plan.parent_sets:
+            self.parent[cid] = par
+        for op in plan.slot_ops:
+            if op[0] == "set":
+                _, s, m = op
+                if s == len(self.matchings):
+                    self._append_slot(m)
+                elif s < len(self.matchings):
+                    self.matchings[s] = m
+                    self._marr[s] = m
+                    self._kind[s] = self._classify(m)
+                else:
+                    raise PatchFallback(
+                        f"slot hole at {s} (arena has "
+                        f"{len(self.matchings)}) — replica needs resync")
+            else:   # kill: tombstone, counted via dead_delta below
+                _, s = op
+                if s < len(self.matchings):
+                    self._kind[s] = CompiledTrie.SLOT_DEAD
+        for nid, row in plan.node_rows:
+            self.node_tab[nid] = row
+            self._mark_node(nid)
+        self.dead_slots = max(0, self.dead_slots + plan.dead_delta)
+        self.garbage_slots += plan.garbage_delta
+        self.relocations += plan.relocations
+        self.patched_ops += 1
+        self._pending_ops += 1
 
     # ---------------- the patch ops (host plan + arena update) --------------
 
@@ -623,6 +780,8 @@ class PatchableTrie(CompiledTrie):
         if root < 0:
             root = self._alloc_node()
             self.tenant_root[tenant_id] = root
+            if self._plan is not None:
+                self._plan.tenant_roots[tenant_id] = root
         nid = self._descend(root, route.matcher.filter_levels, create=True)
         if route.matcher.type == RouteMatcherType.NORMAL:
             url = route.receiver_url
@@ -630,8 +789,7 @@ class PatchableTrie(CompiledTrie):
                 nid, lambda m: not isinstance(m, GroupMatching)
                 and m.receiver_url == url)
             if s is not None:
-                self.matchings[s] = route
-                self._marr[s] = route
+                self._slot_set(s, route)
             else:
                 self._slot_append(nid, route)
         else:
@@ -647,8 +805,7 @@ class PatchableTrie(CompiledTrie):
                 nid, lambda m: isinstance(m, GroupMatching)
                 and m.mqtt_topic_filter == tf)
             if s is not None:
-                self.matchings[s] = gm
-                self._marr[s] = gm
+                self._slot_set(s, gm)
             else:
                 self._slot_append(nid, gm)
         self.patched_ops += 1
@@ -684,8 +841,7 @@ class PatchableTrie(CompiledTrie):
                 gm = GroupMatching(mqtt_topic_filter=tf,
                                    ordered=old.ordered,
                                    members=tuple(group_members.values()))
-                self.matchings[s] = gm
-                self._marr[s] = gm
+                self._slot_set(s, gm)
             else:
                 self._kill_slot(s)
         self.patched_ops += 1
@@ -792,12 +948,17 @@ class PatchableTrie(CompiledTrie):
                 self.node_tab[cid, NODE_RSTART]
         else:
             h1, h2 = level_hash(level, self.salt)
+            if self._plan is not None:
+                self._plan.edge_sets.append((nid, h1, h2, cid))
+                self._plan.edge_levels.append((nid, h1, h2, level))
             self._edge_insert(nid, h1, h2, cid)
             self._edge_level[(nid, h1, h2)] = level
             self.node_tab[nid, NODE_CCOUNT] += 1
             if level.startswith(topic_util.SYS_PREFIX):
                 self.node_tab[nid, NODE_SYS_CCOUNT] += 1
         self.parent[cid] = nid
+        if self._plan is not None:
+            self._plan.parent_sets.append((cid, nid))
         self._mark_node(nid)
         return cid
 
@@ -823,7 +984,18 @@ class PatchableTrie(CompiledTrie):
         self.matchings.append(m)
         self._kind[s] = self._classify(m)
         self._marr[s] = m
+        if self._plan is not None:
+            self._plan.slot_ops.append(("set", s, m))
         return s
+
+    def _slot_set(self, s: int, m: Matching) -> None:
+        """In-place slot content replacement (incarnation upsert / group
+        member swap) — same kind class, zero device traffic."""
+        self.matchings[s] = m
+        self._marr[s] = m
+        self._kind[s] = self._classify(m)
+        if self._plan is not None:
+            self._plan.slot_ops.append(("set", s, m))
 
     def _find_slot(self, nid: int, pred) -> Optional[int]:
         rs = int(self.node_tab[nid, NODE_RSTART])
@@ -839,6 +1011,9 @@ class PatchableTrie(CompiledTrie):
         # pre-remove walk may still be holding this slot id
         self._kind[s] = CompiledTrie.SLOT_DEAD
         self.dead_slots += 1
+        if self._plan is not None:
+            self._plan.slot_ops.append(("kill", s))
+            self._plan.dead_delta += 1
 
     def _slot_append(self, nid: int, m: Matching) -> None:
         rs = int(self.node_tab[nid, NODE_RSTART])
@@ -861,6 +1036,8 @@ class PatchableTrie(CompiledTrie):
             for s in range(rs, rs + rc):
                 if self._kind[s] == CompiledTrie.SLOT_DEAD:
                     self.dead_slots -= 1    # dropped, now plain garbage
+                    if self._plan is not None:
+                        self._plan.dead_delta -= 1
                 else:
                     self._append_slot(self._marr[s])
                     moved += 1
@@ -869,6 +1046,9 @@ class PatchableTrie(CompiledTrie):
             self.node_tab[nid, NODE_RSTART] = new_start
             self.node_tab[nid, NODE_RCOUNT] = moved + 1
             self.relocations += 1
+            if self._plan is not None:
+                self._plan.garbage_delta += rc
+                self._plan.relocations += 1
         self._after_interval_change(nid)
 
     def _after_interval_change(self, nid: int) -> None:
@@ -1152,11 +1332,87 @@ class TokenizedFilters:
 
 def tokenize_filters(filters: Sequence[Sequence[str]], roots: Sequence[int],
                      *, max_levels: int, salt: int,
-                     batch: Optional[int] = None) -> TokenizedFilters:
-    """Hash filter levels ('+'/'#' become kind codes) into a probe batch."""
+                     batch: Optional[int] = None,
+                     vectorized: bool = True) -> TokenizedFilters:
+    """Hash filter levels ('+'/'#' become kind codes) into a probe batch.
+
+    ISSUE 12 satellite (ROADMAP ingest follow-up (b)): the retained-
+    probe path now rides the PR 11 byte plane — one C-level join+pack
+    into :class:`~bifromq_tpu.models.bytetok.TopicBytes`, a vectorized
+    boundary scan, and one vectorized BLAKE2b pass over every literal
+    level of the batch. The per-row Python loop survives as the
+    semantics reference (``vectorized=False``) and the fallback."""
     n = len(filters)
     b = batch or n
     assert b >= n
+    if vectorized and n:
+        try:
+            return _tokenize_filters_vec(filters, roots,
+                                         max_levels=max_levels, salt=salt,
+                                         batch=b)
+        except Exception:  # noqa: BLE001 — e.g. NUL-bearing level rows
+            pass
+    return _tokenize_filters_py(filters, roots, max_levels=max_levels,
+                                salt=salt, batch=b)
+
+
+def _tokenize_filters_vec(filters, roots, *, max_levels: int, salt: int,
+                          batch: int) -> TokenizedFilters:
+    """Byte-plane filter tokenization: pinned row-identical to the
+    reference loop by the randomized parity suite."""
+    from . import bytetok
+    n = len(filters)
+    width = max_levels + 1
+    tb = bytetok.TopicBytes.from_topics(
+        [topic_util.DELIMITER.join(f) for f in filters])
+    st = bytetok.topic_structure(tb)
+    # a joined empty filter ([] -> "") scans as one empty level; the
+    # reference loop records length 0 with no levels — align below
+    n_ref = np.fromiter((len(f) for f in filters), dtype=np.int64, count=n)
+    empty_rows = n_ref == 0
+    if not np.array_equal(st.n_levels[~empty_rows],
+                          n_ref[~empty_rows]):
+        # a level embedding the delimiter (impossible from parse(), but
+        # this is a public API) would silently re-split — refuse, the
+        # caller falls back to the reference loop
+        raise ValueError("level contains the topic delimiter")
+    ok = (st.n_levels <= max_levels) & ~empty_rows
+    lengths = np.full(batch, _EMPTY, dtype=np.int32)
+    rootv = np.full(batch, _EMPTY, dtype=np.int32)
+    roots_a = np.asarray(list(roots), dtype=np.int32)
+    lengths[:n][ok] = st.n_levels[ok]
+    rootv[:n][ok] = roots_a[ok]
+    lengths[:n][empty_rows] = 0
+    rootv[:n][empty_rows] = roots_a[empty_rows]
+    tok_h1 = np.zeros((batch, width), dtype=np.int32)
+    tok_h2 = np.zeros((batch, width), dtype=np.int32)
+    tok_kind = np.zeros((batch, width), dtype=np.int32)
+    sel = ok[st.lvl_row]
+    if sel.any():
+        # wildcard levels are exactly the single-byte '+'/'#' levels
+        one = st.lvl_len == 1
+        b0 = np.zeros(st.lvl_len.shape[0], dtype=np.uint8)
+        oidx = np.nonzero(one)[0]
+        b0[oidx] = tb.data[st.lvl_start[oidx]]
+        kind_lvl = np.zeros(st.lvl_len.shape[0], dtype=np.int32)
+        kind_lvl[one & (b0 == ord(topic_util.SINGLE_WILDCARD))] = KIND_PLUS
+        kind_lvl[one & (b0 == ord(topic_util.MULTI_WILDCARD))] = KIND_HASH
+        lit = sel & (kind_lvl == KIND_LIT)
+        if lit.any():
+            h1, h2 = bytetok.hash_levels(tb.data, st.lvl_start[lit],
+                                         st.lvl_len[lit], salt)
+            tok_h1[st.lvl_row[lit], st.lvl_idx[lit]] = h1
+            tok_h2[st.lvl_row[lit], st.lvl_idx[lit]] = h2
+        tok_kind[st.lvl_row[sel], st.lvl_idx[sel]] = kind_lvl[sel]
+    return TokenizedFilters(tok_h1=tok_h1, tok_h2=tok_h2, tok_kind=tok_kind,
+                            lengths=lengths, roots=rootv)
+
+
+def _tokenize_filters_py(filters, roots, *, max_levels: int, salt: int,
+                         batch: int) -> TokenizedFilters:
+    """The per-row reference loop (parity surface + fallback)."""
+    n = len(filters)
+    b = batch
     width = max_levels + 1
     tok_h1 = np.zeros((b, width), dtype=np.int32)
     tok_h2 = np.zeros((b, width), dtype=np.int32)

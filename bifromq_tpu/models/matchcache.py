@@ -282,6 +282,39 @@ class TenantMatchCache:
 
     # ---------------- introspection ----------------------------------------
 
+    def hot_keys(self, k: int = 16):
+        """Up to ``k`` most-recently-served (tenant, topic) pairs — the
+        digest's hot-topic key set (ISSUE 12): ``get`` refreshes dict
+        recency, so each slot's tail is its hottest working set. Keys
+        normalize to topic strings (level tuples re-join, wire bytes
+        decode) so the set is gossip/JSON-safe and a pre-warming replica
+        can replay them as plain match queries."""
+        from itertools import islice, zip_longest
+        per_tenant = max(1, k // max(1, len(self._slots)))
+        # O(per_tenant) tail walk per tenant — never a full key-list
+        # copy per gossip tick (a full cache holds 64k entries); the
+        # round-robin interleave below gives EVERY tenant its hottest
+        # key before any tenant gets a second (more tenants than k must
+        # not silently drop the earliest-created — possibly hottest —
+        # slots on dict insertion order)
+        tails = [[(tenant, key)
+                  for key in islice(reversed(s.entries), per_tenant)]
+                 for tenant, s in self._slots.items()]
+        out = []
+        for rank in zip_longest(*tails):
+            for pair in rank:
+                if pair is None:
+                    continue
+                tenant, key = pair
+                if isinstance(key, bytes):
+                    key = key.decode("utf-8", "replace")
+                elif isinstance(key, tuple):
+                    key = topic_util.DELIMITER.join(key)
+                out.append([tenant, key])
+                if len(out) >= k:
+                    return out
+        return out
+
     def counts(self) -> Tuple[int, int]:
         return self.hits, self.misses
 

@@ -133,6 +133,35 @@ def _match_cache_default() -> bool:
     return env_bool("BIFROMQ_MATCH_CACHE", True)
 
 
+def apply_log_op(tries: Dict[str, SubscriptionTrie], op: Tuple) -> None:
+    """Apply ONE matcher log op to a tries dict — THE single definition
+    of the op → trie semantics, shared by the shadow replay and the
+    replication standby's authoritative-trie upkeep (ISSUE 12): the two
+    sides must never drift, or standby host-oracle parity silently
+    breaks."""
+    if op[0] == "add":
+        _, tenant, route = op
+        tries.setdefault(tenant, SubscriptionTrie()).add(route)
+    else:
+        _, tenant, matcher, url, inc = op
+        trie = tries.get(tenant)
+        if trie is not None:
+            trie.remove(matcher, url, inc)
+            if len(trie) == 0:
+                del tries[tenant]
+
+
+def _safe_hook(cb, what: str, *args) -> None:
+    """Fire an optional observer hook without letting it poison the
+    mutation/install path (ISSUE 12: delta/rebase emit chains)."""
+    if cb is None:
+        return
+    try:
+        cb(*args)
+    except Exception:  # noqa: BLE001 — observers must not break serving
+        logging.getLogger(__name__).exception("%s hook failed", what)
+
+
 class TpuMatcher:
     # the async pipeline path (match_batch_async) drives _dispatch_device
     # directly; subclasses replacing the whole device plane (MeshMatcher)
@@ -196,6 +225,17 @@ class TpuMatcher:
                                          device_breaker_enabled)
         self.device_breaker = (DEVICE_BREAKERS.create()
                                if device_breaker_enabled() else None)
+        # ISSUE 12 replication emit hooks (armed by DistWorkerCoProc):
+        # on_delta(tenant, filter_levels, op, plan, fallback) fires per
+        # applied mutation with the captured PatchPlan (None when the op
+        # went to the overlay); on_rebase(salt, reason) fires on every
+        # COMPILED base install — arenas renumbered, the delta stream
+        # must re-anchor. _replaying suppresses emission while a replay
+        # (log suffix / reset-from-KV rebuild) re-applies ops that were
+        # already streamed (or are covered by an anchor).
+        self.on_delta = None
+        self.on_rebase = None
+        self._replaying = False
         # mutation log since the shadow copy last synced; shadow is the
         # frozen snapshot source for off-thread compiles
         self._log: List[Tuple] = []
@@ -259,15 +299,14 @@ class TpuMatcher:
             return False
         op = ("add", tenant_id, route)
         self._log.append(op)
-        if not self._try_patch(op):
-            # no patchable base (or the op fell back): serve it from the
-            # delta overlay until the next compaction folds it in
-            self._overlay_record(op)
+        plan, fallback = self._fold_op(op)
         if self.match_cache is not None:
             # filter-aware (ISSUE 4): exact filters evict one topic key,
             # wildcard filters bump the tenant epoch
             self.match_cache.invalidate(tenant_id,
                                         route.matcher.filter_levels)
+        self._emit_delta(tenant_id, route.matcher.filter_levels, op,
+                         plan, fallback)
         self._maybe_compact()
         return created
 
@@ -283,14 +322,47 @@ class TpuMatcher:
             del self.tries[tenant_id]
         op = ("rm", tenant_id, matcher, receiver_url, incarnation)
         self._log.append(op)
-        if not self._try_patch(op):
-            self._overlay_record(op)
+        plan, fallback = self._fold_op(op)
         if self.match_cache is not None:
             self.match_cache.invalidate(tenant_id, matcher.filter_levels)
+        self._emit_delta(tenant_id, matcher.filter_levels, op, plan,
+                         fallback)
         self._maybe_compact()
         return True
 
     # ---------------- incremental patching (ISSUE 9 tentpole) --------------
+
+    def _fold_op(self, op: Tuple):
+        """Patch-first fold of one log op, with PatchPlan capture when a
+        delta subscriber is armed (ISSUE 12): the physical write set the
+        leader just executed is EXACTLY what a byte-identical replica
+        applies — no second descent, no hashing. Returns
+        ``(plan, fallback)``; a declined op records into the overlay and
+        ships op-only (a fallback may still carry a PARTIAL plan: nodes
+        allocated before the patcher refused stay in the arena as
+        garbage, and the replica mirrors them to keep byte parity)."""
+        base = self._base_ct
+        record = (self.on_delta is not None and not self._replaying
+                  and isinstance(base, PatchableTrie))
+        if record:
+            base.begin_plan()
+        try:
+            ok = self._try_patch(op)
+        finally:
+            plan = base.take_plan() if record else None
+        if not ok:
+            # no patchable base (or the op fell back): serve it from the
+            # delta overlay until the next compaction folds it in
+            self._overlay_record(op)
+        if plan is not None and plan.empty and not ok:
+            plan = None
+        return plan, not ok
+
+    def _emit_delta(self, tenant_id, filter_levels, op, plan,
+                    fallback) -> None:
+        if not self._replaying:
+            _safe_hook(self.on_delta, "delta emit", tenant_id,
+                       filter_levels, op, plan, fallback)
 
     def _patching_enabled(self) -> bool:
         return self.supports_patching and patch_enabled()
@@ -426,16 +498,7 @@ class TpuMatcher:
 
     def _replay_log_into_shadow(self) -> None:
         for op in self._log:
-            if op[0] == "add":
-                _, tenant, route = op
-                self._shadow.setdefault(tenant, SubscriptionTrie()).add(route)
-            else:
-                _, tenant, matcher, url, inc = op
-                trie = self._shadow.get(tenant)
-                if trie is not None:
-                    trie.remove(matcher, url, inc)
-                    if len(trie) == 0:
-                        del self._shadow[tenant]
+            apply_log_op(self._shadow, op)
         self._log.clear()
 
     def _compile_shadow(self) -> Tuple[CompiledTrie, object]:
@@ -619,6 +682,11 @@ class TpuMatcher:
                 self.match_cache.bump_all()
                 bumped = True
         self._ledger_record(ct, bumped)
+        # ISSUE 12: a compiled install renumbers the arenas (even a pure
+        # same-salt compaction re-runs the DFS) — the delta stream must
+        # re-anchor so replicas resync instead of scattering stale rows
+        _safe_hook(self.on_rebase, "rebase", self._base_salt(ct),
+                   self._compile_reason)
 
     def _ledger_record(self, ct, bumped: bool) -> None:
         """ISSUE 8: stamp this install into the compile-event ledger so

@@ -26,7 +26,7 @@ from ..plugin.subbroker import SubBrokerRegistry
 from ..types import ClientInfo
 from ..utils import topic as topic_util
 from . import packets as pk
-from .codec import StreamDecoder, encode
+from .codec import StreamDecoder, encode, topic_bytes_enabled
 from .protocol import (CONNACK_ACCEPTED, CONNACK_REFUSED_IDENTIFIER_REJECTED,
                        CONNACK_REFUSED_NOT_AUTHORIZED, PROTOCOL_MQTT5,
                        MalformedPacket, PropertyId, ReasonCode)
@@ -59,7 +59,8 @@ class Connection:
         self.broker = broker
         self.reader = reader
         self.writer = writer
-        self.decoder = StreamDecoder()
+        # ISSUE 12: server ingress keeps PUBLISH topics as wire bytes
+        self.decoder = StreamDecoder(raw_pub_topic=topic_bytes_enabled())
         self.session: Optional[Session] = None
         self.protocol_level = 4
         self._closed = False

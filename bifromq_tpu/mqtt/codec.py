@@ -17,11 +17,20 @@ from typing import Iterator, List, Optional, Tuple
 from . import packets as pk
 from .protocol import (
     PROTOCOL_MQTT5, MalformedPacket, PacketType, ReasonCode,
-    decode_binary, decode_properties, decode_string, decode_varint,
-    encode_binary, encode_properties, encode_string, encode_varint,
+    decode_binary, decode_properties, decode_string, decode_topic_bytes,
+    decode_varint, encode_binary, encode_properties, encode_string,
+    encode_varint,
 )
 
 _MAX_PACKET_ID = 65535
+
+
+def topic_bytes_enabled() -> bool:
+    """ISSUE 12 kill-switch: server-side PUBLISH ingress keeps topics
+    as raw wire bytes end-to-end (codec -> session -> dist -> matcher);
+    BIFROMQ_TOPIC_BYTES=0 restores eager str decode at the codec."""
+    from ..utils.env import env_bool
+    return env_bool("BIFROMQ_TOPIC_BYTES", True)
 
 
 def _read_u16(body: bytes, pos: int) -> int:
@@ -154,8 +163,15 @@ def _encode_connect(c: pk.Connect) -> bytes:
 
 # ------------------------------- decode ------------------------------------
 
-def decode_packet(ptype: int, flags: int, body: bytes, protocol_level: int):
-    """Decode one complete packet body (fixed header already consumed)."""
+def decode_packet(ptype: int, flags: int, body: bytes, protocol_level: int,
+                  raw_pub_topic: bool = False):
+    """Decode one complete packet body (fixed header already consumed).
+
+    ``raw_pub_topic`` (ISSUE 12, server ingress only): PUBLISH topics
+    stay raw wire ``bytes`` — the byte-plane match path consumes them
+    without a decode/re-encode round trip; codec-layer NUL/UTF-8
+    rejection is preserved by ``decode_topic_bytes``. Client-side
+    decoders keep str topics (application surface)."""
     v5 = protocol_level >= PROTOCOL_MQTT5
     if ptype == PacketType.CONNECT:
         return _decode_connect(body)
@@ -173,7 +189,10 @@ def decode_packet(ptype: int, flags: int, body: bytes, protocol_level: int):
         qos = (flags >> 1) & 0x03
         if qos == 3:
             raise MalformedPacket("invalid QoS 3")
-        topic, pos = decode_string(body, 0)
+        if raw_pub_topic:
+            topic, pos = decode_topic_bytes(body, 0)
+        else:
+            topic, pos = decode_string(body, 0)
         packet_id = None
         if qos > 0:
             packet_id = _read_u16(body, pos)
@@ -351,9 +370,11 @@ class StreamDecoder:
     """
 
     def __init__(self, protocol_level: int = 4,
-                 max_packet_size: int = 1 << 20) -> None:
+                 max_packet_size: int = 1 << 20,
+                 raw_pub_topic: bool = False) -> None:
         self.protocol_level = protocol_level
         self.max_packet_size = max_packet_size
+        self.raw_pub_topic = raw_pub_topic
         self._buf = bytearray()
 
     def feed(self, data: bytes) -> List:
@@ -395,7 +416,8 @@ class StreamDecoder:
                 pkt = _decode_connect(body)
                 self.protocol_level = pkt.protocol_level
             else:
-                pkt = decode_packet(ptype, flags, body, level)
+                pkt = decode_packet(ptype, flags, body, level,
+                                    raw_pub_topic=self.raw_pub_topic)
         except (IndexError, struct.error) as e:
             raise MalformedPacket(f"truncated packet body: {e}") from e
         return pkt, pos + length

@@ -197,11 +197,31 @@ def decode_varint(buf: bytes, pos: int) -> Tuple[int, int]:
     raise MalformedPacket("varint too long")
 
 
-def encode_string(s: str) -> bytes:
-    raw = s.encode("utf-8")
+def encode_string(s) -> bytes:
+    # ISSUE 12 byte plane: already-encoded wire bytes pass through
+    # without a str round trip (loopback/bridged publishes)
+    raw = s.encode("utf-8") if isinstance(s, str) else s
     if len(raw) > 65535:
         raise MalformedPacket("string too long")
     return struct.pack(">H", len(raw)) + raw
+
+
+def decode_topic_bytes(buf: bytes, pos: int) -> Tuple[bytes, int]:
+    """PUBLISH topic as RAW WIRE BYTES (ISSUE 12, ROADMAP ingest
+    follow-up (c)): the byte plane consumes them without a decode →
+    re-encode round trip. Codec-layer semantics are preserved exactly —
+    NUL and invalid UTF-8 still raise ``MalformedPacket`` here — but the
+    str only materializes later, at boundaries that need text. Pure
+    ASCII (the overwhelming majority) never decodes at all."""
+    raw, pos = decode_binary(buf, pos)
+    if b"\x00" in raw:
+        raise MalformedPacket("NUL in utf-8 string")
+    if not raw.isascii():
+        try:
+            raw.decode("utf-8")     # validation only; bytes flow onward
+        except UnicodeDecodeError as e:
+            raise MalformedPacket("invalid utf-8") from e
+    return raw, pos
 
 
 def decode_string(buf: bytes, pos: int) -> Tuple[str, int]:

@@ -601,10 +601,16 @@ class Session:
             self.events.report(Event(
                 EventType.MALFORMED_TOPIC if bad_utf8
                 else EventType.INVALID_TOPIC,
-                self.client_info.tenant_id, {"topic": topic}))
+                self.client_info.tenant_id,
+                {"topic": topic_util.to_str(topic)}))
             await self.conn.protocol_error(
                 "invalid topic", ReasonCode.TOPIC_NAME_INVALID)
             return
+        # ISSUE 12 byte plane: ``topic`` may be raw wire bytes (server
+        # ingress keeps them for the match path — byte cache keys, zero
+        # re-encode in TopicBytes); text boundaries (events, SPI plugins,
+        # span tags, retain) share THIS one decode
+        topic_s = topic_util.to_str(topic)
         if p.qos > ts[Setting.MaximumQoS]:
             await self.conn.protocol_error(
                 "QoS not supported", ReasonCode.QOS_NOT_SUPPORTED)
@@ -635,7 +641,7 @@ class Session:
                 TenantResourceType.TOTAL_INGRESS_BYTES_PER_SECOND):
             self.events.report(Event(EventType.OUT_OF_TENANT_RESOURCE,
                                      self.client_info.tenant_id,
-                                     {"topic": topic,
+                                     {"topic": topic_s,
                                       "resource": "ingress_bytes"}))
             if p.qos == 1:
                 await self.conn.send(pk.PubAck(
@@ -646,17 +652,17 @@ class Session:
                     packet_id=p.packet_id,
                     reason_code=ReasonCode.QUOTA_EXCEEDED))
             return
-        allowed = await self._check_permission(MQTTAction.PUB, topic)
+        allowed = await self._check_permission(MQTTAction.PUB, topic_s)
         if not allowed:
             self.events.report(Event(EventType.PUB_ACTION_DISALLOW,
                                      self.client_info.tenant_id,
-                                     {"topic": topic}))
+                                     {"topic": topic_s}))
             if self.protocol_level < PROTOCOL_MQTT5 and p.qos > 0:
                 # MQTT3 acks cannot convey an error: the reference closes
                 # the channel instead (NoPubPermission close event)
                 self.events.report(Event(EventType.NO_PUB_PERMISSION,
                                          self.client_info.tenant_id,
-                                         {"topic": topic}))
+                                         {"topic": topic_s}))
                 await self.conn.disconnect_with(0)
             elif p.qos == 1:
                 await self.conn.send(pk.PubAck(
@@ -706,7 +712,7 @@ class Session:
         hlc_now = HLC.INST.get()
         try:
             extra = tuple(self.user_props_customizer.inbound(
-                topic, p.qos, p.payload, self.client_info, hlc_now))
+                topic_s, p.qos, p.payload, self.client_info, hlc_now))
         except Exception:  # noqa: BLE001 — SPI failure must not drop the pub
             log.exception("user-props customizer inbound failed")
             extra = ()
@@ -718,7 +724,7 @@ class Session:
                       payload_format_indicator=pfi)
         self.events.report(Event(EventType.PUB_RECEIVED,
                                  self.client_info.tenant_id,
-                                 {"topic": topic, "qos": p.qos}))
+                                 {"topic": topic_s, "qos": p.qos}))
         # ISSUE 2: the publish→match→deliver ROOT span — the per-tenant
         # sampling draw for the whole distributed trace happens here; the
         # "ingest" stage histogram records regardless of sampling.
@@ -727,15 +733,16 @@ class Session:
         t0 = time.monotonic()
         try:
             with trace.span("pub.ingest", tenant=self.client_info.tenant_id,
-                            topic=topic, qos=p.qos):
-                await self._ingest_publish(p, topic, msg)
+                            topic=topic_s, qos=p.qos):
+                await self._ingest_publish(p, topic, msg,
+                                           topic_s=topic_s)
         finally:
             dt = time.monotonic() - t0
             STAGES.record("ingest", dt)
             OBS.record_latency(self.client_info.tenant_id, "ingest", dt)
 
-    async def _ingest_publish(self, p: pk.Publish, topic: str,
-                              msg: Message) -> None:
+    async def _ingest_publish(self, p: pk.Publish, topic,
+                              msg: Message, topic_s: str = None) -> None:
         """Retain + dist + ack — the traced tail of ``_on_publish``.
 
         ISSUE 7 overload discipline: under device-pipeline overload
@@ -747,6 +754,8 @@ class Session:
         publisher) so at-least-once work cannot queue without bound.
         """
         from ..resilience.device import INGEST_GATE, SHEDDER
+        if topic_s is None:
+            topic_s = topic_util.to_str(topic)
         ts = self.settings
         if p.retain and self.retain_service is not None:
             if ts[Setting.RetainEnabled]:
@@ -755,11 +764,12 @@ class Session:
                 # retain-store write (dropping it would leave stale
                 # retained payloads long after the overload clears), and
                 # the write costs no device match
-                await self.retain_service.retain(self.client_info, topic, msg)
+                await self.retain_service.retain(self.client_info, topic_s,
+                                                 msg)
         if p.qos == 0 and SHEDDER.should_shed(self.client_info.tenant_id):
             self.events.report(Event(
                 EventType.SHED_QOS0, self.client_info.tenant_id,
-                {"topic": topic, "reason": "overload"}))
+                {"topic": topic_s, "reason": "overload"}))
             return
         try:
             if p.qos > 0:
@@ -778,7 +788,7 @@ class Session:
             self.events.report(Event(
                 (EventType.QOS0_DIST_ERROR, EventType.QOS1_DIST_ERROR,
                  EventType.QOS2_DIST_ERROR)[p.qos],
-                self.client_info.tenant_id, {"topic": topic}))
+                self.client_info.tenant_id, {"topic": topic_s}))
             if p.qos == 2:
                 # forget the undistributed publish on EVERY version —
                 # otherwise a v3 retry hits the duplicate guard, gets a

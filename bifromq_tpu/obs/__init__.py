@@ -129,6 +129,17 @@ class ObsHub:
         stream operators already collect."""
         self.detector.events = collector
 
+    def register_pub_cache(self, cache) -> None:
+        """ISSUE 12: the dist service registers its pub-side match cache
+        so the gossip digest can ship the node's hot (tenant, topic) key
+        set — a failover target pre-warms against it before taking
+        traffic. Weakly held: a torn-down service must not pin its cache."""
+        self._pub_cache_ref = weakref.ref(cache)
+
+    def pub_cache(self):
+        ref = getattr(self, "_pub_cache_ref", None)
+        return ref() if ref is not None else None
+
     def bind_registry(self, registry) -> None:
         """Weakly remember the metrics registry so exporter snapshots can
         include the monotonic per-tenant counters."""

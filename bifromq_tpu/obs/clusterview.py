@@ -238,8 +238,19 @@ class ClusterView:
             # ISSUE 8: compact capacity accounting rides the digest so
             # GET /cluster/capacity federates with no extra RPC plane
             "capacity": self._capacity_field(),
+            # ISSUE 12: this node's hot (tenant, topic) working set — a
+            # failover target pre-warms its match cache against the
+            # cluster's union of these BEFORE taking traffic
+            "hot_topics": self._hot_topics(),
         }
         return digest
+
+    def _hot_topics(self) -> list:
+        try:
+            cache = self.hub.pub_cache()
+            return cache.hot_keys(16) if cache is not None else []
+        except Exception:  # noqa: BLE001 — telemetry must not raise
+            return []
 
     def _capacity_field(self) -> dict:
         try:

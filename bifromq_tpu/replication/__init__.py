@@ -1,0 +1,82 @@
+"""Patch-delta replication & exact invalidation fabric (ISSUE 12).
+
+PR 9 proved every subscription mutation applies to the device-resident
+matcher as a sub-millisecond narrow patch (p99 0.90ms) while a failover
+target still paid the full 28.9s automaton rebuild. This package closes
+that gap: the SAME patch plans the leader folds into its own
+``PatchableTrie`` arenas are serialized as versioned, HLC-stamped,
+idempotent delta records and streamed to replicas — a warm standby at
+10M subs becomes a stream of kilobyte row-scatters, never a recompile
+(TrieJax's relational-table framing of the automaton is exactly the
+representation whose deltas are tiny, orderable row writes; Tailwind's
+discipline says host↔device state moves as incremental plans, not bulk
+re-uploads).
+
+Three legs share one stream:
+
+- **raft followers** already apply every route mutation through the
+  coproc apply stream and patch their own arenas in place (PR 9); their
+  hubs re-export the apply stream so ANY replica can feed downstream
+  consumers.
+- **warm standbys** (:class:`~bifromq_tpu.replication.standby.WarmStandby`)
+  attach over the PR 1/2 RPC fabric: one bounded resync ships the host
+  arenas (``repl_base`` — bytes, not a recompile), then every mutation
+  arrives as a :class:`~bifromq_tpu.models.automaton.PatchPlan` row
+  scatter applied with zero rebuilds and zero match-cache generation
+  bumps. A sequence gap or a compaction barrier (new epoch, possibly a
+  new salt) degrades to another bounded resync.
+- **remote pub caches**: the same records carry exact
+  ``(tenant, filter)`` invalidations, so a frontend's ``DistService``
+  match cache evicts exactly what changed within one delta RTT instead
+  of waiting out its TTL (the TTL survives only as a backstop for
+  stream loss).
+
+Module map: ``records`` (wire codecs), ``stream`` (per-range
+``DeltaLog`` + ``ReplicationHub``), ``standby`` (``WarmStandby`` +
+``InvalidationPuller``). ``GET /replication`` serves
+:func:`status_report`.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Dict, List
+
+_HUBS: "weakref.WeakSet" = weakref.WeakSet()
+_STANDBYS: "weakref.WeakSet" = weakref.WeakSet()
+_PULLERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_hub(hub) -> None:
+    _HUBS.add(hub)
+
+
+def register_standby(standby) -> None:
+    _STANDBYS.add(standby)
+
+
+def register_puller(puller) -> None:
+    _PULLERS.add(puller)
+
+
+def status_report() -> Dict[str, List[dict]]:
+    """Everything this process knows about the fabric — leader-side
+    per-range stream heads, standby cursors/lag, puller cursors — for
+    ``GET /replication``."""
+    from ..utils.metrics import REPLICATION
+
+    def drain(group):
+        out = []
+        for item in list(group):
+            try:
+                out.append(item.status())
+            except Exception:  # noqa: BLE001 — introspection must not raise
+                continue
+        return out
+
+    return {
+        "hubs": drain(_HUBS),
+        "standbys": drain(_STANDBYS),
+        "pullers": drain(_PULLERS),
+        "counters": REPLICATION.snapshot(),
+    }

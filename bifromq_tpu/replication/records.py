@@ -1,0 +1,415 @@
+"""Wire codecs of the replication fabric (ISSUE 12).
+
+Three payload families, all struct-framed like the rest of the fabric
+(one ``_len16`` string framing, u32 ``_frame`` blobs):
+
+- **delta records** — one per applied route mutation: versioned,
+  HLC-stamped, ``(origin, range, epoch, seq)``-addressed. A record
+  carries the LOGICAL op (the route add/remove — what a standby's
+  authoritative tries and the exact cache invalidation need) and, when
+  the leader folded the op as an in-place patch, the PHYSICAL
+  :class:`~bifromq_tpu.models.automaton.PatchPlan` (the row-scatter
+  write set a byte-identical replica arena applies without re-running
+  descent or hashing). Ops the leader's patcher declined ship op-only
+  with ``fallback`` set — the replica serves them from its overlay,
+  exactly like the leader does.
+- **patch plans** — node-row absolutes + deterministic edge upserts +
+  ordered slot writes (numpy column blobs; kilobytes per record).
+- **base snapshots** — the bounded-resync payload: the leader's host
+  arenas verbatim (node/edge/child tables, matchings, tombstone kinds,
+  tenant roots) plus the authoritative ``(tenant, route)`` set so the
+  standby rebuilds its host-oracle tries without a DFS compile.
+
+Idempotency: plan application is state-absolute and the applier's
+``(epoch, seq)`` cursor drops re-deliveries, so every record may be
+applied at-least-once safely.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models.automaton import (NODE_COLS, GroupMatching, Matching,
+                                PatchableTrie, PatchPlan)
+from ..models.oracle import Route, SubscriptionTrie
+from ..rpc.fabric import _len16, _read16
+from ..types import RouteMatcher
+from ..utils import topic as topic_util
+
+WIRE_VERSION = 1
+
+# record kinds
+REC_PATCH = 1
+
+# record flags
+_F_FALLBACK = 1
+_F_HAS_OP = 2
+_F_HAS_PLAN = 4
+
+
+# ONE route codec and ONE u32 framing for the whole dist plane — owned
+# by dist/worker.py (dist/remote.py imports the same); worker's
+# module-level imports never touch this package (its ReplicationHub
+# import is lazy inside DistWorker.__init__), so this is cycle-free.
+from ..dist.worker import (_dec_route, _enc_route, _frame,  # noqa: E402
+                           _read_frame)
+
+
+def _enc_matching(m: Matching) -> bytes:
+    if isinstance(m, GroupMatching):
+        out = bytearray(b"G")
+        out += _len16(m.mqtt_topic_filter.encode())
+        out.append(1 if m.ordered else 0)
+        out += struct.pack(">I", len(m.members))
+        for r in m.members:
+            out += _enc_route(r)
+        return bytes(out)
+    return b"N" + _enc_route(m)
+
+
+def _dec_matching(buf: bytes, pos: int) -> Tuple[Matching, int]:
+    kind = buf[pos:pos + 1]
+    pos += 1
+    if kind == b"G":
+        tf, pos = _read16(buf, pos)
+        ordered = bool(buf[pos])
+        pos += 1
+        n = struct.unpack_from(">I", buf, pos)[0]
+        pos += 4
+        members = []
+        for _ in range(n):
+            r, pos = _dec_route(buf, pos)
+            members.append(r)
+        return GroupMatching(mqtt_topic_filter=tf.decode(),
+                             ordered=ordered,
+                             members=tuple(members)), pos
+    r, pos = _dec_route(buf, pos)
+    return r, pos
+
+
+# ------------------------------- logical ops --------------------------------
+
+def encode_op(op: Tuple) -> bytes:
+    """The matcher's log-op tuple forms, verbatim (they are also what
+    ``TpuMatcher._overlay_record`` consumes on the replica side)."""
+    if op[0] == "add":
+        _, tenant, route = op
+        return b"A" + _len16(tenant.encode()) + _enc_route(route)
+    _, tenant, matcher, url, inc = op
+    return (b"R" + _len16(tenant.encode())
+            + _len16(matcher.mqtt_topic_filter.encode())
+            + struct.pack(">I", url[0]) + _len16(url[1].encode())
+            + _len16(url[2].encode()) + struct.pack(">q", inc))
+
+
+def decode_op(buf: bytes) -> Tuple:
+    kind = buf[:1]
+    tenant, pos = _read16(buf, 1)
+    if kind == b"A":
+        route, pos = _dec_route(buf, pos)
+        return ("add", tenant.decode(), route)
+    tf, pos = _read16(buf, pos)
+    broker = struct.unpack_from(">I", buf, pos)[0]
+    pos += 4
+    recv, pos = _read16(buf, pos)
+    dk, pos = _read16(buf, pos)
+    inc = struct.unpack_from(">q", buf, pos)[0]
+    return ("rm", tenant.decode(), RouteMatcher.from_topic_filter(
+        tf.decode()), (broker, recv.decode(), dk.decode()), inc)
+
+
+# ------------------------------- patch plans --------------------------------
+
+def encode_plan(plan: PatchPlan) -> bytes:
+    out = bytearray(struct.pack(
+        ">IIIiII", plan.n_live_after, plan.node_cap_after,
+        plan.n_slots_after, plan.dead_delta, plan.garbage_delta,
+        plan.relocations))
+    out += struct.pack(">H", len(plan.tenant_roots))
+    for tenant, root in plan.tenant_roots.items():
+        out += _len16(tenant.encode()) + struct.pack(">I", root)
+    es = np.asarray(plan.edge_sets, dtype=np.int32).reshape(-1, 4)
+    out += _frame(np.ascontiguousarray(es).tobytes())
+    out += struct.pack(">H", len(plan.edge_levels))
+    for nid, h1, h2, level in plan.edge_levels:
+        out += struct.pack(">iii", nid, h1, h2) + _len16(level.encode())
+    ps = np.asarray(plan.parent_sets, dtype=np.int32).reshape(-1, 2)
+    out += _frame(np.ascontiguousarray(ps).tobytes())
+    out += struct.pack(">I", len(plan.slot_ops))
+    for sop in plan.slot_ops:
+        if sop[0] == "set":
+            out += b"S" + struct.pack(">I", sop[1]) + _enc_matching(sop[2])
+        else:
+            out += b"K" + struct.pack(">I", sop[1])
+    idx = np.asarray([nid for nid, _ in plan.node_rows], dtype=np.int32)
+    rows = (np.stack([row for _, row in plan.node_rows])
+            if plan.node_rows else np.zeros((0, NODE_COLS), dtype=np.int32))
+    out += _frame(idx.tobytes())
+    out += _frame(np.ascontiguousarray(rows.astype(np.int32)).tobytes())
+    return bytes(out)
+
+
+def decode_plan(buf: bytes) -> PatchPlan:
+    (n_live, cap, n_slots, dead_d, garb_d,
+     reloc) = struct.unpack_from(">IIIiII", buf, 0)
+    pos = 24
+    plan = PatchPlan(n_live_after=n_live, node_cap_after=cap,
+                     n_slots_after=n_slots, dead_delta=dead_d,
+                     garbage_delta=garb_d, relocations=reloc)
+    (n_roots,) = struct.unpack_from(">H", buf, pos)
+    pos += 2
+    for _ in range(n_roots):
+        tenant, pos = _read16(buf, pos)
+        (root,) = struct.unpack_from(">I", buf, pos)
+        pos += 4
+        plan.tenant_roots[tenant.decode()] = root
+    es_b, pos = _read_frame(buf, pos)
+    es = np.frombuffer(es_b, dtype=np.int32).reshape(-1, 4)
+    plan.edge_sets = [tuple(int(v) for v in row) for row in es]
+    (n_lvls,) = struct.unpack_from(">H", buf, pos)
+    pos += 2
+    for _ in range(n_lvls):
+        nid, h1, h2 = struct.unpack_from(">iii", buf, pos)
+        pos += 12
+        level, pos = _read16(buf, pos)
+        plan.edge_levels.append((nid, h1, h2, level.decode()))
+    ps_b, pos = _read_frame(buf, pos)
+    ps = np.frombuffer(ps_b, dtype=np.int32).reshape(-1, 2)
+    plan.parent_sets = [tuple(int(v) for v in row) for row in ps]
+    (n_slot_ops,) = struct.unpack_from(">I", buf, pos)
+    pos += 4
+    for _ in range(n_slot_ops):
+        tag = buf[pos:pos + 1]
+        pos += 1
+        (s,) = struct.unpack_from(">I", buf, pos)
+        pos += 4
+        if tag == b"S":
+            m, pos = _dec_matching(buf, pos)
+            plan.slot_ops.append(("set", s, m))
+        else:
+            plan.slot_ops.append(("kill", s))
+    idx_b, pos = _read_frame(buf, pos)
+    rows_b, pos = _read_frame(buf, pos)
+    idx = np.frombuffer(idx_b, dtype=np.int32)
+    rows = np.frombuffer(rows_b, dtype=np.int32).reshape(-1, NODE_COLS)
+    plan.node_rows = [(int(i), rows[k].copy())
+                      for k, i in enumerate(idx)]
+    plan.node_ids = set(int(i) for i in idx)
+    return plan
+
+
+# ------------------------------- delta records ------------------------------
+
+@dataclass
+class DeltaRecord:
+    """One versioned, HLC-stamped stream element (see module docstring)."""
+
+    origin: str
+    range_id: str
+    epoch: int
+    seq: int
+    hlc: int
+    tenant: str
+    filter_levels: Tuple[str, ...]
+    op: Optional[Tuple] = None
+    plan: Optional[PatchPlan] = None
+    fallback: bool = False
+    version: int = WIRE_VERSION
+    # lazily memoized wire forms (every subscriber fetch re-serves them)
+    _wire: Dict[bool, bytes] = field(default_factory=dict, repr=False)
+
+    def encoded(self, inval_only: bool = False) -> bytes:
+        w = self._wire.get(inval_only)
+        if w is None:
+            w = encode_record(self, inval_only=inval_only)
+            self._wire[inval_only] = w
+        return w
+
+
+def encode_record(rec: DeltaRecord, *, inval_only: bool = False) -> bytes:
+    flags = (_F_FALLBACK if rec.fallback else 0)
+    op_b = plan_b = b""
+    if not inval_only:
+        if rec.op is not None:
+            flags |= _F_HAS_OP
+            op_b = encode_op(rec.op)
+        if rec.plan is not None:
+            flags |= _F_HAS_PLAN
+            plan_b = encode_plan(rec.plan)
+    out = bytearray([REC_PATCH, rec.version, flags])
+    out += _len16(rec.origin.encode())
+    out += _len16(rec.range_id.encode())
+    out += struct.pack(">IQQ", rec.epoch, rec.seq, rec.hlc)
+    out += _len16(rec.tenant.encode())
+    out += _len16(topic_util.DELIMITER.join(rec.filter_levels).encode())
+    out += _frame(op_b)
+    out += _frame(plan_b)
+    return bytes(out)
+
+
+def decode_record(buf: bytes, pos: int = 0) -> Tuple[DeltaRecord, int]:
+    kind, version, flags = buf[pos], buf[pos + 1], buf[pos + 2]
+    assert kind == REC_PATCH, kind
+    pos += 3
+    origin, pos = _read16(buf, pos)
+    range_id, pos = _read16(buf, pos)
+    epoch, seq, hlc = struct.unpack_from(">IQQ", buf, pos)
+    pos += 20
+    tenant, pos = _read16(buf, pos)
+    filt, pos = _read16(buf, pos)
+    op_b, pos = _read_frame(buf, pos)
+    plan_b, pos = _read_frame(buf, pos)
+    return DeltaRecord(
+        origin=origin.decode(), range_id=range_id.decode(),
+        epoch=epoch, seq=seq, hlc=hlc, tenant=tenant.decode(),
+        filter_levels=(tuple(filt.decode().split(topic_util.DELIMITER))
+                       if filt else ()),
+        op=decode_op(op_b) if flags & _F_HAS_OP else None,
+        plan=decode_plan(plan_b) if flags & _F_HAS_PLAN else None,
+        fallback=bool(flags & _F_FALLBACK), version=version), pos
+
+
+# ------------------------------ base snapshots ------------------------------
+
+def _iter_trie_routes(trie: SubscriptionTrie):
+    stack = [trie._root]
+    while stack:
+        node = stack.pop()
+        yield from node.routes.values()
+        for members in node.groups.values():
+            yield from members.values()
+        stack.extend(node.children.values())
+
+
+@dataclass
+class BaseSnapshot:
+    """Decoded ``repl_base`` payload: the leader's arenas + route set."""
+
+    salt: int
+    probe_len: int
+    max_levels: int
+    n_live: int
+    node_tab: np.ndarray
+    edge_tab: np.ndarray
+    child_list: np.ndarray
+    slot_kind: np.ndarray
+    matchings: List[Matching]
+    tenant_root: Dict[str, int]
+    dead_slots: int
+    garbage_slots: int
+    routes: Dict[str, List[Route]]
+
+    def to_trie(self) -> PatchableTrie:
+        return PatchableTrie.from_arenas(
+            node_tab=self.node_tab, n_live=self.n_live,
+            edge_tab=self.edge_tab, child_list=self.child_list,
+            matchings=self.matchings, slot_kind=self.slot_kind,
+            tenant_root=self.tenant_root, salt=self.salt,
+            probe_len=self.probe_len, max_levels=self.max_levels,
+            dead_slots=self.dead_slots, garbage_slots=self.garbage_slots)
+
+    def to_tries(self) -> Dict[str, SubscriptionTrie]:
+        out: Dict[str, SubscriptionTrie] = {}
+        for tenant, routes in self.routes.items():
+            trie = out.setdefault(tenant, SubscriptionTrie())
+            for r in routes:
+                trie.add(r)
+        return out
+
+
+def encode_base(pt: PatchableTrie,
+                tries: Dict[str, SubscriptionTrie]) -> bytes:
+    """Serialize the leader's host arenas + authoritative route set (the
+    bounded resync: bytes ship, nothing recompiles)."""
+    out = bytearray([WIRE_VERSION])
+    out += struct.pack(">qII", pt.salt, pt.probe_len, pt.max_levels)
+    out += struct.pack(">II", pt.n_live, pt.node_tab.shape[0])
+    out += _frame(np.ascontiguousarray(pt.node_tab,
+                                       dtype=np.int32).tobytes())
+    out += struct.pack(">II", pt.edge_tab.shape[0], pt.edge_tab.shape[1])
+    out += _frame(np.ascontiguousarray(pt.edge_tab,
+                                       dtype=np.int32).tobytes())
+    out += _frame(np.ascontiguousarray(pt.child_list,
+                                       dtype=np.int32).tobytes())
+    n_slots = len(pt.matchings)
+    out += struct.pack(">I", n_slots)
+    out += _frame(np.ascontiguousarray(pt.slot_kind,
+                                       dtype=np.int8).tobytes())
+    for m in pt.matchings:
+        out += _frame(_enc_matching(m))
+    out += struct.pack(">I", len(pt.tenant_root))
+    for tenant, root in pt.tenant_root.items():
+        out += _len16(tenant.encode()) + struct.pack(">I", root)
+    out += struct.pack(">II", pt.dead_slots, pt.garbage_slots)
+    # u32 tenant counts: the "millions of users" story must not cap the
+    # resync at 65535 tenants
+    out += struct.pack(">I", len(tries))
+    for tenant, trie in tries.items():
+        routes = list(_iter_trie_routes(trie))
+        out += _len16(tenant.encode()) + struct.pack(">I", len(routes))
+        for r in routes:
+            out += _enc_route(r)
+    return bytes(out)
+
+
+def decode_base(buf: bytes) -> BaseSnapshot:
+    assert buf[0] == WIRE_VERSION, buf[0]
+    salt, probe_len, max_levels = struct.unpack_from(">qII", buf, 1)
+    pos = 17
+    n_live, cap = struct.unpack_from(">II", buf, pos)
+    pos += 8
+    nt_b, pos = _read_frame(buf, pos)
+    node_tab = np.frombuffer(nt_b, dtype=np.int32).reshape(cap, -1).copy()
+    nb, plen = struct.unpack_from(">II", buf, pos)
+    pos += 8
+    et_b, pos = _read_frame(buf, pos)
+    edge_tab = np.frombuffer(et_b, dtype=np.int32).reshape(
+        nb, plen, 4).copy()
+    cl_b, pos = _read_frame(buf, pos)
+    child_list = np.frombuffer(cl_b, dtype=np.int32).copy()
+    (n_slots,) = struct.unpack_from(">I", buf, pos)
+    pos += 4
+    sk_b, pos = _read_frame(buf, pos)
+    slot_kind = np.frombuffer(sk_b, dtype=np.int8).copy()
+    matchings: List[Matching] = []
+    for _ in range(n_slots):
+        m_b, pos = _read_frame(buf, pos)
+        m, _ = _dec_matching(m_b, 0)
+        matchings.append(m)
+    (n_roots,) = struct.unpack_from(">I", buf, pos)
+    pos += 4
+    tenant_root: Dict[str, int] = {}
+    for _ in range(n_roots):
+        tenant, pos = _read16(buf, pos)
+        (root,) = struct.unpack_from(">I", buf, pos)
+        pos += 4
+        tenant_root[tenant.decode()] = root
+    dead, garbage = struct.unpack_from(">II", buf, pos)
+    pos += 8
+    (n_tenants,) = struct.unpack_from(">I", buf, pos)
+    pos += 4
+    routes: Dict[str, List[Route]] = {}
+    for _ in range(n_tenants):
+        tenant, pos = _read16(buf, pos)
+        (n,) = struct.unpack_from(">I", buf, pos)
+        pos += 4
+        lst = []
+        for _ in range(n):
+            r, pos = _dec_route(buf, pos)
+            lst.append(r)
+        routes[tenant.decode()] = lst
+    return BaseSnapshot(
+        salt=salt, probe_len=probe_len, max_levels=max_levels,
+        n_live=n_live, node_tab=node_tab, edge_tab=edge_tab,
+        child_list=child_list, slot_kind=slot_kind, matchings=matchings,
+        tenant_root=tenant_root, dead_slots=dead, garbage_slots=garbage,
+        routes=routes)
+
+
+__all__ = ["DeltaRecord", "BaseSnapshot", "encode_record", "decode_record",
+           "encode_op", "decode_op", "encode_plan", "decode_plan",
+           "encode_base", "decode_base", "REC_PATCH", "WIRE_VERSION"]

@@ -1,0 +1,473 @@
+"""Consumer side of the replication fabric (ISSUE 12).
+
+``WarmStandby`` keeps an exact, device-resident replica of one range's
+matcher at patch-stream cost: one bounded resync ships the leader's host
+arenas (``repl_base`` — bytes, never a recompile), then every mutation
+arrives as a :class:`~bifromq_tpu.models.automaton.PatchPlan` row
+scatter applied in sub-millisecond host time and flushed to the
+replica's own device as the SAME narrow scatters the leader used. The
+logical op riding each record keeps the standby's authoritative tries —
+its exact host oracle — in lockstep, and the ``(tenant, filter)`` pair
+evicts exactly the affected match-cache keys (no generation bumps, no
+TTL). A sequence gap, an epoch anchor (leader compaction/rebuild/reset)
+or a reorder-buffer overflow degrades to another bounded resync.
+
+``InvalidationPuller`` is the cache-only consumer: a frontend whose
+dist-worker is remote long-polls ``repl_inval`` on every worker endpoint
+and applies exact invalidations to its pub-side match cache within one
+delta RTT — the TTL that used to bound cross-node staleness survives
+only as the backstop for stream loss (a gap degrades to one wholesale
+bump, exactly what an expired TTL would have done eventually).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import trace
+from ..models.automaton import PatchableTrie
+from ..rpc.fabric import _len16, _read16
+from ..utils.env import env_float, env_int
+from ..utils.metrics import REPLICATION, STAGES
+from . import register_puller, register_standby
+from .records import BaseSnapshot, DeltaRecord, decode_base, decode_record
+
+log = logging.getLogger(__name__)
+
+SERVICE = "dist-worker"
+
+# repl_fetch / repl_base response status codes
+ST_OK = 0
+ST_GAP = 1
+ST_ANCHOR = 2
+ST_NO_RANGE = 3
+ST_UNSUPPORTED = 4
+
+_ST_NAMES = {ST_OK: "ok", ST_GAP: "gap", ST_ANCHOR: "anchor",
+             ST_NO_RANGE: "no_range", ST_UNSUPPORTED: "unsupported"}
+
+
+def repl_poll_s() -> float:
+    """Long-poll window of the fetch/inval RPCs — the server returns the
+    moment records exist, so this bounds idle RPC churn, not latency."""
+    return max(0.05, env_float("BIFROMQ_REPL_POLL_S", 1.0))
+
+
+def repl_reorder_cap() -> int:
+    """Out-of-order records parked waiting for their predecessor before
+    the applier gives up and resyncs."""
+    return max(4, env_int("BIFROMQ_REPL_REORDER_CAP", 256))
+
+
+class WarmStandby:
+    """N-th exact replica of a range's matcher at kilobyte-stream cost.
+
+    The transport is injectable (``fetch_fn``/``base_fn``/``ranges_fn``)
+    so the delta-semantics tests drive the applier against an in-process
+    hub; the default implementation rides the PR 1/2 RPC fabric against
+    the ``dist-worker`` service.
+    """
+
+    def __init__(self, registry=None, *, service: str = SERVICE,
+                 range_id: Optional[str] = None, matcher=None,
+                 device=None, endpoint: Optional[str] = None,
+                 fetch_fn=None, base_fn=None, ranges_fn=None) -> None:
+        if matcher is None:
+            from ..models.matcher import TpuMatcher
+            # replica mode: never self-compacts — the leader's anchors
+            # drive every rebase through a bounded resync instead
+            matcher = TpuMatcher(auto_compact=False, device=device)
+        self.matcher = matcher
+        self.registry = registry
+        self.service = service
+        self.range_id = range_id
+        self.origin: Optional[str] = None
+        self.cursor: Tuple[int, int] = (0, 0)
+        self.head: Tuple[int, int] = (0, 0)
+        self.attached = False
+        self.applied = 0
+        self.resyncs = 0
+        self.gaps = 0
+        self.reorders = 0
+        self._pending: Dict[int, DeltaRecord] = {}
+        self._endpoint = endpoint
+        self._fetch_fn = fetch_fn or self._rpc_fetch
+        self._base_fn = base_fn or self._rpc_base
+        self._ranges_fn = ranges_fn or self._rpc_ranges
+        self._task: Optional[asyncio.Task] = None
+        register_standby(self)
+
+    # ---------------- lifecycle --------------------------------------------
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except BaseException:  # noqa: BLE001 — cancellation
+                pass
+
+    def promote(self) -> "object":
+        """Failover: hand the replica matcher over as a serving/mutating
+        matcher. Its arenas, tries and device tables are already warm —
+        promotion is a flag flip, not a rebuild. The sync task is
+        cancelled HERE: a still-running loop would resync from the old
+        leader on its next tick (planned handover, partition heal) and
+        clobber every post-promotion mutation."""
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+        self.matcher.auto_compact = True
+        self.attached = False
+        return self.matcher
+
+    # ---------------- sync loop --------------------------------------------
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self.sync_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — keep pulling
+                log.warning("standby sync failed: %r", e)
+                self.attached = False
+                self._endpoint = None if self.registry is not None \
+                    else self._endpoint
+                await asyncio.sleep(0.5)
+
+    async def sync_once(self) -> None:
+        if self.range_id is None:
+            ranges = await self._ranges_fn()
+            if not ranges:
+                await asyncio.sleep(0.2)
+                return
+            self.range_id = ranges[0]
+        if not self.attached:
+            await self.resync()
+        status, records, head = await self._fetch_fn(
+            self.range_id, self.cursor[0], self.cursor[1], repl_poll_s())
+        self.head = head
+        if status != "ok":
+            self.gaps += 1
+            REPLICATION.inc("gaps")
+            self.attached = False
+            return
+        if records:
+            if not self.offer(records):
+                self.attached = False
+
+    async def resync(self) -> None:
+        """Bounded resync: ship the leader's host arenas + route set and
+        install them verbatim — no DFS, no compile, no generation bump
+        when the salt held."""
+        origin, cursor, snap = await self._base_fn(self.range_id)
+        self._install(snap, cursor)
+        self.origin = origin
+        self.resyncs += 1
+        REPLICATION.inc("resyncs")
+
+    # ---------------- record application -----------------------------------
+
+    def offer(self, records: List[DeltaRecord]) -> bool:
+        """Apply a fetched batch: in-order records apply immediately,
+        out-of-order ones park (bounded) until their predecessor lands,
+        re-deliveries drop on the cursor. Returns False when the batch
+        demands a resync (epoch moved / reorder window overflowed)."""
+        t0 = time.perf_counter()
+        applied0 = self.applied
+        with trace.span("repl.apply", n_records=len(records)):
+            ok = self._offer_inner(records)
+        if self.applied != applied0:
+            STAGES.record("repl.apply", time.perf_counter() - t0)
+            self._flush_device()
+        return ok
+
+    def _offer_inner(self, records: List[DeltaRecord]) -> bool:
+        for rec in records:
+            epoch, seq = self.cursor
+            if rec.epoch != epoch:
+                return False
+            if rec.seq <= seq:
+                continue    # idempotent re-delivery
+            if rec.seq == seq + 1:
+                self._apply(rec)
+                self.cursor = (rec.epoch, rec.seq)
+                while self.cursor[1] + 1 in self._pending:
+                    nxt = self._pending.pop(self.cursor[1] + 1)
+                    self._apply(nxt)
+                    self.cursor = (nxt.epoch, nxt.seq)
+            else:
+                self._pending[rec.seq] = rec
+                self.reorders += 1
+                REPLICATION.inc("reorders")
+                if len(self._pending) > repl_reorder_cap():
+                    return False
+        return True
+
+    def _apply(self, rec: DeltaRecord) -> None:
+        from ..models.matcher import apply_log_op
+        m = self.matcher
+        base = m._base_ct
+        if rec.plan is not None and isinstance(base, PatchableTrie):
+            base.apply_plan(rec.plan)
+        if rec.op is not None:
+            op = rec.op
+            # ONE op→trie definition shared with the leader's shadow
+            # replay; applied to BOTH replicas so the shadow stays a
+            # separate, content-equal copy — post-promotion compaction
+            # compiles from it off-thread while the loop mutates tries
+            apply_log_op(m.tries, op)
+            apply_log_op(m._shadow, op)
+            if rec.fallback or rec.plan is None:
+                # the leader served this op from its overlay (patcher
+                # declined / no patchable base yet): mirror that — the
+                # next anchor's resync folds it into the base here too
+                m._overlay_record(op)
+        if m.match_cache is not None and rec.tenant:
+            # EXACT invalidation: the epoch/generation never bumps on
+            # the replica for a patch-stream record
+            m.match_cache.invalidate(rec.tenant, rec.filter_levels)
+        self.applied += 1
+        REPLICATION.inc("applied")
+
+    def _flush_device(self) -> None:
+        # ship the applied rows to this replica's device as the same
+        # narrow scatters the leader used (hot: after every batch)
+        self.matcher._flush_patches()
+
+    def _install(self, snap: BaseSnapshot, cursor: Tuple[int, int]) -> None:
+        from ..ops.match import DeviceTrie
+        m = self.matcher
+        ct = snap.to_trie()
+        dev = DeviceTrie.from_compiled(ct, device=m.device)
+        prev = m._base_ct
+        m._base_ct = ct
+        m._device_trie = dev
+        m._delta = {}
+        m._tomb = {}
+        m._overlay_n = 0
+        m._log = []
+        # TWO independent copies: tries is the serving oracle the apply
+        # loop mutates; _shadow is the frozen-snapshot source a (post-
+        # promotion) background compaction compiles from OFF-thread —
+        # aliasing them would let the compile thread read dicts the
+        # event loop is mutating
+        m.tries = snap.to_tries()
+        m._shadow = snap.to_tries()
+        if m.match_cache is not None and prev is not None \
+                and getattr(prev, "salt", None) != ct.salt:
+            # only a SALT change (collision recompile upstream) voids
+            # cached results wholesale — a same-salt resync re-anchors
+            # the arenas without touching cache validity
+            m.match_cache.bump_all()
+        self.cursor = cursor
+        self._pending.clear()
+        self.attached = True
+
+    # ---------------- pre-warm (PR 5 digest hot-topic key set) --------------
+
+    def prewarm(self, hot_topics) -> int:
+        """Run the cluster's hot (tenant, topic) keys through this
+        replica's matcher so the failover target's match cache is warm
+        BEFORE it takes traffic. ``hot_topics`` is the digest field:
+        a list of [tenant, topic] pairs."""
+        queries = [(t, topic) for t, topic in hot_topics or ()]
+        if not queries:
+            return 0
+        self.matcher.match_batch(queries)
+        return len(queries)
+
+    def prewarm_from_view(self, view) -> int:
+        """Pull the hot-topic key sets from every peer's gossip digest
+        (PR 5 ClusterView) and pre-warm against them."""
+        keys = []
+        for meta in view.peers(include_self=True).values():
+            keys.extend(meta.get("hot_topics") or ())
+        return self.prewarm(keys)
+
+    # ---------------- default RPC transport --------------------------------
+
+    async def _pick_endpoint(self) -> str:
+        if self._endpoint is not None:
+            return self._endpoint
+        eps = list(self.registry.endpoints(self.service))
+        if not eps:
+            raise RuntimeError(f"no endpoints for {self.service}")
+        self._endpoint = eps[0]
+        return self._endpoint
+
+    async def _rpc_ranges(self) -> List[str]:
+        import json
+        ep = await self._pick_endpoint()
+        out = await self.registry.client_for(ep).call(
+            self.service, "repl_status", b"", timeout=5.0)
+        status = json.loads(out.decode())
+        return [r["range"] for r in status.get("ranges", ())]
+
+    async def _rpc_fetch(self, range_id: str, epoch: int, seq: int,
+                         wait_s: float):
+        ep = await self._pick_endpoint()
+        payload = (_len16(range_id.encode())
+                   + struct.pack(">IQIB", epoch, seq,
+                                 int(wait_s * 1000), 0))
+        out = await self.registry.client_for(ep).call(
+            self.service, "repl_fetch", payload, timeout=wait_s + 5.0)
+        st = out[0]
+        r_epoch, head_seq = struct.unpack_from(">IQ", out, 1)
+        (n,) = struct.unpack_from(">I", out, 13)
+        pos = 17
+        records = []
+        for _ in range(n):
+            blen = struct.unpack_from(">I", out, pos)[0]
+            pos += 4
+            rec, _ = decode_record(out[pos:pos + blen])
+            pos += blen
+            records.append(rec)
+        if records and self.origin is not None \
+                and records[0].origin != self.origin:
+            # the pinned endpoint changed identity (restart / failover):
+            # its arenas are NOT ours — resync
+            return "anchor", [], (r_epoch, head_seq)
+        return _ST_NAMES.get(st, "gap"), records, (r_epoch, head_seq)
+
+    async def _rpc_base(self, range_id: str):
+        ep = await self._pick_endpoint()
+        out = await self.registry.client_for(ep).call(
+            self.service, "repl_base", _len16(range_id.encode()),
+            timeout=30.0)
+        st = out[0]
+        if st != ST_OK:
+            raise RuntimeError(
+                f"repl_base({range_id}): {_ST_NAMES.get(st, st)}")
+        origin, pos = _read16(out, 1)
+        epoch, seq = struct.unpack_from(">IQ", out, pos)
+        pos += 12
+        blen = struct.unpack_from(">I", out, pos)[0]
+        pos += 4
+        snap = decode_base(out[pos:pos + blen])
+        return origin.decode(), (epoch, seq), snap
+
+    # ---------------- introspection ----------------------------------------
+
+    def lag(self) -> int:
+        return max(0, self.head[1] - self.cursor[1]) \
+            if self.head[0] == self.cursor[0] else -1
+
+    def status(self) -> dict:
+        return {"role": "standby", "range": self.range_id,
+                "origin": self.origin, "attached": self.attached,
+                "epoch": self.cursor[0], "seq": self.cursor[1],
+                "head_seq": self.head[1], "lag": self.lag(),
+                "applied": self.applied, "resyncs": self.resyncs,
+                "gaps": self.gaps, "reorders": self.reorders,
+                "rebuilds": self.matcher.compile_count,
+                "overlay": self.matcher.overlay_size}
+
+
+class InvalidationPuller:
+    """Exact pub-cache invalidation for frontends with a REMOTE
+    dist-worker: long-polls ``repl_inval`` on every worker endpoint and
+    applies ``(tenant, filter)`` evictions through the same callback the
+    local apply-stream hook uses. A lost window (gap/anchor/new range)
+    degrades to ONE wholesale bump — the semantics an expired TTL used
+    to provide, minus the wait."""
+
+    def __init__(self, registry, invalidate_cb: Callable, *,
+                 service: str = SERVICE,
+                 wait_s: Optional[float] = None) -> None:
+        self.registry = registry
+        self.invalidate_cb = invalidate_cb
+        self.service = service
+        self.wait_s = wait_s
+        # endpoint -> range -> (epoch, seq)
+        self.cursors: Dict[str, Dict[str, Tuple[int, int]]] = {}
+        self.invalidations = 0
+        self.losses = 0
+        self._task: Optional[asyncio.Task] = None
+        register_puller(self)
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except BaseException:  # noqa: BLE001 — cancellation
+                pass
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                eps = list(self.registry.endpoints(self.service))
+                if not eps:
+                    await asyncio.sleep(0.5)
+                    continue
+                await asyncio.gather(*(self._poll(ep) for ep in eps))
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — keep pulling; the
+                # TTL backstop bounds staleness while the stream is down
+                log.debug("invalidation poll failed: %r", e)
+                await asyncio.sleep(0.5)
+
+    async def _poll(self, ep: str) -> None:
+        wait = self.wait_s if self.wait_s is not None else repl_poll_s()
+        cur = self.cursors.setdefault(ep, {})
+        payload = bytearray(struct.pack(">H", len(cur)))
+        for rid, (epoch, seq) in cur.items():
+            payload += _len16(rid.encode()) + struct.pack(">IQ", epoch, seq)
+        payload += struct.pack(">I", int(wait * 1000))
+        try:
+            out = await self.registry.client_for(ep).call(
+                self.service, "repl_inval", bytes(payload),
+                timeout=wait + 5.0)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — one endpoint down must
+            log.debug("repl_inval(%s) failed: %r", ep, e)  # not stop the rest
+            return
+        lost = out[0]
+        (n_ranges,) = struct.unpack_from(">H", out, 1)
+        pos = 3
+        for _ in range(n_ranges):
+            rid, pos = _read16(out, pos)
+            epoch, head = struct.unpack_from(">IQ", out, pos)
+            pos += 12
+            cur[rid.decode()] = (epoch, head)
+        (n_invals,) = struct.unpack_from(">I", out, pos)
+        pos += 4
+        if lost:
+            # stream loss (gap/anchor/new range): degrade to the TTL's
+            # wholesale semantics, immediately
+            self.losses += 1
+            REPLICATION.inc("gaps")
+            self.invalidate_cb(None, None)
+        for _ in range(n_invals):
+            tenant, pos = _read16(out, pos)
+            filt, pos = _read16(out, pos)
+            self.invalidate_cb(tenant.decode(),
+                               tuple(filt.decode().split("/")))
+            self.invalidations += 1
+            REPLICATION.inc("invalidations")
+
+    def status(self) -> dict:
+        return {"role": "inval-puller", "service": self.service,
+                "endpoints": {ep: {rid: list(c)
+                                   for rid, c in cur.items()}
+                              for ep, cur in self.cursors.items()},
+                "invalidations": self.invalidations,
+                "losses": self.losses}

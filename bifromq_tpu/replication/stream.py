@@ -1,0 +1,155 @@
+"""Leader-side delta stream state (ISSUE 12): per-range ``DeltaLog`` +
+the per-worker ``ReplicationHub``.
+
+The log is a bounded ring of :class:`~.records.DeltaRecord` addressed by
+``(epoch, seq)``: ``seq`` is contiguous within an epoch, so ``since``
+resolves a cursor with index math (no scan) and can tell apart the three
+consumer verdicts —
+
+- ``ok`` — records after the cursor (possibly empty),
+- ``gap`` — the cursor fell behind the ring (records trimmed): the
+  consumer degrades to a bounded resync (``repl_base``), never a
+  recompile,
+- ``anchor`` — the epoch moved (compaction/rebuild/reset re-anchored the
+  stream, possibly at a new salt): arenas renumbered, resync required.
+
+Every record is stamped from the process HLC at append, so cross-node
+application order is causally comparable with the rest of the tracing
+plane.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from itertools import islice
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.env import env_int
+from ..utils.hlc import HLC
+from ..utils.metrics import REPLICATION
+from .records import DeltaRecord
+
+
+def repl_log_cap() -> int:
+    """Ring capacity per range — bounds the window a slow consumer may
+    lag before it degrades to a resync."""
+    return max(64, env_int("BIFROMQ_REPL_LOG_CAP", 8192))
+
+
+class DeltaLog:
+    """Bounded, epoch-anchored record ring for ONE range's stream."""
+
+    def __init__(self, origin: str, range_id: str,
+                 cap: Optional[int] = None) -> None:
+        self.origin = origin
+        self.range_id = range_id
+        # boot-seeded epoch: a restarted worker re-anchors roughly the
+        # same small number of times as its previous life, so a 0-based
+        # epoch would let a pre-restart consumer cursor ALIAS the new
+        # stream (same origin, same epoch, stale seq) and apply plans
+        # recorded against different arenas. HLC-derived seconds make a
+        # cross-incarnation collision require a same-second restart AND
+        # an exactly matching anchor count — and the ahead-cursor gap
+        # check in since() backstops even that.
+        self.epoch = int(HLC.physical(HLC.INST.get()) // 1000) & 0x3FFFFFFF
+        self.next_seq = 1
+        self.anchor_salt: Optional[int] = None
+        self.anchor_reason = ""
+        self.anchor_hlc = 0
+        self._records: deque = deque(maxlen=cap or repl_log_cap())
+        self._lock = threading.Lock()
+
+    def append(self, *, tenant: str, filter_levels, op, plan,
+               fallback: bool) -> DeltaRecord:
+        with self._lock:
+            rec = DeltaRecord(
+                origin=self.origin, range_id=self.range_id,
+                epoch=self.epoch, seq=self.next_seq, hlc=HLC.INST.get(),
+                tenant=tenant, filter_levels=tuple(filter_levels or ()),
+                op=op, plan=plan, fallback=fallback)
+            self.next_seq += 1
+            self._records.append(rec)
+        REPLICATION.inc("records")
+        return rec
+
+    def anchor(self, salt, reason: str) -> None:
+        """Re-anchor the stream (compaction fold / rebuild / reset): the
+        arenas were renumbered — possibly under a NEW salt — so every
+        consumer's cursor is void and the ring restarts at a new epoch."""
+        with self._lock:
+            self.epoch += 1
+            self.next_seq = 1
+            self._records.clear()
+            self.anchor_salt = salt if isinstance(salt, int) else None
+            self.anchor_reason = reason
+            self.anchor_hlc = HLC.INST.get()
+        REPLICATION.inc("anchors")
+
+    def cursor(self) -> Tuple[int, int]:
+        """(epoch, last emitted seq) — what a consistent snapshot taken
+        NOW is current through."""
+        with self._lock:
+            return self.epoch, self.next_seq - 1
+
+    def since(self, epoch: int, after_seq: int
+              ) -> Tuple[str, List[DeltaRecord]]:
+        with self._lock:
+            if epoch != self.epoch:
+                return "anchor", []
+            if after_seq > self.next_seq - 1:
+                # a cursor AHEAD of this stream can only come from a
+                # different incarnation that aliased the epoch — treat
+                # as a gap so the consumer resyncs instead of silently
+                # skipping records until the head catches up
+                return "gap", []
+            if after_seq == self.next_seq - 1:
+                return "ok", []
+            oldest = self.next_seq - len(self._records)
+            if after_seq + 1 < oldest:
+                return "gap", []
+            start = after_seq + 1 - oldest
+            return "ok", list(islice(self._records, start, None))
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"range": self.range_id, "epoch": self.epoch,
+                    "head_seq": self.next_seq - 1,
+                    "ring": len(self._records),
+                    "anchor_reason": self.anchor_reason,
+                    "anchor_salt": self.anchor_salt}
+
+
+class ReplicationHub:
+    """Per-worker registry of range streams; the coproc emit hooks feed
+    it and the RPC fabric (``repl_fetch``/``repl_base``/``repl_inval``)
+    serves from it. Followers populate their own hubs from the raft
+    apply stream, so any replica can feed downstream consumers."""
+
+    def __init__(self, origin: str) -> None:
+        self.origin = origin
+        self.logs: Dict[str, DeltaLog] = {}
+        self._lock = threading.Lock()
+        from . import register_hub
+        register_hub(self)
+
+    def log_for(self, range_id: str) -> DeltaLog:
+        with self._lock:
+            log = self.logs.get(range_id)
+            if log is None:
+                log = self.logs[range_id] = DeltaLog(self.origin, range_id)
+            return log
+
+    def get(self, range_id: str) -> Optional[DeltaLog]:
+        with self._lock:
+            return self.logs.get(range_id)
+
+    def range_ids(self) -> List[str]:
+        with self._lock:
+            return list(self.logs)
+
+    def status(self) -> dict:
+        with self._lock:
+            logs = list(self.logs.values())
+        return {"origin": self.origin, "role": "hub",
+                "ranges": [log.status() for log in logs]}

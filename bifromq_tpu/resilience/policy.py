@@ -101,6 +101,12 @@ _IDEMPOTENT: Set[Tuple[str, str]] = {
     ("dist-worker", "match_batch"),
     ("dist-worker", "node_id"),
     ("dist-worker", "trace_spans"),
+    # ISSUE 12: the replication fabric is read-only + cursor-idempotent
+    # end to end (re-delivered records drop on the applier's seq cursor)
+    ("dist-worker", "repl_fetch"),
+    ("dist-worker", "repl_base"),
+    ("dist-worker", "repl_inval"),
+    ("dist-worker", "repl_status"),
     ("session-dict", "exist"),
     ("session-dict", "clients"),
     ("session-dict", "inbox_state"),

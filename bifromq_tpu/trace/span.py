@@ -113,7 +113,12 @@ class Span:
             "start_ms": HLC.physical(self.start_hlc),
             "duration_ms": round(self.duration_ms, 4),
             "status": self.status,
-            "tags": self.tags,
+            # wire-bytes tag values (ISSUE 12 byte-plane pub path) decode
+            # at this cold export boundary so /trace and the exporter
+            # stay JSON-clean
+            "tags": {k: (v.decode("utf-8", "replace")
+                         if isinstance(v, bytes) else v)
+                     for k, v in self.tags.items()},
         }
         if self.links:
             out["links"] = [{"trace_id": f"{t:016x}",

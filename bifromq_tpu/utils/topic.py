@@ -27,6 +27,19 @@ ORDERED_SHARE = "$oshare"
 
 _PREFIX_UNORDERED_SHARE = UNORDERED_SHARE + DELIMITER
 _PREFIX_ORDERED_SHARE = ORDERED_SHARE + DELIMITER
+# byte twins for the wire-bytes pub path (ISSUE 12)
+_PREFIX_UNORDERED_SHARE_B = _PREFIX_UNORDERED_SHARE.encode()
+_PREFIX_ORDERED_SHARE_B = _PREFIX_ORDERED_SHARE.encode()
+
+
+def to_str(topic) -> str:
+    """Raw wire topic ``bytes`` → ``str`` at a cold boundary (events,
+    delivery packs, retain, span export). The ISSUE 12 byte-plane pub
+    path carries topics as bytes end-to-end; only boundaries that NEED
+    text pay the decode, once."""
+    if isinstance(topic, bytes):
+        return topic.decode("utf-8", "replace")
+    return topic
 
 
 def parse(topic: str, escaped: bool = False) -> List[str]:
@@ -54,8 +67,41 @@ def unescape(topic_filter: str) -> str:
     return topic_filter.replace(NUL, DELIMITER)
 
 
-def is_valid_topic(topic: str, max_level_length: int = 40, max_levels: int = 16,
+def is_valid_topic(topic, max_level_length: int = 40, max_levels: int = 16,
                    max_length: int = 255) -> bool:
+    """See ``_is_valid_topic_str``. ISSUE 12 (ROADMAP ingest follow-up
+    (c)): the pub path hands RAW WIRE BYTES — pure-ASCII topics (the
+    overwhelming majority) validate with C-speed byte scans and never
+    decode; non-ASCII topics decode once here (the length rules are
+    CHARACTER-based, [MQTT-4.7.3-3] counts code points) and still flow
+    onward as bytes."""
+    if isinstance(topic, bytes):
+        if not topic.isascii():
+            try:
+                return _is_valid_topic_str(topic.decode("utf-8"),
+                                           max_level_length, max_levels,
+                                           max_length)
+            except UnicodeDecodeError:
+                return False
+        # ASCII: byte length == char length, so the str rules map 1:1
+        assert max_length <= 65535 and max_level_length <= max_length
+        if not topic or len(topic) > max_length:
+            return False
+        if topic.startswith(_PREFIX_ORDERED_SHARE_B) \
+                or topic.startswith(_PREFIX_UNORDERED_SHARE_B):
+            return False
+        if b"\x00" in topic or b"+" in topic or b"#" in topic:
+            return False
+        if topic.count(b"/") + 1 > max_levels:
+            return False
+        return max(map(len, topic.split(b"/"))) <= max_level_length
+    return _is_valid_topic_str(topic, max_level_length, max_levels,
+                               max_length)
+
+
+def _is_valid_topic_str(topic: str, max_level_length: int = 40,
+                        max_levels: int = 16,
+                        max_length: int = 255) -> bool:
     """Validate a PUBLISH topic name (TopicUtil.isValidTopic, TopicUtil.java:48).
 
     No wildcards, no NUL, bounded total length / level count / level length.
@@ -205,10 +251,17 @@ def matches(topic_levels: List[str], filter_levels: List[str]) -> bool:
     return ti == nt
 
 
-def is_well_formed_utf8(s: str) -> bool:
+def is_well_formed_utf8(s) -> bool:
     """MQTT UTF-8 sanity (≈ UTF8Util.isWellFormed with sanity check on):
     no U+0000, no C0/C1 control characters, no Unicode non-characters
-    [MQTT-1.5.4-1/2]."""
+    [MQTT-1.5.4-1/2]. Wire ``bytes`` (ISSUE 12 pub path) additionally
+    reject undecodable sequences; this check only runs when the
+    SANITY_CHECK_MQTT_UTF8 sysprop is on."""
+    if isinstance(s, bytes):
+        try:
+            s = s.decode("utf-8")
+        except UnicodeDecodeError:
+            return False
     for ch in s:
         cp = ord(ch)
         if cp == 0x0000:

@@ -116,7 +116,9 @@ async def run():
         await m.match_batch_async(
             [("tenant0", f"dev/{j}/y{i}") for j in range(16)])
 asyncio.run(run())
-recs = OBS.profiler.records()[-(OBS.profiler.batches_total - b0):]
+n_new = OBS.profiler.batches_total - b0
+assert n_new > 0, "no device batches recorded in the gate window"
+recs = OBS.profiler.records()[-n_new:]
 assert recs, "no device batches recorded"
 assert all(r.tokenize_s > 0 for r in recs if r.kernel != "oracle"), \
     "a device batch lacked tokenize attribution"

@@ -214,6 +214,25 @@ class TestAPI:
             assert g[k] == stamp[k]
         assert len(g["hash"]) == 16
 
+    async def test_replication_endpoint(self, stack):
+        # ISSUE 12: per-range stream heads + replication counters; the
+        # broker's local dist-worker hosts at least one range's DeltaLog
+        broker, api, _ = stack
+        c = MQTTClient(port=broker.port, client_id="repl1")
+        await c.connect()
+        await c.subscribe("repl/t")     # one route mutation → one record
+        status, out = await http(api.port, "GET", "/replication")
+        assert status == 200
+        assert "counters" in out and "hubs" in out
+        hubs = out["hubs"]
+        assert hubs and any(h["ranges"] for h in hubs)
+        rng = next(h["ranges"][0] for h in hubs if h["ranges"])
+        assert {"range", "epoch", "head_seq"} <= set(rng)
+        status, metrics = await http(api.port, "GET", "/metrics")
+        assert "replication" in metrics
+        assert metrics["replication"]["records"] >= 1
+        await c.disconnect()
+
     async def test_unknown_route(self, stack):
         _, api, _ = stack
         status, _ = await http(api.port, "GET", "/nope")

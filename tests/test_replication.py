@@ -1,0 +1,483 @@
+"""Patch-delta replication & exact invalidation fabric (ISSUE 12).
+
+Delta-stream semantics: idempotent re-apply, out-of-order delivery,
+sequence gap → bounded resync, compaction barrier re-anchor, randomized
+churn parity leader ≡ replica ≡ oracle (arena BYTE parity, not just row
+parity), exact remote invalidation over the RPC fabric, and a
+two-process standby tracking a live dist-worker process.
+"""
+
+import asyncio
+import os
+import random
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from bifromq_tpu.models.matcher import TpuMatcher
+from bifromq_tpu.models.oracle import Route, SubscriptionTrie
+from bifromq_tpu.replication import records as R
+from bifromq_tpu.replication import status_report
+from bifromq_tpu.replication.standby import InvalidationPuller, WarmStandby
+from bifromq_tpu.replication.stream import DeltaLog, ReplicationHub
+from bifromq_tpu.types import RouteMatcher
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rt(f, i, broker=0):
+    return Route(matcher=RouteMatcher.from_topic_filter(f),
+                 broker_id=broker, receiver_id=f"rcv{i}",
+                 deliverer_key=f"d{i}", incarnation=0)
+
+
+def canon(m):
+    return (sorted((r.matcher.mqtt_topic_filter, r.receiver_url)
+                   for r in m.normal),
+            {f: sorted(r.receiver_url for r in ms)
+             for f, ms in m.groups.items()})
+
+
+def make_leader(n=40, cap=None):
+    """Leader matcher + its delta log, seeded and compiled (the anchor
+    of the first base has fired; the stream is live)."""
+    leader = TpuMatcher(auto_compact=False)
+    log = DeltaLog("n0", "r0", cap=cap)
+    leader.on_delta = lambda t, f, op, plan, fb: log.append(
+        tenant=t, filter_levels=f, op=op, plan=plan, fallback=fb)
+    leader.on_rebase = lambda salt, reason: log.anchor(salt, reason)
+    for i in range(n):
+        leader.add_route("T", rt(f"s/{i}/t", i))
+    leader.add_route("T", rt("s/+/t", 900))
+    leader.add_route("T", rt("w/#", 901))
+    leader.add_route("T", rt("$share/g/sh/x", 902))
+    leader.add_route("T", rt("$share/g/sh/x", 903))
+    leader.refresh()
+    return leader, log
+
+
+def attach_standby(leader, log):
+    snap = R.decode_base(R.encode_base(leader._base_ct, leader.tries))
+    sb = WarmStandby(matcher=TpuMatcher(auto_compact=False))
+    sb.range_id = "r0"
+    sb._install(snap, log.cursor())
+    return sb
+
+
+def wire(records):
+    """Force every record through the full wire codec."""
+    return [R.decode_record(rec.encoded())[0] for rec in records]
+
+
+def churn(leader, ops, seed=7):
+    rng = random.Random(seed)
+    n = 0
+    i = 0
+    while n < ops:
+        i += 1
+        if rng.random() < 0.55:
+            if leader.add_route("T", rt(f"c/{rng.randint(0, 60)}/x",
+                                        2000 + i)):
+                n += 1
+            else:
+                n += 1  # upsert is still an effective (emitted) op
+        else:
+            f = f"s/{rng.randint(0, 39)}/t"
+            idx = int(f.split("/")[1])
+            if leader.remove_route("T", RouteMatcher.from_topic_filter(f),
+                                   (0, f"rcv{idx}", f"d{idx}")):
+                n += 1
+    return n
+
+
+def assert_arena_parity(leader, sb):
+    a, b = leader._base_ct, sb.matcher._base_ct
+    assert np.array_equal(a.node_tab, b.node_tab)
+    assert np.array_equal(a.edge_tab, b.edge_tab)
+    assert np.array_equal(a.child_list, b.child_list)
+    assert np.array_equal(a.slot_kind, b.slot_kind)
+    assert a.n_live == b.n_live
+    assert a.tenant_root == b.tenant_root
+    assert len(a.matchings) == len(b.matchings)
+
+
+def assert_match_parity(leader, sb, topics):
+    got = sb.matcher.match_batch([("T", t) for t in topics])
+    want = leader.match_from_tries([("T", t) for t in topics])
+    for t, g, w in zip(topics, got, want):
+        assert canon(g) == canon(w), t
+
+
+TOPICS = ([f"s/{i}/t" for i in range(40)]
+          + [f"c/{i}/x" for i in range(61)]
+          + ["w/a/b", "sh/x", "nope/q"])
+
+
+class TestCodecs:
+    def test_record_roundtrip(self):
+        plan = None
+        op = ("add", "T", rt("a/b", 1))
+        rec = R.DeltaRecord(origin="n0", range_id="r0", epoch=3, seq=17,
+                            hlc=12345, tenant="T",
+                            filter_levels=("a", "b"), op=op, plan=plan,
+                            fallback=True)
+        back, _ = R.decode_record(R.encode_record(rec))
+        assert (back.origin, back.range_id, back.epoch, back.seq,
+                back.hlc) == ("n0", "r0", 3, 17, 12345)
+        assert back.tenant == "T"
+        assert back.filter_levels == ("a", "b")
+        assert back.fallback is True
+        assert back.op[0] == "add" and back.op[1] == "T"
+        assert back.op[2].matcher.mqtt_topic_filter == "a/b"
+
+    def test_rm_op_roundtrip(self):
+        op = ("rm", "T", RouteMatcher.from_topic_filter("$share/g/a/+"),
+              (3, "r1", "dk"), 9)
+        back = R.decode_op(R.encode_op(op))
+        assert back[0] == "rm"
+        assert back[2].mqtt_topic_filter == "$share/g/a/+"
+        assert back[2].group == "g"
+        assert back[3] == (3, "r1", "dk")
+        assert back[4] == 9
+
+    def test_inval_only_strips_payload(self):
+        rec = R.DeltaRecord(origin="n0", range_id="r0", epoch=1, seq=1,
+                            hlc=1, tenant="T", filter_levels=("a",),
+                            op=("add", "T", rt("a", 1)))
+        lean, _ = R.decode_record(rec.encoded(inval_only=True))
+        assert lean.op is None and lean.plan is None
+        assert lean.tenant == "T" and lean.filter_levels == ("a",)
+        assert len(rec.encoded(inval_only=True)) < len(rec.encoded())
+
+    def test_base_snapshot_roundtrip(self):
+        leader, log = make_leader(10)
+        snap = R.decode_base(R.encode_base(leader._base_ct, leader.tries))
+        pt = snap.to_trie()
+        assert np.array_equal(pt.node_tab, leader._base_ct.node_tab)
+        assert np.array_equal(pt.edge_tab, leader._base_ct.edge_tab)
+        tries = snap.to_tries()
+        assert set(tries) == set(leader.tries)
+        assert len(tries["T"]) == len(leader.tries["T"])
+
+
+class TestDeltaSemantics:
+    def test_churn_parity_leader_replica_oracle(self):
+        leader, log = make_leader()
+        sb = attach_standby(leader, log)
+        churn(leader, 400)
+        status, recs = log.since(*sb.cursor)
+        assert status == "ok" and recs
+        assert sb.offer(wire(recs))
+        assert_arena_parity(leader, sb)
+        assert_match_parity(leader, sb, TOPICS)
+        # the acceptance bar: deltas only — no rebuild, no cache
+        # generation bump on the replica
+        assert sb.matcher.compile_count == 0
+        assert sb.matcher.match_cache._gen == 0
+
+    def test_idempotent_reapply(self):
+        leader, log = make_leader(10)
+        sb = attach_standby(leader, log)
+        churn(leader, 50)
+        _, recs = log.since(*sb.cursor)
+        batch = wire(recs)
+        assert sb.offer(batch)
+        nt = sb.matcher._base_ct.node_tab.copy()
+        dead = sb.matcher._base_ct.dead_slots
+        assert sb.offer(batch)      # full duplicate delivery
+        assert sb.offer(batch[:3])  # partial duplicate delivery
+        assert np.array_equal(sb.matcher._base_ct.node_tab, nt)
+        assert sb.matcher._base_ct.dead_slots == dead
+        assert_match_parity(leader, sb, TOPICS)
+
+    def test_out_of_order_delivery(self):
+        leader, log = make_leader(10)
+        sb = attach_standby(leader, log)
+        churn(leader, 60)
+        _, recs = log.since(*sb.cursor)
+        batch = wire(recs)
+        rng = random.Random(3)
+        # shuffle within a window: every record arrives, order scrambled
+        for lo in range(0, len(batch), 8):
+            win = batch[lo:lo + 8]
+            rng.shuffle(win)
+            assert sb.offer(win)
+        assert not sb._pending
+        assert_arena_parity(leader, sb)
+        assert_match_parity(leader, sb, TOPICS)
+        assert sb.reorders > 0
+
+    def test_sequence_gap_degrades_to_resync(self):
+        leader, log = make_leader(10, cap=64)
+        sb = attach_standby(leader, log)
+        churn(leader, 200)      # blows past the 64-record ring
+        status, recs = log.since(*sb.cursor)
+        assert status == "gap" and not recs
+        # the bounded resync: ship arenas, apply nothing, recompile never
+        sb._install(R.decode_base(R.encode_base(leader._base_ct,
+                                                leader.tries)),
+                    log.cursor())
+        assert_arena_parity(leader, sb)
+        assert_match_parity(leader, sb, TOPICS)
+        assert sb.matcher.compile_count == 0
+
+    def test_compaction_barrier_reanchors(self):
+        leader, log = make_leader(10)
+        sb = attach_standby(leader, log)
+        churn(leader, 30)
+        _, recs = log.since(*sb.cursor)
+        assert sb.offer(wire(recs))
+        epoch0 = log.epoch
+        leader._maybe_compact(force=True)
+        leader.drain()
+        assert log.epoch == epoch0 + 1
+        status, _ = log.since(*sb.cursor)
+        assert status == "anchor"
+        sb._install(R.decode_base(R.encode_base(leader._base_ct,
+                                                leader.tries)),
+                    log.cursor())
+        assert_arena_parity(leader, sb)
+        assert_match_parity(leader, sb, TOPICS)
+        # same salt ⇒ the resync did NOT bump the replica's cache
+        assert sb.matcher.match_cache._gen == 0
+
+    def test_fallback_op_serves_from_overlay(self, monkeypatch):
+        leader, log = make_leader(10)
+        sb = attach_standby(leader, log)
+        from bifromq_tpu.models.automaton import PatchFallback
+
+        def refuse(*a, **kw):
+            raise PatchFallback("forced")
+        monkeypatch.setattr(type(leader._base_ct), "patch_add", refuse)
+        leader.add_route("T", rt("fb/only", 77))
+        monkeypatch.undo()
+        _, recs = log.since(*sb.cursor)
+        batch = wire(recs)
+        assert batch[-1].fallback
+        assert sb.offer(batch)
+        assert sb.matcher.overlay_size >= 1
+        assert_match_parity(leader, sb, ["fb/only"])
+
+    def test_group_membership_replicates(self):
+        leader, log = make_leader(5)
+        sb = attach_standby(leader, log)
+        leader.add_route("T", rt("$share/g/sh/x", 904))
+        leader.remove_route(
+            "T", RouteMatcher.from_topic_filter("$share/g/sh/x"),
+            (0, "rcv902", "d902"))
+        _, recs = log.since(*sb.cursor)
+        assert sb.offer(wire(recs))
+        assert_match_parity(leader, sb, ["sh/x"])
+
+    def test_ahead_cursor_is_a_gap(self):
+        # a cursor AHEAD of the stream can only come from an epoch-
+        # aliased previous incarnation — must resync, never wait for the
+        # head to catch up past silently-skipped records
+        leader, log = make_leader(5)
+        epoch, head = log.cursor()
+        assert log.since(epoch, head)[0] == "ok"
+        assert log.since(epoch, head + 10)[0] == "gap"
+
+    def test_promote_serves_and_mutates(self):
+        leader, log = make_leader(10)
+        sb = attach_standby(leader, log)
+        churn(leader, 40)
+        _, recs = log.since(*sb.cursor)
+        assert sb.offer(wire(recs))
+        m = sb.promote()
+        # the promoted replica serves without ever having compiled...
+        assert m.compile_count == 0
+        assert_match_parity(leader, sb, TOPICS)
+        # ...and accepts its own mutations from here on
+        m.add_route("T", rt("post/promo", 1))
+        got = m.match_batch([("T", "post/promo")])[0]
+        assert canon(got) == canon(m.match_from_tries(
+            [("T", "post/promo")])[0])
+
+
+class TestHotTopics:
+    def test_hot_keys_and_prewarm(self):
+        from bifromq_tpu.models.matchcache import TenantMatchCache
+        cache = TenantMatchCache(scope="pub")
+        for i in range(5):
+            tok = cache.token("T")
+            cache.put("T", f"t/{i}", (1, 1), object(), tok)
+        keys = cache.hot_keys(3)
+        assert keys and all(t == "T" for t, _ in keys)
+        assert ["T", "t/4"] in keys     # most recent survives the cap
+        leader, log = make_leader(5)
+        sb = attach_standby(leader, log)
+        n = sb.prewarm([["T", "s/1/t"], ["T", "s/2/t"]])
+        assert n == 2
+        assert sb.matcher.match_cache.hits + \
+            sb.matcher.match_cache.misses >= 2
+
+    def test_status_report_shape(self):
+        hub = ReplicationHub("nX")
+        hub.log_for("r0")
+        rep = status_report()
+        assert any(h.get("origin") == "nX" for h in rep["hubs"])
+        assert "counters" in rep
+
+
+@pytest.mark.asyncio
+class TestFabricIntegration:
+    async def _worker_fixture(self):
+        from bifromq_tpu.dist.remote import (SERVICE, DistWorkerRPCService,
+                                             RemoteDistWorker)
+        from bifromq_tpu.dist.worker import DistWorker
+        from bifromq_tpu.rpc.fabric import RPCServer, ServiceRegistry
+        worker = DistWorker(node_id="w0")
+        await worker.start()
+        server = RPCServer(host="127.0.0.1", port=0)
+        DistWorkerRPCService(worker).register(server)
+        await server.start()
+        reg = ServiceRegistry()
+        reg.announce(SERVICE, f"127.0.0.1:{server.port}")
+        return worker, server, reg, RemoteDistWorker(reg)
+
+    async def test_standby_tracks_over_rpc(self):
+        worker, server, reg, remote = await self._worker_fixture()
+        try:
+            for i in range(20):
+                assert (await remote.add_route(
+                    "T", rt(f"x/{i}/y", i))) in ("ok", "exists")
+            sb = WarmStandby(reg)
+            await sb.start()
+            try:
+                for _ in range(200):
+                    await asyncio.sleep(0.05)
+                    if sb.attached and sb.lag() == 0:
+                        break
+                assert sb.attached
+                for i in range(20, 40):
+                    await remote.add_route("T", rt(f"x/{i}/y", i))
+                for _ in range(200):
+                    await asyncio.sleep(0.05)
+                    if sb.attached and sb.lag() == 0 and sb.applied >= 20:
+                        break
+                coproc = next(iter(worker.store.coprocs.values()))
+                topics = [f"x/{i}/y" for i in range(40)]
+                got = sb.matcher.match_batch([("T", t) for t in topics])
+                want = coproc.matcher.match_from_tries(
+                    [("T", t) for t in topics])
+                assert all(canon(g) == canon(w)
+                           for g, w in zip(got, want))
+                assert sb.matcher.compile_count == 0
+                # promotion must CANCEL the sync loop: a surviving old
+                # leader must not clobber post-promotion mutations with
+                # a resync on the next tick
+                applied = sb.applied
+                sb.promote()
+                assert sb._task is None
+                await remote.add_route("T", rt("after/promote", 1))
+                await asyncio.sleep(0.3)
+                assert sb.applied == applied
+            finally:
+                await sb.stop()
+        finally:
+            await server.stop()
+            await worker.stop()
+
+    async def test_exact_invalidation_beats_ttl(self):
+        from bifromq_tpu.models.matchcache import TenantMatchCache
+        worker, server, reg, remote = await self._worker_fixture()
+        puller = None
+        try:
+            cache = TenantMatchCache(scope="pub", ttl_s=1000.0)
+
+            def inval(t, f):
+                if t is None:
+                    cache.bump_all()
+                else:
+                    cache.invalidate(t, f)
+            puller = InvalidationPuller(reg, inval, wait_s=0.3)
+            await puller.start()
+            for _ in range(100):    # wait out the initial-cursor bump
+                await asyncio.sleep(0.05)
+                if puller.cursors:
+                    break
+            await asyncio.sleep(0.4)
+            tok = cache.token("T")
+            assert cache.put("T", "q/1/z", (1, 1), "RESULT", tok)
+            await remote.add_route("T", rt("q/1/z", 999))
+            evicted = False
+            for _ in range(250):    # « the 1000s TTL
+                await asyncio.sleep(0.02)
+                if cache.get("T", "q/1/z", (1, 1)) is None:
+                    evicted = True
+                    break
+            assert evicted, "stream did not evict; TTL would have waited"
+            assert puller.invalidations >= 1
+        finally:
+            if puller is not None:
+                await puller.stop()
+            await server.stop()
+            await worker.stop()
+
+
+@pytest.fixture
+def worker_proc():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "bifromq_tpu.dist.worker_main",
+         "--port", "0"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+    line = proc.stdout.readline().strip()
+    assert line.startswith("READY "), line
+    yield int(line.split()[1])
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+@pytest.mark.asyncio
+class TestTwoProcess:
+    async def test_standby_parity_across_processes(self, worker_proc):
+        """The two-process parity leg: a standby in THIS process tracks
+        a dist-worker in ANOTHER process over the real fabric, against a
+        local oracle trie mirroring every mutation."""
+        from bifromq_tpu.dist.remote import SERVICE, RemoteDistWorker
+        from bifromq_tpu.rpc.fabric import ServiceRegistry
+        reg = ServiceRegistry()
+        reg.announce(SERVICE, f"127.0.0.1:{worker_proc}")
+        remote = RemoteDistWorker(reg)
+        oracle = SubscriptionTrie()
+        rng = random.Random(11)
+        routes = {}
+        sb = WarmStandby(reg)
+        await sb.start()
+        try:
+            for i in range(80):
+                if rng.random() < 0.7 or not routes:
+                    r = rt(f"tp/{rng.randint(0, 30)}/z", i)
+                    out = await remote.add_route("T", r)
+                    assert out in ("ok", "exists")
+                    oracle.add(r)
+                    routes[(r.matcher.mqtt_topic_filter,
+                            r.receiver_url)] = r
+                else:
+                    key = rng.choice(list(routes))
+                    r = routes.pop(key)
+                    await remote.remove_route("T", r.matcher,
+                                              r.receiver_url,
+                                              r.incarnation)
+                    oracle.remove(r.matcher, r.receiver_url,
+                                  r.incarnation)
+            for _ in range(300):
+                await asyncio.sleep(0.05)
+                if sb.attached and sb.lag() == 0 and sb.applied > 0:
+                    break
+            assert sb.attached, sb.status()
+            topics = [f"tp/{i}/z" for i in range(31)]
+            got = sb.matcher.match_batch([("T", t) for t in topics])
+            for t, g in zip(topics, got):
+                want = oracle.match(t.split("/"))
+                assert canon(g) == canon(want), t
+            assert sb.matcher.compile_count == 0
+        finally:
+            await sb.stop()

@@ -258,7 +258,9 @@ class TestMatcherByteQueries:
         b0 = OBS.profiler.batches_total
         m.match_batch([("tenant", "s/0/t")])
         recs = OBS.profiler.records()
-        new = recs[-(OBS.profiler.batches_total - b0):]
+        n_new = OBS.profiler.batches_total - b0
+        assert n_new > 0      # [-0:] would select the WHOLE ring
+        new = recs[-n_new:]
         assert any(r.tokenize_s > 0 for r in new)
         assert "tokenize_ms" in new[-1].to_dict()
         assert "tokenize_ms_p50" in OBS.profiler.split_snapshot(

@@ -1,0 +1,197 @@
+"""ISSUE 12 satellites: wire-bytes topics on the pub path (codec →
+session → dist as ``bytes``) and the byte-plane retained-filter
+tokenizer (``tokenize_filters`` off its per-row Python loop)."""
+
+import asyncio
+import random
+import string
+
+import numpy as np
+import pytest
+
+from bifromq_tpu.models import automaton as am
+from bifromq_tpu.mqtt import packets as pk
+from bifromq_tpu.mqtt.codec import StreamDecoder, encode
+from bifromq_tpu.mqtt.protocol import MalformedPacket
+from bifromq_tpu.utils import topic as topic_util
+
+
+class TestRawTopicCodec:
+    def _roundtrip(self, topic, raw):
+        dec = StreamDecoder(raw_pub_topic=raw)
+        wire = encode(pk.Publish(topic=topic, payload=b"p", qos=0), 4)
+        (out,) = dec.feed(wire)
+        return out
+
+    def test_server_decoder_keeps_wire_bytes(self):
+        out = self._roundtrip("a/b/c", raw=True)
+        assert out.topic == b"a/b/c"
+
+    def test_client_decoder_keeps_str(self):
+        out = self._roundtrip("a/b/c", raw=False)
+        assert out.topic == "a/b/c"
+
+    def test_unicode_topic_survives_as_bytes(self):
+        out = self._roundtrip("温度/测量", raw=True)
+        assert out.topic == "温度/测量".encode("utf-8")
+        assert topic_util.to_str(out.topic) == "温度/测量"
+
+    def test_raw_decode_still_rejects_nul_and_bad_utf8(self):
+        import struct
+        dec = StreamDecoder(raw_pub_topic=True)
+        bad = b"a\x00b"
+        body = struct.pack(">H", len(bad)) + bad
+        frame = bytes([0x30, len(body)]) + body
+        with pytest.raises(MalformedPacket):
+            dec.feed(frame)
+        dec2 = StreamDecoder(raw_pub_topic=True)
+        bad2 = b"a/\xff\xfe"
+        body2 = struct.pack(">H", len(bad2)) + bad2
+        with pytest.raises(MalformedPacket):
+            dec2.feed(bytes([0x30, len(body2)]) + body2)
+
+    def test_encode_string_accepts_bytes(self):
+        a = encode(pk.Publish(topic=b"x/y", payload=b"", qos=0), 4)
+        b = encode(pk.Publish(topic="x/y", payload=b"", qos=0), 4)
+        assert a == b
+
+
+class TestBytesTopicValidation:
+    def _rand_topic(self, rng):
+        alphabet = string.ascii_letters + "/+#$温度 ß"
+        return "".join(rng.choice(alphabet)
+                       for _ in range(rng.randint(0, 24)))
+
+    def test_bytes_str_parity_property(self):
+        rng = random.Random(5)
+        cases = [self._rand_topic(rng) for _ in range(800)]
+        cases += ["", "a/b", "a//b", "$share/g/t", "$oshare/g/t",
+                  "a" * 300, ("x" * 41) + "/y", "/".join("x" * 20),
+                  "温度/" + "x" * 39, "温" * 41]
+        for t in cases:
+            want = topic_util.is_valid_topic(t)
+            got = topic_util.is_valid_topic(t.encode("utf-8"))
+            assert got == want, t
+
+    def test_invalid_utf8_bytes_rejected(self):
+        assert not topic_util.is_valid_topic(b"\xff\xfe/ok")
+        assert not topic_util.is_well_formed_utf8(b"\xff\xfe")
+        assert topic_util.is_well_formed_utf8("ok/level".encode())
+
+    def test_to_str(self):
+        assert topic_util.to_str(b"a/b") == "a/b"
+        assert topic_util.to_str("a/b") == "a/b"
+        assert topic_util.to_str("温度".encode()) == "温度"
+
+
+@pytest.mark.asyncio
+class TestBytesEndToEnd:
+    async def test_pub_deliver_roundtrip_with_unicode(self):
+        """Raw wire bytes flow codec → session → dist; the subscriber
+        still receives the exact topic text."""
+        from bifromq_tpu.mqtt.broker import MQTTBroker
+        from bifromq_tpu.mqtt.client import MQTTClient
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        await broker.start()
+        try:
+            sub = MQTTClient("127.0.0.1", broker.port, client_id="bs")
+            await sub.connect()
+            await sub.subscribe("bytes/+/温度", qos=1)
+            p = MQTTClient("127.0.0.1", broker.port, client_id="bp")
+            await p.connect()
+            await p.publish("bytes/x/温度", b"wired", qos=1)
+            msg = await asyncio.wait_for(sub.messages.get(), 10)
+            assert msg.payload == b"wired"
+            assert msg.topic == "bytes/x/温度"
+            # repeated topic rides the byte-keyed cache path
+            await p.publish("bytes/x/温度", b"again", qos=0)
+            msg = await asyncio.wait_for(sub.messages.get(), 10)
+            assert msg.payload == b"again"
+            await sub.disconnect()
+            await p.disconnect()
+        finally:
+            await broker.stop()
+
+    async def test_invalid_topic_bytes_rejected_at_session(self):
+        from bifromq_tpu.mqtt.broker import MQTTBroker
+        from bifromq_tpu.mqtt.client import MQTTClient
+        broker = MQTTBroker(host="127.0.0.1", port=0)
+        await broker.start()
+        try:
+            c = MQTTClient("127.0.0.1", broker.port, client_id="bad")
+            await c.connect()
+            # wildcard in a PUBLISH topic: structural violation
+            with pytest.raises(Exception):
+                await asyncio.wait_for(
+                    c.publish("oops/+/x", b"x", qos=1), 5)
+        finally:
+            await broker.stop()
+
+
+class TestFilterBytePlane:
+    """ROADMAP ingest follow-up (b): the retained-filter probe path on
+    the byte plane — randomized parity with the per-row reference."""
+
+    def _rand_filters(self, rng, n):
+        out = []
+        for _ in range(n):
+            depth = rng.randint(0, 7)
+            levels = []
+            for j in range(depth):
+                r = rng.random()
+                if r < 0.15:
+                    levels.append("+")
+                elif r < 0.25 and j == depth - 1:
+                    levels.append("#")
+                elif r < 0.35:
+                    levels.append("")
+                elif r < 0.45:
+                    levels.append("温度" + str(j))
+                elif r < 0.5:
+                    levels.append("x" * rng.randint(100, 200))
+                else:
+                    levels.append(f"lvl{rng.randint(0, 30)}")
+            out.append(levels)
+        return out
+
+    def _assert_parity(self, filters, roots, **kw):
+        a = am.tokenize_filters(filters, roots, vectorized=True, **kw)
+        b = am.tokenize_filters(filters, roots, vectorized=False, **kw)
+        for f in ("tok_h1", "tok_h2", "tok_kind", "lengths", "roots"):
+            assert np.array_equal(getattr(a, f), getattr(b, f)), f
+
+    def test_randomized_parity(self):
+        rng = random.Random(0)
+        for salt in (0, 1, 7, 12345):
+            filters = self._rand_filters(rng, 300)
+            roots = [rng.randint(-1, 9) for _ in filters]
+            self._assert_parity(filters, roots, max_levels=5, salt=salt)
+
+    def test_padded_batch_and_edges(self):
+        filters = [["+"], ["#"], ["a", "+", "#"], [], [""],
+                   ["+x"], ["x+"], ["#tag"], ["a"] * 20]
+        roots = list(range(len(filters)))
+        self._assert_parity(filters, roots, max_levels=16, salt=3,
+                            batch=16)
+
+    def test_delimiter_bearing_level_falls_back(self):
+        # a level embedding '/' cannot come from parse(); the public API
+        # still answers exactly via the reference loop
+        filters = [["a/b", "c"]]
+        out = am.tokenize_filters(filters, [0], max_levels=8, salt=1)
+        ref = am.tokenize_filters(filters, [0], max_levels=8, salt=1,
+                                  vectorized=False)
+        assert np.array_equal(out.tok_h1, ref.tok_h1)
+        assert np.array_equal(out.lengths, ref.lengths)
+
+    def test_retained_lookup_still_exact(self):
+        """The retained plane consumes the vectorized filters leg."""
+        from bifromq_tpu.models.retained import RetainedIndex
+        idx = RetainedIndex()
+        for t in ("a/b/c", "a/x/c", "b/b/c", "温度/1"):
+            idx.add_topic("T", t.split("/"), t)
+        assert sorted(idx.match("T", ["a", "+", "c"])) == \
+            ["a/b/c", "a/x/c"]
+        assert idx.match("T", ["温度", "+"]) == ["温度/1"]
+        assert sorted(idx.match("T", ["#"])) == \
+            ["a/b/c", "a/x/c", "b/b/c", "温度/1"]
