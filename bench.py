@@ -64,9 +64,22 @@ profiler attributes a `tokenize` stage on every device batch
 (BENCH_TOK_SUBS sizes its base; every record stamps a "tokenize"
 section when config 9 ran).
 
+MIXED MILLION-CLIENT WORKLOAD (ISSUE 13): config "10" executes one
+deterministic `workloads.config_mixed` plan — Zipf tenants, QoS mix,
+$share worker pools, a >=10k-op retained SET/CLEAR flood against the
+PATCHED RetainedIndex (acceptance: ZERO full rebuilds, device scans
+byte-identical to the host oracle before/during/after), async wildcard
+scans through the retain.scan plane (cache hit-rate on the repeat
+pass), publish matching under concurrent session churn, balanced-vs-
+random $share election spread, a governed reconnect drain storm
+(tenant fairness: the quiet tenants' mean admission wait must not sit
+behind tenant0's herd), and the SLO top-k snapshot (BENCH_MIX_CLIENTS
+default 100_000 — set 1_000_000 for the paper-scale record;
+BENCH_MIX_RETAIN_OPS default 10_000). Stamps record["mixed"].
+
 Env knobs: BENCH_CONFIGS ("1,2,3,4,5" default; "2" = headline only;
 "6" = match-cache A/B; "7" = pipeline A/B; "8" = churn/patch;
-"9" = ingest byte-plane A/B;
+"9" = ingest byte-plane A/B; "10" = mixed million-client workload;
 BENCH_CACHE_HOT_TOPICS sizes config 6's Zipf pool),
 BENCH_SUBS (config-2 subs, default 1_000_000), BENCH_BATCH (16384),
 BENCH_ITERS (30), BENCH_K (16), BENCH_SEED (0), BENCH_RETAINED (1_000_000),
@@ -1232,6 +1245,229 @@ def bench_config9():
     return out
 
 
+def bench_config10():
+    """Mixed million-client workload (ISSUE 13 tentpole part 4): every
+    serving plane measured under one realistic population instead of
+    isolation — see the module docstring for the leg list. The retained
+    flood leg IS the acceptance gate shape: >=10k SET/CLEAR mutations
+    against the patched index with zero full rebuilds and exact scan
+    parity before, during and after the storm."""
+    import asyncio
+    import random as _random
+    from collections import Counter
+
+    from bifromq_tpu import workloads
+    from bifromq_tpu.dist.service import GroupFanoutBalancer
+    from bifromq_tpu.models.matcher import TpuMatcher
+    from bifromq_tpu.models.retained import RetainedIndex, match_filter_host
+    from bifromq_tpu.obs import OBS
+    from bifromq_tpu.retained_plane import DrainGovernor, RetainedScanPlane
+    from bifromq_tpu.types import RouteMatcher, RouteMatcherType
+    from bifromq_tpu.models.oracle import Route
+
+    n_clients = int(os.environ.get("BENCH_MIX_CLIENTS", "100000"))
+    retained_ops = int(os.environ.get("BENCH_MIX_RETAIN_OPS", "10000"))
+    name = f"c10_mixed_{n_clients}"
+    t0 = time.perf_counter()
+    plan = workloads.config_mixed(n_clients, seed=SEED,
+                                  retained_ops=retained_ops)
+    gen_s = time.perf_counter() - t0
+    log(f"[{name}] plan: {plan['n_clients']} clients, qos {plan['qos_mix']}, "
+        f"{len(plan['retained_seed'])} retained base, "
+        f"{len(plan['retained_flood'])} flood ops ({gen_s:.1f}s)")
+
+    # ---- leg 1: route table (transient + persistent + $share) -------------
+    t0 = time.perf_counter()
+    m = TpuMatcher.from_tries(plan["subscriptions"], match_cache=True)
+    build_s = time.perf_counter() - t0
+
+    # ---- leg 2: retained flood against the PATCHED index ------------------
+    idx = RetainedIndex(k_states=K_STATES)
+    t0 = time.perf_counter()
+    for tenant, levels in plan["retained_seed"]:
+        idx.add_topic(tenant, levels, "/".join(levels))
+    ct = idx.refresh()
+    retained_compile_s = time.perf_counter() - t0
+    plane = RetainedScanPlane(lambda: idx)
+    rebuilds0, compactions0 = idx.rebuilds, idx.compactions
+
+    sample = plan["scan_filters"][:32]
+
+    def parity_sample():
+        got = idx.match_batch(sample)
+        for (tenant, f), g in zip(sample, got):
+            trie = idx.tries.get(tenant)
+            want = sorted(match_filter_host(trie, list(f))) if trie else []
+            if sorted(g) != want:
+                return False
+        return True
+
+    parity_before = parity_sample()
+    flood = plan["retained_flood"]
+    scan_lat_during = []
+    t0 = time.perf_counter()
+    for i, (op, tenant, levels) in enumerate(flood):
+        if op == "set":
+            idx.add_topic(tenant, levels, "/".join(levels))
+        else:
+            idx.remove_topic(tenant, levels, "/".join(levels))
+        if i % 1024 == 512:
+            s0 = time.perf_counter()
+            idx.match_batch(sample[:8], limit=10)
+            scan_lat_during.append(time.perf_counter() - s0)
+    flood_s = time.perf_counter() - t0
+    parity_during = parity_sample()
+    zero_rebuilds = idx.rebuilds == rebuilds0
+    parity_after = parity_sample()
+
+    # ---- leg 3: async wildcard scans through the retain.scan plane --------
+    batches = [plan["scan_filters"][i:i + 64]
+               for i in range(0, len(plan["scan_filters"]), 64)]
+
+    async def scan_all():
+        lats = []
+        for b in batches:
+            s0 = time.perf_counter()
+            await plane.scan_batch(b, limit=10)
+            lats.append(time.perf_counter() - s0)
+        return lats
+
+    asyncio.run(scan_all())        # warm (jit + cache fill probes)
+    scan_lats = asyncio.run(scan_all())
+    cache0 = dict(plane.cache.snapshot()) if plane.cache else {}
+    repeat_lats = asyncio.run(scan_all())   # repeat pass: cache hits
+    cache1 = dict(plane.cache.snapshot()) if plane.cache else {}
+    rpt_hits = cache1.get("hits", 0) - cache0.get("hits", 0)
+    rpt_miss = cache1.get("misses", 0) - cache0.get("misses", 0)
+
+    # ---- leg 4: publish matching under concurrent session churn -----------
+    pub_batches = [[(t, topic) for t, topic, _q in plan["publishes"][i:i + 64]]
+                   for i in range(0, min(len(plan["publishes"]), 1024), 64)]
+    for b in pub_batches:
+        m.match_batch(b)           # warm
+    churn = plan["session_churn"]
+    match_lat, churn_lat = [], []
+    ci = 0
+    t0 = time.perf_counter()
+    for bi, b in enumerate(pub_batches * 4):
+        for _ in range(4):
+            if ci < len(churn):
+                op, tenant, levels, rid = churn[ci]
+                ci += 1
+                mt = RouteMatcher(type=RouteMatcherType.NORMAL,
+                                  filter_levels=tuple(levels),
+                                  mqtt_topic_filter="/".join(levels))
+                s0 = time.perf_counter()
+                if op == "sub":
+                    m.add_route(tenant, Route(matcher=mt, broker_id=0,
+                                              receiver_id=rid,
+                                              deliverer_key="d0"))
+                else:
+                    m.remove_route(tenant, mt, (0, rid, "d0"))
+                m._flush_patches()
+                churn_lat.append(time.perf_counter() - s0)
+        s0 = time.perf_counter()
+        m.match_batch(b)
+        match_lat.append(time.perf_counter() - s0)
+    mix_s = time.perf_counter() - t0
+
+    # ---- leg 5: $share election balance (balanced vs random) --------------
+    members = [Route(matcher=RouteMatcher(
+                        type=RouteMatcherType.UNORDERED_SHARE,
+                        filter_levels=("t", "#"),
+                        mqtt_topic_filter="$share/g/t/#", group="g"),
+                     broker_id=0, receiver_id=f"w{i}",
+                     deliverer_key="d0") for i in range(16)]
+    bal = GroupFanoutBalancer(_random.Random(SEED))
+    for _ in range(4096):
+        bal.pick("T", "$share/g/t/#", members)
+    bspread = bal.spread("T", "$share/g/t/#")
+    rng = _random.Random(SEED)
+    rcounts = Counter(members[rng.randrange(16)].receiver_id
+                      for _ in range(4096))
+
+    # ---- leg 6: governed reconnect drain storm ----------------------------
+    async def drain_storm():
+        gov = DrainGovernor(slots=16, per_tenant=4,
+                            noisy_fn=lambda t: False)
+        waits = {}
+
+        async def one(tenant, _inbox, backlog):
+            s0 = time.perf_counter()
+            async with gov.slot(tenant):
+                await asyncio.sleep(backlog * 2e-5)  # simulated page pump
+            waits.setdefault(tenant, []).append(time.perf_counter() - s0)
+
+        await asyncio.gather(*(one(*d) for d in plan["drain_plan"]))
+        herd = waits.pop("tenant0", [0.0])
+        quiet = [w for ws in waits.values() for w in ws] or [0.0]
+        return {
+            "herd_sessions": len(herd),
+            "quiet_sessions": len(quiet),
+            "herd_mean_ms": round(1e3 * sum(herd) / len(herd), 2),
+            "quiet_mean_ms": round(1e3 * sum(quiet) / len(quiet), 2),
+            "tenant_fair": (sum(quiet) / len(quiet))
+            <= (sum(herd) / len(herd)) * 1.5 + 0.005,
+            "governor": gov.snapshot(),
+        }
+
+    drain = asyncio.run(drain_storm())
+
+    def pct(xs, q):
+        return round(float(np.percentile(np.array(xs or [0.0]), q)) * 1e3, 3)
+
+    out = {
+        "n_clients": plan["n_clients"],
+        "qos_mix": plan["qos_mix"],
+        "plan_gen_s": round(gen_s, 1),
+        "route_table_build_s": round(build_s, 1),
+        "retained": {
+            "base_topics": len(plan["retained_seed"]),
+            "flood_ops": len(flood),
+            "compile_s": round(retained_compile_s, 1),
+            "flood_ops_per_s": round(len(flood) / max(1e-9, flood_s), 1),
+            "full_rebuilds_in_flood": idx.rebuilds - rebuilds0,
+            "compactions_in_flood": idx.compactions - compactions0,
+            "zero_rebuilds": zero_rebuilds,
+            "patch_fallbacks": idx.patch_fallbacks,
+            "scan_parity_before_during_after": [
+                parity_before, parity_during, parity_after],
+            "scan_p99_ms_during_flood": pct(scan_lat_during, 99),
+            "patch": (idx._compiled.patch_stats()
+                      if hasattr(idx._compiled, "patch_stats") else None),
+        },
+        "scan": {
+            "filters": len(plan["scan_filters"]),
+            "batch_p50_ms": pct(scan_lats, 50),
+            "batch_p99_ms": pct(scan_lats, 99),
+            "repeat_batch_p50_ms": pct(repeat_lats, 50),
+            "repeat_hit_rate": round(
+                rpt_hits / max(1, rpt_hits + rpt_miss), 3),
+            "degraded": dict(plane.degraded_total),
+        },
+        "publish_mix": {
+            "match_p50_ms": pct(match_lat, 50),
+            "match_p99_ms": pct(match_lat, 99),
+            "churn_patch_p99_ms": pct(churn_lat, 99),
+            "churn_ops": ci,
+            "wall_s": round(mix_s, 1),
+            "matcher_rebuilds": m.compile_count,
+        },
+        "share_balance": {
+            "members": 16, "elections": 4096,
+            "balanced_spread": bspread["max"] - bspread["min"],
+            "random_spread": max(rcounts.values()) - min(rcounts.values()),
+        },
+        "drain_storm": drain,
+        "slo_top5": [
+            {"tenant": r.get("tenant"), "score": r.get("score")}
+            for r in OBS.tenants_snapshot(top_k=5,
+                                          emit=False)["tenants"]],
+    }
+    log(f"[{name}] {json.dumps(out)}")
+    return out
+
+
 def bench_broker():
     """End-to-end MQTT broker throughput over loopback TCP: QoS0/QoS1
     publish → dist match (device matcher) → local fan-out → delivery.
@@ -1451,6 +1687,8 @@ def main():
         results["c8"] = bench_config8()
     if "9" in CONFIGS:
         results["c9"] = bench_config9()
+    if "10" in CONFIGS:
+        results["c10"] = bench_config10()
     if "b" in CONFIGS:
         results["broker"] = bench_broker()
 
@@ -1566,6 +1804,22 @@ def main():
             "three_way_parity": c9["three_way_parity"],
             "tokenize_stage_on_every_device_batch":
                 c9["tokenize_stage_on_every_device_batch"],
+        }
+    # mixed-workload breakdown next to the headline (ISSUE 13): the
+    # retained-flood zero-rebuild verdict, scan parity/latency, drain
+    # fairness and share balance under the realistic population
+    if "c10" in results:
+        c10 = results["c10"]
+        record["mixed"] = {
+            "n_clients": c10["n_clients"],
+            "retained": {k: c10["retained"][k] for k in (
+                "flood_ops", "flood_ops_per_s", "full_rebuilds_in_flood",
+                "compactions_in_flood", "zero_rebuilds",
+                "scan_parity_before_during_after")},
+            "scan": c10["scan"],
+            "publish_mix": c10["publish_mix"],
+            "share_balance": c10["share_balance"],
+            "drain_tenant_fair": c10["drain_storm"]["tenant_fair"],
         }
     # per-stage p50/p99 next to the headline (ISSUE 2): where the broker
     # plane actually spends its time (queue-wait vs device vs deliver)

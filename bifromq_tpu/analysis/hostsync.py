@@ -49,6 +49,15 @@ HOT_SCOPES: Dict[str, Set[str]] = {
     # applied delta batch — it must stay a pure dispatch wrapper (the
     # narrow scatters live in ops/match, already covered above)
     "replication/standby.py": {"WarmStandby._flush_device"},
+    # ISSUE 13 retained serving plane: the scan dispatch leg (patch
+    # flush + walk enqueue) and the async ring leg must stay sync-free;
+    # the one true synchronization lives in RetainedIndex.fetch_scan —
+    # the retained twin of the matcher's designated _fetch_walk readback
+    "models/retained.py": {"RetainedIndex.dispatch_scan",
+                           "RetainedIndex.flush_device"},
+    "ops/retained.py": {"retained_walk", "retained_walk_ext",
+                        "patch_retained_tables", "_patch_retained"},
+    "retained_plane/scan.py": {"RetainedScanPlane._device_serve_async"},
 }
 
 # host-sync call shapes (module-qualified callee names)

@@ -395,6 +395,11 @@ class APIServer:
         else:
             snap["device"] = OBS.device_snapshot()
             snap["obs"] = OBS.obs_snapshot()
+            # ISSUE 13: retained scan planes + drain governors (absent
+            # key when neither exists — lean default scrape)
+            retained = OBS.retained_snapshot()
+            if retained["scan_planes"] or retained["drain_governors"]:
+                snap["retained"] = retained
             # ISSUE 10: graftcheck build-info (rule count, suppression
             # count, last-run hash) — two live nodes disagreeing on the
             # hash are running different code or different suppressions

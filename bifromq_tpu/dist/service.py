@@ -56,6 +56,85 @@ class PubResult:
     error: str = ""
 
 
+class GroupFanoutBalancer:
+    """Least-outstanding election for UNORDERED shared-subscription
+    groups (ISSUE 13 tentpole part 3, $share half).
+
+    The reference (and our pre-13 `_elect`) picks an unordered-share
+    member uniformly at random — fair in expectation, but a burst of a
+    few hundred publishes routinely lands 2-3× the mean on one member
+    (balls-into-bins), which is exactly the skew that trips slow-
+    consumer backpressure under a million-client mixed workload. This
+    balancer tracks per-member delivery counts per (tenant, group
+    filter) and elects the least-loaded member, ties broken by the
+    service rng — deterministic O(members) per publish, worst-case
+    member spread 1 instead of O(log n / log log n).
+
+    Membership churn self-heals: counts are keyed by receiver_url, a
+    first-seen member seeds at the current group MINIMUM (joining the
+    min tie for a fair share — seeding at zero would flood the cold
+    newcomer with 100% of traffic until it caught up), and departed
+    members' counts are swept once the map outgrows the live set.
+    Bounded: group entries are dropped LRU-ish past ``max_groups`` (the
+    counts are a balancing hint, not correctness state).
+    """
+
+    def __init__(self, rng: random.Random, max_groups: int = 8192) -> None:
+        self._rng = rng
+        self.max_groups = max_groups
+        # (tenant, filter) -> {receiver_url: delivered count}
+        self._counts: Dict[Tuple[str, str], Dict[str, int]] = {}
+        self.elections = 0
+
+    def pick(self, tenant_id: str, mqtt_filter: str, members) -> "Route":
+        self.elections += 1
+        key = (tenant_id, mqtt_filter)
+        counts = self._counts.get(key)
+        if counts is None:
+            if len(self._counts) >= self.max_groups:
+                # drop the oldest half (insertion order ≈ LRU for the
+                # steady case: hot groups re-enter immediately)
+                for k in list(self._counts)[:self.max_groups // 2]:
+                    del self._counts[k]
+            counts = self._counts[key] = {}
+        # a first-seen member SEEDS at the current group minimum: with
+        # lifetime counts, seeding at 0 would route 100% of the group's
+        # traffic to every newcomer until it caught up — the exact cold-
+        # consumer flood this balancer exists to prevent. Seeded, it
+        # simply joins the min tie and takes a fair share from now on.
+        seed = min((counts.get(r.receiver_url) for r in members
+                    if r.receiver_url in counts),
+                   default=0)
+        lo = None
+        lo_members = []
+        for r in members:
+            c = counts.get(r.receiver_url)
+            if c is None:
+                c = counts[r.receiver_url] = seed
+            if lo is None or c < lo:
+                lo, lo_members = c, [r]
+            elif c == lo:
+                lo_members.append(r)
+        elected = (lo_members[0] if len(lo_members) == 1
+                   else lo_members[self._rng.randrange(len(lo_members))])
+        counts[elected.receiver_url] = lo + 1
+        if len(counts) > 4 * len(members) + 8:
+            # membership churned: retain only live members' counts
+            live = {r.receiver_url for r in members}
+            for url in [u for u in counts if u not in live]:
+                del counts[url]
+        return elected
+
+    def spread(self, tenant_id: str, mqtt_filter: str) -> dict:
+        """Per-group balance introspection (bench config 10's
+        share-balance leg and the fairness tests read it)."""
+        counts = self._counts.get((tenant_id, mqtt_filter), {})
+        if not counts:
+            return {"members": 0, "max": 0, "min": 0}
+        vals = list(counts.values())
+        return {"members": len(vals), "max": max(vals), "min": min(vals)}
+
+
 class DistService:
     def __init__(self, sub_brokers: SubBrokerRegistry,
                  event_collector: IEventCollector,
@@ -81,6 +160,10 @@ class DistService:
         self.deliverer_registry = None
         self.server_id = ""
         self._rng = random.Random(rng_seed)
+        # ISSUE 13: unordered-$share election balances on per-member
+        # delivery counts instead of uniform random (ordered share keeps
+        # the stateless rendezvous pick — its contract is stability)
+        self.group_balancer = GroupFanoutBalancer(self._rng)
         # pub-side match cache (ISSUE 4: the shared TenantMatchCache, ≈
         # SubscriptionCache/TenantRouteCache.java:65): matched routes per
         # (tenant, topic) with filter-aware invalidation. The TTL bounds
@@ -404,7 +487,7 @@ class DistService:
                                      tenant_id, {"topic": topic_s}))
         targets: List[Route] = list(matched.normal)
         for mqtt_filter, members in matched.groups.items():
-            elected = self._elect(mqtt_filter, members, topic_s)
+            elected = self._elect(tenant_id, mqtt_filter, members, topic_s)
             if elected is not None:
                 targets.append(elected)
         # byte-based persistent fan-out cap (≈ MaxPersistentFanoutBytes in
@@ -493,14 +576,16 @@ class DistService:
                         tenant_id, route.matcher.filter_levels)
         return fanout
 
-    def _elect(self, mqtt_filter: str, members: List[Route],
-               topic: str) -> Optional[Route]:
+    def _elect(self, tenant_id: str, mqtt_filter: str,
+               members: List[Route], topic: str) -> Optional[Route]:
         """Shared-group member election (≈ DeliverExecutorGroup).
 
         Ordered share: rendezvous hash over (member, topic) — stable per
         topic, redistributes ~1/n on membership change (the reference caches
         the pick; rendezvous gives the same stability statelessly).
-        Unordered share: uniform random.
+        Unordered share (ISSUE 13): least-outstanding balanced election
+        via :class:`GroupFanoutBalancer` — worst-case member spread 1
+        where uniform random gave balls-into-bins skew.
         """
         if not members:
             return None
@@ -511,4 +596,4 @@ class DistService:
                     digest_size=8).digest()
                 return int.from_bytes(h, "little")
             return max(members, key=score)
-        return members[self._rng.randrange(len(members))]
+        return self.group_balancer.pick(tenant_id, mqtt_filter, members)

@@ -103,6 +103,12 @@ class InboxService:
         self.store = ShardedInboxStore(self.kvstore, clock=clock)
         self._tick_task = None
         self.delay = DelayTaskRunner(clock=clock)
+        # ISSUE 13: tenant-fair admission for reconnect drain storms —
+        # every persistent session's CATCH-UP drain (the first fetch
+        # burst after attach) passes through this governor so a mass
+        # reconnect cannot let one tenant's backlog monopolize the broker
+        from ..retained_plane.drain import DrainGovernor
+        self.drain_governor = DrainGovernor()
         # online fetch signalers: (tenant, inbox) -> callback (≈ FetcherSignaler)
         self._signals: Dict[Tuple[str, str], Callable[[], None]] = {}
         # per-inbox locks: store mutation + dist consensus write must be

@@ -77,6 +77,16 @@ NODE_HRCOUNT = 10
 NODE_HRSTART = 11
 NODE_COLS = 12
 
+# ext_tab column indices (ISSUE 13 retained extras plane): the
+# host patcher (retained_plane/patched.py) WRITES these columns and the
+# device walk (ops/retained.retained_walk_ext) GATHERS them — one
+# definition here so the two sides cannot desynchronize (the same
+# single-home contract as the NODE_* columns above).
+EXT_START = 0    # first extra_list index of the node's extras run
+EXT_COUNT = 1    # live entries in the run
+EXT_OWN = 2      # extra_list index of the node's OWN patch slot (-1 none)
+EXT_COLS = 4     # padded to a power of two (16B rows)
+
 _EMPTY = -1
 
 
@@ -424,10 +434,15 @@ def _build_edge_table(edges: List[Tuple[int, int, int, int]],
 #   the patcher tracks parents and re-folds on every interval change.
 #
 # Columns only the retained-mode walk reads (NODE_SUB_END,
-# NODE_SUB_RCOUNT, NODE_SYS_*, NODE_CSTART runs) are refreshed by
-# compaction, not by patches — the match walk never gathers them, and the
-# retained plane compiles its own index. Full compilation survives as
-# background compaction when dead+garbage slots cross
+# NODE_SUB_RCOUNT, NODE_SYS_*, NODE_CSTART runs) are NOT maintained by
+# THIS patcher — the match walk never gathers them. ISSUE 13 closed that
+# gap for the retained plane: RetainedPatchableTrie
+# (retained_plane/patched.py) subclasses this arena machinery and
+# maintains the child-list runs + sys prefixes incrementally, keeps the
+# frozen pre-order subtree ranges exact via in-place tombstones and
+# resurrections, and carries patch-era slots in a separate extras plane
+# the retained walk reads next to the base ranges. Full compilation
+# survives as background compaction when dead+garbage slots cross
 # BIFROMQ_PATCH_FRAG_RATIO of the arena.
 
 

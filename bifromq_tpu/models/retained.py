@@ -5,18 +5,39 @@ bifromq-retain .../store/index/RetainTopicIndex.java:35, rebuilt from KV on
 reset — here rebuilt/compiled from the authoritative per-tenant topic maps).
 The oracle-grade fallback ``match_filter_host`` mirrors RetainMatcher.java:36
 semantics plus the [MQTT-4.7.2-1] root-'$' rule.
+
+ISSUE 13: the index is PATCHED, not rebuilt, on the mutation path —
+RETAIN set/clear/expire fold into the live
+:class:`~bifromq_tpu.retained_plane.patched.RetainedPatchableTrie`
+arenas as in-place row writes (tombstones, resurrections, extras-plane
+appends, child-run maintenance) shipped to device as narrow scatters;
+``compile_tries`` survives only for the first build, reset-from-KV, and
+fragmentation-triggered compaction. The scan side is staged
+(prepare → dispatch → fetch → expand) so the async serving plane
+(retained_plane/scan.py) can thread the shared dispatch-ring/breaker/
+watchdog machinery between the stages exactly like the forward matcher.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..types import RouteMatcher, RouteMatcherType
 from ..utils import topic as topic_util
-from .automaton import (CompiledTrie, compile_tries, tokenize_filters)
+from ..utils.env import env_bool
+from .automaton import (CompiledTrie, PatchFallback, _next_pow2,
+                        compile_tries, tokenize_filters)
 from .oracle import Route, SubscriptionTrie, _TrieNode
+
+
+def retained_patch_enabled() -> bool:
+    """Kill-switch for the in-place retained patch plane
+    (``BIFROMQ_RETAIN_PATCH=0`` restores the rebuild-on-mutation path)."""
+    from .automaton import patch_enabled
+    return patch_enabled() and env_bool("BIFROMQ_RETAIN_PATCH", True)
 
 
 def _topic_route(topic_levels: Sequence[str], topic_str: str) -> Route:
@@ -89,30 +110,107 @@ def match_filter_host(trie: SubscriptionTrie,
     return out
 
 
+class _ScanPrep:
+    """Stage-0 output of the retained scan pipeline: tokenized +
+    uploaded filter probes plus the host mirrors the expansion needs.
+    ``ct``/``recv`` are the SNAPSHOT the walk dispatched against — the
+    matcher's _InFlight discipline: a compaction swapping the compiled
+    base mid-flight (the async leg genuinely awaits between dispatch
+    and expand) must not let old slot ids index a renumbered world."""
+
+    __slots__ = ("queries", "probes", "roots", "lengths", "batch", "ct",
+                 "recv")
+
+    def __init__(self, **kw) -> None:
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
 class RetainedIndex:
     """Per-tenant retained-topic tries + compiled automaton for device probes.
 
-    Mirrors TpuMatcher's mutate-dirty-recompile contract; query side takes
-    wildcard FILTERS (ops.retained walk) instead of topics.
+    Mirrors TpuMatcher's serving contract; query side takes wildcard
+    FILTERS (ops.retained walk) instead of topics. ISSUE 13: mutations
+    fold into the live arenas in place (``rebuilds`` stays 0 under a
+    retained flood; ``compactions`` counts the fragmentation-triggered
+    folds which are the only compiles after the first build).
     """
 
     def __init__(self, *, max_levels: int = 16, k_states: int = 32,
-                 probe_len: int = 16, device=None) -> None:
+                 probe_len: int = 16, device=None,
+                 patched: Optional[bool] = None) -> None:
         self.max_levels = max_levels
         self.k_states = k_states
         self.probe_len = probe_len
         self.device = device
         self.tries: Dict[str, SubscriptionTrie] = {}
         self._compiled: Optional[CompiledTrie] = None
-        self._device_trie = None
+        self._device_tables = None
         self._dirty = True
+        self._patched = (retained_patch_enabled() if patched is None
+                         else patched)
+        # observability: full compiles split by trigger — a retained
+        # flood must keep `rebuilds` at ZERO (ISSUE 13 acceptance);
+        # compaction is the fragmentation fallback
+        self.rebuilds = 0
+        self.compactions = 0
+        self.compile_time_s = 0.0
+        self.patch_fallbacks = 0
+        self.patch_flushes = 0
+        # exact-invalidation consumers (scan cache, retained delta log):
+        # fired per applied mutation with (tenant, levels, op) where op
+        # is "set" | "del"; a full rebuild does NOT fire (results are
+        # content-identical across renumbering)
+        self.delta_hooks: List = []
+        # slot -> retained topic string, capacity-padded object ndarray
+        # so slot ranges expand with one vectorized fancy-index (the
+        # per-slot loop measured ~90 filters/s on the c4 bench)
+        self._receiver_arr = np.empty(0, dtype=object)
+        # the scan plane pins its dispatch ring here so EVERY flush —
+        # including ring-less callers like the coproc's RO wire query —
+        # sees the in-flight scans before deciding to donate
+        self.serving_ring = None
+
+    # ---------------- mutation side (patch-first, ISSUE 13) -----------------
+
+    def _emit_delta(self, tenant_id: str, levels, op: str) -> None:
+        for cb in list(self.delta_hooks):
+            try:
+                cb(tenant_id, tuple(levels), op)
+            except Exception:  # noqa: BLE001 — observers must not break
+                import logging
+                logging.getLogger(__name__).exception("retained delta hook")
+
+    def _patch_base(self):
+        """The live patchable base, or None when patching cannot serve
+        this mutation (no base yet / kill-switch / pending rebuild)."""
+        if not self._patched or self._dirty or self._compiled is None:
+            return None
+        from ..retained_plane.patched import RetainedPatchableTrie
+        ct = self._compiled
+        return ct if isinstance(ct, RetainedPatchableTrie) else None
 
     def add_topic(self, tenant_id: str, topic_levels: Sequence[str],
                   topic_str: str) -> bool:
         trie = self.tries.setdefault(tenant_id, SubscriptionTrie())
-        added = trie.add(_topic_route(topic_levels, topic_str))
+        route = _topic_route(topic_levels, topic_str)
+        added = trie.add(route)
         if added:  # payload replacement leaves the index unchanged
-            self._dirty = True
+            base = self._patch_base()
+            if base is not None:
+                try:
+                    action, slot = base.retained_add(
+                        tenant_id, list(topic_levels), route)
+                    if action == "add":
+                        self._recv_set(slot, topic_str)
+                except PatchFallback:
+                    # patch-era hash collision (astronomically rare):
+                    # never guess — the rebuild re-salts
+                    self.patch_fallbacks += 1
+                    self._dirty = True
+            else:
+                self._dirty = True
+            self._emit_delta(tenant_id, topic_levels, "set")
         return added
 
     def remove_topic(self, tenant_id: str, topic_levels: Sequence[str],
@@ -125,41 +223,105 @@ class RetainedIndex:
         if removed:
             if len(trie) == 0:
                 del self.tries[tenant_id]
-            self._dirty = True
+            base = self._patch_base()
+            if base is not None:
+                try:
+                    if not base.retained_remove(tenant_id,
+                                                list(topic_levels)):
+                        # index/authority drift — rebuild, never serve wrong
+                        self.patch_fallbacks += 1
+                        self._dirty = True
+                except PatchFallback:
+                    self.patch_fallbacks += 1
+                    self._dirty = True
+            else:
+                self._dirty = True
+            self._emit_delta(tenant_id, topic_levels, "del")
         return removed
 
     def topic_count(self, tenant_id: str) -> int:
         trie = self.tries.get(tenant_id)
         return len(trie) if trie is not None else 0
 
+    # ---------------- compile / compaction ----------------------------------
+
+    def _recv_set(self, slot: int, topic_str: str) -> None:
+        if slot >= self._receiver_arr.shape[0]:
+            arr = np.empty(_next_pow2(slot + 1, floor=64), dtype=object)
+            arr[:self._receiver_arr.shape[0]] = self._receiver_arr
+            self._receiver_arr = arr
+        self._receiver_arr[slot] = topic_str
+
+    def frag_pending(self) -> bool:
+        base = self._patch_base()
+        return base is not None and base.frag_pending()
+
     def refresh(self) -> CompiledTrie:
-        if self._dirty or self._compiled is None:
-            self._compiled = compile_tries(self.tries,
-                                           max_levels=self.max_levels,
-                                           probe_len=self.probe_len)
-            from ..ops.match import DeviceTrie
-            self._device_trie = DeviceTrie.from_compiled(self._compiled,
-                                                         device=self.device)
-            # slot -> retained topic string, as one object ndarray so slot
-            # ranges expand with a single vectorized fancy-index instead of
-            # per-slot Python (the range loop measured ~90 filters/s on the
-            # c4 bench; vectorized expansion is ~3 orders faster)
-            self._receiver_arr = np.array(
-                [m.receiver_id for m in self._compiled.matchings],
-                dtype=object)
-            self._dirty = False
+        if self._compiled is None:
+            reason = "first"
+        elif self._dirty:
+            reason = "rebuild"
+        elif self.frag_pending():
+            # fragmentation compaction: the ONLY compile a patched index
+            # runs after its first build (tombstone/garbage reclaim)
+            reason = "compact"
+        else:
+            return self._compiled
+        t0 = time.perf_counter()
+        ct = compile_tries(self.tries, max_levels=self.max_levels,
+                           probe_len=self.probe_len)
+        if self._patched:
+            from ..retained_plane.patched import RetainedPatchableTrie
+            ct = RetainedPatchableTrie(ct)
+        self._compiled = ct
+        from ..ops.retained import RetainedDeviceTables
+        self._device_tables = RetainedDeviceTables.from_trie(
+            ct, device=self.device)
+        arr = np.empty(_next_pow2(max(len(ct.matchings), 1), floor=64),
+                       dtype=object)
+        for i, m in enumerate(ct.matchings):
+            arr[i] = m.receiver_id
+        self._receiver_arr = arr
+        self._dirty = False
+        self.compile_time_s += time.perf_counter() - t0
+        if reason == "rebuild":
+            self.rebuilds += 1
+        elif reason == "compact":
+            self.compactions += 1
         return self._compiled
 
-    def device_probes(self, queries: Sequence[Tuple[str, Sequence[str]]],
-                      *, batch: Optional[int] = None):
-        """Tokenize (tenant, filter_levels) pairs into device filter probes.
+    def flush_device(self, *, ring=None, own_slots: int = 0) -> None:
+        """Ship pending host patches to device as narrow scatters —
+        coalesced, at most one flush per dispatch. Donation only when no
+        in-flight scan can still read the old tables (same proof the
+        forward matcher uses: the caller's own not-yet-dispatched slot
+        plus an empty quarantine)."""
+        base = self._patch_base()
+        if base is None or not base.dirty or self._device_tables is None:
+            return
+        from ..ops.retained import patch_retained_tables
+        if ring is None:
+            # a ring-less caller (sync path, RO query) must still honor
+            # the plane's in-flight scans — donating tables a parked
+            # async walk is reading is the exact use-after-donate the
+            # quarantine discipline exists to prevent
+            ring = self.serving_ring
+            own_slots = 0
+        donate = ring is None or (ring.in_flight <= own_slots
+                                  and not len(ring.quarantine))
+        dev, _stats = patch_retained_tables(
+            self._device_tables, base, device=self.device, donate=donate)
+        self._device_tables = dev
+        self.patch_flushes += 1
 
-        Returns (probes, roots, lengths) — lengths is the host-side
-        per-row level count (-1 = over-deep padding row needing host
-        fallback). The ONE probe-construction definition — match_batch and
-        the benchmark both use it, so they can never desynchronize."""
+    # ---------------- staged scan pipeline (ISSUE 13) -----------------------
+
+    def prepare_scan(self, queries: Sequence[Tuple[str, Sequence[str]]],
+                     *, batch: Optional[int] = None) -> _ScanPrep:
+        """Stage 0: tokenize (tenant, filter_levels) pairs into device
+        filter probes. The ONE probe-construction definition — the sync
+        path, the async plane and the benchmark all use it."""
         from ..ops.retained import FilterProbes
-
         from .matcher import _pow2_batch
 
         ct = self.refresh()
@@ -169,52 +331,95 @@ class RetainedIndex:
         tok = tokenize_filters([f for _, f in queries], roots,
                                max_levels=ct.max_levels, salt=ct.salt,
                                batch=batch)
-        return (FilterProbes.from_tokenized(tok, device=self.device),
-                roots, tok.lengths)
+        return _ScanPrep(queries=list(queries),
+                         probes=FilterProbes.from_tokenized(
+                             tok, device=self.device),
+                         roots=np.asarray(roots, dtype=np.int64),
+                         lengths=tok.lengths, batch=batch, ct=ct)
+
+    def device_probes(self, queries: Sequence[Tuple[str, Sequence[str]]],
+                      *, batch: Optional[int] = None):
+        """Back-compat probe constructor: (probes, roots, lengths)."""
+        prep = self.prepare_scan(queries, batch=batch)
+        return prep.probes, list(prep.roots), prep.lengths
+
+    def dispatch_scan(self, prep: _ScanPrep, *,
+                      k_states: Optional[int] = None,
+                      ring=None, own_slots: int = 0):
+        """Stage 1: flush pending patches, enqueue the extras-aware walk.
+        Returns ``(prep, RetainedScanResult)`` — the result is ENQUEUED,
+        not synchronized, and ``prep`` may be a re-prep: a compaction
+        swap landing between prep and dispatch (the async leg awaits
+        ring admission in the gap) renumbers roots/salt, so the probes
+        re-tokenize against the installed base."""
+        from ..ops.retained import retained_walk_ext
+        if self._compiled is not prep.ct:
+            prep = self.prepare_scan(prep.queries, batch=prep.batch)
+        self.flush_device(ring=ring, own_slots=own_slots)
+        # snapshot the slot→topic mirror AT dispatch: later growth
+        # reallocates the array, and a later compaction renumbers slots
+        # entirely — emitted ids must expand against THIS world
+        prep.recv = self._receiver_arr
+        res = retained_walk_ext(self._device_tables, prep.probes,
+                                probe_len=prep.ct.probe_len,
+                                k_states=k_states or self.k_states)
+        return prep, res
+
+    @staticmethod
+    def fetch_scan(res):
+        """Stage 2: the one true synchronization — writable host copies
+        (escalation clears rescued rows in place)."""
+        return (np.asarray(res.start), np.asarray(res.count),
+                np.array(res.overflow))
 
     def walk_device(self, probes, *, k_states: Optional[int] = None):
-        """Dispatch the retained walk on the current compiled tables."""
-        from ..ops.retained import retained_walk
+        """Dispatch the retained walk on the current compiled tables
+        (back-compat surface: returns (base ranges, overflow))."""
+        from ..ops.retained import retained_walk_ext
+        self.refresh()
+        self.flush_device()
+        res = retained_walk_ext(self._device_tables, probes,
+                                probe_len=self._compiled.probe_len,
+                                k_states=k_states or self.k_states)
+        return res.start, res.overflow
 
-        ct = self.refresh()
-        return retained_walk(self._device_trie, probes,
-                             probe_len=ct.probe_len,
-                             k_states=k_states or self.k_states)
+    # ---------------- expansion (stage 3) -----------------------------------
 
-    def match_batch(self, queries: Sequence[Tuple[str, Sequence[str]]],
-                    *, batch: Optional[int] = None,
+    def expand_scan(self, prep: _ScanPrep, fetched,
                     limit: Optional[int] = None) -> List[List[str]]:
-        """(tenant, filter_levels) pairs → matched retained topic strings.
-
-        ``limit`` bounds expansion per query (scan-bounded like the
-        reference's RetainMessageMatchLimit): expired entries filtered by the
-        caller may reduce the final result below the limit.
-        """
-        if not queries:
-            return []
-        probes, roots, lengths = self.device_probes(queries, batch=batch)
-        ranges, overflow = self.walk_device(probes)
+        """ranges → retained topic strings: native/host escalation for
+        overflow rows, extras-plane resolution, dead-slot filtering, and
+        scan-bounded ``limit`` trimming — all against host mirrors."""
+        queries = prep.queries
         nq = len(queries)
-        ranges = np.asarray(ranges)[:nq]            # [Q, R, 2]
-        # writable copy: escalation clears rescued rows in place (a bare
-        # asarray view of a jax buffer is read-only)
-        overflow = np.array(overflow)[:nq]
-        lengths = np.asarray(lengths)[:nq]
-        roots_a = np.asarray(roots[:nq])
+        base_r, ext_r, overflow = fetched
+        base_r = base_r[:nq]
+        ext_r = ext_r[:nq]
+        overflow = np.array(overflow[:nq])    # writable: escalation clears
+        lengths = np.asarray(prep.lengths)[:nq]
+        roots_a = prep.roots[:nq]
+        # the dispatch-time snapshot, NOT the live index: a compaction
+        # landing mid-flight must not renumber under this expansion
+        ct = prep.ct
+        recv = getattr(prep, "recv", None)
+        if recv is None:
+            recv = self._receiver_arr
+        from ..retained_plane.patched import RetainedPatchableTrie
+        base = ct if isinstance(ct, RetainedPatchableTrie) else None
+        pristine = base is None or base.pristine
+        kind_arr = ct.slot_kind if (base is not None
+                                    and base.dead_slots) else None
 
-        # native escalation: rows whose '+'-expansion outgrew the device
-        # lane budget resolve EXACTLY via the C++ DFS over the same
-        # compiled tables (native/retainedwalk.cpp — no lane concept, no
-        # extra XLA compile; ~two orders faster than the Python oracle,
-        # which stays as the last-resort fallback when the range budget
-        # blows or no compiler exists)
-        esc = np.nonzero(overflow & (lengths >= 0)
-                         & (roots_a >= 0))[0]
-        native_map: Dict[int, np.ndarray] = {}
-        if esc.size:
+        # native escalation: '+'-exploded rows resolve via the C++ DFS
+        # over the same compiled tables — ONLY while the base is
+        # pristine (the native walker reads the frozen subtree ranges;
+        # patch-era extras/tombstones route overflow rows to the exact
+        # Python oracle until the next compaction)
+        native_map: Dict[int, tuple] = {}
+        esc = np.nonzero(overflow & (lengths >= 0) & (roots_a >= 0))[0]
+        if esc.size and pristine:
             try:
                 from .native_retained import match_rows_native
-                ct = self._compiled
                 sub_tok = tokenize_filters(
                     [list(queries[i][1]) for i in esc],
                     [int(roots_a[i]) for i in esc],
@@ -235,49 +440,98 @@ class RetainedIndex:
             except Exception:  # noqa: BLE001 — no compiler / load failure:
                 pass    # rows stay on the (exact) oracle path
 
-        starts = ranges[..., 0].astype(np.int64)
-        counts = np.maximum(ranges[..., 1], 0).astype(np.int64)
+        starts = base_r[..., 0].astype(np.int64)
+        counts = np.maximum(base_r[..., 1], 0).astype(np.int64)
+        estarts = ext_r[..., 0].astype(np.int64)
+        ecounts = np.maximum(ext_r[..., 1], 0).astype(np.int64)
         host_rows = overflow | (lengths < 0)
-        counts[host_rows | (roots_a < 0)] = 0   # row mask: no device expansion
+        row_mask = host_rows | (roots_a < 0)
+        counts[row_mask] = 0
+        ecounts[row_mask] = 0
         for qi in native_map:
             counts[qi] = 0      # grid contributes nothing for native rows
+            ecounts[qi] = 0
         if limit is not None:
-            # clip each query's ranges so the cumulative expansion stops
-            # at the cap (scan-bounded like RetainMessageMatchLimit)
-            cum = np.cumsum(counts, axis=1)
-            counts = np.clip(limit - (cum - counts), 0, counts)
-        fc = counts.ravel()
-        total = int(fc.sum())
-        if total:
-            # ragged arange: one flat slot-index vector for the whole batch
+            # clip the CONCATENATED base+extras counts so expansion stops
+            # at the cap (scan-bounded like RetainMessageMatchLimit); a
+            # base with tombstones gets dead-slot head-room, trimmed back
+            # after host filtering
+            cap = limit if kind_arr is None \
+                else limit + base.expansion_budget()
+            all_c = np.concatenate([counts, ecounts], axis=1)
+            cum = np.cumsum(all_c, axis=1)
+            all_c = np.clip(cap - (cum - all_c), 0, all_c)
+            counts = all_c[:, :counts.shape[1]]
+            ecounts = all_c[:, counts.shape[1]:]
+
+        def _ragged(st, ct_):
+            fc = ct_.ravel()
+            total = int(fc.sum())
+            if not total:
+                return (np.empty(0, dtype=np.int64),
+                        np.zeros(nq + 1, dtype=np.int64))
             offs = np.cumsum(fc) - fc
             flat = (np.arange(total, dtype=np.int64)
-                    - np.repeat(offs, fc) + np.repeat(starts.ravel(), fc))
-            recv = self._receiver_arr[flat]
+                    - np.repeat(offs, fc) + np.repeat(st.ravel(), fc))
+            row_offs = np.concatenate(
+                [np.zeros(1, np.int64), np.cumsum(ct_.sum(axis=1))])
+            return flat, row_offs
+
+        bslots, boffs = _ragged(starts, counts)
+        eidx, eoffs = _ragged(estarts, ecounts)
+        if eidx.size:
+            extra_host = base.extra_list
+            eslots = extra_host[eidx].astype(np.int64)
         else:
-            recv = np.empty(0, dtype=object)
-        chunks = np.split(recv, np.cumsum(counts.sum(axis=1))[:-1])
+            eslots = eidx
 
         out: List[List[str]] = []
         for qi, (tenant_id, levels) in enumerate(queries):
             if roots_a[qi] < 0:
                 out.append([])
-            elif qi in native_map:
+                continue
+            if qi in native_map:
                 s0, c0 = native_map[qi]
                 tot = int(c0.sum())
                 if tot:
                     o = np.cumsum(c0) - c0
                     flat = (np.arange(tot, dtype=np.int64)
                             - np.repeat(o, c0) + np.repeat(s0, c0))
-                    out.append(list(self._receiver_arr[flat]))
+                    out.append(list(recv[flat]))
                 else:
                     out.append([])
-            elif host_rows[qi]:
-                out.append(match_filter_host(self.tries[tenant_id],
-                                             list(levels), limit=limit))
-            else:
-                out.append(list(chunks[qi]))
+                continue
+            if host_rows[qi]:
+                trie = self.tries.get(tenant_id)
+                out.append(match_filter_host(trie, list(levels),
+                                             limit=limit)
+                           if trie is not None else [])
+                continue
+            row = np.concatenate([bslots[boffs[qi]:boffs[qi + 1]],
+                                  eslots[eoffs[qi]:eoffs[qi + 1]]])
+            if kind_arr is not None and row.size:
+                row = row[kind_arr[row] != CompiledTrie.SLOT_DEAD]
+            if limit is not None and row.size > limit:
+                row = row[:limit]
+            out.append(list(recv[row]) if row.size else [])
         return out
+
+    # ---------------- sync entry points -------------------------------------
+
+    def match_batch(self, queries: Sequence[Tuple[str, Sequence[str]]],
+                    *, batch: Optional[int] = None,
+                    limit: Optional[int] = None) -> List[List[str]]:
+        """(tenant, filter_levels) pairs → matched retained topic strings.
+
+        ``limit`` bounds expansion per query (scan-bounded like the
+        reference's RetainMessageMatchLimit): expired entries filtered by
+        the caller may reduce the final result below the limit.
+        """
+        if not queries:
+            return []
+        prep = self.prepare_scan(queries, batch=batch)
+        prep, res = self.dispatch_scan(prep)
+        return self.expand_scan(prep, self.fetch_scan(res), limit=limit)
 
     def match(self, tenant_id: str, filter_levels: Sequence[str],
               limit: Optional[int] = None) -> List[str]:

@@ -11,13 +11,16 @@ scheduler); on disconnect the inbox detaches and expires on its own clock.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Dict, Optional, Set
 
+from .. import trace
 from ..inbox.service import InboxService
 from ..inbox.store import LWT
 from ..plugin.events import Event, EventType
 from ..types import Message, QoS, TopicFilterOption
 from ..utils.hlc import HLC
+from ..utils.metrics import STAGES
 from . import packets as pk
 from .protocol import PROTOCOL_MQTT5, ReasonCode
 from .session import BLOCKED, Session, Subscription
@@ -184,57 +187,96 @@ class PersistentSession(Session):
 
     async def _fetch_loop(self) -> None:
         tenant = self.client_info.tenant_id
+        catchup = True
         try:
             while not self.closed:
                 await self._fetch_wake.wait()
                 self._fetch_wake.clear()
-                while not self.closed:
-                    budget = self._client_recv_max - len(self._pid_to_seq)
-                    fetched = self.inbox.store.fetch(
-                        tenant, self.inbox_id, max_fetch=100,
-                        qos0_after=self._qos0_cursor,
-                        buffer_after=self._buf_cursor,
-                        max_buffer=max(0, budget))
+                if catchup:
+                    # ISSUE 13: the CATCH-UP drain (offline backlog at
+                    # reconnect) is admission-governed and measured —
+                    # a mass-reconnect storm stays tenant-fair and the
+                    # drain cost lands in the `inbox.drain` stage and
+                    # the tenant's SLO windows. Steady-state wakes
+                    # (live traffic) bypass the governor.
+                    catchup = False
+                    governor = getattr(self.inbox, "drain_governor", None)
+                    t0 = time.perf_counter()
+                    with trace.span("inbox.drain", tenant=tenant,
+                                    inbox=self.inbox_id) as sp:
+                        if governor is not None:
+                            async with governor.slot(tenant):
+                                fetched = await self._drain_pages(tenant)
+                        else:
+                            fetched = await self._drain_pages(tenant)
+                        if sp is not trace.NOOP:
+                            sp.set_tag("fetched", fetched or 0)
+                    dt = time.perf_counter() - t0
+                    STAGES.record("inbox.drain", dt)
+                    from ..obs import OBS
+                    OBS.record_latency(tenant, "inbox.drain", dt)
                     if fetched is None:
-                        return
-                    if fetched.qos0 or fetched.buffer:
-                        # ≈ MsgFetched (inbox fetcher drained a page)
-                        self.events.report(Event(
-                            EventType.MSG_FETCHED, tenant,
-                            {"count": len(fetched.qos0)
-                             + len(fetched.buffer)}))
-                    if not fetched.qos0 and not fetched.buffer:
-                        if budget <= 0 and self._pid_to_seq \
-                                and not self._stall_reported:
-                            # window full — but only a genuine backlog is a
-                            # stall (fetch(max_buffer=0) can't tell "empty"
-                            # from "window-gated"; a 1-message probe can,
-                            # and fetch never advances cursors)
-                            probe = self.inbox.store.fetch(
-                                tenant, self.inbox_id, max_fetch=1,
-                                qos0_after=self._qos0_cursor,
-                                buffer_after=self._buf_cursor, max_buffer=1)
-                            if probe is not None and probe.buffer:
-                                self._report_stalled()
-                        break  # drained (or window full): wait for a wake
-                    for seq, topic, msg in fetched.qos0:
-                        self._qos0_cursor = seq
-                        await self._push(topic, msg)
-                    if fetched.qos0:
-                        # qos0 committed on send (reference: commit after push)
-                        await self.inbox.store.commit(tenant, self.inbox_id,
-                                                qos0_up_to=self._qos0_cursor)
-                    blocked = False
-                    for seq, topic, msg in fetched.buffer:
-                        if not await self._push(topic, msg, buffer_seq=seq):
-                            blocked = True
-                            break  # retry this seq after acks free the window
-                        self._buf_cursor = seq
-                    if blocked:
-                        self._report_stalled()
-                        break  # _commit_acked wakes us
+                        return      # inbox gone (kicked/deleted)
+                else:
+                    if await self._drain_pages(tenant) is None:
+                        return      # inbox gone (kicked/deleted)
         except asyncio.CancelledError:
             pass
+
+    async def _drain_pages(self, tenant: str) -> Optional[int]:
+        """Drain inbox pages until empty/blocked; returns messages
+        pushed, or None when the inbox is gone (the fetch loop exits) —
+        the one page-pump definition, catch-up and steady-state wakes
+        share it."""
+        drained = 0
+        while not self.closed:
+            budget = self._client_recv_max - len(self._pid_to_seq)
+            fetched = self.inbox.store.fetch(
+                tenant, self.inbox_id, max_fetch=100,
+                qos0_after=self._qos0_cursor,
+                buffer_after=self._buf_cursor,
+                max_buffer=max(0, budget))
+            if fetched is None:
+                return None     # inbox deleted/taken over: stop fetching
+            if fetched.qos0 or fetched.buffer:
+                # ≈ MsgFetched (inbox fetcher drained a page)
+                self.events.report(Event(
+                    EventType.MSG_FETCHED, tenant,
+                    {"count": len(fetched.qos0)
+                     + len(fetched.buffer)}))
+            if not fetched.qos0 and not fetched.buffer:
+                if budget <= 0 and self._pid_to_seq \
+                        and not self._stall_reported:
+                    # window full — but only a genuine backlog is a
+                    # stall (fetch(max_buffer=0) can't tell "empty"
+                    # from "window-gated"; a 1-message probe can,
+                    # and fetch never advances cursors)
+                    probe = self.inbox.store.fetch(
+                        tenant, self.inbox_id, max_fetch=1,
+                        qos0_after=self._qos0_cursor,
+                        buffer_after=self._buf_cursor, max_buffer=1)
+                    if probe is not None and probe.buffer:
+                        self._report_stalled()
+                break  # drained (or window full): wait for a wake
+            for seq, topic, msg in fetched.qos0:
+                self._qos0_cursor = seq
+                await self._push(topic, msg)
+                drained += 1
+            if fetched.qos0:
+                # qos0 committed on send (reference: commit after push)
+                await self.inbox.store.commit(tenant, self.inbox_id,
+                                              qos0_up_to=self._qos0_cursor)
+            blocked = False
+            for seq, topic, msg in fetched.buffer:
+                if not await self._push(topic, msg, buffer_seq=seq):
+                    blocked = True
+                    break  # retry this seq after acks free the window
+                self._buf_cursor = seq
+                drained += 1
+            if blocked:
+                self._report_stalled()
+                break  # _commit_acked wakes us
+        return drained
 
     async def _push(self, topic: str, msg: Message,
                     buffer_seq: Optional[int] = None) -> bool:

@@ -140,6 +140,39 @@ class ObsHub:
         ref = getattr(self, "_pub_cache_ref", None)
         return ref() if ref is not None else None
 
+    # ---------------- retained & session plane (ISSUE 13) -------------------
+
+    def register_retained_plane(self, plane) -> None:
+        """Weakly track a retained scan plane so ``/metrics`` can serve
+        a "retained" section (scans/degradations/cache efficiency per
+        range replica) without pinning torn-down services."""
+        if not hasattr(self, "_retained_planes"):
+            self._retained_planes = weakref.WeakSet()
+        self._retained_planes.add(plane)
+
+    def register_drain_governor(self, gov) -> None:
+        if not hasattr(self, "_drain_governors"):
+            self._drain_governors = weakref.WeakSet()
+        self._drain_governors.add(gov)
+
+    def retained_snapshot(self) -> dict:
+        """The ``/metrics`` "retained" section: every live scan plane's
+        serve/degrade/cache counters + every drain governor's admission
+        state (best-effort; introspection must never raise)."""
+        planes = []
+        for p in list(getattr(self, "_retained_planes", ()) or ()):
+            try:
+                planes.append(p.snapshot())
+            except Exception:  # noqa: BLE001
+                continue
+        drains = []
+        for g in list(getattr(self, "_drain_governors", ()) or ()):
+            try:
+                drains.append(g.snapshot())
+            except Exception:  # noqa: BLE001
+                continue
+        return {"scan_planes": planes, "drain_governors": drains}
+
     def bind_registry(self, registry) -> None:
         """Weakly remember the metrics registry so exporter snapshots can
         include the monotonic per-tenant counters."""

@@ -33,8 +33,13 @@ Three legs share one stream:
 
 Module map: ``records`` (wire codecs), ``stream`` (per-range
 ``DeltaLog`` + ``ReplicationHub``), ``standby`` (``WarmStandby`` +
-``InvalidationPuller``). ``GET /replication`` serves
-:func:`status_report`.
+``StandbySupervisor`` — the ISSUE 13 multi-range/split-following
+lifecycle owner — + ``InvalidationPuller``). ``GET /replication``
+serves :func:`status_report`; since ISSUE 13 the hub registry also
+carries the retained plane's per-range delta logs
+(``retained_plane/cache.RetainedDeltaLog`` — same ``since()`` gap
+contract, lean ``(seq, hlc, tenant, topic, op)`` records feeding the
+scan cache's exact invalidation).
 """
 
 from __future__ import annotations

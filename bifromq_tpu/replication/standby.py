@@ -374,6 +374,121 @@ class WarmStandby:
                 "overlay": self.matcher.overlay_size}
 
 
+class StandbySupervisor:
+    """Multi-range warm-standby supervisor (ISSUE 13 satellite; the
+    PR 12 follow-up (a)).
+
+    A bare :class:`WarmStandby` tracks exactly ONE range. Real workers
+    host many ranges and SPLIT them under load, so a failover target
+    needs the whole set warm: the supervisor polls ``repl_status`` at
+    ``poll_s`` cadence, spawns one per-range ``WarmStandby`` applier for
+    every range the worker reports (splits simply surface as new range
+    ids on the next poll), and retires appliers whose ranges vanished
+    (merge/decommission). Each applier runs its own attach/resync/delta
+    loop — the supervisor owns lifecycle only, so a mid-split resync on
+    one range never stalls the others.
+
+    ``promote_all()`` is the failover half: cancel every sync loop and
+    hand back the warm per-range matchers keyed by range id — flag
+    flips, no rebuilds, exactly the single-range ``promote()`` contract
+    fanned out.
+    """
+
+    def __init__(self, registry=None, *, service: str = SERVICE,
+                 device=None, endpoint: Optional[str] = None,
+                 poll_s: float = 1.0, ranges_fn=None,
+                 standby_factory=None) -> None:
+        self.registry = registry
+        self.service = service
+        self.device = device
+        self.poll_s = poll_s
+        self._endpoint = endpoint
+        self.standbys: Dict[str, WarmStandby] = {}
+        self.spawned = 0
+        self.retired = 0
+        self.polls = 0
+        if standby_factory is None:
+            def standby_factory(range_id: str) -> WarmStandby:
+                return WarmStandby(self.registry, service=self.service,
+                                   range_id=range_id, device=self.device,
+                                   endpoint=self._endpoint)
+        self._standby_factory = standby_factory
+        self._ranges_fn = ranges_fn or self._rpc_ranges
+        self._task: Optional[asyncio.Task] = None
+        register_standby(self)
+
+    async def _rpc_ranges(self) -> List[str]:
+        import json
+        if self._endpoint is None:
+            eps = list(self.registry.endpoints(self.service))
+            if not eps:
+                return []
+            self._endpoint = eps[0]
+        out = await self.registry.client_for(self._endpoint).call(
+            self.service, "repl_status", b"", timeout=5.0)
+        status = json.loads(out.decode())
+        return [r["range"] for r in status.get("ranges", ())]
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except BaseException:  # noqa: BLE001 — cancellation
+                pass
+        for sb in self.standbys.values():
+            await sb.stop()
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self.poll_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — keep polling
+                log.warning("standby supervisor poll failed: %r", e)
+            await asyncio.sleep(self.poll_s)
+
+    async def poll_once(self) -> None:
+        """One reconcile pass: spawn appliers for new ranges (splits),
+        retire appliers for vanished ones."""
+        self.polls += 1
+        live = set(await self._ranges_fn())
+        for rid in sorted(live - set(self.standbys)):
+            sb = self._standby_factory(rid)
+            self.standbys[rid] = sb
+            await sb.start()
+            self.spawned += 1
+        for rid in sorted(set(self.standbys) - live):
+            sb = self.standbys.pop(rid)
+            await sb.stop()
+            self.retired += 1
+
+    def promote_all(self) -> Dict[str, object]:
+        """Failover: every applier's sync loop is cancelled and its warm
+        matcher handed back, keyed by range id."""
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+        return {rid: sb.promote() for rid, sb in self.standbys.items()}
+
+    def lag(self) -> Dict[str, int]:
+        return {rid: sb.lag() for rid, sb in self.standbys.items()}
+
+    def status(self) -> dict:
+        return {"role": "standby-supervisor", "service": self.service,
+                "ranges": sorted(self.standbys),
+                "spawned": self.spawned, "retired": self.retired,
+                "polls": self.polls,
+                "attached": sum(1 for s in self.standbys.values()
+                                if s.attached)}
+
+
 class InvalidationPuller:
     """Exact pub-cache invalidation for frontends with a REMOTE
     dist-worker: long-polls ``repl_inval`` on every worker endpoint and

@@ -55,17 +55,43 @@ def dec_retained(buf: bytes):
 
 
 class RetainCoProc(IKVRangeCoProc):
-    """Applies retain SET/DEL deterministically; derived index per replica."""
+    """Applies retain SET/DEL deterministically; derived index per replica.
+
+    ISSUE 13: the derived index is PATCHED in place per applied op (the
+    apply stream is exactly the retained delta stream), and the coproc
+    fans each applied mutation out to ``delta_consumers`` — the scan
+    cache's exact invalidation and the per-range retained delta log —
+    for raft-replayed mutations too. ``reset`` (rebuild-from-KV) emits
+    the wholesale ``(None, None, "reset")`` record, the retained twin of
+    a stream anchor.
+    """
 
     def __init__(self, index: Optional[RetainedIndex] = None) -> None:
         from ..kv.load import KVLoadRecorder
 
+        # (tenant, topic_levels, op) consumers; op in set|del|reset
+        self.delta_consumers: list = []
+        # the SUBSCRIBE-side serving plane (armed by RetainService; a
+        # bare coproc — tests, RO query — serves without one)
+        self.scan_plane = None
         self.index = index or RetainedIndex()
+        self._arm_index(self.index)
         # tenant -> topic -> value bytes (decoded lazily by the service)
         self.values: Dict[str, Dict[str, bytes]] = {}
         # multi-range hosting (boundary bounce + load profile)
         self.boundary = None
         self.load_recorder = KVLoadRecorder()
+
+    def _arm_index(self, index: RetainedIndex) -> None:
+        index.delta_hooks.append(self._emit_delta)
+
+    def _emit_delta(self, tenant, topic_levels, op) -> None:
+        for cb in list(self.delta_consumers):
+            try:
+                cb(tenant, topic_levels, op)
+            except Exception:  # noqa: BLE001 — observers must not break
+                import logging
+                logging.getLogger(__name__).exception("retain delta hook")
 
     def reset(self, reader: IKVSpace) -> None:
         self.index = RetainedIndex(max_levels=self.index.max_levels,
@@ -76,6 +102,10 @@ class RetainCoProc(IKVRangeCoProc):
             tenant, topic = schema.split_retain_key(key)
             self.values.setdefault(tenant, {})[topic] = value
             self.index.add_topic(tenant, topic_util.parse(topic), topic)
+        # the rebuilt world renumbers everything: consumers degrade to
+        # their wholesale form (scan cache bump), THEN new deltas flow
+        self._arm_index(self.index)
+        self._emit_delta(None, None, "reset")
 
     # RO wildcard match over the wire (retain-store-as-a-service read
     # side, ≈ RetainStoreCoProc's RO batchMatch): a replica-less frontend

@@ -57,6 +57,7 @@ class RetainService:
         self.throttler = throttler or AllowAllResourceThrottler()
         self.clock = clock
         self.tick_interval = tick_interval
+        self._node_id = node_id
         engine = engine or InMemKVEngine()
         self._transport = (transport if transport is not None
                            else InMemTransport())
@@ -81,12 +82,27 @@ class RetainService:
         self._tick_task = None
 
     def _mk_coproc(self, rid: str):
+        from ..retained_plane import RetainedDeltaLog, RetainedScanPlane
         from .coproc import RetainCoProc
         tmpl = self._index_template
         idx = (RetainedIndex(max_levels=tmpl.max_levels,
                              k_states=tmpl.k_states)
                if tmpl is not None else None)
-        return RetainCoProc(idx)
+        coproc = RetainCoProc(idx)
+        # ISSUE 13: the SUBSCRIBE-side scan plane (dispatch ring +
+        # breaker + watchdog + filter-keyed cache) per range replica; the
+        # index indirection survives reset-from-KV swaps
+        plane = RetainedScanPlane(lambda: coproc.index)
+        coproc.scan_plane = plane
+        # per-range retained delta stream (GET /replication visibility +
+        # the exact-invalidation feed; fires for raft-replayed ops too)
+        log = RetainedDeltaLog(self._node_id, rid)
+        if plane.cache is not None:
+            coproc.delta_consumers.append(plane.cache.on_delta)
+        coproc.delta_consumers.append(
+            lambda tenant, levels, op:
+                log.append(tenant or "", levels or (), op))
+        return coproc
 
     # ---------------- per-range access -------------------------------------
 
@@ -233,8 +249,16 @@ class RetainService:
         raw: List[List[str]] = [[] for _ in queries]
         for rid, idxs in range_queries.items():
             sub = [queries[qi] for qi in idxs]
-            res = self.kvstore.coprocs[rid].index.match_batch(sub,
-                                                             limit=limit)
+            coproc = self.kvstore.coprocs[rid]
+            plane = getattr(coproc, "scan_plane", None)
+            if plane is not None:
+                # ISSUE 13: device scans serve through the shared
+                # ring/breaker/watchdog plane — `retain.scan` span +
+                # stage, filter-keyed cache, per-tenant SLO feeds,
+                # oracle degradation on timeout/breaker-open
+                res = await plane.scan_batch(sub, limit=limit)
+            else:
+                res = coproc.index.match_batch(sub, limit=limit)
             for qi, topics in zip(idxs, res):
                 raw[qi].extend(topics)
         now = self.clock()

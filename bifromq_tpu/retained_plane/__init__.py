@@ -1,0 +1,45 @@
+"""Retained & persistent-session serving plane (ISSUE 13).
+
+The paper reuses the compiled trie kernel for retain-store's wildcard
+lookup, but until this package the reproduction's retained side was a
+cold subsystem: every RETAIN mutation re-ran ``compile_tries`` over the
+whole topic population and SUBSCRIBE-time scans ran a bare synchronous
+dispatch outside every resilience/observability plane built since PR 6.
+This package promotes it to a first-class device-resident serving plane:
+
+- :mod:`patched` — :class:`RetainedPatchableTrie`: RETAIN set/clear/
+  expire become in-place arena patches. The retained-mode columns the
+  forward match walk never reads (NODE_CSTART/NODE_CCOUNT child-list
+  runs, NODE_SYS_CCOUNT sys prefixes) are maintained incrementally;
+  the frozen pre-order subtree ranges (NODE_SUB_RCOUNT/NODE_SYS_SLOTS)
+  stay exact for base-era slots while patch-era topics ride a separate
+  per-node **extras** plane (``ext_tab`` + ``extra_list``) the device
+  walk reads next to the base ranges — TrieJax's relational framing
+  again: the delta of a trie under concrete-topic inserts is a handful
+  of orderable row writes, never a rebuild.
+- :mod:`scan` — :class:`RetainedScanPlane`: device-side wildcard
+  retained scans on SUBSCRIBE served through the shared dispatch-ring /
+  device-breaker / watchdog machinery (``retain.scan`` span + stage,
+  oracle degradation on timeout/breaker-open) with a filter-keyed
+  result cache evicted EXACTLY by retained deltas.
+- :mod:`cache` — :class:`RetainedScanCache`: the filter-keyed result
+  cache + :class:`RetainedDeltaLog`, the seq'd per-range retained delta
+  stream (same gap/wholesale-bump degradation contract as the PR 12
+  route stream; surfaces under ``GET /replication``).
+- :mod:`drain` — :class:`DrainGovernor`: tenant-fair admission for
+  offline-inbox drain storms at reconnect (``inbox.drain`` span +
+  stage), so a mass reconnect cannot let one tenant's backlog monopolize
+  the broker.
+"""
+
+from __future__ import annotations
+
+from .cache import RetainedDeltaLog, RetainedScanCache
+from .drain import DrainGovernor
+from .patched import RetainedPatchableTrie
+from .scan import RetainedScanPlane
+
+__all__ = [
+    "RetainedPatchableTrie", "RetainedScanPlane", "RetainedScanCache",
+    "RetainedDeltaLog", "DrainGovernor",
+]

@@ -1,0 +1,156 @@
+"""Tenant-fair admission for offline-inbox drain storms (ISSUE 13
+tentpole part 3, drain half).
+
+A mass reconnect (broker restart, network partition heal) wakes
+thousands of persistent sessions at once, and every one of them starts
+draining its offline backlog through the inbox store — consensus reads,
+send-path work and ack windows all at once. Untamed, the biggest
+tenant's reconnect herd monopolizes the broker exactly when it is most
+fragile. ``DrainGovernor`` bounds the storm with the same
+:class:`~bifromq_tpu.resilience.device.BoundedSlots` machinery that
+bounds the dispatch ring and the QoS1 ingest gate:
+
+- a **global** slot pool (``BIFROMQ_DRAIN_SLOTS``) caps concurrent
+  catch-up drains process-wide,
+- a **per-tenant** pool (``BIFROMQ_DRAIN_PER_TENANT``) caps any one
+  tenant's share of it, so tenant B's two reconnects never wait behind
+  tenant A's two thousand,
+- tenants currently flagged by the PR 3 noisy-neighbor detector yield
+  one scheduling beat before queuing while other drains are waiting —
+  quiet tenants' sessions reach the global pool first under pressure.
+
+The governed section is the persistent session's CATCH-UP drain (the
+first fetch burst after attach — ``inbox.drain`` span + stage,
+mqtt/persistent.py); steady-state wakes are cheap and bypass.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, Optional
+
+from ..resilience.device import BoundedSlots
+from ..utils.env import env_int
+
+_NOISY_YIELD_S = 0.005
+
+
+def drain_slots() -> int:
+    """Concurrent catch-up drains admitted process-wide."""
+    return max(1, env_int("BIFROMQ_DRAIN_SLOTS", 64))
+
+
+def drain_per_tenant() -> int:
+    """One tenant's cap on those slots."""
+    return max(1, env_int("BIFROMQ_DRAIN_PER_TENANT", 8))
+
+
+class _DrainSlot:
+    """``async with governor.slot(tenant):`` — acquires tenant-then-
+    global (one fixed order; both pools are plain BoundedSlots)."""
+
+    __slots__ = ("gov", "tenant", "_held", "_gate")
+
+    def __init__(self, gov: "DrainGovernor", tenant: str) -> None:
+        self.gov = gov
+        self.tenant = tenant
+        self._held = False
+        self._gate = None
+
+    async def __aenter__(self):
+        gov = self.gov
+        if gov.noisy_fn(self.tenant) and gov._global.waiting > 0:
+            # pressure + a noisy tenant: yield one beat so quiet
+            # tenants' drains enqueue ahead of the herd
+            gov.deferred_total += 1
+            await asyncio.sleep(_NOISY_YIELD_S)
+        t0 = time.perf_counter()
+        # pin the gate OBJECT for the slot's lifetime: the governor's
+        # cardinality sweep may drop/recreate map entries meanwhile
+        self._gate = gov._tenant_gate(self.tenant)
+        await self._gate.acquire()
+        try:
+            await gov._global.acquire()
+        except BaseException:
+            self._gate.release()
+            raise
+        self._held = True
+        gov.admitted_total += 1
+        gov.wait_s_total += time.perf_counter() - t0
+        return self
+
+    async def __aexit__(self, *exc):
+        if self._held:
+            self._held = False
+            self.gov._global.release()
+            self._gate.release()
+            d = self.gov.drained_by_tenant
+            d[self.tenant] = d.get(self.tenant, 0) + 1
+            if len(d) > 4096:
+                for k in list(d)[:2048]:
+                    del d[k]
+        return False
+
+
+class DrainGovernor:
+    def __init__(self, *, slots: Optional[int] = None,
+                 per_tenant: Optional[int] = None,
+                 noisy_fn=None) -> None:
+        # env knobs resolve lazily at first use (R3 discipline); explicit
+        # ctor values stay pinned
+        self._slots = slots
+        self._per_tenant = per_tenant
+        self._global_pool: Optional[BoundedSlots] = None
+        self._tenants: Dict[str, BoundedSlots] = {}
+        if noisy_fn is None:
+            def noisy_fn(tenant: str) -> bool:
+                from ..obs import OBS
+                return OBS.is_noisy(tenant)
+        self.noisy_fn = noisy_fn
+        self.admitted_total = 0
+        self.deferred_total = 0
+        self.wait_s_total = 0.0
+        # per-tenant completed-drain totals, served by snapshot() (top
+        # slice) and bounded: past 4096 tenants the coldest half drops
+        self.drained_by_tenant: Dict[str, int] = {}
+        from ..obs import OBS
+        OBS.register_drain_governor(self)   # /metrics "retained" section
+
+    @property
+    def _global(self) -> BoundedSlots:
+        if self._global_pool is None:
+            self._global_pool = BoundedSlots(
+                self._slots if self._slots is not None else drain_slots())
+        return self._global_pool
+
+    def _tenant_gate(self, tenant: str) -> BoundedSlots:
+        gate = self._tenants.get(tenant)
+        if gate is None:
+            if len(self._tenants) > 16384:
+                # bounded cardinality: drop idle gates (an in-flight
+                # drain holds its gate object via the slot, not the map)
+                self._tenants = {t: g for t, g in self._tenants.items()
+                                 if g.in_flight or g.waiting}
+            cap = (self._per_tenant if self._per_tenant is not None
+                   else drain_per_tenant())
+            gate = self._tenants[tenant] = BoundedSlots(cap)
+        return gate
+
+    def slot(self, tenant: str) -> _DrainSlot:
+        return _DrainSlot(self, tenant)
+
+    def snapshot(self) -> dict:
+        g = self._global
+        top = sorted(self.drained_by_tenant.items(),
+                     key=lambda kv: -kv[1])[:5]
+        return {"active": g.in_flight, "waiting": g.waiting,
+                "capacity": g.capacity,
+                "admitted_total": self.admitted_total,
+                "deferred_total": self.deferred_total,
+                "avg_wait_ms": round(
+                    1e3 * self.wait_s_total
+                    / max(1, self.admitted_total), 3),
+                "tenants_active": sum(
+                    1 for g in self._tenants.values() if g.in_flight),
+                "drained_by_tenant_top": dict(top)}

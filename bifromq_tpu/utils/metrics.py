@@ -103,6 +103,8 @@ KNOWN_STAGES = frozenset({
     "device.fetch",     # final host copy
     "deliver",          # dist/service fan-out
     "repl.apply",       # ISSUE 12: standby delta-batch apply (host+flush)
+    "retain.scan",      # ISSUE 13: retained wildcard scan batch (SUBSCRIBE)
+    "inbox.drain",      # ISSUE 13: persistent-session catch-up drain
 })
 
 

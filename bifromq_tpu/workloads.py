@@ -14,7 +14,7 @@ RouteMatcher tuples (bypassing string validation) for speed at the 10M scale.
 from __future__ import annotations
 
 import random
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .models.oracle import Route, SubscriptionTrie
 from .types import RouteMatcher, RouteMatcherType
@@ -248,3 +248,142 @@ def diverse_topics(n: int, *, seed: int = 0,
             levels.append(f"d{rng.randrange(1 << 20)}")
         out.append("/".join(levels) if levels else "x")
     return out
+
+
+# ---------------------- mixed million-client workload (ISSUE 13) ------------
+#
+# Configs 1-5 each exercise ONE plane in isolation; real broker
+# populations are a MIX — transient and persistent sessions, QoS spread,
+# $share worker pools, retained floods, churny connections, reconnect
+# drain storms — and the SLO / noisy-neighbor / shed / cache planes only
+# mean anything under that diversity. `config_mixed` generates one
+# deterministic plan covering all of it; bench config 10 executes the
+# plan leg by leg and reports the per-plane breakdown.
+
+def config_mixed(n_clients: int = 1_000_000, *, seed: int = 0,
+                 n_tenants: int = 100, persistent_ratio: float = 0.3,
+                 share_ratio: float = 0.1, n_groups: int = 16,
+                 retained_base: Optional[int] = None,
+                 retained_ops: int = 10_000,
+                 scan_filters: int = 512,
+                 churn_ops: int = 2_048,
+                 drain_sessions: int = 256,
+                 publishes: int = 4_096) -> dict:
+    """One deterministic mixed-workload plan for ``n_clients`` clients.
+
+    Returns a dict of per-plane inputs:
+
+    - ``subscriptions``: per-tenant SubscriptionTrie route table (one
+      filter per client; Zipf tenant sizes, ~``persistent_ratio``
+      persistent receivers, ~``share_ratio`` $share group members)
+    - ``qos_mix``: per-client QoS histogram {0,1,2} (0.7/0.25/0.05)
+    - ``retained_seed`` / ``retained_flood``: the retained store's base
+      topic population and the SET/CLEAR flood ops (≥ ``retained_ops``,
+      re-SET/CLEAR mix with per-device leaf diversity)
+    - ``scan_filters``: wildcard SUBSCRIBE filters probing the retained
+      store (per tenant)
+    - ``publishes``: (tenant, topic, qos) publish stream over the same
+      Zipf tree
+    - ``session_churn``: ("sub"|"unsub", tenant, filter levels,
+      receiver) connect/disconnect route churn
+    - ``drain_plan``: (tenant, inbox_id, backlog) reconnect-storm
+      population — one HERD tenant holding most sessions plus quiet
+      tenants, the shape tenant-fairness must survive
+    """
+    rng = random.Random(seed)
+    names, weights = _zipf_levels(1000)
+    tenant_w = [1.0 / (i + 1) for i in range(n_tenants)]
+    wsum = sum(tenant_w)
+    tenants = [f"tenant{i}" for i in range(n_tenants)]
+
+    subs: Dict[str, SubscriptionTrie] = {}
+    qos_mix = {0: 0, 1: 0, 2: 0}
+    client = 0
+    for ti, tenant in enumerate(tenants):
+        n = max(1, int(n_clients * tenant_w[ti] / wsum))
+        trie = SubscriptionTrie()
+        for i in range(n):
+            roll = rng.random()
+            qos = 0 if roll < 0.70 else (1 if roll < 0.95 else 2)
+            qos_mix[qos] += 1
+            levels = gen_filter_levels(rng, names, weights, p_plus=0.10,
+                                       p_hash=0.05)
+            share = rng.random() < share_ratio
+            group = f"g{rng.randrange(n_groups)}" if share else ""
+            broker = 1 if (not share
+                           and rng.random() < persistent_ratio) else 0
+            trie.add(Route(
+                matcher=_mk_matcher(levels, group, share
+                                    and rng.random() < 0.3),
+                broker_id=broker, receiver_id=f"c{client}",
+                deliverer_key=f"d{client % 64}"))
+            client += 1
+        subs[tenant] = trie
+
+    # retained plane: base population + flood (device-leaf diversity,
+    # re-SET/CLEAR mix, a '$SYS' slice for the root rules)
+    if retained_base is None:
+        retained_base = max(1024, n_clients // 10)
+    seen = set()
+    retained_seed: List[Tuple[str, List[str]]] = []
+    for i in range(retained_base):
+        tenant = tenants[rng.randrange(n_tenants)]
+        levels = gen_topic_levels(rng, names, weights)
+        if rng.random() < 0.02:
+            levels = ["$SYS"] + levels
+        if (tenant, tuple(levels)) in seen:
+            levels = levels + [f"d{i}"]
+        seen.add((tenant, tuple(levels)))
+        retained_seed.append((tenant, levels))
+    flood: List[Tuple[str, str, List[str]]] = []
+    live = list(retained_seed)
+    for i in range(retained_ops):
+        roll = rng.random()
+        if roll < 0.55 or not live:
+            tenant = tenants[rng.randrange(n_tenants)]
+            levels = gen_topic_levels(rng, names, weights) + [f"f{i}"]
+            flood.append(("set", tenant, levels))
+            live.append((tenant, levels))
+        elif roll < 0.85:
+            tenant, levels = live.pop(rng.randrange(len(live)))
+            flood.append(("clear", tenant, levels))
+        else:   # re-SET of a live topic (payload replace, index no-op)
+            tenant, levels = live[rng.randrange(len(live))]
+            flood.append(("set", tenant, levels))
+
+    filters = [(tenants[rng.randrange(n_tenants)],
+                gen_filter_levels(rng, names, weights))
+               for _ in range(scan_filters)]
+
+    pubs = []
+    for _ in range(publishes):
+        roll = rng.random()
+        qos = 0 if roll < 0.70 else (1 if roll < 0.95 else 2)
+        pubs.append((tenants[rng.randrange(n_tenants)],
+                     "/".join(gen_topic_levels(rng, names, weights)), qos))
+
+    churn = []
+    for i in range(churn_ops):
+        tenant = tenants[rng.randrange(n_tenants)]
+        levels = gen_filter_levels(rng, names, weights)
+        churn.append(("sub", tenant, levels, f"churn{i}"))
+        if rng.random() < 0.5:
+            churn.append(("unsub", tenant, levels, f"churn{i}"))
+
+    # drain storm: tenant0 reconnects a HERD, the tail tenants a handful
+    drain_plan = []
+    herd = max(1, int(drain_sessions * 0.8))
+    for i in range(herd):
+        drain_plan.append(("tenant0", f"inbox-h{i}",
+                           rng.randint(32, 128)))
+    rest = drain_sessions - herd
+    for i in range(rest):
+        tenant = tenants[1 + rng.randrange(max(1, n_tenants - 1))]
+        drain_plan.append((tenant, f"inbox-q{i}", rng.randint(8, 32)))
+
+    return {"tenants": tenants, "subscriptions": subs,
+            "qos_mix": qos_mix, "n_clients": client,
+            "retained_seed": retained_seed, "retained_flood": flood,
+            "scan_filters": filters, "publishes": pubs,
+            "session_churn": churn, "drain_plan": drain_plan,
+            "n_groups": n_groups, "seed": seed}

@@ -1,0 +1,191 @@
+#!/usr/bin/env bash
+# Tier-2 retained & session plane gate (ISSUE 13): a >=10k-mutation
+# retained SET/CLEAR flood against a live PATCHED RetainedIndex on CPU,
+# asserting the serving-plane contract:
+#   1. ZERO full rebuilds inside the flood window — set/clear/expire are
+#      in-place arena patches; compilation is allowed ONLY as the
+#      fragmentation-triggered compaction,
+#   2. device wildcard-scan results byte-identical (as sorted topic
+#      sets) to the host match_filter_host oracle BEFORE, DURING and
+#      AFTER the storm — including $SYS roots and '#'/'+' folds — and
+#      identical to a from-scratch rebuild after it,
+#   3. the async scan plane serves through the ring with the
+#      filter-keyed cache hitting on the repeat pass and a forced
+#      watchdog timeout degrading to the exact oracle,
+#   4. a herd-vs-quiet reconnect drain storm admits tenant-fairly (the
+#      quiet tenant's sessions never queue behind the herd).
+# Runs on CPU (JAX_PLATFORMS=cpu), hard timeout like the other gates.
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+
+timeout -k 10 "${RETAINED_CHECK_TIMEOUT:-420}" \
+    env JAX_PLATFORMS=cpu \
+    python - <<'EOF'
+import asyncio
+import os
+import random
+import time
+
+from bifromq_tpu.models.retained import RetainedIndex, match_filter_host
+from bifromq_tpu.retained_plane import DrainGovernor, RetainedScanPlane
+from bifromq_tpu.utils import topic as t
+
+N_BASE = int(os.environ.get("RETAINED_CHECK_BASE", "4000"))
+N_OPS = int(os.environ.get("RETAINED_CHECK_OPS", "10000"))
+
+rng = random.Random(17)
+NAMES = [f"l{i}" for i in range(200)] + ["", "$s"]
+
+
+def rand_topic(i=None):
+    n = rng.randint(1, 6)
+    lv = [rng.choice(NAMES) for _ in range(n)]
+    if rng.random() < 0.03:
+        lv = ["$SYS"] + lv
+    if i is not None:
+        lv.append(f"d{i}")
+    return "/".join(lv)
+
+
+def rand_filter():
+    n = rng.randint(1, 6)
+    lv = []
+    for i in range(n):
+        roll = rng.random()
+        if roll < 0.25:
+            lv.append("+")
+        elif roll < 0.33 and i == n - 1:
+            lv.append("#")
+        else:
+            lv.append(rng.choice(NAMES))
+    return lv
+
+
+FILTERS = [rand_filter() for _ in range(96)] + \
+    [["#"], ["+"], ["$SYS", "#"], ["+", "+"], ["+", "#"]]
+
+
+def check_parity(idx, tag):
+    got = idx.match_batch([("T", f) for f in FILTERS])
+    trie = idx.tries.get("T")
+    for f, g in zip(FILTERS, got):
+        want = sorted(match_filter_host(trie, f)) if trie else []
+        assert sorted(g) == want, (tag, f, len(g), len(want))
+
+
+live = set()
+while len(live) < N_BASE:
+    live.add(rand_topic())
+idx = RetainedIndex(k_states=16)
+for topic in sorted(live):
+    idx.add_topic("T", t.parse(topic), topic)
+idx.refresh()
+assert hasattr(idx._compiled, "retained_add"), \
+    "index is not patched — BIFROMQ_RETAIN_PATCH off?"
+rebuilds0 = idx.rebuilds
+check_parity(idx, "before")
+
+# ---- the flood: >=10k set/clear/expire-shaped mutations ----------------
+t0 = time.perf_counter()
+pool = sorted(live)
+for i in range(N_OPS):
+    roll = rng.random()
+    if roll < 0.55:
+        topic = rand_topic(i)
+        if topic not in live:
+            idx.add_topic("T", t.parse(topic), topic)
+            live.add(topic)
+            pool.append(topic)
+    elif roll < 0.85 and pool:
+        topic = pool.pop(rng.randrange(len(pool)))
+        if topic in live:
+            idx.remove_topic("T", t.parse(topic), topic)
+            live.discard(topic)
+    elif pool:
+        topic = pool[rng.randrange(len(pool))]   # re-SET (payload only)
+        idx.add_topic("T", t.parse(topic), topic)
+    if i == N_OPS // 2:
+        check_parity(idx, "during")
+flood_s = time.perf_counter() - t0
+check_parity(idx, "after")
+assert idx.rebuilds == rebuilds0, \
+    f"flood ran {idx.rebuilds - rebuilds0} full rebuilds"
+assert idx.patch_fallbacks == 0, idx.patch_fallbacks
+print(f"flood: {N_OPS} ops in {flood_s:.1f}s "
+      f"({N_OPS / flood_s:,.0f} ops/s), rebuilds=0, "
+      f"compactions={idx.compactions}, "
+      f"patch={idx._compiled.patch_stats()}")
+
+# patched index == from-scratch rebuild
+fresh = RetainedIndex(patched=False, k_states=16)
+for topic in sorted(live):
+    fresh.add_topic("T", t.parse(topic), topic)
+fresh.refresh()
+got = idx.match_batch([("T", f) for f in FILTERS])
+want = fresh.match_batch([("T", f) for f in FILTERS])
+for f, g, w in zip(FILTERS, got, want):
+    assert sorted(g) == sorted(w), ("rebuild-parity", f)
+print("patched == post-compaction rebuild == host oracle: OK")
+
+
+# ---- async scan plane: ring + cache + watchdog degradation -------------
+async def scan_leg():
+    plane = RetainedScanPlane(lambda: idx)
+    idx.delta_hooks.append(plane.cache.on_delta)
+    queries = [("T", f) for f in FILTERS[:64]]
+    rows = await plane.scan_batch(queries, limit=10)
+    trie = idx.tries["T"]
+    for (tenant, f), row in zip(queries, rows):
+        full = match_filter_host(trie, list(f))
+        assert len(row) == min(10, len(full)) and set(row) <= set(full)
+    h0 = plane.cache.hits
+    await plane.scan_batch(queries, limit=10)
+    hit_rate = (plane.cache.hits - h0) / len(queries)
+    assert hit_rate > 0.95, hit_rate
+    from bifromq_tpu.resilience.device import DeviceTimeoutError
+    ring = plane._pipeline_ring()
+
+    async def hang(res, **kw):
+        raise DeviceTimeoutError(0.01)
+    orig = ring.wait_ready
+    ring.wait_ready = hang
+    rows = await plane.scan_batch([("T", ["#"])])
+    ring.wait_ready = orig
+    assert sorted(rows[0]) == sorted(match_filter_host(trie, ["#"]))
+    assert plane.degraded_total.get("timeout") == 1
+    print(f"scan plane: repeat hit rate {hit_rate:.2f}, watchdog "
+          f"timeout degraded to exact oracle: OK")
+
+asyncio.run(scan_leg())
+
+
+# ---- drain storm: herd tenant vs quiet tenants must stay fair ----------
+async def drain_leg():
+    gov = DrainGovernor(slots=8, per_tenant=2, noisy_fn=lambda t_: False)
+    waits = {}
+
+    async def one(tenant):
+        s0 = time.perf_counter()
+        async with gov.slot(tenant):
+            await asyncio.sleep(0.002)
+        waits.setdefault(tenant, []).append(time.perf_counter() - s0)
+
+    herd = [one("A") for _ in range(160)]
+    quiet = [one(f"q{i % 4}") for i in range(8)]
+    await asyncio.gather(*herd, *quiet)
+    herd_mean = sum(waits["A"]) / len(waits["A"])
+    qs = [w for k, ws in waits.items() if k != "A" for w in ws]
+    quiet_mean = sum(qs) / len(qs)
+    assert quiet_mean < herd_mean / 4, (quiet_mean, herd_mean)
+    print(f"drain storm: herd mean {herd_mean * 1e3:.1f}ms, quiet mean "
+          f"{quiet_mean * 1e3:.1f}ms — tenant-fair: OK")
+
+asyncio.run(drain_leg())
+print("RETAINED CHECK PASSED")
+EOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "RETAINED CHECK FAILED (rc=$rc)"
+fi
+exit $rc
