@@ -77,9 +77,18 @@ behind tenant0's herd), and the SLO top-k snapshot (BENCH_MIX_CLIENTS
 default 100_000 — set 1_000_000 for the paper-scale record;
 BENCH_MIX_RETAIN_OPS default 10_000). Stamps record["mixed"].
 
+SHARDED MESH (ISSUE 15): config "11" serves BENCH_MESH_SUBS logical
+subscriptions from a BENCH_MESH_REPLICAS x BENCH_MESH_SHARDS device
+mesh (CPU: XLA_FLAGS=--xla_force_host_platform_device_count=8) with a
+replicated hot tenant, checks per-shard bytes against the
+CapacityPlanner.fits prediction, and runs a BENCH_MESH_CHURN_OPS churn
+storm through the per-shard patch plane (acceptance: zero rebuilds,
+zero generation bumps, exact oracle parity). Stamps record["mesh"].
+
 Env knobs: BENCH_CONFIGS ("1,2,3,4,5" default; "2" = headline only;
 "6" = match-cache A/B; "7" = pipeline A/B; "8" = churn/patch;
 "9" = ingest byte-plane A/B; "10" = mixed million-client workload;
+"11" = sharded mesh serving;
 BENCH_CACHE_HOT_TOPICS sizes config 6's Zipf pool),
 BENCH_SUBS (config-2 subs, default 1_000_000), BENCH_BATCH (16384),
 BENCH_ITERS (30), BENCH_K (16), BENCH_SEED (0), BENCH_RETAINED (1_000_000),
@@ -1468,6 +1477,200 @@ def bench_config10():
     return out
 
 
+def bench_config11():
+    """Sharded-mesh serving config (ISSUE 15): the multi-chip matcher as
+    a first-class serving plane on the (emulated or real) device mesh —
+
+    - builds BENCH_MESH_SUBS logical subscriptions across
+      BENCH_MESH_SHARDS shards (BENCH_MESH_REPLICAS replica rows; on CPU
+      run under XLA_FLAGS=--xla_force_host_platform_device_count=8) with
+      one HOT TENANT replicated into every shard,
+    - asserts per-shard ``ShardedTables.device_bytes()`` stays ≤ the
+      ``CapacityPlanner.fits`` per-shard prediction (the ISSUE 9
+      multichip gate, at serving scale),
+    - measures async mesh match p50/p99 through the shared dispatch
+      ring, per-shard patch-apply p99 under an interleaved
+      BENCH_MESH_CHURN_OPS churn storm (acceptance: ZERO full rebuilds,
+      ZERO match-cache generation bumps, ≥100× cheaper than the mesh
+      rebuild, exact oracle parity after the storm), and the
+      replicated-hot-tenant fan-out spread over the grid.
+
+    Stamps record["mesh"].
+    """
+    import asyncio
+
+    from bifromq_tpu import workloads
+    from bifromq_tpu.models.oracle import Route
+    from bifromq_tpu.obs import OBS
+    from bifromq_tpu.obs.capacity import CapacityPlanner
+    from bifromq_tpu.parallel.sharded import MeshMatcher, make_mesh
+    from bifromq_tpu.types import RouteMatcher
+
+    import jax
+
+    n_subs = int(os.environ.get("BENCH_MESH_SUBS", "200000"))
+    n_shards = int(os.environ.get("BENCH_MESH_SHARDS", "8"))
+    n_replicas = int(os.environ.get("BENCH_MESH_REPLICAS", "1"))
+    churn_ops = int(os.environ.get("BENCH_MESH_CHURN_OPS", "400"))
+    need = n_shards * n_replicas
+    if len(jax.devices()) < need:
+        log(f"[c11_mesh] SKIP: {need} devices needed, "
+            f"{len(jax.devices())} present (set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={need} on CPU)")
+        return {"skipped": True, "devices": len(jax.devices())}
+    name = f"c11_mesh_{n_subs}x{n_replicas}r{n_shards}s"
+    mesh = make_mesh(n_replicas, n_shards)
+
+    def mk(tf, rid, inc=0):
+        return Route(matcher=RouteMatcher.from_topic_filter(tf),
+                     broker_id=0, receiver_id=rid, deliverer_key="d0",
+                     incarnation=inc)
+
+    t0 = time.perf_counter()
+    tries = workloads.config_multi_tenant(
+        n_tenants=max(n_shards * 4,
+                      int(os.environ.get("BENCH_MESH_TENANTS", "64"))),
+        total_subs=n_subs, seed=SEED)
+    # hot tenant to replicate across every shard: a mid-rank tenant —
+    # big enough to matter, small enough that S physical copies don't
+    # dominate the per-shard byte budget (tenant0 under Zipf is ~20%)
+    hot = sorted(tries, key=lambda t: -len(tries[t]))[
+        min(7, len(tries) - 1)]
+    build_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    m = MeshMatcher.from_tries(tries, mesh=mesh, match_cache=False,
+                               replicate={hot})
+    install_s = time.perf_counter() - t0
+    rebuild_s = m._last_compile_s
+    tables = m._base_ct
+    logical = sum(len(t) for t in tries.values())
+    log(f"[{name}] base: gen {build_s:.1f}s, compile+install "
+        f"{install_s:.1f}s (mesh rebuild {rebuild_s:.1f}s), "
+        f"logical_subs={logical} hot={hot} ({len(tries[hot])} subs "
+        f"replicated x{n_shards})")
+
+    # --- capacity: per-shard padded bytes vs the planner prediction ----
+    db = tables.device_bytes()
+    worst = max(p["padded_bytes"] for p in db["per_shard"])
+    slots_ref = max(1, max(ct.n_slots for ct in tables.compiled))
+    n_max = max(ct.node_tab.shape[0] for ct in tables.compiled)
+    e_max = max(1, max(
+        int(np.count_nonzero(ct.edge_tab.reshape(-1, 4)[:, 0] >= 0))
+        for ct in tables.compiled))
+    buckets = tables.edge_tab.shape[1]
+    planner = CapacityPlanner(
+        nodes_per_sub=n_max / slots_ref, edges_per_sub=e_max / slots_ref,
+        slots_per_sub=1.0,
+        edge_load=e_max / (buckets * tables.probe_len),
+        calibrated_from=f"c11:{slots_ref}subs/shard")
+    fits = planner.fits(slots_ref * n_shards, mesh=(n_replicas, n_shards),
+                        probe_len=tables.probe_len)
+    predicted = fits["tables"]["total"]
+    cap_ok = worst <= predicted
+
+    # --- serving: async mesh match latency through the dispatch ring ---
+    ledger = OBS.profiler.ledger
+    compiles0, bumps0 = m.compile_count, ledger.generation_bumps
+    tenants = sorted(tries)
+    topics = workloads.probe_topics(1024, seed=SEED + 1)
+    batch = 256
+    rng = np.random.default_rng(SEED)
+
+    def probe_batch(i, tenant=None):
+        rows = topics[(i * batch) % 512:(i * batch) % 512 + batch]
+        ts = ([tenant] * batch if tenant else
+              [tenants[int(j)] for j in rng.integers(0, len(tenants),
+                                                     batch)])
+        return list(zip(ts, rows))
+
+    async def serve():
+        match_lat, hot_lat, patch_lat = [], [], []
+        for wb in range(2):     # warm the grid shapes + scatter jits
+            await m.match_batch_async(probe_batch(wb))
+        # the hot-tenant batch concentrates rows into fewer slots → a
+        # different pow2 grid shape; warm it too or its first serve
+        # pays the XLA trace inside the measured window
+        await m.match_batch_async(probe_batch(0, tenant=hot))
+        m.add_route(hot, mk("bench/mesh/warm/+", "w0"))
+        m._flush_patches()
+        added = []
+        for i in range(churn_ops):
+            tf = f"bench/mesh/{i}/+"
+            tenant = tenants[i % len(tenants)]
+            s0 = time.perf_counter()
+            m.add_route(tenant, mk(tf, f"c{i}", inc=1))
+            m._flush_patches()
+            patch_lat.append(time.perf_counter() - s0)
+            added.append((tenant, tf, f"c{i}"))
+            if i % 8 == 4:
+                s0 = time.perf_counter()
+                await m.match_batch_async(probe_batch(i))
+                match_lat.append(time.perf_counter() - s0)
+            if i % 16 == 8:
+                s0 = time.perf_counter()
+                await m.match_batch_async(probe_batch(i, tenant=hot))
+                hot_lat.append(time.perf_counter() - s0)
+        for tenant, tf, rid in added[:churn_ops // 2]:
+            s0 = time.perf_counter()
+            m.remove_route(tenant, RouteMatcher.from_topic_filter(tf),
+                           (0, rid, "d0"), incarnation=1)
+            m._flush_patches()
+            patch_lat.append(time.perf_counter() - s0)
+        return match_lat, hot_lat, patch_lat
+
+    match_lat, hot_lat, patch_lat = asyncio.run(serve())
+
+    # --- oracle parity after the storm ---------------------------------
+    probe = probe_batch(3)[:128]
+    probe += [(tenants[i % len(tenants)], f"bench/mesh/{i}/x")
+              for i in range(0, churn_ops, 7)]
+    got = m.match_batch(probe)
+    want = m.match_from_tries(probe)
+
+    def canon(r):
+        return (sorted((x.matcher.mqtt_topic_filter, x.receiver_url)
+                       for x in r.normal),
+                {f: sorted(x.receiver_url for x in ms)
+                 for f, ms in r.groups.items()})
+    parity = all(canon(a) == canon(b) for a, b in zip(got, want))
+
+    def pct(xs, q):
+        return round(float(np.percentile(np.array(xs or [0.0]), q)) * 1e3,
+                     3)
+    patch_p99 = pct(patch_lat, 99)
+    out = {
+        "n_subs": n_subs,
+        "logical_subs": logical,
+        "mesh": {"replicas": n_replicas, "shards": n_shards},
+        "build_s": round(build_s, 1),
+        "mesh_rebuild_s": round(rebuild_s, 2),
+        "capacity": {
+            "worst_shard_padded_bytes": worst,
+            "predicted_per_shard_bytes": predicted,
+            "per_shard_under_prediction": cap_ok,
+            "pad_waste_ratio": db["pad_waste_ratio"],
+            "per_shard": db["per_shard"],
+        },
+        "match_ms": {"batch": batch, "p50": pct(match_lat, 50),
+                     "p99": pct(match_lat, 99)},
+        "hot_tenant_fanout_ms": {"tenant": hot, "p50": pct(hot_lat, 50),
+                                 "p99": pct(hot_lat, 99)},
+        "patch_apply_ms": {"p50": pct(patch_lat, 50), "p99": patch_p99},
+        "patch_vs_rebuild_speedup": round(
+            rebuild_s / max(1e-9, patch_p99 / 1e3), 1),
+        "churn_ops": len(patch_lat),
+        "full_rebuilds_in_window": m.compile_count - compiles0,
+        "generation_bumps_in_window": ledger.generation_bumps - bumps0,
+        "oracle_parity": parity,
+        "patch_flushes": m.patch_flushes,
+        "patch_fallbacks": m.patch_fallbacks,
+        "shard_breakers": [br.state if br else None
+                           for br in m.shard_breakers],
+    }
+    log(f"[{name}] {json.dumps(out)}")
+    return out
+
+
 def bench_broker():
     """End-to-end MQTT broker throughput over loopback TCP: QoS0/QoS1
     publish → dist match (device matcher) → local fan-out → delivery.
@@ -1689,6 +1892,8 @@ def main():
         results["c9"] = bench_config9()
     if "10" in CONFIGS:
         results["c10"] = bench_config10()
+    if "11" in CONFIGS:
+        results["c11"] = bench_config11()
     if "b" in CONFIGS:
         results["broker"] = bench_broker()
 
@@ -1820,6 +2025,31 @@ def main():
             "publish_mix": c10["publish_mix"],
             "share_balance": c10["share_balance"],
             "drain_tenant_fair": c10["drain_storm"]["tenant_fair"],
+        }
+    # sharded-mesh cell next to the headline (ISSUE 15): mesh match
+    # latency, per-shard patch p99 under the churn storm, shard count,
+    # per-shard bytes vs the planner prediction — the numbers ready to
+    # re-run the moment the TPU tunnel returns
+    if "c11" in results and not results["c11"].get("skipped"):
+        c11 = results["c11"]
+        record["mesh"] = {
+            "logical_subs": c11["logical_subs"],
+            "shards": c11["mesh"]["shards"],
+            "replicas": c11["mesh"]["replicas"],
+            "match_p50_ms": c11["match_ms"]["p50"],
+            "match_p99_ms": c11["match_ms"]["p99"],
+            "patch_p99_ms": c11["patch_apply_ms"]["p99"],
+            "patch_vs_rebuild_speedup": c11["patch_vs_rebuild_speedup"],
+            "full_rebuilds_in_window": c11["full_rebuilds_in_window"],
+            "generation_bumps_in_window":
+                c11["generation_bumps_in_window"],
+            "oracle_parity": c11["oracle_parity"],
+            "per_shard_bytes": [p["padded_bytes"] for p in
+                                c11["capacity"]["per_shard"]],
+            "per_shard_under_prediction":
+                c11["capacity"]["per_shard_under_prediction"],
+            "hot_tenant_fanout_p99_ms":
+                c11["hot_tenant_fanout_ms"]["p99"],
         }
     # per-stage p50/p99 next to the headline (ISSUE 2): where the broker
     # plane actually spends its time (queue-wait vs device vs deliver)

@@ -49,6 +49,15 @@ HOT_SCOPES: Dict[str, Set[str]] = {
     # applied delta batch — it must stay a pure dispatch wrapper (the
     # narrow scatters live in ops/match, already covered above)
     "replication/standby.py": {"WarmStandby._flush_device"},
+    # ISSUE 15: the mesh serving legs — stage-1 prep (shard routing +
+    # tokenize + grid upload), the step enqueue, the per-shard patch
+    # flush, and the expansion that runs against the in-flight snapshot
+    "parallel/sharded.py": {
+        "MeshMatcher._prepare_probes", "MeshMatcher._dispatch_prepared",
+        "MeshMatcher._flush_patches", "MeshMatcher._expand_walk",
+        "make_match_step", "_shard_scatter", "_shard_scatter_donated",
+        "_shard_slice_set", "_shard_slice_set_donated",
+    },
     # ISSUE 13 retained serving plane: the scan dispatch leg (patch
     # flush + walk enqueue) and the async ring leg must stay sync-free;
     # the one true synchronization lives in RetainedIndex.fetch_scan —

@@ -100,10 +100,17 @@ class DistWorkerRPCService:
         """Bounded resync: ship THIS replica's host arenas + route set.
         The matcher quiesces first (pending patches fold in; a lingering
         overlay — collision fallbacks only — forces one compaction so the
-        shipped base is exact with an empty overlay); the stream cursor
-        captured after the quiesce addresses the snapshot, and nothing
-        awaits in between, so snapshot ⊕ later records is consistent."""
-        from ..replication.records import encode_base
+        shipped base is exact with an empty overlay). ISSUE 15 satellite
+        (ROADMAP replication follow-up (c)): the handler is now a
+        COPY-THEN-ENCODE pipeline — quiesce → arena/route SNAPSHOT →
+        cursor capture run in ONE await-free window (that window IS the
+        consistency mechanism: snapshot ⊕ later records is exact), while
+        the expensive half (per-route byte encode + zlib compression of
+        the whole frame, seconds at 10M subs) runs OFF the event loop on
+        the copies, so the worker keeps serving. Mesh bases (ISSUE 15)
+        ship one arena set per shard plus the shard-routing metadata."""
+        from ..replication.records import (capture_base, capture_mesh_base,
+                                           encode_base_snapshot)
         from ..replication.standby import ST_NO_RANGE, ST_OK, ST_UNSUPPORTED
         from ..models.automaton import PatchableTrie
         rid = _read16(payload, 0)[0].decode()
@@ -119,13 +126,21 @@ class DistWorkerRPCService:
             matcher._maybe_compact(force=True)
             matcher.drain()
         base = matcher._base_ct
-        if not isinstance(base, PatchableTrie) or matcher.overlay_size:
+        snap = None
+        if not matcher.overlay_size:
+            if isinstance(base, PatchableTrie):
+                snap = capture_base(base, matcher.tries)
+            elif getattr(base, "patchable", False):   # mesh ShardedTables
+                snap = capture_mesh_base(base, matcher.tries)
+        if snap is None:
             return bytes([ST_UNSUPPORTED])
         epoch, seq = log.cursor()
-        snap = encode_base(base, matcher.tries)
+        # off-loop encode: everything above ran await-free; the snapshot
+        # is frozen, so later mutations land only in records > cursor
+        body = await asyncio.to_thread(encode_base_snapshot, snap)
         return (bytes([ST_OK]) + _len16(self.worker.store.node_id.encode())
                 + struct.pack(">IQ", epoch, seq)
-                + struct.pack(">I", len(snap)) + snap)
+                + struct.pack(">I", len(body)) + body)
 
     async def _repl_inval(self, payload: bytes, okey: str) -> bytes:
         """Exact-invalidation long-poll across ALL hosted ranges: the

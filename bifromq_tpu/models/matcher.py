@@ -383,17 +383,31 @@ class TpuMatcher:
         gkey = (int(matcher.type), matcher.group or "")
         return dict(node.groups.get(gkey, {}))
 
-    def _try_patch(self, op: Tuple) -> bool:
-        """Fold one log op straight into the installed base arenas.
-
-        Returns False when there is nothing to patch (no base yet, mesh
-        subclass, env kill-switch) or the patcher declined
-        (``PatchFallback``) — the caller then records the op into the
-        overlay, exactly the pre-patching serving path.
-        """
+    def _patch_targets(self, tenant_id: str) -> list:
+        """The PatchableTrie arena(s) a mutation for this tenant folds
+        into — the single-chip base itself; the mesh subclass routes to
+        the tenant's shard(s) (every shard for a replicated hot tenant).
+        Empty when there is nothing to patch (no base yet, kill-switch,
+        non-patchable compile target)."""
         base = self._base_ct
         if base is None or not isinstance(base, PatchableTrie) \
                 or not self._patching_enabled():
+            return []
+        return [base]
+
+    def _try_patch(self, op: Tuple) -> bool:
+        """Fold one log op straight into the installed base arenas.
+
+        Returns False when there is nothing to patch (no base yet, env
+        kill-switch) or the patcher declined (``PatchFallback``) — the
+        caller then records the op into the overlay, exactly the
+        pre-patching serving path. A multi-target fold (replicated mesh
+        tenant) that declines mid-way is safe: the patch methods are
+        find-or-append idempotent and the overlay record supersedes the
+        partially-patched copies exactly like a base copy.
+        """
+        targets = self._patch_targets(op[1])
+        if not targets:
             return False
         from ..types import RouteMatcherType
         t0 = time.perf_counter()
@@ -403,14 +417,16 @@ class TpuMatcher:
                 gm = None
                 if route.matcher.type != RouteMatcherType.NORMAL:
                     gm = self._group_members(tenant_id, route.matcher)
-                base.patch_add(tenant_id, route, group_members=gm)
+                for base in targets:
+                    base.patch_add(tenant_id, route, group_members=gm)
             else:
                 _, tenant_id, matcher, url, _inc = op
                 gm = None
                 if matcher.type != RouteMatcherType.NORMAL:
                     gm = self._group_members(tenant_id, matcher)
-                base.patch_remove(tenant_id, matcher, url,
-                                  group_members=gm)
+                for base in targets:
+                    base.patch_remove(tenant_id, matcher, url,
+                                      group_members=gm)
         except PatchFallback:
             self.patch_fallbacks += 1
             return False
@@ -627,8 +643,7 @@ class TpuMatcher:
             ct, dev = self._compile_shadow()
             self._install_base(ct, dev)
         elif self._log:
-            if self._overlay_n == 0 \
-                    and isinstance(self._base_ct, PatchableTrie):
+            if self._overlay_n == 0 and self._base_patchable():
                 # base already exact (patch-first path): sync the shadow
                 # so the next compaction replays from the right snapshot
                 self._replay_log_into_shadow()
@@ -639,6 +654,12 @@ class TpuMatcher:
                 self._install_base(ct, dev)
         self._flush_patches()
         return self._base_ct
+
+    def _base_patchable(self) -> bool:
+        """Is the INSTALLED base exact under the patch-first path (so a
+        quiesce needs no rebuild)? The mesh subclass answers for its
+        per-shard arenas."""
+        return isinstance(self._base_ct, PatchableTrie)
 
     @staticmethod
     def _base_salt(ct) -> object:
@@ -1123,7 +1144,11 @@ class TpuMatcher:
                                     kernel=fl.kernel):
                         await ring.wait_ready(fl.res, fault=fl.fault)
                 except DeviceTimeoutError:
-                    ring.reclaim(fl.res)
+                    ring.reclaim(fl.res,
+                                 tag=getattr(fl, "quarantine_tag", None))
+                    # ISSUE 15: let the subclass attribute the timeout
+                    # (the mesh feeds the implicated SHARD's breaker)
+                    self._note_device_timeout(fl)
                     raise
                 except BaseException:
                     # cancelled mid-wait (caller timeout, client
@@ -1133,7 +1158,9 @@ class TpuMatcher:
                     # dropping the last reference here would be the
                     # exact use-after-donate the quarantine exists to
                     # prevent
-                    ring.quarantine.add(fl.res)
+                    ring.quarantine.add(fl.res,
+                                        tag=getattr(fl, "quarantine_tag",
+                                                    None))
                     raise
                 ready_s = time.perf_counter() - t0
                 STAGES.record("device.ready", ready_s)
@@ -1162,6 +1189,12 @@ class TpuMatcher:
             ready_s=ready_s, fetch_s=fetch_s,
             expand_s=time.perf_counter() - t0, path="async")
         return out
+
+    def _note_device_timeout(self, fl) -> None:
+        """Subclass hook (ISSUE 15): attribute a watchdog timeout of one
+        in-flight batch — the mesh feeds the implicated shard breaker(s)
+        and settles outstanding canary probes. The single-chip matcher's
+        own breaker is fed by the caller, so this is a no-op here."""
 
     def _canary_parity(self, queries, device_rows,
                        max_persistent_fanout, max_group_fanout):
@@ -1263,6 +1296,7 @@ class TpuMatcher:
             FABRIC.inc(FabricMetric.MATCH_DEGRADED, len(queries))
             if br is not None:
                 br.record_failure(repr(e))
+            self._note_device_timeout(fl)
             if stats is not None:
                 stats["degraded"] = "timeout"
             OBS.profiler.record_batch(

@@ -130,13 +130,15 @@ class DispatchRing(BoundedSlots):
         if len(self.quarantine):
             self.quarantine.sweep()
 
-    def reclaim(self, res) -> None:
+    def reclaim(self, res, tag: Optional[str] = None) -> None:
         """A slot timed out: park its (possibly donated-aliasing) result
         arrays in quarantine until the device reports them ready. The
         caller releases the slot itself — the ring stays bounded AND
-        live, instead of one stuck dispatch wedging a slot forever."""
+        live, instead of one stuck dispatch wedging a slot forever.
+        ``tag`` attributes the parked batch (ISSUE 15: the mesh tags the
+        implicated shard)."""
         self.timeouts_total += 1
-        self.quarantine.add(res)
+        self.quarantine.add(res, tag=tag)
 
     async def wait_idle(self, timeout_s: float = 2.0,
                         poll_s: float = 0.002) -> bool:

@@ -28,7 +28,8 @@ from ..utils import topic as topic_util
 from . import packets as pk
 from .codec import StreamDecoder, encode, topic_bytes_enabled
 from .protocol import (CONNACK_ACCEPTED, CONNACK_REFUSED_IDENTIFIER_REJECTED,
-                       CONNACK_REFUSED_NOT_AUTHORIZED, PROTOCOL_MQTT5,
+                       CONNACK_REFUSED_NOT_AUTHORIZED,
+                       CONNACK_REFUSED_SERVER_UNAVAILABLE, PROTOCOL_MQTT5,
                        MalformedPacket, PropertyId, ReasonCode)
 from .session import (LocalSessionRegistry, Session, SessionRegistry,
                       SessionStartAborted, TransientSubBroker)
@@ -508,6 +509,23 @@ class Connection:
             # even with session-expiry 0 — the session then ends at
             # network disconnect (expiry 0 deletes on close)
             persistent = True
+        if persistent and broker.inbox.store.exists(tenant_id, client_id):
+            # ISSUE 15 satellite (ROADMAP retained (d)): a RESUMING
+            # persistent session triggers a catch-up drain — under a
+            # clustered reconnect storm, a broker whose drain pool is
+            # saturated while peers gossip quieter pressure refuses the
+            # reconnect so the client's retry lands on a quieter peer
+            governor = getattr(broker.inbox, "drain_governor", None)
+            if governor is not None and governor.should_shed_reconnect():
+                broker.events.report(Event(
+                    EventType.SERVER_BUSY, tenant_id,
+                    {"reason": "drain_shed",
+                     "clientId": client_id}))
+                await self.send(pk.Connack(reason_code=(
+                    ReasonCode.SERVER_BUSY if v5
+                    else CONNACK_REFUSED_SERVER_UNAVAILABLE)))
+                await self.close_transport()
+                return
 
         common = dict(
             conn=self, client_id=client_id, client_info=ClientInfo(

@@ -155,6 +155,19 @@ class ObsHub:
             self._drain_governors = weakref.WeakSet()
         self._drain_governors.add(gov)
 
+    def drain_pressure(self) -> float:
+        """Worst drain-governor occupancy on this node — (active +
+        waiting) / capacity; >1.0 means reconnects are queueing. Gossiped
+        in the health digest (ISSUE 15 satellite) so a clustered
+        reconnect storm sheds toward quieter peers."""
+        worst = 0.0
+        for g in list(getattr(self, "_drain_governors", ()) or ()):
+            try:
+                worst = max(worst, g.pressure())
+            except Exception:  # noqa: BLE001 — telemetry must not raise
+                continue
+        return round(worst, 3)
+
     def retained_snapshot(self) -> dict:
         """The ``/metrics`` "retained" section: every live scan plane's
         serve/degrade/cache counters + every drain governor's admission

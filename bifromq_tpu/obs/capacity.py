@@ -197,6 +197,14 @@ def measure(matcher) -> Dict[str, object]:
         out["patch"] = base.patch_stats()
         out["patch_fallbacks"] = getattr(matcher, "patch_fallbacks", 0)
         out["patched_mutations"] = getattr(matcher, "patch_count", 0)
+    elif kind == "mesh" and any(hasattr(c, "patch_stats")
+                                for c in base.compiled):
+        # ISSUE 15: per-shard arena accounting for the patched mesh base
+        out["patch"] = {"shards": [
+            c.patch_stats() if hasattr(c, "patch_stats") else None
+            for c in base.compiled]}
+        out["patch_fallbacks"] = getattr(matcher, "patch_fallbacks", 0)
+        out["patched_mutations"] = getattr(matcher, "patch_count", 0)
     ring = getattr(matcher, "_ring", None)
     if ring is not None:
         out["inflight"] = inflight_bytes(

@@ -242,8 +242,32 @@ class ClusterView:
             # failover target pre-warms its match cache against the
             # cluster's union of these BEFORE taking traffic
             "hot_topics": self._hot_topics(),
+            # ISSUE 15 satellite (ROADMAP retained follow-up (d)): this
+            # node's reconnect-drain occupancy — a clustered reconnect
+            # storm sheds herd drains toward peers reporting less
+            "drain_pressure": self._drain_pressure(),
         }
         return digest
+
+    def _drain_pressure(self) -> float:
+        try:
+            return self.hub.drain_pressure()
+        except Exception:  # noqa: BLE001 — telemetry must not raise
+            return 0.0
+
+    def peer_drain_pressures(self) -> Dict[str, float]:
+        """Fresh peers' gossiped drain-governor occupancy (ISSUE 15
+        satellite): what the local DrainGovernor consults before
+        admitting a herd drain — a saturated broker with quieter peers
+        sheds the reconnect so the client lands elsewhere."""
+        out: Dict[str, float] = {}
+        for node, p in self.peers().items():
+            if p["stale"]:
+                continue
+            dp = (p["digest"] or {}).get("drain_pressure")
+            if dp is not None:
+                out[node] = float(dp)
+        return out
 
     def _hot_topics(self) -> list:
         try:
@@ -281,11 +305,22 @@ class ClusterView:
             return {}
 
     @staticmethod
-    def _device_breaker_field() -> Dict[str, str]:
+    def _device_breaker_field() -> Dict[str, object]:
         try:
             from ..resilience.device import DEVICE_BREAKERS
             worst = DEVICE_BREAKERS.worst_state()
-            return {} if worst == "closed" else {"breaker": worst}
+            if worst == "closed":
+                return {}
+            out: Dict[str, object] = {"breaker": worst}
+            # ISSUE 15: per-SHARD breaker state rides the digest so peers
+            # (and /cluster) can see exactly which fault domain of a mesh
+            # node is sick — closed shards are omitted (compact UDP)
+            shards = {label.rpartition(":")[2]: state
+                      for label, state in DEVICE_BREAKERS.states().items()
+                      if ":shard" in label}
+            if shards:
+                out["shard_breakers"] = shards
+            return out
         except Exception:  # noqa: BLE001 — telemetry must not raise
             return {}
 

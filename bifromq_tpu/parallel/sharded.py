@@ -9,37 +9,70 @@ route table (SURVEY.md §2.8):
 - **Raft replication** for read scaling (replica-spread queries,
   BatchDistServerCall.replicaSelect:245) → here: every shard's tables are
   replicated over the ``replica`` mesh axis and probe batches are split
-  across replicas.
+  across replicas. HOT tenants additionally replicate across the SHARD
+  axis (``MeshMatcher.replicate_tenant``): their queries fan to the
+  least-loaded slot of the whole grid instead of one home shard.
 
 The per-device program is the same fixed-shape walk as single-chip
-(ops.match.walk); cross-device communication is a single ``psum`` for global
-fan-out stats — probes are routed host-side to their tenant's shard, so the
-match itself needs no collective, exactly like the reference where a topic's
-query goes to the one range replica that owns the tenant's key span.
+(ops.match.walk); cross-device communication is a single ``psum`` merging
+the global fan-out count on device before the one host readback — probes
+are routed host-side to their tenant's shard, so the match itself needs
+no collective, exactly like the reference where a topic's query goes to
+the one range replica that owns the tenant's key span.
+
+ISSUE 15 makes this a first-class serving plane:
+
+- **Per-shard patching** — every shard's automaton is a
+  :class:`~bifromq_tpu.models.automaton.PatchableTrie`; route mutations
+  fold into the owning shard's arenas in place and flush as NARROW
+  per-shard ``idx+rows`` scatters into the stacked device tables
+  (donated when the dispatch ring is idle). A churn storm at mesh scale
+  runs zero rebuilds and zero match-cache generation bumps; only an
+  arena reshape (node growth / edge regrow, pow2-amortized) restacks.
+- **Async serving** — ``supports_async`` is on: the mesh leg rides the
+  shared dispatch-ring/watchdog/profiler machinery (prep-before-
+  admission, fetch-on-ready, tokenize/dispatch/ready/fetch stages
+  stamped per mesh step).
+- **Per-shard fault domains** — one device breaker per shard on the
+  shared board: an open shard's rows serve from the host oracle while
+  healthy shards stay on device; half-open re-closes on canary row
+  parity; watchdog reclaims quarantine shard-tagged.
 """
 
 from __future__ import annotations
 
+import functools
 import hashlib
+import time
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import trace
 from ..models.automaton import (
-    NODE_COLS, CompiledTrie, compile_tries, tokenize,
+    NODE_COLS, CompiledTrie, PatchableTrie, _build_edge_table,
+    compile_tries, tokenize,
 )
-from ..models.matcher import TpuMatcher, _parse_levels
+from ..models.matcher import TpuMatcher, _parse_levels, _pow2_batch
 from ..models.oracle import UNCAPPED_FANOUT, MatchedRoutes, SubscriptionTrie
 from ..ops.match import (
-    RT_COLS, DeviceTrie, Probes, _route_walk, expand_intervals,
-    route_cols_from_node_tab,
+    RT_COLS, DeviceTrie, Probes, _pad_patch_idx, _route_walk,
+    expand_intervals, route_cols_from_node_tab,
 )
+from ..utils.env import env_bool
+from ..utils.metrics import STAGES
 
 REPLICA_AXIS = "replica"
 SHARD_AXIS = "shard"
+
+
+def mesh_patch_enabled() -> bool:
+    """Kill-switch for the per-shard patch plane (``BIFROMQ_MESH_PATCH=0``
+    restores the overlay+compaction mutation path on the mesh)."""
+    return env_bool("BIFROMQ_MESH_PATCH", True)
 
 
 def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
@@ -75,6 +108,9 @@ class ShardedTables:
     MUST consult the snapshot's own pins — a pin applied after this build
     only takes effect when the recompiled tables swap in, so queries
     always route to the shard that actually holds the tenant.
+    ``replicated`` names the hot tenants compiled into EVERY shard
+    (query fan-out balancing); ``compiled`` holds per-shard
+    :class:`PatchableTrie` arenas once :meth:`make_patchable` ran.
     """
     node_tab: np.ndarray    # [S, N, NODE_COLS]
     edge_tab: np.ndarray    # [S, T, 4]
@@ -85,8 +121,12 @@ class ShardedTables:
     max_levels: int
     pins: Optional[Dict[str, int]] = None
     route_tab: Optional[np.ndarray] = None   # [S, N, RT_COLS]
+    replicated: Optional[FrozenSet[str]] = None
 
     def shard_of(self, tenant_id: str) -> int:
+        """The tenant's HOME shard (hash placement unless pinned).
+        Replicated tenants report their home shard too — callers that
+        care about every copy use :meth:`shards_of`."""
         if self.pins:
             pin = self.pins.get(tenant_id)
             # same range guard as build_sharded: an out-of-range pin fell
@@ -94,6 +134,13 @@ class ShardedTables:
             if pin is not None and 0 <= pin < self.n_shards:
                 return pin
         return tenant_shard(tenant_id, self.n_shards)
+
+    def shards_of(self, tenant_id: str) -> List[int]:
+        """Every shard holding this tenant's automaton (all shards for a
+        replicated hot tenant) — the mutation fan-out set."""
+        if self.replicated and tenant_id in self.replicated:
+            return list(range(self.n_shards))
+        return [self.shard_of(tenant_id)]
 
     def root_of(self, tenant_id: str) -> int:
         return self.compiled[self.shard_of(tenant_id)].root_of(tenant_id)
@@ -106,17 +153,120 @@ class ShardedTables:
         from ..obs.capacity import sharded_tables_device_bytes
         return sharded_tables_device_bytes(self)
 
+    # ------------- per-shard patchable arenas (ISSUE 15) -------------------
+
+    @property
+    def patchable(self) -> bool:
+        return all(isinstance(ct, PatchableTrie) for ct in self.compiled)
+
+    def make_patchable(self) -> "ShardedTables":
+        """Wrap every shard in a :class:`PatchableTrie` arena and restack
+        — the one-time conversion after a compile (in-place mutations
+        then never rebuild). build_sharded already forced one common
+        edge bucket count; node caps stay per-shard (pow2 + headroom)
+        and the stacks pad to the max."""
+        self.compiled = [ct if isinstance(ct, PatchableTrie)
+                         else PatchableTrie(ct) for ct in self.compiled]
+        self.restack()
+        return self
+
+    def sync_edge_caps(self) -> bool:
+        """Regrow every shard's edge table to the COMMON bucket count
+        (the device-side mixing mask reads one shared shape). Called on
+        the MUTATION path right after a patch op — never from the flush
+        — so cap changes are a pure function of the op stream: a replica
+        applying the same ops regrows at the same op with the same live
+        entry set, keeping arenas byte-identical (``_build_edge_table``
+        is deterministic in (live set, cap)). Returns True when any
+        shard regrew."""
+        if not self.patchable:
+            return False
+        edge_cap = max(pt.edge_tab.shape[0] for pt in self.compiled)
+        changed = False
+        while True:
+            for pt in self.compiled:
+                if pt.edge_tab.shape[0] < edge_cap:
+                    entries = pt.edge_tab.reshape(-1, 4)
+                    live = entries[entries[:, 0] >= 0]
+                    pt.edge_tab = _build_edge_table(
+                        live, self.probe_len, min_cap=edge_cap)
+                    pt._full.add("edge")
+                    pt._dirty_edges.clear()
+                    changed = True
+            new_cap = max(pt.edge_tab.shape[0] for pt in self.compiled)
+            if new_cap == edge_cap:
+                break
+            edge_cap = new_cap
+        return changed
+
+    def restack(self) -> None:
+        """Rebuild the stacked host arrays from the (possibly patched)
+        per-shard arenas — the full-re-upload half of a mesh reshape.
+        Pure STACKING: per-shard arena shapes are never touched here
+        (node caps are op-driven; edge caps sync on the mutation path),
+        so replica arenas stay byte-identical to the leader's regardless
+        of flush cadence. Drains every shard's dirty set: the fresh
+        stacks subsume it."""
+        assert len({pt.edge_tab.shape[0] for pt in self.compiled}) == 1, \
+            "edge caps must be common (sync_edge_caps on the mutation path)"
+        s = self.n_shards
+        n_max = max(ct.node_tab.shape[0] for ct in self.compiled)
+        cap = max(ct.edge_tab.shape[0] for ct in self.compiled)
+        e_max = max(ct.child_list.shape[0] for ct in self.compiled)
+        node_tab = np.full((s, n_max, NODE_COLS), -1, dtype=np.int32)
+        edge_tab = np.full((s, cap, self.probe_len, 4), -1, dtype=np.int32)
+        child_list = np.full((s, e_max), -1, dtype=np.int32)
+        route_tab = np.zeros((s, n_max, RT_COLS), dtype=np.int32)
+        for i, ct in enumerate(self.compiled):
+            n = ct.node_tab.shape[0]
+            node_tab[i, :n] = ct.node_tab
+            edge_tab[i] = ct.edge_tab
+            child_list[i, :ct.child_list.shape[0]] = ct.child_list
+            route_tab[i, :n] = route_cols_from_node_tab(ct.node_tab)
+            if isinstance(ct, PatchableTrie):
+                ct.drain_dirty()
+        self.node_tab = node_tab
+        self.edge_tab = edge_tab
+        self.child_list = child_list
+        self.route_tab = route_tab
+
+    @classmethod
+    def from_patchable(cls, pts: List[PatchableTrie], *, probe_len: int,
+                       max_levels: int, pins: Optional[Dict[str, int]] = None,
+                       replicated=None) -> "ShardedTables":
+        """Reassemble a mesh base from SHIPPED per-shard arenas (ISSUE 15
+        mesh replication: a standby installs the leader's exact shard
+        arenas — no DFS, no compile — then tracks the op stream)."""
+        s = len(pts)
+        self = cls(node_tab=np.zeros((s, 1, NODE_COLS), np.int32),
+                   edge_tab=np.zeros((s, 1, probe_len, 4), np.int32),
+                   child_list=np.zeros((s, 1), np.int32),
+                   compiled=list(pts), n_shards=s, probe_len=probe_len,
+                   max_levels=max_levels,
+                   pins=dict(pins) if pins else None,
+                   route_tab=None,
+                   replicated=(frozenset(replicated)
+                               if replicated else None))
+        self.restack()
+        return self
+
 
 def build_sharded(tries: Dict[str, SubscriptionTrie], n_shards: int, *,
                   max_levels: int = 16, probe_len: int = 16,
-                  pins: Optional[Dict[str, int]] = None) -> ShardedTables:
+                  pins: Optional[Dict[str, int]] = None,
+                  replicate: Optional[Set[str]] = None) -> ShardedTables:
     """Compile each tenant shard with a common edge-table capacity.
 
     All shards share one edge-table size (power of two) so the device-side
     mixing mask is identical; node/child arrays are -1-padded to the max.
+    Tenants in ``replicate`` (hot tenants) compile into EVERY shard.
     """
     by_shard: List[Dict[str, SubscriptionTrie]] = [dict() for _ in range(n_shards)]
     for tenant_id, trie in tries.items():
+        if replicate and tenant_id in replicate:
+            for d in by_shard:
+                d[tenant_id] = trie
+            continue
         sh = (pins or {}).get(tenant_id)
         if sh is None or not (0 <= sh < n_shards):
             sh = tenant_shard(tenant_id, n_shards)
@@ -157,7 +307,9 @@ def build_sharded(tries: Dict[str, SubscriptionTrie], n_shards: int, *,
                          n_shards=n_shards, probe_len=probe_len,
                          max_levels=max_levels,
                          pins=dict(pins) if pins else None,
-                         route_tab=route_tab)
+                         route_tab=route_tab,
+                         replicated=(frozenset(replicate)
+                                     if replicate else None))
 
 
 def make_mesh(n_replicas: int, n_shards: int,
@@ -185,9 +337,11 @@ def make_match_step(mesh: Mesh, *, probe_len: int, k_states: int = 32,
     Outputs: per-topic matched-slot INTERVALS [R, S, B, A] × (start,
              count) — the same compressed MatchedRoutes the single-chip
              walk_routes emits — plus per-topic totals, overflow, and a
-             globally psum'd matched-route count. Cross-device traffic is
-             exactly one psum: probes are shard-routed host-side, so the
-             match itself needs no collective.
+             globally psum'd matched-route count (the cross-shard fan-out
+             MERGE happens on device; the host reads one scalar). Cross-
+             device traffic is exactly that one psum: probes are
+             shard-routed host-side, so the match itself needs no
+             collective.
     """
     key = (mesh, probe_len, k_states, max_intervals)
     cached = _STEP_CACHE.get(key)
@@ -226,6 +380,112 @@ def make_match_step(mesh: Mesh, *, probe_len: int, k_states: int = 32,
     return step
 
 
+# --------------- narrow per-shard device scatters (ISSUE 15) ---------------
+#
+# The single-chip patch flush ships idx+rows into flat tables
+# (ops.match.patch_device_trie); the mesh flush ships the SAME narrow
+# updates into one shard's slice of the stacked tables. ``shard`` is
+# static (one trace per shard id per shape class — S is small) so the
+# update lowers as a local dynamic-update on the owning mesh column.
+# Donated variants update in place when the dispatch ring proves no
+# in-flight reader of the old tables exists (the matcher's
+# single-serving-thread contract, models/matcher._flush_patches).
+
+@functools.partial(jax.jit, static_argnames=("shard",))
+def _shard_scatter(tab, idx, vals, *, shard: int):
+    return tab.at[shard, idx].set(vals)
+
+
+@functools.partial(jax.jit, static_argnames=("shard",), donate_argnums=(0,))
+def _shard_scatter_donated(tab, idx, vals, *, shard: int):
+    return tab.at[shard, idx].set(vals)
+
+
+@functools.partial(jax.jit, static_argnames=("shard",))
+def _shard_slice_set(tab, vals, *, shard: int):
+    return tab.at[shard].set(vals)
+
+
+@functools.partial(jax.jit, static_argnames=("shard",), donate_argnums=(0,))
+def _shard_slice_set_donated(tab, vals, *, shard: int):
+    return tab.at[shard].set(vals)
+
+
+# ---------------------- mesh serving plumbing (ISSUE 15) -------------------
+
+
+@dataclass
+class _MeshResult:
+    """The mesh step's in-flight result leaves, shaped like the
+    single-chip :class:`~bifromq_tpu.ops.match.RouteIntervals` surface the
+    ring/watchdog/quarantine machinery reads (``start``/``count``/
+    ``overflow`` — ``is_ready``/``copy_to_host_async`` probe these)."""
+    start: object     # [R, S, B, A] int32
+    count: object     # [R, S, B, A] int32
+    overflow: object  # [R, S, B] bool
+
+
+class _CanaryTokens:
+    """Outstanding half-open canary probes for one in-flight mesh batch.
+
+    A canary admission reserves the breaker's single probe slot; the
+    verdict lands in ``_expand_walk`` (row parity) or the timeout path.
+    A batch abandoned BEFORE a verdict (device error, cancellation, a
+    re-prep discarding the prepared batch) must hand the slot back or
+    the breaker wedges half-open refusing forever — the finalizer
+    releases whatever was never settled."""
+
+    __slots__ = ("pending",)
+
+    def __init__(self) -> None:
+        self.pending: Dict[int, object] = {}    # shard -> breaker
+
+    def settle(self, shard: int) -> None:
+        self.pending.pop(shard, None)
+
+    def __del__(self):
+        for br in self.pending.values():
+            try:
+                br.release_probe()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+
+class _MeshPrepared:
+    """Stage-1 output of the mesh leg: shard-routed, tokenized and
+    uploaded probe grids, built BEFORE ring admission (ISSUE 11 overlap
+    contract) with per-shard breaker admission already applied."""
+
+    __slots__ = ("queries", "ct", "batch", "b", "slots", "grids",
+                 "lengths_np", "oracle_qis", "canaries", "dispatch_shards",
+                 "tokenize_s")
+
+    def __init__(self, **kw) -> None:
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+class _MeshInFlight:
+    """Captured dispatch state for one mesh batch — the mesh twin of
+    models.matcher._InFlight: expansion must run against THIS snapshot
+    (tables object + overlay dict objects), never re-read the live
+    matcher, or a mid-flight compaction swap drops overlay routes."""
+
+    __slots__ = ("queries", "ct", "dev", "res", "tomb", "delta", "batch",
+                 "b", "slots", "lengths_np", "oracle_qis", "canaries",
+                 "dispatch_shards", "kernel", "fault", "fault_shards",
+                 "dispatch_s", "tokenize_s", "quarantine_tag")
+
+    def __init__(self, **kw) -> None:
+        self.fault = None
+        self.fault_shards = {}
+        self.dispatch_s = 0.0
+        self.tokenize_s = 0.0
+        self.quarantine_tag = "mesh"
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
 @dataclass(frozen=True)
 class ShardMoveCommand:
     """One balancer decision: re-pin a tenant's automaton shard (the
@@ -260,6 +520,8 @@ class ShardPlacementBalancer:
         shard_heat = [0] * s
         by_shard: List[List[Tuple[int, str]]] = [[] for _ in range(s)]
         for tenant_id, h in heat.items():
+            if tables.replicated and tenant_id in tables.replicated:
+                continue    # replicated tenants spread by construction
             sh = tables.shard_of(tenant_id)
             shard_heat[sh] += h
             by_shard[sh].append((h, tenant_id))
@@ -286,31 +548,30 @@ class ShardPlacementBalancer:
 
 class MeshMatcher(TpuMatcher):
     """The multi-device match plane with TpuMatcher's full mutation
-    machinery — delta overlay, tombstones, background shadow-compile
-    compaction — inherited unchanged; only the compile target (sharded
-    tables placed over the mesh) and the walk (shard-routed [R,S,B]
-    batches through the shard_map step) differ. A MeshMatcher therefore
-    drops into every TpuMatcher seat (DistWorkerCoProc, DistWorker) and
-    serves live add_route/remove_route traffic, answering VERDICT-r2's
-    'MeshMatcher is a demo' finding."""
+    machinery — per-shard in-place patching first, delta overlay as the
+    fallback, background shadow-compile compaction — and the SAME staged
+    serving path (prepare → dispatch → ready → fetch → expand) as the
+    single-chip matcher, so the async dispatch ring, watchdog, quarantine
+    and profiler drive the mesh leg unchanged. A MeshMatcher drops into
+    every TpuMatcher seat (DistWorkerCoProc, DistWorker) and serves live
+    add_route/remove_route traffic."""
 
-    # the shard-routed [R,S,B] device plane replaces _match_batch_device
-    # wholesale, so the ISSUE 6 async dispatch ring (which drives
-    # TpuMatcher._dispatch_device) degrades to this sync path; pipelining
-    # the mesh step is the ROADMAP multi-chip item's business
-    supports_async = False
-    # ISSUE 9: the compile target is ShardedTables (per-shard stacks on a
-    # mesh), not the single-chip PatchableTrie — mutations keep the
-    # overlay+compaction path; per-shard independent patching is the
-    # sharded-matcher ROADMAP follow-up this PR's arena layout unlocks
-    supports_patching = False
+    # ISSUE 15: the mesh leg now implements the staged serving contract
+    # (_prepare_probes/_dispatch_prepared/_expand_walk), so the shared
+    # async ring + watchdog drive it like the single-chip path
+    supports_async = True
+    # ISSUE 15: per-shard PatchableTrie arenas — mutations fold into the
+    # owning shard(s) in place; BIFROMQ_MESH_PATCH=0 kills back to the
+    # overlay+compaction path
+    supports_patching = True
 
     def __init__(self, tries: Optional[Dict[str, SubscriptionTrie]] = None,
                  mesh: Optional[Mesh] = None, *,
                  max_levels: int = 16, probe_len: int = 16,
                  k_states: int = 32, auto_compact: bool = True,
                  compact_threshold: int = 2048,
-                 match_cache: Optional[bool] = None) -> None:
+                 match_cache: Optional[bool] = None,
+                 replicate: Optional[Set[str]] = None) -> None:
         assert mesh is not None, "MeshMatcher requires a mesh"
         super().__init__(max_levels=max_levels, k_states=k_states,
                          probe_len=probe_len, auto_compact=auto_compact,
@@ -322,10 +583,28 @@ class MeshMatcher(TpuMatcher):
         self._step = make_match_step(mesh, probe_len=probe_len,
                                      k_states=k_states)
         self._table_sharding = NamedSharding(mesh, P(SHARD_AXIS))
+        self._probe_sharding = NamedSharding(mesh, P(REPLICA_AXIS,
+                                                     SHARD_AXIS))
+        self._repl_sharding = NamedSharding(mesh, P())
+        # ISSUE 15 fault domains: ONE breaker per shard on the shared
+        # board replaces the single matcher-level device breaker — an
+        # open shard's rows degrade to the host oracle while healthy
+        # shards keep serving on device; the board joins them to
+        # /metrics fabric.breakers + the gossip digest per label
+        from ..resilience.device import (DEVICE_BREAKERS,
+                                         device_breaker_enabled)
+        self.device_breaker = None
+        self.shard_breakers = [
+            DEVICE_BREAKERS.create(label=f"shard{sh}")
+            if device_breaker_enabled() else None
+            for sh in range(self.n_shards)]
         # load-driven shard re-placement (SURVEY §2.8 placement): desired
         # tenant→shard pins; the serving snapshot routes by ITS OWN pin
         # copy until a recompile swaps the new assignment in
         self._pins: Dict[str, int] = {}
+        # hot tenants compiled into EVERY shard (ISSUE 15): queries fan
+        # to the least-loaded grid slot; mutations fan to all shards
+        self._replicas: Set[str] = set(replicate or ())
         self.query_heat: Dict[str, int] = {}
         self.shard_balancer = ShardPlacementBalancer()
         if tries:
@@ -345,7 +624,8 @@ class MeshMatcher(TpuMatcher):
                            probe_len=self.probe_len, k_states=self.k_states,
                            auto_compact=self.auto_compact,
                            compact_threshold=self.compact_threshold,
-                           match_cache=self.match_cache is not None)
+                           match_cache=self.match_cache is not None,
+                           replicate=set(self._replicas))
 
     # ---------------- compile target: sharded tables on the mesh -----------
 
@@ -356,18 +636,178 @@ class MeshMatcher(TpuMatcher):
         tables = build_sharded(self._shadow, self.n_shards,
                                max_levels=self.max_levels,
                                probe_len=self.probe_len,
-                               pins=dict(self._pins))
+                               pins=dict(self._pins),
+                               replicate=set(self._replicas))
+        if self._patching_enabled():
+            # ISSUE 15: per-shard patchable arenas at common capacities —
+            # the padded stacked shape is what the mesh step jits against
+            tables.make_patchable()
         # node_tab intentionally NOT uploaded: the interval step never
         # gathers from it (route_tab carries every column the walk reads)
         dev = (jax.device_put(tables.edge_tab, self._table_sharding),
                jax.device_put(tables.child_list, self._table_sharding),
                jax.device_put(tables.route_tab, self._table_sharding))
-        # ISSUE 8: the mesh plane now feeds the same compile accounting
-        # (time + ledger attribution via _install_base) as single-chip —
-        # it previously counted compiles but never their wall time
+        # warm the step at the small-grid shape so the first serve after
+        # an install (this runs on the compile thread) pays no trace
+        self._warm_step(dev)
+        # ISSUE 8: the mesh plane feeds the same compile accounting
+        # (time + ledger attribution via _install_base) as single-chip
         self._last_compile_s = _time.perf_counter() - t0
         self.compile_time_s += self._last_compile_s
         return tables, dev
+
+    def _warm_step(self, dev, b: int = 16) -> None:
+        try:
+            r, s = self.n_replicas, self.n_shards
+            width = self.max_levels + 1
+            z = np.zeros((r, s, b, width), dtype=np.int32)
+            lengths = np.full((r, s, b), -1, dtype=np.int32)
+            roots = np.full((r, s, b), -1, dtype=np.int32)
+            sysm = np.zeros((r, s, b), dtype=bool)
+            out = self._step(dev[0], dev[1], dev[2], z, z, lengths, roots,
+                             sysm)
+            out[4].block_until_ready()
+        except Exception:  # noqa: BLE001 — warm-up is best-effort
+            pass
+
+    # ---------------- per-shard patch plane (ISSUE 15 tentpole) ------------
+
+    def _patching_enabled(self) -> bool:
+        return super()._patching_enabled() and mesh_patch_enabled()
+
+    def _base_patchable(self) -> bool:
+        base = self._base_ct
+        return isinstance(base, ShardedTables) and base.patchable
+
+    def _patch_targets(self, tenant_id: str) -> list:
+        base = self._base_ct
+        if not isinstance(base, ShardedTables) \
+                or not self._patching_enabled():
+            return []
+        pts = [base.compiled[sh] for sh in base.shards_of(tenant_id)]
+        if not all(isinstance(pt, PatchableTrie) for pt in pts):
+            return []
+        return pts
+
+    def _patch_frag_pending(self) -> bool:
+        base = self._base_ct
+        return isinstance(base, ShardedTables) and any(
+            isinstance(pt, PatchableTrie) and pt.frag_pending()
+            for pt in base.compiled)
+
+    def _try_patch(self, op) -> bool:
+        ok = super()._try_patch(op)
+        if ok:
+            # edge-cap sync ON THE MUTATION PATH (not the flush): an
+            # organic bucket regrow on one shard regrows the rest to the
+            # new common mask at the SAME op position — a replica
+            # applying the same op stream regrows at the same point with
+            # the same live sets, keeping arenas byte-identical
+            base = self._base_ct
+            if isinstance(base, ShardedTables):
+                base.sync_edge_caps()
+        return ok
+
+    def _flush_patches(self, own_slots: int = 0) -> None:
+        """Ship every dirty shard's host patches as NARROW per-shard
+        scatters into the stacked device tables (one coalesced flush per
+        dispatch, donated in place when nothing else is in flight — the
+        same exclusivity proof as the single-chip flush). An arena
+        reshape (node growth / edge regrow on any shard) RESTACKS at the
+        new common capacities and re-uploads — pow2-amortized, never a
+        recompile."""
+        base = self._base_ct
+        if not isinstance(base, ShardedTables) or self._device_trie is None:
+            return
+        dirty = [(sh, pt) for sh, pt in enumerate(base.compiled)
+                 if isinstance(pt, PatchableTrie) and pt.dirty]
+        if not dirty:
+            return
+        ring = self._ring
+        donate = ring is None or (ring.in_flight <= own_slots
+                                  and not len(ring.quarantine))
+        t0 = time.perf_counter()
+        dev_edge, dev_child, dev_route = self._device_trie
+        node_dim = int(dev_route.shape[1])
+        edge_shape = tuple(dev_edge.shape[1:])
+        restack = any(pt.node_tab.shape[0] > node_dim
+                      or tuple(pt.edge_tab.shape) != edge_shape
+                      for _, pt in dirty)
+        ops_total = rows_total = bytes_total = 0
+        full_tags = set()
+        drained: List[Tuple[PatchableTrie, int]] = []
+        put = functools.partial(jax.device_put, device=self._repl_sharding)
+        scatter = _shard_scatter_donated if donate else _shard_scatter
+        slice_set = _shard_slice_set_donated if donate else _shard_slice_set
+        try:
+            if restack:
+                for _, pt in dirty:
+                    ops = pt.drain_dirty()[3]
+                    drained.append((pt, ops))
+                    ops_total += ops
+                base.restack()
+                dev_edge = jax.device_put(base.edge_tab,
+                                          self._table_sharding)
+                dev_route = jax.device_put(base.route_tab,
+                                           self._table_sharding)
+                rows_total = int(base.route_tab.shape[0]
+                                 * base.route_tab.shape[1])
+                bytes_total = int(base.edge_tab.nbytes
+                                  + base.route_tab.nbytes)
+                full_tags.add("restack")
+            else:
+                for sh, pt in dirty:
+                    full, nodes, edges, ops = pt.drain_dirty()
+                    drained.append((pt, ops))
+                    ops_total += ops
+                    if "node" in full:
+                        from ..models.automaton import pad_rows
+                        rows = pad_rows(
+                            route_cols_from_node_tab(pt.node_tab),
+                            node_dim)
+                        dev_route = slice_set(dev_route, put(rows),
+                                              shard=sh)
+                        rows_total += int(rows.shape[0])
+                        bytes_total += int(rows.nbytes)
+                        full_tags.add(f"s{sh}:node")
+                    elif nodes.size:
+                        idx_np = _pad_patch_idx(nodes.astype(np.int32))
+                        rows_np = route_cols_from_node_tab(
+                            pt.node_tab[idx_np])
+                        dev_route = scatter(dev_route, put(idx_np),
+                                            put(rows_np), shard=sh)
+                        rows_total += int(nodes.size)
+                        bytes_total += int(idx_np.nbytes + rows_np.nbytes)
+                    if "edge" in full:
+                        dev_edge = slice_set(dev_edge, put(pt.edge_tab),
+                                             shard=sh)
+                        rows_total += int(pt.edge_tab.shape[0])
+                        bytes_total += int(pt.edge_tab.nbytes)
+                        full_tags.add(f"s{sh}:edge")
+                    elif edges.size:
+                        idx_np = _pad_patch_idx(edges.astype(np.int32))
+                        rows_np = pt.edge_tab[idx_np]
+                        dev_edge = scatter(dev_edge, put(idx_np),
+                                           put(rows_np), shard=sh)
+                        rows_total += int(edges.size)
+                        bytes_total += int(idx_np.nbytes + rows_np.nbytes)
+        except BaseException:
+            # a flush that dies mid-update must not lose the drained row
+            # ids (donation may even have consumed a table): mark every
+            # drained shard for full re-upload from its host arenas
+            for pt, ops in drained:
+                pt.restore_dirty(ops)
+            raise
+        self._device_trie = (dev_edge, dev_child, dev_route)
+        dt = time.perf_counter() - t0
+        self.patch_flushes += 1
+        self.patch_device_s += dt
+        STAGES.record("mesh.flush", dt)
+        from ..obs import OBS
+        OBS.profiler.ledger.record_patch(
+            reason="+".join(sorted(full_tags)) if full_tags else "rows",
+            mutations=ops_total, rows=rows_total,
+            bytes_shipped=bytes_total, duration_s=dt)
 
     # ---------------- load-driven shard re-placement ------------------------
 
@@ -377,6 +817,14 @@ class MeshMatcher(TpuMatcher):
         the installed snapshot keeps routing by its own assignment)."""
         assert 0 <= shard < self.n_shards
         self._pins[tenant_id] = shard
+
+    def replicate_tenant(self, tenant_id: str) -> None:
+        """Mark a hot tenant for replication across EVERY shard (ISSUE 15:
+        query fan-out spreads over the whole grid; mutations fan to all
+        copies). Takes effect when the next recompiled snapshot swaps in."""
+        if tenant_id not in self._replicas:
+            self._replicas.add(tenant_id)
+            self._maybe_compact(force=True)
 
     def rebalance_step(self) -> Optional[ShardMoveCommand]:
         """One balancer round (≈ KVStoreBalanceController.java:85's
@@ -402,131 +850,284 @@ class MeshMatcher(TpuMatcher):
                            if h // 2 > 0}
         return cmd
 
-    # ---------------- query side -------------------------------------------
+    # ---------------- staged serving path (ISSUE 15 tentpole) --------------
+    #
+    # The mesh leg implements the SAME prepare/dispatch/expand stage
+    # contract as the single-chip matcher, so TpuMatcher's sync entry
+    # (_match_batch_device) and async entry (_device_leg_async — ring
+    # admission, watchdogged readiness, fetch-on-ready, quarantine,
+    # profiler stamping) drive it without a mesh-specific serve loop.
 
-    def _match_batch_device(self, queries: Sequence[Tuple[str,
-                                                          Sequence[str]]],
-                            *, max_persistent_fanout: int = UNCAPPED_FANOUT,
-                            max_group_fanout: int = UNCAPPED_FANOUT,
-                            batch: Optional[int] = None,
-                            per_device_batch: Optional[int] = None,
-                            stats: Optional[dict] = None
-                            ) -> List[MatchedRoutes]:
-        """Match (tenant, topic_levels) pairs across the mesh; exact at
-        every instant (base walk ⊕ overlay ⊖ tombstones) like TpuMatcher.
-        The cache/dedup front-end (TpuMatcher.match_batch, ISSUE 4) is
-        inherited — only the device plane differs. ``stats`` is accepted
-        for signature parity with the front-end; the mesh plane has no
-        device breaker yet (ROADMAP follow-up) so it never sets
-        ``degraded``."""
-        if not queries:
-            return []
+    def _route_slots(self, queries, tables: ShardedTables
+                     ) -> List[List[int]]:
+        """Route each query to its (replica, shard) slot: home-shard
+        queries round-robin across replicas; replicated hot tenants take
+        the least-loaded slot of the WHOLE grid."""
+        r, s = self.n_replicas, self.n_shards
+        slots: List[List[int]] = [[] for _ in range(r * s)]
+        replicated = tables.replicated or frozenset()
+        for qi, (tenant_id, _) in enumerate(queries):
+            self.query_heat[tenant_id] = \
+                self.query_heat.get(tenant_id, 0) + 1
+            if tenant_id in replicated:
+                slot = min(range(r * s), key=lambda j: len(slots[j]))
+            else:
+                sh = tables.shard_of(tenant_id)
+                slot = min((j * s + sh for j in range(r)),
+                           key=lambda j: len(slots[j]))
+            slots[slot].append(qi)
+        return slots
+
+    def _prepare_probes(self, queries, batch: Optional[int] = None
+                        ) -> _MeshPrepared:
+        """Stage 0: shard-route + per-shard breaker admission + tokenize
+        + probe-grid upload, BEFORE ring admission (the async leg preps
+        batch N+1 while batch N walks). ``batch`` from the generic entry
+        is a whole-batch hint; the mesh pads PER DEVICE from the busiest
+        slot's occupancy (honoring the ring's adaptive floor)."""
         self._apply_pending_swap()
         if self._base_ct is None:
             self.refresh()
         tables: ShardedTables = self._base_ct
-        dev_edge, dev_child, dev_route = self._device_trie
         r, s = self.n_replicas, self.n_shards
-        # route each query to its shard, then round-robin across replicas
-        slots: List[List[int]] = [[] for _ in range(r * s)]
-        for qi, (tenant_id, _) in enumerate(queries):
-            # route via the INSTALLED snapshot's assignment (incl. pins)
-            sh = tables.shard_of(tenant_id)
-            rep = min(range(r), key=lambda j: len(slots[j * s + sh]))
-            slots[rep * s + sh].append(qi)
-            self.query_heat[tenant_id] = \
-                self.query_heat.get(tenant_id, 0) + 1
-        if per_device_batch is None:
-            per_device_batch = batch
-        if per_device_batch is None:
-            # power-of-two bucket: keep the set of compiled shapes small
-            need = max(1, max(len(x) for x in slots))
-            b = 16
-            while b < need:
-                b *= 2
-        else:
-            b = per_device_batch
-        assert all(len(x) <= b for x in slots)
-
+        t0 = time.perf_counter()
+        slots = self._route_slots(queries, tables)
+        # per-shard fault domain: an OPEN shard's rows never dispatch —
+        # they serve from the exact host oracle while healthy shards
+        # stay on device; HALF-OPEN admits this batch's rows as the
+        # canary, re-closed only on row parity in _expand_walk
+        oracle_qis: List[int] = []
+        canaries = _CanaryTokens()
+        for sh in range(s):
+            br = self.shard_breakers[sh]
+            if br is None or not any(slots[j * s + sh] for j in range(r)):
+                continue
+            verdict = br.admit()
+            if verdict == "rejected":
+                for j in range(r):
+                    oracle_qis.extend(slots[j * s + sh])
+                    slots[j * s + sh] = []
+            elif verdict == "canary":
+                canaries.pending[sh] = br
+        floor = self._ring.planned_floor() if self._ring is not None else 16
+        need = max([len(x) for x in slots] + [1])
+        b = _pow2_batch(need, floor=floor)
         width = tables.max_levels + 1
         tok_h1 = np.zeros((r, s, b, width), dtype=np.int32)
         tok_h2 = np.zeros((r, s, b, width), dtype=np.int32)
         lengths = np.full((r, s, b), -1, dtype=np.int32)
         roots = np.full((r, s, b), -1, dtype=np.int32)
         sys_mask = np.zeros((r, s, b), dtype=bool)
-        for rep in range(r):
-            for sh in range(s):
-                idxs = slots[rep * s + sh]
-                if not idxs:
-                    continue
-                ct = tables.compiled[sh]
-                topics = [queries[qi][1] for qi in idxs]
-                qroots = [ct.root_of(queries[qi][0]) for qi in idxs]
-                tk = tokenize(topics, qroots, max_levels=ct.max_levels,
-                              salt=ct.salt, batch=b)
-                tok_h1[rep, sh] = tk.tok_h1
-                tok_h2[rep, sh] = tk.tok_h2
-                lengths[rep, sh] = tk.lengths
-                roots[rep, sh] = tk.roots
-                sys_mask[rep, sh] = tk.sys_mask
+        salts = {ct.salt for ct in tables.compiled}
+        cache = self._tok_cache if len(salts) == 1 else None
+        with trace.span("device.tokenize", batch=r * s * b,
+                        queries=len(queries)):
+            for rep in range(r):
+                for sh in range(s):
+                    idxs = slots[rep * s + sh]
+                    if not idxs:
+                        continue
+                    ct = tables.compiled[sh]
+                    topics = [queries[qi][1] for qi in idxs]
+                    qroots = [ct.root_of(queries[qi][0]) for qi in idxs]
+                    tk = tokenize(topics, qroots, max_levels=ct.max_levels,
+                                  salt=ct.salt, batch=b, cache=cache)
+                    tok_h1[rep, sh] = tk.tok_h1
+                    tok_h2[rep, sh] = tk.tok_h2
+                    lengths[rep, sh] = tk.lengths
+                    roots[rep, sh] = tk.roots
+                    sys_mask[rep, sh] = tk.sys_mask
+            # prep-before-admission upload: the grids land on the mesh
+            # NOW, so ring-parked callers hold uploaded probes bounded by
+            # the prep tickets exactly like the single-chip leg
+            grids = tuple(jax.device_put(a, self._probe_sharding)
+                          for a in (tok_h1, tok_h2, lengths, roots,
+                                    sys_mask))
+        tokenize_s = time.perf_counter() - t0
+        STAGES.record("tokenize", tokenize_s)
+        dispatch_shards = sorted({
+            sh for sh in range(s)
+            if any(slots[j * s + sh] for j in range(r))})
+        return _MeshPrepared(queries=list(queries), ct=tables, batch=r * s * b,
+                             b=b, slots=slots, grids=grids,
+                             lengths_np=lengths, oracle_qis=oracle_qis,
+                             canaries=canaries,
+                             dispatch_shards=dispatch_shards,
+                             tokenize_s=tokenize_s)
 
-        import time as _time
-        t_disp = _time.perf_counter()
-        ivl_s, ivl_c, _n_routes, overflow, _total = self._step(
-            dev_edge, dev_child, dev_route,
-            tok_h1, tok_h2, lengths, roots, sys_mask)
-        t_fetch = _time.perf_counter()
-        ivl_s = np.asarray(ivl_s)       # [R, S, B, A]
-        ivl_c = np.asarray(ivl_c)
-        overflow = np.asarray(overflow)
-        t_done = _time.perf_counter()
-        # ISSUE 8: the mesh walk feeds the same per-batch profile stream
-        # as the single-chip paths (kernel tag distinguishes it); padded
-        # rows = the full [R,S,B] grid minus the real queries
-        from ..obs import OBS
-        OBS.profiler.record_batch(
-            n_queries=len(queries), batch=r * s * b, kernel="mesh",
-            dispatch_s=t_fetch - t_disp, fetch_s=t_done - t_fetch,
-            path="sync")
-        # one vectorized expansion for the whole [R*S*B] grid
-        a = ivl_s.shape[-1]
+    def _dispatch_prepared(self, prep: _MeshPrepared, *,
+                           donate: bool = False,
+                           watchdogged: bool = False) -> _MeshInFlight:
+        """Stage 1: flush per-shard patches, enqueue the mesh step.
+        Returns on ENQUEUE — readiness is awaited by the caller (the
+        watchdogged async ring or the sync short-poll)."""
+        from ..resilience.faults import get_injector
+        inj = get_injector()
+        fault = None
+        fault_shards: Dict[int, object] = {}
+        if watchdogged:
+            fault = inj.device_rule("dispatch")
+        else:
+            inj.check_raise("device", "tpu-device", "dispatch")
+        # per-shard chaos (ISSUE 15): rules target method "mesh:shard<k>"
+        # so a test can hang ONE shard's device; the fired rule both
+        # shapes readiness (threaded into wait_ready) and attributes the
+        # resulting timeout to that shard's breaker alone
+        for sh in prep.dispatch_shards:
+            try:
+                rule = inj.device_rule(f"mesh:shard{sh}")
+            except BaseException:
+                br = self.shard_breakers[sh]
+                if br is not None:
+                    br.record_failure(f"injected error shard{sh}")
+                    prep.canaries.settle(sh)
+                raise
+            if rule is not None:
+                fault_shards[sh] = rule
+                if fault is None:
+                    fault = rule
+        if self._base_ct is not prep.ct:
+            # a compaction swap landed between prep and dispatch (the
+            # async leg awaits ring admission in the gap): roots/salts
+            # are per-snapshot, so re-prep against the installed base
+            prep = self._prepare_probes(prep.queries)
+        # ship any host patches accumulated since the last dispatch (one
+        # coalesced narrow update per shard, so this batch walks the
+        # post-mutation tables). watchdogged == the async leg, which
+        # already holds its own (not-yet-dispatched) ring slot.
+        self._flush_patches(own_slots=1 if watchdogged else 0)
+        dev_edge, dev_child, dev_route = self._device_trie
+        t0 = time.perf_counter()
+        with trace.span("device.dispatch", batch=prep.batch,
+                        queries=len(prep.queries)) as sp:
+            ivl_s, ivl_c, _n_routes, overflow, _total = self._step(
+                dev_edge, dev_child, dev_route, *prep.grids)
+            if sp is not trace.NOOP:
+                sp.set_tag("kernel", "mesh")
+        dispatch_s = time.perf_counter() - t0
+        STAGES.record("device.dispatch", dispatch_s)
+        tag = "mesh"
+        if fault_shards:
+            tag = "mesh:" + ",".join(f"shard{sh}"
+                                     for sh in sorted(fault_shards))
+        return _MeshInFlight(
+            queries=prep.queries, ct=prep.ct, dev=self._device_trie,
+            res=_MeshResult(start=ivl_s, count=ivl_c, overflow=overflow),
+            tomb=self._tomb, delta=self._delta, batch=prep.batch,
+            b=prep.b, slots=prep.slots, lengths_np=prep.lengths_np,
+            oracle_qis=prep.oracle_qis, canaries=prep.canaries,
+            dispatch_shards=prep.dispatch_shards, kernel="mesh",
+            fault=fault, fault_shards=fault_shards,
+            dispatch_s=dispatch_s, tokenize_s=prep.tokenize_s,
+            quarantine_tag=tag)
+
+    def _note_device_timeout(self, fl) -> None:
+        """Watchdog attribution (ISSUE 15): a timed-out mesh step feeds
+        the breaker(s) of the shard(s) whose chaos rule shaped the hang
+        when one fired — else every dispatched shard (a whole-mesh stall
+        has no finer evidence). Subsequent batches then exclude exactly
+        the opened shards while the rest keep serving on device."""
+        shards = sorted(getattr(fl, "fault_shards", {}) or ()) \
+            or list(getattr(fl, "dispatch_shards", ()) or ())
+        for sh in shards:
+            br = self.shard_breakers[sh]
+            if br is not None:
+                br.record_failure("mesh step timeout")
+                fl.canaries.settle(sh)
+        # canary shards not implicated got no verdict: hand the probe
+        # slot back so the breaker can re-probe on the next batch
+        for sh, br in list(fl.canaries.pending.items()):
+            br.release_probe()
+            fl.canaries.settle(sh)
+
+    @staticmethod
+    def _canon_routes(m: MatchedRoutes):
+        return (sorted((r.matcher.mqtt_topic_filter, r.receiver_url)
+                       for r in m.normal),
+                {f: sorted(r.receiver_url for r in ms)
+                 for f, ms in m.groups.items()})
+
+    def _expand_walk(self, fl: _MeshInFlight, overflow, starts_a, counts_a,
+                     max_persistent_fanout: int,
+                     max_group_fanout: int) -> List[MatchedRoutes]:
+        """Stage 3: one vectorized interval expansion for the whole
+        [R,S,B] grid + overlay correction against the _MeshInFlight
+        SNAPSHOT, canary parity settlement, and exact host-oracle serving
+        for breaker-excluded / unknown-tenant / overflowed rows."""
+        tables: ShardedTables = fl.ct
+        r, s, b = overflow.shape
+        a = starts_a.shape[-1]
         flat_slots, flat_offs = expand_intervals(
-            ivl_s.reshape(-1, a), ivl_c.reshape(-1, a))
-
-        out: List[MatchedRoutes] = [MatchedRoutes() for _ in queries]
+            starts_a.reshape(-1, a), counts_a.reshape(-1, a))
+        out: List[Optional[MatchedRoutes]] = [None] * len(fl.queries)
+        oracle_qis: Set[int] = set(fl.oracle_qis)
+        canary_rows: Dict[int, List[int]] = {}
         for rep in range(r):
             for sh in range(s):
                 ct = tables.compiled[sh]
-                for bi, qi in enumerate(slots[rep * s + sh]):
-                    tenant_id, levels = queries[qi]
-                    tomb = self._tomb.get(tenant_id)
-                    delta = self._delta.get(tenant_id)
-                    if ct.root_of(tenant_id) < 0:
-                        # tenant newer than the base: authoritative serve
-                        trie = self.tries.get(tenant_id)
-                        if trie is not None:
-                            out[qi] = trie.match(
-                                _parse_levels(levels),
-                                max_persistent_fanout=max_persistent_fanout,
-                                max_group_fanout=max_group_fanout)
+                for bi, qi in enumerate(fl.slots[rep * s + sh]):
+                    tenant_id, levels = fl.queries[qi]
+                    if ct.root_of(tenant_id) < 0 \
+                            or overflow[rep, sh, bi] \
+                            or fl.lengths_np[rep, sh, bi] < 0:
+                        # tenant newer than the base / active-set or
+                        # interval overflow / topic too deep: exact
+                        # host fallback (not a fault-domain degradation)
+                        oracle_qis.add(qi)
                         continue
-                    if overflow[rep, sh, bi] or lengths[rep, sh, bi] < 0:
-                        trie = self.tries.get(tenant_id)
-                        out[qi] = (trie.match(
-                            _parse_levels(levels),
-                            max_persistent_fanout=max_persistent_fanout,
-                            max_group_fanout=max_group_fanout)
-                            if trie is not None else MatchedRoutes())
-                        continue
-                    row = (rep * s + sh) * b + bi
-                    srow = flat_slots[flat_offs[row]:flat_offs[row + 1]]
+                    row_i = (rep * s + sh) * b + bi
+                    row = flat_slots[flat_offs[row_i]:flat_offs[row_i + 1]]
+                    tomb = fl.tomb.get(tenant_id)
+                    delta = fl.delta.get(tenant_id)
                     if not tomb and delta is None:
                         out[qi] = self._routes_from_slots(
-                            ct, srow, max_persistent_fanout,
+                            ct, row, max_persistent_fanout,
                             max_group_fanout)
                     else:
                         out[qi] = self._expand_with_overlay(
-                            ct, srow, tomb or (), delta,
+                            ct, row, tomb or (), delta,
                             _parse_levels(levels),
                             max_persistent_fanout, max_group_fanout)
+                    if sh in fl.canaries.pending:
+                        canary_rows.setdefault(sh, []).append(qi)
+        # half-open settlement: a canary shard re-closes ONLY when its
+        # device rows are row-identical to the host oracle; wrong rows
+        # reopen the breaker and the oracle rows serve instead
+        for sh, br in list(fl.canaries.pending.items()):
+            qis = canary_rows.get(sh)
+            if not qis:
+                # every row of the canary shard fell to the oracle —
+                # no device evidence either way: release the probe
+                br.release_probe()
+                fl.canaries.settle(sh)
+                continue
+            oracle = self.match_from_tries(
+                [fl.queries[qi] for qi in qis],
+                max_persistent_fanout=max_persistent_fanout,
+                max_group_fanout=max_group_fanout)
+            if all(self._canon_routes(out[qi]) == self._canon_routes(om)
+                   for qi, om in zip(qis, oracle)):
+                br.record_success()
+            else:
+                br.record_failure("canary row parity")
+                for qi, om in zip(qis, oracle):
+                    out[qi] = om
+            fl.canaries.settle(sh)
+        if oracle_qis:
+            qlist = sorted(oracle_qis)
+            rows = self.match_from_tries(
+                [fl.queries[qi] for qi in qlist],
+                max_persistent_fanout=max_persistent_fanout,
+                max_group_fanout=max_group_fanout)
+            for qi, m in zip(qlist, rows):
+                out[qi] = m
+            degraded = len(fl.oracle_qis)
+            if degraded:
+                # ONLY breaker-excluded rows are a degradation; the
+                # overflow/unknown-tenant fallback is normal serving
+                from ..utils.metrics import FABRIC, FabricMetric
+                FABRIC.inc(FabricMetric.MATCH_DEGRADED, degraded)
+                with trace.span("match.degraded", reason="shard_breaker",
+                                n_queries=degraded):
+                    pass
         return out

@@ -28,6 +28,7 @@ applied at-least-once safely.
 from __future__ import annotations
 
 import struct
+import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -321,45 +322,106 @@ class BaseSnapshot:
         return out
 
 
-def encode_base(pt: PatchableTrie,
-                tries: Dict[str, SubscriptionTrie]) -> bytes:
-    """Serialize the leader's host arenas + authoritative route set (the
-    bounded resync: bytes ship, nothing recompiles)."""
-    out = bytearray([WIRE_VERSION])
-    out += struct.pack(">qII", pt.salt, pt.probe_len, pt.max_levels)
-    out += struct.pack(">II", pt.n_live, pt.node_tab.shape[0])
-    out += _frame(np.ascontiguousarray(pt.node_tab,
+@dataclass
+class MeshBaseSnapshot:
+    """Decoded MESH ``repl_base`` payload (ISSUE 15): one arena set per
+    shard plus the routing metadata (pins + replicated hot tenants) the
+    standby needs to route op-stream mutations to the same shard the
+    leader did. Shard assignment is FIXED within a stream epoch — any
+    recompile/re-placement anchors the stream, forcing a resync — so
+    routing by this snapshot's own pins is exact for every record that
+    follows it."""
+
+    n_shards: int
+    probe_len: int
+    max_levels: int
+    pins: Dict[str, int]
+    replicated: Tuple[str, ...]
+    shards: List[BaseSnapshot]          # per-shard arenas (routes empty)
+    routes: Dict[str, List[Route]]
+
+    def to_tries(self) -> Dict[str, SubscriptionTrie]:
+        out: Dict[str, SubscriptionTrie] = {}
+        for tenant, routes in self.routes.items():
+            trie = out.setdefault(tenant, SubscriptionTrie())
+            for r in routes:
+                trie.add(r)
+        return out
+
+
+def capture_routes(tries: Dict[str, SubscriptionTrie]
+                   ) -> Dict[str, List[Route]]:
+    """Snapshot the authoritative route set as plain lists — the cheap
+    ON-LOOP half of the resync (Route objects are immutable; only the
+    trie STRUCTURE mutates, so referencing them is copy enough)."""
+    return {tenant: list(_iter_trie_routes(trie))
+            for tenant, trie in tries.items()}
+
+
+def capture_base(pt: PatchableTrie,
+                 tries: Dict[str, SubscriptionTrie]) -> BaseSnapshot:
+    """Consistent COPY of one arena set + route set (ISSUE 15 satellite:
+    the on-loop copy half of the copy-then-encode resync pipeline —
+    numpy memcpy + list builds, no per-route byte encoding; the
+    expensive encode then runs OFF the event loop on this snapshot)."""
+    return BaseSnapshot(
+        salt=pt.salt, probe_len=pt.probe_len, max_levels=pt.max_levels,
+        n_live=int(pt.n_live), node_tab=pt.node_tab.copy(),
+        edge_tab=pt.edge_tab.copy(), child_list=pt.child_list.copy(),
+        slot_kind=np.array(pt.slot_kind, copy=True),
+        matchings=list(pt.matchings), tenant_root=dict(pt.tenant_root),
+        dead_slots=int(pt.dead_slots), garbage_slots=int(pt.garbage_slots),
+        routes=capture_routes(tries))
+
+
+def capture_mesh_base(tables, tries: Dict[str, SubscriptionTrie]
+                      ) -> MeshBaseSnapshot:
+    """Mesh twin of :func:`capture_base`: one arena copy per shard plus
+    the snapshot's own routing metadata."""
+    shards = [capture_base(pt, {}) for pt in tables.compiled]
+    return MeshBaseSnapshot(
+        n_shards=int(tables.n_shards), probe_len=int(tables.probe_len),
+        max_levels=int(tables.max_levels),
+        pins=dict(tables.pins or {}),
+        replicated=tuple(sorted(tables.replicated or ())),
+        shards=shards, routes=capture_routes(tries))
+
+
+# base-snapshot codec version (independent of the delta-record
+# WIRE_VERSION): v2 = zlib-compressed framing + optional mesh section.
+# v1 (uncompressed, single-chip only) is NOT decoded — a version
+# mismatch raises cleanly instead of mis-parsing compressed bytes.
+BASE_VERSION = 2
+_BF_MESH = 1
+
+
+def _enc_arenas(s: BaseSnapshot) -> bytes:
+    out = bytearray()
+    out += struct.pack(">qII", s.salt, s.probe_len, s.max_levels)
+    out += struct.pack(">II", s.n_live, s.node_tab.shape[0])
+    out += _frame(np.ascontiguousarray(s.node_tab,
                                        dtype=np.int32).tobytes())
-    out += struct.pack(">II", pt.edge_tab.shape[0], pt.edge_tab.shape[1])
-    out += _frame(np.ascontiguousarray(pt.edge_tab,
+    out += struct.pack(">II", s.edge_tab.shape[0], s.edge_tab.shape[1])
+    out += _frame(np.ascontiguousarray(s.edge_tab,
                                        dtype=np.int32).tobytes())
-    out += _frame(np.ascontiguousarray(pt.child_list,
+    out += _frame(np.ascontiguousarray(s.child_list,
                                        dtype=np.int32).tobytes())
-    n_slots = len(pt.matchings)
+    n_slots = len(s.matchings)
     out += struct.pack(">I", n_slots)
-    out += _frame(np.ascontiguousarray(pt.slot_kind,
+    out += _frame(np.ascontiguousarray(s.slot_kind,
                                        dtype=np.int8).tobytes())
-    for m in pt.matchings:
+    for m in s.matchings:
         out += _frame(_enc_matching(m))
-    out += struct.pack(">I", len(pt.tenant_root))
-    for tenant, root in pt.tenant_root.items():
+    out += struct.pack(">I", len(s.tenant_root))
+    for tenant, root in s.tenant_root.items():
         out += _len16(tenant.encode()) + struct.pack(">I", root)
-    out += struct.pack(">II", pt.dead_slots, pt.garbage_slots)
-    # u32 tenant counts: the "millions of users" story must not cap the
-    # resync at 65535 tenants
-    out += struct.pack(">I", len(tries))
-    for tenant, trie in tries.items():
-        routes = list(_iter_trie_routes(trie))
-        out += _len16(tenant.encode()) + struct.pack(">I", len(routes))
-        for r in routes:
-            out += _enc_route(r)
+    out += struct.pack(">II", s.dead_slots, s.garbage_slots)
     return bytes(out)
 
 
-def decode_base(buf: bytes) -> BaseSnapshot:
-    assert buf[0] == WIRE_VERSION, buf[0]
-    salt, probe_len, max_levels = struct.unpack_from(">qII", buf, 1)
-    pos = 17
+def _dec_arenas(buf: bytes, pos: int) -> Tuple[dict, int]:
+    salt, probe_len, max_levels = struct.unpack_from(">qII", buf, pos)
+    pos += 16
     n_live, cap = struct.unpack_from(">II", buf, pos)
     pos += 8
     nt_b, pos = _read_frame(buf, pos)
@@ -390,6 +452,26 @@ def decode_base(buf: bytes) -> BaseSnapshot:
         tenant_root[tenant.decode()] = root
     dead, garbage = struct.unpack_from(">II", buf, pos)
     pos += 8
+    return dict(salt=salt, probe_len=probe_len, max_levels=max_levels,
+                n_live=n_live, node_tab=node_tab, edge_tab=edge_tab,
+                child_list=child_list, slot_kind=slot_kind,
+                matchings=matchings, tenant_root=tenant_root,
+                dead_slots=dead, garbage_slots=garbage), pos
+
+
+def _enc_routes(routes: Dict[str, List[Route]]) -> bytes:
+    # u32 tenant counts: the "millions of users" story must not cap the
+    # resync at 65535 tenants
+    out = bytearray(struct.pack(">I", len(routes)))
+    for tenant, lst in routes.items():
+        out += _len16(tenant.encode()) + struct.pack(">I", len(lst))
+        for r in lst:
+            out += _enc_route(r)
+    return bytes(out)
+
+
+def _dec_routes(buf: bytes, pos: int
+                ) -> Tuple[Dict[str, List[Route]], int]:
     (n_tenants,) = struct.unpack_from(">I", buf, pos)
     pos += 4
     routes: Dict[str, List[Route]] = {}
@@ -402,14 +484,96 @@ def decode_base(buf: bytes) -> BaseSnapshot:
             r, pos = _dec_route(buf, pos)
             lst.append(r)
         routes[tenant.decode()] = lst
-    return BaseSnapshot(
-        salt=salt, probe_len=probe_len, max_levels=max_levels,
-        n_live=n_live, node_tab=node_tab, edge_tab=edge_tab,
-        child_list=child_list, slot_kind=slot_kind, matchings=matchings,
-        tenant_root=tenant_root, dead_slots=dead, garbage_slots=garbage,
+    return routes, pos
+
+
+def encode_base_snapshot(snap) -> bytes:
+    """Wire-encode a captured base snapshot (single-chip or mesh) —
+    the OFF-LOOP half of the resync pipeline (ISSUE 15 satellite): the
+    per-route/matching byte encode plus one zlib pass over the whole
+    body (level 1: the arenas are int32-sparse and compress ~4-10x;
+    route text repeats heavily)."""
+    if isinstance(snap, MeshBaseSnapshot):
+        body = bytearray(struct.pack(">HII", snap.n_shards,
+                                     snap.probe_len, snap.max_levels))
+        body += struct.pack(">I", len(snap.pins))
+        for tenant, sh in snap.pins.items():
+            body += _len16(tenant.encode()) + struct.pack(">I", sh)
+        body += struct.pack(">I", len(snap.replicated))
+        for tenant in snap.replicated:
+            body += _len16(tenant.encode())
+        for s in snap.shards:
+            body += _frame(_enc_arenas(s))
+        body += _enc_routes(snap.routes)
+        flags = _BF_MESH
+    else:
+        body = bytearray(_enc_arenas(snap))
+        body += _enc_routes(snap.routes)
+        flags = 0
+    comp = zlib.compress(bytes(body), 1)
+    return (bytes([BASE_VERSION, flags])
+            + struct.pack(">Q", len(body)) + comp)
+
+
+def encode_base(pt: PatchableTrie,
+                tries: Dict[str, SubscriptionTrie]) -> bytes:
+    """Capture + encode in one call (tests / sync callers). The serving
+    RPC path splits the halves: :func:`capture_base` on the event loop
+    (the await-free consistency window), :func:`encode_base_snapshot`
+    off it."""
+    return encode_base_snapshot(capture_base(pt, tries))
+
+
+def decode_base(buf: bytes):
+    """Decode a ``repl_base`` payload → :class:`BaseSnapshot` (single
+    chip) or :class:`MeshBaseSnapshot`. Version-checked FIRST: a
+    pre-compression (v1) payload — or any future bump — is rejected
+    cleanly instead of fed to zlib."""
+    if not buf or buf[0] != BASE_VERSION:
+        raise ValueError(
+            f"unsupported repl_base codec version "
+            f"{buf[0] if buf else '<empty>'} (this decoder speaks only "
+            f"v{BASE_VERSION}; re-resync from an upgraded leader)")
+    flags = buf[1]
+    (raw_len,) = struct.unpack_from(">Q", buf, 2)
+    body = zlib.decompress(buf[10:])
+    if len(body) != raw_len:
+        raise ValueError(f"repl_base payload truncated: "
+                         f"{len(body)} != declared {raw_len}")
+    if not flags & _BF_MESH:
+        fields, pos = _dec_arenas(body, 0)
+        routes, _ = _dec_routes(body, pos)
+        return BaseSnapshot(routes=routes, **fields)
+    n_shards, probe_len, max_levels = struct.unpack_from(">HII", body, 0)
+    pos = 10
+    (n_pins,) = struct.unpack_from(">I", body, pos)
+    pos += 4
+    pins: Dict[str, int] = {}
+    for _ in range(n_pins):
+        tenant, pos = _read16(body, pos)
+        (sh,) = struct.unpack_from(">I", body, pos)
+        pos += 4
+        pins[tenant.decode()] = sh
+    (n_repl,) = struct.unpack_from(">I", body, pos)
+    pos += 4
+    replicated = []
+    for _ in range(n_repl):
+        tenant, pos = _read16(body, pos)
+        replicated.append(tenant.decode())
+    shards: List[BaseSnapshot] = []
+    for _ in range(n_shards):
+        s_b, pos = _read_frame(body, pos)
+        fields, _ = _dec_arenas(s_b, 0)
+        shards.append(BaseSnapshot(routes={}, **fields))
+    routes, _ = _dec_routes(body, pos)
+    return MeshBaseSnapshot(
+        n_shards=n_shards, probe_len=probe_len, max_levels=max_levels,
+        pins=pins, replicated=tuple(replicated), shards=shards,
         routes=routes)
 
 
-__all__ = ["DeltaRecord", "BaseSnapshot", "encode_record", "decode_record",
-           "encode_op", "decode_op", "encode_plan", "decode_plan",
-           "encode_base", "decode_base", "REC_PATCH", "WIRE_VERSION"]
+__all__ = ["DeltaRecord", "BaseSnapshot", "MeshBaseSnapshot",
+           "encode_record", "decode_record", "encode_op", "decode_op",
+           "encode_plan", "decode_plan", "capture_base",
+           "capture_mesh_base", "encode_base", "encode_base_snapshot",
+           "decode_base", "REC_PATCH", "WIRE_VERSION", "BASE_VERSION"]

@@ -34,7 +34,8 @@ from ..rpc.fabric import _len16, _read16
 from ..utils.env import env_float, env_int
 from ..utils.metrics import REPLICATION, STAGES
 from . import register_puller, register_standby
-from .records import BaseSnapshot, DeltaRecord, decode_base, decode_record
+from .records import (BaseSnapshot, DeltaRecord, MeshBaseSnapshot,
+                      decode_base, decode_record)
 
 log = logging.getLogger(__name__)
 
@@ -218,6 +219,7 @@ class WarmStandby:
         from ..models.matcher import apply_log_op
         m = self.matcher
         base = m._base_ct
+        mesh = base is not None and hasattr(base, "compiled")
         if rec.plan is not None and isinstance(base, PatchableTrie):
             base.apply_plan(rec.plan)
         if rec.op is not None:
@@ -228,7 +230,17 @@ class WarmStandby:
             # compiles from it off-thread while the loop mutates tries
             apply_log_op(m.tries, op)
             apply_log_op(m._shadow, op)
-            if rec.fallback or rec.plan is None:
+            if mesh and not rec.fallback:
+                # ISSUE 15 mesh replication: mesh records ship op-only —
+                # the replica RE-RUNS the same deterministic patch on its
+                # byte-identical shard arenas (descent, edge upserts and
+                # slot find-or-append are pure functions of the pre-op
+                # state, and shard routing is fixed within the epoch by
+                # the snapshot's own pins), so arena parity holds without
+                # shipping per-shard plans
+                if not m._try_patch(op):
+                    m._overlay_record(op)
+            elif rec.fallback or rec.plan is None:
                 # the leader served this op from its overlay (patcher
                 # declined / no patchable base yet): mirror that — the
                 # next anchor's resync folds it into the base here too
@@ -245,7 +257,51 @@ class WarmStandby:
         # narrow scatters the leader used (hot: after every batch)
         self.matcher._flush_patches()
 
-    def _install(self, snap: BaseSnapshot, cursor: Tuple[int, int]) -> None:
+    def _install(self, snap, cursor: Tuple[int, int]) -> None:
+        if isinstance(snap, MeshBaseSnapshot):
+            return self._install_mesh(snap, cursor)
+        return self._install_single(snap, cursor)
+
+    def _install_mesh(self, snap: MeshBaseSnapshot,
+                      cursor: Tuple[int, int]) -> None:
+        """Install a MESH base (ISSUE 15): one PatchableTrie per shard
+        reassembled from the shipped arenas — no DFS, no compile — and
+        the stacked tables re-uploaded to this replica's own mesh. The
+        standby's matcher must be a MeshMatcher over a same-shard-count
+        mesh (its factory's responsibility)."""
+        import jax
+        from ..parallel.sharded import ShardedTables
+        m = self.matcher
+        n_shards = getattr(m, "n_shards", None)
+        if n_shards != snap.n_shards:
+            raise RuntimeError(
+                f"mesh standby shard-count mismatch: leader has "
+                f"{snap.n_shards} shards, replica mesh has {n_shards}")
+        pts = [s.to_trie() for s in snap.shards]
+        tables = ShardedTables.from_patchable(
+            pts, probe_len=snap.probe_len, max_levels=snap.max_levels,
+            pins=snap.pins, replicated=snap.replicated)
+        dev = (jax.device_put(tables.edge_tab, m._table_sharding),
+               jax.device_put(tables.child_list, m._table_sharding),
+               jax.device_put(tables.route_tab, m._table_sharding))
+        prev = m._base_ct
+        m._base_ct = tables
+        m._device_trie = dev
+        m._delta = {}
+        m._tomb = {}
+        m._overlay_n = 0
+        m._log = []
+        m.tries = snap.to_tries()
+        m._shadow = snap.to_tries()
+        if m.match_cache is not None and prev is not None \
+                and m._base_salt(prev) != m._base_salt(tables):
+            m.match_cache.bump_all()
+        self.cursor = cursor
+        self._pending.clear()
+        self.attached = True
+
+    def _install_single(self, snap: BaseSnapshot,
+                        cursor: Tuple[int, int]) -> None:
         from ..ops.match import DeviceTrie
         m = self.matcher
         ct = snap.to_trie()

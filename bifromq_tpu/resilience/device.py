@@ -132,19 +132,25 @@ class BufferQuarantine:
                  clock: Callable[[], float] = time.monotonic) -> None:
         self.max_age_s = max_age_s
         self._clock = clock
-        self._entries: List[tuple] = []    # (res, quarantined_at)
+        self._entries: List[tuple] = []    # (res, quarantined_at, tag)
         self._lock = threading.Lock()
         self.quarantined_total = 0
         self.released_total = 0
         self.expired_total = 0
+        # ISSUE 15: per-tag lifetime counts (the mesh tags reclaimed
+        # batches with the implicated shard, e.g. "mesh:shard3")
+        self.quarantined_by_tag: Dict[str, int] = {}
 
     def __len__(self) -> int:
         return len(self._entries)
 
-    def add(self, res) -> None:
+    def add(self, res, tag: Optional[str] = None) -> None:
         with self._lock:
-            self._entries.append((res, self._clock()))
+            self._entries.append((res, self._clock(), tag))
             self.quarantined_total += 1
+            if tag:
+                self.quarantined_by_tag[tag] = \
+                    self.quarantined_by_tag.get(tag, 0) + 1
 
     @staticmethod
     def _ready(res) -> bool:
@@ -166,7 +172,7 @@ class BufferQuarantine:
         kept: List[tuple] = []
         freed = 0
         with self._lock:
-            for res, at in self._entries:
+            for res, at, tag in self._entries:
                 if self._ready(res):
                     freed += 1
                     self.released_total += 1
@@ -174,15 +180,18 @@ class BufferQuarantine:
                     freed += 1
                     self.expired_total += 1
                 else:
-                    kept.append((res, at))
+                    kept.append((res, at, tag))
             self._entries = kept
         return freed
 
     def snapshot(self) -> dict:
-        return {"held": len(self._entries),
-                "quarantined_total": self.quarantined_total,
-                "released_total": self.released_total,
-                "expired_total": self.expired_total}
+        out = {"held": len(self._entries),
+               "quarantined_total": self.quarantined_total,
+               "released_total": self.released_total,
+               "expired_total": self.expired_total}
+        if self.quarantined_by_tag:
+            out["by_tag"] = dict(self.quarantined_by_tag)
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -210,8 +219,8 @@ class DeviceBreakerBoard:
 
     def create(self, *, failure_threshold: Optional[int] = None,
                recovery_time: Optional[float] = None,
-               clock: Callable[[], float] = time.monotonic
-               ) -> CircuitBreaker:
+               clock: Callable[[], float] = time.monotonic,
+               label: Optional[str] = None) -> CircuitBreaker:
         if failure_threshold is None:
             failure_threshold = int(
                 _env_float("BIFROMQ_DEVICE_BREAKER_THRESHOLD", 3))
@@ -221,7 +230,11 @@ class DeviceBreakerBoard:
         br = CircuitBreaker(failure_threshold=max(1, failure_threshold),
                             recovery_time=recovery_time, clock=clock)
         self._seq += 1
-        self._breakers[f"device:{self._seq}"] = br
+        # ISSUE 15: labeled breakers (the mesh's per-shard fault domains)
+        # keep the shard id in the board key so /metrics and the gossip
+        # digest can report per-shard state, not just the worst
+        key = f"device:{self._seq}" + (f":{label}" if label else "")
+        self._breakers[key] = br
         if not self._registered:
             # lazy: utils.metrics imports obs which imports the exporter
             # which imports resilience — registering at import would

@@ -93,6 +93,13 @@ class _DrainSlot:
         return False
 
 
+def drain_shed_margin() -> float:
+    """How much quieter (in occupancy units) the quietest peer must be
+    before a saturated broker sheds a reconnect toward it."""
+    from ..utils.env import env_float
+    return env_float("BIFROMQ_DRAIN_SHED_MARGIN", 0.5)
+
+
 class DrainGovernor:
     def __init__(self, *, slots: Optional[int] = None,
                  per_tenant: Optional[int] = None,
@@ -111,6 +118,12 @@ class DrainGovernor:
         self.admitted_total = 0
         self.deferred_total = 0
         self.wait_s_total = 0.0
+        # ISSUE 15 satellite (ROADMAP retained follow-up (d)): cluster-
+        # aware reconnect shedding. The broker wires this to the gossip
+        # view's peer_drain_pressures(); a standalone governor (None)
+        # never sheds.
+        self.peer_pressure_fn = None   # () -> Dict[node, float] | None
+        self.shed_to_peers_total = 0
         # per-tenant completed-drain totals, served by snapshot() (top
         # slice) and bounded: past 4096 tenants the coldest half drops
         self.drained_by_tenant: Dict[str, int] = {}
@@ -140,14 +153,48 @@ class DrainGovernor:
     def slot(self, tenant: str) -> _DrainSlot:
         return _DrainSlot(self, tenant)
 
+    def pressure(self) -> float:
+        """Drain occupancy: (active + queued) / global slots. >= 1.0
+        means every slot is busy; > 1.0 means reconnects are parking.
+        Gossiped in the health digest (ObsHub.drain_pressure)."""
+        g = self._global
+        return (g.in_flight + g.waiting) / max(1, g.capacity)
+
+    def should_shed_reconnect(self) -> bool:
+        """Consult the cluster BEFORE admitting a herd drain (ISSUE 15
+        satellite, ROADMAP retained follow-up (d)): when this broker's
+        drain pool is saturated AND some fresh peer gossips materially
+        lower drain pressure, refuse the reconnect so the client's retry
+        lands on the quieter peer. Standalone (no gossip wiring) or
+        cluster-wide saturation never sheds — refusing with nowhere
+        better to go just adds a reconnect loop."""
+        fn = self.peer_pressure_fn
+        if fn is None:
+            return False
+        local = self.pressure()
+        if local < 1.0:
+            return False
+        try:
+            peers = fn() or {}
+        except Exception:  # noqa: BLE001 — gossip must not break CONNECT
+            return False
+        if not peers:
+            return False
+        if min(peers.values()) + drain_shed_margin() <= local:
+            self.shed_to_peers_total += 1
+            return True
+        return False
+
     def snapshot(self) -> dict:
         g = self._global
         top = sorted(self.drained_by_tenant.items(),
                      key=lambda kv: -kv[1])[:5]
         return {"active": g.in_flight, "waiting": g.waiting,
                 "capacity": g.capacity,
+                "pressure": round(self.pressure(), 3),
                 "admitted_total": self.admitted_total,
                 "deferred_total": self.deferred_total,
+                "shed_to_peers_total": self.shed_to_peers_total,
                 "avg_wait_ms": round(
                     1e3 * self.wait_s_total
                     / max(1, self.admitted_total), 3),

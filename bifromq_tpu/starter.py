@@ -361,6 +361,13 @@ class Standalone:
             ClusterObsRPCService(self.clusterview).register(
                 self.rpc_server)
             registry.remote_health = self.clusterview
+            # ISSUE 15 satellite (ROADMAP retained (d)): the reconnect
+            # drain governor consults peers' gossiped drain pressure
+            # before admitting a herd drain — a saturated broker sheds
+            # the reconnect toward quieter peers
+            gov = getattr(self.broker.inbox, "drain_governor", None)
+            if gov is not None:
+                gov.peer_pressure_fn = self.clusterview.peer_drain_pressures
 
         api_cfg = cfg.get("api")
         if api_cfg:

@@ -103,6 +103,7 @@ KNOWN_STAGES = frozenset({
     "device.fetch",     # final host copy
     "deliver",          # dist/service fan-out
     "repl.apply",       # ISSUE 12: standby delta-batch apply (host+flush)
+    "mesh.flush",       # ISSUE 15: per-shard mesh patch flush (scatters)
     "retain.scan",      # ISSUE 13: retained wildcard scan batch (SUBSCRIBE)
     "inbox.drain",      # ISSUE 13: persistent-session catch-up drain
 })

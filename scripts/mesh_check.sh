@@ -1,0 +1,189 @@
+#!/usr/bin/env bash
+# Tier-2 sharded-mesh gate (ISSUE 15): the multi-chip matcher as a
+# first-class serving plane on an 8-way HOST mesh
+# (XLA_FLAGS=--xla_force_host_platform_device_count=8), asserting:
+#   1. a 400-op churn storm through the per-shard patch plane runs ZERO
+#      full rebuilds and ZERO match-cache generation bumps, with exact
+#      host-oracle row parity before/during/after — per-shard patch
+#      apply >=100x cheaper than this base's own mesh rebuild,
+#   2. per-shard ShardedTables.device_bytes() stays <= the
+#      CapacityPlanner.fits per-shard prediction (the multichip capacity
+#      model must never drift from the mesh upload path),
+#   3. per-shard FAULT DOMAINS: a hang injected on ONE shard's device
+#      opens ONLY that shard's breaker; its rows serve exactly from the
+#      host oracle while every healthy shard keeps serving on device
+#      (no further watchdog timeouts), and the half-open canary
+#      re-closes the breaker on row parity.
+# Runs on CPU (JAX_PLATFORMS=cpu), hard timeout like the other gates.
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+
+timeout -k 10 "${MESH_CHECK_TIMEOUT:-420}" \
+    env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    BIFROMQ_DEVICE_DEADLINE_S=0.3 \
+    python - <<'EOF'
+import asyncio, os, random, time
+
+import numpy as np
+
+from bifromq_tpu import workloads
+from bifromq_tpu.models.oracle import Route
+from bifromq_tpu.obs import OBS
+from bifromq_tpu.obs.capacity import CapacityPlanner
+from bifromq_tpu.parallel.sharded import MeshMatcher, make_mesh
+from bifromq_tpu.resilience.faults import get_injector
+from bifromq_tpu.types import RouteMatcher
+
+N_SUBS = int(os.environ.get("MESH_CHECK_SUBS", "20000"))
+N_OPS = int(os.environ.get("MESH_CHECK_OPS", "400"))
+SPEEDUP_MIN = float(os.environ.get("MESH_CHECK_SPEEDUP", "100"))
+N_SHARDS = 8
+
+
+def mk(tf, rid, inc=0):
+    return Route(matcher=RouteMatcher.from_topic_filter(tf), broker_id=0,
+                 receiver_id=rid, deliverer_key="d0", incarnation=inc)
+
+
+def canon(r):
+    return (sorted((x.matcher.mqtt_topic_filter, x.receiver_url)
+                   for x in r.normal),
+            {f: sorted(x.receiver_url for x in ms)
+             for f, ms in r.groups.items()})
+
+
+def assert_parity(m, probe, label):
+    got = m.match_batch(probe)
+    want = m.match_from_tries(probe)
+    bad = sum(1 for a, b in zip(got, want) if canon(a) != canon(b))
+    assert bad == 0, f"{label}: {bad}/{len(probe)} rows mismatch the oracle"
+
+
+mesh = make_mesh(1, N_SHARDS)
+tries = workloads.config_multi_tenant(n_tenants=48, total_subs=N_SUBS,
+                                      seed=0)
+tenants = sorted(tries)
+t0 = time.perf_counter()
+m = MeshMatcher.from_tries(tries, mesh=mesh, match_cache=False)
+rebuild_s = m._last_compile_s
+print(f"mesh base: {sum(len(t) for t in tries.values())} subs over "
+      f"{N_SHARDS} shards, compile+install {time.perf_counter()-t0:.1f}s "
+      f"(mesh rebuild {rebuild_s:.1f}s)")
+
+# ---- capacity: per-shard padded bytes <= planner prediction ------------
+db = m._base_ct.device_bytes()
+worst = max(p["padded_bytes"] for p in db["per_shard"])
+tables = m._base_ct
+slots_ref = max(1, max(ct.n_slots for ct in tables.compiled))
+e_max = max(1, max(
+    int(np.count_nonzero(ct.edge_tab.reshape(-1, 4)[:, 0] >= 0))
+    for ct in tables.compiled))
+planner = CapacityPlanner(
+    nodes_per_sub=max(ct.node_tab.shape[0]
+                      for ct in tables.compiled) / slots_ref,
+    edges_per_sub=e_max / slots_ref, slots_per_sub=1.0,
+    edge_load=e_max / (tables.edge_tab.shape[1] * tables.probe_len))
+predicted = planner.fits(slots_ref * N_SHARDS, mesh=(1, N_SHARDS),
+                         probe_len=tables.probe_len)["tables"]["total"]
+assert worst <= predicted, (
+    f"per-shard padded bytes {worst} exceed fits() prediction {predicted}")
+print(f"capacity: worst shard {worst}B <= predicted {predicted}B "
+      f"(pad_waste={db['pad_waste_ratio']})")
+
+# ---- churn storm: zero rebuilds, zero bumps, parity, >=100x ------------
+topics = workloads.probe_topics(512, seed=1)
+probe = [(tenants[i % len(tenants)], t) for i, t in enumerate(topics[:256])]
+m.match_batch(probe)                     # warm walk shapes
+# warm the per-shard scatter jits OUTSIDE the timed window (one flush
+# per shard: the scatter programs are keyed per shard id + shape class,
+# and their one-off traces are compile cost, not patch cost — same
+# discipline as the single-chip churn gate's warm)
+seen = set()
+i = 0
+while len(seen) < N_SHARDS and i < 200:
+    t = tenants[i % len(tenants)]
+    seen.add(tables.shard_of(t))
+    m.add_route(t, mk(f"gate/warm/{i}/+", f"w{i}"))
+    m._flush_patches()
+    i += 1
+assert_parity(m, probe, "before storm")
+
+ledger = OBS.profiler.ledger
+compiles0, bumps0 = m.compile_count, ledger.generation_bumps
+rng = random.Random(3)
+lat, added = [], []
+for i in range(N_OPS):
+    tenant = tenants[i % len(tenants)]
+    tf = f"gate/{i}/+"
+    s0 = time.perf_counter()
+    if i % 3 == 2 and added:
+        tnt, f, rid = added.pop(rng.randrange(len(added)))
+        m.remove_route(tnt, RouteMatcher.from_topic_filter(f),
+                       (0, rid, "d0"), incarnation=1)
+    else:
+        m.add_route(tenant, mk(tf, f"c{i}", inc=1))
+        added.append((tenant, tf, f"c{i}"))
+    m._flush_patches()
+    lat.append(time.perf_counter() - s0)
+    if i % 50 == 25:
+        assert_parity(m, probe[:64], f"during storm (op {i})")
+p99 = float(np.percentile(np.array(lat), 99))
+speedup = rebuild_s / max(1e-9, p99)
+assert m.compile_count == compiles0, (
+    f"{m.compile_count - compiles0} full rebuilds inside the churn window")
+assert ledger.generation_bumps == bumps0, "generation bumps during churn"
+assert speedup >= SPEEDUP_MIN, (
+    f"patch p99 {p99*1e3:.1f}ms only {speedup:.0f}x vs the "
+    f"{rebuild_s:.1f}s mesh rebuild (need >={SPEEDUP_MIN}x)")
+storm_probe = probe + [(t, f"gate/{i}/x")
+                       for i, (t, _, _) in enumerate(added[:64])]
+assert_parity(m, storm_probe, "after storm")
+print(f"churn: {N_OPS} ops, rebuilds=0 bumps=0, patch p99 "
+      f"{p99*1e3:.2f}ms = {speedup:.0f}x vs rebuild, parity exact "
+      f"(fallbacks={m.patch_fallbacks})")
+
+# ---- per-shard fault domain: one hung shard degrades only itself -------
+sick = tables.shard_of(tenants[0])
+inj = get_injector()
+rule = inj.add_rule(service="tpu-device", method=f"mesh:shard{sick}",
+                    action="hang", side="device")
+
+
+async def fault_leg():
+    qs = probe[:128]
+    for _ in range(4):          # trip threshold (3) + one open serve
+        got = await m.match_batch_async(qs)
+        want = m.match_from_tries(qs)
+        assert all(canon(a) == canon(b) for a, b in zip(got, want)), \
+            "rows must stay exact through the hang (oracle degradation)"
+    states = [br.state for br in m.shard_breakers]
+    assert states[sick] == "open", states
+    assert all(s == "closed" for i, s in enumerate(states) if i != sick), (
+        f"ONLY shard {sick} may open: {states}")
+    inj.remove_rule(rule)
+    timeouts0 = m._ring.timeouts_total
+    got = await m.match_batch_async(qs)
+    want = m.match_from_tries(qs)
+    assert all(canon(a) == canon(b) for a, b in zip(got, want))
+    assert m._ring.timeouts_total == timeouts0, (
+        "healthy shards must keep serving on device with no timeouts "
+        "while the sick shard's breaker is open")
+    m.shard_breakers[sick].recovery_time = 0.0
+    await m.match_batch_async(qs)
+    assert m.shard_breakers[sick].state == "closed", "canary must re-close"
+    q = m._ring.quarantine.snapshot()
+    assert q.get("by_tag", {}).get(f"mesh:shard{sick}", 0) >= 1, q
+
+asyncio.run(fault_leg())
+print(f"fault domain: shard {sick} hang -> only its breaker opened, "
+      f"healthy shards stayed on device, canary re-closed "
+      f"(quarantine {m._ring.quarantine.snapshot()})")
+print("MESH CHECK PASSED")
+EOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "MESH CHECK FAILED (rc=$rc)"
+    exit $rc
+fi

@@ -129,7 +129,7 @@ class TestWatchdog:
         q.sweep()
         assert len(q) == 1 and q.released_total == 0
         # ...and no host materialization ever touched the buffers
-        (res_obj, _at) = q._entries[0]
+        (res_obj, _at, _tag) = q._entries[0]
         assert res_obj.start.reads == 0
         # the device finally finishes: the next sweep lets go
         gate.open = True

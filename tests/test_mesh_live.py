@@ -90,7 +90,26 @@ class TestMeshChurn:
                         assert_same(got, want, f"step {step} {t2}/{topic}")
         assert m.compile_count == base_compiles, "serving must not recompile"
 
-    def test_mesh_background_compaction_swaps(self):
+    def test_mesh_churn_patches_without_rebuilds(self):
+        """ISSUE 15: per-shard patching absorbs the churn — the overlay
+        stays empty and NO threshold compaction ever fires (the old
+        overlay+rebuild path survives only behind the kill-switch)."""
+        m = MeshMatcher(mesh=_mesh(), max_levels=8, k_states=16,
+                        auto_compact=True, compact_threshold=32)
+        for i in range(200):
+            m.add_route("T", mk_route(f"s/{i}/+", f"r{i}"))
+            if i % 20 == 0:
+                m.match_batch([("T", ["s", str(i), "leaf"])])
+        m.drain()
+        got = m.match_batch([("T", ["s", "5", "x"])])[0]
+        assert [r.receiver_url for r in got.normal] == [(0, "r5", "d0")]
+        assert m.compile_count == 1          # zero rebuilds under churn
+        assert m.overlay_size == 0           # every op folded in place
+        assert m.patch_count >= 199
+
+    def test_mesh_background_compaction_swaps_killswitch(self, monkeypatch):
+        """BIFROMQ_MESH_PATCH=0 restores the overlay+compaction path."""
+        monkeypatch.setenv("BIFROMQ_MESH_PATCH", "0")
         m = MeshMatcher(mesh=_mesh(), max_levels=8, k_states=16,
                         auto_compact=True, compact_threshold=32)
         for i in range(200):
