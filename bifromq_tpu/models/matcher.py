@@ -1142,7 +1142,7 @@ class TpuMatcher:
                 try:
                     with trace.span("device.ready", batch=fl.batch,
                                     kernel=fl.kernel):
-                        await ring.wait_ready(fl.res, fault=fl.fault)
+                        await self._await_ready(ring, fl)
                 except DeviceTimeoutError:
                     ring.reclaim(fl.res,
                                  tag=getattr(fl, "quarantine_tag", None))
@@ -1189,6 +1189,13 @@ class TpuMatcher:
             ready_s=ready_s, fetch_s=fetch_s,
             expand_s=time.perf_counter() - t0, path="async")
         return out
+
+    async def _await_ready(self, ring, fl) -> None:
+        """Readiness-wait hook (ISSUE 16): one watchdogged wait over the
+        whole in-flight batch. The mesh overrides this for SPLIT
+        dispatches — per-fault-domain groups each wait under their own
+        per-shard deadline so a hang indicts one device, not the step."""
+        await ring.wait_ready(fl.res, fault=fl.fault)
 
     def _note_device_timeout(self, fl) -> None:
         """Subclass hook (ISSUE 15): attribute a watchdog timeout of one

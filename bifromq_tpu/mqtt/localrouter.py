@@ -49,6 +49,13 @@ class LocalTopicRouter(ISubBroker):
         # deleting a freshly re-added route
         self._inc: Dict[Tuple[str, str], int] = {}
         self._locks: Dict[Tuple[str, str], "asyncio.Lock"] = {}
+        # ISSUE 16: campaign-grade delivery accounting — the chaos
+        # blast-radius gate asserts zero lost/duplicated deliveries by
+        # diffing these monotonic counters against the oracle fan-out
+        # across a fault window (a hung shard may DEGRADE latency; it
+        # must never change these)
+        self.delivered_total = 0
+        self.no_receiver_total = 0
 
     @property
     def dist(self):
@@ -166,6 +173,7 @@ class LocalTopicRouter(ISubBroker):
                 subs = self._index.get((tenant_id, tf))
                 if not subs:
                     out[mi] = DeliveryResult.NO_RECEIVER
+                    self.no_receiver_total += 1
                     continue
                 for sid in list(subs):
                     session = self.registry.get(sid)
@@ -182,12 +190,14 @@ class LocalTopicRouter(ISubBroker):
                         subs.discard(sid)
                 if subs:
                     out[mi] = DeliveryResult.OK
+                    self.delivered_total += 1
                 else:
                     # index and route retire together (NO_RECEIVER drives
                     # the dist-side unmatch), keeping the first-subscriber
                     # route-write invariant consistent
                     del self._index[(tenant_id, tf)]
                     out[mi] = DeliveryResult.NO_RECEIVER
+                    self.no_receiver_total += 1
 
     def _live_subscribers(self, tenant_id: str, topic_filter: str) -> int:
         """Count live index entries, pruning sessions that died or dropped

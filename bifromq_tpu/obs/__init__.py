@@ -493,9 +493,11 @@ class ObsHub:
 # the process-global hub every instrumentation site reports into
 OBS = ObsHub()
 
+from .campaign import CampaignMonitor  # noqa: E402 — needs OBS defined
+
 __all__ = [
     "OBS", "ObsHub", "TenantSLO", "NoisyNeighborDetector", "DeviceGauges",
     "TelemetryExporter", "FileSink", "HTTPSink", "WindowedCounter",
     "WindowedLog2Histogram", "ContinuousProfiler", "CompileLedger",
-    "SegmentStore",
+    "SegmentStore", "CampaignMonitor",
 ]

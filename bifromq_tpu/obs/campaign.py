@@ -1,0 +1,126 @@
+"""Chaos-campaign observability (ISSUE 16 tentpole leg 3).
+
+``CampaignMonitor`` turns the PR 8 continuous profiler's per-batch ring
+into per-fault-domain **degradation windows** and a **blast-radius
+report**: each workload step drains the records the step produced
+(``ContinuousProfiler.since`` cursor — the segment store's incremental
+contract, reused verbatim), bins them by degradation tag and kernel,
+and correlates them with the fault labels the campaign had live at that
+step. The report separates
+
+- the **deterministic half** — per-step batch/degradation counts and
+  the contiguous degradation windows per domain — which the campaign
+  folds into its replay signature ("same seed + schedule ⇒ same
+  blast-radius report"), from
+- the **timing half** — p50/p99 step latencies inside vs outside fault
+  windows — which backs the "healthy-shard p99 stays flat" acceptance
+  check but is never part of the signature (wall-clock is not
+  deterministic anywhere).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .profiler import _pctl
+
+
+class CampaignMonitor:
+    """Per-step profiler drain + degradation-window accounting for one
+    chaos campaign run. Construct it right before ``ChaosCampaign.run``
+    (the cursor snapshots the ring head at construction, so pre-campaign
+    batches never pollute the windows)."""
+
+    def __init__(self, profiler=None) -> None:
+        if profiler is None:
+            from . import OBS
+            profiler = OBS.profiler
+        self.profiler = profiler
+        _, self._cursor, _ = profiler.since(0)
+        self.steps: List[dict] = []
+
+    # ---------------- per-step drain (called by the campaign) --------------
+
+    def observe_step(self, step: int, active=()) -> dict:
+        recs, self._cursor, missed = self.profiler.since(self._cursor)
+        degraded: Dict[str, int] = {}
+        kernels: Dict[str, int] = {}
+        lat: List[float] = []
+        for r in recs:
+            if r.degraded:
+                degraded[r.degraded] = degraded.get(r.degraded, 0) + 1
+            kernels[r.kernel] = kernels.get(r.kernel, 0) + 1
+            lat.append(r.dispatch_s + r.ready_s + r.fetch_s + r.expand_s)
+        entry = {"step": step, "faults": list(active),
+                 "batches": len(recs), "missed": missed,
+                 "degraded": degraded, "kernels": kernels,
+                 "lat_s": lat}
+        self.steps.append(entry)
+        return entry
+
+    # ---------------- windows + report -------------------------------------
+
+    def windows(self) -> List[dict]:
+        """Contiguous step spans per degradation domain: one window per
+        (domain, run of consecutive steps whose batches carried that
+        degradation tag). The blast-radius invariant reads directly off
+        these — a single hung shard must open windows ONLY for its own
+        domain, and they must close when the schedule clears the
+        fault."""
+        out: List[dict] = []
+        open_w: Dict[str, dict] = {}
+        for e in self.steps:
+            seen = set(e["degraded"])
+            for dom in seen:
+                w = open_w.get(dom)
+                if w is None:
+                    w = open_w[dom] = {"domain": dom,
+                                       "start_step": e["step"],
+                                       "end_step": e["step"],
+                                       "batches": 0}
+                    out.append(w)
+                w["end_step"] = e["step"]
+                w["batches"] += e["degraded"][dom]
+            for dom in list(open_w):
+                if dom not in seen:
+                    del open_w[dom]     # window closed: next hit reopens
+        return out
+
+    def _lat_split(self):
+        fault_lat: List[float] = []
+        clean_lat: List[float] = []
+        for e in self.steps:
+            (fault_lat if e["faults"] else clean_lat).extend(e["lat_s"])
+        return sorted(clean_lat), sorted(fault_lat)
+
+    def p99_ratio(self) -> Optional[float]:
+        """p99(step latency under live faults) / p99(fault-free) — the
+        "healthy-shard p99 within 2× fault-free baseline" acceptance
+        number. None when either side has no samples."""
+        clean, fault = self._lat_split()
+        if not clean or not fault:
+            return None
+        base = _pctl(clean, 0.99)
+        return (_pctl(fault, 0.99) / base) if base > 0 else None
+
+    def report(self) -> dict:
+        clean, fault = self._lat_split()
+        return {
+            # deterministic half (folded into the campaign signature)
+            "windows": self.windows(),
+            "steps": [{k: e[k] for k in
+                       ("step", "faults", "batches", "degraded",
+                        "kernels")}
+                      for e in self.steps],
+            # timing half (assertion input, never signature input)
+            "latency": {
+                "clean_p50_ms": _pctl(clean, 0.5) * 1e3 if clean else None,
+                "clean_p99_ms": _pctl(clean, 0.99) * 1e3 if clean else None,
+                "fault_p50_ms": _pctl(fault, 0.5) * 1e3 if fault else None,
+                "fault_p99_ms": _pctl(fault, 0.99) * 1e3 if fault else None,
+                "p99_ratio": self.p99_ratio(),
+            },
+        }
+
+
+__all__ = ["CampaignMonitor"]

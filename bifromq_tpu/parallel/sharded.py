@@ -41,6 +41,7 @@ ISSUE 15 makes this a first-class serving plane:
 
 from __future__ import annotations
 
+import asyncio
 import functools
 import hashlib
 import time
@@ -425,6 +426,79 @@ class _MeshResult:
     overflow: object  # [R, S, B] bool
 
 
+class _MultiLeaf:
+    """One logical result leaf spanning every group of a split dispatch,
+    quacking like a jax array for exactly the two probes the shared
+    machinery makes: ``is_ready`` (ring watchdog + quarantine sweep) and
+    ``copy_to_host_async`` (fetch-on-ready kick)."""
+
+    __slots__ = ("_leaves",)
+
+    def __init__(self, leaves) -> None:
+        self._leaves = list(leaves)
+
+    def is_ready(self) -> bool:
+        for leaf in self._leaves:
+            ready = getattr(leaf, "is_ready", None)
+            if ready is not None and not ready():
+                return False
+        return True
+
+    def copy_to_host_async(self) -> None:
+        for leaf in self._leaves:
+            kick = getattr(leaf, "copy_to_host_async", None)
+            if kick is not None:
+                try:
+                    kick()
+                except Exception:  # noqa: BLE001 — backend-optional
+                    pass
+
+
+class _SplitGroup:
+    """One fault-domain group of a split mesh dispatch: the healthy-shard
+    collective, or a single half-open canary shard probing alone. A group
+    that times out flips ``failed`` — its rows re-route to the host
+    oracle while sibling groups' results still serve."""
+
+    __slots__ = ("shards", "res", "fault", "tag", "failed")
+
+    def __init__(self, shards, res, fault, tag) -> None:
+        self.shards = list(shards)
+        self.res = res
+        self.fault = fault
+        self.tag = tag
+        self.failed = False
+
+
+class _SplitMeshResult:
+    """Composite in-flight result of a SPLIT mesh step (ISSUE 16).
+
+    Presents the ``start``/``count``/``overflow`` leaf surface the
+    ring/watchdog/quarantine machinery expects (as :class:`_MultiLeaf`
+    aggregates), while ``MeshMatcher._await_ready`` waits each group
+    under its OWN per-shard deadline and ``_fetch_walk`` reassembles the
+    full [R, S, B, …] grid from the surviving groups."""
+
+    __slots__ = ("groups", "shape")
+
+    def __init__(self, groups: List[_SplitGroup],
+                 shape: Tuple[int, int, int, int]) -> None:
+        self.groups = groups
+        self.shape = shape    # full-grid (r, s, b, max_intervals)
+
+    @property
+    def start(self) -> _MultiLeaf:
+        return _MultiLeaf(g.res.start for g in self.groups)
+
+    @property
+    def count(self) -> _MultiLeaf:
+        return _MultiLeaf(g.res.count for g in self.groups)
+
+    @property
+    def overflow(self) -> _MultiLeaf:
+        return _MultiLeaf(g.res.overflow for g in self.groups)
+
+
 class _CanaryTokens:
     """Outstanding half-open canary probes for one in-flight mesh batch.
 
@@ -454,11 +528,16 @@ class _CanaryTokens:
 class _MeshPrepared:
     """Stage-1 output of the mesh leg: shard-routed, tokenized and
     uploaded probe grids, built BEFORE ring admission (ISSUE 11 overlap
-    contract) with per-shard breaker admission already applied."""
+    contract) with per-shard breaker admission already applied.
+
+    ISSUE 16: when any shard breaker is not closed, ``split`` is set and
+    ``grids`` stays ``None`` — the full-mesh upload is skipped because
+    the step will dispatch as per-fault-domain GROUPS over sub-mesh
+    slices (``grids_np`` keeps the host grids for per-group slicing)."""
 
     __slots__ = ("queries", "ct", "batch", "b", "slots", "grids",
-                 "lengths_np", "oracle_qis", "canaries", "dispatch_shards",
-                 "tokenize_s")
+                 "grids_np", "split", "lengths_np", "oracle_qis",
+                 "canaries", "dispatch_shards", "tokenize_s")
 
     def __init__(self, **kw) -> None:
         for k, v in kw.items():
@@ -602,6 +681,11 @@ class MeshMatcher(TpuMatcher):
         # tenant→shard pins; the serving snapshot routes by ITS OWN pin
         # copy until a recompile swaps the new assignment in
         self._pins: Dict[str, int] = {}
+        # ISSUE 16 split dispatch: sub-mesh + group-table caches keyed on
+        # the shard column set (one trace / one upload per healthy-mask
+        # class, invalidated by compile epoch + flush count)
+        self._sub_meshes: Dict[Tuple[int, ...], Mesh] = {}
+        self._split_tables: Dict[Tuple[int, ...], tuple] = {}
         # hot tenants compiled into EVERY shard (ISSUE 15): queries fan
         # to the least-loaded grid slot; mutations fan to all shards
         self._replicas: Set[str] = set(replicate or ())
@@ -909,6 +993,16 @@ class MeshMatcher(TpuMatcher):
                     slots[j * s + sh] = []
             elif verdict == "canary":
                 canaries.pending[sh] = br
+        # ISSUE 16 split trigger: a not-closed breaker ANYWHERE on the
+        # board means the full-mesh collective would still synchronize
+        # with the sick device (the psum spans every mesh slot, even
+        # row-less ones) — so the step dispatches as per-fault-domain
+        # groups over sub-mesh slices instead. Half-open canary shards
+        # probe in their OWN group: they never rejoin the collective
+        # until row parity re-closes them.
+        split = bool(canaries.pending) or any(
+            br is not None and br.state != "closed"
+            for br in self.shard_breakers)
         floor = self._ring.planned_floor() if self._ring is not None else 16
         need = max([len(x) for x in slots] + [1])
         b = _pow2_batch(need, floor=floor)
@@ -939,10 +1033,13 @@ class MeshMatcher(TpuMatcher):
                     sys_mask[rep, sh] = tk.sys_mask
             # prep-before-admission upload: the grids land on the mesh
             # NOW, so ring-parked callers hold uploaded probes bounded by
-            # the prep tickets exactly like the single-chip leg
-            grids = tuple(jax.device_put(a, self._probe_sharding)
-                          for a in (tok_h1, tok_h2, lengths, roots,
-                                    sys_mask))
+            # the prep tickets exactly like the single-chip leg. Split
+            # mode defers the upload: each group device_puts only ITS
+            # sub-mesh slice at dispatch, so no probe bytes ever target a
+            # quarantined device.
+            grids = None if split else tuple(
+                jax.device_put(a, self._probe_sharding)
+                for a in (tok_h1, tok_h2, lengths, roots, sys_mask))
         tokenize_s = time.perf_counter() - t0
         STAGES.record("tokenize", tokenize_s)
         dispatch_shards = sorted({
@@ -950,8 +1047,10 @@ class MeshMatcher(TpuMatcher):
             if any(slots[j * s + sh] for j in range(r))})
         return _MeshPrepared(queries=list(queries), ct=tables, batch=r * s * b,
                              b=b, slots=slots, grids=grids,
-                             lengths_np=lengths, oracle_qis=oracle_qis,
-                             canaries=canaries,
+                             grids_np=(tok_h1, tok_h2, lengths, roots,
+                                       sys_mask),
+                             split=split, lengths_np=lengths,
+                             oracle_qis=oracle_qis, canaries=canaries,
                              dispatch_shards=dispatch_shards,
                              tokenize_s=tokenize_s)
 
@@ -996,6 +1095,11 @@ class MeshMatcher(TpuMatcher):
         # post-mutation tables). watchdogged == the async leg, which
         # already holds its own (not-yet-dispatched) ring slot.
         self._flush_patches(own_slots=1 if watchdogged else 0)
+        if prep.split:
+            # ISSUE 16: a not-closed shard breaker splits the step into
+            # per-fault-domain groups so the collective never
+            # synchronizes with the quarantined device
+            return self._dispatch_split(prep, fault, fault_shards)
         dev_edge, dev_child, dev_route = self._device_trie
         t0 = time.perf_counter()
         with trace.span("device.dispatch", batch=prep.batch,
@@ -1020,6 +1124,197 @@ class MeshMatcher(TpuMatcher):
             fault=fault, fault_shards=fault_shards,
             dispatch_s=dispatch_s, tokenize_s=prep.tokenize_s,
             quarantine_tag=tag)
+
+    # ------------- split mesh dispatch (ISSUE 16 tentpole leg 1) -----------
+
+    def _sub_mesh(self, cols: Tuple[int, ...]) -> Mesh:
+        """The surviving mesh slice for one fault-domain group: the same
+        replica rows over only the group's shard columns. Cached per
+        column set so ``make_match_step``'s (mesh, …) memo key is stable
+        — one trace per healthy-mask class, not per batch."""
+        cached = self._sub_meshes.get(cols)
+        if cached is None:
+            cached = Mesh(self.mesh.devices[:, list(cols)],
+                          (REPLICA_AXIS, SHARD_AXIS))
+            self._sub_meshes[cols] = cached
+        return cached
+
+    def _group_tables(self, tables: ShardedTables, cols: Tuple[int, ...]):
+        """Stack the group's per-shard HOST arenas onto its sub-mesh.
+
+        Built from ``tables.compiled[sh]`` (the authoritative arenas),
+        NOT the full-mesh host stacks — those go stale after narrow
+        per-shard device flushes. Cached per (column set, base identity,
+        compile epoch, flush count): a mutation bumps ``patch_flushes``
+        via the pre-dispatch flush, so the cache never serves pre-
+        mutation rows. Edge caps are common across shards by the
+        ``sync_edge_caps`` invariant, so no edge padding happens here
+        (padding would change the device-side mixing mask)."""
+        ver = (id(tables), self.compile_count, self.patch_flushes)
+        cached = self._split_tables.get(cols)
+        if cached is not None and cached[0] == ver:
+            return cached[1]
+        sub = [tables.compiled[sh] for sh in cols]
+        g = len(sub)
+        cap = sub[0].edge_tab.shape[0]
+        n_max = max(ct.node_tab.shape[0] for ct in sub)
+        e_max = max(ct.child_list.shape[0] for ct in sub)
+        edge_tab = np.full((g, cap, tables.probe_len, 4), -1,
+                           dtype=np.int32)
+        child_list = np.full((g, e_max), -1, dtype=np.int32)
+        route_tab = np.zeros((g, n_max, RT_COLS), dtype=np.int32)
+        for i, ct in enumerate(sub):
+            edge_tab[i] = ct.edge_tab
+            child_list[i, :ct.child_list.shape[0]] = ct.child_list
+            route_tab[i, :ct.node_tab.shape[0]] = \
+                route_cols_from_node_tab(ct.node_tab)
+        sharding = NamedSharding(self._sub_mesh(cols), P(SHARD_AXIS))
+        dev = (jax.device_put(edge_tab, sharding),
+               jax.device_put(child_list, sharding),
+               jax.device_put(route_tab, sharding))
+        self._split_tables[cols] = (ver, dev)
+        return dev
+
+    def _dispatch_split(self, prep: _MeshPrepared, fault,
+                        fault_shards: Dict[int, object]) -> _MeshInFlight:
+        """Dispatch the step as per-fault-domain GROUPS: one collective
+        over every closed shard (psum spans only the surviving slice) +
+        one single-shard group per half-open canary — a canary probes
+        alone and rejoins the collective only after row parity re-closes
+        its breaker. Each group gets its own result leaves, chaos rule
+        and quarantine tag, so ``_await_ready`` can time out ONE group
+        (attributing the hang to its shards) while siblings' results
+        still serve from device."""
+        tables: ShardedTables = prep.ct
+        r, s, b = self.n_replicas, self.n_shards, prep.b
+        closed = tuple(sh for sh in prep.dispatch_shards
+                       if sh not in prep.canaries.pending)
+        group_cols: List[Tuple[int, ...]] = \
+            ([closed] if closed else []) + \
+            [(sh,) for sh in sorted(prep.canaries.pending)
+             if sh in prep.dispatch_shards]
+        groups: List[_SplitGroup] = []
+        t0 = time.perf_counter()
+        with trace.span("device.dispatch", batch=prep.batch,
+                        queries=len(prep.queries)) as sp:
+            for cols in group_cols:
+                sub_mesh = self._sub_mesh(cols)
+                step = make_match_step(sub_mesh, probe_len=self.probe_len,
+                                       k_states=self.k_states)
+                dev = self._group_tables(tables, cols)
+                psharding = NamedSharding(sub_mesh, P(REPLICA_AXIS,
+                                                      SHARD_AXIS))
+                idx = list(cols)
+                grids = tuple(
+                    jax.device_put(np.ascontiguousarray(a[:, idx]),
+                                   psharding)
+                    for a in prep.grids_np)
+                ivl_s, ivl_c, _n_routes, overflow, _total = \
+                    step(*dev, *grids)
+                gf = next((fault_shards[sh] for sh in cols
+                           if sh in fault_shards), fault)
+                tag = "mesh:" + ",".join(f"shard{sh}" for sh in cols)
+                groups.append(_SplitGroup(
+                    cols, _MeshResult(start=ivl_s, count=ivl_c,
+                                      overflow=overflow), gf, tag))
+            if sp is not trace.NOOP:
+                sp.set_tag("kernel", "mesh_split")
+        dispatch_s = time.perf_counter() - t0
+        STAGES.record("device.dispatch", dispatch_s)
+        tag = "mesh"
+        if fault_shards:
+            tag = "mesh:" + ",".join(f"shard{sh}"
+                                     for sh in sorted(fault_shards))
+        return _MeshInFlight(
+            queries=prep.queries, ct=prep.ct, dev=self._device_trie,
+            res=_SplitMeshResult(groups, (r, s, b)),
+            tomb=self._tomb, delta=self._delta, batch=prep.batch,
+            b=prep.b, slots=prep.slots, lengths_np=prep.lengths_np,
+            oracle_qis=prep.oracle_qis, canaries=prep.canaries,
+            dispatch_shards=prep.dispatch_shards, kernel="mesh_split",
+            fault=fault, fault_shards=fault_shards,
+            dispatch_s=dispatch_s, tokenize_s=prep.tokenize_s,
+            quarantine_tag=tag)
+
+    async def _await_ready(self, ring, fl) -> None:
+        """Per-group readiness waits under PER-SHARD deadlines (ISSUE 16):
+        a hung group is indicted alone — its leaves go to quarantine
+        shard-tagged, its breakers open, its rows re-route to the host
+        oracle — while every surviving group's device results serve.
+        Only an all-groups hang escalates to the whole-step
+        DeviceTimeoutError the base leg already handles."""
+        res = fl.res
+        if not isinstance(res, _SplitMeshResult):
+            await super()._await_ready(ring, fl)
+            return
+        if not res.groups:
+            return
+        from ..resilience.device import (DeviceTimeoutError,
+                                         shard_deadline_s)
+        deadline = shard_deadline_s()
+
+        async def wait_group(g: _SplitGroup) -> None:
+            try:
+                await ring.wait_ready(g.res, deadline_s=deadline,
+                                      fault=g.fault)
+            except DeviceTimeoutError:
+                g.failed = True
+        await asyncio.gather(*(wait_group(g) for g in res.groups))
+        failed = [g for g in res.groups if g.failed]
+        if not failed:
+            return
+        if len(failed) == len(res.groups):
+            # no surviving device evidence: whole-step timeout semantics
+            # (the caller reclaims the composite, _note_device_timeout
+            # attributes every dispatched shard)
+            raise DeviceTimeoutError(deadline or 0.0,
+                                     " (all shard groups)")
+        from ..utils.metrics import FABRIC, FabricMetric
+        s = self.n_shards
+        for g in failed:
+            ring.reclaim(g.res, tag=g.tag)
+            FABRIC.inc(FabricMetric.DEVICE_TIMEOUT)
+            # blame the shard(s) whose chaos rule shaped the hang when
+            # one fired; a collective-group stall with no finer evidence
+            # indicts every member
+            blame = [sh for sh in g.shards
+                     if sh in fl.fault_shards] or list(g.shards)
+            for sh in blame:
+                br = self.shard_breakers[sh]
+                if br is not None:
+                    br.record_failure("shard group timeout")
+                    fl.canaries.settle(sh)
+            for sh in g.shards:
+                for rep in range(self.n_replicas):
+                    fl.oracle_qis.extend(fl.slots[rep * s + sh])
+
+    @staticmethod
+    def _fetch_walk(res):
+        if not isinstance(res, _SplitMeshResult):
+            return TpuMatcher._fetch_walk(res)
+        from ..resilience.faults import get_injector
+        get_injector().check_raise("device", "tpu-device", "fetch")
+        r, s, b = res.shape
+        live = []
+        a = 1
+        for g in res.groups:
+            if g.failed:
+                continue    # never synchronize with a hung group's leaves
+            gs = np.array(g.res.start)
+            live.append((g, gs, np.array(g.res.count),
+                         np.array(g.res.overflow)))
+            a = max(a, gs.shape[-1])
+        starts = np.zeros((r, s, b, a), dtype=np.int32)
+        counts = np.zeros((r, s, b, a), dtype=np.int32)
+        overflow = np.zeros((r, s, b), dtype=bool)
+        for g, gs, gc, go in live:
+            for i, sh in enumerate(g.shards):
+                starts[:, sh, :, :gs.shape[-1]] = gs[:, i]
+                counts[:, sh, :, :gc.shape[-1]] = gc[:, i]
+                overflow[:, sh] = go[:, i]
+        # failed/absent shards stay all-zero: their rows are already in
+        # oracle_qis, so _expand_walk overwrites them with exact rows
+        return overflow, starts, counts
 
     def _note_device_timeout(self, fl) -> None:
         """Watchdog attribution (ISSUE 15): a timed-out mesh step feeds

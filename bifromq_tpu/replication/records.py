@@ -349,6 +349,54 @@ class MeshBaseSnapshot:
         return out
 
 
+@dataclass
+class RetainedBaseSnapshot:
+    """Decoded RETAINED ``repl_base`` payload (ISSUE 16): the retained
+    index's trie arenas (the :class:`BaseSnapshot` half — ``routes``
+    holds the authoritative per-tenant retained-topic route set) plus
+    the extras plane PR 13 bolted on (ext runs, extra slot list, run
+    capacities, patch-era own slots). A standby that installs this
+    serves wildcard retained scans at arena-BYTE parity with the
+    leader — no KV rebuild, no DFS compile — and op-only delta replays
+    land on identical rows because the patcher is a pure function of
+    this exact pre-op state."""
+
+    base: BaseSnapshot
+    ext_tab: np.ndarray             # [node_cap, EXT_COLS] int32
+    extra_list: np.ndarray          # [E] int32 (slot ids; -1 slack)
+    extra_live: int
+    extra_garbage: int
+    child_live: int
+    child_garbage: int
+    child_cap: Dict[int, int]
+    ext_cap: Dict[int, int]
+    own_slot: Dict[int, int]
+
+    def to_trie(self):
+        """Rebuild the leader's exact ``RetainedPatchableTrie`` —
+        arenas verbatim via ``from_arenas`` (no compile), extras
+        installed on top."""
+        from ..retained_plane.patched import RetainedPatchableTrie
+        pt = RetainedPatchableTrie.from_arenas(
+            node_tab=self.base.node_tab, n_live=self.base.n_live,
+            edge_tab=self.base.edge_tab, child_list=self.base.child_list,
+            matchings=self.base.matchings, slot_kind=self.base.slot_kind,
+            tenant_root=self.base.tenant_root, salt=self.base.salt,
+            probe_len=self.base.probe_len, max_levels=self.base.max_levels,
+            dead_slots=self.base.dead_slots,
+            garbage_slots=self.base.garbage_slots)
+        pt.install_retained_extras(
+            ext_tab=self.ext_tab, extra_list=self.extra_list,
+            extra_live=self.extra_live, extra_garbage=self.extra_garbage,
+            child_live=self.child_live, child_garbage=self.child_garbage,
+            child_cap=self.child_cap, ext_cap=self.ext_cap,
+            own_slot=self.own_slot)
+        return pt
+
+    def to_tries(self) -> Dict[str, SubscriptionTrie]:
+        return self.base.to_tries()
+
+
 def capture_routes(tries: Dict[str, SubscriptionTrie]
                    ) -> Dict[str, List[Route]]:
     """Snapshot the authoritative route set as plain lists — the cheap
@@ -387,12 +435,69 @@ def capture_mesh_base(tables, tries: Dict[str, SubscriptionTrie]
         shards=shards, routes=capture_routes(tries))
 
 
+def capture_retained_base(index) -> RetainedBaseSnapshot:
+    """Retained twin of :func:`capture_base` (ISSUE 16): consistent
+    copy of a :class:`~bifromq_tpu.models.retained.RetainedIndex`'s
+    compiled arenas + extras plane + authoritative topic tries. The
+    index is refreshed first so a pending rebuild never ships stale
+    arenas; a non-patched index (kill-switch) ships empty extras — the
+    decoder still rebuilds a patchable replica."""
+    ct = index.refresh()
+    base = BaseSnapshot(
+        salt=ct.salt, probe_len=ct.probe_len, max_levels=ct.max_levels,
+        n_live=int(ct.n_live), node_tab=ct.node_tab.copy(),
+        edge_tab=ct.edge_tab.copy(), child_list=ct.child_list.copy(),
+        slot_kind=np.array(ct.slot_kind, copy=True),
+        matchings=list(ct.matchings), tenant_root=dict(ct.tenant_root),
+        dead_slots=int(getattr(ct, "dead_slots", 0)),
+        garbage_slots=int(getattr(ct, "garbage_slots", 0)),
+        routes=capture_routes(index.tries))
+    ext = getattr(ct, "ext_tab", None)
+    if ext is None:
+        from ..models.automaton import EXT_COLS, EXT_OWN
+        ext = np.zeros((ct.node_tab.shape[0], EXT_COLS), dtype=np.int32)
+        ext[:, EXT_OWN] = -1
+        extra = np.full(64, -1, dtype=np.int32)
+        return RetainedBaseSnapshot(
+            base=base, ext_tab=ext, extra_list=extra, extra_live=0,
+            extra_garbage=0, child_live=int(base.child_list.shape[0]),
+            child_garbage=0, child_cap={}, ext_cap={}, own_slot={})
+    return RetainedBaseSnapshot(
+        base=base, ext_tab=ct.ext_tab.copy(),
+        extra_list=ct.extra_list.copy(),
+        extra_live=int(ct.extra_live),
+        extra_garbage=int(ct.extra_garbage),
+        child_live=int(ct.child_live),
+        child_garbage=int(ct.child_garbage),
+        child_cap=dict(ct._child_cap), ext_cap=dict(ct._ext_cap),
+        own_slot=dict(ct._own_slot))
+
+
 # base-snapshot codec version (independent of the delta-record
 # WIRE_VERSION): v2 = zlib-compressed framing + optional mesh section.
 # v1 (uncompressed, single-chip only) is NOT decoded — a version
 # mismatch raises cleanly instead of mis-parsing compressed bytes.
 BASE_VERSION = 2
 _BF_MESH = 1
+_BF_RETAINED = 2
+
+
+def _enc_int_dict(d: Dict[int, int]) -> bytes:
+    out = bytearray(struct.pack(">I", len(d)))
+    for k, v in d.items():
+        out += struct.pack(">ii", int(k), int(v))
+    return bytes(out)
+
+
+def _dec_int_dict(buf: bytes, pos: int) -> Tuple[Dict[int, int], int]:
+    (n,) = struct.unpack_from(">I", buf, pos)
+    pos += 4
+    d: Dict[int, int] = {}
+    for _ in range(n):
+        k, v = struct.unpack_from(">ii", buf, pos)
+        pos += 8
+        d[k] = v
+    return d, pos
 
 
 def _enc_arenas(s: BaseSnapshot) -> bytes:
@@ -493,7 +598,22 @@ def encode_base_snapshot(snap) -> bytes:
     per-route/matching byte encode plus one zlib pass over the whole
     body (level 1: the arenas are int32-sparse and compress ~4-10x;
     route text repeats heavily)."""
-    if isinstance(snap, MeshBaseSnapshot):
+    if isinstance(snap, RetainedBaseSnapshot):
+        body = bytearray(_frame(_enc_arenas(snap.base)))
+        body += struct.pack(">II", snap.ext_tab.shape[0],
+                            snap.ext_tab.shape[1])
+        body += _frame(np.ascontiguousarray(snap.ext_tab,
+                                            dtype=np.int32).tobytes())
+        body += _frame(np.ascontiguousarray(snap.extra_list,
+                                            dtype=np.int32).tobytes())
+        body += struct.pack(">IIII", snap.extra_live, snap.extra_garbage,
+                            snap.child_live, snap.child_garbage)
+        body += _enc_int_dict(snap.child_cap)
+        body += _enc_int_dict(snap.ext_cap)
+        body += _enc_int_dict(snap.own_slot)
+        body += _enc_routes(snap.base.routes)
+        flags = _BF_RETAINED
+    elif isinstance(snap, MeshBaseSnapshot):
         body = bytearray(struct.pack(">HII", snap.n_shards,
                                      snap.probe_len, snap.max_levels))
         body += struct.pack(">I", len(snap.pins))
@@ -540,6 +660,29 @@ def decode_base(buf: bytes):
     if len(body) != raw_len:
         raise ValueError(f"repl_base payload truncated: "
                          f"{len(body)} != declared {raw_len}")
+    if flags & _BF_RETAINED:
+        b_b, pos = _read_frame(body, 0)
+        fields, _ = _dec_arenas(b_b, 0)
+        ecap, ecols = struct.unpack_from(">II", body, pos)
+        pos += 8
+        ex_b, pos = _read_frame(body, pos)
+        ext_tab = np.frombuffer(ex_b, dtype=np.int32).reshape(
+            ecap, ecols).copy()
+        el_b, pos = _read_frame(body, pos)
+        extra_list = np.frombuffer(el_b, dtype=np.int32).copy()
+        (extra_live, extra_garbage, child_live,
+         child_garbage) = struct.unpack_from(">IIII", body, pos)
+        pos += 16
+        child_cap, pos = _dec_int_dict(body, pos)
+        ext_cap, pos = _dec_int_dict(body, pos)
+        own_slot, pos = _dec_int_dict(body, pos)
+        routes, _ = _dec_routes(body, pos)
+        return RetainedBaseSnapshot(
+            base=BaseSnapshot(routes=routes, **fields),
+            ext_tab=ext_tab, extra_list=extra_list,
+            extra_live=extra_live, extra_garbage=extra_garbage,
+            child_live=child_live, child_garbage=child_garbage,
+            child_cap=child_cap, ext_cap=ext_cap, own_slot=own_slot)
     if not flags & _BF_MESH:
         fields, pos = _dec_arenas(body, 0)
         routes, _ = _dec_routes(body, pos)
@@ -573,7 +716,8 @@ def decode_base(buf: bytes):
 
 
 __all__ = ["DeltaRecord", "BaseSnapshot", "MeshBaseSnapshot",
-           "encode_record", "decode_record", "encode_op", "decode_op",
-           "encode_plan", "decode_plan", "capture_base",
-           "capture_mesh_base", "encode_base", "encode_base_snapshot",
-           "decode_base", "REC_PATCH", "WIRE_VERSION", "BASE_VERSION"]
+           "RetainedBaseSnapshot", "encode_record", "decode_record",
+           "encode_op", "decode_op", "encode_plan", "decode_plan",
+           "capture_base", "capture_mesh_base", "capture_retained_base",
+           "encode_base", "encode_base_snapshot", "decode_base",
+           "REC_PATCH", "WIRE_VERSION", "BASE_VERSION"]

@@ -30,12 +30,16 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import trace
 from ..models.automaton import PatchableTrie
+from ..resilience.faults import get_injector
+from ..resilience.policy import (DEFAULT_RETRY_POLICY, deadline_scope,
+                                 is_idempotent, remaining_budget)
 from ..rpc.fabric import _len16, _read16
+from ..utils import topic as topic_util
 from ..utils.env import env_float, env_int
 from ..utils.metrics import REPLICATION, STAGES
 from . import register_puller, register_standby
 from .records import (BaseSnapshot, DeltaRecord, MeshBaseSnapshot,
-                      decode_base, decode_record)
+                      capture_retained_base, decode_base, decode_record)
 
 log = logging.getLogger(__name__)
 
@@ -100,6 +104,7 @@ class WarmStandby:
         self._base_fn = base_fn or self._rpc_base
         self._ranges_fn = ranges_fn or self._rpc_ranges
         self._task: Optional[asyncio.Task] = None
+        self._promoted = False
         register_standby(self)
 
     # ---------------- lifecycle --------------------------------------------
@@ -123,12 +128,24 @@ class WarmStandby:
         promotion is a flag flip, not a rebuild. The sync task is
         cancelled HERE: a still-running loop would resync from the old
         leader on its next tick (planned handover, partition heal) and
-        clobber every post-promotion mutation."""
+        clobber every post-promotion mutation.
+
+        IDEMPOTENT + crash-safe (ISSUE 16 satellite): every step is
+        individually re-runnable (cancel of a gone task is a no-op,
+        flag flips are absolute), the ``_promoted`` latch only sets
+        once ALL of them ran, and the chaos hook sits between the
+        task-cancel and the flag flips — a crash there leaves a fully
+        re-runnable promote, never a matcher that serves with the sync
+        loop still racing it."""
+        if self._promoted:
+            return self.matcher
         task, self._task = self._task, None
         if task is not None:
             task.cancel()
+        get_injector().check_raise("server", "standby", "promote")
         self.matcher.auto_compact = True
         self.attached = False
+        self._promoted = True
         return self.matcher
 
     # ---------------- sync loop --------------------------------------------
@@ -277,6 +294,12 @@ class WarmStandby:
             raise RuntimeError(
                 f"mesh standby shard-count mismatch: leader has "
                 f"{snap.n_shards} shards, replica mesh has {n_shards}")
+        # build-then-swap (ISSUE 16 satellite): EVERY fallible
+        # construction — trie reassembly, device upload, both trie
+        # copies — completes before the FIRST matcher field assignment,
+        # so a crash mid-install (device OOM, injected) leaves the old
+        # base serving intact and the resync re-runnable, never a
+        # matcher whose arenas and tries disagree
         pts = [s.to_trie() for s in snap.shards]
         tables = ShardedTables.from_patchable(
             pts, probe_len=snap.probe_len, max_levels=snap.max_levels,
@@ -284,6 +307,9 @@ class WarmStandby:
         dev = (jax.device_put(tables.edge_tab, m._table_sharding),
                jax.device_put(tables.child_list, m._table_sharding),
                jax.device_put(tables.route_tab, m._table_sharding))
+        tries = snap.to_tries()
+        shadow = snap.to_tries()
+        get_injector().check_raise("server", "standby", "install")
         prev = m._base_ct
         m._base_ct = tables
         m._device_trie = dev
@@ -291,8 +317,8 @@ class WarmStandby:
         m._tomb = {}
         m._overlay_n = 0
         m._log = []
-        m.tries = snap.to_tries()
-        m._shadow = snap.to_tries()
+        m.tries = tries
+        m._shadow = shadow
         if m.match_cache is not None and prev is not None \
                 and m._base_salt(prev) != m._base_salt(tables):
             m.match_cache.bump_all()
@@ -304,8 +330,18 @@ class WarmStandby:
                         cursor: Tuple[int, int]) -> None:
         from ..ops.match import DeviceTrie
         m = self.matcher
+        # build-then-swap: see _install_mesh — nothing on the matcher
+        # mutates until every fallible construction below has run
         ct = snap.to_trie()
         dev = DeviceTrie.from_compiled(ct, device=m.device)
+        # TWO independent copies: tries is the serving oracle the apply
+        # loop mutates; _shadow is the frozen-snapshot source a (post-
+        # promotion) background compaction compiles from OFF-thread —
+        # aliasing them would let the compile thread read dicts the
+        # event loop is mutating
+        tries = snap.to_tries()
+        shadow = snap.to_tries()
+        get_injector().check_raise("server", "standby", "install")
         prev = m._base_ct
         m._base_ct = ct
         m._device_trie = dev
@@ -313,13 +349,8 @@ class WarmStandby:
         m._tomb = {}
         m._overlay_n = 0
         m._log = []
-        # TWO independent copies: tries is the serving oracle the apply
-        # loop mutates; _shadow is the frozen-snapshot source a (post-
-        # promotion) background compaction compiles from OFF-thread —
-        # aliasing them would let the compile thread read dicts the
-        # event loop is mutating
-        m.tries = snap.to_tries()
-        m._shadow = snap.to_tries()
+        m.tries = tries
+        m._shadow = shadow
         if m.match_cache is not None and prev is not None \
                 and getattr(prev, "salt", None) != ct.salt:
             # only a SALT change (collision recompile upstream) voids
@@ -370,14 +401,47 @@ class WarmStandby:
         status = json.loads(out.decode())
         return [r["range"] for r in status.get("ranges", ())]
 
+    async def _call_retrying(self, method: str, payload: bytes, *,
+                             timeout: float) -> bytes:
+        """One fabric call under the PR 1 ``RetryPolicy`` (ISSUE 16
+        satellite): full-jitter backoff between attempts, the whole
+        retry ladder bounded by ONE deadline budget (each attempt's
+        timeout shrinks to the remaining budget), and retries only for
+        whitelisted-idempotent methods — the replication surfaces are
+        cursor-idempotent end to end. A flapping leader therefore costs
+        a few decorrelated backoffs, not a wedged poll loop; under a
+        registry the pinned endpoint is dropped between attempts so the
+        retry can land on a healthy peer."""
+        policy = DEFAULT_RETRY_POLICY
+        attempt = 0
+        with deadline_scope(timeout):
+            while True:
+                ep = await self._pick_endpoint()
+                rem = remaining_budget()
+                per_try = timeout if rem is None \
+                    else max(0.05, min(timeout, rem))
+                try:
+                    return await self.registry.client_for(ep).call(
+                        self.service, method, payload, timeout=per_try)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 — transport/endpoint
+                    attempt += 1
+                    if not (is_idempotent(self.service, method)
+                            and policy.should_retry(attempt)):
+                        raise
+                    if self.registry is not None:
+                        self._endpoint = None    # re-pick: maybe a peer
+                    REPLICATION.inc("rpc_retries")
+                    await asyncio.sleep(policy.backoff(attempt))
+
     async def _rpc_fetch(self, range_id: str, epoch: int, seq: int,
                          wait_s: float):
-        ep = await self._pick_endpoint()
         payload = (_len16(range_id.encode())
                    + struct.pack(">IQIB", epoch, seq,
                                  int(wait_s * 1000), 0))
-        out = await self.registry.client_for(ep).call(
-            self.service, "repl_fetch", payload, timeout=wait_s + 5.0)
+        out = await self._call_retrying("repl_fetch", payload,
+                                        timeout=wait_s + 5.0)
         st = out[0]
         r_epoch, head_seq = struct.unpack_from(">IQ", out, 1)
         (n,) = struct.unpack_from(">I", out, 13)
@@ -397,10 +461,8 @@ class WarmStandby:
         return _ST_NAMES.get(st, "gap"), records, (r_epoch, head_seq)
 
     async def _rpc_base(self, range_id: str):
-        ep = await self._pick_endpoint()
-        out = await self.registry.client_for(ep).call(
-            self.service, "repl_base", _len16(range_id.encode()),
-            timeout=30.0)
+        out = await self._call_retrying(
+            "repl_base", _len16(range_id.encode()), timeout=30.0)
         st = out[0]
         if st != ST_OK:
             raise RuntimeError(
@@ -428,6 +490,202 @@ class WarmStandby:
                 "gaps": self.gaps, "reorders": self.reorders,
                 "rebuilds": self.matcher.compile_count,
                 "overlay": self.matcher.overlay_size}
+
+
+class RetainedStandby:
+    """Warm replica of one retain range's :class:`RetainedIndex` at
+    delta-stream cost (ISSUE 16 tentpole leg 2).
+
+    One bounded resync ships the leader's retained arenas + extras
+    plane verbatim (``capture_retained_base`` / the ``_BF_RETAINED``
+    codec — bytes, never a KV rebuild or DFS compile); after that every
+    retained SET/CLEAR arrives as a lean ``(seq, hlc, tenant, topic,
+    op)`` tuple from the range's :class:`RetainedDeltaLog` and is
+    RE-RUN through the replica's own patcher — the retained patch is a
+    pure function of the pre-op state, and the installed state is
+    byte-identical, so arena parity holds op after op without shipping
+    plans (the ISSUE 15 mesh op-only discipline, retained twin).
+    ``promote()`` hands back the warm index: retained wildcard scans
+    serve immediately from device, no KV touch.
+
+    Transport is injectable (``base_fn``/``fetch_fn``); the default
+    drives an in-process leader (``leader_index`` + ``leader_log``) —
+    the wire form rides the same ``repl_base`` payload family via the
+    version-flagged codec when a remote retain frontend lands."""
+
+    def __init__(self, *, index=None, device=None, leader_index=None,
+                 leader_log=None, base_fn=None, fetch_fn=None) -> None:
+        if index is None:
+            from ..models.retained import RetainedIndex
+            index = RetainedIndex(device=device)
+        self.index = index
+        self._leader_index = leader_index
+        self._leader_log = leader_log
+        self._base_fn = base_fn or self._local_base
+        self._fetch_fn = fetch_fn or self._local_fetch
+        self.cursor: Tuple[int, int] = (0, 0)   # (epoch, seq)
+        self.attached = False
+        self.applied = 0
+        self.resyncs = 0
+        self.gaps = 0
+        self._task: Optional[asyncio.Task] = None
+        self._promoted = False
+        register_standby(self)
+
+    # ---------------- lifecycle --------------------------------------------
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except BaseException:  # noqa: BLE001 — cancellation
+                pass
+
+    def promote(self):
+        """Failover: hand the warm replica index over for serving.
+        Idempotent + crash-safe exactly like
+        :meth:`WarmStandby.promote` — the latch sets only after every
+        step ran; the chaos hook between task-cancel and the flag flip
+        models the mid-promote crash."""
+        if self._promoted:
+            return self.index
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+        get_injector().check_raise("server", "retained-standby",
+                                   "promote")
+        self.attached = False
+        self._promoted = True
+        return self.index
+
+    # ---------------- sync loop --------------------------------------------
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self.sync_once()
+                await asyncio.sleep(0.05)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — keep pulling
+                log.warning("retained standby sync failed: %r", e)
+                self.attached = False
+                await asyncio.sleep(0.5)
+
+    async def sync_once(self) -> None:
+        if not self.attached:
+            await self.resync()
+        status, epoch, records = await self._fetch_fn(self.cursor[1])
+        if status != "ok" or epoch != self.cursor[0]:
+            # ring overrun or leader reset (new epoch): bounded resync,
+            # the same degradation ladder as the route standby
+            self.gaps += 1
+            REPLICATION.inc("gaps")
+            self.attached = False
+            return
+        if records:
+            if not self.offer(records):
+                self.attached = False
+
+    async def resync(self) -> None:
+        epoch, seq, snap = await self._base_fn()
+        self._install(snap, epoch, seq)
+        self.resyncs += 1
+        REPLICATION.inc("resyncs")
+
+    # ---------------- record application -----------------------------------
+
+    def offer(self, records) -> bool:
+        """Apply a fetched batch of ``(seq, hlc, tenant, levels, op)``
+        tuples. Re-deliveries drop on the cursor (the ops are also
+        individually idempotent — a replayed SET lands "exists"); a
+        sequence gap inside the batch demands a resync."""
+        applied0 = self.applied
+        for rec in records:
+            seq = int(rec[0])
+            if seq <= self.cursor[1]:
+                continue    # idempotent re-delivery
+            if seq != self.cursor[1] + 1:
+                return False
+            self._apply(rec)
+            self.cursor = (self.cursor[0], seq)
+        if self.applied != applied0:
+            # ship the patched rows to this replica's device as the
+            # same narrow scatters the leader used
+            self.index.flush_device()
+        return True
+
+    def _apply(self, rec) -> None:
+        _seq, _hlc, tenant, levels, op = rec
+        topic = topic_util.DELIMITER.join(levels)
+        if op == "set":
+            self.index.add_topic(tenant, list(levels), topic)
+        else:
+            self.index.remove_topic(tenant, list(levels), topic)
+        self.applied += 1
+        REPLICATION.inc("applied")
+
+    def _install(self, snap, epoch: int, seq: int) -> None:
+        from ..models.automaton import _next_pow2
+        from ..ops.retained import RetainedDeviceTables
+        import numpy as np
+        idx = self.index
+        # build-then-swap: all fallible construction — arena
+        # reassembly, device upload, trie rebuild, the slot→topic
+        # mirror — before the FIRST index field assignment (the same
+        # crash-safety contract as WarmStandby._install*)
+        pt = snap.to_trie()
+        tries = snap.to_tries()
+        dev = RetainedDeviceTables.from_trie(pt, device=idx.device)
+        arr = np.empty(_next_pow2(max(len(pt.matchings), 1), floor=64),
+                       dtype=object)
+        for i, m in enumerate(pt.matchings):
+            arr[i] = m.receiver_id
+        get_injector().check_raise("server", "retained-standby",
+                                   "install")
+        idx.tries = tries
+        idx._compiled = pt
+        idx._device_tables = dev
+        idx._receiver_arr = arr
+        idx._dirty = False
+        self.cursor = (epoch, seq)
+        self.attached = True
+
+    # ---------------- default in-process transport --------------------------
+
+    async def _local_base(self):
+        log = self._leader_log
+        # head BEFORE capture: a mutation landing in between is both in
+        # the snapshot and replayed — the replay lands "exists"/no-op,
+        # so parity holds; the reverse order would LOSE it
+        epoch = log.epoch if log is not None else 0
+        head = (log.next_seq - 1) if log is not None else 0
+        src = self._leader_index
+        snap = capture_retained_base(src() if callable(src) else src)
+        return epoch, head, snap
+
+    async def _local_fetch(self, after_seq: int):
+        log = self._leader_log
+        if log is None:
+            return "ok", self.cursor[0], []
+        st, recs = log.since(after_seq)
+        return st, log.epoch, recs
+
+    # ---------------- introspection ----------------------------------------
+
+    def status(self) -> dict:
+        return {"role": "retained-standby", "attached": self.attached,
+                "epoch": self.cursor[0], "seq": self.cursor[1],
+                "applied": self.applied, "resyncs": self.resyncs,
+                "gaps": self.gaps,
+                "rebuilds": self.index.rebuilds,
+                "patch_fallbacks": self.index.patch_fallbacks}
 
 
 class StandbySupervisor:

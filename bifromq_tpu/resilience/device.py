@@ -75,26 +75,46 @@ DEADLINE_COLD_S = 5.0
 DEADLINE_P99_FACTOR = 32.0
 
 
+def _pinned_deadline(env: str) -> Optional[float]:
+    """Resolve an explicit deadline pin from ``env``.
+
+    Returns ``(found, value)`` folded into one optional: ``None`` when
+    the knob is unset or malformed (callers fall through to their
+    derived default), the float otherwise — ``0``/negative disarm
+    (``-0.0``... any non-positive), positive pins CLAMP into
+    [``DEADLINE_FLOOR_S``, ``DEADLINE_CEIL_S``]. Before ISSUE 16 a
+    positive pin passed through unclamped, so ``=0.001`` turned
+    scheduler jitter into timeouts and ``=9999`` silently disarmed the
+    watchdog; now a nonsensical knob degrades to the nearest sane bound.
+    """
+    raw = env_opt_str(env)
+    if raw is None:
+        return None
+    try:
+        v = float(raw)
+    except ValueError:
+        return None    # malformed pin ("2s") ⇒ the adaptive derivation,
+    if v <= 0:         # same unset-garbage fallback as utils.env helpers
+        return float("-inf")   # sentinel: explicit disarm
+    return min(DEADLINE_CEIL_S, max(DEADLINE_FLOOR_S, v))
+
+
 def device_deadline_s() -> Optional[float]:
     """The watchdog deadline for one device batch.
 
     ``BIFROMQ_DEVICE_DEADLINE_S`` pins it explicitly (``0`` or negative
-    disarms the watchdog entirely). Unset, it derives from the live
-    dispatch-stage p99 in ``STAGES`` (``device.dispatch`` +
-    ``device.ready``) with generous headroom, clamped to
-    [``DEADLINE_FLOOR_S``, ``DEADLINE_CEIL_S``]; before any sample
-    exists the cold-start default applies. The derivation is two ≤64
-    bucket walks — cheap enough per batch, and it tracks the deployment
-    (a CPU walk times out in sub-second, the axon tunnel gets seconds).
+    disarms the watchdog entirely; positive values clamp to
+    [``DEADLINE_FLOOR_S``, ``DEADLINE_CEIL_S``]). Unset, it derives
+    from the live dispatch-stage p99 in ``STAGES`` (``device.dispatch``
+    + ``device.ready``) with generous headroom, clamped the same way;
+    before any sample exists the cold-start default applies. The
+    derivation is two ≤64 bucket walks — cheap enough per batch, and it
+    tracks the deployment (a CPU walk times out in sub-second, the axon
+    tunnel gets seconds).
     """
-    raw = env_opt_str("BIFROMQ_DEVICE_DEADLINE_S")
-    if raw is not None:
-        try:
-            v = float(raw)
-        except ValueError:
-            v = None   # malformed pin ("2s") ⇒ the adaptive derivation,
-        else:          # same unset-garbage fallback as utils.env helpers
-            return v if v > 0 else None
+    pinned = _pinned_deadline("BIFROMQ_DEVICE_DEADLINE_S")
+    if pinned is not None:
+        return None if pinned == float("-inf") else pinned
     from ..utils.metrics import STAGES
     p99_ms = 0.0
     n = 0
@@ -107,6 +127,22 @@ def device_deadline_s() -> Optional[float]:
         return DEADLINE_COLD_S
     derived = (p99_ms / 1000.0) * DEADLINE_P99_FACTOR
     return min(DEADLINE_CEIL_S, max(DEADLINE_FLOOR_S, derived))
+
+
+def shard_deadline_s() -> Optional[float]:
+    """Per-shard watchdog deadline for ISSUE 16 split mesh dispatch.
+
+    When the mesh step splits into per-fault-domain groups, each group
+    waits under ITS OWN deadline so a hang is attributed to the
+    offending shard instead of timing out the whole step.
+    ``BIFROMQ_SHARD_DEADLINE_S`` pins it (same disarm/clamp contract as
+    the device knob); unset, it inherits :func:`device_deadline_s` —
+    one group is just a smaller device batch.
+    """
+    pinned = _pinned_deadline("BIFROMQ_SHARD_DEADLINE_S")
+    if pinned is not None:
+        return None if pinned == float("-inf") else pinned
+    return device_deadline_s()
 
 
 # ---------------------------------------------------------------------------

@@ -40,7 +40,7 @@ from __future__ import annotations
 
 import random
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Callable, Dict, List, Optional, Sequence
 
 
 @dataclass
@@ -164,3 +164,140 @@ _INJECTOR = FaultInjector()
 
 def get_injector() -> FaultInjector:
     return _INJECTOR
+
+
+# ---------------------------------------------------------------------------
+# chaos campaigns (ISSUE 16 tentpole leg 3)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ChaosEvent:
+    """One scripted fault transition, fired at a WORKLOAD STEP index —
+    step-indexed (not wall-clock) so the same schedule replays the same
+    fault sequence on any machine:
+
+    - ``inject``: install a :class:`FaultRule` (``rule_kw`` are the
+      ``add_rule`` kwargs) under ``label``;
+    - ``clear``: remove the rule installed under ``label`` (absent is a
+      no-op — schedules stay valid under reordering edits);
+    - ``call``: invoke ``fn(step)`` — the hook for non-rule chaos like
+      crashing a standby mid-promote or flapping a tunnel object.
+    """
+
+    step: int
+    action: str                      # "inject" | "clear" | "call"
+    label: str = ""
+    rule_kw: Dict = field(default_factory=dict)
+    fn: Optional[Callable[[int], None]] = None
+
+
+class ChaosCampaign:
+    """Seeded, scriptable fault schedule driven against a step-indexed
+    workload — repeatable fault campaigns instead of one-off chaos
+    scripts. The injector is ``reset(seed)`` at campaign start, every
+    event fires at a deterministic step boundary, and the report's
+    ``signature`` carries only deterministic facts (timeline, rule hit
+    counts, per-step workload summaries) so two runs with the same
+    seed + schedule compare EQUAL — the blast-radius regression gate.
+
+    The workload callable runs one step and returns a JSON-able summary
+    (or None). An optional ``monitor`` (duck-typed —
+    :class:`bifromq_tpu.obs.campaign.CampaignMonitor`) is fed after
+    every step with the set of live fault labels; its windows/percentile
+    report rides the final report under ``"monitor"`` (latency numbers
+    excluded from the signature: wall-clock is never deterministic)."""
+
+    def __init__(self, name: str, schedule: Sequence[ChaosEvent], *,
+                 seed: int = 0, injector: Optional[FaultInjector] = None,
+                 monitor=None) -> None:
+        self.name = name
+        # stable order: by step, schedule position breaking ties
+        self.schedule = sorted(enumerate(schedule),
+                               key=lambda kv: (kv[1].step, kv[0]))
+        self.seed = seed
+        self.injector = injector or get_injector()
+        self.monitor = monitor
+        self.timeline: List[dict] = []
+        self.step_outputs: List = []
+        self._live: Dict[str, FaultRule] = {}
+        self._all: Dict[str, FaultRule] = {}
+
+    # ---------------- event firing -----------------------------------------
+
+    def _fire(self, ev: ChaosEvent, step: int) -> None:
+        if ev.action == "inject":
+            label = ev.label or f"rule@{step}"
+            rule = self.injector.add_rule(**ev.rule_kw)
+            self._live[label] = rule
+            self._all[label] = rule
+        elif ev.action == "clear":
+            rule = self._live.pop(ev.label, None)
+            if rule is not None:
+                self.injector.remove_rule(rule)
+        elif ev.action == "call":
+            if ev.fn is not None:
+                ev.fn(step)
+        else:
+            raise ValueError(f"unknown chaos action {ev.action!r}")
+        self.timeline.append({"step": step, "action": ev.action,
+                              "label": ev.label})
+
+    def _step_events(self, step: int) -> None:
+        for _, ev in self.schedule:
+            if ev.step == step:
+                self._fire(ev, step)
+
+    def _observe(self, step: int) -> None:
+        if self.monitor is not None:
+            self.monitor.observe_step(step, active=sorted(self._live))
+
+    def _finish(self) -> None:
+        # campaigns never leak rules into the next test/campaign
+        for rule in self._live.values():
+            self.injector.remove_rule(rule)
+        self._live.clear()
+
+    # ---------------- drivers ----------------------------------------------
+
+    def run(self, workload: Callable[[int], object],
+            n_steps: int) -> dict:
+        self.injector.reset(self.seed)
+        try:
+            for step in range(n_steps):
+                self._step_events(step)
+                self.step_outputs.append(workload(step))
+                self._observe(step)
+        finally:
+            self._finish()
+        return self.report()
+
+    async def arun(self, workload, n_steps: int) -> dict:
+        """Async twin of :meth:`run` for workloads that await (the
+        async serving plane, standby sync loops)."""
+        self.injector.reset(self.seed)
+        try:
+            for step in range(n_steps):
+                self._step_events(step)
+                self.step_outputs.append(await workload(step))
+                self._observe(step)
+        finally:
+            self._finish()
+        return self.report()
+
+    # ---------------- report -----------------------------------------------
+
+    def report(self) -> dict:
+        sig = {"name": self.name, "seed": self.seed,
+               "timeline": list(self.timeline),
+               "rule_hits": {lbl: r.hits for lbl, r in self._all.items()},
+               "steps": [out for out in self.step_outputs]}
+        out = {"signature": sig,
+               "injected_total": self.injector.injected_total}
+        if self.monitor is not None:
+            mon = self.monitor.report()
+            # the monitor's deterministic half joins the signature; its
+            # latency numbers stay outside (wall-clock)
+            sig["windows"] = mon.get("windows")
+            sig["degradation"] = mon.get("steps")
+            out["monitor"] = mon
+        return out

@@ -102,6 +102,9 @@ class RetainService:
         coproc.delta_consumers.append(
             lambda tenant, levels, op:
                 log.append(tenant or "", levels or (), op))
+        # ISSUE 16: the standby feed — a warm retained replica attaches
+        # here (arenas via capture_retained_base, deltas via the log)
+        coproc.delta_log = log
         return coproc
 
     # ---------------- per-range access -------------------------------------
@@ -123,6 +126,30 @@ class RetainService:
         if len(self.kvstore.ranges) != 1:
             raise RuntimeError("multiple ranges; use kvstore.coprocs")
         return next(iter(self.kvstore.coprocs.values())).index
+
+    def standby_feed(self, rid: Optional[str] = None):
+        """(index-accessor, delta log) of one retain range — the
+        in-process feed a :class:`RetainedStandby` attaches to
+        (ISSUE 16). The accessor is a CALLABLE because reset-from-KV
+        swaps the coproc's index object; the indirection keeps a
+        long-lived standby capturing the live one."""
+        if rid is None:
+            if len(self.kvstore.coprocs) != 1:
+                raise RuntimeError("multiple ranges; pass rid")
+            rid = next(iter(self.kvstore.coprocs))
+        coproc = self.kvstore.coprocs[rid]
+        return (lambda: coproc.index), coproc.delta_log
+
+    def retained_standby(self, rid: Optional[str] = None, *,
+                         device=None):
+        """Spawn a warm retained standby of one range: resyncs from
+        this service's arenas (never KV), then rides the range's delta
+        log; ``promote()`` hands back an index that serves wildcard
+        retained scans immediately at arena-byte parity."""
+        from ..replication.standby import RetainedStandby
+        index_fn, log = self.standby_feed(rid)
+        return RetainedStandby(leader_index=index_fn, leader_log=log,
+                               device=device)
 
     async def start(self) -> None:
         import asyncio

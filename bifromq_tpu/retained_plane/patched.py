@@ -88,6 +88,37 @@ class RetainedPatchableTrie(PatchableTrie):
         self._dirty_child: Set[int] = set()
         self._dirty_extra: Set[int] = set()
 
+    def install_retained_extras(self, *, ext_tab: np.ndarray,
+                                extra_list: np.ndarray, extra_live: int,
+                                extra_garbage: int, child_live: int,
+                                child_garbage: int,
+                                child_cap: Dict[int, int],
+                                ext_cap: Dict[int, int],
+                                own_slot: Dict[int, int]) -> None:
+        """Install a leader's retained extras VERBATIM (ISSUE 16
+        standby resync) — the retained-plane counterpart of
+        :meth:`PatchableTrie.from_arenas`. The instance must come from
+        ``RetainedPatchableTrie.from_arenas(...)`` (which skips
+        ``_init_retained``); this supplies the half ``from_arenas``
+        cannot: the extras plane, run capacities and patch-era own
+        slots, byte-identical to the leader so subsequent op-replays
+        land on the same rows."""
+        self.ext_tab = np.asarray(ext_tab, dtype=np.int32)
+        self.extra_list = np.asarray(extra_list, dtype=np.int32)
+        self.extra_live = int(extra_live)
+        self.extra_garbage = int(extra_garbage)
+        # base child_list was installed by from_arenas — the shipped
+        # arena already carries the leader's grown capacity + slack
+        self.child_live = int(child_live)
+        self.child_garbage = int(child_garbage)
+        self._child_cap = dict(child_cap)
+        self._ext_cap = dict(ext_cap)
+        self._own_slot = dict(own_slot)
+        self._roots = set(self.tenant_root.values())
+        self._dirty_ext = set()
+        self._dirty_child = set()
+        self._dirty_extra = set()
+
     # ---------------- arena growth ------------------------------------------
 
     def _grow_nodes(self) -> None:

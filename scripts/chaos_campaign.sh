@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Tier-2 chaos-campaign gate (ISSUE 16): the seeded, scriptable fault
+# campaigns — hung-shard split dispatch with blast-radius assertions and
+# the standby mid-promote crash — run twice from fresh state inside the
+# tests and must produce byte-identical report signatures.
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+
+timeout -k 10 "${CAMPAIGN_TIMEOUT:-300}" \
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m campaign \
+    -p no:cacheprovider -p no:xdist -p no:randomly "$@"
+rc=$?
+if [ $rc -eq 124 ] || [ $rc -eq 137 ]; then
+    echo "chaos-campaign suite TIMED OUT (rc=$rc)" >&2
+fi
+exit $rc

@@ -160,6 +160,34 @@ class TestWatchdog:
         monkeypatch.setenv("BIFROMQ_DEVICE_DEADLINE_S", "-3")
         assert device_deadline_s() is None
 
+    def test_deadline_env_clamps_nonsense(self, monkeypatch):
+        """ISSUE 16 satellite: a nonsensical pin degrades to the nearest
+        sane bound instead of weaponizing scheduler jitter (=0.001) or
+        silently disarming the watchdog (=9999); malformed values fall
+        through to the adaptive derivation."""
+        from bifromq_tpu.resilience.device import (DEADLINE_CEIL_S,
+                                                   DEADLINE_FLOOR_S,
+                                                   shard_deadline_s)
+        monkeypatch.setenv("BIFROMQ_DEVICE_DEADLINE_S", "0.001")
+        assert device_deadline_s() == DEADLINE_FLOOR_S
+        monkeypatch.setenv("BIFROMQ_DEVICE_DEADLINE_S", "9999")
+        assert device_deadline_s() == DEADLINE_CEIL_S
+        monkeypatch.setenv("BIFROMQ_DEVICE_DEADLINE_S", "2s")
+        derived = device_deadline_s()       # malformed ⇒ derived, clamped
+        assert derived is not None
+        assert DEADLINE_FLOOR_S <= derived <= DEADLINE_CEIL_S
+        # the per-shard knob has the same clamp/disarm contract...
+        monkeypatch.setenv("BIFROMQ_SHARD_DEADLINE_S", "0.001")
+        assert shard_deadline_s() == DEADLINE_FLOOR_S
+        monkeypatch.setenv("BIFROMQ_SHARD_DEADLINE_S", "1e9")
+        assert shard_deadline_s() == DEADLINE_CEIL_S
+        monkeypatch.setenv("BIFROMQ_SHARD_DEADLINE_S", "-1")
+        assert shard_deadline_s() is None
+        # ...and unset it inherits the device deadline
+        monkeypatch.delenv("BIFROMQ_SHARD_DEADLINE_S")
+        monkeypatch.setenv("BIFROMQ_DEVICE_DEADLINE_S", "2.5")
+        assert shard_deadline_s() == 2.5
+
     async def test_wait_ready_no_deadline_never_raises(self):
         gate = _Gate()
         leaf = _GatedLeaf(np.zeros(1), gate)

@@ -345,6 +345,35 @@ class TestScanPlane:
                    for p in snap["scan_planes"])
         await svc.stop()
 
+    async def test_retained_standby_promotes_without_kv_rebuild(self):
+        """ISSUE 16 leg 2 at the service layer: a standby spawned off
+        the live RetainService tracks retains through the delta log,
+        and PROMOTING it serves wildcard scans straight off the
+        replicated arenas — one resync ever, no KV replay."""
+        from bifromq_tpu.plugin.events import CollectingEventCollector
+        from bifromq_tpu.retain.service import RetainService
+        from bifromq_tpu.types import ClientInfo, Message, QoS
+        svc = RetainService(CollectingEventCollector())
+        pub = ClientInfo(tenant_id="tenX")
+        msg = Message(message_id=1, payload=b"p",
+                      pub_qos=QoS.AT_LEAST_ONCE, timestamp=0,
+                      expiry_seconds=0xFFFFFFFF)
+        for topic in ("dev/1/temp", "dev/2/temp", "site/a/hum"):
+            assert await svc.retain(pub, topic, msg)
+        sb = svc.retained_standby()
+        await sb.sync_once()
+        assert sb.attached and sb.resyncs == 1
+        # a post-attach retain rides the op stream, not a resync
+        assert await svc.retain(pub, "dev/3/temp", msg)
+        await sb.sync_once()
+        assert sb.applied >= 1 and sb.resyncs == 1
+        idx = sb.promote()
+        assert sb.promote() is idx
+        rows = idx.match_batch([("tenX", ["dev", "+", "temp"])])[0]
+        assert sorted(rows) == ["dev/1/temp", "dev/2/temp",
+                                "dev/3/temp"]
+        await svc.stop()
+
 
 class TestDrainGovernor:
     @pytest.mark.asyncio
