@@ -85,10 +85,20 @@ CapacityPlanner.fits prediction, and runs a BENCH_MESH_CHURN_OPS churn
 storm through the per-shard patch plane (acceptance: zero rebuilds,
 zero generation bumps, exact oracle parity). Stamps record["mesh"].
 
+ELASTIC MESH (ISSUE 17): config "12" live-migrates the Zipf whale
+tenant off its hot shard through the begin/copy/ready/cutover/
+tombstone ladder while async match batches serve THROUGH the
+dual-serve window — migration wall-clock vs the full mesh rebuild,
+match p99 during the window, skew before/after, zero rebuilds, zero
+generation bumps, exact oracle parity. Stamps record["reshard"].
+
 Env knobs: BENCH_CONFIGS ("1,2,3,4,5" default; "2" = headline only;
 "6" = match-cache A/B; "7" = pipeline A/B; "8" = churn/patch;
 "9" = ingest byte-plane A/B; "10" = mixed million-client workload;
-"11" = sharded mesh serving;
+"11" = sharded mesh serving; "12" = live migration vs mesh rebuild
+(BENCH_RESHARD_SUBS 200000, BENCH_RESHARD_SHARDS 8,
+BENCH_RESHARD_REPLICAS 1, BENCH_RESHARD_TENANTS 64,
+BENCH_RESHARD_CHUNK 256);
 BENCH_CACHE_HOT_TOPICS sizes config 6's Zipf pool),
 BENCH_SUBS (config-2 subs, default 1_000_000), BENCH_BATCH (16384),
 BENCH_ITERS (30), BENCH_K (16), BENCH_SEED (0), BENCH_RETAINED (1_000_000),
@@ -1671,6 +1681,152 @@ def bench_config11():
     return out
 
 
+def bench_config12():
+    """Config 12 — c12_reshard (ISSUE 17): live tenant migration vs the
+    full mesh rebuild. A Zipf-skewed population on a replicas x shards
+    mesh; the whale tenant live-migrates off its hot shard through the
+    begin/copy/ready/cutover/tombstone ladder while async match batches
+    keep serving THROUGH the dual-serve window. Reports migration
+    wall-clock vs the mesh rebuild (the zero-rebuild dividend), match
+    p50/p99 during the window, skew before/after, and the zero-rebuild /
+    zero-generation-bump acceptance bits. Stamps record["reshard"]."""
+    import asyncio
+
+    from bifromq_tpu import workloads
+    from bifromq_tpu.models.oracle import Route
+    from bifromq_tpu.obs import OBS
+    from bifromq_tpu.parallel.reshard import ShardLoadModel
+    from bifromq_tpu.parallel.sharded import MeshMatcher, make_mesh
+    from bifromq_tpu.types import RouteMatcher
+
+    import jax
+
+    n_subs = int(os.environ.get("BENCH_RESHARD_SUBS", "200000"))
+    n_shards = int(os.environ.get("BENCH_RESHARD_SHARDS", "8"))
+    n_replicas = int(os.environ.get("BENCH_RESHARD_REPLICAS", "1"))
+    chunk = int(os.environ.get("BENCH_RESHARD_CHUNK", "256"))
+    need = n_shards * n_replicas
+    if len(jax.devices()) < need:
+        log(f"[c12_reshard] SKIP: {need} devices needed, "
+            f"{len(jax.devices())} present (set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={need} on CPU)")
+        return {"skipped": True, "devices": len(jax.devices())}
+    name = f"c12_reshard_{n_subs}x{n_replicas}r{n_shards}s"
+    mesh = make_mesh(n_replicas, n_shards)
+
+    def mk(tf, rid):
+        return Route(matcher=RouteMatcher.from_topic_filter(tf),
+                     broker_id=0, receiver_id=rid, deliverer_key="d0",
+                     incarnation=0)
+
+    tries = workloads.config_multi_tenant(
+        n_tenants=max(n_shards * 4,
+                      int(os.environ.get("BENCH_RESHARD_TENANTS", "64"))),
+        total_subs=n_subs, seed=SEED)
+    whale = max(tries, key=lambda t: len(tries[t]))
+    t0 = time.perf_counter()
+    m = MeshMatcher.from_tries(tries, mesh=mesh, match_cache=False)
+    install_s = time.perf_counter() - t0
+    rebuild_s = m._last_compile_s
+    m.query_heat[whale] = 65536
+    tables = m._base_ct
+    tenants = sorted(tries)
+    logical = sum(len(t) for t in tries.values())
+    src = tables.shard_of(whale)
+    per_shard = [0] * n_shards
+    for t in tenants:
+        per_shard[tables.shard_of(t)] += len(tries[t])
+    dst = min((s for s in range(n_shards) if s != src),
+              key=lambda s: per_shard[s])
+    log(f"[{name}] base: compile+install {install_s:.1f}s (mesh rebuild "
+        f"{rebuild_s:.1f}s), logical_subs={logical}, whale={whale} "
+        f"({len(tries[whale])} subs) shard{src} -> shard{dst}")
+
+    model = ShardLoadModel()
+    skew0 = model.skew(model.rows(m))
+    topics = workloads.probe_topics(1024, seed=SEED + 1)
+    batch = 256
+    rng = np.random.default_rng(SEED)
+
+    def probe_batch(i):
+        rows = topics[(i * batch) % 512:(i * batch) % 512 + batch]
+        return [(tenants[int(j)], t) for j, t in
+                zip(rng.integers(0, len(tenants), batch), rows)]
+
+    ledger = OBS.profiler.ledger
+    compiles0, bumps0 = m.compile_count, ledger.generation_bumps
+
+    async def migrate_and_serve():
+        for wb in range(2):      # warm grid shapes outside the window
+            await m.match_batch_async(probe_batch(wb))
+        window_lat = []
+        t0 = time.perf_counter()
+        mig = m.migrate_tenant(whale, src, dst, run=False)
+        i = 0
+        while mig.state == "copying":
+            done = mig.step(chunk)
+            s0 = time.perf_counter()
+            await m.match_batch_async(probe_batch(i))
+            window_lat.append(time.perf_counter() - s0)
+            i += 1
+            if done:
+                break
+        # dual-serve window: both shards answer for the whale
+        s0 = time.perf_counter()
+        await m.match_batch_async(probe_batch(i))
+        window_lat.append(time.perf_counter() - s0)
+        mig.cutover()
+        while not mig.finish():
+            await asyncio.sleep(0)
+        migrate_s = time.perf_counter() - t0
+        return mig, migrate_s, window_lat
+
+    mig, migrate_s, window_lat = asyncio.run(migrate_and_serve())
+    # the ladder's own cost: the window wall-clock minus the serving
+    # batches deliberately interleaved into it (those are the point of a
+    # LIVE migration, but they are serving time, not migration time)
+    ladder_s = max(1e-9, migrate_s - sum(window_lat))
+    skew1 = model.skew(model.rows(m))
+
+    probe = probe_batch(5)[:192]
+    got = m.match_batch(probe)
+    want = m.match_from_tries(probe)
+
+    def canon(r):
+        return (sorted((x.matcher.mqtt_topic_filter, x.receiver_url)
+                       for x in r.normal),
+                {f: sorted(x.receiver_url for x in ms)
+                 for f, ms in r.groups.items()})
+    parity = all(canon(a) == canon(b) for a, b in zip(got, want))
+
+    def pct(xs, q):
+        return round(float(np.percentile(np.array(xs or [0.0]), q)) * 1e3,
+                     3)
+    out = {
+        "n_subs": n_subs,
+        "logical_subs": logical,
+        "mesh": {"replicas": n_replicas, "shards": n_shards},
+        "mesh_rebuild_s": round(rebuild_s, 2),
+        "whale": {"tenant": whale, "subs": len(tries[whale]),
+                  "src": src, "dst": dst},
+        "migrate_s": round(migrate_s, 3),
+        "migrate_ladder_s": round(ladder_s, 3),
+        "migrated_routes": mig.copied_n,
+        "migrate_vs_rebuild_speedup": round(rebuild_s / ladder_s, 1),
+        "match_during_window_ms": {"batch": batch,
+                                   "p50": pct(window_lat, 50),
+                                   "p99": pct(window_lat, 99)},
+        "skew": {"before": round(skew0, 3), "after": round(skew1, 3)},
+        "full_rebuilds_in_window": m.compile_count - compiles0,
+        "generation_bumps_in_window": ledger.generation_bumps - bumps0,
+        "oracle_parity": parity,
+        "patch_fallbacks": m.patch_fallbacks,
+        "map_version": tables.map_version,
+    }
+    log(f"[{name}] {json.dumps(out)}")
+    return out
+
+
 def bench_broker():
     """End-to-end MQTT broker throughput over loopback TCP: QoS0/QoS1
     publish → dist match (device matcher) → local fan-out → delivery.
@@ -1894,6 +2050,8 @@ def main():
         results["c10"] = bench_config10()
     if "11" in CONFIGS:
         results["c11"] = bench_config11()
+    if "12" in CONFIGS:
+        results["c12"] = bench_config12()
     if "b" in CONFIGS:
         results["broker"] = bench_broker()
 
@@ -2050,6 +2208,28 @@ def main():
                 c11["capacity"]["per_shard_under_prediction"],
             "hot_tenant_fanout_p99_ms":
                 c11["hot_tenant_fanout_ms"]["p99"],
+        }
+    # elastic-mesh cell (ISSUE 17): live-migration wall-clock vs the
+    # full mesh rebuild, match p99 THROUGH the dual-serve window, skew
+    # before/after — the zero-rebuild dividend as a standing number
+    if "c12" in results and not results["c12"].get("skipped"):
+        c12 = results["c12"]
+        record["reshard"] = {
+            "logical_subs": c12["logical_subs"],
+            "shards": c12["mesh"]["shards"],
+            "whale_subs": c12["whale"]["subs"],
+            "migrate_s": c12["migrate_s"],
+            "migrate_ladder_s": c12["migrate_ladder_s"],
+            "mesh_rebuild_s": c12["mesh_rebuild_s"],
+            "migrate_vs_rebuild_speedup":
+                c12["migrate_vs_rebuild_speedup"],
+            "match_window_p99_ms": c12["match_during_window_ms"]["p99"],
+            "skew_before": c12["skew"]["before"],
+            "skew_after": c12["skew"]["after"],
+            "full_rebuilds_in_window": c12["full_rebuilds_in_window"],
+            "generation_bumps_in_window":
+                c12["generation_bumps_in_window"],
+            "oracle_parity": c12["oracle_parity"],
         }
     # per-stage p50/p99 next to the headline (ISSUE 2): where the broker
     # plane actually spends its time (queue-wait vs device vs deliver)

@@ -42,8 +42,10 @@ HOT_SCOPES: Dict[str, Set[str]] = {
     },
     # ISSUE 11 byte-plane prep: the device hash kernel's math + the
     # upload/dispatch wrappers feeding it
+    # (+ ISSUE 17: the retained FILTER-probe twin — same host-structure
+    # + device-hash split, wildcard kind lanes post-masked on device)
     "ops/tokenize.py": {"_hash_lanes", "hash_topics_device",
-                        "device_tokenize"},
+                        "device_tokenize", "device_tokenize_filters"},
     "models/kernels.py": {"_build_fused", "fused_walk_routes"},
     # ISSUE 12: the standby's per-batch device flush runs after every
     # applied delta batch — it must stay a pure dispatch wrapper (the

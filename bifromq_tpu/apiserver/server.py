@@ -180,6 +180,10 @@ class APIServer:
                 return self._routes(arg)
             if route == ("GET", "/retained"):
                 return self._retained(arg)
+            if route == ("GET", "/mesh"):
+                return self._mesh_get()
+            if route == ("GET", "/mesh/rebalance"):
+                return self._mesh_rebalance(arg)
             if route == ("GET", "/metrics"):
                 return self._metrics_get(arg)
             if route == ("GET", "/tenants"):
@@ -400,12 +404,58 @@ class APIServer:
             retained = OBS.retained_snapshot()
             if retained["scan_planes"] or retained["drain_governors"]:
                 snap["retained"] = retained
+            # ISSUE 17: mesh shard-load rows + in-flight migrations
+            # (absent key on single-chip nodes — lean default scrape)
+            mesh = OBS.mesh_snapshot()
+            if mesh:
+                snap["mesh"] = {"shard_load": mesh}
             # ISSUE 10: graftcheck build-info (rule count, suppression
             # count, last-run hash) — two live nodes disagreeing on the
             # hash are running different code or different suppressions
             from ..analysis import build_info
             snap["build_info"] = {"graftcheck": build_info()}
         return 200, snap
+
+    def _mesh_get(self) -> Tuple[int, object]:
+        """/mesh: every live mesh matcher's shard map — per-shard load
+        rows (bytes / logical subs / heat / queue pressure / breaker),
+        skew, map version, in-flight migrations, pins and replicas
+        (ISSUE 17). 404 on a single-chip node: there is no shard map."""
+        from ..obs import OBS
+        meshes = OBS.mesh_snapshot()
+        if not meshes:
+            return 404, {"error": "no mesh matcher on this node"}
+        return 200, {"meshes": meshes}
+
+    def _mesh_rebalance(self, arg) -> Tuple[int, object]:
+        """/mesh/rebalance: the rebalancer's decision log — executed
+        moves (tenant/src/dst, skew before/after, capacity vetoes) and
+        the live skew it would act on next. Read-only: driving a
+        migration is a control-plane call, not a scrape side effect."""
+        from ..obs import OBS
+        top_k = int(arg("top_k", "10"))
+        if top_k < 0:
+            return 400, {"error": f"top_k={top_k} (must be >= 0)"}
+        out = []
+        for m in OBS.device.matchers():
+            status = getattr(m, "mesh_status", None)
+            if status is None:
+                continue
+            try:
+                s = status()
+            except Exception:  # noqa: BLE001 — telemetry must not raise
+                continue
+            reb = getattr(m, "mesh_rebalancer", None)
+            out.append({
+                "skew": s.get("skew"),
+                "map_version": s.get("map_version"),
+                "migrating": s.get("migrating", {}),
+                "decisions": (list(reb.decisions)[-top_k:]
+                              if reb is not None else []),
+            })
+        if not out:
+            return 404, {"error": "no mesh matcher on this node"}
+        return 200, {"rebalancers": out}
 
     def _tenants_ranked(self, arg) -> Tuple[int, object]:
         """Live noisy-neighbor ranking over the windowed RED state: top-K

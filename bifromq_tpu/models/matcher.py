@@ -142,7 +142,7 @@ def apply_log_op(tries: Dict[str, SubscriptionTrie], op: Tuple) -> None:
     if op[0] == "add":
         _, tenant, route = op
         tries.setdefault(tenant, SubscriptionTrie()).add(route)
-    else:
+    elif op[0] == "rm":
         _, tenant, matcher, url, inc = op
         trie = tries.get(tenant)
         if trie is not None:

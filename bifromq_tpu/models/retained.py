@@ -328,7 +328,22 @@ class RetainedIndex:
         if batch is None:
             batch = _pow2_batch(len(queries))
         roots = [ct.root_of(t) for t, _ in queries]
-        tok = tokenize_filters([f for _, f in queries], roots,
+        filters = [f for _, f in queries]
+        # ISSUE 17 satellite: the filter-probe twin of the publish-side
+        # byte plane — raw filter bytes ship to device, the BLAKE2b
+        # kernel hashes the literal lanes there, wildcard lanes ride the
+        # kind grid. Same gate and fallback contract as device_tokenize:
+        # rows the kernel can't hash are padding (-1) and fall back.
+        from ..ops.tokenize import (device_tokenize_enabled,
+                                    device_tokenize_filters)
+        if device_tokenize_enabled():
+            mirror, probes = device_tokenize_filters(
+                filters, roots, max_levels=ct.max_levels, salt=ct.salt,
+                batch=batch, device=self.device)
+            return _ScanPrep(queries=list(queries), probes=probes,
+                             roots=np.asarray(roots, dtype=np.int64),
+                             lengths=mirror.lengths, batch=batch, ct=ct)
+        tok = tokenize_filters(filters, roots,
                                max_levels=ct.max_levels, salt=ct.salt,
                                batch=batch)
         return _ScanPrep(queries=list(queries),

@@ -186,6 +186,24 @@ class ObsHub:
                 continue
         return {"scan_planes": planes, "drain_governors": drains}
 
+    def mesh_snapshot(self) -> list:
+        """The ``/metrics`` "mesh" section + the digest ``mesh`` field:
+        every live mesh matcher's shard-load rows, skew, map version and
+        in-flight migrations (ISSUE 17; introspection must never raise).
+        Single-chip matchers (no ``mesh_status``) are skipped."""
+        out = []
+        for m in self.device.matchers():
+            status = getattr(m, "mesh_status", None)
+            if status is None:
+                continue
+            try:
+                s = status()
+            except Exception:  # noqa: BLE001 — telemetry must not raise
+                continue
+            if s.get("n_shards", 0) > 1 or s.get("shard_load"):
+                out.append(s)
+        return out
+
     def bind_registry(self, registry) -> None:
         """Weakly remember the metrics registry so exporter snapshots can
         include the monotonic per-tenant counters."""

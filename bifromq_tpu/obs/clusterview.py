@@ -247,7 +247,29 @@ class ClusterView:
             # storm sheds herd drains toward peers reporting less
             "drain_pressure": self._drain_pressure(),
         }
+        # ISSUE 17: compact mesh shard-load skew — peers (and /cluster)
+        # see a lopsided mesh before its hot shard trips a breaker;
+        # omitted on single-chip nodes to keep the UDP payload small
+        mesh = self._mesh_field()
+        if mesh:
+            digest["mesh"] = mesh
         return digest
+
+    @staticmethod
+    def _mesh_field() -> dict:
+        try:
+            from . import OBS
+            meshes = OBS.mesh_snapshot()
+            if not meshes:
+                return {}
+            s = meshes[0]     # one mesh matcher per node in practice
+            return {"skew": round(float(s.get("skew", 1.0)), 3),
+                    "map_version": s.get("map_version", 0),
+                    "migrating": len(s.get("migrating", {})),
+                    "shard_load": [round(float(r.get("score", 0.0)), 3)
+                                   for r in s.get("shard_load", [])]}
+        except Exception:  # noqa: BLE001 — telemetry must not raise
+            return {}
 
     def _drain_pressure(self) -> float:
         try:

@@ -297,6 +297,114 @@ class DeviceTokenized:
                         batch=batch)
 
 
+class DeviceTokenizedFilters:
+    """Host mirror of a device-tokenized FILTER probe batch (ISSUE 17
+    satellite): the retained scan plane needs the host lengths / roots /
+    kind grid for planning and fallback accounting, but the literal-lane
+    hashes live only on device — same split as :class:`DeviceTokenized`.
+    """
+
+    __slots__ = ("lengths", "roots", "kinds")
+
+    def __init__(self, lengths, roots, kinds):
+        self.lengths = lengths
+        self.roots = roots
+        self.kinds = kinds
+
+    @property
+    def batch(self) -> int:
+        return self.lengths.shape[0]
+
+
+def device_tokenize_filters(filters, roots: Sequence[int], *,
+                            max_levels: int, salt: int,
+                            batch: Optional[int] = None, device=None,
+                            impl: Optional[str] = None):
+    """Device-side retained FILTER tokenization (ISSUE 17 satellite).
+
+    Mirrors :func:`device_tokenize`: the host does the cheap vectorized
+    structure work — pack the joined filter bytes, scan level
+    boundaries, classify the single-byte ``'+'``/``'#'`` wildcard lanes
+    into ``KIND_PLUS``/``KIND_HASH`` — and the BLAKE2b kernel hashes the
+    lanes on device. Wildcard lanes are post-masked to ``h1 == h2 == 0``
+    (the exact ``TokenizedFilters`` contract: only ``KIND_LIT`` lanes
+    carry hashes; the retained walk branches on the kind grid).
+
+    Rows the kernel cannot hash — deeper than ``max_levels``, longer
+    than ``tok_max_bytes()``, a level over one BLAKE2b block, or a level
+    embedding the topic delimiter (re-split hazard; impossible from
+    ``parse()`` but this is a public API) — are marked padding (length
+    ``-1``) and take the caller's exact host fallback. Empty filters
+    record length 0 with no lanes, matching the reference loop.
+
+    Returns ``(host_mirror, FilterProbes)``.
+    """
+    from ..utils import topic as topic_util
+    from .retained import FilterProbes
+    from ..models.automaton import KIND_HASH, KIND_LIT, KIND_PLUS
+    n = len(filters)
+    b = batch or n
+    assert b >= n
+    width = max_levels + 1
+    max_bytes = tok_max_bytes()
+    tb = bytetok.TopicBytes.from_topics(
+        [topic_util.DELIMITER.join(f) for f in filters])
+    st = bytetok.topic_structure(tb)
+    byte_lens = tb.byte_lens.astype(np.int64)
+    n_ref = np.fromiter((len(f) for f in filters), dtype=np.int64,
+                        count=n)
+    empty_rows = n_ref == 0
+    resplit = (st.n_levels != n_ref) & ~empty_rows
+    ok = ((st.n_levels <= max_levels) & (byte_lens <= max_bytes)
+          & (st.max_lvl_len <= _LEVEL_BLOCK) & ~empty_rows & ~resplit)
+    lengths = np.full(b, _EMPTY, dtype=np.int32)
+    rootv = np.full(b, _EMPTY, dtype=np.int32)
+    roots_a = np.fromiter(roots, dtype=np.int32, count=n)
+    lengths[:n][ok] = st.n_levels[ok]
+    rootv[:n][ok] = roots_a[ok]
+    lengths[:n][empty_rows] = 0
+    rootv[:n][empty_rows] = roots_a[empty_rows]
+    rows = np.zeros((b, max_bytes), dtype=np.uint8)
+    row_of = np.repeat(np.arange(n, dtype=np.int64), byte_lens)
+    pos = bytetok._intra_row_positions(byte_lens)
+    keep = ok[row_of]
+    rows[row_of[keep], pos[keep]] = tb.data[keep]
+    starts = np.zeros((b, width), dtype=np.int32)
+    lens_g = np.zeros((b, width), dtype=np.int32)
+    kinds = np.zeros((b, width), dtype=np.int32)
+    sel = ok[st.lvl_row]
+    # wildcard lanes are exactly the single-byte '+'/'#' levels
+    one = st.lvl_len == 1
+    b0 = np.zeros(st.lvl_len.shape[0], dtype=np.uint8)
+    oidx = np.nonzero(one)[0]
+    b0[oidx] = tb.data[st.lvl_start[oidx]]
+    kind_lvl = np.zeros(st.lvl_len.shape[0], dtype=np.int32)
+    kind_lvl[one & (b0 == ord(topic_util.SINGLE_WILDCARD))] = KIND_PLUS
+    kind_lvl[one & (b0 == ord(topic_util.MULTI_WILDCARD))] = KIND_HASH
+    row_off = tb.offsets.astype(np.int64)[:-1]
+    starts[st.lvl_row[sel], st.lvl_idx[sel]] = \
+        (st.lvl_start[sel] - row_off[st.lvl_row[sel]]).astype(np.int32)
+    lens_g[st.lvl_row[sel], st.lvl_idx[sel]] = \
+        st.lvl_len[sel].astype(np.int32)
+    kinds[st.lvl_row[sel], st.lvl_idx[sel]] = kind_lvl[sel]
+    nlv = lengths.reshape(b, 1)
+    h1, h2 = hash_topics_device(rows, starts, lens_g, nlv, salt,
+                                device=device, impl=impl)
+    put = functools.partial(jax.device_put, device=device)
+    kd = put(kinds)
+    # zero-on-wildcard contract: inactive lanes are already zero (the
+    # kernel's active mask) and carry kind 0 == KIND_LIT, so this mask
+    # only strips the wildcard lanes' dummy hashes
+    lit = kd == KIND_LIT
+    h1 = jnp.where(lit, h1, 0)
+    h2 = jnp.where(lit, h2, 0)
+    probes = FilterProbes(tok_h1=h1, tok_h2=h2, tok_kind=kd,
+                          lengths=put(lengths), roots=put(rootv))
+    mirror = DeviceTokenizedFilters(lengths=lengths, roots=rootv,
+                                    kinds=kinds)
+    return mirror, probes
+
+
 def device_tokenize(tb, roots: Sequence[int], *, max_levels: int,
                     salt: int, batch: Optional[int] = None,
                     device=None, impl: Optional[str] = None

@@ -123,6 +123,13 @@ class ShardedTables:
     pins: Optional[Dict[str, int]] = None
     route_tab: Optional[np.ndarray] = None   # [S, N, RT_COLS]
     replicated: Optional[FrozenSet[str]] = None
+    # ISSUE 17 elastic mesh: in-flight live migrations keyed by tenant
+    # (reshard.MigrationState) and the shard-map version — every
+    # routing-affecting transition (begin/ready/cutover/abort/resize)
+    # bumps it, so operators and tests can watch the map move without
+    # diffing pin dicts
+    migrating: Optional[Dict[str, object]] = None
+    map_version: int = 0
 
     def shard_of(self, tenant_id: str) -> int:
         """The tenant's HOME shard (hash placement unless pinned).
@@ -141,6 +148,11 @@ class ShardedTables:
         replicated hot tenant) — the mutation fan-out set."""
         if self.replicated and tenant_id in self.replicated:
             return list(range(self.n_shards))
+        st = (self.migrating or {}).get(tenant_id)
+        if st is not None:
+            # dual-fold window (ISSUE 17): mutations land on BOTH the
+            # source and the copy-in-progress target until cutover
+            return [st.src, st.dst]
         return [self.shard_of(tenant_id)]
 
     def root_of(self, tenant_id: str) -> int:
@@ -234,10 +246,14 @@ class ShardedTables:
     @classmethod
     def from_patchable(cls, pts: List[PatchableTrie], *, probe_len: int,
                        max_levels: int, pins: Optional[Dict[str, int]] = None,
-                       replicated=None) -> "ShardedTables":
+                       replicated=None, migrating=None,
+                       map_version: int = 0) -> "ShardedTables":
         """Reassemble a mesh base from SHIPPED per-shard arenas (ISSUE 15
         mesh replication: a standby installs the leader's exact shard
-        arenas — no DFS, no compile — then tracks the op stream)."""
+        arenas — no DFS, no compile — then tracks the op stream).
+        ``migrating``/``map_version`` carry a leader's in-flight
+        migrations (ISSUE 17) so a standby joining mid-copy replays the
+        remaining migration ops against identical state."""
         s = len(pts)
         self = cls(node_tab=np.zeros((s, 1, NODE_COLS), np.int32),
                    edge_tab=np.zeros((s, 1, probe_len, 4), np.int32),
@@ -247,7 +263,9 @@ class ShardedTables:
                    pins=dict(pins) if pins else None,
                    route_tab=None,
                    replicated=(frozenset(replicated)
-                               if replicated else None))
+                               if replicated else None),
+                   migrating=dict(migrating) if migrating else None,
+                   map_version=int(map_version))
         self.restack()
         return self
 
@@ -790,6 +808,20 @@ class MeshMatcher(TpuMatcher):
             base = self._base_ct
             if isinstance(base, ShardedTables):
                 base.sync_edge_caps()
+                # ISSUE 17 dual-fold ledger: a mutation folded into a
+                # migrating tenant's TARGET arena joins (add) or leaves
+                # (rm) the copied ledger, so an abort kills exactly the
+                # rows this migration created — leader and standby run
+                # this same hook at the same op position
+                st = (base.migrating or {}).get(op[1])
+                if st is not None:
+                    if op[0] == "add":
+                        route = op[2]
+                        st.copied[(route.matcher.mqtt_topic_filter,
+                                   route.receiver_url)] = route
+                    elif op[0] == "rm":
+                        st.copied.pop((op[2].mqtt_topic_filter, op[3]),
+                                      None)
         return ok
 
     def _flush_patches(self, own_slots: int = 0) -> None:
@@ -906,6 +938,14 @@ class MeshMatcher(TpuMatcher):
         """Mark a hot tenant for replication across EVERY shard (ISSUE 15:
         query fan-out spreads over the whole grid; mutations fan to all
         copies). Takes effect when the next recompiled snapshot swaps in."""
+        base = self._base_ct
+        if isinstance(base, ShardedTables) and base.migrating:
+            # replication lands via a forced recompile, and recompiles
+            # defer while a migration owns the shard map — raising is
+            # honest where silent no-op would lose the request
+            raise RuntimeError(
+                f"migration of {sorted(base.migrating)} in flight — "
+                "finish or abort before replicating")
         if tenant_id not in self._replicas:
             self._replicas.add(tenant_id)
             self._maybe_compact(force=True)
@@ -914,7 +954,12 @@ class MeshMatcher(TpuMatcher):
         """One balancer round (≈ KVStoreBalanceController.java:85's
         observe→command→apply loop for TPU shards): consult the heat
         profile, apply at most one move, kick a background recompile,
-        and decay the heat window."""
+        and decay the heat window.
+
+        This is the RECOMPILE re-placement path (pre-ISSUE 17, kept for
+        the quiesce/bench baseline); :meth:`migrate_tenant` /
+        :class:`~bifromq_tpu.parallel.reshard.MeshRebalancer` move live
+        tenants with zero rebuilds."""
         # defer while a compaction is in flight: the compile thread reads
         # the frozen shadow, and replaying the log (or re-pinning) under
         # it would race; the heat profile persists, so the next round
@@ -922,6 +967,9 @@ class MeshMatcher(TpuMatcher):
         if self._base_ct is None or self._compact_thread is not None:
             self._apply_pending_swap()
             return None
+        if isinstance(self._base_ct, ShardedTables) \
+                and self._base_ct.migrating:
+            return None   # live migrations own the shard map right now
         cmd = self.shard_balancer.balance(self.query_heat, self._base_ct)
         if cmd is not None:
             self.pin_tenant(cmd.tenant_id, cmd.to_shard)
@@ -933,6 +981,98 @@ class MeshMatcher(TpuMatcher):
         self.query_heat = {t: h // 2 for t, h in self.query_heat.items()
                            if h // 2 > 0}
         return cmd
+
+    # ---------------- elastic mesh (ISSUE 17 tentpole) ----------------------
+
+    def _maybe_compact(self, force: bool = False) -> None:
+        # a rebuild mid-migration would compile from the shadow (which
+        # places the tenant by pins — still the SOURCE shard) and
+        # destroy the migration's dual-fold state: defer until every
+        # migration cut over or aborted; the trigger condition persists
+        base = self._base_ct
+        if isinstance(base, ShardedTables) and base.migrating:
+            self._apply_pending_swap()
+            return
+        super()._maybe_compact(force)
+
+    def migrate_tenant(self, tenant_id: str, src: Optional[int] = None,
+                       dst: Optional[int] = None, *, run: bool = True):
+        """Live-migrate a tenant between shards with zero rebuilds
+        (ISSUE 17): streams the tenant's arena rows to ``dst`` as delta
+        records through the target's patch path, dual-serves during the
+        copy, then atomically cuts the shard map over. ``run=False``
+        returns the started :class:`~bifromq_tpu.parallel.reshard.
+        TenantMigration` for step-wise driving (services interleave
+        ``step()`` with serving); ``run=True`` drives the whole ladder
+        synchronously."""
+        from .reshard import TenantMigration
+        if dst is None:
+            src, dst = None, src
+        if dst is None:
+            raise ValueError("migrate_tenant needs a target shard")
+        mig = TenantMigration(self, tenant_id, int(dst), src=src)
+        return mig.run() if run else mig.start()
+
+    def resize_mesh(self, n_shards: int) -> None:
+        """Grow or shrink the mesh's shard axis live (ISSUE 17): pin
+        tenants where they are, add empty arenas / drain evacuees via
+        live migration, re-place the jax mesh plumbing. Zero rebuilds."""
+        from .reshard import resize_mesh
+        resize_mesh(self, n_shards)
+
+    def _rebuild_mesh_plumbing(self, n_shards: int) -> None:
+        """Re-place everything derived from the shard count after a
+        resize: jax Mesh + shardings + step trace + per-shard breakers +
+        split caches, then a full restack/re-upload of the stacked
+        tables (the pjit/NamedSharding re-placement leg — the arenas
+        themselves never recompile)."""
+        from ..resilience.device import (DEVICE_BREAKERS,
+                                         device_breaker_enabled)
+        self.mesh = make_mesh(self.n_replicas, n_shards)
+        self.n_shards = n_shards
+        self._step = make_match_step(self.mesh, probe_len=self.probe_len,
+                                     k_states=self.k_states)
+        self._table_sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
+        self._probe_sharding = NamedSharding(self.mesh, P(REPLICA_AXIS,
+                                                          SHARD_AXIS))
+        self._repl_sharding = NamedSharding(self.mesh, P())
+        self.shard_breakers = [
+            DEVICE_BREAKERS.create(label=f"shard{sh}")
+            if device_breaker_enabled() else None
+            for sh in range(n_shards)]
+        self._sub_meshes.clear()
+        self._split_tables.clear()
+        base = self._base_ct
+        if isinstance(base, ShardedTables) and self._device_trie is not None:
+            base.sync_edge_caps()
+            base.restack()
+            dev = (jax.device_put(base.edge_tab, self._table_sharding),
+                   jax.device_put(base.child_list, self._table_sharding),
+                   jax.device_put(base.route_tab, self._table_sharding))
+            self._device_trie = dev
+            self._warm_step(dev)
+
+    def mesh_status(self) -> dict:
+        """The ``GET /mesh`` / ``mesh.shard_load`` surface: shard map
+        version, per-shard load rows (the same numbers the rebalancer
+        scores), in-flight migrations, pins and replicas."""
+        from .reshard import ShardLoadModel
+        base = self._base_ct
+        if not isinstance(base, ShardedTables):
+            return {"n_replicas": self.n_replicas, "n_shards": self.n_shards,
+                    "map_version": 0, "shard_load": [], "skew": 1.0,
+                    "migrating": {}, "pins": {}, "replicated": []}
+        model = ShardLoadModel()
+        rows = model.rows(self)
+        return {"n_replicas": self.n_replicas,
+                "n_shards": base.n_shards,
+                "map_version": base.map_version,
+                "shard_load": rows,
+                "skew": model.skew(rows),
+                "migrating": {t: st.digest()
+                              for t, st in (base.migrating or {}).items()},
+                "pins": dict(base.pins or {}),
+                "replicated": sorted(base.replicated or ())}
 
     # ---------------- staged serving path (ISSUE 15 tentpole) --------------
     #
@@ -950,15 +1090,26 @@ class MeshMatcher(TpuMatcher):
         r, s = self.n_replicas, self.n_shards
         slots: List[List[int]] = [[] for _ in range(r * s)]
         replicated = tables.replicated or frozenset()
+        migrating = tables.migrating or {}
         for qi, (tenant_id, _) in enumerate(queries):
             self.query_heat[tenant_id] = \
                 self.query_heat.get(tenant_id, 0) + 1
             if tenant_id in replicated:
                 slot = min(range(r * s), key=lambda j: len(slots[j]))
             else:
-                sh = tables.shard_of(tenant_id)
-                slot = min((j * s + sh for j in range(r)),
-                           key=lambda j: len(slots[j]))
+                st = migrating.get(tenant_id)
+                if st is not None and st.ready:
+                    # dual-SERVE window (ISSUE 17): the copy caught up,
+                    # so either shard answers exactly — take the
+                    # least-loaded of the tenant's two homes, like a
+                    # two-shard slice of hot-tenant replication
+                    slot = min((j * s + sh for j in range(r)
+                                for sh in (st.src, st.dst)),
+                               key=lambda j: len(slots[j]))
+                else:
+                    sh = tables.shard_of(tenant_id)
+                    slot = min((j * s + sh for j in range(r)),
+                               key=lambda j: len(slots[j]))
             slots[slot].append(qi)
         return slots
 

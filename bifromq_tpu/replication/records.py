@@ -94,17 +94,38 @@ def _dec_matching(buf: bytes, pos: int) -> Tuple[Matching, int]:
 
 # ------------------------------- logical ops --------------------------------
 
+#: ISSUE 17 migration control ops ride the SAME delta stream as route
+#: mutations (ordering against the dual-fold add/rm stream is the whole
+#: point); single-byte tags next to b"A"/b"R"
+_MIG_TAGS = {"mig_begin": b"B", "mig_ready": b"Y", "mig_cutover": b"V",
+             "mig_abort": b"X", "mig_tombstone": b"T"}
+_MIG_KINDS = {v: k for k, v in _MIG_TAGS.items()}
+
+
 def encode_op(op: Tuple) -> bytes:
     """The matcher's log-op tuple forms, verbatim (they are also what
-    ``TpuMatcher._overlay_record`` consumes on the replica side)."""
+    ``TpuMatcher._overlay_record`` consumes on the replica side), plus
+    the elastic-mesh migration ops (``parallel.reshard``)."""
     if op[0] == "add":
         _, tenant, route = op
         return b"A" + _len16(tenant.encode()) + _enc_route(route)
-    _, tenant, matcher, url, inc = op
-    return (b"R" + _len16(tenant.encode())
-            + _len16(matcher.mqtt_topic_filter.encode())
-            + struct.pack(">I", url[0]) + _len16(url[1].encode())
-            + _len16(url[2].encode()) + struct.pack(">q", inc))
+    if op[0] == "rm":
+        _, tenant, matcher, url, inc = op
+        return (b"R" + _len16(tenant.encode())
+                + _len16(matcher.mqtt_topic_filter.encode())
+                + struct.pack(">I", url[0]) + _len16(url[1].encode())
+                + _len16(url[2].encode()) + struct.pack(">q", inc))
+    if op[0] == "mig_copy":
+        _, tenant, dst, route = op
+        return (b"C" + _len16(tenant.encode())
+                + struct.pack(">H", int(dst)) + _enc_route(route))
+    tag = _MIG_TAGS.get(op[0])
+    if tag is None:
+        raise ValueError(f"unknown log op {op[0]!r}")
+    out = tag + _len16(op[1].encode())
+    for shard in op[2:]:
+        out += struct.pack(">H", int(shard))
+    return out
 
 
 def decode_op(buf: bytes) -> Tuple:
@@ -113,14 +134,25 @@ def decode_op(buf: bytes) -> Tuple:
     if kind == b"A":
         route, pos = _dec_route(buf, pos)
         return ("add", tenant.decode(), route)
-    tf, pos = _read16(buf, pos)
-    broker = struct.unpack_from(">I", buf, pos)[0]
-    pos += 4
-    recv, pos = _read16(buf, pos)
-    dk, pos = _read16(buf, pos)
-    inc = struct.unpack_from(">q", buf, pos)[0]
-    return ("rm", tenant.decode(), RouteMatcher.from_topic_filter(
-        tf.decode()), (broker, recv.decode(), dk.decode()), inc)
+    if kind == b"R":
+        tf, pos = _read16(buf, pos)
+        broker = struct.unpack_from(">I", buf, pos)[0]
+        pos += 4
+        recv, pos = _read16(buf, pos)
+        dk, pos = _read16(buf, pos)
+        inc = struct.unpack_from(">q", buf, pos)[0]
+        return ("rm", tenant.decode(), RouteMatcher.from_topic_filter(
+            tf.decode()), (broker, recv.decode(), dk.decode()), inc)
+    if kind == b"C":
+        dst = struct.unpack_from(">H", buf, pos)[0]
+        route, pos = _dec_route(buf, pos + 2)
+        return ("mig_copy", tenant.decode(), dst, route)
+    name = _MIG_KINDS.get(kind)
+    if name is None:
+        raise ValueError(f"unknown op tag {kind!r}")
+    shards = struct.unpack_from(
+        ">" + "H" * ((len(buf) - pos) // 2), buf, pos)
+    return (name, tenant.decode(), *[int(x) for x in shards])
 
 
 # ------------------------------- patch plans --------------------------------
@@ -339,6 +371,12 @@ class MeshBaseSnapshot:
     replicated: Tuple[str, ...]
     shards: List[BaseSnapshot]          # per-shard arenas (routes empty)
     routes: Dict[str, List[Route]]
+    # ISSUE 17 elastic mesh: in-flight migrations at capture time, per
+    # tenant {"src", "dst", "ready", "copied": [Route, ...]} — a standby
+    # joining mid-copy rebuilds the same MigrationState (esp. the copied
+    # ledger, or a later abort could not kill the right target rows)
+    migrating: Dict[str, dict] = field(default_factory=dict)
+    map_version: int = 0
 
     def to_tries(self) -> Dict[str, SubscriptionTrie]:
         out: Dict[str, SubscriptionTrie] = {}
@@ -346,6 +384,21 @@ class MeshBaseSnapshot:
             trie = out.setdefault(tenant, SubscriptionTrie())
             for r in routes:
                 trie.add(r)
+        return out
+
+    def to_migrating(self) -> Optional[Dict[str, object]]:
+        """Rebuild the live ``MigrationState`` map for the installed
+        :class:`~bifromq_tpu.parallel.sharded.ShardedTables`."""
+        if not self.migrating:
+            return None
+        from ..parallel.reshard import MigrationState
+        out: Dict[str, object] = {}
+        for tenant, st in self.migrating.items():
+            ms = MigrationState(tenant=tenant, src=int(st["src"]),
+                                dst=int(st["dst"]), ready=bool(st["ready"]))
+            for r in st["copied"]:
+                ms.copied[(r.matcher.mqtt_topic_filter, r.receiver_url)] = r
+            out[tenant] = ms
         return out
 
 
@@ -427,12 +480,19 @@ def capture_mesh_base(tables, tries: Dict[str, SubscriptionTrie]
     """Mesh twin of :func:`capture_base`: one arena copy per shard plus
     the snapshot's own routing metadata."""
     shards = [capture_base(pt, {}) for pt in tables.compiled]
+    migrating = {}
+    for tenant, st in (getattr(tables, "migrating", None) or {}).items():
+        migrating[tenant] = {
+            "src": int(st.src), "dst": int(st.dst), "ready": bool(st.ready),
+            "copied": [st.copied[k] for k in sorted(st.copied)]}
     return MeshBaseSnapshot(
         n_shards=int(tables.n_shards), probe_len=int(tables.probe_len),
         max_levels=int(tables.max_levels),
         pins=dict(tables.pins or {}),
         replicated=tuple(sorted(tables.replicated or ())),
-        shards=shards, routes=capture_routes(tries))
+        shards=shards, routes=capture_routes(tries),
+        migrating=migrating,
+        map_version=int(getattr(tables, "map_version", 0)))
 
 
 def capture_retained_base(index) -> RetainedBaseSnapshot:
@@ -625,6 +685,18 @@ def encode_base_snapshot(snap) -> bytes:
         for s in snap.shards:
             body += _frame(_enc_arenas(s))
         body += _enc_routes(snap.routes)
+        # ISSUE 17 elastic-mesh trailer (map version + in-flight
+        # migrations), appended AFTER routes with no BASE_VERSION bump:
+        # older decoders stop at routes and ignore trailing body bytes
+        body += struct.pack(">II", snap.map_version, len(snap.migrating))
+        for tenant in sorted(snap.migrating):
+            st = snap.migrating[tenant]
+            body += _len16(tenant.encode())
+            body += struct.pack(">HHB", st["src"], st["dst"],
+                                1 if st["ready"] else 0)
+            body += struct.pack(">I", len(st["copied"]))
+            for r in st["copied"]:
+                body += _enc_route(r)
         flags = _BF_MESH
     else:
         body = bytearray(_enc_arenas(snap))
@@ -708,11 +780,29 @@ def decode_base(buf: bytes):
         s_b, pos = _read_frame(body, pos)
         fields, _ = _dec_arenas(s_b, 0)
         shards.append(BaseSnapshot(routes={}, **fields))
-    routes, _ = _dec_routes(body, pos)
+    routes, pos = _dec_routes(body, pos)
+    migrating: Dict[str, dict] = {}
+    map_version = 0
+    if pos < len(body):   # ISSUE 17 trailer — absent from older leaders
+        map_version, n_mig = struct.unpack_from(">II", body, pos)
+        pos += 8
+        for _ in range(n_mig):
+            tenant, pos = _read16(body, pos)
+            src, dst, ready = struct.unpack_from(">HHB", body, pos)
+            pos += 5
+            (n_copied,) = struct.unpack_from(">I", body, pos)
+            pos += 4
+            copied = []
+            for _ in range(n_copied):
+                r, pos = _dec_route(body, pos)
+                copied.append(r)
+            migrating[tenant.decode()] = {
+                "src": src, "dst": dst, "ready": bool(ready),
+                "copied": copied}
     return MeshBaseSnapshot(
         n_shards=n_shards, probe_len=probe_len, max_levels=max_levels,
         pins=pins, replicated=tuple(replicated), shards=shards,
-        routes=routes)
+        routes=routes, migrating=migrating, map_version=map_version)
 
 
 __all__ = ["DeltaRecord", "BaseSnapshot", "MeshBaseSnapshot",

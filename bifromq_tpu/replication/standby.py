@@ -237,6 +237,21 @@ class WarmStandby:
         m = self.matcher
         base = m._base_ct
         mesh = base is not None and hasattr(base, "compiled")
+        if rec.op is not None and mesh:
+            # ISSUE 17: elastic-mesh control ops replay through the ONE
+            # migration-op definition — same idempotent patch calls at
+            # the same op-stream position as the leader, so shard arenas
+            # stay byte-identical through begin/copy/cutover/abort. They
+            # move rows, not logical routes: the authoritative tries,
+            # overlay and match-cache generations are untouched (the
+            # zero-bump contract the dual-serve window relies on).
+            from ..parallel.reshard import (apply_migration_op,
+                                            is_migration_op)
+            if is_migration_op(rec.op):
+                apply_migration_op(m, rec.op)
+                self.applied += 1
+                REPLICATION.inc("applied")
+                return
         if rec.plan is not None and isinstance(base, PatchableTrie):
             base.apply_plan(rec.plan)
         if rec.op is not None:
@@ -303,7 +318,9 @@ class WarmStandby:
         pts = [s.to_trie() for s in snap.shards]
         tables = ShardedTables.from_patchable(
             pts, probe_len=snap.probe_len, max_levels=snap.max_levels,
-            pins=snap.pins, replicated=snap.replicated)
+            pins=snap.pins, replicated=snap.replicated,
+            migrating=snap.to_migrating(),
+            map_version=snap.map_version)
         dev = (jax.device_put(tables.edge_tab, m._table_sharding),
                jax.device_put(tables.child_list, m._table_sharding),
                jax.device_put(tables.route_tab, m._table_sharding))
@@ -319,6 +336,10 @@ class WarmStandby:
         m._log = []
         m.tries = tries
         m._shadow = shadow
+        # mirror the leader's pin map onto the matcher too (ISSUE 17:
+        # cutovers arrive as pin writes; a post-promotion compile must
+        # place tenants where the leader's shard map last said)
+        m._pins = dict(snap.pins or {})
         if m.match_cache is not None and prev is not None \
                 and m._base_salt(prev) != m._base_salt(tables):
             m.match_cache.bump_all()

@@ -106,6 +106,7 @@ KNOWN_STAGES = frozenset({
     "mesh.flush",       # ISSUE 15: per-shard mesh patch flush (scatters)
     "retain.scan",      # ISSUE 13: retained wildcard scan batch (SUBSCRIBE)
     "inbox.drain",      # ISSUE 13: persistent-session catch-up drain
+    "mesh.migrate",     # ISSUE 17: live-migration copy chunks + resize
 })
 
 

@@ -1,0 +1,174 @@
+#!/usr/bin/env bash
+# Tier-2 elastic-mesh gate (ISSUE 17): live tenant migration + online
+# rebalancing on a Zipf-skewed 8-way HOST mesh
+# (XLA_FLAGS=--xla_force_host_platform_device_count=8), asserting:
+#   1. the skew-driven rebalancer PLANS a move off the hot shard (load
+#      model skew > threshold, capacity-planner veto consulted),
+#   2. the live migration ladder (begin -> copy* -> ready -> cutover ->
+#      tombstone) runs with ZERO full rebuilds and ZERO match-cache
+#      generation bumps, with exact host-oracle row parity after EVERY
+#      copy chunk and through the dual-serve window — including
+#      mutations folded in mid-migration,
+#   3. post-move shard skew strictly improves,
+#   4. the ABORT ladder: a hang injected on the migration's TARGET
+#      shard opens that shard's breaker mid-copy and the next step()
+#      aborts cleanly — source-only serving restored, partial target
+#      rows tombstoned, exact parity, migration retryable.
+# Runs on CPU (JAX_PLATFORMS=cpu), hard timeout like the other gates.
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+
+timeout -k 10 "${RESHARD_CHECK_TIMEOUT:-420}" \
+    env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    BIFROMQ_DEVICE_DEADLINE_S=0.3 \
+    BIFROMQ_SHARD_DEADLINE_S=0.3 \
+    python - <<'EOF'
+import asyncio, os, random
+
+import numpy as np
+
+from bifromq_tpu.models.oracle import Route
+from bifromq_tpu.obs import OBS
+from bifromq_tpu.parallel.reshard import (MeshRebalancer, MigrationAborted,
+                                          ShardLoadModel)
+from bifromq_tpu.parallel.sharded import MeshMatcher, make_mesh
+from bifromq_tpu.resilience.faults import get_injector
+from bifromq_tpu.types import RouteMatcher
+
+N_SHARDS = 8
+N_TENANTS = int(os.environ.get("RESHARD_CHECK_TENANTS", "32"))
+WHALE_ROUTES = int(os.environ.get("RESHARD_CHECK_WHALE_ROUTES", "400"))
+
+
+def mk(tf, rid):
+    return Route(matcher=RouteMatcher.from_topic_filter(tf), broker_id=0,
+                 receiver_id=rid, deliverer_key="d0", incarnation=0)
+
+
+def canon(r):
+    return (sorted((x.matcher.mqtt_topic_filter, x.receiver_url)
+                   for x in r.normal),
+            {f: sorted(x.receiver_url for x in ms)
+             for f, ms in r.groups.items()})
+
+
+def assert_parity(m, probe, label):
+    got = m.match_batch(probe)
+    want = m.match_from_tries(probe)
+    bad = sum(1 for a, b in zip(got, want) if canon(a) != canon(b))
+    assert bad == 0, f"{label}: {bad}/{len(probe)} rows mismatch the oracle"
+
+
+# ---- Zipf-skewed population: tenant i gets ~N/(i+1) routes -------------
+mesh = make_mesh(1, N_SHARDS)
+m = MeshMatcher(mesh=mesh, max_levels=8, k_states=16,
+                auto_compact=False, match_cache=True)
+tenants = [f"zt{i}" for i in range(N_TENANTS)]
+whale = tenants[0]
+total = 0
+for i, t in enumerate(tenants):
+    n = max(2, WHALE_ROUTES // (i + 1))
+    for j in range(n):
+        m.add_route(t, mk(f"z/{t}/{j}/+", f"r{i}_{j}"))
+        total += 1
+m.refresh()
+m.query_heat[whale] = 65536          # the whale owns the heat too
+probe = [(tenants[i % N_TENANTS], f"z/{tenants[i % N_TENANTS]}/{i}/x")
+         for i in range(128)]
+print(f"zipf mesh: {total} routes over {N_TENANTS} tenants / "
+      f"{N_SHARDS} shards, whale={whale} "
+      f"({max(2, WHALE_ROUTES)} routes + all heat)")
+assert_parity(m, probe, "pre-move")
+
+# ---- 1. the rebalancer must plan the whale off its hot shard -----------
+model = ShardLoadModel()
+skew0 = model.skew(model.rows(m))
+reb = MeshRebalancer(m, max_skew=1.2, min_heat=64)
+decision = reb.plan()
+assert decision is not None, f"no plan at skew {skew0:.2f}"
+assert decision["tenant"] == whale, decision
+src, dst = decision["src"], decision["dst"]
+assert src == m._base_ct.shard_of(whale) and dst != src
+print(f"plan: skew {skew0:.2f} -> move {whale} shard{src} -> shard{dst}")
+
+# ---- 2. step-wise live migration: parity after EVERY chunk -------------
+ledger = OBS.profiler.ledger
+rebuilds0, gen0, bumps0 = (m.compile_count, m.match_cache._gen,
+                           ledger.generation_bumps)
+mig = m.migrate_tenant(whale, src, dst, run=False)
+rng = random.Random(17)
+chunks = 0
+while mig.state == "copying":
+    done = mig.step(64)
+    chunks += 1
+    # mutations mid-migration: dual-fold into BOTH arenas
+    t = rng.choice([whale, rng.choice(tenants)])
+    m.add_route(t, mk(f"mid/{chunks}/+", f"mid{chunks}"))
+    assert_parity(m, probe[:48] + [(t, f"mid/{chunks}/q")],
+                  f"copy chunk {chunks}")
+    if done:
+        break
+assert mig.state == "ready", mig.state
+assert m._base_ct.shards_of(whale) == [src, dst]
+m.add_route(whale, mk("dual/serve/+", "dualrcv"))
+assert_parity(m, probe + [(whale, "dual/serve/q")], "dual-serve window")
+mig.cutover()
+assert m._base_ct.shards_of(whale) == [dst]
+assert_parity(m, probe, "post-cutover")
+assert mig.finish(), "ring busy at tombstone time"
+assert_parity(m, probe, "post-tombstone")
+assert m.compile_count == rebuilds0, "full rebuild during live migration"
+assert m.match_cache._gen == gen0, "match-cache generation bump"
+assert ledger.generation_bumps == bumps0, "ledger generation bump"
+print(f"migrate: {mig.copied_n} routes in {chunks} chunks, rebuilds=0 "
+      f"gen-bumps=0, parity exact every chunk "
+      f"(fallbacks={m.patch_fallbacks})")
+
+# ---- 3. the move must IMPROVE skew -------------------------------------
+skew1 = model.skew(model.rows(m))
+assert skew1 < skew0, f"skew {skew0:.2f} -> {skew1:.2f} did not improve"
+print(f"skew: {skew0:.2f} -> {skew1:.2f}")
+
+# ---- 4. abort ladder: hang the TARGET shard mid-copy -------------------
+victim = tenants[1]
+src2 = m._base_ct.shard_of(victim)
+dst2 = next(s for s in range(N_SHARDS) if s != src2)
+mig2 = m.migrate_tenant(victim, src2, dst2, run=False)
+assert not mig2.step(8), "victim copy must span several chunks"
+inj = get_injector()
+rule = inj.add_rule(service="tpu-device", method=f"mesh:shard{dst2}",
+                    action="hang", side="device")
+
+
+async def trip_target():
+    for k in range(4):           # trip threshold (3) + one open serve
+        # unique topics per round: the match cache must MISS so every
+        # round actually dispatches to the hung target shard
+        qs = [(t, f"trip/{k}/{t}") for t in tenants] * 2
+        got = await m.match_batch_async(qs)
+        want = m.match_from_tries(qs)
+        assert all(canon(a) == canon(b) for a, b in zip(got, want)), \
+            "rows must stay exact through the hang (oracle degradation)"
+
+asyncio.run(trip_target())
+assert m.shard_breakers[dst2].state == "open", \
+    [br.state for br in m.shard_breakers]
+try:
+    mig2.step(8)
+    raise SystemExit("step() must abort on an open target breaker")
+except MigrationAborted as e:
+    print(f"abort: {e}")
+assert mig2.state == "aborted"
+assert not (m._base_ct.migrating or {}), "migration state must clear"
+assert m._base_ct.shards_of(victim) == [src2], "source-only serving"
+inj.remove_rule(rule)
+assert_parity(m, probe, "post-abort")
+print("RESHARD CHECK PASSED")
+EOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "RESHARD CHECK FAILED (rc=$rc)"
+    exit $rc
+fi

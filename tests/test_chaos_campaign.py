@@ -358,3 +358,142 @@ class TestStandbyCrashCampaign:
         # identical signature
         rep2, _, _ = _run_standby_crash_campaign()
         assert rep1["signature"] == rep2["signature"]
+
+
+# ---------------- migration-target-hang campaign (ISSUE 17) -----------------
+
+
+def _run_migration_abort_campaign(monkeypatch):
+    """Live tenant migration whose TARGET shard hangs mid-copy-stream:
+    the watchdog opens the dst breaker, the next migration step aborts
+    cleanly — source-only serving, every partially-copied target row
+    (copy stream AND the dual-folded mid-migration mutation) tombstoned,
+    delivery parity at every step. Same determinism contract as the
+    other campaigns."""
+    import jax
+
+    from bifromq_tpu.parallel.reshard import MigrationAborted
+    from bifromq_tpu.parallel.sharded import MeshMatcher, make_mesh
+    from bifromq_tpu.resilience.breaker import CircuitBreaker
+
+    monkeypatch.setenv("BIFROMQ_DEVICE_DEADLINE_S", "0.3")
+    monkeypatch.setenv("BIFROMQ_SHARD_DEADLINE_S", "0.3")
+    m = MeshMatcher(mesh=make_mesh(1, 4, jax.devices()[:4]),
+                    max_levels=8, k_states=16, auto_compact=False,
+                    match_cache=False)
+    by_shard = _pick_tenants()
+    src, dst = sorted(by_shard)[0], sorted(by_shard)[2]
+    victim = by_shard[src]
+    for t in by_shard.values():
+        for i, tf in enumerate(HUNG_FILTERS):
+            m.add_route(t, _mk_route(tf, f"r{i}"))
+    m.refresh()
+    m.shard_breakers[dst] = CircuitBreaker(failure_threshold=1,
+                                           recovery_time=3600.0)
+    queries = [(t, topic) for t in sorted(by_shard.values())
+               for topic in HUNG_TOPICS]
+
+    def dst_live_slots():
+        # live matching slots in the TARGET arena (dead slots linger
+        # until frag-compaction; live count is the ghost-row metric)
+        import numpy as np
+
+        from bifromq_tpu.models.automaton import CompiledTrie
+        pt = m._base_ct.compiled[dst]
+        n = len(pt.matchings)
+        return n - int(np.sum(np.asarray(pt.slot_kind[:n])
+                              == CompiledTrie.SLOT_DEAD))
+
+    dst_live0 = dst_live_slots()
+    state = {"mig": None}
+
+    def start_migration(step):
+        state["mig"] = m.migrate_tenant(victim, src, dst, run=False)
+        state["mig"].step(2)               # partial copy stream
+        # a mid-migration mutation dual-folds into BOTH arenas — the
+        # abort must tombstone its dst copy too
+        m.add_route(victim, _mk_route("mid/mig", "r-mid"))
+
+    schedule = [
+        ChaosEvent(step=1, action="call", label="start-migration",
+                   fn=start_migration),
+        ChaosEvent(step=2, action="inject", label="hang-dst",
+                   rule_kw=dict(service="tpu-device",
+                                method=f"mesh:shard{dst}",
+                                side="device", action="hang")),
+        ChaosEvent(step=4, action="clear", label="hang-dst"),
+    ]
+
+    async def step_fn(step):
+        aborted = 0
+        mig = state["mig"]
+        if step == 3 and mig is not None:
+            try:
+                mig.step()
+            except MigrationAborted:
+                aborted = 1
+        res = await m.match_batch_async(queries)
+        want = m.match_from_tries(queries)
+        lost_or_dup = sum(
+            1 for g, w in zip(res, want)
+            if MeshMatcher._canon_routes(g) != MeshMatcher._canon_routes(w))
+        return {"step": step, "aborted": aborted,
+                "lost_or_dup": lost_or_dup,
+                "migrating": sorted(m._base_ct.migrating or {}),
+                "victim_shards": list(m._base_ct.shards_of(victim)),
+                "mig_state": mig.state if mig is not None else None,
+                "dst_extra_live": dst_live_slots() - dst_live0,
+                "open_shards": [sh for sh, br in
+                                enumerate(m.shard_breakers)
+                                if br is not None
+                                and br.state != "closed"]}
+
+    campaign = ChaosCampaign("migration-abort", schedule, seed=29)
+    loop = asyncio.new_event_loop()
+    try:
+        rep = loop.run_until_complete(campaign.arun(step_fn, 6))
+    finally:
+        loop.close()
+    return rep, m, src, dst, victim
+
+
+class TestMigrationAbortCampaign:
+    def test_target_hang_aborts_cleanly(self, monkeypatch):
+        rep1, m, src, dst, victim = \
+            _run_migration_abort_campaign(monkeypatch)
+        steps = rep1["signature"]["steps"]
+
+        # delivery parity at EVERY step — through the copy stream, the
+        # hang, the abort and the cleanup (zero lost/duplicated routes)
+        assert all(s["lost_or_dup"] == 0 for s in steps), steps
+
+        # step 1: migration mid-stream, dual-fold active (dst arena
+        # holds copied + dual-folded victim rows)
+        assert steps[1]["migrating"] == [victim]
+        assert steps[1]["victim_shards"] == [src, dst]
+        assert steps[1]["dst_extra_live"] > 0
+
+        # step 2: the hang opens ONLY the target shard's breaker
+        assert steps[2]["open_shards"] == [dst]
+
+        # step 3: the next migration step sees the open target breaker
+        # and aborts CLEANLY — migration table empty, source-only
+        # serving, every partial target row tombstoned (dst arena back
+        # to its pre-migration live count)
+        assert steps[3]["aborted"] == 1
+        assert steps[3]["mig_state"] == "aborted"
+        assert steps[3]["migrating"] == []
+        assert steps[3]["victim_shards"] == [src]
+        assert steps[3]["dst_extra_live"] == 0
+
+        # the abort never left residue for later steps either
+        assert steps[5]["migrating"] == []
+        assert steps[5]["dst_extra_live"] == 0
+        # and the victim still serves its mid-migration route from src
+        got = m.match_batch([(victim, "mid/mig")])[0]
+        assert any(r.receiver_id == "r-mid" for r in got.normal)
+
+        # determinism: fresh mesh, same seed + schedule ⇒ identical
+        # signature
+        rep2, *_ = _run_migration_abort_campaign(monkeypatch)
+        assert rep1["signature"] == rep2["signature"]
