@@ -50,7 +50,13 @@ HOT_SCOPES: Dict[str, Set[str]] = {
     # ISSUE 12: the standby's per-batch device flush runs after every
     # applied delta batch — it must stay a pure dispatch wrapper (the
     # narrow scatters live in ops/match, already covered above)
-    "replication/standby.py": {"WarmStandby._flush_device"},
+    # (+ ISSUE 18: the apply loop itself now folds lag/audit telemetry
+    # per record — that instrumentation must stay host-array-free too)
+    "replication/standby.py": {"WarmStandby._flush_device",
+                               "WarmStandby._offer_inner"},
+    # ISSUE 18: the migration copy stream runs between serving batches;
+    # its per-chunk progress accounting must not synchronize the ring
+    "parallel/reshard.py": {"TenantMigration.step"},
     # ISSUE 15: the mesh serving legs — stage-1 prep (shard routing +
     # tokenize + grid upload), the step enqueue, the per-shard patch
     # flush, and the expansion that runs against the in-flight snapshot

@@ -184,6 +184,12 @@ class APIServer:
                 return self._mesh_get()
             if route == ("GET", "/mesh/rebalance"):
                 return self._mesh_rebalance(arg)
+            if route == ("GET", "/mesh/migrations"):
+                return self._mesh_migrations()
+            if route == ("GET", "/mesh/autoscaler"):
+                return self._mesh_autoscaler(arg)
+            if route == ("GET", "/replication/lag"):
+                return self._replication_lag(arg)
             if route == ("GET", "/metrics"):
                 return self._metrics_get(arg)
             if route == ("GET", "/tenants"):
@@ -456,6 +462,63 @@ class APIServer:
         if not out:
             return 404, {"error": "no mesh matcher on this node"}
         return 200, {"rebalancers": out}
+
+    def _mesh_migrations(self) -> Tuple[int, object]:
+        """/mesh/migrations: the live-migration ladder, rung by rung —
+        per in-flight migration the copy-stream progress (chunks, rows,
+        bytes, %), the dual-serve-window duration and the current rung;
+        per retired migration the per-rung timings and the abort
+        attribution (ISSUE 18). 404 on a single-chip node."""
+        from ..obs import OBS
+        from ..parallel.reshard import migration_digest
+        out = []
+        for m in OBS.device.matchers():
+            if getattr(m, "mesh_status", None) is None:
+                continue
+            active = [mig.progress() for mig in
+                      getattr(m, "migrations_inflight", {}).values()]
+            out.append({
+                "digest": migration_digest(m),
+                "active": active,
+                "history": list(getattr(m, "migration_history", [])),
+            })
+        if not out:
+            return 404, {"error": "no mesh matcher on this node"}
+        return 200, {"migrations": out}
+
+    def _mesh_autoscaler(self, arg) -> Tuple[int, object]:
+        """/mesh/autoscaler: the unattended scaling loop's knobs and its
+        bounded decision ring — every grow/rebalance/shrink/veto with
+        the exact signal snapshot it acted on (ISSUE 18 provenance:
+        'why did the mesh grow at 3am' is answerable from one GET)."""
+        from ..obs import OBS
+        top_k = int(arg("top_k", "32"))
+        if top_k < 0:
+            return 400, {"error": f"top_k={top_k} (must be >= 0)"}
+        out = []
+        for m in OBS.device.matchers():
+            scaler = getattr(m, "mesh_autoscaler", None)
+            if scaler is None:
+                continue
+            st = scaler.status()
+            st["decisions"] = st["decisions"][-top_k:]
+            out.append(st)
+        if not out:
+            return 404, {"error": "no autoscaler on this node"}
+        return 200, {"autoscalers": out}
+
+    def _replication_lag(self, arg) -> Tuple[int, object]:
+        """/replication/lag: the ISSUE 18 lag plane — per (origin,
+        range) stream the windowed apply-lag histogram, throughput,
+        reorder occupancy, resync/gap counters and the stale flag, plus
+        the recent delta-plane event journal."""
+        from ..obs.lag import LAG, REPL_EVENTS
+        top_k = int(arg("events", "64"))
+        if top_k < 0:
+            return 400, {"error": f"events={top_k} (must be >= 0)"}
+        snap = LAG.snapshot()
+        snap["events"] = REPL_EVENTS.tail(top_k)
+        return 200, snap
 
     def _tenants_ranked(self, arg) -> Tuple[int, object]:
         """Live noisy-neighbor ranking over the windowed RED state: top-K

@@ -73,6 +73,9 @@ class ObsHub:
         self._store_prof_cursor = 0
         self._store_slow_cursor = 0
         self._store_ledger_cursor = 0
+        # ISSUE 18: delta-plane event journal drain (lag transitions,
+        # parity audits, autoscaler decisions) into the same store
+        self._store_repl_cursor = -1
         self.exporter: Optional[TelemetryExporter] = None
         self._exporter_refs = 0
         self._registry_ref = None       # weakref to a MetricsRegistry
@@ -420,6 +423,14 @@ class ObsHub:
             trace.TRACER.slow_ring.since(self._store_slow_cursor)
         for s in spans:
             out.append({"type": "span", **s.to_dict()})
+        # ISSUE 18: lag-stale transitions, gaps/resyncs, parity audits
+        # and autoscaler decisions — the post-hoc reader reconstructs
+        # WHY the delta plane resynced or the mesh scaled
+        from .lag import REPL_EVENTS
+        evs, self._store_repl_cursor = \
+            REPL_EVENTS.since(self._store_repl_cursor)
+        for e in evs:
+            out.append({"type": "repl_event", **e})
         if out:
             # one summary record per flush stamps the aggregate view the
             # post-hoc reader anchors on; probe=False — this runs on the
@@ -506,6 +517,10 @@ class ObsHub:
         self._store_prof_cursor = 0
         self._store_slow_cursor = 0
         self._store_ledger_cursor = 0
+        self._store_repl_cursor = -1
+        from .lag import LAG, REPL_EVENTS
+        LAG.reset()
+        REPL_EVENTS.reset()
 
 
 # the process-global hub every instrumentation site reports into

@@ -1,0 +1,192 @@
+"""Continuous parity auditor (ISSUE 18 leg 2).
+
+The replication tests prove arena parity; this module makes it a
+*monitored production invariant*. The leader periodically folds a
+chunked BLAKE2 fingerprint of each live arena set — the single-chip
+route trie, every mesh shard, the retained index — into its own delta
+stream as an ordinary HLC-stamped record (op ``("audit", scope, fp,
+n_chunks)``, wire tag ``b"D"``). Because the record rides the stream,
+every standby compares its OWN arenas at exactly the leader's cursor:
+a mismatch means the byte-replay contract broke somewhere between the
+last resync and this record. The standby then raises
+``PARITY_DIVERGENCE``, bumps ``REPLICATION.parity_divergence_total``
+and degrades to exactly one bounded resync — the same healing ladder a
+sequence gap takes.
+
+Fingerprints are order-exact by construction: a standby installs the
+leader's arenas verbatim and re-applies the identical op/plan stream,
+so ``node_tab``/``edge_tab``/``child_list``/``slot_kind`` must match
+byte-for-byte (the property ``assert_arena_parity`` pins in tests).
+The retained scope hashes the logical (tenant, topic) set instead —
+the retained standby replays SET/CLEAR through its own patcher, whose
+arenas are byte-identical too, but the topic set is the authoritative
+contract its scans serve from.
+
+Layering: ``obs`` must not import ``utils.metrics`` at module scope
+(that module imports ``obs`` on load); the stage histogram import is
+deferred into the audit call.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.env import env_float
+from .lag import REPL_EVENTS
+
+#: arenas are fed to BLAKE2 in fixed-size chunks so one audit never
+#: builds a second full-table byte copy; n_chunks rides the record as a
+#: cheap cross-check that both sides hashed the same table extents
+CHUNK_BYTES = 1 << 20
+
+
+def audit_interval_s() -> float:
+    """Leader audit cadence (seconds) on the ObsHub advisory tick."""
+    return max(0.05, env_float("BIFROMQ_AUDIT_INTERVAL_S", 30.0))
+
+
+def _fold(h, data: bytes) -> int:
+    n = 0
+    for off in range(0, len(data), CHUNK_BYTES):
+        h.update(data[off:off + CHUNK_BYTES])
+        n += 1
+    return n
+
+
+def fingerprint_arenas(pt) -> Tuple[str, int]:
+    """Chunked BLAKE2 over one PatchableTrie-shaped arena set, padding
+    included — the replica's tables are full-array byte-identical, so
+    hashing the capacity tail is both valid and allocation-free."""
+    h = hashlib.blake2b(digest_size=16)
+    chunks = 0
+    for arr in (pt.node_tab, pt.edge_tab, pt.child_list, pt.slot_kind):
+        chunks += _fold(h, np.ascontiguousarray(arr).tobytes())
+    meta = repr((int(pt.n_live), sorted(pt.tenant_root.items()),
+                 len(pt.matchings))).encode()
+    chunks += _fold(h, meta)
+    return h.hexdigest(), chunks
+
+
+def fingerprint_retained(index) -> Tuple[str, int]:
+    """Logical fingerprint of a RetainedIndex: the sorted (tenant,
+    topic) set — exactly what the standby's replayed SET/CLEAR stream
+    must reproduce."""
+    from ..replication.records import _iter_trie_routes
+    h = hashlib.blake2b(digest_size=16)
+    chunks = 0
+    for tenant in sorted(index.tries):
+        topics = sorted(r.matcher.mqtt_topic_filter
+                        for r in _iter_trie_routes(index.tries[tenant]))
+        chunks += _fold(h, repr((tenant, topics)).encode())
+    return h.hexdigest(), chunks
+
+
+def fingerprint_scope(matcher, scope: str) -> Optional[Tuple[str, int]]:
+    """Resolve an audit record's scope against a (replica) matcher:
+    ``route`` = the single-chip base, ``mesh:<i>`` = one shard's arena.
+    Returns None when the scope does not exist here (shape drift — the
+    compare is skipped, never a false divergence)."""
+    base = getattr(matcher, "_base_ct", None)
+    if base is None:
+        return None
+    if scope == "route":
+        return None if hasattr(base, "compiled") \
+            else fingerprint_arenas(base)
+    if scope.startswith("mesh:"):
+        if not hasattr(base, "compiled"):
+            return None
+        i = int(scope.split(":", 1)[1])
+        if i >= len(base.compiled):
+            return None
+        return fingerprint_arenas(base.compiled[i])
+    return None
+
+
+class ParityAuditor:
+    """Leader-side audit emitter.
+
+    ``audit_once()`` fingerprints every live arena set and emits one
+    audit op per scope through the matcher's normal delta hook
+    (``_emit_delta`` — emit-only: the leader does NOT patch its own
+    arenas on an audit op, and ``tenant=""`` keeps the record out of
+    the cache-invalidation fan-out). ``attach()`` puts the cadence on
+    the ObsHub advisory tick via :func:`audit_interval_s`.
+    """
+
+    def __init__(self, matcher, *, retained_index=None,
+                 retained_log=None, clock=None) -> None:
+        import time
+        self.matcher = matcher
+        self.retained_index = retained_index
+        self.retained_log = retained_log
+        self._clock = clock or time.monotonic
+        self._last_at: Optional[float] = None
+        self.audits = 0
+        self._hooked = False
+
+    def scopes(self) -> List[str]:
+        base = getattr(self.matcher, "_base_ct", None)
+        if base is None:
+            return []
+        if hasattr(base, "compiled"):
+            return [f"mesh:{i}" for i in range(len(base.compiled))]
+        return ["route"]
+
+    def audit_once(self) -> List[Tuple]:
+        """Fingerprint + emit one audit record per live scope; returns
+        the emitted ops (tests assert on them)."""
+        import time
+        from .. import trace
+        from ..utils.metrics import STAGES   # deferred: import layering
+        t0 = time.perf_counter()
+        ops: List[Tuple] = []
+        with trace.span("repl.audit", scopes=len(self.scopes())):
+            base = getattr(self.matcher, "_base_ct", None)
+            if base is not None:
+                for scope in self.scopes():
+                    fp = fingerprint_scope(self.matcher, scope)
+                    if fp is None:
+                        continue
+                    op = ("audit", scope, fp[0], fp[1])
+                    self.matcher._emit_delta("", (), op, None, False)
+                    ops.append(op)
+            if self.retained_index is not None \
+                    and self.retained_log is not None:
+                fp_hex, chunks = fingerprint_retained(self.retained_index)
+                self.retained_log.append("", (),
+                                         f"audit:{fp_hex}:{chunks}")
+                ops.append(("audit", "retained", fp_hex, chunks))
+        if ops:
+            self.audits += 1
+            STAGES.record("repl.audit", time.perf_counter() - t0)
+            REPL_EVENTS.append("audit_emitted", scopes=[o[1] for o in ops])
+        return ops
+
+    # ---------------- advisory-tick cadence ----------------------------
+
+    def _tick(self) -> None:
+        now = self._clock()
+        if self._last_at is not None \
+                and now - self._last_at < audit_interval_s():
+            return
+        self._last_at = now
+        self.audit_once()
+
+    def attach(self) -> None:
+        if not self._hooked:
+            from . import OBS
+            OBS.on_advisory_tick(self._tick)
+            self._hooked = True
+
+    def detach(self) -> None:
+        if self._hooked:
+            from . import OBS
+            OBS.remove_advisory_hook(self._tick)
+            self._hooked = False
+
+    def status(self) -> Dict[str, object]:
+        return {"audits": self.audits, "scopes": self.scopes(),
+                "interval_s": audit_interval_s()}

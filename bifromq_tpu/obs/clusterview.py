@@ -253,6 +253,13 @@ class ClusterView:
         mesh = self._mesh_field()
         if mesh:
             digest["mesh"] = mesh
+        # ISSUE 18: compact replication-lag summary — a peer whose
+        # standby is stale is a bad failover target, and /cluster shows
+        # apply lag cluster-wide with no extra RPC plane; omitted when
+        # this node consumes no delta streams
+        repl = self._replication_field()
+        if repl:
+            digest["replication"] = repl
         return digest
 
     @staticmethod
@@ -267,7 +274,18 @@ class ClusterView:
                     "map_version": s.get("map_version", 0),
                     "migrating": len(s.get("migrating", {})),
                     "shard_load": [round(float(r.get("score", 0.0)), 3)
-                                   for r in s.get("shard_load", [])]}
+                                   for r in s.get("shard_load", [])],
+                    # ISSUE 18: live-migration ladder progress rides the
+                    # same field — peers see a dual-serve window open
+                    "migrations": s.get("migrations", {})}
+        except Exception:  # noqa: BLE001 — telemetry must not raise
+            return {}
+
+    @staticmethod
+    def _replication_field() -> dict:
+        try:
+            from .lag import LAG
+            return LAG.summary()
         except Exception:  # noqa: BLE001 — telemetry must not raise
             return {}
 

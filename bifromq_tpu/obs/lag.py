@@ -1,0 +1,232 @@
+"""Replication lag plane (ISSUE 18): per-stream apply-lag observability.
+
+Every delta consumer — ``WarmStandby`` (single and mesh), the
+``RetainedStandby``, the ``InvalidationPuller`` — reports into the
+process-global :data:`LAG` keyed by ``(origin, range_id)``; the leader
+side reports emit throughput from ``DeltaLog.append``.  Per stream we
+keep a windowed log2 histogram of HLC apply lag (record stamp → apply
+wall clock), windowed applied/emitted throughput, the reorder-buffer
+occupancy gauge, and monotonic resync/gap counters.
+
+A stream whose observed lag exceeds ``BIFROMQ_REPL_LAG_STALE_S`` is
+flagged **stale**; the flag clears only after a full threshold-wide
+quiet window of under-threshold applies (hysteresis — a stream that
+oscillates around the threshold stays stale).  ``WarmStandby.promote``
+consults the flag and refuses a stale promotion without ``force=True``.
+
+:data:`REPL_EVENTS` is the bounded journal every delta-plane event
+(stale transitions, gaps, resyncs, parity audits, autoscaler decisions)
+appends to; the ObsHub persistence loop drains it through the segment
+store via the usual ``since()`` cursor contract.
+
+Layering: like the rest of ``obs`` this module must NOT import
+``utils.metrics`` (that module imports ``obs`` at import time).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.env import env_float
+from .window import WindowedCounter, WindowedLog2Histogram
+
+
+def lag_stale_s() -> float:
+    """Apply-lag threshold (seconds) beyond which a stream is stale."""
+    return max(0.1, env_float("BIFROMQ_REPL_LAG_STALE_S", 5.0))
+
+
+class EventJournal:
+    """Bounded, cursor-addressable ring of delta-plane event records.
+
+    ``append`` stamps a monotonically increasing ``seq``; ``since(cur)``
+    returns every surviving record with ``seq > cur`` plus the new
+    cursor, so the ObsHub persistence drain is idempotent across
+    flushes and a flapping process still yields attributable records.
+    """
+
+    def __init__(self, cap: int = 1024) -> None:
+        self.cap = max(16, int(cap))
+        self._lock = threading.Lock()
+        self._ring: List[Dict[str, Any]] = []
+        self.next_seq = 0
+
+    def append(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        rec = {"kind": kind, **fields}
+        with self._lock:
+            rec["seq"] = self.next_seq
+            self.next_seq += 1
+            self._ring.append(rec)
+            if len(self._ring) > self.cap:
+                del self._ring[: len(self._ring) - self.cap]
+        return rec
+
+    def since(self, cursor: int) -> Tuple[List[Dict[str, Any]], int]:
+        with self._lock:
+            out = [r for r in self._ring if r["seq"] > cursor]
+            new_cursor = self.next_seq - 1
+        return out, max(cursor, new_cursor)
+
+    def tail(self, n: int = 64) -> List[Dict[str, Any]]:
+        if n <= 0:
+            return []
+        with self._lock:
+            return list(self._ring[-n:])
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.next_seq = 0
+
+
+class _Stream:
+    """One (origin, range) replication stream's live signal set."""
+
+    __slots__ = ("origin", "range_id", "hist", "applied", "emitted",
+                 "reorder_occupancy", "resyncs", "gaps", "last_lag_s",
+                 "stale", "_last_over", "_clock")
+
+    def __init__(self, origin: str, range_id: str, clock) -> None:
+        self.origin = origin
+        self.range_id = range_id
+        self._clock = clock
+        self.hist = WindowedLog2Histogram(clock=clock)
+        self.applied = WindowedCounter(clock=clock)
+        self.emitted = WindowedCounter(clock=clock)
+        self.reorder_occupancy = 0
+        self.resyncs = 0
+        self.gaps = 0
+        self.last_lag_s = 0.0
+        self.stale = False
+        self._last_over: Optional[float] = None
+
+    def observe(self, lag_s: float, thr: float) -> Optional[bool]:
+        """Fold one applied record's lag; returns the new stale flag on
+        a transition, None when the flag did not move (hysteresis: the
+        flag clears only after a full ``thr``-wide under-threshold
+        window — oscillating streams stay stale)."""
+        now = self._clock()
+        lag_s = max(0.0, lag_s)
+        self.last_lag_s = lag_s
+        self.hist.record(lag_s)
+        self.applied.add(1)
+        if lag_s > thr:
+            self._last_over = now
+            if not self.stale:
+                self.stale = True
+                return True
+        elif (self.stale and self._last_over is not None
+              and now - self._last_over >= thr):
+            self.stale = False
+            return False
+        return None
+
+    def snapshot(self) -> Dict[str, Any]:
+        h = self.hist.snapshot()
+        return {
+            "origin": self.origin,
+            "range": self.range_id,
+            "lag_s": round(self.last_lag_s, 6),
+            "lag_p50_ms": h["p50_ms"],
+            "lag_p99_ms": h["p99_ms"],
+            "applied_window": h["count"],
+            "applied_per_s": round(self.applied.rate(), 3),
+            "emitted_per_s": round(self.emitted.rate(), 3),
+            "reorder_occupancy": self.reorder_occupancy,
+            "resyncs": self.resyncs,
+            "gaps": self.gaps,
+            "stale": self.stale,
+        }
+
+
+class LagPlane:
+    """Process-global registry of replication-stream lag signals."""
+
+    def __init__(self, *, clock=time.monotonic) -> None:
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._streams: Dict[Tuple[str, str], _Stream] = {}
+
+    def _stream(self, origin: str, range_id: str) -> _Stream:
+        key = (origin or "?", range_id or "?")
+        with self._lock:
+            st = self._streams.get(key)
+            if st is None:
+                st = self._streams[key] = _Stream(key[0], key[1],
+                                                  self._clock)
+            return st
+
+    # ---------------- feed side ----------------------------------------
+
+    def observe(self, origin: str, range_id: str, lag_s: float) -> None:
+        st = self._stream(origin, range_id)
+        flipped = st.observe(lag_s, lag_stale_s())
+        if flipped is not None:
+            REPL_EVENTS.append("lag_stale" if flipped else "lag_fresh",
+                               origin=st.origin, range=st.range_id,
+                               lag_s=round(st.last_lag_s, 6))
+
+    def note_emit(self, origin: str, range_id: str, n: int = 1) -> None:
+        self._stream(origin, range_id).emitted.add(n)
+
+    def note_applied(self, origin: str, range_id: str,
+                     n: int = 1) -> None:
+        """Throughput-only feed for consumers whose records carry no HLC
+        stamp (the invalidation puller)."""
+        self._stream(origin, range_id).applied.add(n)
+
+    def note_gap(self, origin: str, range_id: str) -> None:
+        st = self._stream(origin, range_id)
+        st.gaps += 1
+        REPL_EVENTS.append("gap", origin=st.origin, range=st.range_id)
+
+    def note_resync(self, origin: str, range_id: str) -> None:
+        st = self._stream(origin, range_id)
+        st.resyncs += 1
+        REPL_EVENTS.append("resync", origin=st.origin, range=st.range_id)
+
+    def set_occupancy(self, origin: str, range_id: str, n: int) -> None:
+        self._stream(origin, range_id).reorder_occupancy = int(n)
+
+    # ---------------- read side ----------------------------------------
+
+    def is_stale(self, origin: str, range_id: str) -> bool:
+        with self._lock:
+            st = self._streams.get((origin or "?", range_id or "?"))
+        return bool(st is not None and st.stale)
+
+    def stale_streams(self) -> List[Tuple[str, str]]:
+        with self._lock:
+            return [k for k, st in self._streams.items() if st.stale]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            streams = [st.snapshot() for _, st in sorted(
+                self._streams.items())]
+        return {
+            "stale_threshold_s": lag_stale_s(),
+            "streams": streams,
+            "stale": sum(1 for s in streams if s["stale"]),
+        }
+
+    def summary(self) -> Dict[str, Any]:
+        """Compact digest field: stream count, stale count, worst lag."""
+        with self._lock:
+            streams = list(self._streams.values())
+        if not streams:
+            return {}
+        return {
+            "streams": len(streams),
+            "stale": sum(1 for s in streams if s.stale),
+            "worst_lag_s": round(max(s.last_lag_s for s in streams), 3),
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._streams.clear()
+
+
+LAG = LagPlane()
+REPL_EVENTS = EventJournal()

@@ -1,0 +1,287 @@
+"""Unattended mesh autoscaler (ISSUE 18 leg 4 — closes the ROADMAP
+elastic-mesh follow-up (a)).
+
+PR 17 built the mechanism: ``MeshRebalancer`` plans one-tenant moves,
+``resize_mesh`` grows/shrinks the shard axis — but a human still had to
+call them. ``MeshAutoscaler`` is the policy loop: it rides the ObsHub
+advisory tick (the same cadence the noisy-neighbor detector and gossip
+digest refresh on), consumes the *windowed* signals the digest already
+carries — shard skew, device queue pressure, replication lag — and
+decides grow / rebalance / shrink with explicit hysteresis:
+
+- **grow/rebalance** only after ``K`` CONSECUTIVE over-threshold ticks
+  (``BIFROMQ_MESH_AUTOSCALE_K``) — a one-tick spike never scales;
+- **shrink** only after a sustained quiet window
+  (``BIFROMQ_MESH_AUTOSCALE_QUIET_S``) of low skew AND low pressure;
+- a **cooldown** (``BIFROMQ_MESH_AUTOSCALE_COOLDOWN_S``) after ANY
+  action blocks the next — at most one action per cooldown, no
+  flapping;
+- it DEFERS (vetoes) while a migration is in flight or any replication
+  stream is flagged stale — scaling under a half-moved tenant or a
+  lagging replica compounds the problem it is trying to fix;
+- ``BIFROMQ_MESH_AUTOSCALE=0`` is the kill-switch.
+
+Every decision — acted or vetoed — is recorded with the exact signal
+snapshot that justified it (decision provenance) in a bounded ring
+served at ``GET /mesh/autoscaler``, and appended to the delta-plane
+event journal so the PR 8 segment store persists it.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..utils.env import env_bool, env_float, env_int
+from .reshard import (MeshRebalancer, ShardLoadModel, reshard_max_skew,
+                      resize_mesh)
+
+log = logging.getLogger(__name__)
+
+
+def autoscale_enabled() -> bool:
+    """Kill-switch: ``BIFROMQ_MESH_AUTOSCALE=0`` disables the loop."""
+    return env_bool("BIFROMQ_MESH_AUTOSCALE", True)
+
+
+def autoscale_k() -> int:
+    """Consecutive over-threshold ticks before grow/rebalance."""
+    return max(1, env_int("BIFROMQ_MESH_AUTOSCALE_K", 3))
+
+
+def autoscale_cooldown_s() -> float:
+    """Quiet period after ANY action before the next may fire."""
+    return max(0.0, env_float("BIFROMQ_MESH_AUTOSCALE_COOLDOWN_S", 60.0))
+
+
+def autoscale_quiet_s() -> float:
+    """Sustained low-skew/low-pressure window before a shrink."""
+    return max(0.0, env_float("BIFROMQ_MESH_AUTOSCALE_QUIET_S", 300.0))
+
+
+def autoscale_pressure() -> float:
+    """Device queue-pressure fraction treated as over-threshold."""
+    return max(0.0, env_float("BIFROMQ_MESH_AUTOSCALE_PRESSURE", 0.75))
+
+
+def autoscale_min_shards() -> int:
+    return max(1, env_int("BIFROMQ_MESH_AUTOSCALE_MIN_SHARDS", 1))
+
+
+def autoscale_max_shards() -> int:
+    return max(1, env_int("BIFROMQ_MESH_AUTOSCALE_MAX_SHARDS", 64))
+
+
+class MeshAutoscaler:
+    """Hysteresis policy loop over one mesh matcher's signals.
+
+    ``signals_fn`` is injectable so the policy tests drive synthetic
+    skew/pressure sequences through the REAL decision machinery with a
+    fake clock; the default reads the live ShardLoadModel rows, the
+    ObsHub device gauge and the ISSUE 18 lag plane.
+    """
+
+    MAX_DECISIONS = 64
+
+    def __init__(self, matcher, *, rebalancer: Optional[MeshRebalancer]
+                 = None, signals_fn: Optional[Callable[[], dict]] = None,
+                 clock=time.monotonic) -> None:
+        self.matcher = matcher
+        self.rebalancer = rebalancer
+        self._signals_fn = signals_fn or self._live_signals
+        self._clock = clock
+        self._over_ticks = 0
+        self._quiet_since: Optional[float] = None
+        self._last_action_at: Optional[float] = None
+        self.ticks = 0
+        self.actions = 0
+        self.decisions: List[dict] = []
+        self._hooked = False
+        matcher.mesh_autoscaler = self
+
+    # ---------------- signal collection --------------------------------
+
+    def _live_signals(self) -> dict:
+        m = self.matcher
+        base = getattr(m, "_base_ct", None)
+        model = ShardLoadModel()
+        rows = model.rows(m)
+        try:
+            from ..obs import OBS
+            pressure = float(OBS.device.queue_pressure())
+        except Exception:  # noqa: BLE001 — telemetry must not raise
+            pressure = 0.0
+        from ..obs.lag import LAG
+        lag = LAG.summary()
+        return {
+            "skew": model.skew(rows),
+            "pressure": round(pressure, 6),
+            "n_shards": int(getattr(base, "n_shards", 0) or 0),
+            "migrating": len(getattr(base, "migrating", None) or {}),
+            "stale_streams": int(lag.get("stale", 0)),
+            "worst_lag_s": float(lag.get("worst_lag_s", 0.0)),
+        }
+
+    # ---------------- decision machinery -------------------------------
+
+    def _record(self, action: str, acted: bool, reason: str,
+                signals: dict, outcome: object = None) -> dict:
+        decision = {"action": action, "acted": acted, "reason": reason,
+                    "signals": dict(signals), "outcome": outcome,
+                    "tick": self.ticks}
+        self.decisions.append(decision)
+        del self.decisions[:-self.MAX_DECISIONS]
+        from ..obs.lag import REPL_EVENTS
+        REPL_EVENTS.append("autoscale_decision", **decision)
+        if acted:
+            self.actions += 1
+            self._last_action_at = self._clock()
+            self._over_ticks = 0
+            self._quiet_since = None
+        return decision
+
+    def _in_cooldown(self) -> bool:
+        return (self._last_action_at is not None
+                and self._clock() - self._last_action_at
+                < autoscale_cooldown_s())
+
+    def tick(self) -> Optional[dict]:
+        """One policy evaluation; returns the decision recorded this
+        tick, or None when nothing was even worth recording (disabled /
+        signals nominal and no window armed)."""
+        if not autoscale_enabled():
+            return None
+        self.ticks += 1
+        try:
+            sig = self._signals_fn()
+        except Exception as e:  # noqa: BLE001 — a broken signal source
+            log.debug("autoscaler signals failed: %r", e)  # must not kill
+            return None                                    # the tick loop
+        now = self._clock()
+        over = (sig["skew"] > reshard_max_skew()
+                or sig["pressure"] > autoscale_pressure())
+        quiet = (sig["skew"] <= 1.0 + (reshard_max_skew() - 1.0) / 2
+                 and sig["pressure"] < autoscale_pressure() / 2)
+
+        # defer outright while the delta plane is unsettled: a half-
+        # moved tenant or a stale replica makes every signal a lie
+        if sig.get("migrating"):
+            self._over_ticks = 0
+            return self._record("defer", False,
+                                "migration in flight", sig)
+        if sig.get("stale_streams"):
+            self._over_ticks = 0
+            return self._record("defer", False,
+                                "stale replication stream", sig)
+
+        if over:
+            self._quiet_since = None
+            self._over_ticks += 1
+            if self._over_ticks < autoscale_k():
+                return self._record(
+                    "arm", False,
+                    f"over-threshold tick {self._over_ticks}/"
+                    f"{autoscale_k()}", sig)
+            if self._in_cooldown():
+                return self._record("grow", False, "cooldown", sig)
+            return self._scale_up(sig)
+
+        self._over_ticks = 0
+        if quiet and sig["n_shards"] > autoscale_min_shards():
+            if self._quiet_since is None:
+                self._quiet_since = now
+            if now - self._quiet_since < autoscale_quiet_s():
+                return self._record(
+                    "quiet", False,
+                    f"quiet window "
+                    f"{round(now - self._quiet_since, 1)}s/"
+                    f"{autoscale_quiet_s()}s", sig)
+            if self._in_cooldown():
+                return self._record("shrink", False, "cooldown", sig)
+            return self._shrink(sig)
+        self._quiet_since = None
+        return None
+
+    def _scale_up(self, sig: dict) -> dict:
+        """Over-threshold for K ticks: prefer moving ONE tenant off the
+        hot shard (cheap, no new arenas); grow the mesh when no move is
+        plannable (every shard hot / capacity vetoes / single tenant)."""
+        reb = self.rebalancer
+        if reb is None:
+            reb = self.rebalancer = MeshRebalancer(self.matcher)
+        try:
+            move = reb.plan()
+        except Exception as e:  # noqa: BLE001 — plan must not kill the loop
+            move = None
+            log.debug("autoscaler rebalance plan failed: %r", e)
+        if move is not None and move.get("tenant"):
+            outcome = reb.step()
+            return self._record(
+                "rebalance", True,
+                f"skew {sig['skew']} for {autoscale_k()} ticks; "
+                f"moving {move['tenant']}", sig, outcome)
+        n = sig["n_shards"]
+        if n >= autoscale_max_shards():
+            return self._record("grow", False,
+                                "at BIFROMQ_MESH_AUTOSCALE_MAX_SHARDS",
+                                sig)
+        try:
+            resize_mesh(self.matcher, n + 1)
+        except Exception as e:  # noqa: BLE001 — a blocked actuator is a
+            return self._record("grow", False,   # vetoed decision, not a
+                                f"blocked: {e}", sig)   # dead tick loop
+        return self._record(
+            "grow", True,
+            f"over-threshold for {autoscale_k()} ticks and no plannable "
+            f"move", sig, {"n_shards": n + 1})
+
+    def _shrink(self, sig: dict) -> dict:
+        n = sig["n_shards"]
+        try:
+            resize_mesh(self.matcher, n - 1)
+        except Exception as e:  # noqa: BLE001 — same contract as grow
+            return self._record("shrink", False, f"blocked: {e}", sig)
+        return self._record(
+            "shrink", True,
+            f"quiet for {autoscale_quiet_s()}s", sig,
+            {"n_shards": n - 1})
+
+    # ---------------- advisory-tick lifecycle --------------------------
+
+    def attach(self) -> None:
+        """Put the policy loop on the ObsHub advisory tick."""
+        if not self._hooked:
+            from ..obs import OBS
+            OBS.on_advisory_tick(self._safe_tick)
+            self._hooked = True
+
+    def detach(self) -> None:
+        if self._hooked:
+            from ..obs import OBS
+            OBS.remove_advisory_hook(self._safe_tick)
+            self._hooked = False
+
+    def _safe_tick(self) -> None:
+        try:
+            self.tick()
+        except Exception:  # noqa: BLE001 — the advisory tick must survive
+            log.exception("autoscaler tick failed")
+
+    # ---------------- introspection -------------------------------------
+
+    def status(self) -> Dict[str, object]:
+        return {
+            "enabled": autoscale_enabled(),
+            "k": autoscale_k(),
+            "cooldown_s": autoscale_cooldown_s(),
+            "quiet_s": autoscale_quiet_s(),
+            "pressure_threshold": autoscale_pressure(),
+            "min_shards": autoscale_min_shards(),
+            "max_shards": autoscale_max_shards(),
+            "ticks": self.ticks,
+            "actions": self.actions,
+            "over_ticks": self._over_ticks,
+            "in_cooldown": self._in_cooldown(),
+            "decisions": list(self.decisions),
+        }

@@ -255,6 +255,44 @@ def emit_migration_op(matcher, op: Tuple) -> None:
 
 
 # ---------------------------------------------------------------------------
+# migration observability (ISSUE 18 leg 3)
+# ---------------------------------------------------------------------------
+
+#: completed/aborted migrations kept per matcher for GET /mesh/migrations
+MIGRATION_HISTORY_CAP = 32
+
+
+def _inflight(matcher) -> Dict[str, "TenantMigration"]:
+    mp = getattr(matcher, "migrations_inflight", None)
+    if mp is None:
+        mp = matcher.migrations_inflight = {}
+    return mp
+
+
+def _history(matcher) -> List[dict]:
+    hist = getattr(matcher, "migration_history", None)
+    if hist is None:
+        hist = matcher.migration_history = []
+    return hist
+
+
+def migration_digest(matcher) -> dict:
+    """Compact ``mesh.migrations`` digest field: active copy progress +
+    completed/aborted tallies from the bounded history ring."""
+    hist = getattr(matcher, "migration_history", None) or []
+    active = [mig.progress()
+              for mig in (getattr(matcher, "migrations_inflight", None)
+                          or {}).values()]
+    return {
+        "active": len(active),
+        "pct": (round(min(p["pct"] for p in active), 1)
+                if active else 100.0),
+        "completed": sum(1 for h in hist if h["outcome"] == "done"),
+        "aborted": sum(1 for h in hist if h["outcome"] == "aborted"),
+    }
+
+
+# ---------------------------------------------------------------------------
 # migration driver
 # ---------------------------------------------------------------------------
 
@@ -303,6 +341,62 @@ class TenantMigration:
         self.copied_n = 0
         self.state = "init"   # init→copying→ready→cutover→done | aborted
         self.abort_reason = ""
+        # ISSUE 18 leg 3: per-rung wall timestamps + copy-stream volume
+        # for GET /mesh/migrations, the mesh.migrations digest field and
+        # the abort-attribution history record
+        self.rung_at: Dict[str, float] = {}
+        self.chunks = 0
+        self.bytes_copied = 0
+
+    # -------------- observability (ISSUE 18 leg 3) --------------------------
+
+    def _stamp(self, rung: str) -> None:
+        self.rung_at[rung] = time.monotonic()
+
+    def dual_serve_s(self) -> Optional[float]:
+        """Duration the tenant served from BOTH shards (ready→cutover;
+        still-open windows measure up to now)."""
+        t_ready = self.rung_at.get("ready")
+        if t_ready is None:
+            return None
+        t_end = self.rung_at.get("cutover")
+        return max(0.0, (t_end if t_end is not None
+                         else time.monotonic()) - t_ready)
+
+    def progress(self) -> dict:
+        total = len(self.pending)
+        dual = self.dual_serve_s()
+        return {
+            "tenant": self.tenant, "src": self.src, "dst": self.dst,
+            "state": self.state,
+            "rows": self.copied_n, "total": total,
+            "pct": round(100.0 * min(self._cursor, total)
+                         / max(1, total), 1),
+            "chunks": self.chunks, "bytes": self.bytes_copied,
+            "dual_serve_s": None if dual is None else round(dual, 6),
+            "abort_reason": self.abort_reason,
+        }
+
+    def _retire(self, outcome: str) -> None:
+        """Move this migration from the in-flight map into the bounded
+        per-matcher history ring, with full rung/volume attribution."""
+        _inflight(self.matcher).pop(self.tenant, None)
+        t0 = self.rung_at.get("begin")
+        durations = {}
+        if t0 is not None:
+            for rung, at in self.rung_at.items():
+                durations[rung] = round(at - t0, 6)
+        dual = self.dual_serve_s()
+        hist = _history(self.matcher)
+        hist.append({
+            "tenant": self.tenant, "src": self.src, "dst": self.dst,
+            "outcome": outcome, "abort_reason": self.abort_reason,
+            "rows": self.copied_n, "total": len(self.pending),
+            "chunks": self.chunks, "bytes": self.bytes_copied,
+            "rung_s": durations,
+            "dual_serve_s": None if dual is None else round(dual, 6),
+        })
+        del hist[:-MIGRATION_HISTORY_CAP]
 
     # -------------- abort ladder -------------------------------------------
 
@@ -323,11 +417,15 @@ class TenantMigration:
         was never touched — zero lost, zero duplicated routes."""
         if self.state in ("cutover", "done"):
             raise RuntimeError("cannot abort after cutover")
+        if self.state == "aborted":
+            return
         self.abort_reason = reason or "aborted"
         if self.state in ("copying", "ready"):
             emit_migration_op(self.matcher, ("mig_abort", self.tenant,
                                              self.src, self.dst))
         self.state = "aborted"
+        self._stamp("abort")
+        self._retire("aborted")
 
     # -------------- the ladder ---------------------------------------------
 
@@ -343,9 +441,15 @@ class TenantMigration:
             raise RuntimeError(f"migration of {sorted(inflight)} in "
                                f"flight — one live move at a time")
         self._check_migratable_base()
-        emit_migration_op(self.matcher, ("mig_begin", self.tenant,
-                                         self.src, self.dst))
+        t0 = time.perf_counter()
+        with trace.span("mesh.migrate.begin", tenant=self.tenant,
+                        src=self.src, dst=self.dst):
+            emit_migration_op(self.matcher, ("mig_begin", self.tenant,
+                                             self.src, self.dst))
+        STAGES.record("mesh.migrate.begin", time.perf_counter() - t0)
         self.state = "copying"
+        self._stamp("begin")
+        _inflight(self.matcher)[self.tenant] = self
         return self
 
     def _check_migratable_base(self) -> None:
@@ -365,30 +469,42 @@ class TenantMigration:
             raise RuntimeError(f"step() in state {self.state!r}")
         self._check_target()
         t0 = time.perf_counter()
-        chunk = reshard_chunk() if n is None else max(1, int(n))
+        from ..replication.records import encode_op
+        chunk = reshard_chunk() if n is None else max(1, n)
         trie = self.matcher.tries.get(self.tenant)
         emitted = 0
         with trace.span("mesh.migrate", tenant=self.tenant,
-                        src=self.src, dst=self.dst):
+                        src=self.src, dst=self.dst), \
+                trace.span("mesh.migrate.copy", tenant=self.tenant,
+                           chunk=self.chunks):
             try:
                 while self._cursor < len(self.pending) and emitted < chunk:
                     route = self.pending[self._cursor]
                     self._cursor += 1
                     if not _route_live(trie, route):
                         continue
-                    emit_migration_op(self.matcher, ("mig_copy", self.tenant,
-                                                     self.dst, route))
+                    op = ("mig_copy", self.tenant, self.dst, route)
+                    emit_migration_op(self.matcher, op)
                     emitted += 1
                     self.copied_n += 1
+                    self.bytes_copied += len(encode_op(op))
             except MigrationAborted:
                 raise
             except Exception as e:  # noqa: BLE001 — abort, never half-copy
                 self.abort(f"copy error: {e!r}")
                 raise MigrationAborted(self.abort_reason) from e
-        STAGES.record("mesh.migrate", time.perf_counter() - t0)
+        self.chunks += 1
+        dt = time.perf_counter() - t0
+        STAGES.record("mesh.migrate", dt)
+        STAGES.record("mesh.migrate.copy", dt)
         if self._cursor >= len(self.pending):
-            emit_migration_op(self.matcher, ("mig_ready", self.tenant))
+            t1 = time.perf_counter()
+            with trace.span("mesh.migrate.ready", tenant=self.tenant,
+                            rows=self.copied_n):
+                emit_migration_op(self.matcher, ("mig_ready", self.tenant))
+            STAGES.record("mesh.migrate.ready", time.perf_counter() - t1)
             self.state = "ready"
+            self._stamp("ready")
             return True
         return False
 
@@ -399,9 +515,14 @@ class TenantMigration:
         if self.state != "ready":
             raise RuntimeError(f"cutover() in state {self.state!r}")
         self._check_target()
-        emit_migration_op(self.matcher, ("mig_cutover", self.tenant,
-                                         self.src, self.dst))
+        t0 = time.perf_counter()
+        with trace.span("mesh.migrate.cutover", tenant=self.tenant,
+                        src=self.src, dst=self.dst):
+            emit_migration_op(self.matcher, ("mig_cutover", self.tenant,
+                                             self.src, self.dst))
+        STAGES.record("mesh.migrate.cutover", time.perf_counter() - t0)
         self.state = "cutover"
+        self._stamp("cutover")
         return self
 
     def finish(self) -> bool:
@@ -417,9 +538,15 @@ class TenantMigration:
         ring = self.matcher._ring
         if ring is not None and ring.in_flight > 0:
             return False
-        emit_migration_op(self.matcher, ("mig_tombstone", self.tenant,
-                                         self.src))
+        t0 = time.perf_counter()
+        with trace.span("mesh.migrate.tombstone", tenant=self.tenant,
+                        src=self.src):
+            emit_migration_op(self.matcher, ("mig_tombstone", self.tenant,
+                                             self.src))
+        STAGES.record("mesh.migrate.tombstone", time.perf_counter() - t0)
         self.state = "done"
+        self._stamp("tombstone")
+        self._retire("done")
         return True
 
     def run(self) -> "TenantMigration":

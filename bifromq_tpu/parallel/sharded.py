@@ -1056,12 +1056,13 @@ class MeshMatcher(TpuMatcher):
         """The ``GET /mesh`` / ``mesh.shard_load`` surface: shard map
         version, per-shard load rows (the same numbers the rebalancer
         scores), in-flight migrations, pins and replicas."""
-        from .reshard import ShardLoadModel
+        from .reshard import ShardLoadModel, migration_digest
         base = self._base_ct
         if not isinstance(base, ShardedTables):
             return {"n_replicas": self.n_replicas, "n_shards": self.n_shards,
                     "map_version": 0, "shard_load": [], "skew": 1.0,
-                    "migrating": {}, "pins": {}, "replicated": []}
+                    "migrating": {}, "migrations": migration_digest(self),
+                    "pins": {}, "replicated": []}
         model = ShardLoadModel()
         rows = model.rows(self)
         return {"n_replicas": self.n_replicas,
@@ -1071,6 +1072,9 @@ class MeshMatcher(TpuMatcher):
                 "skew": model.skew(rows),
                 "migrating": {t: st.digest()
                               for t, st in (base.migrating or {}).items()},
+                # ISSUE 18 leg 3: ladder progress + completed/aborted
+                # tallies (the mesh.migrations digest subfield)
+                "migrations": migration_digest(self),
                 "pins": dict(base.pins or {}),
                 "replicated": sorted(base.replicated or ())}
 

@@ -163,6 +163,10 @@ class EventType(enum.Enum):
     # noisy tenants shed first (ISSUE 7, repo-specific); QoS1/2 never
     # shed, they backpressure through the bounded ingest gate
     SHED_QOS0 = "shed_qos0"
+    # a standby's arena fingerprint disagreed with the leader's audit
+    # record at the same cursor (ISSUE 18, repo-specific): the continuous
+    # parity auditor caught replica divergence — one bounded resync heals
+    PARITY_DIVERGENCE = "parity_divergence"
 
 
 @dataclass

@@ -119,6 +119,13 @@ def encode_op(op: Tuple) -> bytes:
         _, tenant, dst, route = op
         return (b"C" + _len16(tenant.encode())
                 + struct.pack(">H", int(dst)) + _enc_route(route))
+    if op[0] == "audit":
+        # ISSUE 18 parity-audit record: (scope, blake2 hex, n_chunks);
+        # rides the stream as an ordinary HLC-stamped record so every
+        # standby compares at EXACTLY the leader's cursor
+        _, scope, fp_hex, n_chunks = op
+        return (b"D" + _len16(scope.encode()) + _len16(fp_hex.encode())
+                + struct.pack(">I", int(n_chunks)))
     tag = _MIG_TAGS.get(op[0])
     if tag is None:
         raise ValueError(f"unknown log op {op[0]!r}")
@@ -147,6 +154,10 @@ def decode_op(buf: bytes) -> Tuple:
         dst = struct.unpack_from(">H", buf, pos)[0]
         route, pos = _dec_route(buf, pos + 2)
         return ("mig_copy", tenant.decode(), dst, route)
+    if kind == b"D":
+        fp, pos = _read16(buf, pos)
+        (n_chunks,) = struct.unpack_from(">I", buf, pos)
+        return ("audit", tenant.decode(), fp.decode(), int(n_chunks))
     name = _MIG_KINDS.get(kind)
     if name is None:
         raise ValueError(f"unknown op tag {kind!r}")

@@ -30,12 +30,15 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import trace
 from ..models.automaton import PatchableTrie
+from ..obs.lag import LAG, REPL_EVENTS
+from ..plugin.events import Event, EventType
 from ..resilience.faults import get_injector
 from ..resilience.policy import (DEFAULT_RETRY_POLICY, deadline_scope,
                                  is_idempotent, remaining_budget)
 from ..rpc.fabric import _len16, _read16
 from ..utils import topic as topic_util
 from ..utils.env import env_float, env_int
+from ..utils.hlc import HLC
 from ..utils.metrics import REPLICATION, STAGES
 from . import register_puller, register_standby
 from .records import (BaseSnapshot, DeltaRecord, MeshBaseSnapshot,
@@ -66,6 +69,12 @@ def repl_reorder_cap() -> int:
     """Out-of-order records parked waiting for their predecessor before
     the applier gives up and resyncs."""
     return max(4, env_int("BIFROMQ_REPL_REORDER_CAP", 256))
+
+
+def _apply_lag_s(rec_hlc: int) -> float:
+    """HLC apply lag of one record at apply time, in seconds."""
+    return max(0.0, (HLC.physical(HLC.INST.get())
+                     - HLC.physical(rec_hlc)) / 1000.0)
 
 
 class WarmStandby:
@@ -105,6 +114,12 @@ class WarmStandby:
         self._ranges_fn = ranges_fn or self._rpc_ranges
         self._task: Optional[asyncio.Task] = None
         self._promoted = False
+        # ISSUE 18: optional IEventCollector for PARITY_DIVERGENCE; the
+        # divergence latch forces exactly one bounded resync per caught
+        # mismatch (offer() returns False once, then the flag clears)
+        self.events = None
+        self.parity_divergences = 0
+        self._divergence = False
         register_standby(self)
 
     # ---------------- lifecycle --------------------------------------------
@@ -122,13 +137,23 @@ class WarmStandby:
             except BaseException:  # noqa: BLE001 — cancellation
                 pass
 
-    def promote(self) -> "object":
+    def stale(self) -> bool:
+        """True while the lag plane flags this stream's apply lag over
+        ``BIFROMQ_REPL_LAG_STALE_S`` (hysteresis in ``obs.lag``)."""
+        return LAG.is_stale(self.origin or "?", self.range_id or "?")
+
+    def promote(self, force: bool = False) -> "object":
         """Failover: hand the replica matcher over as a serving/mutating
         matcher. Its arenas, tries and device tables are already warm —
         promotion is a flag flip, not a rebuild. The sync task is
         cancelled HERE: a still-running loop would resync from the old
         leader on its next tick (planned handover, partition heal) and
         clobber every post-promotion mutation.
+
+        ISSUE 18: a STALE standby (apply lag over the threshold) refuses
+        to promote without ``force=True`` — promoting it would serve a
+        matcher known to be behind the leader by more than the operator's
+        declared staleness budget.
 
         IDEMPOTENT + crash-safe (ISSUE 16 satellite): every step is
         individually re-runnable (cancel of a gone task is a no-op,
@@ -139,6 +164,14 @@ class WarmStandby:
         loop still racing it."""
         if self._promoted:
             return self.matcher
+        if self.stale() and not force:
+            log.warning("refusing to promote STALE standby %s/%s "
+                        "(apply lag over BIFROMQ_REPL_LAG_STALE_S); "
+                        "pass force=True to override",
+                        self.origin, self.range_id)
+            raise RuntimeError(
+                f"standby for range {self.range_id!r} is stale; "
+                f"promote(force=True) to override")
         task, self._task = self._task, None
         if task is not None:
             task.cancel()
@@ -178,6 +211,7 @@ class WarmStandby:
         if status != "ok":
             self.gaps += 1
             REPLICATION.inc("gaps")
+            LAG.note_gap(self.origin or "?", self.range_id or "?")
             self.attached = False
             return
         if records:
@@ -193,6 +227,7 @@ class WarmStandby:
         self.origin = origin
         self.resyncs += 1
         REPLICATION.inc("resyncs")
+        LAG.note_resync(self.origin or "?", self.range_id or "?")
 
     # ---------------- record application -----------------------------------
 
@@ -205,6 +240,8 @@ class WarmStandby:
         applied0 = self.applied
         with trace.span("repl.apply", n_records=len(records)):
             ok = self._offer_inner(records)
+        LAG.set_occupancy(self.origin or "?", self.range_id or "?",
+                          len(self._pending))
         if self.applied != applied0:
             STAGES.record("repl.apply", time.perf_counter() - t0)
             self._flush_device()
@@ -224,6 +261,13 @@ class WarmStandby:
                     nxt = self._pending.pop(self.cursor[1] + 1)
                     self._apply(nxt)
                     self.cursor = (nxt.epoch, nxt.seq)
+                if self._divergence:
+                    # a parity-audit mismatch: stop applying and demand
+                    # ONE bounded resync (the latch clears here so the
+                    # next mismatch — if any — costs one more, never a
+                    # resync storm)
+                    self._divergence = False
+                    return False
             else:
                 self._pending[rec.seq] = rec
                 self.reorders += 1
@@ -237,6 +281,15 @@ class WarmStandby:
         m = self.matcher
         base = m._base_ct
         mesh = base is not None and hasattr(base, "compiled")
+        if rec.op is not None and rec.op[0] == "audit":
+            # ISSUE 18: the leader's parity fingerprint at THIS cursor —
+            # compare against our own arenas, never patch anything
+            self._audit_compare(rec)
+            self.applied += 1
+            REPLICATION.inc("applied")
+            LAG.observe(self.origin or "?", self.range_id or "?",
+                        _apply_lag_s(rec.hlc))
+            return
         if rec.op is not None and mesh:
             # ISSUE 17: elastic-mesh control ops replay through the ONE
             # migration-op definition — same idempotent patch calls at
@@ -251,6 +304,8 @@ class WarmStandby:
                 apply_migration_op(m, rec.op)
                 self.applied += 1
                 REPLICATION.inc("applied")
+                LAG.observe(self.origin or "?", self.range_id or "?",
+                            _apply_lag_s(rec.hlc))
                 return
         if rec.plan is not None and isinstance(base, PatchableTrie):
             base.apply_plan(rec.plan)
@@ -283,6 +338,34 @@ class WarmStandby:
             m.match_cache.invalidate(rec.tenant, rec.filter_levels)
         self.applied += 1
         REPLICATION.inc("applied")
+        LAG.observe(self.origin or "?", self.range_id or "?",
+                    _apply_lag_s(rec.hlc))
+
+    def _audit_compare(self, rec: DeltaRecord) -> None:
+        from ..obs.audit import fingerprint_scope
+        _, scope, want_fp, _n_chunks = rec.op
+        got = fingerprint_scope(self.matcher, scope)
+        if got is None or got[0] == want_fp:
+            return
+        self._divergence = True
+        self.parity_divergences += 1
+        REPLICATION.inc("parity_divergence_total")
+        REPL_EVENTS.append("parity_divergence",
+                           origin=self.origin or "?",
+                           range=self.range_id or "?", scope=scope,
+                           want=want_fp, got=got[0], seq=rec.seq)
+        log.warning("parity divergence on %s/%s scope=%s at seq %d — "
+                    "resyncing", self.origin, self.range_id, scope,
+                    rec.seq)
+        events = self.events
+        if events is not None:
+            try:
+                events.report(Event(EventType.PARITY_DIVERGENCE, "", {
+                    "origin": self.origin, "range": self.range_id,
+                    "scope": scope, "seq": rec.seq,
+                    "want": want_fp, "got": got[0]}))
+            except Exception:  # noqa: BLE001 — telemetry must not raise
+                pass
 
     def _flush_device(self) -> None:
         # ship the applied rows to this replica's device as the same
@@ -507,8 +590,10 @@ class WarmStandby:
                 "origin": self.origin, "attached": self.attached,
                 "epoch": self.cursor[0], "seq": self.cursor[1],
                 "head_seq": self.head[1], "lag": self.lag(),
+                "stale": self.stale(),
                 "applied": self.applied, "resyncs": self.resyncs,
                 "gaps": self.gaps, "reorders": self.reorders,
+                "parity_divergences": self.parity_divergences,
                 "rebuilds": self.matcher.compile_count,
                 "overlay": self.matcher.overlay_size}
 
@@ -549,6 +634,11 @@ class RetainedStandby:
         self.applied = 0
         self.resyncs = 0
         self.gaps = 0
+        # ISSUE 18 (see WarmStandby): divergence latch + optional
+        # event collector; the lag plane keys this stream "retained"
+        self.events = None
+        self.parity_divergences = 0
+        self._divergence = False
         self._task: Optional[asyncio.Task] = None
         self._promoted = False
         register_standby(self)
@@ -568,14 +658,24 @@ class RetainedStandby:
             except BaseException:  # noqa: BLE001 — cancellation
                 pass
 
-    def promote(self):
+    def stale(self) -> bool:
+        return LAG.is_stale("retained", "retained")
+
+    def promote(self, force: bool = False):
         """Failover: hand the warm replica index over for serving.
         Idempotent + crash-safe exactly like
         :meth:`WarmStandby.promote` — the latch sets only after every
         step ran; the chaos hook between task-cancel and the flag flip
-        models the mid-promote crash."""
+        models the mid-promote crash. ISSUE 18: refuses while the lag
+        plane flags this stream stale, unless ``force=True``."""
         if self._promoted:
             return self.index
+        if self.stale() and not force:
+            log.warning("refusing to promote STALE retained standby "
+                        "(apply lag over BIFROMQ_REPL_LAG_STALE_S); "
+                        "pass force=True to override")
+            raise RuntimeError("retained standby is stale; "
+                               "promote(force=True) to override")
         task, self._task = self._task, None
         if task is not None:
             task.cancel()
@@ -608,6 +708,7 @@ class RetainedStandby:
             # the same degradation ladder as the route standby
             self.gaps += 1
             REPLICATION.inc("gaps")
+            LAG.note_gap("retained", "retained")
             self.attached = False
             return
         if records:
@@ -619,6 +720,7 @@ class RetainedStandby:
         self._install(snap, epoch, seq)
         self.resyncs += 1
         REPLICATION.inc("resyncs")
+        LAG.note_resync("retained", "retained")
 
     # ---------------- record application -----------------------------------
 
@@ -628,29 +730,66 @@ class RetainedStandby:
         individually idempotent — a replayed SET lands "exists"); a
         sequence gap inside the batch demands a resync."""
         applied0 = self.applied
+        ok = True
         for rec in records:
             seq = int(rec[0])
             if seq <= self.cursor[1]:
                 continue    # idempotent re-delivery
             if seq != self.cursor[1] + 1:
-                return False
+                ok = False
+                break
             self._apply(rec)
             self.cursor = (self.cursor[0], seq)
+            if self._divergence:
+                # parity-audit mismatch: ONE bounded resync (latch
+                # clears here — see WarmStandby._offer_inner)
+                self._divergence = False
+                ok = False
+                break
         if self.applied != applied0:
             # ship the patched rows to this replica's device as the
             # same narrow scatters the leader used
             self.index.flush_device()
-        return True
+        return ok
 
     def _apply(self, rec) -> None:
         _seq, _hlc, tenant, levels, op = rec
-        topic = topic_util.DELIMITER.join(levels)
-        if op == "set":
+        if op.startswith("audit:"):
+            # ISSUE 18: leader's retained parity fingerprint at THIS
+            # cursor — compare, never mutate the index
+            self._audit_compare(op, seq=int(_seq))
+        elif op == "set":
+            topic = topic_util.DELIMITER.join(levels)
             self.index.add_topic(tenant, list(levels), topic)
         else:
+            topic = topic_util.DELIMITER.join(levels)
             self.index.remove_topic(tenant, list(levels), topic)
         self.applied += 1
         REPLICATION.inc("applied")
+        LAG.observe("retained", "retained", _apply_lag_s(int(_hlc)))
+
+    def _audit_compare(self, op: str, *, seq: int) -> None:
+        from ..obs.audit import fingerprint_retained
+        _, want_fp, _n_chunks = op.split(":", 2)
+        got_fp, _ = fingerprint_retained(self.index)
+        if got_fp == want_fp:
+            return
+        self._divergence = True
+        self.parity_divergences += 1
+        REPLICATION.inc("parity_divergence_total")
+        REPL_EVENTS.append("parity_divergence", origin="retained",
+                           range="retained", scope="retained",
+                           want=want_fp, got=got_fp, seq=seq)
+        log.warning("retained parity divergence at seq %d — resyncing",
+                    seq)
+        events = self.events
+        if events is not None:
+            try:
+                events.report(Event(EventType.PARITY_DIVERGENCE, "", {
+                    "scope": "retained", "seq": seq,
+                    "want": want_fp, "got": got_fp}))
+            except Exception:  # noqa: BLE001 — telemetry must not raise
+                pass
 
     def _install(self, snap, epoch: int, seq: int) -> None:
         from ..models.automaton import _next_pow2
@@ -704,7 +843,8 @@ class RetainedStandby:
         return {"role": "retained-standby", "attached": self.attached,
                 "epoch": self.cursor[0], "seq": self.cursor[1],
                 "applied": self.applied, "resyncs": self.resyncs,
-                "gaps": self.gaps,
+                "gaps": self.gaps, "stale": self.stale(),
+                "parity_divergences": self.parity_divergences,
                 "rebuilds": self.index.rebuilds,
                 "patch_fallbacks": self.index.patch_fallbacks}
 
@@ -804,13 +944,17 @@ class StandbySupervisor:
             await sb.stop()
             self.retired += 1
 
-    def promote_all(self) -> Dict[str, object]:
+    def promote_all(self, force: bool = False) -> Dict[str, object]:
         """Failover: every applier's sync loop is cancelled and its warm
-        matcher handed back, keyed by range id."""
+        matcher handed back, keyed by range id. ``force`` passes through
+        to each per-range ``promote()`` (ISSUE 18 stale refusal)."""
         task, self._task = self._task, None
         if task is not None:
             task.cancel()
-        return {rid: sb.promote() for rid, sb in self.standbys.items()}
+        # Plain promote() unless forcing: duck-typed standbys need not
+        # grow the force parameter to keep working under a supervisor.
+        return {rid: (sb.promote(force=True) if force else sb.promote())
+                for rid, sb in self.standbys.items()}
 
     def lag(self) -> Dict[str, int]:
         return {rid: sb.lag() for rid, sb in self.standbys.items()}
@@ -905,6 +1049,7 @@ class InvalidationPuller:
             # wholesale semantics, immediately
             self.losses += 1
             REPLICATION.inc("gaps")
+            LAG.note_gap("inval", ep)
             self.invalidate_cb(None, None)
         for _ in range(n_invals):
             tenant, pos = _read16(out, pos)
@@ -913,6 +1058,9 @@ class InvalidationPuller:
                                tuple(filt.decode().split("/")))
             self.invalidations += 1
             REPLICATION.inc("invalidations")
+        if n_invals:
+            # inval records carry no HLC stamp: throughput-only feed
+            LAG.note_applied("inval", ep, n_invals)
 
     def status(self) -> dict:
         return {"role": "inval-puller", "service": self.service,

@@ -25,6 +25,7 @@ from collections import deque
 from itertools import islice
 from typing import Dict, List, Optional, Tuple
 
+from ..obs.lag import LAG
 from ..utils.env import env_int
 from ..utils.hlc import HLC
 from ..utils.metrics import REPLICATION
@@ -71,6 +72,10 @@ class DeltaLog:
             self.next_seq += 1
             self._records.append(rec)
         REPLICATION.inc("records")
+        # leader-side emit throughput for the ISSUE 18 lag plane — the
+        # consumer side of the same (origin, range) stream feeds the
+        # apply half, so the GET /replication/lag delta is visible
+        LAG.note_emit(self.origin, self.range_id)
         return rec
 
     def anchor(self, salt, reason: str) -> None:

@@ -148,6 +148,10 @@ class RetainedDeltaLog:
                  tuple(topic_levels), op))
             self.next_seq += 1
         REPLICATION.inc("records")
+        # ISSUE 18 lag plane: the RetainedStandby applies under the same
+        # fixed "retained" stream key
+        from ..obs.lag import LAG
+        LAG.note_emit("retained", "retained")
 
     def since(self, after_seq: int) -> Tuple[str, List[tuple]]:
         with self._lock:

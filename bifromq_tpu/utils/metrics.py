@@ -107,6 +107,14 @@ KNOWN_STAGES = frozenset({
     "retain.scan",      # ISSUE 13: retained wildcard scan batch (SUBSCRIBE)
     "inbox.drain",      # ISSUE 13: persistent-session catch-up drain
     "mesh.migrate",     # ISSUE 17: live-migration copy chunks + resize
+    "repl.audit",       # ISSUE 18: leader parity-fingerprint fold + emit
+    # ISSUE 18: per-rung migration-ladder timing (the aggregate
+    # mesh.migrate histogram stays — dashboards keyed on it survive)
+    "mesh.migrate.begin",
+    "mesh.migrate.copy",
+    "mesh.migrate.ready",
+    "mesh.migrate.cutover",
+    "mesh.migrate.tombstone",
 })
 
 
@@ -273,7 +281,9 @@ class ReplicationMetrics:
     # NOTE: not named _FIELDS — graftcheck R5 pins that name to the
     # MATCH_CACHE field registry when parsing this module's AST
     _COUNTERS = ("records", "applied", "invalidations", "anchors",
-                 "resyncs", "gaps", "reorders")
+                 "resyncs", "gaps", "reorders",
+                 # ISSUE 18: parity-audit mismatches caught by a standby
+                 "parity_divergence_total")
 
     def __init__(self) -> None:
         self._counts: Dict[str, int] = dict.fromkeys(self._COUNTERS, 0)

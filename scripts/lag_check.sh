@@ -1,0 +1,227 @@
+#!/usr/bin/env bash
+# Tier-2 delta-plane observability gate (ISSUE 18): the lag plane, the
+# continuous parity auditor, and the unattended autoscaler, end to end
+# on a live leader + standby over the real delta stream. Asserts:
+#   1. LAG VISIBILITY — a churn storm applied with an artificially aged
+#      HLC makes per-stream apply lag visible (stream flagged stale),
+#      and draining back to live-stamped records returns lag to ~0 and
+#      clears the flag only after the full hysteresis window,
+#   2. PARITY AUDIT — an injected single-byte arena corruption on the
+#      standby is caught within ONE audit interval and healed by
+#      EXACTLY one bounded resync: zero full rebuilds, zero match-cache
+#      generation bumps,
+#   3. AUTOSCALER — sustained synthetic pressure on a real 4-shard mesh
+#      grows it unattended (K consecutive ticks), the quiet window
+#      shrinks it back, and no second action lands inside the cooldown.
+# Runs on CPU (JAX_PLATFORMS=cpu), hard timeout like the other gates.
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+
+timeout -k 10 "${LAG_CHECK_TIMEOUT:-420}" \
+    env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python - <<'EOF'
+import asyncio, os, random
+
+from bifromq_tpu.models.matcher import TpuMatcher
+from bifromq_tpu.models.oracle import Route
+from bifromq_tpu.obs.audit import ParityAuditor, fingerprint_scope
+from bifromq_tpu.obs.lag import LAG, REPL_EVENTS
+from bifromq_tpu.replication import records as R
+from bifromq_tpu.replication.standby import WarmStandby
+from bifromq_tpu.replication.stream import DeltaLog
+from bifromq_tpu.types import RouteMatcher
+from bifromq_tpu.utils.hlc import HLC
+
+N_OPS = int(os.environ.get("LAG_CHECK_OPS", "300"))
+os.environ.setdefault("BIFROMQ_REPL_LAG_STALE_S", "2.0")
+
+
+def rt(tf, i):
+    return Route(matcher=RouteMatcher.from_topic_filter(tf), broker_id=0,
+                 receiver_id=f"rcv{i}", deliverer_key=f"d{i}",
+                 incarnation=0)
+
+
+def make_leader(n=60):
+    leader = TpuMatcher(auto_compact=False)
+    log = DeltaLog("n0", "r0")
+    leader.on_delta = lambda t, f, op, plan, fb: log.append(
+        tenant=t, filter_levels=f, op=op, plan=plan, fallback=fb)
+    leader.on_rebase = lambda salt, reason: log.anchor(salt, reason)
+    for i in range(n):
+        leader.add_route("T", rt(f"s/{i}/t", i))
+    leader.refresh()
+    return leader, log
+
+
+def wire(recs):
+    return [R.decode_record(r.encoded())[0] for r in recs]
+
+
+async def main():
+    random.seed(11)
+
+    # ---- 1. lag visibility under a churn storm --------------------------
+    leader, log = make_leader()
+
+    async def fetch(_rid, epoch, seq, _timeout):
+        status, recs = log.since(epoch, seq)
+        return status, wire(recs), log.cursor()
+
+    async def base(_rid):
+        return "n0", log.cursor(), R.decode_base(
+            R.encode_base(leader._base_ct, leader.tries))
+
+    sb = WarmStandby(matcher=TpuMatcher(auto_compact=False),
+                     range_id="r0", fetch_fn=fetch, base_fn=base)
+    await sb.sync_once()
+    assert sb.attached and sb.resyncs == 1, "initial resync"
+
+    # churn storm whose records the standby applies LATE: age every
+    # record's HLC stamp by rewriting it 5 s into the past
+    AGE_MS = 5000
+    for i in range(N_OPS):
+        leader.add_route("T", rt(f"storm/{i}/t", 1000 + i))
+    status, recs = log.since(*sb.cursor)
+    assert status == "ok"
+    aged = []
+    for rec in wire(recs):
+        rec.hlc = HLC.INST.get() - (AGE_MS << 16)
+        aged.append(rec)
+    assert sb.offer(aged)
+    snap = LAG.snapshot()
+    (stream,) = [s for s in snap["streams"] if s["range"] == "r0"]
+    assert stream["lag_s"] > 2.0, f"storm lag visible: {stream}"
+    assert stream["stale"] and sb.stale(), "stream flagged stale"
+    assert stream["applied_window"] >= N_OPS
+    print(f"[lag_check] 1. churn storm: lag={stream['lag_s']:.2f}s "
+          f"stale={stream['stale']} applied={stream['applied_window']}")
+
+    # stale: promote refuses, force overrides (without promoting here)
+    try:
+        sb.promote()
+        raise SystemExit("stale promote must refuse without force")
+    except RuntimeError:
+        pass
+
+    # drain back to live-stamped records → lag ~0, flag clears after
+    # the full hysteresis window (fresh applies spaced past it)
+    import time as _time
+    deadline = _time.monotonic() + 30.0
+    while sb.stale() and _time.monotonic() < deadline:
+        leader.add_route("T", rt(f"live/{random.random()}", 2000))
+        status, recs = log.since(*sb.cursor)
+        assert sb.offer(wire(recs))
+        await asyncio.sleep(0.25)
+    (stream,) = [s for s in LAG.snapshot()["streams"]
+                 if s["range"] == "r0"]
+    assert not stream["stale"], "flag cleared after quiet window"
+    assert stream["lag_s"] < 1.0, f"lag drained: {stream['lag_s']}"
+    assert sb.promote() is sb.matcher, "fresh standby promotes"
+    print(f"[lag_check] 1. drained: lag={stream['lag_s']:.3f}s "
+          f"stale={stream['stale']}")
+
+    # ---- 2. injected corruption → one audit interval → one resync -------
+    leader, log = make_leader()
+
+    async def fetch2(_rid, epoch, seq, _timeout):
+        status, recs = log.since(epoch, seq)
+        return status, wire(recs), log.cursor()
+
+    async def base2(_rid):
+        return "n0", log.cursor(), R.decode_base(
+            R.encode_base(leader._base_ct, leader.tries))
+
+    sb2 = WarmStandby(matcher=TpuMatcher(auto_compact=False),
+                      range_id="r0", fetch_fn=fetch2, base_fn=base2)
+    await sb2.sync_once()
+    compile0 = sb2.matcher.compile_count
+    gen0 = sb2.matcher.match_cache._gen
+    auditor = ParityAuditor(leader)
+
+    sb2.matcher._base_ct.node_tab[0, 0] ^= 1       # ONE corrupted byte
+    auditor.audit_once()                           # next audit interval
+    await sb2.sync_once()
+    assert sb2.parity_divergences == 1 and not sb2.attached, \
+        "caught within one audit interval"
+    await sb2.sync_once()                          # heals
+    assert sb2.attached and sb2.resyncs == 2, "exactly one resync"
+    auditor.audit_once()
+    await sb2.sync_once()
+    assert sb2.parity_divergences == 1 and sb2.resyncs == 2, \
+        "no resync storm"
+    assert sb2.matcher.compile_count == compile0, "zero rebuilds"
+    assert sb2.matcher.match_cache._gen == gen0, "zero generation bumps"
+    assert fingerprint_scope(sb2.matcher, "route") \
+        == fingerprint_scope(leader, "route"), "arenas re-converged"
+    n_div = sum(1 for r in REPL_EVENTS.tail(10_000)
+                if r["kind"] == "parity_divergence")
+    assert n_div == 1, f"one divergence event, got {n_div}"
+    print(f"[lag_check] 2. corruption caught+healed: divergences="
+          f"{sb2.parity_divergences} resyncs={sb2.resyncs} "
+          f"compiles={sb2.matcher.compile_count - compile0}")
+
+    # ---- 3. autoscaler: grow unattended, shrink after quiet -------------
+    os.environ["BIFROMQ_MESH_AUTOSCALE_K"] = "3"
+    os.environ["BIFROMQ_MESH_AUTOSCALE_QUIET_S"] = "10"
+    os.environ["BIFROMQ_MESH_AUTOSCALE_COOLDOWN_S"] = "5"
+    from bifromq_tpu.parallel.autoscale import MeshAutoscaler
+    from bifromq_tpu.parallel.sharded import MeshMatcher, make_mesh
+
+    m = MeshMatcher(mesh=make_mesh(1, 4), max_levels=8, k_states=16,
+                    auto_compact=False, match_cache=False)
+    for i in range(24):
+        m.add_route(f"t{i % 6}", rt(f"s/{i}/t", i))
+    m.refresh()
+    n0 = m._base_ct.n_shards
+    t = [0.0]
+    state = {"pressure": 0.99}
+
+    def signals():
+        return {"skew": 1.0, "pressure": state["pressure"],
+                "n_shards": m._base_ct.n_shards,
+                "migrating": len(m._base_ct.migrating or {}),
+                "stale_streams": 0, "worst_lag_s": 0.0}
+
+    class NoMove:
+        def plan(self): return None
+        def step(self): raise AssertionError("unreachable")
+
+    a = MeshAutoscaler(m, rebalancer=NoMove(), signals_fn=signals,
+                       clock=lambda: t[0])
+    for _ in range(3):
+        a.tick()
+        t[0] += 0.5
+    assert m._base_ct.n_shards == n0 + 1, "grew unattended after K ticks"
+    grew_at = a.actions
+    assert grew_at == 1
+    # sustained pressure INSIDE the cooldown: re-arms but never acts
+    for _ in range(6):
+        a.tick()
+        t[0] += 0.5
+    assert a.actions == 1 and m._base_ct.n_shards == n0 + 1, \
+        "no flapping inside cooldown"
+    # pressure subsides → quiet window → unattended shrink
+    state["pressure"] = 0.0
+    t[0] += 6.0
+    a.tick()                                      # quiet window opens
+    t[0] += 11.0
+    d = a.tick()
+    assert d["acted"] and d["action"] == "shrink", d
+    assert m._base_ct.n_shards == n0, "shrank back after quiet window"
+    assert all("signals" in x for x in a.decisions), "provenance"
+    print(f"[lag_check] 3. autoscaler: grow@{n0}->{n0 + 1}, "
+          f"shrink->{m._base_ct.n_shards}, actions={a.actions}, "
+          f"decisions={len(a.decisions)}")
+    print("[lag_check] PASS")
+
+
+asyncio.run(main())
+EOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "[lag_check] FAIL (rc=$rc)"
+    exit $rc
+fi

@@ -513,3 +513,41 @@ class TestCapacityProfileAPI:
             status, out1, _ = await http_with_headers(
                 api.port, "GET", "/cluster/tenants?top_k=1")
             assert len(out1["tenants"]) == 1
+
+
+class TestDeltaPlaneEndpoints:
+    """ISSUE 18 surfaces: the lag plane, the migration ladder and the
+    autoscaler decision ring over real HTTP."""
+
+    async def test_replication_lag_endpoint(self, stack):
+        from bifromq_tpu.obs.lag import LAG, REPL_EVENTS
+        _, api, _ = stack
+        LAG.reset()
+        REPL_EVENTS.reset()
+        try:
+            LAG.observe("n0", "r0", 0.25)
+            LAG.note_gap("n0", "r0")
+            status, out = await http(api.port, "GET", "/replication/lag")
+            assert status == 200
+            assert out["stale"] == 0
+            (s,) = out["streams"]
+            assert s["origin"] == "n0" and s["range"] == "r0"
+            assert s["lag_s"] == 0.25 and s["gaps"] == 1
+            kinds = [e["kind"] for e in out["events"]]
+            assert "gap" in kinds
+            status, out = await http(api.port, "GET",
+                                     "/replication/lag?events=0")
+            assert status == 200 and out["events"] == []
+        finally:
+            LAG.reset()
+            REPL_EVENTS.reset()
+
+    async def test_mesh_migrations_404_on_single_chip(self, stack):
+        _, api, _ = stack
+        status, _ = await http(api.port, "GET", "/mesh/migrations")
+        assert status == 404
+
+    async def test_mesh_autoscaler_404_without_scaler(self, stack):
+        _, api, _ = stack
+        status, _ = await http(api.port, "GET", "/mesh/autoscaler")
+        assert status == 404

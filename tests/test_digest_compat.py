@@ -1,0 +1,159 @@
+"""Digest extension compat (ISSUE 18 satellite): the new
+``replication`` (lag summary) and ``mesh.migrations`` digest fields
+ride the PR 8 delta encoder, and a LEGACY peer — one that predates the
+fields — keeps decoding without gaps (the dict-merge decoder ignores
+unknown fields by construction)."""
+
+import pytest
+
+from bifromq_tpu.obs import ObsHub
+from bifromq_tpu.obs.clusterview import ClusterView
+from bifromq_tpu.obs.lag import LAG, REPL_EVENTS
+from bifromq_tpu.utils.hlc import HLC
+
+pytestmark = pytest.mark.asyncio
+
+
+class FakeHost:
+    def __init__(self, node_id="me"):
+        self.node_id = node_id
+        self.agent_meta = {}
+        self.members = {}
+        self._listeners = []
+
+    def agent_members(self, agent_id):
+        return dict(self.agent_meta)
+
+    def host_agent(self, agent_id, meta=None):
+        self.agent_meta[self.node_id] = meta or {}
+
+    def stop_agent(self, agent_id):
+        self.agent_meta.pop(self.node_id, None)
+
+    def on_change(self, cb):
+        self._listeners.append(cb)
+
+
+@pytest.fixture(autouse=True)
+def _clean_lag_plane():
+    LAG.reset()
+    REPL_EVENTS.reset()
+    yield
+    LAG.reset()
+    REPL_EVENTS.reset()
+
+
+def _view(host=None, **kw):
+    hub = ObsHub()
+    hub.enabled = True
+    return ClusterView("me", host or FakeHost("me"),
+                       rpc_address="127.0.0.1:7000", api_port=8080,
+                       hub=hub, **kw)
+
+
+def _legacy_digest(**over):
+    """A digest as a pre-ISSUE-18 node publishes it: no replication
+    field, no mesh.migrations subfield."""
+    d = {"v": 1, "hlc": HLC.INST.get(), "breakers": {},
+         "device": {"dispatch_queue_depth": 0, "batches_in_flight": 0,
+                    "compile_count": 0, "mem_peak_bytes": 0},
+         "match_cache_hit_rate": 0.0, "noisy": []}
+    d.update(over)
+    return d
+
+
+class TestPublisher:
+    async def test_replication_field_omitted_when_no_streams(self):
+        d = _view().build_digest()
+        assert "replication" not in d
+
+    async def test_replication_field_rides_digest(self):
+        LAG.observe("n0", "r0", 0.25)
+        LAG.observe("n1", "r1", 99.0)        # stale
+        d = _view().build_digest()
+        assert d["replication"] == {"streams": 2, "stale": 1,
+                                    "worst_lag_s": 99.0}
+
+    async def test_migrations_subfield_rides_mesh_field(self, monkeypatch):
+        from bifromq_tpu.obs import clusterview
+
+        def fake_snapshot():
+            return [{"skew": 1.2, "map_version": 3, "migrating": {},
+                     "shard_load": [{"score": 1.0}],
+                     "migrations": {"active": 1, "pct": 40.0,
+                                    "completed": 2, "aborted": 0}}]
+
+        monkeypatch.setattr(clusterview, "ClusterView",
+                            clusterview.ClusterView)
+        from bifromq_tpu import obs
+        monkeypatch.setattr(obs.OBS, "mesh_snapshot", fake_snapshot)
+        d = _view().build_digest()
+        assert d["mesh"]["migrations"]["active"] == 1
+        assert d["mesh"]["migrations"]["pct"] == 40.0
+
+    async def test_changed_lag_rides_the_delta(self):
+        """The new field is delta-encoded like any other: a full
+        publish, then a lag change, and the delta carries ONLY the
+        changed sections (hlc + replication)."""
+        host = FakeHost("me")
+        view = _view(host, full_every=5)
+        LAG.observe("n0", "r0", 0.25)
+        view.refresh()                       # tick 1: full
+        assert "replication" in host.agent_meta["me"]["digest"]
+        view.refresh()                       # tick 2: delta, lag steady
+        meta = host.agent_meta["me"]
+        assert "digest" not in meta
+        # steady vs the base full → the field stays OUT of the delta
+        assert "replication" not in meta["digest_delta"]
+        LAG.observe("n0", "r0", 1.5)         # worst_lag_s changes
+        view.refresh()                       # tick 3: delta carries it
+        delta = host.agent_meta["me"]["digest_delta"]
+        assert delta["replication"]["worst_lag_s"] == 1.5
+
+
+class TestLegacyPeers:
+    async def test_new_decoder_accepts_legacy_digest(self):
+        """A pre-ISSUE-18 peer's digest (no replication/migrations)
+        decodes and serves — the fields are optional everywhere."""
+        host = FakeHost("me")
+        view = _view(host)
+        host.agent_meta["old-node"] = {"addr": "127.0.0.1:6000",
+                                       "seq": 1,
+                                       "digest": _legacy_digest()}
+        p = view.peers()["old-node"]
+        assert p["digest"]["v"] == 1
+        assert "replication" not in p["digest"]
+        assert view.digest_gaps == 0
+
+    async def test_legacy_decoder_ignores_unknown_fields(self):
+        """The other direction: OUR digest lands at a peer whose decoder
+        predates ISSUE 18. The decoder is a dict merge over top-level
+        fields — unknown keys pass through untouched and nothing the old
+        node reads changes, so the new fields are wire-compatible."""
+        host = FakeHost("me")
+        view = _view(host)               # plays the OLD node
+        new = _legacy_digest()
+        new["replication"] = {"streams": 3, "stale": 0,
+                              "worst_lag_s": 0.1}
+        new["mesh"] = {"skew": 1.1, "map_version": 2, "migrating": 0,
+                       "shard_load": [1.0],
+                       "migrations": {"active": 0, "pct": 100.0,
+                                      "completed": 1, "aborted": 0}}
+        host.agent_meta["new-node"] = {"addr": "127.0.0.1:6001",
+                                       "seq": 1, "digest": new}
+        p = view.peers()["new-node"]
+        # everything the legacy consumer DOES read is intact
+        assert p["digest"]["match_cache_hit_rate"] == 0.0
+        assert p["digest"]["breakers"] == {}
+        assert view.digest_gaps == 0
+        # a delta that ONLY touches the new fields still applies clean
+        host.agent_meta["new-node"] = {
+            "addr": "127.0.0.1:6001", "seq": 2, "base_seq": 1,
+            "digest_delta": {"hlc": HLC.INST.get(),
+                             "replication": {"streams": 3, "stale": 1,
+                                             "worst_lag_s": 9.9}}}
+        p = view.peers()["new-node"]
+        assert p["digest"]["replication"]["stale"] == 1
+        assert p["digest"]["match_cache_hit_rate"] == 0.0
+        assert view.digest_deltas_applied == 1
+        assert view.digest_gaps == 0

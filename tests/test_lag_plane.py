@@ -1,0 +1,173 @@
+"""Replication lag plane (ISSUE 18): per-stream apply-lag histograms,
+stale-flag hysteresis under a fake clock, the stale-standby promote
+refusal, and the bounded delta-plane event journal."""
+
+import pytest
+
+from bifromq_tpu.models.matcher import TpuMatcher
+from bifromq_tpu.models.oracle import Route
+from bifromq_tpu.obs.lag import LAG, REPL_EVENTS, EventJournal, LagPlane
+from bifromq_tpu.replication import records as R
+from bifromq_tpu.replication.standby import WarmStandby
+from bifromq_tpu.replication.stream import DeltaLog
+from bifromq_tpu.types import RouteMatcher
+
+
+def rt(f, i):
+    return Route(matcher=RouteMatcher.from_topic_filter(f),
+                 broker_id=0, receiver_id=f"rcv{i}",
+                 deliverer_key=f"d{i}", incarnation=0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_lag_plane():
+    LAG.reset()
+    REPL_EVENTS.reset()
+    yield
+    LAG.reset()
+    REPL_EVENTS.reset()
+
+
+class TestHysteresis:
+    """The stale flag pins the ISSUE 18 contract: set on the first
+    over-threshold apply, cleared only after a FULL threshold-wide
+    window of under-threshold applies."""
+
+    def _plane(self):
+        t = [0.0]
+        plane = LagPlane(clock=lambda: t[0])
+        return plane, t
+
+    def test_over_threshold_sets_stale(self, monkeypatch):
+        monkeypatch.setenv("BIFROMQ_REPL_LAG_STALE_S", "5.0")
+        plane, _t = self._plane()
+        plane.observe("n0", "r0", 0.1)
+        assert not plane.is_stale("n0", "r0")
+        plane.observe("n0", "r0", 10.0)
+        assert plane.is_stale("n0", "r0")
+        assert ("n0", "r0") in plane.stale_streams()
+
+    def test_oscillating_stream_stays_stale(self, monkeypatch):
+        monkeypatch.setenv("BIFROMQ_REPL_LAG_STALE_S", "5.0")
+        plane, t = self._plane()
+        plane.observe("n0", "r0", 10.0)
+        assert plane.is_stale("n0", "r0")
+        # under-threshold applies arriving WITHIN the 5s quiet window
+        # never clear the flag...
+        for _ in range(8):
+            t[0] += 2.0
+            plane.observe("n0", "r0", 0.5)
+            # ...because each re-over resets the window
+            t[0] += 2.0
+            plane.observe("n0", "r0", 9.0)
+            assert plane.is_stale("n0", "r0")
+
+    def test_full_quiet_window_clears(self, monkeypatch):
+        monkeypatch.setenv("BIFROMQ_REPL_LAG_STALE_S", "5.0")
+        plane, t = self._plane()
+        plane.observe("n0", "r0", 10.0)
+        t[0] += 4.9
+        plane.observe("n0", "r0", 0.1)
+        assert plane.is_stale("n0", "r0")   # 4.9s quiet: not enough
+        t[0] += 0.2
+        plane.observe("n0", "r0", 0.1)      # 5.1s since last over
+        assert not plane.is_stale("n0", "r0")
+
+    def test_stale_transitions_journal(self, monkeypatch):
+        monkeypatch.setenv("BIFROMQ_REPL_LAG_STALE_S", "5.0")
+        t = [0.0]
+        plane = LagPlane(clock=lambda: t[0])
+        plane.observe("n0", "r0", 10.0)
+        t[0] += 6.0
+        plane.observe("n0", "r0", 0.1)
+        kinds = [r["kind"] for r in REPL_EVENTS.tail()]
+        assert kinds == ["lag_stale", "lag_fresh"]
+
+    def test_snapshot_fields(self, monkeypatch):
+        monkeypatch.setenv("BIFROMQ_REPL_LAG_STALE_S", "5.0")
+        plane, _t = self._plane()
+        plane.observe("n0", "r0", 0.25)
+        plane.note_emit("n0", "r0")
+        plane.set_occupancy("n0", "r0", 3)
+        plane.note_gap("n0", "r0")
+        plane.note_resync("n0", "r0")
+        snap = plane.snapshot()
+        assert snap["stale_threshold_s"] == 5.0 and snap["stale"] == 0
+        (s,) = snap["streams"]
+        assert s["origin"] == "n0" and s["range"] == "r0"
+        assert s["lag_s"] == 0.25 and s["applied_window"] == 1
+        assert s["reorder_occupancy"] == 3
+        assert s["gaps"] == 1 and s["resyncs"] == 1
+        assert plane.summary() == {"streams": 1, "stale": 0,
+                                   "worst_lag_s": 0.25}
+
+
+class TestEventJournal:
+    def test_cursor_drain_is_idempotent(self):
+        j = EventJournal(cap=16)
+        for i in range(5):
+            j.append("k", i=i)
+        recs, cur = j.since(-1)
+        assert [r["i"] for r in recs] == [0, 1, 2, 3, 4]
+        again, cur2 = j.since(cur)
+        assert again == [] and cur2 == cur
+        j.append("k", i=5)
+        more, _ = j.since(cur)
+        assert [r["i"] for r in more] == [5]
+
+    def test_ring_bounded(self):
+        j = EventJournal(cap=16)
+        for i in range(100):
+            j.append("k", i=i)
+        assert len(j.tail(1000)) == 16
+        assert j.tail(1)[0]["i"] == 99
+
+
+class TestStalePromote:
+    """A stale standby refuses promote() without force=True (ISSUE 18
+    acceptance criterion)."""
+
+    def _standby(self):
+        leader = TpuMatcher(auto_compact=False)
+        log = DeltaLog("n0", "r0")
+        leader.on_delta = lambda t, f, op, plan, fb: log.append(
+            tenant=t, filter_levels=f, op=op, plan=plan, fallback=fb)
+        for i in range(10):
+            leader.add_route("T", rt(f"s/{i}/t", i))
+        leader.refresh()
+        sb = WarmStandby(matcher=TpuMatcher(auto_compact=False))
+        sb.range_id = "r0"
+        sb.origin = "n0"
+        sb._install(R.decode_base(R.encode_base(leader._base_ct,
+                                                leader.tries)),
+                    log.cursor())
+        return sb
+
+    def test_fresh_standby_promotes(self):
+        sb = self._standby()
+        assert not sb.stale()
+        assert sb.promote() is sb.matcher
+
+    def test_stale_standby_refuses_without_force(self, monkeypatch):
+        monkeypatch.setenv("BIFROMQ_REPL_LAG_STALE_S", "5.0")
+        sb = self._standby()
+        LAG.observe("n0", "r0", 60.0)     # way over the budget
+        assert sb.stale() and sb.status()["stale"]
+        with pytest.raises(RuntimeError, match="stale"):
+            sb.promote()
+        assert not sb._promoted            # refusal left state untouched
+        assert sb.promote(force=True) is sb.matcher
+
+    def test_retained_standby_refuses_without_force(self, monkeypatch):
+        from bifromq_tpu.models.retained import RetainedIndex
+        from bifromq_tpu.replication.standby import RetainedStandby
+        from bifromq_tpu.retained_plane import RetainedDeltaLog
+        monkeypatch.setenv("BIFROMQ_REPL_LAG_STALE_S", "5.0")
+        leader = RetainedIndex()
+        dlog = RetainedDeltaLog("n0", "rr0")
+        sb = RetainedStandby(leader_index=leader, leader_log=dlog)
+        LAG.observe("retained", "retained", 60.0)
+        assert sb.stale()
+        with pytest.raises(RuntimeError, match="stale"):
+            sb.promote()
+        sb.promote(force=True)

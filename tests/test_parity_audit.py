@@ -1,0 +1,267 @@
+"""Continuous parity audit (ISSUE 18): the leader folds chunked BLAKE2
+arena fingerprints into the delta stream; a standby that diverged by
+ONE byte detects it at the audit record's cursor and heals with exactly
+one bounded resync — zero rebuilds, zero generation bumps."""
+
+import asyncio
+
+import pytest
+
+from bifromq_tpu.models.matcher import TpuMatcher
+from bifromq_tpu.models.oracle import Route
+from bifromq_tpu.obs.audit import (ParityAuditor, fingerprint_arenas,
+                                   fingerprint_scope)
+from bifromq_tpu.obs.lag import LAG, REPL_EVENTS
+from bifromq_tpu.replication import records as R
+from bifromq_tpu.replication.standby import WarmStandby
+from bifromq_tpu.replication.stream import DeltaLog
+from bifromq_tpu.types import RouteMatcher
+from bifromq_tpu.utils.metrics import REPLICATION
+
+
+def rt(f, i, broker=0):
+    return Route(matcher=RouteMatcher.from_topic_filter(f),
+                 broker_id=broker, receiver_id=f"rcv{i}",
+                 deliverer_key=f"d{i}", incarnation=0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_lag_plane():
+    LAG.reset()
+    REPL_EVENTS.reset()
+    yield
+    LAG.reset()
+    REPL_EVENTS.reset()
+
+
+def make_leader(n=30):
+    leader = TpuMatcher(auto_compact=False)
+    log = DeltaLog("n0", "r0")
+    leader.on_delta = lambda t, f, op, plan, fb: log.append(
+        tenant=t, filter_levels=f, op=op, plan=plan, fallback=fb)
+    leader.on_rebase = lambda salt, reason: log.anchor(salt, reason)
+    for i in range(n):
+        leader.add_route("T", rt(f"s/{i}/t", i))
+    leader.add_route("T", rt("s/+/t", 900))
+    leader.refresh()
+    return leader, log
+
+
+def attach(leader, log):
+    sb = WarmStandby(matcher=TpuMatcher(auto_compact=False))
+    sb.range_id = "r0"
+    sb.origin = "n0"
+    sb._install(R.decode_base(R.encode_base(leader._base_ct,
+                                            leader.tries)),
+                log.cursor())
+    return sb
+
+
+def pump(log, sb):
+    """Deliver everything new through the full wire codec."""
+    status, recs = log.since(*sb.cursor)
+    assert status == "ok"
+    return sb.offer([R.decode_record(rec.encoded())[0] for rec in recs])
+
+
+class TestCodec:
+    def test_audit_op_wire_roundtrip(self):
+        for op in [("audit", "route", "ab" * 16, 7),
+                   ("audit", "mesh:3", "00" * 16, 1),
+                   ("audit", "retained", "ff" * 16, 123456)]:
+            assert R.decode_op(R.encode_op(op)) == op
+
+
+class TestFingerprints:
+    def test_identical_arenas_identical_fp(self):
+        leader, log = make_leader()
+        sb = attach(leader, log)
+        assert fingerprint_scope(sb.matcher, "route") \
+            == fingerprint_scope(leader, "route")
+
+    def test_one_byte_flip_changes_fp(self):
+        leader, _log = make_leader()
+        fp0, chunks0 = fingerprint_arenas(leader._base_ct)
+        leader._base_ct.node_tab[0, 0] += 1
+        fp1, chunks1 = fingerprint_arenas(leader._base_ct)
+        leader._base_ct.node_tab[0, 0] -= 1
+        assert fp0 != fp1 and chunks0 == chunks1
+        assert fingerprint_arenas(leader._base_ct)[0] == fp0
+
+    def test_unknown_scope_skips(self):
+        leader, _log = make_leader()
+        assert fingerprint_scope(leader, "mesh:0") is None
+        assert fingerprint_scope(leader, "bogus") is None
+
+
+class TestAuditStream:
+    def test_clean_standby_passes_audit(self):
+        leader, log = make_leader()
+        sb = attach(leader, log)
+        auditor = ParityAuditor(leader)
+        ops = auditor.audit_once()
+        assert [o[1] for o in ops] == ["route"]
+        assert pump(log, sb)
+        assert sb.parity_divergences == 0
+        # audit records ride the stream but never touch arenas
+        assert fingerprint_scope(sb.matcher, "route") \
+            == fingerprint_scope(leader, "route")
+
+    def test_audit_skips_invalidation_fanout(self):
+        leader, log = make_leader()
+        ParityAuditor(leader).audit_once()
+        _, recs = log.since(log.epoch, 0)
+        audits = [r for r in recs if r.op and r.op[0] == "audit"]
+        assert audits and all(r.tenant == "" for r in audits)
+
+    def test_divergence_detected_within_one_interval(self):
+        """The acceptance criterion end-to-end through the REAL sync
+        loop: one flipped byte → caught at the very next audit record →
+        healed by exactly one bounded resync — zero rebuilds, zero
+        generation bumps."""
+        loop = asyncio.new_event_loop()
+        leader, log = make_leader()
+
+        async def fetch(_rid, epoch, seq, _timeout):
+            status, recs = log.since(epoch, seq)
+            return (status,
+                    [R.decode_record(r.encoded())[0] for r in recs],
+                    log.cursor())
+
+        async def base(_rid):
+            return "n0", log.cursor(), R.decode_base(
+                R.encode_base(leader._base_ct, leader.tries))
+
+        sb = WarmStandby(matcher=TpuMatcher(auto_compact=False),
+                         range_id="r0", fetch_fn=fetch, base_fn=base)
+        loop.run_until_complete(sb.sync_once())
+        assert sb.attached and sb.resyncs == 1
+        compile_count0 = sb.matcher.compile_count
+        gen0 = sb.matcher.match_cache._gen
+        div0 = REPLICATION.get("parity_divergence_total")
+        auditor = ParityAuditor(leader)
+        # corrupt ONE byte of the standby's live arena
+        sb.matcher._base_ct.node_tab[0, 0] += 1
+        auditor.audit_once()
+        loop.run_until_complete(sb.sync_once())
+        assert sb.parity_divergences == 1 and not sb.attached
+        assert REPLICATION.get("parity_divergence_total") == div0 + 1
+        assert "parity_divergence" in [r["kind"]
+                                       for r in REPL_EVENTS.tail()]
+        # the next pull heals with EXACTLY one bounded resync...
+        loop.run_until_complete(sb.sync_once())
+        assert sb.attached and sb.resyncs == 2
+        # ...and the next audit passes clean — no resync storm, no
+        # rebuild, no generation bump
+        auditor.audit_once()
+        loop.run_until_complete(sb.sync_once())
+        assert sb.parity_divergences == 1 and sb.resyncs == 2
+        assert sb.matcher.compile_count == compile_count0
+        assert sb.matcher.match_cache._gen == gen0
+        assert fingerprint_scope(sb.matcher, "route") \
+            == fingerprint_scope(leader, "route")
+
+    def test_divergence_event_reported(self):
+        from bifromq_tpu.plugin.events import EventType
+
+        class Collector:
+            def __init__(self):
+                self.events = []
+
+            def report(self, ev):
+                self.events.append(ev)
+
+        leader, log = make_leader()
+        sb = attach(leader, log)
+        sb.events = Collector()
+        sb.matcher._base_ct.node_tab[0, 0] += 1
+        ParityAuditor(leader).audit_once()
+        pump(log, sb)
+        assert [e.type for e in sb.events.events] \
+            == [EventType.PARITY_DIVERGENCE]
+
+    def test_cadence_gate(self, monkeypatch):
+        monkeypatch.setenv("BIFROMQ_AUDIT_INTERVAL_S", "30")
+        leader, _log = make_leader()
+        t = [0.0]
+        auditor = ParityAuditor(leader, clock=lambda: t[0])
+        auditor._tick()
+        auditor._tick()            # same instant: gated
+        assert auditor.audits == 1
+        t[0] += 31.0
+        auditor._tick()
+        assert auditor.audits == 2
+
+
+class TestMeshAudit:
+    def test_per_shard_scopes_and_divergence(self):
+        from bifromq_tpu.parallel.sharded import MeshMatcher, make_mesh
+        m = MeshMatcher(mesh=make_mesh(1, 4), max_levels=8, k_states=16,
+                        auto_compact=False)
+        log = DeltaLog("n0", "r0")
+        m.on_delta = lambda t, f, op, plan, fb: log.append(
+            tenant=t, filter_levels=f, op=op, plan=plan, fallback=fb)
+        m.on_rebase = lambda salt, reason: log.anchor(salt, reason)
+        for i in range(24):
+            m.add_route(f"t{i % 6}", rt(f"s/{i}/t", i))
+        m.refresh()
+        auditor = ParityAuditor(m)
+        ops = auditor.audit_once()
+        n = m._base_ct.n_shards
+        assert [o[1] for o in ops] == [f"mesh:{i}" for i in range(n)]
+        # a replica with one flipped byte in ONE shard trips on exactly
+        # that shard's record
+        sb = WarmStandby(matcher=MeshMatcher(mesh=make_mesh(1, 4),
+                                             max_levels=8, k_states=16,
+                                             auto_compact=False,
+                                             match_cache=False))
+        sb.range_id = "r0"
+        sb.origin = "n0"
+        sb._install(R.decode_base(R.encode_base_snapshot(
+            R.capture_mesh_base(m._base_ct, m.tries))), log.cursor())
+        assert fingerprint_scope(sb.matcher, "mesh:1") \
+            == fingerprint_scope(m, "mesh:1")
+        sb.matcher._base_ct.compiled[1].node_tab[0, 0] += 1
+        auditor.audit_once()
+        assert not pump(log, sb)
+        assert sb.parity_divergences == 1
+
+
+class TestRetainedAudit:
+    def _leader(self):
+        from bifromq_tpu.models.retained import RetainedIndex
+        from bifromq_tpu.retained_plane import RetainedDeltaLog
+        from bifromq_tpu.utils import topic as t
+        leader = RetainedIndex()
+        dlog = RetainedDeltaLog("n0", "rr0")
+        leader.delta_hooks.append(
+            lambda tenant, levels, op: dlog.append(tenant, levels, op))
+        for i in range(12):
+            leader.add_topic("T", t.parse(f"a/{i}"), f"a/{i}")
+        leader.refresh()
+        return leader, dlog
+
+    def test_retained_divergence_and_heal(self):
+        from bifromq_tpu.replication.standby import RetainedStandby
+        loop = asyncio.new_event_loop()
+        leader, dlog = self._leader()
+        sb = RetainedStandby(leader_index=leader, leader_log=dlog)
+        loop.run_until_complete(sb.sync_once())
+        assert sb.attached and sb.resyncs == 1
+        auditor = ParityAuditor(TpuMatcher(auto_compact=False),
+                                retained_index=leader, retained_log=dlog)
+        ops = auditor.audit_once()
+        assert ("retained" in [o[1] for o in ops])
+        loop.run_until_complete(sb.sync_once())
+        assert sb.parity_divergences == 0
+        # diverge the replica's logical route set by one topic
+        from bifromq_tpu.utils import topic as t
+        sb.index.add_topic("T", t.parse("ghost/topic"), "ghost/topic")
+        auditor.audit_once()
+        loop.run_until_complete(sb.sync_once())   # detects...
+        assert sb.parity_divergences == 1 and not sb.attached
+        loop.run_until_complete(sb.sync_once())   # ...one resync heals
+        assert sb.attached and sb.resyncs == 2
+        auditor.audit_once()
+        loop.run_until_complete(sb.sync_once())
+        assert sb.parity_divergences == 1 and sb.resyncs == 2

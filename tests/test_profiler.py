@@ -275,6 +275,34 @@ class TestSegmentStore:
         assert hub.persist_now() == 0
         hub.stop_persistence(final_flush=False)
 
+    def test_hub_persists_delta_plane_events(self, tmp_path):
+        """ISSUE 18: lag transitions, resyncs and autoscaler decisions
+        drain into the segment store via the journal's cursor — same
+        incremental contract as the profiler rings."""
+        from bifromq_tpu.obs.lag import LAG, REPL_EVENTS
+        LAG.reset()
+        REPL_EVENTS.reset()
+        hub = ObsHub()
+        try:
+            LAG.observe("n0", "r0", 99.0)       # → lag_stale event
+            LAG.note_resync("n0", "r0")
+            REPL_EVENTS.append("autoscale_decision", action="grow",
+                               acted=True)
+            assert hub.start_persistence(SegmentStore(str(tmp_path)))
+            assert hub.persist_now() > 0
+            kinds = [r["kind"] for r in hub.store.read()
+                     if r["type"] == "repl_event"]
+            assert kinds == ["lag_stale", "resync", "autoscale_decision"]
+            # idempotent across flushes: the cursor advanced
+            hub.persist_now()
+            again = [r for r in hub.store.read()
+                     if r["type"] == "repl_event"]
+            assert len(again) == 3
+            hub.stop_persistence(final_flush=False)
+        finally:
+            LAG.reset()
+            REPL_EVENTS.reset()
+
 
 class TestOTLPFraming:
     async def test_otlp_envelopes_validate_shape(self, tmp_path):
