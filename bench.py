@@ -173,6 +173,7 @@ MT_SUBS = int(os.environ.get("BENCH_MT_SUBS", "1000000"))
 INTERVALS = int(os.environ.get("BENCH_INTERVALS", "64"))
 ROUTES_MODE = os.environ.get("BENCH_ROUTES", "1") != "0"
 LATENCY_MODE = os.environ.get("BENCH_LATENCY", "0") == "1"
+EXPAND_AB_MODE = os.environ.get("BENCH_EXPAND_AB", "1") != "0"
 
 
 def log(msg):
@@ -513,6 +514,245 @@ def _measure_routes(tries, probe_fn, *, name, compiled,
     return out
 
 
+def _measure_expand_ab(tries, probe_fn, *, name, compiled,
+                       k_states=None, iters=None, batch=None,
+                       max_intervals=None):
+    """Device-vs-host fan-out A/B (ISSUE 19 headline): end-to-end
+    matched-routes/s over walk + expansion + per-peer bucketing, tokenize
+    excluded (identical on every leg). Three legs, same probe sets, same
+    walk kernel:
+
+    - ``host``: the pre-ISSUE-19 serving shape — read back the full
+      [B, A] interval grids, ``expand_intervals`` on host, then the
+      per-route ``setdefault(...).append`` delivery grouping the dist
+      service does (dist/service.py BatchDeliveryCall grouping), its rate
+      measured on a bounded pair sample and extrapolated (the loop at
+      full c2 fan-out is minutes per batch — the very wall this A/B
+      documents).
+    - ``host_vectorized``: strongest host contender — same expansion,
+      then ``bucket_pairs_host`` (numpy stable-argsort grouping). Not
+      what the pre-change code did, reported so the headline is not a
+      strawman ratio.
+    - ``device``: fused ``expand_routes`` (ragged-arange expansion +
+      counting-sort bucketing on device); the host reads back only the
+      compact pre-bucketed pair buffers. ``trunc`` rows re-expand on
+      host from the grids — the exact serving cold path.
+
+    The expansion cap is sized from the warmup batches' MEASURED fan-out
+    (1.25x margin, 64k-rounded — NOT pow2, and NOT batch x
+    BIFROMQ_EXPAND_CAP: device expansion is O(cap) whatever the live
+    pair count, so an oversized buffer charges the device leg for lanes
+    the workload never fills).
+    """
+    import jax
+
+    from bifromq_tpu.dist.deliverer import build_peer_table
+    from bifromq_tpu.models.automaton import tokenize
+    from bifromq_tpu.ops.match import (Probes, bucket_pairs_host,
+                                       expand_intervals, expand_routes,
+                                       walk_routes)
+    k_states = k_states or K_STATES
+    iters = int(os.environ.get("BENCH_EXPAND_AB_ITERS",
+                               str(min(iters or ITERS, 6))))
+    # B=4096 at c2 fan-out is the measured sweet spot for the full-route
+    # walk: walk_routes (unlike the headline's walk_count_only) scales
+    # superlinearly with batch (measured ~36 us/topic at 4096 vs ~116 at
+    # 8192), and the expand stage is linear in cap through ~90M lanes
+    # with a ~2.5x per-pair cliff above (multi-GB working set on the
+    # single-core backend). Batch is a tuning knob, not part of the A/B
+    # contract: every leg serves the same batches either way.
+    batch = int(os.environ.get("BENCH_EXPAND_AB_BATCH",
+                               str(min(batch or BATCH, 4096))))
+    max_intervals = max_intervals or INTERVALS
+
+    ct, dev, _ = compiled
+    tab = build_peer_table(ct.matchings_arr)
+    n_peers = tab.n_peers
+    dev_slot_peer = jax.device_put(tab.slot_peer)
+
+    n_batches = 2
+    all_queries = [probe_fn(i, batch) for i in range(n_batches)]
+    toks = [tokenize([q[0] for q in queries],
+                     [ct.root_of(q[1]) for q in queries],
+                     max_levels=ct.max_levels, salt=ct.salt, batch=batch)
+            for queries in all_queries]
+    probe_sets = [Probes.from_tokenized(t) for t in toks]
+    for p in probe_sets:
+        for a in (p.tok_h1, p.tok_h2, p.lengths, p.roots, p.sys_mask):
+            np.asarray(a[:1])  # true upload sync (see _measure_match)
+    compaction = os.environ.get("BENCH_COMPACTION", "sort")
+    run = lambda p: walk_routes(dev, p, probe_len=ct.probe_len,
+                                k_states=k_states,
+                                max_intervals=max_intervals,
+                                compaction=compaction)
+
+    # ---- warmup + cap sizing from measured fan-out -----------------------
+    t0 = time.perf_counter()
+    max_pairs = 1
+    grids = []
+    for p in probe_sets:
+        r = run(p)
+        c_np = np.asarray(r.count).copy()
+        c_np[np.asarray(r.overflow)] = 0
+        np.maximum(c_np, 0, out=c_np)
+        grids.append((np.asarray(r.start), c_np))
+        max_pairs = max(max_pairs, int(c_np.sum(dtype=np.int64)))
+    cap = max(65536, -(-int(max_pairs * 1.25) // 65536) * 65536)
+    er = expand_routes(run(probe_sets[0]), dev_slot_peer, cap=cap,
+                       n_peers=n_peers)
+    np.asarray(er.peer_offsets)  # jit + readback-path warmup
+    log(f"[{name}] expand-ab warmup {time.perf_counter() - t0:.1f}s; "
+        f"max_pairs={max_pairs} cap={cap} n_peers={n_peers}")
+
+    def host_expand(gs, gc):
+        slots, offs = expand_intervals(gs, gc)
+        rows = np.repeat(np.arange(offs.size - 1, dtype=np.int32),
+                         np.diff(offs))
+        return slots, rows, offs
+
+    # ---- one-shot bucket parity check (warmup batch, untimed) ------------
+    gs0, gc0 = grids[0]
+    h_slots, h_rows, _ = host_expand(gs0, gc0)
+    hps, hpr, hpo = bucket_pairs_host(h_slots, h_rows, tab.slot_peer,
+                                      n_peers)
+    live = int(np.asarray(er.peer_offsets)[n_peers + 1])
+    parity = (not np.asarray(er.trunc).any()
+              and live == int(hpo[n_peers + 1])
+              and np.array_equal(np.asarray(er.peer_slots)[:live],
+                                 hps[:live])
+              and np.array_equal(np.asarray(er.peer_rows)[:live],
+                                 hpr[:live]))
+    if not parity:
+        log(f"[{name}] expand-ab WARNING: device/host bucket MISMATCH")
+
+    # ---- device leg ------------------------------------------------------
+    ab_debug = os.environ.get("BENCH_EXPAND_AB_DEBUG", "0") != "0"
+    dev_lat = []
+    dev_routes = 0
+    trunc_rows = 0
+    for it in range(iters):
+        s0 = time.perf_counter()
+        r = run(probe_sets[it % n_batches])
+        if ab_debug:
+            jax.block_until_ready(r.count)
+            t_walk = time.perf_counter() - s0
+        er = expand_routes(r, dev_slot_peer, cap=cap, n_peers=n_peers)
+        if ab_debug:
+            jax.block_until_ready(er.peer_slots)
+            t_expand = time.perf_counter() - s0 - t_walk
+        # the delivery surface serving reads: pre-bucketed pairs + the
+        # per-topic offsets + the escalation flags
+        ps = np.asarray(er.peer_slots)
+        pr = np.asarray(er.peer_rows)
+        po = np.asarray(er.peer_offsets)
+        ro = np.asarray(er.row_offsets)
+        n_live = int(np.asarray(er.n_pairs))
+        tr = np.asarray(er.trunc)
+        np.asarray(er.overflow)
+        if tr.any():
+            # cold path: trunc rows re-expand from the grids, exactly
+            # like serving's escalation fetch
+            first = int(np.argmax(tr))
+            n_live = int(ro[first])
+            g_s = np.asarray(er.start)
+            g_c = np.maximum(np.asarray(er.count), 0)
+            g_c[~tr] = 0
+            esc_slots, _ = expand_intervals(g_s, g_c)
+            n_live += esc_slots.size
+            trunc_rows += int(tr.sum())
+        dev_routes += n_live
+        dev_lat.append(time.perf_counter() - s0)
+        if ab_debug:
+            log(f"[{name}] expand-ab dbg it{it}: walk {t_walk * 1e3:.0f}ms"
+                f" expand {t_expand * 1e3:.0f}ms"
+                f" readback {(dev_lat[-1] - t_walk - t_expand) * 1e3:.0f}ms")
+    dev_elapsed = float(np.sum(dev_lat))
+    del ps, pr, po
+
+    # ---- host leg: walk + grid readback + expand (timed), python
+    # delivery grouping folded from a sampled rate ------------------------
+    host_lat = []
+    host_routes = 0
+    for it in range(iters):
+        s0 = time.perf_counter()
+        r = run(probe_sets[it % n_batches])
+        gs = np.asarray(r.start)
+        gc = np.asarray(r.count).copy()
+        gc[np.asarray(r.overflow)] = 0
+        np.maximum(gc, 0, out=gc)
+        slots, rows, offs = host_expand(gs, gc)
+        host_routes += slots.size
+        host_lat.append(time.perf_counter() - s0)
+    host_expand_elapsed = float(np.sum(host_lat))
+    # per-route python grouping rate, sampled (generously: peer ids are
+    # pre-gathered vectorized; the dist service hashes a (broker, str)
+    # tuple per route on top of this)
+    n_slot = tab.slot_peer.shape[0]
+    sample = min(h_slots.size, 2_000_000)
+    if sample:
+        peer_of = (tab.slot_peer[np.clip(h_slots[:sample], 0, n_slot - 1)]
+                   if n_slot else np.zeros(sample, np.int32)).tolist()
+        sl_list = h_slots[:sample].tolist()
+        s0 = time.perf_counter()
+        by_peer = {}
+        for pe, sl in zip(peer_of, sl_list):
+            by_peer.setdefault(pe, []).append(sl)
+        py_rate = sample / (time.perf_counter() - s0)
+        del by_peer, peer_of, sl_list
+    else:
+        py_rate = float("inf")
+    host_elapsed = host_expand_elapsed + host_routes / py_rate
+
+    # ---- host vectorized leg --------------------------------------------
+    viters = max(2, iters // 2)
+    vec_lat = []
+    vec_routes = 0
+    for it in range(viters):
+        s0 = time.perf_counter()
+        r = run(probe_sets[it % n_batches])
+        gs = np.asarray(r.start)
+        gc = np.asarray(r.count).copy()
+        gc[np.asarray(r.overflow)] = 0
+        np.maximum(gc, 0, out=gc)
+        slots, rows, offs = host_expand(gs, gc)
+        bucket_pairs_host(slots, rows, tab.slot_peer, n_peers)
+        vec_routes += slots.size
+        vec_lat.append(time.perf_counter() - s0)
+    vec_elapsed = float(np.sum(vec_lat))
+
+    dev_rate = dev_routes / dev_elapsed
+    host_rate = host_routes / host_elapsed
+    vec_rate = vec_routes / vec_elapsed
+    out = {
+        "device_matched_routes_per_s": round(dev_rate, 1),
+        "host_matched_routes_per_s": round(host_rate, 1),
+        "host_vectorized_matched_routes_per_s": round(vec_rate, 1),
+        "speedup_vs_host": round(dev_rate / host_rate, 2),
+        "speedup_vs_host_vectorized": round(dev_rate / vec_rate, 2),
+        "routes_per_topic": round(dev_routes / (batch * iters), 2),
+        "device_ms_p50": round(
+            float(np.percentile(dev_lat, 50)) * 1e3, 1),
+        "host_expand_ms_p50": round(
+            float(np.percentile(host_lat, 50)) * 1e3, 1),
+        "host_python_group_pairs_per_s": (round(py_rate, 1)
+                                          if sample else None),
+        "bucket_parity": parity,
+        "cap": cap,
+        "cap_fill": round(max_pairs / cap, 3),
+        "trunc_row_frac": round(trunc_rows / (batch * iters), 6),
+        "n_peers": n_peers,
+        "batch": batch,
+        "iters": iters,
+        "k_states": k_states,
+        "max_intervals": max_intervals,
+        "basis": ("walk + expand + per-peer bucketing, tokenize excluded"
+                  " (identical all legs); host grouping rate sampled at"
+                  f" {sample} pairs then extrapolated"),
+    }
+    log(f"[{name}] expand-ab {json.dumps(out)}")
+    return out
+
+
 def _latency_frontier(tries, probe_fn, *, name, compiled,
                       k_states=None):
     """Small-batch latency mode (VERDICT r4 #4): per-batch sync p50/p99
@@ -574,10 +814,14 @@ def _latency_frontier(tries, probe_fn, *, name, compiled,
 
 
 def _run_modes(tries, probe, *, name, compiled, out, **kw):
-    """Shared per-config mode fan-out: e2e routes + latency frontier."""
+    """Shared per-config mode fan-out: e2e routes + expand A/B + latency
+    frontier."""
     if ROUTES_MODE:
         out["routes"] = _measure_routes(tries, probe, name=name,
                                         compiled=compiled, **kw)
+    if EXPAND_AB_MODE:
+        out["expand_ab"] = _measure_expand_ab(tries, probe, name=name,
+                                              compiled=compiled, **kw)
     if LATENCY_MODE:
         out["latency"] = _latency_frontier(
             tries, probe, name=name, compiled=compiled,
@@ -1644,6 +1888,52 @@ def bench_config11():
                  for f, ms in r.groups.items()})
     parity = all(canon(a) == canon(b) for a, b in zip(got, want))
 
+    # --- expand A/B: device-bucketed serve vs host-expansion serve -----
+    # (ISSUE 19) same pre-generated batches through the full serving path
+    # under BIFROMQ_DEVICE_EXPAND=0 (legacy psum merge + host expansion)
+    # vs =1 (walk-only step + device expand step returning per-peer
+    # buckets, no full-grid host merge). The common MatchedRoutes
+    # materialization dilutes the ratio — the undiluted kernel-level A/B
+    # is config 2's expand_ab record.
+    expand_ab = None
+    if EXPAND_AB_MODE:
+        ab_iters = int(os.environ.get("BENCH_MESH_AB_ITERS", "8"))
+        ab_batches = [probe_batch(100 + i) for i in range(ab_iters)]
+        prev_mode = os.environ.get("BIFROMQ_DEVICE_EXPAND")
+
+        def _serve_leg(mode):
+            os.environ["BIFROMQ_DEVICE_EXPAND"] = mode
+            m.match_batch(ab_batches[0])   # warm this mode's traces
+            n = 0
+            s0 = time.perf_counter()
+            for b in ab_batches:
+                for r in m.match_batch(b):
+                    n += len(r.normal) + sum(len(ms) for ms
+                                             in r.groups.values())
+            return n, time.perf_counter() - s0
+
+        try:
+            host_n, host_s = _serve_leg("0")
+            dev_n, dev_s = _serve_leg("1")
+        finally:
+            if prev_mode is None:
+                os.environ.pop("BIFROMQ_DEVICE_EXPAND", None)
+            else:
+                os.environ["BIFROMQ_DEVICE_EXPAND"] = prev_mode
+        expand_ab = {
+            "device_matched_routes_per_s": round(dev_n / dev_s, 1),
+            "host_matched_routes_per_s": round(host_n / host_s, 1),
+            "speedup": round((dev_n / dev_s)
+                             / max(1e-9, host_n / host_s), 2),
+            "route_count_parity": host_n == dev_n,
+            "device_peer_buckets": m.last_expanded is not None,
+            "iters": ab_iters,
+            "batch": batch,
+            "basis": ("full mesh serve incl host MatchedRoutes"
+                      " materialization (common to both legs)"),
+        }
+        log(f"[{name}] expand-ab {json.dumps(expand_ab)}")
+
     def pct(xs, q):
         return round(float(np.percentile(np.array(xs or [0.0]), q)) * 1e3,
                      3)
@@ -1672,6 +1962,7 @@ def bench_config11():
         "full_rebuilds_in_window": m.compile_count - compiles0,
         "generation_bumps_in_window": ledger.generation_bumps - bumps0,
         "oracle_parity": parity,
+        "expand_ab": expand_ab,
         "patch_flushes": m.patch_flushes,
         "patch_fallbacks": m.patch_fallbacks,
         "shard_breakers": [br.state if br else None

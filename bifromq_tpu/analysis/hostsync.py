@@ -39,6 +39,11 @@ HOT_SCOPES: Dict[str, Set[str]] = {
         "_mix_u32", "_edge_lookup", "_bitonic_desc", "_advance",
         "_count_walk", "_route_walk", "_walk_routes_fn",
         "walk_routes_donated", "patch_device_trie", "_patch_device_trie",
+        # ISSUE 19 device fan-out: the expansion/bucketing bodies the
+        # jit'd expand stage traces, plus its dispatch wrapper — the
+        # compact-pair readback lives in _fetch_walk, nothing here may
+        # synchronize
+        "_expand_pairs", "_bucket_pairs", "expand_routes",
     },
     # ISSUE 11 byte-plane prep: the device hash kernel's math + the
     # upload/dispatch wrappers feeding it
@@ -46,7 +51,10 @@ HOT_SCOPES: Dict[str, Set[str]] = {
     # + device-hash split, wildcard kind lanes post-masked on device)
     "ops/tokenize.py": {"_hash_lanes", "hash_topics_device",
                         "device_tokenize", "device_tokenize_filters"},
-    "models/kernels.py": {"_build_fused", "fused_walk_routes"},
+    # (+ ISSUE 19: the pallas expansion kernel body + its dispatch
+    # wrapper — the device fan-out twin of the fused walk)
+    "models/kernels.py": {"_build_fused", "fused_walk_routes",
+                          "_build_expand", "pallas_expand"},
     # ISSUE 12: the standby's per-batch device flush runs after every
     # applied delta batch — it must stay a pure dispatch wrapper (the
     # narrow scatters live in ops/match, already covered above)
@@ -65,6 +73,9 @@ HOT_SCOPES: Dict[str, Set[str]] = {
         "MeshMatcher._flush_patches", "MeshMatcher._expand_walk",
         "make_match_step", "_shard_scatter", "_shard_scatter_donated",
         "_shard_slice_set", "_shard_slice_set_donated",
+        # ISSUE 19: the per-shard expand step (shard_map body) that
+        # returns pre-bucketed per-peer pair grids over the permute ring
+        "make_expand_step",
     },
     # ISSUE 13 retained serving plane: the scan dispatch leg (patch
     # flush + walk enqueue) and the async ring leg must stay sync-free;

@@ -19,6 +19,32 @@ from ..utils.jaxenv import pin_jax_platform
 pin_jax_platform()
 
 
+def _prewarm_serving_jit() -> None:
+    """Compile the serving walk before READY is advertised.
+
+    A cold worker's FIRST match pays the full walk jit compile — seconds
+    on a small CPU container — against the frontend's 1s per-attempt
+    match deadline (remote.RemoteDistWorker.call_timeout), so the first
+    publish after boot times out and burns its retry budget on a worker
+    that is healthy but cold. The scratch table below never touches
+    worker state; its pow2-padded arena shapes coincide with small
+    serving tables, so the compile it triggers is the one first serves
+    would otherwise hit. Best-effort: a warm failure must not keep the
+    worker from serving (the first match just runs cold, as before)."""
+    try:
+        from ..models.matcher import TpuMatcher
+        from ..models.oracle import Route
+        from ..types import RouteMatcher
+        m = TpuMatcher(auto_compact=False, match_cache=False)
+        m.add_route("_warm", Route(
+            matcher=RouteMatcher.from_topic_filter("w/+/x"), broker_id=0,
+            receiver_id="r0", deliverer_key="d0", incarnation=1))
+        m.refresh()
+        m.match_batch([("_warm", "w/a/x")])
+    except Exception:
+        pass
+
+
 async def serve(args) -> None:
     from .. import trace
     from ..kv.native import NativeKVEngine
@@ -43,6 +69,7 @@ async def serve(args) -> None:
     worker = DistWorker(node_id=args.node_id, engine=engine,
                         raft_store_factory=raft_store_factory)
     await worker.start()
+    _prewarm_serving_jit()
     server = RPCServer(host=args.host, port=args.port)
     DistWorkerRPCService(worker).register(server)
     await server.start()

@@ -549,11 +549,24 @@ class PatchableTrie(CompiledTrie):
                          floor=16)
         node_tab = np.full((cap, NODE_COLS), _EMPTY, dtype=np.int32)
         node_tab[:n] = ct.node_tab
+        # child_list gets the same pow2-floor padding as the node arena:
+        # its exact-length shape was the one arena that still varied
+        # between small tables, so every tiny table recompiled the walk
+        # jit instead of sharing the warm (16,)-shape compile. The CSR
+        # runs only ever index real entries, so the _EMPTY tail is dead
+        # weight the walk never reads.
+        ncl = int(ct.child_list.shape[0])
+        clcap = _next_pow2(max(ncl, 1), floor=16)
+        child_list = ct.child_list
+        if clcap != ncl:
+            child_list = np.full(clcap, _EMPTY, dtype=np.int32)
+            child_list[:ncl] = ct.child_list
         super().__init__(node_tab=node_tab, edge_tab=ct.edge_tab,
-                         child_list=ct.child_list, matchings=ct.matchings,
+                         child_list=child_list, matchings=ct.matchings,
                          tenant_root=ct.tenant_root, salt=ct.salt,
                          probe_len=ct.probe_len, max_levels=ct.max_levels)
         self.n_live = n
+        self.child_used = ncl   # real CSR length under the pad
         self._init_runtime(ct.slot_kind, ct.matchings_arr)
 
     @classmethod
@@ -575,6 +588,10 @@ class PatchableTrie(CompiledTrie):
             tenant_root=dict(tenant_root), salt=salt, probe_len=probe_len,
             max_levels=max_levels)
         self.n_live = int(n_live)
+        # shipped arenas arrive with the leader's padding baked in; the
+        # retained resync path carries its own child_live, so the full
+        # length is the only safe default here
+        self.child_used = int(child_list.shape[0])
         s = len(self.matchings)
         marr = np.empty(max(s, 1), dtype=object)
         for i, m in enumerate(self.matchings):

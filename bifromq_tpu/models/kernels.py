@@ -48,7 +48,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ..ops.match import DeviceTrie, Probes, RouteIntervals, _route_walk
+from ..ops.match import (DeviceTrie, Probes, RouteIntervals, _route_walk,
+                         device_expand_enabled)
 from ..utils.env import env_int, env_str
 
 _VMEM_BUDGET_MB_DEFAULT = 12
@@ -187,3 +188,145 @@ def fused_walk_routes(trie: DeviceTrie, probes: Probes, *, probe_len: int,
                        probes.tok_h2, probes.lengths, probes.roots,
                        probes.sys_mask)
     return RouteIntervals(start=s, count=c, n_routes=nr, overflow=ovf)
+
+
+# ---------------- device fan-out expansion stage (ISSUE 19) -----------------
+#
+# The second kernel stage after the walk: ragged-arange expansion of the
+# [B, A] interval grids into dense (slot, row) pairs. Unlike the lax
+# expansion in ops.match._expand_pairs (scatter-mark + running max — the
+# shape XLA fuses well on CPU), the kernel formulation is a per-element
+# binary search over the lane end-offsets: the prefix sums load into VMEM
+# once and every output position resolves its owning lane in log2(n)
+# steps inside one launch — no scatter, no scan, no HBM bounce between
+# the search and the gather.
+
+
+def expand_kernel_enabled() -> bool:
+    """Route the expansion stage through the Pallas kernel? Compiled TPU
+    only — off-TPU the interpreter is a correctness surface (the parity
+    tests run it explicitly) and the lax expansion is the serving path."""
+    return device_expand_enabled() and _on_tpu()
+
+
+@functools.lru_cache(maxsize=64)
+def _build_expand(n: int, cap: int, a: int, interpret: bool):
+    """One compiled expansion per (lane-count, capacity, lane-width)
+    shape class — same cache-plays-jit role as _build_fused."""
+    from jax.experimental import pallas as pl
+
+    nbits = max(1, n.bit_length())    # n is a static python int
+
+    def kernel(ends_ref, lo_ref, s_ref, slots_ref, rows_ref):
+        ends = ends_ref[...]
+        lane_lo = lo_ref[...]
+        flat_s = s_ref[...]
+        # 2D broadcasted_iota: 1D iota does not lower on TPU
+        j = jax.lax.broadcasted_iota(jnp.int32, (cap, 1), 0)[:, 0]
+
+        # searchsorted-right: smallest lane with ends[lane] > j. Empty
+        # lanes alias their predecessor's end offset and are skipped by
+        # the strict comparison automatically.
+        def body(_, carry):
+            lo, hi = carry
+            mid = (lo + hi) // 2
+            right = ends[mid.clip(0, n - 1)] <= j
+            return (jnp.where(right, mid + 1, lo),
+                    jnp.where(right, hi, mid))
+
+        lo, _hi = jax.lax.fori_loop(
+            0, nbits, body, (jnp.zeros((cap,), jnp.int32),
+                             jnp.full((cap,), n, jnp.int32)))
+        lane = lo.clip(0, n - 1)
+        valid = j < jnp.minimum(ends[n - 1], cap)
+        slots_ref[...] = jnp.where(
+            valid, flat_s[lane] + (j - lane_lo[lane]), -1)
+        rows_ref[...] = jnp.where(valid, lane // a, -1)
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((cap,), jnp.int32),
+            jax.ShapeDtypeStruct((cap,), jnp.int32),
+        ),
+        interpret=interpret,
+    )
+    return jax.jit(lambda ends, lo, s: call(ends, lo, s))
+
+
+def pallas_expand(ivl_s, ivl_c, *, cap: int,
+                  interpret: Optional[bool] = None):
+    """Kernel twin of ``ops.match._expand_pairs`` — identical output
+    contract: (slots [cap], rows [cap], row_offsets [B+1], n_pairs [],
+    trunc [B]) in the host expander's row-major order. The O(B·A) prefix
+    sums stay in lax (they are trivial); only the O(cap) expansion runs
+    in the kernel. Traceable: safe to call under an outer jit."""
+    b, a = ivl_s.shape
+    n = b * a
+    flat_c = jnp.maximum(ivl_c.reshape(n), 0)
+    flat_s = ivl_s.reshape(n)
+    ends = jnp.cumsum(flat_c, dtype=jnp.int32)
+    lane_lo = ends - flat_c
+    row_offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), ends.reshape(b, a)[:, -1]])
+    trunc = row_offsets[1:] > cap
+    if interpret is None:
+        interpret = not _on_tpu()
+    slots, rows = _build_expand(n, cap, a, bool(interpret))(
+        ends, lane_lo, flat_s)
+    return slots, rows, row_offsets, jnp.minimum(ends[n - 1], cap), trunc
+
+
+# ---------------- inter-chip right_permute (ISSUE 19 mesh leg) ---------------
+#
+# The mesh expand step merges per-peer delivery counts across shards with a
+# ring of single-neighbor right-rotate hops instead of the all-reduce psum
+# the walk step used to pay. Each hop is one interconnect transfer; on a
+# real TPU it lowers to a Pallas RDMA kernel (make_async_remote_copy, the
+# SNIPPETS [2] right_permute shape) so the transfer is a direct chip-to-chip
+# DMA with send/recv semaphores — off-TPU the caller uses jax.lax.ppermute,
+# which is both the CPU-emulation path and the parity oracle for this
+# kernel.
+
+
+def rdma_permute_enabled() -> bool:
+    """Route mesh ring hops through the RDMA kernel? Compiled TPU only —
+    there is no interconnect to DMA over anywhere else, and ppermute is
+    the exact same rotation."""
+    return device_expand_enabled() and _on_tpu()
+
+
+def pallas_right_permute(x, axis_name: str, axis_names):
+    """One right-rotate hop over ``axis_name``: ship this device's block
+    to its ring successor and receive the predecessor's, as a single
+    remote DMA. Must be traced inside a shard_map over ``axis_names``
+    (the full mesh axis tuple, so the neighbor coordinate is exact on a
+    2D replica×shard mesh)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(in_ref, out_ref, send_sem, recv_sem):
+        size = jax.lax.psum(1, axis_name)
+        rot = axis_names.index(axis_name)
+        # full mesh coordinate of the right neighbor: rotate only the
+        # ring axis, keep the others (LOGICAL ids are mesh coordinates)
+        device_id = tuple(
+            jnp.remainder(jax.lax.axis_index(a) + 1, size)
+            if i == rot else jax.lax.axis_index(a)
+            for i, a in enumerate(axis_names))
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=in_ref, dst_ref=out_ref,
+            send_sem=send_sem, recv_sem=recv_sem,
+            device_id=device_id,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        rdma.start()
+        rdma.wait()
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
+        compiler_params=pltpu.TPUCompilerParams(collective_id=0),
+    )(x)

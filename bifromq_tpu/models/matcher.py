@@ -118,12 +118,28 @@ class _InFlight:
 
     __slots__ = ("queries", "ct", "dev", "tok", "roots", "res", "tomb",
                  "delta", "batch", "kernel", "fault", "dispatch_s",
-                 "tokenize_s")
+                 "tokenize_s", "dev_expand_s", "peer_tab")
 
     def __init__(self, **kw) -> None:
         self.fault = None   # fired device FaultRule (ISSUE 7 chaos hook)
         self.dispatch_s = 0.0  # dispatch-stage seconds (ISSUE 8 profiler)
         self.tokenize_s = 0.0  # stage-1 prep seconds (ISSUE 11 profiler)
+        self.dev_expand_s = 0.0  # device-expand enqueue (ISSUE 19)
+        self.peer_tab = None     # PeerTable the expansion bucketed against
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+class _HostPairs:
+    """Host view of one device-expanded batch (ISSUE 19): the compact
+    (slot, row) pair buffers + peer buckets ``_fetch_walk`` read back,
+    plus the in-flight result object for the lazy grid fetch that only
+    buffer-truncated rows need."""
+
+    __slots__ = ("slots", "rows", "row_offsets", "n_pairs", "trunc",
+                 "peer_slots", "peer_rows", "peer_offsets", "res")
+
+    def __init__(self, **kw) -> None:
         for k, v in kw.items():
             setattr(self, k, v)
 
@@ -248,6 +264,14 @@ class TpuMatcher:
         self._scatter_warm_thread: Optional[threading.Thread] = None
         self.compile_count = 0      # full compiles (observability/tests)
         self.compile_time_s = 0.0   # cumulative wall time in compiles
+        # ISSUE 19 device fan-out: slot→delivery-peer table cache, keyed
+        # on base-snapshot identity (rebuilt per compile, NEVER per patch
+        # flush — slots patched in after the build land in the UNKNOWN
+        # bucket and get exact host grouping, so staleness is a fast-path
+        # miss, not a correctness risk). last_expanded is the observability
+        # surface for the most recent device-bucketed batch (bench/tests).
+        self._peer_cache: Optional[Tuple] = None
+        self.last_expanded = None
         # ISSUE 9 patch-plane accounting (mutations folded into the base
         # in place vs ops that fell back to the overlay)
         self.patch_count = 0        # mutations applied as in-place patches
@@ -1187,7 +1211,8 @@ class TpuMatcher:
             n_queries=len(fl.queries), batch=fl.batch, kernel=fl.kernel,
             tokenize_s=fl.tokenize_s, dispatch_s=fl.dispatch_s,
             ready_s=ready_s, fetch_s=fetch_s,
-            expand_s=time.perf_counter() - t0, path="async")
+            expand_s=time.perf_counter() - t0,
+            dev_expand_s=fl.dev_expand_s, path="async")
         return out
 
     async def _await_ready(self, ring, fl) -> None:
@@ -1291,7 +1316,7 @@ class TpuMatcher:
                 kernel=fl.kernel, tokenize_s=fl.tokenize_s,
                 dispatch_s=fl.dispatch_s,
                 fetch_s=fetch_s, expand_s=time.perf_counter() - t0,
-                path="sync")
+                dev_expand_s=fl.dev_expand_s, path="sync")
         except DeviceTimeoutError as e:
             # the watchdog fired on the SYNC leg: reclaimed slot
             # semantics without a ring — the orphaned (non-donated)
@@ -1456,12 +1481,33 @@ class TpuMatcher:
         # histograms (/metrics "stages" + the bench breakdown)
         dispatch_s = time.perf_counter() - t0
         STAGES.record("device.dispatch", dispatch_s)
+        # ISSUE 19: the second device stage — fan-out expansion + peer
+        # bucketing enqueued right behind the walk, so the host fetch
+        # reads pre-bucketed (slot, row) pairs instead of interval grids
+        dev_expand_s = 0.0
+        peer_tab = None
+        from ..ops.match import device_expand_enabled
+        import jax
+        # real device arrays only: tests (and degraded backends) hand
+        # duck-typed result leaves the expansion jit cannot consume —
+        # those batches keep the host expander
+        if device_expand_enabled() and isinstance(res.start, jax.Array):
+            from ..ops.match import expand_cap_lanes, expand_routes
+            t0 = time.perf_counter()
+            with trace.span("device.expand", batch=batch):
+                peer_tab, slot_peer = self._peer_table(ct)
+                res = expand_routes(
+                    res, slot_peer, cap=batch * expand_cap_lanes(),
+                    n_peers=peer_tab.n_peers)
+            dev_expand_s = time.perf_counter() - t0
+            STAGES.record("device.expand", dev_expand_s)
         return _InFlight(queries=prep.queries, ct=ct,
                          dev=self._device_trie, tok=tok, roots=roots,
                          res=res, tomb=self._tomb, delta=self._delta,
                          batch=batch, kernel=kernel, fault=fault,
                          dispatch_s=dispatch_s,
-                         tokenize_s=prep.tokenize_s)
+                         tokenize_s=prep.tokenize_s,
+                         dev_expand_s=dev_expand_s, peer_tab=peer_tab)
 
     def _walk_primary(self, probes, ct, *, donate: bool):
         """The primary serving walk: fused Pallas kernel when enabled
@@ -1481,6 +1527,20 @@ class TpuMatcher:
                   max_intervals=self.max_intervals,
                   esc_k=0), ("lax_donated" if donate else "lax")
 
+    def _peer_table(self, ct):
+        """The slot→delivery-peer table for this base snapshot, host +
+        device halves, cached on snapshot identity (see __init__ note on
+        why patch flushes must NOT invalidate it)."""
+        cached = self._peer_cache
+        if cached is not None and cached[0] is ct:
+            return cached[1], cached[2]
+        import jax
+        from ..dist.deliverer import build_peer_table
+        tab = build_peer_table(ct.matchings_arr)
+        dev_tab = jax.device_put(tab.slot_peer, self.device)
+        self._peer_cache = (ct, tab, dev_tab)
+        return tab, dev_tab
+
     @staticmethod
     def _await_ready_sync(res, deadline_s: Optional[float] = None,
                           spin_polls: int = 50,
@@ -1499,7 +1559,9 @@ class TpuMatcher:
             device_deadline_s
         if deadline_s is None:
             deadline_s = device_deadline_s()
-        leaves = (res.start, res.count, res.overflow)
+        ready = getattr(res, "ready_leaves", None)
+        leaves = ready() if ready is not None \
+            else (res.start, res.count, res.overflow)
         t0 = time.monotonic()
         polls = 0
         while True:
@@ -1521,13 +1583,36 @@ class TpuMatcher:
         (escalation patches rescued rows in place; a bare asarray view of
         a jax buffer is read-only). ISSUE 7: the fetch-side device-fault
         hook fires here (error rules only — a readback can crash, it
-        cannot hang-inject)."""
+        cannot hang-inject).
+
+        ISSUE 19 device-expand batches read the COMPACT pair buffers —
+        the interval grids stay on device (escalation/truncation rows
+        fetch them lazily via _fetch_escalation_grids on the slow path).
+        Returns (overflow, _HostPairs, None) in that mode; the legacy
+        (overflow, starts, counts) grids otherwise."""
         from ..resilience.faults import get_injector
         get_injector().check_raise("device", "tpu-device", "fetch")
         overflow = np.array(res.overflow)
+        if hasattr(res, "slots"):
+            pairs = _HostPairs(
+                slots=np.asarray(res.slots), rows=np.asarray(res.rows),
+                row_offsets=np.asarray(res.row_offsets),
+                n_pairs=int(np.asarray(res.n_pairs)),
+                trunc=np.asarray(res.trunc),
+                peer_slots=np.asarray(res.peer_slots),
+                peer_rows=np.asarray(res.peer_rows),
+                peer_offsets=np.asarray(res.peer_offsets), res=res)
+            return overflow, pairs, None
         starts_a = np.array(res.start)
         counts_a = np.array(res.count)
         return overflow, starts_a, counts_a
+
+    @staticmethod
+    def _fetch_escalation_grids(res):
+        """Slow-path grid readback: with device expansion on, only
+        buffer-truncated rows ever need the interval grids on host — a
+        deliberate synchronization OFF the serving fast path."""
+        return np.asarray(res.start), np.asarray(res.count)
 
     def _expand_walk(self, fl: _InFlight, overflow, starts_a, counts_a,
                      max_persistent_fanout: int,
@@ -1567,7 +1652,24 @@ class TpuMatcher:
                 if not o2[j]:
                     esc_slots[int(qi)] = slots2[offs2[j]:offs2[j + 1]]
                     overflow[qi] = False
-        slots, offs = expand_intervals(starts_a, counts_a)
+        # ISSUE 19: device-expanded batches hand the pairs pre-computed;
+        # only buffer-truncated rows re-expand on host from the (lazily
+        # fetched) interval grids — exact, just not pre-bucketed
+        pairs = starts_a if isinstance(starts_a, _HostPairs) else None
+        trunc_slots = trunc_offs = None
+        trunc_map: dict = {}
+        if pairs is not None:
+            slots, offs = pairs.slots, pairs.row_offsets
+            need = np.nonzero(pairs.trunc[:len(queries)]
+                              & ~overflow[:len(queries)])[0]
+            if len(need):
+                g_s, g_c = self._fetch_escalation_grids(pairs.res)
+                trunc_slots, trunc_offs = expand_intervals(
+                    g_s[need], g_c[need])
+                trunc_map = {int(qi): j for j, qi in enumerate(need)}
+            self.last_expanded = (pairs, fl.peer_tab)
+        else:
+            slots, offs = expand_intervals(starts_a, counts_a)
         out: List[MatchedRoutes] = []
         for qi, (tenant_id, levels) in enumerate(queries):
             tomb = fl.tomb.get(tenant_id)
@@ -1588,8 +1690,13 @@ class TpuMatcher:
                     max_persistent_fanout=max_persistent_fanout,
                     max_group_fanout=max_group_fanout)[0])
                 continue
-            row = (esc_slots[qi] if qi in esc_slots
-                   else slots[offs[qi]:offs[qi + 1]])
+            if qi in esc_slots:
+                row = esc_slots[qi]
+            elif qi in trunc_map:
+                j = trunc_map[qi]
+                row = trunc_slots[trunc_offs[j]:trunc_offs[j + 1]]
+            else:
+                row = slots[offs[qi]:offs[qi + 1]]
             if not tomb and delta is None:
                 # fast path: no overlay for this tenant
                 out.append(self._routes_from_slots(

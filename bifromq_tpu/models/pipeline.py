@@ -182,8 +182,13 @@ class DispatchRing(BoundedSlots):
         half 1); ``np.asarray`` later finds the bytes already local.
         Only the leaves ``_fetch_walk`` actually reads — ``n_routes`` is
         derivable from ``count`` and never fetched, so copying it would
-        be one wasted D2H transfer per batch on the tunnel backend."""
-        for leaf in (res.start, res.count, res.overflow):
+        be one wasted D2H transfer per batch on the tunnel backend.
+        ISSUE 19 device-expand results name their own fetch set
+        (``ready_leaves``): the compact pair buffers, never the grids."""
+        ready = getattr(res, "ready_leaves", None)
+        leaves = ready() if ready is not None \
+            else (res.start, res.count, res.overflow)
+        for leaf in leaves:
             copy_async = getattr(leaf, "copy_to_host_async", None)
             if copy_async is not None:
                 try:
@@ -224,7 +229,9 @@ class DispatchRing(BoundedSlots):
         if deadline_s is None:
             deadline_s = device_deadline_s()
         t0 = time.monotonic()
-        leaves = [res.start, res.count, res.overflow]
+        ready = getattr(res, "ready_leaves", None)
+        leaves = list(ready()) if ready is not None \
+            else [res.start, res.count, res.overflow]
         polls = 0
         injector = None
         if fault is not None:

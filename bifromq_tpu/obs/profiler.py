@@ -47,10 +47,11 @@ class BatchRecord:
 
     __slots__ = ("ts", "n_queries", "batch", "kernel", "path",
                  "tokenize_s", "dispatch_s", "ready_s", "fetch_s",
-                 "expand_s", "degraded")
+                 "expand_s", "dev_expand_s", "degraded")
 
     def __init__(self, ts, n_queries, batch, kernel, path, tokenize_s,
-                 dispatch_s, ready_s, fetch_s, expand_s, degraded) -> None:
+                 dispatch_s, ready_s, fetch_s, expand_s, degraded,
+                 dev_expand_s=0.0) -> None:
         self.ts = ts
         self.n_queries = n_queries
         self.batch = batch
@@ -61,6 +62,11 @@ class BatchRecord:
         self.ready_s = ready_s
         self.fetch_s = fetch_s
         self.expand_s = expand_s
+        # ISSUE 19: the DEVICE expansion stage (fan-out pairing +
+        # peer bucketing enqueue) — distinct from expand_s, which is the
+        # host's stage-3 leg (escalation + overlay + route assembly;
+        # with device expansion on, the residual last hop)
+        self.dev_expand_s = dev_expand_s
         self.degraded = degraded
 
     def to_dict(self) -> dict:
@@ -72,6 +78,7 @@ class BatchRecord:
                 "ready_ms": round(self.ready_s * 1e3, 4),
                 "fetch_ms": round(self.fetch_s * 1e3, 4),
                 "expand_ms": round(self.expand_s * 1e3, 4),
+                "dev_expand_ms": round(self.dev_expand_s * 1e3, 4),
                 "degraded": self.degraded}
 
 
@@ -219,6 +226,7 @@ class ContinuousProfiler:
                      dispatch_s: float, tokenize_s: float = 0.0,
                      ready_s: float = 0.0,
                      fetch_s: float = 0.0, expand_s: float = 0.0,
+                     dev_expand_s: float = 0.0,
                      path: str = "async",
                      degraded: Optional[str] = None) -> None:
         self.batches_total += 1
@@ -229,7 +237,8 @@ class ContinuousProfiler:
                 self.degraded_total.get(degraded, 0) + 1
         self._ring.record(BatchRecord(
             self._clock(), n_queries, batch, kernel, path, tokenize_s,
-            dispatch_s, ready_s, fetch_s, expand_s, degraded))
+            dispatch_s, ready_s, fetch_s, expand_s, degraded,
+            dev_expand_s=dev_expand_s))
 
     def record_frontend(self, n_queries: int, hits: int,
                         dedup_saved: int) -> None:
@@ -330,7 +339,7 @@ class ContinuousProfiler:
         recs = self.records()
         out: Dict[str, object] = {"window_batches": len(recs)}
         for stage in ("tokenize_s", "dispatch_s", "ready_s", "fetch_s",
-                      "expand_s"):
+                      "expand_s", "dev_expand_s"):
             vals = sorted(getattr(r, stage) for r in recs)
             key = stage[:-2]
             out[f"{key}_ms_p50"] = round(_pctl(vals, 0.50) * 1e3, 4)

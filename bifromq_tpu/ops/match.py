@@ -942,3 +942,283 @@ def expand_intervals(ivl_start: np.ndarray, ivl_count: np.ndarray
     # of toolchain availability (slot ids are device int32 by construction)
     slots = (np.repeat(flat_s, flat_c) + inner).astype(np.int32)
     return slots, row_offsets
+
+
+# ------------------ device-side fan-out (ISSUE 19) --------------------------
+#
+# expand_intervals above is the host wall this section removes: the walk's
+# [B, A] interval grids become dense (slot, topic-row) pairs ON DEVICE via a
+# ragged arange (one scatter marks each live lane's first output position, a
+# running max recovers the lane per position — O(cap), no per-element binary
+# search), then one stable counting sort groups the pairs by delivery peer so
+# the host receives pre-bucketed grids and keeps only the last-hop MQTT
+# encode. The raw surface (expand_pairs) is byte-compatible with
+# expand_intervals' row-major order; bucketing ships as a SEPARATELY ordered
+# view (peer_slots/peer_rows/peer_offsets), never as a reordering of the
+# parity surface.
+
+# sentinel buckets appended after the n_peers real peers: slots whose
+# delivery target the compile-time peer table cannot name (group matchings
+# spanning servers, slots patched in after the table was built) land in
+# UNKNOWN and get the exact host server_of() grouping; PAD holds the
+# expansion buffer's dead lanes so live buckets stay contiguous in front.
+PEER_UNKNOWN = 0   # bucket id = n_peers + PEER_UNKNOWN
+PEER_PAD = 1       # bucket id = n_peers + PEER_PAD
+N_SENTINEL_BUCKETS = 2
+
+
+def device_expand_mode() -> str:
+    """``BIFROMQ_DEVICE_EXPAND``: ``0`` host expansion (PR-18 behavior),
+    ``1`` force device expansion, ``auto`` (default) device expansion on —
+    the lax path everywhere, the Pallas expand kernel stage on real TPU."""
+    from ..utils.env import env_str
+    mode = env_str("BIFROMQ_DEVICE_EXPAND", "auto").strip().lower()
+    return mode if mode in ("0", "1", "auto") else "auto"
+
+
+def device_expand_enabled() -> bool:
+    return device_expand_mode() != "0"
+
+
+def expand_cap_lanes() -> int:
+    """``BIFROMQ_EXPAND_CAP``: per-row pair budget of the device expansion
+    buffer (batch capacity = B x this). Rows whose fan-out pushes the batch
+    past the buffer are flagged ``trunc`` and re-expand on host from the
+    interval grids — exact, just not pre-bucketed."""
+    from ..utils.env import env_int
+    return max(1, env_int("BIFROMQ_EXPAND_CAP", 64))
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class ExpandedRoutes:
+    """Device-expanded, peer-bucketed fan-out of one walk batch.
+
+    Carries the full :class:`RouteIntervals` surface (``start``/``count``/
+    ``n_routes``/``overflow`` — the escalation re-walk and the host
+    fallback read those unchanged) plus the expansion:
+
+    - ``slots``/``rows``: dense (matching-slot, probe-row) pairs in the
+      host expander's row-major order, ``-1`` past ``n_pairs``. Walk-
+      overflow rows spend no buffer (they re-match on host anyway).
+    - ``row_offsets``: row i's pairs live at ``[ro[i], ro[i+1])`` —
+      valid wherever ``trunc[i]`` is False.
+    - ``trunc``: the row's pairs did not fit the buffer; the host
+      re-expands that row from ``start``/``count``.
+    - ``peer_slots``/``peer_rows``/``peer_offsets``: the same pairs
+      stably grouped by delivery peer (bucket ``n_peers`` = unknown
+      target, ``n_peers + 1`` = dead padding), row-major inside each
+      bucket.
+    """
+    start: jax.Array         # [B, A] int32
+    count: jax.Array         # [B, A] int32
+    n_routes: jax.Array      # [B] int32
+    overflow: jax.Array      # [B] bool — walk overflow (host re-match)
+    slots: jax.Array         # [CAP] int32
+    rows: jax.Array          # [CAP] int32
+    row_offsets: jax.Array   # [B+1] int32
+    n_pairs: jax.Array       # [] int32
+    trunc: jax.Array         # [B] bool — expansion buffer overflow
+    peer_slots: jax.Array    # [CAP] int32
+    peer_rows: jax.Array     # [CAP] int32
+    peer_offsets: jax.Array  # [n_peers+3] int32
+
+    def tree_flatten(self):
+        return ((self.start, self.count, self.n_routes, self.overflow,
+                 self.slots, self.rows, self.row_offsets, self.n_pairs,
+                 self.trunc, self.peer_slots, self.peer_rows,
+                 self.peer_offsets), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def ready_leaves(self):
+        """The leaves the dispatch ring kicks/polls: the compact pair
+        buffers the fetch reads every batch. The interval grids are NOT
+        here — they only cross to host on the escalation slow path."""
+        return (self.slots, self.rows, self.row_offsets, self.n_pairs,
+                self.trunc, self.peer_slots, self.peer_rows,
+                self.peer_offsets, self.overflow, self.n_routes)
+
+
+def _expand_pairs(ivl_s: jax.Array, ivl_c: jax.Array, cap: int):
+    """Ragged-arange expansion of [B, A] interval grids into dense pairs.
+
+    Returns (slots [cap], rows [cap], row_offsets [B+1], n_pairs [],
+    trunc [B]) in exactly ``expand_intervals``' row-major order, ``-1``
+    past ``n_pairs``.
+    """
+    b, a = ivl_s.shape
+    n = b * a
+    flat_c = jnp.maximum(ivl_c.reshape(n), 0)
+    flat_s = ivl_s.reshape(n)
+    ends = jnp.cumsum(flat_c, dtype=jnp.int32)       # [n] lane end offsets
+    lane_lo = ends - flat_c
+    total = ends[-1]
+    row_offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), ends.reshape(b, a)[:, -1]])
+    trunc = row_offsets[1:] > cap
+    # Each output position's owning lane, recovered by one scatter-add +
+    # one cumsum: lane i's pairs start at lane_lo[i], so adding 1 at
+    # every lane_lo[i] (i >= 1) and prefix-summing counts how many lane
+    # boundaries precede each position — i.e. the lane index. Runs of
+    # empty lanes share a boundary position and their +1s telescope to
+    # the correct jump, always landing on the live lane that owns the
+    # position. (A cummax over scatter-max marks computes the same thing
+    # but the cap-sized cummax measures ~13 ns/elem on the single-core
+    # XLA-CPU backend vs ~8 ns/elem for cumsum — at c2 fan-out caps that
+    # difference alone is ~0.5 s per batch.)
+    marks = jnp.zeros((cap,), jnp.int32).at[lane_lo[1:]].add(
+        1, mode="drop")
+    lane_c = jnp.cumsum(marks, dtype=jnp.int32)
+    j = jnp.arange(cap, dtype=jnp.int32)
+    valid = j < total
+    # slot = flat_s[lane] + (j - lane_lo[lane]) refactored to ONE gather
+    # from a precombined [n] table: the cap-sized gathers are the stage's
+    # hot loop and XLA cannot fuse two of them (folding the pair halved
+    # the measured single-core stage time at c2 fan-out)
+    comb = flat_s - lane_lo
+    slots = jnp.where(valid, comb[lane_c] + j, -1)
+    if a & (a - 1) == 0:    # lane // a as a shift: a is a pow2 lane count
+        row_of = jax.lax.shift_right_logical(lane_c, a.bit_length() - 1)
+    else:
+        row_of = lane_c // a
+    rows = jnp.where(valid, row_of, -1)
+    return slots, rows, row_offsets, jnp.minimum(total, cap), trunc
+
+
+def _bucket_pairs(slots: jax.Array, rows: jax.Array, slot_peer: jax.Array,
+                  n_peers: int):
+    """Stable counting sort of expanded pairs by delivery peer.
+
+    ``slot_peer``: [n_slot_cap] int32, peer id in [0, n_peers) or
+    ``n_peers`` for unknown. Pairs keep expansion (row-major) order inside
+    each bucket; pad pairs (slot == -1) sort to the final bucket; slots
+    beyond the table (patched in after the peer table was built) go to the
+    unknown bucket. For wide peer sets a stable argsort replaces the
+    unrolled counting scan.
+    """
+    cap = slots.shape[0]
+    n_slot = slot_peer.shape[0]
+    unknown = n_peers + PEER_UNKNOWN
+    pad = n_peers + PEER_PAD
+    if n_slot == 0:     # empty arena: nothing to bucket beyond live/pad
+        peer = jnp.where(slots < 0, pad, unknown)
+    else:
+        in_tab = (slots >= 0) & (slots < n_slot)
+        peer = jnp.where(
+            slots < 0, pad,
+            jnp.where(in_tab, slot_peer[slots.clip(0, n_slot - 1)],
+                      unknown))
+    p_tot = n_peers + N_SENTINEL_BUCKETS
+    counts = jnp.zeros((p_tot,), jnp.int32).at[peer].add(
+        1, mode="drop")
+    starts = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(counts)])
+    if p_tot <= 16:
+        rank = jnp.zeros((cap,), jnp.int32)
+        for p in range(p_tot):
+            m = peer == p
+            rank = rank + jnp.where(m, jnp.cumsum(m.astype(jnp.int32)) - 1,
+                                    0)
+        dst = starts[peer] + rank
+        peer_slots = jnp.zeros((cap,), jnp.int32).at[dst].set(slots,
+                                                              mode="drop")
+        peer_rows = jnp.zeros((cap,), jnp.int32).at[dst].set(rows,
+                                                             mode="drop")
+    else:
+        order = jnp.argsort(peer)   # lax.sort is stable
+        peer_slots = slots[order]
+        peer_rows = rows[order]
+    return peer_slots, peer_rows, starts
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def expand_pairs(ivl_start: jax.Array, ivl_count: jax.Array, *, cap: int):
+    """Raw device twin of :func:`expand_intervals` (the parity surface):
+    expands whatever the grids say, overflow rows included, no bucketing.
+    Returns (slots [cap], rows [cap], row_offsets [B+1], n_pairs, trunc)."""
+    return _expand_pairs(ivl_start, ivl_count, cap)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cap", "n_peers", "use_kernel"))
+def _expand_routes_fn(ivl_s, ivl_c, overflow, slot_peer, *,
+                      cap: int, n_peers: int, use_kernel: bool):
+    serve_c = jnp.where(overflow[:, None], 0, ivl_c)
+    if use_kernel:
+        from ..models import kernels   # lazy: kernels imports this module
+        slots, rows, row_offsets, n_pairs, trunc = kernels.pallas_expand(
+            ivl_s, serve_c, cap=cap)
+    else:
+        slots, rows, row_offsets, n_pairs, trunc = _expand_pairs(
+            ivl_s, serve_c, cap)
+    if n_peers == 0:
+        # structurally bucketed already: with no named peers every live
+        # pair lands in UNKNOWN, and _expand_pairs emits live pairs as a
+        # contiguous prefix with the pad lanes trailing — the stable
+        # counting sort is the identity. Skipping it skips two cap-sized
+        # scatters, which run ~8M updates/s on the single-core XLA-CPU
+        # backend and would otherwise dominate the whole stage. The peer
+        # views are aliased OUTSIDE the jit (None here): a jit that
+        # returns the same buffer twice pays a real cap-sized copy per
+        # duplicate on the CPU backend.
+        peer_slots = peer_rows = None
+        peer_offsets = jnp.stack(
+            [jnp.zeros((), jnp.int32), n_pairs,
+             jnp.full((), cap, jnp.int32)])
+    else:
+        peer_slots, peer_rows, peer_offsets = _bucket_pairs(
+            slots, rows, slot_peer, n_peers)
+    return (slots, rows, row_offsets, n_pairs, trunc, peer_slots,
+            peer_rows, peer_offsets)
+
+
+def expand_routes(ivl: RouteIntervals, slot_peer, *, cap: int,
+                  n_peers: int, use_kernel=None) -> ExpandedRoutes:
+    """The serving expansion stage: walk intervals -> peer-bucketed pairs.
+
+    Walk-overflow rows spend no buffer (their grids are junk and the host
+    re-matches them regardless); their raw counts stay visible in
+    ``.count`` for the escalation leg.
+    """
+    if use_kernel is None:
+        from ..models.kernels import expand_kernel_enabled
+        use_kernel = expand_kernel_enabled()
+    (slots, rows, row_offsets, n_pairs, trunc, peer_slots, peer_rows,
+     peer_offsets) = _expand_routes_fn(
+        ivl.start, ivl.count, ivl.overflow, slot_peer,
+        cap=cap, n_peers=n_peers, use_kernel=bool(use_kernel))
+    if peer_slots is None:      # n_peers == 0: alias, don't copy
+        peer_slots, peer_rows = slots, rows
+    # the interval grids ride along from the caller's arrays — routing
+    # them through the jit would copy [B, A] buffers for nothing
+    return ExpandedRoutes(ivl.start, ivl.count, ivl.n_routes,
+                          ivl.overflow, slots, rows, row_offsets, n_pairs,
+                          trunc, peer_slots, peer_rows, peer_offsets)
+
+
+def bucket_pairs_host(slots: np.ndarray, rows: np.ndarray,
+                      slot_peer: np.ndarray, n_peers: int):
+    """Host reference of :func:`_bucket_pairs` (parity oracle + the
+    bench's host-A/B leg): same bucket layout, numpy stable argsort."""
+    slots = np.asarray(slots)
+    rows = np.asarray(rows)
+    slot_peer = np.asarray(slot_peer)
+    n_slot = slot_peer.shape[0]
+    unknown = n_peers + PEER_UNKNOWN
+    pad = n_peers + PEER_PAD
+    if n_slot == 0:
+        peer = np.where(slots < 0, pad, unknown).astype(np.int32)
+    else:
+        in_tab = (slots >= 0) & (slots < n_slot)
+        peer = np.where(
+            slots < 0, pad,
+            np.where(in_tab, slot_peer[np.clip(slots, 0, n_slot - 1)],
+                     unknown)).astype(np.int32)
+    p_tot = n_peers + N_SENTINEL_BUCKETS
+    counts = np.bincount(peer, minlength=p_tot).astype(np.int32)
+    starts = np.concatenate([np.zeros(1, np.int32),
+                             np.cumsum(counts, dtype=np.int32)])
+    order = np.argsort(peer, kind="stable")
+    return slots[order], rows[order], starts

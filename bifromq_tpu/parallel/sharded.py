@@ -49,6 +49,7 @@ from dataclasses import dataclass
 from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -57,10 +58,12 @@ from ..models.automaton import (
     NODE_COLS, CompiledTrie, PatchableTrie, _build_edge_table,
     compile_tries, tokenize,
 )
-from ..models.matcher import TpuMatcher, _parse_levels, _pow2_batch
+from ..models.matcher import TpuMatcher, _HostPairs, _parse_levels, \
+    _pow2_batch
 from ..models.oracle import UNCAPPED_FANOUT, MatchedRoutes, SubscriptionTrie
 from ..ops.match import (
-    RT_COLS, DeviceTrie, Probes, _pad_patch_idx, _route_walk,
+    RT_COLS, DeviceTrie, Probes, _bucket_pairs, _expand_pairs,
+    _pad_patch_idx, _route_walk, device_expand_enabled, expand_cap_lanes,
     expand_intervals, route_cols_from_node_tab,
 )
 from ..utils.env import env_bool
@@ -345,7 +348,7 @@ _STEP_CACHE: Dict[Tuple, object] = {}
 
 
 def make_match_step(mesh: Mesh, *, probe_len: int, k_states: int = 32,
-                    max_intervals: int = 32):
+                    max_intervals: int = 32, merge_total: bool = True):
     """Build (or reuse) the jitted multi-device match step — memoized per
     (mesh, probe_len, k_states, max_intervals): clone_empty()/reset and
     per-range matchers must share one compiled program, not re-trace
@@ -355,14 +358,16 @@ def make_match_step(mesh: Mesh, *, probe_len: int, k_states: int = 32,
              REPLICA_AXIS); probes [R, S, B, ...] split over both axes.
     Outputs: per-topic matched-slot INTERVALS [R, S, B, A] × (start,
              count) — the same compressed MatchedRoutes the single-chip
-             walk_routes emits — plus per-topic totals, overflow, and a
-             globally psum'd matched-route count (the cross-shard fan-out
-             MERGE happens on device; the host reads one scalar). Cross-
-             device traffic is exactly that one psum: probes are
-             shard-routed host-side, so the match itself needs no
-             collective.
+             walk_routes emits — plus per-topic totals, overflow, and
+             (with ``merge_total``) a globally psum'd matched-route count
+             (the cross-shard fan-out MERGE happens on device; the host
+             reads one scalar). Cross-device traffic is exactly that one
+             psum: probes are shard-routed host-side, so the match itself
+             needs no collective. ISSUE 19: the device-expand serving
+             path drops the psum (``merge_total=False``) — its merge is
+             the expand step's per-peer right_permute ring instead.
     """
-    key = (mesh, probe_len, k_states, max_intervals)
+    key = (mesh, probe_len, k_states, max_intervals, merge_total)
     cached = _STEP_CACHE.get(key)
     if cached is not None:
         return cached
@@ -378,20 +383,122 @@ def make_match_step(mesh: Mesh, *, probe_len: int, k_states: int = 32,
                         roots[0, 0], sys_mask[0, 0])
         ivl_s, ivl_c, n_routes, overflow = _route_walk(
             trie, probes, probe_len, k_states, "sort", max_intervals)
-        total = jax.lax.psum(n_routes.sum(), (REPLICA_AXIS, SHARD_AXIS))
         expand = lambda x: x[None, None]
-        return (expand(ivl_s), expand(ivl_c), expand(n_routes),
-                expand(overflow), total)
+        outs = (expand(ivl_s), expand(ivl_c), expand(n_routes),
+                expand(overflow))
+        if not merge_total:
+            return outs
+        total = jax.lax.psum(n_routes.sum(), (REPLICA_AXIS, SHARD_AXIS))
+        return outs + (total,)
 
     table_spec = P(SHARD_AXIS)
     probe_spec = P(REPLICA_AXIS, SHARD_AXIS)
+    out_specs = (probe_spec, probe_spec, probe_spec, probe_spec)
     sharded = _shard_map(
         local_step, mesh=mesh,
         in_specs=(table_spec, table_spec, table_spec,
                   probe_spec, probe_spec, probe_spec, probe_spec, probe_spec),
-        out_specs=(probe_spec, probe_spec, probe_spec, probe_spec, P()),
+        out_specs=out_specs + (P(),) if merge_total else out_specs,
         # the walk's loop carries start as replicated constants and become
         # device-varying after the first level; skip the vma consistency check
+        check_vma=False,
+    )
+    step = jax.jit(sharded)
+    _STEP_CACHE[key] = step
+    return step
+
+
+def _ring_allreduce(x, axis_name: str, size: int, axis_names):
+    """Right-rotate ring allreduce over one mesh axis: ``size - 1``
+    single-neighbor hops, each adding the predecessor's running block.
+    This is the ISSUE 19 merge — per-peer delivery counts cross the
+    interconnect as neighbor permutes, never as an all-to-host psum. On
+    a real TPU each hop is the Pallas RDMA right_permute kernel
+    (models/kernels.pallas_right_permute); everywhere else it is
+    ``jax.lax.ppermute``, which doubles as the kernel's parity oracle."""
+    if size <= 1:
+        return x
+    from ..models.kernels import pallas_right_permute, rdma_permute_enabled
+    rdma = rdma_permute_enabled()
+    perm = [(i, (i + 1) % size) for i in range(size)]
+    acc = x
+    buf = x
+    for _ in range(size - 1):
+        buf = (pallas_right_permute(buf, axis_name, axis_names) if rdma
+               else jax.lax.ppermute(buf, axis_name, perm))
+        acc = acc + buf
+    return acc
+
+
+def make_expand_step(mesh: Mesh, *, cap: int, n_peers: int,
+                     use_kernel: bool = False):
+    """The mesh's second device stage (ISSUE 19): per-shard ragged
+    expansion of the walk's interval grids into dense (slot, row) pairs +
+    stable per-peer bucketing, with the global per-peer totals merged by
+    a right_permute ring (shard axis, then replica axis) instead of the
+    psum the walk step used to carry.
+
+    Inputs:  ivl_s/ivl_c [R, S, B, A] + overflow [R, S, B] (the walk's
+             outputs, still device-resident) and slot_peer [S, n_cap]
+             sharded over SHARD_AXIS (each shard buckets against its own
+             arena's table; ids come from the PINNED shared peer list so
+             bucket b means the same broker on every device).
+    Outputs: per-shard compact buffers — slots/rows [R, S, cap],
+             row_offsets [R, S, B+1], n_pairs [R, S], trunc [R, S, B],
+             peer_slots/peer_rows [R, S, cap], peer_offsets
+             [R, S, n_peers+3] — plus the ring-merged per-peer totals
+             [n_peers+2] (pad bucket excluded from meaning, kept for
+             shape). The host reads buffers that are already grouped by
+             delivery target; nothing here ever round-trips the full
+             interval grids.
+    """
+    key = (mesh, "expand", cap, n_peers, use_kernel)
+    cached = _STEP_CACHE.get(key)
+    if cached is not None:
+        return cached
+    r = mesh.shape[REPLICA_AXIS]
+    s = mesh.shape[SHARD_AXIS]
+    axis_names = (REPLICA_AXIS, SHARD_AXIS)
+
+    def local_expand(ivl_s, ivl_c, overflow, slot_peer):
+        ivl_s, ivl_c, ovf = ivl_s[0, 0], ivl_c[0, 0], overflow[0, 0]
+        # walk-overflow rows spend no buffer: their grids are junk and
+        # the host oracle re-matches them regardless (same zeroing as
+        # the single-chip expand_routes)
+        serve_c = jnp.where(ovf[:, None], 0, ivl_c)
+        if use_kernel:
+            from ..models.kernels import pallas_expand
+            slots, rows, row_offsets, n_pairs, trunc = pallas_expand(
+                ivl_s, serve_c, cap=cap)
+        else:
+            slots, rows, row_offsets, n_pairs, trunc = _expand_pairs(
+                ivl_s, serve_c, cap)
+        if n_peers == 0:
+            # no named peers: live pairs are a contiguous prefix (all
+            # UNKNOWN) with pad trailing, so the counting sort is the
+            # identity — same scatter-free shortcut as the single-chip
+            # _expand_routes_fn
+            peer_slots, peer_rows = slots, rows
+            peer_offsets = jnp.stack(
+                [jnp.zeros((), jnp.int32), n_pairs,
+                 jnp.full((), cap, jnp.int32)])
+        else:
+            peer_slots, peer_rows, peer_offsets = _bucket_pairs(
+                slots, rows, slot_peer[0], n_peers)
+        counts = peer_offsets[1:] - peer_offsets[:-1]
+        totals = _ring_allreduce(counts, SHARD_AXIS, s, axis_names)
+        totals = _ring_allreduce(totals, REPLICA_AXIS, r, axis_names)
+        expand = lambda x: x[None, None]
+        return (expand(slots), expand(rows), expand(row_offsets),
+                expand(n_pairs), expand(trunc), expand(peer_slots),
+                expand(peer_rows), expand(peer_offsets), totals)
+
+    table_spec = P(SHARD_AXIS)
+    probe_spec = P(REPLICA_AXIS, SHARD_AXIS)
+    sharded = _shard_map(
+        local_expand, mesh=mesh,
+        in_specs=(probe_spec, probe_spec, probe_spec, table_spec),
+        out_specs=(probe_spec,) * 8 + (P(),),
         check_vma=False,
     )
     step = jax.jit(sharded)
@@ -442,6 +549,47 @@ class _MeshResult:
     start: object     # [R, S, B, A] int32
     count: object     # [R, S, B, A] int32
     overflow: object  # [R, S, B] bool
+
+
+class _MeshExpanded:
+    """The mesh twin of :class:`~bifromq_tpu.ops.match.ExpandedRoutes`
+    (ISSUE 19): the walk's interval grids stay device-resident for the
+    escalation slow path, while the serving fetch reads only the compact
+    per-shard pair buffers + peer buckets. ``peer_totals`` is the
+    ring-merged global per-peer delivery ledger — the replacement for
+    the walk step's all-reduce psum scalar."""
+
+    __slots__ = ("start", "count", "overflow", "slots", "rows",
+                 "row_offsets", "n_pairs", "trunc", "peer_slots",
+                 "peer_rows", "peer_offsets", "peer_totals")
+
+    def __init__(self, **kw) -> None:
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+    def ready_leaves(self):
+        """What the dispatch ring kicks/polls (see ExpandedRoutes): the
+        compact buffers, never the [R, S, B, A] grids."""
+        return (self.slots, self.rows, self.row_offsets, self.n_pairs,
+                self.trunc, self.peer_slots, self.peer_rows,
+                self.peer_offsets, self.peer_totals, self.overflow)
+
+
+class _MeshPeerTable:
+    """The pinned shared delivery-peer id space of one base snapshot:
+    every shard buckets against its OWN arena's slot→peer row, but ids
+    index this one ``peers`` list, so bucket b is the same broker on
+    every device and per-peer totals are summable across the mesh."""
+
+    __slots__ = ("peers", "tables")
+
+    def __init__(self, peers, tables) -> None:
+        self.peers = list(peers)
+        self.tables = tables     # per-shard dist.deliverer.PeerTable
+
+    @property
+    def n_peers(self) -> int:
+        return len(self.peers)
 
 
 class _MultiLeaf:
@@ -571,7 +719,8 @@ class _MeshInFlight:
     __slots__ = ("queries", "ct", "dev", "res", "tomb", "delta", "batch",
                  "b", "slots", "lengths_np", "oracle_qis", "canaries",
                  "dispatch_shards", "kernel", "fault", "fault_shards",
-                 "dispatch_s", "tokenize_s", "quarantine_tag")
+                 "dispatch_s", "tokenize_s", "quarantine_tag",
+                 "dev_expand_s", "peer_tab")
 
     def __init__(self, **kw) -> None:
         self.fault = None
@@ -579,6 +728,8 @@ class _MeshInFlight:
         self.dispatch_s = 0.0
         self.tokenize_s = 0.0
         self.quarantine_tag = "mesh"
+        self.dev_expand_s = 0.0  # device-expand enqueue (ISSUE 19)
+        self.peer_tab = None     # _MeshPeerTable the buckets index
         for k, v in kw.items():
             setattr(self, k, v)
 
@@ -679,6 +830,13 @@ class MeshMatcher(TpuMatcher):
         self.n_shards = mesh.shape[SHARD_AXIS]
         self._step = make_match_step(mesh, probe_len=probe_len,
                                      k_states=k_states)
+        # ISSUE 19: the device-expand serving path walks WITHOUT the
+        # scalar psum — its cross-mesh merge is the expand step's
+        # per-peer right_permute ring (jit is lazy; only the path that
+        # actually serves ever compiles)
+        self._step_walk_only = make_match_step(
+            mesh, probe_len=probe_len, k_states=k_states,
+            merge_total=False)
         self._table_sharding = NamedSharding(mesh, P(SHARD_AXIS))
         self._probe_sharding = NamedSharding(mesh, P(REPLICA_AXIS,
                                                      SHARD_AXIS))
@@ -1032,6 +1190,13 @@ class MeshMatcher(TpuMatcher):
         self.n_shards = n_shards
         self._step = make_match_step(self.mesh, probe_len=self.probe_len,
                                      k_states=self.k_states)
+        self._step_walk_only = make_match_step(
+            self.mesh, probe_len=self.probe_len, k_states=self.k_states,
+            merge_total=False)
+        # the peer table's stacked [S, n_cap] layout is shard-count
+        # derived: a resize must rebuild it (snapshot identity alone
+        # would serve a stale-shaped device table to the new mesh)
+        self._peer_cache = None
         self._table_sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
         self._probe_sharding = NamedSharding(self.mesh, P(REPLICA_AXIS,
                                                           SHARD_AXIS))
@@ -1256,22 +1421,54 @@ class MeshMatcher(TpuMatcher):
             # synchronizes with the quarantined device
             return self._dispatch_split(prep, fault, fault_shards)
         dev_edge, dev_child, dev_route = self._device_trie
+        use_expand = device_expand_enabled()
         t0 = time.perf_counter()
         with trace.span("device.dispatch", batch=prep.batch,
                         queries=len(prep.queries)) as sp:
-            ivl_s, ivl_c, _n_routes, overflow, _total = self._step(
-                dev_edge, dev_child, dev_route, *prep.grids)
+            if use_expand:
+                ivl_s, ivl_c, _n_routes, overflow = self._step_walk_only(
+                    dev_edge, dev_child, dev_route, *prep.grids)
+            else:
+                ivl_s, ivl_c, _n_routes, overflow, _total = self._step(
+                    dev_edge, dev_child, dev_route, *prep.grids)
             if sp is not trace.NOOP:
                 sp.set_tag("kernel", "mesh")
         dispatch_s = time.perf_counter() - t0
         STAGES.record("device.dispatch", dispatch_s)
+        res = _MeshResult(start=ivl_s, count=ivl_c, overflow=overflow)
+        dev_expand_s = 0.0
+        peer_tab = None
+        if use_expand:
+            # ISSUE 19: the second device stage — per-shard fan-out
+            # expansion + peer bucketing, cross-mesh totals merged by the
+            # right_permute ring; the fetch then reads compact buffers
+            # that are already grouped by delivery broker
+            from ..models.kernels import expand_kernel_enabled
+            t1 = time.perf_counter()
+            with trace.span("device.expand", batch=prep.batch):
+                peer_tab, slot_peer = self._mesh_peer_table(prep.ct)
+                step = make_expand_step(
+                    self.mesh, cap=prep.b * expand_cap_lanes(),
+                    n_peers=peer_tab.n_peers,
+                    use_kernel=expand_kernel_enabled())
+                (slots, rows, row_offsets, n_pairs, trunc, peer_slots,
+                 peer_rows, peer_offsets, peer_totals) = step(
+                    ivl_s, ivl_c, overflow, slot_peer)
+                res = _MeshExpanded(
+                    start=ivl_s, count=ivl_c, overflow=overflow,
+                    slots=slots, rows=rows, row_offsets=row_offsets,
+                    n_pairs=n_pairs, trunc=trunc, peer_slots=peer_slots,
+                    peer_rows=peer_rows, peer_offsets=peer_offsets,
+                    peer_totals=peer_totals)
+            dev_expand_s = time.perf_counter() - t1
+            STAGES.record("device.expand", dev_expand_s)
         tag = "mesh"
         if fault_shards:
             tag = "mesh:" + ",".join(f"shard{sh}"
                                      for sh in sorted(fault_shards))
         return _MeshInFlight(
             queries=prep.queries, ct=prep.ct, dev=self._device_trie,
-            res=_MeshResult(start=ivl_s, count=ivl_c, overflow=overflow),
+            res=res, dev_expand_s=dev_expand_s, peer_tab=peer_tab,
             tomb=self._tomb, delta=self._delta, batch=prep.batch,
             b=prep.b, slots=prep.slots, lengths_np=prep.lengths_np,
             oracle_qis=prep.oracle_qis, canaries=prep.canaries,
@@ -1279,6 +1476,40 @@ class MeshMatcher(TpuMatcher):
             fault=fault, fault_shards=fault_shards,
             dispatch_s=dispatch_s, tokenize_s=prep.tokenize_s,
             quarantine_tag=tag)
+
+    def _mesh_peer_table(self, tables: ShardedTables):
+        """The per-shard slot→delivery-peer tables of one base snapshot,
+        stacked + device_put over SHARD_AXIS, with the peer-id space
+        PINNED to the union of every shard's deliverer servers (sorted)
+        so bucket ids line up across devices. Cached on base-snapshot
+        identity only — patch flushes must NOT invalidate it (a stale
+        slot lands in UNKNOWN, a fast-path miss, not a correctness
+        risk; see models/matcher.TpuMatcher.__init__)."""
+        cached = self._peer_cache
+        if cached is not None and cached[0] is tables:
+            return cached[1], cached[2]
+        from ..dist.deliverer import build_peer_table, server_of
+        arenas = [ct.matchings_arr for ct in tables.compiled]
+        keys: Set[str] = set()
+        for arr in arenas:
+            for m in arr:
+                dkey = getattr(m, "deliverer_key", None)
+                if isinstance(dkey, str):
+                    sid = server_of(dkey)
+                    if sid:
+                        keys.add(sid)
+        peers = sorted(keys)
+        tabs = [build_peer_table(arr, peers=peers) for arr in arenas]
+        n_cap = max([t.slot_peer.shape[0] for t in tabs] + [1])
+        # pad rows read UNKNOWN (= n_peers): a slot id past a shard's
+        # arena can only come from post-table patches — host fallback
+        stacked = np.full((self.n_shards, n_cap), len(peers), np.int32)
+        for sh, t in enumerate(tabs):
+            stacked[sh, :t.slot_peer.shape[0]] = t.slot_peer
+        tab = _MeshPeerTable(peers, tabs)
+        dev = jax.device_put(stacked, self._table_sharding)
+        self._peer_cache = (tables, tab, dev)
+        return tab, dev
 
     # ------------- split mesh dispatch (ISSUE 16 tentpole leg 1) -----------
 
@@ -1445,6 +1676,22 @@ class MeshMatcher(TpuMatcher):
 
     @staticmethod
     def _fetch_walk(res):
+        if isinstance(res, _MeshExpanded):
+            # ISSUE 19 fast path: compact per-shard pair buffers only —
+            # the [R, S, B, A] interval grids stay on device (truncated
+            # rows fetch them lazily via _fetch_escalation_grids)
+            from ..resilience.faults import get_injector
+            get_injector().check_raise("device", "tpu-device", "fetch")
+            overflow = np.array(res.overflow)
+            pairs = _HostPairs(
+                slots=np.asarray(res.slots), rows=np.asarray(res.rows),
+                row_offsets=np.asarray(res.row_offsets),
+                n_pairs=np.asarray(res.n_pairs),
+                trunc=np.asarray(res.trunc),
+                peer_slots=np.asarray(res.peer_slots),
+                peer_rows=np.asarray(res.peer_rows),
+                peer_offsets=np.asarray(res.peer_offsets), res=res)
+            return overflow, pairs, None
         if not isinstance(res, _SplitMeshResult):
             return TpuMatcher._fetch_walk(res)
         from ..resilience.faults import get_injector
@@ -1506,9 +1753,15 @@ class MeshMatcher(TpuMatcher):
         for breaker-excluded / unknown-tenant / overflowed rows."""
         tables: ShardedTables = fl.ct
         r, s, b = overflow.shape
-        a = starts_a.shape[-1]
-        flat_slots, flat_offs = expand_intervals(
-            starts_a.reshape(-1, a), counts_a.reshape(-1, a))
+        # ISSUE 19: device-expanded batches hand the pairs pre-computed
+        # per shard; only buffer-truncated rows re-expand on host from
+        # the lazily fetched interval grids (exact, just not bucketed)
+        pairs = starts_a if isinstance(starts_a, _HostPairs) else None
+        g_s = g_c = None
+        if pairs is None:
+            a = starts_a.shape[-1]
+            flat_slots, flat_offs = expand_intervals(
+                starts_a.reshape(-1, a), counts_a.reshape(-1, a))
         out: List[Optional[MatchedRoutes]] = [None] * len(fl.queries)
         oracle_qis: Set[int] = set(fl.oracle_qis)
         canary_rows: Dict[int, List[int]] = {}
@@ -1525,8 +1778,20 @@ class MeshMatcher(TpuMatcher):
                         # host fallback (not a fault-domain degradation)
                         oracle_qis.add(qi)
                         continue
-                    row_i = (rep * s + sh) * b + bi
-                    row = flat_slots[flat_offs[row_i]:flat_offs[row_i + 1]]
+                    if pairs is None:
+                        row_i = (rep * s + sh) * b + bi
+                        row = flat_slots[
+                            flat_offs[row_i]:flat_offs[row_i + 1]]
+                    elif pairs.trunc[rep, sh, bi]:
+                        if g_s is None:
+                            g_s, g_c = TpuMatcher._fetch_escalation_grids(
+                                pairs.res)
+                        row, _ = expand_intervals(
+                            g_s[rep, sh, bi:bi + 1],
+                            g_c[rep, sh, bi:bi + 1])
+                    else:
+                        offs = pairs.row_offsets[rep, sh]
+                        row = pairs.slots[rep, sh][offs[bi]:offs[bi + 1]]
                     tomb = fl.tomb.get(tenant_id)
                     delta = fl.delta.get(tenant_id)
                     if not tomb and delta is None:
@@ -1540,6 +1805,10 @@ class MeshMatcher(TpuMatcher):
                             max_persistent_fanout, max_group_fanout)
                     if sh in fl.canaries.pending:
                         canary_rows.setdefault(sh, []).append(qi)
+        if pairs is not None:
+            # the delivery-plane surface (deliverer.bucket_views reads
+            # the per-shard buckets through this; bench reads totals)
+            self.last_expanded = (pairs, fl.peer_tab)
         # half-open settlement: a canary shard re-closes ONLY when its
         # device rows are row-identical to the host oracle; wrong rows
         # reopen the breaker and the oracle rows serve instead

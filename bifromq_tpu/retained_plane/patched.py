@@ -69,10 +69,13 @@ class RetainedPatchableTrie(PatchableTrie):
         self.extra_garbage = 0
         # child-list arena: base CSR runs + growth headroom at the tail
         base_cl = self.child_list
-        used = int(base_cl.shape[0])
+        # the match-plane pad (PatchableTrie pow2-floors child_list) is
+        # dead tail, not live CSR data — size the retained arena from the
+        # real run length so appends land right after the base runs
+        used = int(getattr(self, "child_used", base_cl.shape[0]))
         ccap = _next_pow2(max(used + 1, int(used * 1.25)), floor=16)
         cl = np.full(ccap, _EMPTY, dtype=np.int32)
-        cl[:used] = base_cl
+        cl[:used] = base_cl[:used]
         self.child_list = cl
         self.child_live = used
         self.child_garbage = 0

@@ -101,6 +101,7 @@ KNOWN_STAGES = frozenset({
     "device.dispatch",  # matcher walk enqueue cost
     "device.ready",     # in-flight walk awaited on readiness
     "device.fetch",     # final host copy
+    "device.expand",    # ISSUE 19: fan-out expansion + peer-bucket enqueue
     "deliver",          # dist/service fan-out
     "repl.apply",       # ISSUE 12: standby delta-batch apply (host+flush)
     "mesh.flush",       # ISSUE 15: per-shard mesh patch flush (scatters)
