@@ -2150,6 +2150,10 @@ def bench_broker():
     from bifromq_tpu.utils.metrics import MATCH_CACHE, STAGES
     STAGES.reset()
     MATCH_CACHE.reset()
+    # ISSUE 20: e2e delivery-latency plane — reset so the per-qos
+    # publish->deliver rollup stamped below covers exactly this run
+    from bifromq_tpu.obs import OBS
+    OBS.e2e.reset()
 
     async def run():
         broker = MQTTBroker(host="127.0.0.1", port=0,
@@ -2213,6 +2217,9 @@ def bench_broker():
     # ISSUE 4: hit rate + dedup ratio next to the stage breakdown — how
     # much of the publish path the match-result cache actually absorbed
     out["match_cache"] = MATCH_CACHE.snapshot()
+    # ISSUE 20: per-qos e2e snapshot (p50/p99 publish->deliver + SLO
+    # violations) rides the bench record next to the stage breakdown
+    out["e2e"] = OBS.e2e.qos_rollup()
     log(f"[broker_e2e] {json.dumps(out)}")
     return out
 

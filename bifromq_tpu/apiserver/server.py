@@ -158,6 +158,8 @@ class APIServer:
                 return await self._cluster_tenants(arg)
             if route == ("GET", "/cluster/capacity"):
                 return self._cluster_capacity()
+            if route == ("GET", "/cluster/slo"):
+                return self._cluster_slo()
             if route == ("GET", "/capacity"):
                 return self._capacity_get(arg)
             if route == ("GET", "/replication"):
@@ -190,6 +192,8 @@ class APIServer:
                 return self._mesh_autoscaler(arg)
             if route == ("GET", "/replication/lag"):
                 return self._replication_lag(arg)
+            if route == ("GET", "/slo"):
+                return self._slo_get(arg)
             if route == ("GET", "/metrics"):
                 return self._metrics_get(arg)
             if route == ("GET", "/tenants"):
@@ -520,6 +524,22 @@ class APIServer:
         snap["events"] = REPL_EVENTS.tail(top_k)
         return 200, snap
 
+    def _slo_get(self, arg) -> Tuple[int, object]:
+        """``GET /slo``: the ISSUE 20 delivery-SLO plane — per-tenant
+        multi-window burn-rate state (objectives, fast/slow burns, the
+        burning set), the full-population publish→deliver latency
+        histograms per (tenant, qos, path) with violation counters and
+        degraded attribution, plus the recent SLO_BURN / SLO_RECOVERED
+        journal (``?events=`` caps the tail)."""
+        from ..obs import OBS
+        from ..obs.burnrate import SLO_EVENTS
+        top_k = int(arg("events", "64"))
+        if top_k < 0:
+            return 400, {"error": f"events={top_k} (must be >= 0)"}
+        return 200, {"burn": OBS.burnrate.snapshot(),
+                     "e2e": OBS.e2e.snapshot(),
+                     "events": SLO_EVENTS.tail(top_k)}
+
     def _tenants_ranked(self, arg) -> Tuple[int, object]:
         """Live noisy-neighbor ranking over the windowed RED state: top-K
         tenants by blended contention score, flags included. Evaluation
@@ -542,13 +562,18 @@ class APIServer:
         counters = {}
         if self.metrics is not None:
             counters = self.metrics.tenant_counters(tenant)
-        if not windows and not counters:
+        # ISSUE 20: burn-rate state + e2e delivery latency ride the view
+        burn = OBS.burnrate.snapshot_tenant(tenant)
+        e2e = OBS.e2e.snapshot_tenant(tenant)
+        if not windows and not counters and not burn and not e2e:
             return 404, {"error": f"no SLO state for tenant {tenant!r}"}
         return 200, {"tenant": tenant,
                      "window_s": OBS.windows.window_s,
                      "slo": windows,
                      "rank": row,
-                     "counters": counters}
+                     "counters": counters,
+                     "burn": burn,
+                     "e2e": e2e}
 
     def _obs_state(self) -> Tuple[int, object]:
         from ..obs import OBS
@@ -556,7 +581,10 @@ class APIServer:
                      "window_s": OBS.windows.window_s,
                      "noisy_threshold": OBS.detector.noisy_threshold,
                      "slow_p99_ms": OBS.detector.slow_p99_ms,
-                     "detector": OBS.detector.config_snapshot()}
+                     "detector": OBS.detector.config_snapshot(),
+                     # ISSUE 20: the burn engine's live config rides the
+                     # same state view PUT /obs returns
+                     "slo": OBS.burnrate.snapshot()}
 
     def _obs_config(self, arg) -> Tuple[int, object]:
         """Runtime SLO knobs: ``windows`` (0/1 toggles the window layer),
@@ -564,6 +592,11 @@ class APIServer:
         / ``w_queue_wait`` / ``w_errors``). With ``tenant_id`` set the
         threshold/weight knobs install a per-tenant override instead
         (ISSUE 5 satellite; ``clear=1`` drops that tenant's overrides).
+        ISSUE 20 adds the burn-rate engine's knobs: process-wide
+        ``slo_fast_window_s`` / ``slo_slow_window_s`` /
+        ``slo_burn_threshold`` / ``slo_cooldown_s`` / ``slo_p99_ms`` /
+        ``slo_success``; with ``tenant_id`` set, ``slo_p99_ms`` /
+        ``slo_success`` install a per-tenant objective instead.
         Parse everything before applying anything (same contract as
         PUT /trace)."""
         from ..obs import OBS
@@ -583,7 +616,20 @@ class APIServer:
             raw = arg(name)
             if raw is not None:
                 knobs[name] = float(raw)      # ValueError → 400 upstream
+        slo = {}
+        for qname, kname in (("slo_fast_window_s", "fast_window_s"),
+                             ("slo_slow_window_s", "slow_window_s"),
+                             ("slo_burn_threshold", "burn_threshold"),
+                             ("slo_cooldown_s", "cooldown_s"),
+                             ("slo_p99_ms", "p99_ms"),
+                             ("slo_success", "success")):
+            raw = arg(qname)
+            if raw is not None:
+                slo[kname] = float(raw)       # ValueError → 400 upstream
         tenant = arg("tenant_id")
+        if tenant and any(k not in ("p99_ms", "success") for k in slo):
+            return 400, {"error": "per-tenant SLO overrides accept only "
+                                  "slo_p99_ms / slo_success"}
         if windows is not None:       # process-wide regardless of tenant
             OBS.enabled = windows
         if tenant:
@@ -591,12 +637,17 @@ class APIServer:
             # override and installs the new knob, never discards it
             if arg("clear") in ("1", "true"):
                 det.clear_tenant(tenant)
+                OBS.burnrate.clear_tenant(tenant)
             if knobs:
                 det.configure_tenant(tenant, **knobs)
+            if slo:
+                OBS.burnrate.configure_tenant(tenant, **slo)
         else:
             # process-wide defaults: noisy_threshold / slow_p99_ms / w_*
             for name, v in knobs.items():
                 setattr(det, name, v)
+            if slo:
+                OBS.burnrate.configure(**slo)
         return self._obs_state()
 
     def _cluster_info(self) -> Tuple[int, object]:
@@ -725,6 +776,23 @@ class APIServer:
                      "max_mem_peak_bytes": local.get("mem_peak_bytes", 0),
                      "logical_subs": {"sum": ls, "dedup": ls,
                                       "replica_groups": 1 if ls else 0}}
+
+    def _cluster_slo(self) -> Tuple[int, object]:
+        """``GET /cluster/slo``: per-node burn summaries federated from
+        the gossiped health digests (no scatter-gather RPC) — which
+        tenants are burning anywhere in the cluster, and the worst
+        burner per node."""
+        from ..obs import OBS
+        local = OBS.burnrate.summary()
+        nodes = {OBS.node_id: {"slo": local, "stale": False,
+                               "self": True}}
+        if self.clusterview is not None:
+            for node, p in self.clusterview.peers().items():
+                nodes[node] = {"slo": (p["digest"] or {}).get("slo", {}),
+                               "stale": p["stale"]}
+        burning = sorted({t for n in nodes.values()
+                          for t in (n["slo"] or {}).get("burning", [])})
+        return 200, {"nodes": nodes, "burning": burning}
 
     async def _cluster_trace(self, trace_id: str, arg) -> Tuple[int, object]:
         """``GET /cluster/trace/<id>``: the full cross-process trace,

@@ -22,6 +22,7 @@ import numpy as np
 
 from .. import trace
 from ..kv import schema
+from ..obs.e2e import DELIVERY_PATH
 from ..plugin.subbroker import DeliveryPack, DeliveryResult
 from ..rpc.fabric import RPCServer, _len16, _read16
 from ..types import (ClientInfo, MatchInfo, PublisherMessagePack,
@@ -214,7 +215,15 @@ class DelivererRPCService:
                              len(mis))
             broker = self.sub_brokers.get(broker_id)
             dp = DeliveryPack(message_pack=pack, match_infos=tuple(mis))
-            res = await broker.deliver(tenant_id, dkey, [dp])
+            # ISSUE 20: sends below this entry point attribute to the
+            # "remote" delivery path — the HLC merged on the request3
+            # header, so the cross-process publish→deliver delta the e2e
+            # plane records here is meaningful
+            token = DELIVERY_PATH.set("remote")
+            try:
+                res = await broker.deliver(tenant_id, dkey, [dp])
+            finally:
+                DELIVERY_PATH.reset(token)
             return bytes(_RESULT_CODE[res.get(mi, DeliveryResult.ERROR)]
                          for mi in mis)
 

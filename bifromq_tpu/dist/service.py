@@ -548,6 +548,8 @@ class DistService:
                     self.events.report(Event(EventType.DELIVER_ERROR,
                                              tenant_id,
                                              {"error": repr(e)}))
+                    OBS.record_delivery_violation(tenant_id, 0,
+                                                  "deliver_error")
                     continue
             elif not self.sub_brokers.has(broker_id):
                 continue
@@ -561,6 +563,8 @@ class DistService:
                     self.events.report(Event(EventType.DELIVER_ERROR,
                                              tenant_id,
                                              {"error": repr(e)}))
+                    OBS.record_delivery_violation(tenant_id, 0,
+                                                  "deliver_error")
                     continue
             for route, mi in zip(routes, match_infos):
                 outcome = res.get(mi, DeliveryResult.ERROR)

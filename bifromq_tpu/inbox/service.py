@@ -488,6 +488,13 @@ class InboxSubBroker(ISubBroker):
                             svc.events.report(Event(
                                 EventType.OVERFLOWED, tenant_id,
                                 {"inbox": mi.receiver_id}))
+                            # ISSUE 20: overflowed inbox writes are
+                            # deliveries that will never happen — the
+                            # tenant's SLO budget pays here, once, on
+                            # the proposer (replica applies stay muted)
+                            from ..obs import OBS
+                            OBS.record_delivery_violation(
+                                tenant_id, 0, "inbox_overflow")
                 out[mi] = result
         for tenant, inbox in touched:
             svc._signal(tenant, inbox)

@@ -1173,6 +1173,14 @@ class TpuMatcher:
                     # ISSUE 15: let the subclass attribute the timeout
                     # (the mesh feeds the implicated SHARD's breaker)
                     self._note_device_timeout(fl)
+                    # ISSUE 20: the e2e plane's degraded map names the
+                    # component stalling deliveries (the mesh hook above
+                    # already named individual shards; this covers the
+                    # single-chip matcher)
+                    from ..obs import OBS
+                    OBS.e2e.set_degraded(
+                        getattr(fl, "quarantine_tag", None) or "device",
+                        "device_timeout")
                     raise
                 except BaseException:
                     # cancelled mid-wait (caller timeout, client
@@ -1188,6 +1196,10 @@ class TpuMatcher:
                     raise
                 ready_s = time.perf_counter() - t0
                 STAGES.record("device.ready", ready_s)
+                # a step that completes clears the single-chip degraded
+                # mark (per-shard marks clear on their own ready rows)
+                from ..obs import OBS as _obs
+                _obs.e2e.clear_degraded("device")
             finally:
                 ring.release()
         finally:

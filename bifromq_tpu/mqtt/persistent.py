@@ -16,6 +16,7 @@ from typing import Dict, Optional, Set
 
 from .. import trace
 from ..inbox.service import InboxService
+from ..obs.e2e import DELIVERY_PATH
 from ..inbox.store import LWT
 from ..plugin.events import Event, EventType
 from ..types import Message, QoS, TopicFilterOption
@@ -287,8 +288,15 @@ class PersistentSession(Session):
         if sub is None:
             # subscription changed since enqueue; honor the stored QoS
             sub = Subscription(matcher=None, qos=int(msg.pub_qos))
-        result = await self._send_publish(topic, msg, sub,
-                                          retained=msg.is_retained)
+        # ISSUE 20: the e2e plane attributes this delivery to the inbox
+        # drain, not the live fan-out (the HLC delta still measures the
+        # true publish→deliver latency the subscriber experienced)
+        token = DELIVERY_PATH.set("inbox_replay")
+        try:
+            result = await self._send_publish(topic, msg, sub,
+                                              retained=msg.is_retained)
+        finally:
+            DELIVERY_PATH.reset(token)
         if result is BLOCKED:
             return False
         if buffer_seq is not None:

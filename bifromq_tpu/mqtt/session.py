@@ -34,6 +34,8 @@ from ..types import ClientInfo, MatchInfo, Message, QoS, RouteMatcher
 from ..utils import topic as topic_util
 from ..utils.hlc import HLC
 from ..obs import OBS
+from ..obs.e2e import DELIVERY_PATH
+from ..utils.env import env_float
 from ..utils.metrics import STAGES
 from . import packets as pk
 from .protocol import (PROTOCOL_MQTT5, PropertyId, ReasonCode,
@@ -440,6 +442,7 @@ class Session:
         self.closed = True
         self.session_registry.unregister(self)
         self.local_registry.unregister(self)
+        OBS.e2e.drop_watermark(self.session_id)
         for tf, sub in list(self.subscriptions.items()):
             await self._unroute(sub)
         self.subscriptions.clear()
@@ -770,6 +773,10 @@ class Session:
             self.events.report(Event(
                 EventType.SHED_QOS0, self.client_info.tenant_id,
                 {"topic": topic_s, "reason": "overload"}))
+            # ISSUE 20: a shed publish is messages NOT delivered — the
+            # tenant's SLO budget pays for it
+            OBS.record_delivery_violation(self.client_info.tenant_id, 0,
+                                          "shed")
             return
         try:
             if p.qos > 0:
@@ -1155,6 +1162,33 @@ class Session:
     # rather than awaited (slow-consumer isolation)
     SEND_BUFFER_HIGH_WATER = 512 * 1024
 
+    # one SLOW_CONSUMER event per continuous above-water episode
+    _slow_over_flagged = False
+
+    def _watch_write_buffer(self) -> int:
+        """Write-buffer watermark watch (ISSUE 20 satellite): returns
+        the outbound buffer size while tracking this connection's
+        continuous time above ``SEND_BUFFER_HIGH_WATER``; crossing
+        ``BIFROMQ_SLOW_CONSUMER_S`` emits one ``SLOW_CONSUMER`` event
+        per episode (cardinality bounded in the e2e plane)."""
+        transport = getattr(self.conn.writer, "transport", None)
+        if transport is None:
+            return 0
+        size = transport.get_write_buffer_size()
+        over_s = OBS.e2e.note_watermark(
+            self.session_id, size > self.SEND_BUFFER_HIGH_WATER)
+        if over_s <= 0.0:
+            self._slow_over_flagged = False
+        elif (not self._slow_over_flagged
+              and over_s >= env_float("BIFROMQ_SLOW_CONSUMER_S", 1.0)):
+            self._slow_over_flagged = True
+            OBS.e2e.slow_consumer_events += 1
+            self.events.report(Event(
+                EventType.SLOW_CONSUMER, self.client_info.tenant_id,
+                {"client_id": self.client_id, "buffer_bytes": size,
+                 "over_s": round(over_s, 3)}))
+        return size
+
     async def _send_publish(self, topic: str, msg: Message,
                             sub: Subscription, retained: bool = False,
                             publisher=None):
@@ -1163,6 +1197,16 @@ class Session:
         ``publisher`` is the originating ClientInfo when the caller knows
         it (live fan-out); None on retained/inbox replay."""
         qos = min(int(msg.pub_qos), sub.qos)
+        # ISSUE 20: delivery-path attribution for the e2e plane. The
+        # contextvar carries what only the entry point knows (remote RPC
+        # hop, inbox replay); retained/shared-sub are decided right here.
+        e2e_path = DELIVERY_PATH.get()
+        if e2e_path == "local_fanout":
+            if retained:
+                e2e_path = "retained"
+            elif sub.matcher is not None and sub.matcher.is_shared:
+                e2e_path = "shared_sub"
+        tenant = self.client_info.tenant_id
         remaining_expiry = None
         if msg.expiry_seconds != 0xFFFFFFFF:
             # [MQTT-3.3.2-5]: drop once the expiry interval has elapsed;
@@ -1177,6 +1221,7 @@ class Session:
                      else EventType.QOS2_DROPPED),
                     self.client_info.tenant_id,
                     {"topic": topic, "reason": "message_expired"}))
+                OBS.record_delivery_violation(tenant, qos, "expired")
                 return None
         retain_flag = (retained if not sub.retain_as_published
                        else (msg.is_retain or retained))
@@ -1258,6 +1303,7 @@ class Session:
                     EventType.OVERSIZE_PACKET_DROPPED,
                     self.client_info.tenant_id,
                     {"topic": topic, "limit": self._client_max_packet}))
+                OBS.record_delivery_violation(tenant, qos, "oversize")
                 return None
 
         def aliased(base_props):
@@ -1276,14 +1322,12 @@ class Session:
             # drain: one slow consumer must never stall the fan-out loop
             # for its siblings (≈ MQTTTransientSessionHandler's
             # channel-writability drop + Discard event)
-            transport = getattr(self.conn.writer, "transport", None)
-            if (transport is not None
-                    and transport.get_write_buffer_size()
-                    > self.SEND_BUFFER_HIGH_WATER):
+            if self._watch_write_buffer() > self.SEND_BUFFER_HIGH_WATER:
                 self.events.report(Event(
                     EventType.DISCARD, self.client_info.tenant_id,
                     {"topic": topic, "client_id": self.client_id,
                      "reason": "channel_unwritable"}))
+                OBS.record_delivery_violation(tenant, 0, "discard")
                 return None
             wire_topic, wprops = aliased(props)
             await self.conn.send(pk.Publish(topic=wire_topic,
@@ -1296,6 +1340,8 @@ class Session:
             self.events.report(Event(EventType.DELIVERED,
                                      self.client_info.tenant_id,
                                      {"topic": topic, "qos": 0}))
+            # ISSUE 20: full-population publish→socket-write latency
+            OBS.record_delivery(tenant, 0, e2e_path, msg.timestamp)
             return None
         pid = None
         if self._recv_quota.has_room(len(self._outbound)):
@@ -1308,7 +1354,9 @@ class Session:
                                          self.client_info.tenant_id,
                                          {"topic": topic,
                                           "reason": "recv_max"}))
+                OBS.record_delivery_violation(tenant, qos, "recv_max")
             return BLOCKED
+        self._watch_write_buffer()
         wire_topic, wprops = aliased(props)
         publish = pk.Publish(topic=wire_topic, payload=msg.payload, qos=qos,
                              retain=retain_flag, packet_id=pid,
@@ -1333,6 +1381,8 @@ class Session:
         self.events.report(Event(EventType.DELIVERED,
                                  self.client_info.tenant_id,
                                  {"topic": topic, "qos": qos}))
+        # ISSUE 20: full-population publish→socket-write latency
+        OBS.record_delivery(tenant, qos, e2e_path, msg.timestamp)
         return pid
 
     def _on_puback(self, pid: int) -> None:

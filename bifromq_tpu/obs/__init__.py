@@ -34,7 +34,9 @@ from typing import Callable, Optional
 
 from ..utils.env import (env_bool as _env_bool, env_float as _env_float,
                          env_str as _env_str)
+from .burnrate import BurnRateEngine
 from .device import DeviceGauges
+from .e2e import E2EPlane, ShardCompletionBoard
 from .exporter import FileSink, HTTPSink, TelemetryExporter
 from .neighbor import NoisyNeighborDetector
 from .profiler import CompileLedger, ContinuousProfiler
@@ -61,6 +63,10 @@ class ObsHub:
             slow_p99_ms=_env_float("BIFROMQ_OBS_SLO_MS", 1000.0),
             clock=clock)
         self.device = DeviceGauges(clock=clock)
+        # ISSUE 20: full-population publish→deliver latency plane +
+        # multi-window burn-rate SLO engine riding the same clock
+        self.e2e = E2EPlane(window_s=ws, clock=clock)
+        self.burnrate = BurnRateEngine(clock=clock)
         # ISSUE 8: always-on continuous profiler (per-batch stage split,
         # padding/dedup/cache efficiency, compile-event ledger) — wall
         # clock, not the hub's monotonic: its records persist across
@@ -76,6 +82,8 @@ class ObsHub:
         # ISSUE 18: delta-plane event journal drain (lag transitions,
         # parity audits, autoscaler decisions) into the same store
         self._store_repl_cursor = -1
+        # ISSUE 20: SLO burn/recovery journal drain
+        self._store_slo_cursor = -1
         self.exporter: Optional[TelemetryExporter] = None
         self._exporter_refs = 0
         self._registry_ref = None       # weakref to a MetricsRegistry
@@ -124,13 +132,35 @@ class ObsHub:
         if self.enabled and (hits or misses):
             self.windows.record_match_cache(tenant, hits, misses)
 
+    def record_delivery(self, tenant: str, qos: int, path: str,
+                        publish_hlc: int) -> None:
+        """ISSUE 20: one delivered message's publish-HLC→socket-write
+        latency — full population, every delivery site calls this."""
+        if self.enabled:
+            seconds = self.e2e.record(tenant, qos, path, publish_hlc)
+            # a retained replay's "latency" is the retained message's AGE
+            # (publish may predate the SUBSCRIBE by hours) — it counts
+            # toward delivery success but never as a latency-target miss
+            self.burnrate.observe(
+                tenant, 0.0 if path == "retained" else seconds)
+
+    def record_delivery_violation(self, tenant: str, qos: int,
+                                  reason: str) -> None:
+        """ISSUE 20: a delivery that failed (expiry/discard/drop/shed/
+        overflow) — counted against the tenant's SLO budget."""
+        if self.enabled:
+            self.e2e.record_violation(tenant, qos, reason)
+            self.burnrate.observe_violation(tenant)
+
     # ---------------- wiring ------------------------------------------------
 
     def bind_events(self, collector) -> None:
-        """Give the detector an event outlet (NOISY_TENANT/SLOW_TENANT).
-        Called by MeteringEventCollector so offender events ride the same
-        stream operators already collect."""
+        """Give the detector an event outlet (NOISY_TENANT/SLOW_TENANT)
+        and the burn engine its SLO_BURN/SLO_RECOVERED outlet. Called by
+        MeteringEventCollector so offender events ride the same stream
+        operators already collect."""
         self.detector.events = collector
+        self.burnrate.events = collector
 
     def register_pub_cache(self, cache) -> None:
         """ISSUE 12: the dist service registers its pub-side match cache
@@ -216,6 +246,11 @@ class ObsHub:
         """Throttler advisory: is this tenant currently flagged noisy?"""
         return self.enabled and self.detector.is_noisy(tenant)
 
+    def is_burning(self, tenant: str) -> bool:
+        """Shedder advisory (ISSUE 20): is this tenant's SLO budget
+        burning? A set probe — evaluation happens on the advisory tick."""
+        return self.enabled and self.burnrate.is_burning(tenant)
+
     def set_identity(self, node_id: Optional[str] = None,
                      cluster_id: Optional[str] = None) -> None:
         """Pin the node/cluster identity federated sinks attribute by."""
@@ -282,6 +317,11 @@ class ObsHub:
         bound registry's monotonic counters (when still alive)."""
         out = {"slo": self.windows.snapshot() if self.enabled else {},
                "device": self.device_snapshot(memory=False)}
+        if self.enabled:
+            # ISSUE 20: e2e latency distributions + burn-rate state ride
+            # every exporter metrics record in both framings
+            out["e2e"] = self.e2e.snapshot()
+            out["slo_burn"] = self.burnrate.snapshot()
         reg = self._registry_ref() if self._registry_ref else None
         if reg is not None:
             try:
@@ -431,6 +471,13 @@ class ObsHub:
             REPL_EVENTS.since(self._store_repl_cursor)
         for e in evs:
             out.append({"type": "repl_event", **e})
+        # ISSUE 20: SLO burn/recovery transitions — the post-hoc reader
+        # lines budget burns up against the profile/span records
+        from .burnrate import SLO_EVENTS
+        sevs, self._store_slo_cursor = \
+            SLO_EVENTS.since(self._store_slo_cursor)
+        for e in sevs:
+            out.append({"type": "slo_event", **e})
         if out:
             # one summary record per flush stamps the aggregate view the
             # post-hoc reader anchors on; probe=False — this runs on the
@@ -481,6 +528,13 @@ class ObsHub:
                 except Exception:  # noqa: BLE001 — telemetry must not die
                     import logging
                     logging.getLogger(__name__).exception("advisory tick")
+                try:
+                    # ISSUE 20: burn-rate transitions fire off-path here
+                    # (same decay argument: windows must keep clearing)
+                    self.burnrate.evaluate()
+                except Exception:  # noqa: BLE001 — telemetry must not die
+                    import logging
+                    logging.getLogger(__name__).exception("burn evaluate")
                 for cb in list(self._tick_hooks):
                     try:
                         cb()
@@ -518,9 +572,14 @@ class ObsHub:
         self._store_slow_cursor = 0
         self._store_ledger_cursor = 0
         self._store_repl_cursor = -1
+        self._store_slo_cursor = -1
         from .lag import LAG, REPL_EVENTS
         LAG.reset()
         REPL_EVENTS.reset()
+        self.e2e.reset()
+        self.burnrate.reset()
+        from .burnrate import SLO_EVENTS
+        SLO_EVENTS.reset()
 
 
 # the process-global hub every instrumentation site reports into
@@ -532,5 +591,6 @@ __all__ = [
     "OBS", "ObsHub", "TenantSLO", "NoisyNeighborDetector", "DeviceGauges",
     "TelemetryExporter", "FileSink", "HTTPSink", "WindowedCounter",
     "WindowedLog2Histogram", "ContinuousProfiler", "CompileLedger",
-    "SegmentStore", "CampaignMonitor",
+    "SegmentStore", "CampaignMonitor", "E2EPlane", "BurnRateEngine",
+    "ShardCompletionBoard",
 ]

@@ -1,0 +1,328 @@
+"""Multi-window burn-rate SLO engine (ISSUE 20, tentpole part 2).
+
+Per-tenant **objectives** — a latency p99 target and a delivery-success
+ratio — are evaluated as error-budget *burn rates* over a fast and a
+slow window (the classic multi-window multi-burn-rate alerting shape):
+
+- the **success budget** is ``1 - success_target``: the fraction of
+  deliveries allowed to fail (drop/expire/shed). Its burn rate is the
+  observed violation ratio divided by that budget.
+- the **latency budget** is the 1% of deliveries allowed above the p99
+  target. Its burn rate is the observed over-target ratio divided by
+  0.01.
+
+A tenant's burn is the worse of the two. The alert fires only when
+**both** the fast and the slow window burn at or above the threshold —
+the fast window gives low detection latency, the slow window keeps a
+brief blip from paging — and clears (``SLO_RECOVERED``) only after the
+cooldown, so a flapping tenant emits one burn/recovery pair, not a
+stream.
+
+Feeding: the e2e plane's record points land here through
+``ObsHub.record_delivery`` / ``record_delivery_violation``; evaluation
+runs on the hub's advisory tick (off the hot path), events ride the
+broker's collector chain as ``SLO_BURN``/``SLO_RECOVERED`` and the
+bounded :data:`SLO_EVENTS` journal the exporter and segment store drain.
+
+``burning()``/``is_burning`` is the throttler/shedder advisory feed: the
+load shedder treats a burning tenant like a noisy one — its QoS0 traffic
+sheds first under device pressure, spending the budget where the SLO is
+already lost.
+
+Knobs (env defaults, per-tenant overridable via ``PUT /obs`` and the
+starter YAML ``obs: slo:`` section): ``BIFROMQ_SLO_P99_MS``,
+``BIFROMQ_SLO_SUCCESS``, ``BIFROMQ_SLO_FAST_WINDOW_S``,
+``BIFROMQ_SLO_SLOW_WINDOW_S``, ``BIFROMQ_SLO_BURN_THRESHOLD``,
+``BIFROMQ_SLO_COOLDOWN_S``.
+
+Layering: must NOT import ``utils.metrics`` (import cycle).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set
+
+from ..plugin.events import Event, EventType
+from ..utils.env import env_float
+from .lag import EventJournal
+from .window import WindowedCounter
+
+# the bounded journal of burn/recovery transitions (exporter + segment
+# store drain it via the usual ``since`` cursor contract)
+SLO_EVENTS = EventJournal()
+
+
+class SLOObjective:
+    """One tenant's target pair. ``None`` fields inherit the defaults."""
+
+    __slots__ = ("p99_ms", "success")
+
+    def __init__(self, p99_ms: Optional[float] = None,
+                 success: Optional[float] = None) -> None:
+        self.p99_ms = p99_ms
+        self.success = success
+
+    def to_dict(self) -> dict:
+        return {"p99_ms": self.p99_ms, "success": self.success}
+
+
+class _TenantBurn:
+    """One tenant's windowed budget-burn state: (total, over-latency,
+    violation) counters over the fast and the slow window."""
+
+    __slots__ = ("fast_total", "fast_lat", "fast_viol",
+                 "slow_total", "slow_lat", "slow_viol",
+                 "burning", "since")
+
+    def __init__(self, fast_s: float, slow_s: float, clock) -> None:
+        self.fast_total = WindowedCounter(fast_s, 5, clock)
+        self.fast_lat = WindowedCounter(fast_s, 5, clock)
+        self.fast_viol = WindowedCounter(fast_s, 5, clock)
+        self.slow_total = WindowedCounter(slow_s, 5, clock)
+        self.slow_lat = WindowedCounter(slow_s, 5, clock)
+        self.slow_viol = WindowedCounter(slow_s, 5, clock)
+        self.burning = False
+        self.since: Optional[float] = None
+
+
+def _burn(total: float, lat_bad: float, viol: float,
+          success_target: float) -> float:
+    """Error-budget burn rate over one window: the worse of the success
+    and the latency budget spend. 1.0 = spending exactly at budget."""
+    if total <= 0:
+        return 0.0
+    success_budget = max(1e-6, 1.0 - success_target)
+    return max((viol / total) / success_budget,
+               (lat_bad / total) / 0.01)
+
+
+class BurnRateEngine:
+    """The per-tenant multi-window burn evaluator."""
+
+    def __init__(self, *, clock: Callable[[], float] = time.monotonic,
+                 max_tenants: int = 512) -> None:
+        self._clock = clock
+        self.max_tenants = int(max_tenants)
+        # window/threshold knobs resolve lazily per configure() so a
+        # PUT /obs or YAML override lands without a process restart;
+        # the env read happens here ONCE (hub-construction discipline)
+        self.fast_window_s = max(1.0, env_float(
+            "BIFROMQ_SLO_FAST_WINDOW_S", 60.0))
+        self.slow_window_s = max(self.fast_window_s, env_float(
+            "BIFROMQ_SLO_SLOW_WINDOW_S", 300.0))
+        self.burn_threshold = max(0.1, env_float(
+            "BIFROMQ_SLO_BURN_THRESHOLD", 2.0))
+        self.cooldown_s = max(0.0, env_float(
+            "BIFROMQ_SLO_COOLDOWN_S", 30.0))
+        self.default_p99_ms = env_float("BIFROMQ_SLO_P99_MS", 250.0)
+        self.default_success = min(0.99999, max(0.5, env_float(
+            "BIFROMQ_SLO_SUCCESS", 0.999)))
+        self._tenants: Dict[str, _TenantBurn] = {}
+        self._objectives: Dict[str, SLOObjective] = {}
+        self._burning: Set[str] = set()
+        self._lock = threading.Lock()
+        self.events = None          # IEventCollector outlet (bind_events)
+        self.journal = SLO_EVENTS
+
+    # ---------------- configuration ----------------------------------------
+
+    def configure(self, *, fast_window_s: Optional[float] = None,
+                  slow_window_s: Optional[float] = None,
+                  burn_threshold: Optional[float] = None,
+                  cooldown_s: Optional[float] = None,
+                  p99_ms: Optional[float] = None,
+                  success: Optional[float] = None) -> None:
+        """Runtime reconfiguration (``PUT /obs`` / starter YAML). A
+        window change rebuilds tenant state — slice rings cannot be
+        resized in place."""
+        rebuild = False
+        if fast_window_s is not None:
+            self.fast_window_s = max(1.0, float(fast_window_s))
+            rebuild = True
+        if slow_window_s is not None:
+            self.slow_window_s = float(slow_window_s)
+            rebuild = True
+        self.slow_window_s = max(self.fast_window_s, self.slow_window_s)
+        if burn_threshold is not None:
+            self.burn_threshold = max(0.1, float(burn_threshold))
+        if cooldown_s is not None:
+            self.cooldown_s = max(0.0, float(cooldown_s))
+        if p99_ms is not None:
+            self.default_p99_ms = max(1.0, float(p99_ms))
+        if success is not None:
+            self.default_success = min(0.99999, max(0.5, float(success)))
+        if rebuild:
+            with self._lock:
+                self._tenants.clear()
+
+    def configure_tenant(self, tenant: str,
+                         p99_ms: Optional[float] = None,
+                         success: Optional[float] = None) -> None:
+        self._objectives[tenant] = SLOObjective(
+            p99_ms=float(p99_ms) if p99_ms is not None else None,
+            success=(min(0.99999, max(0.5, float(success)))
+                     if success is not None else None))
+
+    def clear_tenant(self, tenant: str) -> None:
+        self._objectives.pop(tenant, None)
+
+    def objective(self, tenant: str) -> dict:
+        o = self._objectives.get(tenant)
+        return {"p99_ms": (o.p99_ms if o and o.p99_ms is not None
+                           else self.default_p99_ms),
+                "success": (o.success if o and o.success is not None
+                            else self.default_success)}
+
+    def _windows(self, tenant: str) -> _TenantBurn:
+        w = self._tenants.get(tenant)
+        if w is None:
+            with self._lock:
+                w = self._tenants.get(tenant)
+                if w is None:
+                    if len(self._tenants) >= self.max_tenants:
+                        evict = next(iter(self._tenants))
+                        self._tenants.pop(evict)
+                        self._burning.discard(evict)
+                    w = _TenantBurn(self.fast_window_s,
+                                    self.slow_window_s, self._clock)
+                    self._tenants[tenant] = w
+        return w
+
+    # ---------------- recording (hot path, via ObsHub) ----------------------
+
+    def observe(self, tenant: str, latency_s: float) -> None:
+        w = self._windows(tenant)
+        w.fast_total.add(1.0)
+        w.slow_total.add(1.0)
+        o = self._objectives.get(tenant)
+        p99_ms = (o.p99_ms if o is not None and o.p99_ms is not None
+                  else self.default_p99_ms)
+        if latency_s * 1000.0 > p99_ms:
+            w.fast_lat.add(1.0)
+            w.slow_lat.add(1.0)
+
+    def observe_violation(self, tenant: str) -> None:
+        w = self._windows(tenant)
+        w.fast_total.add(1.0)
+        w.slow_total.add(1.0)
+        w.fast_viol.add(1.0)
+        w.slow_viol.add(1.0)
+
+    # ---------------- evaluation (advisory tick) ----------------------------
+
+    def _burns(self, tenant: str, w: _TenantBurn) -> tuple:
+        succ = self.objective(tenant)["success"]
+        fast = _burn(w.fast_total.total(), w.fast_lat.total(),
+                     w.fast_viol.total(), succ)
+        slow = _burn(w.slow_total.total(), w.slow_lat.total(),
+                     w.slow_viol.total(), succ)
+        return fast, slow
+
+    def evaluate(self) -> List[dict]:
+        """Re-score every tracked tenant; emit transition events. Runs on
+        the hub advisory tick — never on the delivery hot path."""
+        now = self._clock()
+        transitions: List[dict] = []
+        for tenant in list(self._tenants):
+            w = self._tenants.get(tenant)
+            if w is None:
+                continue
+            fast, slow = self._burns(tenant, w)
+            over = (fast >= self.burn_threshold
+                    and slow >= self.burn_threshold)
+            if over and not w.burning:
+                w.burning = True
+                w.since = now
+                self._burning.add(tenant)
+                transitions.append(self._emit(
+                    EventType.SLO_BURN, tenant, fast, slow))
+            elif w.burning and not over:
+                # cooldown: hold the burning flag for at least
+                # cooldown_s after it was raised — one pair per episode
+                if w.since is None or now - w.since >= self.cooldown_s:
+                    w.burning = False
+                    w.since = None
+                    self._burning.discard(tenant)
+                    transitions.append(self._emit(
+                        EventType.SLO_RECOVERED, tenant, fast, slow))
+        return transitions
+
+    def _emit(self, etype: EventType, tenant: str,
+              fast: float, slow: float) -> dict:
+        obj = self.objective(tenant)
+        rec = self.journal.append(
+            etype.value, tenant=tenant,
+            fast_burn=round(fast, 3), slow_burn=round(slow, 3),
+            threshold=self.burn_threshold, objective=obj,
+            ts=round(time.time(), 3))
+        events = self.events
+        if events is not None:
+            try:
+                events.report(Event(etype, tenant, {
+                    "fast_burn": round(fast, 3),
+                    "slow_burn": round(slow, 3),
+                    "threshold": self.burn_threshold}))
+            except Exception:  # noqa: BLE001 — telemetry must not raise
+                pass
+        return rec
+
+    # ---------------- advisory + snapshots ----------------------------------
+
+    def burning(self) -> Set[str]:
+        return set(self._burning)
+
+    def is_burning(self, tenant: str) -> bool:
+        return tenant in self._burning
+
+    def snapshot_tenant(self, tenant: str) -> dict:
+        w = self._tenants.get(tenant)
+        if w is None:
+            return {}
+        fast, slow = self._burns(tenant, w)
+        return {"objective": self.objective(tenant),
+                "fast_burn": round(fast, 3),
+                "slow_burn": round(slow, 3),
+                "burning": w.burning,
+                "fast_total": w.fast_total.total(),
+                "slow_total": w.slow_total.total()}
+
+    def snapshot(self) -> dict:
+        tenants = {}
+        for tenant in list(self._tenants):
+            s = self.snapshot_tenant(tenant)
+            if s and (s["slow_total"] or s["burning"]):
+                tenants[tenant] = s
+        return {"fast_window_s": self.fast_window_s,
+                "slow_window_s": self.slow_window_s,
+                "burn_threshold": self.burn_threshold,
+                "cooldown_s": self.cooldown_s,
+                "defaults": {"p99_ms": self.default_p99_ms,
+                             "success": self.default_success},
+                "overrides": {t: o.to_dict()
+                              for t, o in self._objectives.items()},
+                "burning": sorted(self._burning),
+                "tenants": tenants}
+
+    def summary(self) -> dict:
+        """Compact gossip-digest field: who burns, and the worst pair."""
+        worst_t, worst = "", 0.0
+        for tenant in list(self._tenants):
+            w = self._tenants.get(tenant)
+            if w is None:
+                continue
+            fast, slow = self._burns(tenant, w)
+            score = min(fast, slow)      # alert condition is the min
+            if score > worst:
+                worst_t, worst = tenant, score
+        out: dict = {"burning": sorted(self._burning)}
+        if worst_t:
+            out["worst"] = {"tenant": worst_t, "burn": round(worst, 3)}
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._tenants.clear()
+            self._objectives.clear()
+            self._burning.clear()

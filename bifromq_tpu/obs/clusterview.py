@@ -260,6 +260,13 @@ class ClusterView:
         repl = self._replication_field()
         if repl:
             digest["replication"] = repl
+        # ISSUE 20: compact burn summary — which tenants burn their SLO
+        # budget on this node, and the worst burner; /cluster/slo
+        # federates these with no extra RPC plane. Omitted while no
+        # tenant burns to keep the UDP payload small.
+        slo = self._slo_field()
+        if slo.get("burning") or slo.get("worst"):
+            digest["slo"] = slo
         return digest
 
     @staticmethod
@@ -286,6 +293,14 @@ class ClusterView:
         try:
             from .lag import LAG
             return LAG.summary()
+        except Exception:  # noqa: BLE001 — telemetry must not raise
+            return {}
+
+    @staticmethod
+    def _slo_field() -> dict:
+        try:
+            from . import OBS
+            return OBS.burnrate.summary()
         except Exception:  # noqa: BLE001 — telemetry must not raise
             return {}
 

@@ -228,6 +228,9 @@ class TelemetryExporter:
         # incremental ring cursors (slow ring always; main ring optional)
         self._slow_cursor = 0
         self._ring_cursor = 0
+        # ISSUE 20: SLO burn/recovery journal cursor — events ship in
+        # both framings (otlp re-frames them as resourceLogs)
+        self._slo_cursor = -1
         # span ids already enqueued: a slow span lives in BOTH rings (and
         # a slow root's dragged-in children reach the slow ring a tick
         # after the sampled drain saw them) — dedupe so consumers never
@@ -266,6 +269,13 @@ class TelemetryExporter:
         if self.export_sampled:
             self._ring_cursor = self._drain(trace.TRACER.ring,
                                             self._ring_cursor, now)
+        try:
+            from .burnrate import SLO_EVENTS
+            evs, self._slo_cursor = SLO_EVENTS.since(self._slo_cursor)
+            for e in evs:
+                self.enqueue({"type": "slo_event", "ts": now, **e})
+        except Exception:  # noqa: BLE001 — telemetry must not raise
+            pass
 
     def _drain(self, ring, cursor: int, now: float) -> int:
         """Incrementally drain one span ring into the queue; returns the

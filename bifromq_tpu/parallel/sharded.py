@@ -66,7 +66,10 @@ from ..ops.match import (
     _pad_patch_idx, _route_walk, device_expand_enabled, expand_cap_lanes,
     expand_intervals, route_cols_from_node_tab,
 )
+from ..obs import OBS
+from ..obs.e2e import ShardCompletionBoard
 from ..utils.env import env_bool
+from ..utils.hlc import HLC
 from ..utils.metrics import STAGES
 
 REPLICA_AXIS = "replica"
@@ -857,6 +860,10 @@ class MeshMatcher(TpuMatcher):
         # tenant→shard pins; the serving snapshot routes by ITS OWN pin
         # copy until a recompile swaps the new assignment in
         self._pins: Dict[str, int] = {}
+        # ISSUE 20: per-shard dispatch→ready completion rows — a hung
+        # device is NAMED in /mesh and the e2e degraded attribution, and
+        # recent ready history feeds half-open canary deadline hints
+        self.completion = ShardCompletionBoard()
         # ISSUE 16 split dispatch: sub-mesh + group-table caches keyed on
         # the shard column set (one trace / one upload per healthy-mask
         # class, invalidated by compile epoch + flush count)
@@ -1227,7 +1234,8 @@ class MeshMatcher(TpuMatcher):
             return {"n_replicas": self.n_replicas, "n_shards": self.n_shards,
                     "map_version": 0, "shard_load": [], "skew": 1.0,
                     "migrating": {}, "migrations": migration_digest(self),
-                    "pins": {}, "replicated": []}
+                    "pins": {}, "replicated": [],
+                    "completion": self.completion.snapshot()}
         model = ShardLoadModel()
         rows = model.rows(self)
         return {"n_replicas": self.n_replicas,
@@ -1241,7 +1249,9 @@ class MeshMatcher(TpuMatcher):
                 # tallies (the mesh.migrations digest subfield)
                 "migrations": migration_digest(self),
                 "pins": dict(base.pins or {}),
-                "replicated": sorted(base.replicated or ())}
+                "replicated": sorted(base.replicated or ()),
+                # ISSUE 20: per-shard dispatch→ready rows + hung naming
+                "completion": self.completion.snapshot()}
 
     # ---------------- staged serving path (ISSUE 15 tentpole) --------------
     #
@@ -1622,6 +1632,59 @@ class MeshMatcher(TpuMatcher):
             dispatch_s=dispatch_s, tokenize_s=prep.tokenize_s,
             quarantine_tag=tag)
 
+    def _note_shard_ready(self, sh: int, dt: float,
+                          start_hlc: int = 0) -> None:
+        """One completion row (ISSUE 20): per-shard dispatch→ready timing
+        into the stage histogram + the board (deferred span like the
+        batcher's queue-wait — duration is only known at readiness); a
+        previously-hung shard that serves again clears its degraded
+        attribution."""
+        STAGES.record("device.shard_ready", dt)
+        trace.record_finished("device.shard_ready", trace.current_ctx(),
+                              start_hlc=start_hlc, duration_s=dt,
+                              tags={"shard": sh})
+        self.completion.note_ready(sh, dt)
+        OBS.e2e.clear_degraded(f"mesh:shard{sh}")
+
+    async def _await_ready_shards(self, ring, fl) -> None:
+        """Non-split readiness with PER-SHARD completion attribution
+        (ISSUE 20 tentpole part 3): every dispatched shard polls the
+        same collective leaves under its OWN chaos-rule view, so the
+        board gets one dispatch→ready row per shard and a timeout NAMES
+        the hung shard(s) instead of raising an anonymous step-wide
+        error. The collective still completes (or times out) as one
+        step — attribution costs concurrent polls, never extra syncs."""
+        from ..resilience.device import (DeviceTimeoutError,
+                                         device_deadline_s)
+        shards = list(fl.dispatch_shards or ())
+        if len(shards) <= 1:
+            t0, shlc = time.monotonic(), HLC.INST.get()
+            await ring.wait_ready(fl.res, fault=fl.fault)
+            dt = time.monotonic() - t0
+            for sh in shards:
+                self._note_shard_ready(sh, dt, shlc)
+            return
+        deadline = device_deadline_s()
+        t0, shlc = time.monotonic(), HLC.INST.get()
+        hung: List[int] = []
+
+        async def wait_shard(sh: int) -> None:
+            try:
+                await ring.wait_ready(
+                    fl.res, deadline_s=deadline,
+                    fault=fl.fault_shards.get(sh, fl.fault))
+                self._note_shard_ready(sh, time.monotonic() - t0, shlc)
+            except DeviceTimeoutError:
+                hung.append(sh)
+        await asyncio.gather(*(wait_shard(sh) for sh in shards))
+        if hung:
+            for sh in sorted(hung):
+                self.completion.note_hung(sh, "deadline")
+                OBS.e2e.set_degraded(f"mesh:shard{sh}", "device_timeout")
+            raise DeviceTimeoutError(
+                deadline or 0.0,
+                " (shard%s)" % ",".join(str(sh) for sh in sorted(hung)))
+
     async def _await_ready(self, ring, fl) -> None:
         """Per-group readiness waits under PER-SHARD deadlines (ISSUE 16):
         a hung group is indicted alone — its leaves go to quarantine
@@ -1631,18 +1694,28 @@ class MeshMatcher(TpuMatcher):
         DeviceTimeoutError the base leg already handles."""
         res = fl.res
         if not isinstance(res, _SplitMeshResult):
-            await super()._await_ready(ring, fl)
+            await self._await_ready_shards(ring, fl)
             return
         if not res.groups:
             return
         from ..resilience.device import (DeviceTimeoutError,
                                          shard_deadline_s)
         deadline = shard_deadline_s()
+        t0, shlc = time.monotonic(), HLC.INST.get()
 
         async def wait_group(g: _SplitGroup) -> None:
+            # ISSUE 20: a half-open canary probes alone under a deadline
+            # scaled to ITS OWN recent completion history (never looser
+            # than the configured shard deadline)
+            gd = deadline
+            if len(g.shards) == 1 and g.shards[0] in fl.canaries.pending:
+                gd = self.completion.deadline_hint(g.shards[0], deadline)
             try:
-                await ring.wait_ready(g.res, deadline_s=deadline,
+                await ring.wait_ready(g.res, deadline_s=gd,
                                       fault=g.fault)
+                dt = time.monotonic() - t0
+                for sh in g.shards:
+                    self._note_shard_ready(sh, dt, shlc)
             except DeviceTimeoutError:
                 g.failed = True
         await asyncio.gather(*(wait_group(g) for g in res.groups))
@@ -1670,6 +1743,10 @@ class MeshMatcher(TpuMatcher):
                 if br is not None:
                     br.record_failure("shard group timeout")
                     fl.canaries.settle(sh)
+                # ISSUE 20: the hung shard is NAMED on the completion
+                # board and in the e2e plane's degraded attribution
+                self.completion.note_hung(sh, "group timeout")
+                OBS.e2e.set_degraded(f"mesh:shard{sh}", "shard_group_timeout")
             for sh in g.shards:
                 for rep in range(self.n_replicas):
                     fl.oracle_qis.extend(fl.slots[rep * s + sh])
@@ -1731,6 +1808,10 @@ class MeshMatcher(TpuMatcher):
             if br is not None:
                 br.record_failure("mesh step timeout")
                 fl.canaries.settle(sh)
+            # ISSUE 20: name the implicated shard(s) on the completion
+            # board (idempotent when _await_ready already did)
+            self.completion.note_hung(sh, "mesh step timeout")
+            OBS.e2e.set_degraded(f"mesh:shard{sh}", "device_timeout")
         # canary shards not implicated got no verdict: hand the probe
         # slot back so the breaker can re-probe on the next batch
         for sh, br in list(fl.canaries.pending.items()):

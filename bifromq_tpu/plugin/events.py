@@ -167,6 +167,14 @@ class EventType(enum.Enum):
     # record at the same cursor (ISSUE 18, repo-specific): the continuous
     # parity auditor caught replica divergence — one bounded resync heals
     PARITY_DIVERGENCE = "parity_divergence"
+    # delivery-SLO burn-rate transitions (ISSUE 20, repo-specific): a
+    # tenant's fast AND slow window error-budget burn crossed the alert
+    # threshold / recovered after the cooldown
+    SLO_BURN = "slo_burn"
+    SLO_RECOVERED = "slo_recovered"
+    # a connection held its write buffer above SEND_BUFFER_HIGH_WATER
+    # continuously past the slow-consumer threshold (ISSUE 20 satellite)
+    SLOW_CONSUMER = "slow_consumer"
 
 
 @dataclass

@@ -383,7 +383,11 @@ class LoadShedder:
             return False
         if score < 2 * self.level1:
             from ..obs import OBS
-            if not OBS.is_noisy(tenant):
+            # ISSUE 20 advisory feed: between level1 and 2×level1 only
+            # tenants flagged noisy OR already burning their SLO budget
+            # shed — a burning tenant's QoS0 loss is already priced into
+            # its budget, so the spend lands where the SLO is lost
+            if not (OBS.is_noisy(tenant) or OBS.is_burning(tenant)):
                 return False
         self._record(tenant)
         return True

@@ -85,6 +85,15 @@ class Standalone:
               weights: {fanout: 0.4, queue_wait: 0.4, errors: 0.2}
               tenants:
                 latency-sensitive-tenant: {slow_p99_ms: 150}
+              slo:                       # ISSUE 20: burn-rate objectives
+                p99_ms: 250
+                success: 0.999
+                fast_window_s: 60
+                slow_window_s: 300
+                burn_threshold: 2.0
+                cooldown_s: 30
+                tenants:
+                  paying-tenant: {p99_ms: 100, success: 0.9999}
         """
         from .obs import OBS
         det = OBS.detector
@@ -101,6 +110,18 @@ class Standalone:
         for tenant, knobs in (ocfg.get("tenants") or {}).items():
             det.configure_tenant(str(tenant),
                                  **{k: float(v)
+                                    for k, v in (knobs or {}).items()})
+        slo = ocfg.get("slo") or {}
+        if slo:
+            defaults = {k: float(slo[k])
+                        for k in ("p99_ms", "success", "fast_window_s",
+                                  "slow_window_s", "burn_threshold",
+                                  "cooldown_s") if k in slo}
+            if defaults:
+                OBS.burnrate.configure(**defaults)
+            for tenant, knobs in (slo.get("tenants") or {}).items():
+                OBS.burnrate.configure_tenant(
+                    str(tenant), **{k: float(v)
                                     for k, v in (knobs or {}).items()})
 
     @staticmethod

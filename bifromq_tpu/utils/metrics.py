@@ -100,6 +100,9 @@ KNOWN_STAGES = frozenset({
     "tokenize",         # ISSUE 11: byte-plane topic prep + probe upload
     "device.dispatch",  # matcher walk enqueue cost
     "device.ready",     # in-flight walk awaited on readiness
+    # ISSUE 20: per-shard dispatch→ready completion rows (mesh steps
+    # record one per dispatched shard — the /mesh hung-device naming)
+    "device.shard_ready",
     "device.fetch",     # final host copy
     "device.expand",    # ISSUE 19: fan-out expansion + peer-bucket enqueue
     "deliver",          # dist/service fan-out

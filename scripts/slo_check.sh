@@ -1,0 +1,242 @@
+#!/usr/bin/env bash
+# Tier-2 delivery-SLO gate (ISSUE 20): the e2e latency plane, the
+# multi-window burn-rate engine, and per-shard completion attribution,
+# end to end through a live broker + API. Asserts:
+#   1. BURN LIFECYCLE — real deliveries attribute per path and feed the
+#      burn denominator; a driven violation storm fires SLO_BURN (fast
+#      AND slow windows over threshold), surfaces on GET /slo,
+#      GET /tenants/<id> and GET /cluster/slo, feeds the shedder
+#      advisory, and recovers with exactly one SLO_RECOVERED after the
+#      storm clears the slow window + cooldown,
+#   2. SHARD ATTRIBUTION — an injected device hang (tpu-device fault
+#      rule) on one mesh shard NAMES that shard: hung in the /mesh
+#      completion board, mesh:shard<k> in the e2e degraded set; both
+#      clear after the rule is removed and the canary re-closes,
+#   3. OTLP FRAMING — slo_event records ship through the exporter in
+#      both framings; the OTLP lines validate against
+#      scripts/otlp_schema.json (resourceLogs envelope).
+# Runs on CPU (JAX_PLATFORMS=cpu), hard timeout like the other gates.
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+
+timeout -k 10 "${SLO_CHECK_TIMEOUT:-420}" \
+    env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    BIFROMQ_DEVICE_DEADLINE_S=0.3 \
+    python - <<'EOF'
+import asyncio, json, os, time
+
+from bifromq_tpu.obs import OBS, FileSink, TelemetryExporter
+from bifromq_tpu.obs.burnrate import SLO_EVENTS
+from bifromq_tpu.utils.hlc import HLC
+
+
+async def http(port, method, path):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"{method} {path} HTTP/1.1\r\nhost: x\r\n"
+                 f"content-length: 0\r\nconnection: close\r\n\r\n"
+                 .encode())
+    await writer.drain()
+    raw = await reader.read(524288)
+    writer.close()
+    head, _, payload = raw.partition(b"\r\n\r\n")
+    return int(head.split(b" ")[1]), json.loads(payload)
+
+
+def check(ok, msg):
+    if not ok:
+        raise SystemExit(f"[slo_check] FAILED: {msg}")
+    print(f"[slo_check] ok: {msg}")
+
+
+async def main():
+    from bifromq_tpu.apiserver import APIServer
+    from bifromq_tpu.mqtt.broker import MQTTBroker
+    from bifromq_tpu.mqtt.client import MQTTClient
+
+    OBS.reset()
+    OBS.enabled = True
+    broker = MQTTBroker(port=0)
+    await broker.start()
+    api = APIServer(broker, port=0)
+    await api.start()
+
+    # ---- 1. burn lifecycle through the API ------------------------------
+    sub = MQTTClient(port=broker.port, client_id="s1", username="good/s")
+    await sub.connect()
+    await sub.subscribe("a/t", qos=1)
+    pub = MQTTClient(port=broker.port, client_id="p1", username="good/p")
+    await pub.connect()
+    # warm the match path first: the FIRST publish pays the device
+    # kernel compile (seconds on CPU) — a real latency the e2e plane
+    # faithfully records, but not the steady state this gate scores
+    await pub.publish("a/t", b"warm", qos=0)
+    await sub.recv()
+
+    code, out = await http(
+        api.port, "PUT",
+        "/obs?slo_fast_window_s=1&slo_slow_window_s=2"
+        "&slo_cooldown_s=0.5&slo_burn_threshold=2")
+    check(code == 200 and out["slo"]["fast_window_s"] == 1.0,
+          "PUT /obs installs burn knobs (clears pre-warm burn state)")
+    OBS.e2e.reset()
+
+    for i in range(20):
+        await pub.publish("a/t", b"x", qos=i % 2)
+    for _ in range(20):
+        await sub.recv()
+    code, out = await http(api.port, "GET", "/slo")
+    paths = out["e2e"]["tenants"]["good"]["paths"]["local_fanout"]
+    check(paths["qos0"]["count"] == 10 and paths["qos1"]["count"] == 10,
+          "full-population e2e attribution per (path, qos)")
+
+    # violation storm: every record is a delivery the victim never got
+    for _ in range(50):
+        OBS.record_delivery_violation("victim", 0, "shed")
+    OBS.burnrate.evaluate()
+    code, out = await http(api.port, "GET", "/slo")
+    check("victim" in out["burn"]["burning"]
+          and any(e["kind"] == "slo_burn" for e in out["events"]),
+          "violation storm fires SLO_BURN on GET /slo")
+    check(OBS.is_burning("victim"), "shedder advisory sees the burn")
+    code, out = await http(api.port, "GET", "/tenants/victim")
+    check(code == 200 and out["burn"]["burning"], "/tenants/<id> burn")
+    code, out = await http(api.port, "GET", "/cluster/slo")
+    check("victim" in out["burning"], "/cluster/slo federates the burn")
+    check("good" not in out["burning"], "healthy tenant never burns")
+
+    # storm clears: slow window (2s) + cooldown drain, then recovery
+    deadline = time.monotonic() + 15.0
+    recovered = False
+    while time.monotonic() < deadline and not recovered:
+        await asyncio.sleep(0.5)
+        OBS.burnrate.evaluate()
+        recovered = not OBS.is_burning("victim")
+    kinds = [e["kind"] for e in SLO_EVENTS.tail(100)
+             if e["tenant"] == "victim"]
+    check(recovered and kinds == ["slo_burn", "slo_recovered"],
+          f"one burn episode, one recovery ({kinds})")
+
+    # ---- 2. injected device hang names the shard ------------------------
+    from bifromq_tpu.models.oracle import Route
+    from bifromq_tpu.parallel.sharded import MeshMatcher, make_mesh
+    from bifromq_tpu.resilience.faults import get_injector
+    from bifromq_tpu.types import RouteMatcher
+
+    def rt(tf, i):
+        return Route(matcher=RouteMatcher.from_topic_filter(tf),
+                     broker_id=0, receiver_id=f"r{i}",
+                     deliverer_key=f"d{i}", incarnation=0)
+
+    m = MeshMatcher(mesh=make_mesh(1, 4), max_levels=8, k_states=16,
+                    match_cache=False, auto_compact=False)
+    tens = [f"t{i}" for i in range(24)]
+    for i, t in enumerate(tens):
+        m.add_route(t, rt(f"a/{i}/+", i))
+    m.refresh()
+    sick = m._base_ct.shard_of("t0")
+    inj = get_injector()
+    rule = inj.add_rule(service="tpu-device",
+                        method=f"mesh:shard{sick}", action="hang",
+                        side="device")
+    qs = [(t, f"a/{i}/x") for i, t in enumerate(tens)]
+    try:
+        for _ in range(4):
+            await m.match_batch_async(qs)
+    finally:
+        inj.remove_rule(rule)
+    code, out = await http(api.port, "GET", "/mesh")
+    comp = next(s["completion"] for s in out["meshes"]
+                if "completion" in s)
+    check(sick in comp["hung"]
+          and comp["shards"][str(sick)]["hung"] is True,
+          f"hung device NAMED in /mesh completion (shard {sick})")
+    code, out = await http(api.port, "GET", "/slo")
+    check(f"mesh:shard{sick}" in out["e2e"]["degraded"],
+          "e2e degraded attribution names mesh:shard%d" % sick)
+
+    # recovery: rule gone, canary re-closes, rows note ready again
+    m.shard_breakers[sick].recovery_time = 0.0
+    await m.match_batch_async(qs)
+    check(m.shard_breakers[sick].state == "closed", "canary re-closed")
+    code, out = await http(api.port, "GET", "/mesh")
+    comp = next(s["completion"] for s in out["meshes"]
+                if "completion" in s)
+    check(comp["hung"] == [], "completion board clears after recovery")
+    code, out = await http(api.port, "GET", "/slo")
+    check(f"mesh:shard{sick}" not in out["e2e"]["degraded"],
+          "degraded attribution clears after recovery")
+
+    # ---- 3. OTLP framing of slo_event records ---------------------------
+    otlp_path = "/tmp/slo_check_otlp.jsonl"
+    try:
+        os.unlink(otlp_path)
+    except FileNotFoundError:
+        pass
+    exp = TelemetryExporter(FileSink(otlp_path), interval_s=60,
+                            framing="otlp",
+                            resource=OBS.resource_envelope())
+    await exp._flush_once()      # drains the SLO journal from phase 1
+
+    schema = json.load(open("scripts/otlp_schema.json"))
+
+    def validate(obj, sch, path="$"):
+        if "oneOf" in sch:
+            errs = []
+            for i, branch in enumerate(sch["oneOf"]):
+                try:
+                    validate(obj, branch, f"{path}<{i}>")
+                    return
+                except AssertionError as e:
+                    errs.append(str(e))
+            raise AssertionError(f"{path}: no oneOf branch matched: "
+                                 + " | ".join(errs))
+        t = sch.get("type")
+        if t:
+            pytype = {"object": dict, "array": list, "string": str,
+                      "number": (int, float), "boolean": bool}[t]
+            assert isinstance(obj, pytype), f"{path}: not {t}"
+        for req in sch.get("required", ()):
+            assert req in obj, f"{path}: missing {req!r}"
+        for k, sub in sch.get("properties", {}).items():
+            if isinstance(obj, dict) and k in obj:
+                validate(obj[k], sub, f"{path}.{k}")
+        if "items" in sch and isinstance(obj, list):
+            assert len(obj) >= sch.get("minItems", 0), \
+                f"{path}: fewer than minItems"
+            for i, el in enumerate(obj):
+                validate(el, sch["items"], f"{path}[{i}]")
+
+    lines = [ln for ln in open(otlp_path).read().splitlines() if ln]
+    check(bool(lines), "otlp exporter wrote envelopes")
+    slo_bodies = 0
+    for ln in lines:
+        obj = json.loads(ln)
+        validate(obj, schema)
+        for rl in obj.get("resourceLogs", []):
+            for sl in rl.get("scopeLogs", []):
+                for rec in sl.get("logRecords", []):
+                    body = rec.get("body", {}).get("stringValue", "")
+                    if '"slo_burn"' in body or '"slo_recovered"' in body:
+                        slo_bodies += 1
+    check(slo_bodies >= 2,
+          f"{slo_bodies} slo_event records validate against "
+          f"scripts/otlp_schema.json")
+
+    for c in (sub, pub):
+        await c.disconnect()
+    await api.stop()
+    broker.inbox.close()
+    await broker.stop()
+    OBS.reset()
+    print("[slo_check] PASS")
+
+
+asyncio.run(main())
+EOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "[slo_check] FAIL (rc=$rc)"
+    exit $rc
+fi

@@ -1,0 +1,462 @@
+"""Delivery SLO plane tests (ISSUE 20): multi-window burn-rate
+determinism under a fake clock (fast/slow interplay, cooldown,
+recovery), e2e publish→deliver path/qos attribution through a real
+broker (local fan-out, shared group, retained replay, inbox replay,
+remote hop), the negative-skew clamp, the write-buffer watermark watch,
+per-shard completion rows, the record-overhead bound, and the /slo +
+PUT /obs API surface."""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from bifromq_tpu.obs import OBS
+from bifromq_tpu.obs.burnrate import SLO_EVENTS, BurnRateEngine
+from bifromq_tpu.obs.e2e import E2EPlane, ShardCompletionBoard
+from bifromq_tpu.utils.hlc import HLC
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    OBS.reset()
+    OBS.enabled = True
+    yield
+    OBS.reset()
+    OBS.enabled = True
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# burn-rate engine: multi-window determinism under a fake clock
+# ---------------------------------------------------------------------------
+
+class TestBurnRate:
+    def _engine(self):
+        clk = FakeClock()
+        eng = BurnRateEngine(clock=clk)
+        return eng, clk
+
+    def test_burn_needs_both_windows(self):
+        """The fast window alone never fires: a long stretch of healthy
+        traffic in the slow window absorbs a short violation spike."""
+        eng, clk = self._engine()
+        # 10k healthy deliveries fill the slow window
+        for _ in range(10_000):
+            eng.observe("t1", 0.001)
+        # past the fast window (60s) but inside the slow one (300s)
+        clk.t = 250.0
+        for i in range(10):
+            if i < 5:
+                eng.observe_violation("t1")
+            else:
+                eng.observe("t1", 0.001)
+        fast, slow = eng._burns("t1", eng._tenants["t1"])
+        assert fast >= eng.burn_threshold      # 5/10 of the fast budget
+        assert slow < eng.burn_threshold       # diluted by the 10k
+        assert eng.evaluate() == []
+        assert not eng.is_burning("t1")
+        # sustained violations push the slow window over too → fires
+        for _ in range(200):
+            eng.observe_violation("t1")
+        trans = eng.evaluate()
+        assert [t["kind"] for t in trans] == ["slo_burn"]
+        assert trans[0]["tenant"] == "t1"
+        assert eng.is_burning("t1")
+
+    def test_latency_budget_burns_without_failures(self):
+        """Deliveries slower than the p99 objective spend the latency
+        budget even when every message arrives."""
+        eng, clk = self._engine()
+        eng.configure_tenant("slow-t", p99_ms=100.0)
+        for _ in range(10):
+            eng.observe("slow-t", 0.5)     # 500ms > 100ms objective
+        fast, slow = eng._burns("slow-t", eng._tenants["slow-t"])
+        # 100% over-latency against the 1% allowance
+        assert fast == pytest.approx(100.0)
+        assert slow == pytest.approx(100.0)
+        assert [t["kind"] for t in eng.evaluate()] == ["slo_burn"]
+
+    def test_cooldown_holds_then_recovers(self):
+        eng, clk = self._engine()
+        eng.configure(cooldown_s=500.0)
+        for _ in range(10):
+            eng.observe_violation("t1")
+        assert [t["kind"] for t in eng.evaluate()] == ["slo_burn"]
+        # windows drain: burn drops to zero, but the cooldown pins the
+        # burning flag — no flapping recovery
+        clk.t = 400.0
+        fast, slow = eng._burns("t1", eng._tenants["t1"])
+        assert fast == 0.0 and slow == 0.0
+        assert eng.evaluate() == []
+        assert eng.is_burning("t1")
+        # past the cooldown the episode closes with ONE recovery event
+        clk.t = 520.0
+        trans = eng.evaluate()
+        assert [t["kind"] for t in trans] == ["slo_recovered"]
+        assert not eng.is_burning("t1")
+        assert eng.evaluate() == []
+
+    def test_journal_records_transitions(self):
+        SLO_EVENTS.reset()
+        eng, clk = self._engine()
+        eng.configure(cooldown_s=0.0)
+        for _ in range(10):
+            eng.observe_violation("t1")
+        eng.evaluate()
+        clk.t = 400.0
+        eng.evaluate()
+        kinds = [e["kind"] for e in SLO_EVENTS.tail(10)]
+        assert kinds == ["slo_burn", "slo_recovered"]
+        burn = SLO_EVENTS.tail(10)[0]
+        assert burn["tenant"] == "t1"
+        assert burn["threshold"] == eng.burn_threshold
+        assert burn["objective"]["success"] == eng.default_success
+
+    def test_window_reconfigure_clears_state(self):
+        eng, _clk = self._engine()
+        for _ in range(10):
+            eng.observe_violation("t1")
+        eng.configure(fast_window_s=30.0)
+        assert eng._tenants == {}
+        assert eng.fast_window_s == 30.0
+
+    def test_per_tenant_objective_and_clear(self):
+        eng, _clk = self._engine()
+        eng.configure_tenant("gold", p99_ms=50.0, success=0.9999)
+        assert eng.objective("gold") == {"p99_ms": 50.0, "success": 0.9999}
+        eng.clear_tenant("gold")
+        assert eng.objective("gold")["p99_ms"] == eng.default_p99_ms
+
+
+# ---------------------------------------------------------------------------
+# e2e plane: HLC delta recording, skew clamp, watermark watch, shard board
+# ---------------------------------------------------------------------------
+
+class TestE2EPlane:
+    def _plane(self, wall_ms=1000.0):
+        clk = FakeClock()
+        wall = [wall_ms]
+        plane = E2EPlane(clock=clk, wall_ms=lambda: wall[0])
+        return plane, clk, wall
+
+    @staticmethod
+    def _hlc(ms):
+        return int(ms) << 16
+
+    def test_records_publish_to_deliver_delta(self):
+        plane, _clk, wall = self._plane(wall_ms=1500.0)
+        s = plane.record("t1", 0, "local_fanout", self._hlc(1000))
+        assert s == pytest.approx(0.5)
+        snap = plane.snapshot_tenant("t1")
+        h = snap["paths"]["local_fanout"]["qos0"]
+        assert h["count"] == 1
+        assert 250 <= h["p99_ms"] <= 1000     # log2 bucket containing 500ms
+
+    def test_negative_skew_clamped_and_counted(self):
+        plane, _clk, _wall = self._plane(wall_ms=1000.0)
+        s = plane.record("t1", 1, "remote", self._hlc(5000))  # future stamp
+        assert s == 0.0
+        assert plane.skew_clamped == 1
+        assert plane.snapshot()["skew_clamped"] == 1
+
+    def test_violations_per_reason(self):
+        plane, _clk, _wall = self._plane()
+        plane.record_violation("t1", 0, "shed")
+        plane.record_violation("t1", 0, "shed")
+        plane.record_violation("t1", 1, "expired")
+        snap = plane.snapshot_tenant("t1")
+        assert snap["violations"] == {"shed": 2.0, "expired": 1.0}
+        assert snap["violations_total"] == 3.0
+
+    def test_watermark_continuous_time_above(self):
+        plane, clk, _wall = self._plane()
+        assert plane.note_watermark("c1", True) == 0.0
+        clk.t = 1.5
+        assert plane.note_watermark("c1", True) == pytest.approx(1.5)
+        g = plane.watermark_gauges()
+        assert g["over_high_water"] == 1
+        assert g["max_over_s"] == pytest.approx(1.5)
+        # draining below high water resets the episode
+        assert plane.note_watermark("c1", False) == 0.0
+        clk.t = 2.0
+        assert plane.note_watermark("c1", True) == 0.0
+        plane.drop_watermark("c1")
+        assert plane.watermark_gauges()["over_high_water"] == 0
+
+    def test_degraded_attribution_bounded(self):
+        plane, _clk, _wall = self._plane()
+        plane.set_degraded("mesh:shard2", "device_timeout")
+        first = plane.degraded()["mesh:shard2"]["since"]
+        plane.set_degraded("mesh:shard2", "shard_group_timeout")
+        d = plane.degraded()["mesh:shard2"]
+        assert d["reason"] == "shard_group_timeout"
+        assert d["since"] == first            # re-mark keeps the onset
+        plane.clear_degraded("mesh:shard2")
+        assert plane.degraded() == {}
+
+    def test_qos_rollup_merges_tenants_and_paths(self):
+        plane, _clk, wall = self._plane(wall_ms=1010.0)
+        plane.record("t1", 0, "local_fanout", self._hlc(1000))
+        plane.record("t2", 0, "remote", self._hlc(1000))
+        plane.record("t1", 1, "local_fanout", self._hlc(1000))
+        plane.record_violation("t2", 0, "shed")
+        roll = plane.qos_rollup()
+        assert roll["qos0"]["count"] == 2
+        assert roll["qos1"]["count"] == 1
+        assert roll["violations"] == 1.0
+
+    def test_record_overhead_under_20us(self):
+        """Tentpole bound: full-population recording must stay off the
+        latency budget it measures."""
+        plane = E2EPlane()
+        stamp = HLC.INST.get()
+        for _ in range(500):                  # warm the tenant entry
+            plane.record("t1", 0, "local_fanout", stamp)
+        n = 5000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            plane.record("t1", 0, "local_fanout", stamp)
+        per_call = (time.perf_counter() - t0) / n
+        assert per_call < 20e-6, f"record took {per_call * 1e6:.1f}µs"
+
+
+class TestShardCompletionBoard:
+    def test_ready_rows_and_hang_naming(self):
+        b = ShardCompletionBoard()
+        b.note_ready(0, 0.01)
+        b.note_ready(1, 0.02)
+        b.note_hung(2, "device_timeout")
+        assert b.hung_shards() == [2]
+        snap = b.snapshot()
+        assert snap["hung"] == [2]
+        assert snap["shards"]["2"]["hung"] is True
+        assert snap["shards"]["2"]["reason"] == "device_timeout"
+        assert snap["shards"]["0"]["last_ready_ms"] == pytest.approx(10.0)
+        # a later completion clears the hang
+        b.note_ready(2, 0.05)
+        assert b.hung_shards() == []
+        assert b.snapshot()["shards"]["2"]["hung"] is False
+
+    def test_deadline_hint_needs_history(self):
+        b = ShardCompletionBoard()
+        assert b.deadline_hint(0, 10.0) == 10.0      # no samples yet
+        for _ in range(4):
+            b.note_ready(0, 0.01)
+        # 4×max(recent) = 40ms, floored at 50ms — well under the default
+        assert b.deadline_hint(0, 10.0) == pytest.approx(0.05)
+        assert b.deadline_hint(0, None) is None
+
+
+# ---------------------------------------------------------------------------
+# path/qos attribution through a real broker
+# ---------------------------------------------------------------------------
+
+def _paths(tenant):
+    return OBS.e2e.snapshot_tenant(tenant).get("paths", {})
+
+
+@pytest.mark.asyncio
+class TestPathAttribution:
+    @pytest.fixture
+    async def broker(self):
+        from bifromq_tpu.mqtt.broker import MQTTBroker
+        b = MQTTBroker(port=0)
+        await b.start()
+        yield b
+        b.inbox.close()
+        await b.stop()
+
+    async def _client(self, broker, cid, user):
+        from bifromq_tpu.mqtt.client import MQTTClient
+        c = MQTTClient(port=broker.port, client_id=cid, username=user)
+        await c.connect()
+        return c
+
+    async def test_local_fanout_per_qos(self, broker):
+        sub = await self._client(broker, "s1", "t1/s")
+        await sub.subscribe("a/t", qos=1)
+        pub = await self._client(broker, "p1", "t1/p")
+        await pub.publish("a/t", b"x", qos=0)
+        await pub.publish("a/t", b"y", qos=1)
+        await sub.recv()
+        await sub.recv()
+        paths = _paths("t1")
+        assert paths["local_fanout"]["qos0"]["count"] == 1
+        assert paths["local_fanout"]["qos1"]["count"] == 1
+        # successes feed the burn denominator too
+        assert OBS.burnrate._tenants["t1"].fast_total.total() == 2.0
+        for c in (sub, pub):
+            await c.disconnect()
+
+    async def test_shared_sub_path(self, broker):
+        sub = await self._client(broker, "s1", "t2/s")
+        await sub.subscribe("$share/g/a/t", qos=0)
+        pub = await self._client(broker, "p1", "t2/p")
+        await pub.publish("a/t", b"x", qos=1)
+        await sub.recv()
+        assert _paths("t2")["shared_sub"]["qos0"]["count"] == 1
+        for c in (sub, pub):
+            await c.disconnect()
+
+    async def test_retained_replay_path(self, broker):
+        pub = await self._client(broker, "p1", "t3/p")
+        await pub.publish("a/t", b"keep", qos=1, retain=True)
+        sub = await self._client(broker, "s1", "t3/s")
+        await sub.subscribe("a/t", qos=0)
+        msg = await sub.recv()
+        assert msg.payload == b"keep"
+        paths = _paths("t3")
+        assert paths["retained"]["qos0"]["count"] == 1
+        # retained replay counts toward delivery success but its age is
+        # NOT a latency sample for the burn engine
+        w = OBS.burnrate._tenants["t3"]
+        assert w.fast_lat.total() == 0.0
+        for c in (sub, pub):
+            await c.disconnect()
+
+    async def test_inbox_replay_path(self, broker):
+        from bifromq_tpu.mqtt.client import MQTTClient
+        sub = MQTTClient(port=broker.port, client_id="ps1",
+                         username="t4/s", clean_start=False)
+        await sub.connect()
+        await sub.subscribe("a/t", qos=1)
+        await sub.disconnect()
+        pub = await self._client(broker, "p1", "t4/p")
+        await pub.publish("a/t", b"queued", qos=1)
+        sub2 = MQTTClient(port=broker.port, client_id="ps1",
+                          username="t4/s", clean_start=False)
+        await sub2.connect()
+        msg = await sub2.recv()
+        assert msg.payload == b"queued"
+        assert _paths("t4")["inbox_replay"]["qos1"]["count"] == 1
+        for c in (sub2, pub):
+            await c.disconnect()
+
+    async def test_remote_hop_path(self, broker):
+        """A hop that crossed processes: the deliverer RPC entry point
+        attributes to "remote", and the HLC stamped by the publishing
+        process survives the wire so the delta is end-to-end."""
+        from bifromq_tpu.dist.deliverer import (DelivererRPCService,
+                                                encode_deliver)
+        from bifromq_tpu.types import (ClientInfo, MatchInfo, Message,
+                                       PublisherMessagePack, QoS,
+                                       RouteMatcher, TopicMessagePack)
+        sub = await self._client(broker, "s1", "t5/s")
+        await sub.subscribe("r/t", qos=0)
+        session = next(s for s in broker.local_sessions._by_id.values()
+                       if s.client_id == "s1")
+        msg = Message(message_id=1, pub_qos=QoS.AT_MOST_ONCE,
+                      payload=b"far", timestamp=HLC.INST.get())
+        pack = TopicMessagePack(
+            topic="r/t",
+            packs=(PublisherMessagePack(
+                publisher=ClientInfo(tenant_id="t5"),
+                messages=(msg,)),))
+        mi = MatchInfo(matcher=RouteMatcher.from_topic_filter("r/t"),
+                       receiver_id=session.session_id)
+        svc = DelivererRPCService(broker.sub_brokers, "nodeA")
+        payload = encode_deliver("t5", 0, "d0", pack, [mi])
+        await svc._on_deliver(payload, "")
+        got = await sub.recv()
+        assert got.payload == b"far"
+        assert _paths("t5")["remote"]["qos0"]["count"] == 1
+        await sub.disconnect()
+
+    async def test_shed_counts_as_violation(self, broker):
+        OBS.record_delivery_violation("t6", 0, "shed")
+        snap = OBS.e2e.snapshot_tenant("t6")
+        assert snap["violations"] == {"shed": 1.0}
+        assert OBS.burnrate._tenants["t6"].fast_viol.total() == 1.0
+
+
+# ---------------------------------------------------------------------------
+# API surface: /slo, /cluster/slo, PUT /obs knobs, /tenants/<id>
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+class TestSLOAPI:
+    async def _http(self, port, method, path, body=b""):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(
+            f"{method} {path} HTTP/1.1\r\nhost: x\r\n"
+            f"content-length: {len(body)}\r\n"
+            f"connection: close\r\n\r\n".encode() + body)
+        await writer.drain()
+        raw = await reader.read(262144)
+        writer.close()
+        head, _, payload = raw.partition(b"\r\n\r\n")
+        return int(head.split(b" ")[1]), json.loads(payload)
+
+    @pytest.fixture
+    async def stack(self):
+        from bifromq_tpu.apiserver import APIServer
+        from bifromq_tpu.mqtt.broker import MQTTBroker
+        broker = MQTTBroker(port=0)
+        await broker.start()
+        api = APIServer(broker, port=0)
+        await api.start()
+        yield broker, api
+        await api.stop()
+        broker.inbox.close()
+        await broker.stop()
+
+    async def test_slo_endpoint_shape(self, stack):
+        broker, api = stack
+        OBS.record_delivery("t1", 0, "local_fanout", HLC.INST.get())
+        OBS.record_delivery_violation("t1", 0, "shed")
+        code, out = await self._http(api.port, "GET", "/slo")
+        assert code == 200
+        assert out["burn"]["burn_threshold"] == OBS.burnrate.burn_threshold
+        assert "t1" in out["e2e"]["tenants"]
+        assert isinstance(out["events"], list)
+
+    async def test_put_obs_slo_defaults_and_tenant_override(self, stack):
+        broker, api = stack
+        code, out = await self._http(
+            api.port, "PUT",
+            "/obs?slo_p99_ms=100&slo_burn_threshold=5&slo_cooldown_s=7")
+        assert code == 200
+        assert out["slo"]["defaults"]["p99_ms"] == 100.0
+        assert out["slo"]["burn_threshold"] == 5.0
+        assert out["slo"]["cooldown_s"] == 7.0
+        code, out = await self._http(
+            api.port, "PUT", "/obs?tenant_id=gold&slo_p99_ms=50")
+        assert code == 200
+        assert out["slo"]["overrides"]["gold"]["p99_ms"] == 50.0
+        # window knobs are engine-wide: rejected with tenant_id
+        code, _ = await self._http(
+            api.port, "PUT", "/obs?tenant_id=gold&slo_fast_window_s=5")
+        assert code == 400
+        code, out = await self._http(
+            api.port, "PUT", "/obs?tenant_id=gold&clear=1")
+        assert out["slo"]["overrides"] == {}
+
+    async def test_tenant_detail_carries_burn_and_e2e(self, stack):
+        broker, api = stack
+        OBS.record_delivery("t1", 1, "local_fanout", HLC.INST.get())
+        OBS.windows.record_flow("t1")
+        code, out = await self._http(api.port, "GET", "/tenants/t1")
+        assert code == 200
+        assert out["burn"]["fast_total"] == 1.0
+        assert out["e2e"]["paths"]["local_fanout"]["qos1"]["count"] == 1
+
+    async def test_cluster_slo_standalone(self, stack):
+        broker, api = stack
+        for _ in range(10):
+            OBS.burnrate.observe_violation("t9")
+        OBS.burnrate.evaluate()
+        code, out = await self._http(api.port, "GET", "/cluster/slo")
+        assert code == 200
+        me = out["nodes"][OBS.node_id]
+        assert me["self"] is True
+        assert "t9" in me["slo"]["burning"]
+        assert out["burning"] == ["t9"]
